@@ -1,0 +1,13 @@
+"""Core runtime: flags, logging, monitors, timers.
+
+Role of the reference's platform layer (``paddle/fluid/platform/``):
+gflags (``flags.cc``), glog VLOG, ``platform/monitor.h`` named counters,
+``platform::Timer`` hot-path timers.
+"""
+
+from paddlebox_tpu.core import flags
+from paddlebox_tpu.core import log
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.core import timers
+
+__all__ = ["flags", "log", "monitor", "timers"]

@@ -1,0 +1,208 @@
+"""Typed, env-settable flag registry.
+
+Role of the reference's gflags config core (``paddle/fluid/platform/flags.cc``:
+95 exported ``FLAGS_*`` flags, PaddleBox block at ``flags.cc:956-1007``) and the
+python ``get_flags``/``set_flags`` API
+(``python/paddle/fluid/framework.py`` get_flags/set_flags).
+
+Flags are declared with :func:`define_flag`, may be overridden by environment
+variables named ``FLAGS_<name>`` (checked at first read), and are readable /
+settable at runtime via :func:`get_flags` / :func:`set_flags`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+
+class FlagError(Exception):
+    pass
+
+
+def _parse_bool(s: str) -> bool:
+    v = s.strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    raise FlagError(f"cannot parse {s!r} as bool")
+
+
+def _parse_int(s: str) -> int:
+    s = s.strip()
+    try:
+        # Decimal first so zero-padded values ("08") parse; fall back to
+        # base-0 for hex/octal/binary literals ("0x10").
+        return int(s, 10)
+    except ValueError:
+        return int(s, 0)
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: _parse_int,
+    float: float,
+    str: lambda s: s,
+}
+
+
+def _parse(ftype: type, raw: str, name: str) -> Any:
+    try:
+        return _PARSERS[ftype](raw)
+    except (ValueError, FlagError) as e:
+        raise FlagError(
+            f"cannot parse {raw!r} as {ftype.__name__} for flag {name!r}: {e}"
+        ) from None
+
+
+@dataclasses.dataclass
+class _Flag:
+    name: str
+    type: type
+    default: Any
+    help: str
+    value: Any = None
+    # Whether an explicit set_flags / env override has happened.
+    explicit: bool = False
+    env_checked: bool = False
+
+
+class FlagRegistry:
+    """Process-global registry of typed flags with env overrides."""
+
+    def __init__(self, env_prefix: str = "FLAGS_"):
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.RLock()
+        self._env_prefix = env_prefix
+
+    def define(self, name: str, default: Any, help: str = "",
+               type: Optional[type] = None) -> None:
+        with self._lock:
+            if name in self._flags:
+                raise FlagError(f"flag {name!r} already defined")
+            ftype = type if type is not None else builtins_type(default)
+            if ftype not in _PARSERS:
+                raise FlagError(f"unsupported flag type {ftype} for {name!r}")
+            self._flags[name] = _Flag(name=name, type=ftype, default=default,
+                                      value=default, help=help)
+
+    def _resolve_env(self, f: _Flag) -> None:
+        if f.env_checked:
+            return
+        env_name = self._env_prefix + f.name
+        raw = os.environ.get(env_name)
+        if raw is not None and not f.explicit:
+            # Parse before marking checked: a malformed env value raises
+            # FlagError on every read rather than silently degrading to the
+            # default after the first failure.
+            f.value = _parse(f.type, raw, f.name)
+            f.explicit = True
+        f.env_checked = True
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            f = self._require(name)
+            self._resolve_env(f)
+            return f.value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            f = self._require(name)
+            if isinstance(value, str) and f.type is not str:
+                value = _parse(f.type, value, name)
+            if not isinstance(value, f.type) and f.type is float and isinstance(value, int):
+                value = float(value)
+            if not isinstance(value, f.type):
+                raise FlagError(
+                    f"flag {name!r} expects {f.type.__name__}, got "
+                    f"{type(value).__name__}")
+            f.value = value
+            f.explicit = True
+            f.env_checked = True
+
+    def _require(self, name: str) -> _Flag:
+        if name not in self._flags:
+            raise FlagError(f"unknown flag {name!r}")
+        return self._flags[name]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._flags)
+
+    def describe(self, name: str) -> str:
+        with self._lock:
+            f = self._require(name)
+            return f.help
+
+
+def builtins_type(v: Any) -> type:
+    if isinstance(v, bool):
+        return bool
+    if isinstance(v, int):
+        return int
+    if isinstance(v, float):
+        return float
+    if isinstance(v, str):
+        return str
+    raise FlagError(f"cannot infer flag type from {v!r}")
+
+
+GLOBAL = FlagRegistry()
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                type: Optional[type] = None) -> None:
+    GLOBAL.define(name, default, help, type)
+
+
+def get_flags(names: Union[str, Sequence[str]]) -> Dict[str, Any]:
+    """Read one or many flags; mirrors paddle's ``get_flags`` signature."""
+    if isinstance(names, str):
+        names = [names]
+    return {n: GLOBAL.get(n) for n in names}
+
+
+def set_flags(values: Dict[str, Any]) -> None:
+    """Set many flags; mirrors paddle's ``set_flags`` signature."""
+    for k, v in values.items():
+        GLOBAL.set(k, v)
+
+
+def flag(name: str) -> Any:
+    """Scalar read shorthand used on hot paths."""
+    return GLOBAL.get(name)
+
+
+# ---------------------------------------------------------------------------
+# Built-in flags. These mirror the *roles* of the reference's PaddleBox flag
+# block (``platform/flags.cc:956-1007``) re-expressed for the TPU runtime.
+# ---------------------------------------------------------------------------
+
+define_flag("v", 0, "global VLOG verbosity level (role of glog FLAGS_v)")
+define_flag("check_nan_inf", False,
+            "scan train-step outputs for NaN/Inf and abort the pass "
+            "(role of FLAGS_check_nan_inf + nan_inf_utils_detail)")
+define_flag("enable_pallas_kernels", True,
+            "use Pallas TPU kernels for hot ops where available; "
+            "fall back to pure-XLA lowering when False (or on CPU tests)")
+define_flag("embedding_shard_slack", 1.3,
+            "over-allocation factor for per-shard bucket capacity in the "
+            "sparse pull/push all-to-all (static-shape padding headroom)")
+define_flag("padbox_record_pool_max", 1 << 22,
+            "max pooled slot records held for reuse by the data pipeline "
+            "(role of FLAGS_padbox_record_pool_max_size)")
+define_flag("padbox_max_shuffle_wait_count", 16,
+            "flow-control window for cross-node dataset shuffle "
+            "(role of FLAGS_padbox_max_shuffle_wait_count)")
+define_flag("dense_sync_steps", 1,
+            "k-step dense parameter sync interval in BoxPS-style training "
+            "(role of BoxPSWorker::SyncParam sync_step)")
+define_flag("auc_num_buckets", 1 << 20,
+            "prediction histogram buckets for exact distributed AUC "
+            "(role of BasicAucCalculator _table size, metrics.cc:33)")
+define_flag("profile_trainer", False,
+            "per-op/per-stage timing in the trainer hot loop "
+            "(role of TrainFilesWithProfiler)")

@@ -1,0 +1,45 @@
+"""Leveled logging with VLOG semantics.
+
+Role of glog + ``VLOG(n)`` used throughout the reference C++ core. Verbosity
+is controlled by the ``v`` flag (env ``FLAGS_v``), matching how the reference
+reads ``GLOG_v``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from paddlebox_tpu.core import flags
+
+_logger = logging.getLogger("paddlebox_tpu")
+if not _logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(
+        "%(levelname).1s %(asctime)s.%(msecs)03d %(name)s] %(message)s",
+        datefmt="%m%d %H:%M:%S"))
+    _logger.addHandler(_h)
+    _logger.setLevel(logging.INFO)
+    _logger.propagate = False
+
+
+def vlog(level: int, msg: str, *args) -> None:
+    """Log ``msg`` when the global verbosity flag is >= ``level``."""
+    if flags.flag("v") >= level:
+        _logger.info(msg, *args)
+
+
+def info(msg: str, *args) -> None:
+    _logger.info(msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    _logger.warning(msg, *args)
+
+
+def error(msg: str, *args) -> None:
+    _logger.error(msg, *args)
+
+
+def get_logger() -> logging.Logger:
+    return _logger

@@ -1,0 +1,46 @@
+"""Named global counters.
+
+Role of ``paddle/fluid/platform/monitor.h`` (``platform::Monitor`` /
+``StatRegistry`` named int64 stats, e.g. GPU memory counters). Thread-safe,
+process-global, cheap to bump from the data pipeline and trainer threads.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class Monitor:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: Dict[str, int] = {}
+
+    def add(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + delta
+
+    def set(self, name: str, value: int) -> None:
+        with self._lock:
+            self._stats[name] = value
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+GLOBAL = Monitor()
+
+add = GLOBAL.add
+set_stat = GLOBAL.set
+get = GLOBAL.get
+snapshot = GLOBAL.snapshot
+reset = GLOBAL.reset

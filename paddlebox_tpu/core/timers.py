@@ -1,0 +1,90 @@
+"""Hot-path timers and timer groups.
+
+Role of ``platform::Timer`` (``paddle/fluid/platform/timer.h``) and the
+per-device timer block in ``DeviceBoxData`` printed by ``PrintSyncTimer``
+(``fleet/box_wrapper.h:395-420``): resumable accumulating timers used to
+attribute pass wall-time to pipeline stages (read / pack / pull / fwd-bwd /
+push / sync).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+
+class Timer:
+    """Accumulating resumable timer (Pause/Resume/Reset semantics)."""
+
+    __slots__ = ("_elapsed", "_start", "_count")
+
+    def __init__(self) -> None:
+        self._elapsed = 0.0
+        self._start = None
+        self._count = 0
+
+    def start(self) -> None:
+        if self._start is None:
+            self._start = time.perf_counter()
+
+    resume = start
+
+    def pause(self) -> None:
+        if self._start is not None:
+            self._elapsed += time.perf_counter() - self._start
+            self._start = None
+            self._count += 1
+
+    def reset(self) -> None:
+        self._elapsed = 0.0
+        self._start = None
+        self._count = 0
+
+    @property
+    def elapsed_sec(self) -> float:
+        extra = 0.0
+        if self._start is not None:
+            extra = time.perf_counter() - self._start
+        return self._elapsed + extra
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @contextmanager
+    def scope(self) -> Iterator[None]:
+        self.start()
+        try:
+            yield
+        finally:
+            self.pause()
+
+
+class TimerGroup:
+    """Named timers for pass-stage attribution (role of DeviceBoxData timers)."""
+
+    def __init__(self) -> None:
+        self._timers: Dict[str, Timer] = {}
+
+    def __getitem__(self, name: str) -> Timer:
+        t = self._timers.get(name)
+        if t is None:
+            t = self._timers[name] = Timer()
+        return t
+
+    @contextmanager
+    def scope(self, name: str) -> Iterator[None]:
+        with self[name].scope():
+            yield
+
+    def report(self) -> str:
+        parts = []
+        for name in sorted(self._timers):
+            t = self._timers[name]
+            parts.append(f"{name}={t.elapsed_sec * 1e3:.1f}ms/{t.count}")
+        return " ".join(parts)
+
+    def reset(self) -> None:
+        for t in self._timers.values():
+            t.reset()

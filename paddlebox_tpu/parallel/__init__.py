@@ -1,0 +1,29 @@
+"""Distributed parallelism: hybrid mesh topology, collectives, TP/PP/SP/EP.
+
+Role of the reference's distributed stacks:
+- ``python/paddle/distributed/fleet/base/topology.py`` (HybridCommunicateGroup)
+- ``paddle/fluid/operators/collective/`` + ``distributed/collective/``
+  (NCCL collective ops / ProcessGroupNCCL)
+- ``fleet/meta_parallel/`` (TP/PP layers and schedules)
+
+TPU-first: communication groups are named axes of one
+``jax.sharding.Mesh``; collectives are XLA ops (`psum`, `all_gather`,
+`ppermute`, ...) inserted by the partitioner or written explicitly inside
+``shard_map`` — there is no NCCL analog to manage.
+"""
+
+from paddlebox_tpu.parallel.topology import (
+    HybridTopology,
+    build_mesh,
+    get_default_topology,
+    set_default_topology,
+)
+from paddlebox_tpu.parallel import collective
+
+__all__ = [
+    "HybridTopology",
+    "build_mesh",
+    "collective",
+    "get_default_topology",
+    "set_default_topology",
+]

@@ -1,0 +1,126 @@
+"""Hybrid-parallel device mesh topology.
+
+Role of ``HybridCommunicateGroup`` (reference
+``python/paddle/distributed/fleet/base/topology.py:52,134``): carve the device
+set into communication groups for data-parallel (dp), pipeline (pp),
+ZeRO-sharding (sharding), tensor/model-parallel (mp), expert (ep), and — new
+for the TPU build — sequence/context parallel (sp) axes.
+
+TPU-first difference: instead of materializing NCCL communicators per group,
+we build ONE ``jax.sharding.Mesh`` whose named axes ARE the groups. pjit /
+shard_map + XLA then insert collectives over the right axis; physical ICI
+adjacency is handled by ``jax.experimental.mesh_utils.create_device_mesh``.
+
+Axis order convention (outermost → innermost): ``dp, sharding, pp, sp, ep,
+mp``. The innermost axis maps to physically-adjacent devices, so mp (the
+highest-frequency, latency-sensitive collectives) rides the fastest ICI
+links; dp (lowest frequency — one gradient sync per step) may cross DCN.
+This extends the reference's [dp, pp, sharding, mp] nesting
+(``topology.py:52``) with sp (long-context sequence parallel) and ep
+(expert parallel, role of the MoE group in ``moe_layer.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis order, outermost first.
+AXIS_ORDER: Tuple[str, ...] = ("dp", "sharding", "pp", "sp", "ep", "mp")
+
+
+@dataclasses.dataclass(frozen=True)
+class HybridTopology:
+    """Degrees of each parallelism axis. 1 = axis unused.
+
+    dp       data parallel (replica groups; gradient allreduce)
+    sharding ZeRO optimizer/gradient/param sharding subgroups inside dp
+    pp       pipeline stages
+    sp       sequence/context parallel (ring attention / Ulysses)
+    ep       expert parallel (MoE all-to-all dispatch group)
+    mp       tensor/model parallel (innermost: fastest ICI)
+    """
+
+    dp: int = 1
+    sharding: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    mp: int = 1
+
+    @property
+    def world_size(self) -> int:
+        n = 1
+        for a in AXIS_ORDER:
+            n *= getattr(self, a)
+        return n
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def nontrivial_axes(self) -> List[str]:
+        return [a for a in AXIS_ORDER if getattr(self, a) > 1]
+
+
+def build_mesh(topo: Optional[HybridTopology] = None,
+               devices: Optional[Sequence[jax.Device]] = None,
+               axis_order: Sequence[str] = AXIS_ORDER) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` realizing the hybrid topology.
+
+    On TPU, uses ``mesh_utils.create_device_mesh`` so the logical mesh
+    respects physical ICI adjacency (innermost axes on nearest neighbors).
+    On CPU (virtual-device tests) falls back to a plain reshape.
+    """
+    if devices is None:
+        devices = jax.devices()
+    ndev = len(devices)
+    if topo is None:
+        topo = HybridTopology(dp=ndev)
+    if topo.world_size != ndev:
+        raise ValueError(
+            f"topology {topo.axis_sizes()} needs {topo.world_size} devices, "
+            f"have {ndev}")
+    shape = tuple(getattr(topo, a) for a in axis_order)
+    if devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+        mesh_devices = mesh_utils.create_device_mesh(
+            shape, devices=list(devices))
+    else:
+        mesh_devices = np.asarray(devices).reshape(shape)
+    return Mesh(mesh_devices, axis_names=tuple(axis_order))
+
+
+# Process-global default topology/mesh (role of fleet.init wiring the global
+# HybridCommunicateGroup, fleet_base.py:211).
+_DEFAULT: Dict[str, object] = {"topo": None, "mesh": None}
+
+
+def set_default_topology(topo: HybridTopology,
+                         devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    mesh = build_mesh(topo, devices)
+    _DEFAULT["topo"] = topo
+    _DEFAULT["mesh"] = mesh
+    return mesh
+
+
+def get_default_topology() -> Tuple[Optional[HybridTopology], Optional[Mesh]]:
+    return _DEFAULT["topo"], _DEFAULT["mesh"]  # type: ignore[return-value]
+
+
+def data_sharding(mesh: Mesh, *,
+                  batch_axes: Sequence[str] = ("dp", "sharding")) -> NamedSharding:
+    """Sharding for a [batch, ...] input: batch split over the replica axes
+    (dp and its inner ZeRO-sharding subgroups). Sequence-parallel splits the
+    sequence dimension, not batch — annotate that separately."""
+    axes = [a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1]
+    spec = P(tuple(axes) if axes else None)
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

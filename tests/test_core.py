@@ -1,0 +1,74 @@
+"""Core runtime tests: flags, monitor, timers (SURVEY.md §2.7 config core)."""
+
+import os
+
+import pytest
+
+from paddlebox_tpu.core import flags, monitor, timers
+
+
+def test_flag_define_get_set():
+    flags.define_flag("test_flag_a", 7, "test int flag")
+    assert flags.get_flags("test_flag_a") == {"test_flag_a": 7}
+    flags.set_flags({"test_flag_a": 11})
+    assert flags.flag("test_flag_a") == 11
+
+
+def test_flag_env_override():
+    os.environ["FLAGS_test_flag_env"] = "42"
+    flags.define_flag("test_flag_env", 1, "env-overridable")
+    assert flags.flag("test_flag_env") == 42
+    # Explicit set wins over env after the fact.
+    flags.set_flags({"test_flag_env": 5})
+    assert flags.flag("test_flag_env") == 5
+
+
+def test_flag_bool_parse():
+    os.environ["FLAGS_test_flag_bool"] = "true"
+    flags.define_flag("test_flag_bool", False, "bool flag")
+    assert flags.flag("test_flag_bool") is True
+
+
+def test_flag_type_check():
+    flags.define_flag("test_flag_typed", 1.5)
+    flags.set_flags({"test_flag_typed": 2})  # int coerced to float
+    assert flags.flag("test_flag_typed") == 2.0
+    with pytest.raises(flags.FlagError):
+        flags.set_flags({"test_flag_typed": [1]})
+
+
+def test_builtin_flags_present():
+    vals = flags.get_flags(["check_nan_inf", "auc_num_buckets",
+                            "dense_sync_steps"])
+    assert vals["auc_num_buckets"] == 1 << 20
+    assert vals["check_nan_inf"] is False
+
+
+def test_monitor_counters():
+    monitor.reset()
+    monitor.add("ins_num", 100)
+    monitor.add("ins_num", 28)
+    monitor.set_stat("epoch", 3)
+    snap = monitor.snapshot()
+    assert snap["ins_num"] == 128
+    assert snap["epoch"] == 3
+
+
+def test_timer_accumulates():
+    t = timers.Timer()
+    with t.scope():
+        pass
+    with t.scope():
+        pass
+    assert t.count == 2
+    assert t.elapsed_sec >= 0.0
+
+
+def test_timer_group_report():
+    g = timers.TimerGroup()
+    with g.scope("pull"):
+        pass
+    with g.scope("push"):
+        pass
+    rep = g.report()
+    assert "pull=" in rep and "push=" in rep
