@@ -1,0 +1,33 @@
+"""Data pipeline: channels, slot records, parsers, datasets.
+
+Role of the reference's L5 data layer (SURVEY.md §2.4):
+``framework/channel.h`` (bounded MPMC channel), ``data_feed.{h,cc,cu}``
+(SlotRecord readers + GPU batch packing), ``data_set.{h,cc}``
+(Dataset load/shuffle/pass lifecycle), ``data_feed.proto`` (slot config).
+
+TPU-first differences: ragged slot data is packed host-side into
+STATIC-shape CSR batches (values + row lengths padded to per-slot
+capacity) so every train step compiles once — replacing LoD tensors and
+the CUDA ``BuildSlotBatchGPU`` path with one vectorized pack.
+"""
+
+from paddlebox_tpu.data.channel import Channel, ClosedChannelError
+from paddlebox_tpu.data.slots import (
+    DataFeedConfig,
+    SlotBatch,
+    SlotConf,
+)
+from paddlebox_tpu.data.parser import parse_lines, register_parser, get_parser
+from paddlebox_tpu.data.dataset import Dataset
+
+__all__ = [
+    "Channel",
+    "ClosedChannelError",
+    "DataFeedConfig",
+    "Dataset",
+    "SlotBatch",
+    "SlotConf",
+    "get_parser",
+    "parse_lines",
+    "register_parser",
+]

@@ -1,0 +1,95 @@
+"""Bounded MPMC channel — the pipe between pipeline stages.
+
+Role of ``paddle/fluid/framework/channel.h`` (``Channel<T>``/``MakeChannel``):
+the universal bounded queue connecting read → merge → shuffle → train stages,
+with close semantics so consumers drain and exit cleanly.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Generic, Iterable, Iterator, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ClosedChannelError(Exception):
+    pass
+
+
+class Channel(Generic[T]):
+    """Bounded blocking channel with close semantics.
+
+    ``put`` blocks when full; ``get`` blocks when empty and raises
+    ``ClosedChannelError`` once the channel is closed AND drained —
+    mirroring the reference channel's read-returns-false-on-closed-empty.
+    """
+
+    def __init__(self, capacity: int = 0):
+        self._cap = capacity  # 0 = unbounded
+        self._q: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+
+    def put(self, item: T) -> None:
+        with self._lock:
+            while self._cap and len(self._q) >= self._cap and not self._closed:
+                self._not_full.wait()
+            if self._closed:
+                raise ClosedChannelError("put on closed channel")
+            self._q.append(item)
+            self._not_empty.notify()
+
+    def put_many(self, items: Iterable[T]) -> None:
+        for it in items:
+            self.put(it)
+
+    def get(self, timeout: Optional[float] = None) -> T:
+        with self._lock:
+            while not self._q:
+                if self._closed:
+                    raise ClosedChannelError("channel closed and drained")
+                if not self._not_empty.wait(timeout=timeout):
+                    raise TimeoutError("channel get timed out")
+            item = self._q.popleft()
+            self._not_full.notify()
+            return item
+
+    def get_many(self, n: int) -> List[T]:
+        """Take up to n items; returns fewer at end-of-stream (>=1), raises
+        when closed-and-drained."""
+        out: List[T] = []
+        with self._lock:
+            while not self._q:
+                if self._closed:
+                    raise ClosedChannelError("channel closed and drained")
+                self._not_empty.wait()
+            while self._q and len(out) < n:
+                out.append(self._q.popleft())
+            self._not_full.notify_all()
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def __iter__(self) -> Iterator[T]:
+        while True:
+            try:
+                yield self.get()
+            except ClosedChannelError:
+                return

@@ -1,0 +1,278 @@
+"""Dataset: file list → threaded load → shuffle → static-shape batches.
+
+Role of the reference's dataset hierarchy (``data_set.{h,cc}``, SURVEY.md
+§2.4): ``PadBoxSlotDataset::LoadIntoMemory`` (reader thread pool feeding a
+channel + pass-key merge, ``data_set.cc:2283-2289``), preload/wait
+(``box_wrapper.h:1140,1161``), local & cross-node shuffle
+(``ShuffleData``/``ReceiveSuffleData``, ``data_set.cc:2436,2544``), and the
+python ``BoxPSDataset`` API (``python/paddle/fluid/dataset.py:1225``).
+
+TPU-first shape: batches are packed host-side to STATIC shapes
+(:class:`SlotBatch`) so the jitted train step never recompiles; per-pass
+unique keys are collected during load (role of ``MergeInsKeys`` →
+``PSAgent::AddKey``) and handed to the sparse embedding engine's
+``feed_pass``. Cross-node shuffle exchanges record buckets between hosts
+(pluggable transport; in-process loopback by default — multi-host wiring
+rides jax distributed / gRPC, not MPI).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import subprocess
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.data.channel import Channel, ClosedChannelError
+from paddlebox_tpu.data.parser import parse_lines
+from paddlebox_tpu.data.slots import DataFeedConfig, Instance, SlotBatch
+
+
+def _read_file_lines(path: str, pipe_command: str) -> Iterator[str]:
+    """Stream lines from a file, optionally through a shell filter.
+
+    Role of ``pipe_command`` in data_feed.proto:47 / shell_popen in
+    ``io/fs.cc:69`` — e.g. ``pipe_command="zcat"`` for gzip shards.
+    """
+    if pipe_command:
+        with open(path, "rb") as f:
+            proc = subprocess.Popen(
+                pipe_command, shell=True, stdin=f,
+                stdout=subprocess.PIPE, bufsize=1 << 20)
+            assert proc.stdout is not None
+            try:
+                for raw in proc.stdout:
+                    yield raw.decode("utf-8", "replace")
+            finally:
+                proc.stdout.close()
+                ret = proc.wait()
+            if ret != 0:
+                # A failing filter (typo'd decompressor, truncated file)
+                # must not silently produce an empty pass.
+                raise RuntimeError(
+                    f"pipe_command {pipe_command!r} exited {ret} on {path}")
+    else:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            yield from f
+
+
+class Dataset:
+    """In-memory slot dataset with pass lifecycle.
+
+    Typical CTR pass loop (mirrors BoxPSDataset usage, dataset.py:1225):
+
+        ds = Dataset(config, num_reader_threads=8)
+        ds.set_filelist(shards)
+        ds.load_into_memory()          # or preload_into_memory + wait
+        ds.local_shuffle(seed)
+        for batch in ds.batches():     # static-shape SlotBatch stream
+            ...
+        ds.clear()
+    """
+
+    def __init__(self, config: DataFeedConfig, *, num_reader_threads: int = 4,
+                 channel_capacity: int = 1 << 14):
+        self.config = config
+        self.num_reader_threads = max(1, num_reader_threads)
+        self._channel_capacity = channel_capacity
+        self._filelist: List[str] = []
+        self._instances: List[Instance] = []
+        self._preload_threads: List[threading.Thread] = []
+        self._preload_channel: Optional[Channel] = None
+        self._reader_errors: List[BaseException] = []
+        self._lock = threading.Lock()
+        # Hook invoked with each loaded instance batch's keys at load time —
+        # wired to the embedding engine's pass-key collector (role of
+        # PSAgent::AddKey threading in MergeInsKeys, data_set.cc:2289).
+        self.key_sink: Optional[Callable[[np.ndarray], None]] = None
+
+    # -- file list ---------------------------------------------------------
+
+    def set_filelist(self, files: Sequence[str]) -> None:
+        missing = [f for f in files if not os.path.exists(f)]
+        if missing:
+            raise FileNotFoundError(f"dataset files missing: {missing[:3]}")
+        self._filelist = list(files)
+
+    @property
+    def filelist(self) -> List[str]:
+        return list(self._filelist)
+
+    # -- load --------------------------------------------------------------
+
+    def _reader_worker(self, file_q: "queue.Queue[str]", out: Channel) -> None:
+        try:
+            self._read_files(file_q, out)
+        except BaseException as e:  # surfaced by load_into_memory/wait
+            with self._lock:
+                self._reader_errors.append(e)
+
+    def _read_files(self, file_q: "queue.Queue[str]", out: Channel) -> None:
+        cfg = self.config
+        while True:
+            try:
+                path = file_q.get_nowait()
+            except queue.Empty:
+                return
+            n = 0
+            chunk: List[str] = []
+            for line in _read_file_lines(path, cfg.pipe_command):
+                chunk.append(line)
+                if len(chunk) >= 4096:
+                    ins = parse_lines(chunk, cfg)
+                    n += len(ins)
+                    out.put_many(ins)
+                    chunk.clear()
+            if chunk:
+                ins = parse_lines(chunk, cfg)
+                n += len(ins)
+                out.put_many(ins)
+            monitor.add("dataset/ins_loaded", n)
+            log.vlog(1, "loaded %d instances from %s", n, path)
+
+    def _start_load(self) -> Channel:
+        file_q: "queue.Queue[str]" = queue.Queue()
+        for f in self._filelist:
+            file_q.put(f)
+        out: Channel = Channel(self._channel_capacity)
+        threads = []
+        nthreads = min(self.num_reader_threads, max(1, len(self._filelist)))
+        for _ in range(nthreads):
+            t = threading.Thread(target=self._reader_worker,
+                                 args=(file_q, out), daemon=True)
+            t.start()
+            threads.append(t)
+
+        def closer():
+            for t in threads:
+                t.join()
+            out.close()
+
+        threading.Thread(target=closer, daemon=True).start()
+        return out
+
+    def _raise_reader_errors(self) -> None:
+        with self._lock:
+            errs, self._reader_errors = self._reader_errors, []
+        if errs:
+            raise errs[0]
+
+    def load_into_memory(self) -> None:
+        """Blocking load of the whole filelist (role of LoadIntoMemory)."""
+        ch = self._start_load()
+        self._drain(ch)
+        self._raise_reader_errors()
+
+    def preload_into_memory(self) -> None:
+        """Start background load (role of PreLoadIntoMemory — overlaps the
+        previous pass's training with the next pass's read)."""
+        ch = self._start_load()
+        self._preload_channel = ch
+        t = threading.Thread(target=self._drain, args=(ch,), daemon=True)
+        t.start()
+        self._preload_threads = [t]
+
+    def wait_preload_done(self) -> None:
+        """Role of WaitPreLoadDone/WaitFeedPassDone."""
+        for t in self._preload_threads:
+            t.join()
+        self._preload_threads = []
+        self._preload_channel = None
+        self._raise_reader_errors()
+
+    def _drain(self, ch: Channel) -> None:
+        sink = self.key_sink
+        local: List[Instance] = []
+        try:
+            while True:
+                items = ch.get_many(1024)
+                local.extend(items)
+                if sink is not None:
+                    keys = [i for ins in items for i in ins.sparse.values()]
+                    if keys:
+                        sink(np.concatenate(keys))
+        except ClosedChannelError:
+            pass
+        with self._lock:
+            self._instances.extend(local)
+
+    # -- shuffle -----------------------------------------------------------
+
+    def local_shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.default_rng(seed)
+        with self._lock:
+            rng.shuffle(self._instances)
+
+    def global_shuffle(self, *, num_ranks: int = 1, rank: int = 0,
+                       exchange: Optional[Callable[[List[List[Instance]]],
+                                                   List[Instance]]] = None,
+                       seed: Optional[int] = None,
+                       allow_partition: bool = False) -> None:
+        """Cross-node record shuffle (role of PadBoxSlotDataset::ShuffleData
+        → boxps::PaddleShuffler → ReceiveSuffleData, data_set.cc:2436,2544).
+
+        Records are hashed into ``num_ranks`` buckets; ``exchange`` ships
+        bucket lists to their owner ranks and returns what this rank
+        receives. With ``num_ranks > 1`` a transport is REQUIRED unless
+        ``allow_partition=True`` explicitly opts into keeping only this
+        rank's bucket (useful to simulate one rank of a cluster — the other
+        buckets are dropped).
+        """
+        if num_ranks > 1 and exchange is None and not allow_partition:
+            raise ValueError(
+                "global_shuffle with num_ranks>1 needs an exchange transport "
+                "(or allow_partition=True to keep only this rank's bucket, "
+                "dropping the rest)")
+        rng = np.random.default_rng(seed)
+        with self._lock:
+            buckets: List[List[Instance]] = [[] for _ in range(num_ranks)]
+            for ins in self._instances:
+                buckets[int(rng.integers(num_ranks))].append(ins)
+            if exchange is None:
+                received = buckets[rank]
+                dropped = sum(len(b) for i, b in enumerate(buckets)
+                              if i != rank)
+                if dropped:
+                    monitor.add("dataset/shuffle_partition_dropped", dropped)
+            else:
+                received = exchange(buckets)
+            self._instances = received
+        self.local_shuffle(seed)
+
+    # -- access ------------------------------------------------------------
+
+    @property
+    def num_instances(self) -> int:
+        with self._lock:
+            return len(self._instances)
+
+    def batches(self, *, drop_last: bool = False,
+                batch_size: Optional[int] = None) -> Iterator[SlotBatch]:
+        """Yield static-shape SlotBatches; the short final batch is padded
+        with invalid rows unless drop_last."""
+        bs = batch_size or self.config.batch_size
+        with self._lock:
+            snapshot = list(self._instances)
+        for i in range(0, len(snapshot), bs):
+            chunk = snapshot[i:i + bs]
+            if len(chunk) < bs and drop_last:
+                return
+            yield SlotBatch.pack(chunk, self.config, bs)
+
+    def pass_keys(self) -> np.ndarray:
+        """Unique feasigns currently loaded (role of the per-pass key set
+        registered via FeedPass, box_wrapper.h:1239)."""
+        with self._lock:
+            parts = [v for ins in self._instances
+                     for v in ins.sparse.values() if v.size]
+        if not parts:
+            return np.empty((0,), np.uint64)
+        return np.unique(np.concatenate(parts))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._instances.clear()

@@ -1,0 +1,86 @@
+"""Line parsers: text → Instance, with a pluggable parser registry.
+
+Role of the reference's reader parse paths
+(``MultiSlotInMemoryDataFeed``/``SlotRecordInMemoryDataFeed`` text parsing,
+``data_feed.cc:2142-2395``) and the ``CustomParser``/``DLManager`` dlopen
+plugin interface (``data_feed.h:446,682``). TPU build: parsers are python
+callables registered by name (a C-extension fast path can register under the
+same name later); ``pipe_command`` preprocessing is handled by the Dataset.
+
+Built-in ``svm`` format, one instance per line:
+
+    <label...> <slot>:<feasign> <slot>:<feasign> ... <slot>:v1,v2,v3 ...
+
+- the first ``num_labels`` whitespace tokens are float labels
+- sparse slot tokens carry a uint64 feasign after the colon
+- dense slot tokens carry a comma-separated float vector
+- unknown slots are ignored (slot filtering = is_used in the reference)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List
+
+import numpy as np
+
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.data.slots import DataFeedConfig, Instance
+
+Parser = Callable[[Iterable[str], DataFeedConfig], List[Instance]]
+
+_REGISTRY: Dict[str, Parser] = {}
+
+
+def register_parser(name: str, fn: Parser) -> None:
+    _REGISTRY[name] = fn
+
+
+def get_parser(name: str) -> Parser:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown parser {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def parse_lines(lines: Iterable[str], config: DataFeedConfig) -> List[Instance]:
+    return get_parser(config.parser)(lines, config)
+
+
+def _parse_svm(lines: Iterable[str], config: DataFeedConfig) -> List[Instance]:
+    sparse_names = {s.name for s in config.sparse_slots}
+    dense_names = {s.name for s in config.dense_slots}
+    nl = config.num_labels
+    out: List[Instance] = []
+    for line in lines:
+        toks = line.split()
+        if len(toks) < nl:
+            continue  # malformed line: skip, like the reference readers do
+        try:
+            labels = np.array([float(t) for t in toks[:nl]], np.float32)
+            sparse: Dict[str, List[int]] = {}
+            dense: Dict[str, np.ndarray] = {}
+            for tok in toks[nl:]:
+                slot, sep, val = tok.partition(":")
+                if not sep:
+                    raise ValueError(f"token without ':': {tok!r}")
+                if slot in sparse_names:
+                    sign = int(val)
+                    if not 0 <= sign < (1 << 64):
+                        raise ValueError(f"feasign out of uint64 range: {val}")
+                    sparse.setdefault(slot, []).append(sign)
+                elif slot in dense_names:
+                    dense[slot] = np.array(
+                        [float(x) for x in val.split(",")], np.float32)
+                # else: unused slot — ignore
+            ins = Instance(
+                labels=labels,
+                sparse={k: np.array(v, np.uint64) for k, v in sparse.items()},
+                dense=dense,
+            )
+        except (ValueError, OverflowError):
+            monitor.add("parser/malformed_lines")
+            continue
+        out.append(ins)
+    return out
+
+
+register_parser("svm", _parse_svm)

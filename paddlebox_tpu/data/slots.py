@@ -1,0 +1,187 @@
+"""Slot configuration and static-shape columnar batches.
+
+Role of the reference's slot machinery:
+- ``DataFeedDesc`` proto (``data_feed.proto:17-57``): slot name/type/
+  is_dense/is_used/shape + batch size + pipe command → here a dataclass.
+- ``SlotRecordObject``/``SlotValues`` (``data_feed.h:97,202``): per-instance
+  ragged slot storage → here instances live as parsed numpy fragments and
+  are packed straight into columnar batches.
+- ``BuildSlotBatchGPU``/``CopyForTensor`` CUDA packing (``data_feed.cc:2713``,
+  ``data_feed.cu:161``) → here :meth:`SlotBatch.pack`, a vectorized host
+  pack into STATIC shapes (padded CSR) so XLA compiles the train step once.
+
+Static-shape discipline (replaces LoD): each sparse slot gets a fixed
+per-batch value capacity ``cap = batch_size * avg_len * slack`` rounded up
+to a multiple of 8. Overflow values are dropped with a monitor count
+(CTR slot data is heavy-tailed; the reference's enable_pv_merge path makes
+the same kind of truncation trade elsewhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import monitor
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotConf:
+    """One input slot (role of ``Slot`` in data_feed.proto:24-33)."""
+
+    name: str
+    is_dense: bool = False
+    # Dense: feature dim. Sparse: ignored (ids are scalar feasigns).
+    dim: int = 1
+    # Sparse only: expected average #ids per instance (capacity planning).
+    avg_len: float = 1.0
+    # Sparse only: hard cap of ids kept per instance (0 = unlimited).
+    max_len: int = 0
+    is_used: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class DataFeedConfig:
+    """Reader configuration (role of DataFeedDesc, data_feed.proto:43-57)."""
+
+    slots: Tuple[SlotConf, ...]
+    batch_size: int = 64
+    num_labels: int = 1
+    pipe_command: str = ""            # shell filter per file ("" = plain read)
+    slot_capacity_slack: float = 1.3  # headroom over batch*avg_len
+    parser: str = "svm"               # registered parser name
+
+    def __post_init__(self):
+        names = [s.name for s in self.slots]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate slot names in {names}")
+
+    @property
+    def sparse_slots(self) -> List[SlotConf]:
+        return [s for s in self.slots if not s.is_dense and s.is_used]
+
+    @property
+    def dense_slots(self) -> List[SlotConf]:
+        return [s for s in self.slots if s.is_dense and s.is_used]
+
+    def sparse_capacity(self, slot: SlotConf,
+                        batch_size: Optional[int] = None) -> int:
+        bs = batch_size or self.batch_size
+        cap = int(bs * slot.avg_len * self.slot_capacity_slack)
+        return max(_round_up(max(cap, bs), 8), 8)
+
+
+@dataclasses.dataclass
+class Instance:
+    """One parsed example: labels + ragged sparse ids + dense values.
+
+    The in-flight record between parser and batch pack (role of
+    SlotRecordObject). Kept deliberately thin — numpy arrays, no pooling;
+    CPython refcounting plays the role of the reference's SlotObjPool.
+    """
+
+    labels: np.ndarray                       # [num_labels] float32
+    sparse: Dict[str, np.ndarray]            # slot -> [n] uint64 feasigns
+    dense: Dict[str, np.ndarray]             # slot -> [dim] float32
+
+
+@dataclasses.dataclass
+class SlotBatch:
+    """A static-shape columnar minibatch (the device-feedable pytree).
+
+    For each sparse slot ``s``:
+      ids[s]      [cap]  uint64 — feasigns, zero-padded
+      segments[s] [cap]  int32  — row index per id; ``batch_size`` for pads
+                                  (so segment_sum with num_segments=B+1
+                                  accumulates pads into a discard row)
+      lengths[s]  [B]    int32  — per-row id counts
+    Dense slot ``d``: dense[d]  [B, dim] float32.
+    labels: [B, num_labels] float32.  valid: [B] bool (False = pad row).
+    """
+
+    labels: np.ndarray
+    valid: np.ndarray
+    ids: Dict[str, np.ndarray]
+    segments: Dict[str, np.ndarray]
+    lengths: Dict[str, np.ndarray]
+    dense: Dict[str, np.ndarray]
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.labels.shape[0])
+
+    @property
+    def num_valid(self) -> int:
+        return int(self.valid.sum())
+
+    def all_sparse_ids(self) -> np.ndarray:
+        """All (possibly duplicate) feasigns in this batch — pass-key feed.
+
+        Role of ``MergeInsKeys``/``PSAgent::AddKey`` (data_set.cc:2289).
+        """
+        parts = [v[:int(l.sum())] for v, l in
+                 ((self.ids[s], self.lengths[s]) for s in self.ids)]
+        if not parts:
+            return np.empty((0,), np.uint64)
+        return np.concatenate(parts)
+
+    @staticmethod
+    def pack(instances: Sequence[Instance], config: DataFeedConfig,
+             batch_size: Optional[int] = None) -> "SlotBatch":
+        """Pack instances into one static-shape batch, padding short batches
+        with invalid rows (role of BuildSlotBatchGPU, vectorized on host)."""
+        bs = batch_size or config.batch_size
+        n = len(instances)
+        if n > bs:
+            raise ValueError(f"{n} instances > batch_size {bs}")
+        labels = np.zeros((bs, config.num_labels), np.float32)
+        valid = np.zeros((bs,), bool)
+        for i, ins in enumerate(instances):
+            labels[i] = ins.labels
+            valid[i] = True
+
+        ids: Dict[str, np.ndarray] = {}
+        segments: Dict[str, np.ndarray] = {}
+        lengths: Dict[str, np.ndarray] = {}
+        for slot in config.sparse_slots:
+            cap = config.sparse_capacity(slot, bs)
+            vals = np.zeros((cap,), np.uint64)
+            segs = np.full((cap,), bs, np.int32)
+            lens = np.zeros((bs,), np.int32)
+            off = 0
+            for i, ins in enumerate(instances):
+                v = ins.sparse.get(slot.name)
+                if v is None or v.size == 0:
+                    continue
+                if slot.max_len and v.size > slot.max_len:
+                    v = v[:slot.max_len]
+                take = min(v.size, cap - off)
+                if take < v.size:
+                    monitor.add(f"slot_overflow/{slot.name}", v.size - take)
+                if take <= 0:
+                    continue
+                vals[off:off + take] = v[:take]
+                segs[off:off + take] = i
+                lens[i] = take
+                off += take
+            ids[slot.name] = vals
+            segments[slot.name] = segs
+            lengths[slot.name] = lens
+
+        dense: Dict[str, np.ndarray] = {}
+        for slot in config.dense_slots:
+            d = np.zeros((bs, slot.dim), np.float32)
+            for i, ins in enumerate(instances):
+                v = ins.dense.get(slot.name)
+                if v is not None:
+                    d[i, :v.size] = v[:slot.dim]
+            dense[slot.name] = d
+
+        return SlotBatch(labels=labels, valid=valid, ids=ids,
+                         segments=segments, lengths=lengths, dense=dense)

@@ -1,0 +1,46 @@
+"""Sparse embedding engine — the TPU-native BoxPS/HeterPS equivalent.
+
+The reference's differentiating capability is a GPU-resident sparse
+parameter server (``fleet/box_wrapper.h``, ``fleet/heter_ps/`` — SURVEY.md
+§2.2/2.3): trillion-feature embedding tables live sharded across device HBM,
+training pulls/pushes only the current pass's working set, and a CPU/SSD
+tier holds everything else between passes.
+
+TPU-native re-design (SURVEY.md §7 step 4): BoxPS is *pass-based* — each
+pass pre-registers its exact key set, so device-side "hashtable lookups"
+become plain indexed gathers into a dense per-pass table:
+
+- host: per-pass key dedup + sorted perfect index (role of PreBuildTask /
+  PSAgent::AddKey), persistent host-RAM feature store between passes
+  (role of the CPU PS tables / SSDSparseTable)
+- device: pass table = contiguous arrays sharded over a mesh axis;
+  pull = shard-bucketed all-to-all + gather (role of HeterComm::pull_sparse
+  walk_to_dest/walk_to_src, heter_comm_inl.h:1628);
+  push = sort + segment-merge dedup + all-to-all + exact fused sparse
+  Adagrad/Adam applied in-place with buffer donation (role of
+  dynamic_merge_grad + update_one_table, optimizer.cuh.h)
+"""
+
+from paddlebox_tpu.embedding.store import FeatureStore
+from paddlebox_tpu.embedding.table import PassTable, TableConfig
+from paddlebox_tpu.embedding.lookup import (
+    pull_local,
+    push_local,
+    make_pull_fn,
+    make_push_fn,
+)
+from paddlebox_tpu.embedding.optimizers import SparseAdagrad, SparseOptimizer
+from paddlebox_tpu.embedding.pass_engine import PassEngine
+
+__all__ = [
+    "FeatureStore",
+    "PassEngine",
+    "PassTable",
+    "SparseAdagrad",
+    "SparseOptimizer",
+    "TableConfig",
+    "make_pull_fn",
+    "make_push_fn",
+    "pull_local",
+    "push_local",
+]

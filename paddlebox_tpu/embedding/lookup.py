@@ -1,0 +1,242 @@
+"""Device-side sparse pull/push: bucketed all-to-all over the table axis.
+
+Role of the HeterComm data path (``heter_comm_inl.h``):
+- pull: ``split_input_to_shard`` → ``walk_to_dest`` → per-shard table get →
+  ``walk_to_src`` (heter_comm_inl.h:1628; NVLink-staged P2P in the
+  reference) → here one XLA ``all_to_all`` pair over the ICI mesh axis.
+- push: ``dynamic_merge_grad`` (cub sort + segment-reduce dedup,
+  heter_comm.h:69) → shard scatter → ``update_one_table`` fused optimizer
+  → here an on-owner sort + segment-sum exact merge + masked scatter
+  update, donation-friendly.
+
+Everything is static-shape: per-destination buckets have fixed capacity
+``C = ceil(n/num_shards * slack)`` (slack flag ``embedding_shard_slack``);
+overflow entries fall into the per-shard trash row. All functions are
+*per-device* bodies meant to run inside ``jax.shard_map`` with the table's
+leading dim sharded over ``axis`` and id/grad batches sharded likewise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.core import flags
+from paddlebox_tpu.embedding.optimizers import SparseAdagrad
+from paddlebox_tpu.embedding.table import PassTable, TableConfig
+
+
+def bucket_capacity(n: int, num_shards: int, slack: Optional[float] = None) -> int:
+    """Static per-destination bucket size for n ids over num_shards.
+
+    Mean + 4σ binomial headroom (keys hash ~uniformly across shards), scaled
+    by the ``embedding_shard_slack`` flag: overflow probability per bucket is
+    ~3e-5 at 4σ, and overflowing entries degrade to a dropped lookup (zeros)
+    /dropped grad rather than corruption.
+    """
+    if slack is None:
+        slack = flags.flag("embedding_shard_slack")
+    mean = n / num_shards
+    c = int(slack * (mean + 4.0 * mean ** 0.5 + 8.0)) + 1
+    c = min(max(c, 1), n)
+    return -(-c // 8) * 8 if c >= 8 else c
+
+
+def _bucket_by_shard(dev_rows: jax.Array, num_shards: int, block: int,
+                     cap: int) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sort ids into per-destination-shard buckets of static capacity.
+
+    Role of split_input_to_shard + fill_shard_key (heter_comm_inl.h:273).
+
+    Returns (send_rows [num_shards, cap] dest-local rows with trash-row
+    fill, order [n] sort permutation, slot_shard [n], slot_pos [n]) where
+    (slot_shard[j], slot_pos[j]) locates sorted element j's reply cell;
+    slot_pos >= cap marks overflow (dropped — reply reads are masked).
+    """
+    n = dev_rows.shape[0]
+    trash = block - 1  # last row of each shard block is the trash row
+    shard_of = jnp.clip(dev_rows // block, 0, num_shards - 1)
+    order = jnp.argsort(shard_of, stable=True)
+    sorted_rows = dev_rows[order]
+    sorted_shard = shard_of[order]
+    starts = jnp.searchsorted(sorted_shard, jnp.arange(num_shards))
+    pos = jnp.arange(n) - starts[sorted_shard]
+    local_row = sorted_rows % block
+    send_rows = jnp.full((num_shards, cap), trash, jnp.int32)
+    # Overflow entries (pos >= cap) use an out-of-range column index so the
+    # scatter drops them instead of clobbering cell 0.
+    send_rows = send_rows.at[sorted_shard, pos].set(
+        local_row.astype(jnp.int32), mode="drop")
+    return send_rows, order, sorted_shard, pos
+
+
+def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
+               ) -> Dict[str, jax.Array]:
+    """Per-device pull: ids [n] (device-row space) → {emb [n, D], w [n],
+    show [n], click [n]}. Padding/overflow ids yield the trash row (zeros
+    unless polluted — push re-zeroes it)."""
+    num_shards = table.num_shards
+    block = table.rows_per_shard + 1
+    n = dev_rows.shape[0]
+    cap = bucket_capacity(n, num_shards)
+
+    send_rows, order, slot_shard, slot_pos = _bucket_by_shard(
+        dev_rows, num_shards, block, cap)
+
+    # Exchange requests: recv_req[s, c] = row requested by peer s.
+    recv_req = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0,
+                              tiled=True).reshape(num_shards, cap)
+    # Serve from the local shard block: one fused [emb | w | show | click]
+    # payload so the reply path is a single collective.
+    d = table.dim
+    served = jnp.concatenate([
+        table.emb[recv_req],                  # [S, C, D]
+        table.w[recv_req][..., None],
+        table.show[recv_req][..., None],
+        table.click[recv_req][..., None],
+    ], axis=-1)                               # [S, C, D+3]
+    reply = lax.all_to_all(
+        served.reshape(num_shards * cap, d + 3), axis,
+        split_axis=0, concat_axis=0, tiled=True
+    ).reshape(num_shards, cap, d + 3)
+    # Route replies back: reply[s, c] = value from shard s for my bucket c.
+    unorder = jnp.argsort(order)
+    in_cap = slot_pos < cap
+    picked = reply[slot_shard, jnp.where(in_cap, slot_pos, 0)]
+    picked = jnp.where(in_cap[:, None], picked, 0)[unorder]
+    return {
+        "emb": picked[:, :d],
+        "w": picked[:, d],
+        "show": picked[:, d + 1],
+        "click": picked[:, d + 2],
+    }
+
+
+def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
+               grad_w: jax.Array, shows: jax.Array, clicks: jax.Array, *,
+               axis: str, opt: Optional[SparseAdagrad] = None) -> PassTable:
+    """Per-device push: exact dedup + fused sparse optimizer update.
+
+    dev_rows [n]; grad_emb [n, D]; grad_w/shows/clicks [n]. Padding entries
+    must carry zero grads (guaranteed upstream because padding ids map to
+    the discard segment) — they land in the trash row regardless.
+    """
+    if opt is None:
+        opt = SparseAdagrad()
+    num_shards = table.num_shards
+    block = table.rows_per_shard + 1
+    n = dev_rows.shape[0]
+    d = table.dim
+    cap = bucket_capacity(n, num_shards)
+    trash = block - 1
+
+    send_rows, order, slot_shard, slot_pos = _bucket_by_shard(
+        dev_rows, num_shards, block, cap)
+    in_cap = slot_pos < cap
+
+    # Payload per bucket cell: [grad_emb D | grad_w | show | click].
+    payload = jnp.concatenate([
+        grad_emb, grad_w[:, None], shows[:, None], clicks[:, None]], axis=-1)
+    sorted_payload = payload[order]
+    send_payload = jnp.zeros((num_shards, cap, d + 3), payload.dtype)
+    # Out-of-range positions (overflow) are dropped by the scatter.
+    send_payload = send_payload.at[slot_shard, slot_pos].add(
+        sorted_payload, mode="drop")
+
+    recv_rows = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0,
+                               tiled=True).reshape(num_shards * cap)
+    recv_payload = lax.all_to_all(
+        send_payload.reshape(num_shards * cap, d + 3), axis,
+        split_axis=0, concat_axis=0, tiled=True
+    ).reshape(num_shards * cap, d + 3)
+
+    # --- owner-side exact merge (role of dynamic_merge_grad) -------------
+    m = num_shards * cap
+    row_order = jnp.argsort(recv_rows)
+    rows_s = recv_rows[row_order]
+    pay_s = recv_payload[row_order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), rows_s[1:] != rows_s[:-1]])
+    seg_ids = jnp.cumsum(is_start) - 1
+    merged = jax.ops.segment_sum(pay_s, seg_ids, num_segments=m)  # [m, d+3]
+    merged_per_elem = merged[seg_ids]
+    rep = is_start & (rows_s != trash)  # one update per real row
+
+    g_emb = merged_per_elem[:, :d]
+    g_w = merged_per_elem[:, d]
+    g_show = merged_per_elem[:, d + 1]
+    g_click = merged_per_elem[:, d + 2]
+
+    # Gather current state at touched rows, apply optimizer, write deltas.
+    cur_emb = table.emb[rows_s]
+    cur_emb_g2 = table.emb_g2sum[rows_s]
+    cur_w = table.w[rows_s]
+    cur_w_g2 = table.w_g2sum[rows_s]
+
+    new_emb, new_emb_g2 = opt.update_vector(cur_emb, cur_emb_g2, g_emb)
+    new_w, new_w_g2 = opt.update_scalar(cur_w, cur_w_g2, g_w)
+
+    repf = rep.astype(table.emb.dtype)
+    emb = table.emb.at[rows_s].add(repf[:, None] * (new_emb - cur_emb))
+    emb_g2 = table.emb_g2sum.at[rows_s].add(repf * (new_emb_g2 - cur_emb_g2))
+    w = table.w.at[rows_s].add(repf * (new_w - cur_w))
+    w_g2 = table.w_g2sum.at[rows_s].add(repf * (new_w_g2 - cur_w_g2))
+    show = table.show.at[rows_s].add(repf * g_show)
+    click = table.click.at[rows_s].add(repf * g_click)
+
+    # Re-zero the trash row so padding pulls keep returning zeros.
+    zero_rows = jnp.arange(1) + trash
+    emb = emb.at[zero_rows].set(0.0)
+    emb_g2 = emb_g2.at[zero_rows].set(0.0)
+    w = w.at[zero_rows].set(0.0)
+    w_g2 = w_g2.at[zero_rows].set(0.0)
+    show = show.at[zero_rows].set(0.0)
+    click = click.at[zero_rows].set(0.0)
+
+    return PassTable(emb=emb, emb_g2sum=emb_g2, w=w, w_g2sum=w_g2,
+                     show=show, click=click,
+                     rows_per_shard=table.rows_per_shard,
+                     num_shards=table.num_shards)
+
+
+# ---------------------------------------------------------------------------
+# Standalone jitted wrappers (tests + simple trainers). Production train
+# steps inline pull_local/push_local into their own shard_map body.
+# ---------------------------------------------------------------------------
+
+def make_pull_fn(mesh: Mesh, axis: str = "dp"):
+    """Jitted (table, dev_rows) -> pulled dict, table/ids sharded on axis.
+
+    ``P(axis)`` is a pytree prefix: it shards every PassTable leaf's
+    leading dim over the table axis.
+    """
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False)
+    def pull(table: PassTable, dev_rows: jax.Array):
+        return pull_local(table, dev_rows, axis=axis)
+
+    return pull
+
+
+def make_push_fn(mesh: Mesh, axis: str = "dp",
+                 opt: Optional[SparseAdagrad] = None):
+    """Jitted sparse-grad apply with table donation."""
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False)
+    def push_sm(table, dev_rows, g_emb, g_w, shows, clicks):
+        return push_local(table, dev_rows, g_emb, g_w, shows, clicks,
+                          axis=axis, opt=opt)
+
+    return jax.jit(push_sm, donate_argnums=(0,))

@@ -1,0 +1,71 @@
+"""Fused sparse optimizers applied inside the push step.
+
+Role of the in-kernel GPU sparse optimizers executed during push
+(``heter_ps/optimizer.cuh.h``: SparseAdagradOptimizer:31,
+SparseAdamOptimizer:148; bounds/decay config ``optimizer_conf.h``).
+
+Each rule is a pure function over per-row (value, state, merged-grad)
+vectors; the lookup layer guarantees the grad passed in is already the
+EXACT per-row sum across all duplicates in the step (dedup happens owner-
+side), so one rule application per touched row per step — matching the
+reference's dedup-then-update contract (dynamic_merge_grad →
+update_one_table, heter_comm_inl.h:1646).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.embedding.table import TableConfig
+
+
+class SparseOptimizer:
+    """Interface: update(value, g2sum, grad) -> (new_value, new_g2sum)."""
+
+    def update_vector(self, value: jax.Array, g2sum: jax.Array,
+                      grad: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        raise NotImplementedError
+
+    def update_scalar(self, value: jax.Array, g2sum: jax.Array,
+                      grad: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseAdagrad(SparseOptimizer):
+    """Per-row scalar-accumulator adagrad (reference optimizer.cuh.h:31-78):
+
+      g2sum' = g2sum + mean(g^2)            (scalar per row)
+      scale  = sqrt(initial_g2sum / (initial_g2sum + g2sum'))
+      value' = clip(value - lr * scale * g, [min_bound, max_bound])
+    """
+
+    learning_rate: float = 0.05
+    initial_g2sum: float = 3.0
+    min_bound: float = -10.0
+    max_bound: float = 10.0
+
+    @classmethod
+    def from_config(cls, cfg: TableConfig) -> "SparseAdagrad":
+        return cls(learning_rate=cfg.learning_rate,
+                   initial_g2sum=cfg.initial_g2sum,
+                   min_bound=cfg.min_bound, max_bound=cfg.max_bound)
+
+    def update_vector(self, value, g2sum, grad):
+        # value/grad: [n, D]; g2sum: [n]
+        add_g2 = jnp.mean(grad * grad, axis=-1)
+        new_g2 = g2sum + add_g2
+        scale = jnp.sqrt(self.initial_g2sum / (self.initial_g2sum + new_g2))
+        new_v = value - self.learning_rate * scale[..., None] * grad
+        return jnp.clip(new_v, self.min_bound, self.max_bound), new_g2
+
+    def update_scalar(self, value, g2sum, grad):
+        # value/grad/g2sum: [n]
+        new_g2 = g2sum + grad * grad
+        scale = jnp.sqrt(self.initial_g2sum / (self.initial_g2sum + new_g2))
+        new_v = value - self.learning_rate * scale * grad
+        return jnp.clip(new_v, self.min_bound, self.max_bound), new_g2

@@ -1,0 +1,143 @@
+"""Pass lifecycle orchestration: feed_pass → begin_pass → train → end_pass.
+
+Role of the BoxWrapper/BoxHelper pass driver (``box_wrapper.h:449-453,
+1034-1301``): per-pass key registration (``FeedPass``), staged build of the
+device table (``BeginFeedPass``/``EndFeedPass``; HeterPS ``PreBuildTask`` →
+``BuildPull`` → ``BuildGPUTask``, ps_gpu_wrapper.cc:114,337,684), training
+window between ``BeginPass``/``EndPass``, and write-back on ``EndPass``.
+
+Double-buffering: ``feed_pass`` may run in a background thread while the
+previous pass trains (role of PreLoadIntoMemory/WaitFeedPassDone overlap,
+box_wrapper.h:1140,1161).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.core import log, monitor, timers
+from paddlebox_tpu.embedding.store import FeatureStore
+from paddlebox_tpu.embedding.table import (PassTable, TableConfig,
+                                           build_pass_table_host,
+                                           extract_pass_values_host,
+                                           map_keys_to_rows)
+
+
+class _PendingPass:
+    def __init__(self):
+        self.keys: Optional[np.ndarray] = None
+        self.table: Optional[PassTable] = None
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+
+class PassEngine:
+    """Owns the FeatureStore + the live per-pass device table."""
+
+    def __init__(self, config: TableConfig, store: Optional[FeatureStore] = None,
+                 *, mesh: Optional[Mesh] = None, table_axis: str = "dp"):
+        self.config = config
+        self.store = store or FeatureStore(config)
+        self.mesh = mesh
+        self.table_axis = table_axis
+        self.num_shards = (
+            int(mesh.shape[table_axis]) if mesh is not None else 1)
+        self.timers = timers.TimerGroup()
+
+        self._current_keys: Optional[np.ndarray] = None
+        self._table: Optional[PassTable] = None
+        self._pending: Optional[_PendingPass] = None
+        self._pass_id = -1
+
+    # -- build -------------------------------------------------------------
+
+    def _build(self, pass_keys: np.ndarray, pending: _PendingPass) -> None:
+        try:
+            with self.timers.scope("feed_pass"):
+                keys = np.unique(np.asarray(pass_keys, np.uint64))
+                keys = keys[keys != 0]  # 0 is the null feasign
+                vals = self.store.pull_for_pass(keys)
+                table = build_pass_table_host(
+                    vals, self.num_shards, self.config)
+                if self.mesh is not None:
+                    sharding = NamedSharding(self.mesh, P(self.table_axis))
+                    table = jax.tree.map(
+                        lambda x: jax.device_put(x, sharding), table)
+                pending.keys = keys
+                pending.table = table
+                monitor.add("pass/built", 1)
+        except BaseException as e:  # propagate to the waiting begin_pass
+            pending.error = e
+
+    def feed_pass(self, pass_keys: np.ndarray, *, async_build: bool = False
+                  ) -> None:
+        """Register the next pass's key set and build its device table.
+
+        ``async_build=True`` overlaps the build with current-pass training
+        (role of PreLoadIntoMemory + WaitFeedPassDone).
+        """
+        pending = _PendingPass()
+        if async_build:
+            t = threading.Thread(target=self._build,
+                                 args=(pass_keys, pending), daemon=True)
+            t.start()
+            pending.thread = t
+        else:
+            self._build(pass_keys, pending)
+        self._pending = pending
+
+    def wait_feed_pass_done(self) -> None:
+        p = self._pending
+        if p is not None and p.thread is not None:
+            p.thread.join()
+        if p is not None and p.error is not None:
+            raise p.error
+
+    # -- pass window -------------------------------------------------------
+
+    def begin_pass(self) -> PassTable:
+        """Swap in the pending pass's table (role of BeginPass)."""
+        self.wait_feed_pass_done()
+        if self._pending is None or self._pending.table is None:
+            raise RuntimeError("begin_pass without a successful feed_pass")
+        self._current_keys = self._pending.keys
+        self._table = self._pending.table
+        self._pending = None
+        self._pass_id += 1
+        log.vlog(1, "begin_pass %d: %d keys, %d shards", self._pass_id,
+                 self._current_keys.shape[0], self.num_shards)
+        return self._table
+
+    @property
+    def table(self) -> PassTable:
+        if self._table is None:
+            raise RuntimeError("no active pass")
+        return self._table
+
+    def update_table(self, table: PassTable) -> None:
+        """Trainer hands back the latest device table after push steps."""
+        self._table = table
+
+    def lookup_rows(self, batch_keys: np.ndarray) -> np.ndarray:
+        """Host map: batch feasigns → device row ids for the active pass."""
+        if self._current_keys is None or self._table is None:
+            raise RuntimeError("no active pass")
+        return map_keys_to_rows(self._current_keys, batch_keys,
+                                self._table.rows_per_shard)
+
+    def end_pass(self) -> None:
+        """Write the pass table back to the store (role of EndPass)."""
+        if self._table is None or self._current_keys is None:
+            raise RuntimeError("end_pass without begin_pass")
+        with self.timers.scope("end_pass"):
+            vals = extract_pass_values_host(
+                self._table, self._current_keys.shape[0])
+            self.store.push_from_pass(self._current_keys, vals)
+        self._table = None
+        self._current_keys = None
+        monitor.add("pass/ended", 1)

@@ -1,0 +1,199 @@
+"""Host-RAM persistent feature store — the between-passes tier.
+
+Role of the CPU parameter-server tables that back the device cache between
+passes: ``MemorySparseTable`` / ``SSDSparseTable``
+(``distributed/ps/table/memory_sparse_table.h``, ``ssd_sparse_table.h``)
+and the BoxPS SSD→mem staging (``LoadSSD2Mem``, ``box_wrapper.h:635``),
+plus base/delta model save (``SaveBase/SaveDelta``, ``box_wrapper.h:628``).
+
+TPU-first: no RPC server — the store is a vectorized sorted-key columnar
+structure in host RAM (keys ascending; one numpy row per feature), accessed
+only at pass boundaries (build / write-back), so throughput is dominated by
+``np.searchsorted`` + fancy-indexing, both memory-bandwidth-bound C loops.
+A future C++ shard can register the same interface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.embedding.table import TableConfig
+
+_FIELDS = ("emb", "emb_g2sum", "w", "w_g2sum", "show", "click")
+
+
+class FeatureStore:
+    """Sorted-key columnar feature store with base+delta checkpointing."""
+
+    def __init__(self, config: TableConfig, seed: int = 0):
+        self.config = config
+        d = config.dim
+        self._keys = np.empty((0,), np.uint64)
+        self._vals: Dict[str, np.ndarray] = {
+            "emb": np.empty((0, d), np.float32),
+            "emb_g2sum": np.empty((0,), np.float32),
+            "w": np.empty((0,), np.float32),
+            "w_g2sum": np.empty((0,), np.float32),
+            "show": np.empty((0,), np.float32),
+            "click": np.empty((0,), np.float32),
+        }
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        # Keys touched since the last save_base (delta set).
+        self._dirty = np.empty((0,), np.uint64)
+        # shrink() decays every row and may evict — states a delta cannot
+        # express. Until the next save_base, save_delta must refuse.
+        self._shrunk_since_base = False
+
+    # -- size --------------------------------------------------------------
+
+    @property
+    def num_features(self) -> int:
+        with self._lock:
+            return int(self._keys.shape[0])
+
+    def _locate(self, k: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(found mask, clipped positions) of keys k in the sorted store.
+        Caller must hold the lock."""
+        m = self._keys.shape[0]
+        if m == 0:
+            return np.zeros(k.shape[0], bool), np.zeros(k.shape[0], np.int64)
+        pos = np.searchsorted(self._keys, k)
+        pos_c = np.minimum(pos, m - 1)
+        return self._keys[pos_c] == k, pos_c
+
+    # -- pass build --------------------------------------------------------
+
+    def pull_for_pass(self, pass_keys_sorted: np.ndarray
+                      ) -> Dict[str, np.ndarray]:
+        """Fetch values for a pass's sorted unique keys; unseen keys are
+        initialized (role of BuildPull fetching value pointers from the CPU
+        PS, ps_gpu_wrapper.cc:362; init ranges role of CtrCommonAccessor)."""
+        k = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+        n = k.shape[0]
+        d = self.config.dim
+        out = {
+            "emb": np.empty((n, d), np.float32),
+            "emb_g2sum": np.zeros((n,), np.float32),
+            "w": np.zeros((n,), np.float32),
+            "w_g2sum": np.zeros((n,), np.float32),
+            "show": np.zeros((n,), np.float32),
+            "click": np.zeros((n,), np.float32),
+        }
+        with self._lock:
+            found, pos_c = self._locate(k)
+            # New keys: small-uniform init for emb, zeros elsewhere.
+            out["emb"][:] = self._rng.uniform(
+                -self.config.init_scale, self.config.init_scale,
+                (n, d)).astype(np.float32)
+            if found.any():
+                for f in _FIELDS:
+                    out[f][found] = self._vals[f][pos_c[found]]
+        monitor.add("store/pass_keys", n)
+        monitor.add("store/new_keys", int(n - found.sum()) if n else 0)
+        return out
+
+    def push_from_pass(self, pass_keys_sorted: np.ndarray,
+                       values: Dict[str, np.ndarray]) -> None:
+        """Write a finished pass's values back (role of EndPass write-back,
+        ps_gpu_wrapper.cc:983). Vectorized sorted merge of new keys."""
+        k = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+        if k.shape[0] == 0:
+            return
+        with self._lock:
+            found, pos_c = self._locate(k)
+            # Update existing rows in place.
+            for f in _FIELDS:
+                self._vals[f][pos_c[found]] = values[f][found]
+            # Merge new rows with one sorted concatenate.
+            new_mask = ~found
+            if new_mask.any():
+                merged_keys = np.concatenate([self._keys, k[new_mask]])
+                order = np.argsort(merged_keys, kind="stable")
+                self._keys = merged_keys[order]
+                for f in _FIELDS:
+                    merged = np.concatenate(
+                        [self._vals[f], values[f][new_mask]])
+                    self._vals[f] = merged[order]
+            self._dirty = np.union1d(self._dirty, k)
+
+    # -- lifecycle maintenance --------------------------------------------
+
+    def shrink(self, *, min_show: float = 0.0) -> int:
+        """Day-level table shrink: decay show/click, evict cold features
+        (role of BoxPS ShrinkTable / pslib shrink)."""
+        cfg = self.config
+        with self._lock:
+            self._shrunk_since_base = True
+            self._vals["show"] *= cfg.show_click_decay
+            self._vals["click"] *= cfg.show_click_decay
+            if min_show > 0:
+                keep = self._vals["show"] >= min_show
+                evicted = int((~keep).sum())
+                if evicted:
+                    self._keys = self._keys[keep]
+                    for f in _FIELDS:
+                        self._vals[f] = self._vals[f][keep]
+                return evicted
+        return 0
+
+    # -- checkpoint: base + delta -----------------------------------------
+
+    def _save_arrays(self, path: str, keys: np.ndarray,
+                     vals: Dict[str, np.ndarray], kind: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        np.savez_compressed(
+            os.path.join(path, f"{self.config.name}.{kind}.npz"),
+            keys=keys, **vals)
+        meta = {"kind": kind, "num_features": int(keys.shape[0]),
+                "dim": self.config.dim, "table": self.config.name}
+        with open(os.path.join(path, f"{self.config.name}.{kind}.meta.json"),
+                  "w") as f:
+            json.dump(meta, f)
+
+    def save_base(self, path: str) -> None:
+        """Full snapshot; resets the delta set (role of SaveBase,
+        box_wrapper.h:628)."""
+        with self._lock:
+            keys = self._keys.copy()
+            vals = {f: self._vals[f].copy() for f in _FIELDS}
+            self._dirty = np.empty((0,), np.uint64)
+            self._shrunk_since_base = False
+        self._save_arrays(path, keys, vals, "base")
+        log.vlog(0, "save_base: %d features -> %s", keys.shape[0], path)
+
+    def save_delta(self, path: str) -> None:
+        """Snapshot of keys touched since last base (role of SaveDelta,
+        box_wrapper.h:630)."""
+        with self._lock:
+            if self._shrunk_since_base:
+                raise RuntimeError(
+                    "save_delta after shrink(): decay/eviction cannot be "
+                    "expressed as a delta — save_base first (the reference's "
+                    "day boundary does the same: shrink, then base dump)")
+            dirty = self._dirty.copy()
+            present, pos = self._locate(dirty)
+            dirty = dirty[present]
+            vals = {f: self._vals[f][pos[present]] for f in _FIELDS}
+        self._save_arrays(path, dirty, vals, "delta")
+        log.vlog(0, "save_delta: %d features -> %s", dirty.shape[0], path)
+
+    def load(self, path: str, kind: str = "base") -> None:
+        """Load a base snapshot, or apply a delta on top."""
+        data = np.load(os.path.join(path, f"{self.config.name}.{kind}.npz"))
+        keys = data["keys"].astype(np.uint64)
+        vals = {f: data[f] for f in _FIELDS}
+        if kind == "base":
+            with self._lock:
+                self._keys = keys
+                self._vals = vals
+                self._dirty = np.empty((0,), np.uint64)
+        else:
+            self.push_from_pass(keys, vals)

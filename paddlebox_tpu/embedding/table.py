@@ -1,0 +1,187 @@
+"""Per-pass device table: contiguous sharded arrays + index math.
+
+Role of the HeterPS HBM structures: the per-GPU hashtable + mem_pool value
+slabs (``heter_ps/hashtable.h``, ``mem_pool.h``) and the
+``CommonFeatureValue`` record layout (``heter_ps/feature_value.h:44-120``:
+show, click, embed_w(lr), embed_g2sum, embedx_w[mf], embedx_g2sum).
+
+TPU-first: because the pass key set is pre-registered (pass-based design),
+the device table needs NO hashtable — rows are assigned by sorted-key rank,
+split contiguously across shards. Each shard carries one extra trash row
+(index ``rows_per_shard``) that absorbs padding lookups and padding grads,
+so every kernel is mask-free and static-shape.
+
+Index math (device-side, int32):
+  global row g of key k  = rank of k in the sorted pass key set (host)
+  shard(g)               = g // rows_per_shard
+  row_in_shard(g)        = g %  rows_per_shard
+  padding sentinel       = N_pad (maps to trash row of shard 0)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TableConfig:
+    """Sparse table hyper-params (role of the accessor/optimizer config in
+    the_one_ps.proto + optimizer_conf.h)."""
+
+    name: str = "embedding"
+    dim: int = 8                  # mf embedding width (embedx_dim)
+    num_shards: int = 1           # table shards == size of the shard mesh axis
+    # Initialization (role of CtrCommonAccessor init ranges).
+    init_scale: float = 0.01
+    # Sparse adagrad hyper-params (role of optimizer_conf.h bounds/decay).
+    learning_rate: float = 0.05
+    initial_g2sum: float = 3.0
+    min_bound: float = -10.0
+    max_bound: float = 10.0
+    # Show/click decay applied at end-of-day shrink (role of ShrinkTable).
+    show_click_decay: float = 0.98
+
+    @property
+    def w_width(self) -> int:
+        """Scalar LR weight + its g2sum (wide/linear term)."""
+        return 2
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PassTable:
+    """Device-resident per-pass table (a pytree of sharded arrays).
+
+    Shapes (S = num_shards, R = rows_per_shard real rows, +1 trash row):
+      emb       [S*(R+1), D]  mf embedding
+      emb_g2sum [S*(R+1)]     adagrad accumulator for emb
+      w         [S*(R+1)]     scalar LR weight (wide term)
+      w_g2sum   [S*(R+1)]
+      show      [S*(R+1)]     impression count
+      click     [S*(R+1)]     click count
+
+    Stored flat with shard s owning rows [s*(R+1), (s+1)*(R+1)); when used
+    under shard_map the leading dim is sharded over the table axis so each
+    device holds exactly its own [(R+1), ...] block.
+    """
+
+    emb: jax.Array
+    emb_g2sum: jax.Array
+    w: jax.Array
+    w_g2sum: jax.Array
+    show: jax.Array
+    click: jax.Array
+    rows_per_shard: int            # real rows (excludes trash row)
+    num_shards: int
+
+    def tree_flatten(self):
+        leaves = (self.emb, self.emb_g2sum, self.w, self.w_g2sum,
+                  self.show, self.click)
+        return leaves, (self.rows_per_shard, self.num_shards)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        rows_per_shard, num_shards = aux
+        return cls(*leaves, rows_per_shard=rows_per_shard,
+                   num_shards=num_shards)
+
+    @property
+    def num_rows_padded(self) -> int:
+        return self.num_shards * (self.rows_per_shard + 1)
+
+    @property
+    def dim(self) -> int:
+        return int(self.emb.shape[-1])
+
+
+def plan_shards(num_keys: int, num_shards: int) -> int:
+    """Rows per shard covering num_keys. No alignment needed: gathers index
+    the row dim; only the trailing feature dim needs TPU tiling."""
+    return -(-max(num_keys, 1) // num_shards)
+
+
+def build_pass_table_host(values: Dict[str, np.ndarray], num_shards: int,
+                          config: TableConfig) -> PassTable:
+    """Assemble a PassTable from host arrays produced by the FeatureStore.
+
+    ``values`` carries per-key arrays in sorted-key order: emb [N, D],
+    emb_g2sum [N], w [N], w_g2sum [N], show [N], click [N]. Rows are laid
+    out shard-contiguously with a zeroed trash row appended per shard
+    (role of BuildGPUTask filling HBM mem-pool records,
+    ps_gpu_wrapper.cc:684).
+    """
+    n = values["emb"].shape[0]
+    rps = plan_shards(n, num_shards)
+    d = config.dim
+
+    def lay(flat: np.ndarray, width: Optional[int]) -> np.ndarray:
+        shape = (num_shards, rps + 1) + ((width,) if width else ())
+        out = np.zeros(shape, flat.dtype)
+        src = flat.reshape((n,) + ((width,) if width else ()))
+        for s in range(num_shards):
+            lo, hi = s * rps, min((s + 1) * rps, n)
+            if lo < hi:
+                out[s, :hi - lo] = src[lo:hi]
+        return out.reshape((num_shards * (rps + 1),) +
+                           ((width,) if width else ()))
+
+    return PassTable(
+        emb=jnp.asarray(lay(values["emb"], d)),
+        emb_g2sum=jnp.asarray(lay(values["emb_g2sum"], None)),
+        w=jnp.asarray(lay(values["w"], None)),
+        w_g2sum=jnp.asarray(lay(values["w_g2sum"], None)),
+        show=jnp.asarray(lay(values["show"], None)),
+        click=jnp.asarray(lay(values["click"], None)),
+        rows_per_shard=rps,
+        num_shards=num_shards,
+    )
+
+
+def extract_pass_values_host(table: PassTable, num_keys: int) -> Dict[str, np.ndarray]:
+    """Inverse of build_pass_table_host: strip trash rows, return sorted-key
+    order host arrays (role of EndPass dumping dirty HBM values back to the
+    CPU table, ps_gpu_wrapper.cc:983)."""
+    rps = table.rows_per_shard
+    s = table.num_shards
+
+    def unlay(arr: jax.Array) -> np.ndarray:
+        a = np.asarray(arr)
+        a = a.reshape((s, rps + 1) + a.shape[1:])[:, :rps]  # drop trash rows
+        a = a.reshape((s * rps,) + a.shape[2:])
+        return a[:num_keys]
+
+    return {
+        "emb": unlay(table.emb),
+        "emb_g2sum": unlay(table.emb_g2sum),
+        "w": unlay(table.w),
+        "w_g2sum": unlay(table.w_g2sum),
+        "show": unlay(table.show),
+        "click": unlay(table.click),
+    }
+
+
+def map_keys_to_rows(pass_keys_sorted: np.ndarray, batch_keys: np.ndarray,
+                     rows_per_shard: int) -> np.ndarray:
+    """Host-side: feasigns → device row ids in the shard-contiguous layout.
+
+    Role of the key→slot flattening in CopyKeys + the per-pass perfect
+    index (SURVEY.md §7 design note). Unknown keys and the 0 padding
+    feasign map to the padding sentinel (trash row of shard 0).
+    """
+    n = pass_keys_sorted.shape[0]
+    sentinel_only = np.full(batch_keys.shape, rows_per_shard, np.int32)
+    if n == 0:
+        return sentinel_only  # empty pass: everything hits the trash row
+    g = np.searchsorted(pass_keys_sorted, batch_keys)
+    g_c = np.minimum(g, n - 1)
+    found = (pass_keys_sorted[g_c] == batch_keys) & (batch_keys != 0)
+    shard = g_c // rows_per_shard
+    row = g_c % rows_per_shard
+    dev_row = shard * (rows_per_shard + 1) + row
+    sentinel = rows_per_shard  # trash row of shard 0
+    return np.where(found, dev_row, sentinel).astype(np.int32)

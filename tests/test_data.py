@@ -1,0 +1,192 @@
+"""Data pipeline tests (SURVEY.md §2.4 roles): channel, parse, pack, dataset.
+
+Mirrors the reference's test_dataset.py coverage (load/shuffle/batch) in
+single-process form.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import (Channel, ClosedChannelError, DataFeedConfig,
+                                Dataset, SlotBatch, SlotConf, parse_lines)
+
+CFG = DataFeedConfig(
+    slots=(
+        SlotConf("user", avg_len=2.0),
+        SlotConf("item", avg_len=1.0),
+        SlotConf("dense0", is_dense=True, dim=3),
+    ),
+    batch_size=4,
+    num_labels=1,
+)
+
+
+def _write_shard(tmp_path, name, lines):
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_channel_mpmc_close():
+    ch = Channel(capacity=8)
+    results = []
+
+    def consumer():
+        try:
+            while True:
+                results.append(ch.get(timeout=5))
+        except ClosedChannelError:
+            pass
+
+    ts = [threading.Thread(target=consumer) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for i in range(100):
+        ch.put(i)
+    ch.close()
+    for t in ts:
+        t.join()
+    assert sorted(results) == list(range(100))
+    with pytest.raises(ClosedChannelError):
+        ch.put(1)
+
+
+def test_parse_svm_line():
+    ins = parse_lines(["1 user:11 user:12 item:7 dense0:0.5,1.5,2.5"], CFG)
+    assert len(ins) == 1
+    np.testing.assert_array_equal(ins[0].sparse["user"], [11, 12])
+    np.testing.assert_array_equal(ins[0].sparse["item"], [7])
+    np.testing.assert_allclose(ins[0].dense["dense0"], [0.5, 1.5, 2.5])
+    assert ins[0].labels[0] == 1.0
+
+
+def test_parse_skips_malformed():
+    ins = parse_lines(["", "1 user:1", "garbage-no-colon token", "0 item:5"],
+                      CFG)
+    # "garbage-no-colon token": first tok parses as label? no — "garbage..."
+    # is not a float → the whole line errors. Current parser: float() raises.
+    assert len(ins) >= 2
+
+
+def test_pack_static_shapes():
+    ins = parse_lines(["1 user:11 user:12 item:7 dense0:1,2,3",
+                       "0 user:13 item:9"], CFG)
+    b = SlotBatch.pack(ins, CFG)
+    cap_user = CFG.sparse_capacity(CFG.slots[0])
+    assert b.ids["user"].shape == (cap_user,)
+    assert b.segments["user"].shape == (cap_user,)
+    assert b.lengths["user"].shape == (4,)
+    assert b.labels.shape == (4, 1)
+    assert b.dense["dense0"].shape == (4, 3)
+    assert b.num_valid == 2
+    # Padding segments point to the discard row (batch_size).
+    assert b.segments["user"][3:].max() == 4
+    np.testing.assert_array_equal(b.lengths["user"], [2, 1, 0, 0])
+    np.testing.assert_array_equal(np.sort(b.all_sparse_ids()), [7, 9, 11, 12, 13])
+
+
+def test_dataset_load_shuffle_batches(tmp_path):
+    lines = [f"{i % 2} user:{100 + i} user:{200 + i} item:{i} dense0:{i},{i},{i}"
+             for i in range(37)]
+    shards = [_write_shard(tmp_path, f"part-{j}", lines[j::3]) for j in range(3)]
+    ds = Dataset(CFG, num_reader_threads=3)
+    ds.set_filelist(shards)
+    ds.load_into_memory()
+    assert ds.num_instances == 37
+    keys = ds.pass_keys()
+    assert keys.size == 37 * 3  # all user/item ids unique
+    ds.local_shuffle(seed=0)
+    batches = list(ds.batches())
+    assert len(batches) == 10  # ceil(37/4)
+    assert sum(b.num_valid for b in batches) == 37
+    # drop_last drops the short batch
+    assert len(list(ds.batches(drop_last=True))) == 9
+
+
+def test_dataset_preload_and_key_sink(tmp_path):
+    lines = [f"1 user:{i} item:{i}" for i in range(10)]
+    shard = _write_shard(tmp_path, "p0", lines)
+    seen = []
+    ds = Dataset(CFG)
+    ds.key_sink = lambda keys: seen.append(keys)
+    ds.set_filelist([shard])
+    ds.preload_into_memory()
+    ds.wait_preload_done()
+    assert ds.num_instances == 10
+    assert np.unique(np.concatenate(seen)).size == 10  # user i == item i
+    ds.clear()
+    assert ds.num_instances == 0
+
+
+def test_dataset_pipe_command(tmp_path):
+    import gzip
+    p = tmp_path / "part.gz"
+    with gzip.open(p, "wt") as f:
+        f.write("1 user:5 item:6\n0 user:7 item:8\n")
+    cfg = DataFeedConfig(slots=CFG.slots, batch_size=4, pipe_command="zcat")
+    ds = Dataset(cfg)
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    assert ds.num_instances == 2
+
+
+def test_global_shuffle_loopback(tmp_path):
+    lines = [f"1 user:{i} item:{i}" for i in range(20)]
+    shard = _write_shard(tmp_path, "p0", lines)
+    ds = Dataset(CFG)
+    ds.set_filelist([shard])
+    ds.load_into_memory()
+    # Loopback: rank 0 of 2 keeps ~half the records.
+    ds.global_shuffle(num_ranks=2, rank=0, seed=42, allow_partition=True)
+    assert 0 < ds.num_instances < 20
+
+    # Exchange-callback path: both buckets come back (identity cluster).
+    ds2 = Dataset(CFG)
+    ds2.set_filelist([shard])
+    ds2.load_into_memory()
+    ds2.global_shuffle(num_ranks=2, rank=0, seed=42,
+                       exchange=lambda buckets: [i for b in buckets for i in b])
+    assert ds2.num_instances == 20
+
+
+def test_slot_overflow_truncates(tmp_path):
+    from paddlebox_tpu.core import monitor
+    monitor.reset()
+    cfg = DataFeedConfig(
+        slots=(SlotConf("user", avg_len=1.0),), batch_size=2,
+        slot_capacity_slack=1.0)
+    many = " ".join(f"user:{i}" for i in range(100))
+    ins = parse_lines([f"1 {many}", "0 user:1"], cfg)
+    b = SlotBatch.pack(ins, cfg)
+    cap = cfg.sparse_capacity(cfg.slots[0])
+    assert b.ids["user"].shape == (cap,)
+    assert monitor.get("slot_overflow/user") > 0
+
+
+def test_failing_pipe_command_raises(tmp_path):
+    p = _write_shard(tmp_path, "p0", ["1 user:1 item:2"])
+    cfg = DataFeedConfig(slots=CFG.slots, batch_size=4,
+                         pipe_command="nonexistent-cmd-xyz")
+    ds = Dataset(cfg)
+    ds.set_filelist([p])
+    with pytest.raises(RuntimeError, match="pipe_command"):
+        ds.load_into_memory()
+
+
+def test_parser_negative_feasign_skipped():
+    from paddlebox_tpu.data import parse_lines as pl
+    ins = pl(["1 user:-5 item:3", "0 user:4 item:5"], CFG)
+    assert len(ins) == 1  # negative-feasign line skipped, not crashed
+    np.testing.assert_array_equal(ins[0].sparse["user"], [4])
+
+
+def test_global_shuffle_requires_transport(tmp_path):
+    p = _write_shard(tmp_path, "p0", ["1 user:1 item:2"])
+    ds = Dataset(CFG)
+    ds.set_filelist([p])
+    ds.load_into_memory()
+    with pytest.raises(ValueError, match="transport"):
+        ds.global_shuffle(num_ranks=2, rank=0)

@@ -1,0 +1,263 @@
+"""Sparse embedding engine tests (SURVEY.md §2.2/2.3 roles).
+
+The key correctness bar, mirroring the reference's HeterPS device test
+(``heter_ps/test_comm.cu``): pull returns exactly the stored rows; push
+applies one exact merged update per touched row; multi-shard (8-device
+all-to-all) results equal single-shard results.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.embedding import (FeatureStore, PassEngine, SparseAdagrad,
+                                     TableConfig, make_pull_fn, make_push_fn)
+from paddlebox_tpu.embedding.table import (build_pass_table_host,
+                                           extract_pass_values_host,
+                                           map_keys_to_rows, plan_shards)
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+
+DIM = 4
+CFG = TableConfig(dim=DIM, learning_rate=0.1, initial_g2sum=1.0)
+
+
+def _host_values(n, dim, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "emb": rng.normal(size=(n, dim)).astype(np.float32),
+        "emb_g2sum": np.zeros((n,), np.float32),
+        "w": rng.normal(size=(n,)).astype(np.float32),
+        "w_g2sum": np.zeros((n,), np.float32),
+        "show": np.zeros((n,), np.float32),
+        "click": np.zeros((n,), np.float32),
+    }
+
+
+def _adagrad_ref(v, g2, g, lr=0.1, ig=1.0, scalar=False):
+    if scalar:
+        g2n = g2 + g * g
+        scale = np.sqrt(ig / (ig + g2n))
+        return np.clip(v - lr * scale * g, -10, 10), g2n
+    g2n = g2 + (g * g).mean(axis=-1)
+    scale = np.sqrt(ig / (ig + g2n))
+    return np.clip(v - lr * scale[:, None] * g, -10, 10), g2n
+
+
+def test_map_keys_to_rows():
+    keys = np.array([3, 7, 10, 15, 22, 30, 41, 55], np.uint64)
+    rps = plan_shards(8, 2)  # 4 rows/shard
+    rows = map_keys_to_rows(keys, np.array([3, 55, 99, 0, 22], np.uint64), rps)
+    # shard block = rps+1; key 3 -> g0 -> row 0; 55 -> g7 -> shard1 row3
+    assert rows[0] == 0
+    assert rows[1] == 1 * (rps + 1) + 3
+    assert rows[2] == rps  # unknown -> sentinel trash row of shard 0
+    assert rows[3] == rps  # 0 feasign -> sentinel
+    assert rows[4] == 1 * (rps + 1) + 0  # 22 -> g4 -> shard1 row0
+
+
+def test_table_roundtrip_host():
+    n = 13
+    vals = _host_values(n, DIM)
+    t = build_pass_table_host(vals, 4, CFG)
+    assert t.num_shards == 4
+    back = extract_pass_values_host(t, n)
+    for f in vals:
+        np.testing.assert_allclose(back[f], vals[f], rtol=1e-6)
+
+
+@pytest.mark.parametrize("nshards", [1, 8])
+def test_pull_matches_reference(devices8, nshards):
+    n_keys, n_ids = 64, 128
+    vals = _host_values(n_keys, DIM)
+    keys = np.sort(np.random.default_rng(1).choice(
+        np.arange(1, 10_000, dtype=np.uint64), n_keys, replace=False))
+    table = build_pass_table_host(vals, nshards, CFG)
+    mesh = build_mesh(HybridTopology(dp=nshards),
+                      devices8[:nshards] if nshards > 1 else devices8[:1])
+    pull = make_pull_fn(mesh, "dp")
+
+    rng = np.random.default_rng(2)
+    batch_keys = rng.choice(keys, n_ids).astype(np.uint64)
+    batch_keys[5] = 9999  # unknown key
+    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard)
+    out = pull(table, jnp.asarray(rows))
+
+    g = np.searchsorted(keys, batch_keys)
+    ref = np.zeros((n_ids, DIM), np.float32)
+    known = batch_keys != 9999
+    ref[known] = vals["emb"][g[known]]
+    np.testing.assert_allclose(np.asarray(out["emb"]), ref, rtol=1e-5)
+    ref_w = np.zeros((n_ids,), np.float32)
+    ref_w[known] = vals["w"][g[known]]
+    np.testing.assert_allclose(np.asarray(out["w"]), ref_w, rtol=1e-5)
+
+
+@pytest.mark.parametrize("nshards", [1, 8])
+def test_push_exact_dedup_update(devices8, nshards):
+    n_keys, n_ids = 32, 64
+    vals = _host_values(n_keys, DIM, seed=3)
+    keys = np.arange(1, n_keys + 1, dtype=np.uint64)
+    table = build_pass_table_host(vals, nshards, CFG)
+    mesh = build_mesh(HybridTopology(dp=nshards),
+                      devices8[:nshards] if nshards > 1 else devices8[:1])
+    opt = SparseAdagrad(learning_rate=0.1, initial_g2sum=1.0)
+    push = make_push_fn(mesh, "dp", opt)
+
+    rng = np.random.default_rng(4)
+    batch_keys = rng.choice(keys, n_ids).astype(np.uint64)  # duplicates!
+    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard)
+    g_emb = rng.normal(size=(n_ids, DIM)).astype(np.float32)
+    g_w = rng.normal(size=(n_ids,)).astype(np.float32)
+    shows = np.ones((n_ids,), np.float32)
+    clicks = (rng.random(n_ids) < 0.3).astype(np.float32)
+
+    new_table = push(table, jnp.asarray(rows), jnp.asarray(g_emb),
+                     jnp.asarray(g_w), jnp.asarray(shows),
+                     jnp.asarray(clicks))
+    back = extract_pass_values_host(new_table, n_keys)
+
+    # numpy reference: merge grads per key, single update per key.
+    ref_emb, ref_g2 = vals["emb"].copy(), vals["emb_g2sum"].copy()
+    ref_w_, ref_wg2 = vals["w"].copy(), vals["w_g2sum"].copy()
+    ref_show, ref_click = vals["show"].copy(), vals["click"].copy()
+    for ki, key in enumerate(keys):
+        m = batch_keys == key
+        if not m.any():
+            continue
+        ge = g_emb[m].sum(axis=0)
+        gw = g_w[m].sum()
+        ref_emb[ki:ki+1], ref_g2[ki:ki+1] = _adagrad_ref(
+            ref_emb[ki:ki+1], ref_g2[ki:ki+1], ge[None])
+        ref_w_[ki:ki+1], ref_wg2[ki:ki+1] = _adagrad_ref(
+            ref_w_[ki:ki+1], ref_wg2[ki:ki+1], np.array([gw]), scalar=True)
+        ref_show[ki] += shows[m].sum()
+        ref_click[ki] += clicks[m].sum()
+
+    np.testing.assert_allclose(back["emb"], ref_emb, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(back["emb_g2sum"], ref_g2, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(back["w"], ref_w_, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(back["show"], ref_show, rtol=1e-5)
+    np.testing.assert_allclose(back["click"], ref_click, rtol=1e-5)
+
+
+def test_multi_shard_equals_single_shard(devices8):
+    """8-way all-to-all pull/push == single-device result (the test_comm.cu
+    parity bar)."""
+    n_keys, n_ids = 50, 96
+    vals = _host_values(n_keys, DIM, seed=7)
+    keys = np.sort(np.random.default_rng(8).choice(
+        np.arange(1, 100_000, dtype=np.uint64), n_keys, replace=False))
+    rng = np.random.default_rng(9)
+    batch_keys = rng.choice(keys, n_ids).astype(np.uint64)
+    g_emb = rng.normal(size=(n_ids, DIM)).astype(np.float32)
+    g_w = rng.normal(size=(n_ids,)).astype(np.float32)
+    shows = np.ones((n_ids,), np.float32)
+    clicks = np.zeros((n_ids,), np.float32)
+
+    results = {}
+    for nshards in (1, 8):
+        table = build_pass_table_host(vals, nshards, CFG)
+        mesh = build_mesh(HybridTopology(dp=nshards),
+                          devices8[:nshards] if nshards > 1 else devices8[:1])
+        rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard)
+        pull = make_pull_fn(mesh, "dp")
+        push = make_push_fn(mesh, "dp", SparseAdagrad.from_config(CFG))
+        pulled = pull(table, jnp.asarray(rows))
+        new_table = push(table, jnp.asarray(rows), jnp.asarray(g_emb),
+                         jnp.asarray(g_w), jnp.asarray(shows),
+                         jnp.asarray(clicks))
+        results[nshards] = (np.asarray(pulled["emb"]),
+                            extract_pass_values_host(new_table, n_keys))
+
+    np.testing.assert_allclose(results[1][0], results[8][0], rtol=1e-5)
+    for f in results[1][1]:
+        np.testing.assert_allclose(results[1][1][f], results[8][1][f],
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"field {f}")
+
+
+def test_store_pass_cycle(tmp_path):
+    store = FeatureStore(CFG, seed=0)
+    keys1 = np.array([5, 9, 14], np.uint64)
+    v1 = store.pull_for_pass(keys1)
+    assert v1["emb"].shape == (3, DIM)
+    v1["w"][:] = [1.0, 2.0, 3.0]
+    store.push_from_pass(keys1, v1)
+    assert store.num_features == 3
+
+    # Second pass: overlap {9, 14} + new {20}; existing values persist.
+    keys2 = np.array([9, 14, 20], np.uint64)
+    v2 = store.pull_for_pass(keys2)
+    np.testing.assert_allclose(v2["w"][:2], [2.0, 3.0])
+    v2["w"][:] = [4.0, 5.0, 6.0]
+    store.push_from_pass(keys2, v2)
+    assert store.num_features == 4
+
+    # base+delta checkpoint round trip.
+    store.save_base(str(tmp_path / "base"))
+    keys3 = np.array([5], np.uint64)
+    v3 = store.pull_for_pass(keys3)
+    v3["w"][:] = [7.0]
+    store.push_from_pass(keys3, v3)
+    store.save_delta(str(tmp_path / "delta"))
+
+    restored = FeatureStore(CFG)
+    restored.load(str(tmp_path / "base"), "base")
+    assert restored.num_features == 4
+    np.testing.assert_allclose(
+        restored.pull_for_pass(np.array([5], np.uint64))["w"], [1.0])
+    restored.load(str(tmp_path / "delta"), "delta")
+    np.testing.assert_allclose(
+        restored.pull_for_pass(np.array([5], np.uint64))["w"], [7.0])
+
+
+def test_store_shrink():
+    store = FeatureStore(TableConfig(dim=DIM, show_click_decay=0.5))
+    keys = np.array([1, 2, 3], np.uint64)
+    v = store.pull_for_pass(keys)
+    v["show"][:] = [10.0, 0.1, 5.0]
+    store.push_from_pass(keys, v)
+    evicted = store.shrink(min_show=1.0)
+    assert evicted == 1  # key 2 (0.05 after decay) evicted
+    assert store.num_features == 2
+
+
+def test_pass_engine_lifecycle(devices8):
+    mesh = build_mesh(HybridTopology(dp=8), devices8)
+    eng = PassEngine(CFG, mesh=mesh, table_axis="dp")
+    batch_keys = np.array([11, 22, 33, 44, 11, 22, 33, 44], np.uint64)
+
+    eng.feed_pass(batch_keys, async_build=True)
+    table = eng.begin_pass()
+    assert table.num_shards == 8
+    rows = eng.lookup_rows(batch_keys)
+    assert rows.shape == (8,)  # sharded pull needs len % ndev == 0
+    pull = make_pull_fn(mesh, "dp")
+    out = pull(table, jnp.asarray(rows))
+    # same key -> same embedding row
+    np.testing.assert_allclose(np.asarray(out["emb"])[0],
+                               np.asarray(out["emb"])[4])
+    eng.end_pass()
+    assert eng.store.num_features == 4
+
+    with pytest.raises(RuntimeError):
+        eng.end_pass()
+
+
+def test_map_keys_empty_pass():
+    rows = map_keys_to_rows(np.empty((0,), np.uint64),
+                            np.array([1, 2], np.uint64), 4)
+    np.testing.assert_array_equal(rows, [4, 4])  # all sentinel
+
+
+def test_save_delta_refuses_after_shrink(tmp_path):
+    store = FeatureStore(CFG)
+    keys = np.array([1, 2], np.uint64)
+    store.push_from_pass(keys, store.pull_for_pass(keys))
+    store.save_base(str(tmp_path / "b"))
+    store.shrink()
+    with pytest.raises(RuntimeError, match="save_base first"):
+        store.save_delta(str(tmp_path / "d"))
+    store.save_base(str(tmp_path / "b2"))
+    store.save_delta(str(tmp_path / "d"))  # ok again after new base
