@@ -1,0 +1,116 @@
+"""Benchmark harness: DeepFM CTR training throughput on real TPU.
+
+Runs the flagship sparse-CTR config (BASELINE.md config 4: DeepFM,
+BoxPS-style pull/push through the pass-based embedding engine) on whatever
+accelerator jax exposes, and prints ONE json line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is measured samples/sec/chip divided by the BASELINE.md target
+proxy (the reference publishes no numbers; target proxy = 90% of an 8xA100
+DeepFM-Criteo run ~= 1.3M samples/s/8 chips ~= 162k samples/s/chip,
+BASELINE.md "≥90% of 8×A100 on v5e-8").
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+TARGET_SAMPLES_PER_SEC_PER_CHIP = 162_000.0
+
+# Realistic CTR shapes: 26 sparse slots (Criteo-like), dim-16 embeddings,
+# 13 dense features, batch 4096 per chip.
+NUM_SLOTS = 26
+EMB_DIM = 16
+DENSE_DIM = 13
+BATCH = 4096
+NUM_FEATURES = 2_000_000
+AVG_IDS_PER_SLOT = 1.0
+STEPS_WARMUP = 3
+STEPS_TIMED = 20
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.embedding import TableConfig
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+    ndev = len(jax.devices())
+    mesh = build_mesh(HybridTopology(dp=ndev))
+    slots = tuple(SlotConf(f"s{i}", avg_len=AVG_IDS_PER_SLOT)
+                  for i in range(NUM_SLOTS))
+    feed = DataFeedConfig(slots=slots, batch_size=BATCH)
+    table_cfg = TableConfig(dim=EMB_DIM, learning_rate=0.05)
+    model = DeepFM(slot_names=tuple(s.name for s in slots), emb_dim=EMB_DIM,
+                   hidden=(400, 400, 400))
+    trainer = CTRTrainer(model, feed, table_cfg, mesh=mesh,
+                         config=TrainerConfig(auc_num_buckets=1 << 16))
+    trainer.init(seed=0)
+
+    # Synthetic pass: keys uniform over the feature space.
+    rng = np.random.default_rng(0)
+    pass_keys = rng.choice(np.arange(1, NUM_FEATURES, dtype=np.uint64),
+                           size=NUM_FEATURES // 4, replace=False)
+    trainer.engine.feed_pass(pass_keys)
+    table = trainer.engine.begin_pass()
+
+    # One synthetic packed batch reused every step (isolates device+host-map
+    # throughput from disk IO, like the reference's in-memory pass).
+    caps = {s.name: feed.sparse_capacity(s, num_shards=ndev) for s in slots}
+    ids = {}
+    segments = {}
+    for s in slots:
+        cap = caps[s.name]
+        cap_local = cap // ndev
+        bs_local = BATCH // ndev
+        segs = np.concatenate([
+            np.sort(rng.integers(0, bs_local, cap_local)).astype(np.int32)
+            for _ in range(ndev)])
+        ids[s.name] = rng.choice(pass_keys, cap).astype(np.uint64)
+        segments[s.name] = segs
+    labels = (rng.random((BATCH, 1)) < 0.25).astype(np.float32)
+    valid = np.ones((BATCH,), bool)
+
+    step = trainer._build_step()
+    names = [s.name for s in slots]
+    all_ids = np.concatenate([ids[n] for n in names])
+    rows = trainer.engine.lookup_rows(all_ids)
+    from paddlebox_tpu.train.ctr_trainer import _interleave_slots
+    rows = _interleave_slots(rows, names, caps, ndev)
+    segs_j = {n: jnp.asarray(segments[n]) for n in names}
+    dense = jnp.zeros((BATCH, 0), jnp.float32)
+    args = lambda t, p, o, a: (t, p, o, a, jnp.asarray(rows), segs_j,
+                               jnp.asarray(labels), jnp.asarray(valid), dense)
+
+    params, opt_state, auc = trainer.params, trainer.opt_state, trainer.auc_state
+    for _ in range(STEPS_WARMUP):
+        table, params, opt_state, auc, loss = step(
+            *args(table, params, opt_state, auc))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS_TIMED):
+        table, params, opt_state, auc, loss = step(
+            *args(table, params, opt_state, auc))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = STEPS_TIMED * BATCH / dt
+    per_chip = samples_per_sec / ndev
+    print(json.dumps({
+        "metric": "deepfm_ctr_samples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(per_chip / TARGET_SAMPLES_PER_SEC_PER_CHIP, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

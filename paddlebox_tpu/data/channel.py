@@ -44,8 +44,23 @@ class Channel(Generic[T]):
             self._not_empty.notify()
 
     def put_many(self, items: Iterable[T]) -> None:
-        for it in items:
-            self.put(it)
+        """Bulk put: appends in capacity-sized runs under one lock
+        acquisition each (hot path for reader threads)."""
+        pending = list(items)
+        i = 0
+        while i < len(pending):
+            with self._lock:
+                while self._cap and len(self._q) >= self._cap \
+                        and not self._closed:
+                    self._not_full.wait()
+                if self._closed:
+                    raise ClosedChannelError("put on closed channel")
+                room = (self._cap - len(self._q)) if self._cap \
+                    else len(pending) - i
+                take = max(1, room)
+                self._q.extend(pending[i:i + take])
+                i += take
+                self._not_empty.notify_all()
 
     def get(self, timeout: Optional[float] = None) -> T:
         with self._lock:

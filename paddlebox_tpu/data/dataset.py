@@ -229,9 +229,13 @@ class Dataset:
                 "dropping the rest)")
         rng = np.random.default_rng(seed)
         with self._lock:
-            buckets: List[List[Instance]] = [[] for _ in range(num_ranks)]
-            for ins in self._instances:
-                buckets[int(rng.integers(num_ranks))].append(ins)
+            assign = rng.integers(num_ranks, size=len(self._instances))
+            order = np.argsort(assign, kind="stable")
+            counts = np.bincount(assign, minlength=num_ranks)
+            bounds = np.concatenate([[0], np.cumsum(counts)])
+            buckets: List[List[Instance]] = [
+                [self._instances[j] for j in order[bounds[r]:bounds[r + 1]]]
+                for r in range(num_ranks)]
             if exchange is None:
                 received = buckets[rank]
                 dropped = sum(len(b) for i, b in enumerate(buckets)
@@ -262,6 +266,21 @@ class Dataset:
             if len(chunk) < bs and drop_last:
                 return
             yield SlotBatch.pack(chunk, self.config, bs)
+
+    def batches_sharded(self, num_shards: int, *,
+                        batch_size: Optional[int] = None
+                        ) -> Iterator[SlotBatch]:
+        """Yield batches packed as ``num_shards`` self-contained per-device
+        sub-batches (see SlotBatch.pack_sharded) — the layout a dp-sharded
+        train step consumes directly."""
+        bs = batch_size or self.config.batch_size
+        with self._lock:
+            snapshot = list(self._instances)
+        for i in range(0, len(snapshot), bs):
+            chunk = snapshot[i:i + bs]
+            yield SlotBatch.pack_sharded(chunk, self.config, num_shards, bs)
+
+    # -- pass keys ---------------------------------------------------------
 
     def pass_keys(self) -> np.ndarray:
         """Unique feasigns currently loaded (role of the per-pass key set

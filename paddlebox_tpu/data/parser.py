@@ -64,8 +64,12 @@ def _parse_svm(lines: Iterable[str], config: DataFeedConfig) -> List[Instance]:
                     raise ValueError(f"token without ':': {tok!r}")
                 if slot in sparse_names:
                     sign = int(val)
-                    if not 0 <= sign < (1 << 64):
-                        raise ValueError(f"feasign out of uint64 range: {val}")
+                    if not 0 < sign < (1 << 64):
+                        # 0 is the null/padding sentinel downstream — a
+                        # real 0 feature would silently never train, so
+                        # drop the token loudly (counter), keep the line.
+                        monitor.add("parser/null_or_oob_feasign")
+                        continue
                     sparse.setdefault(slot, []).append(sign)
                 elif slot in dense_names:
                     dense[slot] = np.array(

@@ -71,10 +71,15 @@ class DataFeedConfig:
         return [s for s in self.slots if s.is_dense and s.is_used]
 
     def sparse_capacity(self, slot: SlotConf,
-                        batch_size: Optional[int] = None) -> int:
+                        batch_size: Optional[int] = None,
+                        num_shards: int = 1) -> int:
+        """Per-batch value capacity for a sparse slot; always a multiple of
+        ``num_shards`` (so the array shards evenly over a dp mesh axis) and
+        of 8 per shard."""
         bs = batch_size or self.batch_size
         cap = int(bs * slot.avg_len * self.slot_capacity_slack)
-        return max(_round_up(max(cap, bs), 8), 8)
+        cap_local = -(-max(cap, bs, 1) // num_shards)
+        return _round_up(cap_local, 8) * num_shards
 
 
 @dataclasses.dataclass
@@ -133,9 +138,13 @@ class SlotBatch:
 
     @staticmethod
     def pack(instances: Sequence[Instance], config: DataFeedConfig,
-             batch_size: Optional[int] = None) -> "SlotBatch":
+             batch_size: Optional[int] = None,
+             capacities: Optional[Dict[str, int]] = None) -> "SlotBatch":
         """Pack instances into one static-shape batch, padding short batches
-        with invalid rows (role of BuildSlotBatchGPU, vectorized on host)."""
+        with invalid rows (role of BuildSlotBatchGPU, vectorized on host).
+
+        ``capacities`` overrides the per-slot value capacity (used by
+        pack_sharded so every sub-batch shares one static shape)."""
         bs = batch_size or config.batch_size
         n = len(instances)
         if n > bs:
@@ -150,7 +159,8 @@ class SlotBatch:
         segments: Dict[str, np.ndarray] = {}
         lengths: Dict[str, np.ndarray] = {}
         for slot in config.sparse_slots:
-            cap = config.sparse_capacity(slot, bs)
+            cap = (capacities[slot.name] if capacities is not None
+                   else config.sparse_capacity(slot, bs))
             vals = np.zeros((cap,), np.uint64)
             segs = np.full((cap,), bs, np.int32)
             lens = np.zeros((bs,), np.int32)
@@ -185,3 +195,42 @@ class SlotBatch:
 
         return SlotBatch(labels=labels, valid=valid, ids=ids,
                          segments=segments, lengths=lengths, dense=dense)
+
+    @staticmethod
+    def pack_sharded(instances: Sequence[Instance], config: DataFeedConfig,
+                     num_shards: int,
+                     batch_size: Optional[int] = None) -> "SlotBatch":
+        """Pack into ``num_shards`` self-contained per-device sub-batches,
+        concatenated. Each device's slice of every array is a complete
+        local batch: segments index LOCAL rows [0, B/num_shards], so the
+        arrays can be sharded over a dp mesh axis directly (the reference
+        feeds each device worker its own MiniBatchGpuPack for the same
+        reason, data_feed.h:519).
+        """
+        bs = batch_size or config.batch_size
+        if bs % num_shards:
+            raise ValueError(f"batch_size {bs} not divisible by {num_shards}")
+        bs_local = bs // num_shards
+        # Per-device capacity = sharded full-batch capacity / num_shards,
+        # so the concatenated arrays match what a trainer derives from
+        # sparse_capacity(slot, bs, num_shards).
+        caps_local = {
+            slot.name: config.sparse_capacity(slot, bs, num_shards)
+            // num_shards
+            for slot in config.sparse_slots}
+        subs = []
+        for s in range(num_shards):
+            chunk = list(instances[s * bs_local:(s + 1) * bs_local])
+            subs.append(SlotBatch.pack(chunk, config, bs_local, caps_local))
+        return SlotBatch(
+            labels=np.concatenate([b.labels for b in subs]),
+            valid=np.concatenate([b.valid for b in subs]),
+            ids={k: np.concatenate([b.ids[k] for b in subs])
+                 for k in subs[0].ids},
+            segments={k: np.concatenate([b.segments[k] for b in subs])
+                      for k in subs[0].segments},
+            lengths={k: np.concatenate([b.lengths[k] for b in subs])
+                     for k in subs[0].lengths},
+            dense={k: np.concatenate([b.dense[k] for b in subs])
+                   for k in subs[0].dense},
+        )

@@ -137,7 +137,6 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
 
     send_rows, order, slot_shard, slot_pos = _bucket_by_shard(
         dev_rows, num_shards, block, cap)
-    in_cap = slot_pos < cap
 
     # Payload per bucket cell: [grad_emb D | grad_w | show | click].
     payload = jnp.concatenate([

@@ -53,14 +53,23 @@ class PassEngine:
         self._table: Optional[PassTable] = None
         self._pending: Optional[_PendingPass] = None
         self._pass_id = -1
+        # Sequencing for async builds: the store pull must happen AFTER the
+        # previous pass's end_pass write-back, or updates to keys shared
+        # between passes would be read stale and then overwritten (the
+        # reference sequences BuildPull after EndPass the same way).
+        self._no_active_pass = threading.Event()
+        self._no_active_pass.set()
 
     # -- build -------------------------------------------------------------
 
     def _build(self, pass_keys: np.ndarray, pending: _PendingPass) -> None:
         try:
             with self.timers.scope("feed_pass"):
+                # Key dedup can overlap the active pass...
                 keys = np.unique(np.asarray(pass_keys, np.uint64))
                 keys = keys[keys != 0]  # 0 is the null feasign
+                # ...but the value pull must wait for its end_pass.
+                self._no_active_pass.wait()
                 vals = self.store.pull_for_pass(keys)
                 table = build_pass_table_host(
                     vals, self.num_shards, self.config)
@@ -102,6 +111,10 @@ class PassEngine:
 
     def begin_pass(self) -> PassTable:
         """Swap in the pending pass's table (role of BeginPass)."""
+        if self._table is not None:
+            raise RuntimeError(
+                "begin_pass while a pass is active — end_pass first "
+                "(an async feed_pass build would deadlock waiting for it)")
         self.wait_feed_pass_done()
         if self._pending is None or self._pending.table is None:
             raise RuntimeError("begin_pass without a successful feed_pass")
@@ -109,6 +122,7 @@ class PassEngine:
         self._table = self._pending.table
         self._pending = None
         self._pass_id += 1
+        self._no_active_pass.clear()
         log.vlog(1, "begin_pass %d: %d keys, %d shards", self._pass_id,
                  self._current_keys.shape[0], self.num_shards)
         return self._table
@@ -128,7 +142,7 @@ class PassEngine:
         if self._current_keys is None or self._table is None:
             raise RuntimeError("no active pass")
         return map_keys_to_rows(self._current_keys, batch_keys,
-                                self._table.rows_per_shard)
+                                self._table.rows_per_shard, self.num_shards)
 
     def end_pass(self) -> None:
         """Write the pass table back to the store (role of EndPass)."""
@@ -140,4 +154,5 @@ class PassEngine:
             self.store.push_from_pass(self._current_keys, vals)
         self._table = None
         self._current_keys = None
+        self._no_active_pass.set()
         monitor.add("pass/ended", 1)

@@ -166,22 +166,27 @@ def extract_pass_values_host(table: PassTable, num_keys: int) -> Dict[str, np.nd
 
 
 def map_keys_to_rows(pass_keys_sorted: np.ndarray, batch_keys: np.ndarray,
-                     rows_per_shard: int) -> np.ndarray:
+                     rows_per_shard: int, num_shards: int = 1) -> np.ndarray:
     """Host-side: feasigns → device row ids in the shard-contiguous layout.
 
     Role of the key→slot flattening in CopyKeys + the per-pass perfect
     index (SURVEY.md §7 design note). Unknown keys and the 0 padding
-    feasign map to the padding sentinel (trash row of shard 0).
+    feasign map to trash rows, spread round-robin across ALL shards —
+    padding concentrated on one shard would overflow its fixed-capacity
+    all-to-all bucket and silently drop that shard's real lookups.
     """
     n = pass_keys_sorted.shape[0]
-    sentinel_only = np.full(batch_keys.shape, rows_per_shard, np.int32)
+    m = batch_keys.shape[0]
+    # Round-robin trash row per position: shard (i % S)'s trash row.
+    pad_shard = np.arange(m, dtype=np.int64) % num_shards
+    sentinel = (pad_shard * (rows_per_shard + 1) + rows_per_shard
+                ).astype(np.int32)
     if n == 0:
-        return sentinel_only  # empty pass: everything hits the trash row
+        return sentinel  # empty pass: everything hits a trash row
     g = np.searchsorted(pass_keys_sorted, batch_keys)
     g_c = np.minimum(g, n - 1)
     found = (pass_keys_sorted[g_c] == batch_keys) & (batch_keys != 0)
     shard = g_c // rows_per_shard
     row = g_c % rows_per_shard
     dev_row = shard * (rows_per_shard + 1) + row
-    sentinel = rows_per_shard  # trash row of shard 0
     return np.where(found, dev_row, sentinel).astype(np.int32)

@@ -1,0 +1,29 @@
+"""Distributed training metrics: exact AUC, calibration stats, per-user AUC.
+
+Role of the reference metrics engine (``fleet/metrics.{h,cc}``, SURVEY.md
+§2.2 "Metrics (AUC engine)"): ``BasicAucCalculator`` bucketed pos/neg
+histograms + exact distributed AUC via histogram allreduce + trapezoid
+sweep, plus mae/rmse/predicted-vs-actual CTR; ``WuAucMetricMsg`` per-user
+AUC; python fleet.metrics wrappers.
+
+TPU-first: histogram accumulation is a device-side ``segment_sum`` fused
+into the train step; the cross-replica reduction is a ``psum`` over the dp
+axis (replacing the Gloo/MPI allreduce at metrics.cc:289); the final
+trapezoid sweep runs on host at pass end.
+"""
+
+from paddlebox_tpu.metrics.auc import (
+    AucState,
+    auc_state_init,
+    auc_accumulate,
+    auc_compute,
+    wuauc_compute,
+)
+
+__all__ = [
+    "AucState",
+    "auc_accumulate",
+    "auc_compute",
+    "auc_state_init",
+    "wuauc_compute",
+]

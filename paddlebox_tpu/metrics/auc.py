@@ -1,0 +1,147 @@
+"""Exact bucketed AUC + calibration statistics.
+
+Role of ``BasicAucCalculator`` (``fleet/metrics.h:46``, ``metrics.cc:33-355``):
+- ``add_data``: bucket = pred * num_buckets; ``_table[label][bucket] += 1``
+- distributed: allreduce-sum both histograms (metrics.cc:286-292)
+- ``computeBucketAuc``: sweep buckets high→low accumulating trapezoid area
+- side stats: actual ctr, predicted ctr, mae, rmse, bucket error
+
+and ``WuAucMetricMsg`` per-user AUC (``metrics.h:306``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.core import flags
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class AucState:
+    """Device-side accumulator (all replicated across dp after psum).
+
+    table [2, num_buckets] float32 — pos/neg prediction histograms;
+    scalar sums for calibration stats.
+    """
+
+    table: jax.Array
+    abserr: jax.Array
+    sqrerr: jax.Array
+    pred_sum: jax.Array
+    label_sum: jax.Array
+    count: jax.Array
+
+    def tree_flatten(self):
+        return ((self.table, self.abserr, self.sqrerr, self.pred_sum,
+                 self.label_sum, self.count), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+
+def auc_state_init(num_buckets: Optional[int] = None) -> AucState:
+    nb = num_buckets or flags.flag("auc_num_buckets")
+
+    def z():
+        # Distinct buffers per field: a shared constant would break buffer
+        # donation (same buffer donated N times).
+        return jnp.zeros((), jnp.float32)
+
+    return AucState(table=jnp.zeros((2, nb), jnp.float32),
+                    abserr=z(), sqrerr=z(), pred_sum=z(), label_sum=z(),
+                    count=z())
+
+
+def auc_accumulate(state: AucState, preds: jax.Array, labels: jax.Array,
+                   valid: Optional[jax.Array] = None,
+                   axis: Optional[str] = None) -> AucState:
+    """Accumulate a batch (device-side, jit/shard_map-safe).
+
+    preds/labels [B] float32 in [0,1]/{0,1}; valid [B] bool masks padding
+    rows. When ``axis`` is given (inside shard_map) the per-batch increment
+    is psum'd over it so the state stays replicated — the role of the
+    Gloo/MPI allreduce, paid incrementally.
+    """
+    nb = state.table.shape[1]
+    w = jnp.ones_like(preds) if valid is None else valid.astype(preds.dtype)
+    bucket = jnp.clip((preds * nb).astype(jnp.int32), 0, nb - 1)
+    lab = (labels > 0.5).astype(jnp.int32)
+    flat = lab * nb + bucket
+    inc_table = jax.ops.segment_sum(w, flat, num_segments=2 * nb
+                                    ).reshape(2, nb)
+    err = (preds - labels) * w
+    inc = (inc_table, jnp.sum(jnp.abs(err)), jnp.sum(err * err),
+           jnp.sum(preds * w), jnp.sum(labels * w), jnp.sum(w))
+    if axis is not None:
+        inc = jax.lax.psum(inc, axis)
+    return AucState(table=state.table + inc[0],
+                    abserr=state.abserr + inc[1],
+                    sqrerr=state.sqrerr + inc[2],
+                    pred_sum=state.pred_sum + inc[3],
+                    label_sum=state.label_sum + inc[4],
+                    count=state.count + inc[5])
+
+
+def auc_compute(state: AucState) -> Dict[str, float]:
+    """Host-side final sweep (role of computeBucketAuc + calculate_bucket_error,
+    metrics.cc:124-355). Returns auc, actual/predicted ctr, mae, rmse."""
+    table = np.asarray(state.table, np.float64)
+    neg, pos = table[0], table[1]
+    tot_pos = pos.sum()
+    tot_neg = neg.sum()
+    # AUC = P(score_pos > score_neg): sweep buckets low->high, each positive
+    # in bucket b beats all negatives in lower buckets and ties (half) with
+    # negatives in its own bucket (trapezoid, metrics.cc:124 equivalent).
+    neg_cum = np.cumsum(neg) - neg
+    area = float(np.sum(pos * (neg_cum + neg * 0.5)))
+    if tot_pos > 0 and tot_neg > 0:
+        auc = area / (tot_pos * tot_neg)
+    else:
+        auc = float("nan")
+    count = max(float(state.count), 1.0)
+    return {
+        "auc": auc,
+        "actual_ctr": float(state.label_sum) / count,
+        "predicted_ctr": float(state.pred_sum) / count,
+        "mae": float(state.abserr) / count,
+        "rmse": (float(state.sqrerr) / count) ** 0.5,
+        "count": float(state.count),
+    }
+
+
+def wuauc_compute(user_ids: np.ndarray, preds: np.ndarray,
+                  labels: np.ndarray) -> Dict[str, float]:
+    """Per-user (weighted-user) AUC on host (role of WuAucMetricMsg,
+    metrics.h:306 / ``computeWuAuc``): group records by user, compute AUC
+    per user with >=1 pos and >=1 neg, average weighted by instance count."""
+    order = np.argsort(user_ids, kind="stable")
+    uids, preds, labels = user_ids[order], preds[order], labels[order]
+    boundaries = np.flatnonzero(
+        np.concatenate([[True], uids[1:] != uids[:-1], [True]]))
+    wauc_sum = 0.0
+    weight_sum = 0.0
+    user_count = 0
+    for lo, hi in zip(boundaries[:-1], boundaries[1:]):
+        p, l = preds[lo:hi], labels[lo:hi]
+        npos = float((l > 0.5).sum())
+        nneg = float(len(l) - npos)
+        if npos == 0 or nneg == 0:
+            continue
+        # rank-sum AUC within user
+        ranks = np.argsort(np.argsort(p, kind="stable"), kind="stable") + 1
+        auc_u = (ranks[l > 0.5].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+        w = hi - lo
+        wauc_sum += auc_u * w
+        weight_sum += w
+        user_count += 1
+    return {
+        "wuauc": wauc_sum / weight_sum if weight_sum else float("nan"),
+        "wuauc_users": float(user_count),
+    }

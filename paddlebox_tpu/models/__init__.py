@@ -1,0 +1,13 @@
+"""Model zoo.
+
+CTR family (role of the PaddleBox production models built on
+``_pull_box_sparse`` + ``fused_seqpool_cvm`` graphs,
+``python/paddle/fluid/contrib/layers/nn.py:1746``): DeepFM, Wide&Deep.
+Dense families (ResNet/BERT/GPT — the reference's fleet collective /
+hybrid-parallel configs) live in their own modules.
+"""
+
+from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.models.wide_deep import WideDeep
+
+__all__ = ["DeepFM", "WideDeep"]

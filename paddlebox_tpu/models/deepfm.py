@@ -1,0 +1,70 @@
+"""DeepFM CTR model over pulled sparse embeddings.
+
+The BASELINE.md config-4 model (DeepFM on Criteo, reference path
+``pull_box_sparse`` + dense ops). Consumes the sparse pull outputs
+(per-slot CSR embeddings) and produces logits:
+
+  logit = wide(w) + FM2(v) + MLP(concat slot embeddings [, dense feats])
+
+Functional: ``init`` returns the dense-param pytree; ``apply`` is pure so
+the trainer can differentiate wrt (params, pulled_emb, pulled_w) and feed
+the embedding grads straight into the sparse push.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.nn import mlp_apply, mlp_init
+from paddlebox_tpu.ops import seqpool
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepFM:
+    slot_names: Tuple[str, ...]
+    emb_dim: int
+    dense_dim: int = 0                    # width of concatenated dense slots
+    hidden: Tuple[int, ...] = (400, 400, 400)
+
+    def init(self, rng: jax.Array) -> Dict:
+        s = len(self.slot_names)
+        in_dim = s * self.emb_dim + self.dense_dim
+        rng, sub = jax.random.split(rng)
+        return {
+            "mlp": mlp_init(sub, in_dim, list(self.hidden) + [1]),
+            "bias": jnp.zeros((), jnp.float32),
+        }
+
+    def apply(self, params: Dict,
+              emb: Dict[str, jax.Array],       # slot -> [cap_s, D] pulled
+              w: Dict[str, jax.Array],         # slot -> [cap_s] pulled
+              segments: Dict[str, jax.Array],  # slot -> [cap_s] row ids
+              batch_size: int,
+              dense_feats: jax.Array | None = None) -> jax.Array:
+        """Returns logits [B]."""
+        pooled_v: List[jax.Array] = []   # per-slot [B, D]
+        wide_terms: List[jax.Array] = []  # per-slot [B]
+        for name in self.slot_names:
+            pooled_v.append(seqpool(emb[name], segments[name], batch_size))
+            wide_terms.append(seqpool(w[name], segments[name], batch_size))
+        v = jnp.stack(pooled_v, axis=1)                   # [B, S, D]
+
+        # Wide (first-order) term.
+        wide = sum(wide_terms) + params["bias"]           # [B]
+
+        # FM second-order interaction: 0.5 * ((Σ_s v)^2 - Σ_s v^2).
+        sum_v = jnp.sum(v, axis=1)                        # [B, D]
+        sum_sq = jnp.sum(v * v, axis=1)                   # [B, D]
+        fm = 0.5 * jnp.sum(sum_v * sum_v - sum_sq, axis=-1)  # [B]
+
+        # Deep tower.
+        flat = v.reshape(v.shape[0], -1)                  # [B, S*D]
+        if dense_feats is not None and self.dense_dim:
+            flat = jnp.concatenate([flat, dense_feats], axis=-1)
+        deep = mlp_apply(params["mlp"], flat)[:, 0]       # [B]
+
+        return wide + fm + deep

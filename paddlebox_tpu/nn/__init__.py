@@ -1,0 +1,16 @@
+"""Minimal functional NN layer library for the CTR dense towers.
+
+Role of the dense-layer subset of ``python/paddle/fluid/layers/nn.py`` /
+``paddle.nn`` used by CTR models. Deliberately functional (init fns return
+param pytrees; apply fns are pure) so train steps control donation and
+sharding explicitly; the transformer/vision model zoo uses flax on top.
+"""
+
+from paddlebox_tpu.nn.layers import (
+    dense_init,
+    dense_apply,
+    mlp_init,
+    mlp_apply,
+)
+
+__all__ = ["dense_init", "dense_apply", "mlp_init", "mlp_apply"]

@@ -1,0 +1,41 @@
+"""Pure-functional dense layers (param pytrees + apply fns)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_init(rng: jax.Array, in_dim: int, out_dim: int,
+               dtype=jnp.float32) -> Dict[str, jax.Array]:
+    """Xavier-uniform weight + zero bias."""
+    bound = (6.0 / (in_dim + out_dim)) ** 0.5
+    w = jax.random.uniform(rng, (in_dim, out_dim), dtype, -bound, bound)
+    return {"w": w, "b": jnp.zeros((out_dim,), dtype)}
+
+
+def dense_apply(params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+    return jnp.dot(x, params["w"],
+                   preferred_element_type=jnp.float32) + params["b"]
+
+
+def mlp_init(rng: jax.Array, in_dim: int, hidden: Sequence[int],
+             dtype=jnp.float32) -> List[Dict[str, jax.Array]]:
+    layers = []
+    dims = [in_dim] + list(hidden)
+    for i in range(len(hidden)):
+        rng, sub = jax.random.split(rng)
+        layers.append(dense_init(sub, dims[i], dims[i + 1], dtype))
+    return layers
+
+
+def mlp_apply(layers: List[Dict[str, jax.Array]], x: jax.Array,
+              activation: Callable[[jax.Array], jax.Array] = jax.nn.relu,
+              final_activation: bool = False) -> jax.Array:
+    for i, layer in enumerate(layers):
+        x = dense_apply(layer, x)
+        if i + 1 < len(layers) or final_activation:
+            x = activation(x)
+    return x

@@ -1,0 +1,23 @@
+"""Fused CTR ops (TPU lowerings of the reference's custom CUDA op family).
+
+Role of ``paddle/fluid/operators/fused/`` (SURVEY.md §2.2 "Fused CTR ops"):
+``fused_seqpool_cvm`` + variants, ``cvm_op``, ``rank_attention``. On TPU
+these are expressed as XLA-fusable segment ops / batched matmuls — XLA fuses
+the elementwise CVM transform into the pooling reduction, so no hand kernel
+is needed for the memory-bound path; the MXU-bound rank-attention is a
+batched gather + dot_general.
+"""
+
+from paddlebox_tpu.ops.seqpool import (
+    seqpool,
+    fused_seqpool_cvm,
+    continuous_value_model,
+)
+from paddlebox_tpu.ops.rank_attention import rank_attention
+
+__all__ = [
+    "continuous_value_model",
+    "fused_seqpool_cvm",
+    "rank_attention",
+    "seqpool",
+]

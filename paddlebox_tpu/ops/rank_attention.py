@@ -1,0 +1,63 @@
+"""Rank attention op for rank-aware CTR models.
+
+Role of ``rank_attention_op`` (``operators/rank_attention_op.cc:28-76``,
+CUDA kernels ``operators/rank_attention.cu.h:28-91``): every instance has a
+rank (position bucket) and up to ``max_rank`` (faster_rank, peer_index)
+pairs in ``rank_offset``; the op gathers each peer's feature row, selects a
+parameter block indexed by the (instance_rank, faster_rank) pair, and
+contracts — Out[b] = Σ_k X[index_k] @ P[(lower_b, faster_k)].
+
+TPU-first: the reference expands input and params into helper buffers then
+runs a blocked GEMM; here the whole thing is one gather + one einsum that
+XLA maps onto the MXU, with validity masking instead of zero-fill buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rank_attention(x: jax.Array, rank_offset: jax.Array,
+                   rank_param: jax.Array, *, max_rank: int
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Apply rank attention.
+
+    x           [B, F]            instance features
+    rank_offset [B, 1 + 2*max_rank] int32 — col 0: 1-based instance rank
+                (0 = invalid); then (faster_rank_k, peer_index_k) pairs,
+                faster_rank 1-based, peer_index row into x
+    rank_param  [max_rank * max_rank, F, C] — block (lower*max_rank +
+                faster) is the [F, C] weight for that rank pair
+
+    Returns (out [B, C], ins_rank [B] float32) matching the reference's
+    Out / InsRank outputs.
+    """
+    b, f = x.shape
+    k = max_rank
+    if rank_offset.shape[1] != 1 + 2 * k:
+        raise ValueError(
+            f"rank_offset has {rank_offset.shape[1]} cols, expected {1 + 2*k}")
+    if rank_param.shape[0] != k * k or rank_param.shape[1] != f:
+        raise ValueError(
+            f"rank_param shape {rank_param.shape} != ({k*k}, {f}, C)")
+
+    lower = rank_offset[:, 0] - 1                       # [B]
+    faster = rank_offset[:, 1::2] - 1                   # [B, K]
+    index = rank_offset[:, 2::2]                        # [B, K]
+    valid = (lower >= 0)[:, None] & (faster >= 0)       # [B, K]
+
+    safe_index = jnp.where(valid, index, 0)
+    xin = x[safe_index]                                 # [B, K, F]
+    xin = jnp.where(valid[..., None], xin, 0.0)
+
+    block = lower[:, None] * k + faster                 # [B, K]
+    safe_block = jnp.clip(jnp.where(valid, block, 0), 0, k * k - 1)
+    psel = rank_param[safe_block]                       # [B, K, F, C]
+    psel = jnp.where(valid[..., None, None], psel, 0.0)
+
+    out = jnp.einsum("bkf,bkfc->bc", xin, psel,
+                     preferred_element_type=jnp.float32)
+    return out, rank_offset[:, 0].astype(jnp.float32)

@@ -1,0 +1,82 @@
+"""Sequence pooling + CVM ops over CSR slot batches.
+
+Role of the fused seqpool+CVM CUDA family
+(``operators/fused/fused_seqpool_cvm_op.cu`` and python wrapper
+``python/paddle/fluid/contrib/layers/nn.py:1746`` ``fused_seqpool_cvm``)
+and ``cvm_op`` (``operators/cvm_op.cu``): per-instance sum-pool of each
+slot's embedding sequence, then the "continuous value model" normalization
+that replaces the leading [show, click] columns with
+[log(show+1), log(click+1) - log(show+1)].
+
+TPU-first: pooling is ``jax.ops.segment_sum`` over the static CSR segment
+ids (padding rows accumulate into a discard row) and the CVM transform is
+elementwise — XLA fuses the two, reproducing the "fused" property of the
+reference kernel without a hand-written kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def seqpool(values: jax.Array, segments: jax.Array, num_rows: int,
+            mode: str = "sum") -> jax.Array:
+    """Pool variable-length per-instance sequences to one row each.
+
+    values [n, ...]; segments [n] row ids in [0, num_rows] where num_rows
+    marks padding (discard row). Returns [num_rows, ...].
+    """
+    if mode not in ("sum", "mean", "sqrtn"):
+        raise ValueError(f"unknown seqpool mode {mode!r}")
+    pooled = jax.ops.segment_sum(values, segments, num_segments=num_rows + 1)
+    pooled = pooled[:num_rows]
+    if mode == "sum":
+        return pooled
+    ones = jnp.ones(values.shape[:1], values.dtype)
+    counts = jax.ops.segment_sum(ones, segments, num_segments=num_rows + 1)
+    counts = jnp.maximum(counts[:num_rows], 1.0)
+    counts = counts.reshape(counts.shape + (1,) * (pooled.ndim - 1))
+    if mode == "mean":
+        return pooled / counts
+    return pooled / jnp.sqrt(counts)
+
+
+def continuous_value_model(x: jax.Array, *, use_cvm: bool = True) -> jax.Array:
+    """CVM normalization (role of cvm_op, operators/cvm_op.cu).
+
+    x [B, 2 + D] with leading [show, click] columns. use_cvm=True keeps
+    width (log-transformed counters); False strips the two columns —
+    matching the reference op's two modes.
+    """
+    show = x[:, 0]
+    click = x[:, 1]
+    rest = x[:, 2:]
+    if not use_cvm:
+        return rest
+    log_show = jnp.log(show + 1.0)
+    ctr = jnp.log(click + 1.0) - log_show
+    return jnp.concatenate([log_show[:, None], ctr[:, None], rest], axis=-1)
+
+
+def fused_seqpool_cvm(emb: jax.Array, show: jax.Array, click: jax.Array,
+                      segments: jax.Array, num_rows: int, *,
+                      use_cvm: bool = True, mode: str = "sum",
+                      clip_value: Optional[float] = None) -> jax.Array:
+    """Fused sequence-pool + CVM for one slot.
+
+    emb [n, D] pulled embeddings; show/click [n] per-feature counters from
+    the sparse pull; segments [n] CSR row ids (num_rows = discard). Output
+    [num_rows, 2 + D] when use_cvm else [num_rows, D].
+
+    Mirrors fused_seqpool_cvm's contract where the embedding's first two
+    channels carry show/click — here they arrive as separate pull outputs
+    and are concatenated pre-pool, which XLA fuses into one pass.
+    """
+    if clip_value is not None:
+        emb = jnp.clip(emb, -clip_value, clip_value)
+    x = jnp.concatenate([show[:, None], click[:, None], emb], axis=-1)
+    pooled = seqpool(x, segments, num_rows, mode=mode)
+    return continuous_value_model(pooled, use_cvm=use_cvm)

@@ -1,0 +1,10 @@
+"""Trainers: pass-driven CTR training loop (role of L6 trainer runtime).
+
+Role of ``BoxPSTrainer``/``BoxPSWorker`` (``framework/boxps_trainer.cc``,
+``boxps_worker.cc``) and the ``train_from_dataset`` entry
+(``python/paddle/fluid/executor.py:1787``).
+"""
+
+from paddlebox_tpu.train.ctr_trainer import CTRTrainer, TrainerConfig
+
+__all__ = ["CTRTrainer", "TrainerConfig"]

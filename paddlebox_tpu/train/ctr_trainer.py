@@ -1,0 +1,272 @@
+"""Pass-driven CTR trainer: the BoxPSTrainer/BoxPSWorker equivalent.
+
+Role of the reference hot loop (``boxps_worker.cc:666-724`` TrainFiles):
+per minibatch — pack batch (``BuildSlotBatchGPU``), pull sparse
+(``PullSparse``), run fwd/bwd ops, push sparse grads (``PushSparseGrad``),
+sync dense (``SyncParam``), collect AUC (``AddAucMonitor``) — plus the
+``train_from_dataset`` pass loop around it.
+
+TPU-first: the whole per-batch sequence is ONE jitted shard_map program —
+pull (all slots fused into one all-to-all), model fwd/bwd, exact global
+logloss, dense psum + optax update, sparse push with fused optimizer, and
+AUC histogram accumulation — so XLA overlaps compute with the pull/push
+collectives and there is no per-op dispatch. Device threads, streams, and
+the NCCL ring of the reference collapse into the compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.core import flags, log, timers
+from paddlebox_tpu.data.dataset import Dataset
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
+from paddlebox_tpu.embedding import PassEngine, SparseAdagrad, TableConfig
+from paddlebox_tpu.embedding.lookup import pull_local, push_local
+from paddlebox_tpu.metrics import (AucState, auc_accumulate, auc_compute,
+                                   auc_state_init)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerConfig:
+    dense_learning_rate: float = 1e-3
+    dense_optimizer: str = "adam"
+    auc_num_buckets: int = 1 << 16
+    check_nan_inf: bool = False
+
+
+class CTRTrainer:
+    """Owns PassEngine + dense params + the fused train step.
+
+    Usage (mirrors the BoxPS day/pass loop, SURVEY.md §3.1):
+
+        trainer = CTRTrainer(model, feed_cfg, table_cfg, mesh=mesh)
+        trainer.init(seed=0)
+        for pass_files in day:
+            dataset.set_filelist(pass_files); dataset.load_into_memory()
+            stats = trainer.train_pass(dataset)
+        trainer.engine.store.save_base(path)
+    """
+
+    def __init__(self, model, feed_config: DataFeedConfig,
+                 table_config: TableConfig, *,
+                 mesh: Optional[Mesh] = None, axis: str = "dp",
+                 config: TrainerConfig = TrainerConfig()):
+        self.model = model
+        self.feed_config = feed_config
+        self.config = config
+        self.mesh = mesh
+        self.axis = axis
+        self.ndev = int(mesh.shape[axis]) if mesh is not None else 1
+        if feed_config.batch_size % self.ndev:
+            raise ValueError(
+                f"batch_size {feed_config.batch_size} must be divisible by "
+                f"the {axis} axis size {self.ndev}")
+        self.engine = PassEngine(table_config, mesh=mesh, table_axis=axis)
+        self.sparse_opt = SparseAdagrad.from_config(table_config)
+        self.params: Any = None
+        self.opt_state: Any = None
+        self.auc_state: Optional[AucState] = None
+        self.timers = timers.TimerGroup()
+        self._step_fn = None
+        self._slot_names = [s.name for s in feed_config.sparse_slots]
+        # Sharded capacities: always divisible by ndev (matches
+        # SlotBatch.pack_sharded / Dataset.batches_sharded shapes).
+        self._slot_caps = {
+            s.name: feed_config.sparse_capacity(s, num_shards=self.ndev)
+            for s in feed_config.sparse_slots}
+        if self.config.dense_optimizer == "adam":
+            self._optax = optax.adam(self.config.dense_learning_rate)
+        elif self.config.dense_optimizer == "sgd":
+            self._optax = optax.sgd(self.config.dense_learning_rate)
+        else:
+            raise ValueError(self.config.dense_optimizer)
+
+    # -- init -------------------------------------------------------------
+
+    def init(self, seed: int = 0) -> None:
+        rng = jax.random.PRNGKey(seed)
+        self.params = self.model.init(rng)
+        self.opt_state = self._optax.init(self.params)
+        self.auc_state = auc_state_init(self.config.auc_num_buckets)
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            self.params = jax.device_put(self.params, rep)
+            self.opt_state = jax.device_put(self.opt_state, rep)
+            self.auc_state = jax.device_put(self.auc_state, rep)
+
+    # -- the fused step ----------------------------------------------------
+
+    def _build_step(self):
+        model = self.model
+        axis = self.axis
+        ndev = self.ndev
+        names = self._slot_names
+        caps = self._slot_caps
+        caps_local = {n: caps[n] // ndev for n in names}
+        bs_local = self.feed_config.batch_size // ndev
+        optimizer = self._optax
+        sparse_opt = self.sparse_opt
+        has_dense = bool(self.feed_config.dense_slots)
+
+        def body(table, params, opt_state, auc, rows, segments, labels,
+                 valid, dense_feats):
+            # rows: [sum caps_local] — all slots' ids fused into ONE pull
+            # (one all_to_all pair instead of per-slot collectives).
+            pulled = pull_local(table, rows, axis=axis)
+
+            offs = np.cumsum([0] + [caps_local[n] for n in names])
+            sl = {n: slice(offs[i], offs[i + 1])
+                  for i, n in enumerate(names)}
+            labels1 = labels[:, 0]
+            validf = valid.astype(jnp.float32)
+
+            def loss_fn(params, emb_all, w_all):
+                emb = {n: emb_all[sl[n]] for n in names}
+                w = {n: w_all[sl[n]] for n in names}
+                kwargs = dict(batch_size=bs_local,
+                              dense_feats=dense_feats if has_dense else None)
+                if hasattr(model, "use_cvm"):  # Wide&Deep takes show/click
+                    show = {n: pulled["show"][sl[n]] for n in names}
+                    click = {n: pulled["click"][sl[n]] for n in names}
+                    logits = model.apply(params, emb, w, show, click,
+                                         segments, **kwargs)
+                else:
+                    logits = model.apply(params, emb, w, segments, **kwargs)
+                # Exact global logloss: local sum / global valid count.
+                bce = optax.sigmoid_binary_cross_entropy(logits, labels1)
+                total_valid = lax.psum(jnp.sum(validf), axis)
+                loss = jnp.sum(bce * validf) / jnp.maximum(total_valid, 1.0)
+                return loss, logits
+
+            grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1, 2),
+                                         has_aux=True)
+            (loss, logits), (g_params, g_emb, g_w) = grad_fn(
+                params, pulled["emb"], pulled["w"])
+
+            # Dense sync: grads already carry the global 1/N via the global
+            # denominator — psum completes the cross-replica reduction
+            # (role of SyncParam / c_allreduce_sum).
+            g_params = lax.psum(g_params, axis)
+            updates, opt_state = optimizer.update(g_params, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            # Sparse push: show=1 per occurrence, click=its row's label
+            # (role of feature show/click stats in PushSparseGrad).
+            seg_all = jnp.concatenate([segments[n] for n in names])
+            occ_valid = (seg_all < bs_local).astype(jnp.float32)
+            clicks = jnp.where(seg_all < bs_local,
+                               labels1[jnp.minimum(seg_all, bs_local - 1)],
+                               0.0) * occ_valid
+            table = push_local(table, rows, g_emb, g_w, occ_valid, clicks,
+                               axis=axis, opt=sparse_opt)
+
+            probs = jax.nn.sigmoid(logits)
+            auc = auc_accumulate(auc, probs, labels1, valid, axis=axis)
+            loss_global = lax.psum(loss, axis)
+            return table, params, opt_state, auc, loss_global
+
+        if self.mesh is not None:
+            body_sm = jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(axis), P(), P(), P(), P(axis), P(axis), P(axis),
+                          P(axis), P(axis)),
+                out_specs=(P(axis), P(), P(), P(), P()),
+                check_vma=False)
+        else:
+            raise RuntimeError("CTRTrainer requires a mesh (1-device is a "
+                               "1-axis mesh)")
+        return jax.jit(body_sm, donate_argnums=(0, 1, 2, 3))
+
+    # -- pass loop ---------------------------------------------------------
+
+    def train_pass(self, dataset: Dataset, *, feed_keys: bool = True
+                   ) -> Dict[str, float]:
+        """Train one pass over the dataset (role of train_from_dataset +
+        begin_pass/end_pass, SURVEY.md §3.1)."""
+        if self.params is None:
+            raise RuntimeError("call init() first")
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        eng = self.engine
+        if feed_keys:
+            with self.timers.scope("feed_pass"):
+                eng.feed_pass(dataset.pass_keys())
+        table = eng.begin_pass()
+        params, opt_state = self.params, self.opt_state
+        auc = self.auc_state
+        bs = self.feed_config.batch_size
+        losses: List[float] = []
+        nsteps = 0
+        for batch in dataset.batches_sharded(self.ndev):
+            with self.timers.scope("host_map"):
+                all_ids = np.concatenate(
+                    [batch.ids[n] for n in self._slot_names])
+                rows = eng.lookup_rows(all_ids)
+                # Interleave per-device: [dev, slot, cap_local] flatten.
+                rows = _interleave_slots(rows, self._slot_names,
+                                         self._slot_caps, self.ndev)
+                segs = {n: jnp.asarray(batch.segments[n])
+                        for n in self._slot_names}
+                dense = _concat_dense(batch)
+            with self.timers.scope("device_step"):
+                table, params, opt_state, auc, loss = self._step_fn(
+                    table, params, opt_state, auc, jnp.asarray(rows), segs,
+                    jnp.asarray(batch.labels), jnp.asarray(batch.valid),
+                    dense)
+            nsteps += 1
+            if self.config.check_nan_inf or flags.flag("check_nan_inf"):
+                lf = float(loss)
+                if not np.isfinite(lf):
+                    raise FloatingPointError(
+                        f"NaN/Inf loss at step {nsteps}")
+            losses.append(loss)
+        eng.update_table(table)
+        self.params, self.opt_state, self.auc_state = params, opt_state, auc
+        with self.timers.scope("end_pass"):
+            eng.end_pass()
+        stats = auc_compute(self.auc_state)
+        stats["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+        stats["steps"] = nsteps
+        log.vlog(0, "pass done: steps=%d loss=%.5f auc=%.5f (%s)",
+                 nsteps, stats["loss"], stats["auc"], self.timers.report())
+        return stats
+
+    def reset_metrics(self) -> None:
+        self.auc_state = auc_state_init(self.config.auc_num_buckets)
+        if self.mesh is not None:
+            self.auc_state = jax.device_put(
+                self.auc_state, NamedSharding(self.mesh, P()))
+
+
+def _interleave_slots(rows_concat: np.ndarray, names: List[str],
+                      caps: Dict[str, int], ndev: int) -> np.ndarray:
+    """Reorder [slotA(all devs), slotB(all devs), ...] into per-device
+    groups [dev0: slotA,slotB..., dev1: ...] so sharding the flat array
+    over dp gives each device its own slots' local ids contiguously."""
+    parts = []
+    off = 0
+    per_slot = {}
+    for n in names:
+        per_slot[n] = rows_concat[off:off + caps[n]].reshape(ndev, -1)
+        off += caps[n]
+    for d in range(ndev):
+        for n in names:
+            parts.append(per_slot[n][d])
+    return np.concatenate(parts)
+
+
+def _concat_dense(batch: SlotBatch):
+    if batch.dense:
+        return jnp.asarray(
+            np.concatenate([batch.dense[k] for k in sorted(batch.dense)],
+                           axis=-1))
+    return jnp.zeros((batch.labels.shape[0], 0), jnp.float32)

@@ -1,0 +1,95 @@
+"""End-to-end CTR training: the SURVEY.md §7 'minimum slice' bar —
+DeepFM / Wide&Deep on synthetic slot data, multi-pass, with learning
+verified by AUC lift, on the 8-device virtual mesh."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import Dataset, DataFeedConfig, SlotConf
+from paddlebox_tpu.embedding import TableConfig
+from paddlebox_tpu.models import DeepFM, WideDeep
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+SLOTS = ("user", "item", "ctx")
+
+
+def _synthetic_shard(path, n, seed, num_feats=200):
+    """Clickiness is driven by feature identity so the model can learn:
+    features with id % 5 == 0 are 'clicky'."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            feats = {s: rng.integers(1, num_feats, rng.integers(1, 4))
+                     for s in SLOTS}
+            clickiness = np.mean([(int(v) % 5 == 0)
+                                  for vs in feats.values() for v in vs])
+            label = int(rng.random() < 0.1 + 0.8 * clickiness)
+            toks = " ".join(f"{s}:{v}" for s, vs in feats.items() for v in vs)
+            f.write(f"{label} {toks}\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def shard_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ctr")
+    return [_synthetic_shard(d / f"part-{i}", 512, seed=i) for i in range(2)]
+
+
+def _feed_config(bs=64):
+    return DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=2.0) for s in SLOTS),
+        batch_size=bs)
+
+
+def _run_training(model_cls, shard_files, passes=3):
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = _feed_config()
+    table = TableConfig(dim=8, learning_rate=0.1)
+    model = model_cls(slot_names=SLOTS, emb_dim=8, hidden=(32, 16))
+    trainer = CTRTrainer(model, feed, table, mesh=mesh,
+                         config=TrainerConfig(dense_learning_rate=3e-3,
+                                              auc_num_buckets=1 << 12))
+    trainer.init(seed=0)
+    ds = Dataset(feed, num_reader_threads=2)
+    ds.set_filelist(shard_files)
+    ds.load_into_memory()
+    stats_by_pass = []
+    for p in range(passes):
+        trainer.reset_metrics()
+        ds.local_shuffle(seed=p)
+        stats_by_pass.append(trainer.train_pass(ds))
+    return trainer, stats_by_pass
+
+
+def test_deepfm_learns(shard_files):
+    trainer, stats = _run_training(DeepFM, shard_files)
+    assert stats[0]["steps"] == 16  # 1024 instances / 64
+    for s in stats:
+        assert np.isfinite(s["loss"])
+    # AUC improves materially over passes on learnable synthetic data.
+    assert stats[-1]["auc"] > 0.65, [s["auc"] for s in stats]
+    assert stats[-1]["auc"] > stats[0]["auc"] - 0.02
+    # Store persisted features across passes.
+    assert trainer.engine.store.num_features > 100
+
+
+def test_widedeep_learns(shard_files):
+    _, stats = _run_training(WideDeep, shard_files)
+    assert stats[-1]["auc"] > 0.6, [s["auc"] for s in stats]
+
+
+def test_checkpoint_roundtrip_continues(shard_files, tmp_path):
+    trainer, stats = _run_training(DeepFM, shard_files, passes=2)
+    trainer.engine.store.save_base(str(tmp_path / "base"))
+
+    # New trainer, restored store: first pass starts from trained features.
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = _feed_config()
+    model = DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(32, 16))
+    t2 = CTRTrainer(model, feed, TableConfig(dim=8, learning_rate=0.1),
+                    mesh=mesh,
+                    config=TrainerConfig(auc_num_buckets=1 << 12))
+    t2.init(seed=0)
+    t2.engine.store.load(str(tmp_path / "base"), "base")
+    assert t2.engine.store.num_features == trainer.engine.store.num_features

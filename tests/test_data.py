@@ -89,7 +89,7 @@ def test_pack_static_shapes():
 
 
 def test_dataset_load_shuffle_batches(tmp_path):
-    lines = [f"{i % 2} user:{100 + i} user:{200 + i} item:{i} dense0:{i},{i},{i}"
+    lines = [f"{i % 2} user:{100 + i} user:{200 + i} item:{i + 1} dense0:{i},{i},{i}"
              for i in range(37)]
     shards = [_write_shard(tmp_path, f"part-{j}", lines[j::3]) for j in range(3)]
     ds = Dataset(CFG, num_reader_threads=3)
@@ -107,7 +107,7 @@ def test_dataset_load_shuffle_batches(tmp_path):
 
 
 def test_dataset_preload_and_key_sink(tmp_path):
-    lines = [f"1 user:{i} item:{i}" for i in range(10)]
+    lines = [f"1 user:{i} item:{i}" for i in range(1, 11)]
     shard = _write_shard(tmp_path, "p0", lines)
     seen = []
     ds = Dataset(CFG)
@@ -134,7 +134,7 @@ def test_dataset_pipe_command(tmp_path):
 
 
 def test_global_shuffle_loopback(tmp_path):
-    lines = [f"1 user:{i} item:{i}" for i in range(20)]
+    lines = [f"1 user:{i} item:{i}" for i in range(1, 21)]
     shard = _write_shard(tmp_path, "p0", lines)
     ds = Dataset(CFG)
     ds.set_filelist([shard])
@@ -176,11 +176,15 @@ def test_failing_pipe_command_raises(tmp_path):
         ds.load_into_memory()
 
 
-def test_parser_negative_feasign_skipped():
+def test_parser_negative_and_zero_feasign_dropped():
     from paddlebox_tpu.data import parse_lines as pl
-    ins = pl(["1 user:-5 item:3", "0 user:4 item:5"], CFG)
-    assert len(ins) == 1  # negative-feasign line skipped, not crashed
-    np.testing.assert_array_equal(ins[0].sparse["user"], [4])
+    # Out-of-range/null feasign tokens are dropped (counted), line kept.
+    ins = pl(["1 user:-5 item:3", "0 user:0 item:5"], CFG)
+    assert len(ins) == 2
+    assert "user" not in ins[0].sparse  # -5 dropped
+    np.testing.assert_array_equal(ins[0].sparse["item"], [3])
+    assert "user" not in ins[1].sparse  # 0 is the null sentinel
+    np.testing.assert_array_equal(ins[1].sparse["item"], [5])
 
 
 def test_global_shuffle_requires_transport(tmp_path):

@@ -47,13 +47,25 @@ def _adagrad_ref(v, g2, g, lr=0.1, ig=1.0, scalar=False):
 def test_map_keys_to_rows():
     keys = np.array([3, 7, 10, 15, 22, 30, 41, 55], np.uint64)
     rps = plan_shards(8, 2)  # 4 rows/shard
-    rows = map_keys_to_rows(keys, np.array([3, 55, 99, 0, 22], np.uint64), rps)
+    rows = map_keys_to_rows(keys, np.array([3, 55, 99, 0, 22], np.uint64),
+                            rps, num_shards=2)
     # shard block = rps+1; key 3 -> g0 -> row 0; 55 -> g7 -> shard1 row3
     assert rows[0] == 0
     assert rows[1] == 1 * (rps + 1) + 3
-    assert rows[2] == rps  # unknown -> sentinel trash row of shard 0
-    assert rows[3] == rps  # 0 feasign -> sentinel
+    # Sentinels spread round-robin over shards' trash rows by position:
+    assert rows[2] == 0 * (rps + 1) + rps  # pos 2 -> shard 0 trash
+    assert rows[3] == 1 * (rps + 1) + rps  # pos 3 -> shard 1 trash
     assert rows[4] == 1 * (rps + 1) + 0  # 22 -> g4 -> shard1 row0
+
+
+def test_sentinels_spread_evenly():
+    # Regression: padding concentrated on shard 0 would overflow its
+    # all-to-all bucket; sentinels must hit every shard's trash row.
+    rows = map_keys_to_rows(np.array([5], np.uint64),
+                            np.zeros(64, np.uint64), 4, num_shards=8)
+    shards = rows // 5  # block = rps+1 = 5
+    np.testing.assert_array_equal(np.bincount(shards, minlength=8),
+                                  [8] * 8)
 
 
 def test_table_roundtrip_host():
