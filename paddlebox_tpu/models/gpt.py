@@ -1,0 +1,211 @@
+"""GPT-style transformer with full hybrid parallelism (dp×pp×sp×mp).
+
+The BASELINE.md config-3 model (GPT 1.3B hybrid parallel; reference path
+``fleet/meta_parallel/`` TP+PP+sharding). Composes the whole parallelism
+suite in one train step:
+
+- mp: vocab-parallel embedding + column/row-parallel attention & FFN +
+  vocab-parallel cross entropy (roles of mp_layers.py / c_embedding /
+  c_softmax_with_cross_entropy)
+- pp: transformer blocks partitioned into stages streamed with the
+  scan+ppermute pipeline (role of PipelineParallel.forward_backward_pipeline)
+- sp: ring attention over the sequence axis (NEW capability, absent in the
+  reference — SURVEY.md §5)
+- dp: batch sharding; gradient reduction falls out of autodiff through the
+  global-mean loss (role of EagerReducer/c_allreduce_sum)
+
+Everything runs inside ONE ``shard_map`` over the hybrid mesh; jax.grad
+through it yields the full hybrid backward (pipelined, ring-reversed,
+TP-transposed) with XLA scheduling all collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.parallel import pp as pplib
+from paddlebox_tpu.parallel import sp as splib
+from paddlebox_tpu.parallel import tp as tplib
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 1024
+    dtype: Any = jnp.float32
+
+
+def _layer_init(rng, cfg: GPTConfig):
+    d, f = cfg.d_model, cfg.d_ff
+    k = iter(jax.random.split(rng, 6))
+    s = d ** -0.5
+    return {
+        "ln1_g": jnp.ones((d,)), "ln1_b": jnp.zeros((d,)),
+        # Column order is HEAD-MAJOR [head0(q,k,v) | head1(q,k,v) | ...] so
+        # the mp sharding splits whole heads, not q/k/v mid-tensor.
+        "wqkv": jax.random.normal(next(k), (d, 3 * d)) * s,
+        "wo": jax.random.normal(next(k), (d, d)) * s,
+        "ln2_g": jnp.ones((d,)), "ln2_b": jnp.zeros((d,)),
+        "wi": jax.random.normal(next(k), (d, f)) * s,
+        "bi": jnp.zeros((f,)),
+        "wo2": jax.random.normal(next(k), (f, d)) * (f ** -0.5),
+        "bo2": jnp.zeros((d,)),
+    }
+
+
+def _layer_specs():
+    """TP shardings per layer leaf (with the stacked [pp, layer] dims
+    prepended by the caller)."""
+    return {
+        "ln1_g": P(), "ln1_b": P(),
+        "wqkv": P(None, "mp"),   # column-parallel: heads split over mp
+        "wo": P("mp", None),     # row-parallel
+        "ln2_g": P(), "ln2_b": P(),
+        "wi": P(None, "mp"),     # column-parallel FFN in
+        "bi": P("mp"),
+        "wo2": P("mp", None),    # row-parallel FFN out
+        "bo2": P(),
+    }
+
+
+def init_gpt(rng: jax.Array, cfg: GPTConfig, *, pp_stages: int = 1
+             ) -> Tuple[Dict, Dict]:
+    """Returns (params, partition_specs). Layer params are stacked
+    [pp_stages, layers_per_stage, ...]."""
+    if cfg.n_layers % pp_stages:
+        raise ValueError(f"{cfg.n_layers} layers not divisible into "
+                         f"{pp_stages} stages")
+    lps = cfg.n_layers // pp_stages
+    keys = jax.random.split(rng, cfg.n_layers + 3)
+    layers = [_layer_init(keys[i], cfg) for i in range(cfg.n_layers)]
+    # Stack [pp, layers_per_stage, ...].
+    stages = [jax.tree.map(lambda *xs: jnp.stack(xs),
+                           *layers[s * lps:(s + 1) * lps])
+              for s in range(pp_stages)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+    params = {
+        "embed": jax.random.normal(keys[-3], (cfg.vocab_size, cfg.d_model))
+        * 0.02,
+        "pos": jax.random.normal(keys[-2], (cfg.max_seq_len, cfg.d_model))
+        * 0.02,
+        "layers": stacked,
+        "lnf_g": jnp.ones((cfg.d_model,)), "lnf_b": jnp.zeros((cfg.d_model,)),
+        "head": jax.random.normal(keys[-1], (cfg.d_model, cfg.vocab_size))
+        * cfg.d_model ** -0.5,
+    }
+    lspecs = _layer_specs()
+    specs = {
+        "embed": P("mp", None),        # vocab-parallel
+        "pos": P(None, None),
+        "layers": jax.tree.map(
+            lambda s: P("pp", None, *s), lspecs,
+            is_leaf=lambda x: isinstance(x, P)),
+        "lnf_g": P(), "lnf_b": P(),
+        "head": P(None, "mp"),         # vocab-parallel head
+    }
+    return params, specs
+
+
+def _ln(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+
+def _block(p, x, cfg: GPTConfig, heads_local: int):
+    """One transformer block on local shards: x [mb, S_local, D];
+    wqkv local [D, 3*D/mp]."""
+    b, s, d = x.shape
+    hd = cfg.d_model // cfg.n_heads
+    h = _ln(x, p["ln1_g"], p["ln1_b"])
+    qkv = jnp.dot(h, p["wqkv"], preferred_element_type=jnp.float32)
+    qkv = qkv.reshape(b, s, heads_local, 3, hd)
+    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    attn = splib.ring_attention(q, k, v, axis="sp", causal=True)
+    attn = attn.reshape(b, s, heads_local * hd)
+    o = jnp.dot(attn, p["wo"], preferred_element_type=jnp.float32)
+    o = lax.psum(o, "mp")                       # row-parallel combine
+    x = x + o
+    h2 = _ln(x, p["ln2_g"], p["ln2_b"])
+    u = jnp.dot(h2, p["wi"], preferred_element_type=jnp.float32) + p["bi"]
+    u = jax.nn.gelu(u)
+    y = jnp.dot(u, p["wo2"], preferred_element_type=jnp.float32)
+    y = lax.psum(y, "mp") + p["bo2"]
+    return x + y
+
+
+def gpt_loss_fn(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
+                num_microbatches: int = 1):
+    """Builds loss(params, tokens, targets) -> scalar, shard_mapped over
+    the hybrid mesh. tokens/targets [B, S] int32; B sharded over dp,
+    S over sp."""
+    heads_local = cfg.n_heads // int(mesh.shape["mp"])
+
+    def stage_fn(stage_params, x):
+        # stage_params leaves [layers_per_stage, ...]; scan over layers.
+        def body(h, lp):
+            return _block(lp, h, cfg, heads_local), None
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    def body(params, tokens, targets):
+        # tokens local [B_local, S_local]
+        x = tplib.vocab_parallel_embedding(
+            {"table": params["embed"]}, tokens, axis="mp")
+        rank_sp = lax.axis_index("sp")
+        s_local = tokens.shape[1]
+        pos_ids = rank_sp * s_local + jnp.arange(s_local)
+        x = x + params["pos"][pos_ids][None, :, :]
+
+        # Microbatch the local batch for the pipeline.
+        bl = x.shape[0]
+        m = num_microbatches
+        x_mb = x.reshape(m, bl // m, s_local, cfg.d_model)
+        stage_params_local = jax.tree.map(lambda a: a[0], params["layers"])
+        h_mb = pplib.gpipe_apply(stage_fn, stage_params_local, x_mb,
+                                 axis="pp")
+        h = h_mb.reshape(bl, s_local, cfg.d_model)
+
+        h = _ln(h, params["lnf_g"], params["lnf_b"])
+        logits_local = jnp.dot(h, params["head"],
+                               preferred_element_type=jnp.float32)
+        losses = tplib.parallel_cross_entropy(logits_local, targets,
+                                              axis="mp")
+        # Global mean over all tokens (dp × sp shards).
+        total = lax.psum(jnp.sum(losses), ("dp", "sp"))
+        count = lax.psum(jnp.asarray(losses.size, jnp.float32), ("dp", "sp"))
+        return total / count
+
+    in_specs = (specs, P("dp", "sp"), P("dp", "sp"))
+    return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                         check_vma=False)
+
+
+def make_gpt_train_step(cfg: GPTConfig, mesh: Mesh, specs: Dict,
+                        optimizer, *, num_microbatches: int = 1):
+    """Jitted (params, opt_state, tokens, targets) -> (params, opt_state,
+    loss) with donation. Gradient reduction across dp/pp/sp/mp falls out
+    of differentiating through the shard_map."""
+    loss_fn = gpt_loss_fn(cfg, mesh, specs,
+                          num_microbatches=num_microbatches)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))

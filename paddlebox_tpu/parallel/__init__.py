@@ -19,11 +19,21 @@ from paddlebox_tpu.parallel.topology import (
     set_default_topology,
 )
 from paddlebox_tpu.parallel import collective
+from paddlebox_tpu.parallel import moe
+from paddlebox_tpu.parallel import pp
+from paddlebox_tpu.parallel import sp
+from paddlebox_tpu.parallel import tp
+from paddlebox_tpu.parallel import zero
 
 __all__ = [
     "HybridTopology",
     "build_mesh",
     "collective",
     "get_default_topology",
+    "moe",
+    "pp",
     "set_default_topology",
+    "sp",
+    "tp",
+    "zero",
 ]

@@ -1,0 +1,111 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+Role of the reference MoE stack (``python/paddle/incubate/distributed/
+models/moe/moe_layer.py`` MoELayer, ``gate/gshard_gate.py``, C++
+``global_scatter/global_gather`` ops, ``operators/collective/
+global_scatter_op.cc``): top-k gating, capacity-limited dispatch to
+experts sharded across devices, weighted combine on return.
+
+TPU-first: GShard-style static-shape dispatch — position-in-expert via
+cumsum over one-hot assignments, fixed capacity buffers, one all_to_all
+out and one back (replacing brpc/NCCL global_scatter/global_gather). The
+einsum-heavy dispatch/combine maps onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top2_gate(logits: jax.Array, *, capacity: int
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """GShard top-2 gating (role of gshard_gate.py).
+
+    logits [T, E] → (combine [T, E, C], dispatch [T, E, C] bool, aux_loss).
+    combine[t, e, c] is the gate weight with which token t lands in
+    expert e's capacity slot c.
+    """
+    t, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    # Top-1 and top-2 expert per token.
+    idx1 = jnp.argmax(gates, axis=-1)                          # [T]
+    mask1 = jax.nn.one_hot(idx1, e, dtype=gates.dtype)
+    gates2 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=gates.dtype)
+
+    # Aux load-balancing loss (mean gate * mean assignment per expert).
+    density = jnp.mean(mask1, axis=0)
+    density_proxy = jnp.mean(gates, axis=0)
+    aux_loss = jnp.sum(density * density_proxy) * (e * e) / e
+
+    # Capacity positions: top-1 tokens first, then top-2.
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1           # pos in expert
+    pos2 = (jnp.cumsum(mask2, axis=0) - mask2 +
+            jnp.sum(mask1, axis=0, keepdims=True)) * mask2
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    g1 = jnp.sum(gates * keep1, axis=-1)
+    g2 = jnp.sum(gates * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    loc1 = jnp.sum(pos1 * keep1, axis=-1).astype(jnp.int32)    # [T]
+    loc2 = jnp.sum(pos2 * keep2, axis=-1).astype(jnp.int32)
+
+    oh_c1 = jax.nn.one_hot(loc1, capacity, dtype=gates.dtype)  # [T, C]
+    oh_c2 = jax.nn.one_hot(loc2, capacity, dtype=gates.dtype)
+    combine = (g1[:, None, None] * keep1[:, :, None] * oh_c1[:, None, :] +
+               g2[:, None, None] * keep2[:, :, None] * oh_c2[:, None, :])
+    dispatch = combine > 0.0
+    return combine, dispatch, aux_loss
+
+
+def moe_layer(gate_w: jax.Array, expert_params: Dict[str, jax.Array],
+              expert_fn: Callable[[Dict, jax.Array], jax.Array],
+              x: jax.Array, *, axis: str = "ep",
+              capacity_factor: float = 1.25
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel MoE layer (call INSIDE shard_map).
+
+    gate_w [F, E_total] (replicated); expert_params: pytree whose leaves
+    have leading dim E_local (this device's experts); expert_fn(params_e,
+    tokens [N, F]) -> [N, F] is vmapped over local experts.
+    x [T_local, F] local tokens. Returns (y [T_local, F], aux_loss).
+    """
+    n = lax.axis_size(axis)
+    t_local, f = x.shape
+    e_local = jax.tree.leaves(expert_params)[0].shape[0]
+    e_total = e_local * n
+    capacity = max(int(capacity_factor * (2 * t_local) / e_total), 1)
+
+    logits = jnp.dot(x, gate_w, preferred_element_type=jnp.float32)
+    combine, dispatch, aux = top2_gate(logits, capacity=capacity)
+
+    # Dispatch: [T, E, C] x [T, F] -> [E, C, F] buffers.
+    dispatched = jnp.einsum("tec,tf->ecf", dispatch.astype(x.dtype), x,
+                            preferred_element_type=jnp.float32)
+    # all_to_all: split experts across ep, gather source-device dim:
+    # [E_total, C, F] -> [n * E_local, C, F] -> recv [n, E_local, C, F]
+    recv = lax.all_to_all(
+        dispatched.reshape(n, e_local, capacity, f), axis,
+        split_axis=0, concat_axis=0, tiled=False)      # [n, n?..]
+    # tiled=False adds a leading axis: [n, 1, e_local, C, F] — normalize.
+    recv = recv.reshape(n, e_local, capacity, f)
+    # Per-local-expert token batch: [E_local, n*C, F].
+    expert_in = recv.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, f)
+    expert_out = jax.vmap(expert_fn)(expert_params, expert_in)
+    # Return trip.
+    back = expert_out.reshape(e_local, n, capacity, f).transpose(1, 0, 2, 3)
+    returned = lax.all_to_all(back, axis, split_axis=0, concat_axis=0,
+                              tiled=False).reshape(e_total, capacity, f)
+    # Combine: [T, E, C] x [E, C, F] -> [T, F].
+    y = jnp.einsum("tec,ecf->tf", combine.astype(returned.dtype), returned,
+                   preferred_element_type=jnp.float32)
+    return y.astype(x.dtype), aux

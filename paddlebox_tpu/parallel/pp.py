@@ -1,0 +1,113 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+Role of the reference's pipeline stacks: dygraph 1F1B
+(``meta_parallel/pipeline_parallel.py:82`` forward_backward_pipeline),
+``PipelineLayer`` partitioning (``parallel_layers/pp_layers.py``), p2p
+send/recv (``pp_utils/p2p_communication.py``), and static-graph
+``SectionWorker`` microbatch scopes (``section_worker.cc:40-116``).
+
+TPU-first: stages live on the pp mesh axis (every device holds ITS stage's
+params — stacked pytrees sharded on the leading dim); microbatches stream
+through a ``lax.scan`` whose body computes one stage step and rotates
+activations to the next stage with ``ppermute`` (neighbor ICI transfer).
+Autodiff through the scan yields the pipeline backward with activation
+stashing (GPipe schedule) — no hand-written adjoint, no interceptor
+runtime; XLA overlaps the ppermute with the next microbatch's compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(stage_params: Sequence[Any]) -> Any:
+    """Host-side: stack per-stage param pytrees on a new leading dim
+    (shard it over "pp": each device then holds its own stage's params).
+    Role of PipelineLayer's partitioning of the layer list."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def stage_specs(stacked_params: Any, axis: str = "pp") -> Any:
+    """PartitionSpecs sharding the stacked leading dim over the pp axis."""
+    return jax.tree.map(lambda _: P(axis), stacked_params)
+
+
+def gpipe_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                params_local: Any, x_microbatches: jax.Array, *,
+                axis: str = "pp") -> jax.Array:
+    """Run the pipeline on microbatches (call INSIDE shard_map).
+
+    stage_fn(params, act) -> act: one stage's computation (same signature
+    on every stage; heterogeneous stages dispatch on a params field).
+    params_local: this device's stage params (leading stage dim already
+    consumed by sharding).
+    x_microbatches [M, mb, F]: the full microbatched input (replicated or
+    dp-sharded on mb; only stage 0 reads it).
+
+    Returns [M, mb, F_out]: outputs, valid on the LAST stage and
+    broadcast to all pp ranks via masked psum (so out_specs can be P()).
+
+    Total steps = M + n_stages - 1; the bubble executes masked compute,
+    same cost shape as the reference's 1F1B bubble.
+    """
+    n = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    m = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+
+    # Probe output shape once (shapes static).
+    out_shape = jax.eval_shape(lambda p, a: stage_fn(p, a), params_local,
+                               jax.ShapeDtypeStruct(mb_shape, x_microbatches.dtype))
+
+    state0 = jnp.zeros(mb_shape, x_microbatches.dtype)
+    outputs0 = jnp.zeros((m,) + out_shape.shape, out_shape.dtype)
+
+    def step(carry, t):
+        state, outputs = carry
+        # Stage 0 ingests microbatch t (while t < m).
+        x_t = x_microbatches[jnp.clip(t, 0, m - 1)]
+        ingest = (rank == 0) & (t < m)
+        state = jnp.where(ingest, x_t, state)
+        y = stage_fn(params_local, state)
+        # Last stage emits microbatch t - (n-1) when in range.
+        mb_idx = t - (n - 1)
+        emit = (rank == n - 1) & (mb_idx >= 0) & (mb_idx < m)
+        idx = jnp.clip(mb_idx, 0, m - 1)
+        outputs = outputs.at[idx].set(
+            jnp.where(emit, y, outputs[idx]))
+        # Rotate activations to the next stage.
+        state = lax.ppermute(y, axis, [(i, (i + 1) % n) for i in range(n)])
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(step, (state0, outputs0),
+                               jnp.arange(m + n - 1))
+    # Broadcast final outputs from the last stage to every pp rank so the
+    # loss is computable anywhere (role of _broadcast_final_loss,
+    # pipeline_parallel.py:325).
+    is_last = (rank == n - 1).astype(outputs.dtype)
+    return lax.psum(outputs * is_last, axis)
+
+
+def make_pipeline_fn(mesh: Mesh, stage_fn, stacked_params_template, *,
+                     axis: str = "pp", extra_in_specs: Tuple = ()):
+    """Jitted wrapper: (stacked_params, x_microbatches) -> outputs."""
+    import functools
+
+    pspecs = stage_specs(stacked_params_template, axis)
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(pspecs, P()) + extra_in_specs,
+        out_specs=P(), check_vma=False)
+    def run(stacked_params, x_mb):
+        params_local = jax.tree.map(lambda a: a[0], stacked_params)
+        return gpipe_apply(stage_fn, params_local, x_mb, axis=axis)
+
+    return run

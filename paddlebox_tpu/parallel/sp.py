@@ -1,0 +1,129 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+ABSENT from the 2022-era reference (SURVEY.md §5 "Long-context / sequence
+parallelism: not present") — designed TPU-first here as a first-class
+capability: long sequences are sharded over the ``sp`` mesh axis and
+attention crosses shards either by
+
+- **ring attention**: K/V blocks rotate around the sp ring via
+  ``ppermute`` (ICI neighbor exchange) while each device keeps a running
+  flash-attention-style online softmax over its Q block — ``lax.scan``
+  keeps the rotation one fused XLA loop so transfer overlaps compute, or
+- **Ulysses**: all-to-all exchanging the sequence axis for the head axis,
+  so each device runs full-sequence attention for a head subset.
+
+Both are pure functions for use inside ``shard_map`` with q/k/v already
+sequence-sharded: [B, S_local, H, Dh].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = -1e30
+
+
+def _block_attn(q, k, v, bias, scale):
+    """Un-normalized partial attention of one q-block against one kv-block.
+
+    Returns (numerator [B,Sq,H,D], row-max m [B,Sq,H], row-sum l [B,Sq,H]).
+    Fully-masked rows yield m=_NEG_BIG, l=0, num=0 (no NaNs).
+    """
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.maximum(jnp.max(s, axis=-1), _NEG_BIG)
+    p = jnp.exp(s - m[..., None])          # exp(-inf - finite) = 0 for masks
+    l = jnp.sum(p, axis=-1)
+    num = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(p.dtype),
+                     preferred_element_type=jnp.float32)
+    return num, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention over a sequence sharded on the sp ring.
+
+    q/k/v [B, S_local, H, Dh] (local shard). Output [B, S_local, H, Dh]
+    exactly equals full-sequence attention (online-softmax merge across
+    ring steps). causal masks by GLOBAL position (rank * S_local + t).
+    """
+    n = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    b, s_local, h, d = q.shape
+    if scale is None:
+        scale = float(d) ** -0.5
+    q_pos = rank * s_local + jnp.arange(s_local)
+
+    def step(carry, block_idx):
+        k_blk, v_blk, acc, m_run, l_run = carry
+        # Rotation sends blocks to rank+1, so after block_idx rotations the
+        # block we hold originated at rank - block_idx.
+        src = (rank - block_idx) % n
+        if causal:
+            k_pos = src * s_local + jnp.arange(s_local)
+            bias = jnp.where(q_pos[:, None] >= k_pos[None, :],
+                             0.0, -jnp.inf)[None, :, None, :]
+        else:
+            bias = None
+        num, m_blk, l_blk = _block_attn(q, k_blk, v_blk, bias, scale)
+        m_new = jnp.maximum(m_run, m_blk)
+        w_old = jnp.exp(m_run - m_new)
+        w_blk = jnp.exp(m_blk - m_new)
+        acc = acc * w_old[..., None] + num * w_blk[..., None]
+        l_run = l_run * w_old + l_blk * w_blk
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, acc, m_new, l_run), None
+
+    acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    m0 = jnp.full((b, s_local, h), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, s_local, h), jnp.float32)
+    (_, _, acc, _, l_run), _ = lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(n))
+    out = acc / jnp.maximum(l_run, 1e-20)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis: str = "sp", causal: bool = False,
+                      scale: Optional[float] = None) -> jax.Array:
+    """DeepSpeed-Ulysses-style SP: all-to-all seq<->head, full-sequence
+    attention on a head subset, all-to-all back.
+
+    q/k/v [B, S_local, H, Dh] with H divisible by the sp axis size.
+    """
+    n = lax.axis_size(axis)
+    b, s_local, h, d = q.shape
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by sp axis {n}")
+    if scale is None:
+        scale = float(d) ** -0.5
+
+    def seq_to_head(x):
+        # [B, S_local, H, D] -> [B, S, H/n, D]: exchange seq for heads.
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    s_full = qg.shape[1]
+    if causal:
+        pos = jnp.arange(s_full)
+        bias = jnp.where(pos[:, None] >= pos[None, :],
+                         0.0, -jnp.inf)[None, :, None, :]
+    else:
+        bias = None
+    num, m, l = _block_attn(qg, kg, vg, bias, scale)
+    out = num / jnp.maximum(l, 1e-20)[..., None]
+    return head_to_seq(out.astype(q.dtype))

@@ -1,0 +1,135 @@
+"""Tensor (model) parallel layer library.
+
+Role of the reference's dygraph TP layers
+(``fleet/meta_parallel/parallel_layers/mp_layers.py``):
+``VocabParallelEmbedding`` (:30), ``ColumnParallelLinear`` (:95),
+``RowParallelLinear`` (:171), ``ParallelCrossEntropy`` (:251) and the C++
+ops ``c_embedding``, ``c_softmax_with_cross_entropy``
+(``operators/collective/``).
+
+TPU-first: each layer is a pure function designed to run inside
+``shard_map`` over the ``mp`` mesh axis, with parameters held as the LOCAL
+shard (leading/trailing dim already split). Collectives are explicit lax
+ops on the mp axis — XLA schedules them over ICI. Init helpers return
+full-size params plus the PartitionSpec to shard them with, so pjit can
+alternatively partition automatically (GSPMD path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+# -- vocab-parallel embedding ----------------------------------------------
+
+def vocab_parallel_embedding_init(rng: jax.Array, vocab: int, dim: int,
+                                  scale: float = 0.02):
+    """Full table [vocab, dim]; shard with P("mp", None)."""
+    return {"table": jax.random.normal(rng, (vocab, dim)) * scale}, \
+        {"table": P("mp", None)}
+
+
+def vocab_parallel_embedding(params: Dict, ids: jax.Array, *, axis: str = "mp"
+                             ) -> jax.Array:
+    """ids [**shape] int32 (replicated over mp) → [**shape, dim].
+
+    Local shard holds rows [rank*V_local, (rank+1)*V_local); out-of-range
+    ids contribute zeros, psum combines (role of c_embedding fwd +
+    allreduce, mp_layers.py:75-85).
+    """
+    table = params["table"]           # local [V_local, D]
+    v_local = table.shape[0]
+    rank = lax.axis_index(axis)
+    lo = rank * v_local
+    local_ids = ids - lo
+    in_range = (local_ids >= 0) & (local_ids < v_local)
+    emb = table[jnp.clip(local_ids, 0, v_local - 1)]
+    emb = jnp.where(in_range[..., None], emb, 0.0)
+    return lax.psum(emb, axis)
+
+
+# -- column/row parallel linear --------------------------------------------
+
+def column_parallel_linear_init(rng: jax.Array, in_dim: int, out_dim: int):
+    """W [in, out] sharded on out: P(None, "mp"); bias sharded on "mp"."""
+    bound = (6.0 / (in_dim + out_dim)) ** 0.5
+    w = jax.random.uniform(rng, (in_dim, out_dim), jnp.float32, -bound, bound)
+    return {"w": w, "b": jnp.zeros((out_dim,))}, \
+        {"w": P(None, "mp"), "b": P("mp")}
+
+
+def column_parallel_linear(params: Dict, x: jax.Array, *,
+                           gather_output: bool = False, axis: str = "mp"
+                           ) -> jax.Array:
+    """x [.., in] replicated → [.., out/mp] (or [.., out] if gathered).
+
+    Identity fwd / allreduce bwd on x happens automatically through
+    autodiff of the replicated input (role of ColumnParallelLinear,
+    mp_layers.py:95).
+    """
+    y = jnp.dot(x, params["w"], preferred_element_type=jnp.float32)
+    y = y + params["b"]
+    if gather_output:
+        y = lax.all_gather(y, axis, axis=y.ndim - 1, tiled=True)
+    return y
+
+
+def row_parallel_linear_init(rng: jax.Array, in_dim: int, out_dim: int):
+    """W [in, out] sharded on in: P("mp", None); bias replicated."""
+    bound = (6.0 / (in_dim + out_dim)) ** 0.5
+    w = jax.random.uniform(rng, (in_dim, out_dim), jnp.float32, -bound, bound)
+    return {"w": w, "b": jnp.zeros((out_dim,))}, \
+        {"w": P("mp", None), "b": P()}
+
+
+def row_parallel_linear(params: Dict, x: jax.Array, *,
+                        input_is_parallel: bool = True, axis: str = "mp"
+                        ) -> jax.Array:
+    """x [.., in/mp] (parallel) → [.., out] replicated via psum (role of
+    RowParallelLinear allreduce fwd, mp_layers.py:171)."""
+    if not input_is_parallel:
+        rank = lax.axis_index(axis)
+        in_local = params["w"].shape[0]
+        x = lax.dynamic_slice_in_dim(x, rank * in_local, in_local,
+                                     axis=x.ndim - 1)
+    y = jnp.dot(x, params["w"], preferred_element_type=jnp.float32)
+    y = lax.psum(y, axis)
+    return y + params["b"]
+
+
+# -- vocab-parallel cross entropy ------------------------------------------
+
+def parallel_cross_entropy(logits_local: jax.Array, labels: jax.Array, *,
+                           axis: str = "mp") -> jax.Array:
+    """Softmax-CE over vocab sharded on mp (role of ParallelCrossEntropy /
+    c_softmax_with_cross_entropy_op.cu).
+
+    logits_local [.., V/mp]; labels [..] int32 global vocab ids.
+    Returns per-token loss [..]. Numerically stable: global max via pmax,
+    global sum-exp via psum, target logit fetched from its owner shard.
+    """
+    v_local = logits_local.shape[-1]
+    rank = lax.axis_index(axis)
+    lo = rank * v_local
+
+    # Stabilizer max: analytically gradient-free (softmax-CE grad is
+    # independent of the shift). pmax has no differentiation rule even on
+    # a stopped operand, so take the cross-shard max via all_gather (which
+    # is differentiable) over a stop_gradient'ed local max.
+    local_max = lax.stop_gradient(jnp.max(logits_local, axis=-1))
+    m = jnp.max(lax.all_gather(local_max, axis, axis=0, tiled=False),
+                axis=0)                                           # [..]
+    z = lax.psum(jnp.sum(jnp.exp(logits_local - m[..., None]), axis=-1),
+                 axis)                                            # [..]
+    local_label = labels - lo
+    in_range = (local_label >= 0) & (local_label < v_local)
+    tgt = jnp.take_along_axis(
+        logits_local, jnp.clip(local_label, 0, v_local - 1)[..., None],
+        axis=-1)[..., 0]
+    tgt = lax.psum(jnp.where(in_range, tgt, 0.0), axis)           # [..]
+    return jnp.log(z) + m - tgt

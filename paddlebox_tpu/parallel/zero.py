@@ -1,0 +1,68 @@
+"""ZeRO-style parameter/optimizer-state sharding helpers.
+
+Role of the reference sharding stack (``meta_parallel/sharding_parallel.py``,
+``sharding/group_sharded_stage{2,3}.py``, static ``ShardingOptimizer``,
+``fleet/meta_optimizers/sharding_optimizer.py:46``): stage 1/2 shard
+optimizer state + gradients across a sharding group, stage 3 shards the
+parameters themselves.
+
+TPU-first: ZeRO is NOT an algorithm here — it is a set of sharding
+annotations. Shard a leaf's largest divisible dim over the ``sharding``
+mesh axis and jit/pjit does the rest: XLA inserts reduce-scatter for
+gradients into sharded state and all-gathers for sharded params at use
+sites (exactly the stage-2/3 communication schedule, compiler-scheduled).
+These helpers build those PartitionSpecs for arbitrary pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _spec_for_leaf(shape: Sequence[int], axis_size: int, axis: str,
+                   min_size: int) -> P:
+    """Shard the largest dim divisible by axis_size; P() if none/small."""
+    if int(np.prod(shape)) < min_size:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+    for d in dims:
+        if shape[d] % axis_size == 0 and shape[d] >= axis_size:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def zero_specs(tree: Any, mesh: Mesh, *, axis: str = "sharding",
+               min_size: int = 1 << 14) -> Any:
+    """PartitionSpecs sharding every (large-enough) leaf over ``axis``.
+
+    Apply to optimizer state only → ZeRO-1/2; apply to params too →
+    ZeRO-3. Leaves smaller than ``min_size`` elements stay replicated
+    (gather latency would dominate).
+    """
+    axis_size = int(mesh.shape[axis])
+    if axis_size == 1:
+        return jax.tree.map(lambda _: P(), tree)
+    return jax.tree.map(
+        lambda x: _spec_for_leaf(np.shape(x), axis_size, axis, min_size),
+        tree)
+
+
+def zero_shardings(tree: Any, mesh: Mesh, *, axis: str = "sharding",
+                   min_size: int = 1 << 14) -> Any:
+    """NamedShardings version of :func:`zero_specs` (for device_put /
+    jit out_shardings)."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        zero_specs(tree, mesh, axis=axis, min_size=min_size))
+
+
+def shard_tree(tree: Any, mesh: Mesh, *, axis: str = "sharding",
+               min_size: int = 1 << 14) -> Any:
+    """device_put a pytree with ZeRO shardings (host → sharded HBM)."""
+    sh = zero_shardings(tree, mesh, axis=axis, min_size=min_size)
+    return jax.tree.map(jax.device_put, tree, sh)
