@@ -1,0 +1,110 @@
+"""Automatic mixed precision: bf16 policies + dynamic loss scaling.
+
+Role of the reference AMP stack (SURVEY.md §2.7): static AMP pass
+(``fleet/meta_optimizers/amp_optimizer.py``), dygraph ``paddle.amp``, and
+the fused C++ AMP ops ``check_finite_and_unscale_op`` /
+``update_loss_scaling_op`` (``operators/amp/``).
+
+TPU-first: the native fast dtype is bfloat16, whose fp32-sized exponent
+makes loss scaling unnecessary for most models — ``Policy("bf16")`` just
+casts compute to bf16 and keeps params/updates fp32, and XLA uses the MXU
+bf16 path. Dynamic loss scaling is still provided for fp16-style parity
+(and for models with bf16-underflowing grads): scale/unscale + global
+finite check + growth/backoff, matching update_loss_scaling semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype policy: cast inputs/compute, keep params and optimizer fp32."""
+
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            tree)
+
+    def cast_to_param(self, tree: Any) -> Any:
+        return jax.tree.map(
+            lambda x: x.astype(self.param_dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            tree)
+
+
+def bf16_policy() -> Policy:
+    return Policy(compute_dtype=jnp.bfloat16)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class LossScaleState:
+    """Dynamic loss-scale state (role of update_loss_scaling_op):
+    scale grows 2x after ``growth_interval`` consecutive finite steps,
+    halves on any non-finite grad, which also skips the update."""
+
+    scale: jax.Array
+    growth_tracker: jax.Array
+    growth_interval: int = 2000
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    max_scale: float = 2.0 ** 24
+
+    def tree_flatten(self):
+        return ((self.scale, self.growth_tracker),
+                (self.growth_interval, self.growth_factor,
+                 self.backoff_factor, self.max_scale))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(leaves[0], leaves[1], *aux)
+
+
+def loss_scale_init(initial: float = 2.0 ** 15, **kw) -> LossScaleState:
+    return LossScaleState(scale=jnp.float32(initial),
+                          growth_tracker=jnp.int32(0), **kw)
+
+
+def scale_loss(state: LossScaleState, loss: jax.Array) -> jax.Array:
+    return loss * state.scale
+
+
+def unscale_and_check(state: LossScaleState, grads: Any
+                      ) -> Tuple[Any, jax.Array, LossScaleState]:
+    """(unscaled grads, all_finite, new state). Apply the update only
+    where all_finite (role of check_finite_and_unscale +
+    update_loss_scaling)."""
+    inv = 1.0 / state.scale
+    grads = jax.tree.map(lambda g: g * inv, grads)
+    finite = jnp.array(True)
+    for g in jax.tree.leaves(grads):
+        finite &= jnp.isfinite(g).all()
+    new_tracker = jnp.where(finite, state.growth_tracker + 1, 0)
+    grow = new_tracker >= state.growth_interval
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grow, jnp.minimum(state.scale * state.growth_factor,
+                                    state.max_scale), state.scale),
+        state.scale * state.backoff_factor)
+    new_tracker = jnp.where(grow, 0, new_tracker)
+    return grads, finite, LossScaleState(
+        scale=new_scale, growth_tracker=new_tracker,
+        growth_interval=state.growth_interval,
+        growth_factor=state.growth_factor,
+        backoff_factor=state.backoff_factor, max_scale=state.max_scale)
+
+
+def masked_update(finite: jax.Array, new_tree: Any, old_tree: Any) -> Any:
+    """Select new values only when grads were finite (skip-step)."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(finite, n, o), new_tree, old_tree)

@@ -1,0 +1,25 @@
+"""Checkpointing: dense pytree snapshots + the day/pass production protocol.
+
+Roles (SURVEY.md §5 "Checkpoint / resume"):
+- dense: ``paddle.save/load`` / ``save_persistables`` → :mod:`dense` pytree
+  snapshots (npz, jax-array aware, orbax-compatible layout on disk)
+- sparse: base+delta lives with the FeatureStore
+  (``embedding/store.py``, role of SaveBase/SaveDelta)
+- production protocol: day/pass-addressed output dirs with atomic done-file
+  publication and online pass scheduling — role of ``FleetUtil``
+  (``fleet_util.py:368-1196`` save_batch_model / save_delta_model /
+  write_model_donefile / get_online_pass_interval)
+"""
+
+from paddlebox_tpu.checkpoint.dense import load_pytree, save_pytree
+from paddlebox_tpu.checkpoint.protocol import (
+    CheckpointProtocol,
+    get_online_pass_interval,
+)
+
+__all__ = [
+    "CheckpointProtocol",
+    "get_online_pass_interval",
+    "load_pytree",
+    "save_pytree",
+]

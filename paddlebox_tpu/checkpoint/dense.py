@@ -1,0 +1,56 @@
+"""Dense pytree checkpointing (role of paddle.save / save_persistables).
+
+Flat-key npz format: pytree paths joined with ``/``; arrays fetched to
+host. Restores into the template's structure, re-placing onto the
+template leaves' shardings (so a restored model resumes with identical
+layouts — including ZeRO-sharded optimizer state).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+def _flatten(tree: Any) -> Dict[str, Any]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save_pytree(tree: Any, path: str, *, step: int | None = None) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    flat = _flatten(tree)
+    if step is not None:
+        flat["__step__"] = np.asarray(step)
+    tmp = path + ".tmp"
+    np.savez(tmp, **flat)
+    # np.savez appends .npz to the name it opens.
+    os.replace(tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp, path)
+
+
+def load_pytree(template: Any, path: str) -> Any:
+    """Restore into template's structure + shardings. Returns (tree, step)."""
+    data = np.load(path)
+    flat_t = _flatten(template)
+    missing = [k for k in flat_t if k not in data.files]
+    if missing:
+        raise KeyError(f"checkpoint missing keys: {missing[:5]}")
+    leaves_paths = jax.tree_util.tree_flatten_with_path(template)
+    restored = []
+    for path_keys, leaf in leaves_paths[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path_keys)
+        arr = data[key]
+        if hasattr(leaf, "sharding") and leaf.sharding is not None:
+            arr = jax.device_put(arr, leaf.sharding)
+        restored.append(arr)
+    tree = jax.tree_util.tree_unflatten(leaves_paths[1], restored)
+    step = int(data["__step__"]) if "__step__" in data.files else None
+    return tree, step

@@ -1,0 +1,144 @@
+"""Day/pass checkpoint protocol with atomic done-file publication.
+
+Role of ``FleetUtil`` (reference ``python/paddle/fluid/incubate/fleet/
+utils/fleet_util.py``): day/pass-addressed model output directories
+(``save_batch_model`` :681 — day-level base under <out>/<day>/0;
+``save_delta_model`` :704 — pass-level delta under <out>/<day>/<pass>),
+append-only ``donefile.txt`` with one tab-separated line per published
+model (``write_model_donefile`` :368: day, key, path, pass_id, flag), and
+the online pass schedule (``get_online_pass_interval`` :1196 mapping a
+day's time splits into passes).
+
+TPU-first: the filesystem abstraction is pluggable (local posix here;
+an HDFS/GCS client can swap in), publication is atomic
+(write-temp + rename), and the donefile is the recovery index for
+elastic restart (find last published day/pass, reload base+deltas).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import List, Optional, Tuple
+
+from paddlebox_tpu.core import log
+
+
+def get_online_pass_interval(hours: List[int], split_interval: int,
+                             split_per_pass: int,
+                             is_data_hourly_placed: bool = False
+                             ) -> List[List[str]]:
+    """Map a training day's time splits into pass groups (role of
+    get_online_pass_interval, fleet_util.py:1196).
+
+    hours: training-hour range, e.g. range(24); split_interval: minutes
+    per data split; split_per_pass: splits consumed per pass. Returns one
+    list of split names (HHMM or HH) per pass.
+    """
+    splits_per_day = 24 * 60 // split_interval
+    pass_per_day = splits_per_day // split_per_pass
+    lo, hi = hours[0], hours[-1]
+    split_path = []
+    start = 0
+    for _ in range(splits_per_day):
+        h, m = divmod(start, 60)
+        if lo <= h <= hi:
+            split_path.append(f"{h:02d}" if is_data_hourly_placed
+                              else f"{h:02d}{m:02d}")
+        start += split_interval
+    return [split_path[i * split_per_pass:(i + 1) * split_per_pass]
+            for i in range(pass_per_day)
+            if split_path[i * split_per_pass:(i + 1) * split_per_pass]]
+
+
+@dataclasses.dataclass
+class DoneRecord:
+    day: str
+    key: int
+    path: str
+    pass_id: int
+
+    def line(self) -> str:
+        return f"{self.day}\t{self.key}\t{self.path}\t{self.pass_id}\t0"
+
+    @staticmethod
+    def parse(line: str) -> "DoneRecord":
+        parts = line.strip().split("\t")
+        return DoneRecord(day=parts[0], key=int(parts[1]), path=parts[2],
+                          pass_id=int(parts[3]))
+
+
+class CheckpointProtocol:
+    """Day/pass addressed checkpoint tree with donefile index.
+
+    Layout (mirrors the reference's output convention):
+        <root>/<day>/0/        day-level base model
+        <root>/<day>/<pass>/   pass-level delta model
+        <root>/donefile.txt    append-only publication index
+    """
+
+    def __init__(self, root: str, *, donefile_name: str = "donefile.txt",
+                 is_rank0: bool = True):
+        self.root = root.rstrip("/")
+        self.donefile = os.path.join(self.root, donefile_name)
+        self.is_rank0 = is_rank0
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    def model_dir(self, day: str, pass_id: int = -1) -> str:
+        sub = "0" if pass_id < 0 else str(pass_id)
+        d = os.path.join(self.root, str(day), sub)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- donefile ----------------------------------------------------------
+
+    def records(self) -> List[DoneRecord]:
+        if not os.path.exists(self.donefile):
+            return []
+        with open(self.donefile) as f:
+            return [DoneRecord.parse(l) for l in f if l.strip()]
+
+    def publish(self, day: str, pass_id: int = -1,
+                key: Optional[int] = None) -> bool:
+        """Atomically append a done record (rank 0 only; duplicate
+        day/pass entries are skipped like write_model_donefile)."""
+        if not self.is_rank0:
+            return False
+        day = str(day)
+        pid = 0 if pass_id < 0 else pass_id
+        recs = self.records()
+        if any(r.day == day and r.pass_id == pid for r in recs):
+            log.warning("donefile: %s/%s already published", day, pid)
+            return False
+        rec = DoneRecord(day=day, key=key or int(time.time()),
+                         path=self.model_dir(day, pass_id), pass_id=pid)
+        tmp = self.donefile + ".tmp"
+        with open(tmp, "w") as f:
+            for r in recs:
+                f.write(r.line() + "\n")
+            f.write(rec.line() + "\n")
+        os.replace(tmp, self.donefile)  # atomic publication
+        log.vlog(0, "donefile: published %s/%s -> %s", day, pid, rec.path)
+        return True
+
+    def last_published(self) -> Optional[DoneRecord]:
+        """Recovery entry point: newest published model (role of the
+        donefile consumers in elastic restart)."""
+        recs = self.records()
+        return recs[-1] if recs else None
+
+    def recovery_chain(self) -> Tuple[Optional[DoneRecord], List[DoneRecord]]:
+        """(last day-level base, deltas after it, in order) — the load
+        sequence for failover resume."""
+        recs = self.records()
+        base = None
+        base_i = -1
+        for i, r in enumerate(recs):
+            if r.pass_id == 0:
+                base, base_i = r, i
+        deltas = [r for r in recs[base_i + 1:] if r.pass_id != 0] \
+            if base is not None else []
+        return base, deltas

@@ -129,6 +129,7 @@ def _block(p, x, cfg: GPTConfig, heads_local: int):
     """One transformer block on local shards: x [mb, S_local, D];
     wqkv local [D, 3*D/mp]."""
     b, s, d = x.shape
+    in_dtype = x.dtype
     hd = cfg.d_model // cfg.n_heads
     h = _ln(x, p["ln1_g"], p["ln1_b"])
     qkv = jnp.dot(h, p["wqkv"], preferred_element_type=jnp.float32)
@@ -144,7 +145,9 @@ def _block(p, x, cfg: GPTConfig, heads_local: int):
     u = jax.nn.gelu(u)
     y = jnp.dot(u, p["wo2"], preferred_element_type=jnp.float32)
     y = lax.psum(y, "mp") + p["bo2"]
-    return x + y
+    # Residual stream stays in the input dtype (bf16-safe scan carry);
+    # note x is rebound above, so use the dtype captured at entry.
+    return (x + y).astype(in_dtype)
 
 
 def gpt_loss_fn(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,

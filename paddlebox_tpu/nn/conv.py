@@ -1,0 +1,52 @@
+"""Convolution / normalization layers for the vision stack (pure functional)."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv2d_init(rng: jax.Array, in_ch: int, out_ch: int, kernel: int,
+                dtype=jnp.float32) -> Dict[str, jax.Array]:
+    fan_in = in_ch * kernel * kernel
+    w = jax.random.normal(rng, (kernel, kernel, in_ch, out_ch), dtype)
+    return {"w": w * (2.0 / fan_in) ** 0.5}
+
+
+def conv2d_apply(params: Dict, x: jax.Array, *, stride: int = 1,
+                 padding: str = "SAME") -> jax.Array:
+    """x [B, H, W, C] (NHWC keeps the channel dim on the TPU lane axis)."""
+    return lax.conv_general_dilated(
+        x, params["w"], window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+
+
+def batchnorm_init(ch: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
+    return {"g": jnp.ones((ch,), dtype), "b": jnp.zeros((ch,), dtype),
+            "mean": jnp.zeros((ch,), dtype), "var": jnp.ones((ch,), dtype)}
+
+
+def batchnorm_apply(params: Dict, x: jax.Array, *, train: bool,
+                    momentum: float = 0.9, eps: float = 1e-5,
+                    axis_name: str | None = None
+                    ) -> Tuple[jax.Array, Dict]:
+    """Returns (y, updated_params). Under data parallelism pass axis_name
+    to compute sync batch stats (role of sync_batch_norm)."""
+    if train:
+        mu = jnp.mean(x, axis=(0, 1, 2))
+        var = jnp.mean(x * x, axis=(0, 1, 2)) - mu * mu
+        if axis_name is not None:
+            mu = lax.pmean(mu, axis_name)
+            var = lax.pmean(var, axis_name)
+        new = dict(params)
+        new["mean"] = momentum * params["mean"] + (1 - momentum) * mu
+        new["var"] = momentum * params["var"] + (1 - momentum) * var
+    else:
+        mu, var = params["mean"], params["var"]
+        new = params
+    y = (x - mu) * lax.rsqrt(var + eps) * params["g"] + params["b"]
+    return y, new

@@ -1,0 +1,57 @@
+"""Dense optimizer library (role of ``operators/optimizers/`` +
+``python/paddle/optimizer``): sgd/momentum/adam/adamw/lars/lamb, built on
+optax (the idiomatic JAX optimizer stack) with a string factory mirroring
+the reference's optimizer selection, plus grad clipping and LR schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+
+def make_optimizer(name: str, learning_rate, *, weight_decay: float = 0.0,
+                   momentum: float = 0.9, b1: float = 0.9, b2: float = 0.999,
+                   eps: float = 1e-8, clip_norm: Optional[float] = None,
+                   ) -> optax.GradientTransformation:
+    """Factory by name; lars/lamb cover the reference's large-batch ops
+    (``operators/optimizers/lars_momentum_op``, ``lamb_op``)."""
+    name = name.lower()
+    if name == "sgd":
+        tx = optax.sgd(learning_rate)
+    elif name == "momentum":
+        tx = optax.sgd(learning_rate, momentum=momentum)
+    elif name == "adam":
+        tx = optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+    elif name == "adamw":
+        tx = optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps,
+                         weight_decay=weight_decay)
+    elif name == "lars":
+        tx = optax.lars(learning_rate, weight_decay=weight_decay,
+                        momentum=momentum)
+    elif name == "lamb":
+        tx = optax.lamb(learning_rate, b1=b1, b2=b2, eps=eps,
+                        weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if clip_norm is not None:
+        tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
+    return tx
+
+
+def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
+                  end_lr: float = 0.0) -> optax.Schedule:
+    """Standard BERT/GPT pretraining schedule (role of
+    paddle.optimizer.lr.* schedules)."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak_lr, warmup_steps=warmup_steps,
+        decay_steps=total_steps, end_value=end_lr)
+
+
+def warmup_linear(peak_lr: float, warmup_steps: int, total_steps: int
+                  ) -> optax.Schedule:
+    return optax.join_schedules([
+        optax.linear_schedule(0.0, peak_lr, warmup_steps),
+        optax.linear_schedule(peak_lr, 0.0, total_steps - warmup_steps),
+    ], [warmup_steps])
