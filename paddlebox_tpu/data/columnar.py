@@ -1,0 +1,216 @@
+"""Columnar record chunks: the vectorized host-side data representation.
+
+Role of the reference's C++ record structures and batch packing
+(``SlotRecordObject`` pools + ``BuildSlotBatchGPU``/``CopyForTensor``,
+``data_feed.h:202``, ``data_feed.cc:2713``): instead of per-instance
+objects, a parsed file chunk is a set of flat numpy arrays — labels, and
+per-slot CSR (concatenated feasigns + row offsets). Every batch/shuffle
+operation is then a vectorized gather, and the native C++ parser
+(``native/parser.cc``) writes this layout directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.data.slots import DataFeedConfig, Instance, SlotBatch
+
+
+@dataclasses.dataclass
+class ColumnarChunk:
+    """A set of parsed records in columnar CSR form."""
+
+    labels: np.ndarray                      # [n, L] float32
+    sparse_ids: Dict[str, np.ndarray]       # slot -> concat uint64
+    sparse_offsets: Dict[str, np.ndarray]   # slot -> [n+1] int64
+    dense: Dict[str, np.ndarray]            # slot -> [n, dim] float32
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.labels.shape[0])
+
+    def all_keys(self) -> np.ndarray:
+        parts = [v for v in self.sparse_ids.values() if v.size]
+        if not parts:
+            return np.empty((0,), np.uint64)
+        return np.concatenate(parts)
+
+    @staticmethod
+    def empty(config: DataFeedConfig) -> "ColumnarChunk":
+        return ColumnarChunk(
+            labels=np.empty((0, config.num_labels), np.float32),
+            sparse_ids={s.name: np.empty((0,), np.uint64)
+                        for s in config.sparse_slots},
+            sparse_offsets={s.name: np.zeros((1,), np.int64)
+                            for s in config.sparse_slots},
+            dense={s.name: np.empty((0, s.dim), np.float32)
+                   for s in config.dense_slots})
+
+    @staticmethod
+    def concat(chunks: Sequence["ColumnarChunk"]) -> "ColumnarChunk":
+        if not chunks:
+            raise ValueError("concat of no chunks")
+        if len(chunks) == 1:
+            return chunks[0]
+        labels = np.concatenate([c.labels for c in chunks])
+        ids: Dict[str, np.ndarray] = {}
+        offs: Dict[str, np.ndarray] = {}
+        for s in chunks[0].sparse_ids:
+            ids[s] = np.concatenate([c.sparse_ids[s] for c in chunks])
+            parts = [chunks[0].sparse_offsets[s]]
+            base = chunks[0].sparse_offsets[s][-1]
+            for c in chunks[1:]:
+                parts.append(c.sparse_offsets[s][1:] + base)
+                base = base + c.sparse_offsets[s][-1]
+            offs[s] = np.concatenate(parts)
+        dense = {s: np.concatenate([c.dense[s] for c in chunks])
+                 for s in chunks[0].dense}
+        return ColumnarChunk(labels, ids, offs, dense)
+
+    def take(self, idx: np.ndarray) -> "ColumnarChunk":
+        """Vectorized row gather (shuffle / partition primitive)."""
+        idx = np.asarray(idx, np.int64)
+        ids: Dict[str, np.ndarray] = {}
+        offs: Dict[str, np.ndarray] = {}
+        for s, o in self.sparse_offsets.items():
+            lens = np.diff(o)
+            new_lens = lens[idx]
+            new_offs = np.zeros(idx.size + 1, np.int64)
+            np.cumsum(new_lens, out=new_offs[1:])
+            # Expand: for row j, gather ids[o[idx[j]] : o[idx[j]]+len].
+            total = int(new_offs[-1])
+            gather = (np.repeat(o[idx], new_lens)
+                      + np.arange(total, dtype=np.int64)
+                      - np.repeat(new_offs[:-1], new_lens))
+            ids[s] = self.sparse_ids[s][gather]
+            offs[s] = new_offs
+        return ColumnarChunk(
+            labels=self.labels[idx], sparse_ids=ids, sparse_offsets=offs,
+            dense={s: v[idx] for s, v in self.dense.items()})
+
+    # -- batch packing (vectorized BuildSlotBatchGPU) ----------------------
+
+    def pack_batch(self, lo: int, hi: int, config: DataFeedConfig,
+                   batch_size: int,
+                   capacities: Optional[Dict[str, int]] = None) -> SlotBatch:
+        """Pack rows [lo, hi) into one static-shape SlotBatch, fully
+        vectorized (no per-instance python loop)."""
+        n = hi - lo
+        bs = batch_size
+        if n > bs:
+            raise ValueError(f"{n} rows > batch_size {bs}")
+        labels = np.zeros((bs, config.num_labels), np.float32)
+        labels[:n] = self.labels[lo:hi]
+        valid = np.zeros((bs,), bool)
+        valid[:n] = True
+
+        ids_out: Dict[str, np.ndarray] = {}
+        segs_out: Dict[str, np.ndarray] = {}
+        lens_out: Dict[str, np.ndarray] = {}
+        for slot in config.sparse_slots:
+            name = slot.name
+            cap = (capacities[name] if capacities is not None
+                   else config.sparse_capacity(slot, bs))
+            o = self.sparse_offsets[name]
+            lens = np.diff(o[lo:hi + 1]).astype(np.int64)
+            if slot.max_len:
+                lens = np.minimum(lens, slot.max_len)
+            new_offs = np.zeros(n + 1, np.int64)
+            np.cumsum(lens, out=new_offs[1:])
+            total = int(new_offs[-1])
+            gather = (np.repeat(o[lo:hi], lens)
+                      + np.arange(total, dtype=np.int64)
+                      - np.repeat(new_offs[:-1], lens))
+            vals = self.sparse_ids[name][gather]
+            segs = np.repeat(np.arange(n, dtype=np.int32), lens)
+            if total > cap:
+                monitor.add(f"slot_overflow/{name}", total - cap)
+                vals, segs = vals[:cap], segs[:cap]
+                total = cap
+            out_v = np.zeros((cap,), np.uint64)
+            out_s = np.full((cap,), bs, np.int32)
+            out_v[:total] = vals
+            out_s[:total] = segs
+            ids_out[name] = out_v
+            segs_out[name] = out_s
+            cnt = np.bincount(segs, minlength=bs).astype(np.int32)
+            lens_out[name] = cnt
+
+        dense_out: Dict[str, np.ndarray] = {}
+        for slot in config.dense_slots:
+            d = np.zeros((bs, slot.dim), np.float32)
+            src = self.dense.get(slot.name)
+            if src is not None and src.size:
+                d[:n, :src.shape[1]] = src[lo:hi, :slot.dim]
+            dense_out[slot.name] = d
+
+        return SlotBatch(labels=labels, valid=valid, ids=ids_out,
+                         segments=segs_out, lengths=lens_out,
+                         dense=dense_out)
+
+    def pack_batch_sharded(self, lo: int, hi: int, config: DataFeedConfig,
+                           num_shards: int, batch_size: int) -> SlotBatch:
+        """Sharded-layout pack (role of SlotBatch.pack_sharded) from
+        columnar rows [lo, hi)."""
+        if batch_size % num_shards:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by {num_shards}")
+        bs_local = batch_size // num_shards
+        caps_local = {
+            slot.name: config.sparse_capacity(slot, batch_size, num_shards)
+            // num_shards
+            for slot in config.sparse_slots}
+        subs = []
+        for s in range(num_shards):
+            a = min(lo + s * bs_local, hi)
+            b = min(a + bs_local, hi)
+            subs.append(self.pack_batch(a, b, config, bs_local, caps_local))
+        return SlotBatch(
+            labels=np.concatenate([b.labels for b in subs]),
+            valid=np.concatenate([b.valid for b in subs]),
+            ids={k: np.concatenate([b.ids[k] for b in subs])
+                 for k in subs[0].ids},
+            segments={k: np.concatenate([b.segments[k] for b in subs])
+                      for k in subs[0].segments},
+            lengths={k: np.concatenate([b.lengths[k] for b in subs])
+                     for k in subs[0].lengths},
+            dense={k: np.concatenate([b.dense[k] for b in subs])
+                   for k in subs[0].dense},
+        )
+
+
+def instances_to_chunk(instances: Sequence[Instance],
+                       config: DataFeedConfig) -> ColumnarChunk:
+    """Bridge from the python parser's Instance objects."""
+    n = len(instances)
+    labels = np.zeros((n, config.num_labels), np.float32)
+    for i, ins in enumerate(instances):
+        labels[i] = ins.labels
+    ids: Dict[str, np.ndarray] = {}
+    offs: Dict[str, np.ndarray] = {}
+    for slot in config.sparse_slots:
+        parts = []
+        lens = np.zeros(n, np.int64)
+        for i, ins in enumerate(instances):
+            v = ins.sparse.get(slot.name)
+            if v is not None and v.size:
+                parts.append(v)
+                lens[i] = v.size
+        o = np.zeros(n + 1, np.int64)
+        np.cumsum(lens, out=o[1:])
+        ids[slot.name] = (np.concatenate(parts) if parts
+                          else np.empty((0,), np.uint64))
+        offs[slot.name] = o
+    dense: Dict[str, np.ndarray] = {}
+    for slot in config.dense_slots:
+        d = np.zeros((n, slot.dim), np.float32)
+        for i, ins in enumerate(instances):
+            v = ins.dense.get(slot.name)
+            if v is not None:
+                d[i, :v.size] = v[:slot.dim]
+        dense[slot.name] = d
+    return ColumnarChunk(labels, ids, offs, dense)

@@ -7,13 +7,13 @@ channel + pass-key merge, ``data_set.cc:2283-2289``), preload/wait
 (``ShuffleData``/``ReceiveSuffleData``, ``data_set.cc:2436,2544``), and the
 python ``BoxPSDataset`` API (``python/paddle/fluid/dataset.py:1225``).
 
-TPU-first shape: batches are packed host-side to STATIC shapes
-(:class:`SlotBatch`) so the jitted train step never recompiles; per-pass
-unique keys are collected during load (role of ``MergeInsKeys`` →
-``PSAgent::AddKey``) and handed to the sparse embedding engine's
-``feed_pass``. Cross-node shuffle exchanges record buckets between hosts
-(pluggable transport; in-process loopback by default — multi-host wiring
-rides jax distributed / gRPC, not MPI).
+TPU-first shape: records live as columnar CSR chunks
+(:class:`ColumnarChunk`) parsed by the native C++ parser when available
+(``native/parser.cc``) — every downstream operation (shuffle, partition,
+batch pack) is a vectorized numpy gather, no per-record python objects.
+Batches are packed host-side to STATIC shapes (:class:`SlotBatch`) so the
+jitted train step never recompiles; per-pass unique keys are collected
+during load (role of ``MergeInsKeys`` → ``PSAgent::AddKey``).
 """
 
 from __future__ import annotations
@@ -28,40 +28,69 @@ import numpy as np
 
 from paddlebox_tpu.core import log, monitor
 from paddlebox_tpu.data.channel import Channel, ClosedChannelError
+from paddlebox_tpu.data.columnar import ColumnarChunk, instances_to_chunk
 from paddlebox_tpu.data.parser import parse_lines
-from paddlebox_tpu.data.slots import DataFeedConfig, Instance, SlotBatch
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
+
+_READ_BLOCK = 4 << 20  # bytes per parse chunk
 
 
-def _read_file_lines(path: str, pipe_command: str) -> Iterator[str]:
-    """Stream lines from a file, optionally through a shell filter.
-
-    Role of ``pipe_command`` in data_feed.proto:47 / shell_popen in
-    ``io/fs.cc:69`` — e.g. ``pipe_command="zcat"`` for gzip shards.
-    """
+def _open_stream(path: str, pipe_command: str):
+    """Open a byte stream, optionally through a shell filter (role of
+    pipe_command in data_feed.proto:47 / shell_popen io/fs.cc:69)."""
     if pipe_command:
-        with open(path, "rb") as f:
-            proc = subprocess.Popen(
-                pipe_command, shell=True, stdin=f,
-                stdout=subprocess.PIPE, bufsize=1 << 20)
-            assert proc.stdout is not None
-            try:
-                for raw in proc.stdout:
-                    yield raw.decode("utf-8", "replace")
-            finally:
-                proc.stdout.close()
-                ret = proc.wait()
+        f = open(path, "rb")
+        proc = subprocess.Popen(pipe_command, shell=True, stdin=f,
+                                stdout=subprocess.PIPE, bufsize=1 << 20)
+        return proc, proc.stdout
+    return None, open(path, "rb")
+
+
+def _read_blocks(path: str, pipe_command: str) -> Iterator[bytes]:
+    """Yield newline-aligned byte blocks of ~_READ_BLOCK size."""
+    proc, stream = _open_stream(path, pipe_command)
+    try:
+        carry = b""
+        while True:
+            block = stream.read(_READ_BLOCK)
+            if not block:
+                break
+            block = carry + block
+            cut = block.rfind(b"\n")
+            if cut < 0:
+                carry = block
+                continue
+            carry = block[cut + 1:]
+            yield block[:cut + 1]
+        if carry:
+            yield carry
+    finally:
+        stream.close()
+        if proc is not None:
+            ret = proc.wait()
             if ret != 0:
                 # A failing filter (typo'd decompressor, truncated file)
                 # must not silently produce an empty pass.
                 raise RuntimeError(
                     f"pipe_command {pipe_command!r} exited {ret} on {path}")
-    else:
-        with open(path, "r", encoding="utf-8", errors="replace") as f:
-            yield from f
+
+
+def _parse_block(block: bytes, config: DataFeedConfig) -> ColumnarChunk:
+    """Native C++ parse when available, python fallback otherwise."""
+    if config.parser == "svm":
+        from paddlebox_tpu.native.parser_py import parse_chunk_native
+        chunk = parse_chunk_native(block, config)
+        if chunk is not None:
+            return chunk
+    # Split on '\n' only — matching the block framing and the native
+    # parser; str.splitlines would also break on NEL/FF/LS etc. and make
+    # the two parser paths disagree on exotic bytes.
+    lines = block.decode("utf-8", "replace").split("\n")
+    return instances_to_chunk(parse_lines(lines, config), config)
 
 
 class Dataset:
-    """In-memory slot dataset with pass lifecycle.
+    """In-memory columnar slot dataset with pass lifecycle.
 
     Typical CTR pass loop (mirrors BoxPSDataset usage, dataset.py:1225):
 
@@ -69,24 +98,24 @@ class Dataset:
         ds.set_filelist(shards)
         ds.load_into_memory()          # or preload_into_memory + wait
         ds.local_shuffle(seed)
-        for batch in ds.batches():     # static-shape SlotBatch stream
+        for batch in ds.batches_sharded(ndev):
             ...
         ds.clear()
     """
 
     def __init__(self, config: DataFeedConfig, *, num_reader_threads: int = 4,
-                 channel_capacity: int = 1 << 14):
+                 channel_capacity: int = 64):
         self.config = config
         self.num_reader_threads = max(1, num_reader_threads)
         self._channel_capacity = channel_capacity
         self._filelist: List[str] = []
-        self._instances: List[Instance] = []
+        self._chunks: List[ColumnarChunk] = []
+        self._merged: Optional[ColumnarChunk] = None
         self._preload_threads: List[threading.Thread] = []
-        self._preload_channel: Optional[Channel] = None
         self._reader_errors: List[BaseException] = []
         self._lock = threading.Lock()
-        # Hook invoked with each loaded instance batch's keys at load time —
-        # wired to the embedding engine's pass-key collector (role of
+        # Hook invoked with each loaded chunk's keys at load time — wired
+        # to the embedding engine's pass-key collector (role of
         # PSAgent::AddKey threading in MergeInsKeys, data_set.cc:2289).
         self.key_sink: Optional[Callable[[np.ndarray], None]] = None
 
@@ -106,33 +135,21 @@ class Dataset:
 
     def _reader_worker(self, file_q: "queue.Queue[str]", out: Channel) -> None:
         try:
-            self._read_files(file_q, out)
+            while True:
+                try:
+                    path = file_q.get_nowait()
+                except queue.Empty:
+                    return
+                n = 0
+                for block in _read_blocks(path, self.config.pipe_command):
+                    chunk = _parse_block(block, self.config)
+                    n += chunk.num_rows
+                    out.put(chunk)
+                monitor.add("dataset/ins_loaded", n)
+                log.vlog(1, "loaded %d instances from %s", n, path)
         except BaseException as e:  # surfaced by load_into_memory/wait
             with self._lock:
                 self._reader_errors.append(e)
-
-    def _read_files(self, file_q: "queue.Queue[str]", out: Channel) -> None:
-        cfg = self.config
-        while True:
-            try:
-                path = file_q.get_nowait()
-            except queue.Empty:
-                return
-            n = 0
-            chunk: List[str] = []
-            for line in _read_file_lines(path, cfg.pipe_command):
-                chunk.append(line)
-                if len(chunk) >= 4096:
-                    ins = parse_lines(chunk, cfg)
-                    n += len(ins)
-                    out.put_many(ins)
-                    chunk.clear()
-            if chunk:
-                ins = parse_lines(chunk, cfg)
-                n += len(ins)
-                out.put_many(ins)
-            monitor.add("dataset/ins_loaded", n)
-            log.vlog(1, "loaded %d instances from %s", n, path)
 
     def _start_load(self) -> Channel:
         file_q: "queue.Queue[str]" = queue.Queue()
@@ -171,7 +188,6 @@ class Dataset:
         """Start background load (role of PreLoadIntoMemory — overlaps the
         previous pass's training with the next pass's read)."""
         ch = self._start_load()
-        self._preload_channel = ch
         t = threading.Thread(target=self._drain, args=(ch,), daemon=True)
         t.start()
         self._preload_threads = [t]
@@ -181,70 +197,88 @@ class Dataset:
         for t in self._preload_threads:
             t.join()
         self._preload_threads = []
-        self._preload_channel = None
         self._raise_reader_errors()
 
     def _drain(self, ch: Channel) -> None:
         sink = self.key_sink
-        local: List[Instance] = []
+        local: List[ColumnarChunk] = []
         try:
             while True:
-                items = ch.get_many(1024)
-                local.extend(items)
+                chunk = ch.get()
+                local.append(chunk)
                 if sink is not None:
-                    keys = [i for ins in items for i in ins.sparse.values()]
-                    if keys:
-                        sink(np.concatenate(keys))
+                    keys = chunk.all_keys()
+                    if keys.size:
+                        sink(keys)
         except ClosedChannelError:
             pass
         with self._lock:
-            self._instances.extend(local)
+            self._chunks.extend(local)
+            self._merged = None
+
+    def _merge(self) -> ColumnarChunk:
+        with self._lock:
+            if self._merged is None:
+                chunks = self._chunks or [ColumnarChunk.empty(self.config)]
+                self._merged = ColumnarChunk.concat(chunks)
+                self._chunks = [self._merged]
+            return self._merged
 
     # -- shuffle -----------------------------------------------------------
 
+    def _check_no_preload(self, op: str) -> None:
+        # Shuffles snapshot-then-replace the chunk list; a concurrent
+        # preload _drain appending chunks would be silently discarded.
+        if any(t.is_alive() for t in self._preload_threads):
+            raise RuntimeError(
+                f"{op} while preload_into_memory is running — call "
+                f"wait_preload_done() first")
+
     def local_shuffle(self, seed: Optional[int] = None) -> None:
+        self._check_no_preload("local_shuffle")
+        merged = self._merge()
         rng = np.random.default_rng(seed)
+        perm = rng.permutation(merged.num_rows)
+        shuffled = merged.take(perm)
         with self._lock:
-            rng.shuffle(self._instances)
+            self._chunks = [shuffled]
+            self._merged = shuffled
 
     def global_shuffle(self, *, num_ranks: int = 1, rank: int = 0,
-                       exchange: Optional[Callable[[List[List[Instance]]],
-                                                   List[Instance]]] = None,
+                       exchange: Optional[Callable[[List[ColumnarChunk]],
+                                                   ColumnarChunk]] = None,
                        seed: Optional[int] = None,
                        allow_partition: bool = False) -> None:
         """Cross-node record shuffle (role of PadBoxSlotDataset::ShuffleData
         → boxps::PaddleShuffler → ReceiveSuffleData, data_set.cc:2436,2544).
 
-        Records are hashed into ``num_ranks`` buckets; ``exchange`` ships
-        bucket lists to their owner ranks and returns what this rank
+        Records are hashed into ``num_ranks`` bucket chunks; ``exchange``
+        ships them to their owner ranks and returns the chunk this rank
         receives. With ``num_ranks > 1`` a transport is REQUIRED unless
         ``allow_partition=True`` explicitly opts into keeping only this
-        rank's bucket (useful to simulate one rank of a cluster — the other
-        buckets are dropped).
+        rank's bucket (simulating one rank — other buckets are dropped).
         """
         if num_ranks > 1 and exchange is None and not allow_partition:
             raise ValueError(
                 "global_shuffle with num_ranks>1 needs an exchange transport "
                 "(or allow_partition=True to keep only this rank's bucket, "
                 "dropping the rest)")
+        self._check_no_preload("global_shuffle")
+        merged = self._merge()
         rng = np.random.default_rng(seed)
+        assign = rng.integers(num_ranks, size=merged.num_rows)
+        buckets = [merged.take(np.flatnonzero(assign == r))
+                   for r in range(num_ranks)]
+        if exchange is None:
+            received = buckets[rank]
+            dropped = merged.num_rows - received.num_rows
+            if dropped:
+                monitor.add("dataset/shuffle_partition_dropped", dropped)
+        else:
+            received = exchange(buckets)
         with self._lock:
-            assign = rng.integers(num_ranks, size=len(self._instances))
-            order = np.argsort(assign, kind="stable")
-            counts = np.bincount(assign, minlength=num_ranks)
-            bounds = np.concatenate([[0], np.cumsum(counts)])
-            buckets: List[List[Instance]] = [
-                [self._instances[j] for j in order[bounds[r]:bounds[r + 1]]]
-                for r in range(num_ranks)]
-            if exchange is None:
-                received = buckets[rank]
-                dropped = sum(len(b) for i, b in enumerate(buckets)
-                              if i != rank)
-                if dropped:
-                    monitor.add("dataset/shuffle_partition_dropped", dropped)
-            else:
-                received = exchange(buckets)
-            self._instances = received
+            self._chunks = [received]
+            self._merged = received
         self.local_shuffle(seed)
 
     # -- access ------------------------------------------------------------
@@ -252,46 +286,44 @@ class Dataset:
     @property
     def num_instances(self) -> int:
         with self._lock:
-            return len(self._instances)
+            return sum(c.num_rows for c in self._chunks)
 
     def batches(self, *, drop_last: bool = False,
                 batch_size: Optional[int] = None) -> Iterator[SlotBatch]:
         """Yield static-shape SlotBatches; the short final batch is padded
         with invalid rows unless drop_last."""
         bs = batch_size or self.config.batch_size
-        with self._lock:
-            snapshot = list(self._instances)
-        for i in range(0, len(snapshot), bs):
-            chunk = snapshot[i:i + bs]
-            if len(chunk) < bs and drop_last:
+        merged = self._merge()
+        n = merged.num_rows
+        for lo in range(0, n, bs):
+            hi = min(lo + bs, n)
+            if hi - lo < bs and drop_last:
                 return
-            yield SlotBatch.pack(chunk, self.config, bs)
+            yield merged.pack_batch(lo, hi, self.config, bs)
 
     def batches_sharded(self, num_shards: int, *,
                         batch_size: Optional[int] = None
                         ) -> Iterator[SlotBatch]:
-        """Yield batches packed as ``num_shards`` self-contained per-device
-        sub-batches (see SlotBatch.pack_sharded) — the layout a dp-sharded
-        train step consumes directly."""
+        """Yield batches in the per-device sharded layout (see
+        SlotBatch.pack_sharded) — what a dp-sharded train step consumes."""
         bs = batch_size or self.config.batch_size
-        with self._lock:
-            snapshot = list(self._instances)
-        for i in range(0, len(snapshot), bs):
-            chunk = snapshot[i:i + bs]
-            yield SlotBatch.pack_sharded(chunk, self.config, num_shards, bs)
-
-    # -- pass keys ---------------------------------------------------------
+        merged = self._merge()
+        n = merged.num_rows
+        for lo in range(0, n, bs):
+            hi = min(lo + bs, n)
+            yield merged.pack_batch_sharded(lo, hi, self.config, num_shards,
+                                            bs)
 
     def pass_keys(self) -> np.ndarray:
         """Unique feasigns currently loaded (role of the per-pass key set
         registered via FeedPass, box_wrapper.h:1239)."""
-        with self._lock:
-            parts = [v for ins in self._instances
-                     for v in ins.sparse.values() if v.size]
-        if not parts:
-            return np.empty((0,), np.uint64)
-        return np.unique(np.concatenate(parts))
+        merged = self._merge()
+        keys = merged.all_keys()
+        if keys.size == 0:
+            return keys
+        return np.unique(keys)
 
     def clear(self) -> None:
         with self._lock:
-            self._instances.clear()
+            self._chunks.clear()
+            self._merged = None

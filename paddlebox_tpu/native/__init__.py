@@ -1,0 +1,12 @@
+"""Native (C++) host-runtime components.
+
+Role of the reference's C++ data-pipeline hot paths (SURVEY.md §2.4) — the
+parts where Python-level loops cannot reach disk/parse throughput. Built
+on demand with g++ into a cached shared library; every native component
+has a pure-python fallback so the framework degrades gracefully when no
+toolchain is present.
+"""
+
+from paddlebox_tpu.native.build import load_library, native_available
+
+__all__ = ["load_library", "native_available"]

@@ -1,0 +1,219 @@
+// Native svm-format parser: text chunk -> columnar CSR arrays.
+//
+// Role of the reference's C++ reader parse loops
+// (SlotRecordInMemoryDataFeed::ParseOneInstance / LoadIntoMemoryByLine,
+// paddle/fluid/framework/data_feed.cc:2142-2395): the data-pipeline hot
+// path is tokenizing gigabytes of text into slot records. Python-level
+// parsing is ~50x slower; this library parses into the exact columnar
+// layout paddlebox_tpu/data/columnar.py consumes.
+//
+// Format per line (see data/parser.py):
+//   <label...> <slot>:<feasign> ... <slot>:v1,v2,... ...
+//
+// C ABI (ctypes): two-phase — parse into C++ vectors, query sizes,
+// caller allocates numpy arrays, fill, free.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct SlotDef {
+  int index;        // dense or sparse ordinal
+  bool is_dense;
+  int dim;          // dense only
+};
+
+struct ParseResult {
+  int num_labels = 0;
+  int n_sparse = 0;
+  int n_dense = 0;
+  int64_t n_rows = 0;
+  int64_t malformed = 0;
+  int64_t dropped_signs = 0;  // null/out-of-range feasigns
+  std::vector<float> labels;                       // n_rows * num_labels
+  std::vector<std::vector<uint64_t>> sparse_ids;   // per sparse slot
+  std::vector<std::vector<int64_t>> sparse_offsets;  // per slot, n_rows+1
+  std::vector<std::vector<float>> dense_vals;      // per dense slot, n*dim
+};
+
+inline bool parse_double(const char* b, const char* e, double* out) {
+  if (b == e) return false;
+  char* endp = nullptr;
+  std::string tmp(b, e - b);  // strtod needs NUL; tokens are short
+  *out = std::strtod(tmp.c_str(), &endp);
+  return endp == tmp.c_str() + tmp.size();
+}
+
+// Feasign parse outcomes mirror the python parser's contract:
+// a syntactically-valid integer that is negative/zero/overflowing is a
+// DROPPED token (line kept); non-integer garbage rejects the LINE.
+enum FeasignStatus { FS_OK, FS_NOT_INT, FS_DROP };
+
+inline FeasignStatus parse_feasign(const char* b, const char* e,
+                                   uint64_t* out) {
+  if (b == e) return FS_NOT_INT;
+  bool neg = false;
+  if (*b == '-') { neg = true; ++b; if (b == e) return FS_NOT_INT; }
+  uint64_t v = 0;
+  for (const char* p = b; p != e; ++p) {
+    if (*p < '0' || *p > '9') return FS_NOT_INT;
+    uint64_t digit = static_cast<uint64_t>(*p - '0');
+    if (v > (UINT64_MAX - digit) / 10u) return FS_DROP;  // overflow
+    v = v * 10u + digit;
+  }
+  if (neg || v == 0) return FS_DROP;  // negative / null sentinel
+  *out = v;
+  return FS_OK;
+}
+
+}  // namespace
+
+extern "C" {
+
+// slot_names: n_slots NUL-terminated names; is_dense/dims parallel arrays.
+ParseResult* pbx_parse_svm(const char* buf, int64_t len,
+                           const char** slot_names, const uint8_t* is_dense,
+                           const int32_t* dims, int32_t n_slots,
+                           int32_t num_labels) {
+  auto* res = new ParseResult();
+  res->num_labels = num_labels;
+  std::unordered_map<std::string, SlotDef> slots;
+  for (int i = 0; i < n_slots; ++i) {
+    SlotDef d;
+    d.is_dense = is_dense[i] != 0;
+    d.dim = dims[i];
+    d.index = d.is_dense ? res->n_dense++ : res->n_sparse++;
+    slots.emplace(slot_names[i], d);
+  }
+  res->sparse_ids.resize(res->n_sparse);
+  res->sparse_offsets.assign(res->n_sparse, std::vector<int64_t>{0});
+  res->dense_vals.resize(res->n_dense);
+
+  std::vector<float> row_labels(num_labels);
+  std::vector<float> row_dense;  // scratch per dense slot
+  const char* p = buf;
+  const char* end = buf + len;
+  std::string key;  // reused
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!line_end) line_end = end;
+    const char* q = p;
+    const char* line_start = p;
+    p = line_end + 1;
+    bool blank = true;
+    for (const char* c = line_start; c < line_end; ++c)
+      if (*c != ' ' && *c != '\r' && *c != '\t') { blank = false; break; }
+    if (blank) continue;
+
+    // --- labels ---
+    bool ok = true;
+    for (int li = 0; li < num_labels; ++li) {
+      while (q < line_end && *q == ' ') ++q;
+      const char* tb = q;
+      while (q < line_end && *q != ' ') ++q;
+      double d;
+      if (!parse_double(tb, q, &d)) { ok = false; break; }
+      row_labels[li] = static_cast<float>(d);
+    }
+    if (!ok) { res->malformed++; continue; }
+
+    // --- tokens: stage into per-row buffers so a malformed token can
+    // reject the whole line (parity with the python parser) ---
+    std::vector<std::pair<int, uint64_t>> row_sparse;
+    std::vector<std::pair<int, std::vector<float>>> row_dense_vals;
+    int64_t row_dropped = 0;
+    while (ok && q < line_end) {
+      while (q < line_end && *q == ' ') ++q;
+      if (q >= line_end) break;
+      const char* tb = q;
+      while (q < line_end && *q != ' ') ++q;
+      const char* colon = static_cast<const char*>(
+          memchr(tb, ':', static_cast<size_t>(q - tb)));
+      if (!colon) { ok = false; break; }
+      key.assign(tb, static_cast<size_t>(colon - tb));
+      auto it = slots.find(key);
+      if (it == slots.end()) continue;  // unused slot
+      if (it->second.is_dense) {
+        std::vector<float> vals;
+        const char* vb = colon + 1;
+        while (vb <= q) {
+          const char* ve = static_cast<const char*>(
+              memchr(vb, ',', static_cast<size_t>(q - vb)));
+          if (!ve) ve = q;
+          double d;
+          if (!parse_double(vb, ve, &d)) { ok = false; break; }
+          vals.push_back(static_cast<float>(d));
+          vb = ve + 1;
+          if (ve == q) break;
+        }
+        if (!ok) break;
+        row_dense_vals.emplace_back(it->second.index, std::move(vals));
+      } else {
+        uint64_t sign;
+        FeasignStatus st = parse_feasign(colon + 1, q, &sign);
+        if (st == FS_NOT_INT) { ok = false; break; }
+        if (st == FS_DROP) { row_dropped++; continue; }
+        row_sparse.emplace_back(it->second.index, sign);
+      }
+    }
+    if (!ok) { res->malformed++; continue; }
+
+    // --- commit row ---
+    res->dropped_signs += row_dropped;
+    res->labels.insert(res->labels.end(), row_labels.begin(),
+                       row_labels.end());
+    for (auto& pr : row_sparse) res->sparse_ids[pr.first].push_back(pr.second);
+    for (int s = 0; s < res->n_sparse; ++s)
+      res->sparse_offsets[s].push_back(
+          static_cast<int64_t>(res->sparse_ids[s].size()));
+    // dense: fixed dim per slot, zero-fill
+    for (int dslot = 0; dslot < res->n_dense; ++dslot) {
+      int dim = 0;
+      for (int i = 0; i < n_slots; ++i)
+        if (is_dense[i]) { if (slots[slot_names[i]].index == dslot) dim = dims[i]; }
+      size_t base = res->dense_vals[dslot].size();
+      res->dense_vals[dslot].resize(base + static_cast<size_t>(dim), 0.f);
+      for (auto& pr : row_dense_vals) {
+        if (pr.first == dslot) {
+          for (size_t k = 0; k < pr.second.size() &&
+               k < static_cast<size_t>(dim); ++k)
+            res->dense_vals[dslot][base + k] = pr.second[k];
+        }
+      }
+    }
+    res->n_rows++;
+  }
+  return res;
+}
+
+int64_t pbx_result_rows(ParseResult* r) { return r->n_rows; }
+int64_t pbx_result_malformed(ParseResult* r) { return r->malformed; }
+int64_t pbx_result_dropped(ParseResult* r) { return r->dropped_signs; }
+int64_t pbx_result_sparse_size(ParseResult* r, int32_t slot) {
+  return static_cast<int64_t>(r->sparse_ids[slot].size());
+}
+
+void pbx_result_fill(ParseResult* r, float* labels, uint64_t** sparse_ids,
+                     int64_t** sparse_offsets, float** dense_vals) {
+  memcpy(labels, r->labels.data(), r->labels.size() * sizeof(float));
+  for (int s = 0; s < r->n_sparse; ++s) {
+    memcpy(sparse_ids[s], r->sparse_ids[s].data(),
+           r->sparse_ids[s].size() * sizeof(uint64_t));
+    memcpy(sparse_offsets[s], r->sparse_offsets[s].data(),
+           r->sparse_offsets[s].size() * sizeof(int64_t));
+  }
+  for (int d = 0; d < r->n_dense; ++d) {
+    memcpy(dense_vals[d], r->dense_vals[d].data(),
+           r->dense_vals[d].size() * sizeof(float));
+  }
+}
+
+void pbx_result_free(ParseResult* r) { delete r; }
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+"""ctypes wrapper: native svm parse → ColumnarChunk."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.data.columnar import ColumnarChunk
+from paddlebox_tpu.data.slots import DataFeedConfig
+from paddlebox_tpu.native.build import load_library
+
+
+def parse_chunk_native(text: bytes, config: DataFeedConfig
+                       ) -> Optional[ColumnarChunk]:
+    """Parse a text buffer with the C++ parser; None if lib unavailable."""
+    lib = load_library()
+    if lib is None:
+        return None
+    slots = list(config.slots)
+    used = [s for s in slots if s.is_used]
+    names = (ctypes.c_char_p * len(used))(
+        *[s.name.encode() for s in used])
+    is_dense = (ctypes.c_uint8 * len(used))(
+        *[1 if s.is_dense else 0 for s in used])
+    dims = (ctypes.c_int32 * len(used))(
+        *[s.dim if s.is_dense else 0 for s in used])
+
+    handle = lib.pbx_parse_svm(text, len(text), names, is_dense, dims,
+                               len(used), config.num_labels)
+    try:
+        n = lib.pbx_result_rows(handle)
+        malformed = lib.pbx_result_malformed(handle)
+        dropped = lib.pbx_result_dropped(handle)
+        if malformed:
+            monitor.add("parser/malformed_lines", int(malformed))
+        if dropped:
+            monitor.add("parser/null_or_oob_feasign", int(dropped))
+
+        sparse_slots = [s for s in used if not s.is_dense]
+        dense_slots = [s for s in used if s.is_dense]
+        labels = np.empty((n, config.num_labels), np.float32)
+        ids = {}
+        offs = {}
+        id_ptrs = (ctypes.POINTER(ctypes.c_uint64) * max(len(sparse_slots), 1))()
+        off_ptrs = (ctypes.POINTER(ctypes.c_int64) * max(len(sparse_slots), 1))()
+        for i, s in enumerate(sparse_slots):
+            sz = lib.pbx_result_sparse_size(handle, i)
+            ids[s.name] = np.empty((sz,), np.uint64)
+            offs[s.name] = np.empty((n + 1,), np.int64)
+            id_ptrs[i] = ids[s.name].ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint64))
+            off_ptrs[i] = offs[s.name].ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64))
+        dense = {}
+        dense_ptrs = (ctypes.POINTER(ctypes.c_float) * max(len(dense_slots), 1))()
+        for i, s in enumerate(dense_slots):
+            dense[s.name] = np.zeros((n, s.dim), np.float32)
+            dense_ptrs[i] = dense[s.name].ctypes.data_as(
+                ctypes.POINTER(ctypes.c_float))
+
+        lib.pbx_result_fill(
+            handle, labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            id_ptrs, off_ptrs, dense_ptrs)
+        return ColumnarChunk(labels=labels, sparse_ids=ids,
+                             sparse_offsets=offs, dense=dense)
+    finally:
+        lib.pbx_result_free(handle)

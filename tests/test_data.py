@@ -147,8 +147,9 @@ def test_global_shuffle_loopback(tmp_path):
     ds2 = Dataset(CFG)
     ds2.set_filelist([shard])
     ds2.load_into_memory()
+    from paddlebox_tpu.data.columnar import ColumnarChunk
     ds2.global_shuffle(num_ranks=2, rank=0, seed=42,
-                       exchange=lambda buckets: [i for b in buckets for i in b])
+                       exchange=ColumnarChunk.concat)
     assert ds2.num_instances == 20
 
 
@@ -194,3 +195,29 @@ def test_global_shuffle_requires_transport(tmp_path):
     ds.load_into_memory()
     with pytest.raises(ValueError, match="transport"):
         ds.global_shuffle(num_ranks=2, rank=0)
+
+
+def test_batches_sharded_divisibility_guard(tmp_path):
+    p = _write_shard(tmp_path, "p0", [f"1 user:{i}" for i in range(1, 11)])
+    cfg = DataFeedConfig(slots=(SlotConf("user"),), batch_size=10)
+    ds = Dataset(cfg)
+    ds.set_filelist([p])
+    ds.load_into_memory()
+    with pytest.raises(ValueError, match="not divisible"):
+        next(ds.batches_sharded(4))
+
+
+def test_shuffle_during_preload_raises(tmp_path):
+    import time
+    # a slow pipe keeps the preload alive while we try to shuffle
+    p = _write_shard(tmp_path, "p0", ["1 user:1 item:2"] * 100)
+    cfg = DataFeedConfig(slots=CFG.slots, batch_size=4,
+                         pipe_command="sleep 0.5; cat")
+    ds = Dataset(cfg)
+    ds.set_filelist([p])
+    ds.preload_into_memory()
+    with pytest.raises(RuntimeError, match="preload"):
+        ds.local_shuffle(0)
+    ds.wait_preload_done()
+    ds.local_shuffle(0)  # fine after wait
+    assert ds.num_instances == 100

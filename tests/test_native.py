@@ -1,0 +1,96 @@
+"""Native C++ parser: build, python-parity, and throughput tests
+(role of the reference's C++ data_feed readers, SURVEY.md §2.4)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import DataFeedConfig, SlotConf, parse_lines
+from paddlebox_tpu.data.columnar import ColumnarChunk, instances_to_chunk
+from paddlebox_tpu.native import native_available
+from paddlebox_tpu.native.parser_py import parse_chunk_native
+
+CFG = DataFeedConfig(
+    slots=(SlotConf("user", avg_len=2.0), SlotConf("item"),
+           SlotConf("dense0", is_dense=True, dim=3)),
+    batch_size=8)
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="no native toolchain")
+
+
+def _chunks_equal(a: ColumnarChunk, b: ColumnarChunk):
+    np.testing.assert_allclose(a.labels, b.labels)
+    for s in a.sparse_ids:
+        np.testing.assert_array_equal(a.sparse_ids[s], b.sparse_ids[s])
+        np.testing.assert_array_equal(a.sparse_offsets[s],
+                                      b.sparse_offsets[s])
+    for s in a.dense:
+        np.testing.assert_allclose(a.dense[s], b.dense[s], rtol=1e-6)
+
+
+def test_native_matches_python_parser():
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(500):
+        toks = [f"user:{rng.integers(1, 1000)}"
+                for _ in range(rng.integers(0, 4))]
+        toks += [f"item:{rng.integers(1, 1000)}"]
+        if i % 3 == 0:
+            toks.append(f"dense0:{rng.random():.4f},{rng.random():.4f}")
+        if i % 7 == 0:
+            toks.append("unknown_slot:123")   # ignored
+        lines.append(f"{i % 2} {' '.join(toks)}")
+    # malformed + null-feasign lines
+    lines.insert(5, "not-a-label user:3")
+    lines.insert(9, "1 user:0 item:4")        # user:0 dropped, line kept
+    lines.insert(12, "")
+    lines.insert(20, "0 user:-7 item:2")      # negative -> line malformed?
+    text = ("\n".join(lines) + "\n").encode()
+
+    native = parse_chunk_native(text, CFG)
+    ref = instances_to_chunk(parse_lines(
+        text.decode().splitlines(), CFG), CFG)
+    assert native.num_rows == ref.num_rows
+    _chunks_equal(native, ref)
+
+
+def test_native_parser_throughput():
+    rng = np.random.default_rng(1)
+    lines = [f"1 user:{rng.integers(1, 1<<40)} user:{rng.integers(1, 1<<40)} "
+             f"item:{rng.integers(1, 1<<40)}" for _ in range(20000)]
+    text = ("\n".join(lines) + "\n").encode()
+
+    t0 = time.perf_counter()
+    native = parse_chunk_native(text, CFG)
+    t_native = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ref = instances_to_chunk(parse_lines(text.decode().splitlines(), CFG),
+                             CFG)
+    t_py = time.perf_counter() - t0
+
+    assert native.num_rows == ref.num_rows == 20000
+    speedup = t_py / t_native
+    print(f"\nnative parse: {len(text)/t_native/1e6:.0f} MB/s, "
+          f"python: {len(text)/t_py/1e6:.1f} MB/s, speedup {speedup:.1f}x")
+    assert speedup > 3, f"native only {speedup:.1f}x faster"
+
+
+def test_dataset_uses_native_path(tmp_path):
+    """End-to-end: Dataset load goes through the native parser and
+    produces identical batches to the python path."""
+    from paddlebox_tpu.data import Dataset
+    rng = np.random.default_rng(2)
+    lines = [f"{i%2} user:{rng.integers(1, 100)} item:{i+1}"
+             for i in range(40)]
+    p = tmp_path / "part0"
+    p.write_text("\n".join(lines) + "\n")
+
+    ds = Dataset(CFG)
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    assert ds.num_instances == 40
+    b = next(ds.batches())
+    assert b.num_valid == 8
