@@ -20,6 +20,19 @@ from paddlebox_tpu.core import monitor
 from paddlebox_tpu.data.slots import DataFeedConfig, Instance, SlotBatch
 
 
+def csr_gather(values: np.ndarray, offsets: np.ndarray, starts: np.ndarray,
+               lens: np.ndarray):
+    """Gather ragged rows: for each j, take values[starts[j] : starts[j] +
+    lens[j]]. Returns (gathered values, new offsets [len(starts)+1])."""
+    new_offs = np.zeros(starts.size + 1, np.int64)
+    np.cumsum(lens, out=new_offs[1:])
+    total = int(new_offs[-1])
+    gather = (np.repeat(starts, lens)
+              + np.arange(total, dtype=np.int64)
+              - np.repeat(new_offs[:-1], lens))
+    return values[gather], new_offs
+
+
 @dataclasses.dataclass
 class ColumnarChunk:
     """A set of parsed records in columnar CSR form."""
@@ -78,19 +91,31 @@ class ColumnarChunk:
         offs: Dict[str, np.ndarray] = {}
         for s, o in self.sparse_offsets.items():
             lens = np.diff(o)
-            new_lens = lens[idx]
-            new_offs = np.zeros(idx.size + 1, np.int64)
-            np.cumsum(new_lens, out=new_offs[1:])
-            # Expand: for row j, gather ids[o[idx[j]] : o[idx[j]]+len].
-            total = int(new_offs[-1])
-            gather = (np.repeat(o[idx], new_lens)
-                      + np.arange(total, dtype=np.int64)
-                      - np.repeat(new_offs[:-1], new_lens))
-            ids[s] = self.sparse_ids[s][gather]
-            offs[s] = new_offs
+            ids[s], offs[s] = csr_gather(self.sparse_ids[s], o, o[idx],
+                                         lens[idx])
         return ColumnarChunk(
             labels=self.labels[idx], sparse_ids=ids, sparse_offsets=offs,
             dense={s: v[idx] for s, v in self.dense.items()})
+
+    def shuffle_slot(self, slot: str, rng: np.random.Generator
+                     ) -> "ColumnarChunk":
+        """Shuffle ONE slot's per-row value lists across rows, leaving all
+        other slots/labels fixed — the AUC-runner feature-importance mode
+        (role of SlotsShuffle, box_wrapper.h:1190 / data_set.h slots_shuffle):
+        the AUC drop when a slot's values are decorrelated from the label
+        measures that slot's contribution."""
+        if slot not in self.sparse_ids:
+            raise KeyError(f"unknown sparse slot {slot!r}")
+        n = self.num_rows
+        perm = rng.permutation(n)
+        o = self.sparse_offsets[slot]
+        lens = np.diff(o)
+        ids = dict(self.sparse_ids)
+        offs = dict(self.sparse_offsets)
+        ids[slot], offs[slot] = csr_gather(self.sparse_ids[slot], o,
+                                           o[perm], lens[perm])
+        return ColumnarChunk(labels=self.labels, sparse_ids=ids,
+                             sparse_offsets=offs, dense=self.dense)
 
     # -- batch packing (vectorized BuildSlotBatchGPU) ----------------------
 
@@ -119,13 +144,8 @@ class ColumnarChunk:
             lens = np.diff(o[lo:hi + 1]).astype(np.int64)
             if slot.max_len:
                 lens = np.minimum(lens, slot.max_len)
-            new_offs = np.zeros(n + 1, np.int64)
-            np.cumsum(lens, out=new_offs[1:])
-            total = int(new_offs[-1])
-            gather = (np.repeat(o[lo:hi], lens)
-                      + np.arange(total, dtype=np.int64)
-                      - np.repeat(new_offs[:-1], lens))
-            vals = self.sparse_ids[name][gather]
+            vals, _ = csr_gather(self.sparse_ids[name], o, o[lo:hi], lens)
+            total = int(lens.sum())
             segs = np.repeat(np.arange(n, dtype=np.int32), lens)
             if total > cap:
                 monitor.add(f"slot_overflow/{name}", total - cap)

@@ -314,6 +314,20 @@ class Dataset:
             yield merged.pack_batch_sharded(lo, hi, self.config, num_shards,
                                             bs)
 
+    def slots_shuffle(self, slots: Sequence[str],
+                      seed: Optional[int] = None) -> None:
+        """AUC-runner eval mode: decorrelate the given slots from labels by
+        shuffling their values across records (role of
+        BoxPSDataset.slots_shuffle, dataset.py:1288)."""
+        self._check_no_preload("slots_shuffle")
+        merged = self._merge()
+        rng = np.random.default_rng(seed)
+        for s in slots:
+            merged = merged.shuffle_slot(s, rng)
+        with self._lock:
+            self._chunks = [merged]
+            self._merged = merged
+
     def pass_keys(self) -> np.ndarray:
         """Unique feasigns currently loaded (role of the per-pass key set
         registered via FeedPass, box_wrapper.h:1239)."""

@@ -1,0 +1,28 @@
+"""Distributed runtime: multi-host bootstrap, transport, launch, elastic.
+
+Roles (SURVEY.md §2.6/§5):
+- ``launch``: per-host process spawner + env wiring — role of
+  ``python -m paddle.distributed.launch`` (``launch/main.py:18``,
+  ``controllers/collective.py``)
+- ``bootstrap``: cluster init — role of NCCL id exchange /
+  ``c_gen_nccl_id`` + Gloo HdfsStore rendezvous; on TPU this is
+  ``jax.distributed.initialize`` (coordinator + ICI/DCN discovery)
+- ``transport``: host-side control-plane RPC — role of brpc/MPI for
+  dataset shuffle and PS build traffic (the device data plane is XLA
+  collectives and never touches this)
+- ``elastic``: failure watch + restart — role of ElasticManager
+  (``fleet/elastic/manager.py:131``)
+"""
+
+from paddlebox_tpu.distributed.bootstrap import (initialize, is_initialized,
+                                                 process_count, process_index)
+from paddlebox_tpu.distributed.transport import TcpTransport, FileStore
+
+__all__ = [
+    "FileStore",
+    "TcpTransport",
+    "initialize",
+    "is_initialized",
+    "process_count",
+    "process_index",
+]

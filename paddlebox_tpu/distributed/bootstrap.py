@@ -1,0 +1,60 @@
+"""Multi-host cluster bootstrap over jax.distributed.
+
+Role of the reference's cluster-init machinery: ``c_gen_nccl_id`` /
+``c_comm_init_all`` ops, Gloo ``HdfsStore`` rendezvous
+(``gloo_wrapper.h:53``), and the env contract
+(``PADDLE_TRAINER_ENDPOINTS``/``PADDLE_TRAINER_ID``) set up by launch.
+
+TPU-first: ``jax.distributed.initialize`` is the whole control plane —
+after it, ``jax.devices()`` spans the pod slice and XLA collectives ride
+ICI/DCN; no communicator objects exist to manage. The env contract is
+``PBX_COORDINATOR`` / ``PBX_NUM_PROCESSES`` / ``PBX_PROCESS_ID`` (set by
+``paddlebox_tpu.launch``), falling back to single-process.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from paddlebox_tpu.core import log
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the cluster (idempotent). Reads PBX_* env when args omitted."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("PBX_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("PBX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("PBX_PROCESS_ID", "0"))
+    if num_processes > 1:
+        if not coordinator:
+            raise ValueError("multi-process init needs a coordinator "
+                             "address (PBX_COORDINATOR)")
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        log.vlog(0, "joined cluster: rank %d/%d via %s", process_id,
+                 num_processes, coordinator)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()

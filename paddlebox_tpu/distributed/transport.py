@@ -1,0 +1,196 @@
+"""Host-side control-plane transports.
+
+Role of the reference's host RPC substrates where the payload is bulk
+host data, not device tensors: brpc PS traffic (``brpc_ps_client.h``),
+boxps MPI dataset shuffle (``data_set.cc:2436``), and the Gloo
+``HdfsStore`` file rendezvous (``gloo_wrapper.h:53``).
+
+Two implementations:
+- :class:`FileStore` — shared-filesystem KV store with barrier, the
+  HdfsStore equivalent (works on any NFS/GCS-fuse mount; used for
+  bootstrap-less rank sync in tests and single-host multiprocess).
+- :class:`TcpTransport` — length-prefixed TCP mesh for exchange()
+  (all-to-all of host byte buffers, the dataset global_shuffle transport)
+  built only on the standard library.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from paddlebox_tpu.core import log
+
+
+class FileStore:
+    """Shared-directory KV + barrier (role of gloo HdfsStore)."""
+
+    def __init__(self, root: str, rank: int, world: int):
+        self.root = root
+        self.rank = rank
+        self.world = world
+        os.makedirs(root, exist_ok=True)
+
+    def set(self, key: str, value: bytes) -> None:
+        tmp = os.path.join(self.root, f".{key}.{self.rank}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, os.path.join(self.root, key))
+
+    def get(self, key: str, timeout: float = 60.0) -> bytes:
+        path = os.path.join(self.root, key)
+        deadline = time.time() + timeout
+        while not os.path.exists(path):
+            if time.time() > deadline:
+                raise TimeoutError(f"FileStore.get({key!r}) timed out")
+            time.sleep(0.01)
+        with open(path, "rb") as f:
+            return f.read()
+
+    def barrier(self, name: str, timeout: float = 60.0) -> None:
+        """All ranks arrive (role of _barrier_worker)."""
+        self.set(f"barrier.{name}.{self.rank}", b"1")
+        for r in range(self.world):
+            self.get(f"barrier.{name}.{r}", timeout)
+
+    def all_gather(self, name: str, value: bytes,
+                   timeout: float = 60.0) -> List[bytes]:
+        self.set(f"ag.{name}.{self.rank}", value)
+        return [self.get(f"ag.{name}.{r}", timeout)
+                for r in range(self.world)]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("peer closed")
+        buf.extend(part)
+    return bytes(buf)
+
+
+class TcpTransport:
+    """Length-prefixed TCP mesh for host-buffer exchange.
+
+    Each rank listens on ``ports[rank]``; ``exchange(buffers)`` sends
+    buffers[r] to rank r and returns what every rank sent to us —
+    exactly the contract of the boxps PaddleShuffler used by
+    ``PadBoxSlotDataset::ShuffleData``/``ReceiveSuffleData``.
+    """
+
+    HDR = struct.Struct("<iq")  # (src_rank, payload_len)
+
+    def __init__(self, rank: int, endpoints: Sequence[str]):
+        self.rank = rank
+        self.endpoints = list(endpoints)
+        self.world = len(endpoints)
+        host, port = self.endpoints[rank].rsplit(":", 1)
+        self._server = socket.create_server((host, int(port)), backlog=16,
+                                            reuse_port=False)
+        self._recv_lock = threading.Lock()
+        self._inbox: Dict[int, List[bytes]] = {}
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._running = True
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while True:
+                    hdr = _recv_exact(conn, self.HDR.size)
+                    src, ln = self.HDR.unpack(hdr)
+                    payload = _recv_exact(conn, ln) if ln else b""
+                    with self._recv_lock:
+                        self._inbox.setdefault(src, []).append(payload)
+        except (ConnectionError, OSError):
+            return
+
+    def _send(self, dst: int, payload: bytes) -> None:
+        host, port = self.endpoints[dst].rsplit(":", 1)
+        deadline = time.time() + 30
+        while True:
+            try:
+                with socket.create_connection((host, int(port)),
+                                              timeout=10) as s:
+                    s.sendall(self.HDR.pack(self.rank, len(payload)))
+                    s.sendall(payload)
+                return
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    def exchange(self, buffers: Sequence[bytes],
+                 timeout: float = 120.0) -> List[bytes]:
+        """All-to-all: send buffers[r] to rank r; return one buffer per
+        peer (self's slot short-circuits locally)."""
+        if len(buffers) != self.world:
+            raise ValueError(f"{len(buffers)} buffers != world {self.world}")
+        out: List[Optional[bytes]] = [None] * self.world
+        out[self.rank] = buffers[self.rank]
+        senders = []
+        for dst in range(self.world):
+            if dst == self.rank:
+                continue
+            t = threading.Thread(target=self._send,
+                                 args=(dst, buffers[dst]), daemon=True)
+            t.start()
+            senders.append(t)
+        deadline = time.time() + timeout
+        while True:
+            with self._recv_lock:
+                ready = all(self._inbox.get(src) for src in range(self.world)
+                            if src != self.rank)
+                if ready:
+                    for src in range(self.world):
+                        if src != self.rank:
+                            out[src] = self._inbox[src].pop(0)
+                    break
+            if time.time() > deadline:
+                raise TimeoutError("exchange timed out")
+            time.sleep(0.002)
+        for t in senders:
+            t.join()
+        return out  # type: ignore[return-value]
+
+    def exchange_objects(self, objs: Sequence[Any]) -> List[Any]:
+        bufs = [pickle.dumps(o, protocol=pickle.HIGHEST_PROTOCOL)
+                for o in objs]
+        return [pickle.loads(b) for b in self.exchange(bufs)]
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+def make_chunk_exchanger(transport: TcpTransport
+                         ) -> Callable[[List[Any]], Any]:
+    """Adapter: Dataset.global_shuffle(exchange=...) over a TcpTransport —
+    ships ColumnarChunk buckets to their owner ranks and concatenates what
+    this rank receives (role of ShuffleData → ReceiveSuffleData)."""
+    from paddlebox_tpu.data.columnar import ColumnarChunk
+
+    def exchange(buckets: List[ColumnarChunk]) -> ColumnarChunk:
+        received = transport.exchange_objects(buckets)
+        return ColumnarChunk.concat(received)
+
+    return exchange

@@ -1,0 +1,80 @@
+"""Replicated small-table caches + string-keyed input table.
+
+Roles (SURVEY.md §2.2 "GpuReplicaCache / InputTable",
+``fleet/box_wrapper.h:63-197``):
+- ``ReplicaCache``: a small embedding table replicated in every device's
+  HBM (reference: per-GPU copy filled by ``PullCacheValue``; consumed by
+  the ``pull_cache_value`` op). TPU: one jnp array with replicated
+  sharding — lookups are local gathers, no collective.
+- ``InputTable``: CPU-side string→index dictionary whose indices flow
+  through the graph into a device aux table (reference ``lookup_input``
+  op + ``InputTableDataset``): map raw string features (e.g. URLs) to
+  dense rows at data-load time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ReplicaCache:
+    """Small dense table replicated across devices."""
+
+    def __init__(self, values: np.ndarray, *, mesh: Optional[Mesh] = None):
+        arr = jnp.asarray(values, jnp.float32)
+        if mesh is not None:
+            arr = jax.device_put(arr, NamedSharding(mesh, P()))
+        self.values = arr
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.values.shape[0])
+
+    def pull(self, ids: jax.Array) -> jax.Array:
+        """ids [...] int32 → [..., dim]; out-of-range ids give row 0
+        (jnp clip semantics made explicit)."""
+        safe = jnp.clip(ids, 0, self.num_rows - 1)
+        out = self.values[safe]
+        in_range = (ids >= 0) & (ids < self.num_rows)
+        return jnp.where(in_range[..., None], out, 0.0)
+
+
+class InputTable:
+    """Append-only string→index table (role of BoxWrapper InputTable:
+    lock-sharded insert at load time, frozen lookup at train time)."""
+
+    def __init__(self):
+        self._map: Dict[str, int] = {}
+        self._keys: List[str] = []
+        self._lock = threading.Lock()
+
+    def add(self, key: str) -> int:
+        with self._lock:
+            idx = self._map.get(key)
+            if idx is None:
+                idx = len(self._keys)
+                self._map[key] = idx
+                self._keys.append(key)
+            return idx
+
+    def add_many(self, keys: Sequence[str]) -> np.ndarray:
+        return np.fromiter((self.add(k) for k in keys), np.int32,
+                           count=len(keys))
+
+    def lookup(self, key: str) -> int:
+        """-1 when absent (reference miss semantics)."""
+        return self._map.get(key, -1)
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+    def key_at(self, idx: int) -> str:
+        return self._keys[idx]

@@ -1,0 +1,1 @@
+"""Launch CLI package (role of python -m paddle.distributed.launch)."""

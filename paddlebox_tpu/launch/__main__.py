@@ -1,0 +1,4 @@
+from paddlebox_tpu.launch.main import main
+import sys
+
+sys.exit(main())

@@ -1,0 +1,130 @@
+"""Process launcher: ``python -m paddlebox_tpu.launch <opts> script.py``.
+
+Role of the reference launch stack (``python/paddle/distributed/launch/
+main.py:18`` + ``controllers/collective.py``): spawn one training process
+per host/worker with the cluster env injected, watch them, and restart on
+failure (role of ``controllers/watcher.py`` + the elastic manager's
+fault-tolerant restart, ``fleet/elastic/manager.py``).
+
+TPU-first: one process per HOST (jax owns all local chips), env contract
+``PBX_COORDINATOR/PBX_NUM_PROCESSES/PBX_PROCESS_ID`` consumed by
+``paddlebox_tpu.distributed.initialize``. ``--nproc`` spawns N local
+processes (useful with forced host-platform device counts for tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from paddlebox_tpu.core import log
+
+
+def build_env(rank: int, world: int, coordinator: str,
+              base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    env = dict(base if base is not None else os.environ)
+    env["PBX_COORDINATOR"] = coordinator
+    env["PBX_NUM_PROCESSES"] = str(world)
+    env["PBX_PROCESS_ID"] = str(rank)
+    return env
+
+
+class Watcher:
+    """Spawn + monitor worker processes; restart failed ranks up to
+    ``max_restarts`` (role of launch watcher + elastic restart)."""
+
+    def __init__(self, cmds: List[List[str]], envs: List[Dict[str, str]],
+                 *, max_restarts: int = 0, poll_sec: float = 0.5):
+        self.cmds = cmds
+        self.envs = envs
+        self.max_restarts = max_restarts
+        self.poll_sec = poll_sec
+        self.procs: List[Optional[subprocess.Popen]] = [None] * len(cmds)
+        self.restarts = [0] * len(cmds)
+
+    def _spawn(self, i: int) -> None:
+        self.procs[i] = subprocess.Popen(self.cmds[i], env=self.envs[i])
+        log.vlog(0, "launched rank %d (pid %d)", i, self.procs[i].pid)
+
+    def run(self) -> int:
+        for i in range(len(self.cmds)):
+            self._spawn(i)
+        try:
+            while True:
+                all_done = True
+                for i, p in enumerate(self.procs):
+                    if p is None:
+                        continue
+                    ret = p.poll()
+                    if ret is None:
+                        all_done = False
+                        continue
+                    if ret == 0:
+                        self.procs[i] = None
+                        continue
+                    if self.restarts[i] < self.max_restarts:
+                        self.restarts[i] += 1
+                        log.warning("rank %d exited %d; restart %d/%d", i,
+                                    ret, self.restarts[i], self.max_restarts)
+                        self._spawn(i)
+                        all_done = False
+                    else:
+                        log.error("rank %d failed (%d); terminating job",
+                                  i, ret)
+                        self.terminate()
+                        return ret
+                if all_done:
+                    return 0
+                time.sleep(self.poll_sec)
+        except KeyboardInterrupt:
+            self.terminate()
+            return 130
+
+    def terminate(self) -> None:
+        for p in self.procs:
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 5
+        for p in self.procs:
+            if p is None:
+                continue
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddlebox_tpu.launch",
+        description="launch distributed training processes")
+    ap.add_argument("--nproc", type=int, default=1,
+                    help="local processes to spawn (hosts in prod: 1)")
+    ap.add_argument("--coordinator", default="127.0.0.1:8476",
+                    help="coordinator address for jax.distributed")
+    ap.add_argument("--rank-offset", type=int, default=0,
+                    help="global rank of this host's first process")
+    ap.add_argument("--world-size", type=int, default=0,
+                    help="total processes across hosts (default: nproc)")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="per-rank restart budget on failure (elastic)")
+    ap.add_argument("script", help="training script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    world = args.world_size or args.nproc
+    cmds, envs = [], []
+    for i in range(args.nproc):
+        rank = args.rank_offset + i
+        cmds.append([sys.executable, args.script] + args.script_args)
+        envs.append(build_env(rank, world, args.coordinator))
+    return Watcher(cmds, envs, max_restarts=args.max_restarts).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Utilities: profiler, dump writers."""
+
+from paddlebox_tpu.utils.profiler import Profiler, profile_pass
+from paddlebox_tpu.utils.dump import DumpWriter
+
+__all__ = ["DumpWriter", "Profiler", "profile_pass"]

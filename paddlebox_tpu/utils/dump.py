@@ -1,0 +1,78 @@
+"""Per-line prediction/field dump writer.
+
+Role of the reference dump machinery: ``DeviceWorker::DumpFieldBoxPS`` /
+``DumpParamBoxPS`` (``device_worker.cc:511,543``) and the trainer dump
+channel writing per-instance prediction lines to HDFS
+(``boxps_trainer.cc:102-142``) — used in production to join predictions
+back to logs.
+
+TPU-first: a background writer thread drains a channel of formatted
+batches; filesystem is pluggable (local file; an fsspec-style writer can
+swap in for object stores).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.data.channel import Channel, ClosedChannelError
+
+
+class DumpWriter:
+    """Threaded line dump: ``write_batch`` is non-blocking; ``close``
+    flushes and joins."""
+
+    def __init__(self, path: str, *, capacity: int = 1024):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._ch: Channel = Channel(capacity)
+        self._thread = threading.Thread(target=self._writer, daemon=True)
+        self._error: Optional[BaseException] = None
+        self._thread.start()
+
+    def _writer(self) -> None:
+        try:
+            with open(self.path, "w") as f:
+                while True:
+                    try:
+                        lines = self._ch.get()
+                    except ClosedChannelError:
+                        return
+                    f.write(lines)
+                    monitor.add("dump/lines", lines.count("\n"))
+        except BaseException as e:
+            self._error = e
+
+    def write_batch(self, preds: np.ndarray, labels: np.ndarray,
+                    valid: Optional[np.ndarray] = None,
+                    ins_ids: Optional[Sequence[str]] = None,
+                    extra: Optional[Dict[str, np.ndarray]] = None) -> None:
+        """Queue one batch of prediction lines:
+        ``<ins_id>\\t<pred>\\t<label>[\\t<extra>...]``."""
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        n = preds.shape[0]
+        rows = []
+        for i in range(n):
+            if valid is not None and not valid[i]:
+                continue
+            parts = [ins_ids[i] if ins_ids is not None else str(i),
+                     f"{preds[i]:.6f}", f"{labels[i]:g}"]
+            if extra:
+                parts += [f"{np.asarray(v).reshape(-1)[i]:g}"
+                          for v in extra.values()]
+            rows.append("\t".join(parts))
+        if rows:
+            self._ch.put("\n".join(rows) + "\n")
+
+    def close(self) -> None:
+        self._ch.close()
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+        log.vlog(1, "dump closed: %s", self.path)

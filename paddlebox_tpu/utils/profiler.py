@@ -1,0 +1,85 @@
+"""Profiling: XLA trace capture + pass-stage timers.
+
+Role of the reference profiler stack (SURVEY.md §5): structured
+``paddle.profiler.Profiler`` (host tracer + CUPTI → chrome trace,
+``platform/profiler/``) and the hand-rolled hot-path timers printed by
+``PrintSyncTimer`` (``box_wrapper.h:395-420``) / ``TrainFilesWithProfiler``.
+
+TPU-first: device-side tracing is ``jax.profiler`` (TensorBoard/XPlane
+format — the TPU equivalent of the chrome trace, viewable in
+tensorboard or Perfetto); host-side stage attribution reuses
+``core.timers.TimerGroup``; ``annotate`` marks named regions
+(``TraceAnnotation``) that show up inside the device trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+from paddlebox_tpu.core import log, timers
+
+
+class Profiler:
+    """start()/stop() trace capture + named step annotations.
+
+    Usage:
+        prof = Profiler(logdir="/tmp/trace")
+        prof.start()
+        with prof.step(3):
+            loss = train_step(...)
+        prof.stop()
+    """
+
+    def __init__(self, logdir: str = "/tmp/pbx_profile"):
+        self.logdir = logdir
+        self._active = False
+        self.timers = timers.TimerGroup()
+
+    def start(self) -> None:
+        os.makedirs(self.logdir, exist_ok=True)
+        jax.profiler.start_trace(self.logdir)
+        self._active = True
+        log.vlog(0, "profiler: tracing to %s", self.logdir)
+
+    def stop(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.vlog(0, "profiler: trace written to %s", self.logdir)
+
+    @contextlib.contextmanager
+    def step(self, step_num: Optional[int] = None) -> Iterator[None]:
+        name = f"train_step_{step_num}" if step_num is not None else "step"
+        with jax.profiler.StepTraceAnnotation(
+                "train", step_num=step_num or 0):
+            with self.timers.scope(name if step_num is None else "step"):
+                yield
+
+    @contextlib.contextmanager
+    def annotate(self, name: str) -> Iterator[None]:
+        """Named region visible in the device trace (role of the
+        RecordEvent host annotations)."""
+        with jax.profiler.TraceAnnotation(name):
+            with self.timers.scope(name):
+                yield
+
+    def report(self) -> str:
+        return self.timers.report()
+
+
+@contextlib.contextmanager
+def profile_pass(logdir: str, *, enabled: bool = True) -> Iterator[Optional[Profiler]]:
+    """Trace one whole pass (role of TrainFilesWithProfiler gating)."""
+    if not enabled:
+        yield None
+        return
+    prof = Profiler(logdir)
+    prof.start()
+    try:
+        yield prof
+    finally:
+        prof.stop()
