@@ -20,7 +20,7 @@ from paddlebox_tpu.core import monitor
 from paddlebox_tpu.data.slots import DataFeedConfig, Instance, SlotBatch
 
 
-def csr_gather(values: np.ndarray, offsets: np.ndarray, starts: np.ndarray,
+def csr_gather(values: np.ndarray, starts: np.ndarray,
                lens: np.ndarray):
     """Gather ragged rows: for each j, take values[starts[j] : starts[j] +
     lens[j]]. Returns (gathered values, new offsets [len(starts)+1])."""
@@ -91,7 +91,7 @@ class ColumnarChunk:
         offs: Dict[str, np.ndarray] = {}
         for s, o in self.sparse_offsets.items():
             lens = np.diff(o)
-            ids[s], offs[s] = csr_gather(self.sparse_ids[s], o, o[idx],
+            ids[s], offs[s] = csr_gather(self.sparse_ids[s], o[idx],
                                          lens[idx])
         return ColumnarChunk(
             labels=self.labels[idx], sparse_ids=ids, sparse_offsets=offs,
@@ -112,7 +112,7 @@ class ColumnarChunk:
         lens = np.diff(o)
         ids = dict(self.sparse_ids)
         offs = dict(self.sparse_offsets)
-        ids[slot], offs[slot] = csr_gather(self.sparse_ids[slot], o,
+        ids[slot], offs[slot] = csr_gather(self.sparse_ids[slot],
                                            o[perm], lens[perm])
         return ColumnarChunk(labels=self.labels, sparse_ids=ids,
                              sparse_offsets=offs, dense=self.dense)
@@ -144,7 +144,7 @@ class ColumnarChunk:
             lens = np.diff(o[lo:hi + 1]).astype(np.int64)
             if slot.max_len:
                 lens = np.minimum(lens, slot.max_len)
-            vals, _ = csr_gather(self.sparse_ids[name], o, o[lo:hi], lens)
+            vals, _ = csr_gather(self.sparse_ids[name], o[lo:hi], lens)
             total = int(lens.sum())
             segs = np.repeat(np.arange(n, dtype=np.int32), lens)
             if total > cap:

@@ -22,7 +22,7 @@ import socket
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from paddlebox_tpu.core import log
 
@@ -34,7 +34,15 @@ class FileStore:
         self.root = root
         self.rank = rank
         self.world = world
+        # Per-name generation counters: reusing a barrier/all_gather name
+        # must not match a previous round's marker files.
+        self._gens: Dict[str, int] = {}
         os.makedirs(root, exist_ok=True)
+
+    def _gen(self, name: str) -> int:
+        g = self._gens.get(name, 0)
+        self._gens[name] = g + 1
+        return g
 
     def set(self, key: str, value: bytes) -> None:
         tmp = os.path.join(self.root, f".{key}.{self.rank}.tmp")
@@ -53,15 +61,18 @@ class FileStore:
             return f.read()
 
     def barrier(self, name: str, timeout: float = 60.0) -> None:
-        """All ranks arrive (role of _barrier_worker)."""
-        self.set(f"barrier.{name}.{self.rank}", b"1")
+        """All ranks arrive (role of _barrier_worker). Reusable: each call
+        under the same name is a fresh generation."""
+        g = self._gen(f"barrier.{name}")
+        self.set(f"barrier.{name}.{g}.{self.rank}", b"1")
         for r in range(self.world):
-            self.get(f"barrier.{name}.{r}", timeout)
+            self.get(f"barrier.{name}.{g}.{r}", timeout)
 
     def all_gather(self, name: str, value: bytes,
                    timeout: float = 60.0) -> List[bytes]:
-        self.set(f"ag.{name}.{self.rank}", value)
-        return [self.get(f"ag.{name}.{r}", timeout)
+        g = self._gen(f"ag.{name}")
+        self.set(f"ag.{name}.{g}.{self.rank}", value)
+        return [self.get(f"ag.{name}.{g}.{r}", timeout)
                 for r in range(self.world)]
 
 
@@ -84,7 +95,7 @@ class TcpTransport:
     ``PadBoxSlotDataset::ShuffleData``/``ReceiveSuffleData``.
     """
 
-    HDR = struct.Struct("<iq")  # (src_rank, payload_len)
+    HDR = struct.Struct("<iqq")  # (src_rank, round, payload_len)
 
     def __init__(self, rank: int, endpoints: Sequence[str]):
         self.rank = rank
@@ -94,7 +105,11 @@ class TcpTransport:
         self._server = socket.create_server((host, int(port)), backlog=16,
                                             reuse_port=False)
         self._recv_lock = threading.Lock()
-        self._inbox: Dict[int, List[bytes]] = {}
+        # Messages keyed by (src, round): concurrent connections from the
+        # same peer across back-to-back exchange() rounds may deliver out
+        # of order, so the round tag — not arrival order — pairs them up.
+        self._inbox: Dict[Tuple[int, int], bytes] = {}
+        self._round = 0
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._running = True
@@ -114,21 +129,21 @@ class TcpTransport:
             with conn:
                 while True:
                     hdr = _recv_exact(conn, self.HDR.size)
-                    src, ln = self.HDR.unpack(hdr)
+                    src, rnd, ln = self.HDR.unpack(hdr)
                     payload = _recv_exact(conn, ln) if ln else b""
                     with self._recv_lock:
-                        self._inbox.setdefault(src, []).append(payload)
+                        self._inbox[(src, rnd)] = payload
         except (ConnectionError, OSError):
             return
 
-    def _send(self, dst: int, payload: bytes) -> None:
+    def _send(self, dst: int, rnd: int, payload: bytes) -> None:
         host, port = self.endpoints[dst].rsplit(":", 1)
         deadline = time.time() + 30
         while True:
             try:
                 with socket.create_connection((host, int(port)),
                                               timeout=10) as s:
-                    s.sendall(self.HDR.pack(self.rank, len(payload)))
+                    s.sendall(self.HDR.pack(self.rank, rnd, len(payload)))
                     s.sendall(payload)
                 return
             except OSError:
@@ -142,6 +157,8 @@ class TcpTransport:
         peer (self's slot short-circuits locally)."""
         if len(buffers) != self.world:
             raise ValueError(f"{len(buffers)} buffers != world {self.world}")
+        rnd = self._round
+        self._round += 1
         out: List[Optional[bytes]] = [None] * self.world
         out[self.rank] = buffers[self.rank]
         senders = []
@@ -149,18 +166,16 @@ class TcpTransport:
             if dst == self.rank:
                 continue
             t = threading.Thread(target=self._send,
-                                 args=(dst, buffers[dst]), daemon=True)
+                                 args=(dst, rnd, buffers[dst]), daemon=True)
             t.start()
             senders.append(t)
+        want = [(src, rnd) for src in range(self.world) if src != self.rank]
         deadline = time.time() + timeout
         while True:
             with self._recv_lock:
-                ready = all(self._inbox.get(src) for src in range(self.world)
-                            if src != self.rank)
-                if ready:
-                    for src in range(self.world):
-                        if src != self.rank:
-                            out[src] = self._inbox[src].pop(0)
+                if all(k in self._inbox for k in want):
+                    for src, _ in want:
+                        out[src] = self._inbox.pop((src, rnd))
                     break
             if time.time() > deadline:
                 raise TimeoutError("exchange timed out")

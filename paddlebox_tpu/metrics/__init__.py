@@ -4,12 +4,15 @@ Role of the reference metrics engine (``fleet/metrics.{h,cc}``, SURVEY.md
 §2.2 "Metrics (AUC engine)"): ``BasicAucCalculator`` bucketed pos/neg
 histograms + exact distributed AUC via histogram allreduce + trapezoid
 sweep, plus mae/rmse/predicted-vs-actual CTR; ``WuAucMetricMsg`` per-user
-AUC; python fleet.metrics wrappers.
+AUC; the named ``Metric`` registry with multi-task / cmatch-rank / mask /
+continue variants (metrics.h:217-560).
 
 TPU-first: histogram accumulation is a device-side ``segment_sum`` fused
 into the train step; the cross-replica reduction is a ``psum`` over the dp
 axis (replacing the Gloo/MPI allreduce at metrics.cc:289); the final
-trapezoid sweep runs on host at pass end.
+trapezoid sweep runs on host at pass end. The registry tier
+(metrics/registry.py) is the host-side flexible path for eval/multi-task
+slicing, as in the reference.
 """
 
 from paddlebox_tpu.metrics.auc import (
@@ -19,6 +22,13 @@ from paddlebox_tpu.metrics.auc import (
     auc_compute,
     wuauc_compute,
 )
+from paddlebox_tpu.metrics.registry import (
+    BucketAucCalculator,
+    ContinueCalculator,
+    MetricRegistry,
+    global_registry,
+    parse_group,
+)
 
 __all__ = [
     "AucState",
@@ -26,4 +36,9 @@ __all__ = [
     "auc_compute",
     "auc_state_init",
     "wuauc_compute",
+    "BucketAucCalculator",
+    "ContinueCalculator",
+    "MetricRegistry",
+    "global_registry",
+    "parse_group",
 ]

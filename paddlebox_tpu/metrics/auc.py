@@ -91,29 +91,13 @@ def auc_accumulate(state: AucState, preds: jax.Array, labels: jax.Array,
 
 def auc_compute(state: AucState) -> Dict[str, float]:
     """Host-side final sweep (role of computeBucketAuc + calculate_bucket_error,
-    metrics.cc:124-355). Returns auc, actual/predicted ctr, mae, rmse."""
-    table = np.asarray(state.table, np.float64)
-    neg, pos = table[0], table[1]
-    tot_pos = pos.sum()
-    tot_neg = neg.sum()
-    # AUC = P(score_pos > score_neg): sweep buckets low->high, each positive
-    # in bucket b beats all negatives in lower buckets and ties (half) with
-    # negatives in its own bucket (trapezoid, metrics.cc:124 equivalent).
-    neg_cum = np.cumsum(neg) - neg
-    area = float(np.sum(pos * (neg_cum + neg * 0.5)))
-    if tot_pos > 0 and tot_neg > 0:
-        auc = area / (tot_pos * tot_neg)
-    else:
-        auc = float("nan")
-    count = max(float(state.count), 1.0)
-    return {
-        "auc": auc,
-        "actual_ctr": float(state.label_sum) / count,
-        "predicted_ctr": float(state.pred_sum) / count,
-        "mae": float(state.abserr) / count,
-        "rmse": (float(state.sqrerr) / count) ** 0.5,
-        "count": float(state.count),
-    }
+    metrics.cc:124-391). Returns auc, bucket_error, actual/predicted ctr,
+    mae, rmse — via the sweep shared with the host calculator."""
+    from paddlebox_tpu.metrics.registry import compute_from_table
+    return compute_from_table(
+        np.asarray(state.table, np.float64), float(state.abserr),
+        float(state.sqrerr), float(state.pred_sum), float(state.label_sum),
+        float(state.count))
 
 
 def wuauc_compute(user_ids: np.ndarray, preds: np.ndarray,

@@ -1,0 +1,369 @@
+"""Named metric registry + the full metric-variant family.
+
+Role of the ``Metric`` singleton and its ``MetricMsg`` hierarchy
+(``fleet/metrics.h:217-560``): training code registers named metrics bound
+to tensor names and a *phase* (join/update multi-phase training picks which
+metrics accumulate on a given pass), the worker feeds every batch to all
+metrics of the active phase, and ``get_metric`` computes the distributed
+result and resets.
+
+Variants mirrored from the reference:
+- basic AUC               (``MetricMsg``)
+- per-user AUC            (``WuAucMetricMsg``,        metrics.h:306)
+- multi-task AUC          (``MultiTaskMetricMsg``,    metrics.h:346):
+  N prediction columns + a cmatch tag per record selects WHICH column
+- cmatch/rank-filtered    (``CmatchRankMetricMsg``,   metrics.h:430)
+- mask-filtered           (``MaskMetricMsg``,         metrics.h:511)
+- cmatch+rank+mask        (``CmatchRankMaskMetricMsg``)
+- continue (regression)   (``_continue_bucket_error`` per-bucket mae/rmse)
+
+TPU-first note: the hot-path AUC accumulation in the train step itself is
+the device-side ``AucState`` (metrics/auc.py) folded into the jit step with
+an incremental psum; this registry is the *host-side* flexible tier the
+reference also runs on CPU (its variant add_data loops are host loops over
+copied-back tensors, metrics.h:415-428) — used for eval passes, multi-task
+slicing, and anything not worth burning device time on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import log
+
+# Optional cross-rank reduction: fn(array) -> summed array across ranks
+# (role of the boxps-MPI / Gloo allreduce in metrics.cc:286-292).
+ReduceFn = Callable[[np.ndarray], np.ndarray]
+
+
+# Constants from the reference bucket-error sweep (metrics.h:213-214).
+_REL_ERR_BOUND = 0.05
+_MAX_SPAN = 0.01
+
+
+def bucket_error_sweep(table: np.ndarray) -> float:
+    """Adaptive-span calibration error (calculate_bucket_error,
+    metrics.cc:357-391): grow a bucket window until the binomial relative
+    error of its adjusted ctr is small enough, then score
+    |actual/adjusted - 1| weighted by impressions. table is [2, nb]."""
+    neg, pos = np.asarray(table[0], np.float64), np.asarray(table[1], np.float64)
+    last_ctr = -1.0
+    impression_sum = ctr_sum = click_sum = 0.0
+    error_sum = error_count = 0.0
+    nb = neg.shape[0]
+    nonzero = np.flatnonzero((neg + pos) > 0)
+    for i in nonzero:
+        click = pos[i]
+        show = neg[i] + pos[i]
+        ctr = i / nb
+        if abs(ctr - last_ctr) > _MAX_SPAN:
+            last_ctr = ctr
+            impression_sum = ctr_sum = click_sum = 0.0
+        impression_sum += show
+        ctr_sum += ctr * show
+        click_sum += click
+        adjust_ctr = ctr_sum / impression_sum
+        if adjust_ctr <= 0 or adjust_ctr >= 1:
+            continue
+        rel = ((1 - adjust_ctr) / (adjust_ctr * impression_sum)) ** 0.5
+        if rel < _REL_ERR_BOUND:
+            actual_ctr = click_sum / impression_sum
+            error_sum += abs(actual_ctr / adjust_ctr - 1) * impression_sum
+            error_count += impression_sum
+            last_ctr = -1.0
+    return error_sum / error_count if error_count > 0 else 0.0
+
+
+def compute_from_table(table: np.ndarray, abserr: float, sqrerr: float,
+                       pred_sum: float, label_sum: float, count: float
+                       ) -> Dict[str, float]:
+    """Final sweep shared by the device-side AucState and the host
+    calculator (computeBucketAuc + calculate_bucket_error + calibration
+    ratios, metrics.cc:124-391). table is the [2, nb] neg/pos histogram.
+
+    AUC = P(score_pos > score_neg): each positive in bucket b beats all
+    negatives in lower buckets and ties (half) within its own bucket."""
+    table = np.asarray(table, np.float64)
+    neg, pos = table[0], table[1]
+    tot_pos, tot_neg = pos.sum(), neg.sum()
+    neg_cum = np.cumsum(neg) - neg
+    area = float(np.sum(pos * (neg_cum + neg * 0.5)))
+    auc = (area / (tot_pos * tot_neg)
+           if tot_pos > 0 and tot_neg > 0 else float("nan"))
+    c = max(count, 1.0)
+    return {
+        "auc": auc,
+        "bucket_error": bucket_error_sweep(table),
+        "mae": abserr / c,
+        "rmse": (sqrerr / c) ** 0.5,
+        "actual_ctr": label_sum / c,
+        "predicted_ctr": pred_sum / c,
+        "count": count,
+    }
+
+
+class BucketAucCalculator:
+    """Host twin of ``BasicAucCalculator`` (fleet/metrics.h:46): bucketed
+    pos/neg histograms + running calibration sums; exact AUC + bucket error
+    on compute."""
+
+    def __init__(self, num_buckets: int = 1_000_000):
+        self.num_buckets = num_buckets
+        self.reset()
+
+    def reset(self) -> None:
+        self._table = np.zeros((2, self.num_buckets), np.float64)
+        self._abserr = 0.0
+        self._sqrerr = 0.0
+        self._pred_sum = 0.0
+        self._label_sum = 0.0
+        self._count = 0.0
+        # WuAuc raw records (uid variant needs exact per-user grouping).
+        self._uid_chunks: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+
+    def add_data(self, preds: np.ndarray, labels: np.ndarray,
+                 mask: Optional[np.ndarray] = None) -> None:
+        preds = np.asarray(preds, np.float64).ravel()
+        labels = np.asarray(labels, np.float64).ravel()
+        if mask is not None:
+            keep = np.asarray(mask).ravel().astype(bool)
+            preds, labels = preds[keep], labels[keep]
+        if preds.size == 0:
+            return
+        nb = self.num_buckets
+        bucket = np.clip((preds * nb).astype(np.int64), 0, nb - 1)
+        lab = (labels > 0.5).astype(np.int64)
+        np.add.at(self._table, (lab, bucket), 1.0)
+        err = preds - labels
+        self._abserr += float(np.abs(err).sum())
+        self._sqrerr += float((err * err).sum())
+        self._pred_sum += float(preds.sum())
+        self._label_sum += float(labels.sum())
+        self._count += float(preds.size)
+
+    def add_uid_data(self, preds: np.ndarray, labels: np.ndarray,
+                     uids: np.ndarray) -> None:
+        """Keep raw records for exact per-user AUC (add_uid_data role)."""
+        self.add_data(preds, labels)
+        self._uid_chunks.append((np.asarray(uids).ravel().copy(),
+                                 np.asarray(preds, np.float64).ravel().copy(),
+                                 np.asarray(labels, np.float64).ravel().copy()))
+
+    # -- final sweep -------------------------------------------------------
+
+    def compute(self, reduce_fn: Optional[ReduceFn] = None) -> Dict[str, float]:
+        table = self._table
+        scalars = np.array([self._abserr, self._sqrerr, self._pred_sum,
+                            self._label_sum, self._count], np.float64)
+        if reduce_fn is not None:
+            table = reduce_fn(table)
+            scalars = reduce_fn(scalars)
+        return compute_from_table(table, *scalars)
+
+
+class ContinueCalculator:
+    """Regression ("continue value") metrics with per-value-bucket stats.
+
+    Role of ``add_continue_data`` + ``_continue_bucket_error``
+    (``box_wrapper.h:785-800``, ``metrics.cc:560-600``): global mae/rmse/
+    actual/predicted means plus the same stats per label-magnitude bucket.
+    """
+
+    def __init__(self, num_buckets: int = 10, max_value: float = 1.0):
+        self.num_buckets = num_buckets
+        self.max_value = max_value
+        self.reset()
+
+    def reset(self) -> None:
+        # per bucket: [abserr, sqrerr, label_sum, pred_sum, count]
+        self._acc = np.zeros((self.num_buckets, 5), np.float64)
+
+    def add_data(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        preds = np.asarray(preds, np.float64).ravel()
+        labels = np.asarray(labels, np.float64).ravel()
+        if preds.size == 0:
+            return
+        b = np.clip((labels / self.max_value * self.num_buckets).astype(int),
+                    0, self.num_buckets - 1)
+        err = preds - labels
+        for col, v in enumerate((np.abs(err), err * err, labels, preds,
+                                 np.ones_like(preds))):
+            np.add.at(self._acc[:, col], b, v)
+
+    def compute(self, reduce_fn: Optional[ReduceFn] = None) -> Dict[str, object]:
+        acc = reduce_fn(self._acc) if reduce_fn is not None else self._acc
+        tot = acc.sum(axis=0)
+        c = max(tot[4], 1.0)
+        cb = np.maximum(acc[:, 4], 1.0)
+        return {
+            "mae": tot[0] / c,
+            "rmse": (tot[1] / c) ** 0.5,
+            "actual_value": tot[2] / c,
+            "predicted_value": tot[3] / c,
+            "count": tot[4],
+            "bucket_mae": (acc[:, 0] / cb).tolist(),
+            "bucket_rmse": np.sqrt(acc[:, 1] / cb).tolist(),
+            "bucket_count": acc[:, 4].tolist(),
+        }
+
+
+def _parse_cmatch_rank(x: np.ndarray, ignore_rank: bool
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Tag decode (parse_cmatch_rank, metrics.h:300): with ignore_rank the
+    whole value is the cmatch id; otherwise high 32 bits = cmatch, low
+    8 bits = rank."""
+    x = np.asarray(x, np.uint64)
+    if ignore_rank:
+        return x.astype(np.int64), np.zeros_like(x, np.int64)
+    return (x >> np.uint64(32)).astype(np.int64), \
+        (x & np.uint64(0xFF)).astype(np.int64)
+
+
+@dataclasses.dataclass
+class MetricMsg:
+    """One registered metric: variant config + calculator + phase."""
+
+    name: str
+    kind: str                      # auc | wuauc | multi_task | cmatch_rank |
+    #                                mask | cmatch_rank_mask | continue
+    phase: int = -1                # -1: active in every phase
+    calculator: object = None
+    cmatch_rank_group: Tuple[Tuple[int, int], ...] = ()
+    ignore_rank: bool = True
+
+    def matches(self, cmatch: np.ndarray, rank: np.ndarray) -> np.ndarray:
+        keep = np.zeros(cmatch.shape[0], bool)
+        idx = np.full(cmatch.shape[0], -1, np.int64)
+        for j, (cm, rk) in enumerate(self.cmatch_rank_group):
+            hit = ((cmatch == cm) if self.ignore_rank
+                   else (cmatch == cm) & (rank == rk))
+            idx = np.where(~keep & hit, j, idx)
+            keep |= hit
+        return keep, idx
+
+
+def parse_group(spec: str, ignore_rank: bool) -> Tuple[Tuple[int, int], ...]:
+    """"23_0 severa_1"-style spec → ((cmatch, rank), ...) pairs
+    (constructor parsing, metrics.h:365-377,445-458)."""
+    out = []
+    for tok in spec.split():
+        if ignore_rank and "_" not in tok:
+            out.append((int(tok), 0))
+        else:
+            cm, rk = tok.split("_")
+            out.append((int(cm), int(rk)))
+    return tuple(out)
+
+
+class MetricRegistry:
+    """Role of the process-wide ``Metric`` singleton (metrics.h:217):
+    ``init_metric`` registers, per-batch feeds go through ``add_data``
+    keyed by name, ``get_metric`` computes+resets."""
+
+    def __init__(self):
+        self._metrics: Dict[str, MetricMsg] = {}
+        self.phase = 0             # role of Metric::SetPhase (join/update)
+
+    def init_metric(self, name: str, kind: str = "auc", *, phase: int = -1,
+                    bucket_size: int = 1_000_000,
+                    cmatch_rank_group: str = "", ignore_rank: bool = True,
+                    continue_buckets: int = 10,
+                    continue_max_value: float = 1.0) -> MetricMsg:
+        kind = kind.lower()
+        if kind == "continue":
+            calc = ContinueCalculator(continue_buckets, continue_max_value)
+        else:
+            calc = BucketAucCalculator(bucket_size)
+        msg = MetricMsg(
+            name=name, kind=kind, phase=phase, calculator=calc,
+            cmatch_rank_group=parse_group(cmatch_rank_group, ignore_rank),
+            ignore_rank=ignore_rank)
+        self._metrics[name] = msg
+        log.vlog(1, "init_metric %s kind=%s phase=%d", name, kind, phase)
+        return msg
+
+    def names(self) -> List[str]:
+        return list(self._metrics)
+
+    def _active(self, msg: MetricMsg) -> bool:
+        return msg.phase < 0 or msg.phase == self.phase
+
+    def add_data(self, name: str, preds: np.ndarray, labels: np.ndarray, *,
+                 uids: Optional[np.ndarray] = None,
+                 mask: Optional[np.ndarray] = None,
+                 cmatch_rank: Optional[np.ndarray] = None) -> None:
+        """Feed one batch. ``preds`` is [B] for single-pred kinds or a
+        sequence/2-D [T, B] for multi_task (one row per task head)."""
+        msg = self._metrics[name]
+        if not self._active(msg):
+            return
+        cal = msg.calculator
+        if msg.kind == "continue":
+            cal.add_data(preds, labels)
+            return
+        if msg.kind == "auc":
+            cal.add_data(preds, labels)
+            return
+        if msg.kind == "wuauc":
+            if uids is None:
+                raise ValueError(f"metric {name}: wuauc needs uids")
+            cal.add_uid_data(preds, labels, uids)
+            return
+        if msg.kind == "mask":
+            if mask is None:
+                raise ValueError(f"metric {name}: mask kind needs mask")
+            cal.add_data(preds, labels, mask=mask)
+            return
+        if cmatch_rank is None:
+            raise ValueError(f"metric {name}: {msg.kind} needs cmatch_rank")
+        cmatch, rank = _parse_cmatch_rank(cmatch_rank, msg.ignore_rank)
+        keep, idx = msg.matches(cmatch, rank)
+        labels = np.asarray(labels).ravel()
+        if msg.kind == "multi_task":
+            preds2 = np.atleast_2d(np.asarray(preds, np.float64))
+            sel = np.where(keep, idx, 0)
+            chosen = preds2[sel, np.arange(labels.shape[0])]
+            cal.add_data(chosen[keep], labels[keep])
+        elif msg.kind in ("cmatch_rank", "cmatch_rank_mask"):
+            preds = np.asarray(preds, np.float64).ravel()
+            if msg.kind == "cmatch_rank_mask":
+                if mask is None:
+                    raise ValueError(
+                        f"metric {name}: cmatch_rank_mask needs mask")
+                keep &= np.asarray(mask).ravel().astype(bool)
+            cal.add_data(preds[keep], labels[keep])
+        else:
+            raise ValueError(f"unknown metric kind {msg.kind!r}")
+
+    def get_metric(self, name: str, reduce_fn: Optional[ReduceFn] = None,
+                   reset: bool = True) -> Dict[str, object]:
+        """Compute (with optional cross-rank allreduce) and reset — the
+        GetMetricMsg/print path (metrics.cc:286-355)."""
+        msg = self._metrics[name]
+        cal = msg.calculator
+        out = cal.compute(reduce_fn)
+        if msg.kind == "wuauc":
+            chunks = cal._uid_chunks
+            if chunks:
+                from paddlebox_tpu.metrics.auc import wuauc_compute
+                uids = np.concatenate([c[0] for c in chunks])
+                preds = np.concatenate([c[1] for c in chunks])
+                labels = np.concatenate([c[2] for c in chunks])
+                out.update(wuauc_compute(uids, preds, labels))
+        if reset:
+            cal.reset()
+        return out
+
+
+# Process-wide instance (role of Metric::GetInstance).
+_global_registry: Optional[MetricRegistry] = None
+
+
+def global_registry() -> MetricRegistry:
+    global _global_registry
+    if _global_registry is None:
+        _global_registry = MetricRegistry()
+    return _global_registry

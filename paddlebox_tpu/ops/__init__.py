@@ -1,11 +1,13 @@
 """Fused CTR ops (TPU lowerings of the reference's custom CUDA op family).
 
 Role of ``paddle/fluid/operators/fused/`` (SURVEY.md §2.2 "Fused CTR ops"):
-``fused_seqpool_cvm`` + variants, ``cvm_op``, ``rank_attention``. On TPU
-these are expressed as XLA-fusable segment ops / batched matmuls — XLA fuses
-the elementwise CVM transform into the pooling reduction, so no hand kernel
-is needed for the memory-bound path; the MXU-bound rank-attention is a
-batched gather + dot_general.
+``fused_seqpool_cvm`` + its variant zoo (conv/pcoc/tradew/credit/
+diff_thres), ``fused_concat``/``fusion_seqpool_cvm_concat``, ``cvm_op``,
+``rank_attention``/``rank_attention2``. On TPU these are expressed as
+XLA-fusable segment ops / batched matmuls — XLA fuses the elementwise CVM
+transform into the pooling reduction, so no hand kernel is needed for the
+memory-bound path; the MXU-bound rank-attention is a batched gather +
+dot_general.
 """
 
 from paddlebox_tpu.ops.seqpool import (
@@ -13,11 +15,34 @@ from paddlebox_tpu.ops.seqpool import (
     fused_seqpool_cvm,
     continuous_value_model,
 )
-from paddlebox_tpu.ops.rank_attention import rank_attention
+from paddlebox_tpu.ops.seqpool_variants import (
+    fused_seqpool_cvm_full,
+    fused_seqpool_cvm_with_conv,
+    fused_seqpool_cvm_with_credit,
+    fused_seqpool_cvm_with_pcoc,
+    fused_seqpool_cvm_tradew,
+    fused_seqpool_cvm_with_diff_thres,
+    fused_concat,
+    fusion_seqpool_cvm_concat,
+    quant_filter_mask,
+    quantize,
+)
+from paddlebox_tpu.ops.rank_attention import rank_attention, rank_attention2
 
 __all__ = [
     "continuous_value_model",
+    "fused_concat",
     "fused_seqpool_cvm",
+    "fused_seqpool_cvm_full",
+    "fused_seqpool_cvm_tradew",
+    "fused_seqpool_cvm_with_conv",
+    "fused_seqpool_cvm_with_credit",
+    "fused_seqpool_cvm_with_diff_thres",
+    "fused_seqpool_cvm_with_pcoc",
+    "fusion_seqpool_cvm_concat",
+    "quant_filter_mask",
+    "quantize",
     "rank_attention",
+    "rank_attention2",
     "seqpool",
 ]

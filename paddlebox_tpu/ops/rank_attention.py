@@ -61,3 +61,22 @@ def rank_attention(x: jax.Array, rank_offset: jax.Array,
     out = jnp.einsum("bkf,bkfc->bc", xin, psel,
                      preferred_element_type=jnp.float32)
     return out, rank_offset[:, 0].astype(jnp.float32)
+
+
+def rank_attention2(x: jax.Array, rank_offset: jax.Array,
+                    rank_param: jax.Array, *, max_rank: int) -> jax.Array:
+    """``rank_attention2`` (``rank_attention_op.cc:182``, CUDA
+    ``rank_attention_op.cu:297``): same contraction as rank_attention but
+    the parameter comes flat as [max_rank*max_rank*F, C] and only Out is
+    produced (the reference's grad flows to RankParam only; here jax.grad
+    gives exact grads for both inputs and callers drop what they don't
+    use)."""
+    b, f = x.shape
+    k = max_rank
+    if rank_param.shape[0] != k * k * f:
+        raise ValueError(
+            f"rank_param rows {rank_param.shape[0]} != max_rank^2*F {k*k*f}")
+    out, _ = rank_attention(
+        x, rank_offset, rank_param.reshape(k * k, f, rank_param.shape[1]),
+        max_rank=max_rank)
+    return out

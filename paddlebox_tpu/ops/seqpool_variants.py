@@ -1,0 +1,237 @@
+"""The full fused seqpool+CVM op family, TPU-style.
+
+Role of the CUDA variant zoo under ``operators/fused/``:
+``fused_seqpool_cvm_with_conv_op.cu``, ``_with_pcoc_op.cu``,
+``_tradew_op.cu``, ``_with_credit_op.cu``, ``_with_diff_thres_op.cu``,
+``fused_concat_op.cu``, ``fusion_seqpool_cvm_concat_op.cc`` (python
+wrappers ``python/paddle/fluid/contrib/layers/nn.py:1746-2085``).
+
+Each reference kernel pair is (seqpool with optional token filter/quant)
+followed by a CVM-style transform of the leading counter columns. Here
+both halves are jnp expressions — ``segment_sum`` + elementwise — which
+XLA fuses into one pass over the batch, reproducing the "fused" property
+without bespoke kernels; every function is jit/grad-safe.
+
+Conventions (matching ops/seqpool.py): per-slot CSR inputs ``x [n, C]``
+(leading counter columns then embedding dims), ``segments [n]`` row ids in
+``[0, num_rows]`` with ``num_rows`` = padding discard row.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.ops.seqpool import seqpool
+
+
+def _log1p(x):
+    return jnp.log(x + 1.0)
+
+
+def quant_filter_mask(show: jax.Array, click: jax.Array, *,
+                      show_coeff: float = 0.2, clk_coeff: float = 1.0,
+                      threshold: float = 0.96) -> jax.Array:
+    """Per-token keep mask: drop tokens whose confidence score
+    ``(show-click)*show_coeff + click*clk_coeff`` is under threshold
+    (FusedSeqpoolKernelQuantFilter, fused_seqpool_cvm_op.cu:238-244)."""
+    score = (show - click) * show_coeff + click * clk_coeff
+    return score >= threshold
+
+
+def quantize(emb: jax.Array, quant_ratio: int) -> jax.Array:
+    """Pull-value quantization ``trunc(v*q + 0.5)/q`` — the C int-cast
+    truncates toward zero (fused_seqpool_cvm_op.cu:247)."""
+    if quant_ratio <= 0:
+        return emb
+    return jnp.trunc(emb * quant_ratio + 0.5) / float(quant_ratio)
+
+
+def _pool_with_filter(x: jax.Array, segments: jax.Array, num_rows: int, *,
+                      cvm_offset: int, need_filter: bool, show_coeff: float,
+                      clk_coeff: float, threshold: float,
+                      quant_ratio: int) -> jax.Array:
+    """Shared first half: optional token filter + embed quantization, then
+    sum-pool counters and embeddings together."""
+    cols = x
+    if quant_ratio > 0:
+        cols = jnp.concatenate(
+            [x[:, :cvm_offset], quantize(x[:, cvm_offset:], quant_ratio)],
+            axis=-1)
+    if need_filter:
+        keep = quant_filter_mask(x[:, 0], x[:, 1], show_coeff=show_coeff,
+                                 clk_coeff=clk_coeff, threshold=threshold)
+        cols = cols * keep[:, None].astype(cols.dtype)
+    return seqpool(cols, segments, num_rows, mode="sum")
+
+
+def fused_seqpool_cvm_full(x: jax.Array, segments: jax.Array, num_rows: int, *,
+                           use_cvm: bool = True, need_filter: bool = False,
+                           show_coeff: float = 0.2, clk_coeff: float = 1.0,
+                           threshold: float = 0.96, quant_ratio: int = 0,
+                           cvm_offset: int = 2) -> jax.Array:
+    """Base op with the full attr surface (fused_seqpool_cvm_op.cc:125-141):
+    token confidence filter + quantization + seqpool + CVM.
+
+    x [n, cvm_offset + D] with leading [show, click]. Output
+    [num_rows, cvm_offset + D] when use_cvm else [num_rows, D].
+    """
+    pooled = _pool_with_filter(
+        x, segments, num_rows, cvm_offset=cvm_offset,
+        need_filter=need_filter, show_coeff=show_coeff, clk_coeff=clk_coeff,
+        threshold=threshold, quant_ratio=quant_ratio)
+    if not use_cvm:
+        return pooled[:, cvm_offset:]
+    show, click = pooled[:, 0], pooled[:, 1]
+    lead = [_log1p(show), _log1p(click) - _log1p(show)]
+    return jnp.concatenate(
+        [jnp.stack(lead, axis=-1), pooled[:, 2:]], axis=-1)
+
+
+def fused_seqpool_cvm_with_conv(x: jax.Array, segments: jax.Array,
+                                num_rows: int, *, use_cvm: bool = True,
+                                show_filter: bool = False) -> jax.Array:
+    """Conv-signal variant (fused_seqpool_cvm_with_conv_op.cu:57-140):
+    x [n, 3 + D] leading [show, click, conv]. Output leading columns are
+    [log(show+1), log(click+1), log(conv+1)-log(click+1)]; ``show_filter``
+    drops the show column (join phase feeds click-only);
+    ``use_cvm=False`` strips all three."""
+    cvm_offset = 3
+    pooled = seqpool(x, segments, num_rows, mode="sum")
+    if not use_cvm:
+        return pooled[:, cvm_offset:]
+    show, click, conv = pooled[:, 0], pooled[:, 1], pooled[:, 2]
+    lead = [_log1p(click), _log1p(conv) - _log1p(click)]
+    if not show_filter:
+        lead = [_log1p(show)] + lead
+    return jnp.concatenate(
+        [jnp.stack(lead, axis=-1), pooled[:, cvm_offset:]], axis=-1)
+
+
+def fused_seqpool_cvm_with_credit(x: jax.Array, segments: jax.Array,
+                                  num_rows: int, *, cvm_offset: int = 4,
+                                  use_cvm: bool = True,
+                                  show_filter: bool = False) -> jax.Array:
+    """Credit variant (fused_seqpool_cvm_with_credit_op.cu): all
+    ``cvm_offset`` leading counters [show, click, conv, credit] map through
+    log(x+1); show_filter drops the show column."""
+    pooled = seqpool(x, segments, num_rows, mode="sum")
+    if not use_cvm:
+        return pooled[:, cvm_offset:]
+    lo = 1 if show_filter else 0
+    lead = _log1p(pooled[:, lo:cvm_offset])
+    return jnp.concatenate([lead, pooled[:, cvm_offset:]], axis=-1)
+
+
+def fused_seqpool_cvm_with_pcoc(x: jax.Array, segments: jax.Array,
+                                num_rows: int, *, cvm_offset: int = 7,
+                                pclk_num: int = 3, use_cvm: bool = True,
+                                need_filter: bool = False,
+                                show_coeff: float = 0.2,
+                                clk_coeff: float = 1.0,
+                                threshold: float = 0.96,
+                                quant_ratio: int = 0) -> jax.Array:
+    """PCOC (predicted-click-over-click calibration) variant
+    (fused_seqpool_cvm_with_pcoc_op.cu:87-160).
+
+    Input columns: [show, click, q, d, p_1..p_pclk_num, emb...] with
+    ``cvm_offset = 4 + pclk_num`` leading counters. Output leading columns:
+      [ log(show+1), log(click+1)-log(show+1),
+        log(p_i+1)-log(q+1) ...,            (pclk_num cols)
+        log(p_i+1)-log(d+1) ... ]           (pclk_num cols)
+    followed by the embedding columns.
+    """
+    if cvm_offset != 4 + pclk_num:
+        raise ValueError(
+            f"pcoc layout needs cvm_offset == 4 + pclk_num, got "
+            f"{cvm_offset} vs pclk_num={pclk_num}")
+    pooled = _pool_with_filter(
+        x, segments, num_rows, cvm_offset=cvm_offset,
+        need_filter=need_filter, show_coeff=show_coeff, clk_coeff=clk_coeff,
+        threshold=threshold, quant_ratio=quant_ratio)
+    if not use_cvm:
+        return pooled[:, cvm_offset:]
+    show, click = pooled[:, 0], pooled[:, 1]
+    q, d = pooled[:, 2], pooled[:, 3]
+    p = pooled[:, 4:4 + pclk_num]
+    lead = jnp.concatenate([
+        _log1p(show)[:, None],
+        (_log1p(click) - _log1p(show))[:, None],
+        _log1p(p) - _log1p(q)[:, None],
+        _log1p(p) - _log1p(d)[:, None],
+    ], axis=-1)
+    return jnp.concatenate([lead, pooled[:, cvm_offset:]], axis=-1)
+
+
+def fused_seqpool_cvm_tradew(x: jax.Array, segments: jax.Array,
+                             num_rows: int, *, trade_num: int,
+                             trade_id: int = -1, cvm_offset: int = 2,
+                             use_cvm: bool = True) -> jax.Array:
+    """Trade-weighted variant (fused_seqpool_cvm_tradew_op.cu:34-130).
+
+    Input columns: [show, click, w_0..w_{trade_num-1}, emb...]. With
+    ``trade_id >= 0`` each token's embedding columns are scaled by its
+    trade weight ``w[trade_id]`` before pooling; counters pool unweighted.
+    Then the base CVM transform.
+    """
+    counters = x[:, :cvm_offset]
+    emb = x[:, cvm_offset + trade_num:]
+    if trade_id >= 0:
+        w = x[:, cvm_offset + trade_id]
+        emb = emb * w[:, None]
+    pooled = seqpool(jnp.concatenate([counters, emb], axis=-1),
+                     segments, num_rows, mode="sum")
+    if not use_cvm:
+        return pooled[:, cvm_offset:]
+    show, click = pooled[:, 0], pooled[:, 1]
+    lead = jnp.stack([_log1p(show), _log1p(click) - _log1p(show)], axis=-1)
+    return jnp.concatenate([lead, pooled[:, cvm_offset:]], axis=-1)
+
+
+def fused_seqpool_cvm_with_diff_thres(
+        x: jax.Array, segments: jax.Array, num_rows: int, *,
+        slot_threshold: float, use_cvm: bool = True,
+        need_filter: bool = True, show_coeff: float = 0.2,
+        clk_coeff: float = 1.0, quant_ratio: int = 0,
+        clk_filter: bool = False) -> jax.Array:
+    """Per-slot-threshold variant (fused_seqpool_cvm_with_diff_thres_op.cu:
+    92-111 ``xbox_diff_thres_filter`` path): the confidence filter uses the
+    calling slot's own threshold instead of one global value; ``clk_filter``
+    drops the show column from the CVM output (click-only join input)."""
+    pooled = _pool_with_filter(
+        x, segments, num_rows, cvm_offset=2, need_filter=need_filter,
+        show_coeff=show_coeff, clk_coeff=clk_coeff,
+        threshold=slot_threshold, quant_ratio=quant_ratio)
+    if not use_cvm:
+        return pooled[:, 2:]
+    show, click = pooled[:, 0], pooled[:, 1]
+    ctr = _log1p(click) - _log1p(show)
+    lead = ([ctr] if clk_filter else [_log1p(show), ctr])
+    return jnp.concatenate(
+        [jnp.stack(lead, axis=-1), pooled[:, 2:]], axis=-1)
+
+
+def fused_concat(xs: Sequence[jax.Array], *, offset: int = 0,
+                 length: int = -1) -> jax.Array:
+    """Feature-dim concat of per-slot outputs with optional column slice
+    (role of ``fused_concat_op.cu``: concatenates a [offset, offset+length)
+    column window from every input). XLA lowers this to one fused copy."""
+    if length >= 0:
+        xs = [x[:, offset:offset + length] for x in xs]
+    elif offset:
+        xs = [x[:, offset:] for x in xs]
+    return jnp.concatenate(list(xs), axis=-1)
+
+
+def fusion_seqpool_cvm_concat(xs: Sequence[jax.Array],
+                              segments: Sequence[jax.Array], num_rows: int, *,
+                              use_cvm: bool = True) -> jax.Array:
+    """Multi-slot seqpool+CVM then concat (role of
+    ``fusion_seqpool_cvm_concat_op.cc``): equivalent to the per-slot base
+    op followed by fused_concat, expressed so XLA schedules all slots'
+    segment-sums in one fusion."""
+    outs = [fused_seqpool_cvm_full(x, seg, num_rows, use_cvm=use_cvm)
+            for x, seg in zip(xs, segments)]
+    return jnp.concatenate(outs, axis=-1)

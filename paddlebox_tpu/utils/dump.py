@@ -47,6 +47,10 @@ class DumpWriter:
                     monitor.add("dump/lines", lines.count("\n"))
         except BaseException as e:
             self._error = e
+            # Close so a blocked producer wakes up (put raises on closed)
+            # instead of hanging on a full channel; write_batch re-raises
+            # the root cause.
+            self._ch.close()
 
     def write_batch(self, preds: np.ndarray, labels: np.ndarray,
                     valid: Optional[np.ndarray] = None,
@@ -68,7 +72,13 @@ class DumpWriter:
                           for v in extra.values()]
             rows.append("\t".join(parts))
         if rows:
-            self._ch.put("\n".join(rows) + "\n")
+            if self._error is not None:
+                raise self._error
+            try:
+                self._ch.put("\n".join(rows) + "\n")
+            except ClosedChannelError:
+                raise self._error if self._error is not None else \
+                    RuntimeError("write_batch after close()")
 
     def close(self) -> None:
         self._ch.close()

@@ -53,10 +53,11 @@ class Profiler:
 
     @contextlib.contextmanager
     def step(self, step_num: Optional[int] = None) -> Iterator[None]:
-        name = f"train_step_{step_num}" if step_num is not None else "step"
+        # One aggregated host timer for all steps; per-step attribution
+        # lives in the device trace via the step annotation.
         with jax.profiler.StepTraceAnnotation(
                 "train", step_num=step_num or 0):
-            with self.timers.scope(name if step_num is None else "step"):
+            with self.timers.scope("step"):
                 yield
 
     @contextlib.contextmanager

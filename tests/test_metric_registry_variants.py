@@ -1,0 +1,373 @@
+"""Metric registry + fused-op variant tests (numpy-parity style, role of
+the reference OpTest harness, SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.metrics import (BucketAucCalculator, ContinueCalculator,
+                                   MetricRegistry, auc_accumulate,
+                                   auc_compute, auc_state_init, parse_group)
+from paddlebox_tpu.ops import (fused_concat, fused_seqpool_cvm,
+                               fused_seqpool_cvm_full,
+                               fused_seqpool_cvm_tradew,
+                               fused_seqpool_cvm_with_conv,
+                               fused_seqpool_cvm_with_credit,
+                               fused_seqpool_cvm_with_diff_thres,
+                               fused_seqpool_cvm_with_pcoc,
+                               fusion_seqpool_cvm_concat, quantize,
+                               rank_attention, rank_attention2)
+
+
+def _auc_ref(preds, labels):
+    order = np.argsort(preds, kind="stable")
+    ranks = np.empty(len(preds))
+    ranks[order] = np.arange(1, len(preds) + 1)
+    pos = labels > 0.5
+    npos, nneg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+# --- registry ---------------------------------------------------------------
+
+def _rand_batch(rng, n=512):
+    preds = rng.random(n).astype(np.float64)
+    labels = (rng.random(n) < preds).astype(np.float64)  # informative preds
+    return preds, labels
+
+
+def test_bucket_auc_calculator_matches_exact():
+    rng = np.random.default_rng(0)
+    preds, labels = _rand_batch(rng)
+    cal = BucketAucCalculator(1 << 14)
+    cal.add_data(preds[:300], labels[:300])
+    cal.add_data(preds[300:], labels[300:])
+    out = cal.compute()
+    assert abs(out["auc"] - _auc_ref(preds, labels)) < 1e-3
+    np.testing.assert_allclose(out["mae"], np.abs(preds - labels).mean(),
+                               rtol=1e-9)
+    np.testing.assert_allclose(out["actual_ctr"], labels.mean(), rtol=1e-9)
+    assert out["count"] == 512
+    # reset happens in registry path; direct compute leaves state
+    assert out["bucket_error"] >= 0.0
+
+
+def test_registry_basic_and_phase_gating():
+    reg = MetricRegistry()
+    reg.init_metric("join_auc", "auc", phase=0, bucket_size=1 << 12)
+    reg.init_metric("update_auc", "auc", phase=1, bucket_size=1 << 12)
+    rng = np.random.default_rng(1)
+    preds, labels = _rand_batch(rng)
+    reg.phase = 0
+    reg.add_data("join_auc", preds, labels)
+    reg.add_data("update_auc", preds, labels)   # inactive: dropped
+    assert reg.get_metric("join_auc")["count"] == 512
+    assert reg.get_metric("update_auc")["count"] == 0
+    # get_metric resets
+    assert reg.get_metric("join_auc")["count"] == 0
+
+
+def test_registry_mask_kind():
+    reg = MetricRegistry()
+    reg.init_metric("m", "mask", bucket_size=1 << 12)
+    rng = np.random.default_rng(2)
+    preds, labels = _rand_batch(rng)
+    mask = rng.integers(0, 2, preds.shape[0])
+    reg.add_data("m", preds, labels, mask=mask)
+    out = reg.get_metric("m")
+    keep = mask.astype(bool)
+    assert out["count"] == keep.sum()
+    assert abs(out["auc"] - _auc_ref(preds[keep], labels[keep])) < 5e-3
+
+
+def test_registry_cmatch_rank_filtering():
+    reg = MetricRegistry()
+    reg.init_metric("c", "cmatch_rank", cmatch_rank_group="3 7",
+                    ignore_rank=True, bucket_size=1 << 12)
+    rng = np.random.default_rng(3)
+    preds, labels = _rand_batch(rng)
+    cmatch = rng.choice([3, 5, 7], preds.shape[0]).astype(np.uint64)
+    reg.add_data("c", preds, labels, cmatch_rank=cmatch)
+    keep = (cmatch == 3) | (cmatch == 7)
+    out = reg.get_metric("c")
+    assert out["count"] == keep.sum()
+    assert abs(out["auc"] - _auc_ref(preds[keep], labels[keep])) < 5e-3
+
+
+def test_registry_cmatch_rank_with_rank_bits():
+    # high 32 bits cmatch, low 8 bits rank
+    reg = MetricRegistry()
+    reg.init_metric("cr", "cmatch_rank", cmatch_rank_group="2_1",
+                    ignore_rank=False, bucket_size=1 << 12)
+    tags = np.array([(2 << 32) | 1, (2 << 32) | 0, (3 << 32) | 1],
+                    np.uint64)
+    reg.add_data("cr", np.array([0.9, 0.8, 0.7]), np.array([1.0, 0.0, 1.0]),
+                 cmatch_rank=tags)
+    assert reg.get_metric("cr")["count"] == 1
+
+
+def test_registry_multi_task_selects_column():
+    reg = MetricRegistry()
+    reg.init_metric("mt", "multi_task", cmatch_rank_group="0 1",
+                    ignore_rank=True, bucket_size=1 << 12)
+    rng = np.random.default_rng(4)
+    n = 256
+    preds = rng.random((2, n))
+    labels = (rng.random(n) < 0.5).astype(np.float64)
+    task = rng.integers(0, 2, n).astype(np.uint64)
+    reg.add_data("mt", preds, labels, cmatch_rank=task)
+    out = reg.get_metric("mt")
+    assert out["count"] == n
+    chosen = preds[task.astype(int), np.arange(n)]
+    assert abs(out["auc"] - _auc_ref(chosen, labels)) < 5e-3
+
+
+def test_registry_wuauc():
+    reg = MetricRegistry()
+    reg.init_metric("w", "wuauc", bucket_size=1 << 12)
+    rng = np.random.default_rng(5)
+    preds, labels = _rand_batch(rng, 400)
+    uids = rng.integers(0, 20, 400)
+    reg.add_data("w", preds, labels, uids=uids)
+    out = reg.get_metric("w")
+    assert 0.4 < out["wuauc"] <= 1.0
+    assert out["wuauc_users"] > 0
+
+
+def test_continue_calculator():
+    cal = ContinueCalculator(num_buckets=4, max_value=2.0)
+    preds = np.array([0.5, 1.5, 1.9, 0.1])
+    labels = np.array([0.4, 1.6, 1.8, 0.0])
+    cal.add_data(preds, labels)
+    out = cal.compute()
+    np.testing.assert_allclose(out["mae"], np.abs(preds - labels).mean(),
+                               rtol=1e-9)
+    assert out["count"] == 4
+    assert len(out["bucket_mae"]) == 4
+    # labels 0.4->bucket 0, 1.6/1.8 -> bucket 3, 0.0 -> bucket 0
+    assert out["bucket_count"][0] == 2 and out["bucket_count"][3] == 2
+
+
+def test_registry_reduce_fn_distributed_sum():
+    """Two 'ranks' compute locally; allreduce by summing tables equals the
+    single-rank result (the metrics.cc:286 contract)."""
+    rng = np.random.default_rng(6)
+    preds, labels = _rand_batch(rng)
+    c_all = BucketAucCalculator(1 << 12)
+    c_all.add_data(preds, labels)
+    c0 = BucketAucCalculator(1 << 12)
+    c1 = BucketAucCalculator(1 << 12)
+    c0.add_data(preds[:256], labels[:256])
+    c1.add_data(preds[256:], labels[256:])
+
+    peers = {id(c0): c1, id(c1): c0}
+
+    def make_reduce(me, other):
+        state = {"i": 0}
+        other_payloads = [other._table,
+                          np.array([other._abserr, other._sqrerr,
+                                    other._pred_sum, other._label_sum,
+                                    other._count])]
+
+        def rf(arr):
+            out = arr + other_payloads[state["i"]]
+            state["i"] += 1
+            return out
+        return rf
+
+    out0 = c0.compute(make_reduce(c0, c1))
+    ref = c_all.compute()
+    np.testing.assert_allclose(out0["auc"], ref["auc"], rtol=1e-12)
+    np.testing.assert_allclose(out0["mae"], ref["mae"], rtol=1e-12)
+
+
+def test_device_auc_includes_bucket_error():
+    state = auc_state_init(1 << 10)
+    rng = np.random.default_rng(7)
+    preds, labels = _rand_batch(rng)
+    state = auc_accumulate(state, jnp.asarray(preds, jnp.float32),
+                           jnp.asarray(labels, jnp.float32))
+    out = auc_compute(state)
+    assert "bucket_error" in out and out["bucket_error"] >= 0.0
+
+
+def test_parse_group():
+    assert parse_group("3 7", True) == ((3, 0), (7, 0))
+    assert parse_group("2_1 4_0", False) == ((2, 1), (4, 0))
+
+
+# --- fused op variants ------------------------------------------------------
+
+def _csr(rng, n_rows, cols, max_len=3):
+    lens = rng.integers(0, max_len + 1, n_rows)
+    n = int(lens.sum())
+    segs = np.repeat(np.arange(n_rows), lens).astype(np.int32)
+    x = rng.random((n, cols)).astype(np.float32) * 3
+    return x, segs, lens
+
+
+def test_fused_seqpool_cvm_full_filter_and_quant():
+    rng = np.random.default_rng(8)
+    d = 4
+    x, segs, lens = _csr(rng, 6, 2 + d)
+    out = fused_seqpool_cvm_full(
+        jnp.asarray(x), jnp.asarray(segs), 6, need_filter=True,
+        show_coeff=0.2, clk_coeff=1.0, threshold=0.96, quant_ratio=128)
+    # numpy reference
+    ref = np.zeros((6, 2 + d))
+    for i in range(x.shape[0]):
+        r = segs[i]
+        show, click = x[i, 0], x[i, 1]
+        if (show - click) * 0.2 + click * 1.0 < 0.96:
+            continue
+        ref[r, :2] += x[i, :2]
+        ref[r, 2:] += np.trunc(x[i, 2:] * 128 + 0.5) / 128
+    expect = np.concatenate([
+        np.log(ref[:, :1] + 1),
+        np.log(ref[:, 1:2] + 1) - np.log(ref[:, :1] + 1),
+        ref[:, 2:]], axis=1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_seqpool_cvm_with_conv_modes():
+    rng = np.random.default_rng(9)
+    d = 3
+    x, segs, _ = _csr(rng, 5, 3 + d)
+    pooled = np.zeros((5, 3 + d))
+    np.add.at(pooled, segs, x)
+    out = fused_seqpool_cvm_with_conv(jnp.asarray(x), jnp.asarray(segs), 5)
+    expect = np.concatenate([
+        np.log(pooled[:, :1] + 1),
+        np.log(pooled[:, 1:2] + 1),
+        np.log(pooled[:, 2:3] + 1) - np.log(pooled[:, 1:2] + 1),
+        pooled[:, 3:]], axis=1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-6)
+    # show_filter drops show col
+    out2 = fused_seqpool_cvm_with_conv(jnp.asarray(x), jnp.asarray(segs), 5,
+                                       show_filter=True)
+    np.testing.assert_allclose(np.asarray(out2), expect[:, 1:], rtol=1e-5,
+                               atol=1e-6)
+    out3 = fused_seqpool_cvm_with_conv(jnp.asarray(x), jnp.asarray(segs), 5,
+                                       use_cvm=False)
+    np.testing.assert_allclose(np.asarray(out3), pooled[:, 3:], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_fused_seqpool_cvm_with_credit():
+    rng = np.random.default_rng(10)
+    d = 2
+    x, segs, _ = _csr(rng, 4, 4 + d)
+    pooled = np.zeros((4, 4 + d))
+    np.add.at(pooled, segs, x)
+    out = fused_seqpool_cvm_with_credit(jnp.asarray(x), jnp.asarray(segs), 4)
+    expect = np.concatenate([np.log(pooled[:, :4] + 1), pooled[:, 4:]], axis=1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-6)
+    out2 = fused_seqpool_cvm_with_credit(jnp.asarray(x), jnp.asarray(segs), 4,
+                                         show_filter=True)
+    np.testing.assert_allclose(np.asarray(out2), expect[:, 1:], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_fused_seqpool_cvm_with_pcoc():
+    rng = np.random.default_rng(11)
+    d, p = 2, 3
+    cvm_offset = 4 + p
+    x, segs, _ = _csr(rng, 4, cvm_offset + d)
+    pooled = np.zeros((4, cvm_offset + d))
+    np.add.at(pooled, segs, x)
+    out = fused_seqpool_cvm_with_pcoc(jnp.asarray(x), jnp.asarray(segs), 4,
+                                      cvm_offset=cvm_offset, pclk_num=p)
+    l = lambda v: np.log(v + 1)
+    expect = np.concatenate([
+        l(pooled[:, :1]),
+        l(pooled[:, 1:2]) - l(pooled[:, :1]),
+        l(pooled[:, 4:4 + p]) - l(pooled[:, 2:3]),
+        l(pooled[:, 4:4 + p]) - l(pooled[:, 3:4]),
+        pooled[:, cvm_offset:]], axis=1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-6)
+    assert out.shape == (4, 2 + 2 * p + d)
+
+
+def test_fused_seqpool_cvm_tradew():
+    rng = np.random.default_rng(12)
+    d, tn, tid = 3, 2, 1
+    x, segs, _ = _csr(rng, 5, 2 + tn + d)
+    out = fused_seqpool_cvm_tradew(jnp.asarray(x), jnp.asarray(segs), 5,
+                                   trade_num=tn, trade_id=tid)
+    pooled = np.zeros((5, 2 + d))
+    for i in range(x.shape[0]):
+        r = segs[i]
+        pooled[r, :2] += x[i, :2]
+        pooled[r, 2:] += x[i, 2 + tn:] * x[i, 2 + tid]
+    expect = np.concatenate([
+        np.log(pooled[:, :1] + 1),
+        np.log(pooled[:, 1:2] + 1) - np.log(pooled[:, :1] + 1),
+        pooled[:, 2:]], axis=1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_seqpool_cvm_diff_thres_clk_filter():
+    rng = np.random.default_rng(13)
+    d = 2
+    x, segs, _ = _csr(rng, 4, 2 + d)
+    out = fused_seqpool_cvm_with_diff_thres(
+        jnp.asarray(x), jnp.asarray(segs), 4, slot_threshold=0.5,
+        clk_filter=True)
+    ref = np.zeros((4, 2 + d))
+    for i in range(x.shape[0]):
+        show, click = x[i, 0], x[i, 1]
+        if (show - click) * 0.2 + click * 1.0 < 0.5:
+            continue
+        ref[segs[i]] += x[i]
+    expect = np.concatenate([
+        np.log(ref[:, 1:2] + 1) - np.log(ref[:, :1] + 1), ref[:, 2:]], axis=1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-6)
+    assert out.shape == (4, 1 + d)
+
+
+def test_fused_concat_and_fusion_concat():
+    a = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+    b = jnp.asarray(np.arange(6, dtype=np.float32).reshape(3, 2))
+    out = fused_concat([a, b])
+    assert out.shape == (3, 6)
+    out2 = fused_concat([a, a], offset=1, length=2)
+    np.testing.assert_allclose(np.asarray(out2),
+                               np.concatenate([a[:, 1:3], a[:, 1:3]], 1))
+    rng = np.random.default_rng(14)
+    x1, s1, _ = _csr(rng, 4, 2 + 3)
+    x2, s2, _ = _csr(rng, 4, 2 + 2)
+    fused = fusion_seqpool_cvm_concat(
+        [jnp.asarray(x1), jnp.asarray(x2)],
+        [jnp.asarray(s1), jnp.asarray(s2)], 4)
+    a1 = fused_seqpool_cvm_full(jnp.asarray(x1), jnp.asarray(s1), 4)
+    a2 = fused_seqpool_cvm_full(jnp.asarray(x2), jnp.asarray(s2), 4)
+    np.testing.assert_allclose(np.asarray(fused),
+                               np.concatenate([a1, a2], axis=1), rtol=1e-6)
+
+
+def test_quantize_truncation_matches_c_cast():
+    v = jnp.asarray([0.1, -0.1, 0.004, -0.004], jnp.float32)
+    out = np.asarray(quantize(v, 128))
+    expect = np.array([int(x * 128 + 0.5) / 128 for x in
+                       [0.1, -0.1, 0.004, -0.004]], np.float32)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_rank_attention2_matches_rank_attention():
+    rng = np.random.default_rng(15)
+    b, f, c, k = 6, 5, 4, 3
+    x = rng.normal(size=(b, f)).astype(np.float32)
+    param = rng.normal(size=(k * k, f, c)).astype(np.float32)
+    ro = np.zeros((b, 1 + 2 * k), np.int32)
+    for i in range(b):
+        ro[i, 0] = rng.integers(1, k + 1)
+        for j in range(k):
+            if rng.random() < 0.7:
+                ro[i, 1 + 2 * j] = rng.integers(1, k + 1)
+                ro[i, 2 + 2 * j] = rng.integers(0, b)
+    out1, _ = rank_attention(jnp.asarray(x), jnp.asarray(ro),
+                             jnp.asarray(param), max_rank=k)
+    out2 = rank_attention2(jnp.asarray(x), jnp.asarray(ro),
+                           jnp.asarray(param.reshape(k * k * f, c)),
+                           max_rank=k)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
