@@ -60,10 +60,23 @@ class FileStore:
         with open(path, "rb") as f:
             return f.read()
 
+    def _cleanup_old_gen(self, prefix: str, g: int) -> None:
+        """Unlink our own generation g-2 marker: by the time any rank runs
+        generation g it has completed g-1, which required every rank to
+        have entered g-1 — i.e. to have finished g-2. So no reader can
+        still need a g-2 file, and the directory stays bounded."""
+        if g >= 2:
+            try:
+                os.unlink(os.path.join(self.root,
+                                       f"{prefix}.{g - 2}.{self.rank}"))
+            except FileNotFoundError:
+                pass
+
     def barrier(self, name: str, timeout: float = 60.0) -> None:
         """All ranks arrive (role of _barrier_worker). Reusable: each call
         under the same name is a fresh generation."""
         g = self._gen(f"barrier.{name}")
+        self._cleanup_old_gen(f"barrier.{name}", g)
         self.set(f"barrier.{name}.{g}.{self.rank}", b"1")
         for r in range(self.world):
             self.get(f"barrier.{name}.{g}.{r}", timeout)
@@ -71,6 +84,7 @@ class FileStore:
     def all_gather(self, name: str, value: bytes,
                    timeout: float = 60.0) -> List[bytes]:
         g = self._gen(f"ag.{name}")
+        self._cleanup_old_gen(f"ag.{name}", g)
         self.set(f"ag.{name}.{g}.{self.rank}", value)
         return [self.get(f"ag.{name}.{g}.{r}", timeout)
                 for r in range(self.world)]
@@ -110,6 +124,9 @@ class TcpTransport:
         # of order, so the round tag — not arrival order — pairs them up.
         self._inbox: Dict[Tuple[int, int], bytes] = {}
         self._round = 0
+        # Rounds at or below this are finished/abandoned; late arrivals for
+        # them are discarded instead of pinning payload bytes forever.
+        self._retired_round = -1
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._running = True
@@ -132,7 +149,8 @@ class TcpTransport:
                     src, rnd, ln = self.HDR.unpack(hdr)
                     payload = _recv_exact(conn, ln) if ln else b""
                     with self._recv_lock:
-                        self._inbox[(src, rnd)] = payload
+                        if rnd > self._retired_round:
+                            self._inbox[(src, rnd)] = payload
         except (ConnectionError, OSError):
             return
 
@@ -171,15 +189,23 @@ class TcpTransport:
             senders.append(t)
         want = [(src, rnd) for src in range(self.world) if src != self.rank]
         deadline = time.time() + timeout
-        while True:
+        try:
+            while True:
+                with self._recv_lock:
+                    if all(k in self._inbox for k in want):
+                        for src, _ in want:
+                            out[src] = self._inbox.pop((src, rnd))
+                        break
+                if time.time() > deadline:
+                    raise TimeoutError("exchange timed out")
+                time.sleep(0.002)
+        finally:
+            # Success or timeout, this round is over: drop any partial or
+            # late payloads so they can't leak or mispair.
             with self._recv_lock:
-                if all(k in self._inbox for k in want):
-                    for src, _ in want:
-                        out[src] = self._inbox.pop((src, rnd))
-                    break
-            if time.time() > deadline:
-                raise TimeoutError("exchange timed out")
-            time.sleep(0.002)
+                self._retired_round = rnd
+                for k in [k for k in self._inbox if k[1] <= rnd]:
+                    del self._inbox[k]
         for t in senders:
             t.join()
         return out  # type: ignore[return-value]

@@ -29,7 +29,10 @@ from paddlebox_tpu.embedding.lookup import (
     make_pull_fn,
     make_push_fn,
 )
-from paddlebox_tpu.embedding.optimizers import SparseAdagrad, SparseOptimizer
+from paddlebox_tpu.embedding.optimizers import (SparseAdagrad, SparseAdam,
+                                                SparseAdamShared,
+                                                SparseOptimizer,
+                                                make_sparse_optimizer)
 from paddlebox_tpu.embedding.pass_engine import PassEngine
 
 __all__ = [
@@ -37,6 +40,9 @@ __all__ = [
     "PassEngine",
     "PassTable",
     "SparseAdagrad",
+    "SparseAdam",
+    "SparseAdamShared",
+    "make_sparse_optimizer",
     "SparseOptimizer",
     "TableConfig",
     "make_pull_fn",

@@ -27,7 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddlebox_tpu.core import flags
-from paddlebox_tpu.embedding.optimizers import SparseAdagrad
+from paddlebox_tpu.embedding.optimizers import SparseAdagrad, SparseOptimizer
 from paddlebox_tpu.embedding.table import PassTable, TableConfig
 
 
@@ -119,7 +119,7 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
 
 def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
                grad_w: jax.Array, shows: jax.Array, clicks: jax.Array, *,
-               axis: str, opt: Optional[SparseAdagrad] = None) -> PassTable:
+               axis: str, opt: Optional[SparseOptimizer] = None) -> PassTable:
     """Per-device push: exact dedup + fused sparse optimizer update.
 
     dev_rows [n]; grad_emb [n, D]; grad_w/shows/clicks [n]. Padding entries
@@ -173,31 +173,32 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
 
     # Gather current state at touched rows, apply optimizer, write deltas.
     cur_emb = table.emb[rows_s]
-    cur_emb_g2 = table.emb_g2sum[rows_s]
+    cur_emb_st = table.emb_state[rows_s]
     cur_w = table.w[rows_s]
-    cur_w_g2 = table.w_g2sum[rows_s]
+    cur_w_st = table.w_state[rows_s]
 
-    new_emb, new_emb_g2 = opt.update_vector(cur_emb, cur_emb_g2, g_emb)
-    new_w, new_w_g2 = opt.update_scalar(cur_w, cur_w_g2, g_w)
+    new_emb, new_emb_st = opt.update_vector(cur_emb, cur_emb_st, g_emb)
+    new_w, new_w_st = opt.update_scalar(cur_w, cur_w_st, g_w)
 
     repf = rep.astype(table.emb.dtype)
     emb = table.emb.at[rows_s].add(repf[:, None] * (new_emb - cur_emb))
-    emb_g2 = table.emb_g2sum.at[rows_s].add(repf * (new_emb_g2 - cur_emb_g2))
+    emb_st = table.emb_state.at[rows_s].add(
+        repf[:, None] * (new_emb_st - cur_emb_st))
     w = table.w.at[rows_s].add(repf * (new_w - cur_w))
-    w_g2 = table.w_g2sum.at[rows_s].add(repf * (new_w_g2 - cur_w_g2))
+    w_st = table.w_state.at[rows_s].add(
+        repf[:, None] * (new_w_st - cur_w_st))
     show = table.show.at[rows_s].add(repf * g_show)
     click = table.click.at[rows_s].add(repf * g_click)
 
-    # Re-zero the trash row so padding pulls keep returning zeros.
+    # Re-zero the trash row so padding pulls keep returning zeros (the
+    # optimizer state keeps its init there; only value rows must be 0).
     zero_rows = jnp.arange(1) + trash
     emb = emb.at[zero_rows].set(0.0)
-    emb_g2 = emb_g2.at[zero_rows].set(0.0)
     w = w.at[zero_rows].set(0.0)
-    w_g2 = w_g2.at[zero_rows].set(0.0)
     show = show.at[zero_rows].set(0.0)
     click = click.at[zero_rows].set(0.0)
 
-    return PassTable(emb=emb, emb_g2sum=emb_g2, w=w, w_g2sum=w_g2,
+    return PassTable(emb=emb, emb_state=emb_st, w=w, w_state=w_st,
                      show=show, click=click,
                      rows_per_shard=table.rows_per_shard,
                      num_shards=table.num_shards)
@@ -227,7 +228,7 @@ def make_pull_fn(mesh: Mesh, axis: str = "dp"):
 
 
 def make_push_fn(mesh: Mesh, axis: str = "dp",
-                 opt: Optional[SparseAdagrad] = None):
+                 opt: Optional[SparseOptimizer] = None):
     """Jitted sparse-grad apply with table donation."""
 
     @functools.partial(

@@ -2,14 +2,21 @@
 
 Role of the in-kernel GPU sparse optimizers executed during push
 (``heter_ps/optimizer.cuh.h``: SparseAdagradOptimizer:31,
-SparseAdamOptimizer:148; bounds/decay config ``optimizer_conf.h``).
+SparseAdamOptimizer:148, SparseAdamSharedOptimizer:330; bounds/decay
+config ``optimizer_conf.h``).
 
 Each rule is a pure function over per-row (value, state, merged-grad)
-vectors; the lookup layer guarantees the grad passed in is already the
+arrays; the lookup layer guarantees the grad passed in is already the
 EXACT per-row sum across all duplicates in the step (dedup happens owner-
 side), so one rule application per touched row per step — matching the
 reference's dedup-then-update contract (dynamic_merge_grad →
 update_one_table, heter_comm_inl.h:1646).
+
+Optimizer state is a single per-row ``[n, K]`` float32 array whose width
+and layout the optimizer defines — mirroring how the reference packs
+per-optimizer state inline in the ``CommonFeatureValue`` record
+(``feature_value.h:44``; e.g. adam appends [m1*, m2*, beta1_pow,
+beta2_pow] after the weights, optimizer.cuh.h:306-327).
 """
 
 from __future__ import annotations
@@ -19,19 +26,37 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddlebox_tpu.embedding.table import TableConfig
 
+_EPS = 1e-8
+
 
 class SparseOptimizer:
-    """Interface: update(value, g2sum, grad) -> (new_value, new_g2sum)."""
+    """Interface. State arrays: emb_state [n, emb_state_width(D)],
+    w_state [n, w_state_width()]; update_* returns (new_value, new_state)."""
 
-    def update_vector(self, value: jax.Array, g2sum: jax.Array,
-                      grad: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    def emb_state_width(self, dim: int) -> int:
         raise NotImplementedError
 
-    def update_scalar(self, value: jax.Array, g2sum: jax.Array,
+    def w_state_width(self) -> int:
+        raise NotImplementedError
+
+    def init_emb_state(self, n: int, dim: int) -> np.ndarray:
+        return np.zeros((n, self.emb_state_width(dim)), np.float32)
+
+    def init_w_state(self, n: int) -> np.ndarray:
+        return np.zeros((n, self.w_state_width()), np.float32)
+
+    def update_vector(self, value: jax.Array, state: jax.Array,
                       grad: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """value/grad [n, D]; state [n, emb_state_width(D)]."""
+        raise NotImplementedError
+
+    def update_scalar(self, value: jax.Array, state: jax.Array,
+                      grad: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """value/grad [n]; state [n, w_state_width()]."""
         raise NotImplementedError
 
 
@@ -42,6 +67,8 @@ class SparseAdagrad(SparseOptimizer):
       g2sum' = g2sum + mean(g^2)            (scalar per row)
       scale  = sqrt(initial_g2sum / (initial_g2sum + g2sum'))
       value' = clip(value - lr * scale * g, [min_bound, max_bound])
+
+    State layout: [g2sum] (K=1).
     """
 
     learning_rate: float = 0.05
@@ -55,17 +82,186 @@ class SparseAdagrad(SparseOptimizer):
                    initial_g2sum=cfg.initial_g2sum,
                    min_bound=cfg.min_bound, max_bound=cfg.max_bound)
 
-    def update_vector(self, value, g2sum, grad):
-        # value/grad: [n, D]; g2sum: [n]
-        add_g2 = jnp.mean(grad * grad, axis=-1)
-        new_g2 = g2sum + add_g2
+    def emb_state_width(self, dim: int) -> int:
+        return 1
+
+    def w_state_width(self) -> int:
+        return 1
+
+    def update_vector(self, value, state, grad):
+        g2sum = state[:, 0]
+        new_g2 = g2sum + jnp.mean(grad * grad, axis=-1)
         scale = jnp.sqrt(self.initial_g2sum / (self.initial_g2sum + new_g2))
         new_v = value - self.learning_rate * scale[..., None] * grad
-        return jnp.clip(new_v, self.min_bound, self.max_bound), new_g2
+        return (jnp.clip(new_v, self.min_bound, self.max_bound),
+                new_g2[:, None])
 
-    def update_scalar(self, value, g2sum, grad):
-        # value/grad/g2sum: [n]
+    def update_scalar(self, value, state, grad):
+        g2sum = state[:, 0]
         new_g2 = g2sum + grad * grad
         scale = jnp.sqrt(self.initial_g2sum / (self.initial_g2sum + new_g2))
         new_v = value - self.learning_rate * scale * grad
-        return jnp.clip(new_v, self.min_bound, self.max_bound), new_g2
+        return (jnp.clip(new_v, self.min_bound, self.max_bound),
+                new_g2[:, None])
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseAdam(SparseOptimizer):
+    """Per-dim-moment adam (reference optimizer.cuh.h:148-245):
+
+      ratio = lr * sqrt(1 - beta2_pow) / (1 - beta1_pow)
+      m1'   = beta1*m1 + (1-beta1)*g ; m2' = beta2*m2 + (1-beta2)*g^2
+      value' = clip(value + ratio * m1'/(sqrt(m2') + eps), bounds)
+      beta{1,2}_pow *= beta{1,2}
+
+    (The reference ADDS the ratio term because its pushed grad already
+    points down-hill; our push passes raw dL/dw, so we subtract.)
+
+    State layout: [m1(D), m2(D), beta1_pow, beta2_pow] (K = 2D + 2) —
+    the CommonFeatureValue adam packing (optimizer.cuh.h:306-327).
+    """
+
+    learning_rate: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.999
+    min_bound: float = -10.0
+    max_bound: float = 10.0
+
+    @classmethod
+    def from_config(cls, cfg: TableConfig) -> "SparseAdam":
+        return cls(learning_rate=cfg.learning_rate, beta1=cfg.beta1,
+                   beta2=cfg.beta2, min_bound=cfg.min_bound,
+                   max_bound=cfg.max_bound)
+
+    def emb_state_width(self, dim: int) -> int:
+        return 2 * dim + 2
+
+    def w_state_width(self) -> int:
+        return 4
+
+    def _init(self, n: int, k: int) -> np.ndarray:
+        s = np.zeros((n, k), np.float32)
+        # beta pows start at beta (the reference writes the decay rates on
+        # state creation, optimizer.cuh.h:289-293).
+        s[:, -2] = self.beta1
+        s[:, -1] = self.beta2
+        return s
+
+    def init_emb_state(self, n: int, dim: int) -> np.ndarray:
+        return self._init(n, self.emb_state_width(dim))
+
+    def init_w_state(self, n: int) -> np.ndarray:
+        return self._init(n, 4)
+
+    def _apply(self, value, m1, m2, b1p, b2p, grad):
+        ratio = (self.learning_rate * jnp.sqrt(1.0 - b2p) / (1.0 - b1p))
+        new_m1 = self.beta1 * m1 + (1.0 - self.beta1) * grad
+        new_m2 = self.beta2 * m2 + (1.0 - self.beta2) * grad * grad
+        if value.ndim > 1:
+            ratio = ratio[:, None]
+        new_v = value - ratio * (new_m1 / (jnp.sqrt(new_m2) + _EPS))
+        return (jnp.clip(new_v, self.min_bound, self.max_bound),
+                new_m1, new_m2, b1p * self.beta1, b2p * self.beta2)
+
+    def update_vector(self, value, state, grad):
+        d = value.shape[-1]
+        m1, m2 = state[:, :d], state[:, d:2 * d]
+        b1p, b2p = state[:, 2 * d], state[:, 2 * d + 1]
+        new_v, m1, m2, b1p, b2p = self._apply(value, m1, m2, b1p, b2p, grad)
+        return new_v, jnp.concatenate(
+            [m1, m2, b1p[:, None], b2p[:, None]], axis=-1)
+
+    def update_scalar(self, value, state, grad):
+        m1, m2, b1p, b2p = (state[:, 0], state[:, 1], state[:, 2],
+                            state[:, 3])
+        new_v, m1, m2, b1p, b2p = self._apply(value, m1, m2, b1p, b2p, grad)
+        return new_v, jnp.stack([m1, m2, b1p, b2p], axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseAdamShared(SparseOptimizer):
+    """Shared-moment adam (reference optimizer.cuh.h:330-387): one scalar
+    (m1, m2) pair per row shared by all dims — each dim's update uses the
+    shared OLD moment with its own grad, and the stored moment becomes the
+    mean of the per-dim new moments. Quarter the optimizer-state HBM of
+    full adam at near-adam quality.
+
+    State layout: [m1, m2, beta1_pow, beta2_pow] (K=4).
+    """
+
+    learning_rate: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.999
+    min_bound: float = -10.0
+    max_bound: float = 10.0
+
+    @classmethod
+    def from_config(cls, cfg: TableConfig) -> "SparseAdamShared":
+        return cls(learning_rate=cfg.learning_rate, beta1=cfg.beta1,
+                   beta2=cfg.beta2, min_bound=cfg.min_bound,
+                   max_bound=cfg.max_bound)
+
+    def emb_state_width(self, dim: int) -> int:
+        return 4
+
+    def w_state_width(self) -> int:
+        return 4
+
+    def _init(self, n: int) -> np.ndarray:
+        s = np.zeros((n, 4), np.float32)
+        s[:, 2] = self.beta1
+        s[:, 3] = self.beta2
+        return s
+
+    def init_emb_state(self, n: int, dim: int) -> np.ndarray:
+        return self._init(n)
+
+    def init_w_state(self, n: int) -> np.ndarray:
+        return self._init(n)
+
+    def _apply(self, value, state, grad):
+        m1, m2, b1p, b2p = (state[:, 0], state[:, 1], state[:, 2],
+                            state[:, 3])
+        ratio = self.learning_rate * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+        if value.ndim > 1:
+            new_m1 = self.beta1 * m1[:, None] + (1.0 - self.beta1) * grad
+            new_m2 = (self.beta2 * m2[:, None]
+                      + (1.0 - self.beta2) * grad * grad)
+            new_v = value - ratio[:, None] * (
+                new_m1 / (jnp.sqrt(new_m2) + _EPS))
+            store_m1, store_m2 = (jnp.mean(new_m1, axis=-1),
+                                  jnp.mean(new_m2, axis=-1))
+        else:
+            new_m1 = self.beta1 * m1 + (1.0 - self.beta1) * grad
+            new_m2 = self.beta2 * m2 + (1.0 - self.beta2) * grad * grad
+            new_v = value - ratio * (new_m1 / (jnp.sqrt(new_m2) + _EPS))
+            store_m1, store_m2 = new_m1, new_m2
+        new_state = jnp.stack(
+            [store_m1, store_m2, b1p * self.beta1, b2p * self.beta2],
+            axis=-1)
+        return jnp.clip(new_v, self.min_bound, self.max_bound), new_state
+
+    def update_vector(self, value, state, grad):
+        return self._apply(value, state, grad)
+
+    def update_scalar(self, value, state, grad):
+        return self._apply(value, state, grad)
+
+
+_OPTIMIZERS = {
+    "adagrad": SparseAdagrad,
+    "adam": SparseAdam,
+    "adam_shared": SparseAdamShared,
+}
+
+
+def make_sparse_optimizer(cfg: TableConfig) -> SparseOptimizer:
+    """Factory by ``cfg.optimizer`` (role of HeterPs' optimizer_type
+    dispatch, heter_ps.cu:113-135)."""
+    try:
+        klass = _OPTIMIZERS[cfg.optimizer]
+    except KeyError:
+        raise ValueError(
+            f"unknown sparse optimizer {cfg.optimizer!r}; "
+            f"choose from {sorted(_OPTIMIZERS)}") from None
+    return klass.from_config(cfg)

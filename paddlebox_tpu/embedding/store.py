@@ -26,21 +26,25 @@ import numpy as np
 from paddlebox_tpu.core import log, monitor
 from paddlebox_tpu.embedding.table import TableConfig
 
-_FIELDS = ("emb", "emb_g2sum", "w", "w_g2sum", "show", "click")
+_FIELDS = ("emb", "emb_state", "w", "w_state", "show", "click")
 
 
 class FeatureStore:
     """Sorted-key columnar feature store with base+delta checkpointing."""
 
     def __init__(self, config: TableConfig, seed: int = 0):
+        from paddlebox_tpu.embedding.optimizers import make_sparse_optimizer
         self.config = config
+        self.opt = make_sparse_optimizer(config)
         d = config.dim
+        self._ke = self.opt.emb_state_width(d)
+        self._kw = self.opt.w_state_width()
         self._keys = np.empty((0,), np.uint64)
         self._vals: Dict[str, np.ndarray] = {
             "emb": np.empty((0, d), np.float32),
-            "emb_g2sum": np.empty((0,), np.float32),
+            "emb_state": np.empty((0, self._ke), np.float32),
             "w": np.empty((0,), np.float32),
-            "w_g2sum": np.empty((0,), np.float32),
+            "w_state": np.empty((0, self._kw), np.float32),
             "show": np.empty((0,), np.float32),
             "click": np.empty((0,), np.float32),
         }
@@ -81,9 +85,9 @@ class FeatureStore:
         d = self.config.dim
         out = {
             "emb": np.empty((n, d), np.float32),
-            "emb_g2sum": np.zeros((n,), np.float32),
+            "emb_state": self.opt.init_emb_state(n, d),
             "w": np.zeros((n,), np.float32),
-            "w_g2sum": np.zeros((n,), np.float32),
+            "w_state": self.opt.init_w_state(n),
             "show": np.zeros((n,), np.float32),
             "click": np.zeros((n,), np.float32),
         }

@@ -38,9 +38,13 @@ class TableConfig:
     num_shards: int = 1           # table shards == size of the shard mesh axis
     # Initialization (role of CtrCommonAccessor init ranges).
     init_scale: float = 0.01
-    # Sparse adagrad hyper-params (role of optimizer_conf.h bounds/decay).
+    # Sparse optimizer selection + hyper-params (role of optimizer_conf.h
+    # bounds/decay and HeterPs optimizer_type dispatch).
+    optimizer: str = "adagrad"    # adagrad | adam | adam_shared
     learning_rate: float = 0.05
     initial_g2sum: float = 3.0
+    beta1: float = 0.9
+    beta2: float = 0.999
     min_bound: float = -10.0
     max_bound: float = 10.0
     # Show/click decay applied at end-of-day shrink (role of ShrinkTable).
@@ -58,12 +62,15 @@ class PassTable:
     """Device-resident per-pass table (a pytree of sharded arrays).
 
     Shapes (S = num_shards, R = rows_per_shard real rows, +1 trash row):
-      emb       [S*(R+1), D]  mf embedding
-      emb_g2sum [S*(R+1)]     adagrad accumulator for emb
-      w         [S*(R+1)]     scalar LR weight (wide term)
-      w_g2sum   [S*(R+1)]
-      show      [S*(R+1)]     impression count
-      click     [S*(R+1)]     click count
+      emb       [S*(R+1), D]   mf embedding
+      emb_state [S*(R+1), Ke]  optimizer state for emb (layout per optimizer:
+                               adagrad [g2sum]; adam [m1,m2,b1pow,b2pow] —
+                               the CommonFeatureValue packing,
+                               feature_value.h:44 / optimizer.cuh.h:306)
+      w         [S*(R+1)]      scalar LR weight (wide term)
+      w_state   [S*(R+1), Kw]
+      show      [S*(R+1)]      impression count
+      click     [S*(R+1)]      click count
 
     Stored flat with shard s owning rows [s*(R+1), (s+1)*(R+1)); when used
     under shard_map the leading dim is sharded over the table axis so each
@@ -71,16 +78,16 @@ class PassTable:
     """
 
     emb: jax.Array
-    emb_g2sum: jax.Array
+    emb_state: jax.Array
     w: jax.Array
-    w_g2sum: jax.Array
+    w_state: jax.Array
     show: jax.Array
     click: jax.Array
     rows_per_shard: int            # real rows (excludes trash row)
     num_shards: int
 
     def tree_flatten(self):
-        leaves = (self.emb, self.emb_g2sum, self.w, self.w_g2sum,
+        leaves = (self.emb, self.emb_state, self.w, self.w_state,
                   self.show, self.click)
         return leaves, (self.rows_per_shard, self.num_shards)
 
@@ -110,7 +117,7 @@ def build_pass_table_host(values: Dict[str, np.ndarray], num_shards: int,
     """Assemble a PassTable from host arrays produced by the FeatureStore.
 
     ``values`` carries per-key arrays in sorted-key order: emb [N, D],
-    emb_g2sum [N], w [N], w_g2sum [N], show [N], click [N]. Rows are laid
+    emb_state [N, Ke], w [N], w_state [N, Kw], show [N], click [N]. Rows are laid
     out shard-contiguously with a zeroed trash row appended per shard
     (role of BuildGPUTask filling HBM mem-pool records,
     ps_gpu_wrapper.cc:684).
@@ -132,9 +139,11 @@ def build_pass_table_host(values: Dict[str, np.ndarray], num_shards: int,
 
     return PassTable(
         emb=jnp.asarray(lay(values["emb"], d)),
-        emb_g2sum=jnp.asarray(lay(values["emb_g2sum"], None)),
+        emb_state=jnp.asarray(lay(values["emb_state"],
+                                  values["emb_state"].shape[1])),
         w=jnp.asarray(lay(values["w"], None)),
-        w_g2sum=jnp.asarray(lay(values["w_g2sum"], None)),
+        w_state=jnp.asarray(lay(values["w_state"],
+                                values["w_state"].shape[1])),
         show=jnp.asarray(lay(values["show"], None)),
         click=jnp.asarray(lay(values["click"], None)),
         rows_per_shard=rps,
@@ -157,9 +166,9 @@ def extract_pass_values_host(table: PassTable, num_keys: int) -> Dict[str, np.nd
 
     return {
         "emb": unlay(table.emb),
-        "emb_g2sum": unlay(table.emb_g2sum),
+        "emb_state": unlay(table.emb_state),
         "w": unlay(table.w),
-        "w_g2sum": unlay(table.w_g2sum),
+        "w_state": unlay(table.w_state),
         "show": unlay(table.show),
         "click": unlay(table.click),
     }

@@ -44,12 +44,27 @@ _REL_ERR_BOUND = 0.05
 _MAX_SPAN = 0.01
 
 
+# The sequential sweep is O(nonzero buckets) in Python; above this size the
+# histogram is rebinned first (ctr resolution stays ~60x finer than the
+# 0.01 window span, so the result is unchanged to ~1e-4).
+_SWEEP_MAX_BUCKETS = 16384
+
+
 def bucket_error_sweep(table: np.ndarray) -> float:
     """Adaptive-span calibration error (calculate_bucket_error,
     metrics.cc:357-391): grow a bucket window until the binomial relative
     error of its adjusted ctr is small enough, then score
     |actual/adjusted - 1| weighted by impressions. table is [2, nb]."""
     neg, pos = np.asarray(table[0], np.float64), np.asarray(table[1], np.float64)
+    if neg.shape[0] > _SWEEP_MAX_BUCKETS:
+        nb0 = neg.shape[0]
+        factor = -(-nb0 // _SWEEP_MAX_BUCKETS)
+        pad = (-nb0) % factor
+        if pad:
+            neg = np.concatenate([neg, np.zeros(pad)])
+            pos = np.concatenate([pos, np.zeros(pad)])
+        neg = neg.reshape(-1, factor).sum(axis=1)
+        pos = pos.reshape(-1, factor).sum(axis=1)
     last_ctr = -1.0
     impression_sum = ctr_sum = click_sum = 0.0
     error_sum = error_count = 0.0
@@ -234,7 +249,9 @@ class MetricMsg:
     cmatch_rank_group: Tuple[Tuple[int, int], ...] = ()
     ignore_rank: bool = True
 
-    def matches(self, cmatch: np.ndarray, rank: np.ndarray) -> np.ndarray:
+    def matches(self, cmatch: np.ndarray, rank: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        """(keep mask, matched group index per record)."""
         keep = np.zeros(cmatch.shape[0], bool)
         idx = np.full(cmatch.shape[0], -1, np.int64)
         for j, (cm, rk) in enumerate(self.cmatch_rank_group):
@@ -339,9 +356,17 @@ class MetricRegistry:
             raise ValueError(f"unknown metric kind {msg.kind!r}")
 
     def get_metric(self, name: str, reduce_fn: Optional[ReduceFn] = None,
-                   reset: bool = True) -> Dict[str, object]:
+                   reset: bool = True,
+                   gather_fn: Optional[Callable[[np.ndarray], np.ndarray]]
+                   = None) -> Dict[str, object]:
         """Compute (with optional cross-rank allreduce) and reset — the
-        GetMetricMsg/print path (metrics.cc:286-355)."""
+        GetMetricMsg/print path (metrics.cc:286-355).
+
+        For the wuauc kind the per-user grouping needs the raw records, not
+        a histogram, so distributed wuauc takes ``gather_fn`` (concat an
+        array across ranks — WuAuc's allgather path in the reference). With
+        only ``reduce_fn`` the histogram stats are global but the per-user
+        keys are reported as ``wuauc_local``."""
         msg = self._metrics[name]
         cal = msg.calculator
         out = cal.compute(reduce_fn)
@@ -352,7 +377,15 @@ class MetricRegistry:
                 uids = np.concatenate([c[0] for c in chunks])
                 preds = np.concatenate([c[1] for c in chunks])
                 labels = np.concatenate([c[2] for c in chunks])
-                out.update(wuauc_compute(uids, preds, labels))
+                if gather_fn is not None:
+                    uids = gather_fn(uids)
+                    preds = gather_fn(preds)
+                    labels = gather_fn(labels)
+                w = wuauc_compute(uids, preds, labels)
+                if gather_fn is None and reduce_fn is not None:
+                    w = {f"{k}_local" if not k.endswith("_local") else k: v
+                         for k, v in w.items()}
+                out.update(w)
         if reset:
             cal.reset()
         return out

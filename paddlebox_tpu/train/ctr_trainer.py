@@ -29,7 +29,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from paddlebox_tpu.core import flags, log, timers
 from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
-from paddlebox_tpu.embedding import PassEngine, SparseAdagrad, TableConfig
+from paddlebox_tpu.embedding import (PassEngine, TableConfig,
+                                     make_sparse_optimizer)
 from paddlebox_tpu.embedding.lookup import pull_local, push_local
 from paddlebox_tpu.metrics import (AucState, auc_accumulate, auc_compute,
                                    auc_state_init)
@@ -71,7 +72,7 @@ class CTRTrainer:
                 f"batch_size {feed_config.batch_size} must be divisible by "
                 f"the {axis} axis size {self.ndev}")
         self.engine = PassEngine(table_config, mesh=mesh, table_axis=axis)
-        self.sparse_opt = SparseAdagrad.from_config(table_config)
+        self.sparse_opt = make_sparse_optimizer(table_config)
         self.params: Any = None
         self.opt_state: Any = None
         self.auc_state: Optional[AucState] = None

@@ -26,9 +26,9 @@ def _host_values(n, dim, seed=0):
     rng = np.random.default_rng(seed)
     return {
         "emb": rng.normal(size=(n, dim)).astype(np.float32),
-        "emb_g2sum": np.zeros((n,), np.float32),
+        "emb_state": np.zeros((n, 1), np.float32),
         "w": rng.normal(size=(n,)).astype(np.float32),
-        "w_g2sum": np.zeros((n,), np.float32),
+        "w_state": np.zeros((n, 1), np.float32),
         "show": np.zeros((n,), np.float32),
         "click": np.zeros((n,), np.float32),
     }
@@ -130,8 +130,8 @@ def test_push_exact_dedup_update(devices8, nshards):
     back = extract_pass_values_host(new_table, n_keys)
 
     # numpy reference: merge grads per key, single update per key.
-    ref_emb, ref_g2 = vals["emb"].copy(), vals["emb_g2sum"].copy()
-    ref_w_, ref_wg2 = vals["w"].copy(), vals["w_g2sum"].copy()
+    ref_emb, ref_g2 = vals["emb"].copy(), vals["emb_state"].copy()
+    ref_w_, ref_wg2 = vals["w"].copy(), vals["w_state"].copy()
     ref_show, ref_click = vals["show"].copy(), vals["click"].copy()
     for ki, key in enumerate(keys):
         m = batch_keys == key
@@ -139,15 +139,15 @@ def test_push_exact_dedup_update(devices8, nshards):
             continue
         ge = g_emb[m].sum(axis=0)
         gw = g_w[m].sum()
-        ref_emb[ki:ki+1], ref_g2[ki:ki+1] = _adagrad_ref(
-            ref_emb[ki:ki+1], ref_g2[ki:ki+1], ge[None])
-        ref_w_[ki:ki+1], ref_wg2[ki:ki+1] = _adagrad_ref(
-            ref_w_[ki:ki+1], ref_wg2[ki:ki+1], np.array([gw]), scalar=True)
+        ref_emb[ki:ki+1], ref_g2[ki:ki+1, 0] = _adagrad_ref(
+            ref_emb[ki:ki+1], ref_g2[ki:ki+1, 0], ge[None])
+        ref_w_[ki:ki+1], ref_wg2[ki:ki+1, 0] = _adagrad_ref(
+            ref_w_[ki:ki+1], ref_wg2[ki:ki+1, 0], np.array([gw]), scalar=True)
         ref_show[ki] += shows[m].sum()
         ref_click[ki] += clicks[m].sum()
 
     np.testing.assert_allclose(back["emb"], ref_emb, rtol=1e-4, atol=1e-5)
-    np.testing.assert_allclose(back["emb_g2sum"], ref_g2, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(back["emb_state"], ref_g2, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(back["w"], ref_w_, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(back["show"], ref_show, rtol=1e-5)
     np.testing.assert_allclose(back["click"], ref_click, rtol=1e-5)
