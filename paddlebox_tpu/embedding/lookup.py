@@ -128,6 +128,15 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
     """
     if opt is None:
         opt = SparseAdagrad()
+    ke = opt.emb_state_width(table.dim)
+    kw = opt.w_state_width()
+    if table.emb_state.shape[-1] != ke or table.w_state.shape[-1] != kw:
+        raise ValueError(
+            f"optimizer {type(opt).__name__} expects state widths "
+            f"({ke}, {kw}) but table carries "
+            f"({table.emb_state.shape[-1]}, {table.w_state.shape[-1]}) — "
+            f"push opt must match the TableConfig.optimizer the table was "
+            f"built with")
     num_shards = table.num_shards
     block = table.rows_per_shard + 1
     n = dev_rows.shape[0]

@@ -32,6 +32,7 @@ class _PendingPass:
     def __init__(self):
         self.keys: Optional[np.ndarray] = None
         self.table: Optional[PassTable] = None
+        self.keymap = None
         self.thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
 
@@ -51,6 +52,7 @@ class PassEngine:
 
         self._current_keys: Optional[np.ndarray] = None
         self._table: Optional[PassTable] = None
+        self._keymap = None
         self._pending: Optional[_PendingPass] = None
         self._pass_id = -1
         # Sequencing for async builds: the store pull must happen AFTER the
@@ -65,9 +67,11 @@ class PassEngine:
     def _build(self, pass_keys: np.ndarray, pending: _PendingPass) -> None:
         try:
             with self.timers.scope("feed_pass"):
-                # Key dedup can overlap the active pass...
-                keys = np.unique(np.asarray(pass_keys, np.uint64))
-                keys = keys[keys != 0]  # 0 is the null feasign
+                # Key dedup can overlap the active pass... (native
+                # multi-threaded dedup, role of PreBuildTask,
+                # ps_gpu_wrapper.cc:114; numpy fallback inside)
+                from paddlebox_tpu.native.keymap_py import KeyMap, dedup_keys
+                keys = dedup_keys(np.asarray(pass_keys, np.uint64))
                 # ...but the value pull must wait for its end_pass.
                 self._no_active_pass.wait()
                 vals = self.store.pull_for_pass(keys)
@@ -79,6 +83,8 @@ class PassEngine:
                         lambda x: jax.device_put(x, sharding), table)
                 pending.keys = keys
                 pending.table = table
+                pending.keymap = KeyMap(keys, table.rows_per_shard,
+                                        self.num_shards)
                 monitor.add("pass/built", 1)
         except BaseException as e:  # propagate to the waiting begin_pass
             pending.error = e
@@ -120,6 +126,7 @@ class PassEngine:
             raise RuntimeError("begin_pass without a successful feed_pass")
         self._current_keys = self._pending.keys
         self._table = self._pending.table
+        self._keymap = self._pending.keymap
         self._pending = None
         self._pass_id += 1
         self._no_active_pass.clear()
@@ -138,9 +145,12 @@ class PassEngine:
         self._table = table
 
     def lookup_rows(self, batch_keys: np.ndarray) -> np.ndarray:
-        """Host map: batch feasigns → device row ids for the active pass."""
+        """Host map: batch feasigns → device row ids for the active pass
+        (native hash lookup, role of CopyKeys' host side; numpy fallback)."""
         if self._current_keys is None or self._table is None:
             raise RuntimeError("no active pass")
+        if self._keymap is not None:
+            return self._keymap.lookup(batch_keys)
         return map_keys_to_rows(self._current_keys, batch_keys,
                                 self._table.rows_per_shard, self.num_shards)
 
@@ -154,5 +164,8 @@ class PassEngine:
             self.store.push_from_pass(self._current_keys, vals)
         self._table = None
         self._current_keys = None
+        if self._keymap is not None:
+            self._keymap.close()
+            self._keymap = None
         self._no_active_pass.set()
         monitor.add("pass/ended", 1)

@@ -111,6 +111,7 @@ class FeatureStore:
         k = np.ascontiguousarray(pass_keys_sorted, np.uint64)
         if k.shape[0] == 0:
             return
+        self._check_state_widths(values)
         with self._lock:
             found, pos_c = self._locate(k)
             # Update existing rows in place.
@@ -189,11 +190,24 @@ class FeatureStore:
         self._save_arrays(path, dirty, vals, "delta")
         log.vlog(0, "save_delta: %d features -> %s", dirty.shape[0], path)
 
+    def _check_state_widths(self, vals: Dict[str, np.ndarray]) -> None:
+        """Optimizer-state widths must match the configured optimizer — a
+        silent numpy broadcast here would smear e.g. an adagrad g2sum into
+        adam's beta-pow slots and train on garbage."""
+        for f, want in (("emb_state", self._ke), ("w_state", self._kw)):
+            got = vals[f].shape[-1] if vals[f].ndim > 1 else 1
+            if got != want:
+                raise ValueError(
+                    f"{f} width {got} != {want} expected by optimizer "
+                    f"{self.config.optimizer!r} — checkpoint/table was "
+                    f"written with a different sparse optimizer")
+
     def load(self, path: str, kind: str = "base") -> None:
         """Load a base snapshot, or apply a delta on top."""
         data = np.load(os.path.join(path, f"{self.config.name}.{kind}.npz"))
         keys = data["keys"].astype(np.uint64)
         vals = {f: data[f] for f in _FIELDS}
+        self._check_state_widths(vals)
         if kind == "base":
             with self._lock:
                 self._keys = keys

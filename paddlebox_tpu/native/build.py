@@ -12,7 +12,7 @@ from typing import Optional
 from paddlebox_tpu.core import log
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["parser.cc"]
+_SOURCES = ["parser.cc", "keymap.cc"]
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _failed = False
@@ -84,6 +84,29 @@ def load_library() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.POINTER(ctypes.c_float))]
         lib.pbx_result_free.restype = None
         lib.pbx_result_free.argtypes = [ctypes.c_void_p]
+        # keymap.cc
+        lib.pbx_keymap_build.restype = ctypes.c_void_p
+        lib.pbx_keymap_build.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
+        lib.pbx_keymap_size.restype = ctypes.c_int64
+        lib.pbx_keymap_size.argtypes = [ctypes.c_void_p]
+        lib.pbx_keymap_lookup.restype = None
+        lib.pbx_keymap_lookup.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.pbx_keymap_free.restype = None
+        lib.pbx_keymap_free.argtypes = [ctypes.c_void_p]
+        lib.pbx_dedup_u64.restype = ctypes.c_void_p
+        lib.pbx_dedup_u64.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
+        lib.pbx_dedup_size.restype = ctypes.c_int64
+        lib.pbx_dedup_size.argtypes = [ctypes.c_void_p]
+        lib.pbx_dedup_fill.restype = None
+        lib.pbx_dedup_fill.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_uint64)]
+        lib.pbx_dedup_free.restype = None
+        lib.pbx_dedup_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

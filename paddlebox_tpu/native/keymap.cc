@@ -1,0 +1,278 @@
+// Native host key-map: pass-key dedup + feasign -> device-row lookup.
+//
+// Role of the CPU-side hot path of the reference's pass build and batch
+// feed: PreBuildTask's multi-thread key dedup into shard buckets
+// (ps_gpu_wrapper.cc:114) and the per-batch key->row flattening feeding
+// CopyKeys (box_wrapper.cu). SURVEY.md §7 ranks "per-pass index build
+// throughput on host" as hard part #1 — numpy's unique/searchsorted are
+// single-threaded O(n log n); this is a sharded open-addressing hash map
+// with counting-scatter parallel build and parallel batch lookup.
+//
+// Exposed via a C ABI consumed by ctypes (native/keymap_py.py).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// splitmix64 finalizer: well-mixed 64-bit hash, injective.
+static inline uint64_t mix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+static inline int num_threads_for(int64_t n) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  int t = static_cast<int>(std::min<int64_t>(hw, (n + (1 << 16) - 1) >> 16));
+  return t < 1 ? 1 : t;
+}
+
+template <typename Fn>
+static void parallel_chunks(int64_t n, int nt, Fn fn) {
+  std::vector<std::thread> ths;
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+    ths.emplace_back([fn, t, lo, hi]() { fn(t, lo, hi); });
+  }
+  for (auto& th : ths) th.join();
+}
+
+// One open-addressing sub-table (linear probing). Keys are nonzero
+// (0 = null feasign, handled explicitly); empty slot sentinel key = 0.
+struct SubMap {
+  std::vector<uint64_t> keys;
+  std::vector<int64_t> vals;
+  uint64_t mask = 0;
+
+  void init(size_t n) {
+    size_t cap = 16;
+    while (cap < n * 2) cap <<= 1;  // load factor <= 0.5
+    keys.assign(cap, 0);
+    vals.assign(cap, -1);
+    mask = cap - 1;
+  }
+
+  inline void insert(uint64_t k, int64_t v) {
+    uint64_t i = mix64(k) & mask;
+    while (keys[i] != 0) i = (i + 1) & mask;
+    keys[i] = k;
+    vals[i] = v;
+  }
+
+  // Insert if absent; returns true when newly inserted.
+  inline bool insert_unique(uint64_t k) {
+    uint64_t i = mix64(k) & mask;
+    while (true) {
+      if (keys[i] == k) return false;
+      if (keys[i] == 0) {
+        keys[i] = k;
+        return true;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+
+  inline int64_t find(uint64_t k) const {
+    uint64_t i = mix64(k) & mask;
+    while (true) {
+      if (keys[i] == k) return vals[i];
+      if (keys[i] == 0) return -1;
+      i = (i + 1) & mask;
+    }
+  }
+};
+
+constexpr int kShardBits = 6;  // 64 sub-maps
+constexpr int kShards = 1 << kShardBits;
+
+static inline int shard_of(uint64_t k) {
+  return static_cast<int>(mix64(k) >> (64 - kShardBits));
+}
+
+struct KeyMap {
+  SubMap shards[kShards];
+  int64_t n = 0;
+};
+
+// Counting scatter: partition values of in[0..n) into per-shard contiguous
+// regions of out (+ optional payload), using shard_fn. Returns per-shard
+// (start, size). Two parallel passes: count, then scatter into disjoint
+// per-(thread, shard) windows — no locks, no atomics on the hot path.
+template <typename ShardFn>
+static std::vector<std::pair<int64_t, int64_t>> counting_scatter(
+    const uint64_t* in, int64_t n, int nshards, ShardFn shard_fn, int nt,
+    std::vector<uint64_t>* out, std::vector<int64_t>* payload_out) {
+  std::vector<std::vector<int64_t>> counts(
+      nt, std::vector<int64_t>(nshards, 0));
+  parallel_chunks(n, nt, [&](int t, int64_t lo, int64_t hi) {
+    auto& c = counts[t];
+    for (int64_t i = lo; i < hi; ++i) {
+      int s = shard_fn(in[i]);
+      if (s >= 0) ++c[s];
+    }
+  });
+  // offsets[t][s] = write cursor for thread t within shard s's region.
+  std::vector<std::pair<int64_t, int64_t>> regions(nshards);
+  std::vector<std::vector<int64_t>> offsets(
+      nt, std::vector<int64_t>(nshards, 0));
+  int64_t pos = 0;
+  for (int s = 0; s < nshards; ++s) {
+    regions[s].first = pos;
+    for (int t = 0; t < nt; ++t) {
+      offsets[t][s] = pos;
+      pos += counts[t][s];
+    }
+    regions[s].second = pos - regions[s].first;
+  }
+  out->resize(pos);
+  if (payload_out) payload_out->resize(pos);
+  parallel_chunks(n, nt, [&](int t, int64_t lo, int64_t hi) {
+    auto& off = offsets[t];
+    for (int64_t i = lo; i < hi; ++i) {
+      int s = shard_fn(in[i]);
+      if (s < 0) continue;
+      int64_t w = off[s]++;
+      (*out)[w] = in[i];
+      if (payload_out) (*payload_out)[w] = i;
+    }
+  });
+  return regions;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Build a key -> rank map from the pass's SORTED unique key array (rank =
+// position in that array, the global row id before shard-block layout).
+void* pbx_keymap_build(const uint64_t* sorted_keys, int64_t n) {
+  KeyMap* m = new KeyMap();
+  m->n = n;
+  int nt = num_threads_for(n);
+  std::vector<uint64_t> scat_keys;
+  std::vector<int64_t> scat_rank;
+  auto regions = counting_scatter(
+      sorted_keys, n, kShards, [](uint64_t k) { return shard_of(k); }, nt,
+      &scat_keys, &scat_rank);
+  // Build sub-maps in parallel, each from its contiguous region.
+  std::atomic<int> next{0};
+  std::vector<std::thread> ths;
+  for (int t = 0; t < nt; ++t) {
+    ths.emplace_back([&]() {
+      int s;
+      while ((s = next.fetch_add(1)) < kShards) {
+        auto [lo, sz] = regions[s];
+        m->shards[s].init(static_cast<size_t>(sz) + 1);
+        for (int64_t i = lo; i < lo + sz; ++i)
+          m->shards[s].insert(scat_keys[i], scat_rank[i]);
+      }
+    });
+  }
+  for (auto& th : ths) th.join();
+  return m;
+}
+
+int64_t pbx_keymap_size(void* h) { return static_cast<KeyMap*>(h)->n; }
+
+// Batch lookup: keys[m] -> device rows in the shard-contiguous layout
+// (table.py map_keys_to_rows contract): found -> shard*(rps+1) + row;
+// missing or 0 -> round-robin trash row (position % num_shards).
+void pbx_keymap_lookup(void* h, const uint64_t* batch, int64_t m,
+                       int32_t rows_per_shard, int32_t num_shards,
+                       int32_t* out_rows) {
+  KeyMap* km = static_cast<KeyMap*>(h);
+  int64_t block = rows_per_shard + 1;
+  parallel_chunks(m, num_threads_for(m), [&](int, int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      uint64_t k = batch[i];
+      int64_t g = (k == 0) ? -1 : km->shards[shard_of(k)].find(k);
+      if (g < 0) {
+        int64_t pad_shard = i % num_shards;
+        out_rows[i] =
+            static_cast<int32_t>(pad_shard * block + rows_per_shard);
+      } else {
+        int64_t shard = g / rows_per_shard;
+        int64_t row = g % rows_per_shard;
+        out_rows[i] = static_cast<int32_t>(shard * block + row);
+      }
+    }
+  });
+}
+
+void pbx_keymap_free(void* h) { delete static_cast<KeyMap*>(h); }
+
+// ---------------------------------------------------------------------------
+// Dedup: unsorted (possibly huge, duplicate-heavy) pass keys -> sorted
+// unique array (np.unique replacement for feed_pass). Range-sharded by top
+// key byte so per-shard sorted outputs concatenate globally sorted; each
+// shard dedups with a local hash set before sorting only its unique keys.
+// ---------------------------------------------------------------------------
+
+namespace {
+struct DedupResult {
+  std::vector<std::vector<uint64_t>> parts;
+  int64_t total = 0;
+};
+constexpr int kRangeShards = 256;
+}  // namespace
+
+void* pbx_dedup_u64(const uint64_t* keys, int64_t n) {
+  DedupResult* r = new DedupResult();
+  r->parts.resize(kRangeShards);
+  int nt = num_threads_for(n);
+  std::vector<uint64_t> scat;
+  auto regions = counting_scatter(
+      keys, n, kRangeShards,
+      [](uint64_t k) { return k == 0 ? -1 : static_cast<int>(k >> 56); },
+      nt, &scat, nullptr);
+  std::atomic<int> next{0};
+  std::atomic<int64_t> total{0};
+  std::vector<std::thread> ths;
+  for (int t = 0; t < nt; ++t) {
+    ths.emplace_back([&]() {
+      int s;
+      while ((s = next.fetch_add(1)) < kRangeShards) {
+        auto [lo, sz] = regions[s];
+        if (sz == 0) continue;
+        SubMap set;
+        set.init(static_cast<size_t>(sz) + 1);
+        std::vector<uint64_t> uniq;
+        uniq.reserve(sz);
+        for (int64_t i = lo; i < lo + sz; ++i) {
+          if (set.insert_unique(scat[i])) uniq.push_back(scat[i]);
+        }
+        std::sort(uniq.begin(), uniq.end());
+        total.fetch_add(static_cast<int64_t>(uniq.size()));
+        r->parts[s] = std::move(uniq);
+      }
+    });
+  }
+  for (auto& th : ths) th.join();
+  r->total = total.load();
+  return r;
+}
+
+int64_t pbx_dedup_size(void* h) { return static_cast<DedupResult*>(h)->total; }
+
+void pbx_dedup_fill(void* h, uint64_t* out) {
+  DedupResult* r = static_cast<DedupResult*>(h);
+  int64_t off = 0;
+  for (auto& p : r->parts) {
+    if (!p.empty()) {
+      std::memcpy(out + off, p.data(), p.size() * sizeof(uint64_t));
+      off += static_cast<int64_t>(p.size());
+    }
+  }
+}
+
+void pbx_dedup_free(void* h) { delete static_cast<DedupResult*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,90 @@
+"""ctypes wrapper for the native key-map + dedup (native/keymap.cc).
+
+``KeyMap`` is the per-pass feasign → device-row index (role of the
+PreBuildTask shard tables + CopyKeys host map); ``dedup_keys`` replaces
+``np.unique`` for pass-key registration. Both fall back to numpy when the
+native library is unavailable, preserving exact semantics
+(``table.map_keys_to_rows``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.embedding.table import map_keys_to_rows
+from paddlebox_tpu.native.build import load_library
+
+
+def dedup_keys(keys: np.ndarray) -> np.ndarray:
+    """Sorted unique nonzero keys (np.unique + drop-0 equivalent).
+
+    The native path wins by parallelism (hash-shard dedup across cores);
+    on boxes with few cores numpy's single-threaded sort is faster, so
+    fall back there.
+    """
+    keys = np.ascontiguousarray(keys, np.uint64)
+    lib = load_library()
+    if lib is None or keys.size == 0 or (os.cpu_count() or 1) < 4:
+        u = np.unique(keys)
+        return u[u != 0]
+    h = lib.pbx_dedup_u64(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), keys.size)
+    try:
+        n = lib.pbx_dedup_size(h)
+        out = np.empty((n,), np.uint64)
+        if n:
+            lib.pbx_dedup_fill(
+                h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        monitor.add("native/dedup_keys", int(keys.size))
+        return out
+    finally:
+        lib.pbx_dedup_free(h)
+
+
+class KeyMap:
+    """Hash map from the pass's sorted unique keys to their rank, serving
+    batch key→device-row lookups (shard-contiguous layout with round-robin
+    trash sentinels — exact ``map_keys_to_rows`` semantics)."""
+
+    def __init__(self, sorted_keys: np.ndarray, rows_per_shard: int,
+                 num_shards: int = 1):
+        self._keys = np.ascontiguousarray(sorted_keys, np.uint64)
+        self.rows_per_shard = int(rows_per_shard)
+        self.num_shards = int(num_shards)
+        self._lib = load_library()
+        self._handle: Optional[int] = None
+        if self._lib is not None and self._keys.size:
+            self._handle = self._lib.pbx_keymap_build(
+                self._keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                self._keys.size)
+
+    def lookup(self, batch_keys: np.ndarray) -> np.ndarray:
+        """batch feasigns [m] → device rows [m] int32."""
+        batch = np.ascontiguousarray(batch_keys, np.uint64)
+        if self._handle is None:
+            return map_keys_to_rows(self._keys, batch, self.rows_per_shard,
+                                    self.num_shards)
+        out = np.empty((batch.size,), np.int32)
+        if batch.size:
+            self._lib.pbx_keymap_lookup(
+                self._handle,
+                batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                batch.size, self.rows_per_shard, self.num_shards,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+
+    def close(self) -> None:
+        if self._handle is not None and self._lib is not None:
+            self._lib.pbx_keymap_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
