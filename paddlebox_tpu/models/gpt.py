@@ -45,6 +45,10 @@ class GPTConfig:
     d_ff: int = 2048
     max_seq_len: int = 1024
     dtype: Any = jnp.float32
+    # "auto": Pallas flash attention on TPU when the sequence is not
+    # sharded (sp axis size 1), ring attention otherwise; "ring"/"flash"
+    # force a path (role of the reference's fused_attention_op.cu choice).
+    attention: str = "auto"
 
 
 def _layer_init(rng, cfg: GPTConfig):
@@ -135,7 +139,23 @@ def _block(p, x, cfg: GPTConfig, heads_local: int):
     qkv = jnp.dot(h, p["wqkv"], preferred_element_type=jnp.float32)
     qkv = qkv.reshape(b, s, heads_local, 3, hd)
     q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
-    attn = splib.ring_attention(q, k, v, axis="sp", causal=True)
+    sp_n = lax.axis_size("sp")
+    if cfg.attention not in ("auto", "ring", "flash"):
+        raise ValueError(f"unknown attention mode {cfg.attention!r}; "
+                         "choose from 'auto', 'ring', 'flash'")
+    if cfg.attention == "flash" and sp_n > 1:
+        # The flash kernel sees only the local K/V shard; with a sharded
+        # sequence only ring attention is exact.
+        raise ValueError("attention='flash' requires sp axis size 1; "
+                         "use 'ring' or 'auto' with a sharded sequence")
+    use_flash = cfg.attention == "flash" or (
+        cfg.attention == "auto" and sp_n == 1
+        and jax.default_backend() == "tpu")
+    if use_flash:
+        from paddlebox_tpu.ops.pallas_kernels import flash_attention
+        attn = flash_attention(q, k, v, causal=True)
+    else:
+        attn = splib.ring_attention(q, k, v, axis="sp", causal=True)
     attn = attn.reshape(b, s, heads_local * hd)
     o = jnp.dot(attn, p["wo"], preferred_element_type=jnp.float32)
     o = lax.psum(o, "mp")                       # row-parallel combine

@@ -35,7 +35,7 @@ def _build() -> Optional[str]:
     so_path = os.path.join(_cache_dir(), f"libpbx_native_{h.hexdigest()[:16]}.so")
     if os.path.exists(so_path):
         return so_path
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
            "-o", so_path + ".tmp"] + srcs
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)

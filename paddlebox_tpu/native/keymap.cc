@@ -36,6 +36,10 @@ static inline int num_threads_for(int64_t n) {
 
 template <typename Fn>
 static void parallel_chunks(int64_t n, int nt, Fn fn) {
+  if (nt <= 1) {
+    fn(0, 0, n);
+    return;
+  }
   std::vector<std::thread> ths;
   int64_t chunk = (n + nt - 1) / nt;
   for (int t = 0; t < nt; ++t) {

@@ -1,0 +1,29 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+Role of the reference's hand-written CUDA kernels (SURVEY.md §2.2): where
+Paddle drops to .cu files for ops XLA-era compilers couldn't fuse
+(``operators/fused/fused_attention_op.cu``, ``fused_seqpool_cvm_op.cu``,
+``fused_multi_transformer_op.cu``), this package drops to Pallas — the
+TPU kernel language — for the same reason: control over VMEM tiling,
+on-chip accumulators, and MXU scheduling on the few ops where generic XLA
+lowering leaves performance on the table.
+
+Every kernel has an XLA reference implementation used (a) as the
+correctness oracle in tests and (b) as the automatic fallback on
+non-TPU backends (kernels run under ``interpret=True`` only when
+explicitly requested — the interpreter is for testing, not production).
+"""
+
+from paddlebox_tpu.ops.pallas_kernels.flash_attention import (
+    flash_attention,
+    flash_attention_reference,
+)
+from paddlebox_tpu.ops.pallas_kernels.seqpool_cvm import (
+    seqpool_cvm_pallas,
+)
+
+__all__ = [
+    "flash_attention",
+    "flash_attention_reference",
+    "seqpool_cvm_pallas",
+]
