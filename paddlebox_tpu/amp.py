@@ -50,29 +50,34 @@ def bf16_policy() -> Policy:
 @dataclasses.dataclass
 class LossScaleState:
     """Dynamic loss-scale state (role of update_loss_scaling_op):
-    scale grows 2x after ``growth_interval`` consecutive finite steps,
-    halves on any non-finite grad, which also skips the update."""
+    scale grows 2x after ``growth_interval`` consecutive finite steps
+    (incr_every_n_steps) and backs off after ``backoff_interval``
+    consecutive non-finite steps (decr_every_n_nan_or_inf); a non-finite
+    step always skips the param update regardless."""
 
     scale: jax.Array
     growth_tracker: jax.Array
+    nonfinite_tracker: jax.Array
     growth_interval: int = 2000
     growth_factor: float = 2.0
     backoff_factor: float = 0.5
+    backoff_interval: int = 1
     max_scale: float = 2.0 ** 24
 
     def tree_flatten(self):
-        return ((self.scale, self.growth_tracker),
+        return ((self.scale, self.growth_tracker, self.nonfinite_tracker),
                 (self.growth_interval, self.growth_factor,
-                 self.backoff_factor, self.max_scale))
+                 self.backoff_factor, self.backoff_interval, self.max_scale))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(leaves[0], leaves[1], *aux)
+        return cls(leaves[0], leaves[1], leaves[2], *aux)
 
 
 def loss_scale_init(initial: float = 2.0 ** 15, **kw) -> LossScaleState:
     return LossScaleState(scale=jnp.float32(initial),
-                          growth_tracker=jnp.int32(0), **kw)
+                          growth_tracker=jnp.int32(0),
+                          nonfinite_tracker=jnp.int32(0), **kw)
 
 
 def scale_loss(state: LossScaleState, loss: jax.Array) -> jax.Array:
@@ -90,18 +95,23 @@ def unscale_and_check(state: LossScaleState, grads: Any
     for g in jax.tree.leaves(grads):
         finite &= jnp.isfinite(g).all()
     new_tracker = jnp.where(finite, state.growth_tracker + 1, 0)
+    new_nf = jnp.where(finite, 0, state.nonfinite_tracker + 1)
     grow = new_tracker >= state.growth_interval
+    backoff = new_nf >= state.backoff_interval
     new_scale = jnp.where(
         finite,
         jnp.where(grow, jnp.minimum(state.scale * state.growth_factor,
                                     state.max_scale), state.scale),
-        state.scale * state.backoff_factor)
+        jnp.where(backoff, state.scale * state.backoff_factor, state.scale))
     new_tracker = jnp.where(grow, 0, new_tracker)
+    new_nf = jnp.where(backoff, 0, new_nf)
     return grads, finite, LossScaleState(
         scale=new_scale, growth_tracker=new_tracker,
+        nonfinite_tracker=new_nf,
         growth_interval=state.growth_interval,
         growth_factor=state.growth_factor,
-        backoff_factor=state.backoff_factor, max_scale=state.max_scale)
+        backoff_factor=state.backoff_factor,
+        backoff_interval=state.backoff_interval, max_scale=state.max_scale)
 
 
 def masked_update(finite: jax.Array, new_tree: Any, old_tree: Any) -> Any:

@@ -1,0 +1,235 @@
+"""fleet — the distributed-training facade.
+
+Role of the reference fleet API (``python/paddle/distributed/fleet/base/
+fleet_base.py``): ``fleet.init`` (:211) wires the role maker + hybrid
+topology, ``fleet.distributed_optimizer`` (:912) applies the
+DistributedStrategy's meta-optimizers, ``fleet.distributed_model`` wraps the
+model for the chosen parallelism, and worker-introspection helpers
+(``worker_index/worker_num/is_first_worker/barrier_worker``).
+
+TPU-first: ``init`` builds ONE ``jax.sharding.Mesh`` from the strategy's
+hybrid degrees (collectives come from pjit/shard_map over its axes, not
+from per-group NCCL communicators); ``distributed_optimizer`` resolves the
+strategy into an optax chain + AMP policy/scaler; ``distributed_model``
+applies rematerialization (recompute). Multi-host wiring is
+``jax.distributed.initialize`` driven by the launch CLI's env.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+
+from paddlebox_tpu import amp as amp_lib
+from paddlebox_tpu import optimizers as opt_lib
+from paddlebox_tpu.core import log
+from paddlebox_tpu.fleet.strategy import DistributedStrategy
+from paddlebox_tpu.parallel import topology as topo_lib
+from paddlebox_tpu.fleet import metrics  # noqa: F401  (fleet.metrics.*)
+
+
+class RoleMaker:
+    """Process identity (role of PaddleCloudRoleMaker): rank/world from the
+    JAX runtime, overridable by env for tests (PBT_TRAINER_ID/PBT_TRAINERS
+    mirror PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM)."""
+
+    def __init__(self, rank: Optional[int] = None,
+                 world: Optional[int] = None):
+        self.rank = (rank if rank is not None
+                     else int(os.environ.get("PBT_TRAINER_ID",
+                                             jax.process_index())))
+        self.world = (world if world is not None
+                      else int(os.environ.get("PBT_TRAINERS",
+                                              jax.process_count())))
+
+
+@dataclasses.dataclass
+class _FleetState:
+    initialized: bool = False
+    role: Optional[RoleMaker] = None
+    strategy: Optional[DistributedStrategy] = None
+    topology: Optional[topo_lib.HybridTopology] = None
+    mesh: Optional[jax.sharding.Mesh] = None
+
+
+_STATE = _FleetState()
+
+
+def init(role_maker: Optional[RoleMaker] = None, *,
+         is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None,
+         devices=None) -> jax.sharding.Mesh:
+    """Initialize fleet: resolve strategy → topology → global mesh
+    (role of fleet.init, fleet_base.py:211; mesh plays the part of
+    HybridCommunicateGroup, topology.py:134)."""
+    del is_collective  # PS ("transpiler") mode is the CTR trainer path
+    _STATE.role = role_maker or RoleMaker()
+    _STATE.strategy = strategy or DistributedStrategy()
+    devs = list(devices) if devices is not None else jax.devices()
+    st = _STATE.strategy
+    if not st.hybrid_configs:
+        # No explicit degrees: everything to dp — but still through
+        # topology() so strategy/degree consistency checks run (e.g.
+        # pipeline=True with pp_degree==1 must fail here, not silently
+        # train without a pipeline).
+        st = dataclasses.replace(st, hybrid_configs={"dp_degree": -1})
+    topo = st.topology(world_size=len(devs))
+    _STATE.topology = topo
+    _STATE.mesh = topo_lib.set_default_topology(topo, devs)
+    _STATE.initialized = True
+    log.vlog(0, "fleet.init: rank %d/%d topology %s", _STATE.role.rank,
+             _STATE.role.world, topo.axis_sizes())
+    return _STATE.mesh
+
+
+def _require_init() -> _FleetState:
+    if not _STATE.initialized:
+        raise RuntimeError("call fleet.init() first")
+    return _STATE
+
+
+def mesh() -> jax.sharding.Mesh:
+    return _require_init().mesh  # type: ignore[return-value]
+
+
+def strategy() -> DistributedStrategy:
+    return _require_init().strategy  # type: ignore[return-value]
+
+
+def worker_index() -> int:
+    return _require_init().role.rank  # type: ignore[union-attr]
+
+
+def worker_num() -> int:
+    return _require_init().role.world  # type: ignore[union-attr]
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def barrier_worker(store=None) -> None:
+    """Cross-process barrier (role of fleet.barrier_worker). In-process
+    (single-host) it is a no-op; multi-host uses the provided control-plane
+    store (FileStore/TcpTransport) or JAX's global sync."""
+    if worker_num() == 1:
+        return
+    if store is not None:
+        store.barrier("fleet")
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("fleet_barrier")
+
+
+@dataclasses.dataclass
+class DistributedOptimizer:
+    """Strategy-resolved training kit: the optax transformation chain plus
+    the AMP policy/scaler the train step should use.
+
+    Role of fleet.distributed_optimizer(...).minimize(...) (fleet_base.py:
+    912,1477): where the reference rewrites the program through
+    meta-optimizers (AMPOptimizer → RecomputeOptimizer → ... →
+    RawProgramOptimizer), here the same decisions compose functionally:
+    gradient sync is implicit in pjit sharding, so what remains is the
+    update rule (tx), numerics (amp_policy/loss_scale), and microbatching
+    (gradient merge via optax.MultiSteps).
+    """
+
+    tx: optax.GradientTransformation
+    amp_policy: Optional[amp_lib.Policy]
+    loss_scale: Optional[amp_lib.LossScaleState]
+    every_k_steps: int = 1
+
+    def init(self, params):
+        return self.tx.init(params)
+
+    def update(self, grads, state, params=None):
+        return self.tx.update(grads, state, params)
+
+
+def distributed_optimizer(optimizer, *,
+                          strategy: Optional[DistributedStrategy] = None,
+                          learning_rate=None) -> DistributedOptimizer:
+    """Resolve (base optimizer, strategy) into a DistributedOptimizer.
+
+    ``optimizer`` is an optax.GradientTransformation or a name accepted by
+    :func:`paddlebox_tpu.optimizers.make_optimizer` ("adam", "lars", ...);
+    names require ``learning_rate``. ``strategy.lars`` / ``strategy.lamb``
+    replace a by-name base optimizer with the large-batch rule (role of
+    LarsOptimizer/LambOptimizer meta-optimizers wrapping the user's
+    momentum/adam); with an optax object they raise — the caller already
+    fixed the rule.
+    """
+    st = strategy or _require_init().strategy or DistributedStrategy()
+    if st.lars and st.lamb:
+        raise ValueError("strategy.lars and strategy.lamb are exclusive")
+    if isinstance(optimizer, str):
+        if learning_rate is None:
+            raise ValueError(
+                f"optimizer by name ({optimizer!r}) requires learning_rate=")
+        if st.lars:
+            optimizer = "lars"
+        elif st.lamb:
+            optimizer = "lamb"
+        optimizer = opt_lib.make_optimizer(optimizer, learning_rate)
+    elif st.lars or st.lamb:
+        raise ValueError(
+            "strategy.lars/lamb need the base optimizer by name (e.g. "
+            "'momentum') so the large-batch rule can replace it; got an "
+            "optax object")
+    chain = []
+    if st.clip_norm:
+        chain.append(optax.clip_by_global_norm(st.clip_norm))
+    if st.dgc:
+        from paddlebox_tpu.parallel.dgc import dgc_transform
+        chain.append(dgc_transform(
+            sparsity=st.dgc_configs.sparsity,
+            rampup_begin_step=st.dgc_configs.rampup_begin_step))
+    chain.append(optimizer)
+    tx = optax.chain(*chain) if len(chain) > 1 else optimizer
+    every_k = 1
+    if st.gradient_merge and st.gradient_merge_configs.k_steps > 1:
+        every_k = st.gradient_merge_configs.k_steps
+        tx = optax.MultiSteps(tx, every_k_schedule=every_k,
+                              use_grad_mean=st.gradient_merge_configs.avg)
+    policy = None
+    scale = None
+    if st.amp:
+        cfg = st.amp_configs
+        if cfg.dtype in ("bfloat16", "bf16"):
+            policy = amp_lib.bf16_policy()
+        elif cfg.dtype in ("float16", "fp16"):
+            policy = amp_lib.Policy(compute_dtype=jax.numpy.float16)
+        else:
+            raise ValueError(f"unknown amp dtype {cfg.dtype!r} "
+                             "(want bfloat16/bf16 or float16/fp16)")
+        if cfg.use_dynamic_loss_scaling:
+            scale = amp_lib.loss_scale_init(
+                cfg.init_loss_scaling,
+                growth_interval=cfg.incr_every_n_steps,
+                growth_factor=cfg.incr_ratio,
+                backoff_factor=cfg.decr_ratio,
+                backoff_interval=cfg.decr_every_n_nan_or_inf)
+    return DistributedOptimizer(tx=tx, amp_policy=policy, loss_scale=scale,
+                                every_k_steps=every_k)
+
+
+def distributed_model(apply_fn: Callable[..., Any], *,
+                      strategy: Optional[DistributedStrategy] = None
+                      ) -> Callable[..., Any]:
+    """Wrap a functional model apply for the strategy (role of
+    fleet.distributed_model): recompute → ``jax.checkpoint``. TP/PP/SP
+    structure lives in the model itself (parallel.{tp,pp,sp} layers) since
+    JAX models are explicit about sharding."""
+    st = strategy or _require_init().strategy or DistributedStrategy()
+    if st.recompute:
+        policy_name = st.recompute_configs.checkpoint_policy
+        policy = getattr(jax.checkpoint_policies, policy_name, None)
+        if policy is None:
+            raise ValueError(f"unknown checkpoint policy {policy_name!r}")
+        return jax.checkpoint(apply_fn, policy=policy)
+    return apply_fn

@@ -1,0 +1,89 @@
+"""fleet.metrics — distributed metric reductions over host stat arrays.
+
+Role of ``python/paddle/distributed/fleet/metrics/metric.py``: each worker
+holds local numpy statistics (bucketed AUC histograms, error sums, counts);
+``fleet.metrics.auc/mae/rmse/acc/sum/max/min`` allreduce them across
+trainers and finish the computation on host (reference reduces via fleet
+util allreduce, :144,227,276).
+
+TPU-first: the cross-worker reduction is pluggable — pass ``reduce=`` a
+callable (e.g. built from a FileStore / TcpTransport control-plane channel,
+or jax multihost utils); the default is single-process identity. Device-
+side metric accumulation (inside the jitted step, psum over dp) lives in
+:mod:`paddlebox_tpu.metrics`; this module is the *host* aggregation path
+used at pass/epoch boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+Reduce = Callable[[np.ndarray], np.ndarray]
+
+
+def _ident(x: np.ndarray) -> np.ndarray:
+    return x
+
+
+def make_store_reduce(store, name: str = "metrics") -> Reduce:
+    """Build an allreduce-sum over a control-plane store exposing
+    ``all_gather(name, bytes) -> List[bytes]`` (FileStore protocol)."""
+
+    def reduce(x: np.ndarray) -> np.ndarray:
+        import pickle
+        parts = store.all_gather(name, pickle.dumps(np.asarray(x)))
+        return np.sum([pickle.loads(p) for p in parts], axis=0)
+
+    return reduce
+
+
+def sum(value, reduce: Reduce = _ident) -> np.ndarray:  # noqa: A001
+    """Global elementwise sum (metric.py:sum_metric role)."""
+    return reduce(np.asarray(value, np.float64))
+
+
+def auc(stat_pos: np.ndarray, stat_neg: np.ndarray,
+        reduce: Reduce = _ident) -> float:
+    """Exact global AUC from bucketed pos/neg prediction histograms
+    (metric.py:144; math mirrors BasicAucCalculator::computeBucketAuc,
+    metrics.cc:299-330: sweep buckets accumulating trapezoid area)."""
+    pos = reduce(np.asarray(stat_pos, np.float64)).ravel()
+    neg = reduce(np.asarray(stat_neg, np.float64)).ravel()
+    if pos.shape != neg.shape:
+        raise ValueError("stat_pos/stat_neg shape mismatch")
+    # high→low sweep == reversed cumulative; vectorized trapezoid.
+    tp = np.cumsum(pos[::-1])           # true positives above threshold
+    fp = np.cumsum(neg[::-1])
+    tot_p, tot_n = tp[-1], fp[-1]
+    if tot_p == 0 or tot_n == 0:
+        return 0.5
+    tp_prev = np.concatenate([[0.0], tp[:-1]])
+    fp_prev = np.concatenate([[0.0], fp[:-1]])
+    area = np.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    return float(area / (tot_p * tot_n))
+
+
+def mae(abserr: float, total_ins_num: float, reduce: Reduce = _ident) -> float:
+    """Global mean absolute error (metric.py:227)."""
+    s = reduce(np.asarray([abserr, total_ins_num], np.float64))
+    return float(s[0] / max(s[1], 1.0))
+
+
+def rmse(sqrerr: float, total_ins_num: float,
+         reduce: Reduce = _ident) -> float:
+    """Global root mean squared error (metric.py:252)."""
+    s = reduce(np.asarray([sqrerr, total_ins_num], np.float64))
+    return float(np.sqrt(s[0] / max(s[1], 1.0)))
+
+
+def mse(sqrerr: float, total_ins_num: float, reduce: Reduce = _ident) -> float:
+    s = reduce(np.asarray([sqrerr, total_ins_num], np.float64))
+    return float(s[0] / max(s[1], 1.0))
+
+
+def acc(correct: float, total: float, reduce: Reduce = _ident) -> float:
+    """Global accuracy (metric.py:276)."""
+    s = reduce(np.asarray([correct, total], np.float64))
+    return float(s[0] / max(s[1], 1.0))
