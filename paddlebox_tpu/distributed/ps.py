@@ -1,0 +1,473 @@
+"""CPU parameter-server service: sharded sparse/dense tables over TCP RPC.
+
+Role of the pscore brpc PS runtime (``distributed/ps/service/
+brpc_ps_server.h:40``, ``brpc_ps_client.h``) with its tables
+(``MemorySparseTable``, ``MemoryDenseTable``, ``ps/table/table.h:67``) and
+sparse SGD rules (``sparse_sgd_rule.h``): workers pull/push sparse values
+by feasign key and pull/push dense params by name; the server applies the
+sparse optimizer to pushed gradients.
+
+TPU-first framing: the *training-time* embedding path never touches this
+service — per-pass tables live in TPU HBM (``embedding/``). The PS is the
+host control/persistence plane: the between-pass backing store for
+multi-host CTR jobs (pass build pulls, EndPass pushes back — role of
+``BuildPull``/``EndPass``, ``ps_gpu_wrapper.cc:362,983``), plus dense
+param distribution for async CPU setups. Protocol is length-prefixed
+pickled messages over TCP (stdlib-only stand-in for brpc; the message
+framing mirrors ``transport.TcpTransport``).
+
+Key sharding is client-side ``key % num_servers`` (exactly the reference's
+``key % num_devices`` shard rule, ``heter_comm.h:332``), so any number of
+clients agree on placement without a directory service.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.distributed.transport import _recv_exact
+from paddlebox_tpu.embedding.store import FeatureStore
+from paddlebox_tpu.embedding.table import TableConfig
+
+_HDR = struct.Struct("<q")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    (ln,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, ln))
+
+
+class DenseTable:
+    """Named dense parameter block with server-side SGD apply (role of
+    MemoryDenseTable: workers push summed grads, server applies the rule)."""
+
+    def __init__(self, value: np.ndarray, learning_rate: float = 1.0):
+        self.value = np.asarray(value, np.float32).copy()
+        self.lr = float(learning_rate)
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        with self._lock:
+            self.value -= self.lr * np.asarray(grad, np.float32)
+
+    def set(self, value: np.ndarray) -> None:
+        with self._lock:
+            self.value = np.asarray(value, np.float32).copy()
+
+
+class PSServer:
+    """One PS shard: serves the keys with ``key % num_servers == index``.
+
+    Sparse tables are :class:`FeatureStore` instances (sorted-key columnar
+    host store); pushes apply the table's sparse optimizer server-side —
+    the CPU twin of the in-kernel update the device path fuses into
+    push_sparse (``optimizer.cuh.h:31``).
+    """
+
+    def __init__(self, endpoint: str, index: int, num_servers: int,
+                 tables: Dict[str, TableConfig],
+                 dense: Optional[Dict[str, np.ndarray]] = None,
+                 dense_lr: float = 1.0):
+        self.index = index
+        self.num_servers = num_servers
+        self.tables: Dict[str, FeatureStore] = {
+            name: FeatureStore(cfg, seed=index) for name, cfg in
+            tables.items()}
+        self._opts = {name: self.tables[name].opt for name in tables}
+        # Per-table lock serializing read-modify-write sequences: the
+        # FeatureStore lock only covers single calls, but pull→update→push
+        # from two concurrent client connections racing on the same key
+        # would lose one side's gradient without this.
+        self._table_locks = {name: threading.Lock() for name in tables}
+        self.dense: Dict[str, DenseTable] = {
+            name: DenseTable(v, dense_lr) for name, v in (dense or {}).items()}
+        host, port = endpoint.rsplit(":", 1)
+        self._server = socket.create_server((host, int(port)), backlog=64)
+        self.endpoint = f"{host}:{self._server.getsockname()[1]}"
+        self._running = True
+        self._threads: List[threading.Thread] = []
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+
+    # -- service loop ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while True:
+                    req = _recv_msg(conn)
+                    method = req["method"]
+                    try:
+                        out = getattr(self, "handle_" + method)(req)
+                        _send_msg(conn, {"ok": True, "result": out})
+                    except Exception as e:  # report, keep serving
+                        log.vlog(0, "ps[%d] %s failed: %s", self.index,
+                                 method, e)
+                        _send_msg(conn, {"ok": False, "error": repr(e)})
+        except (ConnectionError, OSError, EOFError):
+            return
+
+    # -- sparse ------------------------------------------------------------
+
+    def _check_owned(self, keys: np.ndarray) -> None:
+        if keys.size and not np.all(keys % self.num_servers == self.index):
+            raise ValueError(f"keys not owned by server {self.index}")
+
+    def handle_pull_sparse(self, req) -> Dict[str, np.ndarray]:
+        """Values for requested keys in request order (duplicates allowed).
+        Unseen keys get initialized rows (accessor init semantics)."""
+        store = self.tables[req["table"]]
+        keys = np.asarray(req["keys"], np.uint64)
+        self._check_owned(keys)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        with self._table_locks[req["table"]]:
+            rows = store.pull_for_pass(uniq)
+            # Persist initializations so repeated pulls are stable.
+            store.push_from_pass(uniq, rows)
+        monitor.add("ps/pull_keys", int(keys.size))
+        return {"emb": rows["emb"][inv], "w": rows["w"][inv]}
+
+    def handle_push_sparse(self, req) -> int:
+        """Merge duplicate-key grads (segment sum — role of
+        dynamic_merge_grad, heter_comm.h:69) then apply the sparse
+        optimizer and show/click accumulation."""
+        store = self.tables[req["table"]]
+        opt = self._opts[req["table"]]
+        keys = np.asarray(req["keys"], np.uint64)
+        self._check_owned(keys)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        n = uniq.size
+        d = store.config.dim
+        emb_g = np.zeros((n, d), np.float32)
+        np.add.at(emb_g, inv, np.asarray(req["emb_grad"], np.float32))
+        w_g = np.zeros((n,), np.float32)
+        np.add.at(w_g, inv, np.asarray(req["w_grad"], np.float32))
+        with self._table_locks[req["table"]]:
+            rows = store.pull_for_pass(uniq)
+            emb, emb_st = opt.update_vector(rows["emb"], rows["emb_state"],
+                                            emb_g)
+            w, w_st = opt.update_scalar(rows["w"], rows["w_state"], w_g)
+            rows["emb"] = np.asarray(emb, np.float32)
+            rows["emb_state"] = np.asarray(emb_st, np.float32)
+            rows["w"] = np.asarray(w, np.float32)
+            rows["w_state"] = np.asarray(w_st, np.float32)
+            if "show" in req:
+                np.add.at(rows["show"], inv,
+                          np.asarray(req["show"], np.float32))
+            if "click" in req:
+                np.add.at(rows["click"], inv,
+                          np.asarray(req["click"], np.float32))
+            store.push_from_pass(uniq, rows)
+        monitor.add("ps/push_keys", int(keys.size))
+        return n
+
+    def handle_pull_pass(self, req):
+        """Bulk fetch for pass build (role of BuildPull): full value rows
+        including optimizer state, for sorted unique keys."""
+        store = self.tables[req["table"]]
+        keys = np.asarray(req["keys"], np.uint64)
+        self._check_owned(keys)
+        return store.pull_for_pass(keys)
+
+    def handle_push_pass(self, req) -> int:
+        """Bulk write-back at EndPass (ps_gpu_wrapper.cc:983)."""
+        store = self.tables[req["table"]]
+        keys = np.asarray(req["keys"], np.uint64)
+        self._check_owned(keys)
+        store.push_from_pass(keys, req["values"])
+        return int(keys.size)
+
+    # -- dense -------------------------------------------------------------
+
+    def handle_pull_dense(self, req) -> np.ndarray:
+        return self.dense[req["name"]].pull()
+
+    def handle_push_dense(self, req) -> bool:
+        self.dense[req["name"]].push(req["grad"])
+        return True
+
+    def handle_set_dense(self, req) -> bool:
+        if req["name"] in self.dense:
+            self.dense[req["name"]].set(req["value"])
+        else:
+            self.dense[req["name"]] = DenseTable(req["value"])
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def handle_save(self, req) -> bool:
+        for store in self.tables.values():
+            if req.get("mode", "base") == "base":
+                store.save_base(self._shard_dir(req["path"]))
+            else:
+                store.save_delta(self._shard_dir(req["path"]))
+        return True
+
+    def handle_load(self, req) -> bool:
+        for store in self.tables.values():
+            store.load(self._shard_dir(req["path"]), req.get("mode", "base"))
+        return True
+
+    def _shard_dir(self, path: str) -> str:
+        import os
+        d = os.path.join(path, f"part-{self.index:05d}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def handle_shrink(self, req) -> int:
+        return sum(store.shrink(min_show=req.get("min_show", 0.0))
+                   for store in self.tables.values())
+
+    def handle_stats(self, req) -> Dict[str, int]:
+        return {name: store.num_features
+                for name, store in self.tables.items()}
+
+    def handle_stop(self, req) -> bool:
+        self._running = False
+        return True
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            # shutdown() wakes the thread blocked in accept(); a bare
+            # close() would leave the kernel file description alive inside
+            # the blocked syscall and the port would keep accepting.
+            self._server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """Client-side sharding + fan-out (role of BrpcPsClient).
+
+    One persistent connection per server; sparse requests are split by
+    ``key % num_servers``, issued concurrently, and reassembled in request
+    order.
+    """
+
+    def __init__(self, endpoints: Sequence[str]):
+        self.endpoints = list(endpoints)
+        self.num_servers = len(self.endpoints)
+        self._socks: List[Optional[socket.socket]] = \
+            [None] * self.num_servers
+        self._locks = [threading.Lock() for _ in range(self.num_servers)]
+
+    def _sock(self, i: int) -> socket.socket:
+        if self._socks[i] is None:
+            host, port = self.endpoints[i].rsplit(":", 1)
+            self._socks[i] = socket.create_connection((host, int(port)),
+                                                      timeout=60)
+        return self._socks[i]
+
+    def _call(self, server: int, method: str, **kw):
+        with self._locks[server]:
+            sock = self._sock(server)
+            _send_msg(sock, {"method": method, **kw})
+            resp = _recv_msg(sock)
+        if not resp["ok"]:
+            raise RuntimeError(f"ps[{server}].{method}: {resp['error']}")
+        return resp["result"]
+
+    def _fanout(self, method: str, **kw) -> List:
+        outs: List = [None] * self.num_servers
+        errs: List = []
+
+        def run(i):
+            try:
+                outs[i] = self._call(i, method, **kw)
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(i,))
+              for i in range(self.num_servers)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        if errs:
+            raise errs[0]
+        return outs
+
+    # -- sparse ------------------------------------------------------------
+
+    def _split(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        owner = (keys % np.uint64(self.num_servers)).astype(np.int64)
+        order = np.argsort(owner, kind="stable")
+        return owner, order
+
+    def pull_sparse(self, table: str, keys: np.ndarray
+                    ) -> Dict[str, np.ndarray]:
+        keys = np.asarray(keys, np.uint64)
+        owner, order = self._split(keys)
+        outs_emb = None
+        out_w = np.empty((keys.size,), np.float32)
+        results: Dict[int, Tuple[np.ndarray, Dict[str, np.ndarray]]] = {}
+        errs: List[BaseException] = []
+        threads = []
+        for s in range(self.num_servers):
+            idx = order[owner[order] == s]
+            if idx.size == 0:
+                continue
+
+            def run(s=s, idx=idx):
+                try:
+                    results[s] = (idx, self._call(
+                        s, "pull_sparse", table=table, keys=keys[idx]))
+                except BaseException as e:
+                    errs.append(e)
+            threads.append(threading.Thread(target=run))
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        if errs:
+            # A lost shard must fail loudly — returning np.empty garbage
+            # for its rows would silently corrupt training.
+            raise errs[0]
+        for s, (idx, res) in results.items():
+            if outs_emb is None:
+                outs_emb = np.empty((keys.size, res["emb"].shape[1]),
+                                    np.float32)
+            outs_emb[idx] = res["emb"]
+            out_w[idx] = res["w"]
+        if outs_emb is None:
+            outs_emb = np.empty((0, 0), np.float32)
+        return {"emb": outs_emb, "w": out_w}
+
+    def push_sparse(self, table: str, keys: np.ndarray,
+                    emb_grad: np.ndarray, w_grad: np.ndarray,
+                    show: Optional[np.ndarray] = None,
+                    click: Optional[np.ndarray] = None) -> None:
+        keys = np.asarray(keys, np.uint64)
+        owner, order = self._split(keys)
+        threads = []
+        errs: List[BaseException] = []
+        for s in range(self.num_servers):
+            idx = order[owner[order] == s]
+            if idx.size == 0:
+                continue
+            kw = dict(table=table, keys=keys[idx], emb_grad=emb_grad[idx],
+                      w_grad=w_grad[idx])
+            if show is not None:
+                kw["show"] = show[idx]
+            if click is not None:
+                kw["click"] = click[idx]
+
+            def run(s=s, kw=kw):
+                try:
+                    self._call(s, "push_sparse", **kw)
+                except BaseException as e:
+                    errs.append(e)
+            threads.append(threading.Thread(target=run))
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        if errs:
+            # Dropped gradients must not be silent.
+            raise errs[0]
+
+    def pull_pass(self, table: str, keys_sorted: np.ndarray
+                  ) -> Dict[str, np.ndarray]:
+        """Bulk pass-build fetch, reassembled to the sorted key order."""
+        keys = np.asarray(keys_sorted, np.uint64)
+        owner, order = self._split(keys)
+        fields: Dict[str, np.ndarray] = {}
+        for s in range(self.num_servers):
+            idx = order[owner[order] == s]
+            if idx.size == 0:
+                continue
+            res = self._call(s, "pull_pass", table=table, keys=keys[idx])
+            for f, arr in res.items():
+                if f not in fields:
+                    fields[f] = np.empty((keys.size,) + arr.shape[1:],
+                                         arr.dtype)
+                fields[f][idx] = arr
+        return fields
+
+    def push_pass(self, table: str, keys_sorted: np.ndarray,
+                  values: Dict[str, np.ndarray]) -> None:
+        keys = np.asarray(keys_sorted, np.uint64)
+        owner, order = self._split(keys)
+        for s in range(self.num_servers):
+            idx = order[owner[order] == s]
+            if idx.size == 0:
+                continue
+            self._call(s, "push_pass", table=table, keys=keys[idx],
+                       values={f: a[idx] for f, a in values.items()})
+
+    # -- dense / lifecycle -------------------------------------------------
+
+    def pull_dense(self, name: str, server: int = 0) -> np.ndarray:
+        return self._call(server, "pull_dense", name=name)
+
+    def push_dense(self, name: str, grad: np.ndarray,
+                   server: int = 0) -> None:
+        self._call(server, "push_dense", name=name, grad=grad)
+
+    def set_dense(self, name: str, value: np.ndarray,
+                  server: int = 0) -> None:
+        self._call(server, "set_dense", name=name, value=value)
+
+    def save(self, path: str, mode: str = "base") -> None:
+        self._fanout("save", path=path, mode=mode)
+
+    def load(self, path: str, mode: str = "base") -> None:
+        self._fanout("load", path=path, mode=mode)
+
+    def shrink(self, min_show: float = 0.0) -> int:
+        return int(np.sum(self._fanout("shrink", min_show=min_show)))
+
+    def stats(self) -> List[Dict[str, int]]:
+        return self._fanout("stats")
+
+    def stop_servers(self) -> None:
+        try:
+            self._fanout("stop")
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        for s in self._socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+def start_local_cluster(num_servers: int, tables: Dict[str, TableConfig],
+                        dense: Optional[Dict[str, np.ndarray]] = None
+                        ) -> Tuple[List[PSServer], PSClient]:
+    """Spin up an in-process PS cluster on localhost ephemeral ports (role
+    of the reference's localhost fake-cluster test mechanism,
+    test_dist_base.py:1041)."""
+    servers = [PSServer("127.0.0.1:0", i, num_servers, tables, dense)
+               for i in range(num_servers)]
+    client = PSClient([s.endpoint for s in servers])
+    return servers, client

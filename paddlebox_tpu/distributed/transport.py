@@ -218,6 +218,12 @@ class TcpTransport:
     def close(self) -> None:
         self._running = False
         try:
+            # shutdown() wakes the blocked accept(); close() alone leaves
+            # the listening file description alive inside the syscall.
+            self._server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._server.close()
         except OSError:
             pass

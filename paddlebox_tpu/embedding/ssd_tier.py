@@ -1,0 +1,267 @@
+"""SSD/disk overflow tier: RAM-bounded feature store with cold spill.
+
+Role of the SSD-backed sparse tables in the reference: ``SSDSparseTable``
+(RocksDB-backed CPU table, ``ps/table/ssd_sparse_table.h``) and the BoxPS
+SSD→mem staging (``LoadSSD2Mem``/``CheckNeedLimitMem``,
+``box_wrapper.h:635,669``): the full trillion-feature table does not fit
+in host RAM, so cold features live on disk and are staged in before the
+pass that needs them.
+
+TPU-first/host design: instead of an LSM keystore, features are bucketed
+by key hash into npz shard files (columnar, one vectorized merge per
+bucket — the access pattern is bulk pass-build reads, never point
+lookups, so columnar beats rocksdb here). RAM and disk tiers are
+exclusive: fetch moves rows RAM-ward, evict moves rows disk-ward, so a
+key has exactly one authoritative copy.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.embedding.store import FeatureStore
+from paddlebox_tpu.embedding.table import TableConfig
+
+
+class DiskShards:
+    """Bucketed columnar key→row storage on disk."""
+
+    def __init__(self, root: str, num_buckets: int = 64):
+        self.root = root
+        self.num_buckets = num_buckets
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, b: int) -> str:
+        return os.path.join(self.root, f"bucket-{b:04d}.npz")
+
+    def _bucket_of(self, keys: np.ndarray) -> np.ndarray:
+        # Mix high bits so sequential feasign ranges spread across buckets.
+        h = keys ^ (keys >> np.uint64(33))
+        h = h * np.uint64(0xFF51AFD7ED558CCD)
+        return (h % np.uint64(self.num_buckets)).astype(np.int64)
+
+    def _load_bucket(self, b: int
+                     ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        path = self._path(b)
+        if not os.path.exists(path):
+            return np.empty((0,), np.uint64), {}
+        data = np.load(path)
+        keys = data["keys"].astype(np.uint64)
+        return keys, {f: data[f] for f in data.files if f != "keys"}
+
+    def _save_bucket(self, b: int, keys: np.ndarray,
+                     vals: Dict[str, np.ndarray]) -> None:
+        path = self._path(b)
+        if keys.size == 0:
+            if os.path.exists(path):
+                os.unlink(path)
+            return
+        tmp = path + ".tmp.npz"
+        np.savez(tmp, keys=keys, **vals)
+        os.replace(tmp, path)
+
+    def write(self, keys: np.ndarray, vals: Dict[str, np.ndarray]) -> None:
+        """Upsert rows (sorted merge per bucket; new rows override)."""
+        keys = np.asarray(keys, np.uint64)
+        if keys.size == 0:
+            return
+        buckets = self._bucket_of(keys)
+        for b in np.unique(buckets):
+            sel = buckets == b
+            bk = keys[sel]
+            bv = {f: v[sel] for f, v in vals.items()}
+            ok, ov = self._load_bucket(int(b))
+            if ok.size:
+                # Drop old copies of updated keys, then sorted-merge.
+                keep = ~np.isin(ok, bk)
+                merged_k = np.concatenate([ok[keep], bk])
+                order = np.argsort(merged_k, kind="stable")
+                merged_v = {f: np.concatenate([ov[f][keep], bv[f]])[order]
+                            for f in bv}
+                self._save_bucket(int(b), merged_k[order], merged_v)
+            else:
+                order = np.argsort(bk, kind="stable")
+                self._save_bucket(int(b), bk[order],
+                                  {f: v[order] for f, v in bv.items()})
+
+    def take(self, keys: np.ndarray
+             ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Remove and return the present subset of ``keys``."""
+        keys = np.unique(np.asarray(keys, np.uint64))
+        if keys.size == 0:
+            return keys, {}
+        out_k = []
+        out_v: Dict[str, list] = {}
+        buckets = self._bucket_of(keys)
+        for b in np.unique(buckets):
+            ok, ov = self._load_bucket(int(b))
+            if ok.size == 0:
+                continue
+            hit = np.isin(ok, keys[buckets == b])
+            if not hit.any():
+                continue
+            out_k.append(ok[hit])
+            for f, v in ov.items():
+                out_v.setdefault(f, []).append(v[hit])
+            self._save_bucket(int(b), ok[~hit],
+                              {f: v[~hit] for f, v in ov.items()})
+        if not out_k:
+            return np.empty((0,), np.uint64), {}
+        k = np.concatenate(out_k)
+        v = {f: np.concatenate(parts) for f, parts in out_v.items()}
+        order = np.argsort(k, kind="stable")
+        return k[order], {f: a[order] for f, a in v.items()}
+
+    @property
+    def num_features(self) -> int:
+        n = 0
+        for path in glob.glob(os.path.join(self.root, "bucket-*.npz")):
+            n += np.load(path)["keys"].shape[0]
+        return n
+
+    def copy_to(self, dst: str) -> None:
+        os.makedirs(dst, exist_ok=True)
+        for path in glob.glob(os.path.join(self.root, "bucket-*.npz")):
+            shutil.copy(path, dst)
+
+    def restore_from(self, src: str) -> None:
+        for path in glob.glob(os.path.join(self.root, "bucket-*.npz")):
+            os.unlink(path)
+        for path in glob.glob(os.path.join(src, "bucket-*.npz")):
+            shutil.copy(path, self.root)
+
+
+class TieredFeatureStore:
+    """FeatureStore bounded to ``max_ram_features`` with disk overflow.
+
+    pull_for_pass stages any disk-resident pass keys into RAM first
+    (LoadSSD2Mem role); push_from_pass writes to RAM and then evicts the
+    coldest rows past the budget (CheckNeedLimitMem role). The wrapped
+    store keeps the FeatureStore interface so the pass engine and PS
+    server can use either interchangeably.
+    """
+
+    def __init__(self, config: TableConfig, disk_dir: str,
+                 max_ram_features: Optional[int] = None,
+                 num_buckets: int = 64, seed: int = 0):
+        self.config = config
+        self.ram = FeatureStore(config, seed=seed)
+        self.disk = DiskShards(disk_dir, num_buckets)
+        self.max_ram_features = max_ram_features
+        self.opt = self.ram.opt
+        # Dirty keys that were evicted to disk since the last save_base:
+        # they must be staged back for save_delta or their training
+        # updates would silently vanish from the delta stream.
+        self._evicted_dirty = np.empty((0,), np.uint64)
+
+    # -- tier movement -----------------------------------------------------
+
+    def _stage_in(self, keys_sorted: np.ndarray) -> None:
+        missing = keys_sorted[~self.ram.contains(keys_sorted)]
+        if missing.size == 0:
+            return
+        k, v = self.disk.take(missing)
+        if k.size:
+            self.ram.push_from_pass(k, v)
+            monitor.add("ssd_tier/staged_in", int(k.size))
+
+    def evict_to_budget(self) -> int:
+        """Spill coldest rows until RAM is within budget."""
+        if self.max_ram_features is None:
+            return 0
+        excess = self.ram.num_features - self.max_ram_features
+        if excess <= 0:
+            return 0
+        cold = self.ram.rows_by_coldness()[:excess]
+        self._evicted_dirty = np.union1d(
+            self._evicted_dirty, np.intersect1d(cold, self.ram.dirty_keys()))
+        k, v = self.ram.pop_rows(cold)
+        self.disk.write(k, v)
+        monitor.add("ssd_tier/evicted", int(k.size))
+        log.vlog(1, "ssd_tier: evicted %d rows to disk", k.size)
+        return int(k.size)
+
+    # -- FeatureStore interface -------------------------------------------
+
+    @property
+    def num_features(self) -> int:
+        return self.ram.num_features + self.disk.num_features
+
+    def pull_for_pass(self, pass_keys_sorted: np.ndarray
+                      ) -> Dict[str, np.ndarray]:
+        self._stage_in(np.asarray(pass_keys_sorted, np.uint64))
+        return self.ram.pull_for_pass(pass_keys_sorted)
+
+    def push_from_pass(self, pass_keys_sorted: np.ndarray,
+                       values: Dict[str, np.ndarray]) -> None:
+        self.ram.push_from_pass(pass_keys_sorted, values)
+        self.evict_to_budget()
+
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.uint64)
+        in_ram = self.ram.contains(keys)
+        if in_ram.all():
+            return in_ram
+        # Disk check without moving rows: take+write-back would churn, so
+        # peek via bucket loads.
+        out = in_ram.copy()
+        miss = keys[~in_ram]
+        buckets = self.disk._bucket_of(miss)
+        for b in np.unique(buckets):
+            ok, _ = self.disk._load_bucket(int(b))
+            if ok.size:
+                sel = buckets == b
+                hit = np.isin(miss[sel], ok)
+                idx = np.flatnonzero(~in_ram)[sel]
+                out[idx[hit]] = True
+        return out
+
+    def shrink(self, *, min_show: float = 0.0) -> int:
+        """Shrink both tiers (disk rows decay too — stage all disk rows
+        through RAM bucket-by-bucket to apply decay/eviction)."""
+        evicted = self.ram.shrink(min_show=min_show)
+        cfg = self.config
+        for b in range(self.disk.num_buckets):
+            k, v = self.disk._load_bucket(b)
+            if k.size == 0:
+                continue
+            v["show"] = v["show"] * cfg.show_click_decay
+            v["click"] = v["click"] * cfg.show_click_decay
+            if min_show > 0:
+                keep = v["show"] >= min_show
+                evicted += int((~keep).sum())
+                k = k[keep]
+                v = {f: a[keep] for f, a in v.items()}
+            self.disk._save_bucket(b, k, v)
+        return evicted
+
+    def save_base(self, path: str) -> None:
+        self.ram.save_base(path)
+        self._evicted_dirty = np.empty((0,), np.uint64)
+        self.disk.copy_to(os.path.join(path,
+                                       f"{self.config.name}.ssd"))
+
+    def save_delta(self, path: str) -> None:
+        # Stage evicted-but-dirty rows back so the RAM delta set covers
+        # every change since the last base (push_from_pass re-marks them
+        # dirty), then re-evict to stay within budget.
+        if self._evicted_dirty.size:
+            k, v = self.disk.take(self._evicted_dirty)
+            if k.size:
+                self.ram.push_from_pass(k, v)
+            self._evicted_dirty = np.empty((0,), np.uint64)
+        self.ram.save_delta(path)
+        self.evict_to_budget()
+
+    def load(self, path: str, kind: str = "base") -> None:
+        self.ram.load(path, kind)
+        if kind == "base":
+            ssd_src = os.path.join(path, f"{self.config.name}.ssd")
+            if os.path.isdir(ssd_src):
+                self.disk.restore_from(ssd_src)

@@ -73,6 +73,47 @@ class FeatureStore:
         pos_c = np.minimum(pos, m - 1)
         return self._keys[pos_c] == k, pos_c
 
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        """Membership mask for keys (any order)."""
+        k = np.ascontiguousarray(keys, np.uint64)
+        with self._lock:
+            found, _ = self._locate(k)
+        return found
+
+    def pop_rows(self, keys: np.ndarray
+                 ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Remove and return rows for the present subset of ``keys`` —
+        the extraction half of spilling to the SSD tier (role of the
+        mem→SSD movement in BoxPS CheckNeedLimitMem/ShrinkResource)."""
+        k = np.unique(np.ascontiguousarray(keys, np.uint64))
+        with self._lock:
+            found, pos = self._locate(k)
+            take = pos[found]
+            out_keys = self._keys[take].copy()
+            out_vals = {f: self._vals[f][take].copy() for f in _FIELDS}
+            keep = np.ones(self._keys.shape[0], bool)
+            keep[take] = False
+            self._keys = self._keys[keep]
+            for f in _FIELDS:
+                self._vals[f] = self._vals[f][keep]
+            # Popped keys leave the delta set — they are no longer present
+            # in RAM and the tiered wrapper snapshots disk separately.
+            if self._dirty.size:
+                self._dirty = np.setdiff1d(self._dirty, out_keys,
+                                           assume_unique=True)
+        return out_keys, out_vals
+
+    def dirty_keys(self) -> np.ndarray:
+        """Keys touched since the last save_base (the delta set)."""
+        with self._lock:
+            return self._dirty.copy()
+
+    def rows_by_coldness(self) -> np.ndarray:
+        """Keys sorted by ascending show (coldest first) for eviction."""
+        with self._lock:
+            order = np.argsort(self._vals["show"], kind="stable")
+            return self._keys[order].copy()
+
     # -- pass build --------------------------------------------------------
 
     def pull_for_pass(self, pass_keys_sorted: np.ndarray

@@ -39,12 +39,19 @@ class RoleMaker:
 
     def __init__(self, rank: Optional[int] = None,
                  world: Optional[int] = None):
-        self.rank = (rank if rank is not None
-                     else int(os.environ.get("PBT_TRAINER_ID",
-                                             jax.process_index())))
-        self.world = (world if world is not None
-                      else int(os.environ.get("PBT_TRAINERS",
-                                              jax.process_count())))
+        # Env overrides are checked FIRST and jax.process_index() only
+        # touched when absent: querying it initializes the local backend,
+        # which must not happen before jax.distributed.initialize on
+        # multi-host setups — the exact case the env override serves.
+        def resolve(explicit, env, fallback):
+            if explicit is not None:
+                return explicit
+            if env in os.environ:
+                return int(os.environ[env])
+            return int(fallback())
+
+        self.rank = resolve(rank, "PBT_TRAINER_ID", jax.process_index)
+        self.world = resolve(world, "PBT_TRAINERS", jax.process_count)
 
 
 @dataclasses.dataclass
@@ -186,9 +193,15 @@ def distributed_optimizer(optimizer, *,
         chain.append(optax.clip_by_global_norm(st.clip_norm))
     if st.dgc:
         from paddlebox_tpu.parallel.dgc import dgc_transform
+        # Under gradient_merge the DGC transform only runs every k_steps
+        # (MultiSteps wraps the chain), so its step counter ticks k times
+        # slower than real steps — rescale the rampup boundary to inner
+        # steps to honor the user's real-step configuration.
+        rampup = st.dgc_configs.rampup_begin_step
+        if st.gradient_merge and st.gradient_merge_configs.k_steps > 1:
+            rampup = rampup // st.gradient_merge_configs.k_steps
         chain.append(dgc_transform(
-            sparsity=st.dgc_configs.sparsity,
-            rampup_begin_step=st.dgc_configs.rampup_begin_step))
+            sparsity=st.dgc_configs.sparsity, rampup_begin_step=rampup))
     chain.append(optimizer)
     tx = optax.chain(*chain) if len(chain) > 1 else optimizer
     every_k = 1

@@ -53,6 +53,8 @@ def auc(stat_pos: np.ndarray, stat_neg: np.ndarray,
     neg = reduce(np.asarray(stat_neg, np.float64)).ravel()
     if pos.shape != neg.shape:
         raise ValueError("stat_pos/stat_neg shape mismatch")
+    if pos.size == 0:
+        return 0.5
     # high→low sweep == reversed cumulative; vectorized trapezoid.
     tp = np.cumsum(pos[::-1])           # true positives above threshold
     fp = np.cumsum(neg[::-1])

@@ -61,13 +61,13 @@ def dgc_transform(sparsity: float = 0.999,
             resid = jnp.where(active, new_resid, r)
             return out, resid
 
-        # Two passes over the original treedef — splitting a tree of
-        # (out, resid) pairs with is_leaf=isinstance(tuple) would also
-        # stop at tuples that are containers in the grads pytree itself.
-        outs = jax.tree_util.tree_map(lambda g, r: compress(g, r)[0],
-                                      grads, state.residual)
-        resids = jax.tree_util.tree_map(lambda g, r: compress(g, r)[1],
-                                        grads, state.residual)
+        # One compress per leaf; tree_transpose splits the (out, resid)
+        # pairs against the ORIGINAL treedef, which stays correct even
+        # when the grads pytree itself contains tuples as containers.
+        pairs = jax.tree_util.tree_map(compress, grads, state.residual)
+        outs, resids = jax.tree_util.tree_transpose(
+            jax.tree_util.tree_structure(grads),
+            jax.tree_util.tree_structure((0, 0)), pairs)
         return outs, DGCState(step=state.step + 1, residual=resids)
 
     return optax.GradientTransformation(init, update)
