@@ -1,0 +1,307 @@
+"""FleetExecutor: actor-style microbatch dataflow runtime.
+
+Role of the reference fleet executor (``distributed/fleet_executor/``):
+``Carrier`` hosting ``Interceptor`` message loops (``carrier.h``,
+``interceptor.h``) with compute/source/sink/amplifier interceptor types,
+``TaskLoop`` worker threads, and a brpc ``MessageBus`` crossing nodes
+(``message_bus.h``); ``FleetExecutor::Run`` (``fleet_executor.h:35``)
+drives ``num_micro_batches`` scopes through the task DAG.
+
+TPU-first framing: device-side pipeline parallelism compiles into the pjit
+program (``parallel/pp.py``), so this runtime orchestrates *host-side*
+stages — data load → pass build → train-dispatch → dump/eval chains,
+cross-host control flow, and any CPU pre/post-processing DAG — where an
+actor model with bounded queues is the right tool. Messages carry
+(scope_id, payload); each interceptor processes scopes in order, with
+backpressure from bounded inboxes.
+
+In-process buses wire carriers directly; a TCP bus (length-prefixed
+pickle, same framing as the PS service) crosses hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from paddlebox_tpu.core import log
+
+STOP = object()  # sentinel flowing through the DAG after the last scope
+
+
+@dataclasses.dataclass
+class TaskNode:
+    """One node of the dataflow DAG (role of fleet_executor TaskNode):
+    ``fn(payload) -> payload`` for compute nodes; source nodes call
+    ``fn(scope_id)`` to produce payloads; sink nodes collect; amplifiers
+    replicate each input ``factor`` times (role of the amplifier
+    interceptor driving per-microbatch repeated stages)."""
+
+    task_id: int
+    role: str = "compute"               # source | compute | sink | amplifier
+    fn: Optional[Callable[..., Any]] = None
+    downstream: Tuple[int, ...] = ()
+    upstream: Tuple[int, ...] = ()
+    rank: int = 0                       # which carrier owns this node
+    factor: int = 1                     # amplifier replication factor
+    buffer_size: int = 8                # inbox bound (backpressure)
+
+
+@dataclasses.dataclass
+class _Msg:
+    src: int
+    dst: int
+    scope: int          # microbatch / scope id
+    payload: Any        # STOP or data
+
+
+class MessageBus:
+    """Routes messages to the carrier owning the destination task (role of
+    message_bus.h). In-process: direct enqueue. Remote ranks: register a
+    sender callable (e.g. built on transport.TcpTransport)."""
+
+    def __init__(self):
+        self._local: Dict[int, "Carrier"] = {}
+        self._remote: Dict[int, Callable[[_Msg], None]] = {}
+
+    def register_carrier(self, rank: int, carrier: "Carrier") -> None:
+        self._local[rank] = carrier
+
+    def register_remote(self, rank: int,
+                        send: Callable[[_Msg], None]) -> None:
+        self._remote[rank] = send
+
+    def send(self, dst_rank: int, msg: _Msg) -> None:
+        if dst_rank in self._local:
+            self._local[dst_rank].deliver(msg)
+        elif dst_rank in self._remote:
+            self._remote[dst_rank](msg)
+        else:
+            raise KeyError(f"no route to rank {dst_rank}")
+
+
+class Interceptor:
+    """One actor: bounded inbox + handler thread (role of interceptor.h
+    message loop; the dedicated thread is the TaskLoop)."""
+
+    def __init__(self, node: TaskNode, carrier: "Carrier"):
+        self.node = node
+        self.carrier = carrier
+        self.inbox: "queue.Queue[_Msg]" = queue.Queue(node.buffer_size)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        # scope_id -> {src: payload}: compute nodes join all upstreams
+        # before firing (role of in_readys_ counting in compute_interceptor)
+        self._pending: Dict[int, Dict[int, Any]] = {}
+        self._stops_seen = 0
+        self.error: Optional[BaseException] = None
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def join(self) -> None:
+        self._thread.join()
+
+    def _send_down(self, scope: int, payload: Any) -> None:
+        for dst in self.node.downstream:
+            self.carrier.route(_Msg(self.node.task_id, dst, scope, payload))
+
+    def _loop(self) -> None:
+        node = self.node
+        n_up = max(len(node.upstream), 1)
+        try:
+            while True:
+                msg = self.inbox.get()
+                if msg.payload is STOP:
+                    self._stops_seen += 1
+                    # forward STOP once every upstream has finished
+                    if self._stops_seen >= n_up:
+                        self._send_down(msg.scope, STOP)
+                        return
+                    continue
+                if node.role == "amplifier":
+                    for i in range(node.factor):
+                        out = node.fn(msg.payload) if node.fn else msg.payload
+                        self._send_down(msg.scope * node.factor + i, out)
+                    continue
+                if n_up == 1:
+                    joined = msg.payload
+                else:
+                    slot = self._pending.setdefault(msg.scope, {})
+                    slot[msg.src] = msg.payload
+                    if len(slot) < n_up:
+                        continue
+                    joined = [slot[s] for s in node.upstream]
+                    del self._pending[msg.scope]
+                out = node.fn(joined) if node.fn else joined
+                if node.role == "sink":
+                    self.carrier.collect(msg.scope, out)
+                else:
+                    self._send_down(msg.scope, out)
+        except BaseException as e:  # propagate to carrier, stop DAG
+            self.error = e
+            self.carrier.abort(e)
+
+
+class Carrier:
+    """Owns the interceptors of one rank's task nodes (role of carrier.h);
+    ``run`` drives source nodes for num_micro_batches scopes and returns
+    the sink's collected outputs in scope order."""
+
+    def __init__(self, nodes: Sequence[TaskNode], rank: int = 0,
+                 bus: Optional[MessageBus] = None):
+        self.rank = rank
+        self.bus = bus or MessageBus()
+        self.bus.register_carrier(rank, self)
+        self.nodes = {n.task_id: n for n in nodes}
+        self._rank_of = {n.task_id: n.rank for n in nodes}
+        self._results: Dict[int, Any] = {}
+        self._results_lock = threading.Lock()
+        self._done = threading.Event()
+        self._aborted = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._expected: Optional[int] = None
+        self._consumed = False
+        self.interceptors: Dict[int, Interceptor] = {}
+        self._spawn_interceptors()
+
+    def _spawn_interceptors(self) -> None:
+        self.interceptors = {n.task_id: Interceptor(n, self)
+                             for n in self.nodes.values()
+                             if n.rank == self.rank}
+        for it in self.interceptors.values():
+            it.start()
+
+    def reset(self) -> None:
+        """Arm for another run: interceptor threads exit after forwarding
+        STOP (or on abort), so each run needs a fresh set. Dead threads
+        blocked on full inboxes from an aborted run are daemons and are
+        simply abandoned. Non-driving carriers of a multi-rank DAG must
+        reset between runs too."""
+        self._aborted.set()   # release anything blocked in deliver()
+        self._aborted = threading.Event()
+        self._done.clear()
+        self._error = None
+        self._results.clear()
+        self._consumed = False
+        self._spawn_interceptors()
+
+    # -- routing -----------------------------------------------------------
+
+    def register_remote_node(self, task_id: int, rank: int) -> None:
+        """Declare a node living on another rank (its carrier must be
+        reachable through the shared bus)."""
+        self._rank_of[task_id] = rank
+
+    def route(self, msg: _Msg) -> None:
+        self.bus.send(self._rank_of[msg.dst], msg)
+
+    def deliver(self, msg: _Msg) -> None:
+        # Bounded put that bails out on abort: without the check, a sender
+        # blocked on a dead interceptor's full inbox would hang forever.
+        inbox = self.interceptors[msg.dst].inbox
+        while not self._aborted.is_set():
+            try:
+                inbox.put(msg, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
+    # -- sink/collection ---------------------------------------------------
+
+    def collect(self, scope: int, payload: Any) -> None:
+        if payload is STOP:
+            self._done.set()
+            return
+        with self._results_lock:
+            self._results[scope] = payload
+            if self._expected is not None \
+                    and len(self._results) >= self._expected:
+                self._done.set()
+
+    def abort(self, err: BaseException) -> None:
+        self._error = err
+        self._aborted.set()
+        self._done.set()
+
+    # -- driving -----------------------------------------------------------
+
+    def run(self, num_micro_batches: int,
+            feeds: Optional[Sequence[Any]] = None,
+            timeout: float = 300.0) -> List[Any]:
+        """Emit one scope per microbatch from every source node, wait for
+        the sink to drain (role of FleetExecutor::Run)."""
+        if self._consumed:
+            self.reset()
+        self._results.clear()
+        self._done.clear()
+        self._error = None
+        self._expected = self._count_sink_scopes(num_micro_batches)
+        sources = [n for n in self.nodes.values() if n.role == "source"
+                   and n.rank == self.rank]
+        if not sources:
+            raise ValueError("carrier has no local source node")
+
+        def feed(src: TaskNode):
+            it = self.interceptors[src.task_id]
+
+            def put(msg: _Msg) -> bool:
+                # Abort-aware bounded put: after an interceptor error the
+                # queues stop draining, and a plain blocking put would
+                # wedge this feeder (and run()'s join) forever.
+                while not self._done.is_set():
+                    try:
+                        it.inbox.put(msg, timeout=0.05)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+
+            for scope in range(num_micro_batches):
+                payload = feeds[scope] if feeds is not None \
+                    else (src.fn(scope) if src.fn else scope)
+                if not put(_Msg(-1, src.task_id, scope, payload)):
+                    return
+            put(_Msg(-1, src.task_id, num_micro_batches, STOP))
+
+        feeders = [threading.Thread(target=feed, args=(s,), daemon=True)
+                   for s in sources]
+        [t.start() for t in feeders]
+        try:
+            if not self._done.wait(timeout):
+                raise TimeoutError("fleet executor did not drain")
+        finally:
+            self._consumed = True
+        [t.join() for t in feeders]
+        if self._error is not None:
+            raise RuntimeError("interceptor failed") from self._error
+        return [self._results[k] for k in sorted(self._results)]
+
+    def _count_sink_scopes(self, num_micro_batches: int) -> int:
+        """Scopes the sink will see = microbatches × product of amplifier
+        factors along any path (assumed uniform)."""
+        n = num_micro_batches
+        for node in self.nodes.values():
+            if node.role == "amplifier":
+                n *= node.factor
+        return n
+
+    def shutdown(self) -> None:
+        self._done.set()
+
+
+def linear_pipeline(fns: Sequence[Callable[[Any], Any]],
+                    buffer_size: int = 8) -> List[TaskNode]:
+    """Helper: source → fn1 → fn2 → ... → sink DAG, the common host
+    pipeline shape (load → parse → build → consume)."""
+    nodes = [TaskNode(task_id=0, role="source", downstream=(1,),
+                      buffer_size=buffer_size)]
+    for i, fn in enumerate(fns, start=1):
+        nodes.append(TaskNode(task_id=i, role="compute", fn=fn,
+                              upstream=(i - 1,), downstream=(i + 1,),
+                              buffer_size=buffer_size))
+    last = len(fns) + 1
+    nodes.append(TaskNode(task_id=last, role="sink", upstream=(last - 1,),
+                          buffer_size=buffer_size))
+    return nodes

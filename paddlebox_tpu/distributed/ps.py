@@ -101,7 +101,6 @@ class PSServer:
         self._server = socket.create_server((host, int(port)), backlog=64)
         self.endpoint = f"{host}:{self._server.getsockname()[1]}"
         self._running = True
-        self._threads: List[threading.Thread] = []
         self._accept = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept.start()
 
@@ -113,10 +112,8 @@ class PSServer:
                 conn, _ = self._server.accept()
             except OSError:
                 return
-            t = threading.Thread(target=self._serve, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
         try:
@@ -131,6 +128,12 @@ class PSServer:
                         log.vlog(0, "ps[%d] %s failed: %s", self.index,
                                  method, e)
                         _send_msg(conn, {"ok": False, "error": repr(e)})
+                    if not self._running:
+                        # stop RPC: response sent, now actually close the
+                        # listener (stop accepting new work; other live
+                        # connections drain until their clients close).
+                        self.stop()
+                        return
         except (ConnectionError, OSError, EOFError):
             return
 
@@ -194,14 +197,18 @@ class PSServer:
         store = self.tables[req["table"]]
         keys = np.asarray(req["keys"], np.uint64)
         self._check_owned(keys)
-        return store.pull_for_pass(keys)
+        with self._table_locks[req["table"]]:
+            return store.pull_for_pass(keys)
 
     def handle_push_pass(self, req) -> int:
         """Bulk write-back at EndPass (ps_gpu_wrapper.cc:983)."""
         store = self.tables[req["table"]]
         keys = np.asarray(req["keys"], np.uint64)
         self._check_owned(keys)
-        store.push_from_pass(keys, req["values"])
+        # Table lock: a concurrent push_sparse RMW reading stale rows must
+        # not overwrite this bulk write-back.
+        with self._table_locks[req["table"]]:
+            store.push_from_pass(keys, req["values"])
         return int(keys.size)
 
     # -- dense -------------------------------------------------------------
@@ -397,12 +404,28 @@ class PSClient:
         """Bulk pass-build fetch, reassembled to the sorted key order."""
         keys = np.asarray(keys_sorted, np.uint64)
         owner, order = self._split(keys)
-        fields: Dict[str, np.ndarray] = {}
+        results: Dict[int, Tuple[np.ndarray, Dict[str, np.ndarray]]] = {}
+        errs: List[BaseException] = []
+        threads = []
         for s in range(self.num_servers):
             idx = order[owner[order] == s]
             if idx.size == 0:
                 continue
-            res = self._call(s, "pull_pass", table=table, keys=keys[idx])
+
+            def run(s=s, idx=idx):
+                try:
+                    results[s] = (idx, self._call(s, "pull_pass",
+                                                  table=table,
+                                                  keys=keys[idx]))
+                except BaseException as e:
+                    errs.append(e)
+            threads.append(threading.Thread(target=run))
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        if errs:
+            raise errs[0]
+        fields: Dict[str, np.ndarray] = {}
+        for s, (idx, res) in results.items():
             for f, arr in res.items():
                 if f not in fields:
                     fields[f] = np.empty((keys.size,) + arr.shape[1:],
@@ -414,12 +437,24 @@ class PSClient:
                   values: Dict[str, np.ndarray]) -> None:
         keys = np.asarray(keys_sorted, np.uint64)
         owner, order = self._split(keys)
+        errs: List[BaseException] = []
+        threads = []
         for s in range(self.num_servers):
             idx = order[owner[order] == s]
             if idx.size == 0:
                 continue
-            self._call(s, "push_pass", table=table, keys=keys[idx],
-                       values={f: a[idx] for f, a in values.items()})
+
+            def run(s=s, idx=idx):
+                try:
+                    self._call(s, "push_pass", table=table, keys=keys[idx],
+                               values={f: a[idx] for f, a in values.items()})
+                except BaseException as e:
+                    errs.append(e)
+            threads.append(threading.Thread(target=run))
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        if errs:
+            raise errs[0]
 
     # -- dense / lifecycle -------------------------------------------------
 

@@ -1,0 +1,204 @@
+"""Elastic membership manager: lease heartbeats, scale watch, rank reassign.
+
+Role of the reference ``ElasticManager`` (``fleet/elastic/manager.py:131``):
+host heartbeats through etcd leases (:236), watch callbacks on scale in/out
+(:443), fault-tolerant rank reassignment rewriting the trainer rank table,
+and restart hooks; plus the launch watcher restarting dead ranks.
+
+TPU-first/infra-neutral: the coordination substrate is a shared directory
+(NFS/GCS-fuse — the same trick as the reference's Gloo HdfsStore rendezvous,
+``gloo_wrapper.h:53``) instead of etcd: each host touches a heartbeat file
+every ``heartbeat_interval``; membership = files fresher than ``timeout``.
+The lexicographically-first alive host acts as leader and publishes a new
+generation of the rank table when stable membership changes; every host
+polls the table and fires the registered callback so training can restart
+from the last published base+delta checkpoint
+(:mod:`paddlebox_tpu.checkpoint.protocol` ``recovery_chain``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from paddlebox_tpu.core import log
+
+
+@dataclasses.dataclass
+class RankTable:
+    """One membership generation: host id → contiguous rank."""
+
+    generation: int
+    hosts: List[str]                  # sorted; index = rank
+
+    def rank_of(self, host_id: str) -> Optional[int]:
+        try:
+            return self.hosts.index(host_id)
+        except ValueError:
+            return None
+
+    @property
+    def world_size(self) -> int:
+        return len(self.hosts)
+
+
+class ElasticManager:
+    """Directory-lease membership + leader-published rank table."""
+
+    def __init__(self, root: str, host_id: str, *,
+                 min_hosts: int = 1, max_hosts: int = 0,
+                 heartbeat_interval: float = 0.5, timeout: float = 2.0,
+                 settle: float = 0.5,
+                 on_change: Optional[Callable[[RankTable], None]] = None):
+        self.root = root
+        self.host_id = host_id
+        self.min_hosts = min_hosts
+        self.max_hosts = max_hosts      # 0 = unbounded
+        self.heartbeat_interval = heartbeat_interval
+        self.timeout = timeout
+        self.settle = settle
+        self.on_change = on_change
+        self._hb_dir = os.path.join(root, "hosts")
+        os.makedirs(self._hb_dir, exist_ok=True)
+        self._running = False
+        self._threads: List[threading.Thread] = []
+        self._table: Optional[RankTable] = None
+        self._table_lock = threading.Lock()
+
+    # -- heartbeat lease ---------------------------------------------------
+
+    def _hb_path(self, host: str) -> str:
+        return os.path.join(self._hb_dir, host)
+
+    def _beat(self) -> None:
+        path = self._hb_path(self.host_id)
+        with open(path, "w") as f:
+            f.write(str(time.time()))
+
+    def alive_hosts(self) -> List[str]:
+        """Hosts with a fresh heartbeat (capped at max_hosts by sorted
+        order, matching the reference's np scale bounds)."""
+        now = time.time()
+        alive = []
+        for name in os.listdir(self._hb_dir):
+            try:
+                if now - os.path.getmtime(self._hb_path(name)) < self.timeout:
+                    alive.append(name)
+            except OSError:
+                continue
+        alive.sort()
+        if self.max_hosts:
+            alive = alive[:self.max_hosts]
+        return alive
+
+    # -- rank table --------------------------------------------------------
+
+    def _table_path(self) -> str:
+        return os.path.join(self.root, "ranktable.json")
+
+    def _read_table(self) -> Optional[RankTable]:
+        try:
+            with open(self._table_path()) as f:
+                d = json.load(f)
+            return RankTable(generation=d["generation"], hosts=d["hosts"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _publish_table(self, hosts: List[str]) -> None:
+        prev = self._read_table()
+        gen = (prev.generation + 1) if prev else 0
+        tmp = self._table_path() + f".{self.host_id}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"generation": gen, "hosts": hosts,
+                       "ts": time.time()}, f)
+        os.replace(tmp, self._table_path())
+        log.vlog(0, "elastic: leader %s published gen %d hosts=%s",
+                 self.host_id, gen, hosts)
+
+    def current_table(self) -> Optional[RankTable]:
+        with self._table_lock:
+            return self._table
+
+    def current_rank(self) -> Optional[int]:
+        t = self.current_table()
+        return t.rank_of(self.host_id) if t else None
+
+    def is_leader(self) -> bool:
+        alive = self.alive_hosts()
+        return bool(alive) and alive[0] == self.host_id
+
+    # -- watch loops -------------------------------------------------------
+
+    def _hb_loop(self) -> None:
+        while self._running:
+            self._beat()
+            time.sleep(self.heartbeat_interval)
+
+    def _watch_loop(self) -> None:
+        pending: Optional[List[str]] = None
+        pending_since = 0.0
+        while self._running:
+            time.sleep(self.heartbeat_interval / 2)
+            alive = self.alive_hosts()
+            if len(alive) < self.min_hosts:
+                continue  # below quorum: hold the old table (ref :443 wait)
+            published = self._read_table()
+            cur_hosts = published.hosts if published else None
+            if alive != cur_hosts:
+                # Require membership stable for `settle` before reranking —
+                # a host mid-restart must not trigger two reassignments.
+                if pending != alive:
+                    pending = alive
+                    pending_since = time.time()
+                elif time.time() - pending_since >= self.settle:
+                    if self.is_leader():
+                        self._publish_table(alive)
+                    pending = None
+            else:
+                pending = None
+            # Everyone (leader included) adopts new generations + callback.
+            if published is not None:
+                with self._table_lock:
+                    stale = (self._table is None or
+                             self._table.generation != published.generation)
+                    self._table = published
+                if stale and self.on_change is not None:
+                    try:
+                        self.on_change(published)
+                    except Exception as e:
+                        log.error("elastic on_change failed: %s", e)
+
+    def start(self) -> None:
+        self._running = True
+        self._beat()
+        for target in (self._hb_loop, self._watch_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, *, remove_lease: bool = True) -> None:
+        self._running = False
+        for t in self._threads:
+            t.join(self.timeout)
+        self._threads.clear()
+        if remove_lease:
+            try:
+                os.unlink(self._hb_path(self.host_id))
+            except OSError:
+                pass
+
+    def wait_for_quorum(self, timeout: float = 30.0) -> RankTable:
+        """Block until a rank table covering >= min_hosts exists and
+        includes this host (role of the reference's pod-ready barrier)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            t = self.current_table()
+            if t and t.world_size >= self.min_hosts \
+                    and t.rank_of(self.host_id) is not None:
+                return t
+            time.sleep(self.heartbeat_interval / 2)
+        raise TimeoutError("elastic quorum not reached")

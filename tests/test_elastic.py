@@ -1,0 +1,115 @@
+"""Elastic manager tests: heartbeat membership, leader rank-table
+publication, scale-out/in reassignment with callbacks, quorum hold
+(mirrors the reference elastic manager scenarios, which CI tests by
+killing subprocesses — here manager instances share a tmpdir)."""
+
+import time
+
+import pytest
+
+from paddlebox_tpu.launch.elastic import ElasticManager, RankTable
+
+FAST = dict(heartbeat_interval=0.05, timeout=0.4, settle=0.1)
+
+
+def _mk(root, host, **kw):
+    m = ElasticManager(str(root), host, **{**FAST, **kw})
+    m.start()
+    return m
+
+
+def test_membership_and_ranktable(tmp_path):
+    a = _mk(tmp_path, "host-a", min_hosts=2)
+    b = _mk(tmp_path, "host-b", min_hosts=2)
+    try:
+        ta = a.wait_for_quorum(5.0)
+        tb = b.wait_for_quorum(5.0)
+        assert ta.hosts == tb.hosts == ["host-a", "host-b"]
+        assert a.current_rank() == 0 and b.current_rank() == 1
+        assert a.is_leader() and not b.is_leader()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_scale_out_triggers_callback(tmp_path):
+    events = []
+    a = _mk(tmp_path, "host-a", on_change=lambda t: events.append(t.hosts))
+    try:
+        a.wait_for_quorum(5.0)
+        c = _mk(tmp_path, "host-c")
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                t = a.current_table()
+                if t and t.world_size == 2:
+                    break
+                time.sleep(0.05)
+            assert a.current_table().hosts == ["host-a", "host-c"]
+            assert events[-1] == ["host-a", "host-c"]
+        finally:
+            c.stop()
+    finally:
+        a.stop()
+
+
+def test_scale_in_reassigns_ranks(tmp_path):
+    a = _mk(tmp_path, "host-a", min_hosts=1)
+    b = _mk(tmp_path, "host-b", min_hosts=1)
+    try:
+        a.wait_for_quorum(5.0)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            t = a.current_table()
+            if t and t.world_size == 2:
+                break
+            time.sleep(0.05)
+        b.stop()  # lease removed -> scale-in
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            t = a.current_table()
+            if t and t.world_size == 1:
+                break
+            time.sleep(0.05)
+        assert a.current_table().hosts == ["host-a"]
+        assert a.current_rank() == 0
+    finally:
+        a.stop()
+
+
+def test_quorum_hold_below_min(tmp_path):
+    """Below min_hosts no table is published (job holds, reference :443)."""
+    a = _mk(tmp_path, "host-a", min_hosts=2)
+    try:
+        with pytest.raises(TimeoutError):
+            a.wait_for_quorum(0.6)
+        assert a.current_table() is None
+    finally:
+        a.stop()
+
+
+def test_leader_failover(tmp_path):
+    a = _mk(tmp_path, "host-a", min_hosts=1)
+    b = _mk(tmp_path, "host-b", min_hosts=1)
+    try:
+        a.wait_for_quorum(5.0)
+        assert a.is_leader()
+        a.stop()  # leader dies; host-b takes over and republishes
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            t = b.current_table()
+            if t and t.hosts == ["host-b"]:
+                break
+            time.sleep(0.05)
+        assert b.is_leader()
+        assert b.current_table().hosts == ["host-b"]
+        assert b.current_rank() == 0
+    finally:
+        b.stop()
+
+
+def test_ranktable_helpers():
+    t = RankTable(generation=3, hosts=["x", "y"])
+    assert t.rank_of("y") == 1
+    assert t.rank_of("zz") is None
+    assert t.world_size == 2

@@ -1,0 +1,153 @@
+"""FleetExecutor interceptor-runtime tests: linear pipeline ordering,
+diamond-join DAG, amplifier fan-out, cross-carrier routing over a shared
+bus, backpressure bounds, and error propagation."""
+
+import threading
+import time
+
+import pytest
+
+from paddlebox_tpu.distributed.fleet_executor import (Carrier, MessageBus,
+                                                      TaskNode,
+                                                      linear_pipeline)
+
+
+def test_linear_pipeline_order_and_values():
+    nodes = linear_pipeline([lambda x: x + 1, lambda x: x * 2])
+    c = Carrier(nodes)
+    out = c.run(8, feeds=list(range(8)))
+    assert out == [(i + 1) * 2 for i in range(8)]
+
+
+def test_pipeline_overlaps_stages():
+    """Stage threads run concurrently: total wall-time of N microbatches
+    through two 10ms stages must be far below serial N*2*10ms."""
+    def slow(x):
+        time.sleep(0.01)
+        return x
+
+    nodes = linear_pipeline([slow, slow, slow])
+    c = Carrier(nodes)
+    t0 = time.time()
+    out = c.run(20, feeds=list(range(20)))
+    elapsed = time.time() - t0
+    assert out == list(range(20))
+    assert elapsed < 0.45  # serial would be 20*3*0.01 = 0.6s + overhead
+
+
+def test_diamond_join():
+    #        1 (x+1)
+    # 0 src <         > 3 (sum) -> 4 sink
+    #        2 (x*10)
+    nodes = [
+        TaskNode(0, role="source", downstream=(1, 2)),
+        TaskNode(1, fn=lambda x: x + 1, upstream=(0,), downstream=(3,)),
+        TaskNode(2, fn=lambda x: x * 10, upstream=(0,), downstream=(3,)),
+        TaskNode(3, fn=lambda pair: pair[0] + pair[1], upstream=(1, 2),
+                 downstream=(4,)),
+        TaskNode(4, role="sink", upstream=(3,)),
+    ]
+    c = Carrier(nodes)
+    out = c.run(5, feeds=[1, 2, 3, 4, 5])
+    assert out == [x + 1 + 10 * x for x in [1, 2, 3, 4, 5]]
+
+
+def test_amplifier_fanout():
+    nodes = [
+        TaskNode(0, role="source", downstream=(1,)),
+        TaskNode(1, role="amplifier", factor=3, upstream=(0,),
+                 downstream=(2,)),
+        TaskNode(2, fn=lambda x: x, upstream=(1,), downstream=(3,)),
+        TaskNode(3, role="sink", upstream=(2,)),
+    ]
+    c = Carrier(nodes)
+    out = c.run(2, feeds=["a", "b"])
+    assert out == ["a", "a", "a", "b", "b", "b"]
+
+
+def test_cross_carrier_routing():
+    """Middle stage lives on another carrier; messages hop 0 -> 1 -> 0
+    through the shared bus (role of the brpc MessageBus crossing nodes)."""
+    nodes = [
+        TaskNode(0, role="source", downstream=(1,), rank=0),
+        TaskNode(1, fn=lambda x: x * x, upstream=(0,), downstream=(2,),
+                 rank=1),
+        TaskNode(2, role="sink", upstream=(1,), rank=0),
+    ]
+    bus = MessageBus()
+    c0 = Carrier(nodes, rank=0, bus=bus)
+    c1 = Carrier(nodes, rank=1, bus=bus)
+    out = c0.run(6, feeds=[1, 2, 3, 4, 5, 6])
+    assert out == [1, 4, 9, 16, 25, 36]
+    c1.shutdown()
+
+
+def test_error_propagates():
+    def boom(x):
+        if x == 3:
+            raise ValueError("bad microbatch")
+        return x
+
+    nodes = linear_pipeline([boom])
+    c = Carrier(nodes)
+    with pytest.raises(RuntimeError):
+        c.run(8, feeds=list(range(8)))
+
+
+def test_error_does_not_hang_with_deep_feed():
+    """More microbatches than total queue capacity: after the first-stage
+    error the feeder is blocked on a full inbox; abort must still unwedge
+    run() promptly (regression for the feeder-join hang)."""
+    def boom(x):
+        raise ValueError("always")
+
+    nodes = linear_pipeline([boom], buffer_size=2)
+    c = Carrier(nodes)
+    t0 = time.time()
+    with pytest.raises(RuntimeError):
+        c.run(64, feeds=list(range(64)), timeout=30.0)
+    assert time.time() - t0 < 5.0
+
+
+def test_carrier_reusable_across_runs():
+    nodes = linear_pipeline([lambda x: x + 1])
+    c = Carrier(nodes)
+    assert c.run(4, feeds=[0, 1, 2, 3]) == [1, 2, 3, 4]
+    assert c.run(4, feeds=[10, 11, 12, 13]) == [11, 12, 13, 14]
+    # reusable after an error too
+    def boom(x):
+        raise ValueError()
+    c2 = Carrier(linear_pipeline([boom]))
+    with pytest.raises(RuntimeError):
+        c2.run(4, feeds=list(range(4)))
+    c2.nodes[1].fn = lambda x: x * 3
+    c2.reset()
+    assert c2.run(2, feeds=[1, 2]) == [3, 6]
+
+
+def test_backpressure_bounded_inbox():
+    """A slow consumer bounds the producer: the fast stage cannot run
+    more than buffer_size ahead."""
+    seen = []
+    gate = threading.Event()
+
+    def fast(x):
+        seen.append(x)
+        return x
+
+    def slow(x):
+        gate.wait(2.0)
+        return x
+
+    nodes = linear_pipeline([fast, slow], buffer_size=2)
+    c = Carrier(nodes)
+    t = threading.Thread(target=lambda: c.run(12, feeds=list(range(12))),
+                         daemon=True)
+    t.start()
+    time.sleep(0.3)
+    # fast stage blocked: at most buffer(2) in slow inbox + 1 in flight +
+    # a couple queued at fast itself
+    assert len(seen) <= 6
+    gate.set()
+    t.join(5.0)
+    assert len(seen) == 12
