@@ -1,0 +1,16 @@
+"""Graph learning engine (role of the reference GPU graph engine §2.3:
+GpuPsGraphTable + samplers + GraphGpuWrapper + GraphDataGenerator)."""
+
+from paddlebox_tpu.graph.table import (CSRGraph, DeviceGraph, GraphTable,
+                                       build_csr, load_edge_file)
+from paddlebox_tpu.graph.sampler import (device_arrays, negative_samples,
+                                         random_walk, sample_neighbors,
+                                         skip_gram_pairs)
+from paddlebox_tpu.graph.data_generator import (GraphDataGenerator,
+                                                GraphGenConfig)
+
+__all__ = [
+    "CSRGraph", "DeviceGraph", "GraphTable", "build_csr", "load_edge_file",
+    "device_arrays", "negative_samples", "random_walk", "sample_neighbors",
+    "skip_gram_pairs", "GraphDataGenerator", "GraphGenConfig",
+]

@@ -1,0 +1,83 @@
+"""GraphDataGenerator: walks → skip-gram training batches.
+
+Role of the reference ``GraphDataGenerator`` (``framework/data_feed.h:892``,
+CUDA fill in ``data_feed.cu``): the graph-learning data feed that walks the
+GPU-resident graph and emits (center, context, negatives) minibatches to
+the trainer, double-buffered ahead of consumption.
+
+TPU-first: batches have STATIC shapes — ``batch_pairs`` pairs per step with
+``num_neg`` negatives each, masks instead of ragged drops — so the train
+step jits once. Walk generation runs on device (sampler.random_walk);
+iteration state is a host-side cursor over shuffled start nodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.graph import sampler
+from paddlebox_tpu.graph.table import DeviceGraph, GraphTable
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphGenConfig:
+    """Knobs mirroring the reference's graph_config fields in
+    DataFeedDesc (``data_feed.proto`` graph_config: walk_len, walk_degree,
+    window, batch_size, samples)."""
+
+    walk_len: int = 8
+    window: int = 3
+    num_neg: int = 4
+    batch_walks: int = 64       # start nodes per generated chunk
+    seed: int = 0
+
+
+class GraphDataGenerator:
+    """Iterate (centers, contexts, negatives, mask) static-shape batches."""
+
+    def __init__(self, table: GraphTable, edge_type: str,
+                 config: GraphGenConfig = GraphGenConfig(),
+                 max_degree: Optional[int] = None):
+        self.config = config
+        self.table = table
+        g = table.device_graph(edge_type, max_degree)
+        self._nbrs, self._deg = sampler.device_arrays(g)
+        self._num_nodes = g.nbrs.shape[0]
+        self._rng = np.random.default_rng(config.seed)
+        self._key = jax.random.PRNGKey(config.seed)
+
+    def _next_key(self) -> jax.Array:
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def batches(self, epochs: int = 1) -> Iterator[Dict[str, jax.Array]]:
+        """Yield skip-gram batches covering every node's walks per epoch
+        (role of DoWalkandSage/GenerateSampleBatch)."""
+        cfg = self.config
+        for _ in range(epochs):
+            starts = self._rng.permutation(self._num_nodes)
+            for i in range(0, len(starts), cfg.batch_walks):
+                chunk = starts[i:i + cfg.batch_walks]
+                if len(chunk) < cfg.batch_walks:  # pad to static shape
+                    pad = self._rng.choice(starts, cfg.batch_walks
+                                           - len(chunk))
+                    chunk = np.concatenate([chunk, pad])
+                walks = sampler.random_walk(
+                    self._nbrs, self._deg, jnp.asarray(chunk, jnp.int32),
+                    self._next_key(), cfg.walk_len)
+                pairs = sampler.skip_gram_pairs(walks, cfg.window)
+                negs = sampler.negative_samples(
+                    self._next_key(), pairs.shape[0], cfg.num_neg,
+                    self._num_nodes)
+                yield {
+                    "centers": pairs[:, 0],
+                    "contexts": pairs[:, 1],
+                    "negatives": negs,
+                    # boundary-crossing pairs were emitted as self-pairs
+                    "mask": (pairs[:, 0] != pairs[:, 1]),
+                }

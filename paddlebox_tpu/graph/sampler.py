@@ -1,0 +1,86 @@
+"""Device-side graph sampling: neighbor sample + random walks in XLA.
+
+Role of the reference's CUDA sample kernels (``graph_gpu_ps_table_inl.cu``
+neighbor_sample / ``graph_sampler.h``, walk generation inside
+``GraphDataGenerator``): warp-per-node gathers from GPU neighbor lists.
+
+TPU-first: the padded DeviceGraph makes every primitive a batched gather
+with static shapes — sample k neighbors = gather at ``rand % degree``
+(with replacement; degree-0 nodes self-loop via the padding), random walk
+= ``lax.scan`` of that gather. All functions are jittable and vmap/pjit
+friendly (shard the node batch over dp).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.graph.table import DeviceGraph
+
+
+def device_arrays(g: DeviceGraph) -> Tuple[jax.Array, jax.Array]:
+    return jnp.asarray(g.nbrs), jnp.asarray(g.degree)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def sample_neighbors(nbrs: jax.Array, degree: jax.Array, nodes: jax.Array,
+                     key: jax.Array, k: int) -> jax.Array:
+    """[B] nodes → [B, k] uniform neighbor sample with replacement.
+    Degree-0 nodes return themselves (self-loop padding)."""
+    b = nodes.shape[0]
+    deg = jnp.maximum(degree[nodes], 1)                       # [B]
+    r = jax.random.randint(key, (b, k), 0, 1 << 30)
+    idx = (r % deg[:, None]).astype(jnp.int32)                # [B,k]
+    return jnp.take_along_axis(nbrs[nodes], idx, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("walk_len",))
+def random_walk(nbrs: jax.Array, degree: jax.Array, starts: jax.Array,
+                key: jax.Array, walk_len: int) -> jax.Array:
+    """[B] start nodes → [B, walk_len+1] uniform random walks (role of the
+    deepwalk walk generation in GraphDataGenerator)."""
+
+    def step(cur, k):
+        nxt = sample_neighbors(nbrs, degree, cur, k, 1)[:, 0]
+        return nxt, nxt
+
+    keys = jax.random.split(key, walk_len)
+    _, path = jax.lax.scan(step, starts, keys)
+    return jnp.concatenate([starts[:, None], path.T], axis=1)
+
+
+def skip_gram_pairs(walks: jax.Array, window: int) -> jax.Array:
+    """[B, L] walks → [B*P, 2] (center, context) pairs for all offsets
+    within ``window`` (role of the pair generation in
+    GraphDataGenerator::GenerateSampleBatch). Static shape: every
+    (position, offset) combination is emitted; pairs that would cross the
+    walk boundary repeat the center node (self-pair) so downstream loss
+    can mask them with ``pair[:,0] != pair[:,1]``."""
+    b, length = walks.shape
+    centers = []
+    contexts = []
+    for off in range(1, window + 1):
+        for sign in (1, -1):
+            shift = off * sign
+            ctx = jnp.roll(walks, -shift, axis=1)
+            pos = jnp.arange(length)
+            valid = ((pos + shift) >= 0) & ((pos + shift) < length)
+            ctx = jnp.where(valid[None, :], ctx, walks)
+            centers.append(walks)
+            contexts.append(ctx)
+    c = jnp.concatenate(centers, axis=1).reshape(-1)
+    x = jnp.concatenate(contexts, axis=1).reshape(-1)
+    return jnp.stack([c, x], axis=1)
+
+
+def negative_samples(key: jax.Array, num_pairs: int, num_neg: int,
+                     num_nodes: int) -> jax.Array:
+    """[P, num_neg] uniform negatives (role of the negative table in the
+    reference's graph trainer)."""
+    return jax.random.randint(key, (num_pairs, num_neg), 0, num_nodes,
+                              dtype=jnp.int32)

@@ -1,0 +1,195 @@
+"""Graph store: host CSR shards + device-resident padded adjacency.
+
+Role of the reference GPU graph engine storage (``heter_ps/
+graph_gpu_ps_table.h`` GpuPsGraphTable keeping per-GPU node/edge shards,
+``gpu_graph_node.h`` GpuPsGraphNode neighbor lists, ``GraphGpuWrapper``
+facade ``heter_ps/graph_gpu_wrapper.h:25`` with load_edge_file /
+upload_batch, and the brpc-served CPU ``common_graph_table.h``).
+
+TPU-first: the host side is one vectorized CSR per edge type (numpy,
+sharded by ``node % num_shards`` like the reference's key%n placement);
+the device side is a **padded** CSR — neighbors dense-packed to
+``max_degree`` with a sentinel, plus a degree vector — because XLA wants
+static shapes: sampling then becomes pure gather + modular arithmetic,
+no pointer chasing (the cuGraph-style warp gathers of
+``graph_gpu_ps_table_inl.cu`` collapse into one batched gather).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import log
+
+
+@dataclasses.dataclass
+class CSRGraph:
+    """Host compact adjacency: neighbors of node i are
+    ``cols[indptr[i]:indptr[i+1]]``."""
+
+    indptr: np.ndarray     # [num_nodes+1] int64
+    cols: np.ndarray       # [num_edges]  int64
+    num_nodes: int
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.cols.shape[0])
+
+    def degrees(self) -> np.ndarray:
+        return np.diff(self.indptr)
+
+    def neighbors(self, node: int) -> np.ndarray:
+        return self.cols[self.indptr[node]:self.indptr[node + 1]]
+
+
+def build_csr(src: np.ndarray, dst: np.ndarray,
+              num_nodes: Optional[int] = None,
+              symmetrize: bool = False) -> CSRGraph:
+    """Vectorized edge-list → CSR (role of load_edge_file + upload_batch:
+    the reference parses then bulk-copies shards; one argsort does it)."""
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    if symmetrize:
+        src, dst = (np.concatenate([src, dst]), np.concatenate([dst, src]))
+    if num_nodes is None:
+        num_nodes = int(max(src.max(initial=-1), dst.max(initial=-1))) + 1
+    else:
+        # Out-of-range ids would otherwise silently corrupt sampling (dst
+        # flows into cols unchecked; src dies later in a cryptic cumsum).
+        hi = max(src.max(initial=-1), dst.max(initial=-1))
+        lo = min(src.min(initial=0), dst.min(initial=0))
+        if hi >= num_nodes or lo < 0:
+            raise ValueError(
+                f"edge ids span [{lo}, {hi}] outside num_nodes={num_nodes}")
+    order = np.argsort(src, kind="stable")
+    counts = np.bincount(src, minlength=num_nodes)
+    indptr = np.zeros(num_nodes + 1, np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    return CSRGraph(indptr=indptr, cols=dst[order], num_nodes=num_nodes)
+
+
+def load_edge_file(path: str, *, delimiter: Optional[str] = None,
+                   symmetrize: bool = False,
+                   num_nodes: Optional[int] = None) -> CSRGraph:
+    """Parse a 'src dst'-per-line edge file (role of
+    GraphGpuWrapper::load_edge_file)."""
+    data = np.loadtxt(path, dtype=np.int64, delimiter=delimiter, ndmin=2)
+    if data.size == 0:
+        return build_csr(np.empty(0, np.int64), np.empty(0, np.int64),
+                         num_nodes=num_nodes or 0)
+    return build_csr(data[:, 0], data[:, 1], num_nodes=num_nodes,
+                     symmetrize=symmetrize)
+
+
+@dataclasses.dataclass
+class DeviceGraph:
+    """Padded adjacency ready for device sampling — static shapes.
+
+    ``nbrs[i, j]`` = j-th neighbor of node i for j < degree[i], else the
+    node itself (self-loop padding keeps walks inside the node id space
+    without masks).
+    """
+
+    nbrs: np.ndarray       # [num_nodes, max_degree] int32
+    degree: np.ndarray     # [num_nodes] int32
+    max_degree: int
+
+    @classmethod
+    def from_csr(cls, g: CSRGraph, max_degree: Optional[int] = None,
+                 seed: int = 0) -> "DeviceGraph":
+        """Pack CSR to padded form. Nodes with degree > max_degree keep a
+        uniform subsample (the reference's neighbor-table truncation);
+        degree-0 nodes self-loop."""
+        deg = g.degrees()
+        md = int(max_degree or max(int(deg.max(initial=1)), 1))
+        n = g.num_nodes
+        nbrs = np.repeat(np.arange(n, dtype=np.int64)[:, None], md, axis=1)
+        rng = np.random.default_rng(seed)
+        eff_deg = np.minimum(deg, md).astype(np.int32)
+        # Vectorized fill for nodes with degree <= md.
+        small = np.flatnonzero((deg > 0) & (deg <= md))
+        if small.size:
+            # position matrix [k, md] valid where col < deg
+            take = g.indptr[small][:, None] + np.arange(md)[None, :]
+            valid = np.arange(md)[None, :] < deg[small][:, None]
+            take = np.where(valid, take, g.indptr[small][:, None])
+            vals = g.cols[np.minimum(take, g.num_edges - 1)]
+            nbrs[small] = np.where(valid, vals, nbrs[small])
+        big = np.flatnonzero(deg > md)
+        if big.size:
+            # Vectorized without-replacement subsample for hub nodes (on
+            # power-law graphs with a caller-capped max_degree these can
+            # be a large fraction of nodes): assign a random sort key per
+            # edge, order edges by (owner, key), keep the first md of each
+            # owner group — a grouped shuffle with no python loop.
+            bdeg = deg[big]
+            owner = np.repeat(big, bdeg)
+            # edge index ranges of the big nodes, concatenated
+            offsets = np.repeat(g.indptr[big], bdeg)
+            ends = np.cumsum(bdeg)
+            starts = ends - bdeg
+            edges = offsets + (np.arange(owner.shape[0])
+                               - np.repeat(starts, bdeg))
+            keys = rng.random(edges.shape[0])
+            order2 = np.lexsort((keys, owner))
+            edges_s = edges[order2]
+            within = np.arange(owner.shape[0]) - np.repeat(starts, bdeg)
+            picked = g.cols[edges_s[within < md]]
+            nbrs[np.repeat(big, md),
+                 np.tile(np.arange(md), big.size)] = picked
+        return cls(nbrs=nbrs.astype(np.int32), degree=eff_deg,
+                   max_degree=md)
+
+
+class GraphTable:
+    """Sharded multi-edge-type graph facade (role of GraphGpuWrapper +
+    GpuPsGraphTable): named edge types, shard-local CSRs, padded device
+    views, and node feature storage."""
+
+    def __init__(self, num_shards: int = 1):
+        self.num_shards = num_shards
+        self._graphs: Dict[str, CSRGraph] = {}
+        self._device: Dict[str, DeviceGraph] = {}
+        self._feats: Dict[str, np.ndarray] = {}
+
+    def add_edges(self, edge_type: str, src: np.ndarray, dst: np.ndarray,
+                  *, num_nodes: Optional[int] = None,
+                  symmetrize: bool = False) -> CSRGraph:
+        g = build_csr(src, dst, num_nodes=num_nodes, symmetrize=symmetrize)
+        self._graphs[edge_type] = g
+        self._device.pop(edge_type, None)
+        log.vlog(1, "graph[%s]: %d nodes %d edges", edge_type, g.num_nodes,
+                 g.num_edges)
+        return g
+
+    def load_edge_file(self, edge_type: str, path: str, **kw) -> CSRGraph:
+        g = load_edge_file(path, **kw)
+        self._graphs[edge_type] = g
+        self._device.pop(edge_type, None)
+        return g
+
+    def graph(self, edge_type: str) -> CSRGraph:
+        return self._graphs[edge_type]
+
+    def device_graph(self, edge_type: str,
+                     max_degree: Optional[int] = None) -> DeviceGraph:
+        """Padded device view, cached per edge type (role of
+        upload_batch moving shards into HBM)."""
+        if edge_type not in self._device:
+            self._device[edge_type] = DeviceGraph.from_csr(
+                self._graphs[edge_type], max_degree)
+        return self._device[edge_type]
+
+    # -- node features (role of the feature table half of the graph PS) --
+
+    def set_node_feat(self, name: str, values: np.ndarray) -> None:
+        self._feats[name] = np.asarray(values)
+
+    def get_node_feat(self, name: str, nodes: np.ndarray) -> np.ndarray:
+        return self._feats[name][np.asarray(nodes, np.int64)]
+
+    def shard_of(self, nodes: np.ndarray) -> np.ndarray:
+        return (np.asarray(nodes, np.int64) % self.num_shards)

@@ -18,7 +18,9 @@ from paddlebox_tpu.parallel.topology import (
     get_default_topology,
     set_default_topology,
 )
+from paddlebox_tpu.parallel import auto
 from paddlebox_tpu.parallel import collective
+from paddlebox_tpu.parallel import dgc
 from paddlebox_tpu.parallel import moe
 from paddlebox_tpu.parallel import pp
 from paddlebox_tpu.parallel import sp
@@ -27,8 +29,10 @@ from paddlebox_tpu.parallel import zero
 
 __all__ = [
     "HybridTopology",
+    "auto",
     "build_mesh",
     "collective",
+    "dgc",
     "get_default_topology",
     "moe",
     "pp",

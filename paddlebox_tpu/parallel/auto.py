@@ -1,0 +1,163 @@
+"""Auto-parallel: process mesh, dist-tensor annotations, shard planner.
+
+Role of the reference's experimental auto-parallel stack
+(``python/paddle/distributed/auto_parallel/``: ``ProcessMesh``, dist
+tensor attrs, ``Engine``/planner/partitioner/reshard,
+``framework/process_mesh_desc.h``): users annotate a few tensors with
+mesh + dims-mapping, a planner completes the rest, a partitioner rewrites
+the program per rank, and reshard inserts communication.
+
+TPU-first: GSPMD **is** the partitioner — XLA propagates shardings and
+inserts collectives; what remains valuable is (a) the annotation surface
+(:class:`ProcessMesh`, :func:`shard_tensor` — dims-mapping semantics match
+the reference: one mesh-dim name or None per tensor dim), (b) a planner
+that completes un-annotated parameter pytrees with sensible specs
+(batch→dp, vocab/feature dims→mp, large remaining dims→sharding), and
+(c) :func:`reshard` (device_put to a new sharding = the reference's
+reshard pass, compiled to collectives by XLA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.parallel.zero import _spec_for_leaf
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessMesh:
+    """Logical device mesh (role of auto_parallel.ProcessMesh): an
+    nd-array of process/device ids with named dims, convertible to a
+    ``jax.sharding.Mesh`` over the actual devices."""
+
+    shape: Tuple[int, ...]
+    dim_names: Tuple[str, ...]
+
+    def __post_init__(self):
+        if len(self.shape) != len(self.dim_names):
+            raise ValueError("shape/dim_names length mismatch")
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape))
+
+    def to_jax(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        devs = list(devices) if devices is not None else jax.devices()
+        if len(devs) < self.size:
+            raise ValueError(f"mesh needs {self.size} devices, "
+                             f"have {len(devs)}")
+        arr = np.asarray(devs[:self.size]).reshape(self.shape)
+        return Mesh(arr, axis_names=self.dim_names)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistAttr:
+    """Per-tensor distributed attributes (role of the reference's
+    TensorDistAttr): the mesh and one mesh-dim (or None) per tensor dim."""
+
+    mesh: ProcessMesh
+    dims_mapping: Tuple[Optional[str], ...]
+
+    def spec(self) -> P:
+        return P(*self.dims_mapping)
+
+
+def shard_tensor(x: jax.Array, mesh: Union[ProcessMesh, Mesh],
+                 dims_mapping: Sequence[Optional[str]],
+                 devices: Optional[Sequence[jax.Device]] = None
+                 ) -> jax.Array:
+    """Place ``x`` with the given dims mapping (role of
+    auto_parallel.shard_tensor). Inside jit, use
+    ``jax.lax.with_sharding_constraint`` with the same spec."""
+    jmesh = mesh.to_jax(devices) if isinstance(mesh, ProcessMesh) else mesh
+    return jax.device_put(x, NamedSharding(jmesh, P(*dims_mapping)))
+
+
+def reshard(x: jax.Array, mesh: Union[ProcessMesh, Mesh],
+            dims_mapping: Sequence[Optional[str]],
+            devices: Optional[Sequence[jax.Device]] = None) -> jax.Array:
+    """Re-layout to a new sharding (role of the reshard pass — XLA emits
+    the all-to-all/all-gather/slice traffic)."""
+    return shard_tensor(x, mesh, dims_mapping, devices)
+
+
+# ---------------------------------------------------------------------------
+# Planner
+# ---------------------------------------------------------------------------
+
+# Parameter-name hints: dims of embedding/vocab-like tables shard over mp
+# (matches the reference planner's operator-aware rules for embedding and
+# matmul ops).
+_VOCAB_HINT = re.compile(r"(embed|vocab|emb_table|wte|lm_head)",
+                         re.IGNORECASE)
+
+
+def plan_params(params: Any, mesh: Mesh, *,
+                mp_axis: str = "mp", sharding_axis: str = "sharding",
+                min_shard_size: int = 1 << 14,
+                overrides: Optional[Dict[str, P]] = None) -> Any:
+    """Complete a parameter pytree with PartitionSpecs (role of the
+    auto-parallel completion/planner pass).
+
+    Rules, in order:
+    1. explicit ``overrides`` by flattened key path substring
+    2. params whose path matches vocab/embedding hints: shard dim 0 over
+       ``mp_axis`` when divisible
+    3. 2D+ weights: shard the largest mp-divisible dim over ``mp_axis``
+       (falls back to ``sharding_axis``)
+    4. small leaves (< min_shard_size elements) replicate
+    """
+    mp = mesh.shape.get(mp_axis, 1)
+    zshard = mesh.shape.get(sharding_axis, 1)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+
+    def path_str(path) -> str:
+        return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+
+    specs = []
+    for path, leaf in flat:
+        name = path_str(path)
+        shape = np.shape(leaf)
+        if overrides:
+            hit = next((s for pat, s in overrides.items() if pat in name),
+                       None)
+            if hit is not None:
+                specs.append(hit)
+                continue
+        if np.prod(shape, dtype=np.int64) < min_shard_size or not shape:
+            specs.append(P())
+            continue
+        if mp > 1 and _VOCAB_HINT.search(name) and shape[0] % mp == 0:
+            specs.append(P(*([mp_axis] + [None] * (len(shape) - 1))))
+            continue
+        # Shared largest-divisible-dim rule (same helper as the ZeRO
+        # planner — one place to improve dim selection).
+        spec = P()
+        if mp > 1 and len(shape) >= 2:
+            spec = _spec_for_leaf(shape, mp, mp_axis, 0)
+        if spec == P() and zshard > 1:
+            spec = _spec_for_leaf(shape, zshard, sharding_axis, 0)
+        specs.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def plan_shardings(params: Any, mesh: Mesh, **kw) -> Any:
+    """plan_params → NamedShardings (feed straight into jit in_shardings)."""
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s),
+                                  plan_params(params, mesh, **kw),
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def apply_plan(params: Any, mesh: Mesh, **kw) -> Any:
+    """Place a parameter pytree per the plan (annotation + partition in
+    one step — the Engine.prepare() ergonomics of the reference)."""
+    shardings = plan_shardings(params, mesh, **kw)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)

@@ -1,0 +1,195 @@
+"""Graph engine tests: CSR build/load parity, padded device view
+invariants, sampling validity (every sampled neighbor is a true
+neighbor), walk validity, skip-gram batch generation, and an end-to-end
+deepwalk-style embedding smoke train."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.graph import (DeviceGraph, GraphDataGenerator,
+                                 GraphGenConfig, GraphTable, build_csr,
+                                 device_arrays, load_edge_file, random_walk,
+                                 sample_neighbors, skip_gram_pairs)
+
+
+def ring_edges(n):
+    src = np.arange(n)
+    return src, (src + 1) % n
+
+
+def test_build_csr_and_neighbors():
+    src = np.asarray([0, 0, 1, 2, 2, 2])
+    dst = np.asarray([1, 2, 2, 0, 1, 3])
+    g = build_csr(src, dst)
+    assert g.num_nodes == 4 and g.num_edges == 6
+    np.testing.assert_array_equal(np.sort(g.neighbors(2)), [0, 1, 3])
+    np.testing.assert_array_equal(g.degrees(), [2, 1, 3, 0])
+
+
+def test_symmetrize_and_load_edge_file(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("0 1\n1 2\n2 3\n")
+    g = load_edge_file(str(p), symmetrize=True)
+    assert g.num_edges == 6
+    np.testing.assert_array_equal(np.sort(g.neighbors(1)), [0, 2])
+
+
+def test_device_graph_padding_invariants():
+    src, dst = ring_edges(6)
+    g = build_csr(src, dst)
+    dg = DeviceGraph.from_csr(g, max_degree=4)
+    # valid slots hold true neighbors; padding slots self-loop
+    for i in range(6):
+        np.testing.assert_array_equal(dg.nbrs[i, :dg.degree[i]],
+                                      g.neighbors(i))
+        np.testing.assert_array_equal(dg.nbrs[i, dg.degree[i]:], i)
+
+
+def test_device_graph_truncates_high_degree():
+    # star graph: node 0 connects to 1..9
+    src = np.zeros(9, np.int64)
+    dst = np.arange(1, 10)
+    g = build_csr(src, dst)
+    dg = DeviceGraph.from_csr(g, max_degree=4)
+    assert dg.degree[0] == 4
+    assert set(dg.nbrs[0].tolist()) <= set(range(1, 10))
+    assert len(set(dg.nbrs[0].tolist())) == 4  # subsample w/o replacement
+
+
+def test_build_csr_validates_ids():
+    with pytest.raises(ValueError):
+        build_csr(np.asarray([0]), np.asarray([5]), num_nodes=3)
+    with pytest.raises(ValueError):
+        build_csr(np.asarray([5]), np.asarray([0]), num_nodes=3)
+
+
+def test_device_graph_truncation_many_hubs():
+    """Vectorized hub subsample: several high-degree nodes at once, all
+    slots valid, no duplicates within a node."""
+    rng = np.random.default_rng(0)
+    srcs, dsts = [], []
+    for hub in range(5):
+        nb = rng.choice(np.arange(5, 100), size=20, replace=False)
+        srcs.append(np.full(20, hub))
+        dsts.append(nb)
+    g = build_csr(np.concatenate(srcs), np.concatenate(dsts))
+    dg = DeviceGraph.from_csr(g, max_degree=8)
+    for hub in range(5):
+        row = dg.nbrs[hub]
+        assert dg.degree[hub] == 8
+        assert len(set(row.tolist())) == 8
+        assert set(row.tolist()) <= set(g.neighbors(hub).tolist())
+
+
+def test_sample_neighbors_validity():
+    src, dst = ring_edges(8)
+    g = build_csr(src, dst, symmetrize=True)
+    nbrs, deg = device_arrays(DeviceGraph.from_csr(g))
+    nodes = jnp.asarray([0, 3, 5], jnp.int32)
+    out = sample_neighbors(nbrs, deg, nodes, jax.random.PRNGKey(0), k=16)
+    assert out.shape == (3, 16)
+    for row, node in zip(np.asarray(out), [0, 3, 5]):
+        true = set(g.neighbors(node).tolist())
+        assert set(row.tolist()) <= true
+        assert len(set(row.tolist())) > 1  # both ring neighbors appear
+
+
+def test_isolated_node_self_loops():
+    g = build_csr(np.asarray([0]), np.asarray([1]), num_nodes=3)
+    nbrs, deg = device_arrays(DeviceGraph.from_csr(g))
+    out = sample_neighbors(nbrs, deg, jnp.asarray([2], jnp.int32),
+                           jax.random.PRNGKey(1), k=4)
+    np.testing.assert_array_equal(np.asarray(out), 2)
+
+
+def test_random_walk_follows_edges():
+    src, dst = ring_edges(10)
+    g = build_csr(src, dst)  # directed ring: walk must be i, i+1, i+2...
+    nbrs, deg = device_arrays(DeviceGraph.from_csr(g))
+    starts = jnp.asarray([0, 4], jnp.int32)
+    walks = np.asarray(random_walk(nbrs, deg, starts,
+                                   jax.random.PRNGKey(0), walk_len=5))
+    np.testing.assert_array_equal(walks[0], np.arange(6) % 10)
+    np.testing.assert_array_equal(walks[1], (4 + np.arange(6)) % 10)
+
+
+def test_skip_gram_pairs_window():
+    walks = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    pairs = np.asarray(skip_gram_pairs(walks, window=1))
+    mask = pairs[:, 0] != pairs[:, 1]
+    real = {tuple(p) for p in pairs[mask].tolist()}
+    want = {(0, 1), (1, 2), (2, 3), (1, 0), (2, 1), (3, 2)}
+    assert real == want
+
+
+def test_graph_table_facade_and_features():
+    t = GraphTable(num_shards=4)
+    src, dst = ring_edges(8)
+    t.add_edges("follow", src, dst, symmetrize=True)
+    assert t.graph("follow").num_edges == 16
+    dg = t.device_graph("follow")
+    assert dg.nbrs.shape[0] == 8
+    t.set_node_feat("emb", np.arange(16, dtype=np.float32).reshape(8, 2))
+    np.testing.assert_array_equal(t.get_node_feat("emb", [2, 0]),
+                                  [[4, 5], [0, 1]])
+    np.testing.assert_array_equal(t.shard_of([5, 8]), [1, 0])
+
+
+def test_data_generator_shapes_and_coverage():
+    t = GraphTable()
+    src, dst = ring_edges(20)
+    t.add_edges("e", src, dst, symmetrize=True)
+    cfg = GraphGenConfig(walk_len=4, window=2, num_neg=3, batch_walks=8)
+    gen = GraphDataGenerator(t, "e", cfg)
+    batches = list(gen.batches(epochs=1))
+    assert len(batches) == 3  # ceil(20/8)
+    b = batches[0]
+    num_pairs = 8 * 5 * 4  # batch_walks * (walk_len+1) * 2*window
+    assert b["centers"].shape == (num_pairs,)
+    assert b["negatives"].shape == (num_pairs, 3)
+    assert b["mask"].dtype == jnp.bool_
+    # masked-in pairs are real edges-or-near pairs within the ring
+    c = np.asarray(b["centers"])[np.asarray(b["mask"])]
+    x = np.asarray(b["contexts"])[np.asarray(b["mask"])]
+    d = np.minimum((c - x) % 20, (x - c) % 20)
+    assert (d <= cfg.window).all() and (d > 0).all()
+
+
+def test_deepwalk_smoke_train():
+    """Tiny deepwalk: two ring communities bridged by one edge; after a
+    few epochs, intra-community similarity > inter-community."""
+    rng = np.random.default_rng(0)
+    s1, d1 = ring_edges(8)
+    s2, d2 = ring_edges(8)
+    src = np.concatenate([s1, s2 + 8, [0]])
+    dst = np.concatenate([d1, d2 + 8, [8]])
+    t = GraphTable()
+    t.add_edges("e", src, dst, symmetrize=True)
+    gen = GraphDataGenerator(
+        t, "e", GraphGenConfig(walk_len=6, window=2, num_neg=2,
+                               batch_walks=16))
+    emb = jnp.asarray(rng.normal(0, 0.1, (16, 8)), jnp.float32)
+
+    @jax.jit
+    def step(emb, c, x, negs, mask):
+        def loss_fn(emb):
+            pos = jnp.sum(emb[c] * emb[x], -1)
+            neg = jnp.einsum("pd,pnd->pn", emb[c], emb[negs])
+            l_pos = jax.nn.softplus(-pos)
+            l_neg = jax.nn.softplus(neg).sum(-1)
+            return jnp.sum((l_pos + l_neg) * mask) / jnp.maximum(
+                mask.sum(), 1)
+        g = jax.grad(loss_fn)(emb)
+        return emb - 0.5 * g
+
+    for batch in gen.batches(epochs=120):
+        emb = step(emb, batch["centers"], batch["contexts"],
+                   batch["negatives"], batch["mask"])
+    e = np.asarray(emb)
+    e = e / np.linalg.norm(e, axis=1, keepdims=True)
+    sims = e @ e.T
+    intra = (sims[:8, :8].sum() - 8) / (8 * 7)
+    inter = sims[:8, 8:].mean()
+    assert intra > inter + 0.1
