@@ -1,0 +1,147 @@
+"""Async CPU dense table: background host optimizer over a grad ring.
+
+Role of ``BoxPSAsynDenseTable`` (``framework/boxps_worker.cc:43-341``): a
+CPU-side dense parameter server inside the trainer process — workers
+``PushDense`` gradients into a ring of buffers and ``PullDense`` the
+freshest params each step (used at ``boxps_worker.cc:683-692``); update
+threads run host Adam with hardcoded β=0.99/0.9999 (:259-268) plus a
+special datanorm rule, decoupling dense updates from the device step so
+k-step device sync can proceed without blocking.
+
+TPU-first: the device path normally folds dense updates into the jitted
+step (CTRTrainer); this table serves the same *decoupling* role for
+host-resident dense state — e.g. very large embedding-adjacent dense
+blocks or multi-process CTR where dense lives host-side between k-step
+syncs. numpy Adam, one background thread, bounded ring with drop-oldest
+(matching the reference's async semantics where a slow updater coalesces
+gradients rather than stalling workers).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from paddlebox_tpu.core import log, monitor
+
+
+class AsyncDenseTable:
+    """Host params + background Adam thread fed by a bounded grad ring."""
+
+    def __init__(self, params: Any, *, learning_rate: float = 1e-3,
+                 beta1: float = 0.99, beta2: float = 0.9999,
+                 eps: float = 1e-8, ring_capacity: int = 8):
+        self._leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        self._leaves = [np.asarray(x, np.float32).copy()
+                        for x in self._leaves]
+        self._m = [np.zeros_like(x) for x in self._leaves]
+        self._v = [np.zeros_like(x) for x in self._leaves]
+        self.lr = learning_rate
+        self.b1, self.b2, self.eps = beta1, beta2, eps
+        self._t = 0
+        self._ring: "queue.Queue" = queue.Queue(ring_capacity)
+        self._params_lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._running = True
+        self._thread = threading.Thread(target=self._update_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- worker API (role of PullDense/PushDense) --------------------------
+
+    def pull_dense(self) -> Any:
+        """Snapshot of the freshest params (boxps_worker.cc:305)."""
+        with self._params_lock:
+            leaves = [x.copy() for x in self._leaves]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def push_dense(self, grads: Any) -> None:
+        """Enqueue a gradient pytree; drops the oldest entry when the ring
+        is full (async coalescing, not backpressure — a stalled updater
+        must not stall the device loop)."""
+        self._check_error()
+        g, treedef = jax.tree_util.tree_flatten(grads)
+        if treedef != self._treedef:
+            raise ValueError(
+                f"grad tree structure {treedef} != param tree "
+                f"{self._treedef} — same leaf count with a different "
+                "structure would update the wrong parameters")
+        g = [np.asarray(x, np.float32) for x in g]
+        for gi, pi in zip(g, self._leaves):
+            if gi.shape != pi.shape:
+                raise ValueError(
+                    f"grad shape {gi.shape} != param shape {pi.shape}")
+        while True:
+            try:
+                self._ring.put_nowait(g)
+                return
+            except queue.Full:
+                try:
+                    self._ring.get_nowait()
+                    self._ring.task_done()
+                    monitor.add("async_dense/dropped", 1)
+                except queue.Empty:
+                    continue
+
+    # -- update thread (role of AsyncUpdate/ThreadUpdate) ------------------
+
+    def _update_loop(self) -> None:
+        while self._running:
+            try:
+                g = self._ring.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                self._apply(g)
+            except BaseException as e:
+                # A dead updater must not be silent: record and surface on
+                # the next worker-side call instead of freezing params.
+                self._error = e
+                log.error("async dense update failed: %s", e)
+                self._ring.task_done()
+                return
+            self._ring.task_done()
+
+    def _apply(self, g) -> None:
+        self._t += 1
+        b1t = 1.0 - self.b1 ** self._t
+        b2t = 1.0 - self.b2 ** self._t
+        with self._params_lock:
+            for i, gi in enumerate(g):
+                self._m[i] = self.b1 * self._m[i] + (1 - self.b1) * gi
+                self._v[i] = self.b2 * self._v[i] + (1 - self.b2) * gi * gi
+                self._leaves[i] -= self.lr * (self._m[i] / b1t) / (
+                    np.sqrt(self._v[i] / b2t) + self.eps)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _check_error(self) -> None:
+        if self._error is not None:
+            raise RuntimeError("async dense updater died") from self._error
+
+    def flush(self, timeout: float = 10.0) -> None:
+        """Drain pending grads INCLUDING the in-flight one the updater has
+        already dequeued (unfinished_tasks counts until task_done), so a
+        post-flush pull/checkpoint sees every pushed gradient applied."""
+        import time
+        deadline = time.time() + timeout
+        while self._ring.unfinished_tasks:
+            self._check_error()
+            if time.time() > deadline:
+                raise TimeoutError("async dense flush timed out")
+            time.sleep(0.005)
+        self._check_error()
+
+    def stop(self) -> None:
+        if self._error is None:
+            self.flush()
+        self._running = False
+        self._thread.join(5.0)
+
+    @property
+    def steps_applied(self) -> int:
+        return self._t

@@ -1,0 +1,263 @@
+"""Trainer hierarchy: lifecycle base + factory + dense/pipeline trainers.
+
+Role of the reference trainer layer (``framework/trainer.h:59-103``):
+``TrainerBase`` lifecycle ``Initialize → InitTrainerEnv → InitOtherEnv →
+Run → Finalize`` with dump-to-file machinery (:81-92), concrete trainers
+created by name through ``TrainerFactory`` (``trainer_factory.cc``) from a
+``TrainerDesc``: ``MultiTrainer``+``HogwildWorker`` (dense multi-device),
+``PipelineTrainer``+``SectionWorker`` (1F1B microbatches), and the CTR
+trainers (``BoxPSTrainer`` — here :class:`~paddlebox_tpu.train.
+ctr_trainer.CTRTrainer`).
+
+TPU-first: a "trainer" is lifecycle + host loop around ONE jitted step —
+the per-device worker threads of the reference collapse into the sharded
+program (hogwild's N threads == dp sharding; SectionWorker's microbatch
+scopes == the pipeline scan). Dump/metrics/sanitizer hooks stay host-side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.core import log, timers
+from paddlebox_tpu.parallel import topology as topo_lib
+from paddlebox_tpu.utils import sanitizer
+from paddlebox_tpu.utils.dump import DumpWriter
+
+
+@dataclasses.dataclass
+class TrainerDesc:
+    """Configuration record (role of trainer_desc.proto:21): trainer
+    selection + loop knobs + dump settings."""
+
+    trainer_class: str = "MultiTrainer"
+    max_steps: int = 0                 # 0 = drain the iterator
+    log_every: int = 50
+    check_nan_inf: bool = False
+    dump_path: str = ""                # per-line prediction dump target
+    num_micro_batches: int = 1         # pipeline trainers
+    # Block on the loss every N steps: keeps async dispatch deep enough to
+    # overlap host and device but bounded — unbounded queues of
+    # collective-heavy programs can starve the runtime's rendezvous
+    # (observed as AwaitAndLogIfStuck aborts on the CPU backend).
+    dispatch_depth: int = 16
+
+
+class TrainerBase:
+    """Lifecycle contract (trainer.h:59): subclasses implement the four
+    stages; ``fit`` drives them in order."""
+
+    def __init__(self):
+        self.desc: Optional[TrainerDesc] = None
+        self.mesh: Optional[Mesh] = None
+        self.dump: Optional[DumpWriter] = None
+        self.timers = timers.TimerGroup()
+
+    def initialize(self, desc: TrainerDesc) -> None:
+        self.desc = desc
+
+    def init_trainer_env(self, mesh: Optional[Mesh] = None) -> None:
+        self.mesh = mesh or topo_lib.get_default_topology()[1]
+
+    def init_other_env(self) -> None:
+        if self.desc and self.desc.dump_path:
+            self.dump = DumpWriter(self.desc.dump_path)
+
+    def run(self, data: Iterable) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def finalize(self) -> None:
+        if self.dump is not None:
+            self.dump.close()
+
+    def fit(self, data: Iterable, desc: Optional[TrainerDesc] = None,
+            mesh: Optional[Mesh] = None) -> Dict[str, float]:
+        self.initialize(desc or self.desc or TrainerDesc())
+        self.init_trainer_env(mesh)
+        self.init_other_env()
+        try:
+            return self.run(data)
+        finally:
+            self.finalize()
+
+
+_REGISTRY: Dict[str, Type[TrainerBase]] = {}
+
+
+def register_trainer(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def create_trainer(name: str, *args, **kw) -> TrainerBase:
+    """TrainerFactory::CreateTrainer equivalent."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown trainer {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](*args, **kw)
+
+
+@register_trainer("MultiTrainer")
+class MultiTrainer(TrainerBase):
+    """Dense data-parallel trainer (role of MultiTrainer+HogwildWorker,
+    trainer.h:105 / device_worker.h:271): one jitted step, batch sharded
+    over the dp axis — XLA's compiled allreduce replaces hogwild's shared
+    scope + per-thread loops.
+
+    ``loss_fn(params, batch) -> scalar`` defines the model; batches are
+    pytrees of numpy arrays with leading batch dim.
+    """
+
+    def __init__(self, loss_fn: Callable[[Any, Any], jax.Array],
+                 params: Any, tx: optax.GradientTransformation,
+                 eval_fn: Optional[Callable[[Any, Any], Any]] = None):
+        super().__init__()
+        self.loss_fn = loss_fn
+        self.eval_fn = eval_fn   # (params, batch) -> (preds, labels) dump
+        self.params = params
+        self.tx = tx
+        self.opt_state = tx.init(params)
+        self._step = None
+
+    def init_other_env(self) -> None:
+        if self.desc and self.desc.dump_path and self.eval_fn is None:
+            # Refuse a dead knob: opening the writer truncates the target
+            # file, and without eval_fn nothing would ever be written.
+            raise ValueError(
+                "TrainerDesc.dump_path set but MultiTrainer has no "
+                "eval_fn to produce (preds, labels) for the dump")
+        super().init_other_env()
+
+    def init_trainer_env(self, mesh: Optional[Mesh] = None) -> None:
+        super().init_trainer_env(mesh)
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        if self.mesh is not None:
+            data_sh = topo_lib.data_sharding(self.mesh)
+            self._data_sharding = data_sh
+            self._step = jax.jit(step,
+                                 in_shardings=(None, None, data_sh),
+                                 out_shardings=(None, None, None))
+        else:
+            self._data_sharding = None
+            self._step = jax.jit(step)
+
+    def run(self, data: Iterable) -> Dict[str, float]:
+        desc = self.desc or TrainerDesc()
+        # Keep losses as device arrays — float() per step would block the
+        # host on every result and defeat async dispatch.
+        first_loss = last_loss = None
+        n = 0
+        for batch in data:
+            if self._data_sharding is not None:
+                batch = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, self._data_sharding), batch)
+            with self.timers.scope("step"):
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, batch)
+            if first_loss is None:
+                first_loss = loss
+            last_loss = loss
+            if desc.check_nan_inf:
+                sanitizer.check_batch(self.params, step=n, force=True)
+            if self.dump is not None:
+                preds, labels = self.eval_fn(self.params, batch)
+                self.dump.write_batch(np.asarray(preds), np.asarray(labels))
+            n += 1
+            if desc.dispatch_depth and n % desc.dispatch_depth == 0:
+                jax.block_until_ready(loss)
+            if desc.log_every and n % desc.log_every == 0:
+                log.vlog(0, "step %d loss %.5f", n, float(loss))
+            if desc.max_steps and n >= desc.max_steps:
+                break
+        return {"steps": n,
+                "loss_first": float(first_loss) if n else float("nan"),
+                "loss_last": float(last_loss) if n else float("nan")}
+
+
+@register_trainer("PipelineTrainer")
+class PipelineTrainer(TrainerBase):
+    """Pipeline-parallel trainer (role of PipelineTrainer+SectionWorker,
+    trainer.h:307 / section_worker.cc:40): stages sharded over the pp
+    mesh axis; microbatch scheduling compiles into the pipeline scan
+    (parallel/pp) and autodiff differentiates through it, replacing the
+    hand-built forward/backward op lists of the reference.
+
+    ``stage_fn(stage_params, x) -> x`` is one stage; ``loss_head(y,
+    batch) -> scalar`` terminates the pipeline.
+    """
+
+    def __init__(self, stage_fn, stacked_params: Any,
+                 loss_head: Callable[[jax.Array, Any], jax.Array],
+                 tx: optax.GradientTransformation):
+        super().__init__()
+        self.stage_fn = stage_fn
+        self.params = stacked_params
+        self.loss_head = loss_head
+        self.tx = tx
+        self.opt_state = tx.init(stacked_params)
+        self._step = None
+
+    def init_trainer_env(self, mesh: Optional[Mesh] = None) -> None:
+        super().init_trainer_env(mesh)
+        from paddlebox_tpu.parallel import pp as pp_lib
+        desc = self.desc or TrainerDesc()
+        mb = desc.num_micro_batches
+        mesh = self.mesh
+        pipe = pp_lib.make_pipeline_fn(mesh, self.stage_fn, self.params)
+
+        def step(params, opt_state, batch):
+            x, rest = batch["x"], batch
+
+            def loss_fn(params):
+                xs = x.reshape((mb, x.shape[0] // mb) + x.shape[1:])
+                y = pipe(params, xs)
+                y = y.reshape((x.shape[0],) + y.shape[2:])
+                return self.loss_head(y, rest)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._step = jax.jit(step)
+
+    def run(self, data: Iterable) -> Dict[str, float]:
+        desc = self.desc or TrainerDesc()
+        mb = desc.num_micro_batches
+        first_loss = last_loss = None
+        n = 0
+        for batch in data:
+            bs = batch["x"].shape[0]
+            if bs % mb:
+                raise ValueError(
+                    f"batch size {bs} not divisible by num_micro_batches "
+                    f"{mb} — pad or drop the partial batch")
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, batch)
+            if first_loss is None:
+                first_loss = loss
+            last_loss = loss
+            if desc.check_nan_inf:
+                sanitizer.check_batch(self.params, step=n, force=True)
+            n += 1
+            if desc.dispatch_depth and n % desc.dispatch_depth == 0:
+                jax.block_until_ready(loss)
+            if desc.log_every and n % desc.log_every == 0:
+                log.vlog(0, "pp step %d loss %.5f", n, float(loss))
+            if desc.max_steps and n >= desc.max_steps:
+                break
+        return {"steps": n,
+                "loss_first": float(first_loss) if n else float("nan"),
+                "loss_last": float(last_loss) if n else float("nan")}

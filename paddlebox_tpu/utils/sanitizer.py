@@ -1,0 +1,75 @@
+"""Numeric sanitizer: NaN/Inf detection over pytrees with named reports.
+
+Role of the reference's ``FLAGS_check_nan_inf`` machinery
+(``framework/details/nan_inf_utils_detail.{cc,cu}``): after each batch the
+worker scans every scope tensor (``CheckBatchNanOrInfRet`` hooked at
+``boxps_worker.cc:699-707``), and on a hit dumps the scope and aborts with
+the offending variable names.
+
+TPU-first: the scan is a jitted reduction per leaf (one ``isfinite.all()``
+fused into the step when used inside jit); reporting walks the pytree on
+host only after a hit, so the hot path stays collective-free and cheap.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.core import flags, log
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """Scalar bool: every leaf of the pytree is finite. Jit-friendly —
+    compose into the train step (role of CheckBatchNanOrInfRet)."""
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if isinstance(x, (jax.Array, np.ndarray))
+              and jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)]
+    ok = jnp.asarray(True)
+    for leaf in leaves:
+        ok &= jnp.isfinite(leaf).all()
+    return ok
+
+
+def find_nonfinite(tree: Any) -> List[Tuple[str, str, int]]:
+    """Host-side report: [(path, kind, count)] for each offending leaf
+    (role of the per-variable PrintNanInf dump). Call only after
+    ``all_finite`` came back False — it materializes every leaf."""
+    out: List[Tuple[str, str, int]] = []
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.inexact):
+            continue
+        n_nan = int(np.isnan(arr).sum())
+        n_inf = int(np.isinf(arr).sum())
+        name = jax.tree_util.keystr(path)
+        if n_nan:
+            out.append((name, "nan", n_nan))
+        if n_inf:
+            out.append((name, "inf", n_inf))
+    return out
+
+
+def check_batch(tree: Any, *, step: int = -1, raise_on_hit: bool = True,
+                force: bool = False) -> bool:
+    """Post-batch host check honoring the ``check_nan_inf`` flag (or
+    ``force=True`` from a per-trainer switch): returns True when clean; on
+    a hit logs the per-leaf report and (by default) raises — matching the
+    reference's abort-with-dump behavior."""
+    if not force and not flags.flag("check_nan_inf"):
+        return True
+    if bool(all_finite(tree)):
+        return True
+    report = find_nonfinite(tree)
+    for name, kind, count in report:
+        log.error("nan_inf[step %d]: %s has %d %s values", step, name,
+                  count, kind)
+    if raise_on_hit:
+        raise FloatingPointError(
+            f"non-finite values at step {step}: "
+            + ", ".join(f"{n}({k}x{c})" for n, k, c in report))
+    return False
