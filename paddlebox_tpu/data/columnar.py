@@ -117,6 +117,58 @@ class ColumnarChunk:
         return ColumnarChunk(labels=self.labels, sparse_ids=ids,
                              sparse_offsets=offs, dense=self.dense)
 
+    # -- disk spill (role of BinaryArchive record serialization) -----------
+
+    def save(self, path: str) -> None:
+        """Write the chunk as one npz archive (role of
+        BinaryArchiveWriter in DumpIntoDisk, data_set.cc:2167)."""
+        payload = {"labels": self.labels}
+        for s, v in self.sparse_ids.items():
+            payload[f"sid:{s}"] = v
+            payload[f"soff:{s}"] = self.sparse_offsets[s]
+        for s, v in self.dense.items():
+            payload[f"dense:{s}"] = v
+        import os
+        # Dot-prefixed temp name: must NOT match the chunk-*.npz glob, or
+        # a crash mid-save would poison later loads with a truncated file.
+        d, base = os.path.split(path)
+        tmp = os.path.join(d, f".{base}.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "ColumnarChunk":
+        data = np.load(path)
+        ids, offs, dense = {}, {}, {}
+        for k in data.files:
+            if k.startswith("sid:"):
+                ids[k[4:]] = data[k]
+            elif k.startswith("soff:"):
+                offs[k[5:]] = data[k]
+            elif k.startswith("dense:"):
+                dense[k[6:]] = data[k]
+        return ColumnarChunk(labels=data["labels"], sparse_ids=ids,
+                             sparse_offsets=offs, dense=dense)
+
+    # -- pv grouping helpers ----------------------------------------------
+
+    def group_keys(self, slot: str) -> "tuple[np.ndarray, np.ndarray]":
+        """Per-row (group key, has_key) from the FIRST value of the given
+        sparse slot (role of the search-id grouping in PaddleBoxDataFeed
+        pv mode, data_feed.h:1701). Rows with an empty slot report
+        has_key=False and form singleton groups downstream — a synthetic
+        key value could collide with real full-range uint64 feasigns, so
+        the mask travels separately."""
+        if slot not in self.sparse_ids:
+            raise KeyError(f"unknown sparse slot {slot!r}")
+        o = self.sparse_offsets[slot]
+        lens = np.diff(o)
+        has = lens > 0
+        keys = np.zeros((self.num_rows,), np.uint64)
+        keys[has] = self.sparse_ids[slot][o[:-1][has]]
+        return keys, has
+
     # -- batch packing (vectorized BuildSlotBatchGPU) ----------------------
 
     def pack_batch(self, lo: int, hi: int, config: DataFeedConfig,

@@ -22,7 +22,7 @@ import os
 import queue
 import subprocess
 import threading
-from typing import Callable, Iterator, List, Optional, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -327,6 +327,134 @@ class Dataset:
         with self._lock:
             self._chunks = [merged]
             self._merged = merged
+
+    # -- disk spill (role of PreLoadIntoDisk/DumpIntoDisk + LoadDiskData,
+    # data_set.cc:2088,2167) ----------------------------------------------
+
+    def dump_into_disk(self, spill_dir: str) -> int:
+        """Stream-parse the filelist straight to disk chunk archives
+        without holding records in RAM (role of PreLoadIntoDisk: datasets
+        larger than host memory spill between load and train). Returns
+        the number of chunks written."""
+        os.makedirs(spill_dir, exist_ok=True)
+        # A re-dump producing fewer chunks must not leave stale chunks
+        # from a previous run to be silently mixed in at load time.
+        for old in self._disk_chunk_files(spill_dir):
+            os.unlink(old)
+        ch = self._start_load()
+        n = 0
+        try:
+            while True:
+                chunk = ch.get()
+                chunk.save(os.path.join(spill_dir, f"chunk-{n:06d}.npz"))
+                if self.key_sink is not None:
+                    keys = chunk.all_keys()
+                    if keys.size:
+                        self.key_sink(keys)
+                n += 1
+        except ClosedChannelError:
+            pass
+        except BaseException:
+            # e.g. disk-full in save(): readers are blocked on the bounded
+            # channel — close it so their put() raises and threads exit
+            # instead of leaking.
+            ch.close()
+            raise
+        self._raise_reader_errors()
+        log.vlog(0, "dump_into_disk: %d chunks -> %s", n, spill_dir)
+        return n
+
+    @staticmethod
+    def _disk_chunk_files(spill_dir: str) -> List[str]:
+        import glob
+        return sorted(glob.glob(os.path.join(spill_dir, "chunk-*.npz")))
+
+    def load_from_disk(self, spill_dir: str) -> None:
+        """Load previously spilled chunks back into memory."""
+        files = self._disk_chunk_files(spill_dir)
+        if not files:
+            # Same convention as set_filelist's missing-file error: a
+            # misconfigured path must not silently yield an empty pass.
+            raise FileNotFoundError(f"no chunk-*.npz under {spill_dir!r}")
+        chunks = [ColumnarChunk.load(p) for p in files]
+        with self._lock:
+            self._chunks = chunks
+            self._merged = None
+
+    def batches_from_disk(self, spill_dir: str, *,
+                          batch_size: Optional[int] = None,
+                          drop_last: bool = False) -> Iterator[SlotBatch]:
+        """Stream batches chunk-by-chunk from a spill dir, holding at most
+        one chunk (+remainder rows) in RAM — training directly from the
+        disk tier."""
+        bs = batch_size or self.config.batch_size
+        rest: Optional[ColumnarChunk] = None
+        for path in self._disk_chunk_files(spill_dir):
+            cur = ColumnarChunk.load(path)
+            if rest is not None and rest.num_rows:
+                cur = ColumnarChunk.concat([rest, cur])
+            n = cur.num_rows
+            lo = 0
+            while lo + bs <= n:
+                yield cur.pack_batch(lo, lo + bs, self.config, bs)
+                lo += bs
+            rest = cur.take(np.arange(lo, n)) if lo < n else None
+        if rest is not None and rest.num_rows and not drop_last:
+            yield rest.pack_batch(0, rest.num_rows, self.config, bs)
+
+    # -- pv/ins grouped batching (role of PaddleBoxDataFeed pv mode,
+    # data_feed.h:1701: group instances by search id; a batch holds whole
+    # pvs) ------------------------------------------------------------------
+
+    def batches_grouped(self, group_slot: str, *,
+                        batch_size: Optional[int] = None,
+                        ) -> Iterator[Tuple[SlotBatch, np.ndarray]]:
+        """Yield (SlotBatch, group_ids[bs]) where rows of the same group
+        (e.g. search id / pv) are contiguous and never split across
+        batches; group_ids carries the per-row group key (0 on padding
+        rows). Groups larger than batch_size are truncated with a monitor
+        tick (the reference drops such pvs)."""
+        bs = batch_size or self.config.batch_size
+        merged = self._merge()
+        keys, has = merged.group_keys(group_slot)
+        n = merged.num_rows
+        if n == 0:
+            return
+        # Group rank = first-occurrence order (NOT sorted key order: that
+        # would make every epoch's batch composition identical and nullify
+        # local_shuffle between pvs). Keyless rows are singleton groups in
+        # encounter order.
+        gid = np.empty((n,), np.int64)
+        num_keyed = 0
+        if has.any():
+            uniq, inv = np.unique(keys[has], return_inverse=True)
+            num_keyed = uniq.size
+            gid[has] = inv
+        gid[~has] = num_keyed + np.arange(int((~has).sum()))
+        first_seen = np.full(num_keyed + int((~has).sum()), n, np.int64)
+        np.minimum.at(first_seen, gid, np.arange(n))
+        rank_of_gid = np.argsort(np.argsort(first_seen))
+        order = np.argsort(rank_of_gid[gid], kind="stable")
+        merged = merged.take(order)
+        keys = np.where(has, keys, 0)[order]
+        starts = np.concatenate(
+            [[0], np.flatnonzero(keys[1:] != keys[:-1]) + 1, [n]])
+        lo = 0
+        g = 0  # index into starts of the first group of this batch
+        while g < starts.size - 1:
+            lo = starts[g]
+            # extend until next group would overflow the batch
+            h = g + 1
+            while h < starts.size - 1 and starts[h + 1] - lo <= bs:
+                h += 1
+            hi = min(starts[h], lo + bs)
+            if starts[h] - lo > bs and h == g + 1:
+                monitor.add("dataset/pv_truncated", int(starts[h] - lo - bs))
+            batch = merged.pack_batch(lo, hi, self.config, bs)
+            gids = np.zeros((bs,), np.uint64)
+            gids[:hi - lo] = keys[lo:hi]
+            yield batch, gids
+            g = h
 
     def pass_keys(self) -> np.ndarray:
         """Unique feasigns currently loaded (role of the per-pass key set

@@ -1,0 +1,65 @@
+"""Extended (base + expand) sparse embedding pull/push.
+
+Role of ``pull_box_extended_sparse`` (``operators/
+pull_box_extended_sparse_op.{cc,cu,h}``; python wrapper
+``_pull_box_extended_sparse``, ``contrib/layers/nn.py:1674``): each slot
+lookup returns TWO embeddings — the stable base vector plus an "expand"
+vector trained for a newer model head — letting one parameter server
+serve both during model migration.
+
+TPU-first: instead of two tables and two collective round-trips (the
+reference calls into the PS once but scatters to two outputs —
+``CopyForPull`` expand path, ``box_wrapper.cu``), the pass table is built
+with fused width ``d_base + d_expand`` so ONE all-to-all pull moves both;
+the split into (base, expand) is a free slice on the consumer side, and
+pushes concatenate the two grads back into one payload.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.embedding.lookup import pull_local, push_local
+from paddlebox_tpu.embedding.optimizers import SparseOptimizer
+from paddlebox_tpu.embedding.table import PassTable, TableConfig
+
+
+def extended_table_config(base: TableConfig, expand_dim: int) -> TableConfig:
+    """Config for the fused-width table backing an extended lookup."""
+    import dataclasses
+    return dataclasses.replace(base, dim=base.dim + expand_dim)
+
+
+def pull_local_extended(table: PassTable, dev_rows: jax.Array, *,
+                        d_base: int, axis: str
+                        ) -> Dict[str, jax.Array]:
+    """Per-device extended pull: one collective, two embedding outputs
+    (keys: emb / emb_expand / w / show / click)."""
+    d_expand = table.dim - d_base
+    if d_expand <= 0:
+        raise ValueError(
+            f"table dim {table.dim} must exceed d_base {d_base} — build it "
+            "with extended_table_config(base_cfg, expand_dim)")
+    out = pull_local(table, dev_rows, axis=axis)
+    fused = out.pop("emb")
+    out["emb"] = fused[:, :d_base]
+    out["emb_expand"] = fused[:, d_base:]
+    return out
+
+
+def push_local_extended(table: PassTable, dev_rows: jax.Array,
+                        grad_base: jax.Array, grad_expand: jax.Array,
+                        grad_w: jax.Array, shows: jax.Array,
+                        clicks: jax.Array, *, axis: str,
+                        opt: Optional[SparseOptimizer] = None) -> PassTable:
+    """Per-device extended push: concatenated grads, one collective."""
+    grad = jnp.concatenate([grad_base, grad_expand], axis=-1)
+    if grad.shape[-1] != table.dim:
+        raise ValueError(
+            f"base {grad_base.shape[-1]} + expand {grad_expand.shape[-1]} "
+            f"grads != table dim {table.dim}")
+    return push_local(table, dev_rows, grad, grad_w, shows, clicks,
+                      axis=axis, opt=opt)

@@ -1,0 +1,257 @@
+"""Dataset extras tests: disk spill roundtrip + streaming batches,
+pv/ins grouped batching, and the extended (base+expand) embedding
+lookup."""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddlebox_tpu.data.columnar import ColumnarChunk
+from paddlebox_tpu.data.dataset import Dataset
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+from paddlebox_tpu.embedding import extended
+from paddlebox_tpu.embedding.table import (TableConfig,
+                                           build_pass_table_host)
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+
+
+def _config():
+    return DataFeedConfig(
+        slots=(SlotConf("sid"),
+               SlotConf("feat", avg_len=4.0),
+               SlotConf("d0", is_dense=True, dim=2)),
+        batch_size=8)
+
+
+def _write_files(tmp_path, n_files=3, rows_per_file=10):
+    """svm format: label slot:feasign ... slot:v1,v2 (data/parser.py)."""
+    paths = []
+    rng = np.random.default_rng(0)
+    rid = 0
+    for f in range(n_files):
+        lines = []
+        for _ in range(rows_per_file):
+            label = rng.integers(0, 2)
+            sid = 1000 + rid // 3  # ~3 rows share a search id
+            feats = " ".join(f"feat:{int(x)}"
+                             for x in rng.integers(1, 500, 4))
+            lines.append(f"{label} sid:{sid} {feats} d0:0.5,1.5")
+            rid += 1
+        p = tmp_path / f"part-{f:03d}.txt"
+        p.write_text("\n".join(lines) + "\n")
+        paths.append(str(p))
+    return paths
+
+
+def test_disk_spill_roundtrip(tmp_path):
+    cfg = _config()
+    files = _write_files(tmp_path)
+    ds = Dataset(cfg, num_reader_threads=2)
+    ds.set_filelist(files)
+    spill = str(tmp_path / "spill")
+    n_chunks = ds.dump_into_disk(spill)
+    assert n_chunks >= 1
+    assert ds.num_instances == 0  # nothing held in RAM
+
+    ds.load_from_disk(spill)
+    assert ds.num_instances == 30
+
+    # parity with direct in-memory load
+    ds2 = Dataset(cfg, num_reader_threads=2)
+    ds2.set_filelist(files)
+    ds2.load_into_memory()
+    k1, k2 = ds.pass_keys(), ds2.pass_keys()
+    np.testing.assert_array_equal(k1, k2)
+
+
+def test_batches_from_disk_streams(tmp_path):
+    cfg = _config()
+    files = _write_files(tmp_path)
+    ds = Dataset(cfg, num_reader_threads=2)
+    ds.set_filelist(files)
+    spill = str(tmp_path / "spill")
+    ds.dump_into_disk(spill)
+    batches = list(ds.batches_from_disk(spill, batch_size=8))
+    assert sum(int(b.valid.sum()) for b in batches) == 30
+    for b in batches:
+        assert b.labels.shape == (8, 1)  # static shape incl. final pad
+
+
+def test_chunk_save_load_roundtrip(tmp_path):
+    cfg = _config()
+    files = _write_files(tmp_path, n_files=1)
+    ds = Dataset(cfg, num_reader_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    chunk = ds._merge()
+    p = str(tmp_path / "c.npz")
+    chunk.save(p)
+    back = ColumnarChunk.load(p)
+    np.testing.assert_array_equal(back.labels, chunk.labels)
+    for s in chunk.sparse_ids:
+        np.testing.assert_array_equal(back.sparse_ids[s],
+                                      chunk.sparse_ids[s])
+        np.testing.assert_array_equal(back.sparse_offsets[s],
+                                      chunk.sparse_offsets[s])
+    np.testing.assert_array_equal(back.dense["d0"], chunk.dense["d0"])
+
+
+def test_batches_grouped_keeps_pvs_whole(tmp_path):
+    cfg = _config()
+    files = _write_files(tmp_path)
+    ds = Dataset(cfg, num_reader_threads=2)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    ds.local_shuffle(seed=1)  # grouping must undo interleaving
+    seen_groups = {}
+    total = 0
+    for batch, gids in ds.batches_grouped("sid", batch_size=8):
+        valid = batch.valid
+        gv = gids[valid]
+        total += int(valid.sum())
+        # groups are contiguous within the batch
+        changes = (gv[1:] != gv[:-1]).sum()
+        assert changes == len(np.unique(gv)) - 1
+        # no group spans two batches
+        for g in np.unique(gv):
+            assert g not in seen_groups, f"group {g} split across batches"
+            seen_groups[g] = True
+    assert total == 30
+
+
+def test_batches_grouped_respects_shuffle_order(tmp_path):
+    """Shuffling between epochs must change pv batch composition (groups
+    ordered by first occurrence, not sorted key)."""
+    cfg = _config()
+    files = _write_files(tmp_path, n_files=2, rows_per_file=12)
+    ds = Dataset(cfg, num_reader_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+
+    def first_batch_groups(seed):
+        ds.local_shuffle(seed=seed)
+        batch, gids = next(ds.batches_grouped("sid", batch_size=8))
+        return tuple(gids[batch.valid].tolist())
+
+    orders = {first_batch_groups(s) for s in range(5)}
+    assert len(orders) > 1, "epoch shuffles produced identical pv batches"
+
+
+def test_dump_into_disk_clears_stale_chunks(tmp_path):
+    cfg = _config()
+    files = _write_files(tmp_path, n_files=3)
+    spill = str(tmp_path / "spill")
+    ds = Dataset(cfg, num_reader_threads=1)
+    ds.set_filelist(files)
+    ds.dump_into_disk(spill)
+    # re-dump with a smaller filelist: old chunks must not survive
+    ds2 = Dataset(cfg, num_reader_threads=1)
+    ds2.set_filelist(files[:1])
+    ds2.dump_into_disk(spill)
+    ds2.load_from_disk(spill)
+    assert ds2.num_instances == 10
+
+
+def test_load_from_disk_missing_dir_raises(tmp_path):
+    ds = Dataset(_config())
+    with pytest.raises(FileNotFoundError):
+        ds.load_from_disk(str(tmp_path / "nope"))
+
+
+def test_batches_grouped_truncates_oversized_group(tmp_path):
+    cfg = _config()
+    # one giant pv: all 12 rows share sid
+    lines = [f"1 sid:7 feat:{i+1} d0:0,0" for i in range(12)]
+    p = tmp_path / "big.txt"
+    p.write_text("\n".join(lines) + "\n")
+    ds = Dataset(cfg, num_reader_threads=1)
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    out = list(ds.batches_grouped("sid", batch_size=8))
+    assert len(out) == 1  # truncated to one batch, remainder dropped
+    assert int(out[0][0].valid.sum()) == 8
+
+
+# ---------------------------------------------------------------------------
+# extended lookup
+# ---------------------------------------------------------------------------
+
+def test_extended_pull_push(devices8):
+    d_base, d_exp = 4, 2
+    base_cfg = TableConfig(dim=d_base, learning_rate=0.1, initial_g2sum=1.0)
+    cfg = extended.extended_table_config(base_cfg, d_exp)
+    assert cfg.dim == 6
+    n = 16
+    rng = np.random.default_rng(0)
+    vals = {
+        "emb": rng.normal(size=(n, 6)).astype(np.float32),
+        "emb_state": np.zeros((n, 1), np.float32),
+        "w": rng.normal(size=(n,)).astype(np.float32),
+        "w_state": np.zeros((n, 1), np.float32),
+        "show": np.zeros((n,), np.float32),
+        "click": np.zeros((n,), np.float32),
+    }
+    mesh = build_mesh(HybridTopology(dp=8))
+    table = build_pass_table_host(vals, 8, cfg)
+
+    rows = jnp.asarray(rng.integers(0, n, 32), jnp.int32)
+    # map global rows to device-row space: table uses block layout
+    block = table.rows_per_shard + 1
+    dev_rows = (rows // table.rows_per_shard) * block \
+        + rows % table.rows_per_shard
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                       out_specs=P("dp"), check_vma=False)
+    def pull(table, dev_rows):
+        return extended.pull_local_extended(table, dev_rows, d_base=d_base,
+                                            axis="dp")
+
+    out = pull(table, dev_rows)
+    assert out["emb"].shape == (32, d_base)
+    assert out["emb_expand"].shape == (32, d_exp)
+    want = vals["emb"][np.asarray(rows)]
+    np.testing.assert_allclose(np.asarray(out["emb"]), want[:, :d_base],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["emb_expand"]),
+                               want[:, d_base:], rtol=1e-6)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P("dp"),) * 7, out_specs=P("dp"),
+                       check_vma=False)
+    def push(table, dev_rows, gb, ge, gw, s, c):
+        return extended.push_local_extended(table, dev_rows, gb, ge, gw,
+                                            s, c, axis="dp")
+
+    gb = jnp.ones((32, d_base))
+    ge = jnp.full((32, d_exp), 2.0)
+    new_table = jax.jit(push)(table, dev_rows, gb, ge,
+                              jnp.zeros(32), jnp.ones(32), jnp.zeros(32))
+    out2 = pull(new_table, dev_rows)
+    # both halves moved (base by grad 1, expand by grad 2 -> more)
+    db = np.abs(np.asarray(out2["emb"]) - np.asarray(out["emb"])).mean()
+    de = np.abs(np.asarray(out2["emb_expand"])
+                - np.asarray(out["emb_expand"])).mean()
+    assert de > db > 0
+
+
+def test_extended_validation():
+    base_cfg = TableConfig(dim=4)
+    with pytest.raises(ValueError):
+        # table dim == d_base -> no expand part
+        vals = {
+            "emb": np.zeros((4, 4), np.float32),
+            "emb_state": np.zeros((4, 1), np.float32),
+            "w": np.zeros((4,), np.float32),
+            "w_state": np.zeros((4, 1), np.float32),
+            "show": np.zeros((4,), np.float32),
+            "click": np.zeros((4,), np.float32),
+        }
+        t = build_pass_table_host(vals, 1, base_cfg)
+        extended.pull_local_extended(t, jnp.zeros((2,), jnp.int32),
+                                     d_base=4, axis="dp")
