@@ -46,6 +46,9 @@ class Watcher:
         self.poll_sec = poll_sec
         self.procs: List[Optional[subprocess.Popen]] = [None] * len(cmds)
         self.restarts = [0] * len(cmds)
+        # terminate() sets this so run() stops respawning SIGTERM'd ranks
+        # (an elastic restart must not race the failure-restart logic).
+        self._stopping = False
 
     def _spawn(self, i: int) -> None:
         self.procs[i] = subprocess.Popen(self.cmds[i], env=self.envs[i])
@@ -67,6 +70,9 @@ class Watcher:
                     if ret == 0:
                         self.procs[i] = None
                         continue
+                    if self._stopping:
+                        self.procs[i] = None
+                        continue
                     if self.restarts[i] < self.max_restarts:
                         self.restarts[i] += 1
                         log.warning("rank %d exited %d; restart %d/%d", i,
@@ -86,6 +92,7 @@ class Watcher:
             return 130
 
     def terminate(self) -> None:
+        self._stopping = True
         for p in self.procs:
             if p is not None and p.poll() is None:
                 p.send_signal(signal.SIGTERM)
@@ -97,6 +104,61 @@ class Watcher:
                 time.sleep(0.1)
             if p.poll() is None:
                 p.kill()
+
+
+def run_elastic(args) -> int:
+    """Elastic mode: membership from an ElasticManager over a shared dir;
+    rank/world derive from the published rank table and workers restart on
+    membership generation changes (role of `paddle.distributed.run
+    --elastic` wiring ElasticManager into the launch controllers)."""
+    import socket
+    import threading
+
+    from paddlebox_tpu.launch.elastic import ElasticManager
+
+    host_id = args.host_id or socket.gethostname()
+    em = ElasticManager(args.elastic_dir, host_id,
+                        min_hosts=args.min_hosts, max_hosts=args.max_hosts)
+    em.start()
+    try:
+        while True:
+            try:
+                table = em.wait_for_quorum(timeout=args.elastic_timeout)
+            except TimeoutError:
+                log.error("elastic: quorum of %d hosts not reached in %.0fs",
+                          args.min_hosts, args.elastic_timeout)
+                return 3
+            gen = table.generation
+            host_rank = table.rank_of(host_id)
+            world = table.world_size * args.nproc
+            cmds, envs = [], []
+            for i in range(args.nproc):
+                rank = host_rank * args.nproc + i
+                cmds.append([sys.executable, args.script] + args.script_args)
+                env = build_env(rank, world, args.coordinator)
+                env["PBX_ELASTIC_GENERATION"] = str(gen)
+                envs.append(env)
+            log.vlog(0, "elastic gen %d: host %s rank %d world %d", gen,
+                     host_id, host_rank, world)
+            watcher = Watcher(cmds, envs, max_restarts=args.max_restarts)
+            result: List[Optional[int]] = [None]
+            t = threading.Thread(target=lambda: result.__setitem__(
+                0, watcher.run()), daemon=True)
+            t.start()
+            while t.is_alive():
+                t.join(0.5)
+                cur = em.current_table()
+                if cur is not None and cur.generation != gen:
+                    log.warning("elastic: membership gen %d -> %d; "
+                                "restarting workers", gen, cur.generation)
+                    watcher.terminate()
+                    t.join(10.0)
+                    break
+            else:
+                return result[0] if result[0] is not None else 1
+            # membership changed: loop — wait for the new table and relaunch
+    finally:
+        em.stop()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -113,9 +175,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="total processes across hosts (default: nproc)")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="per-rank restart budget on failure (elastic)")
+    ap.add_argument("--elastic-dir", default="",
+                    help="shared dir for elastic membership (enables "
+                         "elastic mode: ranks come from the lease table)")
+    ap.add_argument("--host-id", default="",
+                    help="elastic host identity (default: hostname)")
+    ap.add_argument("--min-hosts", type=int, default=1,
+                    help="elastic quorum size")
+    ap.add_argument("--max-hosts", type=int, default=0,
+                    help="elastic max hosts (0 = unbounded)")
+    ap.add_argument("--elastic-timeout", type=float, default=300.0,
+                    help="seconds to wait for elastic quorum")
     ap.add_argument("script", help="training script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
+
+    if args.elastic_dir:
+        return run_elastic(args)
 
     world = args.world_size or args.nproc
     cmds, envs = [], []

@@ -1,0 +1,222 @@
+"""Filesystem abstraction: local posix + HDFS/AFS via shell client.
+
+Role of the reference's ``paddle/fluid/framework/io/fs.{cc,h}``: one
+interface over local files and HDFS, where HDFS access shells out to the
+``hadoop fs`` CLI through popen pipes (``fs.cc:224-244``, ``shell_popen``
+``fs.cc:69``) — used by dump writers (``boxps_trainer.cc:110``), dataset
+readers (``pipe_command``), and the checkpoint save paths; plus the boxps
+``PaddleFileMgr`` AFS client (``box_wrapper.h:716``).
+
+TPU-first/neutral: same split — :class:`LocalFS` is plain python IO;
+:class:`HadoopFS` drives a configurable CLI (``hadoop fs`` by default, so
+an ``afs``/``gsutil``-style tool can swap in). Scheme-based routing via
+:func:`fs_for`: paths like ``hdfs://...`` or ``afs://...`` pick the shell
+client, everything else is local.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import IO, List, Optional
+
+from paddlebox_tpu.core import log
+
+
+class FS:
+    """Interface (role of the fs.h function table)."""
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def ls(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def open_read(self, path: str) -> IO[bytes]:
+        raise NotImplementedError
+
+    def open_write(self, path: str) -> IO[bytes]:
+        raise NotImplementedError
+
+    def mkdir(self, path: str) -> None:
+        raise NotImplementedError
+
+    def remove(self, path: str) -> None:
+        raise NotImplementedError
+
+    def rename(self, src: str, dst: str) -> None:
+        raise NotImplementedError
+
+    def put(self, local_path: str, remote_path: str) -> None:
+        raise NotImplementedError
+
+    def get(self, remote_path: str, local_path: str) -> None:
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Plain posix IO (role of the local_* half of fs.cc)."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def ls(self, path: str) -> List[str]:
+        return sorted(os.path.join(path, n) for n in os.listdir(path))
+
+    def open_read(self, path: str) -> IO[bytes]:
+        return open(path, "rb")
+
+    def open_write(self, path: str) -> IO[bytes]:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        return open(path, "wb")
+
+    def mkdir(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+    def put(self, local_path: str, remote_path: str) -> None:
+        if os.path.abspath(local_path) != os.path.abspath(remote_path):
+            shutil.copy(local_path, remote_path)
+
+    def get(self, remote_path: str, local_path: str) -> None:
+        self.put(remote_path, local_path)
+
+
+class _PipeStream:
+    """Wraps a CLI subprocess pipe so close() is DURABLE: it waits for the
+    process and raises on nonzero exit — otherwise a failed ``-put``
+    (quota/permission/network) would silently lose the data, and a
+    ``-cat`` of a missing path would read as an empty file."""
+
+    def __init__(self, proc: subprocess.Popen, stream: IO[bytes],
+                 desc: str):
+        self._proc = proc
+        self._stream = stream
+        self._desc = desc
+        self._closed = False
+
+    def read(self, *a) -> bytes:
+        return self._stream.read(*a)
+
+    def readline(self, *a) -> bytes:
+        return self._stream.readline(*a)
+
+    def write(self, data: bytes) -> int:
+        return self._stream.write(data)
+
+    def __iter__(self):
+        return iter(self._stream)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._stream.close()
+        finally:
+            rc = self._proc.wait()
+        if rc != 0:
+            raise IOError(f"{self._desc} failed with exit code {rc}")
+
+    def __enter__(self) -> "_PipeStream":
+        return self
+
+    def __exit__(self, et, ev, tb) -> None:
+        # Propagate the CLI failure unless an exception is already flying.
+        if et is None:
+            self.close()
+        else:
+            try:
+                self.close()
+            except IOError:
+                pass
+
+
+class HadoopFS(FS):
+    """HDFS-family client shelling out to the hadoop CLI (role of the
+    hdfs_* half of fs.cc: every op is ``<cmd> fs -<op>`` through a pipe).
+
+    ``command`` is the CLI prefix (default ``hadoop fs``); extra configs
+    (ugi, name services) ride in via ``args`` — mirroring the reference's
+    ``fs.ugi``-style options passed per call.
+    """
+
+    def __init__(self, command: str = "hadoop fs",
+                 args: Optional[List[str]] = None, timeout: float = 300.0):
+        self._cmd = command.split() + list(args or [])
+        self.timeout = timeout
+
+    def _run(self, *op: str, check: bool = True
+             ) -> subprocess.CompletedProcess:
+        cmd = self._cmd + list(op)
+        proc = subprocess.run(cmd, capture_output=True, timeout=self.timeout)
+        if check and proc.returncode != 0:
+            raise IOError(
+                f"{' '.join(cmd)} failed ({proc.returncode}): "
+                f"{proc.stderr.decode(errors='replace')[:500]}")
+        return proc
+
+    def exists(self, path: str) -> bool:
+        return self._run("-test", "-e", path, check=False).returncode == 0
+
+    def ls(self, path: str) -> List[str]:
+        out = self._run("-ls", path).stdout.decode()
+        paths = []
+        for line in out.splitlines():
+            parts = line.split()
+            # 'hadoop fs -ls' rows end with the path; skip the summary line
+            if len(parts) >= 8:
+                paths.append(parts[-1])
+        return paths
+
+    def open_read(self, path: str) -> IO[bytes]:
+        """Streaming read through a pipe (role of hdfs_open_read's
+        ``-text``/``-cat`` popen, fs.cc:224). close() raises if the CLI
+        failed (e.g. missing path) instead of reading as empty."""
+        proc = subprocess.Popen(self._cmd + ["-cat", path],
+                                stdout=subprocess.PIPE)
+        return _PipeStream(proc, proc.stdout,  # type: ignore[arg-type]
+                           f"read {path}")  # type: ignore[return-value]
+
+    def open_write(self, path: str) -> IO[bytes]:
+        """Streaming write through ``-put - <path>`` (fs.cc:244); close()
+        blocks until the upload lands and raises on failure."""
+        proc = subprocess.Popen(self._cmd + ["-put", "-f", "-", path],
+                                stdin=subprocess.PIPE)
+        return _PipeStream(proc, proc.stdin,  # type: ignore[arg-type]
+                           f"write {path}")  # type: ignore[return-value]
+
+    def mkdir(self, path: str) -> None:
+        self._run("-mkdir", "-p", path)
+
+    def remove(self, path: str) -> None:
+        self._run("-rm", "-r", "-f", path)
+
+    def rename(self, src: str, dst: str) -> None:
+        self._run("-mv", src, dst)
+
+    def put(self, local_path: str, remote_path: str) -> None:
+        self._run("-put", "-f", local_path, remote_path)
+
+    def get(self, remote_path: str, local_path: str) -> None:
+        self._run("-get", remote_path, local_path)
+
+
+_REMOTE_SCHEMES = ("hdfs://", "afs://", "viewfs://")
+
+
+def fs_for(path: str, *, hadoop_command: str = "hadoop fs",
+           hadoop_args: Optional[List[str]] = None) -> FS:
+    """Scheme-routed FS selection (role of fs_select in fs.cc)."""
+    if path.startswith(_REMOTE_SCHEMES):
+        return HadoopFS(hadoop_command, hadoop_args)
+    return LocalFS()
