@@ -1,0 +1,162 @@
+"""Downpour-style async CPU-PS training: pull/push per batch over PSClient.
+
+Role of the reference's CPU async-PS path (``DistMultiTrainer`` +
+``DownpourWorker``, ``trainer.h:141``, ``device_worker.h:302``): each
+worker pulls the batch's sparse values from the parameter server, runs
+fwd/bwd locally, pushes sparse+dense gradients back asynchronously, while
+a background ``PullDenseWorker`` (``device_worker.h:87``,
+``pull_dense_worker.cc``) keeps a fresh copy of the dense params.
+
+TPU-first: the device step is one jitted fn over STATIC shapes (ids are
+pulled host-side into a padded [cap, dim] buffer); PS traffic is the
+host-side :class:`~paddlebox_tpu.distributed.ps.PSClient`. This is the
+``strategy.a_sync`` execution mode — the high-throughput BoxPS-style path
+keeps tables in device HBM instead (:mod:`paddlebox_tpu.train.
+ctr_trainer`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.core import log
+from paddlebox_tpu.distributed.ps import PSClient
+
+
+class PullDenseWorker:
+    """Background dense-param refresher (role of PullDenseWorker,
+    device_worker.h:87): polls the PS and publishes versioned snapshots."""
+
+    def __init__(self, client: PSClient, names, interval: float = 0.05):
+        self.client = client
+        self.names = list(names)
+        self.interval = interval
+        self._latest: Dict[str, np.ndarray] = {}
+        self._version = 0
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def _pull_once(self) -> None:
+        vals = {n: self.client.pull_dense(n) for n in self.names}
+        with self._lock:
+            self._latest = vals
+            self._version += 1
+
+    def start(self) -> None:
+        self._pull_once()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                self._pull_once()
+            except Exception as e:
+                log.warning("pull_dense failed: %s", e)
+            time.sleep(self.interval)
+
+    def latest(self) -> Dict[str, np.ndarray]:
+        with self._lock:
+            return dict(self._latest)
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+
+class DownpourTrainer:
+    """Async-PS sparse trainer.
+
+    ``loss_fn(dense_params, emb [cap, D], w [cap], batch) -> scalar`` —
+    emb/w are the pulled values for ``batch["ids"]`` (padded to the static
+    capacity with zeros; ``batch["ids"]`` is a [cap] uint64 array where 0
+    marks padding). Gradients w.r.t. emb/w are pushed to the PS sparse
+    table; gradients w.r.t. dense params are pushed to PS dense tables
+    (server-side apply), with fresh dense params pulled in the background.
+    """
+
+    def __init__(self, client: PSClient, table: str,
+                 loss_fn: Callable[..., jax.Array],
+                 dense_init: Dict[str, np.ndarray], *,
+                 pull_interval: float = 0.05):
+        self.client = client
+        self.table = table
+        self.loss_fn = loss_fn
+        for name, v in dense_init.items():
+            self.client.set_dense(name, v)
+        self.pull_worker = PullDenseWorker(client, dense_init.keys(),
+                                           pull_interval)
+        self.pull_worker.start()
+        self._grad_fn = None
+
+    def _build(self):
+        if self._grad_fn is None:
+            def val_grad(dense, emb, w, batch):
+                return self.loss_fn(dense, emb, w, batch)
+            self._grad_fn = jax.jit(
+                jax.value_and_grad(val_grad, argnums=(0, 1, 2)))
+        return self._grad_fn
+
+    def train_step(self, batch: Dict[str, Any]) -> float:
+        """One async step: pull sparse → device fwd/bwd → push grads."""
+        ids = np.asarray(batch["ids"], np.uint64)
+        pad = ids == 0
+        real = ~pad
+        if not real.any():
+            raise ValueError("batch has no real (nonzero) ids")
+        # Pull only real ids: the server persists an initialized row for
+        # every pulled key, so pulling padding zeros would CREATE a
+        # feasign-0 row in the table.
+        pulled = self.client.pull_sparse(self.table, ids[real])
+        dense = {k: jnp.asarray(v)
+                 for k, v in self.pull_worker.latest().items()}
+        emb_np = np.zeros((ids.shape[0], pulled["emb"].shape[1]),
+                          np.float32)
+        w_np = np.zeros((ids.shape[0],), np.float32)
+        emb_np[real] = pulled["emb"]
+        w_np[real] = pulled["w"]
+        emb = jnp.asarray(emb_np)
+        w = jnp.asarray(w_np)
+        loss, (g_dense, g_emb, g_w) = self._build()(dense, emb, w, batch)
+        # Padding rows must not train feasign 0.
+        if real.any():
+            self.client.push_sparse(
+                self.table, ids[real],
+                emb_grad=np.asarray(g_emb)[real],
+                w_grad=np.asarray(g_w)[real],
+                show=np.ones(int(real.sum()), np.float32),
+                click=np.asarray(batch.get(
+                    "click", np.zeros(ids.shape[0], np.float32)))[real])
+        for name, g in g_dense.items():
+            self.client.push_dense(name, np.asarray(g))
+        return float(loss)
+
+    def fit(self, batches: Iterable[Dict[str, Any]], *,
+            log_every: int = 0) -> Dict[str, float]:
+        first = last = float("nan")
+        n = 0
+        for batch in batches:
+            last = self.train_step(batch)
+            if n == 0:
+                first = last
+            n += 1
+            if log_every and n % log_every == 0:
+                log.vlog(0, "downpour step %d loss %.5f", n, last)
+        return {"steps": n, "loss_first": first, "loss_last": last}
+
+    def stop(self) -> None:
+        self.pull_worker.stop()

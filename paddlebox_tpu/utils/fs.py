@@ -85,6 +85,7 @@ class LocalFS(FS):
 
     def put(self, local_path: str, remote_path: str) -> None:
         if os.path.abspath(local_path) != os.path.abspath(remote_path):
+            os.makedirs(os.path.dirname(remote_path) or ".", exist_ok=True)
             shutil.copy(local_path, remote_path)
 
     def get(self, remote_path: str, local_path: str) -> None:
@@ -98,10 +99,11 @@ class _PipeStream:
     ``-cat`` of a missing path would read as an empty file."""
 
     def __init__(self, proc: subprocess.Popen, stream: IO[bytes],
-                 desc: str):
+                 desc: str, reading: bool = False):
         self._proc = proc
         self._stream = stream
         self._desc = desc
+        self._reading = reading
         self._closed = False
 
     def read(self, *a) -> bytes:
@@ -124,6 +126,10 @@ class _PipeStream:
             self._stream.close()
         finally:
             rc = self._proc.wait()
+        # Read side: closing before EOF SIGPIPEs the CLI (exit 141/-13) —
+        # that's a deliberate partial read, not a failure.
+        if self._reading and rc in (141, -13):
+            return
         if rc != 0:
             raise IOError(f"{self._desc} failed with exit code {rc}")
 
@@ -185,7 +191,8 @@ class HadoopFS(FS):
         proc = subprocess.Popen(self._cmd + ["-cat", path],
                                 stdout=subprocess.PIPE)
         return _PipeStream(proc, proc.stdout,  # type: ignore[arg-type]
-                           f"read {path}")  # type: ignore[return-value]
+                           f"read {path}",
+                           reading=True)  # type: ignore[return-value]
 
     def open_write(self, path: str) -> IO[bytes]:
         """Streaming write through ``-put - <path>`` (fs.cc:244); close()

@@ -1,0 +1,103 @@
+"""Program introspection: jaxpr/HLO views, compile stats, tensor printer.
+
+Roles from the reference (SURVEY.md §2.1/§2.7): the IR pass framework's
+graph views (``framework/ir/graph.h`` — here the jaxpr IS the graph and
+XLA owns the passes, so the useful equivalent is *inspection*), the CINN
+compiler bridge's compiled-subgraph stats (``paddle2cinn/cinn_compiler``),
+and ``lodtensor_printer`` (per-tensor debug summaries pulled from scopes).
+
+TPU-first: everything reads from JAX's own artifacts — ``make_jaxpr`` for
+the traced graph, ``lower().as_text()`` for HLO, and the compiled
+executable's memory/cost analyses for what XLA actually scheduled.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from paddlebox_tpu.core import log
+
+
+def jaxpr_summary(fn: Callable, *args, **kw) -> Dict[str, int]:
+    """Count of equations by primitive in the traced program (the op-level
+    graph view the IR passes of the reference operate on)."""
+    jaxpr = jax.make_jaxpr(fn, **kw)(*args)
+
+    def subjaxprs(p):
+        # scan/pjit carry one ClosedJaxpr; cond carries a tuple of branch
+        # ClosedJaxprs — cover both container shapes.
+        items = p if isinstance(p, (tuple, list)) else (p,)
+        for item in items:
+            inner = getattr(item, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner
+
+    def walk(jx) -> Counter:
+        c: Counter = Counter()
+        for eqn in jx.eqns:
+            c[eqn.primitive.name] += 1
+            for p in eqn.params.values():
+                for inner in subjaxprs(p):
+                    c += walk(inner)
+        return c
+
+    return dict(walk(jaxpr.jaxpr))
+
+
+def hlo_text(fn: Callable, *args, dialect: str = "stablehlo") -> str:
+    """Lowered program text (what the reference would dump from its
+    compiled subgraphs / CINN bridge)."""
+    return jax.jit(fn).lower(*args).as_text(dialect)
+
+
+def compiled_stats(fn: Callable, *args) -> Dict[str, Any]:
+    """Post-compilation facts from XLA: memory analysis (bytes by class)
+    and cost analysis (flops etc.) when the backend provides them."""
+    compiled = jax.jit(fn).lower(*args).compile()
+    out: Dict[str, Any] = {}
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            for f in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "generated_code_size_in_bytes"):
+                v = getattr(mem, f, None)
+                if v is not None:
+                    out[f] = int(v)
+    except Exception:  # backend-dependent
+        pass
+    try:
+        cost = compiled.cost_analysis()
+        if cost:
+            c = cost[0] if isinstance(cost, (list, tuple)) else cost
+            for k in ("flops", "bytes accessed"):
+                if k in c:
+                    out[k.replace(" ", "_")] = float(c[k])
+    except Exception:
+        pass
+    return out
+
+
+def print_tensor(x, name: str = "tensor", *, max_vals: int = 8) -> str:
+    """One-line tensor debug summary (role of lodtensor_printer's
+    PrintVar): shape/dtype/min/mean/max/nonfinite + leading values.
+    Returns the line (and logs it)."""
+    arr = np.asarray(x)
+    if arr.size == 0:
+        line = f"{name}: shape={arr.shape} dtype={arr.dtype} <empty>"
+    elif np.issubdtype(arr.dtype, np.number):
+        flat = arr.ravel()
+        head = np.array2string(flat[:max_vals], precision=4,
+                               separator=",", threshold=max_vals)
+        nonfinite = (int(np.size(flat) - np.isfinite(flat).sum())
+                     if np.issubdtype(arr.dtype, np.inexact) else 0)
+        line = (f"{name}: shape={arr.shape} dtype={arr.dtype} "
+                f"min={flat.min():.6g} mean={flat.mean():.6g} "
+                f"max={flat.max():.6g} nonfinite={nonfinite} head={head}")
+    else:
+        line = f"{name}: shape={arr.shape} dtype={arr.dtype}"
+    log.vlog(0, "%s", line)
+    return line

@@ -106,6 +106,11 @@ def test_hadoop_fs_against_shim(fake_hdfs, tmp_path):
     assert set(names) == {"part-0", "up.txt"}
     fs.rename(f"{base}/up.txt", f"{base}/moved.txt")
     assert fs.exists(f"{base}/moved.txt")
+    # a deliberate partial read must NOT raise (SIGPIPE on the CLI)
+    with fs.open_write(f"{base}/big") as f:
+        f.write(b"x" * (1 << 20))
+    with fs.open_read(f"{base}/big") as f:
+        assert f.read(10) == b"x" * 10
     fs.remove(base)
     assert not fs.exists(base)
 
