@@ -75,9 +75,12 @@ def _read_blocks(path: str, pipe_command: str) -> Iterator[bytes]:
                     f"pipe_command {pipe_command!r} exited {ret} on {path}")
 
 
-def _parse_block(block: bytes, config: DataFeedConfig) -> ColumnarChunk:
-    """Native C++ parse when available, python fallback otherwise."""
-    if config.parser == "svm":
+def _parse_block(block: bytes, config: DataFeedConfig,
+                 parser_fn=None) -> ColumnarChunk:
+    """Native C++ parse when available, python fallback otherwise.
+    ``parser_fn`` overrides the registry lookup (instance-scoped custom
+    parser — the DLManager plugin role without global registration)."""
+    if parser_fn is None and config.parser == "svm":
         from paddlebox_tpu.native.parser_py import parse_chunk_native
         chunk = parse_chunk_native(block, config)
         if chunk is not None:
@@ -86,7 +89,9 @@ def _parse_block(block: bytes, config: DataFeedConfig) -> ColumnarChunk:
     # parser; str.splitlines would also break on NEL/FF/LS etc. and make
     # the two parser paths disagree on exotic bytes.
     lines = block.decode("utf-8", "replace").split("\n")
-    return instances_to_chunk(parse_lines(lines, config), config)
+    instances = (parser_fn(lines, config) if parser_fn is not None
+                 else parse_lines(lines, config))
+    return instances_to_chunk(instances, config)
 
 
 class Dataset:
@@ -104,8 +109,12 @@ class Dataset:
     """
 
     def __init__(self, config: DataFeedConfig, *, num_reader_threads: int = 4,
-                 channel_capacity: int = 64):
+                 channel_capacity: int = 64, parser_fn=None):
         self.config = config
+        # Instance-scoped parser override (DLManager custom-parser role
+        # without mutating the global registry): fn(lines, config) ->
+        # List[Instance].
+        self.parser_fn = parser_fn
         self.num_reader_threads = max(1, num_reader_threads)
         self._channel_capacity = channel_capacity
         self._filelist: List[str] = []
@@ -142,7 +151,7 @@ class Dataset:
                     return
                 n = 0
                 for block in _read_blocks(path, self.config.pipe_command):
-                    chunk = _parse_block(block, self.config)
+                    chunk = _parse_block(block, self.config, self.parser_fn)
                     n += chunk.num_rows
                     out.put(chunk)
                 monitor.add("dataset/ins_loaded", n)

@@ -1,0 +1,87 @@
+"""InputTableDataset: string-keyed slots mapped to dense row indices.
+
+Role of ``InputTableDataset`` (``data_set.h:568``) + the BoxWrapper
+``InputTable`` (``box_wrapper.h:124-197``) + the ``lookup_input`` op: raw
+string features (URLs, app ids) are interned into a process-wide
+string→index dictionary at LOAD time, the index flows through the graph
+as an ordinary feasign, and at train time ``lookup_input`` gathers the
+row from a replicated aux table.
+
+TPU-first: the interned index + 1 is stored as the slot's feasign (0 is
+the padding sentinel downstream, so real index i rides as i+1);
+:func:`lookup_input` undoes the offset against a
+:class:`~paddlebox_tpu.embedding.cache.ReplicaCache`, whose replicated
+sharding makes the gather collective-free on every chip.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.data.dataset import Dataset
+from paddlebox_tpu.data.parser import get_parser
+from paddlebox_tpu.data.slots import DataFeedConfig, Instance
+from paddlebox_tpu.embedding.cache import InputTable, ReplicaCache
+
+
+def make_input_table_parser(table: InputTable, string_slots: Set[str],
+                            base_parser: str = "svm"):
+    """Wrap a registered parser so tokens of ``string_slots`` are interned
+    through ``table`` BEFORE the base parser sees them (the base parser
+    then treats the interned index+1 as an ordinary feasign)."""
+    def parse(lines, config: DataFeedConfig) -> List[Instance]:
+        nl = config.num_labels
+        rewritten = []
+        for line in lines:
+            toks = line.split()
+            if len(toks) < nl:
+                rewritten.append(line)
+                continue
+            out_toks = toks[:nl]
+            for tok in toks[nl:]:
+                slot, sep, val = tok.partition(":")
+                # Empty values stay malformed: the plain path drops such
+                # lines, and interning '' would silently train a phantom
+                # empty-string feature instead.
+                if sep and val and slot in string_slots:
+                    idx = table.add(val)
+                    out_toks.append(f"{slot}:{idx + 1}")  # 0 = padding
+                    monitor.add("input_table/interned")
+                else:
+                    out_toks.append(tok)
+            rewritten.append(" ".join(out_toks))
+        return get_parser(base_parser)(rewritten, config)
+
+    return parse
+
+
+class InputTableDataset(Dataset):
+    """Dataset whose ``string_slots`` are interned via an InputTable at
+    load time (role of InputTableDataset, data_set.h:568)."""
+
+    def __init__(self, config: DataFeedConfig,
+                 string_slots: Sequence[str],
+                 table: Optional[InputTable] = None, **kw):
+        self.input_table = table if table is not None else InputTable()
+        self.string_slots = set(string_slots)
+        unknown = self.string_slots - {s.name for s in config.sparse_slots}
+        if unknown:
+            raise ValueError(
+                f"string_slots {sorted(unknown)} are not sparse slots of "
+                "the feed config")
+        # Instance-scoped parser hook — registering a uniquely-named
+        # closure in the global registry would leak one entry (pinning
+        # this table) per dataset instance across day-over-day loops.
+        super().__init__(config, parser_fn=make_input_table_parser(
+            self.input_table, self.string_slots, config.parser), **kw)
+
+
+def lookup_input(cache: ReplicaCache, ids: jax.Array) -> jax.Array:
+    """Gather aux-table rows for interned slot feasigns (role of the
+    ``lookup_input`` op): feasign i+1 → cache row i; padding feasign 0
+    (and any unseen id past the cache) yields zeros."""
+    return cache.pull(ids.astype(jnp.int32) - 1)

@@ -403,6 +403,11 @@ class PSClient:
                   ) -> Dict[str, np.ndarray]:
         """Bulk pass-build fetch, reassembled to the sorted key order."""
         keys = np.asarray(keys_sorted, np.uint64)
+        if keys.size == 0:
+            # Preserve the FeatureStore contract: an empty pass returns
+            # fully-shaped (0, ...) field arrays, not {} — ask one server
+            # for an empty pull to get the schema.
+            return self._call(0, "pull_pass", table=table, keys=keys)
         owner, order = self._split(keys)
         results: Dict[int, Tuple[np.ndarray, Dict[str, np.ndarray]]] = {}
         errs: List[BaseException] = []
@@ -494,6 +499,46 @@ class PSClient:
                     s.close()
                 except OSError:
                     pass
+
+
+class PSBackedStore:
+    """FeatureStore-shaped adapter over a remote PS cluster — plugs into
+    :class:`~paddlebox_tpu.embedding.pass_engine.PassEngine` as its
+    backing store, making the pass build pull values from the PS servers
+    and EndPass write them back (exactly the reference's BuildPull-from-
+    CPU-PS flow, ps_gpu_wrapper.cc:362, and EndPass write-back :983 —
+    but with the hot training tier in TPU HBM)."""
+
+    def __init__(self, client: PSClient, table: str):
+        self.client = client
+        self.table = table
+
+    def pull_for_pass(self, pass_keys_sorted: np.ndarray
+                      ) -> Dict[str, np.ndarray]:
+        return self.client.pull_pass(self.table, pass_keys_sorted)
+
+    def push_from_pass(self, pass_keys_sorted: np.ndarray,
+                       values: Dict[str, np.ndarray]) -> None:
+        self.client.push_pass(self.table, pass_keys_sorted, values)
+
+    @property
+    def num_features(self) -> int:
+        return int(sum(s.get(self.table, 0) for s in self.client.stats()))
+
+    # Checkpoint/maintenance surface, delegated to the PS cluster so the
+    # documented trainer flow (engine.store.save_base(path)) works the
+    # same against a remote tier — each server writes part-NNNNN shards.
+    def save_base(self, path: str) -> None:
+        self.client.save(path, "base")
+
+    def save_delta(self, path: str) -> None:
+        self.client.save(path, "delta")
+
+    def load(self, path: str, kind: str = "base") -> None:
+        self.client.load(path, kind)
+
+    def shrink(self, *, min_show: float = 0.0) -> int:
+        return self.client.shrink(min_show=min_show)
 
 
 def start_local_cluster(num_servers: int, tables: Dict[str, TableConfig],

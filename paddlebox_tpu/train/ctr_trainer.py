@@ -60,7 +60,8 @@ class CTRTrainer:
     def __init__(self, model, feed_config: DataFeedConfig,
                  table_config: TableConfig, *,
                  mesh: Optional[Mesh] = None, axis: str = "dp",
-                 config: TrainerConfig = TrainerConfig()):
+                 config: TrainerConfig = TrainerConfig(),
+                 store=None):
         self.model = model
         self.feed_config = feed_config
         self.config = config
@@ -71,7 +72,11 @@ class CTRTrainer:
             raise ValueError(
                 f"batch_size {feed_config.batch_size} must be divisible by "
                 f"the {axis} axis size {self.ndev}")
-        self.engine = PassEngine(table_config, mesh=mesh, table_axis=axis)
+        # store: optional FeatureStore-shaped backing tier — a
+        # TieredFeatureStore (RAM+SSD) or a distributed.ps.PSBackedStore
+        # (remote CPU PS, the BuildPull flow); default in-RAM store.
+        self.engine = PassEngine(table_config, store, mesh=mesh,
+                                 table_axis=axis)
         self.sparse_opt = make_sparse_optimizer(table_config)
         self.params: Any = None
         self.opt_state: Any = None

@@ -1,0 +1,122 @@
+"""InputTableDataset tests: string interning at load, stable indices,
+lookup_input gather semantics, multi-threaded load consistency."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data.input_table import (InputTableDataset, lookup_input)
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+from paddlebox_tpu.embedding.cache import InputTable, ReplicaCache
+
+
+def _config():
+    return DataFeedConfig(
+        slots=(SlotConf("url"), SlotConf("feat", avg_len=2.0)),
+        batch_size=4)
+
+
+def _write(tmp_path, name, lines):
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_interning_and_roundtrip(tmp_path):
+    cfg = _config()
+    f = _write(tmp_path, "a.txt", [
+        "1 url:http://a.com feat:11",
+        "0 url:http://b.com feat:12 feat:13",
+        "1 url:http://a.com feat:14",      # repeated url -> same index
+    ])
+    ds = InputTableDataset(cfg, ["url"])
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    assert ds.input_table.size == 2
+    chunk = ds._merge()
+    urls = chunk.sparse_ids["url"]
+    # rows 0 and 2 share an interned id; ids are index+1 (nonzero)
+    assert urls[0] == urls[2] != urls[1]
+    assert urls.min() >= 1
+    # feat slot passed through untouched
+    np.testing.assert_array_equal(np.sort(chunk.sparse_ids["feat"]),
+                                  [11, 12, 13, 14])
+    # the table resolves back to the original strings
+    idx = int(urls[0]) - 1
+    assert ds.input_table.key_at(idx) == "http://a.com"
+
+
+def test_string_slot_must_be_sparse():
+    with pytest.raises(ValueError):
+        InputTableDataset(_config(), ["nope"])
+
+
+def test_empty_string_value_stays_malformed(tmp_path):
+    """'url:' must be dropped like the plain svm path drops it — not
+    interned as a phantom empty-string feature."""
+    cfg = _config()
+    f = _write(tmp_path, "m.txt", [
+        "1 url: feat:11",              # malformed: dropped
+        "0 url:ok feat:12",
+    ])
+    ds = InputTableDataset(cfg, ["url"])
+    ds.set_filelist([f])
+    ds.load_into_memory()
+    assert ds.num_instances == 1
+    assert ds.input_table.size == 1
+    assert ds.input_table.key_at(0) == "ok"
+
+
+def test_no_global_registry_leak(tmp_path):
+    from paddlebox_tpu.data import parser as parser_mod
+    before = set(parser_mod._REGISTRY)
+    for _ in range(5):
+        InputTableDataset(_config(), ["url"])
+    assert set(parser_mod._REGISTRY) == before
+
+
+def test_shared_table_across_datasets(tmp_path):
+    """Day-over-day loads share one table so indices stay stable (the
+    reference keeps the InputTable in the BoxWrapper singleton)."""
+    cfg = _config()
+    table = InputTable()
+    f1 = _write(tmp_path, "d1.txt", ["1 url:x feat:1", "0 url:y feat:2"])
+    f2 = _write(tmp_path, "d2.txt", ["1 url:y feat:3", "0 url:z feat:4"])
+    d1 = InputTableDataset(cfg, ["url"], table=table)
+    d1.set_filelist([f1])
+    d1.load_into_memory()
+    d2 = InputTableDataset(cfg, ["url"], table=table)
+    d2.set_filelist([f2])
+    d2.load_into_memory()
+    assert table.size == 3
+    # 'y' got the same index in both days
+    y1 = d1._merge().sparse_ids["url"][1]
+    y2 = d2._merge().sparse_ids["url"][0]
+    assert y1 == y2
+
+
+def test_lookup_input_gather(devices8):
+    values = np.arange(12, dtype=np.float32).reshape(4, 3)
+    cache = ReplicaCache(values)
+    # feasigns: 1 -> row 0, 3 -> row 2, 0 -> padding (zeros)
+    out = lookup_input(cache, jnp.asarray([1, 3, 0], jnp.int32))
+    np.testing.assert_allclose(np.asarray(out),
+                               [values[0], values[2], [0, 0, 0]])
+
+
+def test_multithreaded_load_consistent(tmp_path):
+    """Many files loaded by concurrent readers: every occurrence of a
+    string maps to one index (lock-sharded insert, box_wrapper.h:151)."""
+    cfg = _config()
+    files = []
+    for i in range(6):
+        lines = [f"1 url:site-{j % 7} feat:{j + 1}" for j in range(40)]
+        files.append(_write(tmp_path, f"p{i}.txt", lines))
+    ds = InputTableDataset(cfg, ["url"])
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    assert ds.input_table.size == 7
+    chunk = ds._merge()
+    # group rows by url feasign: all rows of one feasign share one string
+    ids = chunk.sparse_ids["url"]
+    assert set(np.unique(ids)) == set(range(1, 8))

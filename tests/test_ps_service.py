@@ -73,6 +73,16 @@ def test_pull_push_pass_bulk(cluster):
     np.testing.assert_allclose(back["emb"], 7.0)
 
 
+def test_pull_pass_empty_keeps_schema(cluster):
+    """Zero-key pass returns fully-shaped (0, ...) field arrays (the
+    FeatureStore contract PassEngine builds against), not {}."""
+    _, client, _ = cluster
+    rows = client.pull_pass("emb", np.empty((0,), np.uint64))
+    assert rows["emb"].shape == (0, 4)
+    assert rows["emb_state"].shape[0] == 0
+    assert rows["w"].shape == (0,)
+
+
 def test_dense_table_and_save_load(cluster, tmp_path):
     servers, client, _ = cluster
     np.testing.assert_allclose(client.pull_dense("w0"), 1.0)
