@@ -195,9 +195,13 @@ class FeatureStore:
     def _save_arrays(self, path: str, keys: np.ndarray,
                      vals: Dict[str, np.ndarray], kind: str) -> None:
         os.makedirs(path, exist_ok=True)
-        np.savez_compressed(
-            os.path.join(path, f"{self.config.name}.{kind}.npz"),
-            keys=keys, **vals)
+        final = os.path.join(path, f"{self.config.name}.{kind}.npz")
+        # Atomic write: a crash (or a concurrent writer) mid-savez must
+        # not leave a truncated npz where recovery expects a model.
+        tmp = os.path.join(path, f".{self.config.name}.{kind}.tmp")
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, keys=keys, **vals)
+        os.replace(tmp, final)
         meta = {"kind": kind, "num_features": int(keys.shape[0]),
                 "dim": self.config.dim, "table": self.config.name}
         with open(os.path.join(path, f"{self.config.name}.{kind}.meta.json"),

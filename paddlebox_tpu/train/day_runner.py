@@ -1,0 +1,177 @@
+"""DayRunner: the production day/pass training loop.
+
+Role of the reference's outer CTR main loop (SURVEY.md §3.1 + FleetUtil,
+``fleet_util.py:368-1196``): per day — for each online pass, load that
+pass's data splits, shuffle, ``begin_pass → train → end_pass``, publish a
+pass-level delta; at day end — shrink the table, dump the day-level base,
+and publish both through the atomic done-file index. On restart, resume
+from the done-file recovery chain (last base + following deltas), which
+is exactly what the elastic manager's membership-change callback needs.
+
+TPU-first: the runner is a thin host orchestration shell — all heavy
+work is already in Dataset (threaded columnar load), PassEngine (table
+build), and CTRTrainer's single jitted step. File layout convention:
+``<data_root>/<day>/<split>/part-*`` with pass groups from
+``get_online_pass_interval``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+from paddlebox_tpu.checkpoint.protocol import (CheckpointProtocol,
+                                               get_online_pass_interval)
+from paddlebox_tpu.core import log, timers
+from paddlebox_tpu.data.dataset import Dataset
+
+
+class DayRunner:
+    """Drives a CTRTrainer through days of pass-addressed data."""
+
+    def __init__(self, trainer, feed_config, output_root: str, *,
+                 data_root: str = "",
+                 split_interval: int = 60, split_per_pass: int = 1,
+                 hours: Sequence[int] = tuple(range(24)),
+                 is_data_hourly_placed: bool = True,
+                 shuffle: bool = True,
+                 num_reader_threads: int = 4,
+                 filelist_fn: Optional[Callable[[str, List[str]],
+                                                List[str]]] = None,
+                 min_show_shrink: float = 0.0,
+                 is_rank0: bool = True):
+        self.trainer = trainer
+        self.feed_config = feed_config
+        self.data_root = data_root
+        self.ckpt = CheckpointProtocol(output_root, is_rank0=is_rank0)
+        self.pass_splits = get_online_pass_interval(
+            list(hours), split_interval, split_per_pass,
+            is_data_hourly_placed)
+        self.shuffle = shuffle
+        self.num_reader_threads = num_reader_threads
+        self.filelist_fn = filelist_fn or self._default_filelist
+        self.min_show_shrink = min_show_shrink
+        self.is_rank0 = is_rank0
+        self.timers = timers.TimerGroup()
+
+    # -- data addressing ---------------------------------------------------
+
+    def _default_filelist(self, day: str, splits: List[str]) -> List[str]:
+        files: List[str] = []
+        for s in splits:
+            files.extend(sorted(glob.glob(
+                os.path.join(self.data_root, day, s, "part-*"))))
+        return files
+
+    # -- recovery ----------------------------------------------------------
+
+    def recover(self) -> Optional[Dict[str, object]]:
+        """Load last base + subsequent deltas from the done-file (role of
+        the elastic restart consumers). Returns the resume point
+        ``{"day": str, "pass_id": int}`` — the last day/pass whose state
+        is already in the store — or None when starting fresh."""
+        base, deltas = self.ckpt.recovery_chain()
+        if base is None:
+            log.vlog(0, "day_runner: no published model, fresh start")
+            return None
+        store = self.trainer.engine.store
+        store.load(base.path, "base")
+        for d in deltas:
+            store.load(d.path, "delta")
+        log.vlog(0, "day_runner: recovered base %s + %d deltas (day %s)",
+                 base.path, len(deltas), base.day)
+        if deltas:
+            last = deltas[-1]
+            return {"day": last.day, "pass_id": last.pass_id}
+        return {"day": base.day, "pass_id": 0}
+
+    # -- day loop ----------------------------------------------------------
+
+    def train_pass(self, day: str, pass_id: int,
+                   files: List[str]) -> Dict[str, float]:
+        """One online pass: load → shuffle → train → delta checkpoint."""
+        with self.timers.scope("load"):
+            ds = Dataset(self.feed_config,
+                         num_reader_threads=self.num_reader_threads)
+            ds.set_filelist(files)
+            ds.load_into_memory()
+            if self.shuffle:
+                # Deterministic digest — hash(str) is randomized per
+                # process, which would make recovery replays and per-rank
+                # batch orders irreproducible.
+                import zlib
+                ds.local_shuffle(
+                    seed=zlib.crc32(f"{day}:{pass_id}".encode()))
+        self.trainer.reset_metrics()
+        with self.timers.scope("train"):
+            stats = self.trainer.train_pass(ds)
+        if self.is_rank0:
+            # Only rank 0 writes model files — N ranks racing
+            # savez on one shared path would corrupt the npz.
+            with self.timers.scope("save_delta"):
+                self.trainer.engine.store.save_delta(
+                    self.ckpt.model_dir(day, pass_id))
+                self.ckpt.publish(day, pass_id)
+        ds.clear()
+        log.vlog(0, "day %s pass %d: %s | %s", day, pass_id, stats,
+                 self.timers.report())
+        return stats
+
+    def train_day(self, day: str,
+                  start_pass: int = 1) -> List[Dict[str, float]]:
+        """All passes of one day, then shrink + base dump (the day
+        boundary sequence the reference runs: shrink → SaveBase →
+        write_model_donefile)."""
+        all_stats = []
+        for pass_id, splits in enumerate(self.pass_splits, start=1):
+            if pass_id < start_pass:
+                continue
+            files = self.filelist_fn(day, splits)
+            if not files:
+                log.warning("day %s pass %d: no files for splits %s, "
+                            "skipping", day, pass_id, splits)
+                continue
+            all_stats.append(self.train_pass(day, pass_id, files))
+        if not all_stats:
+            # A day that trained nothing (data outage) must not decay the
+            # model or publish a base marking the day done — the data may
+            # arrive late and the day must remain trainable.
+            log.warning("day %s: no trainable passes; skipping day-end "
+                        "shrink/base", day)
+            return all_stats
+        store = self.trainer.engine.store
+        if self.is_rank0:
+            with self.timers.scope("day_end"):
+                evicted = store.shrink(min_show=self.min_show_shrink)
+                store.save_base(self.ckpt.model_dir(day, pass_id=-1))
+                self.ckpt.publish(day, pass_id=-1)
+        else:
+            evicted = store.shrink(min_show=self.min_show_shrink)
+        log.vlog(0, "day %s done: %d passes, %d evicted", day,
+                 len(all_stats), evicted)
+        return all_stats
+
+    def run_days(self, days: Sequence[str],
+                 resume: bool = True) -> Dict[str, List[Dict[str, float]]]:
+        """Multi-day loop with recovery. The resume point covers both the
+        base day AND any trailing deltas already loaded into the store —
+        the delta day's completed passes are skipped via ``start_pass``
+        (re-training them would double-apply their updates)."""
+        point = self.recover() if resume else None
+        out = {}
+        for day in days:
+            day = str(day)
+            if point is not None:
+                if day < point["day"] or (day == point["day"]
+                                          and point["pass_id"] == 0):
+                    log.vlog(0, "day %s already covered by recovery: skip",
+                             day)
+                    continue
+                if day == point["day"]:
+                    # resume mid-day after the last published delta pass
+                    out[day] = self.train_day(
+                        day, start_pass=point["pass_id"] + 1)
+                    continue
+            out[day] = self.train_day(day)
+        return out

@@ -1,0 +1,148 @@
+"""DayRunner tests: the production day/pass loop — per-pass deltas,
+day-end shrink+base, done-file publication, and crash recovery
+continuing training with preserved state."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import DataFeedConfig, SlotConf
+from paddlebox_tpu.embedding import TableConfig
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+from paddlebox_tpu.train.day_runner import DayRunner
+
+SLOTS = ("user", "item")
+
+
+def _write_day(root, day, hours, rows_per_split=96, seed0=0):
+    rng = np.random.default_rng(seed0 + int(day))
+    for h in hours:
+        d = os.path.join(root, day, f"{h:02d}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "part-00000"), "w") as f:
+            for _ in range(rows_per_split):
+                feats = {s: rng.integers(1, 120, rng.integers(1, 3))
+                         for s in SLOTS}
+                click = np.mean([(int(v) % 5 == 0)
+                                 for vs in feats.values() for v in vs])
+                label = int(rng.random() < 0.1 + 0.8 * click)
+                toks = " ".join(f"{s}:{v}" for s, vs in feats.items()
+                                for v in vs)
+                f.write(f"{label} {toks}\n")
+
+
+def _make_runner(data_root, out_root):
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.5) for s in SLOTS),
+        batch_size=32)
+    trainer = CTRTrainer(
+        DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
+        TableConfig(name="emb", dim=8, learning_rate=0.1), mesh=mesh,
+        config=TrainerConfig(dense_learning_rate=3e-3,
+                             auc_num_buckets=1 << 10))
+    trainer.init(seed=0)
+    return DayRunner(trainer, feed, out_root, data_root=data_root,
+                     split_interval=60, split_per_pass=1,
+                     hours=[0, 1, 2], num_reader_threads=2)
+
+
+def test_day_loop_publishes_deltas_and_base(tmp_path):
+    data = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _write_day(data, "20260728", [0, 1, 2])
+    runner = _make_runner(data, out)
+    stats = runner.train_day("20260728")
+    assert len(stats) == 3  # one pass per hour
+    recs = runner.ckpt.records()
+    # 3 deltas (pass 1..3) + 1 day base (pass 0)
+    assert [(r.day, r.pass_id) for r in recs] == \
+        [("20260728", 1), ("20260728", 2), ("20260728", 3),
+         ("20260728", 0)]
+    assert os.path.exists(os.path.join(out, "20260728", "0",
+                                       "emb.base.npz"))
+    assert os.path.exists(os.path.join(out, "20260728", "2",
+                                       "emb.delta.npz"))
+
+
+def test_missing_splits_skipped(tmp_path):
+    data = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _write_day(data, "20260728", [0, 2])  # hour 1 missing
+    runner = _make_runner(data, out)
+    stats = runner.train_day("20260728")
+    assert len(stats) == 2
+
+
+def test_empty_day_publishes_nothing(tmp_path):
+    """A day with no data must not shrink the model or publish a base
+    (late-arriving data keeps the day trainable)."""
+    data = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    os.makedirs(data, exist_ok=True)
+    runner = _make_runner(data, out)
+    stats = runner.train_day("20260728")
+    assert stats == []
+    assert runner.ckpt.records() == []
+
+
+def test_recovery_resumes_with_state(tmp_path):
+    """Crash after day 1: a fresh runner recovers base+deltas and its
+    store matches the original's feature count; finished days are
+    skipped by run_days."""
+    data = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _write_day(data, "20260728", [0, 1, 2])
+    _write_day(data, "20260729", [0, 1, 2])
+    r1 = _make_runner(data, out)
+    r1.train_day("20260728")
+    n_features = r1.trainer.engine.store.num_features
+    assert n_features > 0
+
+    # 'crash': new process = new runner; recover from donefile
+    r2 = _make_runner(data, out)
+    point = r2.recover()
+    assert point == {"day": "20260728", "pass_id": 0}
+    assert r2.trainer.engine.store.num_features == n_features
+    out2 = r2.run_days(["20260728", "20260729"])
+    assert list(out2) == ["20260729"]  # finished day skipped
+    # day 2 published its own base
+    base, deltas = r2.ckpt.recovery_chain()
+    assert base.day == "20260729"
+
+
+def test_recovery_applies_deltas_after_base(tmp_path):
+    """Deltas published after the base must be part of recovery: train
+    day1 (base), then one pass of day2 (delta only), crash, recover —
+    the delta's updates survive and its pass is NOT re-trained."""
+    data = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _write_day(data, "20260728", [0])
+    _write_day(data, "20260729", [0])
+    runner = _make_runner(data, out)
+    runner.train_day("20260728")
+    files = runner._default_filelist("20260729", ["00"])
+    runner.train_pass("20260729", 1, files)  # delta beyond the base
+    store1 = runner.trainer.engine.store
+    n = store1.num_features
+    show_total = float(store1.pull_for_pass(
+        np.sort(store1.dirty_keys()))["show"].sum()) \
+        if store1.dirty_keys().size else 0.0
+
+    r2 = _make_runner(data, out)
+    point = r2.recover()
+    assert point == {"day": "20260729", "pass_id": 1}
+    assert r2.trainer.engine.store.num_features == n
+    # run_days must resume AFTER the recovered delta pass: day2 only has
+    # hour 0 (= pass 1), so nothing re-trains and show counts stay equal
+    # (re-training pass 1 would double-apply show/click/optimizer state)
+    out2 = r2.run_days(["20260728", "20260729"])
+    assert out2 == {"20260729": []}
+    store2 = r2.trainer.engine.store
+    keys = np.sort(store1.dirty_keys())
+    if keys.size:
+        show2 = float(store2.pull_for_pass(keys)["show"].sum())
+        assert show2 == pytest.approx(show_total)
