@@ -134,7 +134,13 @@ class Interceptor:
                         continue
                     joined = [slot[s] for s in node.upstream]
                     del self._pending[msg.scope]
-                out = node.fn(joined) if node.fn else joined
+                # Source payloads were already produced by fn(scope) in
+                # the feeder — applying fn again here would double-invoke
+                # it (and exhaust generator-backed sources early).
+                if node.role == "source":
+                    out = joined
+                else:
+                    out = node.fn(joined) if node.fn else joined
                 if node.role == "sink":
                     self.carrier.collect(msg.scope, out)
                 else:

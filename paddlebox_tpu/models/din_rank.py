@@ -1,0 +1,112 @@
+"""DIN-Rank: rank-aware CTR model over pv-grouped batches.
+
+Role of the PaddleBox production rank-attention graphs (the consumers of
+``rank_attention_op`` + pv-mode batches, ``data_feed.h:1701``): inside a
+pv (one search/page view), each candidate attends over its PEER candidates
+— the items shown alongside it — with a parameter block selected by the
+(own position, peer position) pair. The model front-end is the same
+pooled-slot-embedding tower as DeepFM; the rank-attention term adds the
+in-pv context signal.
+
+``build_rank_offset`` derives the op's rank_offset input from the group
+ids that :meth:`Dataset.batches_grouped` yields — position within the pv
+is the rank, other members are the peers — so the pv data path and the op
+compose end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.nn import mlp_apply, mlp_init
+from paddlebox_tpu.ops import seqpool
+from paddlebox_tpu.ops.rank_attention import rank_attention
+
+
+def build_rank_offset(gids: np.ndarray, max_rank: int,
+                      valid: np.ndarray | None = None) -> np.ndarray:
+    """Group ids [B] → rank_offset [B, 1 + 2*max_rank] int32.
+
+    Rows of the same group must be contiguous (batches_grouped
+    guarantees it). Col 0 = 1-based position within the group, clipped
+    at max_rank (0 for invalid rows); then (peer_rank, peer_row) pairs
+    for up to max_rank OTHER members of the group (0,0 padding).
+    """
+    b = gids.shape[0]
+    out = np.zeros((b, 1 + 2 * max_rank), np.int32)
+    if valid is None:
+        valid = np.ones((b,), bool)
+    starts = np.concatenate(
+        [[0], np.flatnonzero(gids[1:] != gids[:-1]) + 1, [b]])
+    for g in range(starts.size - 1):
+        lo, hi = int(starts[g]), int(starts[g + 1])
+        members = [r for r in range(lo, hi) if valid[r]]
+        for pos, r in enumerate(members):
+            if pos >= max_rank:
+                break
+            out[r, 0] = pos + 1
+            k = 0
+            for ppos, peer in enumerate(members):
+                if peer == r or ppos >= max_rank:
+                    continue
+                if k >= max_rank:
+                    break
+                out[r, 1 + 2 * k] = ppos + 1
+                out[r, 2 + 2 * k] = peer
+                k += 1
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class DINRank:
+    """Pooled slot embeddings + rank attention over pv peers + MLP."""
+
+    slot_names: Tuple[str, ...]
+    emb_dim: int
+    max_rank: int = 4
+    att_dim: int = 16
+    hidden: Tuple[int, ...] = (64, 32)
+
+    @property
+    def feat_dim(self) -> int:
+        return len(self.slot_names) * self.emb_dim
+
+    def init(self, rng: jax.Array) -> Dict:
+        f = self.feat_dim
+        k = self.max_rank
+        r1, r2 = jax.random.split(rng)
+        return {
+            "rank_param": 0.1 * jax.random.normal(
+                r1, (k * k, f, self.att_dim), jnp.float32),
+            "mlp": mlp_init(r2, f + self.att_dim,
+                            list(self.hidden) + [1]),
+            "bias": jnp.zeros((), jnp.float32),
+        }
+
+    def apply(self, params: Dict,
+              emb: Dict[str, jax.Array],
+              w: Dict[str, jax.Array],
+              segments: Dict[str, jax.Array],
+              batch_size: int,
+              rank_offset: jax.Array | None = None) -> jax.Array:
+        """Returns logits [B]. Without rank_offset the attention term is
+        zero (single-candidate pvs degrade gracefully)."""
+        pooled: List[jax.Array] = []
+        wide = params["bias"]
+        for name in self.slot_names:
+            pooled.append(seqpool(emb[name], segments[name], batch_size))
+            wide = wide + seqpool(w[name], segments[name], batch_size)
+        x = jnp.concatenate(pooled, axis=-1)              # [B, F]
+        if rank_offset is not None:
+            att, _ = rank_attention(x, rank_offset, params["rank_param"],
+                                    max_rank=self.max_rank)
+        else:
+            att = jnp.zeros((x.shape[0], self.att_dim), x.dtype)
+        deep = mlp_apply(params["mlp"],
+                         jnp.concatenate([x, att], axis=-1))[:, 0]
+        return wide + deep

@@ -124,19 +124,24 @@ class DayRunner:
         boundary sequence the reference runs: shrink → SaveBase →
         write_model_donefile)."""
         all_stats = []
+        resumed_past = 0  # passes skipped because recovery already holds them
         for pass_id, splits in enumerate(self.pass_splits, start=1):
-            if pass_id < start_pass:
-                continue
             files = self.filelist_fn(day, splits)
+            if pass_id < start_pass:
+                resumed_past += bool(files)
+                continue
             if not files:
                 log.warning("day %s pass %d: no files for splits %s, "
                             "skipping", day, pass_id, splits)
                 continue
             all_stats.append(self.train_pass(day, pass_id, files))
-        if not all_stats:
+        if not all_stats and not resumed_past:
             # A day that trained nothing (data outage) must not decay the
             # model or publish a base marking the day done — the data may
-            # arrive late and the day must remain trainable.
+            # arrive late and the day must remain trainable. Resuming
+            # after the day's LAST delta is different: those passes are
+            # already in the store, so day-end below must still run or
+            # the day would never get its shrink + base.
             log.warning("day %s: no trainable passes; skipping day-end "
                         "shrink/base", day)
             return all_stats

@@ -48,6 +48,9 @@ class TrainerDesc:
     # collective-heavy programs can starve the runtime's rendezvous
     # (observed as AwaitAndLogIfStuck aborts on the CPU backend).
     dispatch_depth: int = 16
+    # Wall-clock bound for one HeterTrainer pipeline chunk (seconds); a
+    # production pass must not die at an arbitrary default.
+    pass_timeout: float = 3600.0
 
 
 class TrainerBase:
@@ -182,6 +185,87 @@ class MultiTrainer(TrainerBase):
                 log.vlog(0, "step %d loss %.5f", n, float(loss))
             if desc.max_steps and n >= desc.max_steps:
                 break
+        return {"steps": n,
+                "loss_first": float(first_loss) if n else float("nan"),
+                "loss_last": float(last_loss) if n else float("nan")}
+
+
+@register_trainer("HeterTrainer")
+class HeterTrainer(MultiTrainer):
+    """Host↔device split trainer (role of the heter trainers,
+    ``heterxpu_trainer.cc`` / ``heter_pipeline_trainer.cc`` +
+    ``heter_section_worker.cc``): CPU stages and the accelerator stage run
+    as pipelined actors so host preprocessing of batch N+1 overlaps the
+    device step on batch N.
+
+    TPU-first: the stages are FleetExecutor interceptors
+    (:mod:`paddlebox_tpu.distributed.fleet_executor`) — ``host_fn(batch)``
+    runs on its own TaskLoop thread (parse/feature-engineering/CPU
+    lookups), the device stage is MultiTrainer's jitted step (inherited —
+    one step builder, no divergence). The stream is consumed in bounded
+    chunks so memory stays O(chunk) and a short dataset under a larger
+    max_steps just ends the run (the reference's cross-device RPC,
+    heter_service.proto, collapses into the in-process message bus).
+    """
+
+    def __init__(self, loss_fn: Callable[[Any, Any], jax.Array],
+                 params: Any, tx: optax.GradientTransformation,
+                 host_fn: Optional[Callable[[Any], Any]] = None,
+                 buffer_size: int = 4, chunk_size: int = 64):
+        super().__init__(loss_fn, params, tx)
+        self.host_fn = host_fn or (lambda b: b)
+        self.buffer_size = buffer_size
+        self.chunk_size = chunk_size
+
+    def run(self, data: Iterable) -> Dict[str, float]:
+        import itertools
+
+        from paddlebox_tpu.distributed.fleet_executor import (
+            Carrier, linear_pipeline)
+        desc = self.desc or TrainerDesc()
+        it = iter(data)
+        depth = max(desc.dispatch_depth, 1)
+        step_count = [0]
+
+        def device_stage(batch):
+            # Single interceptor thread owns params/opt_state: no lock
+            # needed (the reference's SectionWorker has the same
+            # one-thread-per-stage ownership).
+            if self._data_sharding is not None:
+                batch = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, self._data_sharding),
+                    batch)
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, batch)
+            step_count[0] += 1
+            if step_count[0] % depth == 0:
+                # bounded async dispatch (see TrainerDesc.dispatch_depth)
+                jax.block_until_ready(loss)
+            return loss
+
+        nodes = linear_pipeline([self.host_fn, device_stage],
+                                buffer_size=self.buffer_size)
+        carrier = Carrier(nodes)
+        first_loss = last_loss = None
+        n = 0
+        while True:
+            want = self.chunk_size
+            if desc.max_steps:
+                want = min(want, desc.max_steps - n)
+            if want <= 0:
+                break
+            chunk = list(itertools.islice(it, want))
+            if not chunk:
+                break
+            losses = carrier.run(len(chunk), feeds=chunk,
+                                 timeout=desc.pass_timeout)
+            if first_loss is None and losses:
+                first_loss = losses[0]
+            if losses:
+                last_loss = losses[-1]
+            n += len(chunk)
+            if desc.check_nan_inf:
+                sanitizer.check_batch(self.params, step=n, force=True)
         return {"steps": n,
                 "loss_first": float(first_loss) if n else float("nan"),
                 "loss_last": float(last_loss) if n else float("nan")}

@@ -137,12 +137,16 @@ def test_recovery_applies_deltas_after_base(tmp_path):
     assert point == {"day": "20260729", "pass_id": 1}
     assert r2.trainer.engine.store.num_features == n
     # run_days must resume AFTER the recovered delta pass: day2 only has
-    # hour 0 (= pass 1), so nothing re-trains and show counts stay equal
-    # (re-training pass 1 would double-apply show/click/optimizer state)
+    # hour 0 (= pass 1), so nothing re-trains; but day-end STILL runs
+    # (shrink + base) because the day's passes are complete in the store.
     out2 = r2.run_days(["20260728", "20260729"])
     assert out2 == {"20260729": []}
+    base, _ = r2.ckpt.recovery_chain()
+    assert base.day == "20260729"  # day 2 got its base after resume
     store2 = r2.trainer.engine.store
     keys = np.sort(store1.dirty_keys())
     if keys.size:
+        # show counts = originals * one day-end decay — NOT doubled
+        # (re-training pass 1 would double-apply show/click/state)
         show2 = float(store2.pull_for_pass(keys)["show"].sum())
-        assert show2 == pytest.approx(show_total)
+        assert show2 == pytest.approx(show_total * 0.98)

@@ -67,6 +67,55 @@ def test_multi_trainer_max_steps_and_nan_check(devices8):
 
 
 # ---------------------------------------------------------------------------
+# HeterTrainer
+# ---------------------------------------------------------------------------
+
+def test_heter_trainer_learns_with_host_stage(devices8):
+    """Host normalization stage + device step pipelined through the
+    interceptor runtime; parity with the plain trainer's convergence."""
+    from paddlebox_tpu.train.trainer import HeterTrainer
+    mesh = build_mesh(HybridTopology(dp=8))
+    host_calls = []
+
+    def host_fn(batch):
+        # Fixed host-side transform (a per-batch normalization would make
+        # the regression target batch-dependent and unlearnable).
+        host_calls.append(1)
+        return {"x": batch["x"] * 2.0, "y": batch["y"]}
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch["x"] @ params["w"] + params["b"]
+                         - batch["y"]) ** 2)
+
+    t = HeterTrainer(loss_fn, {"w": jnp.zeros(4), "b": jnp.zeros(())},
+                     optax.adam(0.05), host_fn=host_fn)
+    out = t.fit(list(_linreg_batches(150)), TrainerDesc(log_every=0), mesh)
+    assert out["steps"] == 150
+    assert len(host_calls) == 150
+    assert out["loss_last"] < 0.05 < out["loss_first"]
+
+
+def test_heter_trainer_short_dataset_under_max_steps(devices8):
+    """max_steps beyond the dataset must end cleanly at the data's end,
+    not hang waiting for batches that never come."""
+    from paddlebox_tpu.train.trainer import HeterTrainer
+    mesh = build_mesh(HybridTopology(dp=8))
+    t = HeterTrainer(
+        lambda p, b: jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2),
+        {"w": jnp.zeros(4)}, optax.sgd(0.1), chunk_size=8)
+    out = t.fit(_linreg_batches(10), TrainerDesc(max_steps=50, log_every=0),
+                mesh)
+    assert out["steps"] == 10
+
+
+def test_heter_trainer_factory():
+    from paddlebox_tpu.train.trainer import HeterTrainer
+    t = create_trainer("HeterTrainer", lambda p, b: jnp.sum(p["w"] ** 2),
+                       {"w": jnp.ones(2)}, optax.sgd(0.1))
+    assert isinstance(t, HeterTrainer)
+
+
+# ---------------------------------------------------------------------------
 # PipelineTrainer
 # ---------------------------------------------------------------------------
 
