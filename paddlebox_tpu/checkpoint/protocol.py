@@ -79,9 +79,14 @@ class CheckpointProtocol:
     """
 
     def __init__(self, root: str, *, donefile_name: str = "donefile.txt",
+                 xbox_donefile_name: str = "xbox_donefile.txt",
                  is_rank0: bool = True):
         self.root = root.rstrip("/")
         self.donefile = os.path.join(self.root, donefile_name)
+        # Separate index for serving-format (xbox) exports — consumers
+        # are the online serving fleet, not training recovery (role of
+        # write_xbox_donefile, fleet_util.py:520).
+        self.xbox_donefile = os.path.join(self.root, xbox_donefile_name)
         self.is_rank0 = is_rank0
         os.makedirs(self.root, exist_ok=True)
 
@@ -95,34 +100,54 @@ class CheckpointProtocol:
 
     # -- donefile ----------------------------------------------------------
 
-    def records(self) -> List[DoneRecord]:
-        if not os.path.exists(self.donefile):
+    def _read_records(self, donefile: str) -> List[DoneRecord]:
+        if not os.path.exists(donefile):
             return []
-        with open(self.donefile) as f:
+        with open(donefile) as f:
             return [DoneRecord.parse(l) for l in f if l.strip()]
+
+    def records(self) -> List[DoneRecord]:
+        return self._read_records(self.donefile)
+
+    def xbox_records(self) -> List[DoneRecord]:
+        return self._read_records(self.xbox_donefile)
+
+    def _publish_to(self, donefile: str, day: str, pass_id: int,
+                    key: Optional[int], model_path: str) -> bool:
+        if not self.is_rank0:
+            return False
+        day = str(day)
+        pid = 0 if pass_id < 0 else pass_id
+        recs = self._read_records(donefile)
+        if any(r.day == day and r.pass_id == pid for r in recs):
+            log.warning("donefile %s: %s/%s already published",
+                        os.path.basename(donefile), day, pid)
+            return False
+        rec = DoneRecord(day=day, key=key or int(time.time()),
+                         path=model_path, pass_id=pid)
+        tmp = donefile + ".tmp"
+        with open(tmp, "w") as f:
+            for r in recs:
+                f.write(r.line() + "\n")
+            f.write(rec.line() + "\n")
+        os.replace(tmp, donefile)  # atomic publication
+        log.vlog(0, "%s: published %s/%s -> %s",
+                 os.path.basename(donefile), day, pid, rec.path)
+        return True
 
     def publish(self, day: str, pass_id: int = -1,
                 key: Optional[int] = None) -> bool:
         """Atomically append a done record (rank 0 only; duplicate
         day/pass entries are skipped like write_model_donefile)."""
-        if not self.is_rank0:
-            return False
-        day = str(day)
-        pid = 0 if pass_id < 0 else pass_id
-        recs = self.records()
-        if any(r.day == day and r.pass_id == pid for r in recs):
-            log.warning("donefile: %s/%s already published", day, pid)
-            return False
-        rec = DoneRecord(day=day, key=key or int(time.time()),
-                         path=self.model_dir(day, pass_id), pass_id=pid)
-        tmp = self.donefile + ".tmp"
-        with open(tmp, "w") as f:
-            for r in recs:
-                f.write(r.line() + "\n")
-            f.write(rec.line() + "\n")
-        os.replace(tmp, self.donefile)  # atomic publication
-        log.vlog(0, "donefile: published %s/%s -> %s", day, pid, rec.path)
-        return True
+        return self._publish_to(self.donefile, str(day), pass_id, key,
+                                self.model_dir(str(day), pass_id))
+
+    def publish_xbox(self, day: str, pass_id: int = -1,
+                     key: Optional[int] = None) -> bool:
+        """Publish a serving-format export to the xbox done-file (role of
+        write_xbox_donefile)."""
+        return self._publish_to(self.xbox_donefile, str(day), pass_id, key,
+                                self.model_dir(str(day), pass_id))
 
     def last_published(self) -> Optional[DoneRecord]:
         """Recovery entry point: newest published model (role of the

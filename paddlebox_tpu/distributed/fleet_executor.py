@@ -255,8 +255,11 @@ class Carrier:
             def put(msg: _Msg) -> bool:
                 # Abort-aware bounded put: after an interceptor error the
                 # queues stop draining, and a plain blocking put would
-                # wedge this feeder (and run()'s join) forever.
-                while not self._done.is_set():
+                # wedge this feeder (and run()'s join) forever. Bail only
+                # on ABORT — _done also fires on the expected-count fast
+                # path while STOP still must be delivered so the stage
+                # threads can exit (run() joins them).
+                while not self._aborted.is_set():
                     try:
                         it.inbox.put(msg, timeout=0.05)
                         return True
@@ -282,6 +285,12 @@ class Carrier:
         [t.join() for t in feeders]
         if self._error is not None:
             raise RuntimeError("interceptor failed") from self._error
+        # Drain the STOP cascade before returning: done fires on the
+        # expected result count, but STOP may still be propagating — a
+        # back-to-back run() would reset() to fresh interceptors and the
+        # straggler STOP would terminate a NEW stage before it works.
+        for it in self.interceptors.values():
+            it.join()
         return [self._results[k] for k in sorted(self._results)]
 
     def _count_sink_scopes(self, num_micro_batches: int) -> int:

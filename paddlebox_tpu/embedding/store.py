@@ -235,6 +235,20 @@ class FeatureStore:
         self._save_arrays(path, dirty, vals, "delta")
         log.vlog(0, "save_delta: %d features -> %s", dirty.shape[0], path)
 
+    def save_xbox(self, path: str) -> int:
+        """Serving-format export (role of the 'xbox' model dumps,
+        ``save_xbox_base_model`` fleet_util.py:774): inference needs only
+        {key → emb, w} — optimizer state, show/click stay behind — so the
+        artifact is a fraction of the training checkpoint and can ship to
+        online serving every pass. Returns rows written."""
+        with self._lock:
+            keys = self._keys.copy()
+            vals = {"emb": self._vals["emb"].copy(),
+                    "w": self._vals["w"].copy()}
+        self._save_arrays(path, keys, vals, "xbox")
+        log.vlog(0, "save_xbox: %d features -> %s", keys.shape[0], path)
+        return int(keys.shape[0])
+
     def _check_state_widths(self, vals: Dict[str, np.ndarray]) -> None:
         """Optimizer-state widths must match the configured optimizer — a
         silent numpy broadcast here would smear e.g. an adagrad g2sum into

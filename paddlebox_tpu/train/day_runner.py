@@ -40,6 +40,7 @@ class DayRunner:
                  filelist_fn: Optional[Callable[[str, List[str]],
                                                 List[str]]] = None,
                  min_show_shrink: float = 0.0,
+                 save_xbox: bool = False,
                  is_rank0: bool = True):
         self.trainer = trainer
         self.feed_config = feed_config
@@ -52,6 +53,7 @@ class DayRunner:
         self.num_reader_threads = num_reader_threads
         self.filelist_fn = filelist_fn or self._default_filelist
         self.min_show_shrink = min_show_shrink
+        self.save_xbox = save_xbox  # serving export per pass (xbox role)
         self.is_rank0 = is_rank0
         self.timers = timers.TimerGroup()
 
@@ -113,6 +115,12 @@ class DayRunner:
                 self.trainer.engine.store.save_delta(
                     self.ckpt.model_dir(day, pass_id))
                 self.ckpt.publish(day, pass_id)
+            if self.save_xbox and hasattr(self.trainer.engine.store,
+                                          "save_xbox"):
+                with self.timers.scope("save_xbox"):
+                    self.trainer.engine.store.save_xbox(
+                        self.ckpt.model_dir(day, pass_id))
+                    self.ckpt.publish_xbox(day, pass_id)
         ds.clear()
         log.vlog(0, "day %s pass %d: %s | %s", day, pass_id, stats,
                  self.timers.report())

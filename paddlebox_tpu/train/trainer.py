@@ -224,7 +224,7 @@ class HeterTrainer(MultiTrainer):
             Carrier, linear_pipeline)
         desc = self.desc or TrainerDesc()
         it = iter(data)
-        depth = max(desc.dispatch_depth, 1)
+        depth = desc.dispatch_depth  # 0 = never block (MultiTrainer parity)
         step_count = [0]
 
         def device_stage(batch):
@@ -238,7 +238,7 @@ class HeterTrainer(MultiTrainer):
             self.params, self.opt_state, loss = self._step(
                 self.params, self.opt_state, batch)
             step_count[0] += 1
-            if step_count[0] % depth == 0:
+            if depth and step_count[0] % depth == 0:
                 # bounded async dispatch (see TrainerDesc.dispatch_depth)
                 jax.block_until_ready(loss)
             return loss

@@ -77,6 +77,26 @@ def test_missing_splits_skipped(tmp_path):
     assert len(stats) == 2
 
 
+def test_xbox_serving_export(tmp_path):
+    """save_xbox writes the serving payload (emb+w only) per pass and
+    publishes to the separate xbox done-file."""
+    data = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _write_day(data, "20260728", [0])
+    runner = _make_runner(data, out)
+    runner.save_xbox = True
+    runner.train_day("20260728")
+    xrecs = runner.ckpt.xbox_records()
+    assert [(r.day, r.pass_id) for r in xrecs] == [("20260728", 1)]
+    x = np.load(os.path.join(out, "20260728", "1", "emb.xbox.npz"))
+    assert set(x.files) == {"keys", "emb", "w"}  # no optimizer state
+    assert x["emb"].shape[1] == 8
+    # training donefile unaffected by xbox publications
+    recs = runner.ckpt.records()
+    assert [(r.day, r.pass_id) for r in recs] == \
+        [("20260728", 1), ("20260728", 0)]
+
+
 def test_empty_day_publishes_nothing(tmp_path):
     """A day with no data must not shrink the model or publish a base
     (late-arriving data keeps the day trainable)."""

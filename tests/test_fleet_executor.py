@@ -109,6 +109,18 @@ def test_error_does_not_hang_with_deep_feed():
     assert time.time() - t0 < 5.0
 
 
+def test_many_back_to_back_runs_no_stop_straggler():
+    """Rapid consecutive runs: a straggler STOP from run N must never
+    leak into run N+1's fresh interceptors (run drains the STOP cascade
+    before returning)."""
+    nodes = linear_pipeline([lambda x: x + 1, lambda x: x * 2],
+                            buffer_size=2)
+    c = Carrier(nodes)
+    for r in range(20):
+        out = c.run(5, feeds=[r * 10 + i for i in range(5)])
+        assert out == [(r * 10 + i + 1) * 2 for i in range(5)]
+
+
 def test_carrier_reusable_across_runs():
     nodes = linear_pipeline([lambda x: x + 1])
     c = Carrier(nodes)
