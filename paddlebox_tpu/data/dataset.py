@@ -445,9 +445,14 @@ class Dataset:
         rank_of_gid = np.argsort(np.argsort(first_seen))
         order = np.argsort(rank_of_gid[gid], kind="stable")
         merged = merged.take(order)
+        # Boundaries come from the reordered group ids — NOT the key array
+        # with keyless rows zeroed, which would merge adjacent keyless
+        # singletons (and any real group whose key happens to be 0) into
+        # one pseudo-group.
+        gid_ord = gid[order]
         keys = np.where(has, keys, 0)[order]
         starts = np.concatenate(
-            [[0], np.flatnonzero(keys[1:] != keys[:-1]) + 1, [n]])
+            [[0], np.flatnonzero(gid_ord[1:] != gid_ord[:-1]) + 1, [n]])
         lo = 0
         g = 0  # index into starts of the first group of this batch
         while g < starts.size - 1:

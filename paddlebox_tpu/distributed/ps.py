@@ -95,6 +95,7 @@ class PSServer:
         # from two concurrent client connections racing on the same key
         # would lose one side's gradient without this.
         self._table_locks = {name: threading.Lock() for name in tables}
+        self.dense_lr = float(dense_lr)
         self.dense: Dict[str, DenseTable] = {
             name: DenseTable(v, dense_lr) for name, v in (dense or {}).items()}
         host, port = endpoint.rsplit(":", 1)
@@ -151,9 +152,15 @@ class PSServer:
         self._check_owned(keys)
         uniq, inv = np.unique(keys, return_inverse=True)
         with self._table_locks[req["table"]]:
+            present = store.contains(uniq)
             rows = store.pull_for_pass(uniq)
-            # Persist initializations so repeated pulls are stable.
-            store.push_from_pass(uniq, rows)
+            # Persist ONLY genuinely-new keys so repeated pulls are
+            # stable; re-pushing present keys would mark them dirty and
+            # land every read-only pull in the next save_delta.
+            if not present.all():
+                new = ~present
+                store.push_from_pass(
+                    uniq[new], {f: v[new] for f, v in rows.items()})
         monitor.add("ps/pull_keys", int(keys.size))
         return {"emb": rows["emb"][inv], "w": rows["w"][inv]}
 
@@ -222,9 +229,13 @@ class PSServer:
 
     def handle_set_dense(self, req) -> bool:
         if req["name"] in self.dense:
-            self.dense[req["name"]].set(req["value"])
+            table = self.dense[req["name"]]
+            table.set(req["value"])
+            if "lr" in req:  # omitting lr preserves the configured rate
+                table.lr = float(req["lr"])
         else:
-            self.dense[req["name"]] = DenseTable(req["value"])
+            self.dense[req["name"]] = DenseTable(
+                req["value"], float(req.get("lr", self.dense_lr)))
         return True
 
     # -- lifecycle ---------------------------------------------------------
@@ -249,8 +260,14 @@ class PSServer:
         return d
 
     def handle_shrink(self, req) -> int:
-        return sum(store.shrink(min_show=req.get("min_show", 0.0))
-                   for store in self.tables.values())
+        # Under the same per-table locks as pull/push: shrink evicting a
+        # key between a pull's contains() check and its pull_for_pass()
+        # would hand out an ephemeral (never-persisted) init row.
+        total = 0
+        for name, store in self.tables.items():
+            with self._table_locks[name]:
+                total += store.shrink(min_show=req.get("min_show", 0.0))
+        return total
 
     def handle_stats(self, req) -> Dict[str, int]:
         return {name: store.num_features
@@ -471,8 +488,11 @@ class PSClient:
         self._call(server, "push_dense", name=name, grad=grad)
 
     def set_dense(self, name: str, value: np.ndarray,
-                  server: int = 0) -> None:
-        self._call(server, "set_dense", name=name, value=value)
+                  server: int = 0, lr: Optional[float] = None) -> None:
+        req = dict(name=name, value=value)
+        if lr is not None:
+            req["lr"] = float(lr)
+        self._call(server, "set_dense", **req)
 
     def save(self, path: str, mode: str = "base") -> None:
         self._fanout("save", path=path, mode=mode)
@@ -508,6 +528,10 @@ class PSBackedStore:
     and EndPass write them back (exactly the reference's BuildPull-from-
     CPU-PS flow, ps_gpu_wrapper.cc:362, and EndPass write-back :983 —
     but with the hot training tier in TPU HBM)."""
+
+    #: One backing cluster shared by all ranks: day-end shrink must run
+    #: exactly once (rank 0), unlike per-rank replica stores.
+    shared = True
 
     def __init__(self, client: PSClient, table: str):
         self.client = client

@@ -36,6 +36,13 @@ class DiskShards:
         self.root = root
         self.num_buckets = num_buckets
         os.makedirs(root, exist_ok=True)
+        # Reclaim temps orphaned by a crash mid-save (they are dot-
+        # prefixed so loads never see them, but they'd leak otherwise).
+        for stale in glob.glob(os.path.join(root, ".*.tmp")):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
 
     def _path(self, b: int) -> str:
         return os.path.join(self.root, f"bucket-{b:04d}.npz")
@@ -62,8 +69,13 @@ class DiskShards:
             if os.path.exists(path):
                 os.unlink(path)
             return
-        tmp = path + ".tmp.npz"
-        np.savez(tmp, keys=keys, **vals)
+        # Dot-prefixed temp name so a crash mid-savez can never leave a
+        # truncated file matching the 'bucket-*.npz' glob that
+        # _load_bucket / restore_from scan.
+        tmp = os.path.join(os.path.dirname(path),
+                           "." + os.path.basename(path) + ".tmp")
+        with open(tmp, "wb") as f:  # file object: savez can't append .npz
+            np.savez(f, keys=keys, **vals)
         os.replace(tmp, path)
 
     def write(self, keys: np.ndarray, vals: Dict[str, np.ndarray]) -> None:

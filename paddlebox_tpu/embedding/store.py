@@ -32,6 +32,11 @@ _FIELDS = ("emb", "emb_state", "w", "w_state", "show", "click")
 class FeatureStore:
     """Sorted-key columnar feature store with base+delta checkpointing."""
 
+    #: Per-process replica (each rank owns its own copy). Shared remote
+    #: tiers (PSBackedStore) override this so day-end maintenance such as
+    #: shrink runs once, not world_size times.
+    shared = False
+
     def __init__(self, config: TableConfig, seed: int = 0):
         from paddlebox_tpu.embedding.optimizers import make_sparse_optimizer
         self.config = config

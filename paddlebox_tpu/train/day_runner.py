@@ -159,6 +159,12 @@ class DayRunner:
                 evicted = store.shrink(min_show=self.min_show_shrink)
                 store.save_base(self.ckpt.model_dir(day, pass_id=-1))
                 self.ckpt.publish(day, pass_id=-1)
+        elif getattr(store, "shared", False):
+            # Shared backing tier (e.g. PSBackedStore): rank 0 already
+            # shrank the one store — running it again would apply
+            # show/click decay and eviction world_size times per day
+            # (the reference's day-end ShrinkTable runs once).
+            evicted = 0
         else:
             evicted = store.shrink(min_show=self.min_show_shrink)
         log.vlog(0, "day %s done: %d passes, %d evicted", day,

@@ -92,12 +92,17 @@ class DownpourTrainer:
     def __init__(self, client: PSClient, table: str,
                  loss_fn: Callable[..., jax.Array],
                  dense_init: Dict[str, np.ndarray], *,
-                 pull_interval: float = 0.05):
+                 pull_interval: float = 0.05,
+                 dense_lr: float = 0.05):
         self.client = client
         self.table = table
         self.loss_fn = loss_fn
+        # dense_lr: server-side SGD rate for the raw grads this trainer
+        # pushes. The async pull means grads are computed at stale params
+        # (several steps' worth at full speed) — a large rate here turns
+        # that staleness into oscillation/divergence.
         for name, v in dense_init.items():
-            self.client.set_dense(name, v)
+            self.client.set_dense(name, v, lr=dense_lr)
         self.pull_worker = PullDenseWorker(client, dense_init.keys(),
                                            pull_interval)
         self.pull_worker.start()
@@ -146,16 +151,28 @@ class DownpourTrainer:
         return float(loss)
 
     def fit(self, batches: Iterable[Dict[str, Any]], *,
-            log_every: int = 0) -> Dict[str, float]:
-        first = last = float("nan")
+            log_every: int = 0, window: int = 10) -> Dict[str, float]:
+        """Run all batches; report mean loss over the first/last ``window``
+        steps (single-batch losses are too noisy to compare under async
+        dense-pull timing). O(window) memory: production day loops run
+        millions of steps. When total steps <= 2*window the two windows
+        overlap and first/last converge toward each other — convergence
+        checks need runs longer than 2*window."""
+        import collections
+        window = max(1, window)
+        head: list = []
+        tail: collections.deque = collections.deque(maxlen=window)
         n = 0
         for batch in batches:
-            last = self.train_step(batch)
-            if n == 0:
-                first = last
+            loss = self.train_step(batch)
+            if len(head) < window:
+                head.append(loss)
+            tail.append(loss)
             n += 1
             if log_every and n % log_every == 0:
-                log.vlog(0, "downpour step %d loss %.5f", n, last)
+                log.vlog(0, "downpour step %d loss %.5f", n, loss)
+        first = float(np.mean(head)) if head else float("nan")
+        last = float(np.mean(tail)) if tail else float("nan")
         return {"steps": n, "loss_first": first, "loss_last": last}
 
     def stop(self) -> None:
