@@ -1,43 +1,117 @@
-"""Benchmark harness: DeepFM CTR training throughput on real TPU.
+"""Benchmark harness. Default config: DeepFM CTR end-to-end (driver metric).
 
-Runs the flagship sparse-CTR config (BASELINE.md config 4: DeepFM,
-BoxPS-style pull/push through the pass-based embedding engine) on whatever
-accelerator jax exposes, and prints ONE json line:
+Measures the FULL training path the way production runs it — native text
+parse -> columnar load -> per-batch host key map -> fused device step
+(pull / fwd-bwd / dense+sparse update / AUC) — streaming DISTINCT batches
+drawn from a >=50M-feature store, and prints ONE json line:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-vs_baseline is measured samples/sec/chip divided by the BASELINE.md target
-proxy (the reference publishes no numbers; target proxy = 90% of an 8xA100
-DeepFM-Criteo run ~= 1.3M samples/s/8 chips ~= 162k samples/s/chip,
-BASELINE.md "≥90% of 8×A100 on v5e-8").
+vs_baseline is measured against this repo's own previously-recorded number
+for the same metric on the same hardware (BASELINE.md "measured" table —
+the reference publishes no numbers, so the baseline is our prior round;
+>1.0 means this round is faster). Extra keys break the e2e number down
+(load / host-map / device) and report the device-only upper bound.
+
+Other configs (BASELINE.md configs 1-3): `python bench.py resnet50`,
+`python bench.py bert_dp`, `python bench.py gpt`.
 """
 
 import json
+import os
 import sys
+import tempfile
 import time
 
 import numpy as np
 
-TARGET_SAMPLES_PER_SEC_PER_CHIP = 162_000.0
 
-# Realistic CTR shapes: 26 sparse slots (Criteo-like), dim-16 embeddings,
-# 13 dense features. Batch 16384 per chip: CTR models are small, so
-# smaller batches leave the step dispatch-bound (measured ~2x throughput
-# going 4096 -> 16384 on v5e) — production CTR batches sit in this range.
+def _sync(x) -> float:
+    """Force completion by fetching the value — on the axon remote-TPU
+    platform jax.block_until_ready returns before the dispatched chain
+    finishes, so timing loops MUST fetch a concrete value."""
+    return float(np.asarray(x).ravel()[0])
+
+
+# Previously recorded numbers for vs_baseline ratios (BASELINE.md table;
+# update when a new round records a better number on the same hardware).
+SELF_BASELINE = {
+    # round-2 first honest E2E measurement (v5e single chip) seeds this;
+    # None -> report vs_baseline = 1.0 (first recording).
+    "deepfm_e2e": None,
+    "resnet50": None,
+    "bert_dp": None,
+    "gpt": None,
+}
+
+
+def _vs(metric: str, value: float) -> float:
+    base = SELF_BASELINE.get(metric)
+    return round(value / base, 4) if base else 1.0
+
+
+# ---------------------------------------------------------------------------
+# DeepFM CTR end-to-end (BASELINE.md config 4; the driver's default metric)
+# ---------------------------------------------------------------------------
+
 NUM_SLOTS = 26
 EMB_DIM = 16
 DENSE_DIM = 13
 BATCH = 16384
-NUM_FEATURES = 2_000_000
-AVG_IDS_PER_SLOT = 1.0
-STEPS_WARMUP = 3
-STEPS_TIMED = 20
+STORE_KEYS = 50_000_000       # resident feature store size (host RAM)
+PASS_KEYS = 4_000_000         # working set one pass touches
+# Distinct timed batches: a real online pass trains minutes of traffic
+# against one table build + write-back, so the per-pass fixed costs
+# (feed_pass pull, end_pass D2H + store merge) must amortize over a
+# realistic batch count or the bench mis-states steady-state throughput.
+N_BATCHES = 64
 
 
-def main() -> None:
+def _prepopulate_store(trainer, n_keys: int, chunk: int = 10_000_000) -> float:
+    """Fill the backing store with n_keys initialized features (setup for a
+    realistic pull: the pass working set hits a populated store). Returns
+    build throughput in keys/s."""
+    eng = trainer.engine.groups[0].engine
+    t0 = time.perf_counter()
+    for lo in range(1, n_keys + 1, chunk):
+        keys = np.arange(lo, min(lo + chunk, n_keys + 1), dtype=np.uint64)
+        vals = eng.store.pull_for_pass(keys)   # materializes init values
+        eng.store.push_from_pass(keys, vals)
+    return n_keys / (time.perf_counter() - t0)
+
+
+def _gen_pass_files(tmpdir: str, rng, pass_keys: np.ndarray,
+                    n_batches: int) -> list:
+    """Write n_batches*BATCH svm-format lines across part files (one per
+    batch) — ids drawn from the pass working set, 13 dense features.
+    Vectorized string assembly (np.char): a per-line Python loop takes
+    minutes at 1M+ lines on one core."""
+    files = []
+    for b in range(n_batches):
+        ids = rng.choice(pass_keys, (BATCH, NUM_SLOTS))
+        labels = (rng.random(BATCH) < 0.25).astype(np.int32)
+        dense = (rng.random((BATCH, DENSE_DIM)) * 10000).astype(np.int32)
+        line = labels.astype("U1")
+        for j in range(NUM_SLOTS):
+            line = np.char.add(line, f" s{j}:")
+            line = np.char.add(line, ids[:, j].astype("U20"))
+        line = np.char.add(line, " d:0.")
+        line = np.char.add(line, dense[:, 0].astype("U5"))
+        for j in range(1, DENSE_DIM):
+            line = np.char.add(line, ",0.")
+            line = np.char.add(line, dense[:, j].astype("U5"))
+        path = os.path.join(tmpdir, f"part-{b:05d}")
+        with open(path, "w") as f:
+            f.write("\n".join(line.tolist()) + "\n")
+        files.append(path)
+    return files
+
+
+def bench_deepfm() -> dict:
     import jax
     import jax.numpy as jnp
 
+    from paddlebox_tpu.data.dataset import Dataset
     from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
     from paddlebox_tpu.embedding import TableConfig
     from paddlebox_tpu.models import DeepFM
@@ -46,72 +120,296 @@ def main() -> None:
 
     ndev = len(jax.devices())
     mesh = build_mesh(HybridTopology(dp=ndev))
-    slots = tuple(SlotConf(f"s{i}", avg_len=AVG_IDS_PER_SLOT)
-                  for i in range(NUM_SLOTS))
+    slots = tuple(SlotConf(f"s{i}", avg_len=1.0) for i in range(NUM_SLOTS))
+    slots += (SlotConf("d", is_dense=True, dim=DENSE_DIM),)
     feed = DataFeedConfig(slots=slots, batch_size=BATCH)
     table_cfg = TableConfig(dim=EMB_DIM, learning_rate=0.05)
-    model = DeepFM(slot_names=tuple(s.name for s in slots), emb_dim=EMB_DIM,
+    model = DeepFM(slot_names=tuple(f"s{i}" for i in range(NUM_SLOTS)),
+                   emb_dim=EMB_DIM, dense_dim=DENSE_DIM,
                    hidden=(400, 400, 400))
-    trainer = CTRTrainer(model, feed, table_cfg, mesh=mesh,
-                         config=TrainerConfig(auc_num_buckets=1 << 16))
+    from paddlebox_tpu.embedding import ShardedFeatureStore
+    trainer = CTRTrainer(
+        model, feed, table_cfg, mesh=mesh,
+        config=TrainerConfig(auc_num_buckets=1 << 16),
+        store_factory=lambda cfg: ShardedFeatureStore(cfg, num_buckets=64))
     trainer.init(seed=0)
 
-    # Synthetic pass: keys uniform over the feature space.
     rng = np.random.default_rng(0)
-    pass_keys = rng.choice(np.arange(1, NUM_FEATURES, dtype=np.uint64),
-                           size=NUM_FEATURES // 4, replace=False)
-    trainer.engine.feed_pass(pass_keys)
-    table = trainer.engine.begin_pass()
+    build_keys_per_s = _prepopulate_store(trainer, STORE_KEYS)
+    pass_keys = rng.choice(np.arange(1, STORE_KEYS, dtype=np.uint64),
+                           size=PASS_KEYS, replace=False)
 
-    # One synthetic packed batch reused every step (isolates device+host-map
-    # throughput from disk IO, like the reference's in-memory pass).
-    caps = {s.name: feed.sparse_capacity(s, num_shards=ndev) for s in slots}
-    ids = {}
-    segments = {}
-    for s in slots:
-        cap = caps[s.name]
-        cap_local = cap // ndev
-        bs_local = BATCH // ndev
-        segs = np.concatenate([
-            np.sort(rng.integers(0, bs_local, cap_local)).astype(np.int32)
-            for _ in range(ndev)])
-        ids[s.name] = rng.choice(pass_keys, cap).astype(np.uint64)
-        segments[s.name] = segs
-    labels = (rng.random((BATCH, 1)) < 0.25).astype(np.float32)
-    valid = np.ones((BATCH,), bool)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        # Untimed setup: generate text data.
+        files = _gen_pass_files(tmpdir, rng, pass_keys, N_BATCHES)
 
-    step = trainer._build_step()
-    names = [s.name for s in slots]
-    all_ids = np.concatenate([ids[n] for n in names])
-    rows = trainer.engine.lookup_rows(all_ids)
-    from paddlebox_tpu.train.ctr_trainer import _interleave_slots
-    rows = _interleave_slots(rows, names, caps, ndev)
-    segs_j = {n: jnp.asarray(segments[n]) for n in names}
-    dense = jnp.zeros((BATCH, 0), jnp.float32)
-    args = lambda t, p, o, a: (t, p, o, a, jnp.asarray(rows), segs_j,
-                               jnp.asarray(labels), jnp.asarray(valid), dense)
+        # Device-only upper bound: repeat the jitted step on one fixed
+        # batch (no host work in the loop). Feeding the FULL pass key set
+        # here puts the table in the same power-of-two size bucket as the
+        # timed pass below, so this phase also serves as the compile
+        # warmup and the timed pass runs with zero recompilation.
+        ds_dev = Dataset(feed, num_reader_threads=2)
+        ds_dev.set_filelist(files[:1])
+        ds_dev.load_into_memory()
+        batch = next(ds_dev.batches_sharded(ndev))
+        eng = trainer.engine
+        eng.feed_pass([np.sort(pass_keys) for _ in eng.groups])
+        tables = eng.begin_pass()
+        rows = trainer._map_batch_rows(batch)
+        segs = {n: jnp.asarray(batch.segments[n]) for n in batch.ids}
+        from paddlebox_tpu.train.ctr_trainer import _concat_dense
+        dense_j = _concat_dense(batch)
+        labels_j = jnp.asarray(batch.labels)
+        valid_j = jnp.asarray(batch.valid)
+        if trainer._step_fn is None:
+            trainer._step_fn = trainer._build_step()
+        step = trainer._step_fn
+        params, opt_state, auc = (trainer.params, trainer.opt_state,
+                                  trainer.auc_state)
+        sync0 = jnp.zeros((), jnp.int32)
+        for _ in range(3):
+            tables, params, opt_state, auc, loss, _of = step(
+                tables, params, opt_state, auc, rows, segs, labels_j,
+                valid_j, dense_j, sync0)
+        _sync(loss)
+        t0 = time.perf_counter()
+        dev_steps = 20
+        for _ in range(dev_steps):
+            tables, params, opt_state, auc, loss, _of = step(
+                tables, params, opt_state, auc, rows, segs, labels_j,
+                valid_j, dense_j, sync0)
+        _sync(loss)
+        dev_dt = time.perf_counter() - t0
+        trainer.params, trainer.opt_state, trainer.auc_state = (
+            params, opt_state, auc)
+        eng.update_tables(tables)
+        eng.end_pass()
+        device_only = dev_steps * BATCH / dev_dt
 
-    params, opt_state, auc = trainer.params, trainer.opt_state, trainer.auc_state
-    for _ in range(STEPS_WARMUP):
-        table, params, opt_state, auc, loss = step(
-            *args(table, params, opt_state, auc))
-    jax.block_until_ready(loss)
+        # Timed E2E: native parse + columnar load, then the real pass loop
+        # (feed_pass build -> per-batch host map + device step -> end_pass
+        # write-back) over distinct batches.
+        dataset = Dataset(feed, num_reader_threads=4)
+        dataset.set_filelist(files)
+        t0 = time.perf_counter()
+        dataset.load_into_memory()
+        t_load = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        stats = trainer.train_pass(dataset)
+        t_pass = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for _ in range(STEPS_TIMED):
-        table, params, opt_state, auc, loss = step(
-            *args(table, params, opt_state, auc))
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-
-    samples_per_sec = STEPS_TIMED * BATCH / dt
-    per_chip = samples_per_sec / ndev
-    print(json.dumps({
-        "metric": "deepfm_ctr_samples_per_sec_per_chip",
+    n_samples = N_BATCHES * BATCH
+    e2e = n_samples / (t_load + t_pass)
+    tm = trainer.timers
+    host_map_s = tm["host_map"].elapsed_sec
+    device_step_s = tm["device_step"].elapsed_sec
+    # Analytic model FLOPs/sample (MLP fwd 2*in*out, bwd ~2x fwd).
+    dims = [NUM_SLOTS * EMB_DIM + DENSE_DIM, 400, 400, 400, 1]
+    mults = sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+    flops_per_sample = 3 * 2 * mults
+    per_chip = e2e / ndev
+    return {
+        "metric": "deepfm_ctr_e2e_samples_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "samples/s/chip",
-        "vs_baseline": round(per_chip / TARGET_SAMPLES_PER_SEC_PER_CHIP, 4),
-    }))
+        "vs_baseline": _vs("deepfm_e2e", per_chip),
+        "device_only_per_chip": round(device_only / ndev, 1),
+        "e2e_over_device_only": round(e2e / device_only, 4),
+        "load_s": round(t_load, 3),
+        "pass_s": round(t_pass, 3),
+        "host_map_s": round(host_map_s, 3),
+        "device_step_dispatch_s": round(device_step_s, 3),
+        "achieved_gflops_per_chip": round(
+            per_chip * flops_per_sample / 1e9, 2),
+        "store_build_keys_per_s": round(build_keys_per_s, 0),
+        "store_keys": STORE_KEYS,
+        "pass_keys": PASS_KEYS,
+        "auc": round(float(stats["auc"]), 5),
+        "n_devices": ndev,
+    }
+
+
+# ---------------------------------------------------------------------------
+# ResNet-50 (BASELINE.md config 1): single-chip fwd+bwd images/s
+# ---------------------------------------------------------------------------
+
+def bench_resnet50() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddlebox_tpu.models.resnet import ResNet
+
+    model = ResNet(depth=50, num_classes=1000)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    bs = 128
+
+    def loss_fn(p, x, y):
+        logits = model.apply(p, x, train=True)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    @jax.jit
+    def step(p, s, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(bs, 224, 224, 3)), jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 1000, bs), jnp.int32)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    _sync(loss)
+    t0 = time.perf_counter()
+    n = 20
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    ips = n * bs / dt
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(ips, 1),
+        "unit": "images/s/chip",
+        "vs_baseline": _vs("resnet50", ips),
+        "batch_size": bs,
+    }
+
+
+# ---------------------------------------------------------------------------
+# BERT-base DP (BASELINE.md config 2): tokens/s over the dp mesh
+# ---------------------------------------------------------------------------
+
+def bench_bert_dp() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddlebox_tpu.models.bert import (BertConfig, bert_mlm_loss,
+                                           init_bert)
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+
+    ndev = len(jax.devices())
+    mesh = build_mesh(HybridTopology(dp=ndev))
+    cfg = BertConfig()  # BERT-base defaults
+    params = init_bert(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+    bs, seq = 8 * ndev, 128
+
+    data_sh = NamedSharding(mesh, P("dp"))
+    rep = NamedSharding(mesh, P())
+    params = jax.device_put(params, rep)
+    opt_state = jax.device_put(opt_state, rep)
+
+    def loss_fn(p, tokens, mask_pos, mask_ids):
+        return bert_mlm_loss(p, cfg, tokens, mask_pos, mask_ids)
+
+    @jax.jit
+    def step(p, s, tokens, mask_pos, mask_ids):
+        loss, g = jax.value_and_grad(loss_fn)(p, tokens, mask_pos, mask_ids)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    rng = np.random.default_rng(0)
+    tokens = jax.device_put(jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (bs, seq)), jnp.int32), data_sh)
+    mask_pos = jax.device_put(jnp.asarray(
+        rng.integers(0, seq, (bs, 20)), jnp.int32), data_sh)
+    mask_ids = jax.device_put(jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (bs, 20)), jnp.int32), data_sh)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens,
+                                       mask_pos, mask_ids)
+    _sync(loss)
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, tokens,
+                                       mask_pos, mask_ids)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    tps = n * bs * seq / dt
+    return {
+        "metric": "bert_base_dp_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": _vs("bert_dp", tps),
+        "n_devices": ndev,
+        "batch_size": bs,
+        "seq_len": seq,
+    }
+
+
+# ---------------------------------------------------------------------------
+# GPT (BASELINE.md config 3, scaled to available chips): tokens/s + MFU-ish
+# ---------------------------------------------------------------------------
+
+def bench_gpt() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddlebox_tpu.models.gpt import (GPTConfig, init_gpt,
+                                          make_gpt_train_step)
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+
+    ndev = len(jax.devices())
+    # GPT-350M-class on one chip; hybrid axes engage when chips allow.
+    cfg = GPTConfig(vocab_size=50304, d_model=1024, n_heads=16,
+                    n_layers=24, d_ff=4096, max_seq_len=1024)
+    mesh = build_mesh(HybridTopology(dp=ndev))
+    params, specs = init_gpt(jax.random.PRNGKey(0), cfg, pp_stages=1)
+    opt = optax.adafactor(1e-3)
+    step = make_gpt_train_step(cfg, mesh, specs, opt, num_microbatches=1)
+    opt_state = opt.init(params)
+
+    bs, seq = 4 * ndev, 1024
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, seq)),
+                         jnp.int32)
+    targets = jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, seq)),
+                          jnp.int32)
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    _sync(loss)
+    t0 = time.perf_counter()
+    n = 5
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    tps = n * bs * seq / dt
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    flops = 6.0 * n_params * tps  # standard 6ND estimate
+    return {
+        "metric": "gpt_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": _vs("gpt", tps),
+        "n_devices": ndev,
+        "n_params": n_params,
+        "achieved_tflops": round(flops / 1e12, 2),
+    }
+
+
+CONFIGS = {
+    "deepfm": bench_deepfm,
+    "resnet50": bench_resnet50,
+    "bert_dp": bench_bert_dp,
+    "gpt": bench_gpt,
+}
+
+
+def main() -> None:
+    name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
+    out = CONFIGS[name]()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

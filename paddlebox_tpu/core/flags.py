@@ -191,6 +191,14 @@ define_flag("enable_pallas_kernels", True,
 define_flag("embedding_shard_slack", 1.3,
             "over-allocation factor for per-shard bucket capacity in the "
             "sparse pull/push all-to-all (static-shape padding headroom)")
+define_flag("trainer_prefetch_depth", 2,
+            "bounded queue depth for the train-pass host-map producer "
+            "thread (batches packed ahead of the device)")
+define_flag("pass_table_pow2_rows", 1,
+            "round each pass table's rows-per-shard up to a power of two "
+            "so consecutive passes with different key counts reuse the "
+            "compiled train step (1 recompile per size DOUBLING instead "
+            "of every pass; costs <=2x table HBM in the worst case)")
 define_flag("padbox_record_pool_max", 1 << 22,
             "max pooled slot records held for reuse by the data pipeline "
             "(role of FLAGS_padbox_record_pool_max_size)")

@@ -337,6 +337,19 @@ class Dataset:
             self._chunks = [merged]
             self._merged = merged
 
+    def snapshot_chunks(self):
+        """Cheap state snapshot (chunk refs; chunks are treated as
+        immutable — transforms like shuffle_slot return new ones) so
+        AUC-runner eval can shuffle a slot and restore afterwards."""
+        with self._lock:
+            return (list(self._chunks), self._merged)
+
+    def restore_chunks(self, snap) -> None:
+        chunks, merged = snap
+        with self._lock:
+            self._chunks = list(chunks)
+            self._merged = merged
+
     # -- disk spill (role of PreLoadIntoDisk/DumpIntoDisk + LoadDiskData,
     # data_set.cc:2088,2167) ----------------------------------------------
 
@@ -470,11 +483,21 @@ class Dataset:
             yield batch, gids
             g = h
 
-    def pass_keys(self) -> np.ndarray:
+    def pass_keys(self, slots: Optional[Sequence[str]] = None) -> np.ndarray:
         """Unique feasigns currently loaded (role of the per-pass key set
-        registered via FeedPass, box_wrapper.h:1239)."""
+        registered via FeedPass, box_wrapper.h:1239).
+
+        ``slots`` restricts to the given sparse slots — used by dim-grouped
+        embedding engines that feed each width group its own key set."""
         merged = self._merge()
-        keys = merged.all_keys()
+        if slots is None:
+            keys = merged.all_keys()
+        else:
+            parts = [merged.sparse_ids[s] for s in slots
+                     if merged.sparse_ids.get(s) is not None
+                     and merged.sparse_ids[s].size]
+            keys = (np.concatenate(parts) if parts
+                    else np.empty((0,), np.uint64))
         if keys.size == 0:
             return keys
         return np.unique(keys)

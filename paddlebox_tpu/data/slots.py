@@ -44,6 +44,12 @@ class SlotConf:
     # Sparse only: hard cap of ids kept per instance (0 = unlimited).
     max_len: int = 0
     is_used: bool = True
+    # Sparse only: mf embedding width for this slot; None = the table's
+    # default dim. Role of the per-slot dynamic mf dim in the reference
+    # (CtrDymfAccessor, ps/table/ctr_dymf_accessor.h; mf_dim in the HBM
+    # value record, heter_ps/feature_value.h:44-120) — production CTR
+    # models mix e.g. 8/16/64-wide slots in one model.
+    emb_dim: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -34,10 +34,15 @@ from paddlebox_tpu.embedding.optimizers import (SparseAdagrad, SparseAdam,
                                                 SparseOptimizer,
                                                 make_sparse_optimizer)
 from paddlebox_tpu.embedding.pass_engine import PassEngine
+from paddlebox_tpu.embedding.grouped import GroupedEngine, GroupedStore
+from paddlebox_tpu.embedding.sharded_store import ShardedFeatureStore
 
 __all__ = [
     "FeatureStore",
+    "GroupedEngine",
+    "GroupedStore",
     "PassEngine",
+    "ShardedFeatureStore",
     "PassTable",
     "SparseAdagrad",
     "SparseAdam",

@@ -1,0 +1,188 @@
+"""Dim-grouped embedding engine: per-slot embedding widths (dynamic mf).
+
+Role of the reference's dynamic-mf support: ``CtrDymfAccessor``
+(``paddle/fluid/distributed/ps/table/ctr_dymf_accessor.h``) and the
+per-feature ``mf_dim`` carried in the HBM value record
+(``heter_ps/feature_value.h:44-120``) let production CTR models mix
+8/16/64-wide slots in one model.
+
+TPU-first design: instead of a variable-width value record (which would
+force dynamic shapes or per-row masks on device), slots are grouped by
+embedding width and each width group gets its OWN :class:`PassEngine` —
+a fixed-width PassTable, store, and pull/push all-to-all. The train step
+runs one fused pull per group (G collectives instead of 1; G is tiny —
+production models use 2-3 distinct widths), and every array stays
+static-shape and mask-free. Keys are grouped by the slot they arrive
+through; a feasign appearing in slots of two different widths trains an
+independent row per group (same contract as the reference, where a
+feature's mf_dim is fixed by its slot).
+
+Checkpoint layout: ``<path>/dimD/`` per group, each a normal
+base/delta/xbox store dump, so group checkpoints compose with the
+done-file protocol unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import timers
+from paddlebox_tpu.embedding.pass_engine import PassEngine
+from paddlebox_tpu.embedding.table import PassTable, TableConfig
+
+
+@dataclasses.dataclass
+class DimGroup:
+    """One width group: its dim, member slots (feed order), and engine."""
+
+    dim: int
+    slots: Tuple[str, ...]
+    engine: PassEngine
+
+
+class GroupedStore:
+    """FeatureStore-shaped facade over the per-group stores so day-level
+    maintenance (save/load/shrink) from DayRunner works unchanged."""
+
+    def __init__(self, groups: Sequence[DimGroup]):
+        self._groups = list(groups)
+        # Shared iff every member store is shared (mixing shared and
+        # per-rank tiers across groups is a config error).
+        shared_flags = {getattr(g.engine.store, "shared", False)
+                        for g in self._groups}
+        if len(shared_flags) > 1:
+            raise ValueError("all dim-group stores must agree on 'shared'")
+        self.shared = shared_flags.pop() if shared_flags else False
+
+    def _subdir(self, path: str, g: DimGroup) -> str:
+        # Single-width models keep the flat layout (compatible with
+        # pre-dynamic-mf checkpoints); mixed widths get dimD/ subdirs.
+        if len(self._groups) == 1:
+            return path
+        return os.path.join(path, f"dim{g.dim}")
+
+    def __getattr__(self, name: str):
+        # Single-width models: full pass-through to the one member store
+        # (dirty_keys, pull_for_pass, xbox export, tier internals — the
+        # whole FeatureStore surface, unchanged from pre-dynamic-mf).
+        groups = object.__getattribute__(self, "_groups")
+        if len(groups) == 1:
+            return getattr(groups[0].engine.store, name)
+        # Mixed widths: forward optional capabilities (e.g. save_xbox)
+        # only when EVERY member store provides them, so hasattr() checks
+        # by callers (DayRunner's xbox export gate) stay truthful.
+        if name == "save_xbox":
+            members = [g.engine.store for g in groups]
+            if all(hasattr(m, "save_xbox") for m in members):
+                def save_xbox(path: str) -> int:
+                    return sum(m.save_xbox(self._subdir(path, g))
+                               for m, g in zip(members, groups))
+                return save_xbox
+        raise AttributeError(name)
+
+    def save_base(self, path: str) -> None:
+        for g in self._groups:
+            g.engine.store.save_base(self._subdir(path, g))
+
+    def save_delta(self, path: str) -> None:
+        for g in self._groups:
+            g.engine.store.save_delta(self._subdir(path, g))
+
+    def load(self, path: str, kind: str = "base") -> None:
+        for g in self._groups:
+            g.engine.store.load(self._subdir(path, g), kind)
+
+    def shrink(self, *, min_show: float = 0.0) -> int:
+        return sum(g.engine.store.shrink(min_show=min_show)
+                   for g in self._groups)
+
+    @property
+    def num_features(self) -> int:
+        return sum(g.engine.store.num_features for g in self._groups)
+
+
+class GroupedEngine:
+    """Pass lifecycle across width groups — same surface as PassEngine but
+    tables/rows are per-group tuples (ordered by ascending dim)."""
+
+    def __init__(self, base_config: TableConfig, slot_dims: Dict[str, int],
+                 *, mesh=None, table_axis: str = "dp",
+                 store_factory: Optional[Callable[[TableConfig], object]] = None):
+        if not slot_dims:
+            raise ValueError("slot_dims is empty")
+        dims = sorted(set(slot_dims.values()))
+        self.groups: List[DimGroup] = []
+        for d in dims:
+            slots = tuple(s for s, sd in slot_dims.items() if sd == d)
+            # Single-width models keep the base table name (and, via
+            # GroupedStore, the flat checkpoint layout) — fully compatible
+            # with pre-dynamic-mf artifacts.
+            name = (base_config.name if len(dims) == 1
+                    else f"{base_config.name}_dim{d}")
+            cfg = dataclasses.replace(base_config, dim=d, name=name)
+            store = store_factory(cfg) if store_factory is not None else None
+            eng = PassEngine(cfg, store, mesh=mesh, table_axis=table_axis)
+            self.groups.append(DimGroup(dim=d, slots=slots, engine=eng))
+        self.store = GroupedStore(self.groups)
+        self.timers = timers.TimerGroup()
+        self.num_shards = self.groups[0].engine.num_shards
+
+    @property
+    def dims(self) -> List[int]:
+        return [g.dim for g in self.groups]
+
+    def group_of_slot(self, slot: str) -> int:
+        """Index into self.groups for a slot name."""
+        for i, g in enumerate(self.groups):
+            if slot in g.slots:
+                return i
+        raise KeyError(slot)
+
+    # -- pass lifecycle (tuple-valued twins of PassEngine's surface) -------
+
+    def feed_pass(self, keys_by_group: Sequence[np.ndarray], *,
+                  async_build: bool = False) -> None:
+        if len(keys_by_group) != len(self.groups):
+            raise ValueError(
+                f"expected {len(self.groups)} key sets, got "
+                f"{len(keys_by_group)}")
+        with self.timers.scope("feed_pass"):
+            for g, keys in zip(self.groups, keys_by_group):
+                g.engine.feed_pass(keys, async_build=async_build)
+
+    def wait_feed_pass_done(self) -> None:
+        for g in self.groups:
+            g.engine.wait_feed_pass_done()
+
+    def begin_pass(self) -> Tuple[PassTable, ...]:
+        return tuple(g.engine.begin_pass() for g in self.groups)
+
+    @property
+    def tables(self) -> Tuple[PassTable, ...]:
+        return tuple(g.engine.table for g in self.groups)
+
+    def update_tables(self, tables: Sequence[PassTable]) -> None:
+        for g, t in zip(self.groups, tables):
+            g.engine.update_table(t)
+
+    def lookup_rows(self, group_index: int, batch_keys: np.ndarray
+                    ) -> np.ndarray:
+        return self.groups[group_index].engine.lookup_rows(batch_keys)
+
+    def end_pass(self) -> None:
+        with self.timers.scope("end_pass"):
+            for g in self.groups:
+                g.engine.end_pass()
+
+    def abort_pass(self) -> None:
+        """Drop the active pass without write-back (eval/test mode)."""
+        for g in self.groups:
+            g.engine.abort_pass()
+
+    def cancel_pending(self) -> None:
+        for g in self.groups:
+            g.engine.cancel_pending()

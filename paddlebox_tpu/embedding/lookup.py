@@ -78,15 +78,32 @@ def _bucket_by_shard(dev_rows: jax.Array, num_shards: int, block: int,
 def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
                ) -> Dict[str, jax.Array]:
     """Per-device pull: ids [n] (device-row space) → {emb [n, D], w [n],
-    show [n], click [n]}. Padding/overflow ids yield the trash row (zeros
-    unless polluted — push re-zeroes it)."""
+    show [n], click [n], overflow []}. Padding/overflow ids yield the
+    trash row (zeros unless polluted — push re-zeroes it).
+
+    ``overflow`` counts THIS device's real (non-trash) ids that fell past
+    their destination bucket's static capacity and degraded to a dropped
+    lookup (zeros) — the same positions drop their grads in push_local.
+    The capacity contract (`bucket_capacity`): keys hashing ~uniformly
+    across shards overflow with probability ~3e-5 per bucket at the
+    default slack; a skewed distribution (hot shard) CAN overflow
+    materially, which is exactly what this counter surfaces (contrast:
+    the reference's HeterComm never drops, heter_comm_inl.h:273 — it
+    re-walks; we trade bounded drop odds for static shapes and expose
+    the count)."""
     num_shards = table.num_shards
     block = table.rows_per_shard + 1
     n = dev_rows.shape[0]
     cap = bucket_capacity(n, num_shards)
+    trash = block - 1
 
     send_rows, order, slot_shard, slot_pos = _bucket_by_shard(
         dev_rows, num_shards, block, cap)
+    # Shape [1] (not scalar) so prefix out_specs like P(axis) remain
+    # valid for the returned dict under shard_map.
+    overflow = jnp.sum(((slot_pos >= cap)
+                        & (dev_rows[order] % block != trash)
+                        ).astype(jnp.int32)).reshape(1)
 
     # Exchange requests: recv_req[s, c] = row requested by peer s.
     recv_req = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0,
@@ -114,6 +131,7 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
         "w": picked[:, d],
         "show": picked[:, d + 1],
         "click": picked[:, d + 2],
+        "overflow": overflow,
     }
 
 

@@ -61,6 +61,13 @@ class PassEngine:
         # reference sequences BuildPull after EndPass the same way).
         self._no_active_pass = threading.Event()
         self._no_active_pass.set()
+        # One pending-build slot: a feed_pass issued while an earlier
+        # build is still waiting to be begun (pipelined day loops feeding
+        # pass k+1 from a loader thread) blocks until begin_pass consumes
+        # the earlier one. A semaphore (not an Event) so concurrent
+        # feed_pass callers serialize atomically instead of both passing
+        # a wait()+clear() window.
+        self._pending_sem = threading.Semaphore(1)
 
     # -- build -------------------------------------------------------------
 
@@ -72,9 +79,39 @@ class PassEngine:
                 # ps_gpu_wrapper.cc:114; numpy fallback inside)
                 from paddlebox_tpu.native.keymap_py import KeyMap, dedup_keys
                 keys = dedup_keys(np.asarray(pass_keys, np.uint64))
-                # ...but the value pull must wait for its end_pass.
-                self._no_active_pass.wait()
-                vals = self.store.pull_for_pass(keys)
+                # Split pull (role of the double-buffered build threads,
+                # ps_gpu_wrapper.cc:907): the active pass's end_pass only
+                # writes back ITS OWN keys, so values for keys NOT in the
+                # active set can be pulled while it trains; only the
+                # intersection must wait for write-back. Consecutive
+                # online passes typically share a minority of keys, so
+                # most of the pull overlaps training.
+                active = self._current_keys  # snapshot; sorted or None
+                vals = None
+                shared = None
+                if (active is not None and active.size and keys.size
+                        and not self._no_active_pass.is_set()):
+                    pos = np.minimum(np.searchsorted(active, keys),
+                                     active.size - 1)
+                    shared = active[pos] == keys
+                    if shared.any() and not shared.all():
+                        part = self.store.pull_for_pass(keys[~shared])
+                        n = keys.shape[0]
+                        vals = {f: np.empty((n,) + v.shape[1:], v.dtype)
+                                for f, v in part.items()}
+                        for f, v in part.items():
+                            vals[f][~shared] = v
+                    elif not shared.any():
+                        vals = self.store.pull_for_pass(keys)
+                        shared = None
+                with self.timers.scope("feed_wait"):
+                    self._no_active_pass.wait()
+                if vals is None:
+                    vals = self.store.pull_for_pass(keys)
+                elif shared is not None:
+                    part = self.store.pull_for_pass(keys[shared])
+                    for f, v in part.items():
+                        vals[f][shared] = v
                 table = build_pass_table_host(
                     vals, self.num_shards, self.config)
                 if self.mesh is not None:
@@ -96,6 +133,7 @@ class PassEngine:
         ``async_build=True`` overlaps the build with current-pass training
         (role of PreLoadIntoMemory + WaitFeedPassDone).
         """
+        self._pending_sem.acquire()
         pending = _PendingPass()
         if async_build:
             t = threading.Thread(target=self._build,
@@ -113,6 +151,20 @@ class PassEngine:
         if p is not None and p.error is not None:
             raise p.error
 
+    def cancel_pending(self) -> None:
+        """Discard an un-begun pending build (error-path cleanup: a
+        pipelined runner that fails mid-pass must not leave an orphaned
+        build whose keymap a later retry would silently consume)."""
+        p = self._pending
+        if p is None:
+            return
+        if p.thread is not None:
+            p.thread.join()
+        if p.keymap is not None:
+            p.keymap.close()
+        self._pending = None
+        self._pending_sem.release()
+
     # -- pass window -------------------------------------------------------
 
     def begin_pass(self) -> PassTable:
@@ -121,7 +173,14 @@ class PassEngine:
             raise RuntimeError(
                 "begin_pass while a pass is active — end_pass first "
                 "(an async feed_pass build would deadlock waiting for it)")
-        self.wait_feed_pass_done()
+        try:
+            self.wait_feed_pass_done()
+        except BaseException:
+            # Failed build: release the pending slot so the caller can
+            # retry with a fresh feed_pass instead of deadlocking.
+            self._pending = None
+            self._pending_sem.release()
+            raise
         if self._pending is None or self._pending.table is None:
             raise RuntimeError("begin_pass without a successful feed_pass")
         self._current_keys = self._pending.keys
@@ -129,7 +188,12 @@ class PassEngine:
         self._keymap = self._pending.keymap
         self._pending = None
         self._pass_id += 1
+        # Order matters: mark the pass ACTIVE before releasing the
+        # pending slot, or a queued async build could observe
+        # no-active-pass in the gap, skip the split-pull sequencing, and
+        # pull shared keys before this pass's write-back.
         self._no_active_pass.clear()
+        self._pending_sem.release()
         log.vlog(1, "begin_pass %d: %d keys, %d shards", self._pass_id,
                  self._current_keys.shape[0], self.num_shards)
         return self._table
@@ -153,6 +217,18 @@ class PassEngine:
             return self._keymap.lookup(batch_keys)
         return map_keys_to_rows(self._current_keys, batch_keys,
                                 self._table.rows_per_shard, self.num_shards)
+
+    def abort_pass(self) -> None:
+        """Drop the active pass WITHOUT writing back (role of the test
+        mode, SetTestMode: eval passes must not dirty or grow the store)."""
+        if self._table is None:
+            raise RuntimeError("abort_pass without begin_pass")
+        self._table = None
+        self._current_keys = None
+        if self._keymap is not None:
+            self._keymap.close()
+            self._keymap = None
+        self._no_active_pass.set()
 
     def end_pass(self) -> None:
         """Write the pass table back to the store (role of EndPass)."""

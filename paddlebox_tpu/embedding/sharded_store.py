@@ -1,0 +1,231 @@
+"""Hash-bucketed host feature store: FeatureStore surface at 100M+ keys.
+
+Role of the reference's sharded CPU-side pass build: ``PreBuildTask``
+dedups pass keys into 16-way shard buckets processed by threads
+(``ps_gpu_wrapper.cc:114``), and the brpc PS shards tables by key range.
+The flat :class:`FeatureStore` re-sorts its ENTIRE key array on every
+pass write-back (O(N log N) with N = total resident features) — fine at
+10M keys, a wall at 1B. Here keys are split across ``num_buckets``
+hash-range buckets (same splitmix-style mix as the SSD tier so sequential
+feasign ranges spread); every operation touches only the buckets its keys
+hash into, and independent buckets run on a thread pool (numpy releases
+the GIL for the heavy merges).
+
+Checkpoint layout: ``<path>/bucket-NNNN/`` per bucket plus a top-level
+meta json. Flat FeatureStore dumps load transparently (scattered on
+load), so single-store checkpoints migrate forward.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import log
+from paddlebox_tpu.embedding.store import _FIELDS, FeatureStore
+from paddlebox_tpu.embedding.table import TableConfig
+
+
+def _bucket_of(keys: np.ndarray, num_buckets: int) -> np.ndarray:
+    h = keys ^ (keys >> np.uint64(33))
+    with np.errstate(over="ignore"):
+        h = h * np.uint64(0xFF51AFD7ED558CCD)
+    return (h % np.uint64(num_buckets)).astype(np.int64)
+
+
+class ShardedFeatureStore:
+    """Drop-in FeatureStore replacement, bucketed for scale."""
+
+    shared = False
+
+    def __init__(self, config: TableConfig, num_buckets: int = 64,
+                 seed: int = 0, num_threads: int = 8):
+        self.config = config
+        self.num_buckets = int(num_buckets)
+        # Per-key deterministic init makes one seed safe across buckets.
+        self._buckets: List[FeatureStore] = [
+            FeatureStore(config, seed=seed) for _ in range(self.num_buckets)]
+        self.opt = self._buckets[0].opt
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, min(num_threads, self.num_buckets)),
+            thread_name_prefix="store-shard")
+
+    # -- scatter/gather plumbing ------------------------------------------
+
+    def _split(self, keys: np.ndarray
+               ) -> List[Tuple[int, np.ndarray, np.ndarray]]:
+        """[(bucket, indices_into_keys, keys[indices]), ...] for non-empty
+        buckets. Index lists preserve input order, so sorted inputs stay
+        sorted within each bucket."""
+        b = _bucket_of(keys, self.num_buckets)
+        order = np.argsort(b, kind="stable")
+        sorted_b = b[order]
+        starts = np.searchsorted(sorted_b, np.arange(self.num_buckets + 1))
+        out = []
+        for i in range(self.num_buckets):
+            lo, hi = starts[i], starts[i + 1]
+            if lo < hi:
+                idx = order[lo:hi]
+                out.append((i, idx, keys[idx]))
+        return out
+
+    def _map(self, fn, parts):
+        if len(parts) <= 1:
+            return [fn(*p) for p in parts]
+        return list(self._pool.map(lambda p: fn(*p), parts))
+
+    # -- size / membership -------------------------------------------------
+
+    @property
+    def num_features(self) -> int:
+        return sum(s.num_features for s in self._buckets)
+
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        k = np.ascontiguousarray(keys, np.uint64)
+        out = np.zeros((k.shape[0],), bool)
+        parts = self._split(k)
+        res = self._map(lambda b, idx, kk: self._buckets[b].contains(kk),
+                        parts)
+        for (b, idx, _), r in zip(parts, res):
+            out[idx] = r
+        return out
+
+    def dirty_keys(self) -> np.ndarray:
+        parts = [s.dirty_keys() for s in self._buckets]
+        parts = [p for p in parts if p.size]
+        return (np.concatenate(parts) if parts
+                else np.empty((0,), np.uint64))
+
+    def rows_by_coldness(self) -> np.ndarray:
+        stats = [s.key_stats() for s in self._buckets]
+        keys = np.concatenate([k for k, _ in stats]) if stats else \
+            np.empty((0,), np.uint64)
+        show = np.concatenate([v for _, v in stats]) if stats else \
+            np.empty((0,), np.float32)
+        return keys[np.argsort(show, kind="stable")]
+
+    def pop_rows(self, keys: np.ndarray
+                 ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        k = np.unique(np.ascontiguousarray(keys, np.uint64))
+        parts = self._split(k)
+        res = self._map(lambda b, idx, kk: self._buckets[b].pop_rows(kk),
+                        parts)
+        out_keys = [r[0] for r in res if r[0].size]
+        if not out_keys:
+            empty = self._buckets[0].pull_for_pass(
+                np.empty((0,), np.uint64))
+            return np.empty((0,), np.uint64), empty
+        keys_cat = np.concatenate(out_keys)
+        vals_cat = {f: np.concatenate([r[1][f] for r in res if r[0].size])
+                    for f in _FIELDS}
+        return keys_cat, vals_cat
+
+    # -- pass build --------------------------------------------------------
+
+    def pull_for_pass(self, pass_keys_sorted: np.ndarray
+                      ) -> Dict[str, np.ndarray]:
+        k = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+        n = k.shape[0]
+        parts = self._split(k)
+        res = self._map(
+            lambda b, idx, kk: self._buckets[b].pull_for_pass(kk), parts)
+        if not parts:
+            return self._buckets[0].pull_for_pass(k)
+        out = {f: np.empty((n,) + v.shape[1:], v.dtype)
+               for f, v in res[0].items()}
+        for (b, idx, _), r in zip(parts, res):
+            for f, v in r.items():
+                out[f][idx] = v
+        return out
+
+    def push_from_pass(self, pass_keys_sorted: np.ndarray,
+                       values: Dict[str, np.ndarray]) -> None:
+        k = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+        parts = self._split(k)
+        self._map(
+            lambda b, idx, kk: self._buckets[b].push_from_pass(
+                kk, {f: v[idx] for f, v in values.items()}),
+            parts)
+
+    # -- maintenance -------------------------------------------------------
+
+    def shrink(self, *, min_show: float = 0.0) -> int:
+        return sum(self._pool.map(
+            lambda s: s.shrink(min_show=min_show), self._buckets))
+
+    # -- checkpoint --------------------------------------------------------
+
+    def _bucket_dir(self, path: str, i: int) -> str:
+        return os.path.join(path, f"bucket-{i:04d}")
+
+    def _write_meta(self, path: str, kind: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path,
+                               f"{self.config.name}.sharded.json"),
+                  "w") as f:
+            json.dump({"num_buckets": self.num_buckets, "kind": kind,
+                       "table": self.config.name}, f)
+
+    def save_base(self, path: str) -> None:
+        self._write_meta(path, "base")
+        list(self._pool.map(
+            lambda i: self._buckets[i].save_base(self._bucket_dir(path, i)),
+            range(self.num_buckets)))
+        log.vlog(0, "sharded save_base: %d features x %d buckets -> %s",
+                 self.num_features, self.num_buckets, path)
+
+    def save_delta(self, path: str) -> None:
+        self._write_meta(path, "delta")
+        list(self._pool.map(
+            lambda i: self._buckets[i].save_delta(self._bucket_dir(path, i)),
+            range(self.num_buckets)))
+
+    def save_xbox(self, path: str) -> int:
+        self._write_meta(path, "xbox")
+        return sum(self._pool.map(
+            lambda i: self._buckets[i].save_xbox(self._bucket_dir(path, i)),
+            range(self.num_buckets)))
+
+    def load(self, path: str, kind: str = "base") -> None:
+        meta_path = os.path.join(path, f"{self.config.name}.sharded.json")
+        flat_npz = os.path.join(path, f"{self.config.name}.{kind}.npz")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta["num_buckets"] != self.num_buckets:
+                raise ValueError(
+                    f"checkpoint has {meta['num_buckets']} buckets, store "
+                    f"has {self.num_buckets} — rebucketing not supported; "
+                    f"construct the store with the matching count")
+            list(self._pool.map(
+                lambda i: self._buckets[i].load(self._bucket_dir(path, i),
+                                                kind),
+                range(self.num_buckets)))
+            return
+        if os.path.exists(flat_npz):
+            # Migration path: a flat FeatureStore dump scatters in.
+            data = np.load(flat_npz)
+            keys = data["keys"].astype(np.uint64)
+            vals = {f: data[f] for f in _FIELDS}
+            if kind == "base":
+                parts = self._split(keys)
+                hit = set()
+                for b, idx, kk in parts:
+                    hit.add(b)
+                    self._buckets[b].set_all(
+                        kk, {f: v[idx] for f, v in vals.items()})
+                empty_k = np.empty((0,), np.uint64)
+                for i in range(self.num_buckets):
+                    if i not in hit:
+                        self._buckets[i].set_all(empty_k, {
+                            f: np.empty((0,) + v.shape[1:], v.dtype)
+                            for f, v in vals.items()})
+            else:
+                self.push_from_pass(keys, vals)
+            return
+        raise FileNotFoundError(
+            f"no sharded meta or flat {kind} dump under {path}")

@@ -29,6 +29,21 @@ from paddlebox_tpu.embedding.table import TableConfig
 _FIELDS = ("emb", "emb_state", "w", "w_state", "show", "click")
 
 
+def _per_key_uniform(keys: np.ndarray, dim: int, seed: np.uint64,
+                     scale: float) -> np.ndarray:
+    """[n, dim] uniform(-scale, scale) derived from a splitmix64-style
+    counter hash of (key, column, seed) — order-independent init."""
+    k = keys.astype(np.uint64)[:, None]
+    j = np.arange(1, dim + 1, dtype=np.uint64)[None, :]
+    with np.errstate(over="ignore"):
+        z = k + j * np.uint64(0x9E3779B97F4A7C15) + seed
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> np.uint64(31))
+    u = (z >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+    return ((2.0 * u - 1.0) * scale).astype(np.float32)
+
+
 class FeatureStore:
     """Sorted-key columnar feature store with base+delta checkpointing."""
 
@@ -53,7 +68,7 @@ class FeatureStore:
             "show": np.empty((0,), np.float32),
             "click": np.empty((0,), np.float32),
         }
-        self._rng = np.random.default_rng(seed)
+        self._seed = np.uint64(seed)
         self._lock = threading.Lock()
         # Keys touched since the last save_base (delta set).
         self._dirty = np.empty((0,), np.uint64)
@@ -119,6 +134,12 @@ class FeatureStore:
             order = np.argsort(self._vals["show"], kind="stable")
             return self._keys[order].copy()
 
+    def key_stats(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, show) copies — lets composing stores (sharded/tiered)
+        merge eviction order globally without reaching into internals."""
+        with self._lock:
+            return self._keys.copy(), self._vals["show"].copy()
+
     # -- pass build --------------------------------------------------------
 
     def pull_for_pass(self, pass_keys_sorted: np.ndarray
@@ -140,9 +161,13 @@ class FeatureStore:
         with self._lock:
             found, pos_c = self._locate(k)
             # New keys: small-uniform init for emb, zeros elsewhere.
-            out["emb"][:] = self._rng.uniform(
-                -self.config.init_scale, self.config.init_scale,
-                (n, d)).astype(np.float32)
+            # Deterministic PER KEY (counter-based hash, not a sequential
+            # rng stream): the same feasign inits identically regardless
+            # of pull order, split-pull overlap chunking, or which rank
+            # asks — required for reproducible pipelined builds and for
+            # replica stores to agree without communication.
+            out["emb"][:] = _per_key_uniform(k, d, self._seed,
+                                             self.config.init_scale)
             if found.any():
                 for f in _FIELDS:
                     out[f][found] = self._vals[f][pos_c[found]]
@@ -163,16 +188,31 @@ class FeatureStore:
             # Update existing rows in place.
             for f in _FIELDS:
                 self._vals[f][pos_c[found]] = values[f][found]
-            # Merge new rows with one sorted concatenate.
+            # Merge new rows LINEARLY (two sorted runs -> O(N + n) scatter;
+            # a concat + argsort here would cost O((N+n) log(N+n)) on
+            # every pass write-back, the scaling wall the reference's
+            # 16-way sharded PreBuildTask exists to avoid).
             new_mask = ~found
             if new_mask.any():
-                merged_keys = np.concatenate([self._keys, k[new_mask]])
-                order = np.argsort(merged_keys, kind="stable")
-                self._keys = merged_keys[order]
+                new_k = k[new_mask]           # sorted (subset of sorted k)
+                n_old = self._keys.shape[0]
+                n_new = new_k.shape[0]
+                # Destination index of each old / new element in the merge.
+                ins = np.searchsorted(self._keys, new_k)
+                dst_new = ins + np.arange(n_new)
+                merged_keys = np.empty(n_old + n_new, np.uint64)
+                merged_keys[dst_new] = new_k
+                is_new = np.zeros(n_old + n_new, bool)
+                is_new[dst_new] = True
+                old_pos = np.flatnonzero(~is_new)
+                merged_keys[old_pos] = self._keys
+                self._keys = merged_keys
                 for f in _FIELDS:
-                    merged = np.concatenate(
-                        [self._vals[f], values[f][new_mask]])
-                    self._vals[f] = merged[order]
+                    shape = (n_old + n_new,) + self._vals[f].shape[1:]
+                    merged = np.empty(shape, self._vals[f].dtype)
+                    merged[dst_new] = values[f][new_mask]
+                    merged[old_pos] = self._vals[f]
+                    self._vals[f] = merged
             self._dirty = np.union1d(self._dirty, k)
 
     # -- lifecycle maintenance --------------------------------------------
@@ -266,16 +306,24 @@ class FeatureStore:
                     f"{self.config.optimizer!r} — checkpoint/table was "
                     f"written with a different sparse optimizer")
 
+    def set_all(self, keys_sorted: np.ndarray,
+                vals: Dict[str, np.ndarray]) -> None:
+        """Replace the entire contents (base-load semantics: delta set
+        cleared, shrink guard reset). Keys must be sorted unique."""
+        self._check_state_widths(vals)
+        with self._lock:
+            self._keys = np.ascontiguousarray(keys_sorted, np.uint64)
+            self._vals = {f: np.asarray(vals[f]) for f in _FIELDS}
+            self._dirty = np.empty((0,), np.uint64)
+            self._shrunk_since_base = False
+
     def load(self, path: str, kind: str = "base") -> None:
         """Load a base snapshot, or apply a delta on top."""
         data = np.load(os.path.join(path, f"{self.config.name}.{kind}.npz"))
         keys = data["keys"].astype(np.uint64)
         vals = {f: data[f] for f in _FIELDS}
-        self._check_state_widths(vals)
         if kind == "base":
-            with self._lock:
-                self._keys = keys
-                self._vals = vals
-                self._dirty = np.empty((0,), np.uint64)
+            self.set_all(keys, vals)
         else:
+            self._check_state_widths(vals)
             self.push_from_pass(keys, vals)

@@ -106,10 +106,24 @@ class PassTable:
         return int(self.emb.shape[-1])
 
 
-def plan_shards(num_keys: int, num_shards: int) -> int:
-    """Rows per shard covering num_keys. No alignment needed: gathers index
-    the row dim; only the trailing feature dim needs TPU tiling."""
-    return -(-max(num_keys, 1) // num_shards)
+def plan_shards(num_keys: int, num_shards: int,
+                round_pow2: Optional[bool] = None) -> int:
+    """Rows per shard covering num_keys.
+
+    By default rounds up to a power of two (``pass_table_pow2_rows``
+    flag): the jitted train step's shapes depend on the table's leading
+    dim, so WITHOUT rounding every pass with a new key count would
+    recompile (~tens of seconds); with it, steady-state online passes hit
+    the same size bucket and reuse the compiled program. Row alignment
+    beyond that is unnecessary — gathers index the row dim; only the
+    trailing feature dim needs TPU tiling."""
+    from paddlebox_tpu.core import flags
+    rps = -(-max(num_keys, 1) // num_shards)
+    if round_pow2 is None:
+        round_pow2 = bool(flags.flag("pass_table_pow2_rows"))
+    if round_pow2:
+        rps = 1 << (rps - 1).bit_length()
+    return rps
 
 
 def build_pass_table_host(values: Dict[str, np.ndarray], num_shards: int,

@@ -14,7 +14,7 @@ the embedding grads straight into the sparse push.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Mapping, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -26,13 +26,21 @@ from paddlebox_tpu.ops import seqpool
 @dataclasses.dataclass(frozen=True)
 class DeepFM:
     slot_names: Tuple[str, ...]
-    emb_dim: int
+    # One width for every slot, or a per-slot mapping (dynamic mf, role of
+    # CtrDymfAccessor per-slot mf dims). With mixed widths the FM term
+    # zero-pads pooled vectors to the max width (missing dims contribute
+    # nothing to the interaction); the deep tower concats true widths.
+    emb_dim: Union[int, Mapping[str, int]]
     dense_dim: int = 0                    # width of concatenated dense slots
     hidden: Tuple[int, ...] = (400, 400, 400)
 
+    def _dims(self) -> Dict[str, int]:
+        if isinstance(self.emb_dim, int):
+            return {n: self.emb_dim for n in self.slot_names}
+        return {n: int(self.emb_dim[n]) for n in self.slot_names}
+
     def init(self, rng: jax.Array) -> Dict:
-        s = len(self.slot_names)
-        in_dim = s * self.emb_dim + self.dense_dim
+        in_dim = sum(self._dims().values()) + self.dense_dim
         rng, sub = jax.random.split(rng)
         return {
             "mlp": mlp_init(sub, in_dim, list(self.hidden) + [1]),
@@ -40,29 +48,34 @@ class DeepFM:
         }
 
     def apply(self, params: Dict,
-              emb: Dict[str, jax.Array],       # slot -> [cap_s, D] pulled
+              emb: Dict[str, jax.Array],       # slot -> [cap_s, D_s] pulled
               w: Dict[str, jax.Array],         # slot -> [cap_s] pulled
               segments: Dict[str, jax.Array],  # slot -> [cap_s] row ids
               batch_size: int,
               dense_feats: jax.Array | None = None) -> jax.Array:
         """Returns logits [B]."""
-        pooled_v: List[jax.Array] = []   # per-slot [B, D]
+        dims = self._dims()
+        dmax = max(dims.values())
+        pooled_v: List[jax.Array] = []   # per-slot [B, D_s]
         wide_terms: List[jax.Array] = []  # per-slot [B]
         for name in self.slot_names:
             pooled_v.append(seqpool(emb[name], segments[name], batch_size))
             wide_terms.append(seqpool(w[name], segments[name], batch_size))
-        v = jnp.stack(pooled_v, axis=1)                   # [B, S, D]
 
         # Wide (first-order) term.
         wide = sum(wide_terms) + params["bias"]           # [B]
 
-        # FM second-order interaction: 0.5 * ((Σ_s v)^2 - Σ_s v^2).
-        sum_v = jnp.sum(v, axis=1)                        # [B, D]
-        sum_sq = jnp.sum(v * v, axis=1)                   # [B, D]
+        # FM second-order interaction: 0.5 * ((Σ_s v)^2 - Σ_s v^2), with
+        # narrower slots zero-padded to the max width.
+        padded = [jnp.pad(p, ((0, 0), (0, dmax - p.shape[-1])))
+                  if p.shape[-1] < dmax else p for p in pooled_v]
+        v = jnp.stack(padded, axis=1)                     # [B, S, Dmax]
+        sum_v = jnp.sum(v, axis=1)                        # [B, Dmax]
+        sum_sq = jnp.sum(v * v, axis=1)                   # [B, Dmax]
         fm = 0.5 * jnp.sum(sum_v * sum_v - sum_sq, axis=-1)  # [B]
 
-        # Deep tower.
-        flat = v.reshape(v.shape[0], -1)                  # [B, S*D]
+        # Deep tower over true (unpadded) widths.
+        flat = jnp.concatenate(pooled_v, axis=-1)         # [B, sum D_s]
         if dense_feats is not None and self.dense_dim:
             flat = jnp.concatenate([flat, dense_feats], axis=-1)
         deep = mlp_apply(params["mlp"], flat)[:, 0]       # [B]

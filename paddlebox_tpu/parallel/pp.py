@@ -94,6 +94,150 @@ def gpipe_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     return lax.psum(outputs * is_last, axis)
 
 
+def one_f_one_b_value_and_grad(
+        stage_fn: Callable[[Any, jax.Array], jax.Array],
+        loss_fn: Callable[..., jax.Array],
+        params_local: Any, x_microbatches: jax.Array,
+        targets_microbatches: jax.Array, *,
+        axis: str = "pp", loss_params: Any = None,
+        return_input_grads: bool = False):
+    """1F1B pipeline forward+backward with bounded activation memory
+    (call INSIDE shard_map).
+
+    Role of the reference 1F1B schedules
+    (``meta_parallel/pipeline_parallel.py:82`` forward_backward_pipeline;
+    static-graph ``section_worker.cc:40-63``): each microbatch's backward
+    starts as soon as its gradient returns, so a stage holds at most
+    ``2*(n_stages - rank) - 1`` in-flight stage INPUTS — independent of
+    the microbatch count M — where the GPipe-through-autodiff path
+    (:func:`gpipe_apply` + ``jax.grad``) stashes every scan step's
+    internal residuals, O(M).
+
+    TPU-first differences from the reference:
+    - Eager lock-step schedule: every tick runs one (masked) forward AND
+      one (masked) backward on every stage; in steady state both halves
+      are real work on every stage simultaneously, so there is no
+      masked-idle waste — strict Megatron-style 1F1B alternation would
+      leave half of each SPMD tick masked out. Fill/drain bubbles are the
+      usual ``n-1`` ticks at each end.
+    - Rematerialized backward: the ring buffer stores stage INPUTS only;
+      the backward recomputes the stage forward under ``jax.vjp`` (the
+      standard TPU trade of FLOPs for HBM).
+    - Activations move by neighbor ``ppermute`` (fwd ring s->s+1, bwd
+      ring s->s-1) on ICI; param grads accumulate locally per stage.
+
+    ``stage_fn(params, act) -> act`` must preserve the activation shape
+    across stages (same contract as :func:`gpipe_apply`).
+    ``loss_fn(last_stage_out, target_mb)`` — or, when ``loss_params`` is
+    given, ``loss_fn(loss_params, last_stage_out, target_mb)`` — returns
+    a scalar, evaluated on the last stage; the returned loss is the mean
+    over microbatches, broadcast to every pp rank.
+
+    Returns ``(loss, stage_grads)`` by default, both scaled so grads
+    correspond to the mean loss. With ``loss_params``, returns
+    ``(loss, stage_grads, loss_param_grads)`` — the grads of the
+    last-stage head/readout (zero on other ranks; psum them outside if
+    the head is replicated). With ``return_input_grads``, appends
+    ``dx0 [M, *mb_shape]``: cotangents of the stage-0 microbatch inputs
+    (nonzero on rank 0 only), for backpropagating into an embedding that
+    runs OUTSIDE the pipeline loop.
+    """
+    n = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    m = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+    dtype = x_microbatches.dtype
+
+    # Static ring capacity: max in-flight inputs over all stages.
+    ring_cap = 2 * n - 1
+
+    fwd0 = jnp.zeros(mb_shape, dtype)
+    bwd0 = jnp.zeros(mb_shape, dtype)
+    ring0 = jnp.zeros((ring_cap,) + mb_shape, dtype)
+    grads0 = jax.tree.map(jnp.zeros_like, params_local)
+    loss0 = jnp.zeros((), jnp.float32)
+    lgrads0 = (jax.tree.map(jnp.zeros_like, loss_params)
+               if loss_params is not None else None)
+    dx0_buf0 = (jnp.zeros((m,) + mb_shape, dtype)
+                if return_input_grads else None)
+
+    # Schedule (ticks): F(s, j) at tick j + s;
+    # B(s, j) at tick 2*(n-1) - s + j  (same tick as F on the last stage).
+    total_ticks = m + 2 * (n - 1)
+
+    def tick(carry, t):
+        fwd_in, bwd_in, ring, grads, loss_acc, lgrads, dx0_buf = carry
+
+        # ---- forward half -------------------------------------------
+        j_f = t - rank
+        f_active = (j_f >= 0) & (j_f < m)
+        x_t = x_microbatches[jnp.clip(j_f, 0, m - 1)]
+        x_in = jnp.where(rank == 0, x_t, fwd_in)
+        ring = ring.at[jnp.clip(j_f, 0, m - 1) % ring_cap].set(
+            jnp.where(f_active, x_in, ring[jnp.clip(j_f, 0, m - 1)
+                                           % ring_cap]))
+        y = stage_fn(params_local, x_in)
+        y = jnp.where(f_active, y, 0)
+
+        # Last stage: seed the backward for THIS tick's microbatch.
+        j_b = t - (2 * (n - 1) - rank)
+        b_active = (j_b >= 0) & (j_b < m)
+        tgt = targets_microbatches[jnp.clip(j_b, 0, m - 1)]
+
+        is_last = rank == n - 1
+        if loss_params is None:
+            loss_j, seed = jax.value_and_grad(
+                lambda yy: loss_fn(yy, tgt))(y)
+        else:
+            (loss_j, (dlp, seed)) = jax.value_and_grad(
+                lambda lp, yy: loss_fn(lp, yy, tgt),
+                argnums=(0, 1))(loss_params, y)
+            lmask = (b_active & is_last).astype(jnp.float32)
+            lgrads = jax.tree.map(
+                lambda g, d: g + lmask * d.astype(g.dtype), lgrads, dlp)
+        loss_acc = loss_acc + jnp.where(b_active & is_last,
+                                        loss_j.astype(jnp.float32), 0.0)
+        din = jnp.where(is_last, seed.astype(dtype), bwd_in)
+
+        # ---- backward half (rematerialized) -------------------------
+        x_saved = ring[jnp.clip(j_b, 0, m - 1) % ring_cap]
+        _, vjp = jax.vjp(stage_fn, params_local, x_saved)
+        dparams, dx = vjp(din)
+        bmask = b_active.astype(dtype)
+        grads = jax.tree.map(
+            lambda g, d: g + bmask * d.astype(g.dtype), grads, dparams)
+        dx = dx * bmask
+        if dx0_buf is not None:
+            # Stage 0's input cotangent for microbatch j_b (zero off
+            # rank 0 — there j_b indexes a different stage's schedule).
+            keep = (b_active & (rank == 0)).astype(dtype)
+            idx = jnp.clip(j_b, 0, m - 1)
+            dx0_buf = dx0_buf.at[idx].add(keep * dx)
+
+        # ---- rotate rings -------------------------------------------
+        fwd_next = lax.ppermute(y, axis,
+                                [(i, (i + 1) % n) for i in range(n)])
+        bwd_next = lax.ppermute(dx, axis,
+                                [(i, (i - 1) % n) for i in range(n)])
+        return (fwd_next, bwd_next, ring, grads, loss_acc, lgrads,
+                dx0_buf), None
+
+    (_, _, _, grads, loss_acc, lgrads, dx0_buf), _ = lax.scan(
+        tick, (fwd0, bwd0, ring0, grads0, loss0, lgrads0, dx0_buf0),
+        jnp.arange(total_ticks))
+
+    # Mean loss over microbatches, broadcast from the last stage (role of
+    # _broadcast_final_loss, pipeline_parallel.py:325).
+    loss = lax.psum(loss_acc * (rank == n - 1), axis) / m
+    grads = jax.tree.map(lambda g: g / m, grads)
+    out = (loss, grads)
+    if loss_params is not None:
+        out = out + (jax.tree.map(lambda g: g / m, lgrads),)
+    if return_input_grads:
+        out = out + (dx0_buf / m,)
+    return out
+
+
 def make_pipeline_fn(mesh: Mesh, stage_fn, stacked_params_template, *,
                      axis: str = "pp", extra_in_specs: Tuple = ()):
     """Jitted wrapper: (stacked_params, x_microbatches) -> outputs."""

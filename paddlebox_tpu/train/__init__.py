@@ -6,5 +6,6 @@ Role of ``BoxPSTrainer``/``BoxPSWorker`` (``framework/boxps_trainer.cc``,
 """
 
 from paddlebox_tpu.train.ctr_trainer import CTRTrainer, TrainerConfig
+from paddlebox_tpu.train.auc_runner import slot_replacement_eval
 
-__all__ = ["CTRTrainer", "TrainerConfig"]
+__all__ = ["CTRTrainer", "TrainerConfig", "slot_replacement_eval"]

@@ -17,6 +17,7 @@ the NCCL ring of the reference collapse into the compiled program.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -29,8 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from paddlebox_tpu.core import flags, log, timers
 from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
-from paddlebox_tpu.embedding import (PassEngine, TableConfig,
-                                     make_sparse_optimizer)
+from paddlebox_tpu.embedding import TableConfig, make_sparse_optimizer
+from paddlebox_tpu.embedding.grouped import GroupedEngine
 from paddlebox_tpu.embedding.lookup import pull_local, push_local
 from paddlebox_tpu.metrics import (AucState, auc_accumulate, auc_compute,
                                    auc_state_init)
@@ -42,6 +43,20 @@ class TrainerConfig:
     dense_optimizer: str = "adam"
     auc_num_buckets: int = 1 << 16
     check_nan_inf: bool = False
+    # Dense gradient synchronization across the dp axis (role of the
+    # BoxPSWorker dense-sync modes, boxps_worker.cc:584-645):
+    #   "step"  — psum grads every step (default; c_allreduce_sum role)
+    #   "kstep" — local-SGD: local update each step with the grad scaled
+    #             by world size, params averaged (pmean) every
+    #             dense_sync_interval steps (SyncParam's k-step
+    #             ReduceScatter+SyncDense+AllGather role). Optimizer
+    #             state stays worker-local between syncs, as in the
+    #             reference. At k=1 with SGD this is exactly "step".
+    #   "async" — the jitted step returns psum'd dense grads; a host
+    #             AsyncDenseTable thread applies Adam and workers pull
+    #             fresh params each step (BoxPSAsynDenseTable role).
+    dense_sync_mode: str = "step"
+    dense_sync_interval: int = 8
 
 
 class CTRTrainer:
@@ -61,7 +76,7 @@ class CTRTrainer:
                  table_config: TableConfig, *,
                  mesh: Optional[Mesh] = None, axis: str = "dp",
                  config: TrainerConfig = TrainerConfig(),
-                 store=None):
+                 store=None, store_factory=None):
         self.model = model
         self.feed_config = feed_config
         self.config = config
@@ -72,15 +87,34 @@ class CTRTrainer:
             raise ValueError(
                 f"batch_size {feed_config.batch_size} must be divisible by "
                 f"the {axis} axis size {self.ndev}")
-        # store: optional FeatureStore-shaped backing tier — a
+        # Per-slot mf widths (dynamic mf, role of CtrDymfAccessor): slots
+        # declaring SlotConf.emb_dim get that width; the rest use the
+        # table default. Slots are grouped by width — one PassEngine,
+        # store, and fused pull/push per width group.
+        slot_dims = {s.name: (s.emb_dim or table_config.dim)
+                     for s in feed_config.sparse_slots}
+        # store: optional FeatureStore-shaped backing tier instance — a
         # TieredFeatureStore (RAM+SSD) or a distributed.ps.PSBackedStore
-        # (remote CPU PS, the BuildPull flow); default in-RAM store.
-        self.engine = PassEngine(table_config, store, mesh=mesh,
-                                 table_axis=axis)
+        # (remote CPU PS, the BuildPull flow). Single-width models only;
+        # multi-width models pass store_factory(cfg) -> store instead.
+        if store is not None:
+            if store_factory is not None:
+                raise ValueError("pass store or store_factory, not both")
+            if len(set(slot_dims.values())) > 1:
+                raise ValueError(
+                    "a single store instance cannot back multiple widths "
+                    "— pass store_factory instead")
+            store_factory = lambda cfg: store  # noqa: E731
+        self.engine = GroupedEngine(table_config, slot_dims, mesh=mesh,
+                                    table_axis=axis,
+                                    store_factory=store_factory)
         self.sparse_opt = make_sparse_optimizer(table_config)
         self.params: Any = None
         self.opt_state: Any = None
         self.auc_state: Optional[AucState] = None
+        self._async_dense = None
+        self._sync_params_cache = None
+        self._eval_fn = None
         self.timers = timers.TimerGroup()
         self._step_fn = None
         self._slot_names = [s.name for s in feed_config.sparse_slots]
@@ -111,42 +145,85 @@ class CTRTrainer:
 
     # -- the fused step ----------------------------------------------------
 
-    def _build_step(self):
+    def _group_layout(self) -> Tuple[List[Tuple[str, ...]],
+                                     List[Dict[str, slice]]]:
+        """Width groups (dynamic mf): group g's slots share one PassTable
+        and one fused pull/push; slot slices index into the group's fused
+        arrays."""
+        caps_local = {n: self._slot_caps[n] // self.ndev
+                      for n in self._slot_names}
+        group_slots: List[Tuple[str, ...]] = [
+            g.slots for g in self.engine.groups]
+        group_sl: List[Dict[str, slice]] = []
+        for slots in group_slots:
+            offs = np.cumsum([0] + [caps_local[n] for n in slots])
+            group_sl.append({n: slice(int(offs[i]), int(offs[i + 1]))
+                             for i, n in enumerate(slots)})
+        return group_slots, group_sl
+
+    def _make_forward(self, group_slots, group_sl):
+        """Shared train/eval forward: slice each width group's fused pull
+        into per-slot arrays and call the model. ``emb_alls``/``w_alls``
+        override the pulled emb/w so the train step can differentiate
+        with respect to them."""
         model = self.model
+        bs_local = self.feed_config.batch_size // self.ndev
+        has_dense = bool(self.feed_config.dense_slots)
+
+        def forward(params, pulled, segments, dense_feats,
+                    emb_alls=None, w_alls=None):
+            emb: Dict[str, jax.Array] = {}
+            w: Dict[str, jax.Array] = {}
+            for gi, slots in enumerate(group_slots):
+                src_e = (emb_alls[gi] if emb_alls is not None
+                         else pulled[gi]["emb"])
+                src_w = (w_alls[gi] if w_alls is not None
+                         else pulled[gi]["w"])
+                for n in slots:
+                    emb[n] = src_e[group_sl[gi][n]]
+                    w[n] = src_w[group_sl[gi][n]]
+            kwargs = dict(batch_size=bs_local,
+                          dense_feats=dense_feats if has_dense else None)
+            if hasattr(model, "use_cvm"):  # Wide&Deep takes show/click
+                show = {n: pulled[gi]["show"][group_sl[gi][n]]
+                        for gi, slots in enumerate(group_slots)
+                        for n in slots}
+                click = {n: pulled[gi]["click"][group_sl[gi][n]]
+                         for gi, slots in enumerate(group_slots)
+                         for n in slots}
+                return model.apply(params, emb, w, show, click,
+                                   segments, **kwargs)
+            return model.apply(params, emb, w, segments, **kwargs)
+
+        return forward
+
+    def _build_step(self):
         axis = self.axis
         ndev = self.ndev
-        names = self._slot_names
-        caps = self._slot_caps
-        caps_local = {n: caps[n] // ndev for n in names}
         bs_local = self.feed_config.batch_size // ndev
         optimizer = self._optax
         sparse_opt = self.sparse_opt
-        has_dense = bool(self.feed_config.dense_slots)
+        group_slots, group_sl = self._group_layout()
+        forward = self._make_forward(group_slots, group_sl)
 
-        def body(table, params, opt_state, auc, rows, segments, labels,
-                 valid, dense_feats):
-            # rows: [sum caps_local] — all slots' ids fused into ONE pull
-            # (one all_to_all pair instead of per-slot collectives).
-            pulled = pull_local(table, rows, axis=axis)
+        mode = self.config.dense_sync_mode
+        if mode not in ("step", "kstep", "async"):
+            raise ValueError(f"unknown dense_sync_mode {mode!r}")
 
-            offs = np.cumsum([0] + [caps_local[n] for n in names])
-            sl = {n: slice(offs[i], offs[i + 1])
-                  for i, n in enumerate(names)}
+        def body(tables, params, opt_state, auc, rows, segments, labels,
+                 valid, dense_feats, sync_flag):
+            # rows[g]: [sum caps_local over group g's slots] — each width
+            # group's slots fused into ONE pull (one all_to_all pair per
+            # group; G = #distinct widths, typically 1-3).
+            pulled = [pull_local(t, r, axis=axis)
+                      for t, r in zip(tables, rows)]
+
             labels1 = labels[:, 0]
             validf = valid.astype(jnp.float32)
 
-            def loss_fn(params, emb_all, w_all):
-                emb = {n: emb_all[sl[n]] for n in names}
-                w = {n: w_all[sl[n]] for n in names}
-                kwargs = dict(batch_size=bs_local,
-                              dense_feats=dense_feats if has_dense else None)
-                if hasattr(model, "use_cvm"):  # Wide&Deep takes show/click
-                    show = {n: pulled["show"][sl[n]] for n in names}
-                    click = {n: pulled["click"][sl[n]] for n in names}
-                    logits = model.apply(params, emb, w, show, click,
-                                         segments, **kwargs)
-                else:
-                    logits = model.apply(params, emb, w, segments, **kwargs)
+            def loss_fn(params, emb_alls, w_alls):
+                logits = forward(params, pulled, segments, dense_feats,
+                                 emb_alls=emb_alls, w_alls=w_alls)
                 # Exact global logloss: local sum / global valid count.
                 bce = optax.sigmoid_binary_cross_entropy(logits, labels1)
                 total_valid = lax.psum(jnp.sum(validf), axis)
@@ -155,42 +232,228 @@ class CTRTrainer:
 
             grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1, 2),
                                          has_aux=True)
-            (loss, logits), (g_params, g_emb, g_w) = grad_fn(
-                params, pulled["emb"], pulled["w"])
+            (loss, logits), (g_params, g_embs, g_ws) = grad_fn(
+                params, tuple(p["emb"] for p in pulled),
+                tuple(p["w"] for p in pulled))
 
-            # Dense sync: grads already carry the global 1/N via the global
-            # denominator — psum completes the cross-replica reduction
-            # (role of SyncParam / c_allreduce_sum).
-            g_params = lax.psum(g_params, axis)
-            updates, opt_state = optimizer.update(g_params, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            # Dense sync (see TrainerConfig.dense_sync_mode).
+            if mode == "step":
+                # Grads already carry the global 1/N via the global
+                # denominator — psum completes the cross-replica
+                # reduction (role of SyncParam / c_allreduce_sum).
+                g_params = lax.psum(g_params, axis)
+                updates, opt_state = optimizer.update(g_params, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+            elif mode == "kstep":
+                # Local step with the unbiased full-grad estimate
+                # (local grad x world size, since the loss denominator is
+                # global); params pmean'd when sync_flag fires.
+                g_local = jax.tree.map(lambda g: g * float(ndev), g_params)
+                updates, opt_state = optimizer.update(g_local, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+                params = lax.cond(
+                    sync_flag > 0,
+                    lambda p: jax.tree.map(
+                        lambda x: lax.pmean(x, axis), p),
+                    lambda p: p, params)
+            else:  # async: host table applies the update
+                g_params = lax.psum(g_params, axis)
 
-            # Sparse push: show=1 per occurrence, click=its row's label
-            # (role of feature show/click stats in PushSparseGrad).
-            seg_all = jnp.concatenate([segments[n] for n in names])
-            occ_valid = (seg_all < bs_local).astype(jnp.float32)
-            clicks = jnp.where(seg_all < bs_local,
-                               labels1[jnp.minimum(seg_all, bs_local - 1)],
-                               0.0) * occ_valid
-            table = push_local(table, rows, g_emb, g_w, occ_valid, clicks,
-                               axis=axis, opt=sparse_opt)
+            # Sparse push per group: show=1 per occurrence, click=its
+            # row's label (role of show/click stats in PushSparseGrad).
+            new_tables = []
+            for gi, slots in enumerate(group_slots):
+                seg_g = jnp.concatenate([segments[n] for n in slots])
+                occ_valid = (seg_g < bs_local).astype(jnp.float32)
+                clicks = jnp.where(
+                    seg_g < bs_local,
+                    labels1[jnp.minimum(seg_g, bs_local - 1)],
+                    0.0) * occ_valid
+                new_tables.append(push_local(
+                    tables[gi], rows[gi], g_embs[gi], g_ws[gi], occ_valid,
+                    clicks, axis=axis, opt=sparse_opt))
 
             probs = jax.nn.sigmoid(logits)
             auc = auc_accumulate(auc, probs, labels1, valid, axis=axis)
             loss_global = lax.psum(loss, axis)
-            return table, params, opt_state, auc, loss_global
+            # Dropped-lookup observability: bucket-overflow ids degraded
+            # to zero-embedding pulls and dropped grads this step, summed
+            # over devices and width groups.
+            overflow_global = lax.psum(
+                sum(p["overflow"][0] for p in pulled), axis)
+            out = (tuple(new_tables), params, opt_state, auc, loss_global,
+                   overflow_global)
+            if mode == "async":
+                out = out + (g_params,)
+            return out
 
         if self.mesh is not None:
+            # P(axis) on the tables/rows tuples is a pytree PREFIX spec:
+            # every leaf of every group shards its leading dim over axis.
+            out_specs = (P(axis), P(), P(), P(), P(), P())
+            if mode == "async":
+                out_specs = out_specs + (P(),)
             body_sm = jax.shard_map(
                 body, mesh=self.mesh,
                 in_specs=(P(axis), P(), P(), P(), P(axis), P(axis), P(axis),
-                          P(axis), P(axis)),
-                out_specs=(P(axis), P(), P(), P(), P()),
+                          P(axis), P(axis), P()),
+                out_specs=out_specs,
                 check_vma=False)
         else:
             raise RuntimeError("CTRTrainer requires a mesh (1-device is a "
                                "1-axis mesh)")
         return jax.jit(body_sm, donate_argnums=(0, 1, 2, 3))
+
+    def _build_eval_step(self):
+        """Read-only twin of the train step: pull + forward + AUC, no
+        pushes, no param updates (role of the AUC-runner test mode,
+        box_wrapper.h:900-989 / SetTestMode)."""
+        axis = self.axis
+        group_slots, group_sl = self._group_layout()
+        forward = self._make_forward(group_slots, group_sl)
+
+        def body(tables, params, auc, rows, segments, labels, valid,
+                 dense_feats):
+            pulled = [pull_local(t, r, axis=axis)
+                      for t, r in zip(tables, rows)]
+            logits = forward(params, pulled, segments, dense_feats)
+            labels1 = labels[:, 0]
+            validf = valid.astype(jnp.float32)
+            bce = optax.sigmoid_binary_cross_entropy(logits, labels1)
+            total_valid = lax.psum(jnp.sum(validf), axis)
+            loss = lax.psum(
+                jnp.sum(bce * validf) / jnp.maximum(total_valid, 1.0),
+                axis)
+            auc = auc_accumulate(auc, jax.nn.sigmoid(logits), labels1,
+                                 valid, axis=axis)
+            return auc, loss
+
+        body_sm = jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(self.axis), P(), P(), P(self.axis), P(self.axis),
+                      P(self.axis), P(self.axis), P(self.axis)),
+            out_specs=(P(), P()),
+            check_vma=False)
+        return jax.jit(body_sm, donate_argnums=(2,))
+
+    def eval_pass(self, dataset: Dataset, *, feed_keys: bool = True
+                  ) -> Dict[str, float]:
+        """Evaluate one pass: AUC/loss only — the store is left exactly
+        as-is (no write-back, no new keys persisted, nothing dirtied)."""
+        if self.params is None:
+            raise RuntimeError("call init() first")
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_step()
+        eng = self.engine
+        if feed_keys:
+            eng.feed_pass([dataset.pass_keys(slots=g.slots)
+                           for g in eng.groups])
+        tables = eng.begin_pass()
+        auc = auc_state_init(self.config.auc_num_buckets)
+        if self.mesh is not None:
+            auc = jax.device_put(auc, NamedSharding(self.mesh, P()))
+        losses: List[jax.Array] = []
+        nsteps = 0
+        try:
+            for args in self._prefetch_batches(dataset):
+                rows, segs, labels, valid, dense = args
+                auc, loss = self._eval_fn(tables, self.params, auc, rows,
+                                          segs, labels, valid, dense)
+                losses.append(loss)
+                nsteps += 1
+        finally:
+            eng.abort_pass()
+        stats = auc_compute(auc)
+        stats["loss"] = (float(jnp.mean(jnp.stack(losses)))
+                         if losses else float("nan"))
+        stats["steps"] = nsteps
+        return stats
+
+    def _sync_params_fn(self):
+        """Jitted cross-replica param average for k-step pass boundaries."""
+        if self._sync_params_cache is None:
+            axis = self.axis
+
+            @jax.jit
+            @functools.partial(
+                jax.shard_map, mesh=self.mesh, in_specs=P(),
+                out_specs=P(), check_vma=False)
+            def sync(params):
+                return jax.tree.map(lambda x: lax.pmean(x, axis), params)
+
+            self._sync_params_cache = sync
+        return self._sync_params_cache
+
+    def _prefetch_batches(self, dataset: Dataset):
+        """Producer thread packs + host-maps batch k+1 while batch k's
+        device step executes (role of the reference's pipelined batch
+        packing + preload threads, MiniBatchGpuPack data_feed.cc:4611,
+        PreLoadIntoMemory box_wrapper.h:1140). The host work (numpy pack,
+        native keymap lookup — both GIL-releasing) runs concurrently with
+        the asynchronously-dispatched device computation; a small bounded
+        queue keeps the device fed without unbounded host memory."""
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(
+            maxsize=max(1, int(flags.flag("trainer_prefetch_depth"))))
+        _DONE = object()
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for batch in dataset.batches_sharded(self.ndev):
+                    with self.timers.scope("host_map"):
+                        args = (self._map_batch_rows(batch),
+                                {n: jnp.asarray(batch.segments[n])
+                                 for n in self._slot_names},
+                                jnp.asarray(batch.labels),
+                                jnp.asarray(batch.valid),
+                                _concat_dense(batch))
+                    if not _put(args):
+                        return  # consumer bailed early
+            except BaseException as e:
+                _put(e)
+                return
+            _put(_DONE)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # Unblock the producer if we exited early (error mid-pass).
+            stop.set()
+            t.join(timeout=60.0)
+
+    def _map_batch_rows(self, batch: SlotBatch) -> Tuple[jax.Array, ...]:
+        """Host map: batch feasigns → per-width-group fused device-row
+        arrays (role of CopyKeys' host side, one array per dim group)."""
+        rows = []
+        for gi, g in enumerate(self.engine.groups):
+            all_ids = np.concatenate([batch.ids[n] for n in g.slots])
+            r = self.engine.lookup_rows(gi, all_ids)
+            # Interleave per-device: [dev, slot, cap_local] flatten.
+            rows.append(jnp.asarray(_interleave_slots(
+                r, list(g.slots), self._slot_caps, self.ndev)))
+        return tuple(rows)
 
     # -- pass loop ---------------------------------------------------------
 
@@ -205,29 +468,39 @@ class CTRTrainer:
         eng = self.engine
         if feed_keys:
             with self.timers.scope("feed_pass"):
-                eng.feed_pass(dataset.pass_keys())
-        table = eng.begin_pass()
+                eng.feed_pass([dataset.pass_keys(slots=g.slots)
+                               for g in eng.groups])
+        tables = eng.begin_pass()
         params, opt_state = self.params, self.opt_state
         auc = self.auc_state
-        bs = self.feed_config.batch_size
+        mode = self.config.dense_sync_mode
+        k = max(1, self.config.dense_sync_interval)
+        if mode == "async" and self._async_dense is None:
+            from paddlebox_tpu.train.async_dense import AsyncDenseTable
+            self._async_dense = AsyncDenseTable(
+                jax.device_get(params),
+                learning_rate=self.config.dense_learning_rate)
+        rep = (NamedSharding(self.mesh, P())
+               if self.mesh is not None else None)
         losses: List[float] = []
+        overflows: List[jax.Array] = []
         nsteps = 0
-        for batch in dataset.batches_sharded(self.ndev):
-            with self.timers.scope("host_map"):
-                all_ids = np.concatenate(
-                    [batch.ids[n] for n in self._slot_names])
-                rows = eng.lookup_rows(all_ids)
-                # Interleave per-device: [dev, slot, cap_local] flatten.
-                rows = _interleave_slots(rows, self._slot_names,
-                                         self._slot_caps, self.ndev)
-                segs = {n: jnp.asarray(batch.segments[n])
-                        for n in self._slot_names}
-                dense = _concat_dense(batch)
+        for args in self._prefetch_batches(dataset):
+            rows, segs, labels, valid, dense = args
+            if mode == "async":
+                # PullDense role: freshest host params each step.
+                params = jax.device_put(self._async_dense.pull_dense(), rep)
+            sync_flag = jnp.asarray(
+                1 if (mode == "kstep" and (nsteps + 1) % k == 0) else 0,
+                jnp.int32)
             with self.timers.scope("device_step"):
-                table, params, opt_state, auc, loss = self._step_fn(
-                    table, params, opt_state, auc, jnp.asarray(rows), segs,
-                    jnp.asarray(batch.labels), jnp.asarray(batch.valid),
-                    dense)
+                out = self._step_fn(
+                    tables, params, opt_state, auc, rows, segs,
+                    labels, valid, dense, sync_flag)
+                tables, params, opt_state, auc, loss, overflow = out[:6]
+            if mode == "async":
+                # PushDense role: hand psum'd grads to the host updater.
+                self._async_dense.push_dense(jax.device_get(out[6]))
             nsteps += 1
             if self.config.check_nan_inf or flags.flag("check_nan_inf"):
                 lf = float(loss)
@@ -235,13 +508,32 @@ class CTRTrainer:
                     raise FloatingPointError(
                         f"NaN/Inf loss at step {nsteps}")
             losses.append(loss)
-        eng.update_table(table)
+            overflows.append(overflow)
+        if mode == "kstep" and nsteps % k != 0:
+            # Pass boundary: leave params synchronized regardless of
+            # where the last sync fell (the reference's pass-end
+            # SyncParam does the same).
+            params = self._sync_params_fn()(params)
+        if mode == "async":
+            self._async_dense.flush()
+            params = jax.device_put(self._async_dense.pull_dense(), rep)
+        eng.update_tables(tables)
         self.params, self.opt_state, self.auc_state = params, opt_state, auc
         with self.timers.scope("end_pass"):
             eng.end_pass()
         stats = auc_compute(self.auc_state)
         stats["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
         stats["steps"] = nsteps
+        stats["lookup_overflow"] = (
+            int(jnp.sum(jnp.stack(overflows))) if overflows else 0)
+        if stats["lookup_overflow"]:
+            from paddlebox_tpu.core import monitor
+            monitor.add("embedding/lookup_overflow",
+                        stats["lookup_overflow"])
+            log.warning("pass had %d overflowed sparse lookups (dropped "
+                        "pull+grad) — raise FLAGS_embedding_shard_slack "
+                        "if the key distribution is skewed",
+                        stats["lookup_overflow"])
         log.vlog(0, "pass done: steps=%d loss=%.5f auc=%.5f (%s)",
                  nsteps, stats["loss"], stats["auc"], self.timers.report())
         return stats

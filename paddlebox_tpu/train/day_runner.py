@@ -41,6 +41,7 @@ class DayRunner:
                                                 List[str]]] = None,
                  min_show_shrink: float = 0.0,
                  save_xbox: bool = False,
+                 pipeline_passes: bool = True,
                  is_rank0: bool = True):
         self.trainer = trainer
         self.feed_config = feed_config
@@ -54,6 +55,11 @@ class DayRunner:
         self.filelist_fn = filelist_fn or self._default_filelist
         self.min_show_shrink = min_show_shrink
         self.save_xbox = save_xbox  # serving export per pass (xbox role)
+        # Overlap pass k+1's data load + table build with pass k's
+        # training (role of PreLoadIntoMemory/WaitFeedPassDone,
+        # box_wrapper.h:1140,1161, and the double-buffered build threads,
+        # ps_gpu_wrapper.cc:907).
+        self.pipeline_passes = pipeline_passes
         self.is_rank0 = is_rank0
         self.timers = timers.TimerGroup()
 
@@ -90,24 +96,59 @@ class DayRunner:
 
     # -- day loop ----------------------------------------------------------
 
-    def train_pass(self, day: str, pass_id: int,
-                   files: List[str]) -> Dict[str, float]:
-        """One online pass: load → shuffle → train → delta checkpoint."""
+    def _load_dataset(self, day: str, pass_id: int,
+                      files: List[str]) -> Dataset:
+        ds = Dataset(self.feed_config,
+                     num_reader_threads=self.num_reader_threads)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        if self.shuffle:
+            # Deterministic digest — hash(str) is randomized per
+            # process, which would make recovery replays and per-rank
+            # batch orders irreproducible.
+            import zlib
+            ds.local_shuffle(seed=zlib.crc32(f"{day}:{pass_id}".encode()))
+        return ds
+
+    def _feed_keys(self, ds: Dataset, *, async_build: bool) -> None:
+        eng = self.trainer.engine
+        eng.feed_pass([ds.pass_keys(slots=g.slots) for g in eng.groups],
+                      async_build=async_build)
+
+    def _start_preload(self, day: str, pass_id: int, files: List[str]):
+        """Background: load pass k+1's data and kick its table build while
+        pass k trains. feed_pass blocks until pass k's begin_pass frees
+        the pending slot, and the build's store pull is internally
+        sequenced after pass k's end_pass write-back (split pull: only
+        the shared-key intersection waits)."""
+        import threading
+
+        out = {"ds": None, "error": None}
+
+        def body():
+            try:
+                out["ds"] = self._load_dataset(day, pass_id, files)
+                self._feed_keys(out["ds"], async_build=True)
+            except BaseException as e:
+                out["error"] = e
+
+        t = threading.Thread(target=body, daemon=True)
+        t.start()
+        out["thread"] = t
+        return out
+
+    def train_pass(self, day: str, pass_id: int, files: List[str], *,
+                   dataset: Optional[Dataset] = None,
+                   feed_keys: bool = True) -> Dict[str, float]:
+        """One online pass: load → shuffle → train → delta checkpoint.
+        ``dataset``/``feed_keys`` let the pipelined day loop hand in a
+        preloaded dataset whose table build is already in flight."""
         with self.timers.scope("load"):
-            ds = Dataset(self.feed_config,
-                         num_reader_threads=self.num_reader_threads)
-            ds.set_filelist(files)
-            ds.load_into_memory()
-            if self.shuffle:
-                # Deterministic digest — hash(str) is randomized per
-                # process, which would make recovery replays and per-rank
-                # batch orders irreproducible.
-                import zlib
-                ds.local_shuffle(
-                    seed=zlib.crc32(f"{day}:{pass_id}".encode()))
+            ds = dataset if dataset is not None else self._load_dataset(
+                day, pass_id, files)
         self.trainer.reset_metrics()
         with self.timers.scope("train"):
-            stats = self.trainer.train_pass(ds)
+            stats = self.trainer.train_pass(ds, feed_keys=feed_keys)
         if self.is_rank0:
             # Only rank 0 writes model files — N ranks racing
             # savez on one shared path would corrupt the npz.
@@ -133,6 +174,7 @@ class DayRunner:
         write_model_donefile)."""
         all_stats = []
         resumed_past = 0  # passes skipped because recovery already holds them
+        jobs: List = []
         for pass_id, splits in enumerate(self.pass_splits, start=1):
             files = self.filelist_fn(day, splits)
             if pass_id < start_pass:
@@ -142,7 +184,38 @@ class DayRunner:
                 log.warning("day %s pass %d: no files for splits %s, "
                             "skipping", day, pass_id, splits)
                 continue
-            all_stats.append(self.train_pass(day, pass_id, files))
+            jobs.append((pass_id, files))
+
+        preloaded = None
+        try:
+            for i, (pass_id, files) in enumerate(jobs):
+                if preloaded is not None:
+                    preloaded["thread"].join()
+                    if preloaded["error"] is not None:
+                        raise preloaded["error"]
+                    ds, feed_keys = preloaded["ds"], False
+                elif self.pipeline_passes:
+                    # First pass of the day: load + feed here so training
+                    # can begin while the NEXT pass preloads.
+                    ds = self._load_dataset(day, pass_id, files)
+                    self._feed_keys(ds, async_build=False)
+                    feed_keys = False
+                else:
+                    ds, feed_keys = None, True
+                preloaded = None
+                if self.pipeline_passes and i + 1 < len(jobs):
+                    preloaded = self._start_preload(day, *jobs[i + 1])
+                all_stats.append(self.train_pass(day, pass_id, files,
+                                                 dataset=ds,
+                                                 feed_keys=feed_keys))
+        except BaseException:
+            # A failed pass must not leave the NEXT pass's in-flight
+            # preload occupying the engine's pending slot — a retry
+            # would consume the orphaned (wrong-pass) table/keymap.
+            if preloaded is not None:
+                preloaded["thread"].join()
+            self.trainer.engine.cancel_pending()
+            raise
         if not all_stats and not resumed_past:
             # A day that trained nothing (data outage) must not decay the
             # model or publish a base marking the day done — the data may

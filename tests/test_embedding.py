@@ -273,3 +273,53 @@ def test_save_delta_refuses_after_shrink(tmp_path):
         store.save_delta(str(tmp_path / "d"))
     store.save_base(str(tmp_path / "b2"))
     store.save_delta(str(tmp_path / "d"))  # ok again after new base
+
+
+def test_overflow_counter_on_skewed_keys(devices8):
+    """Adversarial skew: every batch id targets ONE shard, overflowing its
+    fixed-capacity bucket. The overflow counter must surface exactly the
+    dropped lookups (which degrade to zeros) instead of failing silently
+    — the accuracy contract of FLAGS_embedding_shard_slack."""
+    from paddlebox_tpu.embedding.lookup import bucket_capacity
+
+    n_keys, n_ids, nshards = 256, 64, 8
+    vals = _host_values(n_keys, DIM)
+    keys = np.arange(1, n_keys + 1, dtype=np.uint64)
+    table = build_pass_table_host(vals, nshards, CFG)
+    mesh = build_mesh(HybridTopology(dp=nshards), devices8)
+    pull = make_pull_fn(mesh, "dp")
+
+    # All ids hit key rank 0 -> shard 0's bucket on every device.
+    batch_keys = np.full((n_ids * nshards,), 1, np.uint64)
+    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
+                            num_shards=nshards)
+    out = pull(table, jnp.asarray(rows))
+
+    cap = bucket_capacity(n_ids, nshards)
+    expected_drop_per_dev = max(0, n_ids - cap)
+    assert expected_drop_per_dev > 0, "test needs actual overflow"
+    overflow = np.asarray(out["overflow"])
+    assert overflow.shape == (nshards,)
+    assert overflow.sum() == expected_drop_per_dev * nshards
+    # Dropped lookups return zeros; the in-capacity prefix returns the row.
+    per_dev_emb = np.asarray(out["emb"]).reshape(nshards, n_ids, DIM)
+    n_zero = (np.abs(per_dev_emb).sum(-1) == 0).sum(axis=1)
+    assert (n_zero == expected_drop_per_dev).all()
+
+
+def test_no_overflow_under_uniform_keys(devices8):
+    """Uniformly-hashed ids stay within capacity (the 4-sigma headroom
+    contract) — counter reads zero."""
+    n_keys, n_ids, nshards = 1024, 256, 8
+    vals = _host_values(n_keys, DIM)
+    keys = np.sort(np.random.default_rng(3).choice(
+        np.arange(1, 1 << 20, dtype=np.uint64), n_keys, replace=False))
+    table = build_pass_table_host(vals, nshards, CFG)
+    mesh = build_mesh(HybridTopology(dp=nshards), devices8)
+    pull = make_pull_fn(mesh, "dp")
+    rng = np.random.default_rng(4)
+    batch_keys = rng.choice(keys, n_ids * nshards).astype(np.uint64)
+    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
+                            num_shards=nshards)
+    out = pull(table, jnp.asarray(rows))
+    assert np.asarray(out["overflow"]).sum() == 0
