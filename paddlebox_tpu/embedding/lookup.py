@@ -3,17 +3,27 @@
 Role of the HeterComm data path (``heter_comm_inl.h``):
 - pull: ``split_input_to_shard`` → ``walk_to_dest`` → per-shard table get →
   ``walk_to_src`` (heter_comm_inl.h:1628; NVLink-staged P2P in the
-  reference) → here one XLA ``all_to_all`` pair over the ICI mesh axis.
-- push: ``dynamic_merge_grad`` (cub sort + segment-reduce dedup,
-  heter_comm.h:69) → shard scatter → ``update_one_table`` fused optimizer
-  → here an on-owner sort + segment-sum exact merge + masked scatter
-  update, donation-friendly.
+  reference) → here one XLA ``all_to_all`` pair over the ICI mesh axis,
+  serving ONE contiguous slice ``vals[:, :D+3]`` of the fused record.
+- push: ``dynamic_merge_grad`` + ``update_one_table`` (cub sort +
+  segment-reduce dedup then in-kernel optimizer, heter_comm.h:69,150) →
+  here ONE scatter-add of the grad payload into a per-shard accumulator
+  followed by a DENSE vectorized optimizer sweep over the local table
+  block. Mathematically identical to dedup-then-update — the accumulator
+  carries the per-row gradient SUM and the sweep applies the nonlinear
+  optimizer once per touched row — but it lowers to one scatter plus
+  streaming elementwise work instead of 3 sorts + 6 gathers + 6 scatters
+  (XLA TPU scatter costs ~7 ns/element plus ~5 ms fixed per op; the r02
+  layout paid that 6x per step — see tools/profile_step.py).
 
 Everything is static-shape: per-destination buckets have fixed capacity
 ``C = ceil(n/num_shards * slack)`` (slack flag ``embedding_shard_slack``);
 overflow entries fall into the per-shard trash row. All functions are
 *per-device* bodies meant to run inside ``jax.shard_map`` with the table's
 leading dim sharded over ``axis`` and id/grad batches sharded likewise.
+With ``num_shards == 1`` (single-chip or replicated-table configs) the
+bucketing + all_to_all pair is skipped entirely — pull is one gather and
+push is one scatter-add + sweep.
 """
 
 from __future__ import annotations
@@ -79,7 +89,7 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
                ) -> Dict[str, jax.Array]:
     """Per-device pull: ids [n] (device-row space) → {emb [n, D], w [n],
     show [n], click [n], overflow []}. Padding/overflow ids yield the
-    trash row (zeros unless polluted — push re-zeroes it).
+    trash row (zeros unless polluted — push keeps it zeroed).
 
     ``overflow`` counts THIS device's real (non-trash) ids that fell past
     their destination bucket's static capacity and degraded to a dropped
@@ -90,9 +100,24 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
     materially, which is exactly what this counter surfaces (contrast:
     the reference's HeterComm never drops, heter_comm_inl.h:273 — it
     re-walks; we trade bounded drop odds for static shapes and expose
-    the count)."""
+    the count). Single shard: one sliced gather, no collective, no
+    possible overflow.
+    """
     num_shards = table.num_shards
     block = table.rows_per_shard + 1
+    d = table.dim
+    pw = table.pull_width
+
+    if num_shards == 1:
+        picked = table.vals[dev_rows, :pw]
+        return {
+            "emb": picked[:, :d],
+            "w": picked[:, d],
+            "show": picked[:, d + 1],
+            "click": picked[:, d + 2],
+            "overflow": jnp.zeros((1,), jnp.int32),
+        }
+
     n = dev_rows.shape[0]
     cap = bucket_capacity(n, num_shards)
     trash = block - 1
@@ -108,19 +133,14 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
     # Exchange requests: recv_req[s, c] = row requested by peer s.
     recv_req = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0,
                               tiled=True).reshape(num_shards, cap)
-    # Serve from the local shard block: one fused [emb | w | show | click]
-    # payload so the reply path is a single collective.
-    d = table.dim
-    served = jnp.concatenate([
-        table.emb[recv_req],                  # [S, C, D]
-        table.w[recv_req][..., None],
-        table.show[recv_req][..., None],
-        table.click[recv_req][..., None],
-    ], axis=-1)                               # [S, C, D+3]
+    # Serve from the local shard block: the fused record's pull payload
+    # [emb | w | show | click] is one contiguous slice, so the reply path
+    # is a single gather + a single collective.
+    served = table.vals[recv_req, :pw]          # [S, C, D+3]
     reply = lax.all_to_all(
-        served.reshape(num_shards * cap, d + 3), axis,
+        served.reshape(num_shards * cap, pw), axis,
         split_axis=0, concat_axis=0, tiled=True
-    ).reshape(num_shards, cap, d + 3)
+    ).reshape(num_shards, cap, pw)
     # Route replies back: reply[s, c] = value from shard s for my bucket c.
     unorder = jnp.argsort(order)
     in_cap = slot_pos < cap
@@ -135,10 +155,53 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
     }
 
 
+def apply_accumulated(vals: jax.Array, acc: jax.Array, *, dim: int,
+                      ke: int, block: int,
+                      opt: SparseOptimizer) -> jax.Array:
+    """Dense optimizer sweep: apply per-row accumulated grads to the fused
+    local table block (role of update_one_table's in-kernel optimizer,
+    heter_comm.h:150 / optimizer.cuh.h:31).
+
+    ``vals [m, W]`` fused records; ``acc [m, D+4]`` accumulated
+    [g_emb(D) | g_w | show | click | count]. Rows with count == 0 are
+    untouched (their state — incl. adam beta-pows — must not advance);
+    trash rows (local index block-1 of each shard block) keep zero value
+    columns regardless.
+    """
+    m = vals.shape[0]
+    g_emb = acc[:, :dim]
+    g_w = acc[:, dim]
+    touched = acc[:, dim + 3] > 0
+
+    emb = vals[:, :dim]
+    w = vals[:, dim]
+    show = vals[:, dim + 1]
+    click = vals[:, dim + 2]
+    emb_state = vals[:, dim + 3:dim + 3 + ke]
+    w_state = vals[:, dim + 3 + ke:]
+
+    new_emb, new_emb_st = opt.update_vector(emb, emb_state, g_emb)
+    new_w, new_w_st = opt.update_scalar(w, w_state, g_w)
+    new_show = show + acc[:, dim + 1]
+    new_click = click + acc[:, dim + 2]
+
+    new_vals = jnp.concatenate([
+        new_emb, new_w[:, None], new_show[:, None], new_click[:, None],
+        new_emb_st, new_w_st], axis=1)
+    out = jnp.where(touched[:, None], new_vals, vals)
+    # Trash rows: padding/overflow grads land here; keep the PULL columns
+    # zeroed so padding pulls keep returning zeros (optimizer state on the
+    # trash row may drift — it is never read).
+    is_trash = (jnp.arange(m) % block) == (block - 1)
+    zero_pull = jnp.concatenate(
+        [jnp.zeros((m, dim + 3), out.dtype), out[:, dim + 3:]], axis=1)
+    return jnp.where(is_trash[:, None], zero_pull, out)
+
+
 def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
                grad_w: jax.Array, shows: jax.Array, clicks: jax.Array, *,
                axis: str, opt: Optional[SparseOptimizer] = None) -> PassTable:
-    """Per-device push: exact dedup + fused sparse optimizer update.
+    """Per-device push: scatter-accumulate + dense fused optimizer sweep.
 
     dev_rows [n]; grad_emb [n, D]; grad_w/shows/clicks [n]. Padding entries
     must carry zero grads (guaranteed upstream because padding ids map to
@@ -148,28 +211,37 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
         opt = SparseAdagrad()
     ke = opt.emb_state_width(table.dim)
     kw = opt.w_state_width()
-    if table.emb_state.shape[-1] != ke or table.w_state.shape[-1] != kw:
+    if table.ke != ke or table.kw != kw:
         raise ValueError(
             f"optimizer {type(opt).__name__} expects state widths "
-            f"({ke}, {kw}) but table carries "
-            f"({table.emb_state.shape[-1]}, {table.w_state.shape[-1]}) — "
+            f"({ke}, {kw}) but table carries ({table.ke}, {table.kw}) — "
             f"push opt must match the TableConfig.optimizer the table was "
             f"built with")
     num_shards = table.num_shards
     block = table.rows_per_shard + 1
     n = dev_rows.shape[0]
     d = table.dim
-    cap = bucket_capacity(n, num_shards)
-    trash = block - 1
+    aw = d + 4  # accumulator width: [g_emb | g_w | show | click | count]
 
+    # Payload per id: grads + stats + a count of 1 (the count column marks
+    # the row as touched; filler bucket cells carry 0 everywhere).
+    payload = jnp.concatenate([
+        grad_emb, grad_w[:, None], shows[:, None], clicks[:, None],
+        jnp.ones((n, 1), grad_emb.dtype)], axis=-1)
+
+    if num_shards == 1:
+        acc = jnp.zeros((block, aw), payload.dtype)
+        acc = acc.at[dev_rows].add(payload)
+        new_vals = apply_accumulated(table.vals, acc, dim=d, ke=ke,
+                                     block=block, opt=opt)
+        return PassTable(vals=new_vals, rows_per_shard=table.rows_per_shard,
+                         num_shards=1, dim=d, ke=ke, kw=kw)
+
+    cap = bucket_capacity(n, num_shards)
     send_rows, order, slot_shard, slot_pos = _bucket_by_shard(
         dev_rows, num_shards, block, cap)
-
-    # Payload per bucket cell: [grad_emb D | grad_w | show | click].
-    payload = jnp.concatenate([
-        grad_emb, grad_w[:, None], shows[:, None], clicks[:, None]], axis=-1)
     sorted_payload = payload[order]
-    send_payload = jnp.zeros((num_shards, cap, d + 3), payload.dtype)
+    send_payload = jnp.zeros((num_shards, cap, aw), payload.dtype)
     # Out-of-range positions (overflow) are dropped by the scatter.
     send_payload = send_payload.at[slot_shard, slot_pos].add(
         sorted_payload, mode="drop")
@@ -177,58 +249,18 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
     recv_rows = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0,
                                tiled=True).reshape(num_shards * cap)
     recv_payload = lax.all_to_all(
-        send_payload.reshape(num_shards * cap, d + 3), axis,
+        send_payload.reshape(num_shards * cap, aw), axis,
         split_axis=0, concat_axis=0, tiled=True
-    ).reshape(num_shards * cap, d + 3)
+    ).reshape(num_shards * cap, aw)
 
-    # --- owner-side exact merge (role of dynamic_merge_grad) -------------
-    m = num_shards * cap
-    row_order = jnp.argsort(recv_rows)
-    rows_s = recv_rows[row_order]
-    pay_s = recv_payload[row_order]
-    is_start = jnp.concatenate(
-        [jnp.ones((1,), bool), rows_s[1:] != rows_s[:-1]])
-    seg_ids = jnp.cumsum(is_start) - 1
-    merged = jax.ops.segment_sum(pay_s, seg_ids, num_segments=m)  # [m, d+3]
-    merged_per_elem = merged[seg_ids]
-    rep = is_start & (rows_s != trash)  # one update per real row
-
-    g_emb = merged_per_elem[:, :d]
-    g_w = merged_per_elem[:, d]
-    g_show = merged_per_elem[:, d + 1]
-    g_click = merged_per_elem[:, d + 2]
-
-    # Gather current state at touched rows, apply optimizer, write deltas.
-    cur_emb = table.emb[rows_s]
-    cur_emb_st = table.emb_state[rows_s]
-    cur_w = table.w[rows_s]
-    cur_w_st = table.w_state[rows_s]
-
-    new_emb, new_emb_st = opt.update_vector(cur_emb, cur_emb_st, g_emb)
-    new_w, new_w_st = opt.update_scalar(cur_w, cur_w_st, g_w)
-
-    repf = rep.astype(table.emb.dtype)
-    emb = table.emb.at[rows_s].add(repf[:, None] * (new_emb - cur_emb))
-    emb_st = table.emb_state.at[rows_s].add(
-        repf[:, None] * (new_emb_st - cur_emb_st))
-    w = table.w.at[rows_s].add(repf * (new_w - cur_w))
-    w_st = table.w_state.at[rows_s].add(
-        repf[:, None] * (new_w_st - cur_w_st))
-    show = table.show.at[rows_s].add(repf * g_show)
-    click = table.click.at[rows_s].add(repf * g_click)
-
-    # Re-zero the trash row so padding pulls keep returning zeros (the
-    # optimizer state keeps its init there; only value rows must be 0).
-    zero_rows = jnp.arange(1) + trash
-    emb = emb.at[zero_rows].set(0.0)
-    w = w.at[zero_rows].set(0.0)
-    show = show.at[zero_rows].set(0.0)
-    click = click.at[zero_rows].set(0.0)
-
-    return PassTable(emb=emb, emb_state=emb_st, w=w, w_state=w_st,
-                     show=show, click=click,
-                     rows_per_shard=table.rows_per_shard,
-                     num_shards=table.num_shards)
+    # Owner-side accumulate (role of dynamic_merge_grad): filler cells
+    # point at the trash row with all-zero payload, so they are no-ops.
+    acc = jnp.zeros((block, aw), payload.dtype)
+    acc = acc.at[recv_rows].add(recv_payload)
+    new_vals = apply_accumulated(table.vals, acc, dim=d, ke=ke,
+                                 block=block, opt=opt)
+    return PassTable(vals=new_vals, rows_per_shard=table.rows_per_shard,
+                     num_shards=num_shards, dim=d, ke=ke, kw=kw)
 
 
 # ---------------------------------------------------------------------------

@@ -6,11 +6,12 @@ passes: ``MemorySparseTable`` / ``SSDSparseTable``
 and the BoxPS SSD→mem staging (``LoadSSD2Mem``, ``box_wrapper.h:635``),
 plus base/delta model save (``SaveBase/SaveDelta``, ``box_wrapper.h:628``).
 
-TPU-first: no RPC server — the store is a vectorized sorted-key columnar
-structure in host RAM (keys ascending; one numpy row per feature), accessed
-only at pass boundaries (build / write-back), so throughput is dominated by
-``np.searchsorted`` + fancy-indexing, both memory-bandwidth-bound C loops.
-A future C++ shard can register the same interface.
+TPU-first: no RPC server — the store is a sorted-key columnar structure in
+host RAM (keys ascending; one numpy row per feature), accessed only at
+pass boundaries (build / write-back). The hot loops (locate, row
+gather/scatter, sorted merge, per-key init) run through the native store
+engine (``native/store.cc``, role of the reference's C++ PreBuildTask/
+BuildPull walk, ps_gpu_wrapper.cc:114,362) with exact numpy fallbacks.
 """
 
 from __future__ import annotations
@@ -25,6 +26,7 @@ import numpy as np
 
 from paddlebox_tpu.core import log, monitor
 from paddlebox_tpu.embedding.table import TableConfig
+from paddlebox_tpu.native import store_py as native_store
 
 _FIELDS = ("emb", "emb_state", "w", "w_state", "show", "click")
 
@@ -70,8 +72,10 @@ class FeatureStore:
         }
         self._seed = np.uint64(seed)
         self._lock = threading.Lock()
-        # Keys touched since the last save_base (delta set).
-        self._dirty = np.empty((0,), np.uint64)
+        # Keys touched since the last save_base (delta set). Kept as a
+        # list of per-push arrays, compacted lazily — a sorted union per
+        # push was an O(N log N) tax on every pass write-back.
+        self._dirty_parts: list = []
         # shrink() decays every row and may evict — states a delta cannot
         # express. Until the next save_base, save_delta must refuse.
         self._shrunk_since_base = False
@@ -86,12 +90,16 @@ class FeatureStore:
     def _locate(self, k: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """(found mask, clipped positions) of keys k in the sorted store.
         Caller must hold the lock."""
-        m = self._keys.shape[0]
-        if m == 0:
-            return np.zeros(k.shape[0], bool), np.zeros(k.shape[0], np.int64)
-        pos = np.searchsorted(self._keys, k)
-        pos_c = np.minimum(pos, m - 1)
-        return self._keys[pos_c] == k, pos_c
+        return native_store.ss_locate(self._keys, k)
+
+    def _dirty_compact(self) -> np.ndarray:
+        """Sorted unique dirty keys; caller must hold the lock."""
+        if len(self._dirty_parts) > 1:
+            from paddlebox_tpu.native.keymap_py import dedup_keys
+            self._dirty_parts = [dedup_keys(
+                np.concatenate(self._dirty_parts))]
+        return (self._dirty_parts[0] if self._dirty_parts
+                else np.empty((0,), np.uint64))
 
     def contains(self, keys: np.ndarray) -> np.ndarray:
         """Membership mask for keys (any order)."""
@@ -118,15 +126,16 @@ class FeatureStore:
                 self._vals[f] = self._vals[f][keep]
             # Popped keys leave the delta set — they are no longer present
             # in RAM and the tiered wrapper snapshots disk separately.
-            if self._dirty.size:
-                self._dirty = np.setdiff1d(self._dirty, out_keys,
-                                           assume_unique=True)
+            dirty = self._dirty_compact()
+            if dirty.size:
+                self._dirty_parts = [np.setdiff1d(dirty, out_keys,
+                                                  assume_unique=True)]
         return out_keys, out_vals
 
     def dirty_keys(self) -> np.ndarray:
         """Keys touched since the last save_base (the delta set)."""
         with self._lock:
-            return self._dirty.copy()
+            return self._dirty_compact().copy()
 
     def rows_by_coldness(self) -> np.ndarray:
         """Keys sorted by ascending show (coldest first) for eviction."""
@@ -166,11 +175,12 @@ class FeatureStore:
             # of pull order, split-pull overlap chunking, or which rank
             # asks — required for reproducible pipelined builds and for
             # replica stores to agree without communication.
-            out["emb"][:] = _per_key_uniform(k, d, self._seed,
-                                             self.config.init_scale)
+            out["emb"][:] = native_store.init_uniform(
+                k, d, int(self._seed), self.config.init_scale)
             if found.any():
                 for f in _FIELDS:
-                    out[f][found] = self._vals[f][pos_c[found]]
+                    native_store.gather_rows(self._vals[f], pos_c,
+                                             mask=found, out=out[f])
         monitor.add("store/pass_keys", n)
         monitor.add("store/new_keys", int(n - found.sum()) if n else 0)
         return out
@@ -187,7 +197,8 @@ class FeatureStore:
             found, pos_c = self._locate(k)
             # Update existing rows in place.
             for f in _FIELDS:
-                self._vals[f][pos_c[found]] = values[f][found]
+                native_store.scatter_rows(self._vals[f], pos_c, values[f],
+                                          mask=found)
             # Merge new rows LINEARLY (two sorted runs -> O(N + n) scatter;
             # a concat + argsort here would cost O((N+n) log(N+n)) on
             # every pass write-back, the scaling wall the reference's
@@ -196,24 +207,21 @@ class FeatureStore:
             if new_mask.any():
                 new_k = k[new_mask]           # sorted (subset of sorted k)
                 n_old = self._keys.shape[0]
-                n_new = new_k.shape[0]
-                # Destination index of each old / new element in the merge.
-                ins = np.searchsorted(self._keys, new_k)
-                dst_new = ins + np.arange(n_new)
-                merged_keys = np.empty(n_old + n_new, np.uint64)
-                merged_keys[dst_new] = new_k
-                is_new = np.zeros(n_old + n_new, bool)
-                is_new[dst_new] = True
+                merged_keys, src = native_store.merge_sorted(
+                    self._keys, new_k)
+                is_new = src >= n_old
+                dst_new = np.flatnonzero(is_new)
                 old_pos = np.flatnonzero(~is_new)
-                merged_keys[old_pos] = self._keys
                 self._keys = merged_keys
                 for f in _FIELDS:
-                    shape = (n_old + n_new,) + self._vals[f].shape[1:]
+                    shape = (merged_keys.shape[0],) + self._vals[f].shape[1:]
                     merged = np.empty(shape, self._vals[f].dtype)
-                    merged[dst_new] = values[f][new_mask]
-                    merged[old_pos] = self._vals[f]
+                    native_store.scatter_rows(merged, dst_new,
+                                              values[f][new_mask])
+                    native_store.scatter_rows(merged, old_pos,
+                                              self._vals[f])
                     self._vals[f] = merged
-            self._dirty = np.union1d(self._dirty, k)
+            self._dirty_parts.append(k.copy())
 
     # -- lifecycle maintenance --------------------------------------------
 
@@ -259,7 +267,7 @@ class FeatureStore:
         with self._lock:
             keys = self._keys.copy()
             vals = {f: self._vals[f].copy() for f in _FIELDS}
-            self._dirty = np.empty((0,), np.uint64)
+            self._dirty_parts = []
             self._shrunk_since_base = False
         self._save_arrays(path, keys, vals, "base")
         log.vlog(0, "save_base: %d features -> %s", keys.shape[0], path)
@@ -273,7 +281,7 @@ class FeatureStore:
                     "save_delta after shrink(): decay/eviction cannot be "
                     "expressed as a delta — save_base first (the reference's "
                     "day boundary does the same: shrink, then base dump)")
-            dirty = self._dirty.copy()
+            dirty = self._dirty_compact().copy()
             present, pos = self._locate(dirty)
             dirty = dirty[present]
             vals = {f: self._vals[f][pos[present]] for f in _FIELDS}
@@ -314,7 +322,7 @@ class FeatureStore:
         with self._lock:
             self._keys = np.ascontiguousarray(keys_sorted, np.uint64)
             self._vals = {f: np.asarray(vals[f]) for f in _FIELDS}
-            self._dirty = np.empty((0,), np.uint64)
+            self._dirty_parts = []
             self._shrunk_since_base = False
 
     def load(self, path: str, kind: str = "base") -> None:

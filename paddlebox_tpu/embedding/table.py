@@ -1,4 +1,4 @@
-"""Per-pass device table: contiguous sharded arrays + index math.
+"""Per-pass device table: ONE fused sharded value array + index math.
 
 Role of the HeterPS HBM structures: the per-GPU hashtable + mem_pool value
 slabs (``heter_ps/hashtable.h``, ``mem_pool.h``) and the
@@ -11,11 +11,22 @@ split contiguously across shards. Each shard carries one extra trash row
 (index ``rows_per_shard``) that absorbs padding lookups and padding grads,
 so every kernel is mask-free and static-shape.
 
+All per-row fields live in ONE ``[rows, W]`` float32 array (the
+CommonFeatureValue packing) so the hot path is a single gather per pull and
+a single scatter per push — XLA scatter/gather on TPU pays a fixed cost
+per *op*, and the r02 six-arrays layout paid it six times per step
+(measured: ~50 ms per 426K-row scatter; see tools/profile_step.py).
+
+Column layout (D = emb dim, Ke/Kw = optimizer state widths):
+
+    [ emb(D) | w | show | click | emb_state(Ke) | w_state(Kw) ]
+      `--------- pull payload = [:, :D+3] (one contiguous slice) ---'
+
 Index math (device-side, int32):
   global row g of key k  = rank of k in the sorted pass key set (host)
   shard(g)               = g // rows_per_shard
   row_in_shard(g)        = g %  rows_per_shard
-  padding sentinel       = N_pad (maps to trash row of shard 0)
+  padding sentinel       = trash row of shard (i % S)
 """
 
 from __future__ import annotations
@@ -59,51 +70,80 @@ class TableConfig:
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class PassTable:
-    """Device-resident per-pass table (a pytree of sharded arrays).
+    """Device-resident per-pass table (a one-leaf pytree).
 
-    Shapes (S = num_shards, R = rows_per_shard real rows, +1 trash row):
-      emb       [S*(R+1), D]   mf embedding
-      emb_state [S*(R+1), Ke]  optimizer state for emb (layout per optimizer:
-                               adagrad [g2sum]; adam [m1,m2,b1pow,b2pow] —
-                               the CommonFeatureValue packing,
-                               feature_value.h:44 / optimizer.cuh.h:306)
-      w         [S*(R+1)]      scalar LR weight (wide term)
-      w_state   [S*(R+1), Kw]
-      show      [S*(R+1)]      impression count
-      click     [S*(R+1)]      click count
-
-    Stored flat with shard s owning rows [s*(R+1), (s+1)*(R+1)); when used
-    under shard_map the leading dim is sharded over the table axis so each
-    device holds exactly its own [(R+1), ...] block.
+    ``vals [S*(R+1), W]`` — fused per-row record (module docstring layout);
+    shard s owns rows [s*(R+1), (s+1)*(R+1)), the last row of each shard
+    block being its trash row. Under shard_map the leading dim is sharded
+    over the table axis so each device holds exactly its [(R+1), W] block.
     """
 
-    emb: jax.Array
-    emb_state: jax.Array
-    w: jax.Array
-    w_state: jax.Array
-    show: jax.Array
-    click: jax.Array
+    vals: jax.Array
     rows_per_shard: int            # real rows (excludes trash row)
     num_shards: int
+    dim: int
+    ke: int                        # emb_state width
+    kw: int                        # w_state width
 
     def tree_flatten(self):
-        leaves = (self.emb, self.emb_state, self.w, self.w_state,
-                  self.show, self.click)
-        return leaves, (self.rows_per_shard, self.num_shards)
+        return (self.vals,), (self.rows_per_shard, self.num_shards,
+                              self.dim, self.ke, self.kw)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        rows_per_shard, num_shards = aux
-        return cls(*leaves, rows_per_shard=rows_per_shard,
-                   num_shards=num_shards)
+        rows_per_shard, num_shards, dim, ke, kw = aux
+        return cls(leaves[0], rows_per_shard=rows_per_shard,
+                   num_shards=num_shards, dim=dim, ke=ke, kw=kw)
+
+    # -- column views (read-only slices of the fused record) ---------------
+
+    @property
+    def pull_width(self) -> int:
+        return self.dim + 3
+
+    @property
+    def width(self) -> int:
+        return self.dim + 3 + self.ke + self.kw
+
+    @property
+    def emb(self) -> jax.Array:
+        return self.vals[:, :self.dim]
+
+    @property
+    def w(self) -> jax.Array:
+        return self.vals[:, self.dim]
+
+    @property
+    def show(self) -> jax.Array:
+        return self.vals[:, self.dim + 1]
+
+    @property
+    def click(self) -> jax.Array:
+        return self.vals[:, self.dim + 2]
+
+    @property
+    def emb_state(self) -> jax.Array:
+        return self.vals[:, self.dim + 3:self.dim + 3 + self.ke]
+
+    @property
+    def w_state(self) -> jax.Array:
+        return self.vals[:, self.dim + 3 + self.ke:]
 
     @property
     def num_rows_padded(self) -> int:
         return self.num_shards * (self.rows_per_shard + 1)
 
-    @property
-    def dim(self) -> int:
-        return int(self.emb.shape[-1])
+    def with_emb(self, emb: jax.Array) -> "PassTable":
+        """Copy with the emb columns replaced (test/tooling helper)."""
+        return dataclasses.replace(
+            self, vals=self.vals.at[:, :self.dim].set(emb))
+
+
+def table_widths(config: TableConfig) -> Tuple[int, int, int]:
+    """(dim, ke, kw) for a config's optimizer."""
+    from paddlebox_tpu.embedding.optimizers import make_sparse_optimizer
+    opt = make_sparse_optimizer(config)
+    return config.dim, opt.emb_state_width(config.dim), opt.w_state_width()
 
 
 def plan_shards(num_keys: int, num_shards: int,
@@ -126,66 +166,80 @@ def plan_shards(num_keys: int, num_shards: int,
     return rps
 
 
+def fuse_values_host(values: Dict[str, np.ndarray]) -> np.ndarray:
+    """Pack the store's per-field host arrays into the fused [n, W] record
+    (column layout per module docstring)."""
+    n = values["emb"].shape[0]
+    cols = [values["emb"],
+            values["w"].reshape(n, 1),
+            values["show"].reshape(n, 1),
+            values["click"].reshape(n, 1),
+            values["emb_state"],
+            values["w_state"]]
+    return np.concatenate([np.asarray(c, np.float32) for c in cols], axis=1)
+
+
+def split_values_host(fused: np.ndarray, dim: int, ke: int, kw: int
+                      ) -> Dict[str, np.ndarray]:
+    """Inverse of fuse_values_host."""
+    return {
+        "emb": fused[:, :dim].copy(),
+        "w": fused[:, dim].copy(),
+        "show": fused[:, dim + 1].copy(),
+        "click": fused[:, dim + 2].copy(),
+        "emb_state": fused[:, dim + 3:dim + 3 + ke].copy(),
+        "w_state": fused[:, dim + 3 + ke:dim + 3 + ke + kw].copy(),
+    }
+
+
+def lay_fused_host(fused: np.ndarray, num_shards: int, rps: int
+                   ) -> np.ndarray:
+    """[n, W] sorted-rank rows → shard-contiguous [S*(rps+1), W] with a
+    zeroed trash row per shard (role of BuildGPUTask filling HBM mem-pool
+    records, ps_gpu_wrapper.cc:684)."""
+    n, w = fused.shape
+    out = np.zeros((num_shards, rps + 1, w), np.float32)
+    for s in range(num_shards):
+        lo, hi = s * rps, min((s + 1) * rps, n)
+        if lo < hi:
+            out[s, :hi - lo] = fused[lo:hi]
+    return out.reshape(num_shards * (rps + 1), w)
+
+
+def unlay_fused_host(laid: np.ndarray, num_shards: int, rps: int,
+                     num_keys: int) -> np.ndarray:
+    """Inverse of lay_fused_host: strip trash rows, first num_keys rows."""
+    a = laid.reshape(num_shards, rps + 1, laid.shape[-1])[:, :rps]
+    return a.reshape(num_shards * rps, laid.shape[-1])[:num_keys]
+
+
 def build_pass_table_host(values: Dict[str, np.ndarray], num_shards: int,
                           config: TableConfig) -> PassTable:
     """Assemble a PassTable from host arrays produced by the FeatureStore.
 
     ``values`` carries per-key arrays in sorted-key order: emb [N, D],
-    emb_state [N, Ke], w [N], w_state [N, Kw], show [N], click [N]. Rows are laid
-    out shard-contiguously with a zeroed trash row appended per shard
-    (role of BuildGPUTask filling HBM mem-pool records,
-    ps_gpu_wrapper.cc:684).
+    emb_state [N, Ke], w [N], w_state [N, Kw], show [N], click [N]. One
+    fused host pack + ONE H2D transfer (vs six in the r02 layout — the
+    axon tunnel makes every separate transfer expensive).
     """
+    dim, ke, kw = table_widths(config)
     n = values["emb"].shape[0]
     rps = plan_shards(n, num_shards)
-    d = config.dim
-
-    def lay(flat: np.ndarray, width: Optional[int]) -> np.ndarray:
-        shape = (num_shards, rps + 1) + ((width,) if width else ())
-        out = np.zeros(shape, flat.dtype)
-        src = flat.reshape((n,) + ((width,) if width else ()))
-        for s in range(num_shards):
-            lo, hi = s * rps, min((s + 1) * rps, n)
-            if lo < hi:
-                out[s, :hi - lo] = src[lo:hi]
-        return out.reshape((num_shards * (rps + 1),) +
-                           ((width,) if width else ()))
-
+    fused = fuse_values_host(values)
     return PassTable(
-        emb=jnp.asarray(lay(values["emb"], d)),
-        emb_state=jnp.asarray(lay(values["emb_state"],
-                                  values["emb_state"].shape[1])),
-        w=jnp.asarray(lay(values["w"], None)),
-        w_state=jnp.asarray(lay(values["w_state"],
-                                values["w_state"].shape[1])),
-        show=jnp.asarray(lay(values["show"], None)),
-        click=jnp.asarray(lay(values["click"], None)),
-        rows_per_shard=rps,
-        num_shards=num_shards,
-    )
+        vals=jnp.asarray(lay_fused_host(fused, num_shards, rps)),
+        rows_per_shard=rps, num_shards=num_shards, dim=dim, ke=ke, kw=kw)
 
 
-def extract_pass_values_host(table: PassTable, num_keys: int) -> Dict[str, np.ndarray]:
-    """Inverse of build_pass_table_host: strip trash rows, return sorted-key
-    order host arrays (role of EndPass dumping dirty HBM values back to the
-    CPU table, ps_gpu_wrapper.cc:983)."""
-    rps = table.rows_per_shard
-    s = table.num_shards
-
-    def unlay(arr: jax.Array) -> np.ndarray:
-        a = np.asarray(arr)
-        a = a.reshape((s, rps + 1) + a.shape[1:])[:, :rps]  # drop trash rows
-        a = a.reshape((s * rps,) + a.shape[2:])
-        return a[:num_keys]
-
-    return {
-        "emb": unlay(table.emb),
-        "emb_state": unlay(table.emb_state),
-        "w": unlay(table.w),
-        "w_state": unlay(table.w_state),
-        "show": unlay(table.show),
-        "click": unlay(table.click),
-    }
+def extract_pass_values_host(table: PassTable, num_keys: int
+                             ) -> Dict[str, np.ndarray]:
+    """Inverse of build_pass_table_host: ONE D2H transfer, strip trash
+    rows, return sorted-key order host arrays (role of EndPass dumping
+    dirty HBM values back to the CPU table, ps_gpu_wrapper.cc:983)."""
+    laid = np.asarray(table.vals)
+    fused = unlay_fused_host(laid, table.num_shards, table.rows_per_shard,
+                             num_keys)
+    return split_values_host(fused, table.dim, table.ke, table.kw)
 
 
 def map_keys_to_rows(pass_keys_sorted: np.ndarray, batch_keys: np.ndarray,

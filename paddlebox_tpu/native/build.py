@@ -12,7 +12,7 @@ from typing import Optional
 from paddlebox_tpu.core import log
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["parser.cc", "keymap.cc"]
+_SOURCES = ["parser.cc", "keymap.cc", "store.cc"]
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _failed = False
@@ -107,6 +107,52 @@ def load_library() -> Optional[ctypes.CDLL]:
                                        ctypes.POINTER(ctypes.c_uint64)]
         lib.pbx_dedup_free.restype = None
         lib.pbx_dedup_free.argtypes = [ctypes.c_void_p]
+        # store.cc — incremental index
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.pbx_index_new.restype = ctypes.c_void_p
+        lib.pbx_index_new.argtypes = []
+        lib.pbx_index_size.restype = ctypes.c_int64
+        lib.pbx_index_size.argtypes = [ctypes.c_void_p]
+        lib.pbx_index_reserve.restype = None
+        lib.pbx_index_reserve.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pbx_index_lookup.restype = None
+        lib.pbx_index_lookup.argtypes = [ctypes.c_void_p, u64p,
+                                         ctypes.c_int64, i64p]
+        lib.pbx_index_upsert.restype = ctypes.c_int64
+        lib.pbx_index_upsert.argtypes = [ctypes.c_void_p, u64p,
+                                         ctypes.c_int64, i64p]
+        lib.pbx_index_keys_fill.restype = None
+        lib.pbx_index_keys_fill.argtypes = [ctypes.c_void_p, u64p]
+        lib.pbx_index_free.restype = None
+        lib.pbx_index_free.argtypes = [ctypes.c_void_p]
+        # store.cc — sorted-store primitives
+        lib.pbx_ss_locate.restype = None
+        lib.pbx_ss_locate.argtypes = [u64p, ctypes.c_int64, u64p,
+                                      ctypes.c_int64, i64p, u8p]
+        lib.pbx_gather_rows.restype = None
+        lib.pbx_gather_rows.argtypes = [f32p, i64p, ctypes.c_int64,
+                                        ctypes.c_int64, f32p]
+        lib.pbx_scatter_rows.restype = None
+        lib.pbx_scatter_rows.argtypes = [f32p, i64p, ctypes.c_int64,
+                                         ctypes.c_int64, f32p]
+        lib.pbx_gather_rows_masked.restype = None
+        lib.pbx_gather_rows_masked.argtypes = [f32p, i64p, u8p,
+                                               ctypes.c_int64,
+                                               ctypes.c_int64, f32p]
+        lib.pbx_scatter_rows_masked.restype = None
+        lib.pbx_scatter_rows_masked.argtypes = [f32p, i64p, u8p,
+                                                ctypes.c_int64,
+                                                ctypes.c_int64, f32p]
+        lib.pbx_merge_sorted.restype = None
+        lib.pbx_merge_sorted.argtypes = [u64p, ctypes.c_int64, u64p,
+                                         ctypes.c_int64, u64p, i64p]
+        lib.pbx_init_uniform.restype = None
+        lib.pbx_init_uniform.argtypes = [u64p, ctypes.c_int64,
+                                         ctypes.c_int64, ctypes.c_uint64,
+                                         ctypes.c_double, f32p]
         _lib = lib
         return _lib
 

@@ -1,0 +1,265 @@
+"""ctypes wrappers for the native store engine (native/store.cc).
+
+Two surfaces:
+
+- :class:`KeyIndex` — incremental key→row hash index (host half of the
+  device-resident feature store, embedding/device_store.py). Rows are
+  assigned in first-insertion order and never move.
+- Module functions ``ss_locate`` / ``gather_rows`` / ``scatter_rows`` /
+  ``merge_sorted`` / ``init_uniform`` — threaded primitives for the
+  host-RAM store tier (embedding/store.py hot loops; role of the
+  reference's multithreaded PreBuildTask/BuildPull walk,
+  ps_gpu_wrapper.cc:114,362). Each has an exact numpy fallback when the
+  native library is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.native.build import load_library
+
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_f32p = ctypes.POINTER(ctypes.c_float)
+
+
+def _p(a: np.ndarray, t):
+    return a.ctypes.data_as(t)
+
+
+class KeyIndex:
+    """Incremental key → row index. Not internally synchronized — callers
+    serialize mutating calls (the pass lifecycle already does)."""
+
+    def __init__(self):
+        self._lib = load_library()
+        self._closed = False
+        if self._lib is not None:
+            self._h = self._lib.pbx_index_new()
+            self._fallback = None
+        else:
+            self._h = None
+            self._fallback = {}
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise RuntimeError("KeyIndex used after close()")
+
+    @property
+    def size(self) -> int:
+        self._check_open()
+        if self._h is not None:
+            return int(self._lib.pbx_index_size(self._h))
+        return len(self._fallback)
+
+    def reserve(self, n: int) -> None:
+        """Pre-size for ~n more keys (skips incremental rehash churn)."""
+        if self._h is not None:
+            self._lib.pbx_index_reserve(self._h, int(n))
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """rows [n] int64; -1 for absent (and for the 0 null feasign)."""
+        self._check_open()
+        k = np.ascontiguousarray(keys, np.uint64)
+        out = np.empty((k.size,), np.int64)
+        if self._h is not None:
+            if k.size:
+                self._lib.pbx_index_lookup(self._h, _p(k, _u64p), k.size,
+                                           _p(out, _i64p))
+            return out
+        fb = self._fallback
+        for i, kk in enumerate(k.tolist()):
+            out[i] = fb.get(kk, -1) if kk else -1
+        return out
+
+    def upsert(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
+        """(rows [n] int64, n_new). New keys get rows size.. in
+        first-appearance order; key 0 maps to -1 and is never inserted."""
+        self._check_open()
+        k = np.ascontiguousarray(keys, np.uint64)
+        out = np.empty((k.size,), np.int64)
+        if self._h is not None:
+            n_new = int(self._lib.pbx_index_upsert(self._h, _p(k, _u64p),
+                                                   k.size, _p(out, _i64p)))
+            return out, n_new
+        fb = self._fallback
+        n_new = 0
+        for i, kk in enumerate(k.tolist()):
+            if not kk:
+                out[i] = -1
+                continue
+            r = fb.get(kk)
+            if r is None:
+                r = len(fb)
+                fb[kk] = r
+                n_new += 1
+            out[i] = r
+        return out, n_new
+
+    def keys_by_row(self) -> np.ndarray:
+        """All keys, index = row (append order)."""
+        self._check_open()
+        n = self.size
+        out = np.empty((n,), np.uint64)
+        if self._h is not None:
+            if n:
+                self._lib.pbx_index_keys_fill(self._h, _p(out, _u64p))
+            return out
+        for kk, r in self._fallback.items():
+            out[r] = kk
+        return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._h is not None:
+            self._lib.pbx_index_free(self._h)
+            self._h = None
+        self._fallback = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def ss_locate(sorted_keys: np.ndarray, queries: np.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """(found mask [m] bool, clipped positions [m] int64) of queries in the
+    sorted array — threaded searchsorted (store.py _locate contract)."""
+    s = np.ascontiguousarray(sorted_keys, np.uint64)
+    q = np.ascontiguousarray(queries, np.uint64)
+    m, n = q.size, s.size
+    lib = load_library()
+    if lib is None or n == 0 or m == 0:
+        if n == 0:
+            return np.zeros(m, bool), np.zeros(m, np.int64)
+        pos = np.searchsorted(s, q)
+        pos_c = np.minimum(pos, n - 1)
+        return s[pos_c] == q, pos_c
+    pos = np.empty((m,), np.int64)
+    found = np.empty((m,), np.uint8)
+    lib.pbx_ss_locate(_p(s, _u64p), n, _p(q, _u64p), m, _p(pos, _i64p),
+                      _p(found, _u8p))
+    return found.astype(bool), pos
+
+
+def _rows2d(a: np.ndarray) -> Tuple[np.ndarray, int]:
+    """View any row-shaped array as [n, width] contiguous float32."""
+    v = np.ascontiguousarray(a, np.float32)
+    width = int(np.prod(v.shape[1:], dtype=np.int64)) if v.ndim > 1 else 1
+    return v.reshape(v.shape[0] if v.size else 0, max(width, 1)), width
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                mask: Optional[np.ndarray] = None,
+                out: Optional[np.ndarray] = None) -> np.ndarray:
+    """out[i] = src[idx[i]] (float32 rows), threaded; with ``mask`` only
+    masked rows are written (others left as-is in a provided ``out``, or
+    zero in a fresh one)."""
+    lib = load_library()
+    idx = np.ascontiguousarray(idx, np.int64)
+    src2, width = _rows2d(src)
+    if out is None:
+        alloc = np.zeros if mask is not None else np.empty
+        out = alloc((idx.size,) + src.shape[1:], np.float32)
+    elif out.dtype != np.float32:
+        raise ValueError("gather_rows: out must be float32")
+    if lib is None or idx.size == 0:
+        if idx.size:
+            if mask is None:
+                out[...] = src[idx]
+            else:
+                out[mask] = src[idx[mask]]
+        return out
+    out2 = out.reshape(idx.size, max(width, 1))
+    if not out2.flags.c_contiguous:
+        raise ValueError("gather_rows: out must be C-contiguous")
+    if mask is None:
+        lib.pbx_gather_rows(_p(src2, _f32p), _p(idx, _i64p), idx.size,
+                            width, _p(out2, _f32p))
+    else:
+        mk = np.ascontiguousarray(mask, np.uint8)
+        lib.pbx_gather_rows_masked(_p(src2, _f32p), _p(idx, _i64p),
+                                   _p(mk, _u8p), idx.size, width,
+                                   _p(out2, _f32p))
+    return out
+
+
+def scatter_rows(dst: np.ndarray, idx: np.ndarray, src: np.ndarray,
+                 mask: Optional[np.ndarray] = None) -> None:
+    """dst[idx[i]] = src[i] (float32 rows), threaded; idx duplicate-free
+    (duplicates would race). ``mask`` limits to masked rows."""
+    lib = load_library()
+    idx = np.ascontiguousarray(idx, np.int64)
+    if idx.size == 0:
+        return
+    if (lib is None or not dst.flags.c_contiguous
+            or dst.dtype != np.float32):
+        if mask is None:
+            dst[idx] = src
+        else:
+            dst[idx[mask]] = src[mask]
+        return
+    dst2, width = _rows2d(dst)
+    src2 = np.ascontiguousarray(src, np.float32).reshape(
+        idx.size, max(width, 1))
+    if mask is None:
+        lib.pbx_scatter_rows(_p(dst2, _f32p), _p(idx, _i64p), idx.size,
+                             width, _p(src2, _f32p))
+    else:
+        mk = np.ascontiguousarray(mask, np.uint8)
+        lib.pbx_scatter_rows_masked(_p(dst2, _f32p), _p(idx, _i64p),
+                                    _p(mk, _u8p), idx.size, width,
+                                    _p(src2, _f32p))
+
+
+def merge_sorted(old_keys: np.ndarray, add_keys: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Merge two sorted disjoint key arrays: returns (merged_keys [n+m],
+    src [n+m] int64) with src[i] < n meaning old row src[i], else add row
+    src[i]-n — one gather then materializes any merged value column."""
+    o = np.ascontiguousarray(old_keys, np.uint64)
+    a = np.ascontiguousarray(add_keys, np.uint64)
+    n, m = o.size, a.size
+    lib = load_library()
+    if lib is None:
+        ins = np.searchsorted(o, a)
+        dst_new = ins + np.arange(m)
+        merged = np.empty(n + m, np.uint64)
+        src = np.empty(n + m, np.int64)
+        is_new = np.zeros(n + m, bool)
+        is_new[dst_new] = True
+        merged[dst_new] = a
+        src[dst_new] = n + np.arange(m)
+        old_pos = np.flatnonzero(~is_new)
+        merged[old_pos] = o
+        src[old_pos] = np.arange(n)
+        return merged, src
+    merged = np.empty((n + m,), np.uint64)
+    src = np.empty((n + m,), np.int64)
+    lib.pbx_merge_sorted(_p(o, _u64p), n, _p(a, _u64p), m,
+                         _p(merged, _u64p), _p(src, _i64p))
+    return merged, src
+
+
+def init_uniform(keys: np.ndarray, dim: int, seed: int,
+                 scale: float) -> np.ndarray:
+    """[n, dim] deterministic per-key uniform(-scale, scale) init —
+    bit-exact twin of store.py _per_key_uniform."""
+    k = np.ascontiguousarray(keys, np.uint64)
+    lib = load_library()
+    if lib is None or k.size == 0:
+        from paddlebox_tpu.embedding.store import _per_key_uniform
+        return _per_key_uniform(k, dim, np.uint64(seed), scale)
+    out = np.empty((k.size, dim), np.float32)
+    lib.pbx_init_uniform(_p(k, _u64p), k.size, dim, seed, scale,
+                         _p(out, _f32p))
+    return out

@@ -1,0 +1,119 @@
+"""Native store engine (native/store.cc via native/store_py.py): the
+incremental key index + sorted-store primitives, vs their numpy twins.
+
+Role parity target: the reference's C++ PreBuildTask/BuildPull host loops
+(ps_gpu_wrapper.cc:114,362) — VERDICT r02 task 3 (store build throughput).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.embedding.store import _per_key_uniform
+from paddlebox_tpu.native import store_py as sp
+from paddlebox_tpu.native.build import native_available
+
+
+def test_key_index_upsert_lookup_order():
+    idx = sp.KeyIndex()
+    rows, n_new = idx.upsert(np.array([5, 3, 5, 0, 9], np.uint64))
+    assert rows.tolist() == [0, 1, 0, -1, 2]
+    assert n_new == 3 and idx.size == 3
+    # Existing keys keep their rows; new ones append in order.
+    rows2, n_new2 = idx.upsert(np.array([9, 7, 3], np.uint64))
+    assert rows2.tolist() == [2, 3, 1] and n_new2 == 1
+    assert idx.lookup(np.array([7, 8, 0], np.uint64)).tolist() == [3, -1, -1]
+    assert idx.keys_by_row().tolist() == [5, 3, 9, 7]
+    idx.close()
+    with pytest.raises(RuntimeError):
+        idx.lookup(np.array([5], np.uint64))
+
+
+def test_key_index_reserve_and_growth():
+    idx = sp.KeyIndex()
+    idx.reserve(300_000)
+    keys = np.random.default_rng(0).permutation(
+        np.arange(1, 300_001)).astype(np.uint64)
+    rows, n_new = idx.upsert(keys)
+    assert n_new == 300_000
+    assert (rows == np.arange(300_000)).all()
+    back = idx.lookup(keys[::7])
+    assert (back == rows[::7]).all()
+    assert (idx.keys_by_row() == keys).all()
+
+
+def test_ss_locate_matches_numpy():
+    rng = np.random.default_rng(1)
+    s = np.sort(rng.choice(np.arange(1, 100_000, dtype=np.uint64),
+                           10_000, replace=False))
+    q = rng.integers(0, 100_000, 5_000).astype(np.uint64)
+    f, p = sp.ss_locate(s, q)
+    pos = np.searchsorted(s, q)
+    pc = np.minimum(pos, s.size - 1)
+    assert (p == pc).all()
+    assert (f == (s[pc] == q)).all()
+    # empty store
+    f0, p0 = sp.ss_locate(np.empty((0,), np.uint64), q)
+    assert not f0.any()
+
+
+def test_merge_sorted_matches_fallback():
+    rng = np.random.default_rng(2)
+    old = np.sort(rng.choice(np.arange(1, 50_000, dtype=np.uint64),
+                             5_000, replace=False))
+    add = np.setdiff1d(
+        rng.integers(1, 50_000, 2_000).astype(np.uint64), old)
+    mk, src = sp.merge_sorted(old, add)
+    assert (mk == np.sort(np.concatenate([old, add]))).all()
+    allv = np.concatenate([old, add])
+    assert (allv[src] == mk).all()
+    # degenerate sides
+    mk2, src2 = sp.merge_sorted(old, np.empty((0,), np.uint64))
+    assert (mk2 == old).all() and (src2 == np.arange(old.size)).all()
+    mk3, src3 = sp.merge_sorted(np.empty((0,), np.uint64), add)
+    assert (mk3 == add).all() and (src3 == np.arange(add.size)).all()
+
+
+def test_gather_scatter_rows_masked():
+    rng = np.random.default_rng(3)
+    src = rng.normal(size=(500, 6)).astype(np.float32)
+    idx = rng.permutation(500)[:200].astype(np.int64)
+    mask = rng.random(200) < 0.7
+    out = sp.gather_rows(src, idx, mask=mask)
+    assert np.array_equal(out[mask], src[idx[mask]])
+    assert (out[~mask] == 0).all()  # fresh out zeros unmasked rows
+    dst = np.zeros((500, 6), np.float32)
+    sp.scatter_rows(dst, idx, out, mask=mask)
+    assert np.array_equal(dst[idx[mask]], src[idx[mask]])
+    # 1-D (scalar-per-row) fields
+    src1 = rng.normal(size=(500,)).astype(np.float32)
+    g1 = sp.gather_rows(src1, idx)
+    assert np.array_equal(g1, src1[idx])
+
+
+def test_init_uniform_bit_exact_twin():
+    keys = np.random.default_rng(4).integers(
+        1, 1 << 62, 1000).astype(np.uint64)
+    a = sp.init_uniform(keys, 8, 42, 0.01)
+    b = _per_key_uniform(keys, 8, np.uint64(42), 0.01)
+    assert np.array_equal(a, b)
+
+
+@pytest.mark.skipif(not native_available(), reason="native lib unavailable")
+def test_index_build_throughput():
+    """VERDICT r02 task 3 floor: native-grade store build. On the 1-core
+    bench host the prefetch-pipelined insert sustains >~4M keys/s; assert
+    a conservative 2M keys/s so slower CI hosts stay green while a
+    regression to the numpy-era 0.4M keys/s still fails."""
+    n = 10_000_000
+    keys = np.random.default_rng(5).permutation(
+        np.arange(1, n + 1)).astype(np.uint64)
+    idx = sp.KeyIndex()
+    idx.reserve(n)
+    t0 = time.perf_counter()
+    _, n_new = idx.upsert(keys)
+    dt = time.perf_counter() - t0
+    assert n_new == n
+    rate = n / dt
+    assert rate >= 2e6, f"index build {rate/1e6:.2f}M keys/s < 2M floor"

@@ -97,8 +97,7 @@ def test_split_pull_reads_writeback_for_shared_keys():
 
     # Mutate pass A's table (simulating training): bump every emb by 1.
     import jax.numpy as jnp
-    table = jax.tree_util.tree_map(lambda x: x, table)
-    table.emb = table.emb + 1.0
+    table = table.with_emb(table.emb + 1.0)
     eng.update_table(table)
 
     # Async-build pass B while A is still active: B shares keys 33..64

@@ -1,0 +1,166 @@
+"""Micro-profile of the CTR device step's components at bench shapes.
+
+VERDICT r02 task 2 asked for a recorded profile of the jitted step naming
+the dominant op. This measures each stage as its own jitted function at the
+exact bench shapes (4M-key x 16-dim table, 16384-sample batch, 26 slots),
+plus raw D2H/H2D bandwidth (the end_pass/feed_pass transfer path). Run on
+the bench chip:
+
+    python tools/profile_step.py
+
+Results recorded in PROFILE.md.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# Sync on a 4-byte slice of the result: forces completion of the dispatch
+# chain without transferring the (possibly hundreds of MB) result over the
+# axon tunnel (~15 MB/s), which would swamp the op being measured.
+_tiny = jax.jit(lambda x: lax.slice(x.ravel(), (0,), (1,)))
+
+
+def sync(r):
+    leaf = jax.tree_util.tree_leaves(r)[0]
+    return np.asarray(_tiny(leaf))
+
+
+def timeit(fn, *args, n=10, warmup=2):
+    for _ in range(warmup):
+        r = fn(*args)
+    sync(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn(*args)
+    sync(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    N_ROWS = 4 * 1024 * 1024        # pass table rows (pow2 bucket)
+    D = 16
+    BATCH = 16384
+    SLOTS = 26
+    n = BATCH * SLOTS               # ids per step = 425984
+
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.integers(0, N_ROWS, n), jnp.int32)
+    emb = jnp.asarray(rng.normal(size=(N_ROWS, D)), jnp.float32)
+    state = jnp.asarray(np.abs(rng.normal(size=(N_ROWS, D))), jnp.float32)
+    grads = jnp.asarray(rng.normal(size=(n, D)), jnp.float32)
+    payload = jnp.asarray(rng.normal(size=(n, D + 3)), jnp.float32)
+    fused = jnp.asarray(rng.normal(size=(N_ROWS, 2 * D + 8)), jnp.float32)
+    sync(fused)
+
+    print(f"shapes: table [{N_ROWS},{D}] ids [{n}]")
+
+    t = timeit(jax.jit(lambda r: jnp.argsort(r)), rows)
+    print(f"argsort[{n}]                 {t*1e3:8.2f} ms")
+
+    t = timeit(jax.jit(lambda r: jnp.sort(r)), rows)
+    print(f"sort[{n}]                    {t*1e3:8.2f} ms")
+
+    t = timeit(jax.jit(lambda e, r: e[r]), emb, rows)
+    print(f"gather [{n}x{D}]             {t*1e3:8.2f} ms")
+
+    t = timeit(jax.jit(lambda f, r: f[r]), fused, rows)
+    print(f"gather fused [{n}x{2*D+8}]   {t*1e3:8.2f} ms")
+
+    t = timeit(jax.jit(lambda e, r, g: e.at[r].add(g)), emb, rows, grads)
+    print(f"scatter-add [{n}x{D}]        {t*1e3:8.2f} ms")
+
+    sorted_rows = jnp.sort(rows)
+    t = timeit(jax.jit(lambda e, r, g: e.at[r].add(g)),
+               emb, sorted_rows, grads)
+    print(f"scatter-add sorted ids       {t*1e3:8.2f} ms")
+
+    t = timeit(jax.jit(
+        lambda e, r, g: e.at[r].add(g, unique_indices=True)),
+        emb, sorted_rows, grads)
+    print(f"scatter-add sorted+unique    {t*1e3:8.2f} ms")
+
+    donating = jax.jit(lambda e, r, g: e.at[r].add(g), donate_argnums=(0,))
+    e2 = jnp.array(emb)
+    t = timeit(donating, e2, rows, grads, n=1, warmup=0)
+    print(f"scatter-add donated (1x)     {t*1e3:8.2f} ms")
+
+    # segment_sum path (the merge): ids -> full table-sized accumulator
+    t = timeit(jax.jit(lambda p, r: jax.ops.segment_sum(
+        p, r, num_segments=N_ROWS)), payload, rows)
+    print(f"segment_sum->table [{n}]     {t*1e3:8.2f} ms")
+
+    # segment_sum into a small (batch-sized) accumulator after sort-rank
+    t = timeit(jax.jit(lambda p, r: jax.ops.segment_sum(
+        p, r % n, num_segments=n)), payload, rows)
+    print(f"segment_sum->batch [{n}]     {t*1e3:8.2f} ms")
+
+    # dense optimizer sweep over full table (adagrad-style)
+    @jax.jit
+    def dense_update(e, s, acc):
+        g = acc[:, :D]
+        s2 = s + g * g
+        return e - 0.05 * g * lax.rsqrt(s2 + 1e-8), s2
+    acc = jnp.zeros((N_ROWS, D), jnp.float32)
+    t = timeit(dense_update, emb, state, acc)
+    print(f"dense adagrad sweep [{N_ROWS}x{D}]  {t*1e3:8.2f} ms")
+
+    # one-hot matmul alternative for the pull (gather as matmul)? At
+    # 426K x 4M that is infeasible; skip.
+
+    # the MLP fwd+bwd at bench size, f32 and bf16
+    dims = [SLOTS * D + 13, 400, 400, 400, 1]
+    for dt_ in (jnp.float32, jnp.bfloat16):
+        ws = [jnp.asarray(rng.normal(size=(a, b)) * 0.05, dt_)
+              for a, b in zip(dims[:-1], dims[1:])]
+        x = jnp.asarray(rng.normal(size=(BATCH, dims[0])), dt_)
+        y = jnp.asarray(rng.random(BATCH) < 0.3, jnp.float32)
+
+        def loss_fn(ws, x, y):
+            h = x
+            for w in ws[:-1]:
+                h = jax.nn.relu(h @ w)
+            logit = (h @ ws[-1])[:, 0].astype(jnp.float32)
+            p = jax.nn.sigmoid(logit)
+            return -jnp.mean(y * jnp.log(p + 1e-7)
+                             + (1 - y) * jnp.log(1 - p + 1e-7))
+        t = timeit(jax.jit(jax.grad(loss_fn)), ws, x, y)
+        print(f"MLP fwd+bwd {dt_.__name__} [{BATCH}]    {t*1e3:8.2f} ms")
+
+    # AUC histogram accumulate
+    probs = jnp.asarray(rng.random(BATCH), jnp.float32)
+    labels = jnp.asarray(rng.random(BATCH) < 0.3, jnp.float32)
+    NB = 1 << 16
+
+    @jax.jit
+    def auc_acc(hist, probs, labels):
+        b = jnp.clip((probs * NB).astype(jnp.int32), 0, NB - 1)
+        idx = b + (labels.astype(jnp.int32)) * NB
+        return hist.at[idx].add(1.0)
+    hist = jnp.zeros((2 * NB,), jnp.float32)
+    t = timeit(auc_acc, hist, probs, labels)
+    print(f"AUC hist scatter [{BATCH}]   {t*1e3:8.2f} ms")
+
+    # D2H bandwidth at end_pass sizes (np.asarray = the write-back path)
+    for arr in (emb, jnp.asarray(rng.normal(size=(N_ROWS,)), jnp.float32)):
+        sync(arr)
+        t0 = time.perf_counter()
+        h = np.asarray(arr)
+        dt = time.perf_counter() - t0
+        print(f"D2H {h.nbytes/1e6:7.1f} MB          {dt*1e3:8.2f} ms "
+              f"({h.nbytes/dt/1e9:.3f} GB/s)")
+
+    # H2D bandwidth (feed_pass path): device_put + 4-byte readback
+    h = np.asarray(emb)
+    t0 = time.perf_counter()
+    d = jax.device_put(h)
+    sync(d)
+    dt = time.perf_counter() - t0
+    print(f"H2D {h.nbytes/1e6:7.1f} MB          {dt*1e3:8.2f} ms "
+          f"({h.nbytes/dt/1e9:.3f} GB/s)")
+
+
+if __name__ == "__main__":
+    main()
