@@ -208,6 +208,10 @@ define_flag("padbox_max_shuffle_wait_count", 16,
 define_flag("dense_sync_steps", 1,
             "k-step dense parameter sync interval in BoxPS-style training "
             "(role of BoxPSWorker::SyncParam sync_step)")
+define_flag("wuauc_spill_records", 4_000_000,
+            "per-user-AUC raw records held in RAM before spilling to "
+            "uid-hash bucket files on disk (bounds eval-pass host memory; "
+            "role of the WuAucMetricMsg shuffle/sort spill)")
 define_flag("auc_num_buckets", 1 << 20,
             "prediction histogram buckets for exact distributed AUC "
             "(role of BasicAucCalculator _table size, metrics.cc:33)")

@@ -12,9 +12,11 @@ service — per-pass tables live in TPU HBM (``embedding/``). The PS is the
 host control/persistence plane: the between-pass backing store for
 multi-host CTR jobs (pass build pulls, EndPass pushes back — role of
 ``BuildPull``/``EndPass``, ``ps_gpu_wrapper.cc:362,983``), plus dense
-param distribution for async CPU setups. Protocol is length-prefixed
-pickled messages over TCP (stdlib-only stand-in for brpc; the message
-framing mirrors ``transport.TcpTransport``).
+param distribution for async CPU setups. Protocol: versioned typed
+frames over TCP (``distributed/wire.py`` — struct header + numpy
+buffers; no pickle on the socket; stdlib stand-in for brpc). Trusted
+cluster network only — frames are validated, not authenticated (same
+stance as the reference's brpc fabric).
 
 Key sharding is client-side ``key % num_servers`` (exactly the reference's
 ``key % num_devices`` shard rule, ``heter_comm.h:332``), so any number of
@@ -23,30 +25,26 @@ clients agree on placement without a directory service.
 
 from __future__ import annotations
 
-import pickle
 import socket
-import struct
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.distributed import wire
 from paddlebox_tpu.distributed.transport import _recv_exact
 from paddlebox_tpu.embedding.store import FeatureStore
 from paddlebox_tpu.embedding.table import TableConfig
 
-_HDR = struct.Struct("<q")
-
 
 def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+    sock.sendall(wire.pack_frame(obj))
 
 
 def _recv_msg(sock: socket.socket):
-    (ln,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, ln))
+    ln = wire.read_frame_header(_recv_exact(sock, wire.HEADER.size))
+    return wire.loads(_recv_exact(sock, ln))
 
 
 class DenseTable:
@@ -135,6 +133,13 @@ class PSServer:
                         # connections drain until their clients close).
                         self.stop()
                         return
+        except wire.WireError as e:
+            # Protocol violation (malformed/mismatched frame): drop the
+            # connection — resynchronizing a corrupt byte stream is not
+            # possible with length-prefixed framing.
+            log.warning("ps[%d] dropping connection on wire error: %s",
+                        self.index, e)
+            return
         except (ConnectionError, OSError, EOFError):
             return
 

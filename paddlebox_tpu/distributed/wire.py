@@ -1,0 +1,219 @@
+"""Typed wire protocol for the PS service (no pickle on the socket).
+
+Role of the brpc PS message layer (``ps/service/brpc_ps_server.h:40``,
+``sendrecv.proto``): a versioned, length-prefixed frame whose payload is a
+TYPED tree of scalars / strings / numpy buffers — deserialization can
+construct only these types, unlike pickle (which executes arbitrary
+reduce callables from the peer and is unacceptable even one hop past
+localhost).
+
+Frame layout (little-endian):
+
+    magic   2s   b"PB"
+    version u8   WIRE_VERSION — mismatch is rejected, not guessed at
+    flags   u8   reserved (0)
+    length  u64  payload byte length (bounded by MAX_PAYLOAD)
+
+Payload: one value, tag-prefixed; containers recurse.
+
+    0x00 None
+    0x01 bool      u8
+    0x02 int       i64
+    0x03 float     f64
+    0x04 str       u32 len + utf-8
+    0x05 bytes     u64 len + raw
+    0x06 ndarray   u8 dtype-code, u8 ndim, ndim*u64 shape, raw buffer
+    0x07 dict      u32 count + (str key, value)*
+    0x08 list      u32 count + value*
+
+SECURITY SCOPE: the protocol authenticates nothing — it is for a trusted
+cluster network (same stance as the reference's brpc PS, which runs on
+the job's private fabric). It is robust against malformed and truncated
+frames (every length is bounds-checked; unknown tags/dtypes/versions
+raise :class:`WireError`), not against an active adversary.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+WIRE_VERSION = 1
+MAX_PAYLOAD = 1 << 34          # 16 GiB frame cap
+_MAGIC = b"PB"
+HEADER = struct.Struct("<2sBBQ")
+
+# dtype allowlist (code <-> dtype); anything else is rejected.
+_DTYPES = (np.dtype(np.float32), np.dtype(np.float64),
+           np.dtype(np.int32), np.dtype(np.int64),
+           np.dtype(np.uint8), np.dtype(np.uint32),
+           np.dtype(np.uint64), np.dtype(np.bool_),
+           np.dtype(np.int8), np.dtype(np.uint16), np.dtype(np.int16),
+           np.dtype(np.float16))
+_DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
+
+_MAX_NDIM = 16
+_MAX_CONTAINER = 1 << 24       # sanity cap on dict/list entries
+
+
+class WireError(ValueError):
+    """Malformed, truncated, oversized, or version-mismatched frame."""
+
+
+def _enc_value(out: List[bytes], v: Any) -> None:
+    if v is None:
+        out.append(b"\x00")
+    elif isinstance(v, bool):           # before int (bool is int subclass)
+        out.append(b"\x01" + (b"\x01" if v else b"\x00"))
+    elif isinstance(v, (int, np.integer)):
+        out.append(b"\x02" + struct.pack("<q", int(v)))
+    elif isinstance(v, (float, np.floating)):
+        out.append(b"\x03" + struct.pack("<d", float(v)))
+    elif isinstance(v, str):
+        b = v.encode("utf-8")
+        out.append(b"\x04" + struct.pack("<I", len(b)) + b)
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        out.append(b"\x05" + struct.pack("<Q", len(b)) + b)
+    elif isinstance(v, np.ndarray):
+        a = np.ascontiguousarray(v)
+        code = _DTYPE_CODE.get(a.dtype)
+        if code is None:
+            raise WireError(f"dtype {a.dtype} not on the wire allowlist")
+        if a.ndim > _MAX_NDIM:
+            raise WireError(f"ndim {a.ndim} > {_MAX_NDIM}")
+        out.append(b"\x06" + struct.pack("<BB", code, a.ndim)
+                   + struct.pack(f"<{a.ndim}Q", *a.shape))
+        out.append(a.tobytes())
+    elif isinstance(v, dict):
+        out.append(b"\x07" + struct.pack("<I", len(v)))
+        for k, item in v.items():
+            if not isinstance(k, str):
+                raise WireError(f"dict key must be str, got {type(k)}")
+            kb = k.encode("utf-8")
+            out.append(struct.pack("<I", len(kb)) + kb)
+            _enc_value(out, item)
+    elif isinstance(v, (list, tuple)):
+        out.append(b"\x08" + struct.pack("<I", len(v)))
+        for item in v:
+            _enc_value(out, item)
+    else:
+        raise WireError(f"type {type(v).__name__} not wire-serializable")
+
+
+def dumps(obj: Any) -> bytes:
+    out: List[bytes] = []
+    _enc_value(out, obj)
+    return b"".join(out)
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if n < 0 or self.pos + n > len(self.buf):
+            raise WireError("truncated frame")
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def unpack(self, st: struct.Struct) -> Tuple:
+        return st.unpack(self.take(st.size))
+
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+_BB = struct.Struct("<BB")
+
+
+def _dec_value(r: _Reader) -> Any:
+    tag = r.take(1)
+    if tag == b"\x00":
+        return None
+    if tag == b"\x01":
+        return r.take(1) != b"\x00"
+    if tag == b"\x02":
+        return r.unpack(_I64)[0]
+    if tag == b"\x03":
+        return r.unpack(_F64)[0]
+    if tag == b"\x04":
+        (n,) = r.unpack(_U32)
+        try:
+            return r.take(n).decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise WireError(f"bad utf-8 string: {e}") from None
+    if tag == b"\x05":
+        (n,) = r.unpack(_U64)
+        return r.take(n)
+    if tag == b"\x06":
+        code, ndim = r.unpack(_BB)
+        if code >= len(_DTYPES):
+            raise WireError(f"unknown dtype code {code}")
+        if ndim > _MAX_NDIM:
+            raise WireError(f"ndim {ndim} > {_MAX_NDIM}")
+        shape = struct.unpack(f"<{ndim}Q", r.take(8 * ndim))
+        dt = _DTYPES[code]
+        count = 1
+        for s in shape:
+            count *= s
+        nbytes = count * dt.itemsize
+        if nbytes > MAX_PAYLOAD:
+            raise WireError("array larger than frame cap")
+        raw = r.take(nbytes)
+        return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+    if tag == b"\x07":
+        (n,) = r.unpack(_U32)
+        if n > _MAX_CONTAINER:
+            raise WireError("dict too large")
+        d: Dict[str, Any] = {}
+        for _ in range(n):
+            (kl,) = r.unpack(_U32)
+            try:
+                k = r.take(kl).decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise WireError(f"bad utf-8 key: {e}") from None
+            d[k] = _dec_value(r)
+        return d
+    if tag == b"\x08":
+        (n,) = r.unpack(_U32)
+        if n > _MAX_CONTAINER:
+            raise WireError("list too large")
+        return [_dec_value(r) for _ in range(n)]
+    raise WireError(f"unknown type tag {tag!r}")
+
+
+def loads(buf: bytes) -> Any:
+    r = _Reader(buf)
+    v = _dec_value(r)
+    if r.pos != len(buf):
+        raise WireError(f"{len(buf) - r.pos} trailing bytes after value")
+    return v
+
+
+def pack_frame(obj: Any) -> bytes:
+    payload = dumps(obj)
+    if len(payload) > MAX_PAYLOAD:
+        raise WireError(f"payload {len(payload)} exceeds cap")
+    return HEADER.pack(_MAGIC, WIRE_VERSION, 0, len(payload)) + payload
+
+
+def read_frame_header(hdr: bytes) -> int:
+    """Validate a header; returns the payload length to read next."""
+    try:
+        magic, version, _flags, length = HEADER.unpack(hdr)
+    except struct.error as e:
+        raise WireError(f"bad header: {e}") from None
+    if magic != _MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if version != WIRE_VERSION:
+        raise WireError(f"peer wire version {version} != {WIRE_VERSION} — "
+                        f"mixed-version cluster; upgrade in lockstep")
+    if length > MAX_PAYLOAD:
+        raise WireError(f"frame length {length} exceeds cap")
+    return length

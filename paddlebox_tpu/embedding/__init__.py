@@ -36,6 +36,7 @@ from paddlebox_tpu.embedding.optimizers import (SparseAdagrad, SparseAdam,
 from paddlebox_tpu.embedding.pass_engine import PassEngine
 from paddlebox_tpu.embedding.grouped import GroupedEngine, GroupedStore
 from paddlebox_tpu.embedding.sharded_store import ShardedFeatureStore
+from paddlebox_tpu.embedding.device_store import DeviceFeatureStore
 
 __all__ = [
     "FeatureStore",
@@ -43,6 +44,7 @@ __all__ = [
     "GroupedStore",
     "PassEngine",
     "ShardedFeatureStore",
+    "DeviceFeatureStore",
     "PassTable",
     "SparseAdagrad",
     "SparseAdam",

@@ -145,14 +145,15 @@ class GroupedEngine:
     # -- pass lifecycle (tuple-valued twins of PassEngine's surface) -------
 
     def feed_pass(self, keys_by_group: Sequence[np.ndarray], *,
-                  async_build: bool = False) -> None:
+                  async_build: bool = False, readonly: bool = False) -> None:
         if len(keys_by_group) != len(self.groups):
             raise ValueError(
                 f"expected {len(self.groups)} key sets, got "
                 f"{len(keys_by_group)}")
         with self.timers.scope("feed_pass"):
             for g, keys in zip(self.groups, keys_by_group):
-                g.engine.feed_pass(keys, async_build=async_build)
+                g.engine.feed_pass(keys, async_build=async_build,
+                                   readonly=readonly)
 
     def wait_feed_pass_done(self) -> None:
         for g in self.groups:

@@ -33,17 +33,34 @@ _FIELDS = ("emb", "emb_state", "w", "w_state", "show", "click")
 
 def _per_key_uniform(keys: np.ndarray, dim: int, seed: np.uint64,
                      scale: float) -> np.ndarray:
-    """[n, dim] uniform(-scale, scale) derived from a splitmix64-style
-    counter hash of (key, column, seed) — order-independent init."""
-    k = keys.astype(np.uint64)[:, None]
-    j = np.arange(1, dim + 1, dtype=np.uint64)[None, :]
+    """[n, dim] uniform(-scale, scale) from a murmur3-finalizer counter
+    hash of (key's low 32 bits, column, seed) — order-independent init.
+
+    Deliberately 32-bit: the device store tier initializes new rows ON
+    DEVICE from a 4-byte-per-key transfer (device_store.py — uint64 is
+    unavailable under default jax x64 config, and the narrow transfer is
+    what keeps cold-start builds off the slow host↔device link). numpy,
+    native C++ (pbx_init_uniform) and the jnp twin are bit-exact.
+    """
+    lo = (keys.astype(np.uint64) & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return _u32_uniform(lo, dim, np.uint32(np.uint64(seed)
+                                           & np.uint64(0xFFFFFFFF)), scale)
+
+
+def _u32_uniform(keys_lo: np.ndarray, dim: int, seed: np.uint32,
+                 scale: float) -> np.ndarray:
+    k = keys_lo.astype(np.uint32)[:, None]
+    j = np.arange(1, dim + 1, dtype=np.uint32)[None, :]
     with np.errstate(over="ignore"):
-        z = k + j * np.uint64(0x9E3779B97F4A7C15) + seed
-        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-        z = z ^ (z >> np.uint64(31))
-    u = (z >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
-    return ((2.0 * u - 1.0) * scale).astype(np.float32)
+        z = k + j * np.uint32(0x9E3779B9) + seed
+        z ^= z >> np.uint32(16)
+        z *= np.uint32(0x85EBCA6B)
+        z ^= z >> np.uint32(13)
+        z *= np.uint32(0xC2B2AE35)
+        z ^= z >> np.uint32(16)
+    u = (z >> np.uint32(8)).astype(np.float32) * np.float32(1.0 / (1 << 24))
+    return ((np.float32(2.0) * u - np.float32(1.0))
+            * np.float32(scale)).astype(np.float32)
 
 
 class FeatureStore:
@@ -95,8 +112,9 @@ class FeatureStore:
     def _dirty_compact(self) -> np.ndarray:
         """Sorted unique dirty keys; caller must hold the lock."""
         if len(self._dirty_parts) > 1:
-            from paddlebox_tpu.native.keymap_py import dedup_keys
-            self._dirty_parts = [dedup_keys(
+            # np.unique, not dedup_keys: key 0 is a legal dirty key here
+            # (dedup_keys drops the null feasign by design).
+            self._dirty_parts = [np.unique(
                 np.concatenate(self._dirty_parts))]
         return (self._dirty_parts[0] if self._dirty_parts
                 else np.empty((0,), np.uint64))

@@ -235,8 +235,19 @@ def extract_pass_values_host(table: PassTable, num_keys: int
                              ) -> Dict[str, np.ndarray]:
     """Inverse of build_pass_table_host: ONE D2H transfer, strip trash
     rows, return sorted-key order host arrays (role of EndPass dumping
-    dirty HBM values back to the CPU table, ps_gpu_wrapper.cc:983)."""
-    laid = np.asarray(table.vals)
+    dirty HBM values back to the CPU table, ps_gpu_wrapper.cc:983).
+
+    Under a multi-process cluster the table spans hosts; every process
+    needs the full values (the host store is a per-rank replica), so the
+    extraction is a process allgather there (role of the PS pull in the
+    reference's write-back — values cross the host network exactly once
+    per pass)."""
+    if table.vals.is_fully_addressable:
+        laid = np.asarray(table.vals)
+    else:
+        from jax.experimental import multihost_utils
+        laid = np.asarray(
+            multihost_utils.process_allgather(table.vals, tiled=True))
     fused = unlay_fused_host(laid, table.num_shards, table.rows_per_shard,
                              num_keys)
     return split_values_host(fused, table.dim, table.ke, table.kw)

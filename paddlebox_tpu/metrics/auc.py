@@ -100,11 +100,11 @@ def auc_compute(state: AucState) -> Dict[str, float]:
         float(state.count))
 
 
-def wuauc_compute(user_ids: np.ndarray, preds: np.ndarray,
-                  labels: np.ndarray) -> Dict[str, float]:
-    """Per-user (weighted-user) AUC on host (role of WuAucMetricMsg,
-    metrics.h:306 / ``computeWuAuc``): group records by user, compute AUC
-    per user with >=1 pos and >=1 neg, average weighted by instance count."""
+def wuauc_accumulate(user_ids: np.ndarray, preds: np.ndarray,
+                     labels: np.ndarray) -> Tuple[float, float, int]:
+    """(wauc_sum, weight_sum, user_count) over one uid-complete partition
+    of records — partitions (e.g. uid-hash spill buckets) sum, since each
+    user's records live in exactly one partition."""
     order = np.argsort(user_ids, kind="stable")
     uids, preds, labels = user_ids[order], preds[order], labels[order]
     boundaries = np.flatnonzero(
@@ -125,6 +125,16 @@ def wuauc_compute(user_ids: np.ndarray, preds: np.ndarray,
         wauc_sum += auc_u * w
         weight_sum += w
         user_count += 1
+    return wauc_sum, weight_sum, user_count
+
+
+def wuauc_compute(user_ids: np.ndarray, preds: np.ndarray,
+                  labels: np.ndarray) -> Dict[str, float]:
+    """Per-user (weighted-user) AUC on host (role of WuAucMetricMsg,
+    metrics.h:306 / ``computeWuAuc``): group records by user, compute AUC
+    per user with >=1 pos and >=1 neg, average weighted by instance count."""
+    wauc_sum, weight_sum, user_count = wuauc_accumulate(user_ids, preds,
+                                                        labels)
     return {
         "wuauc": wauc_sum / weight_sum if weight_sum else float("nan"),
         "wuauc_users": float(user_count),

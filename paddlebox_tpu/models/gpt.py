@@ -217,16 +217,122 @@ def gpt_loss_fn(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
                          check_vma=False)
 
 
+def gpt_value_and_grad_1f1b(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
+                            num_microbatches: int = 1):
+    """(params, tokens, targets) -> (loss, grads) using the 1F1B pipeline
+    schedule (role of the reference's default train_batch path,
+    ``meta_parallel/pipeline_parallel.py:82``): bounded activation memory
+    — each stage holds O(pp) stage inputs instead of the
+    GPipe-through-autodiff O(M) residuals. The embedding runs outside the
+    pipeline (cotangents returned by the schedule), the final-LN/head pair
+    rides the schedule's ``loss_params`` channel."""
+    heads_local = cfg.n_heads // int(mesh.shape["mp"])
+
+    def stage_fn(stage_params, x):
+        def body(h, lp):
+            return _block(lp, h, cfg, heads_local), None
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    mp_n = int(mesh.shape["mp"])
+
+    def loss_head(lp, y, tgt):
+        h = _ln(y, lp["lnf_g"], lp["lnf_b"])
+        logits = jnp.dot(h, lp["head"], preferred_element_type=jnp.float32)
+        losses = tplib.parallel_cross_entropy(logits, tgt, axis="mp")
+        # The schedule seeds this (mp-replicated) value on EVERY mp rank,
+        # and psum's transpose under shard_map sums seeded cotangents —
+        # so the seeded objective is mp * L unless scaled down here; the
+        # reported loss is scaled back up by the caller.
+        return jnp.mean(losses) / mp_n
+
+    def body(params, tokens, targets):
+        s_local = tokens.shape[1]
+
+        def embed_fn(ep):
+            x = tplib.vocab_parallel_embedding(
+                {"table": ep["embed"]}, tokens, axis="mp")
+            rank_sp = lax.axis_index("sp")
+            pos_ids = rank_sp * s_local + jnp.arange(s_local)
+            return x + ep["pos"][pos_ids][None, :, :]
+
+        ep = {"embed": params["embed"], "pos": params["pos"]}
+        x, vjp_embed = jax.vjp(embed_fn, ep)
+        bl = x.shape[0]
+        m = num_microbatches
+        x_mb = x.reshape(m, bl // m, s_local, cfg.d_model)
+        tgt_mb = targets.reshape(m, bl // m, s_local)
+        lp = {"lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
+              "head": params["head"]}
+        stage_params_local = jax.tree.map(lambda a: a[0], params["layers"])
+        loss, sgrads, lpgrads, dx0 = pplib.one_f_one_b_value_and_grad(
+            stage_fn, loss_head, stage_params_local, x_mb, tgt_mb,
+            axis="pp", loss_params=lp, return_input_grads=True)
+        (dep,) = vjp_embed(
+            dx0.reshape(bl, s_local, cfg.d_model).astype(x.dtype))
+
+        grads = {
+            "embed": dep["embed"],
+            "pos": dep["pos"],
+            "layers": jax.tree.map(lambda g: g[None], sgrads),
+            "lnf_g": lpgrads["lnf_g"],
+            "lnf_b": lpgrads["lnf_b"],
+            "head": lpgrads["head"],
+        }
+
+        # Reductions mirroring what autodiff-through-shard_map gives the
+        # GPipe path implicitly: a param replicated over an axis gets the
+        # SUM of per-rank partials over that axis (broadcast transpose) —
+        # pp (grads exist only on the first/last rank) and mp (each rank
+        # contributes through its own heads/vocab shard) — while dp/sp
+        # average, because each shard's loss is normalized by its LOCAL
+        # token count (mean of local means == global mean for equal
+        # shards).
+        def reduce_leaf(g, spec):
+            sharded = set()
+            for entry in spec:
+                if entry is None:
+                    continue
+                if isinstance(entry, (tuple, list)):
+                    sharded.update(entry)
+                else:
+                    sharded.add(entry)
+            axes = [a for a in ("pp", "mp") if a not in sharded]
+            if axes:
+                g = lax.psum(g, tuple(axes))
+            return lax.pmean(g, ("dp", "sp"))
+
+        grads = jax.tree.map(reduce_leaf, grads, specs)
+        return lax.pmean(loss * mp_n, ("dp", "sp")), grads
+
+    in_specs = (specs, P("dp", "sp"), P("dp", "sp"))
+    return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=(P(), specs), check_vma=False)
+
+
 def make_gpt_train_step(cfg: GPTConfig, mesh: Mesh, specs: Dict,
-                        optimizer, *, num_microbatches: int = 1):
+                        optimizer, *, num_microbatches: int = 1,
+                        schedule: str = "gpipe"):
     """Jitted (params, opt_state, tokens, targets) -> (params, opt_state,
     loss) with donation. Gradient reduction across dp/pp/sp/mp falls out
-    of differentiating through the shard_map."""
-    loss_fn = gpt_loss_fn(cfg, mesh, specs,
-                          num_microbatches=num_microbatches)
+    of differentiating through the shard_map (``schedule="gpipe"``) or is
+    explicit in the 1F1B path (``schedule="1f1b"`` — the reference's
+    default pipeline schedule, pipeline_parallel.py:82, with bounded
+    activation memory; pick it when microbatch count × activation size
+    would blow HBM under GPipe)."""
+    if schedule == "gpipe":
+        loss_fn = gpt_loss_fn(cfg, mesh, specs,
+                              num_microbatches=num_microbatches)
+        vg = jax.value_and_grad(loss_fn)
+    elif schedule == "1f1b":
+        vg = gpt_value_and_grad_1f1b(cfg, mesh, specs,
+                                     num_microbatches=num_microbatches)
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                         "choose 'gpipe' or '1f1b'")
 
     def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        loss, grads = vg(params, tokens, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss

@@ -328,23 +328,27 @@ void pbx_merge_sorted(const uint64_t* old_keys, int64_t n,
 }
 
 // Deterministic per-key uniform init (store.py _per_key_uniform contract):
-// out[i, j] = uniform(-scale, scale) from splitmix-style hash of
-// (key, column j+1, seed) — order-independent, matches the numpy path
-// bit-for-bit (same double rounding).
+// out[i, j] = uniform(-scale, scale) from a murmur3-finalizer hash of
+// (key's low 32 bits, column j+1, seed) — order-independent; bit-exact
+// with the numpy twin AND the on-device jnp twin (32-bit ops only, so the
+// device tier can initialize rows from a 4-byte-per-key transfer).
 void pbx_init_uniform(const uint64_t* keys, int64_t n, int64_t dim,
                       uint64_t seed, double scale, float* out) {
+  uint32_t seed32 = static_cast<uint32_t>(seed & 0xFFFFFFFFULL);
+  float fscale = static_cast<float>(scale);
   parallel_chunks(n, num_threads_for(n * dim / 8),
                   [&](int, int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) {
-      uint64_t k = keys[i];
+      uint32_t k = static_cast<uint32_t>(keys[i] & 0xFFFFFFFFULL);
       for (int64_t j = 1; j <= dim; ++j) {
-        uint64_t z = k + static_cast<uint64_t>(j) * 0x9E3779B97F4A7C15ULL +
-                     seed;
-        z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
-        z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
-        z = z ^ (z >> 31);
-        double u = static_cast<double>(z >> 11) * (1.0 / (1ULL << 53));
-        out[i * dim + (j - 1)] = static_cast<float>((2.0 * u - 1.0) * scale);
+        uint32_t z = k + static_cast<uint32_t>(j) * 0x9E3779B9u + seed32;
+        z ^= z >> 16;
+        z *= 0x85EBCA6Bu;
+        z ^= z >> 13;
+        z *= 0xC2B2AE35u;
+        z ^= z >> 16;
+        float u = static_cast<float>(z >> 8) * (1.0f / 16777216.0f);
+        out[i * dim + (j - 1)] = (2.0f * u - 1.0f) * fscale;
       }
     }
   });

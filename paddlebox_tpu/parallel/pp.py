@@ -180,9 +180,12 @@ def one_f_one_b_value_and_grad(
         y = jnp.where(f_active, y, 0)
 
         # Last stage: seed the backward for THIS tick's microbatch.
+        # targets may be any pytree microbatched on the leading dim (a
+        # trainer batch dict) — each leaf is indexed the same way.
         j_b = t - (2 * (n - 1) - rank)
         b_active = (j_b >= 0) & (j_b < m)
-        tgt = targets_microbatches[jnp.clip(j_b, 0, m - 1)]
+        tgt = jax.tree.map(
+            lambda a: a[jnp.clip(j_b, 0, m - 1)], targets_microbatches)
 
         is_last = rank == n - 1
         if loss_params is None:

@@ -57,6 +57,11 @@ class TrainerConfig:
     #             fresh params each step (BoxPSAsynDenseTable role).
     dense_sync_mode: str = "step"
     dense_sync_interval: int = 8
+    # Forward/backward compute precision (role of paddle.amp / the AMP
+    # meta-optimizer): "bfloat16" casts params + activations for the
+    # model fwd/bwd so matmuls hit the MXU at native rate; master params,
+    # optimizer state, loss, AUC, and the sparse push stay float32.
+    compute_dtype: str = "float32"
 
 
 class CTRTrainer:
@@ -169,31 +174,46 @@ class CTRTrainer:
         model = self.model
         bs_local = self.feed_config.batch_size // self.ndev
         has_dense = bool(self.feed_config.dense_slots)
+        cdt = dict(float32=jnp.float32,
+                   bfloat16=jnp.bfloat16)[self.config.compute_dtype]
+
+        def cast(tree):
+            if cdt == jnp.float32:
+                return tree
+            return jax.tree.map(
+                lambda x: x.astype(cdt)
+                if x.dtype == jnp.float32 else x, tree)
 
         def forward(params, pulled, segments, dense_feats,
                     emb_alls=None, w_alls=None):
+            params = cast(params)
+            dense_feats = cast(dense_feats)
+            if emb_alls is not None:
+                emb_alls, w_alls = cast(emb_alls), cast(w_alls)
             emb: Dict[str, jax.Array] = {}
             w: Dict[str, jax.Array] = {}
             for gi, slots in enumerate(group_slots):
                 src_e = (emb_alls[gi] if emb_alls is not None
-                         else pulled[gi]["emb"])
+                         else cast(pulled[gi]["emb"]))
                 src_w = (w_alls[gi] if w_alls is not None
-                         else pulled[gi]["w"])
+                         else cast(pulled[gi]["w"]))
                 for n in slots:
                     emb[n] = src_e[group_sl[gi][n]]
                     w[n] = src_w[group_sl[gi][n]]
             kwargs = dict(batch_size=bs_local,
                           dense_feats=dense_feats if has_dense else None)
             if hasattr(model, "use_cvm"):  # Wide&Deep takes show/click
-                show = {n: pulled[gi]["show"][group_sl[gi][n]]
+                show = {n: cast(pulled[gi]["show"])[group_sl[gi][n]]
                         for gi, slots in enumerate(group_slots)
                         for n in slots}
-                click = {n: pulled[gi]["click"][group_sl[gi][n]]
+                click = {n: cast(pulled[gi]["click"])[group_sl[gi][n]]
                          for gi, slots in enumerate(group_slots)
                          for n in slots}
-                return model.apply(params, emb, w, show, click,
-                                   segments, **kwargs)
-            return model.apply(params, emb, w, segments, **kwargs)
+                logits = model.apply(params, emb, w, show, click,
+                                     segments, **kwargs)
+            else:
+                logits = model.apply(params, emb, w, segments, **kwargs)
+            return logits.astype(jnp.float32)
 
         return forward
 
@@ -349,7 +369,7 @@ class CTRTrainer:
         eng = self.engine
         if feed_keys:
             eng.feed_pass([dataset.pass_keys(slots=g.slots)
-                           for g in eng.groups])
+                           for g in eng.groups], readonly=True)
         tables = eng.begin_pass()
         auc = auc_state_init(self.config.auc_num_buckets)
         if self.mesh is not None:
@@ -393,7 +413,15 @@ class CTRTrainer:
         PreLoadIntoMemory box_wrapper.h:1140). The host work (numpy pack,
         native keymap lookup — both GIL-releasing) runs concurrently with
         the asynchronously-dispatched device computation; a small bounded
-        queue keeps the device fed without unbounded host memory."""
+        queue keeps the device fed without unbounded host memory.
+
+        Transfer thrift (the host↔device link, not the pack, bounds this
+        pipeline on tunnel-attached TPUs): per-slot segment arrays are
+        usually IDENTICAL between consecutive full batches of fixed-length
+        slots (identity layout), so the producer reuses the previous
+        device copy when the host bytes match instead of re-transferring
+        ~2 MB per batch; dense features ship in the compute dtype (bf16
+        halves them under AMP)."""
         import queue
         import threading
 
@@ -401,6 +429,26 @@ class CTRTrainer:
             maxsize=max(1, int(flags.flag("trainer_prefetch_depth"))))
         _DONE = object()
         stop = threading.Event()
+        seg_cache: Dict[str, Tuple[np.ndarray, jax.Array]] = {}
+        dense_bf16 = self.config.compute_dtype == "bfloat16"
+        # Explicit global placement: every process passes the SAME host
+        # array and owns only its addressable shards — which is what makes
+        # the identical code run under multi-process (jax.distributed)
+        # clusters, where bare jnp.asarray would produce non-addressable
+        # single-device arrays.
+        data_sh = (NamedSharding(self.mesh, P(self.axis))
+                   if self.mesh is not None else None)
+
+        def _dev(host):
+            return _put_global(host, data_sh)
+
+        def _seg_dev(name: str, host: np.ndarray) -> jax.Array:
+            hit = seg_cache.get(name)
+            if hit is not None and np.array_equal(hit[0], host):
+                return hit[1]
+            dev = _dev(host)
+            seg_cache[name] = (host.copy(), dev)
+            return dev
 
         def _put(item) -> bool:
             while not stop.is_set():
@@ -415,12 +463,16 @@ class CTRTrainer:
             try:
                 for batch in dataset.batches_sharded(self.ndev):
                     with self.timers.scope("host_map"):
+                        dense_h = _concat_dense_host(batch)
+                        if dense_bf16:
+                            import ml_dtypes
+                            dense_h = dense_h.astype(ml_dtypes.bfloat16)
                         args = (self._map_batch_rows(batch),
-                                {n: jnp.asarray(batch.segments[n])
+                                {n: _seg_dev(n, batch.segments[n])
                                  for n in self._slot_names},
-                                jnp.asarray(batch.labels),
-                                jnp.asarray(batch.valid),
-                                _concat_dense(batch))
+                                _dev(batch.labels),
+                                _dev(batch.valid),
+                                _dev(dense_h))
                     if not _put(args):
                         return  # consumer bailed early
             except BaseException as e:
@@ -446,13 +498,16 @@ class CTRTrainer:
     def _map_batch_rows(self, batch: SlotBatch) -> Tuple[jax.Array, ...]:
         """Host map: batch feasigns → per-width-group fused device-row
         arrays (role of CopyKeys' host side, one array per dim group)."""
+        data_sh = (NamedSharding(self.mesh, P(self.axis))
+                   if self.mesh is not None else None)
         rows = []
         for gi, g in enumerate(self.engine.groups):
             all_ids = np.concatenate([batch.ids[n] for n in g.slots])
             r = self.engine.lookup_rows(gi, all_ids)
             # Interleave per-device: [dev, slot, cap_local] flatten.
-            rows.append(jnp.asarray(_interleave_slots(
-                r, list(g.slots), self._slot_caps, self.ndev)))
+            h = _interleave_slots(r, list(g.slots), self._slot_caps,
+                                  self.ndev)
+            rows.append(_put_global(h, data_sh))
         return tuple(rows)
 
     # -- pass loop ---------------------------------------------------------
@@ -482,6 +537,11 @@ class CTRTrainer:
                 learning_rate=self.config.dense_learning_rate)
         rep = (NamedSharding(self.mesh, P())
                if self.mesh is not None else None)
+        # Pre-built replicated step flags: creating them per step would
+        # issue host->device transfers (with cross-process consistency
+        # collectives under jax.distributed) racing the prefetch thread's.
+        flags_01 = (_put_global(np.int32(0), rep),
+                    _put_global(np.int32(1), rep))
         losses: List[float] = []
         overflows: List[jax.Array] = []
         nsteps = 0
@@ -490,9 +550,8 @@ class CTRTrainer:
             if mode == "async":
                 # PullDense role: freshest host params each step.
                 params = jax.device_put(self._async_dense.pull_dense(), rep)
-            sync_flag = jnp.asarray(
-                1 if (mode == "kstep" and (nsteps + 1) % k == 0) else 0,
-                jnp.int32)
+            sync_flag = flags_01[
+                1 if (mode == "kstep" and (nsteps + 1) % k == 0) else 0]
             with self.timers.scope("device_step"):
                 out = self._step_fn(
                     tables, params, opt_state, auc, rows, segs,
@@ -562,9 +621,21 @@ def _interleave_slots(rows_concat: np.ndarray, names: List[str],
     return np.concatenate(parts)
 
 
-def _concat_dense(batch: SlotBatch):
+def _put_global(host, sharding) -> jax.Array:
+    """Host array -> global device array under ``sharding``, WITHOUT any
+    cross-process collective (jax.device_put to a multi-process sharding
+    runs an assert-equal allgather, which would race other threads'
+    collectives; make_array_from_callback materializes only this
+    process's addressable shards). Single-process it is equivalent."""
+    if sharding is None:
+        return jnp.asarray(host)
+    host = np.asarray(host)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
+def _concat_dense_host(batch: SlotBatch) -> np.ndarray:
     if batch.dense:
-        return jnp.asarray(
-            np.concatenate([batch.dense[k] for k in sorted(batch.dense)],
-                           axis=-1))
-    return jnp.zeros((batch.labels.shape[0], 0), jnp.float32)
+        return np.concatenate([batch.dense[k] for k in sorted(batch.dense)],
+                              axis=-1)
+    return np.zeros((batch.labels.shape[0], 0), np.float32)

@@ -43,6 +43,12 @@ class TrainerDesc:
     check_nan_inf: bool = False
     dump_path: str = ""                # per-line prediction dump target
     num_micro_batches: int = 1         # pipeline trainers
+    # Pipeline schedule (role of the reference's forward_backward_pipeline
+    # default, pipeline_parallel.py:82): "gpipe" differentiates through
+    # the pipeline scan (O(num_micro_batches) stashed activations);
+    # "1f1b" runs the explicit one-forward-one-backward schedule with
+    # O(pp) bounded activation memory (parallel/pp.py).
+    pipeline_schedule: str = "gpipe"
     # Block on the loss every N steps: keeps async dispatch deep enough to
     # overlap host and device but bounded — unbounded queues of
     # collective-heavy programs can starve the runtime's rendezvous
@@ -300,22 +306,61 @@ class PipelineTrainer(TrainerBase):
         desc = self.desc or TrainerDesc()
         mb = desc.num_micro_batches
         mesh = self.mesh
-        pipe = pp_lib.make_pipeline_fn(mesh, self.stage_fn, self.params)
+        schedule = desc.pipeline_schedule
+        if schedule == "gpipe":
+            pipe = pp_lib.make_pipeline_fn(mesh, self.stage_fn, self.params)
 
-        def step(params, opt_state, batch):
-            x, rest = batch["x"], batch
+            def step(params, opt_state, batch):
+                x, rest = batch["x"], batch
 
-            def loss_fn(params):
+                def loss_fn(params):
+                    xs = x.reshape((mb, x.shape[0] // mb) + x.shape[1:])
+                    y = pipe(params, xs)
+                    y = y.reshape((x.shape[0],) + y.shape[2:])
+                    return self.loss_head(y, rest)
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                return optax.apply_updates(params, updates), opt_state, loss
+
+            self._step = jax.jit(step)
+        elif schedule == "1f1b":
+            # Explicit 1F1B (bounded activation memory). loss_head sees
+            # per-MICROBATCH outputs + the batch dict microbatched the
+            # same way; with equal microbatch sizes a mean-style loss
+            # matches the gpipe full-batch value exactly.
+            from jax.sharding import PartitionSpec as P_
+            pspecs = pp_lib.stage_specs(self.params)
+            stage_fn, loss_head = self.stage_fn, self.loss_head
+
+            def body(stacked_params, x_mb, batch_mb):
+                params_local = jax.tree.map(lambda a: a[0], stacked_params)
+                loss, grads = pp_lib.one_f_one_b_value_and_grad(
+                    stage_fn, loss_head, params_local, x_mb, batch_mb,
+                    axis="pp")
+                return loss, jax.tree.map(lambda g: g[None], grads)
+
+            sm = jax.shard_map(
+                body, mesh=mesh, in_specs=(pspecs, P_(), P_()),
+                out_specs=(P_(), pspecs), check_vma=False)
+
+            def step(params, opt_state, batch):
+                x = batch["x"]
                 xs = x.reshape((mb, x.shape[0] // mb) + x.shape[1:])
-                y = pipe(params, xs)
-                y = y.reshape((x.shape[0],) + y.shape[2:])
-                return self.loss_head(y, rest)
+                batch_mb = jax.tree.map(
+                    lambda a: a.reshape((mb, a.shape[0] // mb)
+                                        + a.shape[1:]), batch)
+                loss, grads = sm(params, xs, batch_mb)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                return optax.apply_updates(params, updates), opt_state, loss
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
-            updates, opt_state = self.tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
-        self._step = jax.jit(step)
+            self._step = jax.jit(step)
+        else:
+            raise ValueError(
+                f"unknown pipeline_schedule {schedule!r}; choose 'gpipe' "
+                f"or '1f1b'")
 
     def run(self, data: Iterable) -> Dict[str, float]:
         desc = self.desc or TrainerDesc()

@@ -1,0 +1,68 @@
+"""Worker payload for the TRUE multi-process CTR test (spawned by
+``python -m paddlebox_tpu.launch --nproc 2 tests/mp_ctr_worker.py``).
+
+Role of the reference worker payloads spawned by _run_cluster
+(``test_dist_base.py:1041``): join the cluster via the env contract,
+train the tiny config on deterministic data, and report the loss
+trajectory so the parent can assert parity with a single-process run.
+
+Usage: mp_ctr_worker.py <data_dir> <out_json>
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    data_dir, out_json = sys.argv[1], sys.argv[2]
+    from paddlebox_tpu.distributed import bootstrap
+    bootstrap.initialize()   # PBX_* env from the launcher
+    assert jax.process_count() == int(os.environ["PBX_NUM_PROCESSES"])
+
+    import numpy as np
+    from paddlebox_tpu.data.dataset import Dataset
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.embedding import TableConfig
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+    ndev = len(jax.devices())        # global across processes
+    mesh = build_mesh(HybridTopology(dp=ndev))
+    slots = tuple(SlotConf(f"s{i}", avg_len=1.0) for i in range(3))
+    feed = DataFeedConfig(slots=slots, batch_size=32)
+    model = DeepFM(slot_names=tuple(f"s{i}" for i in range(3)),
+                   emb_dim=4, hidden=(16,))
+    trainer = CTRTrainer(model, feed,
+                         TableConfig(dim=4, learning_rate=0.1), mesh=mesh,
+                         config=TrainerConfig(auc_num_buckets=1 << 10))
+    trainer.init(seed=0)
+
+    files = sorted(
+        os.path.join(data_dir, f) for f in os.listdir(data_dir)
+        if f.startswith("part-"))
+    losses = []
+    for _ in range(2):
+        ds = Dataset(feed, num_reader_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        stats = trainer.train_pass(ds)
+        losses.append(stats["loss"])
+
+    if jax.process_index() == 0:
+        with open(out_json, "w") as f:
+            json.dump({"losses": losses,
+                       "ndev": ndev,
+                       "nproc": jax.process_count()}, f)
+
+
+if __name__ == "__main__":
+    main()

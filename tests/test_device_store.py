@@ -1,0 +1,223 @@
+"""DeviceFeatureStore: the HBM-resident persistent tier (device_store.py).
+
+Parity contract: behaves exactly like the host FeatureStore for the same
+operation sequence — same init values (shared deterministic per-key init),
+same pull/push semantics, same base/delta checkpoint artifacts — while
+keeping values on device between passes (role of the GPU-resident BoxPS
+tables, README.md:48 / heter_ps hashtables in HBM).
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.embedding import TableConfig
+from paddlebox_tpu.embedding.device_store import DeviceFeatureStore
+from paddlebox_tpu.embedding.store import FeatureStore
+from paddlebox_tpu.embedding.table import extract_pass_values_host
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+
+CFG = TableConfig(dim=4, optimizer="adagrad", learning_rate=0.1)
+FIELDS = ("emb", "emb_state", "w", "w_state", "show", "click")
+
+
+def keys_of(n, seed=0, lo=1, hi=10_000):
+    return np.sort(np.random.default_rng(seed).choice(
+        np.arange(lo, hi, dtype=np.uint64), n, replace=False))
+
+
+def assert_vals_equal(a, b, **kw):
+    for f in FIELDS:
+        np.testing.assert_allclose(a[f], b[f], err_msg=f, **kw)
+
+
+@pytest.mark.parametrize("mesh_shards", [1, 8])
+def test_pull_push_parity_with_host_store(mesh_shards):
+    mesh = (build_mesh(HybridTopology(dp=8)) if mesh_shards == 8 else None)
+    dev = DeviceFeatureStore(CFG, mesh=mesh)
+    host = FeatureStore(CFG)
+    k1 = keys_of(257, seed=1)
+    # Fresh pull: init parity.
+    v_dev = dev.pull_for_pass(k1)
+    v_host = host.pull_for_pass(k1)
+    assert_vals_equal(v_dev, v_host, rtol=0, atol=0)
+    # Mutate + push back through both, then re-pull.
+    for v in (v_dev, v_host):
+        v["emb"] = v["emb"] + 1.5
+        v["show"] = v["show"] + 2.0
+    dev.push_from_pass(k1, v_dev)
+    host.push_from_pass(k1, v_host)
+    assert dev.num_features == host.num_features == 257
+    k2 = keys_of(301, seed=2)  # overlaps k1 partially + new keys
+    assert_vals_equal(dev.pull_for_pass(k2), host.pull_for_pass(k2),
+                      rtol=0, atol=1e-7)
+
+
+@pytest.mark.parametrize("mesh_shards", [1, 8])
+def test_pass_table_roundtrip_and_readonly(mesh_shards):
+    mesh = (build_mesh(HybridTopology(dp=8)) if mesh_shards == 8 else None)
+    s = mesh_shards
+    dev = DeviceFeatureStore(CFG, mesh=mesh)
+    k = keys_of(100, seed=3)
+    table, rows = dev.pull_pass_table(k, s)
+    assert dev.num_features == 100
+    assert (rows >= 0).all()
+    vals = extract_pass_values_host(table, 100)
+    host = FeatureStore(CFG)
+    assert_vals_equal(vals, host.pull_for_pass(k), rtol=0, atol=0)
+    # Write back modified values; re-pull sees them.
+    new_vals = table.with_emb(table.emb + 3.0)
+    dev.push_pass_table(k, rows, new_vals)
+    t2, _ = dev.pull_pass_table(k, s)
+    got = extract_pass_values_host(t2, 100)
+    np.testing.assert_allclose(got["emb"], vals["emb"] + 3.0, atol=1e-6)
+    assert set(np.asarray(dev.dirty_keys()).tolist()) == \
+        set(k.tolist())
+    # Read-only pull with unseen keys: store NOT grown, init overlaid.
+    k_new = keys_of(50, seed=4, lo=20_000, hi=30_000)
+    k_mix = np.sort(np.concatenate([k[:25], k_new]))
+    t3, rows3 = dev.pull_pass_table(k_mix, s, readonly=True)
+    assert dev.num_features == 100          # unchanged
+    got3 = extract_pass_values_host(t3, k_mix.shape[0])
+    ref = host.pull_for_pass(k_mix)         # host never persists on pull
+    known = np.isin(k_mix, k[:25])
+    np.testing.assert_allclose(got3["emb"][known],
+                               vals["emb"][np.isin(k, k_mix)] + 3.0,
+                               atol=1e-6)
+    np.testing.assert_allclose(got3["emb"][~known], ref["emb"][~known],
+                               atol=0)
+    assert (rows3[~known] == -1).all()
+
+
+def test_growth_preserves_values():
+    dev = DeviceFeatureStore(CFG, capacity_hint=0)  # starts at 1024/shard
+    k1 = keys_of(900, seed=5)
+    v1 = dev.pull_for_pass(k1)
+    v1["emb"] += 0.25
+    dev.push_from_pass(k1, v1)
+    # Force growth past the initial capacity (ensure_rows inserts+inits;
+    # pull_for_pass is read-only and must NOT grow the store).
+    k2 = keys_of(3000, seed=6, lo=50_000, hi=90_000)
+    dev.pull_for_pass(k2)
+    assert dev.num_features == 900
+    dev.ensure_rows(k2)
+    assert dev.num_features == 900 + 3000
+    back = dev.pull_for_pass(k1)
+    np.testing.assert_allclose(back["emb"], v1["emb"], atol=1e-7)
+
+
+@pytest.mark.parametrize("mesh_shards", [1, 8])
+def test_checkpoint_roundtrip_and_host_interop(tmp_path, mesh_shards):
+    mesh = (build_mesh(HybridTopology(dp=8)) if mesh_shards == 8 else None)
+    dev = DeviceFeatureStore(CFG, mesh=mesh)
+    k = keys_of(64, seed=7)
+    v = dev.pull_for_pass(k)
+    v["emb"] += 0.5
+    dev.push_from_pass(k, v)
+    dev.save_base(str(tmp_path / "base"))
+    # Delta: touch a subset after base.
+    sub = k[10:20]
+    v2 = dev.pull_for_pass(sub)
+    v2["click"] += 4.0
+    dev.push_from_pass(sub, v2)
+    assert dev.dirty_keys().shape[0] == 10
+    dev.save_delta(str(tmp_path / "delta"))
+    # Host store loads the device store's artifacts (same format).
+    host = FeatureStore(CFG)
+    host.load(str(tmp_path / "base"), "base")
+    host.load(str(tmp_path / "delta"), "delta")
+    # A fresh device store loads its own artifacts.
+    dev2 = DeviceFeatureStore(CFG, mesh=mesh)
+    dev2.load(str(tmp_path / "base"), "base")
+    dev2.load(str(tmp_path / "delta"), "delta")
+    assert_vals_equal(dev2.pull_for_pass(k), host.pull_for_pass(k),
+                      rtol=0, atol=1e-7)
+    # xbox export exists and carries emb+w only.
+    n = dev.save_xbox(str(tmp_path / "xbox"))
+    assert n == 64
+    data = np.load(tmp_path / "xbox" / f"{CFG.name}.xbox.npz")
+    assert set(data.files) == {"keys", "emb", "w"}
+
+
+def test_shrink_decay_and_eviction():
+    dev = DeviceFeatureStore(CFG)
+    k = keys_of(40, seed=8)
+    v = dev.pull_for_pass(k)
+    v["show"][:] = np.where(np.arange(40) < 15, 0.05, 10.0)
+    v["click"][:] = 1.0
+    dev.push_from_pass(k, v)
+    evicted = dev.shrink(min_show=0.1)
+    assert evicted == 15
+    assert dev.num_features == 25
+    survivors = k[15:] if (v["show"][:15] < 0.1).all() else None
+    kept = dev.contains(k)
+    assert kept.sum() == 25
+    back = dev.pull_for_pass(k[kept])
+    np.testing.assert_allclose(back["show"],
+                               10.0 * CFG.show_click_decay, atol=1e-5)
+    np.testing.assert_allclose(back["click"],
+                               1.0 * CFG.show_click_decay, atol=1e-6)
+    with pytest.raises(RuntimeError):
+        dev.save_delta("/tmp/should-not-exist")
+
+
+@pytest.mark.parametrize("mesh_shards", [1, 8])
+def test_ctr_trainer_with_device_store_matches_host_store(mesh_shards):
+    """Same data, same seeds: a CTRTrainer over the device tier must train
+    identically (loss trajectory) to one over the host tier."""
+    import jax
+    from jax.sharding import Mesh
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+    mesh = (build_mesh(HybridTopology(dp=8)) if mesh_shards == 8
+            else Mesh(np.array(jax.devices()[:1]), ("dp",)))
+    slots = tuple(SlotConf(f"s{i}", avg_len=1.0) for i in range(3))
+    feed = DataFeedConfig(slots=slots, batch_size=32)
+    model = DeepFM(slot_names=tuple(f"s{i}" for i in range(3)),
+                   emb_dim=4, hidden=(16,))
+
+    def run(store_factory):
+        tr = CTRTrainer(model, feed, CFG, mesh=mesh,
+                        config=TrainerConfig(auc_num_buckets=1 << 10),
+                        store_factory=store_factory)
+        tr.init(seed=0)
+        losses = []
+        for p in range(2):
+            ds = _FakeDataset(feed, seed=11 + p, nbatches=3, ndev=mesh_shards)
+            losses.append(tr.train_pass(ds)["loss"])
+        return losses
+
+    l_dev = run(lambda cfg: DeviceFeatureStore(cfg, mesh=mesh))
+    l_host = run(lambda cfg: FeatureStore(cfg))
+    np.testing.assert_allclose(l_dev, l_host, rtol=2e-5)
+
+
+class _FakeDataset:
+    """Minimal Dataset stand-in: fixed random batches + pass_keys."""
+
+    def __init__(self, feed, seed, nbatches, ndev):
+        from paddlebox_tpu.data.slots import Instance
+        self.feed = feed
+        rng = np.random.default_rng(seed)
+        self._instances = []
+        for _ in range(nbatches):
+            batch = []
+            for _ in range(feed.batch_size):
+                batch.append(Instance(
+                    labels=np.asarray(
+                        [float(rng.integers(0, 2))], np.float32),
+                    sparse={s.name: rng.integers(1, 300, 1).astype(
+                        np.uint64) for s in feed.sparse_slots},
+                    dense={}))
+            self._instances.append(batch)
+
+    def pass_keys(self, slots=None):
+        return np.concatenate([
+            np.concatenate([ins.sparse[s] for s in ins.sparse])
+            for batch in self._instances for ins in batch])
+
+    def batches_sharded(self, ndev):
+        from paddlebox_tpu.data.slots import SlotBatch
+        for batch in self._instances:
+            yield SlotBatch.pack_sharded(batch, self.feed, ndev)

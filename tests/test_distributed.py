@@ -6,7 +6,6 @@ Mirrors the reference's localhost fake-cluster mechanism
 
 import os
 import socket
-import subprocess
 import sys
 import textwrap
 import threading

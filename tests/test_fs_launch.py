@@ -5,7 +5,6 @@ reference's localhost-fake-cluster test philosophy."""
 
 import os
 import stat
-import subprocess
 import sys
 import textwrap
 

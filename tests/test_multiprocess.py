@@ -1,0 +1,85 @@
+"""TRUE multi-process distributed test (VERDICT r02 task 4).
+
+Spawns 2 REAL OS processes via the production launcher
+(``python -m paddlebox_tpu.launch``), each owning one virtual CPU device,
+joined through ``bootstrap.initialize`` (jax.distributed with a real
+coordinator service and a real localhost socket between the processes),
+trains the tiny CTR config, and asserts loss parity against the
+single-process 2-virtual-device run of the exact same data — the
+reference's _run_cluster mechanism (``test_dist_base.py:1041``).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "mp_ctr_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _write_data(data_dir: str) -> None:
+    rng = np.random.default_rng(7)
+    os.makedirs(data_dir, exist_ok=True)
+    for b in range(3):
+        lines = []
+        for _ in range(64):
+            ids = rng.integers(1, 200, 3)
+            feats = " ".join(f"s{j}:{ids[j]}" for j in range(3))
+            lines.append(f"{rng.integers(0, 2)} {feats}")
+        with open(os.path.join(data_dir, f"part-{b}"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+def _single_process_reference(data_dir: str) -> list:
+    """Same worker payload, run in ONE subprocess with 2 virtual devices
+    (no jax.distributed) — the parity baseline."""
+    out = os.path.join(data_dir, "ref.json")
+    env = dict(os.environ)
+    env.pop("PBX_COORDINATOR", None)
+    env["PBX_NUM_PROCESSES"] = "1"
+    env["PBX_PROCESS_ID"] = "0"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess.run([sys.executable, WORKER, data_dir, out], env=env,
+                   cwd=REPO, check=True, timeout=420)
+    with open(out) as f:
+        return json.load(f)["losses"]
+
+
+@pytest.mark.slow
+def test_two_process_ctr_loss_parity(tmp_path):
+    data_dir = str(tmp_path / "data")
+    _write_data(data_dir)
+    out = str(tmp_path / "mp.json")
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # worker pins its own 1-device flag
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddlebox_tpu.launch", "--nproc", "2",
+         "--coordinator", f"127.0.0.1:{port}", WORKER, data_dir, out],
+        env=env, cwd=REPO, timeout=420, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"launcher failed rc={proc.returncode}\n--- stdout\n"
+        f"{proc.stdout[-3000:]}\n--- stderr\n{proc.stderr[-3000:]}")
+    with open(out) as f:
+        mp = json.load(f)
+    assert mp["nproc"] == 2 and mp["ndev"] == 2
+    ref = _single_process_reference(data_dir)
+    np.testing.assert_allclose(mp["losses"], ref, rtol=1e-5,
+                               err_msg="2-process run diverged from the "
+                                       "single-process 2-device run")
+    assert mp["losses"][1] < mp["losses"][0]
