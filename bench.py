@@ -35,21 +35,25 @@ def _sync(x) -> float:
     return float(np.asarray(x).ravel()[0])
 
 
-# Previously recorded numbers for vs_baseline ratios (BASELINE.md table;
-# update when a new round records a better number on the same hardware).
+# Previously recorded numbers for vs_baseline ratios (BASELINE.md
+# "measured" table; update when a new round records a number on the same
+# hardware).
 SELF_BASELINE = {
-    # round-2 first honest E2E measurement (v5e single chip) seeds this;
-    # None -> report vs_baseline = 1.0 (first recording).
-    "deepfm_e2e": None,
+    # Round-2 honest E2E measurement (v5e single chip via axon),
+    # BENCH_r02.json @ commit fb99701.
+    "deepfm_e2e": 8587.0,          # samples/s/chip
+    # Not yet recorded on the bench chip -> vs_baseline reports null.
     "resnet50": None,
     "bert_dp": None,
     "gpt": None,
 }
 
 
-def _vs(metric: str, value: float) -> float:
+def _vs(metric: str, value: float):
+    """Ratio vs our prior recorded number; None (JSON null) when no
+    baseline exists yet — 1.0 would misread as 'exactly at baseline'."""
     base = SELF_BASELINE.get(metric)
-    return round(value / base, 4) if base else 1.0
+    return round(value / base, 4) if base else None
 
 
 # ---------------------------------------------------------------------------

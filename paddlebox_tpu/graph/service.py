@@ -1,0 +1,365 @@
+"""Distributed graph service: CSR shards served over the typed wire.
+
+Role of the brpc graph PS (``distributed/ps/service/graph_brpc_server.h:40``
++ ``graph_brpc_client``): nodes are sharded ``node % num_servers``; each
+server holds the CSR rows of its nodes and answers upload/sample/feature
+RPCs; the client fans requests out by owner and reassembles in request
+order. Transport is the PS typed-frame protocol (``distributed/wire.py``
+— no pickle, version-checked; trusted cluster network).
+
+Sampling is DETERMINISTIC PER (seed, node, slot) via a counter hash, so
+results are independent of the shard layout — a 2-shard cluster returns
+bit-identical samples to a single-host table, which is what makes the
+fake-cluster parity test (and cross-layout reproducibility in prod)
+possible. The reference's GPU sampler draws from per-thread curand
+states, which it pays for with run-to-run nondeterminism.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.distributed import wire
+from paddlebox_tpu.distributed.transport import _recv_exact
+from paddlebox_tpu.graph.table import CSRGraph, GraphTable, build_csr
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15))
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+def sample_neighbors_host(g: CSRGraph, nodes: np.ndarray, k: int,
+                          seed: int) -> np.ndarray:
+    """[n, k] int64 neighbor samples (with replacement); -1 for isolated
+    nodes. Deterministic per (seed, node, slot) — shard-layout invariant."""
+    nodes = np.asarray(nodes, np.int64)
+    n = nodes.shape[0]
+    out = np.full((n, k), -1, np.int64)
+    in_range = (nodes >= 0) & (nodes < g.num_nodes)
+    deg = np.zeros((n,), np.int64)
+    safe = np.where(in_range, nodes, 0)
+    deg[in_range] = (g.indptr[safe + 1] - g.indptr[safe])[in_range]
+    has = deg > 0
+    if not has.any():
+        return out
+    v = nodes[has].astype(np.uint64)
+    with np.errstate(over="ignore"):
+        base = _mix64(v * np.uint64(0x9DDFEA08EB382D69)
+                      + np.uint64(seed))[:, None]
+        slot = np.arange(k, dtype=np.uint64)[None, :]
+        z = _mix64(base + slot * np.uint64(0xC2B2AE3D27D4EB4F))
+    idx = (z % deg[has].astype(np.uint64)[:, None]).astype(np.int64)
+    starts = g.indptr[nodes[has]].astype(np.int64)[:, None]
+    out[has] = g.cols[starts + idx]
+    return out
+
+
+class GraphServer:
+    """One graph shard: owns nodes with ``node % num_servers == index``
+    (role of GraphBrpcServer holding its partition's adjacency +
+    features)."""
+
+    def __init__(self, endpoint: str, index: int, num_servers: int):
+        self.index = index
+        self.num_servers = num_servers
+        self.table = GraphTable(num_shards=1)
+        # Edge staging: upload_batch appends, build finalizes to CSR.
+        self._pending: Dict[str, List] = {}
+        self._num_nodes: Dict[str, int] = {}
+        self._feat_rows: Dict[str, Dict[int, np.ndarray]] = {}
+        self._lock = threading.Lock()
+        host, port = endpoint.rsplit(":", 1)
+        self._server = socket.create_server((host, int(port)), backlog=32)
+        self.endpoint = f"{host}:{self._server.getsockname()[1]}"
+        self._running = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while True:
+                    ln = wire.read_frame_header(
+                        _recv_exact(conn, wire.HEADER.size))
+                    req = wire.loads(_recv_exact(conn, ln))
+                    try:
+                        out = getattr(self, "handle_" + req["method"])(req)
+                        conn.sendall(wire.pack_frame(
+                            {"ok": True, "result": out}))
+                    except Exception as e:
+                        log.vlog(0, "graph[%d] %s failed: %s", self.index,
+                                 req.get("method"), e)
+                        conn.sendall(wire.pack_frame(
+                            {"ok": False, "error": repr(e)}))
+        except wire.WireError as e:
+            log.warning("graph[%d] dropping connection on wire error: %s",
+                        self.index, e)
+            return
+        except (ConnectionError, OSError, EOFError):
+            return
+
+    # -- handlers ---------------------------------------------------------
+
+    def _check_owned(self, nodes: np.ndarray) -> None:
+        if nodes.size and not np.all(
+                nodes % self.num_servers == self.index):
+            raise ValueError(f"nodes not owned by graph shard {self.index}")
+
+    def handle_upload_batch(self, req) -> int:
+        """Append an edge batch whose SOURCE nodes this shard owns (role
+        of GraphTable upload_batch / load into the partition)."""
+        src = np.asarray(req["src"], np.int64)
+        dst = np.asarray(req["dst"], np.int64)
+        self._check_owned(src)
+        with self._lock:
+            self._pending.setdefault(req["edge_type"], []).append((src, dst))
+            self._num_nodes[req["edge_type"]] = max(
+                self._num_nodes.get(req["edge_type"], 0),
+                int(req["num_nodes"]))
+        return int(src.size)
+
+    def handle_build(self, req) -> int:
+        """Finalize an edge type's pending batches into the local CSR."""
+        et = req["edge_type"]
+        with self._lock:
+            parts = self._pending.pop(et, [])
+            if not parts:
+                return 0
+            src = np.concatenate([p[0] for p in parts])
+            dst = np.concatenate([p[1] for p in parts])
+            g = build_csr(src, dst, num_nodes=self._num_nodes[et])
+            self.table._graphs[et] = g
+        monitor.add("graph/edges_built", int(src.size))
+        return g.num_edges
+
+    def _graph_or_empty(self, edge_type: str) -> CSRGraph:
+        """A shard that received no edges for a type still answers — its
+        owned nodes are simply all isolated."""
+        g = self.table._graphs.get(edge_type)
+        if g is None:
+            n = max(self._num_nodes.get(edge_type, 0), 1)
+            g = build_csr(np.empty(0, np.int64), np.empty(0, np.int64),
+                          num_nodes=n)
+        return g
+
+    def handle_sample_neighbors(self, req) -> np.ndarray:
+        nodes = np.asarray(req["nodes"], np.int64)
+        self._check_owned(nodes)
+        g = self._graph_or_empty(req["edge_type"])
+        return sample_neighbors_host(g, nodes, int(req["k"]),
+                                     int(req["seed"]))
+
+    def handle_degrees(self, req) -> np.ndarray:
+        nodes = np.asarray(req["nodes"], np.int64)
+        self._check_owned(nodes)
+        g = self._graph_or_empty(req["edge_type"])
+        safe = np.clip(nodes, 0, g.num_nodes - 1)
+        deg = g.indptr[safe + 1] - g.indptr[safe]
+        return np.where((nodes >= 0) & (nodes < g.num_nodes), deg, 0)
+
+    def handle_set_node_feat(self, req) -> bool:
+        # Sharded feature rows: a per-name {node: row} map owned by the
+        # SERVICE (GraphTable._feats is dense-array-schema'd; mixing
+        # schemas would corrupt its own get/set API).
+        nodes = np.asarray(req["nodes"], np.int64)
+        self._check_owned(nodes)
+        vals = np.asarray(req["values"])
+        with self._lock:
+            store = self._feat_rows.setdefault(req["name"], {})
+            for nd, v in zip(nodes.tolist(), vals):
+                store[nd] = v
+        return True
+
+    def handle_get_node_feat(self, req) -> np.ndarray:
+        nodes = np.asarray(req["nodes"], np.int64)
+        self._check_owned(nodes)
+        store = self._feat_rows[req["name"]]
+        return np.stack([store[nd] for nd in nodes.tolist()])
+
+    def handle_stats(self, req) -> Dict[str, int]:
+        return {et: g.num_edges for et, g in self.table._graphs.items()}
+
+    def handle_stop(self, req) -> bool:
+        # Close the listener too — _running=False alone would leave the
+        # port bound and accepting until process exit.
+        self.stop()
+        return True
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+class GraphClient:
+    """Fan-out client (role of graph_brpc_client): requests shard by
+    ``node % num_servers`` and reassemble in request order."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        from concurrent.futures import ThreadPoolExecutor
+        self.endpoints = list(endpoints)
+        self.num_servers = len(self.endpoints)
+        self._socks: List[Optional[socket.socket]] = \
+            [None] * self.num_servers
+        self._locks = [threading.Lock() for _ in self.endpoints]
+        # Shard requests go out CONCURRENTLY (one in-flight RPC per
+        # server, serialized per-connection by the lock) — the brpc
+        # client's fan-out shape; a serial loop would pay num_servers
+        # round-trips per op.
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, self.num_servers),
+            thread_name_prefix="graph-client")
+
+    def _fanout(self, calls):
+        """calls: [(server, method, kwargs)] -> results in order."""
+        if len(calls) <= 1:
+            return [self._call(sv, m, **kw) for sv, m, kw in calls]
+        futs = [self._pool.submit(self._call, sv, m, **kw)
+                for sv, m, kw in calls]
+        return [f.result() for f in futs]
+
+    def _call(self, server: int, method: str, **kw):
+        with self._locks[server]:
+            if self._socks[server] is None:
+                host, port = self.endpoints[server].rsplit(":", 1)
+                self._socks[server] = socket.create_connection(
+                    (host, int(port)), timeout=60)
+            s = self._socks[server]
+            s.sendall(wire.pack_frame({"method": method, **kw}))
+            ln = wire.read_frame_header(_recv_exact(s, wire.HEADER.size))
+            resp = wire.loads(_recv_exact(s, ln))
+        if not resp["ok"]:
+            raise RuntimeError(f"graph[{server}].{method}: {resp['error']}")
+        return resp["result"]
+
+    def upload_batch(self, edge_type: str, src: np.ndarray,
+                     dst: np.ndarray, *, num_nodes: int) -> int:
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        total = 0
+        # Empty subsets are still sent: they register num_nodes so a
+        # shard owning only isolated nodes answers with -1 samples
+        # instead of erroring on an unknown edge type.
+        for sv in range(self.num_servers):
+            sel = (src % self.num_servers) == sv
+            total += self._call(sv, "upload_batch", edge_type=edge_type,
+                                src=src[sel], dst=dst[sel],
+                                num_nodes=int(num_nodes))
+        return total
+
+    def build(self, edge_type: str) -> int:
+        return sum(self._call(sv, "build", edge_type=edge_type)
+                   for sv in range(self.num_servers))
+
+    def _shard_sel(self, nodes: np.ndarray):
+        return [(sv, np.flatnonzero((nodes % self.num_servers) == sv))
+                for sv in range(self.num_servers)]
+
+    def sample_neighbors(self, edge_type: str, nodes: np.ndarray, k: int,
+                         *, seed: int = 0) -> np.ndarray:
+        nodes = np.asarray(nodes, np.int64)
+        out = np.full((nodes.shape[0], k), -1, np.int64)
+        shards = [(sv, sel) for sv, sel in self._shard_sel(nodes)
+                  if sel.size]
+        res = self._fanout([(sv, "sample_neighbors",
+                             dict(edge_type=edge_type, nodes=nodes[sel],
+                                  k=int(k), seed=int(seed)))
+                            for sv, sel in shards])
+        for (sv, sel), r in zip(shards, res):
+            out[sel] = r
+        return out
+
+    def degrees(self, edge_type: str, nodes: np.ndarray) -> np.ndarray:
+        nodes = np.asarray(nodes, np.int64)
+        out = np.zeros((nodes.shape[0],), np.int64)
+        shards = [(sv, sel) for sv, sel in self._shard_sel(nodes)
+                  if sel.size]
+        res = self._fanout([(sv, "degrees",
+                             dict(edge_type=edge_type, nodes=nodes[sel]))
+                            for sv, sel in shards])
+        for (sv, sel), r in zip(shards, res):
+            out[sel] = r
+        return out
+
+    def set_node_feat(self, name: str, nodes: np.ndarray,
+                      values: np.ndarray) -> None:
+        nodes = np.asarray(nodes, np.int64)
+        values = np.asarray(values)
+        shards = [(sv, sel) for sv, sel in self._shard_sel(nodes)
+                  if sel.size]
+        self._fanout([(sv, "set_node_feat",
+                       dict(name=name, nodes=nodes[sel],
+                            values=values[sel]))
+                      for sv, sel in shards])
+
+    def get_node_feat(self, name: str, nodes: np.ndarray) -> np.ndarray:
+        nodes = np.asarray(nodes, np.int64)
+        if nodes.size == 0:
+            return np.zeros((0,), np.float32)
+        shards = [(sv, sel) for sv, sel in self._shard_sel(nodes)
+                  if sel.size]
+        res = self._fanout([(sv, "get_node_feat",
+                             dict(name=name, nodes=nodes[sel]))
+                            for sv, sel in shards])
+        first = res[0]
+        out = np.zeros((nodes.shape[0],) + first.shape[1:], first.dtype)
+        for (sv, sel), vals in zip(shards, res):
+            out[sel] = vals
+        return out
+
+    def random_walk(self, edge_type: str, starts: np.ndarray, length: int,
+                    *, seed: int = 0) -> np.ndarray:
+        """[n, length+1] walks via per-hop fan-out sampling (each hop's
+        frontier may live on any shard — the client re-shards per hop,
+        role of the graph client driving multi-hop sampling)."""
+        starts = np.asarray(starts, np.int64)
+        walk = np.empty((starts.shape[0], length + 1), np.int64)
+        walk[:, 0] = starts
+        cur = starts
+        for h in range(length):
+            nxt = self.sample_neighbors(edge_type, cur, 1,
+                                        seed=seed + 1 + h)[:, 0]
+            # Dead ends stay in place (same convention as the device
+            # sampler's isolated-node handling).
+            nxt = np.where(nxt < 0, cur, nxt)
+            walk[:, h + 1] = nxt
+            cur = nxt
+        return walk
+
+    def stop_servers(self) -> None:
+        for sv in range(self.num_servers):
+            try:
+                self._call(sv, "stop")
+            except (RuntimeError, OSError, ConnectionError):
+                pass
+
+    def close(self) -> None:
+        for s in self._socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass

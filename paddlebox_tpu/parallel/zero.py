@@ -54,10 +54,13 @@ def zero_specs(tree: Any, mesh: Mesh, *, axis: str = "sharding",
 
 
 def zero_shardings(tree: Any, mesh: Mesh, *, axis: str = "sharding",
-                   min_size: int = 1 << 14) -> Any:
+                   min_size: int = 1 << 14,
+                   memory_kind: Optional[str] = None) -> Any:
     """NamedShardings version of :func:`zero_specs` (for device_put /
-    jit out_shardings)."""
-    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+    jit out_shardings). ``memory_kind`` pins the leaves to a device
+    memory space (e.g. ``"pinned_host"`` for optimizer-state offload)."""
+    kw = {} if memory_kind is None else {"memory_kind": memory_kind}
+    return jax.tree.map(lambda s: NamedSharding(mesh, s, **kw),
                         zero_specs(tree, mesh, axis=axis, min_size=min_size))
 
 
@@ -66,3 +69,81 @@ def shard_tree(tree: Any, mesh: Mesh, *, axis: str = "sharding",
     """device_put a pytree with ZeRO shardings (host → sharded HBM)."""
     sh = zero_shardings(tree, mesh, axis=axis, min_size=min_size)
     return jax.tree.map(jax.device_put, tree, sh)
+
+
+class OffloadedOptimizer:
+    """optax-compatible wrapper keeping the optimizer STATE in host memory.
+
+    Role of the reference's sharding optimizer-state offload (static
+    ``ShardingOptimizer`` offload pass,
+    ``fleet/meta_optimizers/sharding_optimizer.py:540-558`` +
+    ``sharding/offload_helper.py``): Adam moments etc. live in host
+    ("pinned_host") memory, crossing into HBM only transiently around the
+    update — HBM holds ~zero optimizer-state bytes between steps, buying
+    headroom for params/activations at the cost of PCIe/host-link traffic
+    per update (the reference makes the same trade with cudaMallocHost
+    buffers).
+
+    The wrapped ``update`` is its OWN jitted program whose state inputs
+    and outputs are pinned to ``memory_kind`` via shardings (sharded over
+    ``axis`` where divisible — ZeRO-1/2 placement — so each host stores
+    only its shard). Use exactly like the wrapped optax transformation:
+
+        tx = OffloadedOptimizer(optax.adam(1e-3), mesh)
+        state = tx.init(params)          # state leaves on pinned_host
+        updates, state = tx.update(grads, state, params)
+    """
+
+    def __init__(self, tx, mesh: Mesh, *, axis: str = "sharding",
+                 min_size: int = 0, memory_kind: str = "pinned_host"):
+        self._tx = tx
+        self._mesh = mesh
+        self._axis = axis
+        self._min_size = min_size
+        self._memory_kind = memory_kind
+        self._jit_update = None
+
+    def _state_shardings(self, state: Any) -> Any:
+        """Host-pinned shardings for array leaves; SCALAR leaves (e.g.
+        adam's step count) stay in device memory — they are bytes, and
+        XLA's SPMD partitioner rejects host-placement annotations on
+        scalars under a mesh."""
+        host = zero_shardings(state, self._mesh, axis=self._axis,
+                              min_size=self._min_size,
+                              memory_kind=self._memory_kind)
+        dev = zero_shardings(state, self._mesh, axis=self._axis,
+                             min_size=self._min_size)
+        return jax.tree.map(
+            lambda x, h, d: d if np.ndim(x) == 0 else h, state, host, dev)
+
+    def init(self, params: Any) -> Any:
+        state = self._tx.init(params)
+        return jax.tree.map(jax.device_put, state,
+                            self._state_shardings(state))
+
+    def update(self, grads: Any, state: Any, params: Any = None):
+        # Stage host → device OUTSIDE the jitted program (XLA's SPMD
+        # partitioner currently rejects memory-space annotations mixed
+        # with scalar outputs inside one program); the update itself is a
+        # plain all-device jitted call, then the new state streams back
+        # to its host pinning. The per-step cost is the two transfers —
+        # inherent to offload (the reference pays the same PCIe trips,
+        # offload_helper.py).
+        if self._jit_update is None:
+            dev_sh = zero_shardings(state, self._mesh, axis=self._axis,
+                                    min_size=self._min_size)
+            self._dev_sh = dev_sh
+            self._host_sh = self._state_shardings(state)
+            # No donation: scalar leaves pass through the staging map
+            # uncopied, and donating them would delete the caller's state
+            # buffers (optax's contract leaves the input state readable).
+            self._jit_update = jax.jit(
+                lambda g, s, p: self._tx.update(g, s, p))
+        s_dev = jax.tree.map(
+            lambda x, d: x if np.ndim(x) == 0 else jax.device_put(x, d),
+            state, self._dev_sh)
+        updates, new_state = self._jit_update(grads, s_dev, params)
+        new_state = jax.tree.map(
+            lambda x, h: x if np.ndim(x) == 0 else jax.device_put(x, h),
+            new_state, self._host_sh)
+        return updates, new_state
