@@ -208,6 +208,10 @@ define_flag("padbox_max_shuffle_wait_count", 16,
 define_flag("dense_sync_steps", 1,
             "k-step dense parameter sync interval in BoxPS-style training "
             "(role of BoxPSWorker::SyncParam sync_step)")
+define_flag("sparse_scatter_kernel", "auto",
+            "push-side scatter-accumulate backend: 'auto' (Pallas sorted "
+            "kernel on TPU, XLA scatter elsewhere), 'pallas', 'interpret' "
+            "(Pallas interpreter — tests), or 'xla'")
 define_flag("wuauc_spill_records", 4_000_000,
             "per-user-AUC raw records held in RAM before spilling to "
             "uid-hash bucket files on disk (bounds eval-pass host memory; "

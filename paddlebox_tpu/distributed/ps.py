@@ -322,8 +322,18 @@ class PSClient:
     def _call(self, server: int, method: str, **kw):
         with self._locks[server]:
             sock = self._sock(server)
-            _send_msg(sock, {"method": method, **kw})
-            resp = _recv_msg(sock)
+            try:
+                _send_msg(sock, {"method": method, **kw})
+                resp = _recv_msg(sock)
+            except (OSError, ConnectionError, wire.WireError):
+                # A timed-out / half-read / desynced stream cannot be
+                # reused — drop it so the next call reconnects cleanly.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._socks[server] = None
+                raise
         if not resp["ok"]:
             raise RuntimeError(f"ps[{server}].{method}: {resp['error']}")
         return resp["result"]

@@ -155,6 +155,31 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
     }
 
 
+def _accumulate(rows: jax.Array, payload: jax.Array,
+                block: int) -> jax.Array:
+    """zeros([block, AW]).at[rows].add(payload) by the configured backend
+    (``sparse_scatter_kernel`` flag): the Pallas sorted-stream kernel
+    (CopyForPush role — XLA TPU scatter is the step's dominant cost,
+    PROFILE.md) or the XLA scatter. Trash-row entries (row == block-1:
+    padding/overflow, all-zero or count-only payload) are dropped on the
+    kernel path — apply_accumulated re-zeroes the trash row either way,
+    and concentrating every padding lane on one row is exactly the skew
+    the kernel's per-block budget must not pay for."""
+    mode = flags.flag("sparse_scatter_kernel")
+    use_pallas = mode in ("pallas", "interpret") or (
+        mode == "auto" and jax.default_backend() == "tpu")
+    if not use_pallas:
+        acc = jnp.zeros((block, payload.shape[-1]), payload.dtype)
+        return acc.at[rows].add(payload)
+    from paddlebox_tpu.ops.pallas_kernels.sorted_scatter import (
+        sorted_scatter_accumulate)
+    trash = block - 1
+    rows_k = jnp.where(rows == trash, block, rows).astype(jnp.int32)
+    acc = sorted_scatter_accumulate(rows_k, payload, block,
+                                    interpret=(mode == "interpret"))
+    return acc
+
+
 def apply_accumulated(vals: jax.Array, acc: jax.Array, *, dim: int,
                       ke: int, block: int,
                       opt: SparseOptimizer) -> jax.Array:
@@ -230,8 +255,7 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
         jnp.ones((n, 1), grad_emb.dtype)], axis=-1)
 
     if num_shards == 1:
-        acc = jnp.zeros((block, aw), payload.dtype)
-        acc = acc.at[dev_rows].add(payload)
+        acc = _accumulate(dev_rows, payload, block)
         new_vals = apply_accumulated(table.vals, acc, dim=d, ke=ke,
                                      block=block, opt=opt)
         return PassTable(vals=new_vals, rows_per_shard=table.rows_per_shard,
@@ -255,8 +279,7 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
 
     # Owner-side accumulate (role of dynamic_merge_grad): filler cells
     # point at the trash row with all-zero payload, so they are no-ops.
-    acc = jnp.zeros((block, aw), payload.dtype)
-    acc = acc.at[recv_rows].add(recv_payload)
+    acc = _accumulate(recv_rows, recv_payload, block)
     new_vals = apply_accumulated(table.vals, acc, dim=d, ke=ke,
                                  block=block, opt=opt)
     return PassTable(vals=new_vals, rows_per_shard=table.rows_per_shard,

@@ -187,11 +187,27 @@ class GraphServer:
                 store[nd] = v
         return True
 
-    def handle_get_node_feat(self, req) -> np.ndarray:
+    def handle_get_node_feat(self, req) -> Dict[str, np.ndarray]:
+        """Rows for owned nodes; nodes (or whole names) this shard never
+        saw serve zeros — consistent with the rest of the stack (unknown
+        embedding keys, isolated graph nodes). ``width`` is -1 when the
+        name is unknown here so the client can resolve the row shape from
+        a shard that knows it."""
         nodes = np.asarray(req["nodes"], np.int64)
         self._check_owned(nodes)
-        store = self._feat_rows[req["name"]]
-        return np.stack([store[nd] for nd in nodes.tolist()])
+        store = self._feat_rows.get(req["name"])
+        if not store:
+            return {"width": -1,
+                    "rows": np.zeros((nodes.shape[0], 0), np.float32)}
+        sample = next(iter(store.values()))
+        out = np.zeros((nodes.shape[0],) + np.shape(sample),
+                       np.asarray(sample).dtype)
+        for i, nd in enumerate(nodes.tolist()):
+            v = store.get(nd)
+            if v is not None:
+                out[i] = v
+        return {"width": int(np.shape(sample)[0]) if np.ndim(sample)
+                else 0, "rows": out}
 
     def handle_stats(self, req) -> Dict[str, int]:
         return {et: g.num_edges for et, g in self.table._graphs.items()}
@@ -248,9 +264,20 @@ class GraphClient:
                 self._socks[server] = socket.create_connection(
                     (host, int(port)), timeout=60)
             s = self._socks[server]
-            s.sendall(wire.pack_frame({"method": method, **kw}))
-            ln = wire.read_frame_header(_recv_exact(s, wire.HEADER.size))
-            resp = wire.loads(_recv_exact(s, ln))
+            try:
+                s.sendall(wire.pack_frame({"method": method, **kw}))
+                ln = wire.read_frame_header(
+                    _recv_exact(s, wire.HEADER.size))
+                resp = wire.loads(_recv_exact(s, ln))
+            except (OSError, ConnectionError, wire.WireError):
+                # A timed-out / half-read / desynced stream cannot be
+                # reused — drop it so the next call reconnects cleanly.
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                self._socks[server] = None
+                raise
         if not resp["ok"]:
             raise RuntimeError(f"graph[{server}].{method}: {resp['error']}")
         return resp["result"]
@@ -324,10 +351,14 @@ class GraphClient:
         res = self._fanout([(sv, "get_node_feat",
                              dict(name=name, nodes=nodes[sel]))
                             for sv, sel in shards])
-        first = res[0]
+        known = [r for r in res if r["width"] >= 0]
+        if not known:
+            raise KeyError(f"node feature {name!r} unknown on every shard")
+        first = known[0]["rows"]
         out = np.zeros((nodes.shape[0],) + first.shape[1:], first.dtype)
-        for (sv, sel), vals in zip(shards, res):
-            out[sel] = vals
+        for (sv, sel), r in zip(shards, res):
+            if r["width"] >= 0:
+                out[sel] = r["rows"]
         return out
 
     def random_walk(self, edge_type: str, starts: np.ndarray, length: int,

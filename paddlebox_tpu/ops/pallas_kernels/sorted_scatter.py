@@ -1,0 +1,149 @@
+"""Sorted segment scatter-accumulate — the CopyForPush-class kernel.
+
+Role of the reference's push-side CUDA kernels (``box_wrapper.cu``
+CopyForPush + ``heter_comm`` dynamic_merge_grad): merge a batch of
+per-occurrence sparse updates into a per-row accumulator at memory
+bandwidth. XLA's TPU scatter costs ~7 ns/element regardless of hints
+(PROFILE.md) — ~55 ms for the bench step's 426K×20 update. This kernel
+instead SORTS the updates by destination row (XLA sort — cheap) and
+streams the accumulator through VMEM one block at a time, applying each
+block's contiguous run of updates with in-VMEM dynamic-row adds.
+
+    acc = sorted_scatter_accumulate(rows, payload, num_rows)
+    # == jnp.zeros((num_rows, AW)).at[rows].add(payload)  (exact)
+
+Updates whose row == ``num_rows`` (or anything >= the padded row bound)
+are DROPPED — callers use that as the padding/trash sentinel.
+
+Skew guard: per-block update counts are data-dependent; if any block's
+run exceeds the static per-block budget (a pathologically hot row), the
+caller's wrapper falls back to the XLA scatter via ``lax.cond`` — the
+kernel itself never reads past its budget.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Rows per accumulator block streamed through VMEM. f32 lane padding makes
+# a [BLOCK, AW<=128] block cost BLOCK*128*4 bytes of VMEM (~4 MB at 8192).
+BLOCK = 8192
+# Static per-block update budget (DMA slice size). Uniform-hash rows give
+# ~n/nblocks per block; 4096 covers the binomial tail by orders of
+# magnitude — overflow means a genuinely hot row, handled by fallback.
+UCAP = 4096
+
+
+def _kernel(starts_ref, rows_ref, payload_ref, acc_ref, rows_s, pay_s,
+            sem0, sem1):
+    b = pl.program_id(0)
+    lo = starts_ref[b]
+    cnt = starts_ref[b + 1] - lo
+
+    # Stage this block's run of (row, payload) updates into VMEM. The
+    # inputs are padded by UCAP rows so the fixed-size slice never reads
+    # out of bounds.
+    dma0 = pltpu.make_async_copy(rows_ref.at[pl.ds(lo, UCAP)], rows_s,
+                                 sem0)
+    dma1 = pltpu.make_async_copy(payload_ref.at[pl.ds(lo, UCAP), :],
+                                 pay_s, sem1)
+    dma0.start()
+    dma1.start()
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    dma0.wait()
+    dma1.wait()
+
+    base = b * BLOCK
+
+    def body(j, _):
+        r = rows_s[j] - base
+        acc_ref[r, :] += pay_s[j, :]
+        return 0
+
+    lax.fori_loop(0, jnp.minimum(cnt, UCAP), body, 0)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _sorted_accumulate(sorted_rows: jax.Array, sorted_payload: jax.Array,
+                       rows_pad: int, interpret: bool) -> jax.Array:
+    npad, aw = sorted_payload.shape
+    nblocks = rows_pad // BLOCK
+    boundaries = jnp.arange(nblocks + 1, dtype=jnp.int32) * BLOCK
+    starts = jnp.searchsorted(sorted_rows, boundaries).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),      # sorted rows (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),      # payload (HBM)
+        ],
+        out_specs=pl.BlockSpec((BLOCK, aw), lambda b, starts: (b, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((UCAP,), jnp.int32),
+            pltpu.VMEM((UCAP, aw), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows_pad, aw), jnp.float32),
+        interpret=interpret,
+    )(starts, sorted_rows, sorted_payload)
+
+
+def sorted_scatter_accumulate(rows: jax.Array, payload: jax.Array,
+                              num_rows: int, *,
+                              interpret: bool = False) -> jax.Array:
+    """zeros([num_rows, AW]).at[rows].add(payload), exactly — via sort +
+    VMEM-streamed accumulation. rows [n] int32 (entries >= num_rows are
+    dropped); payload [n, AW] float32. Falls back to the XLA scatter when
+    a block's update run exceeds the kernel budget (hot row)."""
+    n, aw = payload.shape
+    rows_pad = -(-num_rows // BLOCK) * BLOCK
+
+    # Dropped rows (>= num_rows) are remapped to rows_pad so they sort
+    # PAST the last block boundary. Leaving them in [num_rows, rows_pad)
+    # would count them in the last block's run — and since droppers
+    # concentrate (every padding lane carries the same sentinel), that
+    # would trip the hot-row fallback on every call for any num_rows not
+    # a multiple of BLOCK.
+    rows = jnp.where(rows >= num_rows, rows_pad, rows)
+    order = jnp.argsort(rows)
+    sorted_rows = rows[order].astype(jnp.int32)
+    sorted_payload = payload[order].astype(jnp.float32)
+    # Pad by UCAP so the kernel's fixed-size DMA slices stay in bounds;
+    # pad rows use the drop sentinel.
+    sorted_rows = jnp.concatenate(
+        [sorted_rows, jnp.full((UCAP,), rows_pad, jnp.int32)])
+    sorted_payload = jnp.concatenate(
+        [sorted_payload, jnp.zeros((UCAP, aw), jnp.float32)])
+
+    nblocks = rows_pad // BLOCK
+    boundaries = jnp.arange(nblocks + 1, dtype=jnp.int32) * BLOCK
+    # Padding entries (== rows_pad) sort past the last boundary and fall
+    # in no block; the same holds for dropped (sentinel) rows.
+    starts = jnp.searchsorted(sorted_rows, boundaries)
+    max_run = jnp.max(starts[1:] - starts[:-1])
+
+    def pallas_path(_):
+        acc = _sorted_accumulate(sorted_rows, sorted_payload, rows_pad,
+                                 interpret)
+        return acc[:num_rows]
+
+    def xla_path(_):
+        keep = rows < num_rows
+        safe = jnp.where(keep, rows, 0)
+        contrib = jnp.where(keep[:, None], payload, 0.0)
+        return jnp.zeros((num_rows, aw), jnp.float32).at[safe].add(contrib)
+
+    return lax.cond(max_run <= UCAP, pallas_path, xla_path, operand=None)

@@ -1,0 +1,4 @@
+from paddlebox_tpu.serving.predictor import (CTRPredictor,
+                                             load_xbox_model)
+
+__all__ = ["CTRPredictor", "load_xbox_model"]

@@ -1,0 +1,163 @@
+"""Online-serving predictor over xbox model exports.
+
+Role of the inference half of the reference stack for the CTR production
+loop (SURVEY.md L12 — `paddle/fluid/inference/` is scoped to serving the
+trained artifacts): the training side ships per-pass **xbox** exports
+(``save_xbox_base_model``, fleet_util.py:774 — {key → emb, w} only, no
+optimizer state) and the online service answers prediction requests from
+them. Here: load the xbox npz (any store tier wrote it — host, sharded,
+or device), build a device-resident serving table (fused [rows, D+1]
+record + native key index), and run a jitted batch forward.
+
+TPU-first: the serving lookup is the same pass-table machinery as
+training — host key→row map (C++ hash, native/store.cc), one device
+gather, jitted model forward in bf16 — so a model served here is
+bit-compatible with what training evaluated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.native import store_py as native_store
+
+
+def load_xbox_model(path: str, table: str = "embedding"
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(keys, emb [n, D], w [n]) from an xbox export directory — flat
+    (`<table>.xbox.npz`) or sharded (`bucket-*/`, `part-*/`, `dim*/`
+    subdirectories are concatenated)."""
+    flat = os.path.join(path, f"{table}.xbox.npz")
+    if os.path.exists(flat):
+        data = np.load(flat)
+        return (data["keys"].astype(np.uint64), data["emb"], data["w"])
+    parts = sorted(
+        d for d in os.listdir(path)
+        if os.path.isdir(os.path.join(path, d))
+        and (d.startswith("bucket-") or d.startswith("part-")
+             or d.startswith("dim")))
+    if not parts:
+        raise FileNotFoundError(f"no xbox export for {table!r} under {path}")
+    ks, es, ws = [], [], []
+    for d in parts:
+        k, e, w = load_xbox_model(os.path.join(path, d), table)
+        ks.append(k)
+        es.append(e)
+        ws.append(w)
+    return np.concatenate(ks), np.concatenate(es), np.concatenate(ws)
+
+
+class CTRPredictor:
+    """Batch CTR inference over an xbox-exported sparse model + dense
+    params (role of the inference engine serving a BoxPS-trained model).
+
+    ``model`` is the same functional model the trainer used (DeepFM,
+    WideDeep, ...); ``dense_params`` its trained dense pytree. Unknown
+    feasigns serve zero embeddings (a feature the trainer never saw
+    contributes nothing — the reference's serving tier does the same for
+    evicted/unseen keys).
+    """
+
+    def __init__(self, model, feed_config, keys: np.ndarray,
+                 emb: np.ndarray, w: np.ndarray, dense_params,
+                 *, compute_dtype: str = "bfloat16"):
+        self.model = model
+        self.feed = feed_config
+        order = np.argsort(keys, kind="stable")
+        self._index = native_store.KeyIndex()
+        rows, n_new = self._index.upsert(
+            np.ascontiguousarray(keys[order], np.uint64))
+        if n_new != keys.shape[0]:
+            raise ValueError("duplicate keys in xbox export")
+        d = emb.shape[1]
+        # Fused serving record [emb | w], one zero row appended for
+        # unknown keys (row == n).
+        fused = np.zeros((keys.shape[0] + 1, d + 1), np.float32)
+        fused[:-1, :d] = emb[order]
+        fused[:-1, d] = w[order]
+        self._table = jnp.asarray(fused)
+        self._dense_params = dense_params
+        self._dim = d
+        self._cdt = dict(float32=jnp.float32,
+                         bfloat16=jnp.bfloat16)[compute_dtype]
+        self._slot_names = [s.name for s in feed_config.sparse_slots]
+        # Jitted forwards keyed by (caps, batch_size): the traced slicing
+        # closes over them, so a batch with different shapes needs its
+        # own trace — reusing the first would mis-slice silently.
+        self._fwd_cache: Dict[tuple, object] = {}
+
+    @classmethod
+    def from_dirs(cls, model, feed_config, xbox_path: str,
+                  dense_path: Optional[str] = None, *,
+                  table: str = "embedding", dense_params=None,
+                  dense_template=None, **kw) -> "CTRPredictor":
+        """Load from a training run's artifacts: the xbox sparse export +
+        a dense checkpoint (``checkpoint.dense.save_pytree`` format, with
+        ``dense_template`` = a freshly-init'd param pytree)."""
+        keys, emb, w = load_xbox_model(xbox_path, table)
+        if dense_params is None:
+            if dense_path is None or dense_template is None:
+                raise ValueError(
+                    "need dense_params, or dense_path + dense_template")
+            from paddlebox_tpu.checkpoint.dense import load_pytree
+            dense_params = load_pytree(dense_template, dense_path)
+        return cls(model, feed_config, keys, emb, w, dense_params, **kw)
+
+    def _build_fwd(self, caps: Dict[str, int], bs: int):
+        model = self.model
+        d = self._dim
+        cdt = self._cdt
+        names = self._slot_names
+
+        def cast(t):
+            return jax.tree.map(
+                lambda x: x.astype(cdt)
+                if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, t)
+
+        def fwd(table, params, rows, segments, dense_feats):
+            picked = table[rows]                      # [sum caps, D+1]
+            off = 0
+            emb: Dict[str, jax.Array] = {}
+            w: Dict[str, jax.Array] = {}
+            for nme in names:
+                sl = slice(off, off + caps[nme])
+                emb[nme] = cast(picked[sl, :d])
+                w[nme] = cast(picked[sl, d])
+                off += caps[nme]
+            logits = model.apply(cast(params), emb, w, segments,
+                                 batch_size=bs,
+                                 dense_feats=cast(dense_feats))
+            return jax.nn.sigmoid(logits.astype(jnp.float32))
+
+        return jax.jit(fwd)
+
+    def predict(self, batch) -> np.ndarray:
+        """SlotBatch -> CTR probabilities [batch_size] (invalid/padding
+        rows yield whatever the model does on zeros — mask with
+        batch.valid if needed)."""
+        from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
+        caps = {n: batch.ids[n].shape[0] for n in self._slot_names}
+        bs = batch.batch_size
+        key = (tuple(sorted(caps.items())), bs)
+        fwd = self._fwd_cache.get(key)
+        if fwd is None:
+            fwd = self._fwd_cache[key] = self._build_fwd(caps, bs)
+        all_ids = np.concatenate(
+            [batch.ids[n] for n in self._slot_names])
+        rows = self._index.lookup(all_ids)
+        n_tab = self._table.shape[0] - 1
+        rows = np.where(rows < 0, n_tab, rows).astype(np.int32)
+        segs = {n: jnp.asarray(batch.segments[n])
+                for n in self._slot_names}
+        monitor.add("serving/requests", bs)
+        probs = fwd(self._table, self._dense_params,
+                    jnp.asarray(rows), segs,
+                    jnp.asarray(_concat_dense_host(batch)))
+        return np.asarray(probs)

@@ -1,0 +1,130 @@
+"""sorted_scatter_accumulate (CopyForPush-class Pallas kernel) vs the XLA
+scatter reference — interpret mode on CPU; same code compiles for TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.ops.pallas_kernels.sorted_scatter import (
+    BLOCK, UCAP, sorted_scatter_accumulate)
+
+
+def _ref(rows, payload, num_rows):
+    keep = rows < num_rows
+    safe = np.where(keep, rows, 0)
+    contrib = np.where(keep[:, None], payload, 0.0)
+    out = np.zeros((num_rows, payload.shape[1]), np.float32)
+    np.add.at(out, safe, contrib)
+    out[~np.isin(np.arange(num_rows), rows[keep])] *= 1.0
+    # np.add.at added dropped rows' zero contribs at row 0 — they're zero.
+    return out
+
+
+@pytest.mark.parametrize("num_rows,n", [(BLOCK, 1000),
+                                        (3 * BLOCK + 17, 20_000)])
+def test_matches_xla_scatter(num_rows, n):
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, num_rows, n).astype(np.int32)
+    payload = rng.normal(size=(n, 12)).astype(np.float32)
+    got = sorted_scatter_accumulate(jnp.asarray(rows),
+                                    jnp.asarray(payload), num_rows,
+                                    interpret=True)
+    np.testing.assert_allclose(np.asarray(got), _ref(rows, payload,
+                                                     num_rows),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sentinel_rows_dropped():
+    rng = np.random.default_rng(1)
+    num_rows = BLOCK
+    rows = rng.integers(0, num_rows, 500).astype(np.int32)
+    # A third of entries carry the drop sentinel (trash/padding).
+    rows[::3] = num_rows
+    payload = rng.normal(size=(500, 8)).astype(np.float32)
+    got = sorted_scatter_accumulate(jnp.asarray(rows),
+                                    jnp.asarray(payload), num_rows,
+                                    interpret=True)
+    np.testing.assert_allclose(np.asarray(got), _ref(rows, payload,
+                                                     num_rows),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_hot_row_falls_back_to_xla_scatter():
+    """More than UCAP updates on one row: the kernel budget would
+    overflow, so the cond must take the exact XLA path."""
+    rng = np.random.default_rng(2)
+    num_rows = BLOCK
+    n = UCAP + 2048
+    rows = np.full((n,), 7, np.int32)        # everything hits row 7
+    payload = rng.normal(size=(n, 4)).astype(np.float32)
+    got = sorted_scatter_accumulate(jnp.asarray(rows),
+                                    jnp.asarray(payload), num_rows,
+                                    interpret=True)
+    ref = _ref(rows, payload, num_rows)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_push_local_kernel_path_matches_xla(monkeypatch):
+    """Full push_local through the Pallas (interpret) accumulate equals
+    the XLA-scatter path — table values, states, and stats."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.core import flags as flagmod
+    from paddlebox_tpu.embedding.lookup import push_local
+    from paddlebox_tpu.embedding.optimizers import SparseAdagrad
+    from paddlebox_tpu.embedding.table import PassTable
+
+    rng = np.random.default_rng(3)
+    rps, d = 300, 4
+    ke, kw = 1, 1
+    w_width = d + 3 + ke + kw
+    vals = rng.normal(size=(rps + 1, w_width)).astype(np.float32)
+    vals[rps, :d + 3] = 0.0          # trash row pull columns zero
+    n = 256
+    rows = rng.integers(0, rps, n).astype(np.int32)
+    rows[::5] = rps                  # padding entries -> trash row
+    g_emb = rng.normal(size=(n, d)).astype(np.float32)
+    g_w = rng.normal(size=(n,)).astype(np.float32)
+    shows = (rows != rps).astype(np.float32)
+    clicks = shows * (rng.random(n) < 0.4)
+    g_emb[rows == rps] = 0.0
+    g_w[rows == rps] = 0.0
+
+    def run(mode):
+        flagmod.set_flags({"sparse_scatter_kernel": mode})
+        try:
+            table = PassTable(vals=jnp.asarray(vals), rows_per_shard=rps,
+                              num_shards=1, dim=d, ke=ke, kw=kw)
+            out = push_local(table, jnp.asarray(rows), jnp.asarray(g_emb),
+                             jnp.asarray(g_w), jnp.asarray(shows),
+                             jnp.asarray(clicks), axis="dp",
+                             opt=SparseAdagrad())
+            return np.asarray(out.vals)
+        finally:
+            flagmod.set_flags({"sparse_scatter_kernel": "auto"})
+
+    a = run("xla")
+    b = run("interpret")
+    # Trash-row optimizer state may differ (kernel drops trash updates;
+    # the XLA path counts them) — everything consumable must match.
+    np.testing.assert_allclose(b[:rps], a[:rps], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(b[rps, :d + 3], a[rps, :d + 3], atol=0)
+
+
+def test_sentinel_stays_off_the_books_at_non_multiple_num_rows():
+    """num_rows NOT a multiple of BLOCK + thousands of concentrated
+    sentinel entries: they must neither corrupt the result nor count
+    toward any block's run (which would permanently force the XLA
+    fallback)."""
+    rng = np.random.default_rng(4)
+    num_rows = BLOCK + 1           # rows_per_shard+1 shape, the real case
+    n = 9000                       # > UCAP sentinels if they clustered
+    rows = rng.integers(0, num_rows, n).astype(np.int32)
+    rows[::2] = num_rows           # half the entries are padding
+    payload = rng.normal(size=(n, 6)).astype(np.float32)
+    got = sorted_scatter_accumulate(jnp.asarray(rows),
+                                    jnp.asarray(payload), num_rows,
+                                    interpret=True)
+    np.testing.assert_allclose(np.asarray(got),
+                               _ref(rows, payload, num_rows),
+                               rtol=1e-5, atol=1e-5)
