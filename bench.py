@@ -46,6 +46,7 @@ SELF_BASELINE = {
     "resnet50": None,
     "bert_dp": None,
     "gpt": None,
+    "wide_deep": None,
 }
 
 
@@ -100,25 +101,31 @@ def _prepopulate_store(trainer, n_keys: int, chunk: int = 10_000_000) -> float:
 
 
 def _gen_pass_files(tmpdir: str, rng, pass_keys: np.ndarray,
-                    n_batches: int) -> list:
-    """Write n_batches*BATCH svm-format lines across part files (one per
-    batch) — ids drawn from the pass working set, 13 dense features.
+                    n_batches: int, *, batch: int = None,
+                    n_slots: int = None, dense_dim: int = None,
+                    label_rate: float = 0.25) -> list:
+    """Write n_batches*batch svm-format lines across part files (one per
+    batch) — ids drawn from the pass working set, optional dense block.
     Vectorized string assembly (np.char): a per-line Python loop takes
     minutes at 1M+ lines on one core."""
+    batch = BATCH if batch is None else batch
+    n_slots = NUM_SLOTS if n_slots is None else n_slots
+    dense_dim = DENSE_DIM if dense_dim is None else dense_dim
     files = []
     for b in range(n_batches):
-        ids = rng.choice(pass_keys, (BATCH, NUM_SLOTS))
-        labels = (rng.random(BATCH) < 0.25).astype(np.int32)
-        dense = (rng.random((BATCH, DENSE_DIM)) * 10000).astype(np.int32)
+        ids = rng.choice(pass_keys, (batch, n_slots))
+        labels = (rng.random(batch) < label_rate).astype(np.int32)
         line = labels.astype("U1")
-        for j in range(NUM_SLOTS):
+        for j in range(n_slots):
             line = np.char.add(line, f" s{j}:")
             line = np.char.add(line, ids[:, j].astype("U20"))
-        line = np.char.add(line, " d:0.")
-        line = np.char.add(line, dense[:, 0].astype("U5"))
-        for j in range(1, DENSE_DIM):
-            line = np.char.add(line, ",0.")
-            line = np.char.add(line, dense[:, j].astype("U5"))
+        if dense_dim:
+            dense = (rng.random((batch, dense_dim)) * 10000).astype(np.int32)
+            line = np.char.add(line, " d:0.")
+            line = np.char.add(line, dense[:, 0].astype("U5"))
+            for j in range(1, dense_dim):
+                line = np.char.add(line, ",0.")
+                line = np.char.add(line, dense[:, j].astype("U5"))
         path = os.path.join(tmpdir, f"part-{b:05d}")
         with open(path, "w") as f:
             f.write("\n".join(line.tolist()) + "\n")
@@ -440,11 +447,105 @@ def bench_gpt() -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Wide&Deep CTR (BASELINE.md config 5): the HeterPS-style path — CVM
+# (show/click) features flowing through the pull, device-resident store.
+# ---------------------------------------------------------------------------
+
+def bench_wide_deep() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.data.dataset import Dataset
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.embedding import DeviceFeatureStore, TableConfig
+    from paddlebox_tpu.models.wide_deep import WideDeep
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+    ndev = len(jax.devices())
+    mesh = build_mesh(HybridTopology(dp=ndev))
+    n_slots, emb_dim, batch = 20, 8, 8192
+    store_keys, pass_keys_n, n_batches = 10_000_000, 1_000_000, 32
+    slots = tuple(SlotConf(f"s{i}", avg_len=1.0) for i in range(n_slots))
+    feed = DataFeedConfig(slots=slots, batch_size=batch,
+                          slot_capacity_slack=1.0)
+    model = WideDeep(slot_names=tuple(f"s{i}" for i in range(n_slots)),
+                     emb_dim=emb_dim, hidden=(256, 128))
+    trainer = CTRTrainer(
+        model, feed, TableConfig(dim=emb_dim, learning_rate=0.05),
+        mesh=mesh,
+        config=TrainerConfig(auc_num_buckets=1 << 16,
+                             compute_dtype="bfloat16"),
+        store_factory=lambda cfg: DeviceFeatureStore(
+            cfg, mesh=mesh, capacity_hint=store_keys + pass_keys_n))
+    trainer.init(seed=0)
+    build_keys_per_s = _prepopulate_store(trainer, store_keys)
+    rng = np.random.default_rng(0)
+    pass_keys = rng.choice(np.arange(1, store_keys, dtype=np.uint64),
+                           size=pass_keys_n, replace=False)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        files = _gen_pass_files(tmpdir, rng, pass_keys, n_batches,
+                                batch=batch, n_slots=n_slots, dense_dim=0,
+                                label_rate=0.2)
+        dataset = Dataset(feed, num_reader_threads=4)
+        dataset.set_filelist(files)
+        dataset.preload_into_memory()
+        # Compile warmup at the TIMED pass's table size: feed the full
+        # pass key set (same pow2 bucket), run the jitted step twice on
+        # one batch, close the pass — the timed pass then reuses the
+        # compiled program (same discipline as bench_deepfm).
+        ds_warm = Dataset(feed, num_reader_threads=2)
+        ds_warm.set_filelist(files[:1])
+        ds_warm.load_into_memory()
+        batch0 = next(ds_warm.batches_sharded(ndev))
+        eng = trainer.engine
+        eng.feed_pass([np.sort(pass_keys) for _ in eng.groups])
+        tables = eng.begin_pass()
+        if trainer._step_fn is None:
+            trainer._step_fn = trainer._build_step()
+        rows = trainer._map_batch_rows(batch0)
+        segs = {n: jnp.asarray(batch0.segments[n]) for n in batch0.ids}
+        from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
+        import ml_dtypes
+        dense_j = jnp.asarray(
+            _concat_dense_host(batch0).astype(ml_dtypes.bfloat16))
+        params, opt_state, auc = (trainer.params, trainer.opt_state,
+                                  trainer.auc_state)
+        sync0 = jnp.zeros((), jnp.int32)
+        for _ in range(2):
+            tables, params, opt_state, auc, loss, _of = trainer._step_fn(
+                tables, params, opt_state, auc, rows, segs,
+                jnp.asarray(batch0.labels), jnp.asarray(batch0.valid),
+                dense_j, sync0)
+        _sync(loss)
+        trainer.params, trainer.opt_state, trainer.auc_state = (
+            params, opt_state, auc)
+        eng.update_tables(tables)
+        eng.end_pass()
+
+        dataset.wait_preload_done()
+        t0 = time.perf_counter()
+        stats = trainer.train_pass(dataset)
+        t_pass = time.perf_counter() - t0
+    per_chip = n_batches * batch / t_pass / ndev
+    return {
+        "metric": "wide_deep_ctr_e2e_samples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": _vs("wide_deep", per_chip),
+        "store_build_keys_per_s": round(build_keys_per_s, 0),
+        "auc": round(float(stats["auc"]), 5),
+        "n_devices": ndev,
+    }
+
+
 CONFIGS = {
     "deepfm": bench_deepfm,
     "resnet50": bench_resnet50,
     "bert_dp": bench_bert_dp,
     "gpt": bench_gpt,
+    "wide_deep": bench_wide_deep,
 }
 
 

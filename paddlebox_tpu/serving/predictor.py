@@ -32,17 +32,30 @@ from paddlebox_tpu.native import store_py as native_store
 def load_xbox_model(path: str, table: str = "embedding"
                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """(keys, emb [n, D], w [n]) from an xbox export directory — flat
-    (`<table>.xbox.npz`) or sharded (`bucket-*/`, `part-*/`, `dim*/`
-    subdirectories are concatenated)."""
+    (`<table>.xbox.npz`) or sharded (`bucket-*/` / `part-*/`
+    subdirectories are concatenated; all shards carry the same width).
+
+    Dim-grouped exports (mixed-width models write `dim<D>/` subdirs with
+    per-group table names `<base>_dim<D>`) hold INCOMPATIBLE widths —
+    load each group separately:
+    ``load_xbox_model(f"{path}/dim8", table=f"{table}_dim8")``.
+    """
     flat = os.path.join(path, f"{table}.xbox.npz")
     if os.path.exists(flat):
         data = np.load(flat)
         return (data["keys"].astype(np.uint64), data["emb"], data["w"])
+    dim_parts = sorted(d for d in os.listdir(path)
+                       if os.path.isdir(os.path.join(path, d))
+                       and d.startswith("dim"))
+    if dim_parts:
+        raise ValueError(
+            f"{path} is a dim-grouped export ({dim_parts}) — groups have "
+            f"different embedding widths; load each with "
+            f"load_xbox_model(path/dim<D>, table='{table}_dim<D>')")
     parts = sorted(
         d for d in os.listdir(path)
         if os.path.isdir(os.path.join(path, d))
-        and (d.startswith("bucket-") or d.startswith("part-")
-             or d.startswith("dim")))
+        and (d.startswith("bucket-") or d.startswith("part-")))
     if not parts:
         raise FileNotFoundError(f"no xbox export for {table!r} under {path}")
     ks, es, ws = [], [], []
