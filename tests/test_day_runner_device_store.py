@@ -1,0 +1,91 @@
+"""DayRunner over the DEVICE-resident store tier: the pipelined day loop
+(async feed_pass thread racing end_pass on the store lock) must produce
+the same checkpoint protocol artifacts and keep training sane — the
+production configuration (GPU-resident PS thesis) end to end."""
+
+import os
+
+import numpy as np
+
+from paddlebox_tpu.data import DataFeedConfig, SlotConf
+from paddlebox_tpu.embedding import DeviceFeatureStore, TableConfig
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+from paddlebox_tpu.train.day_runner import DayRunner
+
+from tests.test_day_runner import SLOTS, _write_day
+
+
+def _make_runner(data_root, out_root, mesh):
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.5) for s in SLOTS),
+        batch_size=32)
+    trainer = CTRTrainer(
+        DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
+        TableConfig(name="emb", dim=8, learning_rate=0.1), mesh=mesh,
+        config=TrainerConfig(dense_learning_rate=3e-3,
+                             auc_num_buckets=1 << 10),
+        store_factory=lambda cfg: DeviceFeatureStore(cfg, mesh=mesh))
+    trainer.init(seed=0)
+    return trainer, DayRunner(
+        trainer, feed, out_root, data_root=data_root,
+        split_interval=60, split_per_pass=1, hours=[0, 1, 2],
+        num_reader_threads=2, pipeline_passes=True, save_xbox=True)
+
+
+def test_pipelined_day_over_device_store(tmp_path):
+    data_root = str(tmp_path / "data")
+    out_root = str(tmp_path / "out")
+    _write_day(data_root, "20260701", [0, 1, 2])
+    mesh = build_mesh(HybridTopology(dp=8))
+    trainer, runner = _make_runner(data_root, out_root, mesh)
+    out = runner.run_days(["20260701"], resume=False)
+    assert len(out["20260701"]) == 3
+    assert trainer.engine.store.num_features > 0
+    # Checkpoint protocol artifacts: per-pass deltas + xbox, day base in
+    # the pass-0 dir (reference day/pass-addressed layout).
+    day_dir = os.path.join(out_root, "20260701")
+    recs = runner.ckpt.records()
+    assert [(r.day, r.pass_id) for r in recs] == \
+        [("20260701", 1), ("20260701", 2), ("20260701", 3),
+         ("20260701", 0)]
+    assert os.path.exists(os.path.join(day_dir, "0", "emb.base.npz"))
+    assert os.path.exists(os.path.join(day_dir, "2", "emb.delta.npz"))
+    assert os.path.exists(os.path.join(day_dir, "1", "emb.xbox.npz"))
+
+    # The day base reloads into a FRESH device store with equal contents.
+    mesh2 = build_mesh(HybridTopology(dp=8))
+    fresh = DeviceFeatureStore(TableConfig(name="emb", dim=8,
+                                           learning_rate=0.1), mesh=mesh2)
+    fresh.load(os.path.join(day_dir, "0"), "base")
+    assert fresh.num_features == trainer.engine.store.num_features
+    keys = np.sort(
+        trainer.engine.store._index.keys_by_row())
+    a = trainer.engine.store.pull_for_pass(keys)
+    b = fresh.pull_for_pass(keys)
+    np.testing.assert_allclose(b["emb"], a["emb"], atol=1e-7)
+
+
+def test_eval_pass_does_not_grow_device_store(tmp_path):
+    data_root = str(tmp_path / "data")
+    _write_day(data_root, "20260701", [0])
+    mesh = build_mesh(HybridTopology(dp=8))
+    trainer, _ = _make_runner(data_root, str(tmp_path / "out"), mesh)
+    from paddlebox_tpu.data.dataset import Dataset
+    feed = trainer.feed_config
+    ds = Dataset(feed, num_reader_threads=1)
+    ds.set_filelist([os.path.join(data_root, "20260701", "00",
+                                  "part-00000")])
+    ds.load_into_memory()
+    trainer.train_pass(ds)
+    n_after_train = trainer.engine.store.num_features
+    # Eval over data containing UNSEEN keys must not insert them.
+    _write_day(data_root, "20260702", [0], seed0=999)
+    ds2 = Dataset(feed, num_reader_threads=1)
+    ds2.set_filelist([os.path.join(data_root, "20260702", "00",
+                                   "part-00000")])
+    ds2.load_into_memory()
+    stats = trainer.eval_pass(ds2)
+    assert np.isfinite(stats["loss"])
+    assert trainer.engine.store.num_features == n_after_train
