@@ -25,7 +25,20 @@ import time
 
 import numpy as np
 
+# PBX_BENCH_SCALE=small = CPU smoke run of the full harness path (never
+# for recorded numbers): pin the CPU platform BEFORE jax initializes a
+# backend (the axon sitecustomize imports jax at startup, so the env var
+# alone is not enough — same workaround as tests/conftest.py) and shrink
+# every config below.
+_SMALL = os.environ.get("PBX_BENCH_SCALE") == "small"
+if _SMALL:
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
 import jax
+
+if _SMALL:
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _sync(x) -> float:
@@ -65,13 +78,22 @@ NUM_SLOTS = 26
 EMB_DIM = 16
 DENSE_DIM = 13
 BATCH = 16384
-STORE_KEYS = 50_000_000       # resident feature store size (host RAM)
+STORE_KEYS = 50_000_000       # resident feature store size
 PASS_KEYS = 4_000_000         # working set one pass touches
 # Distinct timed batches: a real online pass trains minutes of traffic
 # against one table build + write-back, so the per-pass fixed costs
-# (feed_pass pull, end_pass D2H + store merge) must amortize over a
-# realistic batch count or the bench mis-states steady-state throughput.
+# (feed_pass build, end_pass write-back) must amortize over a realistic
+# batch count or the bench mis-states steady-state throughput.
 N_BATCHES = 64
+
+if _SMALL:
+    BATCH = 1024
+    STORE_KEYS = 1_000_000
+    PASS_KEYS = 100_000
+    N_BATCHES = 4
+    # Ratios vs full-scale recordings would be meaningless noise.
+    for _k in SELF_BASELINE:
+        SELF_BASELINE[_k] = None
 
 
 def _prepopulate_store(trainer, n_keys: int, chunk: int = 10_000_000) -> float:
@@ -549,8 +571,33 @@ CONFIGS = {
 }
 
 
+def _preflight_scatter_kernel() -> None:
+    """Run the Pallas scatter-accumulate once on the real backend before
+    the benchmark; if it fails to compile/execute (an untested
+    toolchain), pin the flag to the XLA scatter so the bench still
+    produces a number instead of dying inside the jitted step."""
+    from paddlebox_tpu.core import flags as flagmod
+    try:
+        from paddlebox_tpu.ops.pallas_kernels.sorted_scatter import (
+            sorted_scatter_accumulate)
+        import jax.numpy as jnp
+        out = np.asarray(sorted_scatter_accumulate(
+            jnp.asarray(np.arange(64, dtype=np.int32)),
+            jnp.ones((64, 8), jnp.float32), 9000))
+        # Value check, not just liveness: a miscompiling toolchain that
+        # returns garbage must also route to the fallback.
+        assert (out[:64] == 1.0).all() and (out[64:] == 0.0).all(), \
+            "kernel output mismatch"
+    except Exception as e:  # noqa: BLE001 - any failure means fallback
+        print(f"[bench] pallas scatter preflight failed ({e!r}); "
+              f"using XLA scatter", file=sys.stderr)
+        flagmod.set_flags({"sparse_scatter_kernel": "xla"})
+
+
 def main() -> None:
     name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
+    if name in ("deepfm", "wide_deep"):
+        _preflight_scatter_kernel()
     out = CONFIGS[name]()
     print(json.dumps(out))
 
