@@ -47,9 +47,12 @@ def _kernel(starts_ref, rows_ref, payload_ref, acc_ref, rows_s, pay_s,
     lo = starts_ref[b]
     cnt = starts_ref[b + 1] - lo
 
-    # Stage this block's run of (row, payload) updates into VMEM. The
-    # inputs are padded by UCAP rows so the fixed-size slice never reads
-    # out of bounds.
+    # Stage this block's run of (row, payload) updates: row ids into SMEM
+    # (they are read one scalar at a time at a data-dependent index — VMEM
+    # vector loads need 1024-element-aligned offsets Mosaic cannot prove
+    # for a dynamic scalar index), payloads into VMEM. The inputs are
+    # padded by UCAP rows so the fixed-size slice never reads out of
+    # bounds.
     dma0 = pltpu.make_async_copy(rows_ref.at[pl.ds(lo, UCAP)], rows_s,
                                  sem0)
     dma1 = pltpu.make_async_copy(payload_ref.at[pl.ds(lo, UCAP), :],
@@ -64,7 +67,7 @@ def _kernel(starts_ref, rows_ref, payload_ref, acc_ref, rows_s, pay_s,
 
     def body(j, _):
         r = rows_s[j] - base
-        acc_ref[r, :] += pay_s[j, :]
+        acc_ref[pl.ds(r, 1), :] += pay_s[pl.ds(j, 1), :]
         return 0
 
     lax.fori_loop(0, jnp.minimum(cnt, UCAP), body, 0)
@@ -87,7 +90,7 @@ def _sorted_accumulate(sorted_rows: jax.Array, sorted_payload: jax.Array,
         ],
         out_specs=pl.BlockSpec((BLOCK, aw), lambda b, starts: (b, 0)),
         scratch_shapes=[
-            pltpu.VMEM((UCAP,), jnp.int32),
+            pltpu.SMEM((UCAP,), jnp.int32),
             pltpu.VMEM((UCAP, aw), jnp.float32),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,

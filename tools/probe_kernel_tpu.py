@@ -1,0 +1,64 @@
+"""Compile + value + timing probe of the Pallas sorted-scatter kernel on
+the real TPU (the bench preflight's big sibling). Run manually after any
+kernel change:
+
+    python tools/probe_kernel_tpu.py
+
+Prints per-shape timing vs the XLA scatter path so kernel-vs-fallback
+decisions (core/flags.py sparse_scatter_kernel) stay evidence-based.
+"""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.ops.pallas_kernels.sorted_scatter import (
+    sorted_scatter_accumulate)
+
+
+def sync(x):
+    return float(np.asarray(x).ravel()[0])
+
+
+def main():
+    print("devices:", jax.devices())
+    rng = np.random.default_rng(0)
+
+    # Small correctness probe first (the preflight shape).
+    out = np.asarray(sorted_scatter_accumulate(
+        jnp.asarray(np.arange(64, dtype=np.int32)),
+        jnp.ones((64, 8), jnp.float32), 9000))
+    if not ((out[:64] == 1.0).all() and (out[64:] == 0.0).all()):
+        raise RuntimeError("small value check FAILED")
+    print("small value check: ok")
+
+    # Bench-scale value check vs XLA scatter.
+    n, rows_n, aw = 425_984, 4_194_304, 20
+    rows = rng.integers(0, rows_n, n).astype(np.int32)
+    payload = rng.standard_normal((n, aw)).astype(np.float32)
+    rows_j = jnp.asarray(rows)
+    pay_j = jnp.asarray(payload)
+
+    acc = sorted_scatter_accumulate(rows_j, pay_j, rows_n)
+    xla = jnp.zeros((rows_n, aw), jnp.float32).at[rows_j].add(pay_j)
+    err = float(jnp.max(jnp.abs(acc - xla)))
+    print(f"bench-scale max |kernel - xla| = {err:.3e}")
+    if not err < 1e-3:
+        raise RuntimeError(f"value mismatch at scale: {err}")
+
+    f_kernel = jax.jit(lambda r, p: sorted_scatter_accumulate(r, p, rows_n))
+    f_xla = jax.jit(
+        lambda r, p: jnp.zeros((rows_n, aw), jnp.float32).at[r].add(p))
+    for name, f in (("kernel", f_kernel), ("xla", f_xla)):
+        sync(f(rows_j, pay_j))  # warm
+        t0 = time.perf_counter()
+        for _ in range(5):
+            sync(f(rows_j, pay_j))
+        dt = (time.perf_counter() - t0) / 5
+        print(f"{name}: {dt * 1e3:.1f} ms per call")
+
+
+if __name__ == "__main__":
+    main()
