@@ -31,21 +31,61 @@ import numpy as np
 # alone is not enough — same workaround as tests/conftest.py) and shrink
 # every config below.
 _SMALL = os.environ.get("PBX_BENCH_SCALE") == "small"
-if _SMALL:
-    os.environ.setdefault(
-        "XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+# ---------------------------------------------------------------------------
+# Stall watchdog. The axon TPU tunnel can wedge mid-run (observed
+# 2026-07-31: a device call blocked on the tunnel socket for 30+ min with
+# zero progress) — and a bench that hangs forever records NOTHING for the
+# round. A daemon thread watches a heartbeat that every phase/sync
+# advances; if nothing moves for PBX_BENCH_WATCHDOG_S (default 900) it
+# prints a parseable JSON line naming the stalled phase and hard-exits.
+# Started before the jax import: backend init itself can hang.
+# ---------------------------------------------------------------------------
+
+_WD = {"t": time.monotonic(), "phase": "import-jax"}
+
+
+def _tick(phase: str) -> None:
+    _WD["t"] = time.monotonic()
+    _WD["phase"] = phase
+
+
+def _watchdog_loop() -> None:
+    limit = float(os.environ.get("PBX_BENCH_WATCHDOG_S", "900"))
+    while True:
+        time.sleep(15)
+        if time.monotonic() - _WD["t"] > limit:
+            name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
+            print(json.dumps({
+                "metric": f"{name}_FAILED",
+                "value": 0.0,
+                "unit": "none",
+                "vs_baseline": None,
+                "error": (f"watchdog: no progress in phase "
+                          f"{_WD['phase']!r} for {limit:.0f}s — "
+                          f"device backend stall (axon tunnel?)"),
+            }), flush=True)
+            os._exit(3)
+
+
+if os.environ.get("PBX_BENCH_WATCHDOG", "1") != "0":
+    import threading
+    threading.Thread(target=_watchdog_loop, daemon=True).start()
 
 import jax
 
 if _SMALL:
     jax.config.update("jax_platforms", "cpu")
+_tick("post-import")
 
 
 def _sync(x) -> float:
     """Force completion by fetching the value — on the axon remote-TPU
     platform jax.block_until_ready returns before the dispatched chain
     finishes, so timing loops MUST fetch a concrete value."""
-    return float(np.asarray(x).ravel()[0])
+    v = float(np.asarray(x).ravel()[0])
+    _tick("sync")
+    return v
 
 
 # Previously recorded numbers for vs_baseline ratios (BASELINE.md
@@ -62,6 +102,19 @@ SELF_BASELINE = {
     "wide_deep": None,
 }
 
+# First-recorded numbers (tools/record_baselines.py writes them as soon
+# as a bench config lands on the real chip) fill metrics that have no
+# hand-recorded baseline yet — never overriding an existing prior-round
+# value, so vs_baseline stays a cross-round ratio where one exists.
+try:
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BASELINE_MEASURED.json")) as _f:
+        for _k, _v in json.load(_f).items():
+            if SELF_BASELINE.get(_k) is None:
+                SELF_BASELINE[_k] = _v
+except (OSError, ValueError):
+    pass
+
 
 def _vs(metric: str, value: float):
     """Ratio vs our prior recorded number; None (JSON null) when no
@@ -76,6 +129,12 @@ def _vs(metric: str, value: float):
 
 NUM_SLOTS = 26
 EMB_DIM = 16
+# Wide&Deep (bench_wide_deep) shape constants — module-level so the
+# scatter preflight probes the SAME shapes the bench will compile.
+WIDE_DEEP_EMB_DIM = 8
+WIDE_DEEP_SLOTS = 20
+WIDE_DEEP_BATCH = 8192
+WIDE_DEEP_PASS_KEYS = 1_000_000
 DENSE_DIM = 13
 BATCH = 16384
 STORE_KEYS = 50_000_000       # resident feature store size
@@ -110,15 +169,18 @@ def _prepopulate_store(trainer, n_keys: int, chunk: int = 10_000_000) -> float:
             keys = np.arange(lo, min(lo + chunk, n_keys + 1),
                              dtype=np.uint64)
             eng.store.ensure_rows(keys)
+            _tick(f"prepopulate:{lo}")
         # Include device completion in the timing.
         jax.block_until_ready(eng.store._vals)
         np.asarray(eng.store._vals[:1, :1])
+        _tick("prepopulate:done")
     else:
         for lo in range(1, n_keys + 1, chunk):
             keys = np.arange(lo, min(lo + chunk, n_keys + 1),
                              dtype=np.uint64)
             vals = eng.store.pull_for_pass(keys)  # materializes init
             eng.store.push_from_pass(keys, vals)
+            _tick(f"prepopulate:{lo}")
     return n_keys / (time.perf_counter() - t0)
 
 
@@ -315,27 +377,63 @@ def bench_resnet50() -> dict:
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.sgd(0.1, momentum=0.9)
     opt_state = opt.init(params)
-    bs = 128
+    bs = 8 if _SMALL else 128
+
+    def cast_compute(p):
+        """bf16 compute cast that leaves BN running stats f32 — casting
+        mean/var would re-quantize the EMA every step and defeat the f32
+        master merge_bn maintains (batchnorm_apply computes stats in f32
+        from whatever it is handed)."""
+        out = {}
+        for k, v in p.items():
+            if isinstance(v, dict):
+                out[k] = cast_compute(v)
+            elif k in ("mean", "var"):
+                out[k] = v
+            else:
+                out[k] = v.astype(jnp.bfloat16)
+        return out
+
+    def merge_bn(master, fresh):
+        """Write the forward's BN running-stat updates back into the f32
+        master tree (stats are state, not gradients — the optimizer sees
+        zero grads for them)."""
+        out = {}
+        for k, v in master.items():
+            if isinstance(v, dict) and "mean" in v and "var" in v:
+                out[k] = {**v,
+                          "mean": fresh[k]["mean"].astype(jnp.float32),
+                          "var": fresh[k]["var"].astype(jnp.float32)}
+            elif isinstance(v, dict):
+                out[k] = merge_bn(v, fresh[k])
+            else:
+                out[k] = v
+        return out
 
     def loss_fn(p, x, y):
-        logits = model.apply(p, x, train=True)
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, y).mean()
+        # bf16 compute (MXU path), f32 master params; BN statistics stay
+        # f32 end-to-end (cast_compute skips them, batchnorm_apply
+        # computes in f32, merge_bn writes them back to the master).
+        logits, p_new = model.apply(cast_compute(p), x, train=True)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y).mean()
+        return loss, p_new
 
     @jax.jit
     def step(p, s, x, y):
-        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        (loss, p_new), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, x, y)
         updates, s = opt.update(g, s, p)
-        return optax.apply_updates(p, updates), s, loss
+        return merge_bn(optax.apply_updates(p, updates), p_new), s, loss
 
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(bs, 224, 224, 3)), jnp.bfloat16)
     y = jnp.asarray(rng.integers(0, 1000, bs), jnp.int32)
-    for _ in range(3):
+    for _ in range(1 if _SMALL else 3):
         params, opt_state, loss = step(params, opt_state, x, y)
     _sync(loss)
     t0 = time.perf_counter()
-    n = 20
+    n = 2 if _SMALL else 20
     for _ in range(n):
         params, opt_state, loss = step(params, opt_state, x, y)
     _sync(loss)
@@ -366,42 +464,46 @@ def bench_bert_dp() -> dict:
 
     ndev = len(jax.devices())
     mesh = build_mesh(HybridTopology(dp=ndev))
-    cfg = BertConfig()  # BERT-base defaults
+    if _SMALL:
+        cfg = BertConfig(d_model=128, n_layers=2, n_heads=2, d_ff=256)
+    else:
+        cfg = BertConfig()  # BERT-base defaults
     params = init_bert(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(1e-4)
     opt_state = opt.init(params)
-    bs, seq = 8 * ndev, 128
+    bs, seq = (2 * ndev, 64) if _SMALL else (8 * ndev, 128)
 
     data_sh = NamedSharding(mesh, P("dp"))
     rep = NamedSharding(mesh, P())
     params = jax.device_put(params, rep)
     opt_state = jax.device_put(opt_state, rep)
 
-    def loss_fn(p, tokens, mask_pos, mask_ids):
-        return bert_mlm_loss(p, cfg, tokens, mask_pos, mask_ids)
+    def loss_fn(p, tokens, targets, mask):
+        return bert_mlm_loss(p, cfg, tokens, targets, mask)
 
     @jax.jit
-    def step(p, s, tokens, mask_pos, mask_ids):
-        loss, g = jax.value_and_grad(loss_fn)(p, tokens, mask_pos, mask_ids)
+    def step(p, s, tokens, targets, mask):
+        loss, g = jax.value_and_grad(loss_fn)(p, tokens, targets, mask)
         updates, s = opt.update(g, s, p)
         return optax.apply_updates(p, updates), s, loss
 
     rng = np.random.default_rng(0)
     tokens = jax.device_put(jnp.asarray(
         rng.integers(0, cfg.vocab_size, (bs, seq)), jnp.int32), data_sh)
-    mask_pos = jax.device_put(jnp.asarray(
-        rng.integers(0, seq, (bs, 20)), jnp.int32), data_sh)
-    mask_ids = jax.device_put(jnp.asarray(
-        rng.integers(0, cfg.vocab_size, (bs, 20)), jnp.int32), data_sh)
+    targets = jax.device_put(jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (bs, seq)), jnp.int32), data_sh)
+    # Standard MLM masking rate: predict ~15% of positions.
+    mask = jax.device_put(jnp.asarray(
+        rng.random((bs, seq)) < 0.15, jnp.float32), data_sh)
     for _ in range(3):
         params, opt_state, loss = step(params, opt_state, tokens,
-                                       mask_pos, mask_ids)
+                                       targets, mask)
     _sync(loss)
     t0 = time.perf_counter()
-    n = 10
+    n = 2 if _SMALL else 10
     for _ in range(n):
         params, opt_state, loss = step(params, opt_state, tokens,
-                                       mask_pos, mask_ids)
+                                       targets, mask)
     _sync(loss)
     dt = time.perf_counter() - t0
     tps = n * bs * seq / dt
@@ -431,15 +533,19 @@ def bench_gpt() -> dict:
 
     ndev = len(jax.devices())
     # GPT-350M-class on one chip; hybrid axes engage when chips allow.
-    cfg = GPTConfig(vocab_size=50304, d_model=1024, n_heads=16,
-                    n_layers=24, d_ff=4096, max_seq_len=1024)
+    if _SMALL:
+        cfg = GPTConfig(vocab_size=1024, d_model=128, n_heads=4,
+                        n_layers=2, d_ff=256, max_seq_len=128)
+    else:
+        cfg = GPTConfig(vocab_size=50304, d_model=1024, n_heads=16,
+                        n_layers=24, d_ff=4096, max_seq_len=1024)
     mesh = build_mesh(HybridTopology(dp=ndev))
     params, specs = init_gpt(jax.random.PRNGKey(0), cfg, pp_stages=1)
     opt = optax.adafactor(1e-3)
     step = make_gpt_train_step(cfg, mesh, specs, opt, num_microbatches=1)
     opt_state = opt.init(params)
 
-    bs, seq = 4 * ndev, 1024
+    bs, seq = (2 * ndev, 128) if _SMALL else (4 * ndev, 1024)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (bs, seq)),
                          jnp.int32)
@@ -449,7 +555,7 @@ def bench_gpt() -> dict:
         params, opt_state, loss = step(params, opt_state, tokens, targets)
     _sync(loss)
     t0 = time.perf_counter()
-    n = 5
+    n = 2 if _SMALL else 5
     for _ in range(n):
         params, opt_state, loss = step(params, opt_state, tokens, targets)
     _sync(loss)
@@ -487,8 +593,12 @@ def bench_wide_deep() -> dict:
 
     ndev = len(jax.devices())
     mesh = build_mesh(HybridTopology(dp=ndev))
-    n_slots, emb_dim, batch = 20, 8, 8192
-    store_keys, pass_keys_n, n_batches = 10_000_000, 1_000_000, 32
+    n_slots, emb_dim, batch = (WIDE_DEEP_SLOTS, WIDE_DEEP_EMB_DIM,
+                               WIDE_DEEP_BATCH)
+    store_keys, pass_keys_n, n_batches = (10_000_000,
+                                          WIDE_DEEP_PASS_KEYS, 32)
+    if _SMALL:
+        batch, store_keys, pass_keys_n, n_batches = 512, 200_000, 20_000, 4
     slots = tuple(SlotConf(f"s{i}", avg_len=1.0) for i in range(n_slots))
     feed = DataFeedConfig(slots=slots, batch_size=batch,
                           slot_capacity_slack=1.0)
@@ -571,23 +681,41 @@ CONFIGS = {
 }
 
 
-def _preflight_scatter_kernel() -> None:
-    """Run the Pallas scatter-accumulate once on the real backend before
-    the benchmark; if it fails to compile/execute (an untested
-    toolchain), pin the flag to the XLA scatter so the bench still
-    produces a number instead of dying inside the jitted step."""
+def _preflight_scatter_kernel(n: int, aw: int, pass_keys: int) -> None:
+    """Run the push scatter-accumulate once on the real backend at the
+    EXACT shape the selected bench will compile — same update count,
+    payload width, and pass-table block (jit/Mosaic treat each shape as
+    a fresh compile, so any other shape would not predict the real one)
+    — through the same ``_accumulate`` wrapper the jitted step uses. If
+    it fails to compile/execute or returns wrong values (an untested /
+    miscompiling toolchain), pin the flag to the XLA scatter so the
+    recorded run never dies (or silently corrupts) inside the jitted
+    step."""
     from paddlebox_tpu.core import flags as flagmod
+    if flagmod.flag("sparse_scatter_kernel") == "xla":
+        # Operator already pinned the fallback (e.g. because the kernel
+        # hard-crashes the runtime, which no try/except catches) —
+        # honor it; running the kernel anyway would defeat the pin.
+        return
     try:
-        from paddlebox_tpu.ops.pallas_kernels.sorted_scatter import (
-            sorted_scatter_accumulate)
+        from paddlebox_tpu.embedding.lookup import _accumulate
+        from paddlebox_tpu.embedding.table import plan_shards
         import jax.numpy as jnp
-        out = np.asarray(sorted_scatter_accumulate(
-            jnp.asarray(np.arange(64, dtype=np.int32)),
-            jnp.ones((64, 8), jnp.float32), 9000))
+        # Mirror make_push_fn: block = rows_per_shard + 1, single shard.
+        block = plan_shards(pass_keys, 1) + 1
+        rng = np.random.default_rng(0)
+        rows = jnp.asarray(
+            rng.integers(0, block - 1, n).astype(np.int32))
+        pay = jnp.asarray(
+            rng.standard_normal((n, aw)).astype(np.float32))
+        out = _accumulate(rows, pay, block)
+        ref = jnp.zeros((block, aw), jnp.float32).at[rows].add(pay)
+        err = float(jnp.max(jnp.abs(out - ref)))
         # Value check, not just liveness: a miscompiling toolchain that
-        # returns garbage must also route to the fallback.
-        assert (out[:64] == 1.0).all() and (out[64:] == 0.0).all(), \
-            "kernel output mismatch"
+        # returns garbage must also route to the fallback. Explicit
+        # raise (not assert) — python -O must not strip it.
+        if not err < 1e-3:
+            raise RuntimeError(f"kernel/xla mismatch: max err {err}")
     except Exception as e:  # noqa: BLE001 - any failure means fallback
         print(f"[bench] pallas scatter preflight failed ({e!r}); "
               f"using XLA scatter", file=sys.stderr)
@@ -596,9 +724,24 @@ def _preflight_scatter_kernel() -> None:
 
 def main() -> None:
     name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
-    if name in ("deepfm", "wide_deep"):
-        _preflight_scatter_kernel()
+    if name in ("deepfm", "wide_deep") and not _SMALL:
+        # (updates/step, payload width, pass keys) of the selected CTR
+        # config — aw = emb_dim + 4 ([g_emb | g_w | show | click |
+        # count]). Small/CPU mode never selects the Pallas path (flag
+        # "auto" gates on the tpu backend), so no preflight.
+        _tick("preflight")
+        if name == "deepfm":
+            _preflight_scatter_kernel(BATCH * NUM_SLOTS, EMB_DIM + 4,
+                                      PASS_KEYS)
+        else:
+            _preflight_scatter_kernel(WIDE_DEEP_BATCH * WIDE_DEEP_SLOTS,
+                                      WIDE_DEEP_EMB_DIM + 4,
+                                      WIDE_DEEP_PASS_KEYS)
+    _tick(f"bench:{name}")
     out = CONFIGS[name]()
+    # Recorded artifacts must be attributable to hardware: the recorder
+    # refuses to treat non-tpu numbers as baselines.
+    out["platform"] = jax.default_backend()
     print(json.dumps(out))
 
 

@@ -18,11 +18,18 @@ def conv2d_init(rng: jax.Array, in_ch: int, out_ch: int, kernel: int,
 
 def conv2d_apply(params: Dict, x: jax.Array, *, stride: int = 1,
                  padding: str = "SAME") -> jax.Array:
-    """x [B, H, W, C] (NHWC keeps the channel dim on the TPU lane axis)."""
+    """x [B, H, W, C] (NHWC keeps the channel dim on the TPU lane axis).
+
+    Inputs are cast to the weight dtype (lax.conv requires matching
+    dtypes — under a bf16 policy the weights set the compute dtype).
+    Output stays in the compute dtype, symmetric for autodiff: a mixed
+    bf16-in/f32-out conv has no valid transpose (the cotangent dtype
+    would mismatch the input), so accumulation precision is left to the
+    MXU's internal f32 accumulate rather than preferred_element_type."""
     return lax.conv_general_dilated(
-        x, params["w"], window_strides=(stride, stride), padding=padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32)
+        x.astype(params["w"].dtype), params["w"],
+        window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def batchnorm_init(ch: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
@@ -36,17 +43,22 @@ def batchnorm_apply(params: Dict, x: jax.Array, *, train: bool,
                     ) -> Tuple[jax.Array, Dict]:
     """Returns (y, updated_params). Under data parallelism pass axis_name
     to compute sync batch stats (role of sync_batch_norm)."""
+    xf = x.astype(jnp.float32)  # stats in f32 even under a bf16 policy
     if train:
-        mu = jnp.mean(x, axis=(0, 1, 2))
-        var = jnp.mean(x * x, axis=(0, 1, 2)) - mu * mu
+        mu = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.mean(xf * xf, axis=(0, 1, 2)) - mu * mu
         if axis_name is not None:
             mu = lax.pmean(mu, axis_name)
             var = lax.pmean(var, axis_name)
         new = dict(params)
-        new["mean"] = momentum * params["mean"] + (1 - momentum) * mu
-        new["var"] = momentum * params["var"] + (1 - momentum) * var
+        new["mean"] = (momentum * params["mean"].astype(jnp.float32)
+                       + (1 - momentum) * mu)
+        new["var"] = (momentum * params["var"].astype(jnp.float32)
+                      + (1 - momentum) * var)
     else:
-        mu, var = params["mean"], params["var"]
+        mu, var = (params["mean"].astype(jnp.float32),
+                   params["var"].astype(jnp.float32))
         new = params
-    y = (x - mu) * lax.rsqrt(var + eps) * params["g"] + params["b"]
-    return y, new
+    y = ((xf - mu) * lax.rsqrt(var + eps) * params["g"].astype(jnp.float32)
+         + params["b"].astype(jnp.float32))
+    return y.astype(x.dtype), new
