@@ -76,16 +76,25 @@ class PSServer:
     host store); pushes apply the table's sparse optimizer server-side —
     the CPU twin of the in-kernel update the device path fuses into
     push_sparse (``optimizer.cuh.h:31``).
+
+    ``store_factory(cfg, shard_index)`` swaps the backing store per shard
+    — pass a :class:`~paddlebox_tpu.embedding.ssd_tier.TieredFeatureStore`
+    factory to bound each remote shard's RAM with disk overflow (the
+    remote twin of the reference's SSD table under the PS service,
+    ``box_wrapper.h:635`` LoadSSD2Mem staging on a served shard).
     """
 
     def __init__(self, endpoint: str, index: int, num_servers: int,
                  tables: Dict[str, TableConfig],
                  dense: Optional[Dict[str, np.ndarray]] = None,
-                 dense_lr: float = 1.0):
+                 dense_lr: float = 1.0, store_factory=None):
         self.index = index
         self.num_servers = num_servers
+        if store_factory is None:
+            def store_factory(cfg, idx):
+                return FeatureStore(cfg, seed=idx)
         self.tables: Dict[str, FeatureStore] = {
-            name: FeatureStore(cfg, seed=index) for name, cfg in
+            name: store_factory(cfg, index) for name, cfg in
             tables.items()}
         self._opts = {name: self.tables[name].opt for name in tables}
         # Per-table lock serializing read-modify-write sequences: the
@@ -581,12 +590,14 @@ class PSBackedStore:
 
 
 def start_local_cluster(num_servers: int, tables: Dict[str, TableConfig],
-                        dense: Optional[Dict[str, np.ndarray]] = None
+                        dense: Optional[Dict[str, np.ndarray]] = None,
+                        store_factory=None
                         ) -> Tuple[List[PSServer], PSClient]:
     """Spin up an in-process PS cluster on localhost ephemeral ports (role
     of the reference's localhost fake-cluster test mechanism,
     test_dist_base.py:1041)."""
-    servers = [PSServer("127.0.0.1:0", i, num_servers, tables, dense)
+    servers = [PSServer("127.0.0.1:0", i, num_servers, tables, dense,
+                        store_factory=store_factory)
                for i in range(num_servers)]
     client = PSClient([s.endpoint for s in servers])
     return servers, client

@@ -180,7 +180,9 @@ class TieredFeatureStore:
             return
         k, v = self.disk.take(missing)
         if k.size:
-            self.ram.push_from_pass(k, v)
+            # mark_dirty=False: staged rows are bit-identical to their
+            # disk copies — a read-only pull must not bloat save_delta.
+            self.ram.push_from_pass(k, v, mark_dirty=False)
             monitor.add("ssd_tier/staged_in", int(k.size))
 
     def evict_to_budget(self) -> int:
@@ -208,7 +210,12 @@ class TieredFeatureStore:
     def pull_for_pass(self, pass_keys_sorted: np.ndarray
                       ) -> Dict[str, np.ndarray]:
         self._stage_in(np.asarray(pass_keys_sorted, np.uint64))
-        return self.ram.pull_for_pass(pass_keys_sorted)
+        out = self.ram.pull_for_pass(pass_keys_sorted)
+        # Pull-only traffic stages rows in too — without eviction here a
+        # read-heavy client (serving-style pulls) would grow RAM
+        # unboundedly past the budget the tier exists to enforce.
+        self.evict_to_budget()
+        return out
 
     def push_from_pass(self, pass_keys_sorted: np.ndarray,
                        values: Dict[str, np.ndarray]) -> None:

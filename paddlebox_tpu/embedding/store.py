@@ -204,9 +204,14 @@ class FeatureStore:
         return out
 
     def push_from_pass(self, pass_keys_sorted: np.ndarray,
-                       values: Dict[str, np.ndarray]) -> None:
+                       values: Dict[str, np.ndarray], *,
+                       mark_dirty: bool = True) -> None:
         """Write a finished pass's values back (role of EndPass write-back,
-        ps_gpu_wrapper.cc:983). Vectorized sorted merge of new keys."""
+        ps_gpu_wrapper.cc:983). Vectorized sorted merge of new keys.
+
+        ``mark_dirty=False`` is for TIER MOVEMENT (ssd_tier stage-in):
+        rows identical to their disk copies must not land in the next
+        save_delta — only training updates are deltas."""
         k = np.ascontiguousarray(pass_keys_sorted, np.uint64)
         if k.shape[0] == 0:
             return
@@ -239,7 +244,8 @@ class FeatureStore:
                     native_store.scatter_rows(merged, old_pos,
                                               self._vals[f])
                     self._vals[f] = merged
-            self._dirty_parts.append(k.copy())
+            if mark_dirty:
+                self._dirty_parts.append(k.copy())
 
     # -- lifecycle maintenance --------------------------------------------
 

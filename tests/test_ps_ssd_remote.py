@@ -1,0 +1,121 @@
+"""SSD-tier-backed PS shards: the remote twin of the local RAM/disk tier.
+
+Role of the reference's SSD table serving under the PS plane
+(``box_wrapper.h:635`` LoadSSD2Mem on a served shard): each PS server
+bounds its RAM-resident rows and overflows the coldest to per-shard disk
+buckets, transparently to clients — pulls stage disk rows back in, and
+save/load round-trips the union of both tiers.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.distributed.ps import start_local_cluster
+from paddlebox_tpu.embedding.ssd_tier import TieredFeatureStore
+from paddlebox_tpu.embedding.table import TableConfig
+
+RAM_BUDGET = 40
+
+
+@pytest.fixture
+def tiered_cluster(tmp_path):
+    cfg = TableConfig(name="emb", dim=4, optimizer="adagrad",
+                      learning_rate=0.1)
+
+    def factory(c, idx):
+        return TieredFeatureStore(c, str(tmp_path / f"shard{idx}"),
+                                  max_ram_features=RAM_BUDGET, seed=idx)
+
+    servers, client = start_local_cluster(2, {"emb": cfg},
+                                          store_factory=factory)
+    yield servers, client, cfg
+    client.stop_servers()
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+def _plain_cluster(cfg):
+    return start_local_cluster(2, {"emb": cfg})
+
+
+def test_remote_tier_parity_with_plain_store(tiered_cluster):
+    """Same pull/push traffic against tiered and plain clusters must give
+    identical values even when the tiered shards evict past budget —
+    tier movement is a placement detail, not a semantics change."""
+    servers, client, cfg = tiered_cluster
+    plain_servers, plain_client = _plain_cluster(cfg)
+    try:
+        rng = np.random.default_rng(0)
+        # 4x the per-shard RAM budget so eviction must happen.
+        all_keys = np.arange(1, 4 * 2 * RAM_BUDGET + 1, dtype=np.uint64)
+        for step in range(4):
+            keys = rng.choice(all_keys, size=64, replace=False)
+            a = client.pull_sparse("emb", keys)
+            b = plain_client.pull_sparse("emb", keys)
+            np.testing.assert_allclose(a["emb"], b["emb"], atol=1e-6)
+            g = rng.standard_normal((64, 4)).astype(np.float32)
+            kw = dict(emb_grad=g,
+                      w_grad=np.ones((64,), np.float32),
+                      show=np.ones((64,), np.float32),
+                      click=np.zeros((64,), np.float32))
+            client.push_sparse("emb", keys, **kw)
+            plain_client.push_sparse("emb", keys, **kw)
+        # After the churn: every key must still read back identically.
+        a = client.pull_sparse("emb", all_keys)
+        b = plain_client.pull_sparse("emb", all_keys)
+        np.testing.assert_allclose(a["emb"], b["emb"], atol=1e-6)
+        np.testing.assert_allclose(a["w"], b["w"], atol=1e-6)
+    finally:
+        plain_client.stop_servers()
+        plain_client.close()
+        for s in plain_servers:
+            s.stop()
+
+
+def test_remote_tier_actually_evicts(tiered_cluster):
+    servers, client, _ = tiered_cluster
+    all_keys = np.arange(1, 4 * 2 * RAM_BUDGET + 1, dtype=np.uint64)
+    client.pull_sparse("emb", all_keys)  # persists init rows
+    g = np.ones((all_keys.size, 4), np.float32)
+    client.push_sparse("emb", all_keys, emb_grad=g,
+                       w_grad=np.ones((all_keys.size,), np.float32))
+    for s in servers:
+        store = s.tables["emb"]
+        assert isinstance(store, TieredFeatureStore)
+        assert store.ram.num_features <= RAM_BUDGET
+        assert store.disk.num_features > 0
+    # stats() reports the union (RAM + disk), not just resident rows.
+    total = sum(st["emb"] for st in client.stats())
+    assert total == all_keys.size
+
+
+def test_remote_tier_save_load_roundtrip(tiered_cluster, tmp_path):
+    servers, client, cfg = tiered_cluster
+    keys = np.arange(1, 3 * 2 * RAM_BUDGET + 1, dtype=np.uint64)
+    before = client.pull_sparse("emb", keys)
+    client.push_sparse("emb", keys,
+                       emb_grad=np.ones((keys.size, 4), np.float32),
+                       w_grad=np.ones((keys.size,), np.float32))
+    after = client.pull_sparse("emb", keys)
+    ckpt = str(tmp_path / "ckpt")
+    client.save(ckpt, "base")
+
+    # Fresh tiered cluster, same shard count: load must restore every
+    # row — including the ones that lived on disk at save time.
+    def factory(c, idx):
+        return TieredFeatureStore(c, str(tmp_path / f"re{idx}"),
+                                  max_ram_features=RAM_BUDGET, seed=idx)
+
+    servers2, client2 = start_local_cluster(2, {"emb": cfg},
+                                            store_factory=factory)
+    try:
+        client2.load(ckpt, "base")
+        out = client2.pull_sparse("emb", keys)
+        np.testing.assert_allclose(out["emb"], after["emb"], atol=1e-6)
+        assert not np.allclose(out["emb"], before["emb"])
+    finally:
+        client2.stop_servers()
+        client2.close()
+        for s in servers2:
+            s.stop()
