@@ -1,0 +1,96 @@
+"""CTR training against a remote parameter-server cluster with SSD tiers.
+
+The multi-node deployment shape (reference role: CPU PS + SSD table
+under BoxPS): sharded PS servers hold the persistent feature store —
+each shard bounded in RAM with disk overflow — and the trainer's pass
+engine does BuildPull / EndPass write-back over the typed wire.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/remote_ps_tiered.py
+"""
+
+import os
+import sys
+
+# Runnable from anywhere: put the repo root (parent of examples/) on the
+# path so `python examples/<name>.py` works without installing.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tempfile
+
+import numpy as np
+
+import jax
+
+from paddlebox_tpu.data.dataset import Dataset
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+from paddlebox_tpu.distributed.ps import PSBackedStore, start_local_cluster
+from paddlebox_tpu.embedding import TableConfig
+from paddlebox_tpu.embedding.ssd_tier import TieredFeatureStore
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+SLOTS = ("user", "item", "ctx")
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as tmp:
+        _run(tmp)
+
+
+def _run(tmp: str) -> None:
+    cfg = TableConfig(name="emb", dim=8, learning_rate=0.05)
+
+    # 2 PS shards, each keeping at most 500 hot rows in RAM.
+    def tiered(c, shard_idx):
+        return TieredFeatureStore(c, os.path.join(tmp, f"ssd{shard_idx}"),
+                                  max_ram_features=500, seed=shard_idx)
+
+    servers, client = start_local_cluster(2, {"emb": cfg},
+                                          store_factory=tiered)
+    try:
+        mesh = build_mesh(HybridTopology(dp=len(jax.devices())))
+        feed = DataFeedConfig(
+            slots=tuple(SlotConf(s, avg_len=1.5) for s in SLOTS),
+            batch_size=128)
+        trainer = CTRTrainer(
+            DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(32,)), feed, cfg,
+            mesh=mesh, config=TrainerConfig(auc_num_buckets=1 << 10),
+            store_factory=lambda c: PSBackedStore(client, "emb"))
+        trainer.init(seed=0)
+
+        rng = np.random.default_rng(0)
+        path = os.path.join(tmp, "part-0")
+        with open(path, "w") as f:
+            for _ in range(2048):
+                feats = {s: rng.integers(1, 4000, rng.integers(1, 3))
+                         for s in SLOTS}
+                toks = " ".join(f"{s}:{v}" for s, vs in feats.items()
+                                for v in vs)
+                f.write(f"{int(rng.random() < 0.2)} {toks}\n")
+
+        for ep in range(2):
+            ds = Dataset(feed, num_reader_threads=2)
+            ds.set_filelist([path])
+            ds.load_into_memory()
+            stats = trainer.train_pass(ds)
+            print(f"pass {ep}: loss={stats['loss']:.4f} "
+                  f"auc={stats['auc']:.4f}")
+
+        for s in servers:
+            st = s.tables["emb"]
+            print(f"shard {s.index}: ram={st.ram.num_features} "
+                  f"disk={st.disk.num_features}")
+        total = sum(st["emb"] for st in client.stats())
+        print(f"cluster holds {total} features across "
+              f"{len(servers)} shards")
+    finally:
+        client.stop_servers()
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+if __name__ == "__main__":
+    main()

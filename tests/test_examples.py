@@ -1,0 +1,32 @@
+"""The examples/ scripts are executable documentation — run each in a
+subprocess on the virtual CPU mesh and require a clean exit. A broken
+example is a broken promise to the first user."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXAMPLES = [
+    "ctr_deepfm_end_to_end.py",
+    "day_production_loop.py",
+    "gpt_hybrid_parallel.py",
+    "remote_ps_tiered.py",
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_runs_clean(script):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script)],
+        env=env, capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"{script} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
