@@ -34,7 +34,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# metric-key in SELF_BASELINE -> bench config name
+# bench.py config name -> its metric key in bench.py's SELF_BASELINE
 CONFIGS = {
     "deepfm": "deepfm_e2e",
     "wide_deep": "wide_deep",
@@ -48,7 +48,11 @@ END = "<!-- record_baselines:end -->"
 
 
 def tpu_alive(timeout: int = 120) -> bool:
-    probe = ("import jax; jax.devices(); import jax.numpy as jnp; "
+    """True only when a real TPU backend answers — a silent CPU fallback
+    must read as 'down' or the recorder would burn full-scale runs whose
+    results bench.py then rejects as non-tpu."""
+    probe = ("import jax; assert jax.default_backend() == 'tpu'; "
+             "import jax.numpy as jnp; "
              "jnp.ones(4).sum().block_until_ready()")
     try:
         return subprocess.run(
@@ -177,11 +181,14 @@ def main() -> None:
             print(f"[{name}] attempt {attempt}", flush=True)
             out = run_bench(name, args.timeout_s)
             print(f"[{name}] -> {json.dumps(out)[:300]}", flush=True)
-            if "error" not in out:
+            if "error" not in out or attempt == 2:
                 break
-            # Tunnel may have died mid-bench: wait for it to come back
-            # before burning the retry.
-            while not tpu_alive():
+            # Tunnel may have died mid-bench: give it a bounded window
+            # to come back before the one retry — then record whatever
+            # we have (a FAILED row beats a hung recorder).
+            t0 = time.monotonic()
+            while (not tpu_alive()
+                   and time.monotonic() - t0 < args.wait_limit_s):
                 print("tpu lost, waiting", flush=True)
                 time.sleep(240)
         results[name] = out
