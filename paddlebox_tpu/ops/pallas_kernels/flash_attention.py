@@ -110,8 +110,13 @@ def _fwd_kernel(qoff_ref, koff_ref, kreal_ref, q_ref, k_ref, v_ref,
         m = m_scr[:, 0]
         out_ref[0] = (acc[:] / jnp.maximum(l, 1e-20)[:, None]
                       ).astype(out_ref.dtype)
-        lse_ref[0] = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-20)),
-                               _NEG_BIG)
+        # lse block is (1, 1, block_q): TPU tiling requires the block's
+        # second-minor dim to divide 8 or equal the array dim, which a
+        # (1, block_q) view of [BH, Sq] cannot satisfy — row stats ride
+        # as [BH, 1, Sq] instead.
+        lse_ref[0, 0] = jnp.where(l > 0.0,
+                                  m + jnp.log(jnp.maximum(l, 1e-20)),
+                                  _NEG_BIG)
 
 
 def _fwd_pallas(q3, k3, v3, qoff, koff, sk_real, *, scale, causal,
@@ -125,7 +130,7 @@ def _fwd_pallas(q3, k3, v3, qoff, koff, sk_real, *, scale, causal,
                              memory_space=pltpu.SMEM)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k)
-    return pl.pallas_call(
+    out, lse3 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -142,12 +147,12 @@ def _fwd_pallas(q3, k3, v3, qoff, koff, sk_real, *, scale, causal,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -156,6 +161,7 @@ def _fwd_pallas(q3, k3, v3, qoff, koff, sk_real, *, scale, causal,
         ],
         interpret=interpret,
     )(qoff, koff, sk_real, q3, k3, v3)
+    return out, lse3.reshape(bh, sq)
 
 
 # ---------------------------------------------------------------------------
@@ -175,7 +181,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, qoff_ref, koff_ref, kreal_ref,
     valid = k_local < kreal_ref[0, 0]
     if causal:
         valid = jnp.logical_and(valid, k_pos <= q_pos)
-    lse = lse_ref[0]
+    lse = lse_ref[0, 0]
     p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
     return p, valid
 
@@ -198,7 +204,7 @@ def _dq_kernel(qoff_ref, koff_ref, kreal_ref, q_ref, k_ref, v_ref,
         do = do_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
         k = k_ref[0].astype(jnp.float32)
         dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -227,7 +233,7 @@ def _dkv_kernel(qoff_ref, koff_ref, kreal_ref, q_ref, k_ref, v_ref,
         dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
         q = q_ref[0].astype(jnp.float32)
         dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
 
@@ -244,11 +250,15 @@ def _bwd_pallas(q3, k3, v3, out3, lse, do3, qoff, koff, sk_real, *,
     nq, nk = sq // block_q, sk // block_k
     delta = jnp.sum(do3.astype(jnp.float32) * out3.astype(jnp.float32),
                     axis=-1)
+    # Row stats as [BH, 1, Sq] — (1, block) blocks of a 2-D array break
+    # the TPU block-tiling rule (see the fwd lse spec).
+    lse3 = lse.reshape(bh, 1, sq)
+    delta3 = delta.reshape(bh, 1, sq)
     smem = functools.partial(pl.BlockSpec, (1, 1),
                              memory_space=pltpu.SMEM)
     qspec = lambda bm, im: pl.BlockSpec((1, bm, d), im,
                                         memory_space=pltpu.VMEM)
-    rspec = lambda bm, im: pl.BlockSpec((1, bm), im,
+    rspec = lambda bm, im: pl.BlockSpec((1, 1, bm), im,
                                         memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
@@ -262,14 +272,14 @@ def _bwd_pallas(q3, k3, v3, out3, lse, do3, qoff, koff, sk_real, *,
             qspec(block_k, lambda b, i, j: (b, j, 0)),
             qspec(block_k, lambda b, i, j: (b, j, 0)),
             qspec(block_q, lambda b, i, j: (b, i, 0)),
-            rspec(block_q, lambda b, i, j: (b, i)),
-            rspec(block_q, lambda b, i, j: (b, i)),
+            rspec(block_q, lambda b, i, j: (b, 0, i)),
+            rspec(block_q, lambda b, i, j: (b, 0, i)),
         ],
         out_specs=qspec(block_q, lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qoff, koff, sk_real, q3, k3, v3, do3, lse, delta)
+    )(qoff, koff, sk_real, q3, k3, v3, do3, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
@@ -282,8 +292,8 @@ def _bwd_pallas(q3, k3, v3, out3, lse, do3, qoff, koff, sk_real, *,
             qspec(block_k, lambda b, j, i: (b, j, 0)),
             qspec(block_k, lambda b, j, i: (b, j, 0)),
             qspec(block_q, lambda b, j, i: (b, i, 0)),
-            rspec(block_q, lambda b, j, i: (b, i)),
-            rspec(block_q, lambda b, j, i: (b, i)),
+            rspec(block_q, lambda b, j, i: (b, 0, i)),
+            rspec(block_q, lambda b, j, i: (b, 0, i)),
         ],
         out_specs=[qspec(block_k, lambda b, j, i: (b, j, 0)),
                    qspec(block_k, lambda b, j, i: (b, j, 0))],
@@ -292,7 +302,7 @@ def _bwd_pallas(q3, k3, v3, out3, lse, do3, qoff, koff, sk_real, *,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qoff, koff, sk_real, q3, k3, v3, do3, lse, delta)
+    )(qoff, koff, sk_real, q3, k3, v3, do3, lse3, delta3)
     return dq, dk, dv
 
 

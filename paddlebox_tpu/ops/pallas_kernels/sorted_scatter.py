@@ -39,6 +39,12 @@ BLOCK = 8192
 # ~n/nblocks per block; 4096 covers the binomial tail by orders of
 # magnitude — overflow means a genuinely hot row, handled by fallback.
 UCAP = 4096
+# DMA source offsets must be provably tile-aligned (i32 1-D VMEM tiles at
+# 1024 elements; f32 2-D at 8 sublanes — 1024 covers both): each block's
+# staging copy starts at the run's offset rounded DOWN to ALIGN and the
+# window carries ALIGN rows of slack, with the kernel skipping into it.
+ALIGN = 1024
+WINDOW = UCAP + ALIGN
 
 
 def _kernel(starts_ref, rows_ref, payload_ref, acc_ref, rows_s, pay_s,
@@ -49,13 +55,17 @@ def _kernel(starts_ref, rows_ref, payload_ref, acc_ref, rows_s, pay_s,
 
     # Stage this block's run of (row, payload) updates: row ids into SMEM
     # (they are read one scalar at a time at a data-dependent index — VMEM
-    # vector loads need 1024-element-aligned offsets Mosaic cannot prove
-    # for a dynamic scalar index), payloads into VMEM. The inputs are
-    # padded by UCAP rows so the fixed-size slice never reads out of
-    # bounds.
-    dma0 = pltpu.make_async_copy(rows_ref.at[pl.ds(lo, UCAP)], rows_s,
+    # vector loads need tile-aligned offsets Mosaic cannot prove for a
+    # dynamic scalar index), payloads into VMEM. The copy starts at the
+    # run's offset rounded down to the tile boundary (ALIGN) — Mosaic
+    # requires provably aligned DMA source offsets — and the loop skips
+    # the `off` leading rows of slack. Inputs are padded by WINDOW rows
+    # so the fixed-size slice never reads out of bounds.
+    lo_a = pl.multiple_of((lo // ALIGN) * ALIGN, ALIGN)
+    off = lo - lo_a
+    dma0 = pltpu.make_async_copy(rows_ref.at[pl.ds(lo_a, WINDOW)], rows_s,
                                  sem0)
-    dma1 = pltpu.make_async_copy(payload_ref.at[pl.ds(lo, UCAP), :],
+    dma1 = pltpu.make_async_copy(payload_ref.at[pl.ds(lo_a, WINDOW), :],
                                  pay_s, sem1)
     dma0.start()
     dma1.start()
@@ -65,12 +75,14 @@ def _kernel(starts_ref, rows_ref, payload_ref, acc_ref, rows_s, pay_s,
 
     base = b * BLOCK
 
+    aw = acc_ref.shape[1]
+
     def body(j, _):
         r = rows_s[j] - base
-        acc_ref[pl.ds(r, 1), :] += pay_s[pl.ds(j, 1), :]
+        acc_ref[pl.ds(r, 1), :] += pay_s[pl.ds(j, 1), :aw]
         return 0
 
-    lax.fori_loop(0, jnp.minimum(cnt, UCAP), body, 0)
+    lax.fori_loop(off, off + jnp.minimum(cnt, UCAP), body, 0)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
@@ -81,6 +93,13 @@ def _sorted_accumulate(sorted_rows: jax.Array, sorted_payload: jax.Array,
     boundaries = jnp.arange(nblocks + 1, dtype=jnp.int32) * BLOCK
     starts = jnp.searchsorted(sorted_rows, boundaries).astype(jnp.int32)
 
+    # DMA slices must cover full 128-lane tiles: pad the payload's lane
+    # dim to the physical width (the HBM buffer is (1,128)-tiled and
+    # lane-padded regardless — this only makes the logical shape match
+    # so Mosaic accepts the copy; the kernel adds back only aw lanes).
+    lanes = 128
+    pay_full = jnp.pad(sorted_payload, ((0, 0), (0, lanes - aw)))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nblocks,),
@@ -90,8 +109,8 @@ def _sorted_accumulate(sorted_rows: jax.Array, sorted_payload: jax.Array,
         ],
         out_specs=pl.BlockSpec((BLOCK, aw), lambda b, starts: (b, 0)),
         scratch_shapes=[
-            pltpu.SMEM((UCAP,), jnp.int32),
-            pltpu.VMEM((UCAP, aw), jnp.float32),
+            pltpu.SMEM((WINDOW,), jnp.int32),
+            pltpu.VMEM((WINDOW, lanes), jnp.float32),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
@@ -101,7 +120,7 @@ def _sorted_accumulate(sorted_rows: jax.Array, sorted_payload: jax.Array,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows_pad, aw), jnp.float32),
         interpret=interpret,
-    )(starts, sorted_rows, sorted_payload)
+    )(starts, sorted_rows, pay_full)
 
 
 def sorted_scatter_accumulate(rows: jax.Array, payload: jax.Array,
@@ -109,9 +128,14 @@ def sorted_scatter_accumulate(rows: jax.Array, payload: jax.Array,
                               interpret: bool = False) -> jax.Array:
     """zeros([num_rows, AW]).at[rows].add(payload), exactly — via sort +
     VMEM-streamed accumulation. rows [n] int32 (entries >= num_rows are
-    dropped); payload [n, AW] float32. Falls back to the XLA scatter when
-    a block's update run exceeds the kernel budget (hot row)."""
+    dropped); payload [n, AW<=128] float32. Falls back to the XLA scatter
+    when a block's update run exceeds the kernel budget (hot row)."""
     n, aw = payload.shape
+    if aw > 128:
+        raise ValueError(
+            f"payload width {aw} > 128: the kernel stages updates in "
+            f"single-tile (128-lane) VMEM rows; split wider payloads "
+            f"into <=128-wide accumulations")
     rows_pad = -(-num_rows // BLOCK) * BLOCK
 
     # Dropped rows (>= num_rows) are remapped to rows_pad so they sort
@@ -124,12 +148,12 @@ def sorted_scatter_accumulate(rows: jax.Array, payload: jax.Array,
     order = jnp.argsort(rows)
     sorted_rows = rows[order].astype(jnp.int32)
     sorted_payload = payload[order].astype(jnp.float32)
-    # Pad by UCAP so the kernel's fixed-size DMA slices stay in bounds;
-    # pad rows use the drop sentinel.
+    # Pad by WINDOW so the kernel's fixed-size aligned DMA slices stay in
+    # bounds; pad rows use the drop sentinel.
     sorted_rows = jnp.concatenate(
-        [sorted_rows, jnp.full((UCAP,), rows_pad, jnp.int32)])
+        [sorted_rows, jnp.full((WINDOW,), rows_pad, jnp.int32)])
     sorted_payload = jnp.concatenate(
-        [sorted_payload, jnp.zeros((UCAP, aw), jnp.float32)])
+        [sorted_payload, jnp.zeros((WINDOW, aw), jnp.float32)])
 
     nblocks = rows_pad // BLOCK
     boundaries = jnp.arange(nblocks + 1, dtype=jnp.int32) * BLOCK

@@ -175,6 +175,10 @@ def main() -> None:
             time.sleep(240)
     print("tpu alive — recording", flush=True)
 
+    # One GLOBAL deadline for all retry waits: a permanently dead tunnel
+    # must not hold the recorder hostage per-config (a FAILED row beats
+    # a hung recorder).
+    deadline = time.monotonic() + args.wait_limit_s
     results = {}
     for name in args.configs.split(","):
         for attempt in (1, 2):
@@ -183,14 +187,13 @@ def main() -> None:
             print(f"[{name}] -> {json.dumps(out)[:300]}", flush=True)
             if "error" not in out or attempt == 2:
                 break
-            # Tunnel may have died mid-bench: give it a bounded window
-            # to come back before the one retry — then record whatever
-            # we have (a FAILED row beats a hung recorder).
-            t0 = time.monotonic()
-            while (not tpu_alive()
-                   and time.monotonic() - t0 < args.wait_limit_s):
+            # Tunnel may have died mid-bench: give it until the global
+            # deadline to come back before the one retry.
+            while not tpu_alive() and time.monotonic() < deadline:
                 print("tpu lost, waiting", flush=True)
                 time.sleep(240)
+            if time.monotonic() >= deadline:
+                break
         results[name] = out
         append_log(name, out)
         record(results)  # persist incrementally — flaps lose nothing
