@@ -28,9 +28,12 @@ from paddlebox_tpu.ops.seqpool_variants import (
     quantize,
 )
 from paddlebox_tpu.ops.rank_attention import rank_attention, rank_attention2
+from paddlebox_tpu.ops.data_norm import data_norm_apply, data_norm_init
 
 __all__ = [
     "continuous_value_model",
+    "data_norm_apply",
+    "data_norm_init",
     "fused_concat",
     "fused_seqpool_cvm",
     "fused_seqpool_cvm_full",

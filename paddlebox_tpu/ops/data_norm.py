@@ -1,0 +1,138 @@
+"""DataNorm — global-statistics feature normalization for CTR dense paths.
+
+Role of the reference's ``data_norm`` op (``data_norm_op.cc:292`` CPU
+kernel, ``data_norm_op.cu:90`` KernelUpdateParam, python surface
+``fluid/layers/nn.py:3490``): normalize each feature channel by running
+GLOBAL statistics — not per-batch moments like BatchNorm — maintained as
+three per-channel accumulators (size, sum, square_sum) that decay by
+``summary_decay_rate`` and absorb each batch's contribution. PaddleBox
+CTR models run it over the concatenated dense/show-click features.
+
+TPU-first shape: a pure function over an explicit stats pytree —
+``(y, new_stats) = data_norm_apply(stats, x, ...)`` with the stats
+update fused into the same jitted program (no mutable parameter hooks),
+and ``sync_stats`` realized as a ``lax.psum`` over the dp mesh axis
+(role of the NCCL allreduce in ``data_norm_op.cu:208``).
+
+Semantics mirrored from the reference:
+
+- ``means = sum / size``; ``scales = sqrt(size / square_sum)``;
+  ``y = (x - means) * scales`` (optionally ``* scale_w + bias``).
+- ``slot_dim > 0``: x is a concatenation of per-slot chunks whose first
+  element is the show count; chunks with show ~ 0 (new/empty slot)
+  output zeros and are EXCLUDED from the stats update
+  (``data_norm_op.cc:341-357,686-718``).
+- batch deltas: without slot_dim ``(N, sum(x), sum((x-mean)^2) + N*eps)``;
+  with slot_dim the per-channel deltas are normalized to a size of 1
+  (``d_sum /= d_size; d_sq = d_sq/d_size + d_size*eps; d_size = 1``).
+- update: ``stats = stats * decay + delta`` (KernelUpdateParam).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_MIN_PRECISION = 1e-7
+
+
+def data_norm_init(c: int, *, batch_size_default: float = 1e4,
+                   batch_sum_default: float = 0.0,
+                   batch_square_sum_default: float = 1e4,
+                   enable_scale_and_shift: bool = False
+                   ) -> Dict[str, jax.Array]:
+    """Per-channel stats (reference defaults make the initial transform
+    the identity: mean 0, scale sqrt(1e4/1e4) = 1)."""
+    out = {
+        "batch_size": jnp.full((c,), batch_size_default, jnp.float32),
+        "batch_sum": jnp.full((c,), batch_sum_default, jnp.float32),
+        "batch_square_sum": jnp.full((c,), batch_square_sum_default,
+                                     jnp.float32),
+    }
+    if enable_scale_and_shift:
+        out["scale_w"] = jnp.ones((c,), jnp.float32)
+        out["bias"] = jnp.zeros((c,), jnp.float32)
+    return out
+
+
+def data_norm_apply(stats: Dict[str, jax.Array], x: jax.Array, *,
+                    slot_dim: int = -1, epsilon: float = 1e-4,
+                    summary_decay_rate: float = 0.9999999,
+                    train: bool = True,
+                    axis_name: Optional[str] = None
+                    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """x [N, C] -> (y [N, C], updated stats).
+
+    ``axis_name`` syncs the batch deltas across that mesh axis before
+    the decayed update (sync_stats role). Stats are state, not
+    gradients — thread them like BN running stats; gradients flow
+    through y w.r.t. x as a plain affine transform.
+    """
+    n, c = x.shape
+    xf = x.astype(jnp.float32)
+    # The three accumulators are updated ONLY by the decayed summary
+    # below (KernelUpdateParam role) — never by SGD, so no cotangent may
+    # flow into them through y. scale_w/bias (when enabled) stay
+    # differentiable: the reference trains those as ordinary parameters.
+    size = lax.stop_gradient(stats["batch_size"])
+    means = lax.stop_gradient(stats["batch_sum"]) / size
+    scales = jnp.sqrt(size / lax.stop_gradient(stats["batch_square_sum"]))
+    y = (xf - means) * scales
+    enable_ss = "scale_w" in stats
+    if enable_ss:
+        y = y * stats["scale_w"] + stats["bias"]
+
+    valid = None
+    if slot_dim > 0 and not enable_ss:
+        if c % slot_dim:
+            raise ValueError(f"C={c} not divisible by slot_dim={slot_dim}")
+        # Chunk k covers channels [k*slot_dim, (k+1)*slot_dim); its show
+        # count sits at the chunk's first channel.
+        show = xf[:, ::slot_dim]                       # [N, C/slot_dim]
+        alive = jnp.abs(show) >= _MIN_PRECISION       # [N, C/slot_dim]
+        valid = jnp.repeat(alive, slot_dim, axis=1)   # [N, C]
+        y = jnp.where(valid, y, 0.0)
+    y = y.astype(x.dtype)
+
+    if not train:
+        return y, stats
+
+    # Batch stat deltas (the reference computes these in the grad op —
+    # they are accumulators, not true gradients; lax.stop_gradient keeps
+    # autodiff from routing cotangents into the stats path).
+    xs = lax.stop_gradient(xf)
+    if valid is not None:
+        v = valid.astype(jnp.float32)
+        d_size = jnp.sum(v, axis=0)
+        d_sum = jnp.sum(xs * v, axis=0)
+        d_sq = jnp.sum((xs - means) ** 2 * v, axis=0)
+        if axis_name is not None:
+            d_size = lax.psum(d_size, axis_name)
+            d_sum = lax.psum(d_sum, axis_name)
+            d_sq = lax.psum(d_sq, axis_name)
+        # Normalize to per-sample scale (data_norm_op.cc:708-716);
+        # channels that saw no live chunk contribute nothing.
+        seen = d_size >= 1.0
+        d_sum = jnp.where(seen, d_sum / jnp.maximum(d_size, 1.0), 0.0)
+        d_sq = jnp.where(
+            seen,
+            d_sq / jnp.maximum(d_size, 1.0) + d_size * epsilon, 0.0)
+        d_size = jnp.where(seen, 1.0, 0.0)
+    else:
+        d_size = jnp.full((c,), float(n), jnp.float32)
+        d_sum = jnp.sum(xs, axis=0)
+        d_sq = jnp.sum((xs - means) ** 2, axis=0) + n * epsilon
+        if axis_name is not None:
+            d_size = lax.psum(d_size, axis_name)
+            d_sum = lax.psum(d_sum, axis_name)
+            d_sq = lax.psum(d_sq, axis_name)
+
+    dr = summary_decay_rate
+    new_stats = dict(stats)
+    new_stats["batch_size"] = size * dr + d_size
+    new_stats["batch_sum"] = stats["batch_sum"] * dr + d_sum
+    new_stats["batch_square_sum"] = stats["batch_square_sum"] * dr + d_sq
+    return y, new_stats

@@ -135,6 +135,17 @@ class CTRPredictor:
                 if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, t)
 
         def fwd(table, params, rows, segments, dense_feats):
+            if isinstance(params, dict) and "data_norm" in params:
+                # data_norm-trained models (TrainerConfig.data_norm):
+                # normalize exactly as the trainer's forward does — by
+                # the f32 global stats, before any compute-dtype cast —
+                # or served probabilities diverge from training.
+                from paddlebox_tpu.ops.data_norm import data_norm_apply
+                if dense_feats is not None:
+                    dense_feats, _ = data_norm_apply(
+                        params["data_norm"], dense_feats, train=False)
+                params = {k: v for k, v in params.items()
+                          if k != "data_norm"}
             picked = table[rows]                      # [sum caps, D+1]
             off = 0
             emb: Dict[str, jax.Array] = {}

@@ -62,6 +62,13 @@ class TrainerConfig:
     # model fwd/bwd so matmuls hit the MXU at native rate; master params,
     # optimizer state, loss, AUC, and the sparse push stay float32.
     compute_dtype: str = "float32"
+    # DataNorm over the concatenated dense features (role of the
+    # reference's data_norm op in CTR models, data_norm_op.cc): global
+    # decayed statistics, synced across dp every step, threaded through
+    # the step as state (f32 regardless of compute_dtype).
+    data_norm: bool = False
+    data_norm_slot_dim: int = -1
+    data_norm_decay: float = 0.9999999
 
 
 class CTRTrainer:
@@ -140,6 +147,16 @@ class CTRTrainer:
     def init(self, seed: int = 0) -> None:
         rng = jax.random.PRNGKey(seed)
         self.params = self.model.init(rng)
+        if self.config.data_norm:
+            dense_dim = sum(s.dim for s in self.feed_config.dense_slots)
+            if not dense_dim:
+                raise ValueError("data_norm=True but the feed declares "
+                                 "no dense slots")
+            from paddlebox_tpu.ops.data_norm import data_norm_init
+            # Lives in the params tree (checkpointed with the dense
+            # model) but is updated by the decayed summary path, not the
+            # optimizer — _build_step overwrites it after the update.
+            self.params["data_norm"] = data_norm_init(dense_dim)
         self.opt_state = self._optax.init(self.params)
         self.auc_state = auc_state_init(self.config.auc_num_buckets)
         if self.mesh is not None:
@@ -184,8 +201,21 @@ class CTRTrainer:
                 lambda x: x.astype(cdt)
                 if x.dtype == jnp.float32 else x, tree)
 
+        dn_slot_dim = self.config.data_norm_slot_dim
+
         def forward(params, pulled, segments, dense_feats,
                     emb_alls=None, w_alls=None):
+            if isinstance(params, dict) and "data_norm" in params:
+                # Normalize dense features by the global stats BEFORE the
+                # bf16 cast (the ~1e4-scale accumulators must stay f32);
+                # the stats update happens in the train body, not here.
+                from paddlebox_tpu.ops.data_norm import data_norm_apply
+                if dense_feats is not None:
+                    dense_feats, _ = data_norm_apply(
+                        params["data_norm"], dense_feats,
+                        slot_dim=dn_slot_dim, train=False)
+                params = {k: v for k, v in params.items()
+                          if k != "data_norm"}
             params = cast(params)
             dense_feats = cast(dense_feats)
             if emb_alls is not None:
@@ -229,9 +259,20 @@ class CTRTrainer:
         mode = self.config.dense_sync_mode
         if mode not in ("step", "kstep", "async"):
             raise ValueError(f"unknown dense_sync_mode {mode!r}")
+        dn_on = self.config.data_norm
+        if dn_on and mode == "async":
+            # The reference routes data_norm stats through the async
+            # dense table with update_norm=False (data_norm_op.cu:253);
+            # this build updates them in-step, which the async host
+            # table would overwrite.
+            raise NotImplementedError(
+                "data_norm with dense_sync_mode='async' is not supported")
+        dn_slot_dim = self.config.data_norm_slot_dim
+        dn_decay = self.config.data_norm_decay
 
         def body(tables, params, opt_state, auc, rows, segments, labels,
                  valid, dense_feats, sync_flag):
+            dn_old = params.get("data_norm") if dn_on else None
             # rows[g]: [sum caps_local over group g's slots] — each width
             # group's slots fused into ONE pull (one all_to_all pair per
             # group; G = #distinct widths, typically 1-3).
@@ -280,6 +321,21 @@ class CTRTrainer:
                     lambda p: p, params)
             else:  # async: host table applies the update
                 g_params = lax.psum(g_params, axis)
+
+            if dn_on:
+                # Decayed summary update from the SAME stats the forward
+                # normalized with (the optimizer saw zero grads for them
+                # — stop_gradient — so post-update stats are unchanged);
+                # psum over dp = the sync_stats allreduce.
+                from paddlebox_tpu.ops.data_norm import data_norm_apply
+                _, dn_new = data_norm_apply(
+                    dn_old, dense_feats.astype(jnp.float32),
+                    slot_dim=dn_slot_dim, summary_decay_rate=dn_decay,
+                    axis_name=axis)
+                params = {**params, "data_norm": {
+                    **params["data_norm"],
+                    **{k: dn_new[k] for k in (
+                        "batch_size", "batch_sum", "batch_square_sum")}}}
 
             # Sparse push per group: show=1 per occurrence, click=its
             # row's label (role of show/click stats in PushSparseGrad).

@@ -12,7 +12,6 @@ kernel stops compiling at the exact shapes the benchmarks use.
 Skipped when libtpu's AOT topology is unavailable in the environment.
 """
 
-import numpy as np
 import pytest
 
 import jax

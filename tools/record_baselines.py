@@ -188,11 +188,16 @@ def main() -> None:
             if "error" not in out or attempt == 2:
                 break
             # Tunnel may have died mid-bench: give it until the global
-            # deadline to come back before the one retry.
-            while not tpu_alive() and time.monotonic() < deadline:
+            # deadline to come back before the one retry. A live tunnel
+            # always gets its retry (transient failures late in a long
+            # run must not be recorded FAILED unretried); only a tunnel
+            # still dead past the deadline forfeits it.
+            alive = tpu_alive()
+            while not alive and time.monotonic() < deadline:
                 print("tpu lost, waiting", flush=True)
                 time.sleep(240)
-            if time.monotonic() >= deadline:
+                alive = tpu_alive()
+            if not alive:
                 break
         results[name] = out
         append_log(name, out)
