@@ -39,6 +39,21 @@ from jax import lax
 _MIN_PRECISION = 1e-7
 
 
+def normalize_dense_and_strip(params, dense_feats, *, slot_dim: int = -1):
+    """Shared train-forward/serving helper: if ``params`` carries a
+    ``data_norm`` stats entry, normalize ``dense_feats`` by it (f32,
+    no stats update) and return (params-without-the-entry, dense).
+    One implementation for both sides — trainer and predictor MUST
+    normalize identically or served probabilities drift from training."""
+    if not (isinstance(params, dict) and "data_norm" in params):
+        return params, dense_feats
+    if dense_feats is not None:
+        dense_feats, _ = data_norm_apply(params["data_norm"], dense_feats,
+                                         slot_dim=slot_dim, train=False)
+    return {k: v for k, v in params.items() if k != "data_norm"}, \
+        dense_feats
+
+
 def data_norm_init(c: int, *, batch_size_default: float = 1e4,
                    batch_sum_default: float = 0.0,
                    batch_square_sum_default: float = 1e4,

@@ -80,9 +80,14 @@ class CTRPredictor:
 
     def __init__(self, model, feed_config, keys: np.ndarray,
                  emb: np.ndarray, w: np.ndarray, dense_params,
-                 *, compute_dtype: str = "bfloat16"):
+                 *, compute_dtype: str = "bfloat16",
+                 data_norm_slot_dim: int = -1):
         self.model = model
         self.feed = feed_config
+        # Must match the trainer's TrainerConfig.data_norm_slot_dim for
+        # data_norm-trained models — the show-skip zeroing is part of
+        # the forward.
+        self._dn_slot_dim = int(data_norm_slot_dim)
         order = np.argsort(keys, kind="stable")
         self._index = native_store.KeyIndex()
         rows, n_new = self._index.upsert(
@@ -134,18 +139,17 @@ class CTRPredictor:
                 lambda x: x.astype(cdt)
                 if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, t)
 
+        dn_slot_dim = self._dn_slot_dim
+
         def fwd(table, params, rows, segments, dense_feats):
-            if isinstance(params, dict) and "data_norm" in params:
-                # data_norm-trained models (TrainerConfig.data_norm):
-                # normalize exactly as the trainer's forward does — by
-                # the f32 global stats, before any compute-dtype cast —
-                # or served probabilities diverge from training.
-                from paddlebox_tpu.ops.data_norm import data_norm_apply
-                if dense_feats is not None:
-                    dense_feats, _ = data_norm_apply(
-                        params["data_norm"], dense_feats, train=False)
-                params = {k: v for k, v in params.items()
-                          if k != "data_norm"}
+            # data_norm-trained models (TrainerConfig.data_norm):
+            # normalize exactly as the trainer's forward does — the
+            # SAME shared helper, f32 stats, before the compute cast —
+            # or served probabilities diverge from training.
+            from paddlebox_tpu.ops.data_norm import (
+                normalize_dense_and_strip)
+            params, dense_feats = normalize_dense_and_strip(
+                params, dense_feats, slot_dim=dn_slot_dim)
             picked = table[rows]                      # [sum caps, D+1]
             off = 0
             emb: Dict[str, jax.Array] = {}

@@ -205,17 +205,13 @@ class CTRTrainer:
 
         def forward(params, pulled, segments, dense_feats,
                     emb_alls=None, w_alls=None):
-            if isinstance(params, dict) and "data_norm" in params:
-                # Normalize dense features by the global stats BEFORE the
-                # bf16 cast (the ~1e4-scale accumulators must stay f32);
-                # the stats update happens in the train body, not here.
-                from paddlebox_tpu.ops.data_norm import data_norm_apply
-                if dense_feats is not None:
-                    dense_feats, _ = data_norm_apply(
-                        params["data_norm"], dense_feats,
-                        slot_dim=dn_slot_dim, train=False)
-                params = {k: v for k, v in params.items()
-                          if k != "data_norm"}
+            # Normalize dense features by the global stats BEFORE the
+            # bf16 cast (the ~1e4-scale accumulators must stay f32);
+            # the stats update happens in the train body, not here.
+            from paddlebox_tpu.ops.data_norm import (
+                normalize_dense_and_strip)
+            params, dense_feats = normalize_dense_and_strip(
+                params, dense_feats, slot_dim=dn_slot_dim)
             params = cast(params)
             dense_feats = cast(dense_feats)
             if emb_alls is not None:

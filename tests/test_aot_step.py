@@ -1,0 +1,40 @@
+"""The full CTR train/eval step must compile through the real XLA:TPU +
+Mosaic pipeline (compile-only PJRT topology) — program-level insurance
+the per-kernel AOT tests can't give (shard_map + donation + Pallas
+custom-call interactions). Runs tools/aot_check_step.py in a subprocess
+because it re-pins platforms at import time."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_tool(name, timeout):
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", name)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0 and "get_default_c_api_topology" in proc.stderr:
+        pytest.skip("no TPU AOT topology available")
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_full_ctr_step_aot_compiles_for_tpu():
+    out = _run_tool("aot_check_step.py", 900)
+    assert "FULL-STEP TPU AOT COMPILE: OK" in out
+    assert "EVAL-STEP TPU AOT COMPILE: OK" in out
+
+
+@pytest.mark.slow
+def test_multichip_steps_aot_compile_for_tpu():
+    """GPT hybrid (pp x sp, 1F1B, ring attention) and CTR dp=4 (sharded
+    table all-to-all) through the real TPU pipeline on a 4-device
+    compile-only topology — ICI collective lowering included."""
+    out = _run_tool("aot_check_multichip.py", 900)
+    assert "MULTICHIP TPU AOT COMPILE: OK" in out

@@ -154,7 +154,7 @@ def test_synced_stats_match_global_batch():
 
 # -- trainer integration ----------------------------------------------------
 
-def _train_once(data_norm, tmp_path, n_steps=4):
+def _train_once(data_norm, tmp_path, n_steps=4, slot_dim=-1):
     import os
     import tempfile
 
@@ -173,7 +173,8 @@ def _train_once(data_norm, tmp_path, n_steps=4):
                    hidden=(16,))
     tr = CTRTrainer(model, feed, TableConfig(dim=4, learning_rate=0.1),
                     mesh=mesh,
-                    config=TrainerConfig(data_norm=data_norm))
+                    config=TrainerConfig(data_norm=data_norm,
+                                         data_norm_slot_dim=slot_dim))
     tr.init(seed=0)
     rng = np.random.default_rng(7)
     p = str(tmp_path / f"part-dn-{data_norm}")
@@ -239,15 +240,17 @@ def test_trainer_data_norm_eval_does_not_touch_stats(tmp_path):
         np.testing.assert_array_equal(np.asarray(v), before[k])
 
 
-def test_serving_parity_with_data_norm(tmp_path):
+@pytest.mark.parametrize("slot_dim", [-1, 2])
+def test_serving_parity_with_data_norm(tmp_path, slot_dim):
     """The predictor must normalize dense features by the trained stats
-    exactly as the trainer forward does (PARITY serving row)."""
+    exactly as the trainer forward does (PARITY serving row) — incl. the
+    slot_dim show-skip zeroing."""
     import dataclasses
 
     from paddlebox_tpu.data.dataset import Dataset
     from paddlebox_tpu.serving import CTRPredictor, load_xbox_model
 
-    tr, _ = _train_once(True, tmp_path)
+    tr, _ = _train_once(True, tmp_path, slot_dim=slot_dim)
     n = tr.engine.store.save_xbox(str(tmp_path))
     keys, emb, w = load_xbox_model(str(tmp_path), table="embedding")
     assert keys.shape[0] == n
@@ -259,8 +262,17 @@ def test_serving_parity_with_data_norm(tmp_path):
     ds.load_into_memory()
     batch = next(ds.batches_sharded(1))
 
+    if slot_dim > 0:
+        # Zero some show channels so the skip path actually fires.
+        dense0 = {k: v.copy() for k, v in batch.dense.items()}
+        for v in dense0.values():
+            v[::3, 0] = 0.0
+            v[1::4, 2] = 0.0
+        batch = dataclasses.replace(batch, dense=dense0)
+
     pred = CTRPredictor(tr.model, tr.feed_config, keys, emb, w, tr.params,
-                        compute_dtype="float32")
+                        compute_dtype="float32",
+                        data_norm_slot_dim=slot_dim)
     probs = pred.predict(batch)
 
     # Reference: strip the stats and hand the predictor pre-normalized
@@ -270,7 +282,8 @@ def test_serving_parity_with_data_norm(tmp_path):
     stripped = {k: v for k, v in tr.params.items() if k != "data_norm"}
     dense_norm = {
         k: np.asarray(data_norm_apply(tr.params["data_norm"],
-                                      jnp.asarray(v), train=False)[0])
+                                      jnp.asarray(v), train=False,
+                                      slot_dim=slot_dim)[0])
         for k, v in batch.dense.items()}
     batch2 = dataclasses.replace(batch, dense=dense_norm)
     pred2 = CTRPredictor(tr.model, tr.feed_config, keys, emb, w, stripped,

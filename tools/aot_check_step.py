@@ -1,0 +1,120 @@
+"""AOT-compile the FULL jitted CTR train step for TPU — no TPU needed.
+
+The per-kernel AOT tests (tests/test_pallas_aot.py) prove each Pallas
+kernel compiles; this tool proves the whole bench device program does —
+pull all-to-all, fwd/bwd, scatter-accumulate push (Pallas path active:
+the flag's "auto" gate is forced on), dense update, AUC histograms —
+through the real XLA:TPU + Mosaic pipeline via jax's compile-only PJRT
+topology. Run after any change to the step, kernels, or models:
+
+    python tools/aot_check_step.py
+
+Shapes are a scaled-down bench config (full-scale kernel shapes are
+covered by the per-kernel tests; program structure, not size, is what
+this validates).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Append (last occurrence of a repeated flag wins) so an inherited
+# 8-virtual-device setting from a test env doesn't leak in.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from paddlebox_tpu.core import flags as flagmod  # noqa: E402
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf  # noqa: E402
+from paddlebox_tpu.embedding import TableConfig  # noqa: E402
+from paddlebox_tpu.models import DeepFM  # noqa: E402
+from paddlebox_tpu.parallel import HybridTopology, build_mesh  # noqa: E402
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig  # noqa: E402
+
+
+def sds_like(tree):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype),
+        tree)
+
+
+def main() -> None:
+    n_slots, emb_dim, dense_dim, batch = 8, 16, 13, 1024
+    pass_keys = 200_000
+
+    slots = tuple(SlotConf(f"s{i}", avg_len=1.0) for i in range(n_slots))
+    slots += (SlotConf("d", is_dense=True, dim=dense_dim),)
+    feed = DataFeedConfig(slots=slots, batch_size=batch,
+                          slot_capacity_slack=1.0)
+    model = DeepFM(slot_names=tuple(f"s{i}" for i in range(n_slots)),
+                   emb_dim=emb_dim, dense_dim=dense_dim,
+                   hidden=(400, 400, 400))
+    mesh_cpu = build_mesh(HybridTopology(dp=1))
+    tr = CTRTrainer(model, feed,
+                    TableConfig(dim=emb_dim, learning_rate=0.05),
+                    mesh=mesh_cpu,
+                    config=TrainerConfig(auc_num_buckets=1 << 16,
+                                         compute_dtype="bfloat16",
+                                         data_norm=True))
+    tr.init(seed=0)
+
+    # Real pass state on CPU to learn the exact argument structure.
+    rng = np.random.default_rng(0)
+    keys = np.sort(rng.choice(np.arange(1, 10 * pass_keys, dtype=np.uint64),
+                              pass_keys, replace=False))
+    tr.engine.feed_pass([keys for _ in tr.engine.groups])
+    tables = tr.engine.begin_pass()
+
+    import ml_dtypes
+    from paddlebox_tpu.data.slots import SlotBatch
+    ids = {f"s{i}": rng.choice(keys, batch).astype(np.uint64)
+           for i in range(n_slots)}
+    segs = {n: np.arange(batch, dtype=np.int32) for n in ids}
+    batch_obj = SlotBatch(
+        labels=(rng.random((batch, 1)) < 0.2).astype(np.float32),
+        valid=np.ones((batch,), bool),
+        ids=ids, segments=segs,
+        lengths={n: np.ones((batch,), np.int32) for n in ids},
+        dense={"d": rng.normal(size=(batch, dense_dim)
+                               ).astype(np.float32)})
+    rows = tr._map_batch_rows(batch_obj)
+    segs_j = {n: jnp.asarray(batch_obj.segments[n]) for n in ids}
+    dense_j = jnp.asarray(batch_obj.dense["d"].astype(ml_dtypes.bfloat16))
+
+    args = (tables, tr.params, tr.opt_state, tr.auc_state, rows, segs_j,
+            jnp.asarray(batch_obj.labels), jnp.asarray(batch_obj.valid),
+            dense_j, jnp.zeros((), jnp.int32))
+
+    # Rebuild the step against a compile-only TPU device mesh and force
+    # the Pallas scatter path (the "auto" gate keys off the default
+    # backend, which is cpu here).
+    topo = topologies.get_topology_desc("v5e:2x2x1", "tpu")
+    tr.mesh = Mesh(np.array([topo.devices[0]]), (tr.axis,))
+    flagmod.set_flags({"sparse_scatter_kernel": "pallas"})
+    step = tr._build_step()
+    compiled = step.lower(*sds_like(args)).compile()
+    print("FULL-STEP TPU AOT COMPILE: OK "
+          f"(flops={compiled.cost_analysis().get('flops', 0):.3e})")
+
+    eval_step = tr._build_eval_step()
+    eval_args = (tables, tr.params, tr.auc_state, rows, segs_j,
+                 jnp.asarray(batch_obj.labels),
+                 jnp.asarray(batch_obj.valid), dense_j)
+    eval_step.lower(*sds_like(eval_args)).compile()
+    print("EVAL-STEP TPU AOT COMPILE: OK")
+
+
+if __name__ == "__main__":
+    main()
