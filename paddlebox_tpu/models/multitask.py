@@ -1,0 +1,78 @@
+"""Shared-bottom multi-task CTR model (click + conversion style heads).
+
+Role of the reference's multi-task CTR setups whose metrics ship as
+``MultiTaskMetricMsg`` (``fleet/metrics.h:346``) and the multi-task AUC
+family in ``python/paddle/fluid/incubate/fleet/utils``: one shared
+sparse-embedding bottom feeding T per-task towers, trained on
+``num_labels >= T`` label columns with per-task AUC.
+
+Same functional contract as :class:`~paddlebox_tpu.models.DeepFM`
+(init/apply over pulled per-slot embeddings), but ``apply`` returns
+``[B, T]`` logits; CTRTrainer keys multi-task behavior (per-task loss +
+stacked AUC states) off the ``num_tasks`` attribute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.nn import mlp_apply, mlp_init
+from paddlebox_tpu.ops import seqpool
+
+
+@dataclasses.dataclass(frozen=True)
+class SharedBottomMultiTask:
+    slot_names: Tuple[str, ...]
+    emb_dim: Union[int, Mapping[str, int]]
+    num_tasks: int = 2
+    dense_dim: int = 0
+    bottom_hidden: Tuple[int, ...] = (256, 128)
+    tower_hidden: Tuple[int, ...] = (64,)
+
+    def _dims(self) -> Dict[str, int]:
+        if isinstance(self.emb_dim, int):
+            return {n: self.emb_dim for n in self.slot_names}
+        return {n: int(self.emb_dim[n]) for n in self.slot_names}
+
+    def init(self, rng: jax.Array) -> Dict:
+        in_dim = sum(self._dims().values()) + self.dense_dim
+        keys = jax.random.split(rng, self.num_tasks + 1)
+        bottom_out = self.bottom_hidden[-1]
+        return {
+            "bottom": mlp_init(keys[0], in_dim, list(self.bottom_hidden)),
+            "towers": [mlp_init(keys[1 + t], bottom_out,
+                                list(self.tower_hidden) + [1])
+                       for t in range(self.num_tasks)],
+            # Per-task wide bias over the pooled first-order weights.
+            "task_bias": jnp.zeros((self.num_tasks,), jnp.float32),
+        }
+
+    def apply(self, params: Dict,
+              emb: Dict[str, jax.Array],
+              w: Dict[str, jax.Array],
+              segments: Dict[str, jax.Array],
+              batch_size: int,
+              dense_feats: jax.Array | None = None) -> jax.Array:
+        """Returns logits [B, num_tasks]."""
+        pooled: List[jax.Array] = []
+        wide_terms: List[jax.Array] = []
+        for name in self.slot_names:
+            pooled.append(seqpool(emb[name], segments[name], batch_size))
+            wide_terms.append(seqpool(w[name], segments[name], batch_size))
+        wide = sum(wide_terms)                            # [B]
+        flat = jnp.concatenate(pooled, axis=-1)
+        if dense_feats is not None and self.dense_dim:
+            flat = jnp.concatenate([flat, dense_feats], axis=-1)
+        # final_activation: the shared representation feeding the towers
+        # should be nonlinear (mlp_apply leaves the last layer linear by
+        # default, which is right for logit heads, not for a bottom).
+        shared = mlp_apply(params["bottom"], flat,
+                           final_activation=True)         # [B, H]
+        logits = [mlp_apply(params["towers"][t], shared)[:, 0]
+                  + wide + params["task_bias"][t]
+                  for t in range(self.num_tasks)]
+        return jnp.stack(logits, axis=-1)                 # [B, T]

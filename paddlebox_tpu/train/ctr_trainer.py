@@ -105,6 +105,10 @@ class CTRTrainer:
         # store, and fused pull/push per width group.
         slot_dims = {s.name: (s.emb_dim or table_config.dim)
                      for s in feed_config.sparse_slots}
+        if self.num_tasks > 1 and feed_config.num_labels < self.num_tasks:
+            raise ValueError(
+                f"model has {self.num_tasks} tasks but the feed parses "
+                f"only {feed_config.num_labels} label columns")
         # store: optional FeatureStore-shaped backing tier instance — a
         # TieredFeatureStore (RAM+SSD) or a distributed.ps.PSBackedStore
         # (remote CPU PS, the BuildPull flow). Single-width models only;
@@ -144,6 +148,61 @@ class CTRTrainer:
 
     # -- init -------------------------------------------------------------
 
+    @property
+    def num_tasks(self) -> int:
+        """Multi-task models (SharedBottomMultiTask) advertise num_tasks
+        and return [B, T] logits; the trainer then trains per-task BCE
+        over labels[:, :T] with a stacked per-task AUC state (role of
+        the MultiTaskMetricMsg AUC family, fleet/metrics.h:346)."""
+        return int(getattr(self.model, "num_tasks", 1))
+
+    def _auc_init(self):
+        nb = self.config.auc_num_buckets
+        if self.num_tasks == 1:
+            return auc_state_init(nb)
+        return jax.vmap(lambda _: auc_state_init(nb))(
+            jnp.arange(self.num_tasks))
+
+    def _make_loss_auc(self, axis):
+        """One implementation of the masked (multi-)task BCE and the
+        (stacked) AUC accumulation, shared by the train and eval steps —
+        the two must never drift."""
+        num_tasks = self.num_tasks
+
+        def loss_of(logits, labels, validf):
+            # Local masked sum over the GLOBAL valid count; callers psum
+            # the result to finish the cross-replica mean.
+            total_valid = lax.psum(jnp.sum(validf), self.axis)
+            if num_tasks > 1:   # [B, T]: mean over tasks
+                bce = optax.sigmoid_binary_cross_entropy(
+                    logits, labels[:, :num_tasks])
+                return (jnp.sum(bce * validf[:, None])
+                        / jnp.maximum(total_valid * num_tasks, 1.0))
+            bce = optax.sigmoid_binary_cross_entropy(logits, labels[:, 0])
+            return jnp.sum(bce * validf) / jnp.maximum(total_valid, 1.0)
+
+        def auc_of(auc, probs, labels, valid):
+            if num_tasks > 1:
+                return jax.vmap(
+                    lambda st, p, l: auc_accumulate(st, p, l, valid,
+                                                    axis=axis),
+                    in_axes=(0, 1, 1))(auc, probs, labels[:, :num_tasks])
+            return auc_accumulate(auc, probs, labels[:, 0], valid,
+                                  axis=axis)
+
+        return loss_of, auc_of
+
+    def _auc_stats(self, auc) -> Dict[str, float]:
+        if self.num_tasks == 1:
+            return auc_compute(auc)
+        per_task = [auc_compute(jax.tree.map(lambda x: x[t], auc))
+                    for t in range(self.num_tasks)]
+        stats = dict(per_task[0])  # task 0 (click) is the headline
+        for t, st in enumerate(per_task):
+            for k, v in st.items():
+                stats[f"{k}_task{t}"] = v
+        return stats
+
     def init(self, seed: int = 0) -> None:
         rng = jax.random.PRNGKey(seed)
         self.params = self.model.init(rng)
@@ -158,7 +217,7 @@ class CTRTrainer:
             # optimizer — _build_step overwrites it after the update.
             self.params["data_norm"] = data_norm_init(dense_dim)
         self.opt_state = self._optax.init(self.params)
-        self.auc_state = auc_state_init(self.config.auc_num_buckets)
+        self.auc_state = self._auc_init()
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
             self.params = jax.device_put(self.params, rep)
@@ -255,6 +314,7 @@ class CTRTrainer:
         mode = self.config.dense_sync_mode
         if mode not in ("step", "kstep", "async"):
             raise ValueError(f"unknown dense_sync_mode {mode!r}")
+        loss_of, auc_of = self._make_loss_auc(axis)
         dn_on = self.config.data_norm
         if dn_on and mode == "async":
             # The reference routes data_norm stats through the async
@@ -282,10 +342,7 @@ class CTRTrainer:
                 logits = forward(params, pulled, segments, dense_feats,
                                  emb_alls=emb_alls, w_alls=w_alls)
                 # Exact global logloss: local sum / global valid count.
-                bce = optax.sigmoid_binary_cross_entropy(logits, labels1)
-                total_valid = lax.psum(jnp.sum(validf), axis)
-                loss = jnp.sum(bce * validf) / jnp.maximum(total_valid, 1.0)
-                return loss, logits
+                return loss_of(logits, labels, validf), logits
 
             grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1, 2),
                                          has_aux=True)
@@ -348,7 +405,7 @@ class CTRTrainer:
                     clicks, axis=axis, opt=sparse_opt))
 
             probs = jax.nn.sigmoid(logits)
-            auc = auc_accumulate(auc, probs, labels1, valid, axis=axis)
+            auc = auc_of(auc, probs, labels, valid)
             loss_global = lax.psum(loss, axis)
             # Dropped-lookup observability: bucket-overflow ids degraded
             # to zero-embedding pulls and dropped grads this step, summed
@@ -385,21 +442,16 @@ class CTRTrainer:
         axis = self.axis
         group_slots, group_sl = self._group_layout()
         forward = self._make_forward(group_slots, group_sl)
+        loss_of, auc_of = self._make_loss_auc(axis)
 
         def body(tables, params, auc, rows, segments, labels, valid,
                  dense_feats):
             pulled = [pull_local(t, r, axis=axis)
                       for t, r in zip(tables, rows)]
             logits = forward(params, pulled, segments, dense_feats)
-            labels1 = labels[:, 0]
             validf = valid.astype(jnp.float32)
-            bce = optax.sigmoid_binary_cross_entropy(logits, labels1)
-            total_valid = lax.psum(jnp.sum(validf), axis)
-            loss = lax.psum(
-                jnp.sum(bce * validf) / jnp.maximum(total_valid, 1.0),
-                axis)
-            auc = auc_accumulate(auc, jax.nn.sigmoid(logits), labels1,
-                                 valid, axis=axis)
+            loss = lax.psum(loss_of(logits, labels, validf), axis)
+            auc = auc_of(auc, jax.nn.sigmoid(logits), labels, valid)
             return auc, loss
 
         body_sm = jax.shard_map(
@@ -423,7 +475,7 @@ class CTRTrainer:
             eng.feed_pass([dataset.pass_keys(slots=g.slots)
                            for g in eng.groups], readonly=True)
         tables = eng.begin_pass()
-        auc = auc_state_init(self.config.auc_num_buckets)
+        auc = self._auc_init()
         if self.mesh is not None:
             auc = jax.device_put(auc, NamedSharding(self.mesh, P()))
         losses: List[jax.Array] = []
@@ -437,7 +489,7 @@ class CTRTrainer:
                 nsteps += 1
         finally:
             eng.abort_pass()
-        stats = auc_compute(auc)
+        stats = self._auc_stats(auc)
         stats["loss"] = (float(jnp.mean(jnp.stack(losses)))
                          if losses else float("nan"))
         stats["steps"] = nsteps
@@ -632,7 +684,7 @@ class CTRTrainer:
         self.params, self.opt_state, self.auc_state = params, opt_state, auc
         with self.timers.scope("end_pass"):
             eng.end_pass()
-        stats = auc_compute(self.auc_state)
+        stats = self._auc_stats(self.auc_state)
         stats["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
         stats["steps"] = nsteps
         stats["lookup_overflow"] = (
@@ -650,7 +702,7 @@ class CTRTrainer:
         return stats
 
     def reset_metrics(self) -> None:
-        self.auc_state = auc_state_init(self.config.auc_num_buckets)
+        self.auc_state = self._auc_init()
         if self.mesh is not None:
             self.auc_state = jax.device_put(
                 self.auc_state, NamedSharding(self.mesh, P()))

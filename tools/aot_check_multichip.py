@@ -106,10 +106,39 @@ def check_ctr_dp4(topo) -> None:
     print("AOT ctr dp=4 (sharded table all-to-all pull/push): OK")
 
 
+def check_device_store_sharded(topo) -> None:
+    """The HBM-resident store's cross-chip programs: request/serve/reply
+    all_to_all gather, write-back scatter, and on-device row append."""
+    from jax.sharding import Mesh
+
+    from paddlebox_tpu.embedding.device_store import (
+        _append_fn_sharded, _gather_fn_sharded, _scatter_fn_sharded)
+
+    mesh = Mesh(np.array(topo.devices).reshape(4), ("dp",))
+    s, cap_store, w, rps, cap = 4, 1 << 18, 23, 1 << 16, 1 << 14
+
+    v = jax.ShapeDtypeStruct((s * (cap_store + 1), w), jnp.float32)
+    rq = jax.ShapeDtypeStruct((s, s * cap), jnp.int32)
+    ii = jax.ShapeDtypeStruct((s, 1), jnp.int32)
+    iv = jax.ShapeDtypeStruct((s, w), jnp.float32)
+    _gather_fn_sharded(mesh, "dp", s, cap, w, rps, cap_store).lower(
+        v, rq, rq, ii, iv).compile()
+    b = jax.ShapeDtypeStruct(((rps + 1) * s, w), jnp.float32)
+    _scatter_fn_sharded(mesh, "dp", s, cap, w).lower(
+        v, b, rq, rq).compile()
+    keys = jax.ShapeDtypeStruct((s * (1 << 12),), jnp.uint32)
+    tmpl = jax.ShapeDtypeStruct((s, w), jnp.float32)
+    st = jax.ShapeDtypeStruct((s,), jnp.int32)
+    _append_fn_sharded(mesh, "dp", w, 1 << 12, 16, 0, 0.01).lower(
+        v, keys, tmpl, st, st).compile()
+    print("AOT device store sharded gather/scatter/append: OK")
+
+
 def main() -> None:
     topo = topologies.get_topology_desc("v5e:2x2x1", "tpu")
     check_gpt_hybrid(topo)
     check_ctr_dp4(topo)
+    check_device_store_sharded(topo)
     print("MULTICHIP TPU AOT COMPILE: OK")
 
 
