@@ -9,8 +9,8 @@ hybrid-parallel configs) live in their own modules.
 
 from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.din_rank import DINRank, build_rank_offset
-from paddlebox_tpu.models.multitask import SharedBottomMultiTask
+from paddlebox_tpu.models.multitask import MMoE, SharedBottomMultiTask
 from paddlebox_tpu.models.wide_deep import WideDeep
 
-__all__ = ["DeepFM", "DINRank", "SharedBottomMultiTask", "WideDeep",
-           "build_rank_offset"]
+__all__ = ["DeepFM", "DINRank", "MMoE", "SharedBottomMultiTask",
+           "WideDeep", "build_rank_offset"]

@@ -24,6 +24,22 @@ from paddlebox_tpu.nn import mlp_apply, mlp_init
 from paddlebox_tpu.ops import seqpool
 
 
+def _pool_slot_inputs(slot_names, emb, w, segments, batch_size,
+                      dense_feats, dense_dim):
+    """Shared input prelude for the multi-task models: per-slot sum-pool
+    of embeddings and first-order weights -> (flat [B, sum D + dense],
+    wide [B])."""
+    pooled: List[jax.Array] = []
+    wide_terms: List[jax.Array] = []
+    for name in slot_names:
+        pooled.append(seqpool(emb[name], segments[name], batch_size))
+        wide_terms.append(seqpool(w[name], segments[name], batch_size))
+    flat = jnp.concatenate(pooled, axis=-1)
+    if dense_feats is not None and dense_dim:
+        flat = jnp.concatenate([flat, dense_feats], axis=-1)
+    return flat, sum(wide_terms)
+
+
 @dataclasses.dataclass(frozen=True)
 class SharedBottomMultiTask:
     slot_names: Tuple[str, ...]
@@ -58,15 +74,9 @@ class SharedBottomMultiTask:
               batch_size: int,
               dense_feats: jax.Array | None = None) -> jax.Array:
         """Returns logits [B, num_tasks]."""
-        pooled: List[jax.Array] = []
-        wide_terms: List[jax.Array] = []
-        for name in self.slot_names:
-            pooled.append(seqpool(emb[name], segments[name], batch_size))
-            wide_terms.append(seqpool(w[name], segments[name], batch_size))
-        wide = sum(wide_terms)                            # [B]
-        flat = jnp.concatenate(pooled, axis=-1)
-        if dense_feats is not None and self.dense_dim:
-            flat = jnp.concatenate([flat, dense_feats], axis=-1)
+        flat, wide = _pool_slot_inputs(self.slot_names, emb, w, segments,
+                                       batch_size, dense_feats,
+                                       self.dense_dim)
         # final_activation: the shared representation feeding the towers
         # should be nonlinear (mlp_apply leaves the last layer linear by
         # default, which is right for logit heads, not for a bottom).
@@ -75,4 +85,71 @@ class SharedBottomMultiTask:
         logits = [mlp_apply(params["towers"][t], shared)[:, 0]
                   + wide + params["task_bias"][t]
                   for t in range(self.num_tasks)]
+        return jnp.stack(logits, axis=-1)                 # [B, T]
+
+
+@dataclasses.dataclass(frozen=True)
+class MMoE:
+    """Multi-gate Mixture-of-Experts multi-task CTR (Ma et al. 2018) —
+    the step up from the shared bottom when tasks conflict: E expert
+    MLPs share the input; each task mixes them through its own softmax
+    gate before its tower. Same trainer contract as
+    :class:`SharedBottomMultiTask` (``num_tasks`` + [B, T] logits).
+
+    All experts evaluate densely and the mix is one einsum — the
+    MXU-friendly formulation (no data-dependent routing; this is the
+    multi-task MMoE, not a sparse-dispatch MoE layer — for expert
+    parallelism over the ep mesh axis see ``parallel/moe.py``)."""
+
+    slot_names: Tuple[str, ...]
+    emb_dim: Union[int, Mapping[str, int]]
+    num_tasks: int = 2
+    num_experts: int = 4
+    dense_dim: int = 0
+    expert_hidden: Tuple[int, ...] = (128, 64)
+    tower_hidden: Tuple[int, ...] = (32,)
+
+    def _dims(self) -> Dict[str, int]:
+        if isinstance(self.emb_dim, int):
+            return {n: self.emb_dim for n in self.slot_names}
+        return {n: int(self.emb_dim[n]) for n in self.slot_names}
+
+    def init(self, rng: jax.Array) -> Dict:
+        in_dim = sum(self._dims().values()) + self.dense_dim
+        n_keys = self.num_experts + 2 * self.num_tasks
+        keys = jax.random.split(rng, n_keys)
+        h = self.expert_hidden[-1]
+        ki = iter(keys)
+        return {
+            "experts": [mlp_init(next(ki), in_dim,
+                                 list(self.expert_hidden))
+                        for _ in range(self.num_experts)],
+            "gates": [mlp_init(next(ki), in_dim, [self.num_experts])
+                      for _ in range(self.num_tasks)],
+            "towers": [mlp_init(next(ki), h,
+                                list(self.tower_hidden) + [1])
+                       for _ in range(self.num_tasks)],
+            "task_bias": jnp.zeros((self.num_tasks,), jnp.float32),
+        }
+
+    def apply(self, params: Dict,
+              emb: Dict[str, jax.Array],
+              w: Dict[str, jax.Array],
+              segments: Dict[str, jax.Array],
+              batch_size: int,
+              dense_feats: jax.Array | None = None) -> jax.Array:
+        """Returns logits [B, num_tasks]."""
+        flat, wide = _pool_slot_inputs(self.slot_names, emb, w, segments,
+                                       batch_size, dense_feats,
+                                       self.dense_dim)
+        experts = jnp.stack(
+            [mlp_apply(p, flat, final_activation=True)
+             for p in params["experts"]], axis=1)         # [B, E, H]
+        logits = []
+        for t in range(self.num_tasks):
+            gate = jax.nn.softmax(
+                mlp_apply(params["gates"][t], flat), axis=-1)  # [B, E]
+            mixed = jnp.einsum("be,beh->bh", gate, experts)
+            logits.append(mlp_apply(params["towers"][t], mixed)[:, 0]
+                          + wide + params["task_bias"][t])
         return jnp.stack(logits, axis=-1)                 # [B, T]

@@ -4,6 +4,7 @@ MultiTaskMetricMsg role), eval twin, and single-task equivalence of the
 stacked-AUC plumbing."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -11,21 +12,26 @@ import jax.numpy as jnp
 from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
 from paddlebox_tpu.embedding import TableConfig
-from paddlebox_tpu.models import SharedBottomMultiTask
+from paddlebox_tpu.models import MMoE, SharedBottomMultiTask
 from paddlebox_tpu.parallel import HybridTopology, build_mesh
 from paddlebox_tpu.train import CTRTrainer, TrainerConfig
 
 SLOTS = ("a", "b")
 
 
-def _make(tmp_path, num_tasks=2, n_steps=6):
+def _make(tmp_path, num_tasks=2, n_steps=6, arch="shared_bottom"):
     mesh = build_mesh(HybridTopology(dp=8))
     feed = DataFeedConfig(
         slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
         batch_size=64, num_labels=num_tasks)
-    model = SharedBottomMultiTask(
-        slot_names=SLOTS, emb_dim=8, num_tasks=num_tasks,
-        bottom_hidden=(32, 16), tower_hidden=(8,))
+    if arch == "mmoe":
+        model = MMoE(slot_names=SLOTS, emb_dim=8, num_tasks=num_tasks,
+                     num_experts=3, expert_hidden=(32, 16),
+                     tower_hidden=(8,))
+    else:
+        model = SharedBottomMultiTask(
+            slot_names=SLOTS, emb_dim=8, num_tasks=num_tasks,
+            bottom_hidden=(32, 16), tower_hidden=(8,))
     tr = CTRTrainer(model, feed, TableConfig(dim=8, learning_rate=0.2),
                     mesh=mesh,
                     config=TrainerConfig(auc_num_buckets=1 << 10,
@@ -40,12 +46,14 @@ def _make(tmp_path, num_tasks=2, n_steps=6):
             # signal on b — distinct learnable targets.
             l0 = int(rng.random() < (0.6 if a % 3 == 0 else 0.1))
             l1 = int(l0 and rng.random() < (0.7 if b % 2 == 0 else 0.1))
-            f.write(f"{l0} {l1} a:{a} b:{b}\n")
+            labels = " ".join(str(v) for v in (l0, l1)[:num_tasks])
+            f.write(f"{labels} a:{a} b:{b}\n")
     return tr, feed, p
 
 
-def test_multitask_trains_and_reports_per_task_auc(tmp_path):
-    tr, feed, p = _make(tmp_path)
+@pytest.mark.parametrize("arch", ["shared_bottom", "mmoe"])
+def test_multitask_trains_and_reports_per_task_auc(tmp_path, arch):
+    tr, feed, p = _make(tmp_path, arch=arch)
     losses = []
     for _ in range(3):
         ds = Dataset(feed, num_reader_threads=1)
@@ -85,6 +93,19 @@ def test_multitask_label_column_check(tmp_path):
         batch_size=64, num_labels=1)  # too few label columns
     model = SharedBottomMultiTask(slot_names=SLOTS, emb_dim=8,
                                   num_tasks=2)
-    import pytest
     with pytest.raises(ValueError, match="label columns"):
         CTRTrainer(model, feed, TableConfig(dim=8), mesh=mesh)
+
+
+def test_single_task_plumbing_unchanged(tmp_path):
+    """num_tasks=1 through the same stacked-AUC helpers must behave as
+    the classic single-task path: scalar-state AUC, no _task keys."""
+    tr, feed, p = _make(tmp_path, num_tasks=1)
+    ds = Dataset(feed, num_reader_threads=1)
+    ds.set_filelist([p])
+    ds.load_into_memory()
+    stats = tr.train_pass(ds)
+    assert np.isfinite(stats["loss"])
+    assert "auc" in stats and not any(k.endswith("_task0") for k in stats)
+    # State is the plain (unstacked) AucState.
+    assert tr.auc_state.table.ndim == 2

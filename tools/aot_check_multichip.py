@@ -10,7 +10,9 @@ inside shard_map — against a 4-device v5e compile-only topology:
 
 Covers: (1) GPT hybrid pp=2 x sp=2 with the 1F1B schedule and ring
 attention; (2) the sparse CTR step over dp=4 (table sharded over dp,
-bucket-by-shard all-to-all pull/push).
+bucket-by-shard all-to-all pull/push); (3) the device-resident store's
+sharded gather/scatter/append programs (request/serve/reply
+all_to_all).
 """
 
 import os
