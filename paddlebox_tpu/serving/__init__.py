@@ -1,4 +1,5 @@
 from paddlebox_tpu.serving.predictor import (CTRPredictor,
+                                             load_delta_update,
                                              load_xbox_model)
 
-__all__ = ["CTRPredictor", "load_xbox_model"]
+__all__ = ["CTRPredictor", "load_delta_update", "load_xbox_model"]

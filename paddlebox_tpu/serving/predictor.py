@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -61,6 +62,41 @@ def load_xbox_model(path: str, table: str = "embedding"
     ks, es, ws = [], [], []
     for d in parts:
         k, e, w = load_xbox_model(os.path.join(path, d), table)
+        ks.append(k)
+        es.append(e)
+        ws.append(w)
+    return np.concatenate(ks), np.concatenate(es), np.concatenate(ws)
+
+
+def load_delta_update(path: str, table: str = "embedding"
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(keys, emb, w) from a per-pass delta checkpoint — the serving
+    fields only, for :meth:`CTRPredictor.apply_update`. Handles the
+    same layouts as :func:`load_xbox_model`: flat
+    (``<table>.delta.npz``), sharded (``bucket-*/`` / ``part-*/``
+    concatenated), and rejects dim-grouped roots (per-group widths are
+    incompatible — load each ``dim<D>/`` subdir separately)."""
+    flat = os.path.join(path, f"{table}.delta.npz")
+    if os.path.exists(flat):
+        data = np.load(flat)
+        return data["keys"].astype(np.uint64), data["emb"], data["w"]
+    dim_parts = sorted(d for d in os.listdir(path)
+                       if os.path.isdir(os.path.join(path, d))
+                       and d.startswith("dim"))
+    if dim_parts:
+        raise ValueError(
+            f"{path} is a dim-grouped delta ({dim_parts}) — load each "
+            f"with load_delta_update(path/dim<D>, table='{table}_dim<D>')")
+    parts = sorted(
+        d for d in os.listdir(path)
+        if os.path.isdir(os.path.join(path, d))
+        and (d.startswith("bucket-") or d.startswith("part-")))
+    if not parts:
+        raise FileNotFoundError(
+            f"no delta checkpoint for {table!r} under {path}")
+    ks, es, ws = [], [], []
+    for d in parts:
+        k, e, w = load_delta_update(os.path.join(path, d), table)
         ks.append(k)
         es.append(e)
         ws.append(w)
@@ -110,6 +146,11 @@ class CTRPredictor:
         # closes over them, so a batch with different shapes needs its
         # own trace — reusing the first would mis-slice silently.
         self._fwd_cache: Dict[tuple, object] = {}
+        # Serializes apply_update against predict's index lookup + state
+        # snapshot: KeyIndex is not internally synchronized (a concurrent
+        # upsert can rehash under a reader), and (table, index, dense)
+        # must be swapped as one consistent version.
+        self._lock = threading.Lock()
 
     @classmethod
     def from_dirs(cls, model, feed_config, xbox_path: str,
@@ -166,6 +207,72 @@ class CTRPredictor:
 
         return jax.jit(fwd)
 
+    def apply_update(self, keys: np.ndarray, emb: np.ndarray,
+                     w: np.ndarray, *, dense_params=None) -> int:
+        """Apply a per-pass update to the LIVE serving table — the
+        reference's online patch-model flow (``README.md:48``
+        "real-time model update": per-pass delta/xbox exports land on
+        serving without a cold reload). Existing keys' rows are
+        overwritten in place, new keys appended (the zero trash row for
+        unknown feasigns stays last); optionally swap the dense params
+        in the same call. Returns the number of new keys.
+
+        Thread-safe against concurrent predict(): the (index, table,
+        dense) triple swaps as one version under the predictor lock."""
+        k = np.ascontiguousarray(keys, np.uint64)
+        # The null feasign (0) never serves — KeyIndex maps it to row -1
+        # and a -1 scatter would wrap onto the trash row, corrupting the
+        # zeros every unknown key reads.
+        nz = k != 0
+        if not nz.all():
+            k = k[nz]
+            emb, w = np.asarray(emb)[nz], np.asarray(w)[nz]
+        if k.shape[0] == 0:
+            if dense_params is not None:
+                with self._lock:
+                    self._dense_params = dense_params
+            return 0
+        if emb.shape[1] != self._dim:
+            raise ValueError(
+                f"update width {emb.shape[1]} != serving table width "
+                f"{self._dim}")
+        # Keep the LAST occurrence of duplicate keys (a stream of
+        # updates applies in order; scatter with dup indices would be
+        # order-nondeterministic).
+        _, last = np.unique(k[::-1], return_index=True)
+        keep = np.sort(k.shape[0] - 1 - last)
+        k = k[keep]
+        vals = np.concatenate(
+            [np.asarray(emb, np.float32)[keep],
+             np.asarray(w, np.float32)[keep][:, None]], axis=1)
+        with self._lock:
+            n_old = self._table.shape[0] - 1
+            rows, n_new = self._index.upsert(k)
+            table = self._table
+            if n_new:
+                # upsert assigns fresh rows [n_old, n_old+n_new) in
+                # input order; splice them in — pre-filled with their
+                # values — BEFORE the trash row.
+                new_mask = rows >= n_old
+                grow = np.zeros((n_new, self._dim + 1), np.float32)
+                grow[rows[new_mask] - n_old] = vals[new_mask]
+                table = jnp.concatenate(
+                    [table[:-1], jnp.asarray(grow),
+                     jnp.zeros((1, self._dim + 1), jnp.float32)])
+                rows, vals = rows[~new_mask], vals[~new_mask]
+            if rows.size:
+                # Scatter only the EXISTING keys' rows (fresh rows were
+                # written via the splice — re-scattering them would pay
+                # a second full-table materialization for nothing).
+                table = table.at[jnp.asarray(rows, jnp.int32)].set(
+                    jnp.asarray(vals))
+            self._table = table
+            if dense_params is not None:
+                self._dense_params = dense_params
+        monitor.add("serving/updated_keys", int(k.shape[0]))
+        monitor.add("serving/new_keys", int(n_new))
+        return int(n_new)
+
     def predict(self, batch) -> np.ndarray:
         """SlotBatch -> CTR probabilities [batch_size] (invalid/padding
         rows yield whatever the model does on zeros — mask with
@@ -179,13 +286,18 @@ class CTRPredictor:
             fwd = self._fwd_cache[key] = self._build_fwd(caps, bs)
         all_ids = np.concatenate(
             [batch.ids[n] for n in self._slot_names])
-        rows = self._index.lookup(all_ids)
-        n_tab = self._table.shape[0] - 1
+        with self._lock:
+            # One consistent model version per batch: lookup + table +
+            # dense snapshot under the update lock (jax arrays are
+            # immutable, so the compute below needs no lock).
+            rows = self._index.lookup(all_ids)
+            table, dense_params = self._table, self._dense_params
+        n_tab = table.shape[0] - 1
         rows = np.where(rows < 0, n_tab, rows).astype(np.int32)
         segs = {n: jnp.asarray(batch.segments[n])
                 for n in self._slot_names}
         monitor.add("serving/requests", bs)
-        probs = fwd(self._table, self._dense_params,
+        probs = fwd(table, dense_params,
                     jnp.asarray(rows), segs,
                     jnp.asarray(_concat_dense_host(batch)))
         return np.asarray(probs)
