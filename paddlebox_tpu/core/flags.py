@@ -208,6 +208,12 @@ define_flag("padbox_max_shuffle_wait_count", 16,
 define_flag("dense_sync_steps", 1,
             "k-step dense parameter sync interval in BoxPS-style training "
             "(role of BoxPSWorker::SyncParam sync_step)")
+define_flag("xbox_quant_bits", 0,
+            "xbox serving-export embedding quantization: 0 = float32, "
+            "8/16 = symmetric per-row int8/int16 with an f32 scale "
+            "(role of the reference's quantized pull values, "
+            "fused_seqpool_cvm_op.cu:247 quant_ratio — applied at the "
+            "export boundary; w and the serving math stay float)")
 define_flag("sparse_scatter_kernel", "auto",
             "push-side scatter-accumulate backend: 'auto' (Pallas sorted "
             "kernel on TPU, XLA scatter elsewhere), 'pallas', 'interpret' "

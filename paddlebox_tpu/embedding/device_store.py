@@ -692,12 +692,14 @@ class DeviceFeatureStore:
                  dirty.shape[0], path)
 
     def save_xbox(self, path: str) -> int:
+        from paddlebox_tpu.embedding.store import quantize_xbox_vals
         with self._lock:
             keys = np.sort(self._index.keys_by_row())
             vals = (self._snapshot_sorted_locked(keys) if keys.size
                     else self._empty_vals())
         self._save_arrays(path, keys,
-                          {"emb": vals["emb"], "w": vals["w"]}, "xbox")
+                          quantize_xbox_vals({"emb": vals["emb"],
+                                              "w": vals["w"]}), "xbox")
         log.vlog(0, "device store save_xbox: %d features -> %s",
                  keys.shape[0], path)
         return int(keys.shape[0])

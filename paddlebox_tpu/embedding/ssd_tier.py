@@ -266,6 +266,32 @@ class TieredFeatureStore:
         self.disk.copy_to(os.path.join(path,
                                        f"{self.config.name}.ssd"))
 
+    def save_xbox(self, path: str) -> int:
+        """Serving export across BOTH tiers (RAM ∪ disk — the tiers hold
+        disjoint keys: eviction removes from RAM). Same artifact format
+        as FeatureStore.save_xbox incl. the xbox_quant_bits flag."""
+        from paddlebox_tpu.embedding.store import quantize_xbox_vals
+        with self.ram._lock:
+            # Snapshot under the RAM store's lock — a concurrent push's
+            # sorted merge reassigns _keys/_vals, and a torn copy would
+            # pair keys with the wrong rows.
+            keys = [self.ram._keys.copy()]
+            embs = [self.ram._vals["emb"].copy()]
+            ws = [self.ram._vals["w"].copy()]
+        for b in range(self.disk.num_buckets):
+            k, v = self.disk._load_bucket(b)
+            if k.size:
+                keys.append(k)
+                embs.append(v["emb"])
+                ws.append(v["w"])
+        k_all = np.concatenate(keys)
+        order = np.argsort(k_all, kind="stable")
+        vals = {"emb": np.concatenate(embs)[order],
+                "w": np.concatenate(ws)[order]}
+        self.ram._save_arrays(path, k_all[order],
+                              quantize_xbox_vals(vals), "xbox")
+        return int(k_all.shape[0])
+
     def save_delta(self, path: str) -> None:
         # Stage evicted-but-dirty rows back so the RAM delta set covers
         # every change since the last base (push_from_pass re-marks them

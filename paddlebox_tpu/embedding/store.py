@@ -24,9 +24,30 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.core import flags, log, monitor
 from paddlebox_tpu.embedding.table import TableConfig
 from paddlebox_tpu.native import store_py as native_store
+
+
+def quantize_xbox_vals(vals: Dict[str, np.ndarray]
+                       ) -> Dict[str, np.ndarray]:
+    """Apply the ``xbox_quant_bits`` flag to a serving export's value
+    dict: symmetric per-row int8/int16 embeddings + f32 scales (4x/2x
+    smaller artifacts shipping to serving every pass); w stays f32.
+    The loader (serving.load_xbox_model) dequantizes transparently."""
+    bits = int(flags.flag("xbox_quant_bits"))
+    if not bits:
+        return vals
+    if bits not in (8, 16):
+        raise ValueError(f"xbox_quant_bits must be 0, 8 or 16: {bits}")
+    emb = np.asarray(vals["emb"], np.float32)
+    qmax = (1 << (bits - 1)) - 1
+    scale = (np.abs(emb).max(axis=1) / qmax if emb.size
+             else np.zeros((0,), np.float32))
+    scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    q = np.clip(np.rint(emb / scale[:, None]), -qmax, qmax).astype(
+        np.int8 if bits == 8 else np.int16)
+    return {"emb_q": q, "emb_scale": scale, "w": vals["w"]}
 
 _FIELDS = ("emb", "emb_state", "w", "w_state", "show", "click")
 
@@ -322,7 +343,7 @@ class FeatureStore:
             keys = self._keys.copy()
             vals = {"emb": self._vals["emb"].copy(),
                     "w": self._vals["w"].copy()}
-        self._save_arrays(path, keys, vals, "xbox")
+        self._save_arrays(path, keys, quantize_xbox_vals(vals), "xbox")
         log.vlog(0, "save_xbox: %d features -> %s", keys.shape[0], path)
         return int(keys.shape[0])
 

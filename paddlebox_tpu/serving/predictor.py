@@ -30,77 +30,60 @@ from paddlebox_tpu.core import log, monitor
 from paddlebox_tpu.native import store_py as native_store
 
 
-def load_xbox_model(path: str, table: str = "embedding"
-                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """(keys, emb [n, D], w [n]) from an xbox export directory — flat
-    (`<table>.xbox.npz`) or sharded (`bucket-*/` / `part-*/`
-    subdirectories are concatenated; all shards carry the same width).
-
-    Dim-grouped exports (mixed-width models write `dim<D>/` subdirs with
-    per-group table names `<base>_dim<D>`) hold INCOMPATIBLE widths —
-    load each group separately:
-    ``load_xbox_model(f"{path}/dim8", table=f"{table}_dim8")``.
-    """
-    flat = os.path.join(path, f"{table}.xbox.npz")
+def _load_export(path: str, table: str, kind: str
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Shared (keys, emb, w) loader for serving artifacts of ``kind``
+    ('xbox' | 'delta'): flat ``<table>.<kind>.npz``, sharded
+    (``bucket-*/`` / ``part-*/`` concatenated), dim-grouped roots
+    rejected (per-group widths are incompatible). Quantized embeddings
+    (FLAGS_xbox_quant_bits at save time: symmetric per-row intN * f32
+    scale) dequantize to f32 transparently."""
+    flat = os.path.join(path, f"{table}.{kind}.npz")
     if os.path.exists(flat):
         data = np.load(flat)
-        return (data["keys"].astype(np.uint64), data["emb"], data["w"])
+        if "emb_q" in data:
+            emb = (data["emb_q"].astype(np.float32)
+                   * data["emb_scale"][:, None])
+        else:
+            emb = data["emb"]
+        return (data["keys"].astype(np.uint64), emb, data["w"])
     dim_parts = sorted(d for d in os.listdir(path)
                        if os.path.isdir(os.path.join(path, d))
                        and d.startswith("dim"))
     if dim_parts:
         raise ValueError(
             f"{path} is a dim-grouped export ({dim_parts}) — groups have "
-            f"different embedding widths; load each with "
-            f"load_xbox_model(path/dim<D>, table='{table}_dim<D>')")
-    parts = sorted(
-        d for d in os.listdir(path)
-        if os.path.isdir(os.path.join(path, d))
-        and (d.startswith("bucket-") or d.startswith("part-")))
-    if not parts:
-        raise FileNotFoundError(f"no xbox export for {table!r} under {path}")
-    ks, es, ws = [], [], []
-    for d in parts:
-        k, e, w = load_xbox_model(os.path.join(path, d), table)
-        ks.append(k)
-        es.append(e)
-        ws.append(w)
-    return np.concatenate(ks), np.concatenate(es), np.concatenate(ws)
-
-
-def load_delta_update(path: str, table: str = "embedding"
-                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """(keys, emb, w) from a per-pass delta checkpoint — the serving
-    fields only, for :meth:`CTRPredictor.apply_update`. Handles the
-    same layouts as :func:`load_xbox_model`: flat
-    (``<table>.delta.npz``), sharded (``bucket-*/`` / ``part-*/``
-    concatenated), and rejects dim-grouped roots (per-group widths are
-    incompatible — load each ``dim<D>/`` subdir separately)."""
-    flat = os.path.join(path, f"{table}.delta.npz")
-    if os.path.exists(flat):
-        data = np.load(flat)
-        return data["keys"].astype(np.uint64), data["emb"], data["w"]
-    dim_parts = sorted(d for d in os.listdir(path)
-                       if os.path.isdir(os.path.join(path, d))
-                       and d.startswith("dim"))
-    if dim_parts:
-        raise ValueError(
-            f"{path} is a dim-grouped delta ({dim_parts}) — load each "
-            f"with load_delta_update(path/dim<D>, table='{table}_dim<D>')")
+            f"different embedding widths; load each group with "
+            f"table='{table}_dim<D>' under path/dim<D>")
     parts = sorted(
         d for d in os.listdir(path)
         if os.path.isdir(os.path.join(path, d))
         and (d.startswith("bucket-") or d.startswith("part-")))
     if not parts:
         raise FileNotFoundError(
-            f"no delta checkpoint for {table!r} under {path}")
+            f"no {kind} export for {table!r} under {path}")
     ks, es, ws = [], [], []
     for d in parts:
-        k, e, w = load_delta_update(os.path.join(path, d), table)
+        k, e, w = _load_export(os.path.join(path, d), table, kind)
         ks.append(k)
         es.append(e)
         ws.append(w)
     return np.concatenate(ks), np.concatenate(es), np.concatenate(ws)
+
+
+def load_xbox_model(path: str, table: str = "embedding"
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(keys, emb [n, D], w [n]) from an xbox export directory — see
+    :func:`_load_export` for the layouts handled."""
+    return _load_export(path, table, "xbox")
+
+
+def load_delta_update(path: str, table: str = "embedding"
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(keys, emb, w) from a per-pass delta checkpoint — the serving
+    fields only, for :meth:`CTRPredictor.apply_update`. Same layouts as
+    :func:`load_xbox_model` (see :func:`_load_export`)."""
+    return _load_export(path, table, "delta")
 
 
 class CTRPredictor:
@@ -247,25 +230,37 @@ class CTRPredictor:
              np.asarray(w, np.float32)[keep][:, None]], axis=1)
         with self._lock:
             n_old = self._table.shape[0] - 1
-            rows, n_new = self._index.upsert(k)
+            # Read-only lookup FIRST: the fallible device allocations
+            # (concat/scatter) must complete before the index mutates,
+            # or an exception would leave index and table permanently
+            # out of sync (every later update then mis-splices).
+            looked = self._index.lookup(k)
+            new_mask = looked < 0
+            n_new = int(new_mask.sum())
             table = self._table
             if n_new:
-                # upsert assigns fresh rows [n_old, n_old+n_new) in
-                # input order; splice them in — pre-filled with their
+                # upsert (below) assigns fresh rows [n_old, n_old+n_new)
+                # in input order; splice them in — pre-filled with their
                 # values — BEFORE the trash row.
-                new_mask = rows >= n_old
-                grow = np.zeros((n_new, self._dim + 1), np.float32)
-                grow[rows[new_mask] - n_old] = vals[new_mask]
+                grow = vals[new_mask]
                 table = jnp.concatenate(
                     [table[:-1], jnp.asarray(grow),
                      jnp.zeros((1, self._dim + 1), jnp.float32)])
-                rows, vals = rows[~new_mask], vals[~new_mask]
-            if rows.size:
+            ex_rows, ex_vals = looked[~new_mask], vals[~new_mask]
+            if ex_rows.size:
                 # Scatter only the EXISTING keys' rows (fresh rows were
                 # written via the splice — re-scattering them would pay
                 # a second full-table materialization for nothing).
-                table = table.at[jnp.asarray(rows, jnp.int32)].set(
-                    jnp.asarray(vals))
+                table = table.at[jnp.asarray(ex_rows, jnp.int32)].set(
+                    jnp.asarray(ex_vals))
+            if n_new:
+                rows, got_new = self._index.upsert(k)
+                if got_new != n_new or not np.array_equal(
+                        rows[new_mask],
+                        n_old + np.arange(n_new)):
+                    raise RuntimeError(
+                        "serving index assignment diverged from the "
+                        "spliced table layout")
             self._table = table
             if dense_params is not None:
                 self._dense_params = dense_params

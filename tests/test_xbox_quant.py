@@ -1,0 +1,105 @@
+"""Quantized xbox serving exports (FLAGS_xbox_quant_bits): artifact
+shrinks, loader dequantizes transparently, error is bounded by the
+per-row scale, predictor serves from it, and the tiered store exports
+across both tiers."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.embedding.ssd_tier import TieredFeatureStore
+from paddlebox_tpu.embedding.store import FeatureStore
+from paddlebox_tpu.embedding.table import TableConfig
+from paddlebox_tpu.serving import load_xbox_model
+
+
+@pytest.fixture(autouse=True)
+def _reset_flag():
+    yield
+    flagmod.set_flags({"xbox_quant_bits": 0})
+
+
+def _filled_store(n=500, dim=8):
+    store = FeatureStore(TableConfig(name="emb", dim=dim,
+                                     learning_rate=0.1))
+    keys = np.arange(1, n + 1, dtype=np.uint64)
+    vals = store.pull_for_pass(keys)
+    rng = np.random.default_rng(0)
+    vals["emb"] = rng.normal(0, 0.3, vals["emb"].shape).astype(np.float32)
+    store.push_from_pass(keys, vals)
+    return store, keys, vals
+
+
+@pytest.mark.parametrize("bits", [8, 16])
+def test_quantized_export_roundtrip_bounded_error(tmp_path, bits):
+    store, keys, vals = _filled_store()
+    store.save_xbox(str(tmp_path / "f32"))
+    flagmod.set_flags({"xbox_quant_bits": bits})
+    store.save_xbox(str(tmp_path / "q"))
+
+    k, e, w = load_xbox_model(str(tmp_path / "q"), table="emb")
+    assert np.array_equal(k, keys)
+    np.testing.assert_array_equal(w, vals["w"])
+    # Per-row error bound: half a quantization step.
+    qmax = (1 << (bits - 1)) - 1
+    bound = np.abs(vals["emb"]).max(axis=1) / qmax / 2 + 1e-7
+    err = np.abs(e - vals["emb"]).max(axis=1)
+    assert (err <= bound).all()
+
+    size_f = os.path.getsize(tmp_path / "f32" / "emb.xbox.npz")
+    size_q = os.path.getsize(tmp_path / "q" / "emb.xbox.npz")
+    assert size_q < size_f * (0.45 if bits == 8 else 0.75), \
+        (size_q, size_f)
+
+
+def test_quantized_export_serves(tmp_path):
+    import jax
+
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.serving import CTRPredictor
+
+    store, keys, vals = _filled_store(dim=4)
+    flagmod.set_flags({"xbox_quant_bits": 8})
+    store.save_xbox(str(tmp_path))
+    k, e, w = load_xbox_model(str(tmp_path), table="emb")
+    feed = DataFeedConfig(slots=(SlotConf("u", avg_len=1.0),
+                                 SlotConf("i", avg_len=1.0)),
+                          batch_size=8)
+    model = DeepFM(slot_names=("u", "i"), emb_dim=4, hidden=(8,))
+    pred = CTRPredictor(model, feed, k, e, w,
+                        model.init(jax.random.PRNGKey(0)),
+                        compute_dtype="float32")
+    from paddlebox_tpu.data.dataset import Dataset
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "part")
+        rng = np.random.default_rng(1)
+        with open(p, "w") as f:
+            for _ in range(8):
+                f.write(f"0 u:{rng.integers(1, 500)} "
+                        f"i:{rng.integers(1, 500)}\n")
+        ds = Dataset(feed, num_reader_threads=1)
+        ds.set_filelist([p])
+        ds.load_into_memory()
+        probs = pred.predict(next(ds.batches_sharded(1)))
+    assert np.isfinite(probs).all()
+
+
+def test_tiered_store_xbox_covers_both_tiers(tmp_path):
+    cfg = TableConfig(name="emb", dim=4, learning_rate=0.1)
+    store = TieredFeatureStore(cfg, str(tmp_path / "ssd"),
+                               max_ram_features=100)
+    keys = np.arange(1, 401, dtype=np.uint64)
+    vals = store.pull_for_pass(keys)
+    store.push_from_pass(keys, vals)      # evicts past 100
+    assert store.disk.num_features > 0
+    n = store.save_xbox(str(tmp_path / "out"))
+    assert n == 400
+    k, e, w = load_xbox_model(str(tmp_path / "out"), table="emb")
+    assert np.array_equal(k, keys)        # sorted, both tiers
+    # Values must match the store's own view regardless of tier.
+    pulled = store.pull_for_pass(keys)
+    np.testing.assert_allclose(e, pulled["emb"], atol=1e-6)
