@@ -20,6 +20,7 @@ from __future__ import annotations
 import glob
 import os
 import shutil
+import threading
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -165,6 +166,12 @@ class TieredFeatureStore:
         self.config = config
         self.ram = FeatureStore(config, seed=seed)
         self.disk = DiskShards(disk_dir, num_buckets)
+        # Serializes TIER MOVEMENT against multi-step readers: the RAM
+        # store's own lock only covers single calls, but stage-in/evict
+        # move rows BETWEEN tiers — an export or pull interleaving with
+        # a move would see a key in both tiers (or neither). RLock:
+        # public methods nest (push_from_pass -> evict_to_budget).
+        self._tier_lock = threading.RLock()
         self.max_ram_features = max_ram_features
         self.opt = self.ram.opt
         # Dirty keys that were evicted to disk since the last save_base:
@@ -175,6 +182,7 @@ class TieredFeatureStore:
     # -- tier movement -----------------------------------------------------
 
     def _stage_in(self, keys_sorted: np.ndarray) -> None:
+        # callers hold _tier_lock
         missing = keys_sorted[~self.ram.contains(keys_sorted)]
         if missing.size == 0:
             return
@@ -187,6 +195,10 @@ class TieredFeatureStore:
 
     def evict_to_budget(self) -> int:
         """Spill coldest rows until RAM is within budget."""
+        with self._tier_lock:
+            return self._evict_to_budget_locked()
+
+    def _evict_to_budget_locked(self) -> int:
         if self.max_ram_features is None:
             return 0
         excess = self.ram.num_features - self.max_ram_features
@@ -209,20 +221,26 @@ class TieredFeatureStore:
 
     def pull_for_pass(self, pass_keys_sorted: np.ndarray
                       ) -> Dict[str, np.ndarray]:
-        self._stage_in(np.asarray(pass_keys_sorted, np.uint64))
-        out = self.ram.pull_for_pass(pass_keys_sorted)
-        # Pull-only traffic stages rows in too — without eviction here a
-        # read-heavy client (serving-style pulls) would grow RAM
-        # unboundedly past the budget the tier exists to enforce.
-        self.evict_to_budget()
+        with self._tier_lock:
+            self._stage_in(np.asarray(pass_keys_sorted, np.uint64))
+            out = self.ram.pull_for_pass(pass_keys_sorted)
+            # Pull-only traffic stages rows in too — without eviction
+            # here a read-heavy client (serving-style pulls) would grow
+            # RAM unboundedly past the budget the tier enforces.
+            self._evict_to_budget_locked()
         return out
 
     def push_from_pass(self, pass_keys_sorted: np.ndarray,
                        values: Dict[str, np.ndarray]) -> None:
-        self.ram.push_from_pass(pass_keys_sorted, values)
-        self.evict_to_budget()
+        with self._tier_lock:
+            self.ram.push_from_pass(pass_keys_sorted, values)
+            self._evict_to_budget_locked()
 
     def contains(self, keys: np.ndarray) -> np.ndarray:
+        with self._tier_lock:
+            return self._contains_locked(keys)
+
+    def _contains_locked(self, keys: np.ndarray) -> np.ndarray:
         keys = np.asarray(keys, np.uint64)
         in_ram = self.ram.contains(keys)
         if in_ram.all():
@@ -242,6 +260,10 @@ class TieredFeatureStore:
         return out
 
     def shrink(self, *, min_show: float = 0.0) -> int:
+        with self._tier_lock:
+            return self._shrink_locked(min_show=min_show)
+
+    def _shrink_locked(self, *, min_show: float = 0.0) -> int:
         """Shrink both tiers (disk rows decay too — stage all disk rows
         through RAM bucket-by-bucket to apply decay/eviction)."""
         evicted = self.ram.shrink(min_show=min_show)
@@ -261,6 +283,10 @@ class TieredFeatureStore:
         return evicted
 
     def save_base(self, path: str) -> None:
+        with self._tier_lock:
+            self._save_base_locked(path)
+
+    def _save_base_locked(self, path: str) -> None:
         self.ram.save_base(path)
         self._evicted_dirty = np.empty((0,), np.uint64)
         self.disk.copy_to(os.path.join(path,
@@ -269,12 +295,12 @@ class TieredFeatureStore:
     def save_xbox(self, path: str) -> int:
         """Serving export across BOTH tiers (RAM ∪ disk — the tiers hold
         disjoint keys: eviction removes from RAM). Same artifact format
-        as FeatureStore.save_xbox incl. the xbox_quant_bits flag."""
+        as FeatureStore.save_xbox incl. the xbox_quant_bits flag.
+
+        Under the tier lock: tier movement BETWEEN the RAM snapshot and
+        the disk scan would export a moved key twice (or drop it)."""
         from paddlebox_tpu.embedding.store import quantize_xbox_vals
-        with self.ram._lock:
-            # Snapshot under the RAM store's lock — a concurrent push's
-            # sorted merge reassigns _keys/_vals, and a torn copy would
-            # pair keys with the wrong rows.
+        with self._tier_lock, self.ram._lock:
             keys = [self.ram._keys.copy()]
             embs = [self.ram._vals["emb"].copy()]
             ws = [self.ram._vals["w"].copy()]
@@ -296,6 +322,10 @@ class TieredFeatureStore:
         # Stage evicted-but-dirty rows back so the RAM delta set covers
         # every change since the last base (push_from_pass re-marks them
         # dirty), then re-evict to stay within budget.
+        with self._tier_lock:
+            self._save_delta_locked(path)
+
+    def _save_delta_locked(self, path: str) -> None:
         if self._evicted_dirty.size:
             k, v = self.disk.take(self._evicted_dirty)
             if k.size:
@@ -305,6 +335,10 @@ class TieredFeatureStore:
         self.evict_to_budget()
 
     def load(self, path: str, kind: str = "base") -> None:
+        with self._tier_lock:
+            self._load_locked(path, kind)
+
+    def _load_locked(self, path: str, kind: str) -> None:
         self.ram.load(path, kind)
         if kind == "base":
             ssd_src = os.path.join(path, f"{self.config.name}.ssd")

@@ -69,6 +69,12 @@ class TrainerConfig:
     data_norm: bool = False
     data_norm_slot_dim: int = -1
     data_norm_decay: float = 0.9999999
+    # Global-norm clip on the dense gradients before the optimizer
+    # (role of paddle.nn.ClipGradByGlobalNorm in fleet configs);
+    # 0 disables. Applied AFTER the cross-replica psum in "step" mode —
+    # the clip must see the true global gradient, as the reference's
+    # post-allreduce clip does.
+    grad_clip_norm: float = 0.0
 
 
 class CTRTrainer:
@@ -145,6 +151,17 @@ class CTRTrainer:
             self._optax = optax.sgd(self.config.dense_learning_rate)
         else:
             raise ValueError(self.config.dense_optimizer)
+        if self.config.grad_clip_norm > 0:
+            if self.config.dense_sync_mode == "async":
+                # The async path applies updates in the host
+                # AsyncDenseTable, not through self._optax — chaining
+                # the clip there would be silently ignored.
+                raise NotImplementedError(
+                    "grad_clip_norm with dense_sync_mode='async' is not "
+                    "supported (the host dense table applies updates)")
+            self._optax = optax.chain(
+                optax.clip_by_global_norm(self.config.grad_clip_norm),
+                self._optax)
 
     # -- init -------------------------------------------------------------
 

@@ -93,3 +93,47 @@ def test_checkpoint_roundtrip_continues(shard_files, tmp_path):
     t2.init(seed=0)
     t2.engine.store.load(str(tmp_path / "base"), "base")
     assert t2.engine.store.num_features == trainer.engine.store.num_features
+
+
+def test_grad_clip_bounds_update(tmp_path):
+    """grad_clip_norm must cap the dense update: with a tiny clip the
+    first-step parameter movement is strictly smaller than unclipped
+    (clip sees the post-psum global grad)."""
+    import jax
+
+    from paddlebox_tpu.data.dataset import Dataset
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+    def run(clip):
+        mesh = build_mesh(HybridTopology(dp=8))
+        feed = DataFeedConfig(slots=(SlotConf("a", avg_len=1.0),),
+                              batch_size=64)
+        model = DeepFM(slot_names=("a",), emb_dim=4, hidden=(16,))
+        tr = CTRTrainer(
+            model, feed, TableConfig(dim=4, learning_rate=0.1),
+            mesh=mesh,
+            config=TrainerConfig(dense_optimizer="sgd",
+                                 dense_learning_rate=1.0,
+                                 grad_clip_norm=clip))
+        tr.init(seed=0)
+        before = jax.tree.map(lambda x: np.asarray(x).copy(), tr.params)
+        rng = np.random.default_rng(0)
+        p = str(tmp_path / f"part-clip-{clip}")
+        with open(p, "w") as f:
+            for _ in range(64):
+                f.write(f"{rng.integers(0, 2)} a:{rng.integers(1, 50)}\n")
+        ds = Dataset(feed, num_reader_threads=1)
+        ds.set_filelist([p])
+        ds.load_into_memory()
+        tr.train_pass(ds)
+        delta = jax.tree.map(lambda a, b: np.abs(np.asarray(a) - b).max(),
+                             tr.params, before)
+        return max(jax.tree.leaves(delta))
+
+    unclipped = run(0.0)
+    clipped = run(1e-3)
+    assert clipped < unclipped
+    # SGD with lr 1 and global-norm clip c: max |update| <= c.
+    assert clipped <= 1e-3 + 1e-6
