@@ -300,16 +300,20 @@ class TieredFeatureStore:
         Under the tier lock: tier movement BETWEEN the RAM snapshot and
         the disk scan would export a moved key twice (or drop it)."""
         from paddlebox_tpu.embedding.store import quantize_xbox_vals
-        with self._tier_lock, self.ram._lock:
-            keys = [self.ram._keys.copy()]
-            embs = [self.ram._vals["emb"].copy()]
-            ws = [self.ram._vals["w"].copy()]
-        for b in range(self.disk.num_buckets):
-            k, v = self.disk._load_bucket(b)
-            if k.size:
-                keys.append(k)
-                embs.append(v["emb"])
-                ws.append(v["w"])
+        with self._tier_lock:
+            with self.ram._lock:
+                keys = [self.ram._keys.copy()]
+                embs = [self.ram._vals["emb"].copy()]
+                ws = [self.ram._vals["w"].copy()]
+            # Disk scan stays under the TIER lock: a concurrent eviction
+            # between snapshot and scan would export a moved key twice;
+            # a stage-in would drop it entirely.
+            for b in range(self.disk.num_buckets):
+                k, v = self.disk._load_bucket(b)
+                if k.size:
+                    keys.append(k)
+                    embs.append(v["emb"])
+                    ws.append(v["w"])
         k_all = np.concatenate(keys)
         order = np.argsort(k_all, kind="stable")
         vals = {"emb": np.concatenate(embs)[order],

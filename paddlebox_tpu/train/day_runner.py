@@ -74,6 +74,37 @@ class DayRunner:
 
     # -- recovery ----------------------------------------------------------
 
+    def _save_dense(self, model_dir: str) -> None:
+        """Dense params + optimizer state beside the sparse checkpoint
+        (written BEFORE the done-file publish, so a published record
+        always implies a complete model)."""
+        from paddlebox_tpu.checkpoint.dense import save_pytree
+        save_pytree({"params": self.trainer.params,
+                     "opt_state": self.trainer.opt_state},
+                    os.path.join(model_dir, "dense.npz"))
+
+    def _load_dense(self, model_dir: str) -> bool:
+        from paddlebox_tpu.checkpoint.dense import load_pytree
+        path = os.path.join(model_dir, "dense.npz")
+        if not os.path.exists(path):
+            return False
+        try:
+            state, _step = load_pytree(
+                {"params": self.trainer.params,
+                 "opt_state": self.trainer.opt_state}, path)
+        except KeyError as e:
+            # Structure mismatch — e.g. the optimizer config changed
+            # (grad_clip_norm re-nests opt_state under optax.chain) since
+            # the checkpoint was written. Recovery falls back to an older
+            # record or a warned fresh-dense resume rather than aborting.
+            log.warning("day_runner: dense checkpoint %s does not match "
+                        "the current optimizer/model structure (%s) — "
+                        "skipping it", path, e)
+            return False
+        self.trainer.params = state["params"]
+        self.trainer.opt_state = state["opt_state"]
+        return True
+
     def recover(self) -> Optional[Dict[str, object]]:
         """Load last base + subsequent deltas from the done-file (role of
         the elastic restart consumers). Returns the resume point
@@ -87,6 +118,16 @@ class DayRunner:
         store.load(base.path, "base")
         for d in deltas:
             store.load(d.path, "delta")
+        # Dense state from the NEWEST record that carries it (sparse
+        # deltas are cumulative; dense checkpoints are full snapshots).
+        for rec in [*reversed(deltas), base]:
+            if self._load_dense(rec.path):
+                log.vlog(0, "day_runner: dense state from %s", rec.path)
+                break
+        else:
+            log.warning("day_runner: no dense checkpoint in the recovery "
+                        "chain — dense towers resume from current "
+                        "(likely fresh) init")
         log.vlog(0, "day_runner: recovered base %s + %d deltas (day %s)",
                  base.path, len(deltas), base.day)
         if deltas:
@@ -153,8 +194,14 @@ class DayRunner:
             # Only rank 0 writes model files — N ranks racing
             # savez on one shared path would corrupt the npz.
             with self.timers.scope("save_delta"):
-                self.trainer.engine.store.save_delta(
-                    self.ckpt.model_dir(day, pass_id))
+                mdir = self.ckpt.model_dir(day, pass_id)
+                self.trainer.engine.store.save_delta(mdir)
+                # Dense state rides with every sparse checkpoint (role
+                # of save_persistables beside the table dumps): a
+                # recovery that reloads the table but restarts the
+                # dense towers from init would resume an inconsistent
+                # model. data_norm stats live in params and ride too.
+                self._save_dense(mdir)
                 self.ckpt.publish(day, pass_id)
             if self.save_xbox and hasattr(self.trainer.engine.store,
                                           "save_xbox"):
@@ -230,7 +277,9 @@ class DayRunner:
         if self.is_rank0:
             with self.timers.scope("day_end"):
                 evicted = store.shrink(min_show=self.min_show_shrink)
-                store.save_base(self.ckpt.model_dir(day, pass_id=-1))
+                bdir = self.ckpt.model_dir(day, pass_id=-1)
+                store.save_base(bdir)
+                self._save_dense(bdir)
                 self.ckpt.publish(day, pass_id=-1)
         elif getattr(store, "shared", False):
             # Shared backing tier (e.g. PSBackedStore): rank 0 already

@@ -170,3 +170,35 @@ def test_recovery_applies_deltas_after_base(tmp_path):
         # (re-training pass 1 would double-apply show/click/state)
         show2 = float(store2.pull_for_pass(keys)["show"].sum())
         assert show2 == pytest.approx(show_total * 0.98)
+
+
+def test_recovery_restores_dense_state(tmp_path):
+    """The recovered model must be CONSISTENT: sparse table AND dense
+    towers (params + optimizer state) from the same checkpoint — a
+    table-only recovery would pair trained embeddings with freshly
+    initialized dense weights."""
+    import jax
+
+    data = str(tmp_path / "data")
+    out = str(tmp_path / "out")
+    _write_day(data, "20260728", [0, 1])
+    r1 = _make_runner(data, out)
+    r1.train_day("20260728")
+    trained = jax.tree.map(lambda x: np.asarray(x).copy(),
+                           r1.trainer.params)
+
+    r2 = _make_runner(data, out)  # fresh init (different weights)
+    fresh_leaf = np.asarray(jax.tree.leaves(r2.trainer.params)[0]).copy()
+    r2.recover()
+    for a, b in zip(jax.tree.leaves(r2.trainer.params),
+                    jax.tree.leaves(trained)):
+        np.testing.assert_allclose(np.asarray(a), b, atol=1e-7)
+    # And it genuinely changed something (the fresh init differed).
+    restored_leaf = np.asarray(jax.tree.leaves(r2.trainer.params)[0])
+    assert not np.allclose(restored_leaf, fresh_leaf) or \
+        np.allclose(fresh_leaf, jax.tree.leaves(trained)[0])
+    # Optimizer state restored too (adam moments non-zero post-recovery).
+    moments = [np.abs(np.asarray(x)).sum()
+               for x in jax.tree.leaves(r2.trainer.opt_state)
+               if hasattr(x, "shape") and np.asarray(x).size > 1]
+    assert any(m > 0 for m in moments)
