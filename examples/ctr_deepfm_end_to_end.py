@@ -28,7 +28,8 @@ from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
 from paddlebox_tpu.embedding import TableConfig
 from paddlebox_tpu.models import DeepFM
 from paddlebox_tpu.parallel import HybridTopology, build_mesh
-from paddlebox_tpu.serving import CTRPredictor, load_xbox_model
+from paddlebox_tpu.serving import (CTRPredictor, load_delta_update,
+                                   load_xbox_model)
 from paddlebox_tpu.train import CTRTrainer, TrainerConfig
 
 SLOTS = ("user", "item", "context")
@@ -93,6 +94,22 @@ def main() -> None:
         print(f"served {probs.shape[0]} predictions; "
               f"mean CTR {probs.mean():.4f}")
         assert np.isfinite(probs).all()
+
+        # Real-time model update: train one more pass, export only the
+        # touched keys (delta), land it on the LIVE predictor — no cold
+        # reload (the reference's online patch-model flow).
+        trainer.engine.store.save_base(os.path.join(tmp, "b0"))
+        ds = Dataset(feed, num_reader_threads=2)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        trainer.train_pass(ds)
+        delta_dir = os.path.join(tmp, "delta")
+        trainer.engine.store.save_delta(delta_dir)
+        dk, de, dw = load_delta_update(delta_dir, table="emb")
+        n_new = pred.apply_update(dk, de, dw, dense_params=trainer.params)
+        probs2 = pred.predict(batch)
+        print(f"live update: {dk.shape[0]} keys ({n_new} new); mean CTR "
+              f"{probs.mean():.4f} -> {probs2.mean():.4f}")
 
 
 if __name__ == "__main__":

@@ -149,7 +149,7 @@ class CTRPredictor:
                 raise ValueError(
                     "need dense_params, or dense_path + dense_template")
             from paddlebox_tpu.checkpoint.dense import load_pytree
-            dense_params = load_pytree(dense_template, dense_path)
+            dense_params, _step = load_pytree(dense_template, dense_path)
         return cls(model, feed_config, keys, emb, w, dense_params, **kw)
 
     def _build_fwd(self, caps: Dict[str, int], bs: int):

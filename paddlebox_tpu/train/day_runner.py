@@ -21,6 +21,8 @@ import glob
 import os
 from typing import Callable, Dict, List, Optional, Sequence
 
+import numpy as np
+
 from paddlebox_tpu.checkpoint.protocol import (CheckpointProtocol,
                                                get_online_pass_interval)
 from paddlebox_tpu.core import log, timers
@@ -88,10 +90,10 @@ class DayRunner:
         path = os.path.join(model_dir, "dense.npz")
         if not os.path.exists(path):
             return False
+        template = {"params": self.trainer.params,
+                    "opt_state": self.trainer.opt_state}
         try:
-            state, _step = load_pytree(
-                {"params": self.trainer.params,
-                 "opt_state": self.trainer.opt_state}, path)
+            state, _step = load_pytree(template, path)
         except KeyError as e:
             # Structure mismatch — e.g. the optimizer config changed
             # (grad_clip_norm re-nests opt_state under optax.chain) since
@@ -101,6 +103,18 @@ class DayRunner:
                         "the current optimizer/model structure (%s) — "
                         "skipping it", path, e)
             return False
+        # Same key paths can still carry different SHAPES (model config
+        # changed): restoring them would train garbage or crash later in
+        # the jitted step — reject here with the same warned fallback.
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(template)):
+            if np.shape(a) != np.shape(b):
+                log.warning(
+                    "day_runner: dense checkpoint %s leaf shape %s != "
+                    "current model's %s — skipping it", path,
+                    np.shape(a), np.shape(b))
+                return False
         self.trainer.params = state["params"]
         self.trainer.opt_state = state["opt_state"]
         return True

@@ -194,3 +194,81 @@ def test_concurrent_predict_during_updates(tmp_path):
         stop.set()
         t.join()
     assert not errors, errors
+
+
+def test_from_dirs_loads_dense_checkpoint(tmp_path):
+    """CTRPredictor.from_dirs over a DayRunner-style artifact pair
+    (xbox export + dense.npz) — the load_pytree (tree, step) unpack was
+    untested and broken."""
+    import jax
+
+    from paddlebox_tpu.checkpoint.dense import save_pytree
+
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=8)
+    model = DeepFM(slot_names=SLOTS, emb_dim=4, hidden=(8,))
+    params = model.init(jax.random.PRNGKey(1))
+    from paddlebox_tpu.embedding.store import FeatureStore
+    store = FeatureStore(TableConfig(name="embedding", dim=4,
+                                     learning_rate=0.1))
+    keys = np.arange(1, 50, dtype=np.uint64)
+    vals = store.pull_for_pass(keys)
+    store.push_from_pass(keys, vals)
+    store.save_xbox(str(tmp_path))
+    save_pytree(params, str(tmp_path / "dense.npz"))
+
+    template = model.init(jax.random.PRNGKey(2))  # different weights
+    pred = CTRPredictor.from_dirs(
+        model, feed, str(tmp_path),
+        dense_path=str(tmp_path / "dense.npz"),
+        dense_template=template, compute_dtype="float32")
+    # The restored dense params are the SAVED ones, not the template.
+    for a, b in zip(jax.tree.leaves(pred._dense_params),
+                    jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    rng = np.random.default_rng(0)
+    p = _write(str(tmp_path / "probe"), rng, 8, 1, 60)
+    ds = Dataset(feed, num_reader_threads=1)
+    ds.set_filelist([p])
+    ds.load_into_memory()
+    probs = pred.predict(next(ds.batches_sharded(1)))
+    assert np.isfinite(probs).all()
+
+
+def test_recovery_skips_shape_mismatched_dense(tmp_path):
+    """A dense checkpoint whose leaf shapes no longer match the model is
+    rejected with a warning, not silently restored."""
+    import jax
+
+    from paddlebox_tpu.checkpoint.dense import save_pytree
+    from paddlebox_tpu.train.day_runner import DayRunner
+
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=64)
+
+    def make(hidden):
+        model = DeepFM(slot_names=SLOTS, emb_dim=4, hidden=hidden)
+        tr = CTRTrainer(model, feed, TableConfig(name="emb", dim=4),
+                        mesh=mesh, config=TrainerConfig())
+        tr.init(seed=0)
+        return tr
+
+    tr_old = make((8,))
+    runner = DayRunner(tr_old, feed, str(tmp_path / "out"),
+                       data_root=str(tmp_path / "data"))
+    mdir = str(tmp_path / "ckpt")
+    import os
+    os.makedirs(mdir, exist_ok=True)
+    runner._save_dense(mdir)
+
+    tr_new = make((16,))  # changed model shape
+    runner_new = DayRunner(tr_new, feed, str(tmp_path / "out2"),
+                           data_root=str(tmp_path / "data"))
+    before = [np.asarray(x).copy()
+              for x in jax.tree.leaves(tr_new.params)]
+    assert runner_new._load_dense(mdir) is False
+    for a, b in zip(jax.tree.leaves(tr_new.params), before):
+        np.testing.assert_array_equal(np.asarray(a), b)
