@@ -7,10 +7,11 @@ Dense families (ResNet/BERT/GPT — the reference's fleet collective /
 hybrid-parallel configs) live in their own modules.
 """
 
+from paddlebox_tpu.models.dcn import DCN
 from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.din_rank import DINRank, build_rank_offset
 from paddlebox_tpu.models.multitask import MMoE, SharedBottomMultiTask
 from paddlebox_tpu.models.wide_deep import WideDeep
 
-__all__ = ["DeepFM", "DINRank", "MMoE", "SharedBottomMultiTask",
+__all__ = ["DCN", "DeepFM", "DINRank", "MMoE", "SharedBottomMultiTask",
            "WideDeep", "build_rank_offset"]

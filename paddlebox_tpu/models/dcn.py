@@ -1,0 +1,68 @@
+"""DCN — Deep & Cross Network over pulled sparse embeddings.
+
+The other staple of the PaddleBox-era CTR zoo next to DeepFM/Wide&Deep
+(reference models compose ``pull_box_sparse`` + ``fused_seqpool_cvm``
+graphs with explicit feature crossing). CrossNet v2 form: each layer
+``x_{l+1} = x0 * (W_l x_l + b_l) + x_l`` learns bounded-degree feature
+interactions explicitly; a parallel deep tower learns implicit ones;
+both feed one logit head.
+
+Same functional contract as :class:`~paddlebox_tpu.models.DeepFM`
+(init/apply, differentiable w.r.t. pulled emb/w for the sparse push) —
+all dense ops are [B, F] matmuls the MXU eats directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.nn import dense_apply, dense_init, mlp_apply, mlp_init
+from paddlebox_tpu.models.multitask import _pool_slot_inputs
+
+
+@dataclasses.dataclass(frozen=True)
+class DCN:
+    slot_names: Tuple[str, ...]
+    emb_dim: Union[int, Mapping[str, int]]
+    dense_dim: int = 0
+    num_cross_layers: int = 3
+    hidden: Tuple[int, ...] = (128, 64)
+
+    def _dims(self) -> Dict[str, int]:
+        if isinstance(self.emb_dim, int):
+            return {n: self.emb_dim for n in self.slot_names}
+        return {n: int(self.emb_dim[n]) for n in self.slot_names}
+
+    def init(self, rng: jax.Array) -> Dict:
+        f = sum(self._dims().values()) + self.dense_dim
+        keys = jax.random.split(rng, self.num_cross_layers + 2)
+        return {
+            "cross": [dense_init(keys[i], f, f)
+                      for i in range(self.num_cross_layers)],
+            "deep": mlp_init(keys[-2], f, list(self.hidden)),
+            # Head over [cross_out | deep_out].
+            "head": dense_init(keys[-1], f + self.hidden[-1], 1),
+            "bias": jnp.zeros((), jnp.float32),
+        }
+
+    def apply(self, params: Dict,
+              emb: Dict[str, jax.Array],
+              w: Dict[str, jax.Array],
+              segments: Dict[str, jax.Array],
+              batch_size: int,
+              dense_feats: jax.Array | None = None) -> jax.Array:
+        """Returns logits [B]."""
+        x0, wide = _pool_slot_inputs(self.slot_names, emb, w, segments,
+                                     batch_size, dense_feats,
+                                     self.dense_dim)
+        x = x0
+        for layer in params["cross"]:
+            x = x0 * dense_apply(layer, x) + x
+        deep = mlp_apply(params["deep"], x0, final_activation=True)
+        both = jnp.concatenate([x, deep], axis=-1)
+        return (dense_apply(params["head"], both)[:, 0] + wide
+                + params["bias"])

@@ -1,0 +1,63 @@
+"""DCN through CTRTrainer end-to-end: cross layers learn an explicit
+feature interaction a linear/wide model cannot."""
+
+import numpy as np
+
+from paddlebox_tpu.data.dataset import Dataset
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+from paddlebox_tpu.embedding import TableConfig
+from paddlebox_tpu.models import DCN
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+SLOTS = ("a", "b")
+
+
+def test_dcn_learns_cross_interaction(tmp_path):
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=64)
+    model = DCN(slot_names=SLOTS, emb_dim=8, num_cross_layers=2,
+                hidden=(32,))
+    tr = CTRTrainer(model, feed, TableConfig(dim=8, learning_rate=0.2),
+                    mesh=mesh,
+                    config=TrainerConfig(auc_num_buckets=1 << 10,
+                                         dense_learning_rate=3e-3))
+    tr.init(seed=0)
+    rng = np.random.default_rng(9)
+    p = str(tmp_path / "part")
+    with open(p, "w") as f:
+        for _ in range(512):
+            a, b = rng.integers(1, 60), rng.integers(1, 60)
+            # Pure INTERACTION signal: label depends on the (a, b) pair's
+            # parity product, not on either feature alone.
+            label = int(((a % 2) == (b % 2)) == (rng.random() < 0.85))
+            f.write(f"{label} a:{a} b:{b}\n")
+    losses = []
+    for _ in range(7):
+        ds = Dataset(feed, num_reader_threads=1)
+        ds.set_filelist([p])
+        ds.load_into_memory()
+        stats = tr.train_pass(ds)
+        losses.append(stats["loss"])
+    assert losses[-1] < losses[0]
+    assert stats["auc"] > 0.62, stats["auc"]
+
+
+def test_dcn_cross_layer_math():
+    """One cross layer == x0 * (W x + b) + x exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    model = DCN(slot_names=("a",), emb_dim=4, num_cross_layers=1,
+                hidden=(8,))
+    params = model.init(jax.random.PRNGKey(0))
+    x0 = jnp.asarray(np.random.default_rng(0).normal(size=(3, 4)),
+                     jnp.float32)
+    from paddlebox_tpu.nn import dense_apply
+    expect = x0 * dense_apply(params["cross"][0], x0) + x0
+    got = x0
+    for layer in params["cross"]:
+        got = x0 * dense_apply(layer, got) + got
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect))
