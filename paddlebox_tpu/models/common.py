@@ -1,0 +1,26 @@
+"""Shared model-input helpers for the CTR zoo."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.ops import seqpool
+
+
+def pool_slot_inputs(slot_names, emb, w, segments, batch_size,
+                     dense_feats, dense_dim):
+    """Shared input prelude for the pooled CTR models: per-slot sum-pool
+    of embeddings and first-order weights -> (flat [B, sum D + dense],
+    wide [B])."""
+    pooled: List[jax.Array] = []
+    wide_terms: List[jax.Array] = []
+    for name in slot_names:
+        pooled.append(seqpool(emb[name], segments[name], batch_size))
+        wide_terms.append(seqpool(w[name], segments[name], batch_size))
+    flat = jnp.concatenate(pooled, axis=-1)
+    if dense_feats is not None and dense_dim:
+        flat = jnp.concatenate([flat, dense_feats], axis=-1)
+    return flat, sum(wide_terms)

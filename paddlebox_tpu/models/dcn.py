@@ -20,8 +20,8 @@ from typing import Dict, Mapping, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu.models.common import pool_slot_inputs
 from paddlebox_tpu.nn import dense_apply, dense_init, mlp_apply, mlp_init
-from paddlebox_tpu.models.multitask import _pool_slot_inputs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,14 +40,18 @@ class DCN:
     def init(self, rng: jax.Array) -> Dict:
         f = sum(self._dims().values()) + self.dense_dim
         keys = jax.random.split(rng, self.num_cross_layers + 2)
-        return {
+        deep_out = self.hidden[-1] if self.hidden else 0
+        out = {
             "cross": [dense_init(keys[i], f, f)
                       for i in range(self.num_cross_layers)],
-            "deep": mlp_init(keys[-2], f, list(self.hidden)),
-            # Head over [cross_out | deep_out].
-            "head": dense_init(keys[-1], f + self.hidden[-1], 1),
+            # Head over [cross_out | deep_out] (cross-only when
+            # hidden=() — a standard DCN variant).
+            "head": dense_init(keys[-1], f + deep_out, 1),
             "bias": jnp.zeros((), jnp.float32),
         }
+        if self.hidden:
+            out["deep"] = mlp_init(keys[-2], f, list(self.hidden))
+        return out
 
     def apply(self, params: Dict,
               emb: Dict[str, jax.Array],
@@ -56,13 +60,14 @@ class DCN:
               batch_size: int,
               dense_feats: jax.Array | None = None) -> jax.Array:
         """Returns logits [B]."""
-        x0, wide = _pool_slot_inputs(self.slot_names, emb, w, segments,
+        x0, wide = pool_slot_inputs(self.slot_names, emb, w, segments,
                                      batch_size, dense_feats,
                                      self.dense_dim)
         x = x0
         for layer in params["cross"]:
             x = x0 * dense_apply(layer, x) + x
-        deep = mlp_apply(params["deep"], x0, final_activation=True)
-        both = jnp.concatenate([x, deep], axis=-1)
-        return (dense_apply(params["head"], both)[:, 0] + wide
+        if self.hidden:
+            deep = mlp_apply(params["deep"], x0, final_activation=True)
+            x = jnp.concatenate([x, deep], axis=-1)
+        return (dense_apply(params["head"], x)[:, 0] + wide
                 + params["bias"])

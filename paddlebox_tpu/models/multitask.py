@@ -20,24 +20,8 @@ from typing import Dict, List, Mapping, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu.models.common import pool_slot_inputs
 from paddlebox_tpu.nn import mlp_apply, mlp_init
-from paddlebox_tpu.ops import seqpool
-
-
-def _pool_slot_inputs(slot_names, emb, w, segments, batch_size,
-                      dense_feats, dense_dim):
-    """Shared input prelude for the multi-task models: per-slot sum-pool
-    of embeddings and first-order weights -> (flat [B, sum D + dense],
-    wide [B])."""
-    pooled: List[jax.Array] = []
-    wide_terms: List[jax.Array] = []
-    for name in slot_names:
-        pooled.append(seqpool(emb[name], segments[name], batch_size))
-        wide_terms.append(seqpool(w[name], segments[name], batch_size))
-    flat = jnp.concatenate(pooled, axis=-1)
-    if dense_feats is not None and dense_dim:
-        flat = jnp.concatenate([flat, dense_feats], axis=-1)
-    return flat, sum(wide_terms)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,7 +58,7 @@ class SharedBottomMultiTask:
               batch_size: int,
               dense_feats: jax.Array | None = None) -> jax.Array:
         """Returns logits [B, num_tasks]."""
-        flat, wide = _pool_slot_inputs(self.slot_names, emb, w, segments,
+        flat, wide = pool_slot_inputs(self.slot_names, emb, w, segments,
                                        batch_size, dense_feats,
                                        self.dense_dim)
         # final_activation: the shared representation feeding the towers
@@ -139,7 +123,7 @@ class MMoE:
               batch_size: int,
               dense_feats: jax.Array | None = None) -> jax.Array:
         """Returns logits [B, num_tasks]."""
-        flat, wide = _pool_slot_inputs(self.slot_names, emb, w, segments,
+        flat, wide = pool_slot_inputs(self.slot_names, emb, w, segments,
                                        batch_size, dense_feats,
                                        self.dense_dim)
         experts = jnp.stack(

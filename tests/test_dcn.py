@@ -45,19 +45,33 @@ def test_dcn_learns_cross_interaction(tmp_path):
     assert stats["auc"] > 0.62, stats["auc"]
 
 
-def test_dcn_cross_layer_math():
-    """One cross layer == x0 * (W x + b) + x exactly."""
+def test_dcn_apply_matches_numpy_reference():
+    """model.apply (cross-only variant, hidden=()) against an
+    independently written numpy transcription of CrossNet v2:
+    x_{l+1} = x0 * (x_l W + b) + x_l, then the head + wide + bias."""
     import jax
     import jax.numpy as jnp
 
-    model = DCN(slot_names=("a",), emb_dim=4, num_cross_layers=1,
-                hidden=(8,))
+    model = DCN(slot_names=SLOTS, emb_dim=4, num_cross_layers=2,
+                hidden=())
     params = model.init(jax.random.PRNGKey(0))
-    x0 = jnp.asarray(np.random.default_rng(0).normal(size=(3, 4)),
-                     jnp.float32)
-    from paddlebox_tpu.nn import dense_apply
-    expect = x0 * dense_apply(params["cross"][0], x0) + x0
-    got = x0
+    rng = np.random.default_rng(1)
+    bs = 3
+    emb = {s: jnp.asarray(rng.normal(size=(bs, 4)), jnp.float32)
+           for s in SLOTS}
+    w = {s: jnp.asarray(rng.normal(size=(bs,)), jnp.float32)
+         for s in SLOTS}
+    segs = {s: jnp.arange(bs, dtype=jnp.int32) for s in SLOTS}
+    got = np.asarray(model.apply(params, emb, w, segs, batch_size=bs))
+
+    # numpy reference
+    x0 = np.concatenate([np.asarray(emb[s]) for s in SLOTS], axis=-1)
+    x = x0.copy()
     for layer in params["cross"]:
-        got = x0 * dense_apply(layer, got) + got
-    np.testing.assert_allclose(np.asarray(got), np.asarray(expect))
+        x = x0 * (x @ np.asarray(layer["w"])
+                  + np.asarray(layer["b"])) + x
+    head = np.asarray(params["head"]["w"])
+    logits = (x @ head)[:, 0] + np.asarray(params["head"]["b"])[0]
+    wide = sum(np.asarray(w[s]) for s in SLOTS)
+    expect = logits + wide + float(params["bias"])
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
