@@ -24,3 +24,11 @@ def pool_slot_inputs(slot_names, emb, w, segments, batch_size,
     if dense_feats is not None and dense_dim:
         flat = jnp.concatenate([flat, dense_feats], axis=-1)
     return flat, sum(wide_terms)
+
+
+def slot_dims(slot_names, emb_dim):
+    """Per-slot embedding widths from an int (uniform) or mapping
+    (dynamic-mf per-slot override)."""
+    if isinstance(emb_dim, int):
+        return {n: emb_dim for n in slot_names}
+    return {n: int(emb_dim[n]) for n in slot_names}

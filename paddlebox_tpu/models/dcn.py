@@ -20,7 +20,7 @@ from typing import Dict, Mapping, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.models.common import pool_slot_inputs
+from paddlebox_tpu.models.common import pool_slot_inputs, slot_dims
 from paddlebox_tpu.nn import dense_apply, dense_init, mlp_apply, mlp_init
 
 
@@ -33,9 +33,7 @@ class DCN:
     hidden: Tuple[int, ...] = (128, 64)
 
     def _dims(self) -> Dict[str, int]:
-        if isinstance(self.emb_dim, int):
-            return {n: self.emb_dim for n in self.slot_names}
-        return {n: int(self.emb_dim[n]) for n in self.slot_names}
+        return slot_dims(self.slot_names, self.emb_dim)
 
     def init(self, rng: jax.Array) -> Dict:
         f = sum(self._dims().values()) + self.dense_dim

@@ -19,6 +19,7 @@ from typing import Dict, List, Mapping, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu.models.common import slot_dims
 from paddlebox_tpu.nn import mlp_apply, mlp_init
 from paddlebox_tpu.ops import seqpool
 
@@ -35,9 +36,7 @@ class DeepFM:
     hidden: Tuple[int, ...] = (400, 400, 400)
 
     def _dims(self) -> Dict[str, int]:
-        if isinstance(self.emb_dim, int):
-            return {n: self.emb_dim for n in self.slot_names}
-        return {n: int(self.emb_dim[n]) for n in self.slot_names}
+        return slot_dims(self.slot_names, self.emb_dim)
 
     def init(self, rng: jax.Array) -> Dict:
         in_dim = sum(self._dims().values()) + self.dense_dim

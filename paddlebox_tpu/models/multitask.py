@@ -20,7 +20,7 @@ from typing import Dict, List, Mapping, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.models.common import pool_slot_inputs
+from paddlebox_tpu.models.common import pool_slot_inputs, slot_dims
 from paddlebox_tpu.nn import mlp_apply, mlp_init
 
 
@@ -34,9 +34,7 @@ class SharedBottomMultiTask:
     tower_hidden: Tuple[int, ...] = (64,)
 
     def _dims(self) -> Dict[str, int]:
-        if isinstance(self.emb_dim, int):
-            return {n: self.emb_dim for n in self.slot_names}
-        return {n: int(self.emb_dim[n]) for n in self.slot_names}
+        return slot_dims(self.slot_names, self.emb_dim)
 
     def init(self, rng: jax.Array) -> Dict:
         in_dim = sum(self._dims().values()) + self.dense_dim
@@ -94,9 +92,7 @@ class MMoE:
     tower_hidden: Tuple[int, ...] = (32,)
 
     def _dims(self) -> Dict[str, int]:
-        if isinstance(self.emb_dim, int):
-            return {n: self.emb_dim for n in self.slot_names}
-        return {n: int(self.emb_dim[n]) for n in self.slot_names}
+        return slot_dims(self.slot_names, self.emb_dim)
 
     def init(self, rng: jax.Array) -> Dict:
         in_dim = sum(self._dims().values()) + self.dense_dim

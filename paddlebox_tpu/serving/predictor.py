@@ -28,6 +28,7 @@ import numpy as np
 
 from paddlebox_tpu.core import log, monitor
 from paddlebox_tpu.native import store_py as native_store
+from paddlebox_tpu.ops.data_norm import normalize_dense_and_strip
 
 
 def _load_export(path: str, table: str, kind: str
@@ -170,8 +171,6 @@ class CTRPredictor:
             # normalize exactly as the trainer's forward does — the
             # SAME shared helper, f32 stats, before the compute cast —
             # or served probabilities diverge from training.
-            from paddlebox_tpu.ops.data_norm import (
-                normalize_dense_and_strip)
             params, dense_feats = normalize_dense_and_strip(
                 params, dense_feats, slot_dim=dn_slot_dim)
             picked = table[rows]                      # [sum caps, D+1]

@@ -35,6 +35,8 @@ from paddlebox_tpu.embedding.grouped import GroupedEngine
 from paddlebox_tpu.embedding.lookup import pull_local, push_local
 from paddlebox_tpu.metrics import (AucState, auc_accumulate, auc_compute,
                                    auc_state_init)
+from paddlebox_tpu.ops.data_norm import (data_norm_apply, data_norm_init,
+                                         normalize_dense_and_strip)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,7 +191,7 @@ class CTRTrainer:
         def loss_of(logits, labels, validf):
             # Local masked sum over the GLOBAL valid count; callers psum
             # the result to finish the cross-replica mean.
-            total_valid = lax.psum(jnp.sum(validf), self.axis)
+            total_valid = lax.psum(jnp.sum(validf), axis)
             if num_tasks > 1:   # [B, T]: mean over tasks
                 bce = optax.sigmoid_binary_cross_entropy(
                     logits, labels[:, :num_tasks])
@@ -228,7 +230,6 @@ class CTRTrainer:
             if not dense_dim:
                 raise ValueError("data_norm=True but the feed declares "
                                  "no dense slots")
-            from paddlebox_tpu.ops.data_norm import data_norm_init
             # Lives in the params tree (checkpointed with the dense
             # model) but is updated by the decayed summary path, not the
             # optimizer — _build_step overwrites it after the update.
@@ -284,8 +285,6 @@ class CTRTrainer:
             # Normalize dense features by the global stats BEFORE the
             # bf16 cast (the ~1e4-scale accumulators must stay f32);
             # the stats update happens in the train body, not here.
-            from paddlebox_tpu.ops.data_norm import (
-                normalize_dense_and_strip)
             params, dense_feats = normalize_dense_and_strip(
                 params, dense_feats, slot_dim=dn_slot_dim)
             params = cast(params)
@@ -397,7 +396,6 @@ class CTRTrainer:
                 # normalized with (the optimizer saw zero grads for them
                 # — stop_gradient — so post-update stats are unchanged);
                 # psum over dp = the sync_stats allreduce.
-                from paddlebox_tpu.ops.data_norm import data_norm_apply
                 _, dn_new = data_norm_apply(
                     dn_old, dense_feats.astype(jnp.float32),
                     slot_dim=dn_slot_dim, summary_decay_rate=dn_decay,
