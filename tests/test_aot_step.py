@@ -38,3 +38,11 @@ def test_multichip_steps_aot_compile_for_tpu():
     compile-only topology — ICI collective lowering included."""
     out = _run_tool("aot_check_multichip.py", 900)
     assert "MULTICHIP TPU AOT COMPILE: OK" in out
+
+
+@pytest.mark.slow
+def test_dense_bench_steps_aot_compile_for_tpu():
+    """resnet50 (bf16 conv fwd+transpose under autodiff) and BERT-base
+    train steps at their bench shapes."""
+    out = _run_tool("aot_check_dense.py", 900)
+    assert "DENSE BENCH TPU AOT COMPILE: OK" in out

@@ -1,0 +1,121 @@
+"""AOT-compile the dense benchmark train steps (resnet50 bf16, BERT-base)
+for TPU — no TPU needed (compile-only PJRT topology).
+
+These two bench harnesses had never run on hardware before round 3 (both
+carried calling-convention bugs), so their TPU-compile surface — notably
+the bf16 conv forward/transpose path resnet now uses — is exactly the
+kind of thing that would otherwise only fail inside the recorded run:
+
+    python tools/aot_check_dense.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import Mesh, NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+def sds(tree):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype),
+        tree)
+
+
+def check_resnet(sh) -> None:
+    """bench_resnet50's step shape: bf16 compute params (BN stats f32),
+    f32 master merge — the conv dtype-symmetry fix under autodiff."""
+    from paddlebox_tpu.models.resnet import ResNet
+    model = ResNet(depth=50, num_classes=1000)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optax.sgd(0.1, momentum=0.9)
+
+    def cast_compute(p):
+        out = {}
+        for k, v in p.items():
+            if isinstance(v, dict):
+                out[k] = cast_compute(v)
+            elif k in ("mean", "var"):
+                out[k] = v
+            else:
+                out[k] = v.astype(jnp.bfloat16)
+        return out
+
+    def merge_bn(master, fresh):
+        out = {}
+        for k, v in master.items():
+            if isinstance(v, dict) and "mean" in v and "var" in v:
+                out[k] = {**v,
+                          "mean": fresh[k]["mean"].astype(jnp.float32),
+                          "var": fresh[k]["var"].astype(jnp.float32)}
+            elif isinstance(v, dict):
+                out[k] = merge_bn(v, fresh[k])
+            else:
+                out[k] = v
+        return out
+
+    def loss_fn(p, x, y):
+        logits, p_new = model.apply(cast_compute(p), x, train=True)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y).mean(), p_new
+
+    def step(p, s, x, y):
+        (loss, p_new), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, x, y)
+        updates, s = opt.update(g, s, p)
+        return merge_bn(optax.apply_updates(p, updates), p_new), s, loss
+
+    opt_state = jax.eval_shape(opt.init, sds(params))
+    x = jax.ShapeDtypeStruct((128, 224, 224, 3), jnp.bfloat16,
+                             sharding=sh)
+    y = jax.ShapeDtypeStruct((128,), jnp.int32, sharding=sh)
+    jax.jit(step).lower(sds(params), opt_state, x, y).compile()
+    print("AOT resnet50 bf16 train step: OK")
+
+
+def check_bert(sh) -> None:
+    from paddlebox_tpu.models.bert import (BertConfig, bert_mlm_loss,
+                                           init_bert)
+    cfg = BertConfig()
+    params = init_bert(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(1e-4)
+
+    def step(p, s, tokens, targets, mask):
+        loss, g = jax.value_and_grad(
+            lambda p: bert_mlm_loss(p, cfg, tokens, targets, mask))(p)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    opt_state = jax.eval_shape(opt.init, sds(params))
+    tok = jax.ShapeDtypeStruct((8, 128), jnp.int32, sharding=sh)
+    msk = jax.ShapeDtypeStruct((8, 128), jnp.float32, sharding=sh)
+    jax.jit(step).lower(sds(params), opt_state, tok, tok, msk).compile()
+    print("AOT bert-base train step: OK")
+
+
+def main() -> None:
+    topo = topologies.get_topology_desc("v5e:2x2x1", "tpu")
+    sh = NamedSharding(Mesh([topo.devices[0]], ("d",)), P())
+    check_bert(sh)
+    check_resnet(sh)
+    print("DENSE BENCH TPU AOT COMPILE: OK")
+
+
+if __name__ == "__main__":
+    main()
