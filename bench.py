@@ -373,42 +373,14 @@ def bench_resnet50() -> dict:
 
     from paddlebox_tpu.models.resnet import ResNet
 
+    from paddlebox_tpu.amp import cast_compute_except_stats as cast_compute
+    from paddlebox_tpu.amp import merge_bn_stats as merge_bn
+
     model = ResNet(depth=50, num_classes=1000)
     params = model.init(jax.random.PRNGKey(0))
     opt = optax.sgd(0.1, momentum=0.9)
     opt_state = opt.init(params)
     bs = 8 if _SMALL else 128
-
-    def cast_compute(p):
-        """bf16 compute cast that leaves BN running stats f32 — casting
-        mean/var would re-quantize the EMA every step and defeat the f32
-        master merge_bn maintains (batchnorm_apply computes stats in f32
-        from whatever it is handed)."""
-        out = {}
-        for k, v in p.items():
-            if isinstance(v, dict):
-                out[k] = cast_compute(v)
-            elif k in ("mean", "var"):
-                out[k] = v
-            else:
-                out[k] = v.astype(jnp.bfloat16)
-        return out
-
-    def merge_bn(master, fresh):
-        """Write the forward's BN running-stat updates back into the f32
-        master tree (stats are state, not gradients — the optimizer sees
-        zero grads for them)."""
-        out = {}
-        for k, v in master.items():
-            if isinstance(v, dict) and "mean" in v and "var" in v:
-                out[k] = {**v,
-                          "mean": fresh[k]["mean"].astype(jnp.float32),
-                          "var": fresh[k]["var"].astype(jnp.float32)}
-            elif isinstance(v, dict):
-                out[k] = merge_bn(v, fresh[k])
-            else:
-                out[k] = v
-        return out
 
     def loss_fn(p, x, y):
         # bf16 compute (MXU path), f32 master params; BN statistics stay
@@ -701,8 +673,14 @@ def _preflight_scatter_kernel(n: int, aw: int, pass_keys: int) -> None:
         from paddlebox_tpu.embedding.lookup import _accumulate
         from paddlebox_tpu.embedding.table import plan_shards
         import jax.numpy as jnp
-        # Mirror make_push_fn: block = rows_per_shard + 1, single shard.
-        block = plan_shards(pass_keys, 1) + 1
+        # Mirror make_push_fn at the bench's actual device count: the
+        # jitted step compiles PER-SHARD shapes (block =
+        # rows_per_shard + 1, n/ndev updates inside shard_map) — a
+        # single-shard probe on a multi-chip bench would validate a
+        # shape the step never compiles.
+        ndev = len(jax.devices())
+        block = plan_shards(pass_keys, ndev) + 1
+        n = n // ndev
         rng = np.random.default_rng(0)
         rows = jnp.asarray(
             rng.integers(0, block - 1, n).astype(np.int32))

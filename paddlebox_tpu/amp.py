@@ -46,6 +46,40 @@ def bf16_policy() -> Policy:
     return Policy(compute_dtype=jnp.bfloat16)
 
 
+def cast_compute_except_stats(p: Any,
+                              stat_keys: Tuple[str, ...] = ("mean", "var")
+                              ) -> Any:
+    """bf16 compute cast over a nested-dict param tree that leaves
+    normalization running statistics f32 — casting them would
+    re-quantize the EMA every step and defeat an f32 master."""
+    out = {}
+    for k, v in p.items():
+        if isinstance(v, dict):
+            out[k] = cast_compute_except_stats(v, stat_keys)
+        elif k in stat_keys:
+            out[k] = v
+        else:
+            out[k] = v.astype(jnp.bfloat16)
+    return out
+
+
+def merge_bn_stats(master: Any, fresh: Any) -> Any:
+    """Write a forward pass's BN running-stat updates back into the f32
+    master tree (stats are state, not gradients — the optimizer sees
+    zero grads for them)."""
+    out = {}
+    for k, v in master.items():
+        if isinstance(v, dict) and "mean" in v and "var" in v:
+            out[k] = {**v,
+                      "mean": fresh[k]["mean"].astype(jnp.float32),
+                      "var": fresh[k]["var"].astype(jnp.float32)}
+        elif isinstance(v, dict):
+            out[k] = merge_bn_stats(v, fresh[k])
+        else:
+            out[k] = v
+    return out
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class LossScaleState:

@@ -233,6 +233,16 @@ class TieredFeatureStore:
     def push_from_pass(self, pass_keys_sorted: np.ndarray,
                        values: Dict[str, np.ndarray]) -> None:
         with self._tier_lock:
+            # Disjoint-tiers invariant: any pushed key still on disk
+            # (evicted between this RMW's pull and push, or pushed
+            # without a pull — delta load) must leave the disk tier
+            # before the RAM write, or it would exist in BOTH tiers
+            # with the disk copy stale (duplicate keys in exports,
+            # over-counted num_features).
+            keys = np.asarray(pass_keys_sorted, np.uint64)
+            not_in_ram = keys[~self.ram.contains(keys)]
+            if not_in_ram.size:
+                self.disk.take(not_in_ram)  # values discarded: overwritten
             self.ram.push_from_pass(pass_keys_sorted, values)
             self._evict_to_budget_locked()
 
@@ -348,3 +358,10 @@ class TieredFeatureStore:
             ssd_src = os.path.join(path, f"{self.config.name}.ssd")
             if os.path.isdir(ssd_src):
                 self.disk.restore_from(ssd_src)
+        else:
+            # Disjoint-tiers invariant: the delta's keys are now
+            # authoritative in RAM — purge any disk copies (a delta can
+            # cover keys that were evicted since the base).
+            data = np.load(os.path.join(
+                path, f"{self.config.name}.delta.npz"))
+            self.disk.take(data["keys"].astype(np.uint64))

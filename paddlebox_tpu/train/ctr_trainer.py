@@ -188,6 +188,13 @@ class CTRTrainer:
         the two must never drift."""
         num_tasks = self.num_tasks
 
+        def squeeze1(t):
+            # A multi-task ARCHITECTURE configured with num_tasks=1
+            # still emits [B, 1]; without the squeeze the single-task
+            # BCE would broadcast [B, 1] against [B] into a [B, B]
+            # matrix — finite loss, silently garbage training.
+            return t[:, 0] if t.ndim == 2 else t
+
         def loss_of(logits, labels, validf):
             # Local masked sum over the GLOBAL valid count; callers psum
             # the result to finish the cross-replica mean.
@@ -197,7 +204,8 @@ class CTRTrainer:
                     logits, labels[:, :num_tasks])
                 return (jnp.sum(bce * validf[:, None])
                         / jnp.maximum(total_valid * num_tasks, 1.0))
-            bce = optax.sigmoid_binary_cross_entropy(logits, labels[:, 0])
+            bce = optax.sigmoid_binary_cross_entropy(squeeze1(logits),
+                                                     labels[:, 0])
             return jnp.sum(bce * validf) / jnp.maximum(total_valid, 1.0)
 
         def auc_of(auc, probs, labels, valid):
@@ -206,8 +214,8 @@ class CTRTrainer:
                     lambda st, p, l: auc_accumulate(st, p, l, valid,
                                                     axis=axis),
                     in_axes=(0, 1, 1))(auc, probs, labels[:, :num_tasks])
-            return auc_accumulate(auc, probs, labels[:, 0], valid,
-                                  axis=axis)
+            return auc_accumulate(auc, squeeze1(probs), labels[:, 0],
+                                  valid, axis=axis)
 
         return loss_of, auc_of
 

@@ -99,13 +99,23 @@ def test_multitask_label_column_check(tmp_path):
 
 def test_single_task_plumbing_unchanged(tmp_path):
     """num_tasks=1 through the same stacked-AUC helpers must behave as
-    the classic single-task path: scalar-state AUC, no _task keys."""
+    the classic single-task path: scalar-state AUC, no _task keys — and
+    it must LEARN. (A [B,1]-vs-[B] broadcast in the single-task BCE
+    yields a finite loss while training a constant predictor, so the
+    learning assertion is the real guard.)"""
     tr, feed, p = _make(tmp_path, num_tasks=1)
-    ds = Dataset(feed, num_reader_threads=1)
-    ds.set_filelist([p])
-    ds.load_into_memory()
-    stats = tr.train_pass(ds)
+    stats = None
+    for _ in range(10):
+        ds = Dataset(feed, num_reader_threads=1)
+        ds.set_filelist([p])
+        ds.load_into_memory()
+        stats = tr.train_pass(ds)
     assert np.isfinite(stats["loss"])
     assert "auc" in stats and not any(k.endswith("_task0") for k in stats)
+    # The broadcast bug converges to a CONSTANT predictor, whose best
+    # possible logloss is the label entropy H(p~0.267) ~= 0.58 — beating
+    # it requires per-sample discrimination (auc must move too).
+    assert stats["loss"] < 0.575, stats["loss"]
+    assert stats["auc"] > 0.52, stats["auc"]
     # State is the plain (unstacked) AucState.
     assert tr.auc_state.table.ndim == 2

@@ -217,3 +217,29 @@ def test_tiered_store_shrink_decays_disk(tmp_path):
     evicted = ts.shrink(min_show=0.99)  # decay pushes show below 0.99
     assert evicted == 6
     assert ts.num_features == 0
+
+
+def test_tiered_rmw_preserves_disjoint_tiers(tmp_path):
+    """The review repro: pull-then-push RMW on keys the pull's budget
+    eviction spilled back to disk must NOT leave them in both tiers
+    (duplicate export keys, stale disk values, inflated counts)."""
+    cfg = TableConfig(name="emb", dim=4, optimizer="adagrad",
+                      learning_rate=0.1)
+    store = TieredFeatureStore(cfg, str(tmp_path), max_ram_features=2)
+    seed = np.arange(1, 5, dtype=np.uint64)
+    store.push_from_pass(seed, store.pull_for_pass(seed))
+    cold = store.rows_by_coldness()[:2] if hasattr(
+        store, "rows_by_coldness") else seed[:2]
+    keys = np.sort(np.asarray(cold, np.uint64))
+    vals = store.pull_for_pass(keys)          # may stage in + evict
+    vals["emb"] = vals["emb"] + 1.0
+    store.push_from_pass(keys, vals)          # RMW write-back
+    assert store.num_features == 4
+    out = store.save_xbox(str(tmp_path / "x"))
+    assert out == 4
+    from paddlebox_tpu.serving import load_xbox_model
+    k, e, w = load_xbox_model(str(tmp_path / "x"), table="emb")
+    assert np.array_equal(k, seed)            # unique, complete
+    # The updated values won (not a stale disk copy).
+    back = store.pull_for_pass(keys)
+    np.testing.assert_allclose(back["emb"], vals["emb"], atol=1e-6)

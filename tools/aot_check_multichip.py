@@ -137,7 +137,13 @@ def check_device_store_sharded(topo) -> None:
 
 
 def main() -> None:
-    topo = topologies.get_topology_desc("v5e:2x2x1", "tpu")
+    try:
+        topo = topologies.get_topology_desc("v5e:2x2x1", "tpu")
+    except Exception as e:  # noqa: BLE001 - any init failure means no AOT
+        # Sentinel for CI: environments without libtpu's AOT topology
+        # (matched by tests/test_aot_step.py to SKIP, not fail).
+        print(f"TPU-AOT-TOPOLOGY-UNAVAILABLE: {e!r}")
+        return
     check_gpt_hybrid(topo)
     check_ctr_dp4(topo)
     check_device_store_sharded(topo)

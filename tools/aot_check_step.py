@@ -100,7 +100,13 @@ def main() -> None:
     # Rebuild the step against a compile-only TPU device mesh and force
     # the Pallas scatter path (the "auto" gate keys off the default
     # backend, which is cpu here).
-    topo = topologies.get_topology_desc("v5e:2x2x1", "tpu")
+    try:
+        topo = topologies.get_topology_desc("v5e:2x2x1", "tpu")
+    except Exception as e:  # noqa: BLE001 - any init failure means no AOT
+        # Sentinel for CI: environments without libtpu's AOT topology
+        # (matched by tests/test_aot_step.py to SKIP, not fail).
+        print(f"TPU-AOT-TOPOLOGY-UNAVAILABLE: {e!r}")
+        return
     tr.mesh = Mesh(np.array([topo.devices[0]]), (tr.axis,))
     flagmod.set_flags({"sparse_scatter_kernel": "pallas"})
     step = tr._build_step()

@@ -89,7 +89,9 @@ def run_bench(name: str, timeout_s: int) -> dict:
     except ValueError:
         return {"metric": f"{name}_FAILED", "value": 0.0,
                 "error": f"unparseable output line: {line[:200]!r}"}
-    if out.get("platform") != "tpu":
+    if "error" not in out and out.get("platform") != "tpu":
+        # Never clobber an existing error (the watchdog's stalled-phase
+        # message is the diagnostic this recorder exists to capture).
         out["error"] = (f"ran on platform {out.get('platform')!r}, not "
                         f"tpu — not a recordable baseline")
     return out
