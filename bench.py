@@ -37,12 +37,20 @@ _SMALL = os.environ.get("PBX_BENCH_SCALE") == "small"
 # 2026-07-31: a device call blocked on the tunnel socket for 30+ min with
 # zero progress) — and a bench that hangs forever records NOTHING for the
 # round. A daemon thread watches a heartbeat that every phase/sync
-# advances; if nothing moves for PBX_BENCH_WATCHDOG_S (default 900) it
-# prints a parseable JSON line naming the stalled phase and hard-exits.
-# Started before the jax import: backend init itself can hang.
+# advances; if nothing moves for the limit it prints a parseable JSON
+# line naming the stalled phase and hard-exits. Two-tier limit: a DEAD
+# tunnel shows up in the very first device round-trip, so until one
+# _sync succeeds the limit is short (PBX_BENCH_WATCHDOG_EARLY_S, 240 —
+# a dead-tunnel run fails structured in <5 min); after the backend has
+# proven alive it relaxes (PBX_BENCH_WATCHDOG_S, 900) so a long mid-run
+# compile is not a false positive. The thread also emits a stderr
+# heartbeat every 30 s naming the current phase, so an externally killed
+# capture window still shows where the run was. Started before the jax
+# import: backend init itself can hang.
 # ---------------------------------------------------------------------------
 
-_WD = {"t": time.monotonic(), "phase": "import-jax"}
+_WD = {"t": time.monotonic(), "t0": time.monotonic(),
+       "phase": "import-jax", "device_alive": False}
 
 
 def _tick(phase: str) -> None:
@@ -51,10 +59,20 @@ def _tick(phase: str) -> None:
 
 
 def _watchdog_loop() -> None:
-    limit = float(os.environ.get("PBX_BENCH_WATCHDOG_S", "900"))
+    early = float(os.environ.get("PBX_BENCH_WATCHDOG_EARLY_S", "240"))
+    late = float(os.environ.get("PBX_BENCH_WATCHDOG_S", "900"))
+    last_hb = time.monotonic()
     while True:
-        time.sleep(15)
-        if time.monotonic() - _WD["t"] > limit:
+        time.sleep(5)
+        now = time.monotonic()
+        if now - last_hb >= 30:
+            last_hb = now
+            print(f"[bench hb] phase={_WD['phase']} "
+                  f"idle={now - _WD['t']:.0f}s "
+                  f"elapsed={now - _WD['t0']:.0f}s",
+                  file=sys.stderr, flush=True)
+        limit = late if _WD["device_alive"] else early
+        if now - _WD["t"] > limit:
             name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
             print(json.dumps({
                 "metric": f"{name}_FAILED",
@@ -85,6 +103,7 @@ def _sync(x) -> float:
     finishes, so timing loops MUST fetch a concrete value."""
     v = float(np.asarray(x).ravel()[0])
     _tick("sync")
+    _WD["device_alive"] = True  # backend proven: relax the watchdog tier
     return v
 
 
@@ -184,6 +203,26 @@ def _prepopulate_store(trainer, n_keys: int, chunk: int = 10_000_000) -> float:
     return n_keys / (time.perf_counter() - t0)
 
 
+def _bench_host_index(n_keys: int) -> float:
+    """Pure host-side pass-build throughput: fresh upsert of n_keys into
+    the native incremental index (SURVEY hard part #1 — PreBuildTask
+    role, ps_gpu_wrapper.cc:114). Separate from _prepopulate_store,
+    whose number includes on-device row init; this isolates the C++
+    index (hugepage open addressing + prefetch pipeline, store.cc)."""
+    from paddlebox_tpu.native.store_py import KeyIndex
+    rng = np.random.default_rng(7)
+    keys = rng.integers(1, 1 << 62, n_keys, dtype=np.uint64)
+    idx = KeyIndex()
+    idx.reserve(n_keys)
+    t0 = time.perf_counter()
+    for lo in range(0, n_keys, 10_000_000):
+        idx.upsert(keys[lo:lo + 10_000_000])
+        _tick(f"host_index:{lo}")
+    dt = time.perf_counter() - t0
+    idx.close()
+    return n_keys / dt
+
+
 def _gen_pass_files(tmpdir: str, rng, pass_keys: np.ndarray,
                     n_batches: int, *, batch: int = None,
                     n_slots: int = None, dense_dim: int = None,
@@ -253,6 +292,7 @@ def bench_deepfm() -> dict:
 
     rng = np.random.default_rng(0)
     build_keys_per_s = _prepopulate_store(trainer, STORE_KEYS)
+    host_index_keys_per_s = _bench_host_index(STORE_KEYS)
     pass_keys = rng.choice(np.arange(1, STORE_KEYS, dtype=np.uint64),
                            size=PASS_KEYS, replace=False)
 
@@ -355,6 +395,7 @@ def bench_deepfm() -> dict:
         "achieved_gflops_per_chip": round(
             per_chip * flops_per_sample / 1e9, 2),
         "store_build_keys_per_s": round(build_keys_per_s, 0),
+        "host_index_build_keys_per_s": round(host_index_keys_per_s, 0),
         "store_keys": STORE_KEYS,
         "pass_keys": PASS_KEYS,
         "auc": round(float(stats["auc"]), 5),
@@ -702,6 +743,13 @@ def _preflight_scatter_kernel(n: int, aw: int, pass_keys: int) -> None:
 
 def main() -> None:
     name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
+    # Liveness probe: one tiny device round-trip. A dead tunnel hangs
+    # HERE, inside the short early-watchdog tier, producing a structured
+    # failure in <5 min; once it answers, the watchdog relaxes so a long
+    # (legitimate) compile later in the run can't false-positive.
+    _tick("device-probe")
+    import jax.numpy as jnp
+    _sync(jnp.ones((8,), jnp.float32).sum())
     if name in ("deepfm", "wide_deep") and not _SMALL:
         # (updates/step, payload width, pass keys) of the selected CTR
         # config — aw = emb_dim + 4 ([g_emb | g_w | show | click |

@@ -16,7 +16,7 @@ finite check + growth/backoff, matching update_loss_scaling semantics.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,17 +46,36 @@ def bf16_policy() -> Policy:
     return Policy(compute_dtype=jnp.bfloat16)
 
 
+def _is_bn_node(d: Any) -> bool:
+    """True for a dict carrying the full batchnorm_init signature
+    (g/b/mean/var, nn/conv.py:37). Shared by the cast and the merge —
+    matching on mean+var names alone would misclassify an unrelated
+    param group that happens to use those names."""
+    return (isinstance(d, dict) and "mean" in d and "var" in d
+            and "g" in d and "b" in d)
+
+
 def cast_compute_except_stats(p: Any,
-                              stat_keys: Tuple[str, ...] = ("mean", "var")
+                              stat_keys: Optional[Tuple[str, ...]] = None
                               ) -> Any:
     """bf16 compute cast over a nested-dict param tree that leaves
     normalization running statistics f32 — casting them would
-    re-quantize the EMA every step and defeat an f32 master."""
+    re-quantize the EMA every step and defeat an f32 master.
+
+    With the default ``stat_keys=None``, mean/var are preserved only
+    inside a full BN node (same _is_bn_node contract as merge_bn_stats)
+    so an unrelated param that happens to be named mean/var still gets
+    cast. Passing an explicit tuple preserves exactly those keys in ANY
+    dict — the caller owns that contract (e.g. a custom stats node with
+    no g/b siblings)."""
+    bn_gated = stat_keys is None
+    keys = ("mean", "var") if bn_gated else stat_keys
+    preserve_here = (not bn_gated) or _is_bn_node(p)
     out = {}
     for k, v in p.items():
         if isinstance(v, dict):
             out[k] = cast_compute_except_stats(v, stat_keys)
-        elif k in stat_keys:
+        elif preserve_here and k in keys:
             out[k] = v
         else:
             out[k] = v.astype(jnp.bfloat16)
@@ -66,10 +85,10 @@ def cast_compute_except_stats(p: Any,
 def merge_bn_stats(master: Any, fresh: Any) -> Any:
     """Write a forward pass's BN running-stat updates back into the f32
     master tree (stats are state, not gradients — the optimizer sees
-    zero grads for them)."""
+    zero grads for them). BN nodes are identified by _is_bn_node."""
     out = {}
     for k, v in master.items():
-        if isinstance(v, dict) and "mean" in v and "var" in v:
+        if _is_bn_node(v):
             out[k] = {**v,
                       "mean": fresh[k]["mean"].astype(jnp.float32),
                       "var": fresh[k]["var"].astype(jnp.float32)}

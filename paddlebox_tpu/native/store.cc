@@ -27,6 +27,10 @@
 #include <thread>
 #include <vector>
 
+#ifdef __linux__
+#include <sys/mman.h>
+#endif
+
 namespace {
 
 static inline uint64_t mix64(uint64_t x) {
@@ -63,35 +67,78 @@ static void parallel_chunks(int64_t n, int nt, Fn fn) {
 // 16-byte slot (one cache line touch per probe, not two), and batch
 // operations software-prefetch a window of slots ahead — on this class
 // of host (single core, ~100ns memory) memory-level parallelism is the
-// only lever, worth ~5x on random probes.
+// only lever, worth ~5x on random probes. The slot array lives in an
+// anonymous mmap with MADV_HUGEPAGE: at production sizes (50M keys ->
+// 2 GiB of slots) random probes on 4 KiB pages page-walk on every
+// access, and 2 MiB pages measured 2.66 -> 7.0 M upserts/s on this
+// host (with the window at 32); vector/new allocations don't reliably
+// get THP-backed.
 struct Entry {
   uint64_t key;
   int64_t row;
 };
 
-constexpr int kPrefetchWindow = 16;
+constexpr int kPrefetchWindow = 32;
+
+// out_mmapped records which allocator produced the block — the free
+// path must match it exactly (munmap on a new[] fallback pointer would
+// be heap corruption; delete[] on an mmap would abort).
+static Entry* slots_alloc(size_t cap, bool* out_mmapped) {
+#ifdef __linux__
+  void* p = mmap(nullptr, cap * sizeof(Entry), PROT_READ | PROT_WRITE,
+                 MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (p != MAP_FAILED) {
+    madvise(p, cap * sizeof(Entry), MADV_HUGEPAGE);
+    *out_mmapped = true;
+    return static_cast<Entry*>(p);  // zero-filled: key 0 == empty
+  }
+#endif
+  *out_mmapped = false;
+  return new Entry[cap]();
+}
+
+static void slots_free(Entry* p, size_t cap, bool mmapped) {
+  if (p == nullptr) return;
+#ifdef __linux__
+  if (mmapped) {
+    munmap(p, cap * sizeof(Entry));
+    return;
+  }
+#endif
+  (void)cap;
+  delete[] p;
+}
 
 struct GrowMap {
-  std::vector<Entry> slots;
+  Entry* slots = nullptr;
+  size_t cap = 0;
+  bool slots_mmapped = false;
   std::vector<uint64_t> by_row;  // row -> key (append order)
   uint64_t mask = 0;
   int64_t used = 0;
 
   GrowMap() { rehash(1 << 16); }
+  ~GrowMap() { slots_free(slots, cap, slots_mmapped); }
 
-  void rehash(size_t cap) {
-    std::vector<Entry> old = std::move(slots);
-    slots.assign(cap, Entry{0, -1});
-    mask = cap - 1;
-    for (size_t i = 0; i + kPrefetchWindow < old.size(); ++i) {
-      __builtin_prefetch(
-          &slots[mix64(old[i + kPrefetchWindow].key) & mask], 1, 1);
-      if (old[i].key != 0) place(old[i].key, old[i].row);
-    }
-    for (size_t i = old.size() > kPrefetchWindow
-                        ? old.size() - kPrefetchWindow : 0;
-         i < old.size(); ++i) {
-      if (old[i].key != 0) place(old[i].key, old[i].row);
+  void rehash(size_t new_cap) {
+    Entry* old = slots;
+    size_t old_cap = cap;
+    bool old_mmapped = slots_mmapped;
+    slots = slots_alloc(new_cap, &slots_mmapped);
+    cap = new_cap;
+    mask = new_cap - 1;
+    if (old != nullptr) {
+      for (size_t i = 0; i + kPrefetchWindow < old_cap; ++i) {
+        __builtin_prefetch(
+            &slots[mix64(old[i + kPrefetchWindow].key) & mask], 1, 1);
+        if (old[i].key != 0) place(old[i].key, old[i].row);
+      }
+      for (size_t i = old_cap > kPrefetchWindow
+                          ? old_cap - kPrefetchWindow : 0;
+           i < old_cap; ++i) {
+        if (old[i].key != 0) place(old[i].key, old[i].row);
+      }
+      slots_free(old, old_cap, old_mmapped);
     }
   }
 
@@ -110,22 +157,9 @@ struct GrowMap {
     }
   }
 
-  // Find-or-insert; returns assigned row. Caller pre-sizes (bulk path).
-  inline int64_t upsert(uint64_t k) {
-    if (static_cast<uint64_t>(used) * 2 >= mask + 1) rehash((mask + 1) * 2);
-    uint64_t i = mix64(k) & mask;
-    while (true) {
-      if (slots[i].key == k) return slots[i].row;
-      if (slots[i].key == 0) {
-        int64_t r = static_cast<int64_t>(by_row.size());
-        slots[i] = Entry{k, r};
-        by_row.push_back(k);
-        ++used;
-        return r;
-      }
-      i = (i + 1) & mask;
-    }
-  }
+  // (Find-or-insert lives ONLY in pbx_index_upsert's inlined batch loop
+  // — a per-element member with its own growth check would be a second
+  // diverging copy of the probe logic.)
 
   inline void prefetch(uint64_t k, int write) const {
     __builtin_prefetch(&slots[mix64(k) & mask], write, 1);
@@ -189,12 +223,42 @@ int64_t pbx_index_upsert(void* h, const uint64_t* keys, int64_t n,
   }
   m->by_row.reserve(m->by_row.size() + n);
   int64_t before = static_cast<int64_t>(m->by_row.size());
+  // Hot loop: the pre-size above guarantees no rehash can fire inside
+  // this batch, so probe inline WITHOUT the per-element growth check —
+  // keeping the loop body small enough to stay inlined preserves the
+  // prefetch pipeline (measured ~1.8x on the 50M fresh build vs calling
+  // the checking member function per element).
+  Entry* slots = m->slots;
+  const uint64_t mask = m->mask;
+  auto& by_row = m->by_row;
   for (int64_t i = 0; i < n; ++i) {
     if (i + kPrefetchWindow < n && keys[i + kPrefetchWindow])
-      m->prefetch(keys[i + kPrefetchWindow], 1);
-    out_rows[i] = (keys[i] == 0) ? -1 : m->upsert(keys[i]);
+      __builtin_prefetch(&slots[mix64(keys[i + kPrefetchWindow]) & mask],
+                         1, 1);
+    uint64_t k = keys[i];
+    if (k == 0) {
+      out_rows[i] = -1;
+      continue;
+    }
+    uint64_t j = mix64(k) & mask;
+    while (true) {
+      if (slots[j].key == k) {
+        out_rows[i] = slots[j].row;
+        break;
+      }
+      if (slots[j].key == 0) {
+        int64_t r = static_cast<int64_t>(by_row.size());
+        slots[j] = Entry{k, r};
+        by_row.push_back(k);
+        out_rows[i] = r;
+        break;
+      }
+      j = (j + 1) & mask;
+    }
   }
-  return static_cast<int64_t>(m->by_row.size()) - before;
+  int64_t n_new = static_cast<int64_t>(m->by_row.size()) - before;
+  m->used += n_new;
+  return n_new;
 }
 
 // Dump keys in row order into out[size].

@@ -101,15 +101,20 @@ def data_norm_apply(stats: Dict[str, jax.Array], x: jax.Array, *,
         y = y * stats["scale_w"] + stats["bias"]
 
     valid = None
-    if slot_dim > 0 and not enable_ss:
+    if slot_dim > 0:
         if c % slot_dim:
             raise ValueError(f"C={c} not divisible by slot_dim={slot_dim}")
         # Chunk k covers channels [k*slot_dim, (k+1)*slot_dim); its show
-        # count sits at the chunk's first channel.
+        # count sits at the chunk's first channel. The mask drives the
+        # stats update REGARDLESS of scale/shift (data_norm_op.cc:686
+        # applies the show-skip to the stat deltas unconditionally);
+        # only the output zeroing is the not-enable_ss behavior
+        # (data_norm_op.cc:341-357).
         show = xf[:, ::slot_dim]                       # [N, C/slot_dim]
         alive = jnp.abs(show) >= _MIN_PRECISION       # [N, C/slot_dim]
         valid = jnp.repeat(alive, slot_dim, axis=1)   # [N, C]
-        y = jnp.where(valid, y, 0.0)
+        if not enable_ss:
+            y = jnp.where(valid, y, 0.0)
     y = y.astype(x.dtype)
 
     if not train:

@@ -73,9 +73,14 @@ class TrainerConfig:
     data_norm_decay: float = 0.9999999
     # Global-norm clip on the dense gradients before the optimizer
     # (role of paddle.nn.ClipGradByGlobalNorm in fleet configs);
-    # 0 disables. Applied AFTER the cross-replica psum in "step" mode —
-    # the clip must see the true global gradient, as the reference's
-    # post-allreduce clip does.
+    # 0 disables. In "step" mode it is applied AFTER the cross-replica
+    # psum — the clip sees the true global gradient, as the reference's
+    # post-allreduce clip does. In "kstep" (local-SGD) mode the clip is
+    # deliberately PER-REPLICA: between syncs each worker owns a local
+    # trajectory (grads are the ndev-scaled local estimate, optimizer
+    # state worker-local), so the clip bounds that local step; replicas
+    # may make different clip decisions until the next param average —
+    # accepted local-SGD semantics, not the "step"-mode global clip.
     grad_clip_norm: float = 0.0
 
 
