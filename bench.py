@@ -142,6 +142,18 @@ def _vs(metric: str, value: float):
     return round(value / base, 4) if base else None
 
 
+# Per-chip peak for MFU accounting: TPU v5e bf16 = 197 TFLOP/s
+# (PALLAS_AXON_TPU_GEN=v5e on this rig). Override for other parts.
+PEAK_FLOPS = float(os.environ.get("PBX_TPU_PEAK_FLOPS", 197e12))
+
+
+def _mfu(model_flops_per_s: float) -> float:
+    """Model-FLOPs utilization vs the bf16 peak — the
+    analytically-required FLOPs (not hardware-counter FLOPs), so remat
+    recompute does not inflate it."""
+    return round(model_flops_per_s / PEAK_FLOPS, 4)
+
+
 # ---------------------------------------------------------------------------
 # DeepFM CTR end-to-end (BASELINE.md config 4; the driver's default metric)
 # ---------------------------------------------------------------------------
@@ -205,39 +217,56 @@ def _prepopulate_store(trainer, n_keys: int, chunk: int = 10_000_000) -> float:
 
 def _bench_host_index(n_keys: int) -> float:
     """Pure host-side pass-build throughput: fresh upsert of n_keys into
-    the native incremental index (SURVEY hard part #1 — PreBuildTask
-    role, ps_gpu_wrapper.cc:114). Separate from _prepopulate_store,
-    whose number includes on-device row init; this isolates the C++
-    index (hugepage open addressing + prefetch pipeline, store.cc)."""
-    from paddlebox_tpu.native.store_py import KeyIndex
-    rng = np.random.default_rng(7)
-    keys = rng.integers(1, 1 << 62, n_keys, dtype=np.uint64)
-    idx = KeyIndex()
-    idx.reserve(n_keys)
-    t0 = time.perf_counter()
-    for lo in range(0, n_keys, 10_000_000):
-        idx.upsert(keys[lo:lo + 10_000_000])
-        _tick(f"host_index:{lo}")
-    dt = time.perf_counter() - t0
-    idx.close()
-    return n_keys / dt
+    the native incremental index. Separate from _prepopulate_store,
+    whose number includes on-device row init; the measurement itself is
+    the SHARED bench_index_build (one methodology with
+    tools/bench_native_store.py)."""
+    from paddlebox_tpu.native.store_py import bench_index_build
+    return bench_index_build(n_keys,
+                             tick=lambda lo: _tick(f"host_index:{lo}"))
+
+
+def _planted_labels(rng, hot_ids: np.ndarray, *, target_rate: float = 0.25,
+                    strength: float = 2.0) -> np.ndarray:
+    """Labels from a PLANTED sparse signal: each hot key carries a latent
+    ±1 weight (a hash of the key), the sample logit is that weight scaled
+    by ``strength`` plus the base-rate offset, and labels are Bernoulli
+    in that logit. A learner that recovers per-key weights (exactly what
+    the sparse w/embedding path trains) must pull AUC well above 0.5
+    within a pass — random labels would mask sign/aliasing bugs that
+    parity tests can't see (an embedding served to the wrong row still
+    produces 0.5 AUC on random labels, never on planted ones). Role of
+    the AUC discipline around metrics.cc:286-355."""
+    h = (hot_ids * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(32)
+    sign = (h & np.uint64(1)).astype(np.float32) * 2.0 - 1.0   # ±1 per key
+    logit = sign * strength + np.log(target_rate / (1.0 - target_rate))
+    p = 1.0 / (1.0 + np.exp(-logit))
+    return (rng.random(hot_ids.shape[0]) < p).astype(np.int32)
 
 
 def _gen_pass_files(tmpdir: str, rng, pass_keys: np.ndarray,
                     n_batches: int, *, batch: int = None,
                     n_slots: int = None, dense_dim: int = None,
-                    label_rate: float = 0.25) -> list:
+                    label_rate: float = 0.25,
+                    planted_hot: int = 1000) -> list:
     """Write n_batches*batch svm-format lines across part files (one per
-    batch) — ids drawn from the pass working set, optional dense block.
+    batch). Slot 0 draws from a HOT head of ``planted_hot`` keys (the
+    Zipf head every real CTR stream has — each hot key repeats
+    batch*n_batches/planted_hot times, enough for the in-pass optimizer
+    to recover its planted weight); the label carries that key's planted
+    signal (_planted_labels). Remaining slots draw uniformly from the
+    full working set — the cold tail that sizes the store/pass machinery.
     Vectorized string assembly (np.char): a per-line Python loop takes
     minutes at 1M+ lines on one core."""
     batch = BATCH if batch is None else batch
     n_slots = NUM_SLOTS if n_slots is None else n_slots
     dense_dim = DENSE_DIM if dense_dim is None else dense_dim
+    hot = pass_keys[:min(planted_hot, pass_keys.size)]
     files = []
     for b in range(n_batches):
         ids = rng.choice(pass_keys, (batch, n_slots))
-        labels = (rng.random(batch) < label_rate).astype(np.int32)
+        ids[:, 0] = rng.choice(hot, batch)
+        labels = _planted_labels(rng, ids[:, 0], target_rate=label_rate)
         line = labels.astype("U1")
         for j in range(n_slots):
             line = np.char.add(line, f" s{j}:")
@@ -399,8 +428,23 @@ def bench_deepfm() -> dict:
         "store_keys": STORE_KEYS,
         "pass_keys": PASS_KEYS,
         "auc": round(float(stats["auc"]), 5),
+        "auc_floor": _auc_floor(stats["auc"]),
         "n_devices": ndev,
     }
+
+
+def _auc_floor(auc: float, floor: float = 0.7):
+    """Learning proof on the planted-signal labels: a full-scale pass
+    must pull AUC past the floor; below it the sparse path is broken
+    (sign/aliasing/routing), and the record says so. Small smoke runs
+    see each key ~once — the floor doesn't apply."""
+    if _SMALL:
+        return None
+    ok = float(auc) > floor
+    if not ok:
+        print(f"[bench] AUC {auc:.4f} <= {floor} on planted-signal "
+              f"labels — sparse path is NOT learning", file=sys.stderr)
+    return {"floor": floor, "passed": ok}
 
 
 # ---------------------------------------------------------------------------
@@ -452,12 +496,16 @@ def bench_resnet50() -> dict:
     _sync(loss)
     dt = time.perf_counter() - t0
     ips = n * bs / dt
+    # ResNet-50 @224: ~4.09 GFLOP forward/image (standard conv+fc
+    # multiply-add count x2); train step ~3x forward (bwd ~2x fwd).
+    flops_per_image = 3 * 4.09e9
     return {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(ips, 1),
         "unit": "images/s/chip",
         "vs_baseline": _vs("resnet50", ips),
         "batch_size": bs,
+        "achieved_mfu": _mfu(ips * flops_per_image),
     }
 
 
@@ -520,6 +568,8 @@ def bench_bert_dp() -> dict:
     _sync(loss)
     dt = time.perf_counter() - t0
     tps = n * bs * seq / dt
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
     return {
         "metric": "bert_base_dp_tokens_per_sec",
         "value": round(tps, 1),
@@ -528,6 +578,9 @@ def bench_bert_dp() -> dict:
         "n_devices": ndev,
         "batch_size": bs,
         "seq_len": seq,
+        "n_params": n_params,
+        # 6ND estimate over ALL chips -> divide by ndev for per-chip MFU.
+        "achieved_mfu": _mfu(6.0 * n_params * tps / ndev),
     }
 
 
@@ -585,6 +638,7 @@ def bench_gpt() -> dict:
         "n_devices": ndev,
         "n_params": n_params,
         "achieved_tflops": round(flops / 1e12, 2),
+        "achieved_mfu": _mfu(flops / ndev),
     }
 
 
@@ -681,6 +735,7 @@ def bench_wide_deep() -> dict:
         "vs_baseline": _vs("wide_deep", per_chip),
         "store_build_keys_per_s": round(build_keys_per_s, 0),
         "auc": round(float(stats["auc"]), 5),
+        "auc_floor": _auc_floor(stats["auc"]),
         "n_devices": ndev,
     }
 

@@ -214,6 +214,12 @@ define_flag("xbox_quant_bits", 0,
             "(role of the reference's quantized pull values, "
             "fused_seqpool_cvm_op.cu:247 quant_ratio — applied at the "
             "export boundary; w and the serving math stay float)")
+define_flag("flash_block_q", 512,
+            "flash-attention q-tile rows (Pallas kernel); tuned per "
+            "hardware by tools/tune_flash_blocks.py — override via "
+            "FLAGS_flash_block_q without touching call sites")
+define_flag("flash_block_k", 512,
+            "flash-attention k-tile columns (see flash_block_q)")
 define_flag("sparse_scatter_kernel", "auto",
             "push-side scatter-accumulate backend: 'auto' (Pallas sorted "
             "kernel on TPU, XLA scatter elsewhere), 'pallas', 'interpret' "

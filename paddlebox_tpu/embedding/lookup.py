@@ -225,12 +225,23 @@ def apply_accumulated(vals: jax.Array, acc: jax.Array, *, dim: int,
 
 def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
                grad_w: jax.Array, shows: jax.Array, clicks: jax.Array, *,
-               axis: str, opt: Optional[SparseOptimizer] = None) -> PassTable:
+               axis: str, opt: Optional[SparseOptimizer] = None,
+               dcn_axis: Optional[str] = None) -> PassTable:
     """Per-device push: scatter-accumulate + dense fused optimizer sweep.
 
     dev_rows [n]; grad_emb [n, D]; grad_w/shows/clicks [n]. Padding entries
     must carry zero grads (guaranteed upstream because padding ids map to
     the discard segment) — they land in the trash row regardless.
+
+    ``dcn_axis`` (multi-slice): the pass table is sharded over ``axis``
+    INSIDE each slice and replicated across slices, so the bucketed
+    all_to_all stays on ICI; the per-shard grad accumulator is then
+    psum'd once over the slice axis — the single DCN stage — before the
+    optimizer sweep, so every slice applies the identical global update
+    and replicas stay bit-equal (role of gather_multi_node_grad's
+    inter-node allreduce of node-merged grads, ``heter_comm.h:156-172``,
+    landed on the dense accumulator instead of a sorted key list because
+    the accumulator has the same static shape on every slice).
     """
     if opt is None:
         opt = SparseAdagrad()
@@ -256,6 +267,8 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
 
     if num_shards == 1:
         acc = _accumulate(dev_rows, payload, block)
+        if dcn_axis is not None:
+            acc = lax.psum(acc, dcn_axis)
         new_vals = apply_accumulated(table.vals, acc, dim=d, ke=ke,
                                      block=block, opt=opt)
         return PassTable(vals=new_vals, rows_per_shard=table.rows_per_shard,
@@ -280,6 +293,10 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
     # Owner-side accumulate (role of dynamic_merge_grad): filler cells
     # point at the trash row with all-zero payload, so they are no-ops.
     acc = _accumulate(recv_rows, recv_payload, block)
+    if dcn_axis is not None:
+        # The one DCN stage: combine each shard's slice-local grad sums
+        # across slices (table replicas) before the optimizer applies.
+        acc = lax.psum(acc, dcn_axis)
     new_vals = apply_accumulated(table.vals, acc, dim=d, ke=ke,
                                  block=block, opt=opt)
     return PassTable(vals=new_vals, rows_per_shard=table.rows_per_shard,

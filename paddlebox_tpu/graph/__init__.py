@@ -3,14 +3,20 @@ GpuPsGraphTable + samplers + GraphGpuWrapper + GraphDataGenerator)."""
 
 from paddlebox_tpu.graph.table import (CSRGraph, DeviceGraph, GraphTable,
                                        build_csr, load_edge_file)
-from paddlebox_tpu.graph.sampler import (device_arrays, negative_samples,
+from paddlebox_tpu.graph.sampler import (degree_neg_cdf, device_arrays,
+                                         gather_node_feats, metapath_walk,
+                                         negative_samples,
+                                         negative_samples_by_degree,
                                          random_walk, sample_neighbors,
-                                         skip_gram_pairs)
+                                         skip_gram_pairs,
+                                         stack_device_graphs)
 from paddlebox_tpu.graph.data_generator import (GraphDataGenerator,
                                                 GraphGenConfig)
 
 __all__ = [
     "CSRGraph", "DeviceGraph", "GraphTable", "build_csr", "load_edge_file",
-    "device_arrays", "negative_samples", "random_walk", "sample_neighbors",
-    "skip_gram_pairs", "GraphDataGenerator", "GraphGenConfig",
+    "degree_neg_cdf", "device_arrays", "gather_node_feats",
+    "metapath_walk", "negative_samples", "negative_samples_by_degree",
+    "random_walk", "sample_neighbors", "skip_gram_pairs",
+    "stack_device_graphs", "GraphDataGenerator", "GraphGenConfig",
 ]

@@ -28,13 +28,23 @@ from paddlebox_tpu.graph.table import DeviceGraph, GraphTable
 class GraphGenConfig:
     """Knobs mirroring the reference's graph_config fields in
     DataFeedDesc (``data_feed.proto`` graph_config: walk_len, walk_degree,
-    window, batch_size, samples)."""
+    window, batch_size, samples, meta_path).
+
+    ``metapath``: when set (a tuple of edge-type names) walks alternate
+    edge types per hop, cycling the tuple to ``walk_len`` hops (the
+    reference's meta_path config). ``degree_negatives``: draw negatives
+    ∝ degree^0.75 instead of uniform. ``feat_name``: attach each batch's
+    center-node feature rows (device gather from the table's feature
+    column — the node-feature-pulling half of the graph engine)."""
 
     walk_len: int = 8
     window: int = 3
     num_neg: int = 4
     batch_walks: int = 64       # start nodes per generated chunk
     seed: int = 0
+    metapath: Optional[tuple] = None
+    degree_negatives: bool = False
+    feat_name: Optional[str] = None
 
 
 class GraphDataGenerator:
@@ -48,6 +58,18 @@ class GraphDataGenerator:
         g = table.device_graph(edge_type, max_degree)
         self._nbrs, self._deg = sampler.device_arrays(g)
         self._num_nodes = g.nbrs.shape[0]
+        self._type_seq = None
+        if config.metapath:
+            views = [table.device_graph(et, max_degree)
+                     for et in config.metapath]
+            self._mp_nbrs, self._mp_deg = sampler.stack_device_graphs(views)
+            self._type_seq = tuple(
+                i % len(config.metapath) for i in range(config.walk_len))
+        self._neg_cdf = None
+        if config.degree_negatives:
+            self._neg_cdf = sampler.degree_neg_cdf(g.degree)
+        self._feats = (table.device_feats(config.feat_name)
+                       if config.feat_name else None)
         self._rng = np.random.default_rng(config.seed)
         self._key = jax.random.PRNGKey(config.seed)
 
@@ -67,17 +89,33 @@ class GraphDataGenerator:
                     pad = self._rng.choice(starts, cfg.batch_walks
                                            - len(chunk))
                     chunk = np.concatenate([chunk, pad])
-                walks = sampler.random_walk(
-                    self._nbrs, self._deg, jnp.asarray(chunk, jnp.int32),
-                    self._next_key(), cfg.walk_len)
+                if self._type_seq is not None:
+                    walks = sampler.metapath_walk(
+                        self._mp_nbrs, self._mp_deg,
+                        jnp.asarray(chunk, jnp.int32), self._next_key(),
+                        self._type_seq)
+                else:
+                    walks = sampler.random_walk(
+                        self._nbrs, self._deg,
+                        jnp.asarray(chunk, jnp.int32),
+                        self._next_key(), cfg.walk_len)
                 pairs = sampler.skip_gram_pairs(walks, cfg.window)
-                negs = sampler.negative_samples(
-                    self._next_key(), pairs.shape[0], cfg.num_neg,
-                    self._num_nodes)
-                yield {
+                if self._neg_cdf is not None:
+                    negs = sampler.negative_samples_by_degree(
+                        self._next_key(), self._neg_cdf,
+                        int(pairs.shape[0]), cfg.num_neg)
+                else:
+                    negs = sampler.negative_samples(
+                        self._next_key(), pairs.shape[0], cfg.num_neg,
+                        self._num_nodes)
+                out = {
                     "centers": pairs[:, 0],
                     "contexts": pairs[:, 1],
                     "negatives": negs,
                     # boundary-crossing pairs were emitted as self-pairs
                     "mask": (pairs[:, 0] != pairs[:, 1]),
                 }
+                if self._feats is not None:
+                    out["center_feats"] = sampler.gather_node_feats(
+                        self._feats, pairs[:, 0])
+                yield out

@@ -84,3 +84,80 @@ def negative_samples(key: jax.Array, num_pairs: int, num_neg: int,
     reference's graph trainer)."""
     return jax.random.randint(key, (num_pairs, num_neg), 0, num_nodes,
                               dtype=jnp.int32)
+
+
+def stack_device_graphs(graphs) -> Tuple[jax.Array, jax.Array]:
+    """Stack per-edge-type padded views into [T, N, Dmax] / [T, N] device
+    arrays for metapath sampling. Types may have different max_degree —
+    narrower ones pad with self-loops (their degree vector already stops
+    the sampler from reading the padding). All types must share the node
+    id space (same N), as the reference's typed graphs do
+    (graph_gpu_wrapper.h:25 — one node space, per-type adjacency)."""
+    n = {g.nbrs.shape[0] for g in graphs}
+    if len(n) != 1:
+        raise ValueError(f"edge types disagree on node count: {n}")
+    dmax = max(g.max_degree for g in graphs)
+    nbrs, degs = [], []
+    for g in graphs:
+        pad = dmax - g.nbrs.shape[1]
+        a = g.nbrs
+        if pad:
+            self_col = np.arange(a.shape[0], dtype=a.dtype)[:, None]
+            a = np.concatenate([a, np.repeat(self_col, pad, axis=1)],
+                               axis=1)
+        nbrs.append(a)
+        degs.append(g.degree)
+    return jnp.asarray(np.stack(nbrs)), jnp.asarray(np.stack(degs))
+
+
+@functools.partial(jax.jit, static_argnames=("type_seq",))
+def metapath_walk(nbrs_stack: jax.Array, degree_stack: jax.Array,
+                  starts: jax.Array, key: jax.Array,
+                  type_seq: Tuple[int, ...]) -> jax.Array:
+    """[B] starts → [B, len(type_seq)+1] walks where hop h samples from
+    edge type ``type_seq[h]`` (role of the reference's meta-path walks —
+    graph_gpu_wrapper.h:25 get_sage_keys/metapath config over typed
+    adjacency, e.g. user→item→user): one lax.scan whose per-step gather
+    indexes the stacked [T, N, D] adjacency by the hop's type id.
+    Dead-end nodes (degree 0 in the hop's type) stay in place via the
+    self-loop padding."""
+    ts = jnp.asarray(type_seq, jnp.int32)
+    keys = jax.random.split(key, len(type_seq))
+
+    def step(cur, inp):
+        t, k = inp
+        deg = jnp.maximum(degree_stack[t, cur], 1)            # [B]
+        r = jax.random.randint(k, cur.shape, 0, 1 << 30)
+        idx = (r % deg).astype(jnp.int32)
+        nxt = nbrs_stack[t, cur, idx]
+        return nxt, nxt
+
+    _, path = jax.lax.scan(step, starts, (ts, keys))
+    return jnp.concatenate([starts[:, None], path.T], axis=1)
+
+
+def degree_neg_cdf(degree: np.ndarray, power: float = 0.75) -> jax.Array:
+    """Cumulative sampling table for degree-aware negatives: node i drawn
+    ∝ degree_i^power (the word2vec unigram^0.75 discipline; role of the
+    reference's degree-weighted negative table). Isolated nodes get a
+    unit weight so every id stays reachable."""
+    w = np.maximum(np.asarray(degree, np.float64), 1.0) ** power
+    cdf = np.cumsum(w)
+    return jnp.asarray((cdf / cdf[-1]).astype(np.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("num_pairs", "num_neg"))
+def negative_samples_by_degree(key: jax.Array, cdf: jax.Array,
+                               num_pairs: int, num_neg: int) -> jax.Array:
+    """[P, num_neg] negatives drawn from the degree-weighted table —
+    searchsorted on the cdf (one fused gather-free op on TPU)."""
+    u = jax.random.uniform(key, (num_pairs, num_neg))
+    return jnp.searchsorted(cdf, u).astype(jnp.int32)
+
+
+def gather_node_feats(feats: jax.Array, nodes: jax.Array) -> jax.Array:
+    """Device-side node-feature pull: [B, ...] rows for [B] node ids
+    (role of the feature half of the graph PS — get_node_feat in
+    graph_gpu_wrapper.h / common_graph_table.h feature columns — once
+    the feature table is device-resident)."""
+    return feats[nodes]

@@ -366,13 +366,23 @@ class GraphClient:
         """[n, length+1] walks via per-hop fan-out sampling (each hop's
         frontier may live on any shard — the client re-shards per hop,
         role of the graph client driving multi-hop sampling)."""
+        return self.metapath_walk([edge_type] * length, starts, seed=seed)
+
+    def metapath_walk(self, edge_types: Sequence[str], starts: np.ndarray,
+                      *, seed: int = 0) -> np.ndarray:
+        """[n, len(edge_types)+1] walks where hop h samples from
+        ``edge_types[h]`` (role of the reference's meta-path walks over
+        typed adjacency — graph_gpu_wrapper.h:25 metapath config, e.g.
+        user2item → item2user): per hop the frontier re-shards by owner
+        and the hop's edge type routes the sample. Deterministic per
+        (seed, node, hop) exactly like single-type walks — shard-layout
+        invariant. Dead ends stay in place."""
         starts = np.asarray(starts, np.int64)
-        walk = np.empty((starts.shape[0], length + 1), np.int64)
+        walk = np.empty((starts.shape[0], len(edge_types) + 1), np.int64)
         walk[:, 0] = starts
         cur = starts
-        for h in range(length):
-            nxt = self.sample_neighbors(edge_type, cur, 1,
-                                        seed=seed + 1 + h)[:, 0]
+        for h, et in enumerate(edge_types):
+            nxt = self.sample_neighbors(et, cur, 1, seed=seed + 1 + h)[:, 0]
             # Dead ends stay in place (same convention as the device
             # sampler's isolated-node handling).
             nxt = np.where(nxt < 0, cur, nxt)

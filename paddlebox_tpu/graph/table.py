@@ -154,6 +154,7 @@ class GraphTable:
         self._graphs: Dict[str, CSRGraph] = {}
         self._device: Dict[str, DeviceGraph] = {}
         self._feats: Dict[str, np.ndarray] = {}
+        self._node_types: Optional[np.ndarray] = None
 
     def add_edges(self, edge_type: str, src: np.ndarray, dst: np.ndarray,
                   *, num_nodes: Optional[int] = None,
@@ -190,6 +191,38 @@ class GraphTable:
 
     def get_node_feat(self, name: str, nodes: np.ndarray) -> np.ndarray:
         return self._feats[name][np.asarray(nodes, np.int64)]
+
+    def device_feats(self, name: str):
+        """Device-resident feature column for jitted gathers
+        (sampler.gather_node_feats)."""
+        import jax.numpy as jnp
+        return jnp.asarray(self._feats[name])
+
+    # -- node types (role of load_node_file's typed node sets — metapath
+    # walks start from a typed frontier, graph_gpu_wrapper.h:25) --------
+
+    def set_node_types(self, types: np.ndarray) -> None:
+        """types[i] = integer type id of node i."""
+        self._node_types = np.asarray(types, np.int32)
+
+    def nodes_of_type(self, t: int) -> np.ndarray:
+        if self._node_types is None:
+            raise RuntimeError("no node types loaded — call "
+                               "set_node_types/load_node_file first")
+        return np.flatnonzero(self._node_types == t).astype(np.int64)
+
+    def load_node_file(self, path: str, type_ids: Dict[str, int],
+                       num_nodes: int) -> np.ndarray:
+        """Parse a '<type_name> <node_id>'-per-line node file (role of
+        GraphGpuWrapper::load_node_file). Unlisted nodes get type -1."""
+        types = np.full(num_nodes, -1, np.int32)
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    types[int(parts[1])] = type_ids[parts[0]]
+        self.set_node_types(types)
+        return types
 
     def shard_of(self, nodes: np.ndarray) -> np.ndarray:
         return (np.asarray(nodes, np.int64) % self.num_shards)

@@ -170,12 +170,23 @@ def _block(p, x, cfg: GPTConfig, heads_local: int):
     return (x + y).astype(in_dtype)
 
 
+def _data_axes(mesh: Mesh) -> tuple:
+    """Batch-dim axes: ("slice", "dp") on a multi-slice mesh (batch
+    splits across DCN slices too; XLA decomposes the loss/grad psums
+    hierarchically over the physical topology), else ("dp",)."""
+    if "slice" in mesh.axis_names and int(mesh.shape["slice"]) > 1:
+        return ("slice", "dp")
+    return ("dp",)
+
+
 def gpt_loss_fn(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
                 num_microbatches: int = 1):
     """Builds loss(params, tokens, targets) -> scalar, shard_mapped over
-    the hybrid mesh. tokens/targets [B, S] int32; B sharded over dp,
-    S over sp."""
+    the hybrid mesh. tokens/targets [B, S] int32; B sharded over the data
+    axes (dp, plus the DCN slice axis on multi-slice meshes), S over sp."""
     heads_local = cfg.n_heads // int(mesh.shape["mp"])
+    daxes = _data_axes(mesh)
+    raxes = daxes + ("sp",)
 
     def stage_fn(stage_params, x):
         # stage_params leaves [layers_per_stage, ...]; scan over layers.
@@ -207,12 +218,12 @@ def gpt_loss_fn(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
                                preferred_element_type=jnp.float32)
         losses = tplib.parallel_cross_entropy(logits_local, targets,
                                               axis="mp")
-        # Global mean over all tokens (dp × sp shards).
-        total = lax.psum(jnp.sum(losses), ("dp", "sp"))
-        count = lax.psum(jnp.asarray(losses.size, jnp.float32), ("dp", "sp"))
+        # Global mean over all tokens (replica × sp shards).
+        total = lax.psum(jnp.sum(losses), raxes)
+        count = lax.psum(jnp.asarray(losses.size, jnp.float32), raxes)
         return total / count
 
-    in_specs = (specs, P("dp", "sp"), P("dp", "sp"))
+    in_specs = (specs, P(daxes, "sp"), P(daxes, "sp"))
     return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
                          check_vma=False)
 
@@ -235,6 +246,8 @@ def gpt_value_and_grad_1f1b(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
         return out
 
     mp_n = int(mesh.shape["mp"])
+    daxes = _data_axes(mesh)
+    raxes = daxes + ("sp",)
 
     def loss_head(lp, y, tgt):
         h = _ln(y, lp["lnf_g"], lp["lnf_b"])
@@ -300,12 +313,12 @@ def gpt_value_and_grad_1f1b(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
             axes = [a for a in ("pp", "mp") if a not in sharded]
             if axes:
                 g = lax.psum(g, tuple(axes))
-            return lax.pmean(g, ("dp", "sp"))
+            return lax.pmean(g, raxes)
 
         grads = jax.tree.map(reduce_leaf, grads, specs)
-        return lax.pmean(loss * mp_n, ("dp", "sp")), grads
+        return lax.pmean(loss * mp_n, raxes), grads
 
-    in_specs = (specs, P("dp", "sp"), P("dp", "sp"))
+    in_specs = (specs, P(daxes, "sp"), P(daxes, "sp"))
     return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                          out_specs=(P(), specs), check_vma=False)
 

@@ -130,6 +130,30 @@ class KeyIndex:
             pass
 
 
+def bench_index_build(n_keys: int, *, chunk: int = 10_000_000,
+                      seed: int = 7, tick=None) -> float:
+    """ONE definition of the 'host pass-build' metric (SURVEY hard part
+    #1 — PreBuildTask role, ps_gpu_wrapper.cc:114): fresh upsert of
+    n_keys uniform-random keys into a pre-sized KeyIndex, chunked like a
+    production bulk build. Returns keys/s. Shared by bench.py
+    (host_index_build_keys_per_s) and tools/bench_native_store.py so the
+    two recorded numbers can never drift in methodology. ``tick`` is an
+    optional per-chunk progress callback (the bench watchdog)."""
+    import time as _time
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(1, 1 << 62, n_keys, dtype=np.uint64)
+    idx = KeyIndex()
+    idx.reserve(n_keys)
+    t0 = _time.perf_counter()
+    for lo in range(0, n_keys, chunk):
+        idx.upsert(keys[lo:lo + chunk])
+        if tick is not None:
+            tick(lo)
+    dt = _time.perf_counter() - t0
+    idx.close()
+    return n_keys / dt
+
+
 def ss_locate(sorted_keys: np.ndarray, queries: np.ndarray
               ) -> Tuple[np.ndarray, np.ndarray]:
     """(found mask [m] bool, clipped positions [m] int64) of queries in the

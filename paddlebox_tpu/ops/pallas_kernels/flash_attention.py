@@ -393,15 +393,23 @@ def flash_attention_reference(q, k, v, *, causal: bool = False,
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None, q_offset=0, k_offset=0,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     use_pallas: Optional[bool] = None,
                     interpret: bool = False) -> jax.Array:
     """Flash attention over [B, S, H, D] tensors (differentiable).
 
     ``use_pallas=None`` auto-selects: the Pallas kernel on TPU backends,
     the XLA reference elsewhere (``interpret=True`` forces the kernel in
-    interpreter mode — for tests).
+    interpreter mode — for tests). ``block_q``/``block_k`` default to
+    the ``flash_block_{q,k}`` flags (tuned per hardware by
+    tools/tune_flash_blocks.py) so every call site picks up the tuned
+    tiles without plumbing.
     """
+    if block_q is None or block_k is None:
+        from paddlebox_tpu.core import flags as _flags
+        block_q = int(block_q or _flags.flag("flash_block_q"))
+        block_k = int(block_k or _flags.flag("flash_block_k"))
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     if use_pallas is None:

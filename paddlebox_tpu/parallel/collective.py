@@ -66,6 +66,56 @@ def broadcast(x: jax.Array, axis: Axis, *, root: int = 0) -> jax.Array:
     return lax.psum(x * mask, axis)
 
 
+def hierarchical_psum_tree(tree, *, inner_axis: Axis,
+                           outer_axis: Axis):
+    """All-reduce-sum a pytree across inner (ICI) × outer (DCN) axes by
+    the bandwidth-optimal two-level schedule: reduce-scatter over the
+    fast inner axis, all-reduce only the 1/inner_n shard over the slow
+    outer axis, all-gather back over the inner axis.
+
+    Role of the reference's two-level dense sync — SyncParam's fused
+    ReduceScatter + inter-node SyncDense + AllGather
+    (``boxps_worker.cc:584-645``) and HeterComm's
+    gather_one_node_grad/gather_multi_node_grad split
+    (``heter_comm.h:156-172``): each DCN link carries total_bytes /
+    inner_n instead of total_bytes.
+
+    The tree is flattened into ONE fused f32-width-preserving vector
+    (leaves raveled + concatenated, padded to a multiple of the inner
+    axis size) so arbitrary leaf shapes never break the reduce-scatter
+    split — same fusion the reference applies to the dense param block.
+    Numerically == ``lax.psum(tree, (inner, outer))`` up to summation
+    order. Call under shard_map with both axes in scope.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    n_in = lax.axis_size(inner_axis)
+    sizes = [int(l.size) for l in leaves]
+    shapes = [l.shape for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    # One accumulation dtype for the fused buffer: promote everything to
+    # the widest leaf dtype (in practice f32 for grads); cast back after.
+    acc_dt = jnp.result_type(*dtypes)
+    flat = jnp.concatenate([l.astype(acc_dt).ravel() for l in leaves])
+    pad = (-flat.size) % n_in
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), acc_dt)])
+    if n_in > 1:
+        part = lax.psum_scatter(flat, inner_axis, scatter_dimension=0,
+                                tiled=True)
+        part = lax.psum(part, outer_axis)
+        flat = lax.all_gather(part, inner_axis, axis=0, tiled=True)
+    else:
+        flat = lax.psum(flat, outer_axis)
+    out = []
+    off = 0
+    for size, shape, dt in zip(sizes, shapes, dtypes):
+        out.append(flat[off:off + size].reshape(shape).astype(dt))
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
 def ppermute_shift(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
     """Ring shift: rank i sends to rank (i+shift) % n. Role of send_v2/recv_v2
     p2p pairs in pipeline parallelism (reference p2p_communication.py)."""

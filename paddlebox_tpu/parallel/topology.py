@@ -11,13 +11,22 @@ we build ONE ``jax.sharding.Mesh`` whose named axes ARE the groups. pjit /
 shard_map + XLA then insert collectives over the right axis; physical ICI
 adjacency is handled by ``jax.experimental.mesh_utils.create_device_mesh``.
 
-Axis order convention (outermost → innermost): ``dp, sharding, pp, sp, ep,
-mp``. The innermost axis maps to physically-adjacent devices, so mp (the
-highest-frequency, latency-sensitive collectives) rides the fastest ICI
+Axis order convention (outermost → innermost): ``slice, dp, sharding, pp,
+sp, ep, mp``. The innermost axis maps to physically-adjacent devices, so mp
+(the highest-frequency, latency-sensitive collectives) rides the fastest ICI
 links; dp (lowest frequency — one gradient sync per step) may cross DCN.
 This extends the reference's [dp, pp, sharding, mp] nesting
 (``topology.py:52``) with sp (long-context sequence parallel) and ep
 (expert parallel, role of the MoE group in ``moe_layer.py``).
+
+``slice`` is the multi-slice / multi-pod DCN axis (role of the reference's
+inner-vs-inter-node comm split — ``heter_comm.h:156-172``
+gather_one_node_grad / gather_multi_node_grad and the two-level NCCL
+communicators): devices within a slice are ICI-connected; crossing slices
+rides the data-center network. Collectives that name only intra-slice axes
+stay on ICI; the hierarchical helpers in ``parallel.collective``
+(``hierarchical_psum_tree``) and the ``dcn_axis`` hooks in the sparse
+push / CTR trainer route the slow DCN hop over the minimum data.
 """
 
 from __future__ import annotations
@@ -30,14 +39,19 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# Canonical axis order, outermost first.
-AXIS_ORDER: Tuple[str, ...] = ("dp", "sharding", "pp", "sp", "ep", "mp")
+# Canonical axis order, outermost first. "slice" (DCN) is outermost: its
+# links are the slowest, so only the lowest-frequency collectives may
+# name it.
+AXIS_ORDER: Tuple[str, ...] = ("slice", "dp", "sharding", "pp", "sp",
+                               "ep", "mp")
 
 
 @dataclasses.dataclass(frozen=True)
 class HybridTopology:
     """Degrees of each parallelism axis. 1 = axis unused.
 
+    slice    multi-slice / multi-pod data parallel over DCN (outermost:
+             slowest links, lowest-frequency collectives)
     dp       data parallel (replica groups; gradient allreduce)
     sharding ZeRO optimizer/gradient/param sharding subgroups inside dp
     pp       pipeline stages
@@ -46,6 +60,7 @@ class HybridTopology:
     mp       tensor/model parallel (innermost: fastest ICI)
     """
 
+    slice: int = 1
     dp: int = 1
     sharding: int = 1
     pp: int = 1
@@ -88,8 +103,21 @@ def build_mesh(topo: Optional[HybridTopology] = None,
     shape = tuple(getattr(topo, a) for a in axis_order)
     if devices[0].platform == "tpu":
         from jax.experimental import mesh_utils
-        mesh_devices = mesh_utils.create_device_mesh(
-            shape, devices=list(devices))
+        n_slices = getattr(topo, "slice", 1)
+        if n_slices > 1 and "slice" in axis_order:
+            # Multi-slice: the slice axis spans DCN, every other axis is
+            # intra-slice ICI. create_hybrid_device_mesh lays devices out
+            # so exactly the slice dim crosses slice boundaries.
+            si = list(axis_order).index("slice")
+            dcn_shape = tuple(n_slices if i == si else 1
+                              for i in range(len(shape)))
+            ici_shape = tuple(1 if i == si else s
+                              for i, s in enumerate(shape))
+            mesh_devices = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=list(devices))
+        else:
+            mesh_devices = mesh_utils.create_device_mesh(
+                shape, devices=list(devices))
     else:
         mesh_devices = np.asarray(devices).reshape(shape)
     return Mesh(mesh_devices, axis_names=tuple(axis_order))
@@ -113,7 +141,8 @@ def get_default_topology() -> Tuple[Optional[HybridTopology], Optional[Mesh]]:
 
 
 def data_sharding(mesh: Mesh, *,
-                  batch_axes: Sequence[str] = ("dp", "sharding")) -> NamedSharding:
+                  batch_axes: Sequence[str] = ("slice", "dp", "sharding")
+                  ) -> NamedSharding:
     """Sharding for a [batch, ...] input: batch split over the replica axes
     (dp and its inner ZeRO-sharding subgroups). Sequence-parallel splits the
     sequence dimension, not batch — annotate that separately."""

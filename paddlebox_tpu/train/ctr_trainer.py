@@ -37,6 +37,7 @@ from paddlebox_tpu.metrics import (AucState, auc_accumulate, auc_compute,
                                    auc_state_init)
 from paddlebox_tpu.ops.data_norm import (data_norm_apply, data_norm_init,
                                          normalize_dense_and_strip)
+from paddlebox_tpu.parallel.collective import hierarchical_psum_tree
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +72,16 @@ class TrainerConfig:
     data_norm: bool = False
     data_norm_slot_dim: int = -1
     data_norm_decay: float = 0.9999999
+    # Scale sparse grads by the global batch size before the push (role
+    # of scale_sparse_gradient_with_batch_size, trainer_desc.proto:64
+    # default true, applied in fleet_wrapper.cc:294): the loss carries a
+    # 1/global_batch factor, so without the scale each key's
+    # per-occurrence gradient is O(1/batch) and the sparse optimizer
+    # cannot move a key meaningfully within one pass; scaling restores
+    # per-occurrence O(1) grads, which is the regime the sparse adagrad
+    # defaults (initial_g2sum=3, lr=0.05, optimizer.cuh.h:31) are tuned
+    # for.
+    scale_sparse_grad_by_batch: bool = True
     # Global-norm clip on the dense gradients before the optimizer
     # (role of paddle.nn.ClipGradByGlobalNorm in fleet configs);
     # 0 disables. In "step" mode it is applied AFTER the cross-replica
@@ -107,11 +118,26 @@ class CTRTrainer:
         self.config = config
         self.mesh = mesh
         self.axis = axis
-        self.ndev = int(mesh.shape[axis]) if mesh is not None else 1
+        # Multi-slice (DCN) topology: the pass table is sharded over
+        # `axis` INSIDE each slice and replicated across slices; the
+        # batch splits over slice × axis. dcn_axis drives the
+        # hierarchical dense sync and the sparse push's one DCN stage.
+        self.dcn_axis = None
+        if (mesh is not None and "slice" in mesh.axis_names
+                and int(mesh.shape["slice"]) > 1):
+            if axis == "slice":
+                raise ValueError("table axis cannot be the DCN slice axis")
+            self.dcn_axis = "slice"
+        n_slices = (int(mesh.shape["slice"])
+                    if self.dcn_axis is not None else 1)
+        # ndev = REPLICA count (batch shards) = slice * table axis size;
+        # the table itself has mesh.shape[axis] shards regardless.
+        self.ndev = (int(mesh.shape[axis]) * n_slices
+                     if mesh is not None else 1)
         if feed_config.batch_size % self.ndev:
             raise ValueError(
                 f"batch_size {feed_config.batch_size} must be divisible by "
-                f"the {axis} axis size {self.ndev}")
+                f"the replica count {self.ndev} (slice x {axis})")
         # Per-slot mf widths (dynamic mf, role of CtrDymfAccessor): slots
         # declaring SlotConf.emb_dim get that width; the rest use the
         # table default. Slots are grouped by width — one PassEngine,
@@ -333,6 +359,11 @@ class CTRTrainer:
 
     def _build_step(self):
         axis = self.axis
+        dcn = self.dcn_axis
+        # Replica-wide reductions (loss, AUC, stats) span slice x axis;
+        # table collectives (all_to_all in pull/push) stay on `axis`
+        # (intra-slice ICI) with the one accumulator psum over `dcn`.
+        raxes = (dcn, axis) if dcn else axis
         ndev = self.ndev
         bs_local = self.feed_config.batch_size // ndev
         optimizer = self._optax
@@ -343,7 +374,9 @@ class CTRTrainer:
         mode = self.config.dense_sync_mode
         if mode not in ("step", "kstep", "async"):
             raise ValueError(f"unknown dense_sync_mode {mode!r}")
-        loss_of, auc_of = self._make_loss_auc(axis)
+        scale_sparse = self.config.scale_sparse_grad_by_batch
+        sparse_scale = float(self.feed_config.batch_size)
+        loss_of, auc_of = self._make_loss_auc(raxes)
         dn_on = self.config.data_norm
         if dn_on and mode == "async":
             # The reference routes data_norm stats through the async
@@ -382,9 +415,17 @@ class CTRTrainer:
             # Dense sync (see TrainerConfig.dense_sync_mode).
             if mode == "step":
                 # Grads already carry the global 1/N via the global
-                # denominator — psum completes the cross-replica
-                # reduction (role of SyncParam / c_allreduce_sum).
-                g_params = lax.psum(g_params, axis)
+                # denominator — the sum over replicas completes the
+                # reduction (role of SyncParam / c_allreduce_sum). On a
+                # multi-slice mesh the sum is hierarchical: reduce-
+                # scatter on ICI, psum the 1/dp shard over DCN,
+                # all-gather back (SyncParam's exact shape,
+                # boxps_worker.cc:584-645).
+                if dcn:
+                    g_params = hierarchical_psum_tree(
+                        g_params, inner_axis=axis, outer_axis=dcn)
+                else:
+                    g_params = lax.psum(g_params, axis)
                 updates, opt_state = optimizer.update(g_params, opt_state,
                                                       params)
                 params = optax.apply_updates(params, updates)
@@ -399,10 +440,10 @@ class CTRTrainer:
                 params = lax.cond(
                     sync_flag > 0,
                     lambda p: jax.tree.map(
-                        lambda x: lax.pmean(x, axis), p),
+                        lambda x: lax.pmean(x, raxes), p),
                     lambda p: p, params)
             else:  # async: host table applies the update
-                g_params = lax.psum(g_params, axis)
+                g_params = lax.psum(g_params, raxes)
 
             if dn_on:
                 # Decayed summary update from the SAME stats the forward
@@ -412,7 +453,7 @@ class CTRTrainer:
                 _, dn_new = data_norm_apply(
                     dn_old, dense_feats.astype(jnp.float32),
                     slot_dim=dn_slot_dim, summary_decay_rate=dn_decay,
-                    axis_name=axis)
+                    axis_name=raxes)
                 params = {**params, "data_norm": {
                     **params["data_norm"],
                     **{k: dn_new[k] for k in (
@@ -420,6 +461,9 @@ class CTRTrainer:
 
             # Sparse push per group: show=1 per occurrence, click=its
             # row's label (role of show/click stats in PushSparseGrad).
+            if scale_sparse:
+                g_embs = tuple(g * sparse_scale for g in g_embs)
+                g_ws = tuple(g * sparse_scale for g in g_ws)
             new_tables = []
             for gi, slots in enumerate(group_slots):
                 seg_g = jnp.concatenate([segments[n] for n in slots])
@@ -430,16 +474,16 @@ class CTRTrainer:
                     0.0) * occ_valid
                 new_tables.append(push_local(
                     tables[gi], rows[gi], g_embs[gi], g_ws[gi], occ_valid,
-                    clicks, axis=axis, opt=sparse_opt))
+                    clicks, axis=axis, opt=sparse_opt, dcn_axis=dcn))
 
             probs = jax.nn.sigmoid(logits)
             auc = auc_of(auc, probs, labels, valid)
-            loss_global = lax.psum(loss, axis)
+            loss_global = lax.psum(loss, raxes)
             # Dropped-lookup observability: bucket-overflow ids degraded
             # to zero-embedding pulls and dropped grads this step, summed
             # over devices and width groups.
             overflow_global = lax.psum(
-                sum(p["overflow"][0] for p in pulled), axis)
+                sum(p["overflow"][0] for p in pulled), raxes)
             out = (tuple(new_tables), params, opt_state, auc, loss_global,
                    overflow_global)
             if mode == "async":
@@ -448,14 +492,18 @@ class CTRTrainer:
 
         if self.mesh is not None:
             # P(axis) on the tables/rows tuples is a pytree PREFIX spec:
-            # every leaf of every group shards its leading dim over axis.
+            # every leaf of every group shards its leading dim over axis
+            # (replicated across slices on a multi-slice mesh — the push
+            # keeps the replicas bit-equal). Batch args shard over the
+            # full replica set (slice-major matches pack_sharded order).
+            dspec = P((dcn, axis)) if dcn else P(axis)
             out_specs = (P(axis), P(), P(), P(), P(), P())
             if mode == "async":
                 out_specs = out_specs + (P(),)
             body_sm = jax.shard_map(
                 body, mesh=self.mesh,
-                in_specs=(P(axis), P(), P(), P(), P(axis), P(axis), P(axis),
-                          P(axis), P(axis), P()),
+                in_specs=(P(axis), P(), P(), P(), dspec, dspec, dspec,
+                          dspec, dspec, P()),
                 out_specs=out_specs,
                 check_vma=False)
         else:
@@ -468,9 +516,11 @@ class CTRTrainer:
         pushes, no param updates (role of the AUC-runner test mode,
         box_wrapper.h:900-989 / SetTestMode)."""
         axis = self.axis
+        dcn = self.dcn_axis
+        raxes = (dcn, axis) if dcn else axis
         group_slots, group_sl = self._group_layout()
         forward = self._make_forward(group_slots, group_sl)
-        loss_of, auc_of = self._make_loss_auc(axis)
+        loss_of, auc_of = self._make_loss_auc(raxes)
 
         def body(tables, params, auc, rows, segments, labels, valid,
                  dense_feats):
@@ -478,14 +528,15 @@ class CTRTrainer:
                       for t, r in zip(tables, rows)]
             logits = forward(params, pulled, segments, dense_feats)
             validf = valid.astype(jnp.float32)
-            loss = lax.psum(loss_of(logits, labels, validf), axis)
+            loss = lax.psum(loss_of(logits, labels, validf), raxes)
             auc = auc_of(auc, jax.nn.sigmoid(logits), labels, valid)
             return auc, loss
 
+        dspec = P((dcn, axis)) if dcn else P(axis)
         body_sm = jax.shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(self.axis), P(), P(), P(self.axis), P(self.axis),
-                      P(self.axis), P(self.axis), P(self.axis)),
+            in_specs=(P(self.axis), P(), P(), dspec, dspec,
+                      dspec, dspec, dspec),
             out_specs=(P(), P()),
             check_vma=False)
         return jax.jit(body_sm, donate_argnums=(2,))
@@ -527,13 +578,15 @@ class CTRTrainer:
         """Jitted cross-replica param average for k-step pass boundaries."""
         if self._sync_params_cache is None:
             axis = self.axis
+            raxes = ((self.dcn_axis, axis) if self.dcn_axis is not None
+                     else axis)
 
             @jax.jit
             @functools.partial(
                 jax.shard_map, mesh=self.mesh, in_specs=P(),
                 out_specs=P(), check_vma=False)
             def sync(params):
-                return jax.tree.map(lambda x: lax.pmean(x, axis), params)
+                return jax.tree.map(lambda x: lax.pmean(x, raxes), params)
 
             self._sync_params_cache = sync
         return self._sync_params_cache
@@ -568,7 +621,9 @@ class CTRTrainer:
         # the identical code run under multi-process (jax.distributed)
         # clusters, where bare jnp.asarray would produce non-addressable
         # single-device arrays.
-        data_sh = (NamedSharding(self.mesh, P(self.axis))
+        dspec = (P((self.dcn_axis, self.axis))
+                 if self.dcn_axis is not None else P(self.axis))
+        data_sh = (NamedSharding(self.mesh, dspec)
                    if self.mesh is not None else None)
 
         def _dev(host):
@@ -630,7 +685,9 @@ class CTRTrainer:
     def _map_batch_rows(self, batch: SlotBatch) -> Tuple[jax.Array, ...]:
         """Host map: batch feasigns → per-width-group fused device-row
         arrays (role of CopyKeys' host side, one array per dim group)."""
-        data_sh = (NamedSharding(self.mesh, P(self.axis))
+        dspec = (P((self.dcn_axis, self.axis))
+                 if self.dcn_axis is not None else P(self.axis))
+        data_sh = (NamedSharding(self.mesh, dspec)
                    if self.mesh is not None else None)
         rows = []
         for gi, g in enumerate(self.engine.groups):

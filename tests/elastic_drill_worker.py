@@ -1,0 +1,76 @@
+"""Worker payload for the REAL-PROCESS elastic drill (spawned per
+generation by ``python -m paddlebox_tpu.launch --elastic-dir ...``).
+
+Role of the training process under the reference's elastic stack
+(``fleet/elastic/manager.py:131-614`` + the launch watcher): join the
+cluster at whatever world size the current rank table dictates, RECOVER
+from the donefile chain (base + deltas published by earlier
+generations), train the remaining passes of the day, and publish
+checkpoints as it goes — so a SIGKILL'd peer costs at most the
+in-flight pass.
+
+Usage: elastic_drill_worker.py <data_dir> <out_dir> <result_json>
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+DAY = "20260728"
+SLOTS = ("user", "item")
+
+
+def main() -> None:
+    data_dir, out_dir, result_json = sys.argv[1:4]
+    from paddlebox_tpu.distributed import bootstrap
+    bootstrap.initialize()   # PBX_* env from the launcher
+
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.embedding import TableConfig
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+    from paddlebox_tpu.train.day_runner import DayRunner
+
+    ndev = len(jax.devices())        # global across the generation
+    mesh = build_mesh(HybridTopology(dp=ndev))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.5) for s in SLOTS),
+        batch_size=32)
+    trainer = CTRTrainer(
+        DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
+        TableConfig(name="emb", dim=8, learning_rate=0.1), mesh=mesh,
+        config=TrainerConfig(dense_learning_rate=3e-3,
+                             auc_num_buckets=1 << 10))
+    trainer.init(seed=0)
+    runner = DayRunner(trainer, feed, out_dir, data_root=data_dir,
+                       split_interval=60, split_per_pass=1,
+                       hours=list(range(6)), num_reader_threads=1,
+                       shuffle=False,
+                       is_rank0=jax.process_index() == 0)
+    # Elastic restart contract: every generation recovers the donefile
+    # chain first; finished passes are skipped inside train_day.
+    runner.recover()
+    stats = runner.train_day(DAY)
+
+    if jax.process_index() == 0:
+        with open(result_json + ".tmp", "w") as f:
+            json.dump({
+                "losses": [s["loss"] for s in stats],
+                "trained_passes": len(stats),
+                "world": jax.process_count(),
+                "generation": int(os.environ.get(
+                    "PBX_ELASTIC_GENERATION", "-1")),
+            }, f)
+        os.replace(result_json + ".tmp", result_json)
+
+
+if __name__ == "__main__":
+    main()

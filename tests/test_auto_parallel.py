@@ -67,3 +67,46 @@ def test_plan_overrides_and_apply(devices8):
     f = jax.jit(lambda p: p["wte"] @ p["head"], in_shardings=(shardings,))
     np.testing.assert_allclose(np.asarray(f(placed)),
                                np.asarray(params["wte"] @ params["head"]))
+
+
+def test_cost_planner_respects_budget():
+    """Cost-based planner (planner_v2/cost-model role): ample budget →
+    fully replicated (cheapest comm); tight budget → largest leaves
+    sharded first until resident bytes fit; impossible budget raises."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.parallel.auto import estimate_plan, plan_params_cost
+
+    mesh = build_mesh(HybridTopology(dp=2, sharding=2, mp=2))
+    params = {
+        "big": np.zeros((1024, 64), np.float32),     # 256 KiB
+        "mid": np.zeros((256, 64), np.float32),      # 64 KiB
+        "tiny": np.zeros((7,), np.float32),          # indivisible by 2
+    }
+    total = 256 * 1024 + 64 * 1024 + 28
+
+    # Ample budget: everything replicated, comm = 2x bytes allreduce.
+    specs, cost = plan_params_cost(params, mesh,
+                                   bytes_budget_per_device=2 * total)
+    assert specs["big"] == P() and specs["mid"] == P()
+    assert cost.param_bytes_per_device == total
+    assert cost.allgather_bytes == 0
+    assert cost.allreduce_bytes == 2 * total
+
+    # Tight budget: big must shard; mid may stay replicated.
+    budget = 256 * 1024 // 2 + 64 * 1024 + 1024
+    specs, cost = plan_params_cost(params, mesh,
+                                   bytes_budget_per_device=budget)
+    assert specs["big"] != P()
+    assert cost.param_bytes_per_device <= budget
+    assert cost.allgather_bytes > 0
+    # estimate_plan consistency on the returned plan
+    again = estimate_plan(params, specs, mesh)
+    assert again == cost
+
+    # Impossible budget raises (tiny is indivisible, floor exists).
+    import pytest
+    with pytest.raises(ValueError):
+        plan_params_cost(params, mesh, bytes_budget_per_device=100)

@@ -12,6 +12,7 @@ from paddlebox_tpu.graph import (DeviceGraph, GraphDataGenerator,
                                  GraphGenConfig, GraphTable, build_csr,
                                  device_arrays, load_edge_file, random_walk,
                                  sample_neighbors, skip_gram_pairs)
+from paddlebox_tpu.graph import sampler
 
 
 def ring_edges(n):
@@ -193,3 +194,89 @@ def test_deepwalk_smoke_train():
     intra = (sims[:8, :8].sum() - 8) / (8 * 7)
     inter = sims[:8, 8:].mean()
     assert intra > inter + 0.1
+
+
+def test_metapath_walk_alternates_edge_types():
+    """Bipartite u2i/i2u metapath: hop parity must land on the right
+    side of the graph every time (users 0-3, items 4-7)."""
+    users = np.arange(4)
+    items = np.arange(4, 8)
+    rng = np.random.default_rng(0)
+    # every user connects to 2 items; every item back to 2 users
+    u2i_src = np.repeat(users, 2)
+    u2i_dst = rng.choice(items, 8)
+    i2u_src = np.repeat(items, 2)
+    i2u_dst = rng.choice(users, 8)
+    table = GraphTable()
+    table.add_edges("u2i", u2i_src, u2i_dst, num_nodes=8)
+    table.add_edges("i2u", i2u_src, i2u_dst, num_nodes=8)
+    views = [table.device_graph("u2i"), table.device_graph("i2u")]
+    nbrs, deg = sampler.stack_device_graphs(views)
+    walks = sampler.metapath_walk(
+        nbrs, deg, jnp.asarray(users, jnp.int32),
+        jax.random.PRNGKey(0), (0, 1, 0, 1))
+    w = np.asarray(walks)
+    assert w.shape == (4, 5)
+    # hops 1,3 are items; hops 0,2,4 are users
+    assert np.all(w[:, [1, 3]] >= 4)
+    assert np.all(w[:, [0, 2, 4]] < 4)
+
+
+def test_metapath_dead_end_stays_in_place():
+    table = GraphTable()
+    table.add_edges("a", np.array([0]), np.array([1]), num_nodes=3)
+    table.add_edges("b", np.array([2]), np.array([0]), num_nodes=3)
+    nbrs, deg = sampler.stack_device_graphs(
+        [table.device_graph("a"), table.device_graph("b")])
+    # node 1 has no 'b' edges: the b-hop must self-loop
+    walks = sampler.metapath_walk(
+        nbrs, deg, jnp.asarray([0], jnp.int32),
+        jax.random.PRNGKey(1), (0, 1))
+    w = np.asarray(walks)[0]
+    assert w[1] == 1 and w[2] == 1
+
+
+def test_degree_negative_sampling_tracks_degree():
+    deg = np.array([0, 1, 1, 1, 100], np.int64)
+    cdf = sampler.degree_neg_cdf(deg)
+    negs = np.asarray(sampler.negative_samples_by_degree(
+        jax.random.PRNGKey(0), cdf, 4096, 4)).ravel()
+    counts = np.bincount(negs, minlength=5)
+    # hub node ~ deg^0.75 weight: drawn far more often than unit nodes
+    assert counts[4] > 5 * counts[1]
+    assert counts.sum() == 4096 * 4
+    assert (counts[:4] > 0).all()  # isolated node stays reachable
+
+
+def test_node_types_and_typed_starts(tmp_path):
+    table = GraphTable()
+    p = tmp_path / "nodes.txt"
+    p.write_text("user 0\nuser 1\nitem 2\nitem 3\n")
+    table.load_node_file(str(p), {"user": 0, "item": 1}, num_nodes=5)
+    np.testing.assert_array_equal(table.nodes_of_type(0), [0, 1])
+    np.testing.assert_array_equal(table.nodes_of_type(1), [2, 3])
+    np.testing.assert_array_equal(table.nodes_of_type(-1), [4])
+
+
+def test_generator_metapath_feats_and_degree_negs():
+    users = np.arange(6)
+    items = np.arange(6, 12)
+    rng = np.random.default_rng(3)
+    table = GraphTable()
+    table.add_edges("u2i", np.repeat(users, 2), rng.choice(items, 12),
+                    num_nodes=12)
+    table.add_edges("i2u", np.repeat(items, 2), rng.choice(users, 12),
+                    num_nodes=12)
+    feats = rng.normal(size=(12, 5)).astype(np.float32)
+    table.set_node_feat("x", feats)
+    gen = GraphDataGenerator(
+        table, "u2i",
+        GraphGenConfig(walk_len=4, window=2, num_neg=3, batch_walks=8,
+                       metapath=("u2i", "i2u"), degree_negatives=True,
+                       feat_name="x"))
+    batch = next(iter(gen.batches()))
+    assert batch["center_feats"].shape == (batch["centers"].shape[0], 5)
+    np.testing.assert_allclose(
+        np.asarray(batch["center_feats"]),
+        feats[np.asarray(batch["centers"])])
+    assert np.asarray(batch["negatives"]).max() < 12

@@ -115,3 +115,42 @@ def test_isolated_and_out_of_range_nodes():
         cli.close()
         for s in servers:
             s.stop()
+
+
+def test_metapath_walk_shard_invariant_with_features():
+    """Metapath walks over two edge types + feature pulls on the walk
+    frontier: 2-shard answers must be bit-identical to 1-shard, and
+    every hop must respect its hop's edge type (bipartite layout)."""
+    rng = np.random.default_rng(9)
+    users = np.arange(0, 100, dtype=np.int64)
+    items = np.arange(100, 200, dtype=np.int64)
+    u2i = (np.repeat(users, 4), rng.choice(items, 400))
+    i2u = (np.repeat(items, 4), rng.choice(users, 400))
+    feats = rng.normal(size=(200, 3)).astype(np.float32)
+    outs = {}
+    for n in (1, 2):
+        servers, cli = _cluster(n)
+        try:
+            cli.upload_batch("u2i", *u2i, num_nodes=200)
+            cli.upload_batch("i2u", *i2u, num_nodes=200)
+            cli.build("u2i")
+            cli.build("i2u")
+            nodes = np.arange(200, dtype=np.int64)
+            cli.set_node_feat("x", nodes, feats)
+            walks = cli.metapath_walk(["u2i", "i2u", "u2i", "i2u"],
+                                      users[:32], seed=13)
+            # feature pull on the walk's final frontier
+            f = cli.get_node_feat("x", walks[:, -1])
+            outs[n] = (walks, f)
+        finally:
+            cli.stop_servers()
+            cli.close()
+            for s in servers:
+                s.stop()
+    w1, f1 = outs[1]
+    w2, f2 = outs[2]
+    np.testing.assert_array_equal(w1, w2)
+    np.testing.assert_array_equal(f1, f2)
+    # typed hops: odd positions are items, even are users
+    assert np.all(w2[:, [1, 3]] >= 100) and np.all(w2[:, [0, 2, 4]] < 100)
+    np.testing.assert_allclose(f2, feats[w2[:, -1]])

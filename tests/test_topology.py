@@ -21,7 +21,8 @@ def test_world_size_mismatch_raises():
 def test_build_hybrid_mesh(devices8):
     topo = HybridTopology(dp=2, pp=1, sp=1, mp=4)
     mesh = build_mesh(topo, devices8)
-    assert mesh.shape == {"dp": 2, "sharding": 1, "pp": 1, "sp": 1, "ep": 1, "mp": 4}
+    assert mesh.shape == {"slice": 1, "dp": 2, "sharding": 1, "pp": 1,
+                          "sp": 1, "ep": 1, "mp": 4}
     assert mesh.devices.size == 8
 
 

@@ -1,0 +1,104 @@
+"""Flash-attention block-size tuner: sweep (block_q, block_k) tiles at a
+given shape on the attached accelerator and report the fastest.
+
+Run when a real TPU is attached (the CPU path ignores blocks):
+
+    python tools/tune_flash_blocks.py --shape gpt   # bench_gpt's shape
+    python tools/tune_flash_blocks.py --b 4 --s 2048 --h 16 --d 64
+
+Prints one JSON line per candidate and a final "best" line; apply the
+winner via ``FLAGS_flash_block_q/k`` env (every call site reads the
+flags — ops/pallas_kernels/flash_attention.py).
+
+Role of the tile-size tuning the reference bakes into its fused
+attention CUDA kernels per-arch (fused_multi_transformer_op.cu launch
+configs); on TPU the tile choice is the Mosaic grid, so it is a runtime
+flag instead of a compile-time template.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SHAPES = {
+    # bench_gpt full-scale: d_model 1024, 16 heads, seq 1024, batch 4
+    "gpt": dict(b=4, s=1024, h=16, d=64),
+    "long": dict(b=1, s=8192, h=16, d=64),
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shape", choices=sorted(SHAPES), default=None)
+    ap.add_argument("--b", type=int, default=4)
+    ap.add_argument("--s", type=int, default=1024)
+    ap.add_argument("--h", type=int, default=16)
+    ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--blocks", default="128,256,512,1024")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    shp = SHAPES[args.shape] if args.shape else dict(
+        b=args.b, s=args.s, h=args.h, d=args.d)
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.ops.pallas_kernels import flash_attention
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": f"backend is {jax.default_backend()!r}"
+                          " — block tuning needs the TPU kernel"}))
+        return
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(shp["b"], shp["s"], shp["h"],
+                                     shp["d"])), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=q.shape), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=q.shape), jnp.bfloat16)
+
+    def bench(bq, bk) -> float:
+        f = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk))
+        o = f(q, k, v)
+        float(np.asarray(o).ravel()[0])       # warm + force completion
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            o = f(q, k, v)
+        float(np.asarray(o).ravel()[0])
+        return (time.perf_counter() - t0) / args.iters
+
+    cands = sorted({min(int(x), shp["s"])
+                    for x in args.blocks.split(",")})
+    best = None
+    for bq, bk in itertools.product(cands, cands):
+        try:
+            dt = bench(bq, bk)
+        except Exception as e:  # noqa: BLE001 - report and keep sweeping
+            print(json.dumps({"block_q": bq, "block_k": bk,
+                              "error": repr(e)[:200]}), flush=True)
+            continue
+        print(json.dumps({"block_q": bq, "block_k": bk,
+                          "ms": round(dt * 1e3, 3)}), flush=True)
+        if best is None or dt < best[0]:
+            best = (dt, bq, bk)
+    if best:
+        print(json.dumps({
+            "best": {"block_q": best[1], "block_k": best[2],
+                     "ms": round(best[0] * 1e3, 3)},
+            "apply": (f"FLAGS_flash_block_q={best[1]} "
+                      f"FLAGS_flash_block_k={best[2]}"),
+            "shape": shp,
+        }))
+
+
+if __name__ == "__main__":
+    main()
