@@ -13,10 +13,10 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_tool(name, timeout):
+def _run_tool(name, timeout, *args):
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", name)],
+        [sys.executable, os.path.join(REPO, "tools", name), *args],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
     # The tools print this sentinel (and exit cleanly) when libtpu's AOT
     # topology cannot initialize, whatever the underlying error text —
@@ -49,3 +49,14 @@ def test_dense_bench_steps_aot_compile_for_tpu():
     train steps at their bench shapes."""
     out = _run_tool("aot_check_dense.py", 900)
     assert "DENSE BENCH TPU AOT COMPILE: OK" in out
+
+
+@pytest.mark.slow
+def test_scale_steps_aot_compile_for_tpu_256_chips():
+    """The 8->256-chip scaling evidence (BASELINE.md metric 3) the bench
+    chip can't give: the multislice CTR step (slice=4 x dp=64) and the
+    hybrid GPT step (slice x dp x pp x sp x mp) lower + compile against
+    a real 16x16 v5e compile-only topology — XLA schedules the ICI/DCN
+    collectives for 256 chips."""
+    out = _run_tool("aot_check_scale.py", 1500, "--chips", "256")
+    assert "SCALE TPU AOT COMPILE (256 chips): OK" in out

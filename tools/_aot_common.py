@@ -1,0 +1,20 @@
+"""Shared helpers for the aot_check_* tools.
+
+Import AFTER the tool has pinned its platform env (each tool sets
+JAX_PLATFORMS/XLA_FLAGS before importing jax; this module only assumes
+jax is importable by then).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sds(tree):
+    """Pytree of arrays -> pytree of ShapeDtypeStructs (compile-only
+    stand-ins; nothing touches a device)."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype),
+        tree)

@@ -32,10 +32,7 @@ from jax.sharding import Mesh, NamedSharding  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
 
-def sds(tree):
-    return jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype),
-        tree)
+from tools._aot_common import sds  # noqa: E402
 
 
 def check_resnet(sh) -> None:

@@ -38,10 +38,7 @@ from jax.experimental import topologies  # noqa: E402
 from paddlebox_tpu.parallel import HybridTopology, build_mesh  # noqa: E402
 
 
-def sds(tree):
-    return jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype),
-        tree)
+from tools._aot_common import sds  # noqa: E402
 
 
 def check_gpt_hybrid(topo) -> None:
