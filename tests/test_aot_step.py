@@ -56,7 +56,8 @@ def test_scale_steps_aot_compile_for_tpu_256_chips():
     """The 8->256-chip scaling evidence (BASELINE.md metric 3) the bench
     chip can't give: the multislice CTR step (slice=4 x dp=64) and the
     hybrid GPT step (slice x dp x pp x sp x mp) lower + compile against
-    a real 16x16 v5e compile-only topology — XLA schedules the ICI/DCN
-    collectives for 256 chips."""
+    a real 16x16 v5e compile-only topology — XLA schedules the full
+    256-chip collective program (slice axis logical on the single-slice
+    compile topology; DCN semantics pinned by test_multislice)."""
     out = _run_tool("aot_check_scale.py", 1500, "--chips", "256")
     assert "SCALE TPU AOT COMPILE (256 chips): OK" in out

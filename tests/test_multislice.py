@@ -57,7 +57,7 @@ def test_hierarchical_psum_tree_matches_flat():
                                    np.asarray(out_f[k]), rtol=1e-6)
 
 
-def _make_ctr_trainer(mesh, n_slots=3, batch=16):
+def _make_ctr_trainer(mesh, n_slots=3, batch=16, **config_kw):
     from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
     from paddlebox_tpu.embedding import DeviceFeatureStore, TableConfig
     from paddlebox_tpu.models import DeepFM
@@ -69,7 +69,7 @@ def _make_ctr_trainer(mesh, n_slots=3, batch=16):
                    emb_dim=8, hidden=(16, 8))
     trainer = CTRTrainer(
         model, feed, TableConfig(dim=8), mesh=mesh,
-        config=TrainerConfig(auc_num_buckets=1 << 10),
+        config=TrainerConfig(auc_num_buckets=1 << 10, **config_kw),
         store_factory=lambda cfg: DeviceFeatureStore(cfg, mesh=mesh))
     trainer.init(seed=0)
     return trainer, feed
@@ -89,9 +89,14 @@ def _synth_batch(feed, ndev, seed=0):
 
 
 def _run_steps(trainer, feed, n_steps=3):
-    """Drive n_steps of the jitted train step on deterministic batches;
-    return (loss trace, final dense params)."""
+    """Drive n_steps of the jitted train step on deterministic batches
+    with the SAME sync-flag schedule train_pass uses (kstep mode fires
+    the periodic param average and the pass-end sync — otherwise the
+    slice-spanning pmean would be dead code in these tests); return
+    (loss trace, final dense params)."""
     eng = trainer.engine
+    mode = trainer.config.dense_sync_mode
+    k = max(1, trainer.config.dense_sync_interval)
     losses = []
     for step_i in range(n_steps):
         batch = _synth_batch(feed, trainer.ndev, seed=100 + step_i)
@@ -104,16 +109,21 @@ def _run_steps(trainer, feed, n_steps=3):
         rows = trainer._map_batch_rows(batch)
         segs = {n: jnp.asarray(batch.segments[n]) for n in batch.ids}
         from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
+        sync = 1 if (mode == "kstep" and (step_i + 1) % k == 0) else 0
         tables, trainer.params, trainer.opt_state, trainer.auc_state, \
             loss, _of = trainer._step_fn(
                 tables, trainer.params, trainer.opt_state,
                 trainer.auc_state, rows, segs, jnp.asarray(batch.labels),
                 jnp.asarray(batch.valid),
                 jnp.asarray(_concat_dense_host(batch)),
-                jnp.zeros((), jnp.int32))
+                jnp.asarray(sync, jnp.int32))
         losses.append(float(loss))
         eng.update_tables(tables)
         eng.end_pass()
+    if mode == "kstep" and n_steps % k != 0:
+        # Pass-boundary sync, as train_pass does — also makes the
+        # returned params well-defined (replica-identical).
+        trainer.params = trainer._sync_params_fn()(trainer.params)
     return losses, jax.device_get(trainer.params)
 
 
@@ -170,3 +180,22 @@ def test_gpt_multislice_step():
     loss_flat = run(_mesh(dp=2, pp=2, mp=2))
     assert np.isfinite(loss_sl)
     np.testing.assert_allclose(loss_sl, loss_flat, rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_ctr_multislice_kstep_parity_vs_flat():
+    """kstep (local-SGD) under a slice mesh: the periodic param average
+    spans slice x dp — 2-slice x 2-dp must equal flat 4-dp exactly (sgd
+    optimizer so kstep's local trajectories are deterministic). With
+    interval=2 over 3 steps the in-step sync fires at step 2 AND the
+    pass-end sync covers the trailing local step."""
+    kw = dict(dense_optimizer="sgd", dense_sync_mode="kstep",
+              dense_sync_interval=2)
+    tr_flat, feed = _make_ctr_trainer(_mesh(dp=4), **kw)
+    tr_sl, _ = _make_ctr_trainer(_mesh(slice_=2, dp=2), **kw)
+    losses_f, params_f = _run_steps(tr_flat, feed)
+    losses_s, params_s = _run_steps(tr_sl, feed)
+    np.testing.assert_allclose(losses_f, losses_s, rtol=2e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
+        params_f, params_s)

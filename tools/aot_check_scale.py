@@ -13,6 +13,14 @@ hundreds-of-nodes claim rides gather_multi_node_grad + two-level NCCL,
 heter_comm.h:156-172) — here the compiler is the witness: if XLA can
 schedule the collectives over the 16x16 v5e topology, the program runs
 when the chips exist.
+
+Scope note: the compile-only topology is a SINGLE physical slice, so
+the "slice" mesh axis here is logical (a device reshape) and its
+collectives lower to ICI — this validates the program structure and
+collective schedule at 256-chip scale, not the DCN transport itself.
+The DCN hop's semantics are pinned by tests/test_multislice.py parity;
+on real multi-slice hardware build_mesh routes the slice axis over DCN
+via create_hybrid_device_mesh.
 """
 
 from __future__ import annotations
@@ -78,8 +86,9 @@ def check_ctr_multislice(topo, n_slices: int, dp: int) -> None:
                      for s in feed.sparse_slots}
 
     # Hand-built arg shapes (what _map_batch_rows/begin_pass would feed).
+    from paddlebox_tpu.embedding.table import table_widths
     rps = 1 << 14                       # rows per table shard
-    ke, kw = 1, 1                       # adagrad state widths
+    _, ke, kw = table_widths(TableConfig(dim=emb_dim))
     w = emb_dim + 3 + ke + kw
     tables = tuple(
         PassTable(vals=jax.ShapeDtypeStruct((dp * (rps + 1), w),

@@ -43,11 +43,7 @@ from paddlebox_tpu.models import DeepFM  # noqa: E402
 from paddlebox_tpu.parallel import HybridTopology, build_mesh  # noqa: E402
 from paddlebox_tpu.train import CTRTrainer, TrainerConfig  # noqa: E402
 
-
-def sds_like(tree):
-    return jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype),
-        tree)
+from tools._aot_common import sds as sds_like  # noqa: E402
 
 
 def main() -> None:
