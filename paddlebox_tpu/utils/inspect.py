@@ -28,13 +28,13 @@ def jaxpr_summary(fn: Callable, *args, **kw) -> Dict[str, int]:
     jaxpr = jax.make_jaxpr(fn, **kw)(*args)
 
     def subjaxprs(p):
-        # scan/pjit carry one ClosedJaxpr; cond carries a tuple of branch
-        # ClosedJaxprs — cover both container shapes.
+        # Anything exposing .eqns is a traversable program: plain Jaxpr
+        # (shard_map's param) and ClosedJaxpr (scan/pjit/cond branches —
+        # its .eqns property forwards to the inner jaxpr) both qualify.
         items = p if isinstance(p, (tuple, list)) else (p,)
         for item in items:
-            inner = getattr(item, "jaxpr", None)
-            if inner is not None and hasattr(inner, "eqns"):
-                yield inner
+            if hasattr(item, "eqns"):
+                yield item
 
     def walk(jx) -> Counter:
         c: Counter = Counter()

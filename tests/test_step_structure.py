@@ -1,0 +1,87 @@
+"""Structural regression net over the fused CTR train step.
+
+The r02→r03 rework collapsed the push path from six scatter-adds +
+three argsorts + six gathers per step to ONE owner-side
+scatter-accumulate + a dense optimizer sweep (PROFILE.md: XLA TPU
+scatter costs ~7 ns/element, so scatter COUNT is the step's cost
+model). These tests pin the op-level shape of the compiled program so a
+refactor that quietly reintroduces per-field scatters (or a second
+all_to_all round) fails loudly here instead of as a silent 3x
+throughput regression the CPU tests can't see.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.data.parser import parse_lines
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch, SlotConf
+from paddlebox_tpu.embedding import DeviceFeatureStore, TableConfig
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
+from paddlebox_tpu.utils import inspect as pbx_inspect
+
+
+def _step_op_counts(ndev=4):
+    mesh = build_mesh(HybridTopology(dp=ndev),
+                      devices=jax.devices()[:ndev])
+    slots = tuple(SlotConf(f"s{i}", avg_len=2.0) for i in range(3))
+    feed = DataFeedConfig(slots=slots, batch_size=4 * ndev)
+    model = DeepFM(slot_names=tuple(f"s{i}" for i in range(3)),
+                   emb_dim=8, hidden=(16, 8))
+    tr = CTRTrainer(model, feed, TableConfig(dim=8), mesh=mesh,
+                    config=TrainerConfig(auc_num_buckets=1 << 10),
+                    store_factory=lambda c: DeviceFeatureStore(
+                        c, mesh=mesh))
+    tr.init(seed=0)
+    rng = np.random.default_rng(0)
+    lines = [f"{rng.integers(0, 2)} "
+             + " ".join(f"s{i}:{rng.integers(1, 40)}" for i in range(3))
+             for _ in range(feed.batch_size)]
+    batch = SlotBatch.pack_sharded(parse_lines(lines, feed), feed, ndev)
+    tr.engine.feed_pass([
+        np.unique(np.concatenate([batch.ids[n] for n in g.slots]))
+        for g in tr.engine.groups])
+    step = tr._build_step()
+    tables = tr.engine.begin_pass()
+    rows = tr._map_batch_rows(batch)
+    segs = {n: jnp.asarray(batch.segments[n]) for n in batch.ids}
+    args = (tables, tr.params, tr.opt_state, tr.auc_state, rows, segs,
+            jnp.asarray(batch.labels), jnp.asarray(batch.valid),
+            jnp.asarray(_concat_dense_host(batch)),
+            jnp.zeros((), jnp.int32))
+    return pbx_inspect.jaxpr_summary(lambda *a: step(*a), *args)
+
+
+def test_ctr_step_collective_and_scatter_budget():
+    c = _step_op_counts()
+    # Exactly TWO all_to_all pairs for a single width group: pull
+    # (request + reply) and push (rows + payload). A third pair means a
+    # new collective round crept into the hot path.
+    assert c.get("all_to_all", 0) == 4, c
+    # Scatter budget: bucket-set x2 (pull/push send), payload add,
+    # owner-side accumulate, AUC histograms, and the gather-VJP
+    # scatter-adds from autodiff. The six-field push layout this
+    # replaced would blow past the ceiling (+5 per width group).
+    assert (c.get("scatter-add", 0) + c.get("scatter", 0)) <= 13, c
+    # One argsort per bucket-by-shard (pull + push) plus AUC at most;
+    # the r02 layout carried 3 argsorts in the push alone.
+    assert c.get("sort", 0) <= 4, c
+
+
+def test_jaxpr_summary_sees_inside_shard_map():
+    """Guard for the introspection fix: shard_map carries a PLAIN Jaxpr
+    param; the summary must recurse into it (a regression here silently
+    turns the budget test above into {'jit': 1})."""
+    from jax.sharding import PartitionSpec as P
+    mesh = build_mesh(HybridTopology(dp=4), devices=jax.devices()[:4])
+
+    def body(x):
+        return jnp.zeros((8, 4)).at[jnp.array([1, 2])].add(x[:2])
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                              out_specs=P(), check_vma=False))
+    c = pbx_inspect.jaxpr_summary(f, jnp.ones((4, 4)))
+    assert c.get("scatter-add", 0) >= 1, c
