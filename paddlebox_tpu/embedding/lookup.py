@@ -85,8 +85,23 @@ def _bucket_by_shard(dev_rows: jax.Array, num_shards: int, block: int,
     return send_rows, order, sorted_shard, pos
 
 
-def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
-               ) -> Dict[str, jax.Array]:
+def compute_bucketing(table: PassTable,
+                      dev_rows: jax.Array) -> Optional[Tuple]:
+    """The bucket-by-shard layout for one (table, ids) pair — the ONE
+    source of truth for block/cap so a caller sharing the layout between
+    pull_local and push_local (both sort the same dev_rows; computing it
+    twice pays a second argsort+searchsorted per step) can never drift
+    from their internal fallback. None when the table is unsharded
+    (single-shard paths never bucket)."""
+    if table.num_shards == 1:
+        return None
+    block = table.rows_per_shard + 1
+    cap = bucket_capacity(dev_rows.shape[0], table.num_shards)
+    return _bucket_by_shard(dev_rows, table.num_shards, block, cap)
+
+
+def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
+               bucketing: Optional[Tuple] = None) -> Dict[str, jax.Array]:
     """Per-device pull: ids [n] (device-row space) → {emb [n, D], w [n],
     show [n], click [n], overflow []}. Padding/overflow ids yield the
     trash row (zeros unless polluted — push keeps it zeroed).
@@ -122,8 +137,14 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str
     cap = bucket_capacity(n, num_shards)
     trash = block - 1
 
-    send_rows, order, slot_shard, slot_pos = _bucket_by_shard(
-        dev_rows, num_shards, block, cap)
+    # ``bucketing``: the train step computes the bucket-by-shard layout
+    # ONCE per width group and shares it between this pull and the
+    # matching push — the two sort the SAME dev_rows, so recomputing
+    # would pay a second argsort+searchsorted per step (~8 ms at bench
+    # scale, PROFILE.md) for identical results.
+    if bucketing is None:
+        bucketing = _bucket_by_shard(dev_rows, num_shards, block, cap)
+    send_rows, order, slot_shard, slot_pos = bucketing
     # Shape [1] (not scalar) so prefix out_specs like P(axis) remain
     # valid for the returned dict under shard_map.
     overflow = jnp.sum(((slot_pos >= cap)
@@ -226,7 +247,8 @@ def apply_accumulated(vals: jax.Array, acc: jax.Array, *, dim: int,
 def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
                grad_w: jax.Array, shows: jax.Array, clicks: jax.Array, *,
                axis: str, opt: Optional[SparseOptimizer] = None,
-               dcn_axis: Optional[str] = None) -> PassTable:
+               dcn_axis: Optional[str] = None,
+               bucketing: Optional[Tuple] = None) -> PassTable:
     """Per-device push: scatter-accumulate + dense fused optimizer sweep.
 
     dev_rows [n]; grad_emb [n, D]; grad_w/shows/clicks [n]. Padding entries
@@ -275,8 +297,9 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
                          num_shards=1, dim=d, ke=ke, kw=kw)
 
     cap = bucket_capacity(n, num_shards)
-    send_rows, order, slot_shard, slot_pos = _bucket_by_shard(
-        dev_rows, num_shards, block, cap)
+    if bucketing is None:
+        bucketing = _bucket_by_shard(dev_rows, num_shards, block, cap)
+    send_rows, order, slot_shard, slot_pos = bucketing
     sorted_payload = payload[order]
     send_payload = jnp.zeros((num_shards, cap, aw), payload.dtype)
     # Out-of-range positions (overflow) are dropped by the scatter.

@@ -32,7 +32,8 @@ from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
 from paddlebox_tpu.embedding import TableConfig, make_sparse_optimizer
 from paddlebox_tpu.embedding.grouped import GroupedEngine
-from paddlebox_tpu.embedding.lookup import pull_local, push_local
+from paddlebox_tpu.embedding.lookup import (compute_bucketing, pull_local,
+                                            push_local)
 from paddlebox_tpu.metrics import (AucState, auc_accumulate, auc_compute,
                                    auc_state_init)
 from paddlebox_tpu.ops.data_norm import (data_norm_apply, data_norm_init,
@@ -393,9 +394,14 @@ class CTRTrainer:
             dn_old = params.get("data_norm") if dn_on else None
             # rows[g]: [sum caps_local over group g's slots] — each width
             # group's slots fused into ONE pull (one all_to_all pair per
-            # group; G = #distinct widths, typically 1-3).
-            pulled = [pull_local(t, r, axis=axis)
-                      for t, r in zip(tables, rows)]
+            # group; G = #distinct widths, typically 1-3). The
+            # bucket-by-shard layout is computed ONCE per group and
+            # shared by the pull and the push below (both sort the same
+            # dev_rows — CopyKeys computed once in the reference too).
+            bucketings = [compute_bucketing(t, r)
+                          for t, r in zip(tables, rows)]
+            pulled = [pull_local(t, r, axis=axis, bucketing=bk)
+                      for t, r, bk in zip(tables, rows, bucketings)]
 
             labels1 = labels[:, 0]
             validf = valid.astype(jnp.float32)
@@ -474,7 +480,8 @@ class CTRTrainer:
                     0.0) * occ_valid
                 new_tables.append(push_local(
                     tables[gi], rows[gi], g_embs[gi], g_ws[gi], occ_valid,
-                    clicks, axis=axis, opt=sparse_opt, dcn_axis=dcn))
+                    clicks, axis=axis, opt=sparse_opt, dcn_axis=dcn,
+                    bucketing=bucketings[gi]))
 
             probs = jax.nn.sigmoid(logits)
             auc = auc_of(auc, probs, labels, valid)

@@ -61,14 +61,15 @@ def test_ctr_step_collective_and_scatter_budget():
     # (request + reply) and push (rows + payload). A third pair means a
     # new collective round crept into the hot path.
     assert c.get("all_to_all", 0) == 4, c
-    # Scatter budget: bucket-set x2 (pull/push send), payload add,
-    # owner-side accumulate, AUC histograms, and the gather-VJP
-    # scatter-adds from autodiff. The six-field push layout this
-    # replaced would blow past the ceiling (+5 per width group).
-    assert (c.get("scatter-add", 0) + c.get("scatter", 0)) <= 13, c
-    # One argsort per bucket-by-shard (pull + push) plus AUC at most;
-    # the r02 layout carried 3 argsorts in the push alone.
-    assert c.get("sort", 0) <= 4, c
+    # Scatter budget: ONE shared bucket-set (pull+push share the
+    # bucket-by-shard layout), payload add, owner-side accumulate, AUC
+    # histograms, and the gather-VJP scatter-adds from autodiff. The
+    # six-field push layout this replaced would blow past the ceiling
+    # (+5 per width group).
+    assert (c.get("scatter-add", 0) + c.get("scatter", 0)) <= 12, c
+    # ONE argsort for the shared bucketing + its unorder; the r02
+    # layout carried 3 argsorts in the push alone.
+    assert c.get("sort", 0) <= 3, c
 
 
 def test_jaxpr_summary_sees_inside_shard_map():
