@@ -15,6 +15,8 @@ EXAMPLES = [
     "day_production_loop.py",
     "gpt_hybrid_parallel.py",
     "remote_ps_tiered.py",
+    "graph_deepwalk.py",
+    "multislice_ctr.py",
 ]
 
 
