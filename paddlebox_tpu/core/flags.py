@@ -171,6 +171,18 @@ def set_flags(values: Dict[str, Any]) -> None:
         GLOBAL.set(k, v)
 
 
+def enable_compilation_cache() -> str:
+    """Point jax's persistent compilation cache at the ONE shared
+    location (env default — an operator override wins). Must run before
+    jax initializes a backend; this module imports no jax, so callers
+    (bench.py, the dryrun child env) can use it pre-import. Returns the
+    directory."""
+    d = os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.expanduser("~/.cache/jax_comp_cache"))
+    return d
+
+
 def flag(name: str) -> Any:
     """Scalar read shorthand used on hot paths."""
     return GLOBAL.get(name)

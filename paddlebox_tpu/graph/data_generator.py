@@ -32,10 +32,13 @@ class GraphGenConfig:
 
     ``metapath``: when set (a tuple of edge-type names) walks alternate
     edge types per hop, cycling the tuple to ``walk_len`` hops (the
-    reference's meta_path config). ``degree_negatives``: draw negatives
-    ∝ degree^0.75 instead of uniform. ``feat_name``: attach each batch's
-    center-node feature rows (device gather from the table's feature
-    column — the node-feature-pulling half of the graph engine)."""
+    reference's meta_path config). ``start_type``: restrict walk starts
+    to nodes of that type (table.set_node_types/load_node_file — the
+    reference's typed frontier: a user→item metapath starts from user
+    nodes). ``degree_negatives``: draw negatives ∝ degree^0.75 instead
+    of uniform. ``feat_name``: attach each batch's center-node feature
+    rows (device gather from the table's feature column — the
+    node-feature-pulling half of the graph engine)."""
 
     walk_len: int = 8
     window: int = 3
@@ -43,6 +46,7 @@ class GraphGenConfig:
     batch_walks: int = 64       # start nodes per generated chunk
     seed: int = 0
     metapath: Optional[tuple] = None
+    start_type: Optional[int] = None
     degree_negatives: bool = False
     feat_name: Optional[str] = None
 
@@ -70,6 +74,21 @@ class GraphDataGenerator:
             self._neg_cdf = sampler.degree_neg_cdf(g.degree)
         self._feats = (table.device_feats(config.feat_name)
                        if config.feat_name else None)
+        if config.start_type is not None:
+            self._start_pool = table.nodes_of_type(config.start_type)
+            if self._start_pool.size == 0:
+                raise ValueError(
+                    f"no nodes of type {config.start_type} to start from")
+            if int(self._start_pool.max()) >= self._num_nodes:
+                # jnp's clamping gather would otherwise silently walk
+                # from the wrong node when the node-type table is larger
+                # than the walk graph.
+                raise ValueError(
+                    f"typed start pool has node "
+                    f"{int(self._start_pool.max())} outside the walk "
+                    f"graph's {self._num_nodes} nodes")
+        else:
+            self._start_pool = np.arange(self._num_nodes)
         self._rng = np.random.default_rng(config.seed)
         self._key = jax.random.PRNGKey(config.seed)
 
@@ -78,11 +97,11 @@ class GraphDataGenerator:
         return k
 
     def batches(self, epochs: int = 1) -> Iterator[Dict[str, jax.Array]]:
-        """Yield skip-gram batches covering every node's walks per epoch
-        (role of DoWalkandSage/GenerateSampleBatch)."""
+        """Yield skip-gram batches covering every start-pool node's walks
+        per epoch (role of DoWalkandSage/GenerateSampleBatch)."""
         cfg = self.config
         for _ in range(epochs):
-            starts = self._rng.permutation(self._num_nodes)
+            starts = self._rng.permutation(self._start_pool)
             for i in range(0, len(starts), cfg.batch_walks):
                 chunk = starts[i:i + cfg.batch_walks]
                 if len(chunk) < cfg.batch_walks:  # pad to static shape

@@ -280,3 +280,43 @@ def test_generator_metapath_feats_and_degree_negs():
         np.asarray(batch["center_feats"]),
         feats[np.asarray(batch["centers"])])
     assert np.asarray(batch["negatives"]).max() < 12
+
+
+def test_generator_typed_starts():
+    """start_type restricts the walk start pool to the typed frontier
+    (metapath semantics: a u2i...-path starts from user nodes) —
+    asserted BEHAVIORALLY through emitted batches: with walk_len=1 over
+    "u2i", a user start yields exactly two unmasked (user<->item) pairs
+    per walk, while an item start dead-ends into fully-masked
+    self-pairs, so any item leaking into the start pool shows up as a
+    short or type-violating batch."""
+    users = np.arange(4)
+    items = np.arange(4, 8)
+    rng = np.random.default_rng(1)
+    table = GraphTable()
+    table.add_edges("u2i", np.repeat(users, 2), rng.choice(items, 8),
+                    num_nodes=8)
+    table.add_edges("i2u", np.repeat(items, 2), rng.choice(users, 8),
+                    num_nodes=8)
+    table.set_node_types(np.array([0, 0, 0, 0, 1, 1, 1, 1], np.int32))
+    gen = GraphDataGenerator(
+        table, "u2i",
+        GraphGenConfig(walk_len=1, window=1, batch_walks=4,
+                       metapath=("u2i",), start_type=0))
+    for batch in gen.batches(epochs=2):
+        mask = np.asarray(batch["mask"])
+        c = np.asarray(batch["centers"])[mask]
+        x = np.asarray(batch["contexts"])[mask]
+        # every walk contributes its 2 cross pairs — nothing masked away
+        # by dead-end item starts
+        assert mask.sum() == 2 * 4, mask.sum()
+        assert np.all((c < 4) != (x < 4)), (c, x)  # user<->item only
+    with pytest.raises(ValueError):
+        GraphDataGenerator(table, "u2i",
+                           GraphGenConfig(metapath=("u2i",), start_type=7))
+    # Typed pool larger than the walk graph: loud failure, not a
+    # silently clamped gather.
+    table.set_node_types(np.array([0] * 4 + [1] * 4 + [0], np.int32))
+    with pytest.raises(ValueError):
+        GraphDataGenerator(table, "u2i",
+                           GraphGenConfig(metapath=("u2i",), start_type=0))
