@@ -63,10 +63,43 @@ def tpu_alive(timeout: int = 120) -> bool:
         return False
 
 
-def run_bench(name: str, timeout_s: int) -> dict:
+def tune_flash_blocks(timeout_s: int = 900) -> dict:
+    """Run the flash tile sweep at the gpt bench shape on the live chip;
+    return FLAGS_* env overrides for the winner ({} on any failure —
+    tuning is an optimization, never a blocker)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "tools/tune_flash_blocks.py", "--shape",
+             "gpt"], cwd=REPO, capture_output=True, text=True,
+            timeout=timeout_s)
+    except (subprocess.TimeoutExpired, OSError):
+        return {}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and "best" in d:
+            env = {"FLAGS_flash_block_q": str(d["best"]["block_q"]),
+                   "FLAGS_flash_block_k": str(d["best"]["block_k"])}
+            try:
+                append_log("tune_flash_blocks", d)
+            except OSError:
+                pass  # a logging failure must not discard the winner
+            return env
+    return {}
+
+
+def run_bench(name: str, timeout_s: int,
+              extra_env: dict = None) -> dict:
     """Run one config; return the parsed final JSON line (always returns
     a dict — synthesized error records for timeouts/crashes)."""
     env = {k: v for k, v in os.environ.items() if k != "PBX_BENCH_SCALE"}
+    if extra_env:
+        env.update(extra_env)
     try:
         proc = subprocess.run(
             [sys.executable, "bench.py", name], cwd=REPO, env=env,
@@ -177,6 +210,17 @@ def main() -> None:
             time.sleep(240)
     print("tpu alive — recording", flush=True)
 
+    # Tile tuning first: the gpt/bert configs read FLAGS_flash_block_*
+    # — record them with the tuned tiles, and record WHICH tiles in the
+    # raw log (tune_flash_blocks appends its own line). Skipped when no
+    # selected config uses attention — the sweep must not burn a scarce
+    # tunnel up-window for nothing.
+    flash_env = {}
+    if set(args.configs.split(",")) & {"gpt", "bert_dp"}:
+        flash_env = tune_flash_blocks()
+        if flash_env:
+            print(f"flash tiles tuned: {flash_env}", flush=True)
+
     # One GLOBAL deadline for all retry waits: a permanently dead tunnel
     # must not hold the recorder hostage per-config (a FAILED row beats
     # a hung recorder).
@@ -185,7 +229,9 @@ def main() -> None:
     for name in args.configs.split(","):
         for attempt in (1, 2):
             print(f"[{name}] attempt {attempt}", flush=True)
-            out = run_bench(name, args.timeout_s)
+            out = run_bench(
+                name, args.timeout_s,
+                extra_env=flash_env if name in ("gpt", "bert_dp") else None)
             print(f"[{name}] -> {json.dumps(out)[:300]}", flush=True)
             if "error" not in out or attempt == 2:
                 break
