@@ -58,41 +58,48 @@ def bucket_capacity(n: int, num_shards: int, slack: Optional[float] = None) -> i
 
 
 def _bucket_by_shard(dev_rows: jax.Array, num_shards: int, block: int,
-                     cap: int) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Sort ids into per-destination-shard buckets of static capacity.
+                     cap: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Assign ids to per-destination-shard buckets of static capacity.
 
     Role of split_input_to_shard + fill_shard_key (heter_comm_inl.h:273).
 
+    SORT-FREE: with only ``num_shards`` distinct destinations, each
+    element's rank within its bucket is a running count — a one-hot
+    cumsum — so no argsort, no sorted/unorder permutation gathers, and
+    (slot_shard, slot_pos) come back in ORIGINAL element order (the r03
+    layout paid an argsort + two permutation gathers per step for the
+    same result). The [n, S] one-hot is ~global-ids-sized regardless of
+    the shard count (per-device n shrinks as S grows).
+
     Returns (send_rows [num_shards, cap] dest-local rows with trash-row
-    fill, order [n] sort permutation, slot_shard [n], slot_pos [n]) where
-    (slot_shard[j], slot_pos[j]) locates sorted element j's reply cell;
-    slot_pos >= cap marks overflow (dropped — reply reads are masked).
+    fill, slot_shard [n], slot_pos [n]) where (slot_shard[j],
+    slot_pos[j]) locates element j's bucket cell; slot_pos >= cap marks
+    overflow (dropped — reply reads are masked).
     """
     n = dev_rows.shape[0]
     trash = block - 1  # last row of each shard block is the trash row
-    shard_of = jnp.clip(dev_rows // block, 0, num_shards - 1)
-    order = jnp.argsort(shard_of, stable=True)
-    sorted_rows = dev_rows[order]
-    sorted_shard = shard_of[order]
-    starts = jnp.searchsorted(sorted_shard, jnp.arange(num_shards))
-    pos = jnp.arange(n) - starts[sorted_shard]
-    local_row = sorted_rows % block
+    shard_of = jnp.clip(dev_rows // block, 0, num_shards - 1
+                        ).astype(jnp.int32)
+    onehot = (shard_of[:, None]
+              == jnp.arange(num_shards, dtype=jnp.int32)[None, :])
+    ranks = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
+    pos = jnp.take_along_axis(ranks, shard_of[:, None], axis=1)[:, 0] - 1
+    local_row = (dev_rows % block).astype(jnp.int32)
     send_rows = jnp.full((num_shards, cap), trash, jnp.int32)
     # Overflow entries (pos >= cap) use an out-of-range column index so the
     # scatter drops them instead of clobbering cell 0.
-    send_rows = send_rows.at[sorted_shard, pos].set(
-        local_row.astype(jnp.int32), mode="drop")
-    return send_rows, order, sorted_shard, pos
+    send_rows = send_rows.at[shard_of, pos].set(local_row, mode="drop")
+    return send_rows, shard_of, pos
 
 
 def compute_bucketing(table: PassTable,
                       dev_rows: jax.Array) -> Optional[Tuple]:
     """The bucket-by-shard layout for one (table, ids) pair — the ONE
     source of truth for block/cap so a caller sharing the layout between
-    pull_local and push_local (both sort the same dev_rows; computing it
-    twice pays a second argsort+searchsorted per step) can never drift
-    from their internal fallback. None when the table is unsharded
-    (single-shard paths never bucket)."""
+    pull_local and push_local (both bucket the same dev_rows; computing
+    it twice pays the one-hot cumsum + bucket scatter twice per step)
+    can never drift from their internal fallback. None when the table is
+    unsharded (single-shard paths never bucket)."""
     if table.num_shards == 1:
         return None
     block = table.rows_per_shard + 1
@@ -139,16 +146,15 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
 
     # ``bucketing``: the train step computes the bucket-by-shard layout
     # ONCE per width group and shares it between this pull and the
-    # matching push — the two sort the SAME dev_rows, so recomputing
-    # would pay a second argsort+searchsorted per step (~8 ms at bench
-    # scale, PROFILE.md) for identical results.
+    # matching push — both bucket the SAME dev_rows, so recomputing
+    # would pay the layout twice per step for identical results.
     if bucketing is None:
         bucketing = _bucket_by_shard(dev_rows, num_shards, block, cap)
-    send_rows, order, slot_shard, slot_pos = bucketing
+    send_rows, slot_shard, slot_pos = bucketing
     # Shape [1] (not scalar) so prefix out_specs like P(axis) remain
     # valid for the returned dict under shard_map.
     overflow = jnp.sum(((slot_pos >= cap)
-                        & (dev_rows[order] % block != trash)
+                        & (dev_rows % block != trash)
                         ).astype(jnp.int32)).reshape(1)
 
     # Exchange requests: recv_req[s, c] = row requested by peer s.
@@ -162,11 +168,11 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
         served.reshape(num_shards * cap, pw), axis,
         split_axis=0, concat_axis=0, tiled=True
     ).reshape(num_shards, cap, pw)
-    # Route replies back: reply[s, c] = value from shard s for my bucket c.
-    unorder = jnp.argsort(order)
+    # Route replies back: (slot_shard, slot_pos) are in original element
+    # order (sort-free bucketing), so one gather finishes the pull.
     in_cap = slot_pos < cap
     picked = reply[slot_shard, jnp.where(in_cap, slot_pos, 0)]
-    picked = jnp.where(in_cap[:, None], picked, 0)[unorder]
+    picked = jnp.where(in_cap[:, None], picked, 0)
     return {
         "emb": picked[:, :d],
         "w": picked[:, d],
@@ -299,12 +305,13 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
     cap = bucket_capacity(n, num_shards)
     if bucketing is None:
         bucketing = _bucket_by_shard(dev_rows, num_shards, block, cap)
-    send_rows, order, slot_shard, slot_pos = bucketing
-    sorted_payload = payload[order]
+    send_rows, slot_shard, slot_pos = bucketing
     send_payload = jnp.zeros((num_shards, cap, aw), payload.dtype)
+    # (slot_shard, slot_pos) are in original element order — the payload
+    # scatters straight into its bucket cells, no permutation gather.
     # Out-of-range positions (overflow) are dropped by the scatter.
     send_payload = send_payload.at[slot_shard, slot_pos].add(
-        sorted_payload, mode="drop")
+        payload, mode="drop")
 
     recv_rows = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0,
                                tiled=True).reshape(num_shards * cap)

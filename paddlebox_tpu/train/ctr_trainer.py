@@ -396,8 +396,9 @@ class CTRTrainer:
             # group's slots fused into ONE pull (one all_to_all pair per
             # group; G = #distinct widths, typically 1-3). The
             # bucket-by-shard layout is computed ONCE per group and
-            # shared by the pull and the push below (both sort the same
-            # dev_rows — CopyKeys computed once in the reference too).
+            # shared by the pull and the push below (both bucket the
+            # same dev_rows — CopyKeys computed once in the reference
+            # too).
             bucketings = [compute_bucketing(t, r)
                           for t, r in zip(tables, rows)]
             pulled = [pull_local(t, r, axis=axis, bucketing=bk)

@@ -67,9 +67,12 @@ def test_ctr_step_collective_and_scatter_budget():
     # six-field push layout this replaced would blow past the ceiling
     # (+5 per width group).
     assert (c.get("scatter-add", 0) + c.get("scatter", 0)) <= 12, c
-    # ONE argsort for the shared bucketing + its unorder; the r02
-    # layout carried 3 argsorts in the push alone.
-    assert c.get("sort", 0) <= 3, c
+    # SORT-FREE bucketing: positions come from a one-hot cumsum, so the
+    # step carries ZERO sorts (the r02 layout carried 3 argsorts in the
+    # push alone; the Pallas accumulate's internal sort lives behind the
+    # TPU-only flag and is not part of this CPU lowering).
+    assert c.get("sort", 0) == 0, c
+    assert c.get("cumsum", 0) >= 1, c
 
 
 def test_jaxpr_summary_sees_inside_shard_map():

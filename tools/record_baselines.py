@@ -46,6 +46,26 @@ CONFIGS = {
 BEGIN = "<!-- record_baselines:begin -->"
 END = "<!-- record_baselines:end -->"
 
+# Configs whose steps read the flash-attention tiles (tuned tiles are
+# applied to exactly this set — one constant, no drift).
+ATTENTION_CONFIGS = {"gpt", "bert_dp"}
+
+
+def _last_json_line(stdout: str):
+    """Last stdout line that parses to a JSON OBJECT, or None (shared by
+    the bench-output and tuner-output parsers)."""
+    for cand in reversed(stdout.strip().splitlines()):
+        cand = cand.strip()
+        if not (cand.startswith("{") and cand.endswith("}")):
+            continue
+        try:
+            d = json.loads(cand)
+        except ValueError:
+            continue
+        if isinstance(d, dict):
+            return d
+    return None
+
 
 def tpu_alive(timeout: int = 120) -> bool:
     """True only when a real TPU backend answers — a silent CPU fallback
@@ -74,23 +94,18 @@ def tune_flash_blocks(timeout_s: int = 900) -> dict:
             timeout=timeout_s)
     except (subprocess.TimeoutExpired, OSError):
         return {}
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if not (line.startswith("{") and line.endswith("}")):
-            continue
-        try:
-            d = json.loads(line)
-        except ValueError:
-            continue
-        if isinstance(d, dict) and "best" in d:
-            env = {"FLAGS_flash_block_q": str(d["best"]["block_q"]),
-                   "FLAGS_flash_block_k": str(d["best"]["block_k"])}
-            try:
-                append_log("tune_flash_blocks", d)
-            except OSError:
-                pass  # a logging failure must not discard the winner
-            return env
-    return {}
+    d = _last_json_line(proc.stdout)
+    best = d.get("best") if d else None
+    if not isinstance(best, dict) or "block_q" not in best \
+            or "block_k" not in best:
+        return {}  # schema drift or tuner failure: default tiles
+    env = {"FLAGS_flash_block_q": str(best["block_q"]),
+           "FLAGS_flash_block_k": str(best["block_k"])}
+    try:
+        append_log("tune_flash_blocks", d)
+    except OSError:
+        pass  # a logging failure must not discard the winner
+    return env
 
 
 def run_bench(name: str, timeout_s: int,
@@ -107,21 +122,11 @@ def run_bench(name: str, timeout_s: int,
     except subprocess.TimeoutExpired:
         return {"metric": f"{name}_FAILED", "value": 0.0,
                 "error": f"recorder timeout after {timeout_s}s"}
-    line = ""
-    for cand in reversed(proc.stdout.strip().splitlines()):
-        cand = cand.strip()
-        if cand.startswith("{") and cand.endswith("}"):
-            line = cand
-            break
-    if not line:
+    out = _last_json_line(proc.stdout)
+    if out is None:
         return {"metric": f"{name}_FAILED", "value": 0.0,
                 "error": f"no JSON output (rc={proc.returncode}); "
                          f"stderr tail: {proc.stderr[-300:]!r}"}
-    try:
-        out = json.loads(line)
-    except ValueError:
-        return {"metric": f"{name}_FAILED", "value": 0.0,
-                "error": f"unparseable output line: {line[:200]!r}"}
     if "error" not in out and out.get("platform") != "tpu":
         # Never clobber an existing error (the watchdog's stalled-phase
         # message is the diagnostic this recorder exists to capture).
@@ -216,7 +221,7 @@ def main() -> None:
     # selected config uses attention — the sweep must not burn a scarce
     # tunnel up-window for nothing.
     flash_env = {}
-    if set(args.configs.split(",")) & {"gpt", "bert_dp"}:
+    if set(args.configs.split(",")) & ATTENTION_CONFIGS:
         flash_env = tune_flash_blocks()
         if flash_env:
             print(f"flash tiles tuned: {flash_env}", flush=True)
@@ -231,7 +236,7 @@ def main() -> None:
             print(f"[{name}] attempt {attempt}", flush=True)
             out = run_bench(
                 name, args.timeout_s,
-                extra_env=flash_env if name in ("gpt", "bert_dp") else None)
+                extra_env=flash_env if name in ATTENTION_CONFIGS else None)
             print(f"[{name}] -> {json.dumps(out)[:300]}", flush=True)
             if "error" not in out or attempt == 2:
                 break
