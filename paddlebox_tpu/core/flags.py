@@ -171,6 +171,15 @@ def set_flags(values: Dict[str, Any]) -> None:
         GLOBAL.set(k, v)
 
 
+def pallas_kernels_enabled() -> bool:
+    """True when auto-selection may pick a Pallas kernel: TPU backend
+    AND the enable_pallas_kernels master switch. One predicate for every
+    kernel gate (lookup scatter, flash attention, seqpool-CVM)."""
+    import jax
+    return jax.default_backend() == "tpu" and bool(
+        flag("enable_pallas_kernels"))
+
+
 def enable_compilation_cache() -> str:
     """Point jax's persistent compilation cache at the ONE shared
     location (env default — an operator override wins). Must run before
@@ -211,15 +220,10 @@ define_flag("pass_table_pow2_rows", 1,
             "so consecutive passes with different key counts reuse the "
             "compiled train step (1 recompile per size DOUBLING instead "
             "of every pass; costs <=2x table HBM in the worst case)")
-define_flag("padbox_record_pool_max", 1 << 22,
-            "max pooled slot records held for reuse by the data pipeline "
-            "(role of FLAGS_padbox_record_pool_max_size)")
 define_flag("padbox_max_shuffle_wait_count", 16,
-            "flow-control window for cross-node dataset shuffle "
-            "(role of FLAGS_padbox_max_shuffle_wait_count)")
-define_flag("dense_sync_steps", 1,
-            "k-step dense parameter sync interval in BoxPS-style training "
-            "(role of BoxPSWorker::SyncParam sync_step)")
+            "max concurrent sends per rank in the cross-node shuffle "
+            "exchange (flow-control window — role of "
+            "FLAGS_padbox_max_shuffle_wait_count; transport.py)")
 define_flag("xbox_quant_bits", 0,
             "xbox serving-export embedding quantization: 0 = float32, "
             "8/16 = symmetric per-row int8/int16 with an f32 scale "

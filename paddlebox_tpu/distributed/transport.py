@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import queue
 import socket
 import struct
 import threading
@@ -179,12 +180,34 @@ class TcpTransport:
         self._round += 1
         out: List[Optional[bytes]] = [None] * self.world
         out[self.rank] = buffers[self.rank]
-        senders = []
+        # Flow control (role of FLAGS_padbox_max_shuffle_wait_count in
+        # the reference's shuffle): at most `window` concurrent sends per
+        # rank — an unbounded fan-out at large world sizes floods the
+        # receiver sockets and this host's thread table, so the window
+        # bounds BOTH: `window` worker threads drain a destination
+        # queue (not one gated thread per destination).
+        from paddlebox_tpu.core import flags as _flags
+        window = max(1, int(_flags.flag("padbox_max_shuffle_wait_count")))
+        dst_q: "queue.Queue[int]" = queue.Queue()
         for dst in range(self.world):
-            if dst == self.rank:
-                continue
-            t = threading.Thread(target=self._send,
-                                 args=(dst, rnd, buffers[dst]), daemon=True)
+            if dst != self.rank:
+                dst_q.put(dst)
+        send_errors: List[BaseException] = []
+
+        def _drain() -> None:
+            while True:
+                try:
+                    dst = dst_q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    self._send(dst, rnd, buffers[dst])
+                except BaseException as e:  # surfaced after the joins
+                    send_errors.append(e)
+
+        senders = []
+        for _ in range(min(window, self.world - 1)):
+            t = threading.Thread(target=_drain, daemon=True)
             t.start()
             senders.append(t)
         want = [(src, rnd) for src in range(self.world) if src != self.rank]
@@ -208,6 +231,8 @@ class TcpTransport:
                     del self._inbox[k]
         for t in senders:
             t.join()
+        if send_errors:
+            raise send_errors[0]
         return out  # type: ignore[return-value]
 
     def exchange_objects(self, objs: Sequence[Any]) -> List[Any]:

@@ -194,7 +194,7 @@ def _accumulate(rows: jax.Array, payload: jax.Array,
     the kernel's per-block budget must not pay for."""
     mode = flags.flag("sparse_scatter_kernel")
     use_pallas = mode in ("pallas", "interpret") or (
-        mode == "auto" and jax.default_backend() == "tpu")
+        mode == "auto" and flags.pallas_kernels_enabled())
     if not use_pallas:
         acc = jnp.zeros((block, payload.shape[-1]), payload.dtype)
         return acc.at[rows].add(payload)

@@ -406,14 +406,14 @@ def flash_attention(q, k, v, *, causal: bool = False,
     tools/tune_flash_blocks.py) so every call site picks up the tuned
     tiles without plumbing.
     """
+    from paddlebox_tpu.core import flags as _flags
     if block_q is None or block_k is None:
-        from paddlebox_tpu.core import flags as _flags
         block_q = int(block_q or _flags.flag("flash_block_q"))
         block_k = int(block_k or _flags.flag("flash_block_k"))
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     if use_pallas is None:
-        use_pallas = interpret or jax.default_backend() == "tpu"
+        use_pallas = interpret or _flags.pallas_kernels_enabled()
     if not use_pallas:
         return flash_attention_reference(q, k, v, causal=causal,
                                          scale=scale, q_offset=q_offset,

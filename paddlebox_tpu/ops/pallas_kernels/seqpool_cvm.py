@@ -149,7 +149,8 @@ def seqpool_cvm_pallas(emb: jax.Array, show: jax.Array, click: jax.Array,
     [num_rows, D].
     """
     if use_pallas is None:
-        use_pallas = interpret or jax.default_backend() == "tpu"
+        from paddlebox_tpu.core import flags as _flags
+        use_pallas = interpret or _flags.pallas_kernels_enabled()
     if not use_pallas:
         from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
         return fused_seqpool_cvm(emb, show, click, segments, num_rows,

@@ -749,15 +749,25 @@ class CTRTrainer:
                 params = jax.device_put(self._async_dense.pull_dense(), rep)
             sync_flag = flags_01[
                 1 if (mode == "kstep" and (nsteps + 1) % k == 0) else 0]
+            profiling = bool(flags.flag("profile_trainer"))
             with self.timers.scope("device_step"):
                 out = self._step_fn(
                     tables, params, opt_state, auc, rows, segs,
                     labels, valid, dense, sync_flag)
                 tables, params, opt_state, auc, loss, overflow = out[:6]
+                if profiling:
+                    # Completion INSIDE the scope so device_step records
+                    # the real step wall time, not async dispatch.
+                    # Profiling trades the pipelining away on purpose
+                    # (TrainFilesWithProfiler does the same).
+                    float(loss)
             if mode == "async":
                 # PushDense role: hand psum'd grads to the host updater.
                 self._async_dense.push_dense(jax.device_get(out[6]))
             nsteps += 1
+            if profiling:
+                log.vlog(0, "step %d: loss=%.5f %s", nsteps, float(loss),
+                         self.timers.report())
             if self.config.check_nan_inf or flags.flag("check_nan_inf"):
                 lf = float(loss)
                 if not np.isfinite(lf):

@@ -39,7 +39,7 @@ def test_flag_type_check():
 
 def test_builtin_flags_present():
     vals = flags.get_flags(["check_nan_inf", "auc_num_buckets",
-                            "dense_sync_steps"])
+                            "padbox_max_shuffle_wait_count"])
     assert vals["auc_num_buckets"] == 1 << 20
     assert vals["check_nan_inf"] is False
 

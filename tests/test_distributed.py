@@ -74,6 +74,36 @@ def test_tcp_transport_exchange():
         t.close()
 
 
+def test_tcp_transport_exchange_window_1():
+    """Flow-controlled exchange (FLAGS_padbox_max_shuffle_wait_count=1:
+    one in-flight send per rank) must still complete the full
+    all-to-all — the window serializes sends, never drops them."""
+    from paddlebox_tpu.core import flags as flagmod
+    old = flagmod.flag("padbox_max_shuffle_wait_count")
+    flagmod.set_flags({"padbox_max_shuffle_wait_count": 1})
+    try:
+        ports = _free_ports(3)
+        eps = [f"127.0.0.1:{p}" for p in ports]
+        transports = [TcpTransport(r, eps) for r in range(3)]
+        results = [None] * 3
+
+        def worker(r):
+            bufs = [f"w{r}->{d}".encode() for d in range(3)]
+            results[r] = transports[r].exchange(bufs, timeout=30)
+
+        ts = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for r in range(3):
+            assert results[r] == [f"w{s}->{r}".encode() for s in range(3)]
+        for t in transports:
+            t.close()
+    finally:
+        flagmod.set_flags({"padbox_max_shuffle_wait_count": old})
+
+
 def test_global_shuffle_over_tcp(tmp_path):
     """Two-rank dataset global shuffle through the real TCP transport —
     the ShuffleData/ReceiveSuffleData round trip."""
