@@ -17,7 +17,6 @@ Two implementations:
 from __future__ import annotations
 
 import os
-import pickle
 import queue
 import socket
 import struct
@@ -220,7 +219,12 @@ class TcpTransport:
                             out[src] = self._inbox.pop((src, rnd))
                         break
                 if time.time() > deadline:
-                    raise TimeoutError("exchange timed out")
+                    # Surface the root cause: a refused send explains a
+                    # missing buffer far better than a bare timeout.
+                    raise TimeoutError(
+                        "exchange timed out"
+                        + (f" (send errors: {send_errors!r})"
+                           if send_errors else ""))
                 time.sleep(0.002)
         finally:
             # Success or timeout, this round is over: drop any partial or
@@ -236,9 +240,13 @@ class TcpTransport:
         return out  # type: ignore[return-value]
 
     def exchange_objects(self, objs: Sequence[Any]) -> List[Any]:
-        bufs = [pickle.dumps(o, protocol=pickle.HIGHEST_PROTOCOL)
-                for o in objs]
-        return [pickle.loads(b) for b in self.exchange(bufs)]
+        """All-to-all of structured values over the TYPED wire encoding
+        (dicts/lists/numpy/scalars — distributed/wire.py): the shuffle
+        path carries no pickle, same discipline as the PS protocol (a
+        malformed frame raises WireError instead of executing bytes)."""
+        from paddlebox_tpu.distributed import wire
+        bufs = [wire.dumps(o) for o in objs]
+        return [wire.loads(b) for b in self.exchange(bufs)]
 
     def close(self) -> None:
         self._running = False
@@ -262,7 +270,13 @@ def make_chunk_exchanger(transport: TcpTransport
     from paddlebox_tpu.data.columnar import ColumnarChunk
 
     def exchange(buckets: List[ColumnarChunk]) -> ColumnarChunk:
-        received = transport.exchange_objects(buckets)
-        return ColumnarChunk.concat(received)
+        # Chunk -> dict-of-arrays for the typed wire via the dataclass
+        # fields themselves (a future ColumnarChunk column rides along
+        # automatically instead of being silently dropped); rebuilt on
+        # receive. ColumnarChunk is exactly wire-shaped: numpy leaves.
+        received = transport.exchange_objects(
+            [vars(b).copy() for b in buckets])
+        return ColumnarChunk.concat(
+            [ColumnarChunk(**d) for d in received])
 
     return exchange
