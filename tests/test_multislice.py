@@ -199,3 +199,33 @@ def test_ctr_multislice_kstep_parity_vs_flat():
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
         params_f, params_s)
+
+
+def test_hierarchical_psum_tree_mixed_dtypes_and_empty():
+    """The fused buffer promotes to the widest leaf dtype and casts back
+    per-leaf (bf16 grads ride with f32 without precision loss beyond
+    bf16's own); an empty tree is a no-op, not an error."""
+    mesh = _mesh(slice_=2, dp=4)
+    rng = np.random.default_rng(1)
+    tree = {"h": jnp.asarray(rng.normal(size=(6,)), jnp.bfloat16),
+            "f": jnp.asarray(rng.normal(size=(5,)), jnp.float32)}
+
+    def hier(t):
+        return hierarchical_psum_tree(t, inner_axis="dp",
+                                      outer_axis="slice")
+
+    out = jax.jit(jax.shard_map(hier, mesh=mesh, in_specs=P(),
+                                out_specs=P(), check_vma=False))(tree)
+    assert out["h"].dtype == jnp.bfloat16
+    assert out["f"].dtype == jnp.float32
+    # 8 replicated copies summed: f32 leaf is exact; bf16 leaf promoted
+    # to f32 for the sum, only the final cast re-quantizes.
+    np.testing.assert_allclose(np.asarray(out["f"]),
+                               np.asarray(tree["f"]) * 8, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out["h"], np.float32),
+        np.asarray(tree["h"], np.float32) * 8, rtol=2e-2)
+
+    out_e = jax.jit(jax.shard_map(hier, mesh=mesh, in_specs=P(),
+                                  out_specs=P(), check_vma=False))({})
+    assert out_e == {}
