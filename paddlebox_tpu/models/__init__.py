@@ -12,6 +12,7 @@ from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.din_rank import DINRank, build_rank_offset
 from paddlebox_tpu.models.multitask import MMoE, SharedBottomMultiTask
 from paddlebox_tpu.models.wide_deep import WideDeep
+from paddlebox_tpu.models.xdeepfm import XDeepFM
 
 __all__ = ["DCN", "DeepFM", "DINRank", "MMoE", "SharedBottomMultiTask",
-           "WideDeep", "build_rank_offset"]
+           "WideDeep", "XDeepFM", "build_rank_offset"]

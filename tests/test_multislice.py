@@ -203,14 +203,21 @@ def test_ctr_multislice_kstep_parity_vs_flat():
 
 def test_hierarchical_psum_tree_mixed_dtypes_and_empty():
     """The fused buffer promotes to the widest leaf dtype and casts back
-    per-leaf (bf16 grads ride with f32 without precision loss beyond
-    bf16's own); an empty tree is a no-op, not an error."""
+    per-leaf; an empty tree is a no-op, not an error. Per-rank
+    contributions DIFFER (scaled by a global rank index) so the sum is
+    non-trivial — summing 8 identical copies would be an exact power-of-
+    two shift even in raw bf16 and could not detect a dropped
+    promotion."""
     mesh = _mesh(slice_=2, dp=4)
     rng = np.random.default_rng(1)
     tree = {"h": jnp.asarray(rng.normal(size=(6,)), jnp.bfloat16),
             "f": jnp.asarray(rng.normal(size=(5,)), jnp.float32)}
 
     def hier(t):
+        r = (lax.axis_index("slice") * lax.axis_size("dp")
+             + lax.axis_index("dp") + 1).astype(jnp.float32)
+        t = jax.tree.map(lambda x: (x.astype(jnp.float32)
+                                    * r).astype(x.dtype), t)
         return hierarchical_psum_tree(t, inner_axis="dp",
                                       outer_axis="slice")
 
@@ -218,13 +225,12 @@ def test_hierarchical_psum_tree_mixed_dtypes_and_empty():
                                 out_specs=P(), check_vma=False))(tree)
     assert out["h"].dtype == jnp.bfloat16
     assert out["f"].dtype == jnp.float32
-    # 8 replicated copies summed: f32 leaf is exact; bf16 leaf promoted
-    # to f32 for the sum, only the final cast re-quantizes.
+    scale = float(sum(range(1, 9)))   # ranks 1..8
     np.testing.assert_allclose(np.asarray(out["f"]),
-                               np.asarray(tree["f"]) * 8, rtol=1e-6)
+                               np.asarray(tree["f"]) * scale, rtol=1e-5)
     np.testing.assert_allclose(
         np.asarray(out["h"], np.float32),
-        np.asarray(tree["h"], np.float32) * 8, rtol=2e-2)
+        np.asarray(tree["h"], np.float32) * scale, rtol=3e-2)
 
     out_e = jax.jit(jax.shard_map(hier, mesh=mesh, in_specs=P(),
                                   out_specs=P(), check_vma=False))({})
