@@ -18,7 +18,10 @@ Role of the HeterComm data path (``heter_comm_inl.h``):
 
 Everything is static-shape: per-destination buckets have fixed capacity
 ``C = ceil(n/num_shards * slack)`` (slack flag ``embedding_shard_slack``);
-overflow entries fall into the per-shard trash row. All functions are
+overflow entries fall into the per-shard trash row. Bucketing is
+SORT-FREE (one-hot cumsum ranks in original element order — zero sorts
+in the whole step) and computed once per step, shared by pull and push
+(``compute_bucketing``). All functions are
 *per-device* bodies meant to run inside ``jax.shard_map`` with the table's
 leading dim sharded over ``axis`` and id/grad batches sharded likewise.
 With ``num_shards == 1`` (single-chip or replicated-table configs) the
