@@ -212,6 +212,18 @@ define_flag("enable_pallas_kernels", True,
 define_flag("embedding_shard_slack", 1.3,
             "over-allocation factor for per-shard bucket capacity in the "
             "sparse pull/push all-to-all (static-shape padding headroom)")
+define_flag("embedding_dedup", True,
+            "merge duplicate ids BEFORE the pull/push all-to-all: only the "
+            "first occurrence of each id consumes a bucket cell and "
+            "duplicate grads sum into that cell pre-exchange (role of "
+            "dedup_keys_and_fillidx + dynamic_merge_grad, heter_comm.h:69,"
+            "192); hot keys can no longer overflow a shard bucket")
+define_flag("embedding_unique_frac", 1.0,
+            "expected unique fraction of per-device ids, used to size the "
+            "per-shard bucket capacity when embedding_dedup is on (1.0 = "
+            "assume all unique, always safe; CTR batches typically dedup "
+            "2-4x, so 0.5 halves the all-to-all bytes). Overflowing ids "
+            "degrade to counted drops, never corruption")
 define_flag("trainer_prefetch_depth", 2,
             "bounded queue depth for the train-pass host-map producer "
             "thread (batches packed ahead of the device)")

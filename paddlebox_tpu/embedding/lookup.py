@@ -17,11 +17,15 @@ Role of the HeterComm data path (``heter_comm_inl.h``):
   layout paid that 6x per step — see tools/profile_step.py).
 
 Everything is static-shape: per-destination buckets have fixed capacity
-``C = ceil(n/num_shards * slack)`` (slack flag ``embedding_shard_slack``);
-overflow entries fall into the per-shard trash row. Bucketing is
-SORT-FREE (one-hot cumsum ranks in original element order — zero sorts
-in the whole step) and computed once per step, shared by pull and push
-(``compute_bucketing``). All functions are
+``C = ceil(n_unique/num_shards * slack)`` (flags
+``embedding_shard_slack`` / ``embedding_unique_frac``); overflow entries
+fall into the per-shard trash row. Bucketing is SORT-FREE (one-hot
+cumsum ranks in original element order — zero sorts in the whole step),
+DEDUPED (duplicate ids share one bucket cell, so pull/push transfer
+unique rows only and duplicate grads merge sender-side before the
+exchange — roles of dedup_keys_and_fillidx, heter_comm.h:192, and
+dynamic_merge_grad, heter_comm.h:69-83, without their radix sorts), and
+computed once per step, shared by pull and push (``compute_bucketing``). All functions are
 *per-device* bodies meant to run inside ``jax.shard_map`` with the table's
 leading dim sharded over ``axis`` and id/grad batches sharded likewise.
 With ``num_shards == 1`` (single-chip or replicated-table configs) the
@@ -44,53 +48,94 @@ from paddlebox_tpu.embedding.optimizers import SparseAdagrad, SparseOptimizer
 from paddlebox_tpu.embedding.table import PassTable, TableConfig
 
 
-def bucket_capacity(n: int, num_shards: int, slack: Optional[float] = None) -> int:
+def bucket_capacity(n: int, num_shards: int, slack: Optional[float] = None,
+                    unique_frac: Optional[float] = None) -> int:
     """Static per-destination bucket size for n ids over num_shards.
 
     Mean + 4σ binomial headroom (keys hash ~uniformly across shards), scaled
     by the ``embedding_shard_slack`` flag: overflow probability per bucket is
     ~3e-5 at 4σ, and overflowing entries degrade to a dropped lookup (zeros)
     /dropped grad rather than corruption.
+
+    With dedup enabled (``embedding_dedup``) a bucket cell holds a UNIQUE
+    key, so capacity sizes to the expected unique-id count
+    ``n * embedding_unique_frac`` instead of the occurrence count — this is
+    where dedup turns into an all-to-all byte reduction (the reference gets
+    the same effect from transferring d_merged_keys after
+    dedup_keys_and_fillidx, heter_comm.h:192).
     """
     if slack is None:
         slack = flags.flag("embedding_shard_slack")
-    mean = n / num_shards
+    if unique_frac is None:
+        unique_frac = (flags.flag("embedding_unique_frac")
+                       if flags.flag("embedding_dedup") else 1.0)
+    n_eff = max(min(int(n * unique_frac + 0.999999), n), 1)
+    mean = n_eff / num_shards
     c = int(slack * (mean + 4.0 * mean ** 0.5 + 8.0)) + 1
     c = min(max(c, 1), n)
     return -(-c // 8) * 8 if c >= 8 else c
 
 
 def _bucket_by_shard(dev_rows: jax.Array, num_shards: int, block: int,
-                     cap: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                     cap: int, dedup: Optional[bool] = None
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Assign ids to per-destination-shard buckets of static capacity.
 
-    Role of split_input_to_shard + fill_shard_key (heter_comm_inl.h:273).
+    Role of split_input_to_shard + fill_shard_key (heter_comm_inl.h:273)
+    plus — with ``dedup`` (flag ``embedding_dedup``, default on) —
+    dedup_keys_and_fillidx (heter_comm.h:192): only the FIRST occurrence
+    of each id consumes a bucket cell; later occurrences map to the same
+    (shard, pos) cell, so the pull reply fans back out through the
+    existing routing gather and push payloads for duplicates SUM into one
+    cell via the existing bucket scatter-add — the pre-exchange merge the
+    reference does with dynamic_merge_grad (heter_comm.h:69-83). A hot
+    key therefore occupies exactly one cell and can never overflow a
+    bucket by repetition; all-to-all bytes scale with UNIQUE ids.
 
-    SORT-FREE: with only ``num_shards`` distinct destinations, each
-    element's rank within its bucket is a running count — a one-hot
-    cumsum — so no argsort, no sorted/unorder permutation gathers, and
-    (slot_shard, slot_pos) come back in ORIGINAL element order (the r03
-    layout paid an argsort + two permutation gathers per step for the
-    same result). The [n, S] one-hot is ~global-ids-sized regardless of
-    the shard count (per-device n shrinks as S grows).
+    SORT-FREE, dedup included: destinations rank by one-hot cumsum (no
+    argsort), and representatives are found by a scatter-min of the
+    element index over the destination-row space (first occurrence = min
+    index) — one [R]-scratch scatter-min + two [n] gathers, still zero
+    sorts in the whole step (the reference's dedup is 2x cub radix sort,
+    heter_comm.h:196-205).
 
     Returns (send_rows [num_shards, cap] dest-local rows with trash-row
     fill, slot_shard [n], slot_pos [n]) where (slot_shard[j],
     slot_pos[j]) locates element j's bucket cell; slot_pos >= cap marks
-    overflow (dropped — reply reads are masked).
+    overflow (dropped — reply reads are masked). With dedup, duplicate
+    elements share a cell (same id -> same cell, by construction).
     """
+    if dedup is None:
+        dedup = bool(flags.flag("embedding_dedup"))
     n = dev_rows.shape[0]
     trash = block - 1  # last row of each shard block is the trash row
     shard_of = jnp.clip(dev_rows // block, 0, num_shards - 1
                         ).astype(jnp.int32)
+    local_row = (dev_rows % block).astype(jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
     onehot = (shard_of[:, None]
               == jnp.arange(num_shards, dtype=jnp.int32)[None, :])
+    if dedup:
+        # Representative (first occurrence) per destination row: the row
+        # space is exact (shard * block + local), so there are no hash
+        # collisions and the merge is never wrong — the [R] int32 scratch
+        # is small next to the [R, W] table it indexes into.
+        key = shard_of * block + local_row
+        buf = jnp.full((num_shards * block,), n, jnp.int32)
+        buf = buf.at[key].min(idx, mode="drop")
+        first_idx = buf[key]
+        is_first = first_idx == idx
+        # Only representatives consume bucket cells.
+        onehot = onehot & is_first[:, None]
     ranks = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
     pos = jnp.take_along_axis(ranks, shard_of[:, None], axis=1)[:, 0] - 1
-    local_row = (dev_rows % block).astype(jnp.int32)
+    if dedup:
+        # Every occurrence adopts its representative's bucket cell.
+        pos = pos[first_idx]
     send_rows = jnp.full((num_shards, cap), trash, jnp.int32)
     # Overflow entries (pos >= cap) use an out-of-range column index so the
-    # scatter drops them instead of clobbering cell 0.
+    # scatter drops them instead of clobbering cell 0. Under dedup,
+    # duplicates write the SAME local_row into the same cell — idempotent.
     send_rows = send_rows.at[shard_of, pos].set(local_row, mode="drop")
     return send_rows, shard_of, pos
 
@@ -110,6 +155,22 @@ def compute_bucketing(table: PassTable,
     return _bucket_by_shard(dev_rows, table.num_shards, block, cap)
 
 
+def exchange_bytes(table: PassTable, n: int) -> int:
+    """Static per-device all-to-all bytes for one pull+push round over
+    ``n`` ids — the observable that dedup + ``embedding_unique_frac``
+    shrink (the reference transfers d_merged_keys/grads after dedup,
+    heter_comm.h:192; here the byte count is a pure function of the
+    static bucket capacity, so trainers can report it per step without
+    touching the hot path)."""
+    if table.num_shards == 1:
+        return 0
+    cap = bucket_capacity(n, table.num_shards)
+    s = table.num_shards
+    pull = s * cap * 4 + s * cap * table.pull_width * 4
+    push = s * cap * 4 + s * cap * (table.dim + 4) * 4
+    return pull + push
+
+
 def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
                bucketing: Optional[Tuple] = None) -> Dict[str, jax.Array]:
     """Per-device pull: ids [n] (device-row space) → {emb [n, D], w [n],
@@ -119,14 +180,16 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
     ``overflow`` counts THIS device's real (non-trash) ids that fell past
     their destination bucket's static capacity and degraded to a dropped
     lookup (zeros) — the same positions drop their grads in push_local.
-    The capacity contract (`bucket_capacity`): keys hashing ~uniformly
-    across shards overflow with probability ~3e-5 per bucket at the
-    default slack; a skewed distribution (hot shard) CAN overflow
-    materially, which is exactly what this counter surfaces (contrast:
-    the reference's HeterComm never drops, heter_comm_inl.h:273 — it
-    re-walks; we trade bounded drop odds for static shapes and expose
-    the count). Single shard: one sliced gather, no collective, no
-    possible overflow.
+    The capacity contract (`bucket_capacity`): with dedup (default) a
+    cell holds a UNIQUE id, so repetition — the realistic skew in CTR
+    data, where a hot key can be 30%+ of a batch — cannot overflow a
+    bucket at all; what remains is uniform-hash spread of unique ids
+    (~3e-5 per bucket at default slack, less any margin given away via
+    ``embedding_unique_frac``). Overflows remain counted, honest drops
+    (contrast: the reference's HeterComm never drops,
+    heter_comm_inl.h:273 — it re-walks; we trade bounded drop odds for
+    static shapes and expose the count). Single shard: one sliced
+    gather, no collective, no possible overflow.
     """
     num_shards = table.num_shards
     block = table.rows_per_shard + 1

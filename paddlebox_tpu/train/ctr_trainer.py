@@ -32,7 +32,8 @@ from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
 from paddlebox_tpu.embedding import TableConfig, make_sparse_optimizer
 from paddlebox_tpu.embedding.grouped import GroupedEngine
-from paddlebox_tpu.embedding.lookup import (compute_bucketing, pull_local,
+from paddlebox_tpu.embedding.lookup import (compute_bucketing,
+                                            exchange_bytes, pull_local,
                                             push_local)
 from paddlebox_tpu.metrics import (AucState, auc_accumulate, auc_compute,
                                    auc_state_init)
@@ -741,9 +742,15 @@ class CTRTrainer:
                     _put_global(np.int32(1), rep))
         losses: List[float] = []
         overflows: List[jax.Array] = []
+        group_n: Optional[List[int]] = None
         nsteps = 0
         for args in self._prefetch_batches(dataset):
             rows, segs, labels, valid, dense = args
+            if group_n is None:
+                # Per-device id count per width group — static across the
+                # pass, feeds the exchange-bytes observable below.
+                group_n = [int(r.shape[0]) // max(self.ndev, 1)
+                           for r in rows]
             if mode == "async":
                 # PullDense role: freshest host params each step.
                 params = jax.device_put(self._async_dense.pull_dense(), rep)
@@ -792,6 +799,15 @@ class CTRTrainer:
         stats["steps"] = nsteps
         stats["lookup_overflow"] = (
             int(jnp.sum(jnp.stack(overflows))) if overflows else 0)
+        # Static per-device all-to-all bytes for one pull+push round —
+        # what dedup + FLAGS_embedding_unique_frac shrink (the dedup-
+        # before-exchange observable; heter_comm.h:192 transfers merged
+        # keys for the same reason).
+        stats["lookup_exchange_bytes"] = (int(sum(
+            exchange_bytes(t, n) for t, n in zip(tables, group_n)))
+            if group_n else 0)
+        stats["scale_sparse_grad_by_batch"] = bool(
+            self.config.scale_sparse_grad_by_batch)
         if stats["lookup_overflow"]:
             from paddlebox_tpu.core import monitor
             monitor.add("embedding/lookup_overflow",

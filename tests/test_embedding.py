@@ -276,21 +276,23 @@ def test_save_delta_refuses_after_shrink(tmp_path):
 
 
 def test_overflow_counter_on_skewed_keys(devices8):
-    """Adversarial skew: every batch id targets ONE shard, overflowing its
-    fixed-capacity bucket. The overflow counter must surface exactly the
-    dropped lookups (which degrade to zeros) instead of failing silently
-    — the accuracy contract of FLAGS_embedding_shard_slack."""
+    """Adversarial skew: every batch id targets ONE shard with DISTINCT
+    keys (a hot shard — the one skew dedup cannot absorb), overflowing
+    its fixed-capacity bucket. The overflow counter must surface exactly
+    the dropped lookups (which degrade to zeros) instead of failing
+    silently — the accuracy contract of FLAGS_embedding_shard_slack."""
     from paddlebox_tpu.embedding.lookup import bucket_capacity
 
-    n_keys, n_ids, nshards = 256, 64, 8
+    n_keys, n_ids, nshards = 1024, 64, 8
     vals = _host_values(n_keys, DIM)
     keys = np.arange(1, n_keys + 1, dtype=np.uint64)
     table = build_pass_table_host(vals, nshards, CFG)
     mesh = build_mesh(HybridTopology(dp=nshards), devices8)
     pull = make_pull_fn(mesh, "dp")
 
-    # All ids hit key rank 0 -> shard 0's bucket on every device.
-    batch_keys = np.full((n_ids * nshards,), 1, np.uint64)
+    # Distinct keys of adjacent rank -> all land in shard 0's bucket on
+    # every device (ranks map to shards in contiguous blocks).
+    batch_keys = np.tile(np.arange(1, n_ids + 1, dtype=np.uint64), nshards)
     rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
                             num_shards=nshards)
     out = pull(table, jnp.asarray(rows))
@@ -305,6 +307,123 @@ def test_overflow_counter_on_skewed_keys(devices8):
     per_dev_emb = np.asarray(out["emb"]).reshape(nshards, n_ids, DIM)
     n_zero = (np.abs(per_dev_emb).sum(-1) == 0).sum(axis=1)
     assert (n_zero == expected_drop_per_dev).all()
+
+
+def test_hot_key_dedup_no_overflow(devices8):
+    """The VERDICT-r04 contract: a hot key making up 30% of a device's
+    ids (the realistic CTR skew) must NOT overflow at default slack —
+    dedup collapses every repetition into one bucket cell
+    (dedup_keys_and_fillidx role, heter_comm.h:192) — and every
+    occurrence must still pull the exact stored row."""
+    n_keys, n_ids, nshards = 1024, 160, 8
+    vals = _host_values(n_keys, DIM)
+    keys = np.arange(1, n_keys + 1, dtype=np.uint64)
+    table = build_pass_table_host(vals, nshards, CFG)
+    mesh = build_mesh(HybridTopology(dp=nshards), devices8)
+    pull = make_pull_fn(mesh, "dp")
+
+    rng = np.random.default_rng(7)
+    hot = int(0.3 * n_ids)
+    per_dev = []
+    for d in range(nshards):
+        # One hot key (different per device) at 30%, rest uniform draws
+        # WITH repetition — duplicates everywhere, like real CTR data.
+        hot_key = np.uint64(1 + rng.integers(0, n_keys))
+        rest = rng.integers(1, n_keys + 1, size=n_ids - hot).astype(
+            np.uint64)
+        ids = np.concatenate([np.full((hot,), hot_key, np.uint64), rest])
+        per_dev.append(rng.permutation(ids))
+    batch_keys = np.concatenate(per_dev)
+    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
+                            num_shards=nshards)
+    out = pull(table, jnp.asarray(rows))
+
+    assert np.asarray(out["overflow"]).sum() == 0
+    np.testing.assert_allclose(np.asarray(out["emb"]),
+                               vals["emb"][batch_keys - 1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               vals["w"][batch_keys - 1], rtol=1e-6)
+
+
+def test_dedup_parity_with_nondedup(devices8):
+    """Dedup is a layout change, not a math change: pull values and the
+    pushed table must be bit-identical with the flag on and off (when
+    neither path overflows) — sender-side duplicate-grad merging
+    (dynamic_merge_grad role) commutes with the owner-side accumulate."""
+    from paddlebox_tpu.core import flags as flagmod
+
+    n_keys, n_ids, nshards = 512, 64, 8
+    vals = _host_values(n_keys, DIM)
+    keys = np.arange(1, n_keys + 1, dtype=np.uint64)
+    mesh = build_mesh(HybridTopology(dp=nshards), devices8)
+    rng = np.random.default_rng(11)
+    batch_keys = rng.integers(1, n_keys + 1,
+                              size=n_ids * nshards).astype(np.uint64)
+    g_emb = rng.normal(size=(n_ids * nshards, DIM)).astype(np.float32)
+    g_w = rng.normal(size=(n_ids * nshards,)).astype(np.float32)
+    shows = np.ones((n_ids * nshards,), np.float32)
+    clicks = rng.integers(0, 2, n_ids * nshards).astype(np.float32)
+
+    results = {}
+    prev = flagmod.flag("embedding_dedup")
+    for dedup in (True, False):
+        flagmod.set_flags({"embedding_dedup": dedup})
+        try:
+            table = build_pass_table_host(vals, nshards, CFG)
+            rows = jnp.asarray(map_keys_to_rows(
+                keys, batch_keys, table.rows_per_shard,
+                num_shards=nshards))
+            pulled = make_pull_fn(mesh, "dp")(table, rows)
+            assert np.asarray(pulled["overflow"]).sum() == 0
+            pushed = make_push_fn(mesh, "dp")(
+                table, rows, jnp.asarray(g_emb), jnp.asarray(g_w),
+                jnp.asarray(shows), jnp.asarray(clicks))
+            results[dedup] = (np.asarray(pulled["emb"]),
+                              np.asarray(pushed.vals))
+        finally:
+            flagmod.set_flags({"embedding_dedup": prev})
+    np.testing.assert_array_equal(results[True][0], results[False][0])
+    np.testing.assert_allclose(results[True][1], results[False][1],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_unique_frac_shrinks_exchange_bytes(devices8):
+    """FLAGS_embedding_unique_frac turns dedup into an all-to-all byte
+    reduction: capacity (and so exchange_bytes) shrinks, and a
+    duplicate-heavy batch still overflows nothing at the smaller cap."""
+    from paddlebox_tpu.core import flags as flagmod
+    from paddlebox_tpu.embedding.lookup import (bucket_capacity,
+                                                exchange_bytes)
+
+    n_keys, n_ids, nshards = 1024, 256, 8
+    vals = _host_values(n_keys, DIM)
+    keys = np.arange(1, n_keys + 1, dtype=np.uint64)
+    table = build_pass_table_host(vals, nshards, CFG)
+    mesh = build_mesh(HybridTopology(dp=nshards), devices8)
+
+    bytes_full = exchange_bytes(table, n_ids)
+    prev = flagmod.flag("embedding_unique_frac")
+    flagmod.set_flags({"embedding_unique_frac": 0.5})
+    try:
+        assert bucket_capacity(n_ids, nshards) < bucket_capacity(
+            n_ids, nshards, unique_frac=1.0)
+        bytes_half = exchange_bytes(table, n_ids)
+        assert bytes_half < bytes_full
+
+        # Each id appears ~4x (256 draws from 64 distinct keys): unique
+        # count per device is <= 64, well inside the halved capacity.
+        rng = np.random.default_rng(13)
+        batch_keys = rng.choice(
+            np.arange(1, n_keys + 1, dtype=np.uint64), 64,
+            replace=False)[rng.integers(0, 64, size=n_ids * nshards)]
+        rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
+                                num_shards=nshards)
+        out = make_pull_fn(mesh, "dp")(table, jnp.asarray(rows))
+        assert np.asarray(out["overflow"]).sum() == 0
+        np.testing.assert_allclose(np.asarray(out["emb"]),
+                                   vals["emb"][batch_keys - 1], rtol=1e-6)
+    finally:
+        flagmod.set_flags({"embedding_unique_frac": prev})
 
 
 def test_no_overflow_under_uniform_keys(devices8):

@@ -67,10 +67,19 @@ def test_ctr_step_collective_and_scatter_budget():
     # six-field push layout this replaced would blow past the ceiling
     # (+5 per width group).
     assert (c.get("scatter-add", 0) + c.get("scatter", 0)) <= 12, c
-    # SORT-FREE bucketing: positions come from a one-hot cumsum, so the
-    # step carries ZERO sorts (the r02 layout carried 3 argsorts in the
-    # push alone; the Pallas accumulate's internal sort lives behind the
-    # TPU-only flag and is not part of this CPU lowering).
+    # Dedup-before-exchange (r05): representatives come from ONE
+    # scatter-min over the row space per width group — a second one
+    # means the layout stopped being shared between pull and push.
+    assert c.get("scatter-min", 0) <= 1, c
+    # ...and its routing costs at most two extra [n] gathers (first_idx,
+    # representative cell) on top of the r04 budget of 10.
+    assert c.get("gather", 0) <= 12, c
+    # SORT-FREE bucketing, dedup included: positions come from a one-hot
+    # cumsum and representatives from a scatter-min, so the step carries
+    # ZERO sorts (the r02 layout carried 3 argsorts in the push alone;
+    # the reference's dedup itself is 2x cub radix sort,
+    # heter_comm.h:196-205; the Pallas accumulate's internal sort lives
+    # behind the TPU-only flag and is not part of this CPU lowering).
     assert c.get("sort", 0) == 0, c
     assert c.get("cumsum", 0) >= 1, c
 
