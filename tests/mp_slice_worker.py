@@ -1,0 +1,120 @@
+"""Worker payload for the multi-process MULTI-SLICE test (spawned by
+``python -m paddlebox_tpu.launch --nproc 2 tests/mp_slice_worker.py``).
+
+The r04 multislice suite proved the slice hierarchy's math on a
+single-process mesh; this worker puts the ``slice`` axis on a REAL
+process boundary — 2 jax.distributed processes x 4 CPU devices each,
+mesh ``slice=2 x dp=4`` — the closest this environment gets to the
+reference's inter-node path (gather_multi_node_grad over a second comm
+set, heter_comm.h:156-172). It checks, inside the distributed run:
+
+- the mesh actually lays ``slice`` on the process boundary;
+- ``hierarchical_psum_tree`` (RS-ICI -> psum-DCN -> AG-ICI) equals the
+  flat psum ACROSS processes;
+- a 2-pass CTR training trajectory, for the parent to compare against
+  the identical single-process 8-device ``slice=2 x dp=4`` run.
+
+Usage: mp_slice_worker.py <data_dir> <out_json>
+(env PBX_TEST_LOCAL_DEVICES overrides the per-process device count — the
+parent's single-process reference run uses 8.)
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + os.environ.get("PBX_TEST_LOCAL_DEVICES", "4"))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    data_dir, out_json = sys.argv[1], sys.argv[2]
+    from paddlebox_tpu.distributed import bootstrap
+    bootstrap.initialize()   # PBX_* env from the launcher
+    nproc = jax.process_count()
+    assert nproc == int(os.environ["PBX_NUM_PROCESSES"])
+
+    import numpy as np
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from paddlebox_tpu.data.dataset import Dataset
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.embedding import TableConfig
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.parallel.collective import hierarchical_psum_tree
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+    ndev = len(jax.devices())        # global across processes
+    n_slices = 2
+    mesh = build_mesh(HybridTopology(slice=n_slices, dp=ndev // n_slices))
+
+    # The whole point of this worker: each slice must be owned by ONE
+    # process, so the slice axis (DCN role) crosses the process boundary
+    # and nothing else does.
+    slice_procs = [sorted({d.process_index for d in
+                           mesh.devices[s].flatten()})
+                   for s in range(n_slices)]
+    slice_on_boundary = (nproc == n_slices
+                         and slice_procs == [[0], [1]])
+
+    # Hierarchical DCN tree vs flat psum, ACROSS the process boundary.
+    rng = np.random.default_rng(3)
+    tree = {"a": np.asarray(rng.normal(size=(5, 3)), np.float32),
+            "b": np.asarray(rng.normal(size=(7,)), np.float32)}
+
+    def hier(t):
+        return hierarchical_psum_tree(t, inner_axis="dp",
+                                      outer_axis="slice")
+
+    def flat(t):
+        return jax.tree.map(lambda x: lax.psum(x, ("slice", "dp")), t)
+
+    out_h = jax.jit(jax.shard_map(hier, mesh=mesh, in_specs=P(),
+                                  out_specs=P(), check_vma=False))(tree)
+    out_f = jax.jit(jax.shard_map(flat, mesh=mesh, in_specs=P(),
+                                  out_specs=P(), check_vma=False))(tree)
+    hier_err = max(float(np.max(np.abs(np.asarray(out_h[k])
+                                       - np.asarray(out_f[k]))))
+                   for k in tree)
+
+    slots = tuple(SlotConf(f"s{i}", avg_len=1.0) for i in range(3))
+    feed = DataFeedConfig(slots=slots, batch_size=32)
+    model = DeepFM(slot_names=tuple(f"s{i}" for i in range(3)),
+                   emb_dim=4, hidden=(16,))
+    trainer = CTRTrainer(model, feed,
+                         TableConfig(dim=4, learning_rate=0.1), mesh=mesh,
+                         config=TrainerConfig(auc_num_buckets=1 << 10))
+    assert trainer.dcn_axis == "slice", trainer.dcn_axis
+    trainer.init(seed=0)
+
+    files = sorted(
+        os.path.join(data_dir, f) for f in os.listdir(data_dir)
+        if f.startswith("part-"))
+    losses = []
+    for _ in range(2):
+        ds = Dataset(feed, num_reader_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        stats = trainer.train_pass(ds)
+        losses.append(stats["loss"])
+        assert stats["lookup_overflow"] == 0
+
+    if jax.process_index() == 0:
+        with open(out_json, "w") as f:
+            json.dump({"losses": losses,
+                       "ndev": ndev,
+                       "nproc": nproc,
+                       "slice_on_boundary": slice_on_boundary,
+                       "slice_procs": slice_procs,
+                       "hier_err": hier_err}, f)
+
+
+if __name__ == "__main__":
+    main()

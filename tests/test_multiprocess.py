@@ -59,6 +59,58 @@ def _single_process_reference(data_dir: str) -> list:
 
 
 @pytest.mark.slow
+def test_two_process_multislice_ctr_parity(tmp_path):
+    """The slice (DCN) axis on a REAL process boundary (VERDICT-r04 #3):
+    2 jax.distributed processes x 4 CPU devices, mesh slice=2 x dp=4.
+    Inside the run the worker asserts the mesh puts each slice on one
+    process and that hierarchical_psum_tree equals the flat psum across
+    the boundary; here we assert the training trajectory matches the
+    identical single-process 8-device slice=2 x dp=4 run — the hierarchy
+    changes the transport, not the math (gather_multi_node_grad role,
+    heter_comm.h:156-172)."""
+    worker = os.path.join(REPO, "tests", "mp_slice_worker.py")
+    data_dir = str(tmp_path / "data")
+    _write_data(data_dir)
+    out = str(tmp_path / "mp_slice.json")
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # worker pins its own 4-device flag
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddlebox_tpu.launch", "--nproc", "2",
+         "--coordinator", f"127.0.0.1:{port}", worker, data_dir, out],
+        env=env, cwd=REPO, timeout=420, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"launcher failed rc={proc.returncode}\n--- stdout\n"
+        f"{proc.stdout[-3000:]}\n--- stderr\n{proc.stderr[-3000:]}")
+    with open(out) as f:
+        mp = json.load(f)
+    assert mp["nproc"] == 2 and mp["ndev"] == 8
+    assert mp["slice_on_boundary"], (
+        f"slice axis not on the process boundary: {mp['slice_procs']}")
+    assert mp["hier_err"] < 1e-5, (
+        f"hierarchical psum diverged across processes: {mp['hier_err']}")
+
+    # Single-process reference: SAME worker, same mesh shape, 8 local
+    # virtual devices, no jax.distributed.
+    ref_out = os.path.join(data_dir, "ref_slice.json")
+    env_ref = dict(env)
+    env_ref.pop("PBX_COORDINATOR", None)
+    env_ref["PBX_NUM_PROCESSES"] = "1"
+    env_ref["PBX_PROCESS_ID"] = "0"
+    env_ref["PBX_TEST_LOCAL_DEVICES"] = "8"
+    subprocess.run([sys.executable, worker, data_dir, ref_out],
+                   env=env_ref, cwd=REPO, check=True, timeout=420)
+    with open(ref_out) as f:
+        ref = json.load(f)
+    np.testing.assert_allclose(mp["losses"], ref["losses"], rtol=1e-5,
+                               err_msg="2-process slice run diverged from "
+                                       "the single-process slice run")
+    assert mp["losses"][1] < mp["losses"][0]
+
+
+@pytest.mark.slow
 def test_two_process_ctr_loss_parity(tmp_path):
     data_dir = str(tmp_path / "data")
     _write_data(data_dir)
