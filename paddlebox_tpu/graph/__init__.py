@@ -4,19 +4,26 @@ GpuPsGraphTable + samplers + GraphGpuWrapper + GraphDataGenerator)."""
 from paddlebox_tpu.graph.table import (CSRGraph, DeviceGraph, GraphTable,
                                        build_csr, load_edge_file)
 from paddlebox_tpu.graph.sampler import (degree_neg_cdf, device_arrays,
-                                         gather_node_feats, metapath_walk,
+                                         device_cdf, gather_node_feats,
+                                         metapath_walk,
+                                         metapath_walk_weighted,
                                          negative_samples,
                                          negative_samples_by_degree,
-                                         random_walk, sample_neighbors,
+                                         random_walk, random_walk_weighted,
+                                         sample_neighbors,
+                                         sample_neighbors_weighted,
                                          skip_gram_pairs,
+                                         stack_device_cdfs,
                                          stack_device_graphs)
 from paddlebox_tpu.graph.data_generator import (GraphDataGenerator,
                                                 GraphGenConfig)
 
 __all__ = [
     "CSRGraph", "DeviceGraph", "GraphTable", "build_csr", "load_edge_file",
-    "degree_neg_cdf", "device_arrays", "gather_node_feats",
-    "metapath_walk", "negative_samples", "negative_samples_by_degree",
-    "random_walk", "sample_neighbors", "skip_gram_pairs",
-    "stack_device_graphs", "GraphDataGenerator", "GraphGenConfig",
+    "degree_neg_cdf", "device_arrays", "device_cdf", "gather_node_feats",
+    "metapath_walk", "metapath_walk_weighted", "negative_samples",
+    "negative_samples_by_degree", "random_walk", "random_walk_weighted",
+    "sample_neighbors", "sample_neighbors_weighted", "skip_gram_pairs",
+    "stack_device_cdfs", "stack_device_graphs", "GraphDataGenerator",
+    "GraphGenConfig",
 ]

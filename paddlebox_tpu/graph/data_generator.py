@@ -49,6 +49,10 @@ class GraphGenConfig:
     start_type: Optional[int] = None
     degree_negatives: bool = False
     feat_name: Optional[str] = None
+    # Hops draw neighbors proportional to per-edge weight (requires the
+    # CSR built with weights= — the reference's is_weighted walk mode,
+    # common_graph_table.h:128-152).
+    weighted: bool = False
 
 
 class GraphDataGenerator:
@@ -61,12 +65,19 @@ class GraphDataGenerator:
         self.table = table
         g = table.device_graph(edge_type, max_degree)
         self._nbrs, self._deg = sampler.device_arrays(g)
+        # Metapath walks only ever read the stacked per-type CDFs — the
+        # base type's CDF would be dead weight (and wrongly require the
+        # base graph to be weighted).
+        self._cdf = (sampler.device_cdf(g)
+                     if config.weighted and not config.metapath else None)
         self._num_nodes = g.nbrs.shape[0]
         self._type_seq = None
         if config.metapath:
             views = [table.device_graph(et, max_degree)
                      for et in config.metapath]
             self._mp_nbrs, self._mp_deg = sampler.stack_device_graphs(views)
+            self._mp_cdf = (sampler.stack_device_cdfs(views)
+                            if config.weighted else None)
             self._type_seq = tuple(
                 i % len(config.metapath) for i in range(config.walk_len))
         self._neg_cdf = None
@@ -109,10 +120,21 @@ class GraphDataGenerator:
                                            - len(chunk))
                     chunk = np.concatenate([chunk, pad])
                 if self._type_seq is not None:
-                    walks = sampler.metapath_walk(
-                        self._mp_nbrs, self._mp_deg,
-                        jnp.asarray(chunk, jnp.int32), self._next_key(),
-                        self._type_seq)
+                    if self._mp_cdf is not None:
+                        walks = sampler.metapath_walk_weighted(
+                            self._mp_nbrs, self._mp_cdf,
+                            jnp.asarray(chunk, jnp.int32),
+                            self._next_key(), self._type_seq)
+                    else:
+                        walks = sampler.metapath_walk(
+                            self._mp_nbrs, self._mp_deg,
+                            jnp.asarray(chunk, jnp.int32), self._next_key(),
+                            self._type_seq)
+                elif self._cdf is not None:
+                    walks = sampler.random_walk_weighted(
+                        self._nbrs, self._cdf,
+                        jnp.asarray(chunk, jnp.int32),
+                        self._next_key(), cfg.walk_len)
                 else:
                     walks = sampler.random_walk(
                         self._nbrs, self._deg,

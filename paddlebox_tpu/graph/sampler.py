@@ -27,6 +27,49 @@ def device_arrays(g: DeviceGraph) -> Tuple[jax.Array, jax.Array]:
     return jnp.asarray(g.nbrs), jnp.asarray(g.degree)
 
 
+def device_cdf(g: DeviceGraph) -> jax.Array:
+    """Device-resident per-neighbor weight CDF for weighted sampling."""
+    if g.nbr_cdf is None:
+        raise ValueError("graph has no edge weights — build the CSR with "
+                         "weights= to sample by weight")
+    return jnp.asarray(g.nbr_cdf)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def sample_neighbors_weighted(nbrs: jax.Array, cdf: jax.Array,
+                              nodes: jax.Array, key: jax.Array,
+                              k: int) -> jax.Array:
+    """[B] nodes → [B, k] neighbor sample with replacement, each neighbor
+    drawn ∝ its edge weight (role of the reference's weighted
+    sample_neighbors over per-edge weight_arr,
+    common_graph_table.h:128-152). Inverse-CDF draw as a compare+sum —
+    static shapes, no alias table, fuses to one elementwise pass over
+    [B, k, D]. Isolated nodes return themselves (their cdf row puts all
+    mass on the self-loop padding column 0)."""
+    u = jax.random.uniform(key, (nodes.shape[0], k))          # [B,k)
+    row_cdf = cdf[nodes]                                      # [B,D]
+    idx = jnp.sum(row_cdf[:, None, :] < u[:, :, None],
+                  axis=-1).astype(jnp.int32)                  # [B,k]
+    idx = jnp.minimum(idx, nbrs.shape[1] - 1)
+    return jnp.take_along_axis(nbrs[nodes], idx, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("walk_len",))
+def random_walk_weighted(nbrs: jax.Array, cdf: jax.Array,
+                         starts: jax.Array, key: jax.Array,
+                         walk_len: int) -> jax.Array:
+    """[B] starts → [B, walk_len+1] weighted random walks (each hop draws
+    ∝ edge weight — the node2vec/deepwalk-on-weighted-graph primitive)."""
+
+    def step(cur, k):
+        nxt = sample_neighbors_weighted(nbrs, cdf, cur, k, 1)[:, 0]
+        return nxt, nxt
+
+    keys = jax.random.split(key, walk_len)
+    _, path = jax.lax.scan(step, starts, keys)
+    return jnp.concatenate([starts[:, None], path.T], axis=1)
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def sample_neighbors(nbrs: jax.Array, degree: jax.Array, nodes: jax.Array,
                      key: jax.Array, k: int) -> jax.Array:
@@ -108,6 +151,49 @@ def stack_device_graphs(graphs) -> Tuple[jax.Array, jax.Array]:
         nbrs.append(a)
         degs.append(g.degree)
     return jnp.asarray(np.stack(nbrs)), jnp.asarray(np.stack(degs))
+
+
+def stack_device_cdfs(graphs) -> jax.Array:
+    """[T, N, Dmax] stacked weight CDFs aligned with stack_device_graphs'
+    adjacency stack (narrower types pad with 1.0 — already past the last
+    valid cdf value, so a draw never lands in the padding)."""
+    if any(g.nbr_cdf is None for g in graphs):
+        raise ValueError("all edge types need weights for a weighted "
+                         "metapath — mixed weighted/uniform would "
+                         "silently sample the uniform types wrong")
+    dmax = max(g.max_degree for g in graphs)
+    out = []
+    for g in graphs:
+        c = g.nbr_cdf
+        pad = dmax - c.shape[1]
+        if pad:
+            c = np.concatenate(
+                [c, np.ones((c.shape[0], pad), np.float32)], axis=1)
+        out.append(c)
+    return jnp.asarray(np.stack(out))
+
+
+@functools.partial(jax.jit, static_argnames=("type_seq",))
+def metapath_walk_weighted(nbrs_stack: jax.Array, cdf_stack: jax.Array,
+                           starts: jax.Array, key: jax.Array,
+                           type_seq: Tuple[int, ...]) -> jax.Array:
+    """Weighted metapath walk: hop h draws from edge type type_seq[h]
+    with per-edge weights (the weighted half of the reference's metapath
+    machinery — typed adjacency + weight_arr sampling)."""
+    ts = jnp.asarray(type_seq, jnp.int32)
+    keys = jax.random.split(key, len(type_seq))
+
+    def step(cur, inp):
+        t, k = inp
+        u = jax.random.uniform(k, cur.shape)
+        row_cdf = cdf_stack[t, cur]                            # [B,D]
+        idx = jnp.sum(row_cdf < u[:, None], axis=-1).astype(jnp.int32)
+        idx = jnp.minimum(idx, nbrs_stack.shape[-1] - 1)
+        nxt = nbrs_stack[t, cur, idx]
+        return nxt, nxt
+
+    _, path = jax.lax.scan(step, starts, (ts, keys))
+    return jnp.concatenate([starts[:, None], path.T], axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("type_seq",))

@@ -38,9 +38,17 @@ def _mix64(x: np.ndarray) -> np.ndarray:
 
 
 def sample_neighbors_host(g: CSRGraph, nodes: np.ndarray, k: int,
-                          seed: int) -> np.ndarray:
+                          seed: int, *,
+                          weighted: bool = False) -> np.ndarray:
     """[n, k] int64 neighbor samples (with replacement); -1 for isolated
-    nodes. Deterministic per (seed, node, slot) — shard-layout invariant."""
+    nodes. Deterministic per (seed, node, slot) — shard-layout invariant.
+
+    ``weighted=True`` draws each neighbor ∝ its edge weight (role of the
+    weighted sampling over common_graph_table.h:128-152 weight_arr): the
+    counter hash becomes a uniform in [0, 1), and the pick is an
+    inverse-CDF lookup on the GLOBAL weight cumsum — one vectorized
+    searchsorted, no per-node python. Still deterministic per
+    (seed, node, slot), so the layout invariance holds exactly."""
     nodes = np.asarray(nodes, np.int64)
     n = nodes.shape[0]
     out = np.full((n, k), -1, np.int64)
@@ -57,8 +65,29 @@ def sample_neighbors_host(g: CSRGraph, nodes: np.ndarray, k: int,
                       + np.uint64(seed))[:, None]
         slot = np.arange(k, dtype=np.uint64)[None, :]
         z = _mix64(base + slot * np.uint64(0xC2B2AE3D27D4EB4F))
-    idx = (z % deg[has].astype(np.uint64)[:, None]).astype(np.int64)
     starts = g.indptr[nodes[has]].astype(np.int64)[:, None]
+    if weighted and g.is_weighted:
+        # Segment-local inverse CDF via the global cumsum (cached on the
+        # CSR — immutable between builds): target = (prefix before the
+        # node's segment) + u * (segment total).
+        cw = g.cum_weights()
+        seg_lo = starts.astype(np.int64)
+        prefix = np.where(seg_lo > 0, cw[seg_lo - 1], 0.0)
+        ends = g.indptr[nodes[has] + 1].astype(np.int64)[:, None]
+        total = cw[ends - 1] - prefix
+        u = (z >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+        # Zero-total segments (all weights 0) degrade to uniform.
+        zero = total <= 0
+        target = prefix + u * np.where(zero, 1.0, total)
+        pos = np.searchsorted(cw, target, side="right")
+        pos = np.clip(pos, seg_lo, ends - 1)
+        if zero.any():
+            idx_u = (z % deg[has].astype(np.uint64)[:, None]
+                     ).astype(np.int64)
+            pos = np.where(zero, seg_lo + idx_u, pos)
+        out[has] = g.cols[pos]
+        return out
+    idx = (z % deg[has].astype(np.uint64)[:, None]).astype(np.int64)
     out[has] = g.cols[starts + idx]
     return out
 
@@ -124,12 +153,17 @@ class GraphServer:
 
     def handle_upload_batch(self, req) -> int:
         """Append an edge batch whose SOURCE nodes this shard owns (role
-        of GraphTable upload_batch / load into the partition)."""
+        of GraphTable upload_batch / load into the partition). Optional
+        per-edge ``weights`` ride along (common_graph_table.h
+        add_neighbor(id, dst, weight))."""
         src = np.asarray(req["src"], np.int64)
         dst = np.asarray(req["dst"], np.int64)
+        w = req.get("weights")
+        w = None if w is None else np.asarray(w, np.float32)
         self._check_owned(src)
         with self._lock:
-            self._pending.setdefault(req["edge_type"], []).append((src, dst))
+            self._pending.setdefault(req["edge_type"], []).append(
+                (src, dst, w))
             self._num_nodes[req["edge_type"]] = max(
                 self._num_nodes.get(req["edge_type"], 0),
                 int(req["num_nodes"]))
@@ -144,7 +178,17 @@ class GraphServer:
                 return 0
             src = np.concatenate([p[0] for p in parts])
             dst = np.concatenate([p[1] for p in parts])
-            g = build_csr(src, dst, num_nodes=self._num_nodes[et])
+            ws = [p[2] for p in parts]
+            if any(w is not None for w in ws):
+                if any(w is None for w in ws):
+                    raise ValueError(
+                        f"edge type {et!r}: some batches carry weights "
+                        f"and some do not — refusing to guess")
+                weights = np.concatenate(ws)
+            else:
+                weights = None
+            g = build_csr(src, dst, num_nodes=self._num_nodes[et],
+                          weights=weights)
             self.table._graphs[et] = g
         monitor.add("graph/edges_built", int(src.size))
         return g.num_edges
@@ -163,8 +207,13 @@ class GraphServer:
         nodes = np.asarray(req["nodes"], np.int64)
         self._check_owned(nodes)
         g = self._graph_or_empty(req["edge_type"])
+        weighted = bool(req.get("weighted", False))
+        if weighted and not g.is_weighted and g.num_edges:
+            raise ValueError(
+                f"edge type {req['edge_type']!r} has no weights on shard "
+                f"{self.index} — upload with weights= to sample weighted")
         return sample_neighbors_host(g, nodes, int(req["k"]),
-                                     int(req["seed"]))
+                                     int(req["seed"]), weighted=weighted)
 
     def handle_degrees(self, req) -> np.ndarray:
         nodes = np.asarray(req["nodes"], np.int64)
@@ -283,18 +332,22 @@ class GraphClient:
         return resp["result"]
 
     def upload_batch(self, edge_type: str, src: np.ndarray,
-                     dst: np.ndarray, *, num_nodes: int) -> int:
+                     dst: np.ndarray, *, num_nodes: int,
+                     weights: Optional[np.ndarray] = None) -> int:
         src = np.asarray(src, np.int64)
         dst = np.asarray(dst, np.int64)
+        if weights is not None:
+            weights = np.asarray(weights, np.float32)
         total = 0
         # Empty subsets are still sent: they register num_nodes so a
         # shard owning only isolated nodes answers with -1 samples
         # instead of erroring on an unknown edge type.
         for sv in range(self.num_servers):
             sel = (src % self.num_servers) == sv
-            total += self._call(sv, "upload_batch", edge_type=edge_type,
-                                src=src[sel], dst=dst[sel],
-                                num_nodes=int(num_nodes))
+            total += self._call(
+                sv, "upload_batch", edge_type=edge_type,
+                src=src[sel], dst=dst[sel], num_nodes=int(num_nodes),
+                weights=None if weights is None else weights[sel])
         return total
 
     def build(self, edge_type: str) -> int:
@@ -306,14 +359,16 @@ class GraphClient:
                 for sv in range(self.num_servers)]
 
     def sample_neighbors(self, edge_type: str, nodes: np.ndarray, k: int,
-                         *, seed: int = 0) -> np.ndarray:
+                         *, seed: int = 0,
+                         weighted: bool = False) -> np.ndarray:
         nodes = np.asarray(nodes, np.int64)
         out = np.full((nodes.shape[0], k), -1, np.int64)
         shards = [(sv, sel) for sv, sel in self._shard_sel(nodes)
                   if sel.size]
         res = self._fanout([(sv, "sample_neighbors",
                              dict(edge_type=edge_type, nodes=nodes[sel],
-                                  k=int(k), seed=int(seed)))
+                                  k=int(k), seed=int(seed),
+                                  weighted=bool(weighted)))
                             for sv, sel in shards])
         for (sv, sel), r in zip(shards, res):
             out[sel] = r
@@ -362,14 +417,16 @@ class GraphClient:
         return out
 
     def random_walk(self, edge_type: str, starts: np.ndarray, length: int,
-                    *, seed: int = 0) -> np.ndarray:
+                    *, seed: int = 0, weighted: bool = False) -> np.ndarray:
         """[n, length+1] walks via per-hop fan-out sampling (each hop's
         frontier may live on any shard — the client re-shards per hop,
         role of the graph client driving multi-hop sampling)."""
-        return self.metapath_walk([edge_type] * length, starts, seed=seed)
+        return self.metapath_walk([edge_type] * length, starts, seed=seed,
+                                  weighted=weighted)
 
     def metapath_walk(self, edge_types: Sequence[str], starts: np.ndarray,
-                      *, seed: int = 0) -> np.ndarray:
+                      *, seed: int = 0,
+                      weighted: bool = False) -> np.ndarray:
         """[n, len(edge_types)+1] walks where hop h samples from
         ``edge_types[h]`` (role of the reference's meta-path walks over
         typed adjacency — graph_gpu_wrapper.h:25 metapath config, e.g.
@@ -382,7 +439,8 @@ class GraphClient:
         walk[:, 0] = starts
         cur = starts
         for h, et in enumerate(edge_types):
-            nxt = self.sample_neighbors(et, cur, 1, seed=seed + 1 + h)[:, 0]
+            nxt = self.sample_neighbors(et, cur, 1, seed=seed + 1 + h,
+                                        weighted=weighted)[:, 0]
             # Dead ends stay in place (same convention as the device
             # sampler's isolated-node handling).
             nxt = np.where(nxt < 0, cur, nxt)

@@ -28,15 +28,33 @@ from paddlebox_tpu.core import log
 @dataclasses.dataclass
 class CSRGraph:
     """Host compact adjacency: neighbors of node i are
-    ``cols[indptr[i]:indptr[i+1]]``."""
+    ``cols[indptr[i]:indptr[i+1]]``; ``weights`` (optional, aligned with
+    ``cols``) carry per-edge sampling weights — the reference stores them
+    next to each neighbor and samples by them when ``is_weighted``
+    (common_graph_table.h:128-152 add_neighbor(id, dst, weight))."""
 
     indptr: np.ndarray     # [num_nodes+1] int64
     cols: np.ndarray       # [num_edges]  int64
     num_nodes: int
+    weights: Optional[np.ndarray] = None   # [num_edges] float32
+    # Lazy global weight cumsum (float64) for the host weighted sampler —
+    # cached because the CSR is immutable between builds and an O(E)
+    # cumsum per sample RPC would dominate the sampling cost.
+    _cum_weights: Optional[np.ndarray] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def cum_weights(self) -> np.ndarray:
+        if self._cum_weights is None:
+            self._cum_weights = np.cumsum(self.weights, dtype=np.float64)
+        return self._cum_weights
 
     @property
     def num_edges(self) -> int:
         return int(self.cols.shape[0])
+
+    @property
+    def is_weighted(self) -> bool:
+        return self.weights is not None
 
     def degrees(self) -> np.ndarray:
         return np.diff(self.indptr)
@@ -44,16 +62,33 @@ class CSRGraph:
     def neighbors(self, node: int) -> np.ndarray:
         return self.cols[self.indptr[node]:self.indptr[node + 1]]
 
+    def neighbor_weights(self, node: int) -> Optional[np.ndarray]:
+        if self.weights is None:
+            return None
+        return self.weights[self.indptr[node]:self.indptr[node + 1]]
+
 
 def build_csr(src: np.ndarray, dst: np.ndarray,
               num_nodes: Optional[int] = None,
-              symmetrize: bool = False) -> CSRGraph:
+              symmetrize: bool = False,
+              weights: Optional[np.ndarray] = None) -> CSRGraph:
     """Vectorized edge-list → CSR (role of load_edge_file + upload_batch:
-    the reference parses then bulk-copies shards; one argsort does it)."""
+    the reference parses then bulk-copies shards; one argsort does it).
+    ``weights`` ride the same permutation (symmetrize duplicates them
+    with their edge)."""
     src = np.asarray(src, np.int64)
     dst = np.asarray(dst, np.int64)
+    if weights is not None:
+        weights = np.asarray(weights, np.float32)
+        if weights.shape != src.shape:
+            raise ValueError(
+                f"weights shape {weights.shape} != edges {src.shape}")
+        if weights.size and weights.min() < 0:
+            raise ValueError("negative edge weights are not samplable")
     if symmetrize:
         src, dst = (np.concatenate([src, dst]), np.concatenate([dst, src]))
+        if weights is not None:
+            weights = np.concatenate([weights, weights])
     if num_nodes is None:
         num_nodes = int(max(src.max(initial=-1), dst.max(initial=-1))) + 1
     else:
@@ -68,19 +103,43 @@ def build_csr(src: np.ndarray, dst: np.ndarray,
     counts = np.bincount(src, minlength=num_nodes)
     indptr = np.zeros(num_nodes + 1, np.int64)
     np.cumsum(counts, out=indptr[1:])
-    return CSRGraph(indptr=indptr, cols=dst[order], num_nodes=num_nodes)
+    return CSRGraph(indptr=indptr, cols=dst[order], num_nodes=num_nodes,
+                    weights=None if weights is None else weights[order])
 
 
 def load_edge_file(path: str, *, delimiter: Optional[str] = None,
                    symmetrize: bool = False,
                    num_nodes: Optional[int] = None) -> CSRGraph:
-    """Parse a 'src dst'-per-line edge file (role of
-    GraphGpuWrapper::load_edge_file)."""
-    data = np.loadtxt(path, dtype=np.int64, delimiter=delimiter, ndmin=2)
-    if data.size == 0:
+    """Parse a 'src dst [weight]'-per-line edge file (role of
+    GraphGpuWrapper::load_edge_file; the optional third column is the
+    reference's weighted-graph file format, common_graph_table.h
+    is_weighted)."""
+    # Sniff the column count (skipping the same '#' comments loadtxt
+    # skips), then parse ONCE with a structured dtype: node ids must
+    # parse as int64 (a float64 round-trip silently corrupts hash-style
+    # ids above 2^53) while the optional weight column is float.
+    ncols = 0
+    with open(path) as f:
+        for line in f:
+            s = line.split("#", 1)[0]
+            parts = [p for p in (s.split(delimiter) if delimiter
+                                 else s.split()) if p.strip()]
+            if parts:
+                ncols = len(parts)
+                break
+    if ncols == 0:
         return build_csr(np.empty(0, np.int64), np.empty(0, np.int64),
                          num_nodes=num_nodes or 0)
-    return build_csr(data[:, 0], data[:, 1], num_nodes=num_nodes,
+    if ncols >= 3:
+        dt = np.dtype([("src", np.int64), ("dst", np.int64),
+                       ("w", np.float32)])
+        data = np.atleast_1d(np.loadtxt(path, dtype=dt,
+                                        delimiter=delimiter,
+                                        usecols=(0, 1, 2)))
+        return build_csr(data["src"], data["dst"], num_nodes=num_nodes,
+                         symmetrize=symmetrize, weights=data["w"])
+    ids = np.loadtxt(path, dtype=np.int64, delimiter=delimiter, ndmin=2)
+    return build_csr(ids[:, 0], ids[:, 1], num_nodes=num_nodes,
                      symmetrize=symmetrize)
 
 
@@ -90,23 +149,35 @@ class DeviceGraph:
 
     ``nbrs[i, j]`` = j-th neighbor of node i for j < degree[i], else the
     node itself (self-loop padding keeps walks inside the node id space
-    without masks).
+    without masks). For weighted graphs ``nbr_cdf[i]`` is the inclusive
+    normalized weight CDF over the kept neighbors (padding columns sit at
+    1.0), so a weighted draw is ``count(cdf < u)`` — one compare+sum, no
+    alias table and no data-dependent control flow (role of the
+    weight_arr the reference samples against, common_graph_table.h:152).
     """
 
     nbrs: np.ndarray       # [num_nodes, max_degree] int32
     degree: np.ndarray     # [num_nodes] int32
     max_degree: int
+    nbr_cdf: Optional[np.ndarray] = None   # [num_nodes, max_degree] f32
+
+    @property
+    def is_weighted(self) -> bool:
+        return self.nbr_cdf is not None
 
     @classmethod
     def from_csr(cls, g: CSRGraph, max_degree: Optional[int] = None,
                  seed: int = 0) -> "DeviceGraph":
         """Pack CSR to padded form. Nodes with degree > max_degree keep a
-        uniform subsample (the reference's neighbor-table truncation);
-        degree-0 nodes self-loop."""
+        subsample (uniform without replacement; weighted graphs keep a
+        probability-proportional-to-weight sample via Efraimidis-Spirakis
+        keys — the same grouped shuffle, keyed by -log(u)/w); degree-0
+        nodes self-loop."""
         deg = g.degrees()
         md = int(max_degree or max(int(deg.max(initial=1)), 1))
         n = g.num_nodes
         nbrs = np.repeat(np.arange(n, dtype=np.int64)[:, None], md, axis=1)
+        w_pad = (np.zeros((n, md), np.float32) if g.is_weighted else None)
         rng = np.random.default_rng(seed)
         eff_deg = np.minimum(deg, md).astype(np.int32)
         # Vectorized fill for nodes with degree <= md.
@@ -116,15 +187,21 @@ class DeviceGraph:
             take = g.indptr[small][:, None] + np.arange(md)[None, :]
             valid = np.arange(md)[None, :] < deg[small][:, None]
             take = np.where(valid, take, g.indptr[small][:, None])
-            vals = g.cols[np.minimum(take, g.num_edges - 1)]
+            take = np.minimum(take, g.num_edges - 1)
+            vals = g.cols[take]
             nbrs[small] = np.where(valid, vals, nbrs[small])
+            if w_pad is not None:
+                w_pad[small] = np.where(valid, g.weights[take], 0.0)
         big = np.flatnonzero(deg > md)
         if big.size:
             # Vectorized without-replacement subsample for hub nodes (on
             # power-law graphs with a caller-capped max_degree these can
-            # be a large fraction of nodes): assign a random sort key per
-            # edge, order edges by (owner, key), keep the first md of each
-            # owner group — a grouped shuffle with no python loop.
+            # be a large fraction of nodes): assign a sort key per edge,
+            # order edges by (owner, key), keep the first md of each
+            # owner group — a grouped shuffle with no python loop. Keys:
+            # uniform for unweighted truncation; -log(u)/w for weighted
+            # (Efraimidis-Spirakis — keeps each edge with probability
+            # proportional to its weight).
             bdeg = deg[big]
             owner = np.repeat(big, bdeg)
             # edge index ranges of the big nodes, concatenated
@@ -133,15 +210,35 @@ class DeviceGraph:
             starts = ends - bdeg
             edges = offsets + (np.arange(owner.shape[0])
                                - np.repeat(starts, bdeg))
-            keys = rng.random(edges.shape[0])
+            u = rng.random(edges.shape[0])
+            if g.is_weighted:
+                ew = np.maximum(g.weights[edges], 1e-30)
+                keys = -np.log(np.maximum(u, 1e-300)) / ew
+            else:
+                keys = u
             order2 = np.lexsort((keys, owner))
             edges_s = edges[order2]
             within = np.arange(owner.shape[0]) - np.repeat(starts, bdeg)
-            picked = g.cols[edges_s[within < md]]
-            nbrs[np.repeat(big, md),
-                 np.tile(np.arange(md), big.size)] = picked
+            kept = edges_s[within < md]
+            rows_idx = np.repeat(big, md)
+            cols_idx = np.tile(np.arange(md), big.size)
+            nbrs[rows_idx, cols_idx] = g.cols[kept]
+            if w_pad is not None:
+                w_pad[rows_idx, cols_idx] = g.weights[kept]
+        cdf = None
+        if w_pad is not None:
+            # Rows whose kept weights sum to 0 (all-zero weights but
+            # degree > 0, or isolated nodes) fall back to uniform over
+            # the valid columns so every neighbor stays reachable.
+            valid_cols = (np.arange(md)[None, :]
+                          < np.maximum(eff_deg, 1)[:, None])
+            totals = w_pad.sum(axis=1)
+            w_eff = np.where((totals <= 0)[:, None] & valid_cols,
+                             1.0, w_pad)
+            cum = np.cumsum(w_eff, axis=1)
+            cdf = (cum / cum[:, -1:]).astype(np.float32)
         return cls(nbrs=nbrs.astype(np.int32), degree=eff_deg,
-                   max_degree=md)
+                   max_degree=md, nbr_cdf=cdf)
 
 
 class GraphTable:
@@ -158,8 +255,10 @@ class GraphTable:
 
     def add_edges(self, edge_type: str, src: np.ndarray, dst: np.ndarray,
                   *, num_nodes: Optional[int] = None,
-                  symmetrize: bool = False) -> CSRGraph:
-        g = build_csr(src, dst, num_nodes=num_nodes, symmetrize=symmetrize)
+                  symmetrize: bool = False,
+                  weights: Optional[np.ndarray] = None) -> CSRGraph:
+        g = build_csr(src, dst, num_nodes=num_nodes, symmetrize=symmetrize,
+                      weights=weights)
         self._graphs[edge_type] = g
         self._device.pop(edge_type, None)
         log.vlog(1, "graph[%s]: %d nodes %d edges", edge_type, g.num_nodes,

@@ -1,0 +1,233 @@
+"""Edge-weighted graph sampling (VERDICT-r04 #6).
+
+The reference's graph store carries a weight per edge and samples
+neighbors by it when ``is_weighted``
+(common_graph_table.h:128-152 add_neighbor(id, dst, weight)); these tests
+pin the TPU build's three surfaces of the same capability: the host CSR
+(weights ride build/load), the padded device view (per-neighbor CDF +
+compare-sum inverse-CDF draw in XLA), and the sharded service
+(deterministic counter-hash draws -> shard-layout-invariant weighted
+samples).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from paddlebox_tpu.graph import (DeviceGraph, build_csr, device_arrays,
+                                 device_cdf, load_edge_file,
+                                 metapath_walk_weighted,
+                                 random_walk_weighted,
+                                 sample_neighbors_weighted,
+                                 stack_device_cdfs, stack_device_graphs)
+from paddlebox_tpu.graph.service import (GraphClient, GraphServer,
+                                         sample_neighbors_host)
+
+
+def _weighted_star():
+    """Node 0 -> {1, 2, 3} with weights 1, 2, 7 (plus a spectator edge)."""
+    src = np.asarray([0, 0, 0, 4], np.int64)
+    dst = np.asarray([1, 2, 3, 0], np.int64)
+    w = np.asarray([1.0, 2.0, 7.0, 5.0], np.float32)
+    return build_csr(src, dst, num_nodes=5, weights=w)
+
+
+def test_build_csr_carries_weights_through_permutation():
+    src = np.asarray([2, 0, 2, 1], np.int64)
+    dst = np.asarray([3, 1, 0, 2], np.int64)
+    w = np.asarray([0.3, 0.1, 0.2, 0.4], np.float32)
+    g = build_csr(src, dst, num_nodes=4, weights=w)
+    assert g.is_weighted
+    # Weight must stay glued to its (src, dst) edge across the sort.
+    for s, d, wi in zip(src, dst, w):
+        seg = slice(g.indptr[s], g.indptr[s + 1])
+        j = np.flatnonzero(g.cols[seg] == d)[0]
+        assert g.weights[seg][j] == np.float32(wi)
+
+
+def test_load_edge_file_third_column(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("0 1 2.5\n1 2 0.5\n2 0 1.0\n")
+    g = load_edge_file(str(p))
+    assert g.is_weighted and g.num_edges == 3
+    np.testing.assert_allclose(g.neighbor_weights(0), [2.5])
+    # Two-column files stay unweighted.
+    p2 = tmp_path / "plain.txt"
+    p2.write_text("0 1\n1 2\n")
+    assert not load_edge_file(str(p2)).is_weighted
+
+
+def test_negative_weights_rejected():
+    with pytest.raises(ValueError, match="negative"):
+        build_csr(np.asarray([0]), np.asarray([1]), num_nodes=2,
+                  weights=np.asarray([-1.0]))
+
+
+def test_device_sampling_frequency_matches_weights():
+    g = _weighted_star()
+    dg = DeviceGraph.from_csr(g)
+    nbrs, _ = device_arrays(dg)
+    cdf = device_cdf(dg)
+    nodes = np.zeros(512, np.int64)
+    out = np.asarray(sample_neighbors_weighted(
+        nbrs, cdf, nodes, jax.random.key(0), 16)).reshape(-1)
+    freq = np.bincount(out, minlength=5) / out.size
+    # weights 1:2:7 over neighbors {1,2,3}
+    np.testing.assert_allclose(freq[[1, 2, 3]], [0.1, 0.2, 0.7], atol=0.02)
+    assert freq[0] == 0 and freq[4] == 0  # non-neighbors never sampled
+
+
+def test_zero_weight_edge_never_sampled_and_isolated_self_loops():
+    src = np.asarray([0, 0], np.int64)
+    dst = np.asarray([1, 2], np.int64)
+    g = build_csr(src, dst, num_nodes=4,
+                  weights=np.asarray([0.0, 3.0], np.float32))
+    dg = DeviceGraph.from_csr(g)
+    nbrs, _ = device_arrays(dg)
+    cdf = device_cdf(dg)
+    # node 0: only the weight-3 edge; node 3: isolated -> self.
+    out = np.asarray(sample_neighbors_weighted(
+        nbrs, cdf, np.asarray([0, 3], np.int64), jax.random.key(1), 64))
+    assert set(out[0].tolist()) == {2}
+    assert set(out[1].tolist()) == {3}
+
+
+def test_weighted_walk_follows_heavy_path():
+    # Chain 0->1->2 with heavy weights vs decoy edges of tiny weight:
+    # a weighted walk follows the heavy chain essentially always.
+    src = np.asarray([0, 0, 1, 1, 2], np.int64)
+    dst = np.asarray([1, 3, 2, 3, 2], np.int64)
+    w = np.asarray([1e4, 1e-4, 1e4, 1e-4, 1.0], np.float32)
+    dg = DeviceGraph.from_csr(build_csr(src, dst, num_nodes=4, weights=w))
+    nbrs, _ = device_arrays(dg)
+    cdf = device_cdf(dg)
+    walks = np.asarray(random_walk_weighted(
+        nbrs, cdf, np.zeros(64, np.int64), jax.random.key(2), 2))
+    heavy = (walks == np.asarray([0, 1, 2])).all(axis=1).mean()
+    assert heavy > 0.95
+
+
+def test_hub_truncation_keeps_heavy_edges():
+    # Node 0 has 64 neighbors but max_degree=8; 8 edges carry weight 1,
+    # the rest ~0 — the Efraimidis-Spirakis subsample must keep exactly
+    # the heavy ones.
+    n_nb = 64
+    src = np.zeros(n_nb, np.int64)
+    dst = np.arange(1, n_nb + 1, dtype=np.int64)
+    w = np.full(n_nb, 1e-20, np.float32)
+    heavy = np.asarray([3, 7, 11, 19, 23, 31, 47, 55])
+    w[heavy - 1] = 1.0
+    g = build_csr(src, dst, num_nodes=n_nb + 1, weights=w)
+    dg = DeviceGraph.from_csr(g, max_degree=8, seed=5)
+    assert set(dg.nbrs[0].tolist()) == set(heavy.tolist())
+
+
+def test_weighted_metapath_stack():
+    # Type 0: 0->{1,2} heavy to 1; type 1: from {1,2} heavy to 3 vs 4.
+    g0 = build_csr(np.asarray([0, 0]), np.asarray([1, 2]), num_nodes=5,
+                   weights=np.asarray([1e4, 1e-4], np.float32))
+    g1 = build_csr(np.asarray([1, 1, 2]), np.asarray([3, 4, 4]),
+                   num_nodes=5,
+                   weights=np.asarray([1e4, 1e-4, 1.0], np.float32))
+    dgs = [DeviceGraph.from_csr(g0), DeviceGraph.from_csr(g1)]
+    nbrs_s, _ = stack_device_graphs(dgs)
+    cdf_s = stack_device_cdfs(dgs)
+    walks = np.asarray(metapath_walk_weighted(
+        nbrs_s, cdf_s, np.zeros(64, np.int64), jax.random.key(3),
+        (0, 1)))
+    frac = (walks == np.asarray([0, 1, 3])).all(axis=1).mean()
+    assert frac > 0.95
+
+
+def test_service_weighted_layout_invariance():
+    """The decisive service property: weighted samples are deterministic
+    per (seed, node, slot), so a 2-shard cluster answers BIT-IDENTICALLY
+    to the single-shard one — and both match the local host sampler on
+    the full CSR. Integer-valued weights keep the prefix-sum float ops
+    exact, so the equality is exact."""
+    rng = np.random.default_rng(11)
+    n_nodes, n_edges = 120, 1500
+    src = rng.integers(0, n_nodes, n_edges).astype(np.int64)
+    dst = rng.integers(0, n_nodes, n_edges).astype(np.int64)
+    w = rng.integers(1, 9, n_edges).astype(np.float32)
+    full = build_csr(src, dst, num_nodes=n_nodes, weights=w)
+    nodes = rng.integers(0, n_nodes, 64).astype(np.int64)
+
+    results = {}
+    for n_servers in (1, 2):
+        servers = [GraphServer("127.0.0.1:0", i, n_servers)
+                   for i in range(n_servers)]
+        cli = GraphClient([s.endpoint for s in servers])
+        try:
+            cli.upload_batch("e", src, dst, num_nodes=n_nodes, weights=w)
+            cli.build("e")
+            results[n_servers] = (
+                cli.sample_neighbors("e", nodes, k=6, seed=9,
+                                     weighted=True),
+                cli.metapath_walk(["e", "e", "e"], nodes, seed=4,
+                                  weighted=True))
+        finally:
+            cli.stop_servers()
+            cli.close()
+            for s in servers:
+                s.stop()
+    np.testing.assert_array_equal(results[1][0], results[2][0])
+    np.testing.assert_array_equal(results[1][1], results[2][1])
+    ref = sample_neighbors_host(full, nodes, 6, 9, weighted=True)
+    np.testing.assert_array_equal(results[1][0], ref)
+
+    # And the weighted draws actually tilt toward heavy edges: the host
+    # sampler's empirical pick distribution on a 3-neighbor star. The
+    # draw is deterministic per (seed, node, slot), so the SLOT axis is
+    # what varies the randomness (identical rows repeat by design).
+    star = _weighted_star()
+    picks = sample_neighbors_host(star, np.zeros(1, np.int64), 8192, 123,
+                                  weighted=True).reshape(-1)
+    freq = np.bincount(picks, minlength=5) / picks.size
+    np.testing.assert_allclose(freq[[1, 2, 3]], [0.1, 0.2, 0.7], atol=0.03)
+
+
+def test_generator_weighted_walks():
+    """GraphDataGenerator(weighted=True): walk hops follow edge weights
+    (single-type and metapath), so skip-gram contexts concentrate on the
+    heavy-edge path."""
+    from paddlebox_tpu.graph import (GraphDataGenerator, GraphGenConfig,
+                                     GraphTable)
+
+    t = GraphTable()
+    # 0 -> 1 heavy vs 0 -> 3 tiny; the first hop from 0 lands on 1.
+    # Node 3 is a sink (its walks self-loop and mask out), so center-0
+    # pairs come only from walks STARTING at 0 — no backward dilution.
+    src = np.asarray([0, 0, 1, 2], np.int64)
+    dst = np.asarray([1, 3, 2, 1], np.int64)
+    w = np.asarray([1e4, 1e-4, 1.0, 1.0], np.float32)
+    t.add_edges("e", src, dst, num_nodes=4, weights=w)
+    gen = GraphDataGenerator(
+        t, "e", GraphGenConfig(walk_len=1, window=1, batch_walks=64,
+                               start_type=None, weighted=True))
+    b = next(gen.batches())
+    centers = np.asarray(b["centers"])
+    contexts = np.asarray(b["contexts"])
+    from_zero = contexts[(centers == 0) & np.asarray(b["mask"])]
+    assert from_zero.size and (from_zero == 1).mean() > 0.9
+
+    t.add_edges("f", dst, src, num_nodes=4, weights=w)
+    gen2 = GraphDataGenerator(
+        t, "e", GraphGenConfig(walk_len=2, batch_walks=8, weighted=True,
+                               metapath=("e", "f")))
+    assert next(gen2.batches())["centers"].size
+
+
+def test_service_weighted_requires_weights():
+    servers = [GraphServer("127.0.0.1:0", 0, 1)]
+    cli = GraphClient([servers[0].endpoint])
+    try:
+        cli.upload_batch("e", np.asarray([0]), np.asarray([1]),
+                         num_nodes=2)
+        cli.build("e")
+        with pytest.raises(RuntimeError, match="no weights"):
+            cli.sample_neighbors("e", np.asarray([0]), 2, weighted=True)
+    finally:
+        cli.stop_servers()
+        cli.close()
+        servers[0].stop()
