@@ -131,6 +131,7 @@ SELF_BASELINE = {
     "bert_dp": None,
     "gpt": None,
     "wide_deep": None,
+    "graph_walk": None,
 }
 
 # First-recorded numbers (tools/record_baselines.py writes them as soon
@@ -441,8 +442,26 @@ def bench_deepfm() -> dict:
         "pass_keys": PASS_KEYS,
         "auc": round(float(stats["auc"]), 5),
         "auc_floor": _auc_floor(stats["auc"]),
+        "lookup_overflow": _overflow_guard(stats),
+        "lookup_exchange_bytes": int(stats["lookup_exchange_bytes"]),
+        "scale_sparse_grad_by_batch": stats["scale_sparse_grad_by_batch"],
         "n_devices": ndev,
     }
+
+
+def _overflow_guard(stats: dict) -> int:
+    """VERDICT-r04 #8: dropped grads must never hide inside a throughput
+    number. Any bucket-overflowed lookup during the TIMED pass fails the
+    bench record outright — with dedup-before-exchange on (default),
+    even planted hot-key skew must not overflow at default slack."""
+    n = int(stats.get("lookup_overflow", 0))
+    if n:
+        raise RuntimeError(
+            f"{n} sparse lookups overflowed their shard bucket during the "
+            f"timed pass (dropped pull+grad) — the throughput number would "
+            f"be measuring dropped work; raise FLAGS_embedding_shard_slack "
+            f"or FLAGS_embedding_unique_frac")
+    return 0
 
 
 def _auc_floor(auc: float, floor: float = 0.7):
@@ -748,6 +767,103 @@ def bench_wide_deep() -> dict:
         "store_build_keys_per_s": round(build_keys_per_s, 0),
         "auc": round(float(stats["auc"]), 5),
         "auc_floor": _auc_floor(stats["auc"]),
+        "lookup_overflow": _overflow_guard(stats),
+        "lookup_exchange_bytes": int(stats["lookup_exchange_bytes"]),
+        "scale_sparse_grad_by_batch": stats["scale_sparse_grad_by_batch"],
+        "n_devices": ndev,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Graph engine at non-toy scale (SURVEY §2.3): 10M-edge weighted build +
+# sharded deepwalk throughput — the roles of GraphGpuWrapper::load_edge_file
+# + upload_batch and GraphDataGenerator's walk loop
+# (graph_gpu_ps_table_inl.cu), measured instead of merely covered.
+# ---------------------------------------------------------------------------
+
+GRAPH_EDGES = 10_000_000
+GRAPH_NODES = 1_000_000
+GRAPH_MAX_DEGREE = 64
+GRAPH_WALK_LEN = 24
+GRAPH_WALK_BATCH = 65_536
+if _SMALL:
+    GRAPH_EDGES, GRAPH_NODES = 1_000_000, 100_000
+    GRAPH_WALK_BATCH = 8_192
+
+
+def bench_graph() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddlebox_tpu.graph import DeviceGraph, build_csr
+    from paddlebox_tpu.graph.sampler import (random_walk,
+                                             random_walk_weighted)
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+
+    ndev = len(jax.devices())
+    mesh = build_mesh(HybridTopology(dp=ndev))
+    rng = np.random.default_rng(0)
+
+    # Power-law-ish destinations (Zipf hubs — the degree skew real graphs
+    # have, which is exactly what stresses the hub truncation path) with
+    # integer weights.
+    _tick("graph:gen")
+    src = rng.integers(0, GRAPH_NODES, GRAPH_EDGES).astype(np.int64)
+    dst = (rng.zipf(1.3, GRAPH_EDGES) % GRAPH_NODES).astype(np.int64)
+    w = rng.integers(1, 10, GRAPH_EDGES).astype(np.float32)
+
+    _tick("graph:build")
+    t0 = time.perf_counter()
+    g = build_csr(src, dst, num_nodes=GRAPH_NODES, weights=w)
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    dg = DeviceGraph.from_csr(g, max_degree=GRAPH_MAX_DEGREE)
+    pad_s = time.perf_counter() - t0
+
+    _tick("graph:upload")
+    rep = NamedSharding(mesh, P())
+    shd = NamedSharding(mesh, P("dp"))
+    nbrs = jax.device_put(jnp.asarray(dg.nbrs), rep)
+    degree = jax.device_put(jnp.asarray(dg.degree), rep)
+    cdf = jax.device_put(jnp.asarray(dg.nbr_cdf), rep)
+    starts = jax.device_put(
+        jnp.asarray(rng.integers(0, GRAPH_NODES, GRAPH_WALK_BATCH),
+                    jnp.int32), shd)
+
+    def timed_walks(fn, *arrays):
+        # jitted fns shard the start batch over dp; the adjacency is
+        # device-resident and replicated (each GPU holds its graph shard
+        # in the reference; one chip holds the whole padded table here).
+        _tick("graph:walk-compile")
+        out = fn(*arrays, starts, jax.random.key(0), GRAPH_WALK_LEN)
+        _sync(out[-1, -1])
+        t0 = time.perf_counter()
+        iters = 10
+        for i in range(iters):
+            out = fn(*arrays, starts, jax.random.key(i + 1),
+                     GRAPH_WALK_LEN)
+        _sync(out[-1, -1])
+        dt = time.perf_counter() - t0
+        return iters * GRAPH_WALK_BATCH * GRAPH_WALK_LEN / dt
+
+    uniform_sps = timed_walks(random_walk, nbrs, degree)
+    weighted_sps = timed_walks(random_walk_weighted, nbrs, cdf)
+
+    return {
+        "metric": "graph_walk_steps_per_sec",
+        "value": round(uniform_sps, 0),
+        "unit": "walk steps/s",
+        "vs_baseline": _vs("graph_walk", uniform_sps),
+        "weighted_walk_steps_per_sec": round(weighted_sps, 0),
+        "build_edges_per_sec": round(GRAPH_EDGES / build_s, 0),
+        "build_s": round(build_s, 3),
+        "pad_s": round(pad_s, 3),
+        "edges": GRAPH_EDGES,
+        "nodes": GRAPH_NODES,
+        "max_degree": GRAPH_MAX_DEGREE,
+        "walk_len": GRAPH_WALK_LEN,
+        "walk_batch": GRAPH_WALK_BATCH,
         "n_devices": ndev,
     }
 
@@ -758,6 +874,7 @@ CONFIGS = {
     "bert_dp": bench_bert_dp,
     "gpt": bench_gpt,
     "wide_deep": bench_wide_deep,
+    "graph": bench_graph,
 }
 
 

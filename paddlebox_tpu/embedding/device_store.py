@@ -418,20 +418,21 @@ class DeviceFeatureStore:
                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
         """Host-exact bucketing for the sharded pass transfers.
 
-        Pass row p (pass-shard p // rps, local p % rps) maps to store
-        shard rows[p] % s at slot rows[p] // s; missing keys (row -1,
-        read-only pulls) route to the scratch slot of shard p % s so they
-        read zero. Returns (slot [sp,s,cap], local [sp,s,cap], counts,
-        cap) with pads slot=-1/local=-1 to be sentineled by the caller;
-        cap pow2-stable across passes.
+        Pass rank p (round-robin: pass-shard p % sp, local p // sp —
+        table.py layout) maps to store shard rows[p] % s at slot
+        rows[p] // s; missing keys (row -1, read-only pulls) route to the
+        scratch slot of shard p % s so they read zero. Returns
+        (slot [sp,s,cap], local [sp,s,cap], counts, cap) with pads
+        slot=-1/local=-1 to be sentineled by the caller; cap pow2-stable
+        across passes.
         """
         s = self.num_shards
         valid = rows >= 0
         store_shard = np.where(valid, rows % s, np.arange(n) % s
                                ).astype(np.int64)
         store_slot = np.where(valid, rows // s, self._cap).astype(np.int64)
-        pass_shard = (np.arange(n) // rps).astype(np.int64)
-        pass_local = (np.arange(n) % rps).astype(np.int64)
+        pass_shard = (np.arange(n) % sp).astype(np.int64)
+        pass_local = (np.arange(n) // sp).astype(np.int64)
         counts = np.zeros((sp, s), np.int64)
         np.add.at(counts, (pass_shard, store_shard), 1)
         cap = _pow2(max(int(counts.max()) if n else 1, 1))
@@ -476,8 +477,8 @@ class DeviceFeatureStore:
         place = np.where(local >= 0, local, rps).astype(np.int32)
         # Overlay init records bucketed by pass shard.
         if n_miss:
-            m_shard = missing // rps
-            m_local = (missing % rps).astype(np.int32)
+            m_shard = missing % sp
+            m_local = (missing // sp).astype(np.int32)
             m_counts = np.bincount(m_shard, minlength=sp)
             cap_m = _pow2(int(m_counts.max()))
         else:

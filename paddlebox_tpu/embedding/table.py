@@ -7,9 +7,16 @@ show, click, embed_w(lr), embed_g2sum, embedx_w[mf], embedx_g2sum).
 
 TPU-first: because the pass key set is pre-registered (pass-based design),
 the device table needs NO hashtable — rows are assigned by sorted-key rank,
-split contiguously across shards. Each shard carries one extra trash row
-(index ``rows_per_shard``) that absorbs padding lookups and padding grads,
-so every kernel is mask-free and static-shape.
+dealt ROUND-ROBIN across shards (rank g -> shard g % S, slot g // S). The
+round-robin deal is load-bearing: ``plan_shards`` rounds rows_per_shard up
+to a power of two for compile stability, and a contiguous split would then
+leave the tail shards empty (a 20K-key pass over 8 shards of 4096 rows
+puts everything in shards 0-4), concentrating the pull/push all-to-all on
+a subset of links and overflowing their fixed-capacity buckets — the
+reference gets the same balance by hashing keys to shards
+(``key % shard_num``, heter_comm_inl.h:267). Each shard carries one extra
+trash row (index ``rows_per_shard``) that absorbs padding lookups and
+padding grads, so every kernel is mask-free and static-shape.
 
 All per-row fields live in ONE ``[rows, W]`` float32 array (the
 CommonFeatureValue packing) so the hot path is a single gather per pull and
@@ -24,8 +31,8 @@ Column layout (D = emb dim, Ke/Kw = optimizer state widths):
 
 Index math (device-side, int32):
   global row g of key k  = rank of k in the sorted pass key set (host)
-  shard(g)               = g // rows_per_shard
-  row_in_shard(g)        = g %  rows_per_shard
+  shard(g)               = g %  num_shards
+  row_in_shard(g)        = g // num_shards
   padding sentinel       = trash row of shard (i % S)
 """
 
@@ -194,23 +201,28 @@ def split_values_host(fused: np.ndarray, dim: int, ke: int, kw: int
 
 def lay_fused_host(fused: np.ndarray, num_shards: int, rps: int
                    ) -> np.ndarray:
-    """[n, W] sorted-rank rows → shard-contiguous [S*(rps+1), W] with a
+    """[n, W] sorted-rank rows → round-robin sharded [S*(rps+1), W] with a
     zeroed trash row per shard (role of BuildGPUTask filling HBM mem-pool
-    records, ps_gpu_wrapper.cc:684)."""
+    records, ps_gpu_wrapper.cc:684): rank g lands in shard g % S at slot
+    g // S, so every shard holds ~n/S rows for ANY n (module docstring)."""
     n, w = fused.shape
     out = np.zeros((num_shards, rps + 1, w), np.float32)
     for s in range(num_shards):
-        lo, hi = s * rps, min((s + 1) * rps, n)
-        if lo < hi:
-            out[s, :hi - lo] = fused[lo:hi]
+        part = fused[s::num_shards]
+        out[s, :part.shape[0]] = part
     return out.reshape(num_shards * (rps + 1), w)
 
 
 def unlay_fused_host(laid: np.ndarray, num_shards: int, rps: int,
                      num_keys: int) -> np.ndarray:
-    """Inverse of lay_fused_host: strip trash rows, first num_keys rows."""
+    """Inverse of lay_fused_host: strip trash rows, back to sorted-rank
+    order."""
     a = laid.reshape(num_shards, rps + 1, laid.shape[-1])[:, :rps]
-    return a.reshape(num_shards * rps, laid.shape[-1])[:num_keys]
+    out = np.empty((num_keys, laid.shape[-1]), laid.dtype)
+    for s in range(num_shards):
+        cnt = len(range(s, num_keys, num_shards))
+        out[s::num_shards] = a[s, :cnt]
+    return out
 
 
 def build_pass_table_host(values: Dict[str, np.ndarray], num_shards: int,
@@ -255,7 +267,9 @@ def extract_pass_values_host(table: PassTable, num_keys: int
 
 def map_keys_to_rows(pass_keys_sorted: np.ndarray, batch_keys: np.ndarray,
                      rows_per_shard: int, num_shards: int = 1) -> np.ndarray:
-    """Host-side: feasigns → device row ids in the shard-contiguous layout.
+    """Host-side: feasigns → device row ids in the ROUND-ROBIN sharded
+    layout (rank g -> shard g % num_shards at slot g // num_shards —
+    module docstring).
 
     Role of the key→slot flattening in CopyKeys + the per-pass perfect
     index (SURVEY.md §7 design note). Unknown keys and the 0 padding
@@ -274,7 +288,7 @@ def map_keys_to_rows(pass_keys_sorted: np.ndarray, batch_keys: np.ndarray,
     g = np.searchsorted(pass_keys_sorted, batch_keys)
     g_c = np.minimum(g, n - 1)
     found = (pass_keys_sorted[g_c] == batch_keys) & (batch_keys != 0)
-    shard = g_c // rows_per_shard
-    row = g_c % rows_per_shard
+    shard = g_c % num_shards
+    row = g_c // num_shards
     dev_row = shard * (rows_per_shard + 1) + row
     return np.where(found, dev_row, sentinel).astype(np.int32)

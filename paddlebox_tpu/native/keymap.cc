@@ -186,9 +186,11 @@ void* pbx_keymap_build(const uint64_t* sorted_keys, int64_t n) {
 
 int64_t pbx_keymap_size(void* h) { return static_cast<KeyMap*>(h)->n; }
 
-// Batch lookup: keys[m] -> device rows in the shard-contiguous layout
-// (table.py map_keys_to_rows contract): found -> shard*(rps+1) + row;
-// missing or 0 -> round-robin trash row (position % num_shards).
+// Batch lookup: keys[m] -> device rows in the round-robin sharded layout
+// (table.py map_keys_to_rows contract): found rank g -> shard g % S at
+// slot g / S (the deal keeps every shard ~equally loaded under the pow2
+// rows_per_shard rounding); missing or 0 -> round-robin trash row
+// (position % num_shards).
 void pbx_keymap_lookup(void* h, const uint64_t* batch, int64_t m,
                        int32_t rows_per_shard, int32_t num_shards,
                        int32_t* out_rows) {
@@ -203,8 +205,8 @@ void pbx_keymap_lookup(void* h, const uint64_t* batch, int64_t m,
         out_rows[i] =
             static_cast<int32_t>(pad_shard * block + rows_per_shard);
       } else {
-        int64_t shard = g / rows_per_shard;
-        int64_t row = g % rows_per_shard;
+        int64_t shard = g % num_shards;
+        int64_t row = g / num_shards;
         out_rows[i] = static_cast<int32_t>(shard * block + row);
       }
     }

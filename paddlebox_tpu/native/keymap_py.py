@@ -48,8 +48,9 @@ def dedup_keys(keys: np.ndarray) -> np.ndarray:
 
 class KeyMap:
     """Hash map from the pass's sorted unique keys to their rank, serving
-    batch key→device-row lookups (shard-contiguous layout with round-robin
-    trash sentinels — exact ``map_keys_to_rows`` semantics)."""
+    batch key→device-row lookups (round-robin sharded layout — rank g ->
+    shard g % S at slot g // S — with round-robin trash sentinels; exact
+    ``map_keys_to_rows`` semantics)."""
 
     def __init__(self, sorted_keys: np.ndarray, rows_per_shard: int,
                  num_shards: int = 1):

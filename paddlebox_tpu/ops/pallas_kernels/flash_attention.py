@@ -407,9 +407,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     tiles without plumbing.
     """
     from paddlebox_tpu.core import flags as _flags
-    if block_q is None or block_k is None:
-        block_q = int(block_q or _flags.flag("flash_block_q"))
-        block_k = int(block_k or _flags.flag("flash_block_k"))
+    # Per-parameter None checks: an explicit (invalid) 0 must error in
+    # the kernel's own validation, not silently fall back to the flag.
+    if block_q is None:
+        block_q = int(_flags.flag("flash_block_q"))
+    if block_k is None:
+        block_k = int(_flags.flag("flash_block_k"))
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     if use_pallas is None:

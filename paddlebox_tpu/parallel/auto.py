@@ -178,15 +178,22 @@ def estimate_plan(params: Any, specs: Any, mesh: Mesh, *,
     its full bytes (use) + reduce-scatter of its grad (~2x bytes total)
     over A, while its grad sync over dp shrinks to bytes/|A|."""
     leaves = jax.tree_util.tree_leaves(params)
+    # None spec leaves mean replicated; keep them as leaves so the two
+    # flattenings stay congruent.
     spec_leaves = jax.tree_util.tree_leaves(
-        specs, is_leaf=lambda x: isinstance(x, P))
+        specs, is_leaf=lambda x: x is None or isinstance(x, P))
+    if len(leaves) != len(spec_leaves):
+        raise ValueError(
+            f"params/specs structure mismatch: {len(leaves)} param leaves "
+            f"vs {len(spec_leaves)} spec leaves — a silent zip truncation "
+            f"here would under-count the plan's cost")
     dp = int(mesh.shape.get(dp_axis, 1))
     mem = ar = ag = 0
     for leaf, spec in zip(leaves, spec_leaves):
         nbytes = int(np.prod(np.shape(leaf), dtype=np.int64)
                      * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize)
         factor = 1
-        for entry in spec:
+        for entry in (() if spec is None else spec):
             if entry is None:
                 continue
             for ax in (entry if isinstance(entry, (tuple, list))

@@ -748,9 +748,21 @@ class CTRTrainer:
             rows, segs, labels, valid, dense = args
             if group_n is None:
                 # Per-device id count per width group — static across the
-                # pass, feeds the exchange-bytes observable below.
+                # pass, feeds the exchange-bytes observable below. The
+                # duplication factor (occurrences per unique id in the
+                # first batch) tells the operator how much headroom
+                # FLAGS_embedding_unique_frac could reclaim: dedup means
+                # bucket cells hold UNIQUE ids, so unique_frac can drop
+                # toward 1/duplication before overflow risk returns.
                 group_n = [int(r.shape[0]) // max(self.ndev, 1)
                            for r in rows]
+                first_batch_dup = None
+                if all(getattr(r, "is_fully_addressable", True)
+                       for r in rows):
+                    occ = sum(int(r.shape[0]) for r in rows)
+                    uniq = sum(len(np.unique(np.asarray(r)))
+                               for r in rows)
+                    first_batch_dup = occ / max(uniq, 1)
             if mode == "async":
                 # PullDense role: freshest host params each step.
                 params = jax.device_put(self._async_dense.pull_dense(), rep)
@@ -806,6 +818,12 @@ class CTRTrainer:
         stats["lookup_exchange_bytes"] = (int(sum(
             exchange_bytes(t, n) for t, n in zip(tables, group_n)))
             if group_n else 0)
+        # Occurrences per unique id in the pass's first batch: the
+        # operator's sizing signal for FLAGS_embedding_unique_frac
+        # (safe floor ~= 1/duplication).
+        stats["lookup_duplication"] = (
+            round(first_batch_dup, 3) if group_n and first_batch_dup
+            else None)
         stats["scale_sparse_grad_by_batch"] = bool(
             self.config.scale_sparse_grad_by_batch)
         if stats["lookup_overflow"]:

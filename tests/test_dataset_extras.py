@@ -200,10 +200,11 @@ def test_extended_pull_push(devices8):
     table = build_pass_table_host(vals, 8, cfg)
 
     rows = jnp.asarray(rng.integers(0, n, 32), jnp.int32)
-    # map global rows to device-row space: table uses block layout
+    # map global ranks to device-row space: round-robin deal (rank g ->
+    # shard g % S at slot g // S, table.py module docstring)
     block = table.rows_per_shard + 1
-    dev_rows = (rows // table.rows_per_shard) * block \
-        + rows % table.rows_per_shard
+    nsh = table.num_shards
+    dev_rows = (rows % nsh) * block + rows // nsh
 
     @jax.jit
     @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),

@@ -49,13 +49,13 @@ def test_map_keys_to_rows():
     rps = plan_shards(8, 2)  # 4 rows/shard
     rows = map_keys_to_rows(keys, np.array([3, 55, 99, 0, 22], np.uint64),
                             rps, num_shards=2)
-    # shard block = rps+1; key 3 -> g0 -> row 0; 55 -> g7 -> shard1 row3
-    assert rows[0] == 0
-    assert rows[1] == 1 * (rps + 1) + 3
+    # Round-robin deal: rank g -> shard g % S, slot g // S (block rps+1).
+    assert rows[0] == 0                    # key 3 -> g0 -> shard0 slot0
+    assert rows[1] == 1 * (rps + 1) + 3    # 55 -> g7 -> shard1 slot3
     # Sentinels spread round-robin over shards' trash rows by position:
     assert rows[2] == 0 * (rps + 1) + rps  # pos 2 -> shard 0 trash
     assert rows[3] == 1 * (rps + 1) + rps  # pos 3 -> shard 1 trash
-    assert rows[4] == 1 * (rps + 1) + 0  # 22 -> g4 -> shard1 row0
+    assert rows[4] == 0 * (rps + 1) + 2    # 22 -> g4 -> shard0 slot2
 
 
 def test_sentinels_spread_evenly():
@@ -92,7 +92,8 @@ def test_pull_matches_reference(devices8, nshards):
     rng = np.random.default_rng(2)
     batch_keys = rng.choice(keys, n_ids).astype(np.uint64)
     batch_keys[5] = 9999  # unknown key
-    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard)
+    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
+                            num_shards=nshards)
     out = pull(table, jnp.asarray(rows))
 
     g = np.searchsorted(keys, batch_keys)
@@ -118,7 +119,8 @@ def test_push_exact_dedup_update(devices8, nshards):
 
     rng = np.random.default_rng(4)
     batch_keys = rng.choice(keys, n_ids).astype(np.uint64)  # duplicates!
-    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard)
+    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
+                            num_shards=nshards)
     g_emb = rng.normal(size=(n_ids, DIM)).astype(np.float32)
     g_w = rng.normal(size=(n_ids,)).astype(np.float32)
     shows = np.ones((n_ids,), np.float32)
@@ -172,7 +174,8 @@ def test_multi_shard_equals_single_shard(devices8):
         table = build_pass_table_host(vals, nshards, CFG)
         mesh = build_mesh(HybridTopology(dp=nshards),
                           devices8[:nshards] if nshards > 1 else devices8[:1])
-        rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard)
+        rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
+                            num_shards=nshards)
         pull = make_pull_fn(mesh, "dp")
         push = make_push_fn(mesh, "dp", SparseAdagrad.from_config(CFG))
         pulled = pull(table, jnp.asarray(rows))
@@ -290,9 +293,11 @@ def test_overflow_counter_on_skewed_keys(devices8):
     mesh = build_mesh(HybridTopology(dp=nshards), devices8)
     pull = make_pull_fn(mesh, "dp")
 
-    # Distinct keys of adjacent rank -> all land in shard 0's bucket on
-    # every device (ranks map to shards in contiguous blocks).
-    batch_keys = np.tile(np.arange(1, n_ids + 1, dtype=np.uint64), nshards)
+    # Distinct keys whose ranks are all ≡ 0 (mod nshards) -> all land in
+    # shard 0's bucket on every device (round-robin deal: shard = rank %
+    # nshards), so dedup cannot absorb the skew.
+    batch_keys = np.tile(
+        1 + nshards * np.arange(n_ids, dtype=np.uint64), nshards)
     rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
                             num_shards=nshards)
     out = pull(table, jnp.asarray(rows))

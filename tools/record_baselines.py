@@ -41,6 +41,7 @@ CONFIGS = {
     "resnet50": "resnet50",
     "bert_dp": "bert_dp",
     "gpt": "gpt",
+    "graph": "graph_walk",
 }
 
 BEGIN = "<!-- record_baselines:begin -->"
