@@ -157,13 +157,16 @@ class CheckpointProtocol:
 
     def recovery_chain(self) -> Tuple[Optional[DoneRecord], List[DoneRecord]]:
         """(last day-level base, deltas after it, in order) — the load
-        sequence for failover resume."""
+        sequence for failover resume. With no base yet (a crash during
+        the FIRST day), the chain is every published delta applied to
+        the fresh store — deltas are self-contained row snapshots, so a
+        day-1 mid-day failure still resumes at the last published pass
+        instead of retraining the day from scratch."""
         recs = self.records()
         base = None
         base_i = -1
         for i, r in enumerate(recs):
             if r.pass_id == 0:
                 base, base_i = r, i
-        deltas = [r for r in recs[base_i + 1:] if r.pass_id != 0] \
-            if base is not None else []
+        deltas = [r for r in recs[base_i + 1:] if r.pass_id != 0]
         return base, deltas

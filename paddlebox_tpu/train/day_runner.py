@@ -123,18 +123,27 @@ class DayRunner:
         """Load last base + subsequent deltas from the done-file (role of
         the elastic restart consumers). Returns the resume point
         ``{"day": str, "pass_id": int}`` — the last day/pass whose state
-        is already in the store — or None when starting fresh."""
+        is already in the store — or None when starting fresh. The point
+        is also remembered so a direct ``train_day`` call (the elastic
+        worker pattern) skips already-published passes; pass_id 0 means
+        the day completed through its base dump.
+
+        A chain with deltas but NO base (crash during the first day)
+        loads the deltas onto the fresh store — resuming costs at most
+        the in-flight pass even before the first day-end base exists."""
         base, deltas = self.ckpt.recovery_chain()
-        if base is None:
+        if base is None and not deltas:
             log.vlog(0, "day_runner: no published model, fresh start")
+            self._recover_point = None
             return None
         store = self.trainer.engine.store
-        store.load(base.path, "base")
+        if base is not None:
+            store.load(base.path, "base")
         for d in deltas:
             store.load(d.path, "delta")
         # Dense state from the NEWEST record that carries it (sparse
         # deltas are cumulative; dense checkpoints are full snapshots).
-        for rec in [*reversed(deltas), base]:
+        for rec in [*reversed(deltas)] + ([base] if base else []):
             if self._load_dense(rec.path):
                 log.vlog(0, "day_runner: dense state from %s", rec.path)
                 break
@@ -143,11 +152,15 @@ class DayRunner:
                         "chain — dense towers resume from current "
                         "(likely fresh) init")
         log.vlog(0, "day_runner: recovered base %s + %d deltas (day %s)",
-                 base.path, len(deltas), base.day)
+                 base.path if base else "<none>", len(deltas),
+                 base.day if base else (deltas[-1].day if deltas else "?"))
         if deltas:
             last = deltas[-1]
-            return {"day": last.day, "pass_id": last.pass_id}
-        return {"day": base.day, "pass_id": 0}
+            point = {"day": last.day, "pass_id": last.pass_id}
+        else:
+            point = {"day": base.day, "pass_id": 0}
+        self._recover_point = point
+        return point
 
     # -- day loop ----------------------------------------------------------
 
@@ -229,10 +242,29 @@ class DayRunner:
         return stats
 
     def train_day(self, day: str,
-                  start_pass: int = 1) -> List[Dict[str, float]]:
+                  start_pass: Optional[int] = None
+                  ) -> List[Dict[str, float]]:
         """All passes of one day, then shrink + base dump (the day
         boundary sequence the reference runs: shrink → SaveBase →
-        write_model_donefile)."""
+        write_model_donefile).
+
+        ``start_pass=None`` derives the start from the last ``recover()``
+        point: a recovered pass of THIS day resumes after it, and a
+        recovered day BASE (pass 0 — the day finished) skips the day
+        outright — an elastic restart landing after the day completed
+        must not retrain it and republish its passes (observed: a
+        post-completion join regenerated deltas 1..6 over a finished
+        day before this guard)."""
+        if start_pass is None:
+            p = getattr(self, "_recover_point", None)
+            if p is not None and p["day"] == str(day):
+                if p["pass_id"] == 0:
+                    log.vlog(0, "day %s already complete in the recovery "
+                             "chain: skipping", day)
+                    return []
+                start_pass = int(p["pass_id"]) + 1
+            else:
+                start_pass = 1
         all_stats = []
         resumed_past = 0  # passes skipped because recovery already holds them
         jobs: List = []
