@@ -7,6 +7,7 @@ Dense families (ResNet/BERT/GPT — the reference's fleet collective /
 hybrid-parallel configs) live in their own modules.
 """
 
+from paddlebox_tpu.models.autoint import AutoInt
 from paddlebox_tpu.models.dcn import DCN
 from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.din_rank import DINRank, build_rank_offset
@@ -14,5 +15,6 @@ from paddlebox_tpu.models.multitask import MMoE, SharedBottomMultiTask
 from paddlebox_tpu.models.wide_deep import WideDeep
 from paddlebox_tpu.models.xdeepfm import XDeepFM
 
-__all__ = ["DCN", "DeepFM", "DINRank", "MMoE", "SharedBottomMultiTask",
-           "WideDeep", "XDeepFM", "build_rank_offset"]
+__all__ = ["AutoInt", "DCN", "DeepFM", "DINRank", "MMoE",
+           "SharedBottomMultiTask", "WideDeep", "XDeepFM",
+           "build_rank_offset"]

@@ -1,0 +1,132 @@
+"""AutoInt — multi-head self-attention feature interactions for CTR.
+
+The attention member of the CTR zoo (next to DeepFM's FM, DCN's
+CrossNet, and xDeepFM's CIN; reference models compose the same
+``pull_box_sparse`` + ``fused_seqpool_cvm`` input graphs and differ only
+in the interaction tower). Each attention layer lets every FIELD attend
+over all fields — a learned, input-dependent interaction order, where
+CIN/CrossNet fix the order per layer.
+
+TPU-first shape: the whole tower is five einsums per layer (q/k/v
+projections, score matmul, value matmul) over [B, fields, width] with
+fields ~tens — small matmuls batch over B on the MXU, and the softmax
+over the field axis fuses into the surrounding elementwise work. No
+per-field loops, no masks (fields are dense by construction).
+
+Same functional contract as :class:`~paddlebox_tpu.models.DeepFM`
+(init/apply, differentiable w.r.t. pulled emb/w for the sparse push).
+Attention mixes field vectors, so like CIN it requires a UNIFORM
+embedding width; dense features (when present) project to that width
+and join as one extra field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.common import pool_slot_inputs, uniform_emb_dim
+from paddlebox_tpu.nn import dense_apply, dense_init, mlp_apply, mlp_init
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoInt:
+    slot_names: Tuple[str, ...]
+    emb_dim: Union[int, Mapping[str, int]]
+    dense_dim: int = 0
+    att_dim: int = 32            # per-layer output width (num_heads * dh)
+    num_heads: int = 2
+    num_layers: int = 2
+    hidden: Tuple[int, ...] = () # optional parallel deep tower
+
+    def _d(self) -> int:
+        return uniform_emb_dim(self.slot_names, self.emb_dim, "AutoInt",
+                               "attention cannot mix field widths")
+
+    def _dh(self) -> int:
+        if self.num_layers < 1:
+            raise ValueError("AutoInt needs num_layers >= 1 — with zero "
+                             "attention layers there is no interaction "
+                             "tower to size the head for")
+        if self.att_dim % self.num_heads:
+            raise ValueError(f"att_dim {self.att_dim} must divide by "
+                             f"num_heads {self.num_heads}")
+        return self.att_dim // self.num_heads
+
+    def init(self, rng: jax.Array) -> Dict:
+        d = self._d()
+        dh = self._dh()
+        m = len(self.slot_names)
+        flat = m * d + self.dense_dim
+        n_fields = m + (1 if self.dense_dim else 0)
+        keys = jax.random.split(rng, self.num_layers + 4)
+        layers = []
+        d_in = d
+        for i in range(self.num_layers):
+            s = (2.0 / (d_in + dh)) ** 0.5
+            k1, k2, k3, k4 = jax.random.split(keys[i], 4)
+            layers.append({
+                "wq": jax.random.normal(
+                    k1, (self.num_heads, d_in, dh)) * s,
+                "wk": jax.random.normal(
+                    k2, (self.num_heads, d_in, dh)) * s,
+                "wv": jax.random.normal(
+                    k3, (self.num_heads, d_in, dh)) * s,
+                # Residual projection to the layer's output width.
+                "wr": jax.random.normal(
+                    k4, (d_in, self.att_dim)
+                ) * (2.0 / (d_in + self.att_dim)) ** 0.5,
+            })
+            d_in = self.att_dim
+        out = {
+            "att": layers,
+            "head": dense_init(
+                keys[-1],
+                n_fields * self.att_dim
+                + (self.hidden[-1] if self.hidden else 0), 1),
+            "bias": jnp.zeros((), jnp.float32),
+        }
+        if self.dense_dim:
+            out["dense_proj"] = dense_init(keys[-3], self.dense_dim, d)
+        if self.hidden:
+            out["deep"] = mlp_init(keys[-2], flat, list(self.hidden))
+        return out
+
+    def apply(self, params: Dict,
+              emb: Dict[str, jax.Array],
+              w: Dict[str, jax.Array],
+              segments: Dict[str, jax.Array],
+              batch_size: int,
+              dense_feats: jax.Array | None = None) -> jax.Array:
+        """Returns logits [B]."""
+        d = self._d()
+        dh = self._dh()
+        m = len(self.slot_names)
+        flat, wide = pool_slot_inputs(self.slot_names, emb, w, segments,
+                                      batch_size, dense_feats,
+                                      self.dense_dim)
+        x = flat[:, :m * d].reshape(batch_size, m, d)     # [B, m, D]
+        if self.dense_dim:
+            dfield = dense_apply(params["dense_proj"],
+                                 flat[:, m * d:])          # [B, D]
+            x = jnp.concatenate([x, dfield[:, None, :]], axis=1)
+
+        for layer in params["att"]:
+            q = jnp.einsum("bmd,hde->bhme", x, layer["wq"])
+            k = jnp.einsum("bmd,hde->bhme", x, layer["wk"])
+            v = jnp.einsum("bmd,hde->bhme", x, layer["wv"])
+            scores = jnp.einsum("bhme,bhne->bhmn", q, k) / (dh ** 0.5)
+            att = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bhmn,bhne->bhme", att, v)      # [B,H,m,dh]
+            o = jnp.moveaxis(o, 1, 2).reshape(
+                x.shape[0], x.shape[1], self.att_dim)      # [B,m,H*dh]
+            x = jnp.maximum(o + x @ layer["wr"], 0.0)      # residual+ReLU
+
+        h = x.reshape(batch_size, -1)
+        if self.hidden:
+            deep = mlp_apply(params["deep"], flat, final_activation=True)
+            h = jnp.concatenate([h, deep], axis=-1)
+        return dense_apply(params["head"], h)[:, 0] + wide + params["bias"]

@@ -32,3 +32,15 @@ def slot_dims(slot_names, emb_dim):
     if isinstance(emb_dim, int):
         return {n: emb_dim for n in slot_names}
     return {n: int(emb_dim[n]) for n in slot_names}
+
+
+def uniform_emb_dim(slot_names, emb_dim, model: str, why: str) -> int:
+    """The single embedding width, for models whose interaction tower
+    mixes field VECTORS (CIN, attention) and so cannot host dynamic-mf
+    per-slot widths; raises with the model's reason otherwise."""
+    dims = set(slot_dims(slot_names, emb_dim).values())
+    if len(dims) != 1:
+        raise ValueError(
+            f"{model} needs one uniform emb_dim; got widths "
+            f"{sorted(dims)} — {why}")
+    return dims.pop()

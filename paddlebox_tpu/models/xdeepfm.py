@@ -27,7 +27,7 @@ from typing import Dict, Mapping, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.models.common import pool_slot_inputs, slot_dims
+from paddlebox_tpu.models.common import pool_slot_inputs, uniform_emb_dim
 from paddlebox_tpu.nn import dense_apply, dense_init, mlp_apply, mlp_init
 
 
@@ -40,12 +40,9 @@ class XDeepFM:
     hidden: Tuple[int, ...] = (128, 64)
 
     def _d(self) -> int:
-        dims = set(slot_dims(self.slot_names, self.emb_dim).values())
-        if len(dims) != 1:
-            raise ValueError(
-                f"CIN needs one uniform emb_dim; got widths {sorted(dims)}"
-                " — vector-wise interactions cannot mix embedding sizes")
-        return dims.pop()
+        return uniform_emb_dim(
+            self.slot_names, self.emb_dim, "CIN",
+            "vector-wise interactions cannot mix embedding sizes")
 
     def init(self, rng: jax.Array) -> Dict:
         d = self._d()
