@@ -261,14 +261,17 @@ def _gen_pass_files(tmpdir: str, rng, pass_keys: np.ndarray,
                     n_batches: int, *, batch: int = None,
                     n_slots: int = None, dense_dim: int = None,
                     label_rate: float = 0.25,
-                    planted_hot: int = 1000) -> list:
+                    planted_hot: int = 1000,
+                    zipf_a: float = None) -> list:
     """Write n_batches*batch svm-format lines across part files (one per
     batch). Slot 0 draws from a HOT head of ``planted_hot`` keys (the
     Zipf head every real CTR stream has — each hot key repeats
     batch*n_batches/planted_hot times, enough for the in-pass optimizer
     to recover its planted weight); the label carries that key's planted
     signal (_planted_labels). Remaining slots draw uniformly from the
-    full working set — the cold tail that sizes the store/pass machinery.
+    full working set when ``zipf_a`` is None, else Zipf(zipf_a)-ranked
+    over it (head-heavy, duplication 2-5x at a~1.2) — the cold tail
+    that sizes the store/pass machinery.
     Vectorized string assembly (np.char): a per-line Python loop takes
     minutes at 1M+ lines on one core."""
     batch = BATCH if batch is None else batch
@@ -277,7 +280,16 @@ def _gen_pass_files(tmpdir: str, rng, pass_keys: np.ndarray,
     hot = pass_keys[:min(planted_hot, pass_keys.size)]
     files = []
     for b in range(n_batches):
-        ids = rng.choice(pass_keys, (batch, n_slots))
+        if zipf_a is not None:
+            # Zipf-ranked draws over the working set — the head-heavy
+            # key distribution every real CTR stream has (and what makes
+            # dedup + measured capacity pay: duplication is 2-5x at
+            # a~1.2 instead of the uniform draw's ~1.0).
+            ranks = (rng.zipf(zipf_a, (batch, n_slots)).astype(np.int64)
+                     - 1) % pass_keys.size
+            ids = pass_keys[ranks]
+        else:
+            ids = rng.choice(pass_keys, (batch, n_slots))
         ids[:, 0] = rng.choice(hot, batch)
         labels = _planted_labels(rng, ids[:, 0], target_rate=label_rate)
         line = labels.astype("U1")
@@ -714,10 +726,17 @@ def bench_wide_deep() -> dict:
     rng = np.random.default_rng(0)
     pass_keys = rng.choice(np.arange(1, store_keys, dtype=np.uint64),
                            size=pass_keys_n, replace=False)
+    # Zipf key stream + measured bucket capacity: the HeterPS-style
+    # config is the duplicate-heavy one, so it carries the dedup
+    # demonstration — capacity sizes to measured unique ids and the
+    # record's lookup_exchange_bytes shows the reduction (overflow
+    # still hard-fails via _overflow_guard).
+    from paddlebox_tpu.core import flags as flagmod
+    flagmod.set_flags({"embedding_auto_capacity": True})
     with tempfile.TemporaryDirectory() as tmpdir:
         files = _gen_pass_files(tmpdir, rng, pass_keys, n_batches,
                                 batch=batch, n_slots=n_slots, dense_dim=0,
-                                label_rate=0.2)
+                                label_rate=0.2, zipf_a=1.2)
         dataset = Dataset(feed, num_reader_threads=4)
         dataset.set_filelist(files)
         dataset.preload_into_memory()
@@ -732,9 +751,12 @@ def bench_wide_deep() -> dict:
         eng = trainer.engine
         eng.feed_pass([np.sort(pass_keys) for _ in eng.groups])
         tables = eng.begin_pass()
-        if trainer._step_fn is None:
-            trainer._step_fn = trainer._build_step()
         rows = trainer._map_batch_rows(batch0)
+        # Warm the MEASURED-capacity step (auto-capacity is on for this
+        # config): the timed pass measures the same Zipf distribution
+        # into the same pow2 bucket and reuses this compile.
+        trainer._step_caps = tuple(trainer._measure_caps(tables, rows))
+        trainer._step_fn = trainer._build_step(caps=trainer._step_caps)
         segs = {n: jnp.asarray(batch0.segments[n]) for n in batch0.ids}
         from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
         import ml_dtypes

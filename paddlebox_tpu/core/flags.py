@@ -218,6 +218,14 @@ define_flag("embedding_dedup", True,
             "duplicate grads sum into that cell pre-exchange (role of "
             "dedup_keys_and_fillidx + dynamic_merge_grad, heter_comm.h:69,"
             "192); hot keys can no longer overflow a shard bucket")
+define_flag("embedding_auto_capacity", False,
+            "size the pull/push bucket capacity from the MEASURED "
+            "per-shard unique-id maximum of each pass's first batch "
+            "(x shard slack, pow2-bucketed so steady-state passes reuse "
+            "the compiled step) instead of the n-based binomial bound — "
+            "removes the unique_frac guesswork on duplicate-heavy data; "
+            "a later batch exceeding the measured headroom degrades to "
+            "counted drops, surfaced by lookup_overflow")
 define_flag("embedding_unique_frac", 1.0,
             "expected unique fraction of per-device ids, used to size the "
             "per-shard bucket capacity when embedding_dedup is on (1.0 = "

@@ -140,31 +140,40 @@ def _bucket_by_shard(dev_rows: jax.Array, num_shards: int, block: int,
     return send_rows, shard_of, pos
 
 
-def compute_bucketing(table: PassTable,
-                      dev_rows: jax.Array) -> Optional[Tuple]:
+def compute_bucketing(table: PassTable, dev_rows: jax.Array,
+                      cap: Optional[int] = None) -> Optional[Tuple]:
     """The bucket-by-shard layout for one (table, ids) pair — the ONE
     source of truth for block/cap so a caller sharing the layout between
     pull_local and push_local (both bucket the same dev_rows; computing
     it twice pays the one-hot cumsum + bucket scatter twice per step)
     can never drift from their internal fallback. None when the table is
-    unsharded (single-shard paths never bucket)."""
+    unsharded (single-shard paths never bucket).
+
+    ``cap`` overrides the n-based capacity bound — the trainer's
+    measured auto-capacity path (FLAGS_embedding_auto_capacity) sizes it
+    from the pass data's actual per-shard unique-id maximum; a caller
+    overriding it here MUST pass the same cap to pull_local/push_local
+    (their masks read it)."""
     if table.num_shards == 1:
         return None
     block = table.rows_per_shard + 1
-    cap = bucket_capacity(dev_rows.shape[0], table.num_shards)
+    if cap is None:
+        cap = bucket_capacity(dev_rows.shape[0], table.num_shards)
     return _bucket_by_shard(dev_rows, table.num_shards, block, cap)
 
 
-def exchange_bytes(table: PassTable, n: int) -> int:
+def exchange_bytes(table: PassTable, n: int,
+                   cap: Optional[int] = None) -> int:
     """Static per-device all-to-all bytes for one pull+push round over
     ``n`` ids — the observable that dedup + ``embedding_unique_frac``
-    shrink (the reference transfers d_merged_keys/grads after dedup,
-    heter_comm.h:192; here the byte count is a pure function of the
-    static bucket capacity, so trainers can report it per step without
-    touching the hot path)."""
+    (or a measured ``cap``) shrink (the reference transfers
+    d_merged_keys/grads after dedup, heter_comm.h:192; here the byte
+    count is a pure function of the static bucket capacity, so trainers
+    can report it per step without touching the hot path)."""
     if table.num_shards == 1:
         return 0
-    cap = bucket_capacity(n, table.num_shards)
+    if cap is None:
+        cap = bucket_capacity(n, table.num_shards)
     s = table.num_shards
     pull = s * cap * 4 + s * cap * table.pull_width * 4
     push = s * cap * 4 + s * cap * (table.dim + 4) * 4
@@ -172,7 +181,8 @@ def exchange_bytes(table: PassTable, n: int) -> int:
 
 
 def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
-               bucketing: Optional[Tuple] = None) -> Dict[str, jax.Array]:
+               bucketing: Optional[Tuple] = None,
+               cap: Optional[int] = None) -> Dict[str, jax.Array]:
     """Per-device pull: ids [n] (device-row space) → {emb [n, D], w [n],
     show [n], click [n], overflow []}. Padding/overflow ids yield the
     trash row (zeros unless polluted — push keeps it zeroed).
@@ -207,7 +217,8 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
         }
 
     n = dev_rows.shape[0]
-    cap = bucket_capacity(n, num_shards)
+    if cap is None:
+        cap = bucket_capacity(n, num_shards)
     trash = block - 1
 
     # ``bucketing``: the train step computes the bucket-by-shard layout
@@ -320,7 +331,8 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
                grad_w: jax.Array, shows: jax.Array, clicks: jax.Array, *,
                axis: str, opt: Optional[SparseOptimizer] = None,
                dcn_axis: Optional[str] = None,
-               bucketing: Optional[Tuple] = None) -> PassTable:
+               bucketing: Optional[Tuple] = None,
+               cap: Optional[int] = None) -> PassTable:
     """Per-device push: scatter-accumulate + dense fused optimizer sweep.
 
     dev_rows [n]; grad_emb [n, D]; grad_w/shows/clicks [n]. Padding entries
@@ -368,7 +380,8 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
         return PassTable(vals=new_vals, rows_per_shard=table.rows_per_shard,
                          num_shards=1, dim=d, ke=ke, kw=kw)
 
-    cap = bucket_capacity(n, num_shards)
+    if cap is None:
+        cap = bucket_capacity(n, num_shards)
     if bucketing is None:
         bucketing = _bucket_by_shard(dev_rows, num_shards, block, cap)
     send_rows, slot_shard, slot_pos = bucketing

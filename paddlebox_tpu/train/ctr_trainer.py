@@ -174,6 +174,9 @@ class CTRTrainer:
         self._eval_fn = None
         self.timers = timers.TimerGroup()
         self._step_fn = None
+        # Measured bucket-capacity overrides the current _step_fn was
+        # traced with (None = default n-based capacity).
+        self._step_caps: Optional[Tuple[Optional[int], ...]] = None
         self._slot_names = [s.name for s in feed_config.sparse_slots]
         # Sharded capacities: always divisible by ndev (matches
         # SlotBatch.pack_sharded / Dataset.batches_sharded shapes).
@@ -359,9 +362,16 @@ class CTRTrainer:
 
         return forward
 
-    def _build_step(self):
+    def _build_step(self, caps: Optional[Tuple[Optional[int], ...]] = None):
         axis = self.axis
         dcn = self.dcn_axis
+        # Per-width-group bucket-capacity overrides (measured
+        # auto-capacity, FLAGS_embedding_auto_capacity): trace-time
+        # constants, so a cap change means a rebuild — train_pass
+        # pow2-buckets the measurement to keep steady-state passes on
+        # the same compiled step.
+        caps_list = (list(caps) if caps is not None
+                     else [None] * len(self.engine.groups))
         # Replica-wide reductions (loss, AUC, stats) span slice x axis;
         # table collectives (all_to_all in pull/push) stay on `axis`
         # (intra-slice ICI) with the one accumulator psum over `dcn`.
@@ -400,10 +410,11 @@ class CTRTrainer:
             # shared by the pull and the push below (both bucket the
             # same dev_rows — CopyKeys computed once in the reference
             # too).
-            bucketings = [compute_bucketing(t, r)
-                          for t, r in zip(tables, rows)]
-            pulled = [pull_local(t, r, axis=axis, bucketing=bk)
-                      for t, r, bk in zip(tables, rows, bucketings)]
+            bucketings = [compute_bucketing(t, r, cap=c)
+                          for t, r, c in zip(tables, rows, caps_list)]
+            pulled = [pull_local(t, r, axis=axis, bucketing=bk, cap=c)
+                      for t, r, bk, c in zip(tables, rows, bucketings,
+                                             caps_list)]
 
             labels1 = labels[:, 0]
             validf = valid.astype(jnp.float32)
@@ -483,7 +494,7 @@ class CTRTrainer:
                 new_tables.append(push_local(
                     tables[gi], rows[gi], g_embs[gi], g_ws[gi], occ_valid,
                     clicks, axis=axis, opt=sparse_opt, dcn_axis=dcn,
-                    bucketing=bucketings[gi]))
+                    bucketing=bucketings[gi], cap=caps_list[gi]))
 
             probs = jax.nn.sigmoid(logits)
             auc = auc_of(auc, probs, labels, valid)
@@ -708,6 +719,37 @@ class CTRTrainer:
             rows.append(_put_global(h, data_sh))
         return tuple(rows)
 
+    def _measure_caps(self, tables, rows) -> List[Optional[int]]:
+        """Per-group measured bucket capacity: the first batch's worst
+        per-(device, shard) row count — UNIQUE rows when dedup is on (a
+        cell holds a unique id), occurrences otherwise — with the
+        shard-slack headroom, rounded up to a power of two
+        (compile-stability bucketing) and clamped to the per-device id
+        count. Role of the reference sizing its shard buffers from the
+        actual batch (heter_comm_inl.h:273 walks real counts) — here the
+        shapes must be static, so measure once per pass and retrace only
+        when the pow2 bucket grows."""
+        slack = float(flags.flag("embedding_shard_slack"))
+        dedup = bool(flags.flag("embedding_dedup"))
+        caps: List[Optional[int]] = []
+        for t, r in zip(tables, rows):
+            if t.num_shards == 1:
+                caps.append(None)
+                continue
+            block = t.rows_per_shard + 1
+            rr = np.asarray(r).reshape(self.ndev, -1)
+            worst = 1
+            for d in range(rr.shape[0]):
+                vals = np.unique(rr[d]) if dedup else rr[d]
+                shard = np.clip(vals // block, 0, t.num_shards - 1)
+                worst = max(worst, int(np.bincount(
+                    shard, minlength=t.num_shards).max()))
+            n_local = rr.shape[1]
+            c = min(max(int(slack * worst) + 8, 8), n_local)
+            c = min(1 << (c - 1).bit_length(), n_local)
+            caps.append(c)
+        return caps
+
     # -- pass loop ---------------------------------------------------------
 
     def train_pass(self, dataset: Dataset, *, feed_keys: bool = True
@@ -757,12 +799,39 @@ class CTRTrainer:
                 group_n = [int(r.shape[0]) // max(self.ndev, 1)
                            for r in rows]
                 first_batch_dup = None
-                if all(getattr(r, "is_fully_addressable", True)
-                       for r in rows):
+                addressable = all(getattr(r, "is_fully_addressable", True)
+                                  for r in rows)
+                if addressable:
                     occ = sum(int(r.shape[0]) for r in rows)
                     uniq = sum(len(np.unique(np.asarray(r)))
                                for r in rows)
                     first_batch_dup = occ / max(uniq, 1)
+                if addressable and flags.flag("embedding_auto_capacity"):
+                    # Measured capacity (pow2-bucketed): size each
+                    # group's bucket to the first batch's worst
+                    # per-(device, shard) cell demand instead of the
+                    # n-based binomial bound. Caps only RATCHET UP: a
+                    # pass measuring smaller keeps the compiled (larger,
+                    # still-safe) step, so re-measurement jitter across
+                    # passes can never recompile mid-run — only a batch
+                    # genuinely exceeding the warmed capacity does.
+                    meas = self._measure_caps(tables, rows)
+                    cur = self._step_caps
+                    merged = tuple(
+                        c if cur is None or cur[i] is None
+                        else (None if c is None else max(c, cur[i]))
+                        for i, c in enumerate(meas))
+                    if merged != cur:
+                        self._step_caps = merged
+                        self._step_fn = self._build_step(caps=merged)
+                        log.vlog(0, "auto-capacity: bucket caps %s "
+                                 "(measured from first batch)",
+                                 list(merged))
+                elif self._step_caps is not None:
+                    # Flag turned off (or data not addressable): drop
+                    # back to the default-capacity step.
+                    self._step_caps = None
+                    self._step_fn = self._build_step()
             if mode == "async":
                 # PullDense role: freshest host params each step.
                 params = jax.device_put(self._async_dense.pull_dense(), rep)
@@ -815,8 +884,11 @@ class CTRTrainer:
         # what dedup + FLAGS_embedding_unique_frac shrink (the dedup-
         # before-exchange observable; heter_comm.h:192 transfers merged
         # keys for the same reason).
+        caps_now = (list(self._step_caps) if self._step_caps is not None
+                    else [None] * len(group_n or []))
         stats["lookup_exchange_bytes"] = (int(sum(
-            exchange_bytes(t, n) for t, n in zip(tables, group_n)))
+            exchange_bytes(t, n, cap=c)
+            for t, n, c in zip(tables, group_n, caps_now)))
             if group_n else 0)
         # Occurrences per unique id in the pass's first batch: the
         # operator's sizing signal for FLAGS_embedding_unique_frac

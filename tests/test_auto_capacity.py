@@ -1,0 +1,109 @@
+"""Measured bucket auto-capacity (FLAGS_embedding_auto_capacity).
+
+With dedup, a bucket cell holds a unique id — so the right capacity is
+the data's actual per-shard unique-id maximum, not the occurrence-based
+binomial bound. The flag measures it from each pass's first batch
+(pow2-bucketed for compile stability). These tests pin: the exchange
+shrinks on duplicate-heavy data, results are IDENTICAL to the default
+capacity (capacity is padding, never math), nothing overflows, and
+steady-state passes reuse the compiled step.
+"""
+
+import numpy as np
+
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.data.dataset import Dataset
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+from paddlebox_tpu.embedding import DeviceFeatureStore, TableConfig
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+SLOTS = tuple(f"s{i}" for i in range(6))
+
+
+def _write_data(tmp_path, n_lines=1024, n_keys=40):
+    # Heavy duplication: 6 slots drawing from only 40 keys — every
+    # batch's unique count is a small fraction of its occurrences.
+    rng = np.random.default_rng(5)
+    p = str(tmp_path / "part")
+    with open(p, "w") as f:
+        for _ in range(n_lines):
+            ks = rng.integers(1, n_keys + 1, len(SLOTS))
+            label = int((int(ks[0]) % 2) == (rng.random() < 0.8))
+            f.write(f"{label} " + " ".join(
+                f"{s}:{k}" for s, k in zip(SLOTS, ks)) + "\n")
+    return p
+
+
+def _run(tmp_path, p, auto):
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=128)
+    tr = CTRTrainer(
+        DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
+        TableConfig(dim=8, learning_rate=0.1), mesh=mesh,
+        config=TrainerConfig(auc_num_buckets=1 << 10),
+        store_factory=lambda c: DeviceFeatureStore(c, mesh=mesh))
+    tr.init(seed=0)
+    prev = flagmod.flag("embedding_auto_capacity")
+    flagmod.set_flags({"embedding_auto_capacity": auto})
+    try:
+        stats = []
+        for _ in range(2):
+            ds = Dataset(feed, num_reader_threads=1)
+            ds.set_filelist([p])
+            ds.load_into_memory()
+            stats.append(tr.train_pass(ds))
+        return tr, stats
+    finally:
+        flagmod.set_flags({"embedding_auto_capacity": prev})
+
+
+def test_auto_capacity_shrinks_exchange_identically(tmp_path):
+    p = _write_data(tmp_path)
+    tr_def, stats_def = _run(tmp_path, p, auto=False)
+    tr_auto, stats_auto = _run(tmp_path, p, auto=True)
+
+    for s in stats_def + stats_auto:
+        assert s["lookup_overflow"] == 0
+    # The measured capacity strictly shrinks the all-to-all...
+    assert (stats_auto[0]["lookup_exchange_bytes"]
+            < stats_def[0]["lookup_exchange_bytes"])
+    # ...while capacity stays pure padding: identical training results.
+    for sd, sa in zip(stats_def, stats_auto):
+        np.testing.assert_allclose(sa["loss"], sd["loss"], rtol=1e-6)
+        np.testing.assert_allclose(sa["auc"], sd["auc"], rtol=1e-6)
+
+    # Steady state: the second pass re-measures into the SAME pow2
+    # bucket, so the compiled step is reused (no rebuild).
+    assert tr_auto._step_caps is not None
+    step_obj = tr_auto._step_fn
+    ds = Dataset(DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=128), num_reader_threads=1)
+    ds.set_filelist([p])
+    ds.load_into_memory()
+    prev = flagmod.flag("embedding_auto_capacity")
+    flagmod.set_flags({"embedding_auto_capacity": True})
+    try:
+        tr_auto.train_pass(ds)
+    finally:
+        flagmod.set_flags({"embedding_auto_capacity": prev})
+    assert tr_auto._step_fn is step_obj
+
+
+def test_auto_capacity_off_restores_default_step(tmp_path):
+    p = _write_data(tmp_path, n_lines=256)
+    tr, _ = _run(tmp_path, p, auto=True)
+    assert tr._step_caps is not None
+    # Next pass with the flag off must rebuild at default capacity.
+    ds = Dataset(DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=128), num_reader_threads=1)
+    ds.set_filelist([p])
+    ds.load_into_memory()
+    stats = tr.train_pass(ds)
+    assert tr._step_caps is None
+    assert stats["lookup_overflow"] == 0
