@@ -730,8 +730,10 @@ def bench_wide_deep() -> dict:
     # config is the duplicate-heavy one, so it carries the dedup
     # demonstration — capacity sizes to measured unique ids and the
     # record's lookup_exchange_bytes shows the reduction (overflow
-    # still hard-fails via _overflow_guard).
+    # still hard-fails via _overflow_guard). Restored on exit so a
+    # same-process deepfm run keeps its uniform-stream comparability.
     from paddlebox_tpu.core import flags as flagmod
+    _prev_autocap = flagmod.flag("embedding_auto_capacity")
     flagmod.set_flags({"embedding_auto_capacity": True})
     with tempfile.TemporaryDirectory() as tmpdir:
         files = _gen_pass_files(tmpdir, rng, pass_keys, n_batches,
@@ -778,7 +780,11 @@ def bench_wide_deep() -> dict:
 
         dataset.wait_preload_done()
         t0 = time.perf_counter()
-        stats = trainer.train_pass(dataset)
+        try:
+            stats = trainer.train_pass(dataset)
+        finally:
+            flagmod.set_flags(
+                {"embedding_auto_capacity": _prev_autocap})
         t_pass = time.perf_counter() - t0
     per_chip = n_batches * batch / t_pass / ndev
     return {

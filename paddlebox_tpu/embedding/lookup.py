@@ -151,15 +151,16 @@ def compute_bucketing(table: PassTable, dev_rows: jax.Array,
 
     ``cap`` overrides the n-based capacity bound — the trainer's
     measured auto-capacity path (FLAGS_embedding_auto_capacity) sizes it
-    from the pass data's actual per-shard unique-id maximum; a caller
-    overriding it here MUST pass the same cap to pull_local/push_local
-    (their masks read it)."""
+    from the pass data's actual per-shard unique-id maximum. The cap
+    rides INSIDE the returned tuple, so pull_local/push_local consuming
+    a shared layout always mask with the capacity it was built at —
+    capacity cannot drift between the layout and its consumers."""
     if table.num_shards == 1:
         return None
     block = table.rows_per_shard + 1
     if cap is None:
         cap = bucket_capacity(dev_rows.shape[0], table.num_shards)
-    return _bucket_by_shard(dev_rows, table.num_shards, block, cap)
+    return _bucket_by_shard(dev_rows, table.num_shards, block, cap) + (cap,)
 
 
 def exchange_bytes(table: PassTable, n: int,
@@ -217,17 +218,21 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
         }
 
     n = dev_rows.shape[0]
-    if cap is None:
-        cap = bucket_capacity(n, num_shards)
     trash = block - 1
 
     # ``bucketing``: the train step computes the bucket-by-shard layout
-    # ONCE per width group and shares it between this pull and the
-    # matching push — both bucket the SAME dev_rows, so recomputing
-    # would pay the layout twice per step for identical results.
+    # ONCE per width group (compute_bucketing) and shares it between
+    # this pull and the matching push — both bucket the SAME dev_rows,
+    # so recomputing would pay the layout twice per step for identical
+    # results. The shared tuple CARRIES its capacity: masks below must
+    # use the capacity the buckets were built at, never a local guess.
     if bucketing is None:
+        if cap is None:
+            cap = bucket_capacity(n, num_shards)
         bucketing = _bucket_by_shard(dev_rows, num_shards, block, cap)
-    send_rows, slot_shard, slot_pos = bucketing
+        send_rows, slot_shard, slot_pos = bucketing
+    else:
+        send_rows, slot_shard, slot_pos, cap = bucketing
     # Shape [1] (not scalar) so prefix out_specs like P(axis) remain
     # valid for the returned dict under shard_map.
     overflow = jnp.sum(((slot_pos >= cap)
@@ -380,11 +385,14 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
         return PassTable(vals=new_vals, rows_per_shard=table.rows_per_shard,
                          num_shards=1, dim=d, ke=ke, kw=kw)
 
-    if cap is None:
-        cap = bucket_capacity(n, num_shards)
     if bucketing is None:
+        if cap is None:
+            cap = bucket_capacity(n, num_shards)
         bucketing = _bucket_by_shard(dev_rows, num_shards, block, cap)
-    send_rows, slot_shard, slot_pos = bucketing
+        send_rows, slot_shard, slot_pos = bucketing
+    else:
+        # Shared layout carries its own capacity (compute_bucketing).
+        send_rows, slot_shard, slot_pos, cap = bucketing
     send_payload = jnp.zeros((num_shards, cap, aw), payload.dtype)
     # (slot_shard, slot_pos) are in original element order — the payload
     # scatters straight into its bucket cells, no permutation gather.

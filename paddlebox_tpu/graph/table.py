@@ -91,14 +91,25 @@ def build_csr(src: np.ndarray, dst: np.ndarray,
             weights = np.concatenate([weights, weights])
     if num_nodes is None:
         num_nodes = int(max(src.max(initial=-1), dst.max(initial=-1))) + 1
-    else:
-        # Out-of-range ids would otherwise silently corrupt sampling (dst
-        # flows into cols unchecked; src dies later in a cryptic cumsum).
-        hi = max(src.max(initial=-1), dst.max(initial=-1))
-        lo = min(src.min(initial=0), dst.min(initial=0))
-        if hi >= num_nodes or lo < 0:
-            raise ValueError(
-                f"edge ids span [{lo}, {hi}] outside num_nodes={num_nodes}")
+    # Out-of-range ids would otherwise silently corrupt sampling (dst
+    # flows into cols unchecked; a negative src is heap-corrupting UB on
+    # the native path below) — validate on BOTH num_nodes branches.
+    hi = max(src.max(initial=-1), dst.max(initial=-1))
+    lo = min(src.min(initial=0), dst.min(initial=0))
+    if hi >= num_nodes or lo < 0:
+        raise ValueError(
+            f"edge ids span [{lo}, {hi}] outside num_nodes={num_nodes}")
+    # Large edge lists take the native parallel counting sort (O(E),
+    # bit-identical layout to the stable argsort below — the role of the
+    # reference's native graph load/build, graph_gpu_wrapper.h:25);
+    # small ones stay in numpy where thread spawn would dominate.
+    if src.size >= 100_000:
+        from paddlebox_tpu.native.graph_py import build_csr_native
+        built = build_csr_native(src, dst, weights, num_nodes)
+        if built is not None:
+            indptr_n, cols_n, w_n = built
+            return CSRGraph(indptr=indptr_n, cols=cols_n,
+                            num_nodes=num_nodes, weights=w_n)
     order = np.argsort(src, kind="stable")
     counts = np.bincount(src, minlength=num_nodes)
     indptr = np.zeros(num_nodes + 1, np.int64)

@@ -12,7 +12,7 @@ from typing import Optional
 from paddlebox_tpu.core import log
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["parser.cc", "keymap.cc", "store.cc"]
+_SOURCES = ["parser.cc", "keymap.cc", "store.cc", "graph.cc"]
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _failed = False

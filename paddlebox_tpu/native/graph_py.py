@@ -1,0 +1,61 @@
+"""ctypes wrapper: native parallel CSR build (stable counting sort)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.native.build import load_library
+
+_configured = False
+
+
+def _lib():
+    global _configured
+    lib = load_library()
+    if lib is None:
+        return None
+    if not _configured:
+        lib.pbx_csr_build.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float)]
+        lib.pbx_csr_build.restype = None
+        _configured = True
+    return lib
+
+
+def build_csr_native(src: np.ndarray, dst: np.ndarray,
+                     weights: Optional[np.ndarray], num_nodes: int
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                         Optional[np.ndarray]]]:
+    """(indptr, cols, weights_sorted) in the exact layout of the numpy
+    stable-argsort path, or None when the native lib is unavailable.
+    Inputs must already be validated/in-range (build_csr does that)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src, np.int64)
+    dst = np.ascontiguousarray(dst, np.int64)
+    n = src.shape[0]
+    indptr = np.zeros(num_nodes + 1, np.int64)
+    cols = np.empty(n, np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    if weights is not None:
+        weights = np.ascontiguousarray(weights, np.float32)
+        w_out = np.empty(n, np.float32)
+        w_in_p = weights.ctypes.data_as(f32p)
+        w_out_p = w_out.ctypes.data_as(f32p)
+    else:
+        w_out = None
+        w_in_p = ctypes.cast(None, f32p)
+        w_out_p = ctypes.cast(None, f32p)
+    lib.pbx_csr_build(src.ctypes.data_as(i64p), dst.ctypes.data_as(i64p),
+                      w_in_p, n, int(num_nodes),
+                      indptr.ctypes.data_as(i64p),
+                      cols.ctypes.data_as(i64p), w_out_p)
+    return indptr, cols, w_out

@@ -412,9 +412,10 @@ class CTRTrainer:
             # too).
             bucketings = [compute_bucketing(t, r, cap=c)
                           for t, r, c in zip(tables, rows, caps_list)]
-            pulled = [pull_local(t, r, axis=axis, bucketing=bk, cap=c)
-                      for t, r, bk, c in zip(tables, rows, bucketings,
-                                             caps_list)]
+            # The bucketing tuples carry their capacity — pull/push mask
+            # with the capacity the buckets were built at.
+            pulled = [pull_local(t, r, axis=axis, bucketing=bk)
+                      for t, r, bk in zip(tables, rows, bucketings)]
 
             labels1 = labels[:, 0]
             validf = valid.astype(jnp.float32)
@@ -494,7 +495,7 @@ class CTRTrainer:
                 new_tables.append(push_local(
                     tables[gi], rows[gi], g_embs[gi], g_ws[gi], occ_valid,
                     clicks, axis=axis, opt=sparse_opt, dcn_axis=dcn,
-                    bucketing=bucketings[gi], cap=caps_list[gi]))
+                    bucketing=bucketings[gi]))
 
             probs = jax.nn.sigmoid(logits)
             auc = auc_of(auc, probs, labels, valid)
@@ -827,11 +828,21 @@ class CTRTrainer:
                         log.vlog(0, "auto-capacity: bucket caps %s "
                                  "(measured from first batch)",
                                  list(merged))
-                elif self._step_caps is not None:
-                    # Flag turned off (or data not addressable): drop
-                    # back to the default-capacity step.
-                    self._step_caps = None
-                    self._step_fn = self._build_step()
+                else:
+                    if (flags.flag("embedding_auto_capacity")
+                            and not addressable):
+                        # Multi-host: rows span processes, so the host
+                        # cannot measure them — say so ONCE instead of
+                        # silently delivering zero byte reduction.
+                        log.warning(
+                            "auto-capacity requested but batch rows are "
+                            "not fully addressable (multi-host run) — "
+                            "using the default n-based capacity")
+                    if self._step_caps is not None:
+                        # Flag turned off (or data not addressable):
+                        # drop back to the default-capacity step.
+                        self._step_caps = None
+                        self._step_fn = self._build_step()
             if mode == "async":
                 # PullDense role: freshest host params each step.
                 params = jax.device_put(self._async_dense.pull_dense(), rep)
