@@ -730,11 +730,11 @@ def bench_wide_deep() -> dict:
     # config is the duplicate-heavy one, so it carries the dedup
     # demonstration — capacity sizes to measured unique ids and the
     # record's lookup_exchange_bytes shows the reduction (overflow
-    # still hard-fails via _overflow_guard). Restored on exit so a
-    # same-process deepfm run keeps its uniform-stream comparability.
+    # still hard-fails via _overflow_guard). The flag itself is only
+    # needed around train_pass (the warmup seeds _step_caps directly),
+    # so it is set there under try/finally — a failure anywhere in this
+    # function cannot leak it into a same-process deepfm run.
     from paddlebox_tpu.core import flags as flagmod
-    _prev_autocap = flagmod.flag("embedding_auto_capacity")
-    flagmod.set_flags({"embedding_auto_capacity": True})
     with tempfile.TemporaryDirectory() as tmpdir:
         files = _gen_pass_files(tmpdir, rng, pass_keys, n_batches,
                                 batch=batch, n_slots=n_slots, dense_dim=0,
@@ -780,6 +780,8 @@ def bench_wide_deep() -> dict:
 
         dataset.wait_preload_done()
         t0 = time.perf_counter()
+        _prev_autocap = flagmod.flag("embedding_auto_capacity")
+        flagmod.set_flags({"embedding_auto_capacity": True})
         try:
             stats = trainer.train_pass(dataset)
         finally:

@@ -31,6 +31,7 @@ from paddlebox_tpu.embedding.lookup import (
 )
 from paddlebox_tpu.embedding.optimizers import (SparseAdagrad, SparseAdam,
                                                 SparseAdamShared,
+                                                SparseFTRL,
                                                 SparseOptimizer,
                                                 make_sparse_optimizer)
 from paddlebox_tpu.embedding.pass_engine import PassEngine
@@ -49,6 +50,7 @@ __all__ = [
     "SparseAdagrad",
     "SparseAdam",
     "SparseAdamShared",
+    "SparseFTRL",
     "make_sparse_optimizer",
     "SparseOptimizer",
     "TableConfig",

@@ -248,10 +248,71 @@ class SparseAdamShared(SparseOptimizer):
         return self._apply(value, state, grad)
 
 
+@dataclasses.dataclass(frozen=True)
+class SparseFTRL(SparseOptimizer):
+    """FTRL-proximal — the classic sparse-CTR rule (reference
+    ``operators/optimizers/ftrl_op.cc`` / ftrl_op.h FTRLOpKernel, at the
+    standard lr_power = -1/2):
+
+      n'     = n + g^2                       (per coordinate)
+      sigma  = (sqrt(n') - sqrt(n)) / alpha
+      z'     = z + g - sigma * value
+      value' = 0                                     if |z'| <= l1
+               -(z' - sign(z')*l1)
+                 / ((beta + sqrt(n')) / alpha + l2)  otherwise
+
+    The l1 threshold drives untouched-signal coordinates EXACTLY to
+    zero — the sparsity-inducing behavior CTR systems run FTRL for.
+    State layout: [z(D), n(D)] (K = 2D); scalar weights [z, n] (K = 2).
+    Values are additionally clipped to the table bounds like every other
+    sparse rule here.
+    """
+
+    learning_rate: float = 0.05         # alpha
+    l1: float = 0.1
+    l2: float = 1.0
+    beta: float = 1.0
+    min_bound: float = -10.0
+    max_bound: float = 10.0
+
+    @classmethod
+    def from_config(cls, cfg: TableConfig) -> "SparseFTRL":
+        return cls(learning_rate=cfg.learning_rate, l1=cfg.ftrl_l1,
+                   l2=cfg.ftrl_l2, beta=cfg.ftrl_beta,
+                   min_bound=cfg.min_bound, max_bound=cfg.max_bound)
+
+    def emb_state_width(self, dim: int) -> int:
+        return 2 * dim
+
+    def w_state_width(self) -> int:
+        return 2
+
+    def _apply(self, value, z, n, grad):
+        alpha = self.learning_rate
+        new_n = n + grad * grad
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / alpha
+        new_z = z + grad - sigma * value
+        denom = (self.beta + jnp.sqrt(new_n)) / alpha + self.l2
+        shrunk = -(new_z - jnp.sign(new_z) * self.l1) / denom
+        new_v = jnp.where(jnp.abs(new_z) <= self.l1, 0.0, shrunk)
+        return (jnp.clip(new_v, self.min_bound, self.max_bound),
+                new_z, new_n)
+
+    def update_vector(self, value, state, grad):
+        d = value.shape[-1]
+        new_v, z, n = self._apply(value, state[:, :d], state[:, d:], grad)
+        return new_v, jnp.concatenate([z, n], axis=-1)
+
+    def update_scalar(self, value, state, grad):
+        new_v, z, n = self._apply(value, state[:, 0], state[:, 1], grad)
+        return new_v, jnp.stack([z, n], axis=-1)
+
+
 _OPTIMIZERS = {
     "adagrad": SparseAdagrad,
     "adam": SparseAdam,
     "adam_shared": SparseAdamShared,
+    "ftrl": SparseFTRL,
 }
 
 

@@ -58,11 +58,15 @@ class TableConfig:
     init_scale: float = 0.01
     # Sparse optimizer selection + hyper-params (role of optimizer_conf.h
     # bounds/decay and HeterPs optimizer_type dispatch).
-    optimizer: str = "adagrad"    # adagrad | adam | adam_shared
+    optimizer: str = "adagrad"    # adagrad | adam | adam_shared | ftrl
     learning_rate: float = 0.05
     initial_g2sum: float = 3.0
     beta1: float = 0.9
     beta2: float = 0.999
+    # FTRL-proximal knobs (optimizer="ftrl"; role of ftrl_op.cc attrs).
+    ftrl_l1: float = 0.1
+    ftrl_l2: float = 1.0
+    ftrl_beta: float = 1.0
     min_bound: float = -10.0
     max_bound: float = 10.0
     # Show/click decay applied at end-of-day shrink (role of ShrinkTable).

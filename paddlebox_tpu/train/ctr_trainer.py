@@ -830,10 +830,14 @@ class CTRTrainer:
                                  list(merged))
                 else:
                     if (flags.flag("embedding_auto_capacity")
-                            and not addressable):
+                            and not addressable
+                            and not getattr(self, "_autocap_warned",
+                                            False)):
                         # Multi-host: rows span processes, so the host
-                        # cannot measure them — say so ONCE instead of
-                        # silently delivering zero byte reduction.
+                        # cannot measure them — say so ONCE (per
+                        # trainer) instead of silently delivering zero
+                        # byte reduction every pass.
+                        self._autocap_warned = True
                         log.warning(
                             "auto-capacity requested but batch rows are "
                             "not fully addressable (multi-host run) — "
