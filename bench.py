@@ -132,6 +132,7 @@ SELF_BASELINE = {
     "gpt": None,
     "wide_deep": None,
     "graph_walk": None,
+    "serving": None,
 }
 
 # First-recorded numbers (tools/record_baselines.py writes them as soon
@@ -898,6 +899,88 @@ def bench_graph() -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Online serving (SURVEY L12): xbox-style sparse model + jitted bf16
+# predictor — the inference half of the CTR production loop, measured.
+# ---------------------------------------------------------------------------
+
+SERVING_KEYS = 2_000_000
+SERVING_BATCH = 2048
+SERVING_QUERY_BATCHES = 50
+if _SMALL:
+    SERVING_KEYS = 100_000
+    SERVING_BATCH = 512
+    SERVING_QUERY_BATCHES = 10
+
+
+def bench_serving() -> dict:
+    import jax
+
+    from paddlebox_tpu.data.parser import parse_lines
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch, SlotConf
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.serving.predictor import CTRPredictor
+
+    rng = np.random.default_rng(0)
+    slots = tuple(SlotConf(f"s{i}", avg_len=1.0) for i in range(NUM_SLOTS))
+    feed = DataFeedConfig(slots=slots, batch_size=SERVING_BATCH,
+                          slot_capacity_slack=1.0)
+    model = DeepFM(slot_names=tuple(f"s{i}" for i in range(NUM_SLOTS)),
+                   emb_dim=EMB_DIM, hidden=(400, 400, 400))
+    dense_params = model.init(jax.random.PRNGKey(0))
+
+    # Trained-model stand-in: the serving table's cost profile depends on
+    # key count and width, not the values.
+    _tick("serving:table")
+    keys = np.arange(1, SERVING_KEYS + 1, dtype=np.uint64)
+    emb = rng.normal(size=(SERVING_KEYS, EMB_DIM)).astype(np.float32) * 0.01
+    w = rng.normal(size=(SERVING_KEYS,)).astype(np.float32) * 0.01
+    t0 = time.perf_counter()
+    pred = CTRPredictor(model, feed, keys, emb, w, dense_params)
+    # Force the table upload before stopping the clock (the axon
+    # platform returns from dispatch before the H2D lands — see _sync).
+    float(np.asarray(pred._table[0, 0]))
+    load_s = time.perf_counter() - t0
+
+    # Query stream: hot head + uniform tail, same shape discipline as the
+    # training benches. One batch shape -> one cached jitted forward.
+    # Vectorized line assembly (np.char) — the per-line loop this file
+    # warns about in _gen_pass_files would burn tunnel-window seconds
+    # in untimed setup.
+    def query_batch():
+        ids = rng.integers(1, SERVING_KEYS + 1,
+                           (SERVING_BATCH, NUM_SLOTS))
+        ids[:, 0] = rng.integers(1, 1001, SERVING_BATCH)
+        line = np.full((SERVING_BATCH,), "0", dtype="U16")
+        for j in range(NUM_SLOTS):
+            line = np.char.add(line, f" s{j}:")
+            line = np.char.add(line, ids[:, j].astype("U20"))
+        return SlotBatch.pack(parse_lines(line.tolist(), feed), feed)
+
+    batches = [query_batch() for _ in range(SERVING_QUERY_BATCHES)]
+    _tick("serving:warmup")
+    probs = pred.predict(batches[0])          # compile
+    assert probs.shape == (SERVING_BATCH,)
+    _tick("serving:timed")
+    t0 = time.perf_counter()
+    for b in batches:
+        probs = pred.predict(b)
+    float(probs[0])
+    dt = time.perf_counter() - t0
+    qps = SERVING_QUERY_BATCHES * SERVING_BATCH / dt
+
+    return {
+        "metric": "serving_predict_samples_per_sec",
+        "value": round(qps, 1),
+        "unit": "samples/s",
+        "vs_baseline": _vs("serving", qps),
+        "table_load_s": round(load_s, 3),
+        "serving_keys": SERVING_KEYS,
+        "batch_size": SERVING_BATCH,
+        "n_devices": len(jax.devices()),
+    }
+
+
 CONFIGS = {
     "deepfm": bench_deepfm,
     "resnet50": bench_resnet50,
@@ -905,6 +988,7 @@ CONFIGS = {
     "gpt": bench_gpt,
     "wide_deep": bench_wide_deep,
     "graph": bench_graph,
+    "serving": bench_serving,
 }
 
 

@@ -42,6 +42,7 @@ CONFIGS = {
     "bert_dp": "bert_dp",
     "gpt": "gpt",
     "graph": "graph_walk",
+    "serving": "serving",
 }
 
 BEGIN = "<!-- record_baselines:begin -->"
