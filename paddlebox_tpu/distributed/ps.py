@@ -32,7 +32,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from paddlebox_tpu.core import log, monitor
-from paddlebox_tpu.distributed import wire
+from paddlebox_tpu.distributed import rpc, wire
 from paddlebox_tpu.distributed.transport import _recv_exact
 from paddlebox_tpu.embedding.store import FeatureStore
 from paddlebox_tpu.embedding.table import TableConfig
@@ -69,7 +69,7 @@ class DenseTable:
             self.value = np.asarray(value, np.float32).copy()
 
 
-class PSServer:
+class PSServer(rpc.FramedRPCServer):
     """One PS shard: serves the keys with ``key % num_servers == index``.
 
     Sparse tables are :class:`FeatureStore` instances (sorted-key columnar
@@ -105,52 +105,19 @@ class PSServer:
         self.dense_lr = float(dense_lr)
         self.dense: Dict[str, DenseTable] = {
             name: DenseTable(v, dense_lr) for name, v in (dense or {}).items()}
-        host, port = endpoint.rsplit(":", 1)
-        self._server = socket.create_server((host, int(port)), backlog=64)
-        self.endpoint = f"{host}:{self._server.getsockname()[1]}"
-        self._running = True
-        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
-        self._accept.start()
+        # Service identity BEFORE the base starts accepting (handler
+        # threads read it for log attribution).
+        self.service_name = f"ps[{index}]"
+        rpc.FramedRPCServer.__init__(self, endpoint, backlog=64)
 
-    # -- service loop ------------------------------------------------------
-
-    def _accept_loop(self) -> None:
-        while self._running:
-            try:
-                conn, _ = self._server.accept()
-            except OSError:
-                return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
-
-    def _serve(self, conn: socket.socket) -> None:
-        try:
-            with conn:
-                while True:
-                    req = _recv_msg(conn)
-                    method = req["method"]
-                    try:
-                        out = getattr(self, "handle_" + method)(req)
-                        _send_msg(conn, {"ok": True, "result": out})
-                    except Exception as e:  # report, keep serving
-                        log.vlog(0, "ps[%d] %s failed: %s", self.index,
-                                 method, e)
-                        _send_msg(conn, {"ok": False, "error": repr(e)})
-                    if not self._running:
-                        # stop RPC: response sent, now actually close the
-                        # listener (stop accepting new work; other live
-                        # connections drain until their clients close).
-                        self.stop()
-                        return
-        except wire.WireError as e:
-            # Protocol violation (malformed/mismatched frame): drop the
-            # connection — resynchronizing a corrupt byte stream is not
-            # possible with length-prefixed framing.
-            log.warning("ps[%d] dropping connection on wire error: %s",
-                        self.index, e)
-            return
-        except (ConnectionError, OSError, EOFError):
-            return
+    def _after_reply(self) -> bool:
+        if not self._running:
+            # stop RPC: response sent, now actually close the listener
+            # (stop accepting new work; other live connections drain
+            # until their clients close).
+            self.stop()
+            return True
+        return False
 
     # -- sparse ------------------------------------------------------------
 
@@ -291,19 +258,6 @@ class PSServer:
         self._running = False
         return True
 
-    def stop(self) -> None:
-        self._running = False
-        try:
-            # shutdown() wakes the thread blocked in accept(); a bare
-            # close() would leave the kernel file description alive inside
-            # the blocked syscall and the port would keep accepting.
-            self._server.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._server.close()
-        except OSError:
-            pass
 
 
 class PSClient:

@@ -1,0 +1,147 @@
+"""Shared framed-RPC skeleton for the wire-protocol services.
+
+The PS (``distributed/ps.py``), graph (``graph/service.py``), and
+serving (``serving/service.py``) services all speak the same
+length-prefixed typed-frame protocol (``distributed/wire.py``) with the
+same loop shape: accept → per-connection thread → dispatch
+``handle_<method>`` → ``{ok, result|error}`` reply. This base collects
+that loop ONCE so protocol hardening (malformed-payload handling, frame
+errors, shutdown semantics) cannot drift between services — the role of
+brpc's common service plumbing under the reference's PS/graph stubs
+(``sendrecv.proto`` services share one server loop there too).
+
+Robustness contract of the loop:
+- a payload that is not a ``{"method": str, ...}`` dict gets an error
+  REPLY (not a dropped connection — a malformed request must not strand
+  the client until its socket timeout);
+- handler exceptions are reported in-band and the connection keeps
+  serving;
+- wire-protocol violations drop the connection (a corrupt
+  length-prefixed stream cannot be resynchronized);
+- ``_after_reply()`` hooks post-response actions (the PS ``stop`` RPC
+  closes its listener only AFTER the acknowledgement is on the wire).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from paddlebox_tpu.core import log
+from paddlebox_tpu.distributed import wire
+from paddlebox_tpu.distributed.transport import _recv_exact
+
+
+class FramedRPCServer:
+    """Socket server dispatching typed frames to ``handle_<method>``."""
+
+    # Subclasses set this for log attribution ("ps[3]", "graph[0]", ...).
+    service_name: str = "rpc"
+
+    def __init__(self, endpoint: str, *, backlog: int = 32):
+        host, port = endpoint.rsplit(":", 1)
+        self._server = socket.create_server((host, int(port)),
+                                            backlog=backlog)
+        self.endpoint = f"{host}:{self._server.getsockname()[1]}"
+        self._running = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while True:
+                    ln = wire.read_frame_header(
+                        _recv_exact(conn, wire.HEADER.size))
+                    req = wire.loads(_recv_exact(conn, ln))
+                    method = (req.get("method")
+                              if isinstance(req, dict) else None)
+                    if not isinstance(method, str):
+                        conn.sendall(wire.pack_frame(
+                            {"ok": False,
+                             "error": "request must be a dict with a "
+                                      "str 'method'"}))
+                        continue
+                    try:
+                        out = getattr(self, "handle_" + method)(req)
+                        conn.sendall(wire.pack_frame(
+                            {"ok": True, "result": out}))
+                    except Exception as e:  # report in-band, keep serving
+                        log.vlog(0, "%s %s failed: %s", self.service_name,
+                                 method, e)
+                        conn.sendall(wire.pack_frame(
+                            {"ok": False, "error": repr(e)}))
+                    if self._after_reply():
+                        return
+        except wire.WireError as e:
+            # Protocol violation (malformed/mismatched frame): drop the
+            # connection — resynchronizing a corrupt byte stream is not
+            # possible with length-prefixed framing.
+            log.warning("%s: dropping connection on wire error: %s",
+                        self.service_name, e)
+            return
+        except (ConnectionError, OSError, EOFError):
+            return
+
+    def _after_reply(self) -> bool:
+        """Post-response hook; return True to end this connection (the
+        PS stop RPC uses it to close only after the ack is sent)."""
+        return False
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+class FramedRPCConn:
+    """One blocking client connection with in-band error raising."""
+
+    def __init__(self, endpoint: str, *, timeout: float = 60.0,
+                 service_name: str = "rpc"):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock: Optional[socket.socket] = socket.create_connection(
+            (host, int(port)), timeout=timeout)
+        self._lock = threading.Lock()
+        self._service = service_name
+
+    def call(self, method: str, **kw):
+        with self._lock:
+            s = self._sock
+            try:
+                s.sendall(wire.pack_frame({"method": method, **kw}))
+                ln = wire.read_frame_header(
+                    _recv_exact(s, wire.HEADER.size))
+                resp = wire.loads(_recv_exact(s, ln))
+            except (OSError, ConnectionError, wire.WireError):
+                # A timed-out / half-read / desynced stream cannot be
+                # reused — drop it so the caller can reconnect cleanly.
+                self.close()
+                raise
+        if not resp["ok"]:
+            raise RuntimeError(
+                f"{self._service}.{method}: {resp['error']}")
+        return resp["result"]
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

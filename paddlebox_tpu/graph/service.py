@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from paddlebox_tpu.core import log, monitor
-from paddlebox_tpu.distributed import wire
+from paddlebox_tpu.distributed import rpc, wire
 from paddlebox_tpu.distributed.transport import _recv_exact
 from paddlebox_tpu.graph.table import CSRGraph, GraphTable, build_csr
 
@@ -92,10 +92,11 @@ def sample_neighbors_host(g: CSRGraph, nodes: np.ndarray, k: int,
     return out
 
 
-class GraphServer:
+class GraphServer(rpc.FramedRPCServer):
     """One graph shard: owns nodes with ``node % num_servers == index``
     (role of GraphBrpcServer holding its partition's adjacency +
-    features)."""
+    features). Service loop/framing from
+    :class:`~paddlebox_tpu.distributed.rpc.FramedRPCServer`."""
 
     def __init__(self, endpoint: str, index: int, num_servers: int):
         self.index = index
@@ -106,43 +107,8 @@ class GraphServer:
         self._num_nodes: Dict[str, int] = {}
         self._feat_rows: Dict[str, Dict[int, np.ndarray]] = {}
         self._lock = threading.Lock()
-        host, port = endpoint.rsplit(":", 1)
-        self._server = socket.create_server((host, int(port)), backlog=32)
-        self.endpoint = f"{host}:{self._server.getsockname()[1]}"
-        self._running = True
-        threading.Thread(target=self._accept_loop, daemon=True).start()
-
-    def _accept_loop(self) -> None:
-        while self._running:
-            try:
-                conn, _ = self._server.accept()
-            except OSError:
-                return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
-
-    def _serve(self, conn: socket.socket) -> None:
-        try:
-            with conn:
-                while True:
-                    ln = wire.read_frame_header(
-                        _recv_exact(conn, wire.HEADER.size))
-                    req = wire.loads(_recv_exact(conn, ln))
-                    try:
-                        out = getattr(self, "handle_" + req["method"])(req)
-                        conn.sendall(wire.pack_frame(
-                            {"ok": True, "result": out}))
-                    except Exception as e:
-                        log.vlog(0, "graph[%d] %s failed: %s", self.index,
-                                 req.get("method"), e)
-                        conn.sendall(wire.pack_frame(
-                            {"ok": False, "error": repr(e)}))
-        except wire.WireError as e:
-            log.warning("graph[%d] dropping connection on wire error: %s",
-                        self.index, e)
-            return
-        except (ConnectionError, OSError, EOFError):
-            return
+        self.service_name = f"graph[{index}]"
+        rpc.FramedRPCServer.__init__(self, endpoint)
 
     # -- handlers ---------------------------------------------------------
 
@@ -263,20 +229,10 @@ class GraphServer:
 
     def handle_stop(self, req) -> bool:
         # Close the listener too — _running=False alone would leave the
-        # port bound and accepting until process exit.
+        # port bound and accepting until process exit. (stop() from the
+        # RPC base; this connection stays open for the acknowledgement.)
         self.stop()
         return True
-
-    def stop(self) -> None:
-        self._running = False
-        try:
-            self._server.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._server.close()
-        except OSError:
-            pass
 
 
 class GraphClient:

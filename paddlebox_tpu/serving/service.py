@@ -1,0 +1,104 @@
+"""Online predict service: the CTRPredictor behind the typed wire.
+
+Role of the serving deployment the reference pairs its training stack
+with (an online service loads the per-pass xbox exports and answers CTR
+requests while deltas stream in — the "realtime model update" half of
+the README's pitch): a socket server owning one :class:`CTRPredictor`,
+answering predict RPCs on raw svm-format lines and accepting live
+base/delta updates between requests, over the same typed-frame protocol
+as the PS and graph services (service loop/framing from
+``distributed/rpc.py`` — no pickle, version-checked; trusted cluster
+network).
+
+The predictor's internal lock already serializes apply_update against
+predict's snapshot, so concurrent request threads get per-batch
+consistent model versions for free.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.data.parser import parse_lines
+from paddlebox_tpu.data.slots import SlotBatch
+from paddlebox_tpu.distributed import rpc
+from paddlebox_tpu.serving.predictor import CTRPredictor, load_delta_update
+
+
+class PredictServer(rpc.FramedRPCServer):
+    """One predictor endpoint (role of a serving replica)."""
+
+    service_name = "serving"
+
+    def __init__(self, endpoint: str, predictor: CTRPredictor):
+        self.predictor = predictor
+        rpc.FramedRPCServer.__init__(self, endpoint)
+
+    # -- handlers ---------------------------------------------------------
+
+    def handle_predict(self, req) -> np.ndarray:
+        """Raw svm-format lines -> CTR probabilities [n_lines]. Lines
+        beyond the predictor's feed batch_size are rejected (the caller
+        splits; one fixed shape keeps the jitted forward cache small)."""
+        lines: List[str] = list(req["lines"])
+        feed = self.predictor.feed
+        if len(lines) > feed.batch_size:
+            raise ValueError(
+                f"{len(lines)} lines exceed the serving batch size "
+                f"{feed.batch_size} — split the request")
+        n = len(lines)
+        if n < feed.batch_size:
+            # Pad to the fixed shape; padding rows carry no features and
+            # are stripped from the reply.
+            lines = lines + ["0"] * (feed.batch_size - n)
+        batch = SlotBatch.pack(parse_lines(lines, feed), feed)
+        probs = self.predictor.predict(batch)
+        return np.asarray(probs[:n], np.float32)
+
+    def handle_apply_delta(self, req) -> int:
+        """Live model refresh from a delta export directory (the online
+        update path — serving_online_update's surface over the wire)."""
+        keys, emb, w = load_delta_update(req["path"], req.get(
+            "table", "embedding"))
+        n_new = self.predictor.apply_update(keys, emb, w)
+        monitor.add("serving/delta_rpcs", 1)
+        return int(n_new)
+
+    def handle_stats(self, req) -> dict:
+        return {"keys": int(self.predictor._table.shape[0] - 1),
+                "dim": int(self.predictor._dim)}
+
+    def handle_stop(self, req) -> bool:
+        self.stop()
+        return True
+
+
+class PredictClient:
+    """Blocking client for one serving endpoint."""
+
+    def __init__(self, endpoint: str, timeout: float = 60.0):
+        self._conn = rpc.FramedRPCConn(endpoint, timeout=timeout,
+                                       service_name="serving")
+
+    def predict(self, lines: List[str]) -> np.ndarray:
+        # The wire serializes str natively (utf-8 frames) — no
+        # per-line encode/decode round-trip.
+        return self._conn.call("predict", lines=list(lines))
+
+    def apply_delta(self, path: str, table: str = "embedding") -> int:
+        return self._conn.call("apply_delta", path=path, table=table)
+
+    def stats(self) -> dict:
+        return self._conn.call("stats")
+
+    def stop_server(self) -> None:
+        try:
+            self._conn.call("stop")
+        except (RuntimeError, OSError, ConnectionError):
+            pass
+
+    def close(self) -> None:
+        self._conn.close()
