@@ -17,6 +17,7 @@ EXAMPLES = [
     "remote_ps_tiered.py",
     "graph_deepwalk.py",
     "multislice_ctr.py",
+    "online_serving.py",
 ]
 
 
