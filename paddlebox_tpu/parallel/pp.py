@@ -241,6 +241,139 @@ def one_f_one_b_value_and_grad(
     return out
 
 
+def interleaved_one_f_one_b_value_and_grad(
+        stage_fn: Callable[[Any, jax.Array], jax.Array],
+        loss_fn: Callable[..., jax.Array],
+        chunk_params: Any, x_microbatches: jax.Array,
+        targets_microbatches: jax.Array, *,
+        num_chunks: int, axis: str = "pp"):
+    """Interleaved (virtual-stage) 1F1B: each rank holds ``num_chunks``
+    pipeline chunks assigned CYCLICALLY over ranks (virtual stage
+    ``d`` lives on rank ``d % p``, chunk ``d // p``) — the reference's
+    interleaved scheduler (``meta_parallel/pipeline_parallel.py``
+    ``_forward_backward_pipeline(... virtual_pp_degree)``, Megatron-style
+    ``virtual_pipeline_model_parallel_size``). Each TICK runs one CHUNK
+    forward + one chunk backward per rank, so fill/drain bubbles cost
+    chunk-times rather than stage-times: total masked work is
+    ``(V-1)p + 2(p-1)`` chunk-ticks against the plain schedule's
+    ``2(p-1)`` FULL-stage ticks — about half the bubble time at V>=2
+    (asymptote ~p chunk-ticks as V grows).
+
+    Schedule (lock-step SPMD, all data-independent): rank r's i-th
+    forward runs at tick ``t = i + r`` on chunk ``(i // p) % V`` for
+    microbatch ``(i // (p*V)) * p + i % p`` — exactly the cyclic
+    grouping that makes every producer finish one tick before its
+    consumer on the NEXT rank (chunk boundaries included: rank p-1's
+    chunk c feeds rank 0's chunk c+1 with the same uniform +1 ring
+    ppermute). Backwards mirror with chunk order reversed and constant
+    offset ``C = (V-1)p + 2(p-1)``; at V=1 both formulas collapse to
+    :func:`one_f_one_b_value_and_grad`'s schedule.
+
+    ``chunk_params``: pytree whose leaves carry a leading ``[V, ...]``
+    chunk dim (this rank's chunks, cyclic layout). ``stage_fn`` must
+    preserve activation shape (same contract as the other schedules).
+    Requires ``m % p == 0`` (the Megatron interleave constraint — the
+    grouped schedule needs whole microbatch groups).
+
+    Returns ``(loss, chunk_grads)`` — grads stacked ``[V, ...]`` like
+    the params, scaled for the mean loss over microbatches.
+    """
+    p = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    v = int(num_chunks)
+    leading = jax.tree.leaves(chunk_params)[0].shape[0]
+    if leading != v:
+        # Silent dynamic-index clipping would otherwise train chunk
+        # v-1's params in place of the missing virtual stages.
+        raise ValueError(
+            f"chunk_params carry {leading} chunks but num_chunks={v}")
+    m = x_microbatches.shape[0]
+    if m % p != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs microbatches % pp == 0, got {m} % "
+            f"{p} (the grouped schedule consumes whole groups)")
+    mb_shape = x_microbatches.shape[1:]
+    dtype = x_microbatches.dtype
+    mv = m * v
+    c_off = (v - 1) * p + 2 * (p - 1)
+    # Forward-order-keyed stash of chunk INPUTS for the rematerialized
+    # backward; capacity bounds the max (written i) - (read i_match).
+    ring_cap = c_off + (v - 1) * p + 1
+
+    fwd0 = jnp.zeros(mb_shape, dtype)
+    bwd0 = jnp.zeros(mb_shape, dtype)
+    ring0 = jnp.zeros((ring_cap,) + mb_shape, dtype)
+    grads0 = jax.tree.map(jnp.zeros_like, chunk_params)
+    loss0 = jnp.zeros((), jnp.float32)
+
+    def decode_f(i):
+        c = (i // p) % v
+        j = (i // (p * v)) * p + (i % p)
+        return c, j
+
+    def tick(carry, t):
+        fwd_in, bwd_in, ring, grads, loss_acc = carry
+
+        # ---- forward: rank r's (t - r)-th chunk execution ------------
+        i = t - rank
+        f_active = (i >= 0) & (i < mv)
+        i_c = jnp.clip(i, 0, mv - 1)
+        c_f, j_f = decode_f(i_c)
+        params_f = jax.tree.map(lambda a: a[c_f], chunk_params)
+        # Virtual stage 0 (rank 0, chunk 0) ingests the raw microbatch;
+        # everything else consumes the ring-delivered activation.
+        ingest = (rank == 0) & (c_f == 0)
+        x_in = jnp.where(ingest, x_microbatches[j_f], fwd_in)
+        slot_w = i_c % ring_cap
+        ring = ring.at[slot_w].set(
+            jnp.where(f_active, x_in, ring[slot_w]))
+        y = stage_fn(params_f, x_in)
+        y = jnp.where(f_active, y, 0)
+
+        # ---- backward: mirrored order, reversed chunk cycle ----------
+        ib = t - c_off + rank
+        b_active = (ib >= 0) & (ib < mv)
+        ib_c = jnp.clip(ib, 0, mv - 1)
+        # Same decode as the forward with the chunk cycle reversed —
+        # one formula, so stash and read cannot desynchronize.
+        cb_raw, j_b = decode_f(ib_c)
+        cb = v - 1 - cb_raw
+        # The forward-order index that stashed this (chunk, microbatch).
+        i_match = cb * p + (ib_c // (p * v)) * (p * v) + (ib_c % p)
+        x_saved = ring[i_match % ring_cap]
+        params_b = jax.tree.map(lambda a: a[cb], chunk_params)
+
+        tgt = jax.tree.map(lambda a: a[j_b], targets_microbatches)
+        is_lastv = (rank == p - 1) & (cb == v - 1)
+        loss_j, seed = jax.value_and_grad(lambda yy: loss_fn(yy, tgt))(y)
+        loss_acc = loss_acc + jnp.where(b_active & is_lastv,
+                                        loss_j.astype(jnp.float32), 0.0)
+        din = jnp.where(is_lastv, seed.astype(dtype), bwd_in)
+
+        _, vjp = jax.vjp(stage_fn, params_b, x_saved)
+        dparams, dx = vjp(din)
+        bmask = b_active.astype(dtype)
+        grads = jax.tree.map(
+            lambda g, d: g.at[cb].add(bmask * d.astype(g.dtype)),
+            grads, dparams)
+        dx = dx * bmask
+
+        fwd_next = lax.ppermute(y, axis,
+                                [(s, (s + 1) % p) for s in range(p)])
+        bwd_next = lax.ppermute(dx, axis,
+                                [(s, (s - 1) % p) for s in range(p)])
+        return (fwd_next, bwd_next, ring, grads, loss_acc), None
+
+    total_ticks = mv + c_off
+    (_, _, _, grads, loss_acc), _ = lax.scan(
+        tick, (fwd0, bwd0, ring0, grads0, loss0),
+        jnp.arange(total_ticks))
+
+    loss = lax.psum(loss_acc * (rank == p - 1), axis) / m
+    grads = jax.tree.map(lambda g: g / m, grads)
+    return loss, grads
+
+
 def make_pipeline_fn(mesh: Mesh, stage_fn, stacked_params_template, *,
                      axis: str = "pp", extra_in_specs: Tuple = ()):
     """Jitted wrapper: (stacked_params, x_microbatches) -> outputs."""
