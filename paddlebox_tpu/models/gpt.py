@@ -229,14 +229,24 @@ def gpt_loss_fn(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
 
 
 def gpt_value_and_grad_1f1b(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
-                            num_microbatches: int = 1):
+                            num_microbatches: int = 1,
+                            num_chunks: int = 1):
     """(params, tokens, targets) -> (loss, grads) using the 1F1B pipeline
     schedule (role of the reference's default train_batch path,
     ``meta_parallel/pipeline_parallel.py:82``): bounded activation memory
     — each stage holds O(pp) stage inputs instead of the
     GPipe-through-autodiff O(M) residuals. The embedding runs outside the
     pipeline (cotangents returned by the schedule), the final-LN/head pair
-    rides the schedule's ``loss_params`` channel."""
+    rides the schedule's ``loss_params`` channel.
+
+    ``num_chunks > 1`` selects the INTERLEAVED schedule (virtual pipeline
+    stages, role of virtual_pp_degree): each rank's resident layer rows
+    split into ``num_chunks`` chunks whose virtual depth is CYCLIC over
+    ranks (chunk c on rank r sits at depth c*pp + r) — about half the
+    fill/drain bubble time. Note the depth meaning of a given physical
+    layer row therefore differs from the plain schedule; layers are
+    iid-initialized so training from scratch is equivalent, but
+    checkpoints are not interchangeable between num_chunks settings."""
     heads_local = cfg.n_heads // int(mesh.shape["mp"])
 
     def stage_fn(stage_params, x):
@@ -278,9 +288,27 @@ def gpt_value_and_grad_1f1b(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
         lp = {"lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
               "head": params["head"]}
         stage_params_local = jax.tree.map(lambda a: a[0], params["layers"])
-        loss, sgrads, lpgrads, dx0 = pplib.one_f_one_b_value_and_grad(
-            stage_fn, loss_head, stage_params_local, x_mb, tgt_mb,
-            axis="pp", loss_params=lp, return_input_grads=True)
+        if num_chunks > 1:
+            lps = jax.tree.leaves(stage_params_local)[0].shape[0]
+            if lps % num_chunks:
+                raise ValueError(
+                    f"{lps} layers per pp stage do not split into "
+                    f"num_chunks={num_chunks} equal chunks")
+            chunked = jax.tree.map(
+                lambda a: a.reshape((num_chunks, a.shape[0] // num_chunks)
+                                    + a.shape[1:]), stage_params_local)
+            loss, cgrads, lpgrads, dx0 = \
+                pplib.interleaved_one_f_one_b_value_and_grad(
+                    stage_fn, loss_head, chunked, x_mb, tgt_mb,
+                    num_chunks=num_chunks, axis="pp", loss_params=lp,
+                    return_input_grads=True)
+            sgrads = jax.tree.map(
+                lambda g: g.reshape((g.shape[0] * g.shape[1],)
+                                    + g.shape[2:]), cgrads)
+        else:
+            loss, sgrads, lpgrads, dx0 = pplib.one_f_one_b_value_and_grad(
+                stage_fn, loss_head, stage_params_local, x_mb, tgt_mb,
+                axis="pp", loss_params=lp, return_input_grads=True)
         (dep,) = vjp_embed(
             dx0.reshape(bl, s_local, cfg.d_model).astype(x.dtype))
 
@@ -325,14 +353,24 @@ def gpt_value_and_grad_1f1b(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
 
 def make_gpt_train_step(cfg: GPTConfig, mesh: Mesh, specs: Dict,
                         optimizer, *, num_microbatches: int = 1,
-                        schedule: str = "gpipe"):
+                        schedule: str = "gpipe", num_chunks: int = 1):
     """Jitted (params, opt_state, tokens, targets) -> (params, opt_state,
     loss) with donation. Gradient reduction across dp/pp/sp/mp falls out
     of differentiating through the shard_map (``schedule="gpipe"``) or is
     explicit in the 1F1B path (``schedule="1f1b"`` — the reference's
     default pipeline schedule, pipeline_parallel.py:82, with bounded
     activation memory; pick it when microbatch count × activation size
-    would blow HBM under GPipe)."""
+    would blow HBM under GPipe). ``schedule="interleaved_1f1b"`` with
+    ``num_chunks=V`` runs the virtual-stage interleave (~half the
+    pipeline bubble; see gpt_value_and_grad_1f1b for the layer-layout
+    note)."""
+    if schedule in ("gpipe", "1f1b") and num_chunks != 1:
+        # Silently training the plain schedule while the caller believes
+        # they got the interleave would also bake in the wrong layer
+        # layout (checkpoints differ between num_chunks settings).
+        raise ValueError(
+            f"num_chunks={num_chunks} requires "
+            f"schedule='interleaved_1f1b' (got {schedule!r})")
     if schedule == "gpipe":
         loss_fn = gpt_loss_fn(cfg, mesh, specs,
                               num_microbatches=num_microbatches)
@@ -340,9 +378,16 @@ def make_gpt_train_step(cfg: GPTConfig, mesh: Mesh, specs: Dict,
     elif schedule == "1f1b":
         vg = gpt_value_and_grad_1f1b(cfg, mesh, specs,
                                      num_microbatches=num_microbatches)
+    elif schedule == "interleaved_1f1b":
+        if num_chunks < 2:
+            raise ValueError("interleaved_1f1b needs num_chunks >= 2 — "
+                             "at 1 chunk it IS the plain 1f1b schedule")
+        vg = gpt_value_and_grad_1f1b(cfg, mesh, specs,
+                                     num_microbatches=num_microbatches,
+                                     num_chunks=num_chunks)
     else:
         raise ValueError(f"unknown pipeline schedule {schedule!r}; "
-                         "choose 'gpipe' or '1f1b'")
+                         "choose 'gpipe', '1f1b', or 'interleaved_1f1b'")
 
     def step(params, opt_state, tokens, targets):
         loss, grads = vg(params, tokens, targets)

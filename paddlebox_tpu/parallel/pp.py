@@ -94,6 +94,33 @@ def gpipe_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     return lax.psum(outputs * is_last, axis)
 
 
+def _loss_and_seed(loss_fn, loss_params, y, tgt, lgrads, lmask):
+    """Shared last-stage loss evaluation for both 1F1B schedules: the
+    loss value, the backward seed (d loss / d y), and — when the head
+    rides the loss_params channel — its masked grad accumulation. One
+    implementation so the two schedules cannot drift."""
+    if loss_params is None:
+        loss_j, seed = jax.value_and_grad(lambda yy: loss_fn(yy, tgt))(y)
+        return loss_j, seed, lgrads
+    (loss_j, (dlp, seed)) = jax.value_and_grad(
+        lambda lp, yy: loss_fn(lp, yy, tgt), argnums=(0, 1))(loss_params, y)
+    lgrads = jax.tree.map(
+        lambda g, d: g + lmask * d.astype(g.dtype), lgrads, dlp)
+    return loss_j, seed, lgrads
+
+
+def _pipeline_out(loss, grads, lgrads, dx0_buf, m, loss_params,
+                  return_input_grads):
+    """Shared output assembly (mean-loss scaling + optional channels)."""
+    grads = jax.tree.map(lambda g: g / m, grads)
+    out = (loss, grads)
+    if loss_params is not None:
+        out = out + (jax.tree.map(lambda g: g / m, lgrads),)
+    if return_input_grads:
+        out = out + (dx0_buf / m,)
+    return out
+
+
 def one_f_one_b_value_and_grad(
         stage_fn: Callable[[Any, jax.Array], jax.Array],
         loss_fn: Callable[..., jax.Array],
@@ -188,16 +215,9 @@ def one_f_one_b_value_and_grad(
             lambda a: a[jnp.clip(j_b, 0, m - 1)], targets_microbatches)
 
         is_last = rank == n - 1
-        if loss_params is None:
-            loss_j, seed = jax.value_and_grad(
-                lambda yy: loss_fn(yy, tgt))(y)
-        else:
-            (loss_j, (dlp, seed)) = jax.value_and_grad(
-                lambda lp, yy: loss_fn(lp, yy, tgt),
-                argnums=(0, 1))(loss_params, y)
-            lmask = (b_active & is_last).astype(jnp.float32)
-            lgrads = jax.tree.map(
-                lambda g, d: g + lmask * d.astype(g.dtype), lgrads, dlp)
+        loss_j, seed, lgrads = _loss_and_seed(
+            loss_fn, loss_params, y, tgt, lgrads,
+            (b_active & is_last).astype(jnp.float32))
         loss_acc = loss_acc + jnp.where(b_active & is_last,
                                         loss_j.astype(jnp.float32), 0.0)
         din = jnp.where(is_last, seed.astype(dtype), bwd_in)
@@ -232,13 +252,8 @@ def one_f_one_b_value_and_grad(
     # Mean loss over microbatches, broadcast from the last stage (role of
     # _broadcast_final_loss, pipeline_parallel.py:325).
     loss = lax.psum(loss_acc * (rank == n - 1), axis) / m
-    grads = jax.tree.map(lambda g: g / m, grads)
-    out = (loss, grads)
-    if loss_params is not None:
-        out = out + (jax.tree.map(lambda g: g / m, lgrads),)
-    if return_input_grads:
-        out = out + (dx0_buf / m,)
-    return out
+    return _pipeline_out(loss, grads, lgrads, dx0_buf, m, loss_params,
+                         return_input_grads)
 
 
 def interleaved_one_f_one_b_value_and_grad(
@@ -246,7 +261,8 @@ def interleaved_one_f_one_b_value_and_grad(
         loss_fn: Callable[..., jax.Array],
         chunk_params: Any, x_microbatches: jax.Array,
         targets_microbatches: jax.Array, *,
-        num_chunks: int, axis: str = "pp"):
+        num_chunks: int, axis: str = "pp", loss_params: Any = None,
+        return_input_grads: bool = False):
     """Interleaved (virtual-stage) 1F1B: each rank holds ``num_chunks``
     pipeline chunks assigned CYCLICALLY over ranks (virtual stage
     ``d`` lives on rank ``d % p``, chunk ``d // p``) — the reference's
@@ -276,7 +292,11 @@ def interleaved_one_f_one_b_value_and_grad(
     grouped schedule needs whole microbatch groups).
 
     Returns ``(loss, chunk_grads)`` — grads stacked ``[V, ...]`` like
-    the params, scaled for the mean loss over microbatches.
+    the params, scaled for the mean loss over microbatches. The
+    ``loss_params`` / ``return_input_grads`` channels behave exactly as
+    on :func:`one_f_one_b_value_and_grad` (last-virtual-stage head
+    grads; stage-0 input cotangents for an outside-the-pipeline
+    embedding).
     """
     p = lax.axis_size(axis)
     rank = lax.axis_index(axis)
@@ -305,6 +325,10 @@ def interleaved_one_f_one_b_value_and_grad(
     ring0 = jnp.zeros((ring_cap,) + mb_shape, dtype)
     grads0 = jax.tree.map(jnp.zeros_like, chunk_params)
     loss0 = jnp.zeros((), jnp.float32)
+    lgrads0 = (jax.tree.map(jnp.zeros_like, loss_params)
+               if loss_params is not None else None)
+    dx0_buf0 = (jnp.zeros((m,) + mb_shape, dtype)
+                if return_input_grads else None)
 
     def decode_f(i):
         c = (i // p) % v
@@ -312,7 +336,7 @@ def interleaved_one_f_one_b_value_and_grad(
         return c, j
 
     def tick(carry, t):
-        fwd_in, bwd_in, ring, grads, loss_acc = carry
+        fwd_in, bwd_in, ring, grads, loss_acc, lgrads, dx0_buf = carry
 
         # ---- forward: rank r's (t - r)-th chunk execution ------------
         i = t - rank
@@ -345,7 +369,9 @@ def interleaved_one_f_one_b_value_and_grad(
 
         tgt = jax.tree.map(lambda a: a[j_b], targets_microbatches)
         is_lastv = (rank == p - 1) & (cb == v - 1)
-        loss_j, seed = jax.value_and_grad(lambda yy: loss_fn(yy, tgt))(y)
+        loss_j, seed, lgrads = _loss_and_seed(
+            loss_fn, loss_params, y, tgt, lgrads,
+            (b_active & is_lastv).astype(jnp.float32))
         loss_acc = loss_acc + jnp.where(b_active & is_lastv,
                                         loss_j.astype(jnp.float32), 0.0)
         din = jnp.where(is_lastv, seed.astype(dtype), bwd_in)
@@ -357,21 +383,26 @@ def interleaved_one_f_one_b_value_and_grad(
             lambda g, d: g.at[cb].add(bmask * d.astype(g.dtype)),
             grads, dparams)
         dx = dx * bmask
+        if dx0_buf is not None:
+            # Virtual stage 0's input cotangent (rank 0, chunk 0).
+            keep = (b_active & (rank == 0) & (cb == 0)).astype(dtype)
+            dx0_buf = dx0_buf.at[j_b].add(keep * dx)
 
         fwd_next = lax.ppermute(y, axis,
                                 [(s, (s + 1) % p) for s in range(p)])
         bwd_next = lax.ppermute(dx, axis,
                                 [(s, (s - 1) % p) for s in range(p)])
-        return (fwd_next, bwd_next, ring, grads, loss_acc), None
+        return (fwd_next, bwd_next, ring, grads, loss_acc, lgrads,
+                dx0_buf), None
 
     total_ticks = mv + c_off
-    (_, _, _, grads, loss_acc), _ = lax.scan(
-        tick, (fwd0, bwd0, ring0, grads0, loss0),
+    (_, _, _, grads, loss_acc, lgrads, dx0_buf), _ = lax.scan(
+        tick, (fwd0, bwd0, ring0, grads0, loss0, lgrads0, dx0_buf0),
         jnp.arange(total_ticks))
 
     loss = lax.psum(loss_acc * (rank == p - 1), axis) / m
-    grads = jax.tree.map(lambda g: g / m, grads)
-    return loss, grads
+    return _pipeline_out(loss, grads, lgrads, dx0_buf, m, loss_params,
+                         return_input_grads)
 
 
 def make_pipeline_fn(mesh: Mesh, stage_fn, stacked_params_template, *,
