@@ -109,8 +109,8 @@ def test_interleaved_1f1b_matches_tied_layer_loss(devices8, data):
     # Tie all layer rows to layer 0's values.
     params = dict(params)
     params["layers"] = jax.tree.map(
-        lambda a: jnp.broadcast_to(a[:1, :1], a.shape).copy()
-        if a.ndim >= 2 else a, params["layers"])
+        lambda a: jnp.broadcast_to(a[:1, :1], a.shape).copy(),
+        params["layers"])
     tokens, targets = data
     opt = optax.adam(1e-3)
 
