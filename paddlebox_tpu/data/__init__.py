@@ -19,6 +19,9 @@ from paddlebox_tpu.data.slots import (
 )
 from paddlebox_tpu.data.parser import parse_lines, register_parser, get_parser
 from paddlebox_tpu.data.dataset import Dataset
+from paddlebox_tpu.data.proto_desc import (data_feed_config_from_desc,
+                                           graph_gen_config_from_desc,
+                                           parse_proto_text)
 
 __all__ = [
     "Channel",
@@ -27,7 +30,10 @@ __all__ = [
     "Dataset",
     "SlotBatch",
     "SlotConf",
+    "data_feed_config_from_desc",
     "get_parser",
+    "graph_gen_config_from_desc",
     "parse_lines",
+    "parse_proto_text",
     "register_parser",
 ]

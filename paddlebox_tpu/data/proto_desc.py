@@ -1,0 +1,226 @@
+"""DataFeedDesc proto-text compatibility: load reference configs as-is.
+
+The reference configures its readers with protobuf TEXT files
+(``data_feed.proto:43-57`` DataFeedDesc — slots, batch size, pipe
+command, graph walk config), and a migrating user has a directory of
+them. This module parses that text format directly into
+:class:`~paddlebox_tpu.data.slots.DataFeedConfig` /
+:class:`~paddlebox_tpu.graph.data_generator.GraphGenConfig` — no
+protobuf runtime, no generated bindings: the grammar is only
+``key: value`` scalars and ``key { ... }`` blocks with repetition, so a
+small recursive reader covers every DataFeedDesc in the reference's
+tests. Unknown fields are preserved in the returned extras dict rather
+than dropped, so nothing silently disappears in migration.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Tuple
+
+from paddlebox_tpu.core import log
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+
+_GRAPH_FIELDS = {"walk_degree", "walk_len", "window",
+                 "once_sample_startid_len", "sample_times_one_chunk",
+                 "batch_size", "debug_mode", "first_node_type",
+                 "meta_path", "gpu_graph_training"}
+
+_TOKEN = re.compile(
+    r"""\s*(?:(?P<comment>\#[^\n]*)
+          |(?P<brace>[{}])
+          |(?P<ident>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)?
+          |(?P<string>"(?:[^"\\]|\\.)*")
+          |(?P<scalar>[^\s{}"]+))""",
+    re.VERBOSE)
+
+
+def _tokens(text: str):
+    """Yields (kind, value): kind 'key' only for ``ident:`` (or a bare
+    ident that a '{' follows — block names may omit the colon); an
+    identifier WITHOUT a colon in value position is a scalar (true/false
+    /enum values lex as identifiers too)."""
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None or m.end() == pos:
+            if text[pos:].strip():
+                raise ValueError(
+                    f"unparseable proto text at: {text[pos:pos + 40]!r}")
+            return
+        pos = m.end()
+        kind = m.lastgroup if m.lastgroup != "colon" else "ident"
+        if kind == "comment":
+            continue
+        if kind == "ident":
+            if m.group("colon"):
+                yield "key", m.group("ident")
+            else:
+                nxt = _TOKEN.match(text, pos)
+                if nxt and nxt.lastgroup == "brace" \
+                        and nxt.group("brace") == "{":
+                    yield "key", m.group("ident")
+                else:
+                    yield "scalar", m.group("ident")
+        else:
+            yield kind, m.group(kind)
+
+
+def _coerce(raw: str) -> Any:
+    if raw.startswith('"'):
+        s = raw[1:-1]
+        if "\\" not in s:
+            return s          # no escapes: keep UTF-8 intact
+        # Escape decoding without mangling non-ASCII: unicode_escape is
+        # latin-1-based, so round-trip the result back through UTF-8.
+        return (s.encode("latin-1", "backslashreplace")
+                .decode("unicode_escape")
+                .encode("latin-1", "replace").decode("utf-8", "replace"))
+    low = raw.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        return raw
+
+
+def parse_proto_text(text: str) -> Dict[str, Any]:
+    """proto-text → dict; repeated fields become lists (a field seen once
+    stays scalar — callers use :func:`_as_list` where repetition is
+    legal, so both spellings work)."""
+    root: Dict[str, Any] = {}
+    stack: List[Dict[str, Any]] = [root]
+    pending_key = None
+    for kind, value in _tokens(text):
+        if kind == "brace":
+            if value == "{":
+                if pending_key is None:
+                    raise ValueError("'{' without a field name")
+                child: Dict[str, Any] = {}
+                _store(stack[-1], pending_key, child)
+                stack.append(child)
+                pending_key = None
+            else:
+                if len(stack) == 1:
+                    raise ValueError("unbalanced '}'")
+                stack.pop()
+        elif kind == "key":
+            if pending_key is not None:
+                # Two bare keys in a row: the first had no value.
+                raise ValueError(f"field {pending_key!r} has no value")
+            pending_key = value
+        else:
+            if pending_key is None:
+                raise ValueError(f"value {value!r} without a field name")
+            _store(stack[-1], pending_key, _coerce(value))
+            pending_key = None
+    if len(stack) != 1:
+        raise ValueError("unbalanced '{' — missing closing brace")
+    if pending_key is not None:
+        raise ValueError(f"field {pending_key!r} has no value")
+    return root
+
+
+def _store(d: Dict[str, Any], key: str, value: Any) -> None:
+    if key in d:
+        if not isinstance(d[key], list):
+            d[key] = [d[key]]
+        d[key].append(value)
+    else:
+        d[key] = value
+
+
+def _as_list(v: Any) -> List[Any]:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+_FEED_FIELDS = {"name", "batch_size", "multi_slot_desc", "pipe_command",
+                "thread_num", "rank_offset", "pv_batch_size", "input_type",
+                "so_parser_name", "graph_config", "sample_rate",
+                "index_parser"}
+
+
+def data_feed_config_from_desc(text: str, *, num_labels: int = 1
+                               ) -> Tuple[DataFeedConfig, Dict[str, Any]]:
+    """(DataFeedConfig, extras) from a DataFeedDesc text config.
+
+    Slots map 1:1 (name / is_dense / is_used; a dense slot's dim is the
+    product of its ``shape``). Fields DataFeedConfig has no seat for —
+    thread_num, pv_batch_size, graph_config, sample_rate, ... — come
+    back verbatim in ``extras`` so the caller can route them (thread
+    counts go to Dataset, graph_config to
+    :func:`graph_gen_config_from_desc`)."""
+    d = parse_proto_text(text)
+    if not set(d) & _FEED_FIELDS:
+        raise ValueError(
+            f"no DataFeedDesc fields found in {sorted(d)} — not a "
+            f"data_feed.proto text config?")
+    unknown = set(d) - _FEED_FIELDS
+    if unknown:
+        # Newer-reference fields ride along in extras (the as-is load
+        # promise) — surfaced, not silently dropped, not fatal.
+        log.vlog(0, "DataFeedDesc: passing unknown fields %s through to "
+                 "extras", sorted(unknown))
+    slots = []
+    msd = d.get("multi_slot_desc") or {}
+    for s in _as_list(msd.get("slots")):
+        is_dense = bool(s.get("is_dense", False))
+        shape = _as_list(s.get("shape"))
+        dim = 1
+        for x in shape:
+            dim *= int(x)
+        slots.append(SlotConf(
+            name=str(s["name"]), is_dense=is_dense,
+            dim=dim if is_dense else 1,
+            is_used=bool(s.get("is_used", False))))
+    cfg = DataFeedConfig(
+        slots=tuple(slots),
+        batch_size=int(d.get("batch_size", 32)),
+        num_labels=num_labels,
+        pipe_command=str(d.get("pipe_command", "")))
+    extras = {k: v for k, v in d.items()
+              if k not in ("batch_size", "multi_slot_desc", "pipe_command")}
+    return cfg, extras
+
+
+def graph_gen_config_from_desc(text: str):
+    """GraphGenConfig from the DataFeedDesc's graph_config block (role of
+    the reference's graph walk knobs, data_feed.proto GraphConfig:
+    walk_len / window / batch_size / meta_path)."""
+    from paddlebox_tpu.graph.data_generator import GraphGenConfig
+
+    d = parse_proto_text(text)
+    g = d.get("graph_config")
+    if g is None:
+        # Accept a BARE graph-config block, but a graph-less
+        # DataFeedDesc must fail loudly — defaulted walk knobs would
+        # silently train wrong.
+        if set(d) & _GRAPH_FIELDS:
+            g = d
+        else:
+            raise ValueError(
+                "no graph_config block (and no graph fields) in this "
+                "desc — nothing to build a GraphGenConfig from")
+    meta = g.get("meta_path")
+    if isinstance(meta, list):
+        meta = meta[-1]   # proto2 optional semantics: last value wins
+    kw: Dict[str, Any] = dict(
+        walk_len=int(g.get("walk_len", 20)),
+        window=int(g.get("window", 5)),
+        batch_walks=int(g.get("batch_size", 1)))
+    if meta:
+        # reference meta_path spelling: semicolon-separated alternative
+        # paths, each a hyphenated edge-type chain
+        # ("u2i-i2u;u2c-c2u", data_feed.h:1080). GraphGenConfig walks
+        # one metapath per generator — this maps the FIRST; build one
+        # generator per path for the multi-path training mix.
+        first = str(meta).split(";")[0]
+        kw["metapath"] = tuple(first.split("-"))
+    return GraphGenConfig(**kw)
