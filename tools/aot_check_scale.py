@@ -118,28 +118,30 @@ def check_ctr_multislice(topo, n_slices: int, dp: int) -> None:
 
 
 def check_gpt_scale(topo, n_slices: int, dp: int, pp: int, sp: int,
-                    mp: int) -> None:
+                    mp: int, schedule: str = "1f1b",
+                    num_chunks: int = 1) -> None:
     from paddlebox_tpu.models.gpt import (GPTConfig, init_gpt,
                                           make_gpt_train_step)
     from paddlebox_tpu.parallel.topology import AXIS_ORDER
 
     n = n_slices * dp * pp * sp * mp
     cfg = GPTConfig(vocab_size=2048, d_model=256, n_heads=8,
-                    n_layers=2 * pp, d_ff=512, max_seq_len=256,
-                    attention="ring")
+                    n_layers=2 * pp * max(num_chunks, 1), d_ff=512,
+                    max_seq_len=256, attention="ring")
     params, specs = init_gpt(jax.random.PRNGKey(0), cfg, pp_stages=pp)
     shape = {"slice": n_slices, "dp": dp, "pp": pp, "sp": sp, "mp": mp}
     dims = [shape.get(a, 1) for a in AXIS_ORDER]
     mesh = Mesh(np.array(topo.devices).reshape(dims), tuple(AXIS_ORDER))
     opt = optax.adam(1e-3)
     step = make_gpt_train_step(cfg, mesh, specs, opt, num_microbatches=2,
-                               schedule="1f1b")
+                               schedule=schedule, num_chunks=num_chunks)
     opt_state = jax.eval_shape(opt.init, sds(params))
     tokens = jax.ShapeDtypeStruct((4 * n_slices * dp, 256), jnp.int32)
     t0 = time.time()
     step.lower(sds(params), opt_state, tokens, tokens).compile()
     print(f"AOT gpt hybrid slice={n_slices} dp={dp} pp={pp} sp={sp} "
-          f"mp={mp} ({n} chips): OK in {time.time()-t0:.0f}s")
+          f"mp={mp} schedule={schedule} ({n} chips): OK in "
+          f"{time.time()-t0:.0f}s")
 
 
 def main() -> None:
@@ -155,9 +157,13 @@ def main() -> None:
     if args.chips == 64:
         check_ctr_multislice(topo, n_slices=4, dp=16)
         check_gpt_scale(topo, n_slices=2, dp=4, pp=2, sp=2, mp=2)
+        check_gpt_scale(topo, n_slices=2, dp=4, pp=2, sp=2, mp=2,
+                        schedule="interleaved_1f1b", num_chunks=2)
     else:
         check_ctr_multislice(topo, n_slices=4, dp=64)
         check_gpt_scale(topo, n_slices=4, dp=8, pp=2, sp=2, mp=2)
+        check_gpt_scale(topo, n_slices=4, dp=8, pp=2, sp=2, mp=2,
+                        schedule="interleaved_1f1b", num_chunks=2)
     print(f"SCALE TPU AOT COMPILE ({args.chips} chips): OK")
 
 
