@@ -21,7 +21,8 @@ from paddlebox_tpu.data.parser import parse_lines, register_parser, get_parser
 from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.proto_desc import (data_feed_config_from_desc,
                                            graph_gen_config_from_desc,
-                                           parse_proto_text)
+                                           parse_proto_text,
+                                           table_config_from_desc)
 
 __all__ = [
     "Channel",
@@ -36,4 +37,5 @@ __all__ = [
     "parse_lines",
     "parse_proto_text",
     "register_parser",
+    "table_config_from_desc",
 ]

@@ -190,6 +190,80 @@ def data_feed_config_from_desc(text: str, *, num_labels: int = 1
     return cfg, extras
 
 
+def table_config_from_desc(text: str):
+    """(TableConfig, extras) from a TableParameter proto-text config
+    (``the_one_ps.proto:109`` — the reference's sparse-table/accessor
+    declaration). Maps the fields with seats here:
+
+    - ``accessor.embedx_dim`` → ``dim`` (the mf embedding width);
+    - the embedx SGD rule (falling back to the embed rule) → optimizer
+      selection + hyperparameters: SparseAdaGradSGDRule → "adagrad"
+      (learning_rate, initial_g2sum), SparseAdamSGDRule → "adam"
+      (learning_rate, beta1/2), SparseNaiveSGDRule → "adagrad" with its
+      learning_rate; ``weight_bounds`` → min/max_bound;
+    - ``ctr_accessor_param.show_click_decay_rate`` → show_click_decay.
+
+    ``shard_num`` deliberately does NOT map: table placement here is the
+    mesh axis size, not a config constant. Everything else (thresholds,
+    cache knobs, save params) returns in ``extras``."""
+    from paddlebox_tpu.embedding.table import TableConfig
+
+    d = parse_proto_text(text)
+    acc = d.get("accessor")
+    if not isinstance(acc, dict):
+        raise ValueError("no accessor block — not a TableParameter "
+                         "proto-text config?")
+    kw: Dict[str, Any] = {"name": str(d.get("table_class", "embedding"))}
+    if "embedx_dim" in acc:
+        kw["dim"] = int(acc["embedx_dim"])
+    rule_key = ("embedx_sgd_param" if "embedx_sgd_param" in acc
+                else "embed_sgd_param")
+    rule = acc.get(rule_key) or {}
+    name = str(rule.get("name", "")).lower()
+    if "adam" in name:
+        a = rule.get("adam") or {}
+        # SparseSharedAdamSGDRule -> the shared-moment rule, NOT plain
+        # adam (different update semantics and state layout).
+        kw["optimizer"] = "adam_shared" if "shared" in name else "adam"
+        kw["learning_rate"] = float(a.get("learning_rate", 0.001))
+        kw["beta1"] = float(a.get("beta1_decay_rate", 0.9))
+        kw["beta2"] = float(a.get("beta2_decay_rate", 0.999))
+        bounds = _as_list(a.get("weight_bounds"))
+    elif "naive" in name:
+        a = rule.get("naive") or {}
+        kw["optimizer"] = "adagrad"
+        kw["learning_rate"] = float(a.get("learning_rate", 0.05))
+        bounds = _as_list(a.get("weight_bounds"))
+    else:  # adagrad family is the reference default
+        a = rule.get("adagrad") or {}
+        kw["optimizer"] = "adagrad"
+        kw["learning_rate"] = float(a.get("learning_rate", 0.05))
+        kw["initial_g2sum"] = float(a.get("initial_g2sum", 3.0))
+        bounds = _as_list(a.get("weight_bounds"))
+    if len(bounds) == 2:
+        kw["min_bound"] = float(bounds[0])
+        kw["max_bound"] = float(bounds[1])
+    ctr = acc.get("ctr_accessor_param") or {}
+    if "show_click_decay_rate" in ctr:
+        kw["show_click_decay"] = float(ctr["show_click_decay_rate"])
+    # Unmapped accessor subfields ride along under extras["accessor"]
+    # (the module's no-silent-drop promise): consumed keys removed, the
+    # rest — thresholds, coefficients, save params — preserved.
+    acc_rest = {k: v for k, v in acc.items()
+                if k not in ("embedx_dim", rule_key)}
+    ctr_rest = {k: v for k, v in ctr.items()
+                if k != "show_click_decay_rate"}
+    if ctr_rest:
+        acc_rest["ctr_accessor_param"] = ctr_rest
+    else:
+        acc_rest.pop("ctr_accessor_param", None)
+    extras = {k: v for k, v in d.items()
+              if k not in ("table_class", "accessor")}
+    if acc_rest:
+        extras["accessor"] = acc_rest
+    return TableConfig(**kw), extras
+
+
 def graph_gen_config_from_desc(text: str):
     """GraphGenConfig from the DataFeedDesc's graph_config block (role of
     the reference's graph walk knobs, data_feed.proto GraphConfig:

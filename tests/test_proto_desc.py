@@ -132,3 +132,74 @@ graph_config {
 """)
     assert g.walk_len == 6 and g.window == 2 and g.batch_walks == 16
     assert g.metapath == ("u2i", "i2u")      # first path of the mix
+
+
+def test_table_config_from_desc():
+    from paddlebox_tpu.data import table_config_from_desc
+
+    cfg, extras = table_config_from_desc("""
+table_id: 0
+table_class: "MemorySparseTable"
+shard_num: 1950
+accessor {
+  accessor_class: "CtrCommonAccessor"
+  fea_dim: 11
+  embedx_dim: 16
+  embedx_threshold: 10
+  ctr_accessor_param {
+    nonclk_coeff: 0.1
+    click_coeff: 1.0
+    show_click_decay_rate: 0.96
+  }
+  embedx_sgd_param {
+    name: "SparseAdaGradSGDRule"
+    adagrad {
+      learning_rate: 0.08
+      initial_g2sum: 2.5
+      weight_bounds: -12.0
+      weight_bounds: 12.0
+    }
+  }
+}
+""")
+    assert cfg.dim == 16 and cfg.optimizer == "adagrad"
+    assert cfg.learning_rate == 0.08 and cfg.initial_g2sum == 2.5
+    assert cfg.min_bound == -12.0 and cfg.max_bound == 12.0
+    assert cfg.show_click_decay == 0.96
+    # Placement stays mesh-derived; shard_num passes through.
+    assert cfg.num_shards == 1 and extras["shard_num"] == 1950
+
+    # Adam rule selects the adam optimizer; a built table honors it.
+    cfg2, _ = table_config_from_desc("""
+accessor {
+  embedx_dim: 8
+  embedx_sgd_param {
+    name: "SparseAdamSGDRule"
+    adam { learning_rate: 0.002 beta1_decay_rate: 0.85 }
+  }
+}
+""")
+    assert cfg2.optimizer == "adam" and cfg2.beta1 == 0.85
+    from paddlebox_tpu.embedding import make_sparse_optimizer
+    opt = make_sparse_optimizer(cfg2)
+    assert type(opt).__name__ == "SparseAdam"
+
+    # Shared-adam rule selects adam_shared, not plain adam (different
+    # update semantics + state layout).
+    cfg3, _ = table_config_from_desc("""
+accessor {
+  embedx_dim: 8
+  embedx_sgd_param { name: "SparseSharedAdamSGDRule"
+                     adam { learning_rate: 0.01 } }
+}
+""")
+    assert cfg3.optimizer == "adam_shared"
+
+    # Unmapped accessor subfields survive in extras (no silent drops).
+    acc_extras = extras["accessor"]
+    assert acc_extras["embedx_threshold"] == 10
+    assert acc_extras["ctr_accessor_param"]["nonclk_coeff"] == 0.1
+    assert "embedx_sgd_param" not in acc_extras  # consumed
+
+    with pytest.raises(ValueError, match="no accessor"):
+        table_config_from_desc("batch_size: 4")
