@@ -116,6 +116,66 @@ class DistributedStrategy:
                 "pp_degree": "pp", "sp_degree": "sp", "ep_degree": "ep",
                 "mp_degree": "mp"}
 
+    @classmethod
+    def from_proto_text(cls, text: str) -> "DistributedStrategy":
+        """Build a strategy from the reference's DistributedStrategy
+        proto-TEXT config (``distributed_strategy.proto:286-346`` — the
+        file a migrating user already has). Top-level bool switches and
+        the nested ``*_configs`` blocks map by field name onto this
+        dataclass and its sub-configs; fields without a seat here are
+        warned about (vlog), never silently dropped-and-forgotten.
+        ``hybrid_configs`` maps degree-for-degree (dp/mp/pp/sharding,
+        plus this build's sp/ep)."""
+        from paddlebox_tpu.core import log
+        from paddlebox_tpu.data.proto_desc import parse_proto_text
+
+        d = parse_proto_text(text)
+
+        def last(v):
+            # parse_proto_text lists repeated fields; proto2 singular
+            # semantics: the LAST value wins.
+            return v[-1] if isinstance(v, list) else v
+
+        out = cls()
+        skipped = []
+        for key, value in d.items():
+            value = last(value)
+            if key == "hybrid_configs" and isinstance(value, dict):
+                hc = {k: int(last(v)) for k, v in value.items()
+                      if k in cls._DEGREES}
+                skipped += [f"hybrid_configs.{k}" for k in value
+                            if k not in cls._DEGREES]
+                out.hybrid_configs = hc
+                continue
+            if not hasattr(out, key) or key.startswith("_"):
+                skipped.append(key)
+                continue
+            cur = getattr(out, key)
+            if dataclasses.is_dataclass(cur):
+                if not isinstance(value, dict):
+                    # A scalar where a config block belongs: refusing
+                    # beats planting an AttributeError for later.
+                    skipped.append(key)
+                    continue
+                for fk, fv in value.items():
+                    fv = last(fv)
+                    if hasattr(cur, fk):
+                        setattr(cur, fk, type(getattr(cur, fk))(fv)
+                                if getattr(cur, fk) is not None else fv)
+                    else:
+                        skipped.append(f"{key}.{fk}")
+            elif isinstance(cur, bool):
+                setattr(out, key, bool(value))
+            elif isinstance(value, (int, float, str, bool)):
+                setattr(out, key, value)
+            else:
+                skipped.append(key)
+        if skipped:
+            log.vlog(0, "DistributedStrategy.from_proto_text: no seat "
+                     "for %s — review whether they matter for this "
+                     "config", sorted(skipped))
+        return out
+
     def topology(self, world_size: Optional[int] = None) -> HybridTopology:
         """Resolve hybrid_configs into a HybridTopology. A dp_degree of -1
         (reference convention: 'fill the rest') absorbs the remaining

@@ -203,3 +203,50 @@ accessor {
 
     with pytest.raises(ValueError, match="no accessor"):
         table_config_from_desc("batch_size: 4")
+
+
+def test_distributed_strategy_from_proto_text():
+    from paddlebox_tpu.fleet.strategy import DistributedStrategy
+
+    s = DistributedStrategy.from_proto_text("""
+amp: true
+recompute: true
+sharding: true
+amp_configs {
+  dtype: "bfloat16"
+  init_loss_scaling: 1024.0
+  unknown_amp_knob: 3
+}
+sharding_configs { stage: 3 offload: true }
+hybrid_configs {
+  dp_degree: 2
+  mp_degree: 2
+  pp_degree: 2
+  weird_degree: 9
+}
+future_switch: true
+""")
+    assert s.amp and s.recompute and s.sharding
+    assert s.amp_configs.init_loss_scaling == 1024.0
+    assert s.sharding_configs.stage == 3 and s.sharding_configs.offload
+    assert s.hybrid_configs == {"dp_degree": 2, "mp_degree": 2,
+                                "pp_degree": 2}
+    topo = s.topology(world_size=8)
+    assert topo.dp == 2 and topo.mp == 2 and topo.pp == 2
+
+
+def test_strategy_proto_repeated_and_malformed_fields():
+    from paddlebox_tpu.fleet.strategy import DistributedStrategy
+
+    # Repeated fields: last value wins (proto2 singular semantics).
+    s = DistributedStrategy.from_proto_text(
+        "amp: true\namp: false\n"
+        "hybrid_configs { dp_degree: 2 dp_degree: 4 }\n"
+        "sharding_configs { stage: 2 stage: 3 }")
+    assert s.amp is False
+    assert s.hybrid_configs == {"dp_degree": 4}
+    assert s.sharding_configs.stage == 3
+    # A scalar where a config block belongs is refused (skipped), not
+    # planted as a time bomb.
+    s2 = DistributedStrategy.from_proto_text("amp_configs: true")
+    assert s2.amp_configs.dtype == "bfloat16"
