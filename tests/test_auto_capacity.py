@@ -94,6 +94,23 @@ def test_auto_capacity_shrinks_exchange_identically(tmp_path):
     assert tr_auto._step_fn is step_obj
 
 
+def test_auto_capacity_sizes_occurrences_when_dedup_off(tmp_path):
+    """With dedup off a bucket cell is consumed per OCCURRENCE — the
+    measurement must count occurrences, or duplicate-heavy data would
+    undersize every bucket by the duplication factor and silently drop
+    grads (counted, but dropped)."""
+    p = _write_data(tmp_path, n_lines=512)
+    prev = flagmod.flag("embedding_dedup")
+    flagmod.set_flags({"embedding_dedup": False})
+    try:
+        tr, stats = _run(tmp_path, p, auto=True)
+        for s in stats:
+            assert s["lookup_overflow"] == 0
+        assert tr._step_caps is not None
+    finally:
+        flagmod.set_flags({"embedding_dedup": prev})
+
+
 def test_auto_capacity_off_restores_default_step(tmp_path):
     p = _write_data(tmp_path, n_lines=256)
     tr, _ = _run(tmp_path, p, auto=True)
