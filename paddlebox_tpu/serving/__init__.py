@@ -1,7 +1,9 @@
 from paddlebox_tpu.serving.predictor import (CTRPredictor,
                                              load_delta_update,
+                                             load_serving_predictor,
                                              load_xbox_model)
 from paddlebox_tpu.serving.service import PredictClient, PredictServer
 
 __all__ = ["CTRPredictor", "PredictClient", "PredictServer",
-           "load_delta_update", "load_xbox_model"]
+           "load_delta_update", "load_serving_predictor",
+           "load_xbox_model"]

@@ -87,6 +87,34 @@ def load_delta_update(path: str, table: str = "embedding"
     return _load_export(path, table, "delta")
 
 
+def load_serving_predictor(model, feed_config, path: str,
+                           **kw) -> "CTRPredictor":
+    """Stand a predictor up from a ``CTRTrainer.export_serving`` dir:
+    meta.json names the table and whether the dense snapshot carries
+    data_norm stats — the template is built to MATCH (a plain
+    ``model.init`` template would silently drop those stats, and
+    ``load_pytree`` ignores extra file keys, so the predictor would
+    serve un-normalized probabilities with no error)."""
+    import jax as _jax
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    template = dict(model.init(_jax.random.PRNGKey(0)))
+    if meta.get("data_norm"):
+        from paddlebox_tpu.ops.data_norm import data_norm_init
+        template["data_norm"] = data_norm_init(int(meta["dense_dim"]))
+    kw.setdefault("data_norm_slot_dim",
+                  int(meta.get("data_norm_slot_dim", -1)))
+    kw.setdefault("compute_dtype", meta.get("compute_dtype", "bfloat16"))
+    if kw["compute_dtype"] not in ("bfloat16", "float32"):
+        kw["compute_dtype"] = "bfloat16"
+    return CTRPredictor.from_dirs(
+        model, feed_config, os.path.join(path, "xbox"),
+        os.path.join(path, "dense.npz"),
+        table=str(meta.get("table", "embedding")),
+        dense_template=template, **kw)
+
+
 class CTRPredictor:
     """Batch CTR inference over an xbox-exported sparse model + dense
     params (role of the inference engine serving a BoxPS-trained model).

@@ -162,6 +162,7 @@ class CTRTrainer:
                     "a single store instance cannot back multiple widths "
                     "— pass store_factory instead")
             store_factory = lambda cfg: store  # noqa: E731
+        self.table_config = table_config
         self.engine = GroupedEngine(table_config, slot_dims, mesh=mesh,
                                     table_axis=axis,
                                     store_factory=store_factory)
@@ -719,6 +720,42 @@ class CTRTrainer:
                                   self.ndev)
             rows.append(_put_global(h, data_sh))
         return tuple(rows)
+
+    def export_serving(self, path: str) -> Dict[str, object]:
+        """One-call serving export: the xbox sparse model (emb + w, no
+        optimizer state — save_xbox_base_model role, fleet_util.py:774)
+        plus a BARE dense-params snapshot and a ``meta.json`` naming the
+        table and the data_norm configuration — everything
+        ``serving.load_serving_predictor(model, feed, path)`` needs to
+        stand a predictor up (the meta matters: a hand-built fresh
+        template would silently DROP the trainer-added data_norm stats
+        and serve un-normalized probabilities). Training-resume
+        snapshots (params + optimizer state) are the checkpoint
+        protocol's job, not this artifact's."""
+        import json
+        import os
+
+        from paddlebox_tpu.checkpoint.dense import save_pytree
+
+        if self.params is None:
+            raise RuntimeError("call init() (and train) before exporting")
+        os.makedirs(path, exist_ok=True)
+        xbox = os.path.join(path, "xbox")
+        n = int(self.engine.store.save_xbox(xbox))
+        dense = os.path.join(path, "dense.npz")
+        save_pytree(jax.device_get(self.params), dense)
+        meta = {
+            "table": self.table_config.name,
+            "data_norm": bool(self.config.data_norm),
+            "dense_dim": int(sum(s.dim
+                                 for s in self.feed_config.dense_slots)),
+            "data_norm_slot_dim": int(self.config.data_norm_slot_dim),
+            "compute_dtype": self.config.compute_dtype,
+        }
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+        return {"xbox": xbox, "dense": dense, "features": n,
+                "meta": os.path.join(path, "meta.json")}
 
     def _measure_caps(self, tables, rows) -> List[Optional[int]]:
         """Per-group measured bucket capacity: the first batch's worst

@@ -178,3 +178,79 @@ def test_delta_rpc_refreshes_model(tmp_path):
         cli.stop_server()
         cli.close()
         server.stop()
+
+
+def test_export_serving_round_trip(tmp_path):
+    """CTRTrainer.export_serving -> load_serving_predictor: the one-call
+    export serves exactly what a live-params predictor serves."""
+    import jax
+
+    from paddlebox_tpu.serving import load_serving_predictor
+
+    rng = np.random.default_rng(21)
+    tr, model, feed = _train_and_export(tmp_path, rng)
+    out = tr.export_serving(str(tmp_path / "exp"))
+    assert out["features"] > 0
+
+    pred = load_serving_predictor(model, feed, str(tmp_path / "exp"),
+                                  compute_dtype="float32")
+
+    keys, emb, w = load_xbox_model(out["xbox"], table="emb")
+    ref = CTRPredictor(model, feed, keys, emb, w,
+                       jax.device_get(tr.params),
+                       compute_dtype="float32")
+    lines = [f"0 u:{rng.integers(1, 500)} i:{rng.integers(1, 500)}"
+             for _ in range(feed.batch_size)]
+    batch = SlotBatch.pack(parse_lines(lines, feed), feed)
+    np.testing.assert_allclose(pred.predict(batch), ref.predict(batch),
+                               rtol=1e-6)
+
+
+def test_export_serving_preserves_data_norm(tmp_path):
+    """The meta-driven load keeps the trainer-added data_norm stats — a
+    plain model.init template would silently drop them (load_pytree
+    ignores extra file keys) and serve un-normalized probabilities."""
+    import jax
+
+    from paddlebox_tpu.serving import load_serving_predictor
+
+    rng = np.random.default_rng(23)
+    mesh = build_mesh(HybridTopology(dp=8))
+    slots = tuple(SlotConf(s, avg_len=1.0) for s in SLOTS)
+    slots += (SlotConf("d", is_dense=True, dim=3),)
+    feed = DataFeedConfig(slots=slots, batch_size=64)
+    model = DeepFM(slot_names=SLOTS, emb_dim=8, dense_dim=3, hidden=(16,))
+    tr = CTRTrainer(model, feed, TableConfig(name="emb", dim=8,
+                                             learning_rate=0.1),
+                    mesh=mesh,
+                    config=TrainerConfig(auc_num_buckets=1 << 10,
+                                         data_norm=True))
+    tr.init(seed=0)
+    p = str(tmp_path / "p0")
+    with open(p, "w") as f:
+        for _ in range(256):
+            toks = " ".join(f"{s}:{rng.integers(1, 300)}" for s in SLOTS)
+            dv = ",".join(f"{rng.random() * 9:.3f}" for _ in range(3))
+            f.write(f"{int(rng.random() < 0.3)} {toks} d:{dv}\n")
+    ds = Dataset(feed, num_reader_threads=1)
+    ds.set_filelist([p])
+    ds.load_into_memory()
+    tr.train_pass(ds)
+
+    out = tr.export_serving(str(tmp_path / "exp"))
+    pred = load_serving_predictor(model, feed, str(tmp_path / "exp"),
+                                  compute_dtype="float32")
+    assert "data_norm" in pred._dense_params  # stats survived the load
+
+    keys, emb, w = load_xbox_model(out["xbox"], table="emb")
+    ref = CTRPredictor(model, feed, keys, emb, w,
+                       jax.device_get(tr.params),
+                       compute_dtype="float32")
+    lines = []
+    for _ in range(feed.batch_size):
+        toks = " ".join(f"{s}:{rng.integers(1, 300)}" for s in SLOTS)
+        dv = ",".join(f"{rng.random() * 9:.3f}" for _ in range(3))
+        lines.append(f"0 {toks} d:{dv}")
+    batch = SlotBatch.pack(parse_lines(lines, feed), feed)
+    np.testing.assert_allclose(pred.predict(batch), ref.predict(batch),
+                               rtol=1e-6)
