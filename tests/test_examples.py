@@ -18,6 +18,7 @@ EXAMPLES = [
     "graph_deepwalk.py",
     "multislice_ctr.py",
     "online_serving.py",
+    "migrate_reference_configs.py",
 ]
 
 
