@@ -121,6 +121,63 @@ def test_v1_equals_plain_schedule():
                                    rtol=1e-6, atol=1e-7)
 
 
+def test_interleaved_channels_match_direct_autodiff():
+    """The loss_params (head) and return_input_grads (embedding
+    cotangent) channels at V=3: every gradient surface — chunk params,
+    head params, and dx0 — must match direct autodiff over the full
+    virtual composition."""
+    v, m = 3, 8
+    mesh = build_mesh(HybridTopology(pp=P_RANKS),
+                      devices=jax.devices()[:P_RANKS])
+    stages, stacked = _virtual_stages(v, seed=4)
+    rng = np.random.default_rng(5)
+    head = {"w": jnp.asarray(rng.normal(0, 0.5, (F, F)), jnp.float32)}
+    x = jnp.asarray(rng.normal(size=(m, 4, F)), jnp.float32)
+    t = jnp.asarray(rng.normal(size=(m, 4, F)), jnp.float32)
+
+    def head_loss(lp, y, tt):
+        return jnp.mean((y @ lp["w"] - tt) ** 2)
+
+    def direct(ss, lp, xx):
+        def per_mb(xj, tj):
+            h = xj
+            for s in ss:
+                h = _stage_fn(s, h)
+            return head_loss(lp, h, tj)
+        return jnp.mean(jax.vmap(per_mb)(xx, t))
+
+    ref_loss, (ref_sg, ref_lg, ref_dx) = jax.value_and_grad(
+        direct, argnums=(0, 1, 2))(stages, head, x)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=(P(), P("pp"), P(), P()), check_vma=False)
+    def run(stacked_, lp, x_mb, t_mb):
+        chunks = jax.tree.map(lambda a: a[0], stacked_)
+        loss, grads, lgrads, dx0 = \
+            interleaved_one_f_one_b_value_and_grad(
+                _stage_fn, head_loss, chunks, x_mb, t_mb,
+                num_chunks=v, axis="pp", loss_params=lp,
+                return_input_grads=True)
+        return (loss, jax.tree.map(lambda g: g[None], grads),
+                lgrads, dx0)
+
+    loss, grads, lgrads, dx0 = jax.jit(run)(stacked, head, x, t)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(lgrads["w"]),
+                               np.asarray(ref_lg["w"]), rtol=2e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx0), np.asarray(ref_dx),
+                               rtol=2e-4, atol=1e-6)
+    for c in range(v):
+        for r in range(P_RANKS):
+            got = jax.tree.map(lambda a: np.asarray(a[r, c]), grads)
+            ref = jax.tree.map(np.asarray, ref_sg[c * P_RANKS + r])
+            np.testing.assert_allclose(got["w"], ref["w"], rtol=2e-4,
+                                       atol=1e-6)
+
+
 def test_rejects_indivisible_microbatches():
     mesh = build_mesh(HybridTopology(pp=P_RANKS),
                       devices=jax.devices()[:P_RANKS])
