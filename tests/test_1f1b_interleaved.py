@@ -160,6 +160,11 @@ def test_interleaved_channels_match_direct_autodiff():
                 _stage_fn, head_loss, chunks, x_mb, t_mb,
                 num_chunks=v, axis="pp", loss_params=lp,
                 return_input_grads=True)
+        # Documented contract: head grads live on the last rank, dx0 on
+        # rank 0 (zero elsewhere) — psum to replicate for P() outputs.
+        from jax import lax
+        lgrads = jax.tree.map(lambda g: lax.psum(g, "pp"), lgrads)
+        dx0 = lax.psum(dx0, "pp")
         return (loss, jax.tree.map(lambda g: g[None], grads),
                 lgrads, dx0)
 
