@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.core import monitor
 from paddlebox_tpu.distributed import rpc, wire
 from paddlebox_tpu.distributed.transport import _recv_exact
 from paddlebox_tpu.embedding.store import FeatureStore

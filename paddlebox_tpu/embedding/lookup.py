@@ -41,7 +41,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from paddlebox_tpu.core import flags
 from paddlebox_tpu.embedding.optimizers import SparseAdagrad, SparseOptimizer

@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.core import monitor
 from paddlebox_tpu.distributed import rpc, wire
 from paddlebox_tpu.distributed.transport import _recv_exact
 from paddlebox_tpu.graph.table import CSRGraph, GraphTable, build_csr

@@ -22,14 +22,13 @@ TP-transposed) with XLA scheduling all collectives.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from paddlebox_tpu.parallel import pp as pplib
 from paddlebox_tpu.parallel import sp as splib

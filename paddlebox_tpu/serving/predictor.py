@@ -20,13 +20,13 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.core import monitor
 from paddlebox_tpu.native import store_py as native_store
 from paddlebox_tpu.ops.data_norm import normalize_dense_and_strip
 
