@@ -94,6 +94,7 @@ if os.environ.get("PBX_BENCH_WATCHDOG", "1") != "0":
 # and the driver may run multiple configs) must not re-pay multi-minute
 # compiles over the flaky tunnel — cached executables make every attempt
 # after the first cheap. (core.flags imports no jax; safe pre-import.)
+from paddlebox_tpu.core import flags
 from paddlebox_tpu.core.flags import enable_compilation_cache
 
 _CACHE_DIR = enable_compilation_cache()
@@ -311,6 +312,61 @@ def _gen_pass_files(tmpdir: str, rng, pass_keys: np.ndarray,
     return files
 
 
+def _bench_pull_push(trainer, tables, rows, iters=10):
+    """Isolated (pull_ms, push_ms) for width group 0 on the live pass
+    tables: jitted shard_map'd pull_local / push_local at the bench's
+    real shapes. pull_ms is the op FLAGS_sparse_gather_kernel attacks
+    (the last XLA gather of the CTR step), push_ms the one
+    FLAGS_sparse_scatter_kernel already converted — recording both keys
+    keeps the pull-side win visible in the artifact even when only CPU
+    smoke runs are possible. Standalone (unshared-layout) timings: each
+    side pays its own bucketing/sort here, so the fused step's total is
+    below pull_ms + push_ms."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddlebox_tpu.embedding.lookup import make_pull_fn, push_local
+
+    table0, r0 = tables[0], rows[0]
+    d = table0.dim
+    n = int(r0.shape[0])
+    axis = trainer.axis
+    sh = NamedSharding(trainer.mesh, P(axis))
+
+    def timed(thunk):
+        out = thunk()                       # compile + warm
+        _sync(jax.tree_util.tree_leaves(out)[0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = thunk()
+        _sync(jax.tree_util.tree_leaves(out)[0])
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    _tick("deepfm:pull_push_breakdown")
+    pull_fn = make_pull_fn(trainer.mesh, axis)
+    pull_ms = timed(lambda: pull_fn(table0, r0))
+
+    opt = trainer.sparse_opt
+
+    # Deliberately NOT donating the table: the timed pass still trains
+    # on these buffers; the copy is the price of a non-destructive probe.
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=trainer.mesh,
+        in_specs=(P(axis),) * 6, out_specs=P(axis), check_vma=False)
+    def push_fn(table, dev_rows, ge, gw, sh_, ck):
+        return push_local(table, dev_rows, ge, gw, sh_, ck, axis=axis,
+                          opt=opt)
+
+    ge = jax.device_put(np.zeros((n, d), np.float32), sh)
+    gs = jax.device_put(np.zeros((n,), np.float32), sh)
+    push_ms = timed(lambda: push_fn(table0, r0, ge, gs, gs, gs))
+    return pull_ms, push_ms
+
+
 def bench_deepfm() -> dict:
     import jax
     import jax.numpy as jnp
@@ -406,6 +462,7 @@ def bench_deepfm() -> dict:
                 valid_j, dense_j, sync0)
         _sync(loss)
         dev_dt = time.perf_counter() - t0
+        pull_ms, push_ms = _bench_pull_push(trainer, tables, rows)
         trainer.params, trainer.opt_state, trainer.auc_state = (
             params, opt_state, auc)
         eng.update_tables(tables)
@@ -442,6 +499,10 @@ def bench_deepfm() -> dict:
         "vs_baseline": _vs("deepfm_e2e", per_chip),
         "device_only_per_chip": round(device_only / ndev, 1),
         "e2e_over_device_only": round(e2e / device_only, 4),
+        "pull_ms": round(pull_ms, 3),
+        "push_ms": round(push_ms, 3),
+        "sparse_gather_kernel": flags.flag("sparse_gather_kernel"),
+        "sparse_scatter_kernel": flags.flag("sparse_scatter_kernel"),
         "load_s": round(t_load, 3),
         "preload_wall_s": round(preload_wall, 3),
         "pass_s": round(t_pass, 3),
@@ -1039,6 +1100,46 @@ def _preflight_scatter_kernel(n: int, aw: int, pass_keys: int) -> None:
         flagmod.set_flags({"sparse_scatter_kernel": "xla"})
 
 
+def _preflight_gather_kernel(n: int, dim: int, pass_keys: int) -> None:
+    """The pull-side twin of _preflight_scatter_kernel: run the Pallas
+    sorted-stream gather once on the real backend at the EXACT per-shard
+    shape the selected bench will compile (fused record width from the
+    table config's optimizer, pull width dim+3) — through the same
+    ``_gather_rows`` wrapper the jitted step uses. Any compile/execute
+    failure or value mismatch pins the flag to the XLA gather so the
+    recorded run never dies (or silently corrupts) inside the step."""
+    from paddlebox_tpu.core import flags as flagmod
+    if flagmod.flag("sparse_gather_kernel") == "xla":
+        return
+    try:
+        import jax.numpy as jnp
+
+        from paddlebox_tpu.embedding import (TableConfig,
+                                             make_sparse_optimizer)
+        from paddlebox_tpu.embedding.lookup import _gather_rows
+        from paddlebox_tpu.embedding.table import plan_shards
+        opt = make_sparse_optimizer(TableConfig(dim=dim))
+        w = dim + 3 + opt.emb_state_width(dim) + opt.w_state_width()
+        pw = dim + 3
+        ndev = len(jax.devices())
+        block = plan_shards(pass_keys, ndev) + 1
+        n = n // ndev
+        rng = np.random.default_rng(1)
+        # block - 1 is the trash row: the kernel path DROPS it to zeros
+        # by contract, so the probe keys stay below it.
+        rows = jnp.asarray(rng.integers(0, block - 1, n).astype(np.int32))
+        vals = jnp.asarray(
+            rng.standard_normal((block, w)).astype(np.float32))
+        out = _gather_rows(vals, rows, pw, block)
+        err = float(jnp.max(jnp.abs(out - vals[rows, :pw])))
+        if not err == 0.0:
+            raise RuntimeError(f"kernel/xla mismatch: max err {err}")
+    except Exception as e:  # noqa: BLE001 - any failure means fallback
+        print(f"[bench] pallas gather preflight failed ({e!r}); "
+              f"using XLA gather", file=sys.stderr)
+        flagmod.set_flags({"sparse_gather_kernel": "xla"})
+
+
 def main() -> None:
     name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
     # Liveness probe: one tiny device round-trip. A dead tunnel hangs
@@ -1057,10 +1158,15 @@ def main() -> None:
         if name == "deepfm":
             _preflight_scatter_kernel(BATCH * NUM_SLOTS, EMB_DIM + 4,
                                       PASS_KEYS)
+            _preflight_gather_kernel(BATCH * NUM_SLOTS, EMB_DIM,
+                                     PASS_KEYS)
         else:
             _preflight_scatter_kernel(WIDE_DEEP_BATCH * WIDE_DEEP_SLOTS,
                                       WIDE_DEEP_EMB_DIM + 4,
                                       WIDE_DEEP_PASS_KEYS)
+            _preflight_gather_kernel(WIDE_DEEP_BATCH * WIDE_DEEP_SLOTS,
+                                     WIDE_DEEP_EMB_DIM,
+                                     WIDE_DEEP_PASS_KEYS)
     _tick(f"bench:{name}")
     out = CONFIGS[name]()
     # Recorded artifacts must be attributable to hardware: the recorder

@@ -260,6 +260,12 @@ define_flag("sparse_scatter_kernel", "auto",
             "push-side scatter-accumulate backend: 'auto' (Pallas sorted "
             "kernel on TPU, XLA scatter elsewhere), 'pallas', 'interpret' "
             "(Pallas interpreter — tests), or 'xla'")
+define_flag("sparse_gather_kernel", "auto",
+            "pull-side table-row gather backend: 'auto' (Pallas sorted-"
+            "stream kernel on TPU, XLA gather elsewhere), 'pallas', "
+            "'interpret' (Pallas interpreter — tests), or 'xla'; the "
+            "kernel shares one argsort per width group with the push "
+            "scatter (embedding/lookup.py compute_bucketing)")
 define_flag("wuauc_spill_records", 4_000_000,
             "per-user-AUC raw records held in RAM before spilling to "
             "uid-hash bucket files on disk (bounds eval-pass host memory; "

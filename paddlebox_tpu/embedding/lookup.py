@@ -25,7 +25,11 @@ DEDUPED (duplicate ids share one bucket cell, so pull/push transfer
 unique rows only and duplicate grads merge sender-side before the
 exchange — roles of dedup_keys_and_fillidx, heter_comm.h:192, and
 dynamic_merge_grad, heter_comm.h:69-83, without their radix sorts), and
-computed once per step, shared by pull and push (``compute_bucketing``). All functions are
+computed once per step, shared by pull and push (``compute_bucketing``,
+which with ``axis=`` also runs the rows all_to_all ONCE for both sides —
+3 collectives per width group, not 4 — and builds the one shared argsort
+layout the Pallas sorted-stream pull gather / push scatter consume:
+``sparse_gather_kernel`` / ``sparse_scatter_kernel``). All functions are
 *per-device* bodies meant to run inside ``jax.shard_map`` with the table's
 leading dim sharded over ``axis`` and id/grad batches sharded likewise.
 With ``num_shards == 1`` (single-chip or replicated-table configs) the
@@ -140,27 +144,87 @@ def _bucket_by_shard(dev_rows: jax.Array, num_shards: int, block: int,
     return send_rows, shard_of, pos
 
 
+def _kernel_mode(flag_name: str) -> Optional[str]:
+    """Resolve a sorted-stream kernel flag to 'pallas' / 'interpret' /
+    None (XLA). One predicate so the gather and scatter sites — and the
+    shared-layout builder that must know whether EITHER will consume a
+    sort — can never disagree on what 'auto' means."""
+    mode = flags.flag(flag_name)
+    if mode in ("pallas", "interpret"):
+        return mode
+    if mode == "auto" and flags.pallas_kernels_enabled():
+        return "pallas"
+    return None
+
+
+def _stream_layout_for(rows: jax.Array, block: int) -> Optional[Tuple]:
+    """The shared sorted-stream layout (sorted_gather.sorted_stream_layout
+    over the trash-remapped rows) for one width group's pull gather AND
+    push scatter — or None when neither kernel is enabled (the argsort
+    would be pure cost on the XLA paths). Trash rows (block - 1) are
+    remapped past the row bound so both kernels DROP them — the trash
+    row's pull columns are zero by contract, so the drop is
+    value-identical to gathering it, and the scatter must not pay the
+    concentrated padding run (see _accumulate)."""
+    if (_kernel_mode("sparse_gather_kernel") is None
+            and _kernel_mode("sparse_scatter_kernel") is None):
+        return None
+    from paddlebox_tpu.ops.pallas_kernels.sorted_gather import (
+        sorted_stream_layout)
+    trash = block - 1
+    rows_k = jnp.where(rows == trash, block, rows).astype(jnp.int32)
+    return sorted_stream_layout(rows_k, block)
+
+
 def compute_bucketing(table: PassTable, dev_rows: jax.Array,
-                      cap: Optional[int] = None) -> Optional[Tuple]:
+                      cap: Optional[int] = None, *,
+                      axis: Optional[str] = None) -> Optional[Tuple]:
     """The bucket-by-shard layout for one (table, ids) pair — the ONE
     source of truth for block/cap so a caller sharing the layout between
     pull_local and push_local (both bucket the same dev_rows; computing
     it twice pays the one-hot cumsum + bucket scatter twice per step)
     can never drift from their internal fallback. None when the table is
-    unsharded (single-shard paths never bucket).
+    unsharded (single-shard paths never bucket) and no kernel layout
+    applies.
 
     ``cap`` overrides the n-based capacity bound — the trainer's
     measured auto-capacity path (FLAGS_embedding_auto_capacity) sizes it
     from the pass data's actual per-shard unique-id maximum. The cap
     rides INSIDE the returned tuple, so pull_local/push_local consuming
     a shared layout always mask with the capacity it was built at —
-    capacity cannot drift between the layout and its consumers."""
-    if table.num_shards == 1:
-        return None
+    capacity cannot drift between the layout and its consumers.
+
+    ``axis`` (the table mesh axis, when called inside shard_map) extends
+    the tuple with the OWNER-SIDE shared state: the pull's request
+    exchange and the push's row exchange move the SAME ``send_rows``, so
+    the rows all_to_all runs ONCE here (3 collectives per width group
+    instead of 4), and — when a sorted-stream kernel is enabled — the
+    received rows' argsort layout is built ONCE and consumed by both the
+    pull gather (CopyForPull) and the push scatter (CopyForPush), so the
+    step pays one argsort instead of two. Tuple shapes:
+
+        no axis:   (send_rows, slot_shard, slot_pos, cap)     — legacy
+        axis:      (send_rows, slot_shard, slot_pos, cap,
+                    recv_rows [S*C], stream_layout | None)
+        axis, 1-shard: (None, None, None, None, dev_rows,
+                    stream_layout)  — sort sharing only, or None when
+                    no kernel is enabled (nothing to share)."""
     block = table.rows_per_shard + 1
+    if table.num_shards == 1:
+        if axis is None:
+            return None
+        layout = _stream_layout_for(dev_rows, block)
+        if layout is None:
+            return None
+        return (None, None, None, None, dev_rows, layout)
     if cap is None:
         cap = bucket_capacity(dev_rows.shape[0], table.num_shards)
-    return _bucket_by_shard(dev_rows, table.num_shards, block, cap) + (cap,)
+    bk = _bucket_by_shard(dev_rows, table.num_shards, block, cap)
+    if axis is None:
+        return bk + (cap,)
+    recv_rows = lax.all_to_all(bk[0], axis, split_axis=0, concat_axis=0,
+                               tiled=True).reshape(table.num_shards * cap)
+    return bk + (cap, recv_rows, _stream_layout_for(recv_rows, block))
 
 
 def exchange_bytes(table: PassTable, n: int,
@@ -179,6 +243,30 @@ def exchange_bytes(table: PassTable, n: int,
     pull = s * cap * 4 + s * cap * table.pull_width * 4
     push = s * cap * 4 + s * cap * (table.dim + 4) * 4
     return pull + push
+
+
+def _gather_rows(vals: jax.Array, rows: jax.Array, width: int, block: int,
+                 layout: Optional[Tuple] = None) -> jax.Array:
+    """vals[rows, :width] by the configured backend
+    (``sparse_gather_kernel`` flag): the Pallas sorted-stream gather
+    (CopyForPull role — the XLA gather is the pull path's dominant op,
+    PROFILE.md) or the XLA gather. On the kernel path trash rows
+    (block - 1: padding/overflow requests) are DROPPED to zeros — the
+    trash row's pull columns are zero by contract (apply_accumulated
+    keeps them so), so the result is identical while the concentrated
+    padding run stays off the kernel's per-block budget. ``layout`` is
+    the shared sorted-stream layout from compute_bucketing (one argsort
+    serves this gather and the push scatter)."""
+    mode = _kernel_mode("sparse_gather_kernel")
+    if mode is None or vals.shape[-1] > 128:
+        # Fused records wider than one 128-lane tile cannot stream
+        # through the kernel's VMEM blocks — serve them with XLA.
+        return vals[rows, :width]
+    from paddlebox_tpu.ops.pallas_kernels.sorted_gather import sorted_gather
+    trash = block - 1
+    rows_k = jnp.where(rows == trash, block, rows).astype(jnp.int32)
+    return sorted_gather(rows_k, vals, width=width, layout=layout,
+                         interpret=(mode == "interpret"))
 
 
 def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
@@ -208,7 +296,12 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
     pw = table.pull_width
 
     if num_shards == 1:
-        picked = table.vals[dev_rows, :pw]
+        # Shared sorted-stream layout (compute_bucketing with axis): the
+        # push scatter sorts the same dev_rows — one argsort serves both.
+        layout = (bucketing[5] if bucketing is not None
+                  and len(bucketing) == 6 else None)
+        picked = _gather_rows(table.vals, dev_rows, pw, block,
+                              layout=layout)
         return {
             "emb": picked[:, :d],
             "w": picked[:, d],
@@ -226,13 +319,19 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
     # so recomputing would pay the layout twice per step for identical
     # results. The shared tuple CARRIES its capacity: masks below must
     # use the capacity the buckets were built at, never a local guess.
+    # With axis-extended tuples it also carries the received rows (the
+    # push exchanges the same send_rows — one collective, not two) and
+    # the owner-side sorted-stream layout for the Pallas kernels.
+    recv_rows = layout = None
     if bucketing is None:
         if cap is None:
             cap = bucket_capacity(n, num_shards)
-        bucketing = _bucket_by_shard(dev_rows, num_shards, block, cap)
-        send_rows, slot_shard, slot_pos = bucketing
+        send_rows, slot_shard, slot_pos = _bucket_by_shard(
+            dev_rows, num_shards, block, cap)
     else:
-        send_rows, slot_shard, slot_pos, cap = bucketing
+        send_rows, slot_shard, slot_pos, cap = bucketing[:4]
+        if len(bucketing) == 6:
+            recv_rows, layout = bucketing[4], bucketing[5]
     # Shape [1] (not scalar) so prefix out_specs like P(axis) remain
     # valid for the returned dict under shard_map.
     overflow = jnp.sum(((slot_pos >= cap)
@@ -240,12 +339,17 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
                         ).astype(jnp.int32)).reshape(1)
 
     # Exchange requests: recv_req[s, c] = row requested by peer s.
-    recv_req = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0,
-                              tiled=True).reshape(num_shards, cap)
+    if recv_rows is None:
+        recv_rows = lax.all_to_all(send_rows, axis, split_axis=0,
+                                   concat_axis=0, tiled=True
+                                   ).reshape(num_shards * cap)
+    recv_req = recv_rows.reshape(num_shards, cap)
     # Serve from the local shard block: the fused record's pull payload
     # [emb | w | show | click] is one contiguous slice, so the reply path
-    # is a single gather + a single collective.
-    served = table.vals[recv_req, :pw]          # [S, C, D+3]
+    # is a single gather (or the Pallas sorted-stream kernel) + a single
+    # collective.
+    served = _gather_rows(table.vals, recv_rows, pw, block,
+                          layout=layout).reshape(num_shards, cap, pw)
     reply = lax.all_to_all(
         served.reshape(num_shards * cap, pw), axis,
         split_axis=0, concat_axis=0, tiled=True
@@ -264,8 +368,8 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
     }
 
 
-def _accumulate(rows: jax.Array, payload: jax.Array,
-                block: int) -> jax.Array:
+def _accumulate(rows: jax.Array, payload: jax.Array, block: int,
+                layout: Optional[Tuple] = None) -> jax.Array:
     """zeros([block, AW]).at[rows].add(payload) by the configured backend
     (``sparse_scatter_kernel`` flag): the Pallas sorted-stream kernel
     (CopyForPush role — XLA TPU scatter is the step's dominant cost,
@@ -273,11 +377,11 @@ def _accumulate(rows: jax.Array, payload: jax.Array,
     padding/overflow, all-zero or count-only payload) are dropped on the
     kernel path — apply_accumulated re-zeroes the trash row either way,
     and concentrating every padding lane on one row is exactly the skew
-    the kernel's per-block budget must not pay for."""
-    mode = flags.flag("sparse_scatter_kernel")
-    use_pallas = mode in ("pallas", "interpret") or (
-        mode == "auto" and flags.pallas_kernels_enabled())
-    if not use_pallas:
+    the kernel's per-block budget must not pay for. ``layout`` is the
+    shared sorted-stream layout from compute_bucketing (one argsort
+    serves this scatter and the pull gather)."""
+    mode = _kernel_mode("sparse_scatter_kernel")
+    if mode is None:
         acc = jnp.zeros((block, payload.shape[-1]), payload.dtype)
         return acc.at[rows].add(payload)
     from paddlebox_tpu.ops.pallas_kernels.sorted_scatter import (
@@ -285,7 +389,8 @@ def _accumulate(rows: jax.Array, payload: jax.Array,
     trash = block - 1
     rows_k = jnp.where(rows == trash, block, rows).astype(jnp.int32)
     acc = sorted_scatter_accumulate(rows_k, payload, block,
-                                    interpret=(mode == "interpret"))
+                                    interpret=(mode == "interpret"),
+                                    layout=layout)
     return acc
 
 
@@ -377,7 +482,11 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
         jnp.ones((n, 1), grad_emb.dtype)], axis=-1)
 
     if num_shards == 1:
-        acc = _accumulate(dev_rows, payload, block)
+        # Shared sorted-stream layout (compute_bucketing with axis): the
+        # pull gather sorted the same dev_rows — one argsort for both.
+        layout = (bucketing[5] if bucketing is not None
+                  and len(bucketing) == 6 else None)
+        acc = _accumulate(dev_rows, payload, block, layout=layout)
         if dcn_axis is not None:
             acc = lax.psum(acc, dcn_axis)
         new_vals = apply_accumulated(table.vals, acc, dim=d, ke=ke,
@@ -385,14 +494,19 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
         return PassTable(vals=new_vals, rows_per_shard=table.rows_per_shard,
                          num_shards=1, dim=d, ke=ke, kw=kw)
 
+    recv_rows = layout = None
     if bucketing is None:
         if cap is None:
             cap = bucket_capacity(n, num_shards)
-        bucketing = _bucket_by_shard(dev_rows, num_shards, block, cap)
-        send_rows, slot_shard, slot_pos = bucketing
+        send_rows, slot_shard, slot_pos = _bucket_by_shard(
+            dev_rows, num_shards, block, cap)
     else:
-        # Shared layout carries its own capacity (compute_bucketing).
-        send_rows, slot_shard, slot_pos, cap = bucketing
+        # Shared layout carries its own capacity (compute_bucketing) —
+        # and, when axis-extended, the already-exchanged rows (the pull
+        # moved the same send_rows) plus the owner-side sort layout.
+        send_rows, slot_shard, slot_pos, cap = bucketing[:4]
+        if len(bucketing) == 6:
+            recv_rows, layout = bucketing[4], bucketing[5]
     send_payload = jnp.zeros((num_shards, cap, aw), payload.dtype)
     # (slot_shard, slot_pos) are in original element order — the payload
     # scatters straight into its bucket cells, no permutation gather.
@@ -400,8 +514,10 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
     send_payload = send_payload.at[slot_shard, slot_pos].add(
         payload, mode="drop")
 
-    recv_rows = lax.all_to_all(send_rows, axis, split_axis=0, concat_axis=0,
-                               tiled=True).reshape(num_shards * cap)
+    if recv_rows is None:
+        recv_rows = lax.all_to_all(send_rows, axis, split_axis=0,
+                                   concat_axis=0, tiled=True
+                                   ).reshape(num_shards * cap)
     recv_payload = lax.all_to_all(
         send_payload.reshape(num_shards * cap, aw), axis,
         split_axis=0, concat_axis=0, tiled=True
@@ -409,7 +525,7 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
 
     # Owner-side accumulate (role of dynamic_merge_grad): filler cells
     # point at the trash row with all-zero payload, so they are no-ops.
-    acc = _accumulate(recv_rows, recv_payload, block)
+    acc = _accumulate(recv_rows, recv_payload, block, layout=layout)
     if dcn_axis is not None:
         # The one DCN stage: combine each shard's slice-local grad sums
         # across slices (table replicas) before the optimizer applies.

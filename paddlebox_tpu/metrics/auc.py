@@ -72,10 +72,17 @@ def auc_accumulate(state: AucState, preds: jax.Array, labels: jax.Array,
     nb = state.table.shape[1]
     w = jnp.ones_like(preds) if valid is None else valid.astype(preds.dtype)
     bucket = jnp.clip((preds * nb).astype(jnp.int32), 0, nb - 1)
-    lab = (labels > 0.5).astype(jnp.int32)
-    flat = lab * nb + bucket
-    inc_table = jax.ops.segment_sum(w, flat, num_segments=2 * nb
-                                    ).reshape(2, nb)
+    pos = (labels > 0.5).astype(preds.dtype) * w
+    # ONE width-2 scatter-add builds BOTH histograms: each sample adds
+    # its (neg_w, pos_w) column at its bucket. XLA TPU scatter pays a
+    # ~5 ms fixed cost per OP (PROFILE.md "AUC hist scatter"), so the
+    # split show/click form — one scatter per label row, or the flat
+    # segment_sum over [2*nb] whose index arithmetic defeats the
+    # unique-window lowering — pays the overhead twice for the same
+    # bytes. Column-major update ([:, bucket]) keeps the state layout
+    # [2, nb] unchanged for checkpoints and compute_from_table.
+    inc_table = jnp.zeros((2, nb), preds.dtype).at[:, bucket].add(
+        jnp.stack([w - pos, pos], axis=0))
     err = (preds - labels) * w
     inc = (inc_table, jnp.sum(jnp.abs(err)), jnp.sum(err * err),
            jnp.sum(preds * w), jnp.sum(labels * w), jnp.sum(w))

@@ -1,0 +1,198 @@
+"""Sorted-stream pull gather — the CopyForPull-class kernel.
+
+Role of the reference's pull-side CUDA kernels (``box_wrapper.cu``
+CopyForPull + the HeterComm per-shard table get): materialize the pull
+payload ``table[rows, :pw]`` for a batch of request rows at memory
+bandwidth. XLA's TPU gather costs ~6 ns/element regardless of layout
+(PROFILE.md: 16.2 ms for [426K x 16], 25.4 ms at pull width 40) — two
+orders of magnitude off HBM bandwidth for what is a streaming read. This
+kernel instead SORTS the requests by destination row (XLA argsort —
+cheap, and SHARED with the push-side ``sorted_scatter`` via
+``sorted_stream_layout``), streams the table through VMEM one block at a
+time via the Pallas pipeline, services each block's contiguous run of
+requests with in-VMEM dynamic-row reads into per-block staging slots,
+then inverse-permutes the slots back to original request order.
+
+    out = sorted_gather(rows, table, width=pw)
+    # == jnp.where(rows[:, None] < num_rows, table[rows, :pw], 0)  (exact)
+
+Requests whose row >= ``num_rows`` are DROPPED (zeros) — callers use
+that as the padding/trash sentinel, mirroring the scatter's drop
+semantics (the lookup trash row carries zero pull columns, so dropping
+is value-identical to gathering it).
+
+Skew guard: per-block request counts are data-dependent; if any block's
+run exceeds the static per-block budget (a pathologically hot row,
+requested > UCAP times without dedup), ``lax.cond`` falls back to the
+XLA gather — the kernel itself never reads past its budget. The budget,
+block size, and DMA alignment constants are the scatter's: the two
+kernels must agree for one argsort + one ``starts`` table to serve both
+(``embedding/lookup.py`` shares the layout per width group per step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddlebox_tpu.ops.pallas_kernels.sorted_scatter import (
+    ALIGN, BLOCK, UCAP, WINDOW)
+
+
+def sorted_stream_layout(rows: jax.Array, num_rows: int) -> Tuple[
+        jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The per-(rows, num_rows) sort layout BOTH sorted-stream kernels
+    consume: (sorted_rows [n+WINDOW] incl. the sentinel pad, order [n],
+    starts [nblocks+1], max_run []). Computing it once per width group
+    and passing it to ``sorted_gather`` (pull) and
+    ``sorted_scatter_accumulate`` (push) makes the step pay the argsort
+    once instead of twice — rows >= num_rows are remapped to the
+    one-past-the-last-block sentinel so they sort past every block
+    boundary and count toward no block's run (the scatter's exact
+    dropped-row convention)."""
+    rows = rows.astype(jnp.int32)
+    rows_pad = -(-num_rows // BLOCK) * BLOCK
+    rows = jnp.where(rows >= num_rows, rows_pad, rows)
+    order = jnp.argsort(rows).astype(jnp.int32)
+    sorted_rows = jnp.concatenate(
+        [rows[order], jnp.full((WINDOW,), rows_pad, jnp.int32)])
+    nblocks = rows_pad // BLOCK
+    boundaries = jnp.arange(nblocks + 1, dtype=jnp.int32) * BLOCK
+    starts = jnp.searchsorted(sorted_rows, boundaries).astype(jnp.int32)
+    max_run = jnp.max(starts[1:] - starts[:-1])
+    return sorted_rows, order, starts, max_run
+
+
+def _kernel(starts_ref, rows_ref, tbl_ref, out_ref, rows_s, sem):
+    b = pl.program_id(0)
+    lo = starts_ref[b]
+    cnt = starts_ref[b + 1] - lo
+
+    # Stage this block's run of request rows into SMEM (read one scalar
+    # at a time at a data-dependent index — see sorted_scatter._kernel
+    # for why SMEM + the ALIGN'd window): the copy starts at the run's
+    # offset rounded down to the tile boundary and the loop skips the
+    # `off` leading rows of slack. The rows input is padded by WINDOW so
+    # the fixed-size slice never reads out of bounds.
+    lo_a = pl.multiple_of((lo // ALIGN) * ALIGN, ALIGN)
+    off = lo - lo_a
+    dma = pltpu.make_async_copy(rows_ref.at[pl.ds(lo_a, WINDOW)], rows_s,
+                                sem)
+    dma.start()
+    # Staging slots the run does not fill must not leak garbage (the
+    # inverse permute only reads filled slots, but zeroing is one cheap
+    # VMEM store and keeps interpret/compiled bit-identical); overlaps
+    # the rows DMA like the scatter's accumulator zeroing.
+    out_ref[:] = jnp.zeros_like(out_ref)
+    dma.wait()
+
+    base = b * BLOCK
+    pw = out_ref.shape[1]
+
+    def body(j, _):
+        r = rows_s[j] - base
+        out_ref[pl.ds(j - off, 1), :] = tbl_ref[pl.ds(r, 1), :pw]
+        return 0
+
+    lax.fori_loop(off, off + jnp.minimum(cnt, UCAP), body, 0)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _sorted_gather_blocks(sorted_rows: jax.Array, table: jax.Array,
+                          pw: int, interpret: bool) -> jax.Array:
+    """[nblocks * UCAP, pw] staging slots: block b's run of requests
+    lands at slots [b*UCAP, b*UCAP + run_len) in sorted order."""
+    num_rows, w = table.shape
+    nblocks = -(-num_rows // BLOCK)
+    boundaries = jnp.arange(nblocks + 1, dtype=jnp.int32) * BLOCK
+    starts = jnp.searchsorted(sorted_rows, boundaries).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),      # sorted rows (HBM)
+            # The table streams through VMEM one [BLOCK, w] slab at a
+            # time — the Pallas pipeline double-buffers the HBM reads,
+            # so the random-access gather becomes a sequential sweep.
+            # The last block may overhang num_rows; its padding rows are
+            # never indexed (rows >= num_rows carry the sort sentinel).
+            pl.BlockSpec((BLOCK, w), lambda b, starts: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((UCAP, pw), lambda b, starts: (b, 0)),
+        scratch_shapes=[
+            pltpu.SMEM((WINDOW,), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nblocks * UCAP, pw), jnp.float32),
+        interpret=interpret,
+    )(starts, sorted_rows, table)
+
+
+def sorted_gather(rows: jax.Array, table: jax.Array, *,
+                  width: int = None, interpret: bool = False,
+                  layout: Tuple = None) -> jax.Array:
+    """``jnp.where(rows[:, None] < num_rows, table[rows, :width], 0)``,
+    exactly — via sort + VMEM-streamed block service. rows [n] int32
+    (entries >= num_rows yield zeros); table [num_rows, W<=128] float32;
+    width <= W selects the leading pull slice. ``layout`` is an optional
+    precomputed ``sorted_stream_layout(rows, num_rows)`` (the push
+    scatter shares it). Falls back to the XLA gather when a block's
+    request run exceeds the kernel budget (hot row)."""
+    n = rows.shape[0]
+    num_rows, w = table.shape
+    pw = w if width is None else width
+    if w > 128:
+        raise ValueError(
+            f"table width {w} > 128: the kernel streams full fused rows "
+            f"through single-tile (128-lane) VMEM blocks; gather wider "
+            f"records with the XLA path or split the record")
+    if not 0 < pw <= w:
+        raise ValueError(f"width {pw} outside (0, {w}]")
+    table = table.astype(jnp.float32)
+    rows_pad = -(-num_rows // BLOCK) * BLOCK
+    nblocks = rows_pad // BLOCK
+    if layout is None:
+        layout = sorted_stream_layout(rows, num_rows)
+    sorted_rows, order, starts, max_run = layout
+    if sorted_rows.shape[0] != n + WINDOW or starts.shape[0] != nblocks + 1:
+        raise ValueError(
+            f"shared layout shapes {sorted_rows.shape[0]}/"
+            f"{starts.shape[0]} do not match rows/table "
+            f"({n + WINDOW}/{nblocks + 1}) — it was built for different "
+            f"(rows, num_rows)")
+
+    def pallas_path(_):
+        staged = _sorted_gather_blocks(sorted_rows, table, pw, interpret)
+        # Slot of sorted rank s: its block's slot base + its rank within
+        # the block's run. Sentinel (dropped) entries get the
+        # one-past-the-end slot, turned into zeros after the gather.
+        nslots = nblocks * UCAP
+        s = jnp.arange(n, dtype=jnp.int32)
+        srows = sorted_rows[:n]
+        blk = jnp.minimum(srows // BLOCK, nblocks)
+        slot = blk * UCAP + (s - starts[blk])
+        slot = jnp.where(srows < num_rows, slot, nslots)
+        # Inverse permute: order maps sorted rank -> original position,
+        # so one small int32 scatter routes every slot index home and
+        # the payload moves in a single compact gather.
+        idx = jnp.zeros((n,), jnp.int32).at[order].set(slot)
+        picked = staged[jnp.minimum(idx, nslots - 1)]
+        return jnp.where((idx < nslots)[:, None], picked, 0.0)
+
+    def xla_path(_):
+        keep = rows < num_rows
+        safe = jnp.where(keep, rows, 0)
+        return jnp.where(keep[:, None], table[safe, :pw], 0.0)
+
+    return lax.cond(max_run <= UCAP, pallas_path, xla_path, operand=None)

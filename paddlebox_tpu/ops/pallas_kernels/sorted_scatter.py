@@ -125,11 +125,15 @@ def _sorted_accumulate(sorted_rows: jax.Array, sorted_payload: jax.Array,
 
 def sorted_scatter_accumulate(rows: jax.Array, payload: jax.Array,
                               num_rows: int, *,
-                              interpret: bool = False) -> jax.Array:
+                              interpret: bool = False,
+                              layout=None) -> jax.Array:
     """zeros([num_rows, AW]).at[rows].add(payload), exactly — via sort +
     VMEM-streamed accumulation. rows [n] int32 (entries >= num_rows are
-    dropped); payload [n, AW<=128] float32. Falls back to the XLA scatter
-    when a block's update run exceeds the kernel budget (hot row)."""
+    dropped); payload [n, AW<=128] float32. ``layout`` is an optional
+    precomputed ``sorted_gather.sorted_stream_layout(rows, num_rows)``
+    so the pull gather and this push scatter share ONE argsort per step.
+    Falls back to the XLA scatter when a block's update run exceeds the
+    kernel budget (hot row)."""
     n, aw = payload.shape
     if aw > 128:
         raise ValueError(
@@ -137,30 +141,39 @@ def sorted_scatter_accumulate(rows: jax.Array, payload: jax.Array,
             f"single-tile (128-lane) VMEM rows; split wider payloads "
             f"into <=128-wide accumulations")
     rows_pad = -(-num_rows // BLOCK) * BLOCK
-
-    # Dropped rows (>= num_rows) are remapped to rows_pad so they sort
-    # PAST the last block boundary. Leaving them in [num_rows, rows_pad)
-    # would count them in the last block's run — and since droppers
-    # concentrate (every padding lane carries the same sentinel), that
-    # would trip the hot-row fallback on every call for any num_rows not
-    # a multiple of BLOCK.
-    rows = jnp.where(rows >= num_rows, rows_pad, rows)
-    order = jnp.argsort(rows)
-    sorted_rows = rows[order].astype(jnp.int32)
-    sorted_payload = payload[order].astype(jnp.float32)
-    # Pad by WINDOW so the kernel's fixed-size aligned DMA slices stay in
-    # bounds; pad rows use the drop sentinel.
-    sorted_rows = jnp.concatenate(
-        [sorted_rows, jnp.full((WINDOW,), rows_pad, jnp.int32)])
-    sorted_payload = jnp.concatenate(
-        [sorted_payload, jnp.zeros((WINDOW, aw), jnp.float32)])
-
     nblocks = rows_pad // BLOCK
-    boundaries = jnp.arange(nblocks + 1, dtype=jnp.int32) * BLOCK
-    # Padding entries (== rows_pad) sort past the last boundary and fall
-    # in no block; the same holds for dropped (sentinel) rows.
-    starts = jnp.searchsorted(sorted_rows, boundaries)
-    max_run = jnp.max(starts[1:] - starts[:-1])
+
+    if layout is None:
+        # Dropped rows (>= num_rows) are remapped to rows_pad so they
+        # sort PAST the last block boundary. Leaving them in
+        # [num_rows, rows_pad) would count them in the last block's run
+        # — and since droppers concentrate (every padding lane carries
+        # the same sentinel), that would trip the hot-row fallback on
+        # every call for any num_rows not a multiple of BLOCK.
+        remapped = jnp.where(rows >= num_rows, rows_pad, rows)
+        order = jnp.argsort(remapped)
+        # Pad by WINDOW so the kernel's fixed-size aligned DMA slices
+        # stay in bounds; pad rows use the drop sentinel.
+        sorted_rows = jnp.concatenate(
+            [remapped[order].astype(jnp.int32),
+             jnp.full((WINDOW,), rows_pad, jnp.int32)])
+        boundaries = jnp.arange(nblocks + 1, dtype=jnp.int32) * BLOCK
+        # Padding entries (== rows_pad) sort past the last boundary and
+        # fall in no block; the same holds for dropped (sentinel) rows.
+        starts = jnp.searchsorted(sorted_rows, boundaries)
+        max_run = jnp.max(starts[1:] - starts[:-1])
+    else:
+        sorted_rows, order, starts, max_run = layout
+        if (sorted_rows.shape[0] != n + WINDOW
+                or starts.shape[0] != nblocks + 1):
+            raise ValueError(
+                f"shared layout shapes {sorted_rows.shape[0]}/"
+                f"{starts.shape[0]} do not match rows/num_rows "
+                f"({n + WINDOW}/{nblocks + 1}) — it was built for "
+                f"different (rows, num_rows)")
+    sorted_payload = jnp.concatenate(
+        [payload[order].astype(jnp.float32),
+         jnp.zeros((WINDOW, aw), jnp.float32)])
 
     def pallas_path(_):
         acc = _sorted_accumulate(sorted_rows, sorted_payload, rows_pad,

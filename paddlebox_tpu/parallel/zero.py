@@ -71,6 +71,24 @@ def shard_tree(tree: Any, mesh: Mesh, *, axis: str = "sharding",
     return jax.tree.map(jax.device_put, tree, sh)
 
 
+def _resolve_host_kind(mesh: Mesh, requested: str) -> str:
+    """Map the canonical host memory kind to what the backend actually
+    exposes: TPU runtimes advertise ``pinned_host``; CPU backends (the
+    test mesh) only ``unpinned_host``. Asking for a kind the device does
+    not have fails at device_put — resolve once at construction so the
+    offload wrapper runs unchanged on both."""
+    try:
+        kinds = {m.kind for m in mesh.devices.flat[0].addressable_memories()}
+    except Exception:  # backend without memory-space introspection
+        return requested
+    if requested in kinds:
+        return requested
+    for k in ("pinned_host", "unpinned_host"):
+        if k in kinds:
+            return k
+    return requested
+
+
 class OffloadedOptimizer:
     """optax-compatible wrapper keeping the optimizer STATE in host memory.
 
@@ -100,7 +118,7 @@ class OffloadedOptimizer:
         self._mesh = mesh
         self._axis = axis
         self._min_size = min_size
-        self._memory_kind = memory_kind
+        self._memory_kind = _resolve_host_kind(mesh, memory_kind)
         self._jit_update = None
 
     def _state_shardings(self, state: Any) -> Any:

@@ -410,8 +410,10 @@ class CTRTrainer:
             # bucket-by-shard layout is computed ONCE per group and
             # shared by the pull and the push below (both bucket the
             # same dev_rows — CopyKeys computed once in the reference
-            # too).
-            bucketings = [compute_bucketing(t, r, cap=c)
+            # too). Passing axis shares the rows exchange and the
+            # sorted-stream kernels' argsort between pull and push, so
+            # the step pays 3 collectives + 1 sort per group, not 4 + 2.
+            bucketings = [compute_bucketing(t, r, cap=c, axis=axis)
                           for t, r, c in zip(tables, rows, caps_list)]
             # The bucketing tuples carry their capacity — pull/push mask
             # with the capacity the buckets were built at.

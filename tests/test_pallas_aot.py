@@ -52,6 +52,31 @@ def test_scatter_kernel_mosaic_compiles_at_bench_shapes(n, aw, rows_n):
     assert compiled is not None
 
 
+# (requests, pull width, table width, rows incl. trash) — bench_deepfm
+# pull (426K ids from the [4M, W] fused table; rows NOT a multiple of
+# the kernel BLOCK, so this also pins Mosaic's padded tail-block fetch)
+# and the tiny probe shape.
+GATHER_SHAPES = [
+    (425_984, 16, 20, 4_194_305),
+    (425_984, 40, 40, 4_194_305),
+    (64, 8, 9, 9000),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n,pw,w,rows_n", GATHER_SHAPES)
+def test_gather_kernel_mosaic_compiles_at_bench_shapes(n, pw, w, rows_n):
+    from paddlebox_tpu.ops.pallas_kernels.sorted_gather import sorted_gather
+    dev = _aot_device()
+    sh = NamedSharding(Mesh([dev], ("d",)), P())
+    rows = jax.ShapeDtypeStruct((n,), jnp.int32, sharding=sh)
+    tbl = jax.ShapeDtypeStruct((rows_n, w), jnp.float32, sharding=sh)
+    compiled = jax.jit(
+        lambda r, t: sorted_gather(r, t, width=pw)
+    ).lower(rows, tbl).compile()
+    assert compiled is not None
+
+
 @pytest.mark.slow
 def test_flash_attention_mosaic_compiles_fwd_bwd():
     """bench_gpt's shape: [4, 1024, 16, 64], causal, with gradients."""

@@ -1,0 +1,259 @@
+"""sorted_gather (CopyForPull-class Pallas kernel) vs the XLA gather
+reference — interpret mode on CPU; the same code compiles for TPU
+(Mosaic AOT check in tests/test_pallas_aot.py). Covers the ISSUE's
+parity matrix: uniform keys, skewed/hot-row fallback, trash rows, empty
+blocks, widths 8/16/40, non-BLOCK-multiple row counts (the production
+pow2+trash shape), the shared pull+push sort layout, and the lookup
+wiring (pull_local single- and multi-shard) under the
+``sparse_gather_kernel`` flag."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.ops.pallas_kernels.sorted_gather import (
+    sorted_gather, sorted_stream_layout)
+from paddlebox_tpu.ops.pallas_kernels.sorted_scatter import (
+    BLOCK, UCAP, sorted_scatter_accumulate)
+
+
+def _ref(rows, table, pw):
+    keep = rows < table.shape[0]
+    safe = np.where(keep, rows, 0)
+    return np.where(keep[:, None], table[safe, :pw], 0.0).astype(np.float32)
+
+
+@pytest.mark.parametrize("num_rows,n,w,pw", [
+    (BLOCK, 1000, 16, 16),            # one block, full width
+    (3 * BLOCK + 17, 20_000, 20, 16),  # non-multiple rows: tail block
+    (BLOCK + 1, 9_000, 8, 8),          # the rows_per_shard+1 real shape
+    (2 * BLOCK, 4_000, 40, 40),        # pull width 40 (wide mf)
+])
+def test_matches_xla_gather(num_rows, n, w, pw):
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, num_rows, n).astype(np.int32)
+    table = rng.normal(size=(num_rows, w)).astype(np.float32)
+    got = sorted_gather(jnp.asarray(rows), jnp.asarray(table), width=pw,
+                        interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), _ref(rows, table, pw))
+
+
+def test_trash_rows_dropped_to_zeros():
+    rng = np.random.default_rng(1)
+    num_rows = BLOCK + 1
+    n = 6000
+    rows = rng.integers(0, num_rows, n).astype(np.int32)
+    # A third of entries carry the drop sentinel (padding/overflow), and
+    # they CONCENTRATE — must count toward no block's run (else the
+    # hot-row fallback would fire on every call).
+    rows[::3] = num_rows
+    table = rng.normal(size=(num_rows, 12)).astype(np.float32)
+    got = sorted_gather(jnp.asarray(rows), jnp.asarray(table), width=12,
+                        interpret=True)
+    ref = _ref(rows, table, 12)
+    assert (np.asarray(got)[::3] == 0.0).all()
+    np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+def test_hot_row_falls_back_to_xla_gather():
+    """More than UCAP requests for one row: the kernel budget would
+    overflow, so the cond must take the exact XLA path."""
+    rng = np.random.default_rng(2)
+    num_rows = BLOCK
+    n = UCAP + 2048
+    rows = np.full((n,), 7, np.int32)
+    rows[-5:] = num_rows              # plus a few dropped sentinels
+    table = rng.normal(size=(num_rows, 16)).astype(np.float32)
+    got = sorted_gather(jnp.asarray(rows), jnp.asarray(table), width=16,
+                        interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), _ref(rows, table, 16))
+
+
+def test_empty_blocks_and_tail_rows():
+    """All requests inside block 0 plus a handful in the tail partial
+    block: interior blocks have zero-length runs (the kernel loop body
+    must not execute), and tail rows past the last full block boundary
+    are still served exactly."""
+    rng = np.random.default_rng(3)
+    num_rows = 3 * BLOCK + 5
+    rows = np.concatenate([
+        rng.integers(0, 64, 500),                    # block 0 only
+        rng.integers(3 * BLOCK, num_rows, 40),        # tail block rows
+    ]).astype(np.int32)
+    table = rng.normal(size=(num_rows, 8)).astype(np.float32)
+    got = sorted_gather(jnp.asarray(rows), jnp.asarray(table), width=8,
+                        interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), _ref(rows, table, 8))
+
+
+def test_width_slice_of_wider_record():
+    """width < table width gathers the leading pull slice only — the
+    lookup serves [emb | w | show | click] out of the fused record."""
+    rng = np.random.default_rng(4)
+    num_rows = BLOCK
+    rows = rng.integers(0, num_rows, 300).astype(np.int32)
+    table = rng.normal(size=(num_rows, 21)).astype(np.float32)
+    got = sorted_gather(jnp.asarray(rows), jnp.asarray(table), width=11,
+                        interpret=True)
+    assert got.shape == (300, 11)
+    np.testing.assert_array_equal(np.asarray(got), _ref(rows, table, 11))
+
+
+def test_shared_layout_serves_gather_and_scatter():
+    """ONE sorted_stream_layout drives both kernels (the step's shared
+    argsort): results must be identical to each kernel computing its own
+    sort."""
+    rng = np.random.default_rng(5)
+    num_rows = BLOCK + 1
+    n = 4000
+    rows = rng.integers(0, num_rows, n).astype(np.int32)
+    rows[::6] = num_rows
+    table = rng.normal(size=(num_rows, 12)).astype(np.float32)
+    payload = rng.normal(size=(n, 12)).astype(np.float32)
+    layout = sorted_stream_layout(jnp.asarray(rows), num_rows)
+
+    g_shared = sorted_gather(jnp.asarray(rows), jnp.asarray(table),
+                             width=12, interpret=True, layout=layout)
+    g_own = sorted_gather(jnp.asarray(rows), jnp.asarray(table),
+                          width=12, interpret=True)
+    np.testing.assert_array_equal(np.asarray(g_shared), np.asarray(g_own))
+
+    s_shared = sorted_scatter_accumulate(jnp.asarray(rows),
+                                         jnp.asarray(payload), num_rows,
+                                         interpret=True, layout=layout)
+    s_own = sorted_scatter_accumulate(jnp.asarray(rows),
+                                      jnp.asarray(payload), num_rows,
+                                      interpret=True)
+    np.testing.assert_array_equal(np.asarray(s_shared), np.asarray(s_own))
+
+
+def test_layout_shape_mismatch_raises():
+    rng = np.random.default_rng(6)
+    rows = rng.integers(0, BLOCK, 100).astype(np.int32)
+    table = rng.normal(size=(BLOCK, 8)).astype(np.float32)
+    layout = sorted_stream_layout(jnp.asarray(rows), BLOCK)
+    with pytest.raises(ValueError, match="shared layout"):
+        sorted_gather(jnp.asarray(rows[:50]), jnp.asarray(table),
+                      width=8, interpret=True, layout=layout)
+
+
+def test_width_guards():
+    rng = np.random.default_rng(7)
+    rows = jnp.asarray(rng.integers(0, 64, 16).astype(np.int32))
+    wide = jnp.asarray(rng.normal(size=(64, 130)).astype(np.float32))
+    with pytest.raises(ValueError, match="table width"):
+        sorted_gather(rows, wide, width=16, interpret=True)
+    tbl = jnp.asarray(rng.normal(size=(64, 8)).astype(np.float32))
+    with pytest.raises(ValueError, match="width"):
+        sorted_gather(rows, tbl, width=9, interpret=True)
+
+
+def test_pull_local_kernel_path_matches_xla():
+    """Full single-shard pull_local through the Pallas (interpret)
+    gather equals the XLA-gather path — emb, w, show, click — with
+    padding (trash-row) requests in the batch."""
+    from paddlebox_tpu.core import flags as flagmod
+    from paddlebox_tpu.embedding.lookup import pull_local
+    from paddlebox_tpu.embedding.table import PassTable
+
+    rng = np.random.default_rng(8)
+    rps, d = 300, 4
+    ke, kw = 1, 1
+    w_width = d + 3 + ke + kw
+    vals = rng.normal(size=(rps + 1, w_width)).astype(np.float32)
+    vals[rps, :d + 3] = 0.0          # trash row pull columns zero
+    n = 256
+    rows = rng.integers(0, rps, n).astype(np.int32)
+    rows[::5] = rps                  # padding entries -> trash row
+
+    def run(mode):
+        flagmod.set_flags({"sparse_gather_kernel": mode})
+        try:
+            table = PassTable(vals=jnp.asarray(vals), rows_per_shard=rps,
+                              num_shards=1, dim=d, ke=ke, kw=kw)
+            out = pull_local(table, jnp.asarray(rows), axis="dp")
+            return {k: np.asarray(v) for k, v in out.items()}
+        finally:
+            flagmod.set_flags({"sparse_gather_kernel": "auto"})
+
+    a = run("xla")
+    b = run("interpret")
+    for k in ("emb", "w", "show", "click", "overflow"):
+        np.testing.assert_array_equal(b[k], a[k], err_msg=k)
+
+
+def test_sharded_pull_push_kernel_parity(devices8):
+    """Multi-shard pull + push through compute_bucketing's SHARED
+    layout (one rows exchange + one argsort) in interpret mode equal
+    the XLA paths bit-for-bit — the serve-side gather and the owner-side
+    scatter both consume the same sort."""
+    from paddlebox_tpu.core import flags as flagmod
+    from paddlebox_tpu.embedding.lookup import (compute_bucketing,
+                                                pull_local, push_local)
+    from paddlebox_tpu.embedding.optimizers import SparseAdagrad
+    from paddlebox_tpu.embedding.table import PassTable
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from jax.sharding import PartitionSpec as P
+    import functools
+
+    ndev = 4
+    mesh = build_mesh(HybridTopology(dp=ndev), devices=devices8[:ndev])
+    rng = np.random.default_rng(9)
+    rps, d = 64, 4
+    ke, kw = 1, 1
+    block = rps + 1
+    w_width = d + 3 + ke + kw
+    vals = rng.normal(size=(ndev * block, w_width)).astype(np.float32)
+    for s in range(ndev):
+        vals[s * block + rps, :d + 3] = 0.0
+    n_local = 40
+    rows = rng.integers(0, ndev * block, ndev * n_local).astype(np.int32)
+    rows[::7] = (rows[::7] // block) * block + rps     # padding -> trash
+    g_emb = rng.normal(size=(ndev * n_local, d)).astype(np.float32)
+    g_w = rng.normal(size=(ndev * n_local,)).astype(np.float32)
+    shows = np.ones((ndev * n_local,), np.float32)
+    clicks = (rng.random(ndev * n_local) < 0.4).astype(np.float32)
+
+    def run(gmode, smode):
+        flagmod.set_flags({"sparse_gather_kernel": gmode,
+                           "sparse_scatter_kernel": smode})
+        try:
+            @jax.jit
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P("dp"), P("dp"), P("dp"), P("dp"), P("dp"),
+                          P("dp")),
+                out_specs=(P("dp"), P("dp")),
+                check_vma=False)
+            def both(table, dev_rows, ge, gw, sh, ck):
+                bk = compute_bucketing(table, dev_rows, axis="dp")
+                pulled = pull_local(table, dev_rows, axis="dp",
+                                    bucketing=bk)
+                new = push_local(table, dev_rows, ge, gw, sh, ck,
+                                 axis="dp", opt=SparseAdagrad(),
+                                 bucketing=bk)
+                return pulled["emb"], new.vals
+
+            table = PassTable(vals=jnp.asarray(vals), rows_per_shard=rps,
+                              num_shards=ndev, dim=d, ke=ke, kw=kw)
+            emb, new_vals = both(table, jnp.asarray(rows),
+                                 jnp.asarray(g_emb), jnp.asarray(g_w),
+                                 jnp.asarray(shows), jnp.asarray(clicks))
+            return np.asarray(emb), np.asarray(new_vals)
+        finally:
+            flagmod.set_flags({"sparse_gather_kernel": "auto",
+                               "sparse_scatter_kernel": "auto"})
+
+    emb_x, vals_x = run("xla", "xla")
+    emb_k, vals_k = run("interpret", "interpret")
+    np.testing.assert_allclose(emb_k, emb_x, rtol=1e-6, atol=1e-6)
+    # Trash-row optimizer state may differ (kernel drops trash updates);
+    # everything consumable must match.
+    for s in range(ndev):
+        np.testing.assert_allclose(
+            vals_k[s * block:s * block + rps],
+            vals_x[s * block:s * block + rps], rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(
+            vals_k[s * block + rps, :d + 3],
+            vals_x[s * block + rps, :d + 3])

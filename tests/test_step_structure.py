@@ -57,10 +57,13 @@ def _step_op_counts(ndev=4):
 
 def test_ctr_step_collective_and_scatter_budget():
     c = _step_op_counts()
-    # Exactly TWO all_to_all pairs for a single width group: pull
-    # (request + reply) and push (rows + payload). A third pair means a
-    # new collective round crept into the hot path.
-    assert c.get("all_to_all", 0) == 4, c
+    # Exactly THREE all_to_alls for a single width group: the SHARED
+    # rows exchange (compute_bucketing moves send_rows once for the
+    # pull's requests AND the push's destinations — same array), the
+    # pull reply, and the push payload. A fourth means the pull/push
+    # stopped sharing the rows exchange (or a new collective round
+    # crept into the hot path).
+    assert c.get("all_to_all", 0) == 3, c
     # Scatter budget: ONE shared bucket-set (pull+push share the
     # bucket-by-shard layout), payload add, owner-side accumulate, AUC
     # histograms, and the gather-VJP scatter-adds from autodiff. The
@@ -82,6 +85,97 @@ def test_ctr_step_collective_and_scatter_budget():
     # behind the TPU-only flag and is not part of this CPU lowering).
     assert c.get("sort", 0) == 0, c
     assert c.get("cumsum", 0) >= 1, c
+
+
+def _walk_eqns(jaxpr, in_cond=False):
+    """Yield (primitive_name, eqn, inside_cond_branch) over the whole
+    program. ``inside_cond_branch`` marks ops that exist only in a
+    lax.cond arm — the sorted-stream kernels keep their exact XLA
+    fallback there (the hot-row guard), and the budget below must
+    distinguish the fallback's table-sized gather/scatter from one on
+    the hot path."""
+    for eqn in jaxpr.eqns:
+        yield eqn.primitive.name, eqn, in_cond
+        inner_cond = in_cond or eqn.primitive.name == "cond"
+        for p in eqn.params.values():
+            items = p if isinstance(p, (tuple, list)) else (p,)
+            for item in items:
+                if hasattr(item, "eqns"):
+                    yield from _walk_eqns(item, inner_cond)
+
+
+def test_ctr_step_pallas_mode_no_table_gather_scatter_one_sort():
+    """The Pallas sorted-stream pair (sparse_gather_kernel +
+    sparse_scatter_kernel = pallas) must leave ZERO XLA gathers reading
+    the table and ZERO XLA scatters building the [block, aw] grad
+    accumulator on the hot path (the exact fallbacks live inside the
+    hot-row lax.cond arms only), and the shared pull+push layout must
+    pay exactly ONE argsort per width group — the whole point of
+    sharing compute_bucketing's stream layout."""
+    import jax.tree_util as jtu
+
+    from paddlebox_tpu.core import flags as flagmod
+    from paddlebox_tpu.embedding.table import PassTable
+
+    flagmod.set_flags({"sparse_gather_kernel": "pallas",
+                       "sparse_scatter_kernel": "pallas"})
+    try:
+        mesh = build_mesh(HybridTopology(dp=4), devices=jax.devices()[:4])
+        slots = tuple(SlotConf(f"s{i}", avg_len=2.0) for i in range(3))
+        feed = DataFeedConfig(slots=slots, batch_size=16)
+        model = DeepFM(slot_names=tuple(f"s{i}" for i in range(3)),
+                       emb_dim=8, hidden=(16, 8))
+        tr = CTRTrainer(model, feed, TableConfig(dim=8), mesh=mesh,
+                        config=TrainerConfig(auc_num_buckets=1 << 10),
+                        store_factory=lambda c: DeviceFeatureStore(
+                            c, mesh=mesh))
+        tr.init(seed=0)
+        rng = np.random.default_rng(0)
+        lines = [f"{rng.integers(0, 2)} "
+                 + " ".join(f"s{i}:{rng.integers(1, 40)}" for i in range(3))
+                 for _ in range(feed.batch_size)]
+        batch = SlotBatch.pack_sharded(parse_lines(lines, feed), feed, 4)
+        tr.engine.feed_pass([
+            np.unique(np.concatenate([batch.ids[n] for n in g.slots]))
+            for g in tr.engine.groups])
+        step = tr._build_step()
+        tables = tr.engine.begin_pass()
+        rows = tr._map_batch_rows(batch)
+        segs = {n: jnp.asarray(batch.segments[n]) for n in batch.ids}
+        args = (tables, tr.params, tr.opt_state, tr.auc_state, rows, segs,
+                jnp.asarray(batch.labels), jnp.asarray(batch.valid),
+                jnp.asarray(_concat_dense_host(batch)),
+                jnp.zeros((), jnp.int32))
+        jaxpr = jax.make_jaxpr(lambda *a: step(*a))(*args)
+
+        # Per-shard table/accumulator shapes as the shard_map body sees
+        # them (gathers/scatters against these are the ~6-7 ns/element
+        # ops the kernels exist to kill).
+        t = tables[0]
+        block, w = t.rows_per_shard + 1, t.vals.shape[-1]
+        aw = t.dim + 4
+        table_gathers, acc_scatters, sorts = [], [], 0
+        for prim, eqn, in_cond in _walk_eqns(jaxpr.jaxpr):
+            if prim == "sort":
+                sorts += 1
+            if in_cond or not eqn.invars:
+                continue  # the hot-row fallback arm, by design
+            shp = tuple(getattr(eqn.invars[0], "aval", None).shape
+                        if hasattr(eqn.invars[0], "aval") else ())
+            if prim == "gather" and shp == (block, w):
+                table_gathers.append(eqn)
+            if prim in ("scatter-add", "scatter") and shp == (block, aw):
+                acc_scatters.append(eqn)
+        assert not table_gathers, table_gathers
+        assert not acc_scatters, acc_scatters
+        # One width group -> exactly one argsort, shared by the pull
+        # gather and the push scatter via compute_bucketing's layout.
+        n_groups = len(tr.engine.groups)
+        assert sorts == n_groups, (sorts, n_groups)
+        assert jtu.tree_structure(args) is not None  # keep args alive
+    finally:
+        flagmod.set_flags({"sparse_gather_kernel": "auto",
+                           "sparse_scatter_kernel": "auto"})
 
 
 def test_jaxpr_summary_sees_inside_shard_map():

@@ -99,7 +99,8 @@ def check_ctr_dp4(topo) -> None:
             jnp.asarray(b.labels), jnp.asarray(b.valid), dense_j,
             jnp.zeros((), jnp.int32))
     tr.mesh = Mesh(np.array(topo.devices).reshape(4), (tr.axis,))
-    flagmod.set_flags({"sparse_scatter_kernel": "pallas"})
+    flagmod.set_flags({"sparse_scatter_kernel": "pallas",
+                       "sparse_gather_kernel": "pallas"})
     step = tr._build_step()
     step.lower(*sds(args)).compile()
     print("AOT ctr dp=4 (sharded table all-to-all pull/push): OK")

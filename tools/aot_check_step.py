@@ -104,11 +104,15 @@ def main() -> None:
         print(f"TPU-AOT-TOPOLOGY-UNAVAILABLE: {e!r}")
         return
     tr.mesh = Mesh(np.array([topo.devices[0]]), (tr.axis,))
-    flagmod.set_flags({"sparse_scatter_kernel": "pallas"})
+    flagmod.set_flags({"sparse_scatter_kernel": "pallas",
+                       "sparse_gather_kernel": "pallas"})
     step = tr._build_step()
     compiled = step.lower(*sds_like(args)).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # jax < 0.5 returns [dict]
+        ca = ca[0] if ca else {}
     print("FULL-STEP TPU AOT COMPILE: OK "
-          f"(flops={compiled.cost_analysis().get('flops', 0):.3e})")
+          f"(flops={ca.get('flops', 0):.3e})")
 
     eval_step = tr._build_eval_step()
     eval_args = (tables, tr.params, tr.auc_state, rows, segs_j,

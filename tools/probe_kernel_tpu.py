@@ -1,11 +1,12 @@
-"""Compile + value + timing probe of the Pallas sorted-scatter kernel on
-the real TPU (the bench preflight's big sibling). Run manually after any
-kernel change:
+"""Compile + value + timing probe of BOTH Pallas sorted-stream kernels
+(push scatter + pull gather) on the real TPU (the bench preflight's big
+sibling). Run manually after any kernel change:
 
     python tools/probe_kernel_tpu.py
 
-Prints per-shape timing vs the XLA scatter path so kernel-vs-fallback
-decisions (core/flags.py sparse_scatter_kernel) stay evidence-based.
+Prints per-shape timing vs the XLA scatter/gather paths so
+kernel-vs-fallback decisions (core/flags.py sparse_scatter_kernel /
+sparse_gather_kernel) stay evidence-based.
 """
 import time
 
@@ -14,6 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu.ops.pallas_kernels.sorted_gather import sorted_gather
 from paddlebox_tpu.ops.pallas_kernels.sorted_scatter import (
     sorted_scatter_accumulate)
 
@@ -51,13 +53,37 @@ def main():
     f_kernel = jax.jit(lambda r, p: sorted_scatter_accumulate(r, p, rows_n))
     f_xla = jax.jit(
         lambda r, p: jnp.zeros((rows_n, aw), jnp.float32).at[r].add(p))
-    for name, f in (("kernel", f_kernel), ("xla", f_xla)):
+    for name, f in (("scatter kernel", f_kernel), ("scatter xla", f_xla)):
         sync(f(rows_j, pay_j))  # warm
         t0 = time.perf_counter()
         for _ in range(5):
             sync(f(rows_j, pay_j))
         dt = (time.perf_counter() - t0) / 5
         print(f"{name}: {dt * 1e3:.1f} ms per call")
+
+    # Pull gather at both bench pull widths, incl. the production
+    # rows_per_shard+1 tail (rows_n + 1 is NOT a multiple of the kernel
+    # BLOCK — the padded last-block fetch must survive on hardware, not
+    # just in the AOT compile).
+    for pw in (16, 40):
+        tbl_j = jnp.asarray(
+            rng.standard_normal((rows_n + 1, pw)).astype(np.float32))
+        got = sorted_gather(rows_j, tbl_j, width=pw)
+        ref = tbl_j[rows_j, :pw]
+        gerr = float(jnp.max(jnp.abs(got - ref)))
+        print(f"gather width {pw}: max |kernel - xla| = {gerr:.3e}")
+        if not gerr == 0.0:
+            raise RuntimeError(f"gather value mismatch: {gerr}")
+        g_kernel = jax.jit(lambda r, t: sorted_gather(r, t, width=pw))
+        g_xla = jax.jit(lambda r, t: t[r, :pw])
+        for name, f in ((f"gather kernel w={pw}", g_kernel),
+                        (f"gather xla w={pw}", g_xla)):
+            sync(f(rows_j, tbl_j))  # warm
+            t0 = time.perf_counter()
+            for _ in range(5):
+                sync(f(rows_j, tbl_j))
+            dt = (time.perf_counter() - t0) / 5
+            print(f"{name}: {dt * 1e3:.1f} ms per call")
 
 
 if __name__ == "__main__":

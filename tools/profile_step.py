@@ -69,6 +69,22 @@ def main():
     t = timeit(jax.jit(lambda f, r: f[r]), fused, rows)
     print(f"gather fused [{n}x{2*D+8}]   {t*1e3:8.2f} ms")
 
+    # Pull-side sorted-stream kernel (CopyForPull role) vs the XLA
+    # gather at both bench pull widths — includes the kernel's argsort,
+    # which the real step AMORTIZES by sharing it with the push scatter
+    # (compute_bucketing), so the steady-state cost is lower than this
+    # standalone row by ~the argsort line above.
+    from paddlebox_tpu.ops.pallas_kernels.sorted_gather import sorted_gather
+    for pw in (16, 40):
+        tbl = jnp.asarray(rng.normal(size=(N_ROWS, pw)), jnp.float32)
+        sync(tbl)
+        t = timeit(jax.jit(lambda t_, r: t_[r, :pw]), tbl, rows)
+        print(f"gather xla [{n}x{pw}]        {t*1e3:8.2f} ms")
+        t = timeit(jax.jit(
+            lambda r, t_: sorted_gather(r, t_, width=pw)), rows, tbl)
+        print(f"sorted_gather [{n}x{pw}]     {t*1e3:8.2f} ms "
+              f"(incl. its own argsort)")
+
     t = timeit(jax.jit(lambda e, r, g: e.at[r].add(g)), emb, rows, grads)
     print(f"scatter-add [{n}x{D}]        {t*1e3:8.2f} ms")
 
