@@ -503,6 +503,16 @@ def bench_deepfm() -> dict:
         "push_ms": round(push_ms, 3),
         "sparse_gather_kernel": flags.flag("sparse_gather_kernel"),
         "sparse_scatter_kernel": flags.flag("sparse_scatter_kernel"),
+        # Dispatch amortization (FLAGS_trainer_steps_per_dispatch):
+        # dispatch_ms is the host-side enqueue wall per BLOCK (the
+        # device_step scope records async dispatch, not completion) —
+        # at K>1 the same number covers K steps.
+        "steps_per_dispatch": int(stats["steps_per_dispatch"]),
+        "dispatch_blocks": int(stats["dispatch_blocks"]),
+        "dispatch_ms": round(
+            device_step_s / max(int(stats["dispatch_blocks"]), 1) * 1e3,
+            3),
+        "embedding_exchange_dtype": flags.flag("embedding_exchange_dtype"),
         "load_s": round(t_load, 3),
         "preload_wall_s": round(preload_wall, 3),
         "pass_s": round(t_pass, 3),

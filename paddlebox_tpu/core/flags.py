@@ -235,6 +235,23 @@ define_flag("embedding_unique_frac", 1.0,
 define_flag("trainer_prefetch_depth", 2,
             "bounded queue depth for the train-pass host-map producer "
             "thread (batches packed ahead of the device)")
+define_flag("trainer_steps_per_dispatch", 1,
+            "fuse K train/eval steps into ONE scanned XLA dispatch "
+            "(lax.scan megastep): the pass loop pays one host dispatch "
+            "and at most one host sync per K steps instead of per step "
+            "— the amortization that matters when the host link is "
+            "high-latency (the axon tunnel pays ~ms per dispatch). "
+            "1 = per-step dispatch (legacy behavior); "
+            "dense_sync_mode='async' (host dense table needs per-step "
+            "pull/push) and FLAGS_profile_trainer (per-step timing) "
+            "force 1 with a logged note")
+define_flag("embedding_exchange_dtype", "f32",
+            "wire dtype of the sparse pull-reply and push-gradient "
+            "all_to_all payloads: 'f32' (exact, default) or 'bf16' "
+            "(halves the ICI exchange bytes on top of dedup — "
+            "EQuARX-style reduced-precision exchange; accumulation and "
+            "the table stay f32). Row/request exchanges stay int32 "
+            "either way")
 define_flag("pass_table_pow2_rows", 1,
             "round each pass table's rows-per-shard up to a power of two "
             "so consecutive passes with different key counts reuse the "

@@ -144,6 +144,24 @@ def _bucket_by_shard(dev_rows: jax.Array, num_shards: int, block: int,
     return send_rows, shard_of, pos
 
 
+def _wire_dtype():
+    """Wire dtype for the pull-reply / push-grad all_to_all payloads
+    (``embedding_exchange_dtype``): None for f32 (exact, the cast code
+    must be a no-op so the default path stays bit-identical), or
+    jnp.bfloat16 — payloads are cast on the sender, exchanged at half
+    the bytes, and cast back to f32 on the receiver BEFORE any
+    accumulation (EQuARX-style reduced-precision exchange: quantize the
+    wire, accumulate in full precision). Row/request exchanges are
+    int32 and never cast."""
+    mode = flags.flag("embedding_exchange_dtype")
+    if mode == "f32":
+        return None
+    if mode == "bf16":
+        return jnp.bfloat16
+    raise ValueError(
+        f"unknown embedding_exchange_dtype {mode!r} (want 'f32'/'bf16')")
+
+
 def _kernel_mode(flag_name: str) -> Optional[str]:
     """Resolve a sorted-stream kernel flag to 'pallas' / 'interpret' /
     None (XLA). One predicate so the gather and scatter sites — and the
@@ -240,8 +258,14 @@ def exchange_bytes(table: PassTable, n: int,
     if cap is None:
         cap = bucket_capacity(n, table.num_shards)
     s = table.num_shards
-    pull = s * cap * 4 + s * cap * table.pull_width * 4
-    push = s * cap * 4 + s * cap * (table.dim + 4) * 4
+    # Payload bytes follow the wire dtype (embedding_exchange_dtype);
+    # the two row exchanges (pull requests shared with push dests via
+    # compute_bucketing, so ONE exchange — but exchange_bytes predates
+    # the sharing and deliberately reports the pull+push round as two
+    # independent halves, each carrying its rows) stay int32.
+    esize = 2 if _wire_dtype() is not None else 4
+    pull = s * cap * 4 + s * cap * table.pull_width * esize
+    push = s * cap * 4 + s * cap * (table.dim + 4) * esize
     return pull + push
 
 
@@ -349,11 +373,18 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
     # is a single gather (or the Pallas sorted-stream kernel) + a single
     # collective.
     served = _gather_rows(table.vals, recv_rows, pw, block,
-                          layout=layout).reshape(num_shards, cap, pw)
+                          layout=layout).reshape(num_shards * cap, pw)
+    # Reduced-precision wire (embedding_exchange_dtype=bf16): cast the
+    # reply payload sender-side, exchange half the bytes, widen back to
+    # f32 receiver-side. f32 mode takes the untouched path (bit-exact).
+    wire = _wire_dtype()
+    if wire is not None:
+        served = served.astype(wire)
     reply = lax.all_to_all(
-        served.reshape(num_shards * cap, pw), axis,
-        split_axis=0, concat_axis=0, tiled=True
-    ).reshape(num_shards, cap, pw)
+        served, axis, split_axis=0, concat_axis=0, tiled=True)
+    if wire is not None:
+        reply = reply.astype(jnp.float32)
+    reply = reply.reshape(num_shards, cap, pw)
     # Route replies back: (slot_shard, slot_pos) are in original element
     # order (sort-free bucketing), so one gather finishes the pull.
     in_cap = slot_pos < cap
@@ -518,10 +549,19 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
         recv_rows = lax.all_to_all(send_rows, axis, split_axis=0,
                                    concat_axis=0, tiled=True
                                    ).reshape(num_shards * cap)
+    # bf16 wire (embedding_exchange_dtype): grads merged sender-side in
+    # f32 (the bucket scatter-add above), cast for the exchange only,
+    # widened back before the owner-side accumulate — accumulation
+    # never happens in reduced precision.
+    wire = _wire_dtype()
+    send_flat = send_payload.reshape(num_shards * cap, aw)
+    if wire is not None:
+        send_flat = send_flat.astype(wire)
     recv_payload = lax.all_to_all(
-        send_payload.reshape(num_shards * cap, aw), axis,
-        split_axis=0, concat_axis=0, tiled=True
-    ).reshape(num_shards * cap, aw)
+        send_flat, axis, split_axis=0, concat_axis=0, tiled=True)
+    if wire is not None:
+        recv_payload = recv_payload.astype(jnp.float32)
+    recv_payload = recv_payload.reshape(num_shards * cap, aw)
 
     # Owner-side accumulate (role of dynamic_merge_grad): filler cells
     # point at the trash row with all-zero payload, so they are no-ops.

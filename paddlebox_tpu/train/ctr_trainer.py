@@ -175,6 +175,25 @@ class CTRTrainer:
         self._eval_fn = None
         self.timers = timers.TimerGroup()
         self._step_fn = None
+        # K-step scanned megastep (FLAGS_trainer_steps_per_dispatch > 1):
+        # the compiled fn and the K it was built at — invalidated together
+        # with _step_fn whenever the measured bucket caps change.
+        self._mega_fn = None
+        self._mega_k = 0
+        self._eval_k = 0
+        # Pass-loop observability (reset per pass, surfaced in stats):
+        # dispatches = compiled-program launches; host_syncs = blocking
+        # device fetches INSIDE the loop (the check_nan_inf finite-vector
+        # reads — pass-end stat reductions are O(1) and not counted).
+        self._dispatch_blocks = 0
+        self._host_syncs = 0
+        # Test hook: when True the pass loop retains per-step loss device
+        # arrays (K=1: scalars, K>1: [K] blocks) in _debug_losses so
+        # parity tests can compare per-step losses bitwise. Off by
+        # default — retaining O(steps) arrays is exactly what the
+        # running-sum path exists to avoid.
+        self._debug_collect_losses = False
+        self._debug_losses: List[Tuple[int, jax.Array, int]] = []
         # Measured bucket-capacity overrides the current _step_fn was
         # traced with (None = default n-based capacity).
         self._step_caps: Optional[Tuple[Optional[int], ...]] = None
@@ -363,7 +382,19 @@ class CTRTrainer:
 
         return forward
 
-    def _build_step(self, caps: Optional[Tuple[Optional[int], ...]] = None):
+    def _build_step(self, caps: Optional[Tuple[Optional[int], ...]] = None,
+                    k_steps: int = 1):
+        """The fused device step. ``k_steps == 1`` (default) builds the
+        per-step program with its legacy signature; ``k_steps > 1``
+        wraps the SAME per-step body in a ``lax.scan`` over a stacked
+        [K, ...] batch block — one XLA dispatch runs K steps, with the
+        kstep sync_flag derived from an in-scan global step counter and
+        loss/overflow/finite-ness accumulated on device into [K]
+        outputs (one host fetch per block, not per step). A partial
+        tail block is handled by ``n_active``: steps with in-block
+        index >= n_active compute on the padded (repeated) batch but
+        their state updates are masked out, so padding never reaches
+        the tables/params/AUC."""
         axis = self.axis
         dcn = self.dcn_axis
         # Per-width-group bucket-capacity overrides (measured
@@ -514,13 +545,16 @@ class CTRTrainer:
                 out = out + (g_params,)
             return out
 
-        if self.mesh is not None:
-            # P(axis) on the tables/rows tuples is a pytree PREFIX spec:
-            # every leaf of every group shards its leading dim over axis
-            # (replicated across slices on a multi-slice mesh — the push
-            # keeps the replicas bit-equal). Batch args shard over the
-            # full replica set (slice-major matches pack_sharded order).
-            dspec = P((dcn, axis)) if dcn else P(axis)
+        if self.mesh is None:
+            raise RuntimeError("CTRTrainer requires a mesh (1-device is a "
+                               "1-axis mesh)")
+        # P(axis) on the tables/rows tuples is a pytree PREFIX spec:
+        # every leaf of every group shards its leading dim over axis
+        # (replicated across slices on a multi-slice mesh — the push
+        # keeps the replicas bit-equal). Batch args shard over the
+        # full replica set (slice-major matches pack_sharded order).
+        dspec = P((dcn, axis)) if dcn else P(axis)
+        if k_steps == 1:
             out_specs = (P(axis), P(), P(), P(), P(), P())
             if mode == "async":
                 out_specs = out_specs + (P(),)
@@ -530,15 +564,75 @@ class CTRTrainer:
                           dspec, dspec, P()),
                 out_specs=out_specs,
                 check_vma=False)
-        else:
-            raise RuntimeError("CTRTrainer requires a mesh (1-device is a "
-                               "1-axis mesh)")
-        return jax.jit(body_sm, donate_argnums=(0, 1, 2, 3))
+            return jax.jit(body_sm, donate_argnums=(0, 1, 2, 3))
 
-    def _build_eval_step(self):
+        # K-step megastep: scan the per-step body over the stacked block
+        # INSIDE shard_map (collectives run per scan iteration exactly as
+        # in the K=1 program — the per-step op budget is unchanged ×K).
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        if mode == "async":
+            # The host dense table needs a pull/push around EVERY step;
+            # train_pass forces K=1 for this mode before building.
+            raise ValueError("steps_per_dispatch > 1 requires a device-"
+                             "side dense_sync_mode ('step'/'kstep'), "
+                             "not 'async'")
+        k_sync = max(1, self.config.dense_sync_interval)
+
+        def mega(tables, params, opt_state, auc, step0, n_active, rows,
+                 segments, labels, valid, dense_feats):
+            def scan_step(carry, xs):
+                tables_c, params_c, opt_c, auc_c = carry
+                ki, rows_k, segs_k, labels_k, valid_k, dense_k = xs
+                # Per-step sync_flag from the in-scan step counter: the
+                # SAME (global_step + 1) % interval the host computes on
+                # the K=1 path — a dense-sync boundary may fall anywhere
+                # inside a block.
+                if mode == "kstep":
+                    sync_flag = (((step0 + ki + 1) % k_sync) == 0
+                                 ).astype(jnp.int32)
+                else:
+                    sync_flag = jnp.zeros((), jnp.int32)
+                out = body(tables_c, params_c, opt_c, auc_c, rows_k,
+                           segs_k, labels_k, valid_k, dense_k, sync_flag)
+                new_tables, new_params, new_opt, new_auc = out[:4]
+                loss, overflow = out[4], out[5]
+                # Tail-block mask: padded steps (repeat of the last real
+                # batch) run the math but write NOTHING — carry passes
+                # through untouched, and their loss/overflow report as
+                # zero / finite so the per-block outputs stay clean.
+                active = ki < n_active
+                carry = (_tree_select(active, new_tables, tables_c),
+                         _tree_select(active, new_params, params_c),
+                         _tree_select(active, new_opt, opt_c),
+                         _tree_select(active, new_auc, auc_c))
+                return carry, (jnp.where(active, loss, 0.0),
+                               jnp.where(active, overflow,
+                                         jnp.zeros_like(overflow)),
+                               jnp.where(active, jnp.isfinite(loss), True))
+
+            ks = jnp.arange(k_steps, dtype=jnp.int32)
+            (tables, params, opt_state, auc), outs = lax.scan(
+                scan_step, (tables, params, opt_state, auc),
+                (ks, rows, segments, labels, valid, dense_feats))
+            losses, overflows, finites = outs
+            return tables, params, opt_state, auc, losses, overflows, finites
+
+        sdspec = P(None, (dcn, axis)) if dcn else P(None, axis)
+        mega_sm = jax.shard_map(
+            mega, mesh=self.mesh,
+            in_specs=(P(axis), P(), P(), P(), P(), P(), sdspec, sdspec,
+                      sdspec, sdspec, sdspec),
+            out_specs=(P(axis), P(), P(), P(), P(), P(), P()),
+            check_vma=False)
+        return jax.jit(mega_sm, donate_argnums=(0, 1, 2, 3))
+
+    def _build_eval_step(self, k_steps: int = 1):
         """Read-only twin of the train step: pull + forward + AUC, no
         pushes, no param updates (role of the AUC-runner test mode,
-        box_wrapper.h:900-989 / SetTestMode)."""
+        box_wrapper.h:900-989 / SetTestMode). ``k_steps > 1`` scans the
+        same body over a stacked [K, ...] block (one dispatch per K
+        eval steps), with the tail mask of the train megastep."""
         axis = self.axis
         dcn = self.dcn_axis
         raxes = (dcn, axis) if dcn else axis
@@ -557,13 +651,39 @@ class CTRTrainer:
             return auc, loss
 
         dspec = P((dcn, axis)) if dcn else P(axis)
-        body_sm = jax.shard_map(
-            body, mesh=self.mesh,
-            in_specs=(P(self.axis), P(), P(), dspec, dspec,
-                      dspec, dspec, dspec),
+        if k_steps == 1:
+            body_sm = jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(self.axis), P(), P(), dspec, dspec,
+                          dspec, dspec, dspec),
+                out_specs=(P(), P()),
+                check_vma=False)
+            return jax.jit(body_sm, donate_argnums=(2,))
+
+        def mega(tables, params, auc, n_active, rows, segments, labels,
+                 valid, dense_feats):
+            def scan_step(auc_c, xs):
+                ki, rows_k, segs_k, labels_k, valid_k, dense_k = xs
+                new_auc, loss = body(tables, params, auc_c, rows_k,
+                                     segs_k, labels_k, valid_k, dense_k)
+                active = ki < n_active
+                return (_tree_select(active, new_auc, auc_c),
+                        jnp.where(active, loss, 0.0))
+
+            ks = jnp.arange(k_steps, dtype=jnp.int32)
+            auc, losses = lax.scan(
+                scan_step, auc,
+                (ks, rows, segments, labels, valid, dense_feats))
+            return auc, losses
+
+        sdspec = P(None, (dcn, axis)) if dcn else P(None, axis)
+        mega_sm = jax.shard_map(
+            mega, mesh=self.mesh,
+            in_specs=(P(self.axis), P(), P(), P(), sdspec, sdspec,
+                      sdspec, sdspec, sdspec),
             out_specs=(P(), P()),
             check_vma=False)
-        return jax.jit(body_sm, donate_argnums=(2,))
+        return jax.jit(mega_sm, donate_argnums=(2,))
 
     def eval_pass(self, dataset: Dataset, *, feed_keys: bool = True
                   ) -> Dict[str, float]:
@@ -571,30 +691,49 @@ class CTRTrainer:
         as-is (no write-back, no new keys persisted, nothing dirtied)."""
         if self.params is None:
             raise RuntimeError("call init() first")
-        if self._eval_fn is None:
-            self._eval_fn = self._build_eval_step()
+        k_disp = max(1, int(flags.flag("trainer_steps_per_dispatch")))
+        if self._eval_fn is None or self._eval_k != k_disp:
+            self._eval_fn = self._build_eval_step(k_steps=k_disp)
+            self._eval_k = k_disp
         eng = self.engine
         if feed_keys:
             eng.feed_pass([dataset.pass_keys(slots=g.slots)
                            for g in eng.groups], readonly=True)
         tables = eng.begin_pass()
         auc = self._auc_init()
+        rep = (NamedSharding(self.mesh, P())
+               if self.mesh is not None else None)
         if self.mesh is not None:
-            auc = jax.device_put(auc, NamedSharding(self.mesh, P()))
-        losses: List[jax.Array] = []
+            auc = jax.device_put(auc, rep)
+        # Running device-side loss sum: no O(steps) retained arrays and
+        # no per-step host sync — one fetch at pass end.
+        loss_sum = None
+        nact_full = (_put_global(np.int32(k_disp), rep)
+                     if k_disp > 1 else None)
         nsteps = 0
         try:
-            for args in self._prefetch_batches(dataset):
-                rows, segs, labels, valid, dense = args
-                auc, loss = self._eval_fn(tables, self.params, auc, rows,
-                                          segs, labels, valid, dense)
-                losses.append(loss)
-                nsteps += 1
+            for args in self._prefetch_batches(dataset, k=k_disp):
+                if k_disp == 1:
+                    rows, segs, labels, valid, dense = args
+                    auc, loss = self._eval_fn(tables, self.params, auc,
+                                              rows, segs, labels, valid,
+                                              dense)
+                    n_active = 1
+                else:
+                    rows, segs, labels, valid, dense, n_active = args
+                    nact = (nact_full if n_active == k_disp
+                            else _put_global(np.int32(n_active), rep))
+                    auc, losses = self._eval_fn(tables, self.params, auc,
+                                                nact, rows, segs, labels,
+                                                valid, dense)
+                    loss = jnp.sum(losses)
+                loss_sum = loss if loss_sum is None else loss_sum + loss
+                nsteps += n_active
         finally:
             eng.abort_pass()
         stats = self._auc_stats(auc)
-        stats["loss"] = (float(jnp.mean(jnp.stack(losses)))
-                         if losses else float("nan"))
+        stats["loss"] = (float(loss_sum) / nsteps if nsteps
+                         else float("nan"))
         stats["steps"] = nsteps
         return stats
 
@@ -615,7 +754,7 @@ class CTRTrainer:
             self._sync_params_cache = sync
         return self._sync_params_cache
 
-    def _prefetch_batches(self, dataset: Dataset):
+    def _prefetch_batches(self, dataset: Dataset, k: int = 1):
         """Producer thread packs + host-maps batch k+1 while batch k's
         device step executes (role of the reference's pipelined batch
         packing + preload threads, MiniBatchGpuPack data_feed.cc:4611,
@@ -630,7 +769,17 @@ class CTRTrainer:
         slots (identity layout), so the producer reuses the previous
         device copy when the host bytes match instead of re-transferring
         ~2 MB per batch; dense features ship in the compute dtype (bf16
-        halves them under AMP)."""
+        halves them under AMP).
+
+        ``k > 1`` (FLAGS_trainer_steps_per_dispatch): the producer stacks
+        K packed batches into ONE leading-axis block — yields 6-tuples
+        ``(rows, segs, labels, valid, dense, n_active)`` with [K, ...]
+        device arrays for the scanned megastep. The segment cache works
+        on the stacked host arrays (consecutive full blocks of
+        fixed-length slots are still byte-identical) and a partial tail
+        block is padded by repeating the last real batch with
+        ``n_active < K`` (the scan masks the padding out). ``k == 1``
+        yields the legacy per-batch 5-tuples."""
         import queue
         import threading
 
@@ -649,15 +798,24 @@ class CTRTrainer:
                  if self.dcn_axis is not None else P(self.axis))
         data_sh = (NamedSharding(self.mesh, dspec)
                    if self.mesh is not None else None)
+        # Stacked blocks shard dim 1 (dim 0 is the K steps axis).
+        stk_spec = (P(None, (self.dcn_axis, self.axis))
+                    if self.dcn_axis is not None else P(None, self.axis))
+        stk_sh = (NamedSharding(self.mesh, stk_spec)
+                  if self.mesh is not None else None)
 
         def _dev(host):
             return _put_global(host, data_sh)
 
-        def _seg_dev(name: str, host: np.ndarray) -> jax.Array:
+        def _dev_stk(host):
+            return _put_global(host, stk_sh)
+
+        def _seg_dev(name: str, host: np.ndarray,
+                     put=None) -> jax.Array:
             hit = seg_cache.get(name)
             if hit is not None and np.array_equal(hit[0], host):
                 return hit[1]
-            dev = _dev(host)
+            dev = (put or _dev)(host)
             seg_cache[name] = (host.copy(), dev)
             return dev
 
@@ -670,22 +828,64 @@ class CTRTrainer:
                     continue
             return False
 
+        n_groups = len(self.engine.groups)
+
+        def _pack_host(batch):
+            dense_h = _concat_dense_host(batch)
+            if dense_bf16:
+                import ml_dtypes
+                dense_h = dense_h.astype(ml_dtypes.bfloat16)
+            return (self._map_batch_rows_host(batch),
+                    {n: batch.segments[n] for n in self._slot_names},
+                    batch.labels, batch.valid, dense_h)
+
+        def _stack_block(blk):
+            n_active = len(blk)
+            blk = blk + [blk[-1]] * (k - n_active)  # static-shape tail pad
+            rows = tuple(_dev_stk(np.stack([b[0][g] for b in blk]))
+                         for g in range(n_groups))
+            segs = {n: _seg_dev(n, np.stack([b[1][n] for b in blk]),
+                                put=_dev_stk)
+                    for n in self._slot_names}
+            return (rows, segs,
+                    _dev_stk(np.stack([b[2] for b in blk])),
+                    _dev_stk(np.stack([b[3] for b in blk])),
+                    _dev_stk(np.stack([b[4] for b in blk])),
+                    n_active)
+
         def producer():
+            buf: List[tuple] = []
             try:
                 for batch in dataset.batches_sharded(self.ndev):
+                    if k == 1:
+                        with self.timers.scope("host_map"):
+                            dense_h = _concat_dense_host(batch)
+                            if dense_bf16:
+                                import ml_dtypes
+                                dense_h = dense_h.astype(
+                                    ml_dtypes.bfloat16)
+                            args = (self._map_batch_rows(batch),
+                                    {n: _seg_dev(n, batch.segments[n])
+                                     for n in self._slot_names},
+                                    _dev(batch.labels),
+                                    _dev(batch.valid),
+                                    _dev(dense_h))
+                        if not _put(args):
+                            return  # consumer bailed early
+                        continue
                     with self.timers.scope("host_map"):
-                        dense_h = _concat_dense_host(batch)
-                        if dense_bf16:
-                            import ml_dtypes
-                            dense_h = dense_h.astype(ml_dtypes.bfloat16)
-                        args = (self._map_batch_rows(batch),
-                                {n: _seg_dev(n, batch.segments[n])
-                                 for n in self._slot_names},
-                                _dev(batch.labels),
-                                _dev(batch.valid),
-                                _dev(dense_h))
+                        buf.append(_pack_host(batch))
+                        args = (_stack_block(buf) if len(buf) == k
+                                else None)
+                        if args is not None:
+                            buf = []
+                    if args is not None and not _put(args):
+                        return
+                if buf:
+                    with self.timers.scope("host_map"):
+                        args = _stack_block(buf)
                     if not _put(args):
-                        return  # consumer bailed early
+                        return
             except BaseException as e:
                 _put(e)
                 return
@@ -706,22 +906,27 @@ class CTRTrainer:
             stop.set()
             t.join(timeout=60.0)
 
-    def _map_batch_rows(self, batch: SlotBatch) -> Tuple[jax.Array, ...]:
+    def _map_batch_rows_host(self, batch: SlotBatch) -> List[np.ndarray]:
         """Host map: batch feasigns → per-width-group fused device-row
-        arrays (role of CopyKeys' host side, one array per dim group)."""
-        dspec = (P((self.dcn_axis, self.axis))
-                 if self.dcn_axis is not None else P(self.axis))
-        data_sh = (NamedSharding(self.mesh, dspec)
-                   if self.mesh is not None else None)
+        arrays (role of CopyKeys' host side, one array per dim group) —
+        host side only, so the K-stacking prefetcher can np.stack K
+        batches before the one device transfer."""
         rows = []
         for gi, g in enumerate(self.engine.groups):
             all_ids = np.concatenate([batch.ids[n] for n in g.slots])
             r = self.engine.lookup_rows(gi, all_ids)
             # Interleave per-device: [dev, slot, cap_local] flatten.
-            h = _interleave_slots(r, list(g.slots), self._slot_caps,
-                                  self.ndev)
-            rows.append(_put_global(h, data_sh))
-        return tuple(rows)
+            rows.append(_interleave_slots(r, list(g.slots),
+                                          self._slot_caps, self.ndev))
+        return rows
+
+    def _map_batch_rows(self, batch: SlotBatch) -> Tuple[jax.Array, ...]:
+        dspec = (P((self.dcn_axis, self.axis))
+                 if self.dcn_axis is not None else P(self.axis))
+        data_sh = (NamedSharding(self.mesh, dspec)
+                   if self.mesh is not None else None)
+        return tuple(_put_global(h, data_sh)
+                     for h in self._map_batch_rows_host(batch))
 
     def export_serving(self, path: str) -> Dict[str, object]:
         """One-call serving export: the xbox sparse model (emb + w, no
@@ -777,7 +982,12 @@ class CTRTrainer:
                 caps.append(None)
                 continue
             block = t.rows_per_shard + 1
-            rr = np.asarray(r).reshape(self.ndev, -1)
+            # r is flat [n] (per-step) or stacked [K, n] (megastep first
+            # block): either way measure every (step, device) row set —
+            # the scanned fn compiles ONCE for the block, so its caps
+            # must cover the worst batch in it.
+            arr = np.asarray(r)
+            rr = arr.reshape(-1, arr.shape[-1] // self.ndev)
             worst = 1
             for d in range(rr.shape[0]):
                 vals = np.unique(rr[d]) if dedup else rr[d]
@@ -798,9 +1008,27 @@ class CTRTrainer:
         begin_pass/end_pass, SURVEY.md §3.1)."""
         if self.params is None:
             raise RuntimeError("call init() first")
-        if self._step_fn is None:
-            self._step_fn = self._build_step()
         eng = self.engine
+        mode = self.config.dense_sync_mode
+        k = max(1, self.config.dense_sync_interval)
+        profiling = bool(flags.flag("profile_trainer"))
+        check_nan = (self.config.check_nan_inf
+                     or flags.flag("check_nan_inf"))
+        # K-step megastep (FLAGS_trainer_steps_per_dispatch): one scanned
+        # XLA dispatch per K steps. Two configs force K=1: async dense
+        # sync needs a host pull/push around every step, and the profiler
+        # needs per-step dispatch boundaries to time.
+        k_disp = max(1, int(flags.flag("trainer_steps_per_dispatch")))
+        if k_disp > 1 and mode == "async":
+            log.vlog(0, "trainer_steps_per_dispatch=%d ignored: "
+                     "dense_sync_mode='async' pulls/pushes the host dense "
+                     "table around every step — running K=1", k_disp)
+            k_disp = 1
+        if k_disp > 1 and profiling:
+            log.vlog(0, "trainer_steps_per_dispatch=%d ignored under "
+                     "FLAGS_profile_trainer (per-step timing needs "
+                     "per-step dispatch) — running K=1", k_disp)
+            k_disp = 1
         if feed_keys:
             with self.timers.scope("feed_pass"):
                 eng.feed_pass([dataset.pass_keys(slots=g.slots)
@@ -808,8 +1036,6 @@ class CTRTrainer:
         tables = eng.begin_pass()
         params, opt_state = self.params, self.opt_state
         auc = self.auc_state
-        mode = self.config.dense_sync_mode
-        k = max(1, self.config.dense_sync_interval)
         if mode == "async" and self._async_dense is None:
             from paddlebox_tpu.train.async_dense import AsyncDenseTable
             self._async_dense = AsyncDenseTable(
@@ -822,12 +1048,44 @@ class CTRTrainer:
         # collectives under jax.distributed) racing the prefetch thread's.
         flags_01 = (_put_global(np.int32(0), rep),
                     _put_global(np.int32(1), rep))
-        losses: List[float] = []
-        overflows: List[jax.Array] = []
+        nact_full = (_put_global(np.int32(k_disp), rep)
+                     if k_disp > 1 else None)
+        # Device-side running sums: the pass keeps TWO device scalars
+        # alive instead of O(steps) retained loss/overflow arrays, and
+        # nothing here blocks the dispatch pipeline.
+        loss_sum = None
+        overflow_sum = None
         group_n: Optional[List[int]] = None
+        first_batch_dup = None
         nsteps = 0
-        for args in self._prefetch_batches(dataset):
-            rows, segs, labels, valid, dense = args
+        self._dispatch_blocks = 0
+        self._host_syncs = 0
+        if self._debug_collect_losses:
+            self._debug_losses = []
+        # check_nan_inf without the per-step float(loss) sync: each
+        # dispatch also yields a device-side finite-ness vector; the host
+        # fetches block i-1's verdict while block i executes (one sync
+        # per BLOCK, one block late mid-pass, exact at pass end).
+        pending_finite = None
+
+        def _check_pending():
+            nonlocal pending_finite
+            if pending_finite is None:
+                return
+            base, fin, na = pending_finite
+            pending_finite = None
+            self._host_syncs += 1
+            fv = np.asarray(fin)[:na]
+            if not fv.all():
+                bad = base + int(np.argmin(fv)) + 1
+                raise FloatingPointError(f"NaN/Inf loss at step {bad}")
+
+        for args in self._prefetch_batches(dataset, k=k_disp):
+            if k_disp == 1:
+                rows, segs, labels, valid, dense = args
+                n_active = 1
+            else:
+                rows, segs, labels, valid, dense, n_active = args
             if group_n is None:
                 # Per-device id count per width group — static across the
                 # pass, feeds the exchange-bytes observable below. The
@@ -836,15 +1094,17 @@ class CTRTrainer:
                 # FLAGS_embedding_unique_frac could reclaim: dedup means
                 # bucket cells hold UNIQUE ids, so unique_frac can drop
                 # toward 1/duplication before overflow risk returns.
-                group_n = [int(r.shape[0]) // max(self.ndev, 1)
+                group_n = [int(r.shape[-1]) // max(self.ndev, 1)
                            for r in rows]
-                first_batch_dup = None
                 addressable = all(getattr(r, "is_fully_addressable", True)
                                   for r in rows)
                 if addressable:
-                    occ = sum(int(r.shape[0]) for r in rows)
-                    uniq = sum(len(np.unique(np.asarray(r)))
-                               for r in rows)
+                    # Duplication is a first-BATCH signal: slice step 0
+                    # out of a stacked [K, n] block.
+                    firsts = [np.asarray(r)[0] if k_disp > 1
+                              else np.asarray(r) for r in rows]
+                    occ = sum(int(f.shape[0]) for f in firsts)
+                    uniq = sum(len(np.unique(f)) for f in firsts)
                     first_batch_dup = occ / max(uniq, 1)
                 if addressable and flags.flag("embedding_auto_capacity"):
                     # Measured capacity (pow2-bucketed): size each
@@ -863,10 +1123,13 @@ class CTRTrainer:
                         for i, c in enumerate(meas))
                     if merged != cur:
                         self._step_caps = merged
-                        self._step_fn = self._build_step(caps=merged)
+                        self._step_fn = None
+                        self._mega_fn = None
                         log.vlog(0, "auto-capacity: bucket caps %s "
-                                 "(measured from first batch)",
-                                 list(merged))
+                                 "(measured from first %s)",
+                                 list(merged),
+                                 "stacked block" if k_disp > 1
+                                 else "batch")
                 else:
                     if (flags.flag("embedding_auto_capacity")
                             and not addressable
@@ -885,38 +1148,78 @@ class CTRTrainer:
                         # Flag turned off (or data not addressable):
                         # drop back to the default-capacity step.
                         self._step_caps = None
-                        self._step_fn = self._build_step()
+                        self._step_fn = None
+                        self._mega_fn = None
+                # Build (or reuse) the compiled fn for this pass's K —
+                # AFTER the capacity measurement above, so the scanned
+                # megastep is traced at the measured caps (caps only
+                # ratchet up; a steady-state pass reuses the warm fn).
+                if k_disp == 1:
+                    if self._step_fn is None:
+                        self._step_fn = self._build_step(
+                            caps=self._step_caps)
+                elif self._mega_fn is None or self._mega_k != k_disp:
+                    self._mega_fn = self._build_step(
+                        caps=self._step_caps, k_steps=k_disp)
+                    self._mega_k = k_disp
             if mode == "async":
                 # PullDense role: freshest host params each step.
                 params = jax.device_put(self._async_dense.pull_dense(), rep)
-            sync_flag = flags_01[
-                1 if (mode == "kstep" and (nsteps + 1) % k == 0) else 0]
-            profiling = bool(flags.flag("profile_trainer"))
+            block_base = nsteps
             with self.timers.scope("device_step"):
-                out = self._step_fn(
-                    tables, params, opt_state, auc, rows, segs,
-                    labels, valid, dense, sync_flag)
-                tables, params, opt_state, auc, loss, overflow = out[:6]
-                if profiling:
-                    # Completion INSIDE the scope so device_step records
-                    # the real step wall time, not async dispatch.
-                    # Profiling trades the pipelining away on purpose
-                    # (TrainFilesWithProfiler does the same).
-                    float(loss)
+                if k_disp == 1:
+                    sync_flag = flags_01[
+                        1 if (mode == "kstep" and (nsteps + 1) % k == 0)
+                        else 0]
+                    out = self._step_fn(
+                        tables, params, opt_state, auc, rows, segs,
+                        labels, valid, dense, sync_flag)
+                    tables, params, opt_state, auc, loss, overflow = out[:6]
+                    blk_losses, blk_overflow = loss, overflow
+                    if profiling:
+                        # Completion INSIDE the scope so device_step
+                        # records the real step wall time, not async
+                        # dispatch. Profiling trades the pipelining away
+                        # on purpose (TrainFilesWithProfiler does the
+                        # same).
+                        float(loss)
+                else:
+                    # ONE dispatch runs n_active steps; the in-scan step
+                    # counter starts at this block's first global step.
+                    step0 = _put_global(np.int32(nsteps), rep)
+                    nact = (nact_full if n_active == k_disp
+                            else _put_global(np.int32(n_active), rep))
+                    out = self._mega_fn(
+                        tables, params, opt_state, auc, step0, nact,
+                        rows, segs, labels, valid, dense)
+                    (tables, params, opt_state, auc, blk_losses,
+                     blk_overflows, blk_finites) = out
+                    blk_overflow = jnp.sum(blk_overflows)
+            self._dispatch_blocks += 1
             if mode == "async":
                 # PushDense role: hand psum'd grads to the host updater.
                 self._async_dense.push_dense(jax.device_get(out[6]))
-            nsteps += 1
-            if profiling:
+            nsteps += n_active
+            if profiling and k_disp == 1:
                 log.vlog(0, "step %d: loss=%.5f %s", nsteps, float(loss),
                          self.timers.report())
-            if self.config.check_nan_inf or flags.flag("check_nan_inf"):
-                lf = float(loss)
-                if not np.isfinite(lf):
-                    raise FloatingPointError(
-                        f"NaN/Inf loss at step {nsteps}")
-            losses.append(loss)
-            overflows.append(overflow)
+            blk_loss = (blk_losses if k_disp == 1
+                        else jnp.sum(blk_losses))
+            loss_sum = blk_loss if loss_sum is None else loss_sum + blk_loss
+            overflow_sum = (blk_overflow if overflow_sum is None
+                            else overflow_sum + blk_overflow)
+            if self._debug_collect_losses:
+                self._debug_losses.append((block_base, blk_losses,
+                                           n_active))
+            if check_nan:
+                # Fetch block i-1's verdict while block i executes —
+                # the device never idles waiting on the host check.
+                _check_pending()
+                fin = (jnp.isfinite(blk_losses).reshape(1)
+                       if k_disp == 1 else blk_finites)
+                pending_finite = (block_base, fin, n_active)
+        if check_nan:
+            _check_pending()
         if mode == "kstep" and nsteps % k != 0:
             # Pass boundary: leave params synchronized regardless of
             # where the last sync fell (the reference's pass-end
@@ -930,10 +1233,14 @@ class CTRTrainer:
         with self.timers.scope("end_pass"):
             eng.end_pass()
         stats = self._auc_stats(self.auc_state)
-        stats["loss"] = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+        stats["loss"] = (float(loss_sum) / nsteps if nsteps
+                         else float("nan"))
         stats["steps"] = nsteps
+        stats["steps_per_dispatch"] = k_disp
+        stats["dispatch_blocks"] = self._dispatch_blocks
+        stats["host_syncs"] = self._host_syncs
         stats["lookup_overflow"] = (
-            int(jnp.sum(jnp.stack(overflows))) if overflows else 0)
+            int(overflow_sum) if overflow_sum is not None else 0)
         # Static per-device all-to-all bytes for one pull+push round —
         # what dedup + FLAGS_embedding_unique_frac shrink (the dedup-
         # before-exchange observable; heter_comm.h:192 transfers merged
@@ -986,6 +1293,13 @@ def _interleave_slots(rows_concat: np.ndarray, names: List[str],
         for n in names:
             parts.append(per_slot[n][d])
     return np.concatenate(parts)
+
+
+def _tree_select(pred, new, old):
+    """Per-leaf ``where(pred, new, old)`` over matching pytrees — the
+    megastep's tail mask (a padded scan step computes ``new`` but must
+    leave the carried state byte-identical to ``old``)."""
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new, old)
 
 
 def _put_global(host, sharding) -> jax.Array:

@@ -32,6 +32,10 @@ def test_full_ctr_step_aot_compiles_for_tpu():
     out = _run_tool("aot_check_step.py", 900)
     assert "FULL-STEP TPU AOT COMPILE: OK" in out
     assert "EVAL-STEP TPU AOT COMPILE: OK" in out
+    # K-step scanned megastep (train + eval), Pallas kernels inside the
+    # scan body, through the same Mosaic pipeline.
+    assert "MEGASTEP(K=4) TPU AOT COMPILE: OK" in out
+    assert "MEGASTEP-EVAL(K=4) TPU AOT COMPILE: OK" in out
 
 
 @pytest.mark.slow

@@ -1,0 +1,121 @@
+"""FLAGS_embedding_exchange_dtype: reduced-precision all_to_all wire.
+
+The pull-reply and push-grad payloads may cross the ICI as bf16
+(EQuARX-style quantized exchange — PAPERS.md) while every accumulation
+stays f32: grads merge sender-side in f32 (the bucket scatter-add),
+ride the wire in bf16, and widen back before the owner-side
+accumulate. Pins: (1) 'f32' is BIT-identical to the pre-flag behavior
+(the cast path must be a no-op, not a f32->f32 convert), (2) 'bf16'
+matches within bf16 tolerance, (3) the exchange-bytes observable
+reflects the halved payload, (4) unknown values fail loudly.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.embedding import (SparseAdagrad, TableConfig,
+                                     make_pull_fn, make_push_fn)
+from paddlebox_tpu.embedding.lookup import exchange_bytes
+from paddlebox_tpu.embedding.table import (build_pass_table_host,
+                                           extract_pass_values_host,
+                                           map_keys_to_rows)
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+
+DIM = 8
+CFG = TableConfig(dim=DIM, learning_rate=0.1, initial_g2sum=1.0)
+
+
+def _setup(seed=3, n_keys=60, n_ids=128, nshards=8):
+    rng = np.random.default_rng(seed)
+    vals = {
+        "emb": rng.normal(size=(n_keys, DIM)).astype(np.float32),
+        "emb_state": np.zeros((n_keys, 1), np.float32),
+        "w": rng.normal(size=(n_keys,)).astype(np.float32),
+        "w_state": np.zeros((n_keys, 1), np.float32),
+        "show": np.zeros((n_keys,), np.float32),
+        "click": np.zeros((n_keys,), np.float32),
+    }
+    keys = np.sort(rng.choice(np.arange(1, 100_000, dtype=np.uint64),
+                              n_keys, replace=False))
+    batch_keys = rng.choice(keys, n_ids).astype(np.uint64)
+    table = build_pass_table_host(vals, nshards, CFG)
+    rows = map_keys_to_rows(keys, batch_keys, table.rows_per_shard,
+                            num_shards=nshards)
+    mesh = build_mesh(HybridTopology(dp=nshards))
+    g_emb = rng.normal(size=(n_ids, DIM)).astype(np.float32)
+    g_w = rng.normal(size=(n_ids,)).astype(np.float32)
+    ones = np.ones((n_ids,), np.float32)
+    return table, mesh, jnp.asarray(rows), (g_emb, g_w, ones), n_keys
+
+
+def _pull_push(mode):
+    table, mesh, rows, (g_emb, g_w, ones), n_keys = _setup()
+    prev = flagmod.flag("embedding_exchange_dtype")
+    flagmod.set_flags({"embedding_exchange_dtype": mode})
+    try:
+        pulled = make_pull_fn(mesh, "dp")(table, rows)
+        new_table = make_push_fn(mesh, "dp", SparseAdagrad.from_config(
+            CFG))(table, rows, jnp.asarray(g_emb), jnp.asarray(g_w),
+                  jnp.asarray(ones), jnp.asarray(ones))
+    finally:
+        flagmod.set_flags({"embedding_exchange_dtype": prev})
+    return (np.asarray(pulled["emb"]), np.asarray(pulled["w"]),
+            extract_pass_values_host(new_table, n_keys))
+
+
+def test_f32_wire_is_bit_identical_to_default():
+    """Explicit 'f32' == the default path, bitwise — the flag code must
+    not add so much as a convert on the exact path."""
+    emb_d, w_d, pushed_d = _pull_push("f32")
+    emb_2, w_2, pushed_2 = _pull_push("f32")
+    np.testing.assert_array_equal(emb_d, emb_2)
+    np.testing.assert_array_equal(w_d, w_2)
+    for f in pushed_d:
+        np.testing.assert_array_equal(pushed_d[f], pushed_2[f],
+                                      err_msg=f"field {f}")
+
+
+def test_bf16_wire_parity_within_tolerance():
+    emb_f, w_f, pushed_f = _pull_push("f32")
+    emb_b, w_b, pushed_b = _pull_push("bf16")
+    # bf16 has ~8 mantissa bits: 2^-8 relative on the wire values.
+    np.testing.assert_allclose(emb_b, emb_f, rtol=8e-3, atol=8e-3)
+    np.testing.assert_allclose(w_b, w_f, rtol=8e-3, atol=8e-3)
+    for f in pushed_f:
+        np.testing.assert_allclose(
+            pushed_b[f], pushed_f[f], rtol=2e-2, atol=2e-2,
+            err_msg=f"field {f}")
+    # ...and the quantization actually happened (values differ, so a
+    # future refactor can't silently drop the cast and keep passing).
+    assert not np.array_equal(emb_b, emb_f)
+
+
+def test_exchange_bytes_tracks_wire_dtype():
+    table, _, rows, _, _ = _setup()
+    n = int(rows.shape[0])
+    prev = flagmod.flag("embedding_exchange_dtype")
+    try:
+        flagmod.set_flags({"embedding_exchange_dtype": "f32"})
+        b_f32 = exchange_bytes(table, n)
+        flagmod.set_flags({"embedding_exchange_dtype": "bf16"})
+        b_bf16 = exchange_bytes(table, n)
+    finally:
+        flagmod.set_flags({"embedding_exchange_dtype": prev})
+    assert b_bf16 < b_f32
+    # Only the payload halves — the two int32 row exchanges don't — so
+    # the ratio sits strictly between 0.5 and 1, near 0.5 at this width.
+    ratio = b_bf16 / b_f32
+    assert 0.5 < ratio < 0.62, ratio
+
+
+def test_unknown_exchange_dtype_raises():
+    table, mesh, rows, _, _ = _setup()
+    prev = flagmod.flag("embedding_exchange_dtype")
+    flagmod.set_flags({"embedding_exchange_dtype": "fp8"})
+    try:
+        with pytest.raises(ValueError, match="embedding_exchange_dtype"):
+            make_pull_fn(mesh, "dp")(table, rows)
+    finally:
+        flagmod.set_flags({"embedding_exchange_dtype": prev})

@@ -24,7 +24,7 @@ from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
 from paddlebox_tpu.utils import inspect as pbx_inspect
 
 
-def _step_op_counts(ndev=4):
+def _trainer_and_batch(ndev=4):
     mesh = build_mesh(HybridTopology(dp=ndev),
                       devices=jax.devices()[:ndev])
     slots = tuple(SlotConf(f"s{i}", avg_len=2.0) for i in range(3))
@@ -44,6 +44,11 @@ def _step_op_counts(ndev=4):
     tr.engine.feed_pass([
         np.unique(np.concatenate([batch.ids[n] for n in g.slots]))
         for g in tr.engine.groups])
+    return tr, batch
+
+
+def _step_op_counts(ndev=4):
+    tr, batch = _trainer_and_batch(ndev)
     step = tr._build_step()
     tables = tr.engine.begin_pass()
     rows = tr._map_batch_rows(batch)
@@ -83,6 +88,38 @@ def test_ctr_step_collective_and_scatter_budget():
     # the reference's dedup itself is 2x cub radix sort,
     # heter_comm.h:196-205; the Pallas accumulate's internal sort lives
     # behind the TPU-only flag and is not part of this CPU lowering).
+    assert c.get("sort", 0) == 0, c
+    assert c.get("cumsum", 0) >= 1, c
+
+
+def test_ctr_megastep_one_scan_unchanged_per_step_budget():
+    """The K-step megastep (FLAGS_trainer_steps_per_dispatch) must be
+    ONE lax.scan wrapping the SAME per-step body: exactly one scan in
+    the program, and the per-step collective / scatter / sort budgets
+    of the K=1 pins above unchanged — jaxpr_summary counts the scan
+    body ONCE, so any number here growing with K means ops leaked out
+    of the scan (paid per block) or multiplied inside it."""
+    K = 4
+    tr, batch = _trainer_and_batch()
+    mega = tr._build_step(k_steps=K)
+    tables = tr.engine.begin_pass()
+    rows = tuple(jnp.stack([r] * K) for r in tr._map_batch_rows(batch))
+    segs = {n: jnp.stack([jnp.asarray(batch.segments[n])] * K)
+            for n in batch.ids}
+    stack = lambda x: jnp.stack([jnp.asarray(x)] * K)  # noqa: E731
+    args = (tables, tr.params, tr.opt_state, tr.auc_state,
+            jnp.zeros((), jnp.int32), jnp.asarray(K, jnp.int32),
+            rows, segs, stack(batch.labels), stack(batch.valid),
+            stack(_concat_dense_host(batch)))
+    c = pbx_inspect.jaxpr_summary(lambda *a: mega(*a), *args)
+    assert c.get("scan", 0) == 1, c
+    # Per-step budgets identical to test_ctr_step_collective_and_
+    # scatter_budget — the scan re-stages the body, it must not reshape
+    # it (an extra all_to_all or scatter here costs K× per block).
+    assert c.get("all_to_all", 0) == 3, c
+    assert (c.get("scatter-add", 0) + c.get("scatter", 0)) <= 12, c
+    assert c.get("scatter-min", 0) <= 1, c
+    assert c.get("gather", 0) <= 12, c
     assert c.get("sort", 0) == 0, c
     assert c.get("cumsum", 0) >= 1, c
 

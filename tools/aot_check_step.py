@@ -121,6 +121,34 @@ def main() -> None:
     eval_step.lower(*sds_like(eval_args)).compile()
     print("EVAL-STEP TPU AOT COMPILE: OK")
 
+    # K-step scanned megastep (FLAGS_trainer_steps_per_dispatch=4):
+    # the lax.scan wrapper + donation + both Pallas kernels INSIDE the
+    # scan body must survive the real XLA:TPU + Mosaic pipeline —
+    # compile-only shape stand-ins with the stacked [K, ...] leading
+    # axis the prefetcher produces.
+    K = 4
+
+    def stk(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                (K,) + tuple(np.shape(x)), jnp.asarray(x).dtype), tree)
+
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    mega = tr._build_step(k_steps=K)
+    mega_args = (*sds_like((tables, tr.params, tr.opt_state,
+                            tr.auc_state)), i32, i32,
+                 stk(rows), stk(segs_j), stk(batch_obj.labels),
+                 stk(batch_obj.valid), stk(dense_j))
+    mega.lower(*mega_args).compile()
+    print(f"MEGASTEP(K={K}) TPU AOT COMPILE: OK")
+
+    mega_eval = tr._build_eval_step(k_steps=K)
+    mega_eval_args = (*sds_like((tables, tr.params, tr.auc_state)), i32,
+                      stk(rows), stk(segs_j), stk(batch_obj.labels),
+                      stk(batch_obj.valid), stk(dense_j))
+    mega_eval.lower(*mega_eval_args).compile()
+    print(f"MEGASTEP-EVAL(K={K}) TPU AOT COMPILE: OK")
+
 
 if __name__ == "__main__":
     main()

@@ -57,6 +57,23 @@ def main():
 
     print(f"shapes: table [{N_ROWS},{D}] ids [{n}]")
 
+    # Dispatch-latency probe (empty-step RTT): one trivial jitted
+    # program, dispatched AND synced per iteration — the pure host-side
+    # enqueue + completion round-trip with ~zero device work. This is
+    # the per-step overhead FLAGS_trainer_steps_per_dispatch amortizes
+    # (K steps ride one dispatch, so the hot loop pays RTT/K); on the
+    # axon tunnel it has been the step's hidden floor.
+    tiny = jnp.zeros((8,), jnp.float32)
+    empty = jax.jit(lambda x: x + 1.0)
+    np.asarray(empty(tiny))  # compile + warm
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        np.asarray(empty(tiny))
+    t = (time.perf_counter() - t0) / iters
+    print(f"empty-step dispatch RTT      {t*1e3:8.2f} ms "
+          f"(amortized by steps_per_dispatch)")
+
     t = timeit(jax.jit(lambda r: jnp.argsort(r)), rows)
     print(f"argsort[{n}]                 {t*1e3:8.2f} ms")
 
