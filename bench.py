@@ -50,12 +50,17 @@ _SMALL = os.environ.get("PBX_BENCH_SCALE") == "small"
 # ---------------------------------------------------------------------------
 
 _WD = {"t": time.monotonic(), "t0": time.monotonic(),
-       "phase": "import-jax", "device_alive": False}
+       "phase": "import-jax", "device_alive": False, "trace": None}
 
 
 def _tick(phase: str) -> None:
     _WD["t"] = time.monotonic()
     _WD["phase"] = phase
+    tr = _WD["trace"]
+    if tr is not None and tr.enabled:
+        # Phase transitions land in the span-tracer ring, so a stall
+        # dump's trace_tail shows the path INTO the hung phase.
+        tr.instant("bench/" + phase)
 
 
 def _watchdog_loop() -> None:
@@ -74,6 +79,16 @@ def _watchdog_loop() -> None:
         limit = late if _WD["device_alive"] else early
         if now - _WD["t"] > limit:
             name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
+            # Stall forensics (the r05 lesson: "no progress in phase
+            # 'device-probe'" with nothing else is undiagnosable):
+            # every thread's Python stack + the trace ring tail ride
+            # in the failure JSON, so the post-mortem names the frame
+            # blocked on the tunnel, not just the phase.
+            try:
+                from paddlebox_tpu.core.trace import stall_forensics
+                tail = stall_forensics()
+            except Exception as e:  # noqa: BLE001 - keep the record
+                tail = {"error": f"forensics unavailable: {e!r}"}
             print(json.dumps({
                 "metric": f"{name}_FAILED",
                 "value": 0.0,
@@ -82,7 +97,8 @@ def _watchdog_loop() -> None:
                 "error": (f"watchdog: no progress in phase "
                           f"{_WD['phase']!r} for {limit:.0f}s — "
                           f"device backend stall (axon tunnel?)"),
-            }), flush=True)
+                "tail": tail,
+            }, default=str), flush=True)
             os._exit(3)
 
 
@@ -95,9 +111,20 @@ if os.environ.get("PBX_BENCH_WATCHDOG", "1") != "0":
 # compiles over the flaky tunnel — cached executables make every attempt
 # after the first cheap. (core.flags imports no jax; safe pre-import.)
 from paddlebox_tpu.core import flags
+from paddlebox_tpu.core import report as _report
+from paddlebox_tpu.core import trace as _trace
 from paddlebox_tpu.core.flags import enable_compilation_cache
 
 _CACHE_DIR = enable_compilation_cache()
+
+# Telemetry: arm the flag-configured sinks (FLAGS_trace_path /
+# FLAGS_metrics_path), then ALWAYS keep the span-tracer ring on for the
+# bench — phases and pass spans cost ~1 µs each here, and they are the
+# watchdog's stall-forensics timeline (ring-only: no file is written
+# unless FLAGS_trace_path asks for one).
+_report.init_telemetry_from_flags()
+_trace.GLOBAL.enable()
+_WD["trace"] = _trace.GLOBAL
 
 import jax
 

@@ -1,13 +1,18 @@
-"""Core runtime: flags, logging, monitors, timers.
+"""Core runtime: flags, logging, monitors, timers, tracing, reports.
 
 Role of the reference's platform layer (``paddle/fluid/platform/``):
-gflags (``flags.cc``), glog VLOG, ``platform/monitor.h`` named counters,
-``platform::Timer`` hot-path timers.
+gflags (``flags.cc``), glog VLOG, ``platform/monitor.h`` named counters
+(grown into a counters/gauges/histograms registry with a JSONL
+exporter), ``platform::Timer`` hot-path timers, plus the span tracer +
+pass report that replace ad-hoc ``PrintSyncTimer`` prints (see
+OBSERVABILITY.md).
 """
 
 from paddlebox_tpu.core import flags
 from paddlebox_tpu.core import log
 from paddlebox_tpu.core import monitor
+from paddlebox_tpu.core import report
 from paddlebox_tpu.core import timers
+from paddlebox_tpu.core import trace
 
-__all__ = ["flags", "log", "monitor", "timers"]
+__all__ = ["flags", "log", "monitor", "report", "timers", "trace"]

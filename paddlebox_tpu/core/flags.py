@@ -293,3 +293,21 @@ define_flag("auc_num_buckets", 1 << 20,
 define_flag("profile_trainer", False,
             "per-op/per-stage timing in the trainer hot loop "
             "(role of TrainFilesWithProfiler)")
+define_flag("trace_path", "",
+            "write a chrome://tracing / Perfetto-loadable span trace to "
+            "this path (empty = tracing off, the default; spans wrap "
+            "host stage/dispatch/fetch boundaries only — never ops "
+            "inside the jitted step). Exported at process exit and on "
+            "core.trace.export()")
+define_flag("trace_ring_events", 65536,
+            "bounded ring-buffer capacity of the span tracer (oldest "
+            "events drop first; bounds host memory on multi-hour runs "
+            "and sizes the stall-forensics tail)")
+define_flag("metrics_path", "",
+            "append metric-registry snapshots (counters / gauges / "
+            "histograms) as JSON lines to this path (empty = exporter "
+            "off, the default). One line per pass report plus the "
+            "periodic flush thread")
+define_flag("metrics_flush_interval_s", 30.0,
+            "period of the metrics JSONL background flush thread "
+            "(<= 0 disables the thread; pass reports still append)")

@@ -1,40 +1,228 @@
-"""Named global counters.
+"""Process-global metric registry: counters, gauges, histograms.
 
 Role of ``paddle/fluid/platform/monitor.h`` (``platform::Monitor`` /
-``StatRegistry`` named int64 stats, e.g. GPU memory counters). Thread-safe,
-process-global, cheap to bump from the data pipeline and trainer threads.
+``StatRegistry`` named int64 stats) grown into a full registry: int
+counters (the original API, unchanged), FLOAT gauges (so rate/ratio call
+sites don't silently truncate through the int counter path), and
+fixed-bucket histograms (step/dispatch latency distributions).
+
+Thread-safe and cheap to bump from the data pipeline, trainer, and RPC
+threads. A labeled ``snapshot_all()`` returns one structured view; the
+JSONL exporter appends snapshot lines to ``FLAGS_metrics_path`` — one
+per pass report plus a periodic background flush thread
+(``FLAGS_metrics_flush_interval_s``). Telemetry is default-off: with no
+metrics path configured nothing is written and the flush thread never
+starts.
 """
 
 from __future__ import annotations
 
+import bisect
+import json
 import threading
-from typing import Dict
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+Number = Union[int, float]
+
+# Default latency buckets (ms): exponential-ish 1ms..30s — wide enough
+# for both a CPU smoke step and an axon-tunnel dispatch stall.
+DEFAULT_BUCKETS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+                   1000.0, 2000.0, 5000.0, 10000.0, 30000.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram: counts per bucket + running sum/min/max.
+
+    Buckets are upper bounds; values above the last bound land in the
+    implicit +inf bucket. Percentiles are estimated from bucket counts
+    by tools/trace_report.py — the registry itself stores only O(len
+    (buckets)) state no matter how many observations arrive."""
+
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.buckets = tuple(float(b) for b in buckets)
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(f"histogram buckets must be strictly "
+                             f"increasing: {buckets}")
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.count += 1
+        self.sum += v
+        self.min = v if v < self.min else self.min
+        self.max = v if v > self.max else self.max
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"buckets": list(self.buckets),
+                "counts": list(self.counts),
+                "count": self.count,
+                "sum": round(self.sum, 6),
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None}
 
 
 class Monitor:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._stats: Dict[str, int] = {}
+        self._stats: Dict[str, Number] = {}        # counters (add/set)
+        self._gauges: Dict[str, float] = {}        # float set-last-wins
+        self._hists: Dict[str, Histogram] = {}
+        self._flush_thread: Optional[threading.Thread] = None
+        self._flush_stop = threading.Event()
+        self._flush_path: Optional[str] = None
 
-    def add(self, name: str, delta: int = 1) -> None:
+    # -- counters (original StatRegistry API, unchanged) -------------------
+
+    def add(self, name: str, delta: Number = 1) -> None:
         with self._lock:
             self._stats[name] = self._stats.get(name, 0) + delta
 
-    def set(self, name: str, value: int) -> None:
+    def set(self, name: str, value: Number) -> None:
         with self._lock:
             self._stats[name] = value
 
-    def get(self, name: str) -> int:
+    def get(self, name: str) -> Number:
         with self._lock:
             return self._stats.get(name, 0)
 
-    def snapshot(self) -> Dict[str, int]:
+    # -- gauges ------------------------------------------------------------
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Float gauge: last-write-wins (rates, ratios, ms figures —
+        values the int counter path would truncate)."""
         with self._lock:
-            return dict(self._stats)
+            self._gauges[name] = float(value)
+
+    def get_gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    # -- histograms ---------------------------------------------------------
+
+    def define_histogram(self, name: str,
+                         buckets: Sequence[float] = DEFAULT_BUCKETS
+                         ) -> None:
+        """Pre-declare a histogram's buckets (idempotent for identical
+        buckets; re-defining with different ones raises — silently
+        changing bucket bounds mid-run would corrupt the series)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                self._hists[name] = Histogram(buckets)
+            elif h.buckets != tuple(float(b) for b in buckets):
+                raise ValueError(
+                    f"histogram {name!r} already defined with buckets "
+                    f"{h.buckets}")
+
+    def observe(self, name: str, value: float,
+                buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(buckets)
+            h.observe(value)
+
+    # -- snapshots -----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Number]:
+        """Flat counters+gauges view (the original API shape — existing
+        call sites and tests keep working)."""
+        with self._lock:
+            out: Dict[str, Number] = dict(self._stats)
+            out.update(self._gauges)
+            return out
+
+    def snapshot_all(self, labels: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+        """One labeled structured snapshot — the JSONL export record."""
+        with self._lock:
+            return {
+                "ts": time.time(),
+                "labels": dict(labels or {}),
+                "counters": dict(self._stats),
+                "gauges": dict(self._gauges),
+                "histograms": {n: h.to_dict()
+                               for n, h in self._hists.items()},
+            }
 
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    # -- JSONL exporter -------------------------------------------------------
+
+    def flush_jsonl(self, path: Optional[str] = None,
+                    labels: Optional[Dict[str, Any]] = None
+                    ) -> Optional[str]:
+        """Append one snapshot line to ``path`` (default: the configured
+        ``FLAGS_metrics_path``). No-op (returns None) when neither is
+        set — callers sprinkle this freely without gating."""
+        if path is None:
+            path = self._flush_path
+            if path is None:
+                from paddlebox_tpu.core import flags
+                path = flags.flag("metrics_path") or None
+        if not path:
+            return None
+        line = json.dumps(self.snapshot_all(labels), default=str)
+        with open(path, "a") as f:
+            f.write(line + "\n")
+        return path
+
+    def start_flush_thread(self, path: str,
+                           interval_s: float = 30.0) -> bool:
+        """Periodic background JSONL flusher (daemon). Idempotent; a
+        non-positive interval means 'no thread' (pass-report flushes
+        still append)."""
+        with self._lock:
+            self._flush_path = path
+            if interval_s <= 0 or (self._flush_thread is not None
+                                   and self._flush_thread.is_alive()):
+                return self._flush_thread is not None
+            self._flush_stop.clear()
+
+            def loop():
+                while not self._flush_stop.wait(interval_s):
+                    try:
+                        self.flush_jsonl(path)
+                    except OSError:
+                        pass
+
+            self._flush_thread = threading.Thread(
+                target=loop, name="metrics-flush", daemon=True)
+            self._flush_thread.start()
+            return True
+
+    def stop_flush_thread(self) -> None:
+        """Stop the flusher AND disarm the configured path (tests and
+        shutdown paths use this to fully de-configure the exporter)."""
+        t = self._flush_thread
+        self._flush_stop.set()
+        if t is not None:
+            t.join(timeout=5.0)
+        self._flush_thread = None
+        self._flush_path = None
+
+    def init_from_flags(self) -> bool:
+        """Idempotent flag-driven setup: a non-empty FLAGS_metrics_path
+        arms the exporter (and its flush thread). Returns armed."""
+        from paddlebox_tpu.core import flags
+        path = flags.flag("metrics_path")
+        if not path:
+            return self._flush_path is not None
+        self.start_flush_thread(
+            path, float(flags.flag("metrics_flush_interval_s")))
+        return True
 
 
 GLOBAL = Monitor()
@@ -43,4 +231,13 @@ add = GLOBAL.add
 set_stat = GLOBAL.set
 get = GLOBAL.get
 snapshot = GLOBAL.snapshot
+snapshot_all = GLOBAL.snapshot_all
 reset = GLOBAL.reset
+set_gauge = GLOBAL.set_gauge
+get_gauge = GLOBAL.get_gauge
+observe = GLOBAL.observe
+define_histogram = GLOBAL.define_histogram
+flush_jsonl = GLOBAL.flush_jsonl
+start_flush_thread = GLOBAL.start_flush_thread
+stop_flush_thread = GLOBAL.stop_flush_thread
+init_from_flags = GLOBAL.init_from_flags

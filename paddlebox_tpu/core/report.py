@@ -1,0 +1,113 @@
+"""PaddleBox-style pass report: one structured summary line per pass.
+
+Role of ``PrintSyncTimer`` (``fleet/box_wrapper.h:395-420``): at every
+pass boundary the reference prints the per-device stage timers
+(read / pack / pull / fwd-bwd / push / sync) that attribute the pass's
+wall time to pipeline stages. Here the same stage names are host-side
+timers (the TPU step fuses pull/fwd-bwd/push into ONE jitted program, so
+their device time cannot be split without adding syncs — the host-visible
+halves carry the names instead; see OBSERVABILITY.md for the exact
+mapping) and the report is one machine-parseable line:
+
+    pass_report {"kind": "train", "steps": 13, "samples_per_s": ..., ...}
+
+The emit also lands in the metric registry (counters/gauges + the
+step-latency histogram feed happens at the call sites) and appends one
+labeled snapshot line to the metrics JSONL when configured — one report
+path for log line, registry, and exporter.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from paddlebox_tpu.core import log, monitor, timers, trace
+
+# Canonical stage-timer names (the PrintSyncTimer vocabulary). Every
+# pass summary carries ALL of them — a stage the host could not observe
+# this pass reports 0.0 rather than disappearing, so downstream tooling
+# (tools/trace_report.py, PROFILE rounds) sees a stable schema.
+STAGES = ("read", "pack", "pull", "fwd_bwd", "push", "dispatch", "sync")
+
+
+def stage_delta(group: "timers.TimerGroup",
+                base_ms: Dict[str, float]) -> Dict[str, float]:
+    """Per-pass stage ms from a cumulative TimerGroup: current snapshot
+    minus the snapshot taken at pass start (the group is shared across
+    passes — bench.py reads its cumulative totals — so the pass report
+    must difference, not read raw)."""
+    now = group.snapshot_ms()
+    out = {s: round(now.get(s, 0.0) - base_ms.get(s, 0.0), 3)
+           for s in STAGES}
+    for name, ms in now.items():
+        if name not in out:
+            out[name] = round(ms - base_ms.get(name, 0.0), 3)
+    return out
+
+
+def emit_pass_report(kind: str, *, steps: int, samples: int,
+                     wall_s: float, stage_ms: Dict[str, float],
+                     stats: Optional[Dict[str, Any]] = None,
+                     extra: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Build + publish one per-pass summary. Returns the summary dict
+    (callers may attach it to their stats).
+
+    - logs ONE ``pass_report {json}`` line (the PrintSyncTimer moment)
+    - bumps registry counters/gauges under ``pass/``
+    - appends a labeled snapshot to the metrics JSONL (if configured)
+    - drops a trace instant so the report is visible in the timeline
+    """
+    summary: Dict[str, Any] = {
+        "kind": kind,
+        "steps": int(steps),
+        "samples": int(samples),
+        "wall_s": round(wall_s, 4),
+        "samples_per_s": round(samples / wall_s, 1) if wall_s > 0 else 0.0,
+        "stage_ms": {s: round(float(stage_ms.get(s, 0.0)), 3)
+                     for s in STAGES},
+    }
+    # Non-canonical timers (host_map, feed_pass, ...) ride along without
+    # polluting the stable stage schema.
+    other = {k: v for k, v in stage_ms.items() if k not in STAGES}
+    if other:
+        summary["other_ms"] = other
+    for src in (stats or {}), (extra or {}):
+        for k, v in src.items():
+            if k not in summary:
+                summary[k] = v
+
+    reg = monitor.GLOBAL
+    reg.add(f"pass/{kind}_passes", 1)
+    reg.add(f"pass/{kind}_steps", int(steps))
+    reg.add(f"pass/{kind}_samples", int(samples))
+    reg.set_gauge(f"pass/{kind}_samples_per_s", summary["samples_per_s"])
+    reg.set_gauge(f"pass/{kind}_wall_s", summary["wall_s"])
+    for s in STAGES:
+        reg.set_gauge(f"pass/{kind}_{s}_ms", summary["stage_ms"][s])
+    if stats:
+        for k in ("loss", "auc"):
+            v = stats.get(k)
+            if isinstance(v, (int, float)):
+                reg.set_gauge(f"pass/{kind}_{k}", float(v))
+        for k in ("dispatch_blocks", "host_syncs", "lookup_overflow",
+                  "lookup_exchange_bytes"):
+            v = stats.get(k)
+            if isinstance(v, (int, float)):
+                reg.set(f"pass/{kind}_{k}", int(v))
+
+    line = json.dumps(summary, default=str)
+    log.info("pass_report %s", line)
+    trace.instant(f"pass_report/{kind}", steps=steps,
+                  samples_per_s=summary["samples_per_s"])
+    reg.flush_jsonl(labels={"event": "pass_report", "kind": kind})
+    return summary
+
+
+def init_telemetry_from_flags() -> None:
+    """One-call arming of both telemetry sinks from flags (trace path +
+    metrics path). Idempotent and near-free when both are unset — the
+    trainer/bench/serving entry points call it unconditionally."""
+    trace.init_from_flags()
+    monitor.init_from_flags()

@@ -41,6 +41,13 @@ class Timer:
         self._start = None
         self._count = 0
 
+    def add_elapsed(self, seconds: float) -> None:
+        """Credit an externally-measured interval (a duration observed
+        by other means — e.g. the profiler's synced step wall — without
+        re-running it under this timer)."""
+        self._elapsed += seconds
+        self._count += 1
+
     @property
     def elapsed_sec(self) -> float:
         extra = 0.0
@@ -84,6 +91,32 @@ class TimerGroup:
             t = self._timers[name]
             parts.append(f"{name}={t.elapsed_sec * 1e3:.1f}ms/{t.count}")
         return " ".join(parts)
+
+    # -- unified report path (core.monitor registry) ----------------------
+    # TimerGroup predates the metric registry; these bridge the two so
+    # there is ONE report surface (the old report() string stays as a
+    # shim for existing log lines).
+
+    def snapshot_ms(self) -> Dict[str, float]:
+        """Cumulative elapsed ms per timer — the delta basis for
+        per-pass stage attribution (core.report.stage_delta)."""
+        return {n: t.elapsed_sec * 1e3 for n, t in self._timers.items()}
+
+    def report_dict(self) -> Dict[str, Dict[str, float]]:
+        return {n: {"ms": round(t.elapsed_sec * 1e3, 3),
+                    "count": t.count}
+                for n, t in sorted(self._timers.items())}
+
+    def publish(self, prefix: str, registry=None) -> None:
+        """Mirror every timer into the metric registry as float gauges
+        ``<prefix>/<name>_ms`` (+ ``_count`` counters) — one exporter
+        (the metrics JSONL) covers timers too."""
+        if registry is None:
+            from paddlebox_tpu.core import monitor
+            registry = monitor.GLOBAL
+        for n, t in self._timers.items():
+            registry.set_gauge(f"{prefix}/{n}_ms", t.elapsed_sec * 1e3)
+            registry.set(f"{prefix}/{n}_count", t.count)
 
     def reset(self) -> None:
         for t in self._timers.values():

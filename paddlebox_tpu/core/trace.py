@@ -1,0 +1,259 @@
+"""Thread-safe span tracer buffering Chrome-trace events.
+
+Role of the per-stage ``platform::Timer`` blocks the reference prints via
+``PrintSyncTimer`` (``fleet/box_wrapper.h:395-420``) and its nvprof range
+annotations — re-expressed as a process-global tracer whose spans land in
+a bounded ring buffer and export to a ``chrome://tracing`` / Perfetto
+loadable JSON file (``FLAGS_trace_path``).
+
+Design constraints (the CTR hot loop runs through here):
+
+- **Zero hot-loop cost when disabled.** ``span()`` checks ONE cached bool
+  and returns a shared ``nullcontext`` — no flag-registry lock, no
+  allocation. Enabling is explicit (``enable()`` or ``init_from_flags()``
+  reading ``FLAGS_trace_path``), never inferred per event.
+- **Host-side only.** Spans wrap dispatch/fetch boundaries and host
+  stages; nothing here may add ops or syncs to a jitted program.
+- **Bounded.** Events live in a ring (``FLAGS_trace_ring_events``); a
+  multi-hour run cannot OOM the host, and ``snapshot()`` hands the tail
+  to crash/stall dumps (bench.py's watchdog forensics).
+
+Usage::
+
+    from paddlebox_tpu.core import trace
+    trace.enable("/tmp/run.trace.json")
+    with trace.span("pull", k=4):
+        ...
+    trace.export()           # or automatic at process exit
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from paddlebox_tpu.core import flags
+
+
+def _json_safe(v: Any) -> Any:
+    """Clamp span args to JSON scalars — a jax array or object captured
+    into an event must not make the whole export unserializable."""
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    return repr(v)
+
+
+class _NullSpan:
+    """Shared no-op context for the disabled path (allocation-free)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: records a Chrome 'X' (complete) event on exit —
+    including exit-via-exception, with the exception recorded in the
+    event args so a crash dump names the failing stage."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, etype, evalue, tb):
+        t1 = time.perf_counter_ns()
+        args = self.args
+        if etype is not None:
+            args = dict(args or {})
+            args["error"] = f"{etype.__name__}: {evalue!r}"
+        self._tracer._record("X", self.name, self._t0, args,
+                             dur_ns=t1 - self._t0)
+        return False
+
+
+class Tracer:
+    """Process-global span tracer with a bounded event ring."""
+
+    def __init__(self, capacity: int = 65536):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max(1, int(capacity)))
+        self._enabled = False          # the ONE hot-path check
+        self._path: Optional[str] = None
+        self._epoch_ns = time.perf_counter_ns()
+        self._pid = os.getpid()
+        self._atexit_registered = False
+        self._dropped = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, path: Optional[str] = None,
+               ring_events: Optional[int] = None) -> None:
+        """Turn tracing on; ``path`` (if given) is where ``export()`` and
+        the process-exit hook write the Chrome trace JSON."""
+        with self._lock:
+            if ring_events and ring_events != self._events.maxlen:
+                self._events = deque(self._events,
+                                     maxlen=max(1, int(ring_events)))
+            if path:
+                self._path = path
+            self._enabled = True
+            if self._path and not self._atexit_registered:
+                self._atexit_registered = True
+                atexit.register(self._export_at_exit)
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    def init_from_flags(self) -> bool:
+        """Idempotent flag-driven enable: a non-empty ``FLAGS_trace_path``
+        turns tracing on (called at pass/bench/service entry points, so
+        env-set flags work without code changes). Returns enabled."""
+        if not self._enabled:
+            path = flags.flag("trace_path")
+            if path:
+                self.enable(path, int(flags.flag("trace_ring_events")))
+        return self._enabled
+
+    # -- recording --------------------------------------------------------
+
+    def _record(self, ph: str, name: str, t_ns: int,
+                args: Optional[Dict[str, Any]], dur_ns: int = 0) -> None:
+        if not self._enabled:
+            return  # span opened just as tracing was disabled
+        th = threading.current_thread()
+        ev: Dict[str, Any] = {
+            "name": name, "ph": ph, "pid": self._pid,
+            "tid": th.ident or 0,
+            "ts": (t_ns - self._epoch_ns) / 1e3,   # Chrome wants us
+        }
+        if ph == "X":
+            ev["dur"] = dur_ns / 1e3
+        if args:
+            ev["args"] = {k: _json_safe(v) for k, v in args.items()}
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(ev)
+
+    def span(self, name: str, **args: Any):
+        """``with trace.span("pull", k=4): ...`` — a null context when
+        disabled, a recorded Chrome complete-event otherwise."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args: Any) -> None:
+        """Point-in-time marker (phase transitions, watchdog ticks)."""
+        if not self._enabled:
+            return
+        self._record("i", name, time.perf_counter_ns(), args)
+
+    def counter(self, name: str, **values: float) -> None:
+        """Chrome counter event — graphs a named value over time."""
+        if not self._enabled:
+            return
+        self._record("C", name, time.perf_counter_ns(), values)
+
+    # -- output -----------------------------------------------------------
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """The current ring contents, oldest first — the crash/stall dump
+        surface (bench watchdog, ``stall_forensics``)."""
+        with self._lock:
+            return list(self._events)
+
+    def trace_object(self) -> Dict[str, Any]:
+        """The full Chrome-trace JSON object (thread-name metadata +
+        events) — what ``export`` serializes."""
+        events = self.snapshot()
+        meta = []
+        seen = set()
+        for th in threading.enumerate():
+            if th.ident is None or th.ident in seen:
+                continue
+            seen.add(th.ident)
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": self._pid, "tid": th.ident,
+                         "args": {"name": th.name}})
+        return {"traceEvents": meta + events,
+                "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": self._dropped}}
+
+    def export(self, path: Optional[str] = None) -> str:
+        """Write the Perfetto/chrome://tracing-loadable JSON file.
+        Returns the path written."""
+        path = path or self._path
+        if not path:
+            raise ValueError("no trace path: pass one or enable(path=...)")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.trace_object(), f, default=str)
+        os.replace(tmp, path)
+        return path
+
+    def _export_at_exit(self) -> None:
+        if self._enabled and self._path:
+            try:
+                self.export()
+            except OSError:
+                pass
+
+
+GLOBAL = Tracer()
+
+enable = GLOBAL.enable
+disable = GLOBAL.disable
+clear = GLOBAL.clear
+enabled = lambda: GLOBAL.enabled  # noqa: E731
+init_from_flags = GLOBAL.init_from_flags
+span = GLOBAL.span
+instant = GLOBAL.instant
+counter = GLOBAL.counter
+snapshot = GLOBAL.snapshot
+export = GLOBAL.export
+
+
+def stall_forensics(max_events: int = 256) -> Dict[str, Any]:
+    """Post-mortem payload for a hung run: every thread's Python stack
+    (faulthandler) + the trace ring tail. bench.py's watchdog embeds
+    this in the failure JSON so an r05-style 'no progress in phase
+    device-probe' stall names the blocked frame, not just the phase."""
+    import faulthandler
+    import tempfile
+    try:
+        with tempfile.TemporaryFile(mode="w+") as f:
+            faulthandler.dump_traceback(file=f, all_threads=True)
+            f.seek(0)
+            stacks = f.read().splitlines()
+    except Exception as e:  # noqa: BLE001 - forensics must never raise
+        stacks = [f"<faulthandler failed: {e!r}>"]
+    return {"thread_stacks": stacks,
+            "trace_tail": GLOBAL.snapshot()[-max_events:]}

@@ -269,6 +269,22 @@ def exchange_bytes(table: PassTable, n: int,
     return pull + push
 
 
+def record_exchange_stats(tables, group_n, caps) -> int:
+    """Per-pass exchange telemetry: total static per-device all-to-all
+    bytes for one pull+push round across all width groups, published
+    into the metric registry (``lookup/…``) and as a trace counter so
+    the exchange shows up in the pass report AND the timeline. Pure
+    host arithmetic over static shapes — never touches the hot path."""
+    from paddlebox_tpu.core import monitor, trace
+    total = int(sum(exchange_bytes(t, n, cap=c)
+                    for t, n, c in zip(tables, group_n, caps)))
+    monitor.set_stat("lookup/exchange_bytes_per_step", total)
+    monitor.set_gauge("lookup/wire_bits",
+                      16.0 if _wire_dtype() is not None else 32.0)
+    trace.counter("lookup/exchange_bytes", per_step=total)
+    return total
+
+
 def _gather_rows(vals: jax.Array, rows: jax.Array, width: int, block: int,
                  layout: Optional[Tuple] = None) -> jax.Array:
     """vals[rows, :width] by the configured backend

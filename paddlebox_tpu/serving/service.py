@@ -21,7 +21,9 @@ from typing import List
 
 import numpy as np
 
-from paddlebox_tpu.core import monitor
+import time
+
+from paddlebox_tpu.core import monitor, report, trace
 from paddlebox_tpu.data.parser import parse_lines
 from paddlebox_tpu.data.slots import SlotBatch
 from paddlebox_tpu.distributed import rpc
@@ -35,6 +37,9 @@ class PredictServer(rpc.FramedRPCServer):
 
     def __init__(self, endpoint: str, predictor: CTRPredictor):
         self.predictor = predictor
+        # Arm the telemetry sinks (trace/metrics paths) once per replica;
+        # per-request cost is one cached-bool check when disabled.
+        report.init_telemetry_from_flags()
         rpc.FramedRPCServer.__init__(self, endpoint)
 
     # -- handlers ---------------------------------------------------------
@@ -43,6 +48,7 @@ class PredictServer(rpc.FramedRPCServer):
         """Raw svm-format lines -> CTR probabilities [n_lines]. Lines
         beyond the predictor's feed batch_size are rejected (the caller
         splits; one fixed shape keeps the jitted forward cache small)."""
+        t0 = time.perf_counter()
         lines: List[str] = list(req["lines"])
         feed = self.predictor.feed
         if len(lines) > feed.batch_size:
@@ -54,22 +60,34 @@ class PredictServer(rpc.FramedRPCServer):
             # Pad to the fixed shape; padding rows carry no features and
             # are stripped from the reply.
             lines = lines + ["0"] * (feed.batch_size - n)
-        batch = SlotBatch.pack(parse_lines(lines, feed), feed)
-        probs = self.predictor.predict(batch)
-        return np.asarray(probs[:n], np.float32)
+        with trace.span("serving/predict", lines=n):
+            batch = SlotBatch.pack(parse_lines(lines, feed), feed)
+            probs = self.predictor.predict(batch)
+            out = np.asarray(probs[:n], np.float32)
+        monitor.add("serving/predict_rpcs", 1)
+        monitor.add("serving/predict_lines", n)
+        monitor.observe("serving/predict_ms",
+                        (time.perf_counter() - t0) * 1e3)
+        return out
 
     def handle_apply_delta(self, req) -> int:
         """Live model refresh from a delta export directory (the online
         update path — serving_online_update's surface over the wire)."""
-        keys, emb, w = load_delta_update(req["path"], req.get(
-            "table", "embedding"))
-        n_new = self.predictor.apply_update(keys, emb, w)
+        with trace.span("serving/apply_delta", path=req["path"]):
+            keys, emb, w = load_delta_update(req["path"], req.get(
+                "table", "embedding"))
+            n_new = self.predictor.apply_update(keys, emb, w)
         monitor.add("serving/delta_rpcs", 1)
         return int(n_new)
 
     def handle_stats(self, req) -> dict:
+        snap = monitor.snapshot()
         return {"keys": int(self.predictor._table.shape[0] - 1),
-                "dim": int(self.predictor._dim)}
+                "dim": int(self.predictor._dim),
+                "predict_rpcs": int(snap.get("serving/predict_rpcs", 0)),
+                "predict_lines": int(snap.get("serving/predict_lines",
+                                              0)),
+                "delta_rpcs": int(snap.get("serving/delta_rpcs", 0))}
 
     def handle_stop(self, req) -> bool:
         self.stop()

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -27,14 +28,14 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddlebox_tpu.core import flags, log, timers
+from paddlebox_tpu.core import flags, log, monitor, report, timers, trace
 from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
 from paddlebox_tpu.embedding import TableConfig, make_sparse_optimizer
 from paddlebox_tpu.embedding.grouped import GroupedEngine
-from paddlebox_tpu.embedding.lookup import (compute_bucketing,
-                                            exchange_bytes, pull_local,
-                                            push_local)
+from paddlebox_tpu.embedding.lookup import (compute_bucketing, pull_local,
+                                            push_local,
+                                            record_exchange_stats)
 from paddlebox_tpu.metrics import (AucState, auc_accumulate, auc_compute,
                                    auc_state_init)
 from paddlebox_tpu.ops.data_norm import (data_norm_apply, data_norm_init,
@@ -174,6 +175,10 @@ class CTRTrainer:
         self._sync_params_cache = None
         self._eval_fn = None
         self.timers = timers.TimerGroup()
+        # Per-pass prefetch segment-cache observability (reset per pass;
+        # surfaced as seg_cache_hit_rate in the pass report).
+        self._seg_cache_hits = 0
+        self._seg_cache_misses = 0
         self._step_fn = None
         # K-step scanned megastep (FLAGS_trainer_steps_per_dispatch > 1):
         # the compiled fn and the K it was built at — invalidated together
@@ -691,6 +696,12 @@ class CTRTrainer:
         as-is (no write-back, no new keys persisted, nothing dirtied)."""
         if self.params is None:
             raise RuntimeError("call init() first")
+        report.init_telemetry_from_flags()
+        pass_t0 = time.perf_counter()
+        stage_base = self.timers.snapshot_ms()
+        self._seg_cache_hits = 0
+        self._seg_cache_misses = 0
+        n_blocks = 0
         k_disp = max(1, int(flags.flag("trainer_steps_per_dispatch")))
         if self._eval_fn is None or self._eval_k != k_disp:
             self._eval_fn = self._build_eval_step(k_steps=k_disp)
@@ -713,28 +724,47 @@ class CTRTrainer:
         nsteps = 0
         try:
             for args in self._prefetch_batches(dataset, k=k_disp):
-                if k_disp == 1:
-                    rows, segs, labels, valid, dense = args
-                    auc, loss = self._eval_fn(tables, self.params, auc,
-                                              rows, segs, labels, valid,
-                                              dense)
-                    n_active = 1
-                else:
-                    rows, segs, labels, valid, dense, n_active = args
-                    nact = (nact_full if n_active == k_disp
-                            else _put_global(np.int32(n_active), rep))
-                    auc, losses = self._eval_fn(tables, self.params, auc,
-                                                nact, rows, segs, labels,
-                                                valid, dense)
-                    loss = jnp.sum(losses)
+                t_disp0 = time.perf_counter()
+                with self.timers.scope("dispatch"), \
+                        trace.span("pass/dispatch", kind="eval",
+                                   block=n_blocks, k=k_disp):
+                    if k_disp == 1:
+                        rows, segs, labels, valid, dense = args
+                        auc, loss = self._eval_fn(tables, self.params,
+                                                  auc, rows, segs, labels,
+                                                  valid, dense)
+                        n_active = 1
+                    else:
+                        rows, segs, labels, valid, dense, n_active = args
+                        nact = (nact_full if n_active == k_disp
+                                else _put_global(np.int32(n_active), rep))
+                        auc, losses = self._eval_fn(tables, self.params,
+                                                    auc, nact, rows, segs,
+                                                    labels, valid, dense)
+                        loss = jnp.sum(losses)
+                n_blocks += 1
+                monitor.observe("trainer/dispatch_ms",
+                                (time.perf_counter() - t_disp0) * 1e3)
                 loss_sum = loss if loss_sum is None else loss_sum + loss
                 nsteps += n_active
         finally:
             eng.abort_pass()
-        stats = self._auc_stats(auc)
-        stats["loss"] = (float(loss_sum) / nsteps if nsteps
-                         else float("nan"))
+        with self.timers.scope("sync"), trace.span("pass/final_fetch"):
+            stats = self._auc_stats(auc)
+            stats["loss"] = (float(loss_sum) / nsteps if nsteps
+                             else float("nan"))
         stats["steps"] = nsteps
+        stats["dispatch_blocks"] = n_blocks
+        stats["steps_per_dispatch"] = k_disp
+        stats["seg_cache_hit_rate"] = self._seg_cache_rate()
+        stats["pass_report"] = report.emit_pass_report(
+            "eval", steps=nsteps,
+            samples=nsteps * self.feed_config.batch_size,
+            wall_s=time.perf_counter() - pass_t0,
+            stage_ms=report.stage_delta(self.timers, stage_base),
+            stats=stats,
+            extra={"steps_per_dispatch": k_disp,
+                   "seg_cache_hit_rate": stats["seg_cache_hit_rate"]})
         return stats
 
     def _sync_params_fn(self):
@@ -814,7 +844,9 @@ class CTRTrainer:
                      put=None) -> jax.Array:
             hit = seg_cache.get(name)
             if hit is not None and np.array_equal(hit[0], host):
+                self._seg_cache_hits += 1
                 return hit[1]
+            self._seg_cache_misses += 1
             dev = (put or _dev)(host)
             seg_cache[name] = (host.copy(), dev)
             return dev
@@ -831,49 +863,74 @@ class CTRTrainer:
         n_groups = len(self.engine.groups)
 
         def _pack_host(batch):
-            dense_h = _concat_dense_host(batch)
-            if dense_bf16:
-                import ml_dtypes
-                dense_h = dense_h.astype(ml_dtypes.bfloat16)
-            return (self._map_batch_rows_host(batch),
-                    {n: batch.segments[n] for n in self._slot_names},
-                    batch.labels, batch.valid, dense_h)
+            # Stage split (PrintSyncTimer vocabulary): "pull" is the host
+            # half of PullSparse (feasign -> device-row keymap, the
+            # CopyKeys role); "pack" is batch assembly + dtype prep.
+            with self.timers.scope("pull"):
+                rows_h = self._map_batch_rows_host(batch)
+            with self.timers.scope("pack"):
+                dense_h = _concat_dense_host(batch)
+                if dense_bf16:
+                    import ml_dtypes
+                    dense_h = dense_h.astype(ml_dtypes.bfloat16)
+                return (rows_h,
+                        {n: batch.segments[n] for n in self._slot_names},
+                        batch.labels, batch.valid, dense_h)
 
         def _stack_block(blk):
-            n_active = len(blk)
-            blk = blk + [blk[-1]] * (k - n_active)  # static-shape tail pad
-            rows = tuple(_dev_stk(np.stack([b[0][g] for b in blk]))
-                         for g in range(n_groups))
-            segs = {n: _seg_dev(n, np.stack([b[1][n] for b in blk]),
-                                put=_dev_stk)
-                    for n in self._slot_names}
-            return (rows, segs,
-                    _dev_stk(np.stack([b[2] for b in blk])),
-                    _dev_stk(np.stack([b[3] for b in blk])),
-                    _dev_stk(np.stack([b[4] for b in blk])),
-                    n_active)
+            with self.timers.scope("pack"):
+                n_active = len(blk)
+                # static-shape tail pad
+                blk = blk + [blk[-1]] * (k - n_active)
+                rows = tuple(_dev_stk(np.stack([b[0][g] for b in blk]))
+                             for g in range(n_groups))
+                segs = {n: _seg_dev(n, np.stack([b[1][n] for b in blk]),
+                                    put=_dev_stk)
+                        for n in self._slot_names}
+                return (rows, segs,
+                        _dev_stk(np.stack([b[2] for b in blk])),
+                        _dev_stk(np.stack([b[3] for b in blk])),
+                        _dev_stk(np.stack([b[4] for b in blk])),
+                        n_active)
+
+        _EOF = object()
 
         def producer():
             buf: List[tuple] = []
+            it = iter(dataset.batches_sharded(self.ndev))
             try:
-                for batch in dataset.batches_sharded(self.ndev):
+                while True:
+                    # "read" = waiting on the dataset iterator (columnar
+                    # slice/channel pop — the reference's ReadInstance
+                    # timer); separate from pack/pull so a starved pass
+                    # is distinguishable from a slow keymap.
+                    with self.timers.scope("read"):
+                        batch = next(it, _EOF)
+                    if batch is _EOF:
+                        break
                     if k == 1:
-                        with self.timers.scope("host_map"):
-                            dense_h = _concat_dense_host(batch)
-                            if dense_bf16:
-                                import ml_dtypes
-                                dense_h = dense_h.astype(
-                                    ml_dtypes.bfloat16)
-                            args = (self._map_batch_rows(batch),
-                                    {n: _seg_dev(n, batch.segments[n])
-                                     for n in self._slot_names},
-                                    _dev(batch.labels),
-                                    _dev(batch.valid),
-                                    _dev(dense_h))
+                        with self.timers.scope("host_map"), \
+                                trace.span("prefetch/host_map"):
+                            with self.timers.scope("pull"):
+                                rows = self._map_batch_rows(batch)
+                            with self.timers.scope("pack"):
+                                dense_h = _concat_dense_host(batch)
+                                if dense_bf16:
+                                    import ml_dtypes
+                                    dense_h = dense_h.astype(
+                                        ml_dtypes.bfloat16)
+                                args = (rows,
+                                        {n: _seg_dev(n,
+                                                     batch.segments[n])
+                                         for n in self._slot_names},
+                                        _dev(batch.labels),
+                                        _dev(batch.valid),
+                                        _dev(dense_h))
                         if not _put(args):
                             return  # consumer bailed early
                         continue
-                    with self.timers.scope("host_map"):
+                    with self.timers.scope("host_map"), \
+                            trace.span("prefetch/host_map", k=k):
                         buf.append(_pack_host(batch))
                         args = (_stack_block(buf) if len(buf) == k
                                 else None)
@@ -1008,6 +1065,15 @@ class CTRTrainer:
         begin_pass/end_pass, SURVEY.md §3.1)."""
         if self.params is None:
             raise RuntimeError("call init() first")
+        # Telemetry is host-side only: flag-armed sinks, a per-pass stage
+        # baseline (the TimerGroup is cumulative across passes — bench.py
+        # reads the totals), and seg-cache counters. NOTHING below adds
+        # ops or syncs to the jitted step.
+        report.init_telemetry_from_flags()
+        pass_t0 = time.perf_counter()
+        stage_base = self.timers.snapshot_ms()
+        self._seg_cache_hits = 0
+        self._seg_cache_misses = 0
         eng = self.engine
         mode = self.config.dense_sync_mode
         k = max(1, self.config.dense_sync_interval)
@@ -1075,7 +1141,8 @@ class CTRTrainer:
             base, fin, na = pending_finite
             pending_finite = None
             self._host_syncs += 1
-            fv = np.asarray(fin)[:na]
+            with self.timers.scope("sync"), trace.span("pass/sync_finite"):
+                fv = np.asarray(fin)[:na]
             if not fv.all():
                 bad = base + int(np.argmin(fv)) + 1
                 raise FloatingPointError(f"NaN/Inf loss at step {bad}")
@@ -1166,7 +1233,15 @@ class CTRTrainer:
                 # PullDense role: freshest host params each step.
                 params = jax.device_put(self._async_dense.pull_dense(), rep)
             block_base = nsteps
-            with self.timers.scope("device_step"):
+            t_disp0 = time.perf_counter()
+            # "dispatch" = the host-side enqueue wall of the (async)
+            # compiled-program launch; under FLAGS_profile_trainer the
+            # per-step sync runs inside, so the same scope degenerates to
+            # the synced step wall (credited to fwd_bwd below).
+            with self.timers.scope("device_step"), \
+                    self.timers.scope("dispatch"), \
+                    trace.span("pass/dispatch",
+                               block=self._dispatch_blocks, k=k_disp):
                 if k_disp == 1:
                     sync_flag = flags_01[
                         1 if (mode == "kstep" and (nsteps + 1) % k == 0)
@@ -1196,6 +1271,15 @@ class CTRTrainer:
                      blk_overflows, blk_finites) = out
                     blk_overflow = jnp.sum(blk_overflows)
             self._dispatch_blocks += 1
+            disp_s = time.perf_counter() - t_disp0
+            # Step-latency distribution (host-observed block enqueue
+            # wall): the pass report's histogram feed.
+            monitor.observe("trainer/dispatch_ms", disp_s * 1e3)
+            if profiling and k_disp == 1:
+                # Profiling syncs per step, so the block wall IS the
+                # fused device step (pull+fwd-bwd+push) — the closest
+                # host-observable stand-in for the fwd_bwd stage.
+                self.timers["fwd_bwd"].add_elapsed(disp_s)
             if mode == "async":
                 # PushDense role: hand psum'd grads to the host updater.
                 self._async_dense.push_dense(jax.device_get(out[6]))
@@ -1230,26 +1314,34 @@ class CTRTrainer:
             params = jax.device_put(self._async_dense.pull_dense(), rep)
         eng.update_tables(tables)
         self.params, self.opt_state, self.auc_state = params, opt_state, auc
-        with self.timers.scope("end_pass"):
+        # "push" = the host-visible half of PushSparse: the pass-end
+        # table write-back into the persistent store (the in-step push
+        # is fused into the jitted program and rides "dispatch").
+        with self.timers.scope("end_pass"), self.timers.scope("push"), \
+                trace.span("pass/end_pass"):
             eng.end_pass()
-        stats = self._auc_stats(self.auc_state)
-        stats["loss"] = (float(loss_sum) / nsteps if nsteps
-                         else float("nan"))
+        # "sync" = blocking device fetches: the pass-end stat reductions
+        # (plus any deferred finite-vector fetches counted above).
+        with self.timers.scope("sync"), trace.span("pass/final_fetch"):
+            stats = self._auc_stats(self.auc_state)
+            stats["loss"] = (float(loss_sum) / nsteps if nsteps
+                             else float("nan"))
         stats["steps"] = nsteps
         stats["steps_per_dispatch"] = k_disp
         stats["dispatch_blocks"] = self._dispatch_blocks
         stats["host_syncs"] = self._host_syncs
-        stats["lookup_overflow"] = (
-            int(overflow_sum) if overflow_sum is not None else 0)
+        with self.timers.scope("sync"):
+            stats["lookup_overflow"] = (
+                int(overflow_sum) if overflow_sum is not None else 0)
         # Static per-device all-to-all bytes for one pull+push round —
         # what dedup + FLAGS_embedding_unique_frac shrink (the dedup-
         # before-exchange observable; heter_comm.h:192 transfers merged
-        # keys for the same reason).
+        # keys for the same reason). record_exchange_stats also lands
+        # it in the metric registry + trace counter.
         caps_now = (list(self._step_caps) if self._step_caps is not None
                     else [None] * len(group_n or []))
-        stats["lookup_exchange_bytes"] = (int(sum(
-            exchange_bytes(t, n, cap=c)
-            for t, n, c in zip(tables, group_n, caps_now)))
+        stats["lookup_exchange_bytes"] = (
+            record_exchange_stats(tables, group_n, caps_now)
             if group_n else 0)
         # Occurrences per unique id in the pass's first batch: the
         # operator's sizing signal for FLAGS_embedding_unique_frac
@@ -1260,16 +1352,31 @@ class CTRTrainer:
         stats["scale_sparse_grad_by_batch"] = bool(
             self.config.scale_sparse_grad_by_batch)
         if stats["lookup_overflow"]:
-            from paddlebox_tpu.core import monitor
             monitor.add("embedding/lookup_overflow",
                         stats["lookup_overflow"])
             log.warning("pass had %d overflowed sparse lookups (dropped "
                         "pull+grad) — raise FLAGS_embedding_shard_slack "
                         "if the key distribution is skewed",
                         stats["lookup_overflow"])
+        stats["seg_cache_hit_rate"] = self._seg_cache_rate()
+        # The PrintSyncTimer moment: ONE structured per-pass summary
+        # line + registry/JSONL publish (core.report).
+        stats["pass_report"] = report.emit_pass_report(
+            "train", steps=nsteps,
+            samples=nsteps * self.feed_config.batch_size,
+            wall_s=time.perf_counter() - pass_t0,
+            stage_ms=report.stage_delta(self.timers, stage_base),
+            stats=stats,
+            extra={"steps_per_dispatch": k_disp,
+                   "seg_cache_hit_rate": stats["seg_cache_hit_rate"],
+                   "lookup_duplication": stats["lookup_duplication"]})
         log.vlog(0, "pass done: steps=%d loss=%.5f auc=%.5f (%s)",
                  nsteps, stats["loss"], stats["auc"], self.timers.report())
         return stats
+
+    def _seg_cache_rate(self) -> Optional[float]:
+        total = self._seg_cache_hits + self._seg_cache_misses
+        return round(self._seg_cache_hits / total, 4) if total else None
 
     def reset_metrics(self) -> None:
         self.auc_state = self._auc_init()

@@ -25,7 +25,7 @@ import numpy as np
 
 from paddlebox_tpu.checkpoint.protocol import (CheckpointProtocol,
                                                get_online_pass_interval)
-from paddlebox_tpu.core import log, timers
+from paddlebox_tpu.core import log, monitor, report, timers, trace
 from paddlebox_tpu.data.dataset import Dataset
 
 
@@ -211,16 +211,21 @@ class DayRunner:
         """One online pass: load → shuffle → train → delta checkpoint.
         ``dataset``/``feed_keys`` let the pipelined day loop hand in a
         preloaded dataset whose table build is already in flight."""
-        with self.timers.scope("load"):
+        report.init_telemetry_from_flags()
+        with self.timers.scope("load"), \
+                trace.span("day/load", day=day, pass_id=pass_id):
             ds = dataset if dataset is not None else self._load_dataset(
                 day, pass_id, files)
         self.trainer.reset_metrics()
-        with self.timers.scope("train"):
+        with self.timers.scope("train"), \
+                trace.span("day/train", day=day, pass_id=pass_id):
             stats = self.trainer.train_pass(ds, feed_keys=feed_keys)
         if self.is_rank0:
             # Only rank 0 writes model files — N ranks racing
             # savez on one shared path would corrupt the npz.
-            with self.timers.scope("save_delta"):
+            with self.timers.scope("save_delta"), \
+                    trace.span("day/save_delta", day=day,
+                               pass_id=pass_id):
                 mdir = self.ckpt.model_dir(day, pass_id)
                 self.trainer.engine.store.save_delta(mdir)
                 # Dense state rides with every sparse checkpoint (role
@@ -232,11 +237,17 @@ class DayRunner:
                 self.ckpt.publish(day, pass_id)
             if self.save_xbox and hasattr(self.trainer.engine.store,
                                           "save_xbox"):
-                with self.timers.scope("save_xbox"):
+                with self.timers.scope("save_xbox"), \
+                        trace.span("day/save_xbox", day=day,
+                                   pass_id=pass_id):
                     self.trainer.engine.store.save_xbox(
                         self.ckpt.model_dir(day, pass_id))
                     self.ckpt.publish_xbox(day, pass_id)
         ds.clear()
+        monitor.add("day_runner/passes", 1)
+        # One report path: the day-loop timers land in the registry
+        # (and thus the metrics JSONL) beside the trainer's pass stages.
+        self.timers.publish("day_runner")
         log.vlog(0, "day %s pass %d: %s | %s", day, pass_id, stats,
                  self.timers.report())
         return stats
@@ -321,7 +332,8 @@ class DayRunner:
             return all_stats
         store = self.trainer.engine.store
         if self.is_rank0:
-            with self.timers.scope("day_end"):
+            with self.timers.scope("day_end"), \
+                    trace.span("day/day_end", day=day):
                 evicted = store.shrink(min_show=self.min_show_shrink)
                 bdir = self.ckpt.model_dir(day, pass_id=-1)
                 store.save_base(bdir)
@@ -335,6 +347,8 @@ class DayRunner:
             evicted = 0
         else:
             evicted = store.shrink(min_show=self.min_show_shrink)
+        monitor.add("day_runner/days", 1)
+        monitor.add("day_runner/evicted_keys", int(evicted))
         log.vlog(0, "day %s done: %d passes, %d evicted", day,
                  len(all_stats), evicted)
         return all_stats

@@ -72,3 +72,75 @@ def test_timer_group_report():
         pass
     rep = g.report()
     assert "pull=" in rep and "push=" in rep
+
+
+def test_monitor_float_gauges_do_not_truncate():
+    monitor.reset()
+    monitor.set_gauge("ratio", 0.75)
+    monitor.add("float_counter", 0.5)   # float deltas survive too
+    monitor.add("float_counter", 0.25)
+    assert monitor.get_gauge("ratio") == 0.75
+    snap = monitor.snapshot()           # flat back-compat view
+    assert snap["ratio"] == 0.75
+    assert snap["float_counter"] == 0.75
+
+
+def test_monitor_histogram_fixed_buckets():
+    monitor.reset()
+    monitor.define_histogram("lat_ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        monitor.observe("lat_ms", v)
+    h = monitor.snapshot_all()["histograms"]["lat_ms"]
+    assert h["counts"] == [1, 1, 1, 1]  # one per bucket + overflow
+    assert h["count"] == 4 and h["min"] == 0.5 and h["max"] == 500.0
+    # Redefining with different buckets must fail loudly.
+    with pytest.raises(ValueError):
+        monitor.define_histogram("lat_ms", buckets=(2.0, 4.0))
+
+
+def test_monitor_snapshot_all_labeled_and_jsonl(tmp_path):
+    import json
+    monitor.reset()
+    monitor.add("c", 3)
+    monitor.set_gauge("g", 1.25)
+    monitor.observe("h", 7.0)
+    snap = monitor.snapshot_all({"kind": "test"})
+    assert snap["labels"] == {"kind": "test"}
+    assert snap["counters"]["c"] == 3
+    assert snap["gauges"]["g"] == 1.25
+    path = str(tmp_path / "m.jsonl")
+    monitor.flush_jsonl(path, {"n": 1})
+    monitor.flush_jsonl(path, {"n": 2})
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert len(lines) == 2
+    assert lines[1]["labels"] == {"n": 2}
+    assert lines[0]["histograms"]["h"]["count"] == 1
+
+
+def test_monitor_flush_thread(tmp_path):
+    import time as _time
+    monitor.reset()
+    monitor.add("tick", 1)
+    path = str(tmp_path / "bg.jsonl")
+    try:
+        assert monitor.start_flush_thread(path, interval_s=0.05)
+        _time.sleep(0.2)
+    finally:
+        monitor.stop_flush_thread()
+    assert len(open(path).read().splitlines()) >= 1
+    # Disarmed after stop: flush with no explicit path is a no-op.
+    assert monitor.flush_jsonl() is None
+
+
+def test_timer_group_publishes_into_registry():
+    monitor.reset()
+    g = timers.TimerGroup()
+    with g.scope("train"):
+        pass
+    g["fwd_bwd"].add_elapsed(0.25)
+    g.publish("day")
+    snap = monitor.snapshot()
+    assert snap["day/train_ms"] >= 0.0
+    assert snap["day/train_count"] == 1
+    assert abs(snap["day/fwd_bwd_ms"] - 250.0) < 1e-6
+    assert g.report_dict()["fwd_bwd"]["count"] == 1

@@ -9,13 +9,60 @@ the bench chip:
     python tools/profile_step.py
 
 Results recorded in PROFILE.md.
+
+A stall watchdog (PBX_PROFILE_WATCHDOG_S, default 600 s; 0 disables)
+guards the axon-tunnel wedge mode the bench learned the hard way
+(BENCH_r05): if no probe completes within the limit, it prints one JSON
+line with faulthandler thread stacks + the trace ring tail and exits 3 —
+a hung probe run is diagnosable post-mortem instead of silent.
 """
+import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from paddlebox_tpu.core import report as _report
+from paddlebox_tpu.core import trace as _trace
+
+_WD = {"t": time.monotonic(), "phase": "start"}
+
+
+def _tick(phase: str) -> None:
+    _WD["t"] = time.monotonic()
+    _WD["phase"] = phase
+    if _trace.GLOBAL.enabled:
+        _trace.instant("profile/" + phase)
+
+
+def _watchdog_loop(limit: float) -> None:
+    while True:
+        time.sleep(5)
+        if time.monotonic() - _WD["t"] > limit:
+            try:
+                tail = _trace.stall_forensics()
+            except Exception as e:  # noqa: BLE001 - keep the record
+                tail = {"error": f"forensics unavailable: {e!r}"}
+            print(json.dumps({
+                "metric": "profile_step_FAILED",
+                "error": (f"watchdog: no probe progress in phase "
+                          f"{_WD['phase']!r} for {limit:.0f}s"),
+                "tail": tail,
+            }, default=str), flush=True)
+            os._exit(3)
+
+
+def _start_watchdog() -> None:
+    limit = float(os.environ.get("PBX_PROFILE_WATCHDOG_S", "600"))
+    if limit <= 0:
+        return
+    import threading
+    threading.Thread(target=_watchdog_loop, args=(limit,),
+                     daemon=True).start()
+
 
 # Sync on a 4-byte slice of the result: forces completion of the dispatch
 # chain without transferring the (possibly hundreds of MB) result over the
@@ -25,7 +72,9 @@ _tiny = jax.jit(lambda x: lax.slice(x.ravel(), (0,), (1,)))
 
 def sync(r):
     leaf = jax.tree_util.tree_leaves(r)[0]
-    return np.asarray(_tiny(leaf))
+    out = np.asarray(_tiny(leaf))
+    _WD["t"] = time.monotonic()  # every completed probe feeds the dog
+    return out
 
 
 def timeit(fn, *args, n=10, warmup=2):
@@ -40,6 +89,12 @@ def timeit(fn, *args, n=10, warmup=2):
 
 
 def main():
+    # Ring-only tracing (file export when FLAGS_trace_path is set) +
+    # the stall watchdog — same forensics discipline as bench.py.
+    _report.init_telemetry_from_flags()
+    _trace.GLOBAL.enable()
+    _start_watchdog()
+    _tick("setup")
     N_ROWS = 4 * 1024 * 1024        # pass table rows (pow2 bucket)
     D = 16
     BATCH = 16384
@@ -56,6 +111,7 @@ def main():
     sync(fused)
 
     print(f"shapes: table [{N_ROWS},{D}] ids [{n}]")
+    _tick("dispatch-rtt")
 
     # Dispatch-latency probe (empty-step RTT): one trivial jitted
     # program, dispatched AND synced per iteration — the pure host-side
@@ -74,6 +130,7 @@ def main():
     print(f"empty-step dispatch RTT      {t*1e3:8.2f} ms "
           f"(amortized by steps_per_dispatch)")
 
+    _tick("sort-gather-scatter")
     t = timeit(jax.jit(lambda r: jnp.argsort(r)), rows)
     print(f"argsort[{n}]                 {t*1e3:8.2f} ms")
 
@@ -91,6 +148,7 @@ def main():
     # which the real step AMORTIZES by sharing it with the push scatter
     # (compute_bucketing), so the steady-state cost is lower than this
     # standalone row by ~the argsort line above.
+    _tick("sorted-gather")
     from paddlebox_tpu.ops.pallas_kernels.sorted_gather import sorted_gather
     for pw in (16, 40):
         tbl = jnp.asarray(rng.normal(size=(N_ROWS, pw)), jnp.float32)
@@ -120,6 +178,7 @@ def main():
     t = timeit(donating, e2, rows, grads, n=1, warmup=0)
     print(f"scatter-add donated (1x)     {t*1e3:8.2f} ms")
 
+    _tick("segment-sum")
     # segment_sum path (the merge): ids -> full table-sized accumulator
     t = timeit(jax.jit(lambda p, r: jax.ops.segment_sum(
         p, r, num_segments=N_ROWS)), payload, rows)
@@ -143,6 +202,7 @@ def main():
     # one-hot matmul alternative for the pull (gather as matmul)? At
     # 426K x 4M that is infeasible; skip.
 
+    _tick("mlp")
     # the MLP fwd+bwd at bench size, f32 and bf16
     dims = [SLOTS * D + 13, 400, 400, 400, 1]
     for dt_ in (jnp.float32, jnp.bfloat16):
@@ -176,6 +236,7 @@ def main():
     t = timeit(auc_acc, hist, probs, labels)
     print(f"AUC hist scatter [{BATCH}]   {t*1e3:8.2f} ms")
 
+    _tick("bandwidth")
     # D2H bandwidth at end_pass sizes (np.asarray = the write-back path)
     for arr in (emb, jnp.asarray(rng.normal(size=(N_ROWS,)), jnp.float32)):
         sync(arr)

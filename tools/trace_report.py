@@ -1,0 +1,139 @@
+"""Summarize a span trace (and/or metrics JSONL) into per-stage tables.
+
+The PROFILE.md workflow in one command: point it at the artifacts a
+telemetry-enabled run wrote (``FLAGS_trace_path`` / ``FLAGS_metrics_path``)
+and get the same shape of table the profile rounds hand-build — per
+span name: count, total ms, p50/p95/max, share of the traced wall —
+plus the registry's counters/gauges and bucket-estimated histogram
+percentiles from the newest metrics snapshot.
+
+    python tools/trace_report.py /tmp/run.trace.json
+    python tools/trace_report.py --metrics /tmp/run.metrics.jsonl
+    python tools/trace_report.py trace.json --metrics m.jsonl --top 15
+"""
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def _pct(durs, q):
+    """Exact percentile over the recorded durations (nearest-rank)."""
+    if not durs:
+        return 0.0
+    s = sorted(durs)
+    i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[i]
+
+
+def report_trace(path: str, top: int) -> None:
+    with open(path) as f:
+        obj = json.load(f)
+    events = [e for e in obj.get("traceEvents", obj
+                                 if isinstance(obj, list) else [])
+              if e.get("ph") == "X"]
+    if not events:
+        print(f"{path}: no complete ('X') span events")
+        return
+    wall_us = (max(e["ts"] + e.get("dur", 0.0) for e in events)
+               - min(e["ts"] for e in events))
+    by_name = defaultdict(list)
+    for e in events:
+        by_name[e["name"]].append(e.get("dur", 0.0) / 1e3)  # us -> ms
+    print(f"\n== {path}: {len(events)} spans, "
+          f"{len(by_name)} names, wall {wall_us / 1e3:.1f} ms ==")
+    hdr = (f"{'span':<28} {'count':>6} {'total_ms':>10} {'p50_ms':>9} "
+           f"{'p95_ms':>9} {'max_ms':>9} {'share':>7}")
+    print(hdr)
+    print("-" * len(hdr))
+    rows = sorted(by_name.items(), key=lambda kv: -sum(kv[1]))
+    for name, durs in rows[:top]:
+        total = sum(durs)
+        share = total / (wall_us / 1e3) if wall_us else 0.0
+        print(f"{name:<28} {len(durs):>6} {total:>10.2f} "
+              f"{_pct(durs, 0.50):>9.3f} {_pct(durs, 0.95):>9.3f} "
+              f"{max(durs):>9.3f} {share:>6.1%}")
+    if len(rows) > top:
+        print(f"... {len(rows) - top} more span names (--top to widen)")
+
+
+def _hist_pct(buckets, counts, q):
+    """Bucket-estimated percentile: the upper bound of the bucket where
+    the cumulative count crosses q (the +inf bucket reports the last
+    finite bound tagged '>')."""
+    total = sum(counts)
+    if not total:
+        return "-"
+    need = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= need:
+            if i < len(buckets):
+                return f"{buckets[i]:g}"
+            return f">{buckets[-1]:g}"
+    return f">{buckets[-1]:g}"
+
+
+def report_metrics(path: str) -> None:
+    last = None
+    n = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            last = json.loads(line)
+            n += 1
+    if last is None:
+        print(f"{path}: empty")
+        return
+    print(f"\n== {path}: {n} snapshots, newest ts={last.get('ts')} "
+          f"labels={last.get('labels')} ==")
+    hists = last.get("histograms", {})
+    if hists:
+        hdr = (f"{'histogram':<28} {'count':>8} {'mean_ms':>9} "
+               f"{'p50<=':>8} {'p95<=':>8} {'max':>9}")
+        print(hdr)
+        print("-" * len(hdr))
+        for name, h in sorted(hists.items()):
+            mean = h["sum"] / h["count"] if h["count"] else 0.0
+            print(f"{name:<28} {h['count']:>8} {mean:>9.3f} "
+                  f"{_hist_pct(h['buckets'], h['counts'], 0.5):>8} "
+                  f"{_hist_pct(h['buckets'], h['counts'], 0.95):>8} "
+                  f"{(h['max'] if h['max'] is not None else 0):>9.3f}")
+    gauges = last.get("gauges", {})
+    if gauges:
+        print(f"\n{'gauge':<44} {'value':>14}")
+        print("-" * 59)
+        for name, v in sorted(gauges.items()):
+            print(f"{name:<44} {v:>14.4f}")
+    counters = last.get("counters", {})
+    if counters:
+        print(f"\n{'counter':<44} {'value':>14}")
+        print("-" * 59)
+        for name, v in sorted(counters.items()):
+            print(f"{name:<44} {v:>14}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", nargs="?", help="Chrome trace JSON "
+                    "(FLAGS_trace_path output)")
+    ap.add_argument("--metrics", help="metrics JSONL "
+                    "(FLAGS_metrics_path output)")
+    ap.add_argument("--top", type=int, default=20,
+                    help="max span rows (default 20)")
+    args = ap.parse_args(argv)
+    if not args.trace and not args.metrics:
+        ap.error("pass a trace file and/or --metrics")
+    if args.trace:
+        report_trace(args.trace, args.top)
+    if args.metrics:
+        report_metrics(args.metrics)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
