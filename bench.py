@@ -540,6 +540,18 @@ def bench_deepfm() -> dict:
             device_step_s / max(int(stats["dispatch_blocks"]), 1) * 1e3,
             3),
         "embedding_exchange_dtype": flags.flag("embedding_exchange_dtype"),
+        # Pass-boundary breakdown (round 8): end_pass write-back ms and
+        # the pass build's total vs blocked ms, so the split-build /
+        # fused-boundary path is visible in the artifact even on CPU
+        # smoke runs. This bench feeds with no pass active (feed_wait~0);
+        # the pipelined day loop is where feed_wait vs build_ms shows
+        # the real contention and overlap_frac its hidden fraction.
+        "end_ms": (stats.get("boundary") or {}).get("end_ms"),
+        "build_ms": (stats.get("boundary") or {}).get("build_ms"),
+        "feed_wait_ms": (stats.get("boundary") or {}).get("feed_wait_ms"),
+        "overlap_frac": (stats.get("boundary") or {}).get("overlap_frac"),
+        "pass_split_build": bool(flags.flag("pass_split_build")),
+        "pass_boundary_fuse": flags.flag("pass_boundary_fuse"),
         "load_s": round(t_load, 3),
         "preload_wall_s": round(preload_wall, 3),
         "pass_s": round(t_pass, 3),

@@ -283,6 +283,36 @@ define_flag("sparse_gather_kernel", "auto",
             "'interpret' (Pallas interpreter — tests), or 'xla'; the "
             "kernel shares one argsort per width group with the push "
             "scatter (embedding/lookup.py compute_bucketing)")
+define_flag("pass_split_build", True,
+            "device-tier split-key early build: gather the next pass's "
+            "NOT-shared rows (and insert its unseen keys) from the "
+            "resident store WHILE the active pass trains — only the "
+            "shared-key remainder waits for the write-back (role of the "
+            "double-buffered build threads, ps_gpu_wrapper.cc:907, "
+            "extended to the HBM tier). False = the r04 serial build "
+            "(the whole gather waits on end_pass)")
+define_flag("pass_boundary_fuse", "auto",
+            "compile the pass boundary — previous pass's end_pass "
+            "scatter + next pass's shared-remainder gather — into ONE "
+            "jitted device program: 'auto'/'on' fuse whenever a split "
+            "early build is ready at end_pass (one PJRT dispatch "
+            "crosses the host link per boundary instead of two — the "
+            "ms-class axon tunnel pays per dispatch), 'off' keeps the "
+            "two-dispatch boundary (scatter, then merge in the builder)")
+define_flag("keymap_lookup_threads", 0,
+            "worker threads sharding the per-batch feasign->row keymap "
+            "lookup in the NUMPY fallback (searchsorted releases the "
+            "GIL, so threads genuinely parallelize the ~426K-id batch "
+            "map); 0 = auto (min(4, cores/2) for batches >= 64K ids, "
+            "single-threaded below). The native keymap parallelizes "
+            "internally and ignores this")
+define_flag("trainer_map_ahead", True,
+            "run the host keymap lookup of batch i+1 on a dedicated "
+            "worker while the prefetch producer packs + transfers "
+            "batch i — takes the CopyKeys host map off the prefetch "
+            "critical path entirely (it was already off the DEVICE "
+            "path via the producer thread). False = map inline in the "
+            "producer (r07 behavior)")
 define_flag("wuauc_spill_records", 4_000_000,
             "per-user-AUC raw records held in RAM before spilling to "
             "uid-hash bucket files on disk (bounds eval-pass host memory; "

@@ -96,6 +96,15 @@ def emit_pass_report(kind: str, *, steps: int, samples: int,
             v = stats.get(k)
             if isinstance(v, (int, float)):
                 reg.set(f"pass/{kind}_{k}", int(v))
+    # Pass-boundary breakdown (split build / fused end-begin, round 8):
+    # end_ms / build_ms / feed_wait_ms / overlap_frac ride the summary
+    # AND land as gauges so the JSONL exporter carries the overlap win.
+    b = summary.get("boundary")
+    if isinstance(b, dict):
+        for k in ("end_ms", "build_ms", "feed_wait_ms", "overlap_frac"):
+            v = b.get(k)
+            if isinstance(v, (int, float)):
+                reg.set_gauge(f"pass/{kind}_boundary_{k}", float(v))
 
     line = json.dumps(summary, default=str)
     log.info("pass_report %s", line)

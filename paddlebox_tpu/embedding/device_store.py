@@ -34,6 +34,7 @@ reference's GPU-mem vs CPU-mem vs SSD tier split.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import json
 import os
@@ -195,6 +196,84 @@ def _scatter_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int, w: int):
                        in_specs=(P(axis), P(axis), P(axis), P(axis)),
                        out_specs=P(axis), check_vma=False)
     return jax.jit(sm, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=64)
+def _merge_fn_local(w: int, rps: int):
+    """Late half of the split pass build: overlay store rows v[idx[i]]
+    at block[place[i]] — the shared-key remainder gather AFTER the
+    previous pass's write-back, merged into the early-built block. Pads
+    point idx at the scratch row and place at the trash row (re-zeroed),
+    so the early-gathered rows elsewhere are untouched."""
+    def merge(block, v, idx, place):
+        out = block.at[place].set(v[idx])
+        return out.at[rps].set(0.0)
+    return jax.jit(merge, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=64)
+def _merge_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int, w: int,
+                      rps: int, store_cap: int):
+    def body(block, v, rq, pl):
+        rq2 = rq.reshape(s, cap)
+        recv = lax.all_to_all(rq2, axis, split_axis=0, concat_axis=0,
+                              tiled=True).reshape(s, cap)
+        served = jnp.where((recv == store_cap)[..., None], 0.0, v[recv])
+        reply = lax.all_to_all(
+            served.reshape(s * cap, w), axis, split_axis=0,
+            concat_axis=0, tiled=True).reshape(s * cap, w)
+        out = block.at[pl.reshape(s * cap)].set(reply)
+        return out.at[rps].set(0.0)
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                       out_specs=P(axis), check_vma=False)
+    return jax.jit(sm, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_boundary_fn_local(w: int, rps_prev: int, rps_next: int):
+    """ONE device program for the pass boundary (FLAGS_pass_boundary_
+    fuse): the previous pass's EndPass scatter followed by the next
+    pass's shared-remainder gather — the gather reads the POST-scatter
+    store, so shared keys observe the write-back exactly as the serial
+    sequencing guarantees, but the host pays one dispatch, not two."""
+    def fused(v, prev_block, prev_idx, next_block, idx, place):
+        v = v.at[prev_idx].set(prev_block[:rps_prev])
+        nb = next_block.at[place].set(v[idx])
+        return v, nb.at[rps_next].set(0.0)
+    return jax.jit(fused, donate_argnums=(0, 3))
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_boundary_fn_sharded(mesh: Mesh, axis: str, s: int,
+                               cap_prev: int, cap_next: int, w: int,
+                               rps_prev: int, rps_next: int,
+                               store_cap: int):
+    def body(v, b_prev, sr, ds, b_next, rq, pl):
+        # EndPass scatter leg (the _scatter_fn_sharded structure).
+        payload = b_prev[sr.reshape(s, cap_prev)]
+        sent = lax.all_to_all(
+            payload.reshape(s * cap_prev, w), axis, split_axis=0,
+            concat_axis=0, tiled=True)
+        recv_dst = lax.all_to_all(ds.reshape(s, cap_prev), axis,
+                                  split_axis=0, concat_axis=0, tiled=True)
+        v = v.at[recv_dst.reshape(s * cap_prev)].set(
+            sent.reshape(s * cap_prev, w))
+        # Remainder-gather leg (the _merge_fn_sharded structure) over
+        # the post-scatter values.
+        recv = lax.all_to_all(rq.reshape(s, cap_next), axis, split_axis=0,
+                              concat_axis=0, tiled=True).reshape(s,
+                                                                 cap_next)
+        served = jnp.where((recv == store_cap)[..., None], 0.0, v[recv])
+        reply = lax.all_to_all(
+            served.reshape(s * cap_next, w), axis, split_axis=0,
+            concat_axis=0, tiled=True).reshape(s * cap_next, w)
+        nb = b_next.at[pl.reshape(s * cap_next)].set(reply)
+        return v, nb.at[rps_next].set(0.0)
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P(axis),) * 7,
+                       out_specs=(P(axis), P(axis)), check_vma=False)
+    return jax.jit(sm, donate_argnums=(0, 4))
 
 
 @functools.lru_cache(maxsize=64)
@@ -368,9 +447,191 @@ class DeviceFeatureStore:
         record via an overlay, and the store is left untouched; the
         returned rows have -1 at missing keys."""
         with self._lock:
+            monitor.add("device_store/boundary_progs", 1)
             return self._pull_pass_table_locked(pass_keys_sorted,
                                                 num_pass_shards,
                                                 readonly=readonly)
+
+    def pull_pass_table_partial(self, pass_keys_sorted: np.ndarray,
+                                num_pass_shards: int, *,
+                                select: np.ndarray,
+                                readonly: bool = False
+                                ) -> Tuple[PassTable, np.ndarray]:
+        """EARLY half of the split pass build (role of the overlapped
+        BuildPull threads, ps_gpu_wrapper.cc:907, on the HBM tier):
+        gather only the ``select`` pass positions — the keys the active
+        pass cannot dirty (it writes back only its own key set) — while
+        it still trains. Non-selected positions read zero until
+        :meth:`merge_pass_rows` / the fused boundary fills them in.
+        Unseen keys are inserted here too (``readonly=False``): the
+        append region is disjoint from the active pass's rows. Missing
+        keys under ``readonly`` get their init-record overlay in this
+        half (a missing key is never shared — it is not in the store at
+        all, so it is always an early position)."""
+        with self._lock:
+            k = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+            if readonly:
+                rows = self._index.lookup(k)
+            else:
+                rows = self._ensure_rows_locked(k)
+            n = k.shape[0]
+            rps = plan_shards(n, num_pass_shards)
+            sel = np.asarray(select, bool)
+            rows_eff = np.where(sel, rows, -1)
+            missing = np.flatnonzero(sel & (rows < 0))
+            init = (self._host_init_fused(k[missing]) if missing.size
+                    else np.zeros((0, self.width), np.float32))
+            table_vals = self._gather_pass_locked(rows_eff, n, rps,
+                                                  num_pass_shards,
+                                                  missing, init)
+            table = PassTable(vals=table_vals, rows_per_shard=rps,
+                              num_shards=num_pass_shards, dim=self.dim,
+                              ke=self.ke, kw=self.kw)
+            monitor.add("store/pass_keys", n)
+            monitor.add("device_store/early_rows", int(sel.sum()))
+            return table, rows
+
+    def merge_pass_rows(self, rows: np.ndarray, table: PassTable,
+                        select: np.ndarray) -> PassTable:
+        """LATE half of the split build: gather the ``select`` positions
+        (the shared-key remainder, post write-back) from the store into
+        the early-built block. Selected rows are always present (shared
+        keys live in the store by definition), so no init overlay."""
+        sel_pos = np.flatnonzero(np.asarray(select, bool))
+        with self._lock:
+            if sel_pos.size == 0:
+                return table
+            monitor.add("device_store/boundary_progs", 1)
+            vals = self._merge_rows_locked(table.vals, rows, sel_pos,
+                                           table.rows_per_shard,
+                                           table.num_shards)
+        return dataclasses.replace(table, vals=vals)
+
+    def push_and_pull_merge(self, prev_keys_sorted: np.ndarray,
+                            prev_rows: np.ndarray, prev_table: PassTable,
+                            next_rows: np.ndarray, next_table: PassTable,
+                            next_select: np.ndarray) -> PassTable:
+        """Fused pass boundary (FLAGS_pass_boundary_fuse): the previous
+        pass's write-back scatter AND the next pass's shared-remainder
+        gather in ONE jitted program — one dispatch crosses the host
+        link per boundary instead of two, and the gather reads the
+        post-scatter store so shared keys observe the write-back
+        bit-exactly as the serial sequencing does."""
+        with self._lock:
+            k = np.ascontiguousarray(prev_keys_sorted, np.uint64)
+            n_prev = k.shape[0]
+            sel_pos = np.flatnonzero(np.asarray(next_select, bool))
+            s = self.num_shards
+            w = self.width
+            rps_p = prev_table.rows_per_shard
+            sp_p = prev_table.num_shards
+            rps_n = next_table.rows_per_shard
+            sp_n = next_table.num_shards
+            monitor.add("device_store/boundary_progs", 1)
+            monitor.add("device_store/boundary_fused", 1)
+            if s == 1 and sp_p == 1 and sp_n == 1:
+                scratch = s * (self._cap + 1) - 1
+                idx_p = np.full((rps_p,), scratch, np.int64)
+                idx_p[:n_prev] = self._dev_idx(prev_rows)
+                m = sel_pos.size
+                cap_m = _pow2(max(m, 1))
+                idx_n = np.full((cap_m,), scratch, np.int64)
+                place = np.full((cap_m,), rps_n, np.int32)
+                if m:
+                    idx_n[:m] = self._dev_idx(next_rows[sel_pos])
+                    place[:m] = sel_pos
+                self._vals, merged = _fused_boundary_fn_local(
+                    w, rps_p, rps_n)(
+                    self._vals, prev_table.vals,
+                    jnp.asarray(idx_p, jnp.int32), next_table.vals,
+                    jnp.asarray(idx_n, jnp.int32), jnp.asarray(place))
+            else:
+                if s != sp_p or s != sp_n:
+                    raise ValueError(
+                        "pass shards must equal store shards")
+                slot, local, _, cap_p = self._bucket_exact(
+                    prev_rows, n_prev, rps_p, sp_p)
+                src = np.where(local >= 0, local, rps_p).astype(np.int32)
+                dst = np.where(slot >= 0, slot, self._cap).astype(np.int32)
+                req, place, cap_n = self._bucket_selected(
+                    next_rows, sel_pos, rps_n, sp_n)
+                src_d = jax.device_put(
+                    jnp.asarray(src.reshape(sp_p, s * cap_p)),
+                    self._sharding)
+                dst_d = jax.device_put(
+                    jnp.asarray(dst.reshape(sp_p, s * cap_p)),
+                    self._sharding)
+                req_d = jax.device_put(
+                    jnp.asarray(req.reshape(sp_n, s * cap_n)),
+                    self._sharding)
+                pl_d = jax.device_put(
+                    jnp.asarray(place.reshape(sp_n, s * cap_n)),
+                    self._sharding)
+                self._vals, merged = _fused_boundary_fn_sharded(
+                    self.mesh, self.axis, s, cap_p, cap_n, w, rps_p,
+                    rps_n, self._cap)(
+                    self._vals, prev_table.vals, src_d, dst_d,
+                    next_table.vals, req_d, pl_d)
+            self._dirty_parts.append(k.copy())
+            monitor.add("device_store/pushed_keys", n_prev)
+        return dataclasses.replace(next_table, vals=merged)
+
+    def _bucket_selected(self, rows: np.ndarray, sel_pos: np.ndarray,
+                         rps: int, sp: int
+                         ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """[sp, s, cap] (request slots, pass-local placements) covering
+        ONLY the selected pass positions (all with valid store rows);
+        pads request the scratch slot and place at the trash row. cap is
+        pow2-stable like _bucket_exact's."""
+        s = self.num_shards
+        m = sel_pos.size
+        rs = rows[sel_pos]
+        store_shard = (rs % s).astype(np.int64)
+        store_slot = (rs // s).astype(np.int64)
+        pass_shard = (sel_pos % sp).astype(np.int64)
+        pass_local = (sel_pos // sp).astype(np.int64)
+        counts = np.zeros((sp, s), np.int64)
+        np.add.at(counts, (pass_shard, store_shard), 1)
+        cap = _pow2(max(int(counts.max()) if m else 1, 1))
+        req = np.full((sp, s, cap), self._cap, np.int64)
+        place = np.full((sp, s, cap), rps, np.int64)
+        order = np.lexsort((store_shard, pass_shard))
+        gs = pass_shard[order] * s + store_shard[order]
+        starts = np.searchsorted(gs, np.arange(sp * s))
+        pos = np.arange(m) - starts[gs]
+        req[pass_shard[order], store_shard[order], pos] = \
+            store_slot[order]
+        place[pass_shard[order], store_shard[order], pos] = \
+            pass_local[order]
+        return req.astype(np.int32), place.astype(np.int32), cap
+
+    def _merge_rows_locked(self, block_vals: jax.Array, rows: np.ndarray,
+                           sel_pos: np.ndarray, rps: int,
+                           sp: int) -> jax.Array:
+        s = self.num_shards
+        w = self.width
+        m = sel_pos.size
+        if s == 1 and sp == 1:
+            cap_m = _pow2(max(m, 1))
+            scratch = s * (self._cap + 1) - 1
+            idx = np.full((cap_m,), scratch, np.int64)
+            place = np.full((cap_m,), rps, np.int32)
+            if m:
+                idx[:m] = self._dev_idx(rows[sel_pos])
+                place[:m] = sel_pos
+            return _merge_fn_local(w, rps)(
+                block_vals, self._vals, jnp.asarray(idx, jnp.int32),
+                jnp.asarray(place))
+        if s != sp:
+            raise ValueError("pass shards must equal store shards")
+        req, place, cap = self._bucket_selected(rows, sel_pos, rps, sp)
+        req_d = jax.device_put(
+            jnp.asarray(req.reshape(sp, s * cap)), self._sharding)
+        pl_d = jax.device_put(
+            jnp.asarray(place.reshape(sp, s * cap)), self._sharding)
+        return _merge_fn_sharded(self.mesh, self.axis, s, cap, w, rps,
+                                 self._cap)(
+            block_vals, self._vals, req_d, pl_d)
 
     def _pull_pass_table_locked(self, pass_keys_sorted: np.ndarray,
                                 num_pass_shards: int, *,
@@ -404,6 +665,7 @@ class DeviceFeatureStore:
             n = k.shape[0]
             if n == 0:
                 return
+            monitor.add("device_store/boundary_progs", 1)
             self._vals = self._scatter_pass_locked(
                 table.vals, rows, n, table.rows_per_shard,
                 table.num_shards)

@@ -187,3 +187,12 @@ class GroupedEngine:
     def cancel_pending(self) -> None:
         for g in self.groups:
             g.engine.cancel_pending()
+
+    def boundary_ms(self) -> Dict[str, float]:
+        """Cumulative pass-boundary stage ms summed across width groups
+        (PassEngine.boundary_ms schema)."""
+        out: Dict[str, float] = {}
+        for g in self.groups:
+            for k, v in g.engine.boundary_ms().items():
+                out[k] = out.get(k, 0.0) + v
+        return out

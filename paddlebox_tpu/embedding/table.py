@@ -269,8 +269,22 @@ def extract_pass_values_host(table: PassTable, num_keys: int
     return split_values_host(fused, table.dim, table.ke, table.kw)
 
 
+def shared_key_mask(active_sorted: np.ndarray,
+                    keys_sorted: np.ndarray) -> np.ndarray:
+    """Boolean mask over ``keys_sorted``: True where the key is also in
+    ``active_sorted`` (both sorted unique). The split pass build keys off
+    this: the active pass's end_pass writes back ONLY its own keys, so
+    the False positions can be pulled/gathered while it still trains."""
+    if active_sorted.size == 0 or keys_sorted.size == 0:
+        return np.zeros(keys_sorted.shape, bool)
+    pos = np.minimum(np.searchsorted(active_sorted, keys_sorted),
+                     active_sorted.size - 1)
+    return active_sorted[pos] == keys_sorted
+
+
 def map_keys_to_rows(pass_keys_sorted: np.ndarray, batch_keys: np.ndarray,
-                     rows_per_shard: int, num_shards: int = 1) -> np.ndarray:
+                     rows_per_shard: int, num_shards: int = 1,
+                     index_offset: int = 0) -> np.ndarray:
     """Host-side: feasigns → device row ids in the ROUND-ROBIN sharded
     layout (rank g -> shard g % num_shards at slot g // num_shards —
     module docstring).
@@ -280,11 +294,17 @@ def map_keys_to_rows(pass_keys_sorted: np.ndarray, batch_keys: np.ndarray,
     feasign map to trash rows, spread round-robin across ALL shards —
     padding concentrated on one shard would overflow its fixed-capacity
     all-to-all bucket and silently drop that shard's real lookups.
+
+    ``index_offset``: global position of ``batch_keys[0]`` when the
+    caller shards one big batch across lookup workers — the round-robin
+    trash assignment depends on the GLOBAL position, so a chunked lookup
+    must stay bit-identical to the unchunked one.
     """
     n = pass_keys_sorted.shape[0]
     m = batch_keys.shape[0]
     # Round-robin trash row per position: shard (i % S)'s trash row.
-    pad_shard = np.arange(m, dtype=np.int64) % num_shards
+    pad_shard = (np.arange(m, dtype=np.int64)
+                 + int(index_offset)) % num_shards
     sentinel = (pad_shard * (rows_per_shard + 1) + rows_per_shard
                 ).astype(np.int32)
     if n == 0:

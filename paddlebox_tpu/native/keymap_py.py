@@ -11,13 +11,43 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 from typing import Optional
 
 import numpy as np
 
-from paddlebox_tpu.core import monitor
+from paddlebox_tpu.core import flags, monitor
 from paddlebox_tpu.embedding.table import map_keys_to_rows
 from paddlebox_tpu.native.build import load_library
+
+# Shared worker pool for the sharded numpy-fallback lookup (the native
+# path parallelizes inside the GIL-releasing C call and never uses it).
+_POOL = None
+_POOL_LOCK = threading.Lock()
+_POOL_WORKERS = 8
+
+
+def _lookup_pool():
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _POOL = ThreadPoolExecutor(max_workers=_POOL_WORKERS,
+                                       thread_name_prefix="pbx-keymap")
+        return _POOL
+
+
+def _fallback_threads(m: int) -> int:
+    """Worker count for a numpy-fallback lookup of m ids
+    (FLAGS_keymap_lookup_threads; 0 = auto). Small batches stay
+    single-threaded — thread handoff would cost more than the
+    searchsorted."""
+    n = int(flags.flag("keymap_lookup_threads"))
+    if n <= 0:
+        if m < (1 << 16):
+            return 1
+        n = min(4, max(1, (os.cpu_count() or 1) // 2))
+    return max(1, min(n, _POOL_WORKERS))
 
 
 def dedup_keys(keys: np.ndarray) -> np.ndarray:
@@ -64,19 +94,48 @@ class KeyMap:
                 self._keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
                 self._keys.size)
 
-    def lookup(self, batch_keys: np.ndarray) -> np.ndarray:
-        """batch feasigns [m] → device rows [m] int32."""
+    def lookup(self, batch_keys: np.ndarray,
+               out: Optional[np.ndarray] = None) -> np.ndarray:
+        """batch feasigns [m] → device rows [m] int32.
+
+        ``out``: optional preallocated int32 [m] buffer for callers that
+        recycle one per pipeline slot instead of allocating ~1.7 MB per
+        batch. The native path releases the GIL and
+        parallelizes internally (keymap.cc parallel_chunks); the numpy
+        fallback shards the batch across the module worker pool
+        (searchsorted releases the GIL on large inputs), staying
+        bit-identical via the position-offset-aware trash assignment."""
         batch = np.ascontiguousarray(batch_keys, np.uint64)
-        if self._handle is None:
-            return map_keys_to_rows(self._keys, batch, self.rows_per_shard,
-                                    self.num_shards)
-        out = np.empty((batch.size,), np.int32)
-        if batch.size:
-            self._lib.pbx_keymap_lookup(
-                self._handle,
-                batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-                batch.size, self.rows_per_shard, self.num_shards,
-                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        m = batch.size
+        if out is None:
+            out = np.empty((m,), np.int32)
+        if self._handle is not None:
+            if m:
+                self._lib.pbx_keymap_lookup(
+                    self._handle,
+                    batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                    m, self.rows_per_shard, self.num_shards,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            return out
+        nt = _fallback_threads(m)
+        if nt <= 1:
+            out[:m] = map_keys_to_rows(self._keys, batch,
+                                       self.rows_per_shard,
+                                       self.num_shards)
+            return out
+        chunk = -(-m // nt)
+
+        def work(lo: int) -> None:
+            hi = min(m, lo + chunk)
+            out[lo:hi] = map_keys_to_rows(
+                self._keys, batch[lo:hi], self.rows_per_shard,
+                self.num_shards, index_offset=lo)
+
+        futs = [_lookup_pool().submit(work, lo)
+                for lo in range(0, m, chunk)]
+        for f in futs:
+            f.result()
+        monitor.add("native/keymap_lookup_sharded", m)
         return out
 
     def close(self) -> None:

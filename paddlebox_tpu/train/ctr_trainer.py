@@ -699,6 +699,7 @@ class CTRTrainer:
         report.init_telemetry_from_flags()
         pass_t0 = time.perf_counter()
         stage_base = self.timers.snapshot_ms()
+        boundary_base = self.engine.boundary_ms()
         self._seg_cache_hits = 0
         self._seg_cache_misses = 0
         n_blocks = 0
@@ -757,6 +758,7 @@ class CTRTrainer:
         stats["dispatch_blocks"] = n_blocks
         stats["steps_per_dispatch"] = k_disp
         stats["seg_cache_hit_rate"] = self._seg_cache_rate()
+        stats["boundary"] = self._boundary_delta(boundary_base)
         stats["pass_report"] = report.emit_pass_report(
             "eval", steps=nsteps,
             samples=nsteps * self.feed_config.batch_size,
@@ -861,13 +863,26 @@ class CTRTrainer:
             return False
 
         n_groups = len(self.engine.groups)
+        # Map-ahead worker (FLAGS_trainer_map_ahead): the host keymap
+        # lookup of batch i+1 runs on this ONE worker while the producer
+        # packs + transfers batch i — the CopyKeys host map leaves the
+        # prefetch critical path entirely (the native hash probe and the
+        # sharded numpy fallback both release the GIL, so the two
+        # threads genuinely overlap).
+        mapper = None
+        if flags.flag("trainer_map_ahead"):
+            from concurrent.futures import ThreadPoolExecutor
+            mapper = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="pbx-map-ahead")
 
-        def _pack_host(batch):
+        def _map_rows_timed(batch):
             # Stage split (PrintSyncTimer vocabulary): "pull" is the host
             # half of PullSparse (feasign -> device-row keymap, the
             # CopyKeys role); "pack" is batch assembly + dtype prep.
-            with self.timers.scope("pull"):
-                rows_h = self._map_batch_rows_host(batch)
+            with self.timers.scope("pull"), trace.span("prefetch/keymap"):
+                return self._map_batch_rows_host(batch)
+
+        def _pack_host(batch, rows_h):
             with self.timers.scope("pack"):
                 dense_h = _concat_dense_host(batch)
                 if dense_bf16:
@@ -898,28 +913,40 @@ class CTRTrainer:
         def producer():
             buf: List[tuple] = []
             it = iter(dataset.batches_sharded(self.ndev))
+
+            def read_next():
+                # "read" = waiting on the dataset iterator (columnar
+                # slice/channel pop — the reference's ReadInstance
+                # timer); separate from pack/pull so a starved pass
+                # is distinguishable from a slow keymap.
+                with self.timers.scope("read"):
+                    return next(it, _EOF)
+
             try:
-                while True:
-                    # "read" = waiting on the dataset iterator (columnar
-                    # slice/channel pop — the reference's ReadInstance
-                    # timer); separate from pack/pull so a starved pass
-                    # is distinguishable from a slow keymap.
-                    with self.timers.scope("read"):
-                        batch = next(it, _EOF)
-                    if batch is _EOF:
-                        break
+                batch = read_next()
+                fut = (mapper.submit(_map_rows_timed, batch)
+                       if mapper is not None and batch is not _EOF
+                       else None)
+                while batch is not _EOF:
+                    # Kick batch i+1's keymap map NOW: it runs on the
+                    # mapper worker while this thread packs + transfers
+                    # batch i below.
+                    nxt = read_next()
+                    fut_n = (mapper.submit(_map_rows_timed, nxt)
+                             if mapper is not None and nxt is not _EOF
+                             else None)
+                    rows_h = (fut.result() if fut is not None
+                              else _map_rows_timed(batch))
                     if k == 1:
                         with self.timers.scope("host_map"), \
                                 trace.span("prefetch/host_map"):
-                            with self.timers.scope("pull"):
-                                rows = self._map_batch_rows(batch)
                             with self.timers.scope("pack"):
                                 dense_h = _concat_dense_host(batch)
                                 if dense_bf16:
                                     import ml_dtypes
                                     dense_h = dense_h.astype(
                                         ml_dtypes.bfloat16)
-                                args = (rows,
+                                args = (tuple(_dev(h) for h in rows_h),
                                         {n: _seg_dev(n,
                                                      batch.segments[n])
                                          for n in self._slot_names},
@@ -928,16 +955,18 @@ class CTRTrainer:
                                         _dev(dense_h))
                         if not _put(args):
                             return  # consumer bailed early
+                        batch, fut = nxt, fut_n
                         continue
                     with self.timers.scope("host_map"), \
                             trace.span("prefetch/host_map", k=k):
-                        buf.append(_pack_host(batch))
+                        buf.append(_pack_host(batch, rows_h))
                         args = (_stack_block(buf) if len(buf) == k
                                 else None)
                         if args is not None:
                             buf = []
                     if args is not None and not _put(args):
                         return
+                    batch, fut = nxt, fut_n
                 if buf:
                     with self.timers.scope("host_map"):
                         args = _stack_block(buf)
@@ -962,6 +991,8 @@ class CTRTrainer:
             # Unblock the producer if we exited early (error mid-pass).
             stop.set()
             t.join(timeout=60.0)
+            if mapper is not None:
+                mapper.shutdown(wait=False)
 
     def _map_batch_rows_host(self, batch: SlotBatch) -> List[np.ndarray]:
         """Host map: batch feasigns → per-width-group fused device-row
@@ -1072,6 +1103,7 @@ class CTRTrainer:
         report.init_telemetry_from_flags()
         pass_t0 = time.perf_counter()
         stage_base = self.timers.snapshot_ms()
+        boundary_base = self.engine.boundary_ms()
         self._seg_cache_hits = 0
         self._seg_cache_misses = 0
         eng = self.engine
@@ -1359,6 +1391,7 @@ class CTRTrainer:
                         "if the key distribution is skewed",
                         stats["lookup_overflow"])
         stats["seg_cache_hit_rate"] = self._seg_cache_rate()
+        stats["boundary"] = self._boundary_delta(boundary_base)
         # The PrintSyncTimer moment: ONE structured per-pass summary
         # line + registry/JSONL publish (core.report).
         stats["pass_report"] = report.emit_pass_report(
@@ -1377,6 +1410,23 @@ class CTRTrainer:
     def _seg_cache_rate(self) -> Optional[float]:
         total = self._seg_cache_hits + self._seg_cache_misses
         return round(self._seg_cache_hits / total, 4) if total else None
+
+    def _boundary_delta(self, base: Dict[str, float]) -> Dict[str, float]:
+        """Per-pass pass-boundary breakdown: deltas of the engine's
+        cumulative boundary timers over this pass's window. In a
+        pipelined day loop the NEXT pass's (overlapped) build lands in
+        this window — exactly the boundary this pass paid for.
+        ``overlap_frac`` = the fraction of the build that ran while
+        training still owned the store (1.0 = fully hidden; 0.0 = the
+        r04 serial boundary)."""
+        now = self.engine.boundary_ms()
+        d = {key: round(now[key] - base.get(key, 0.0), 3) for key in now}
+        build = d.get("build_ms", 0.0)
+        wait = d.get("feed_wait_ms", 0.0)
+        d["overlap_frac"] = (round(min(1.0, max(0.0, 1.0 - wait / build)),
+                                   4)
+                             if build > 1e-6 else None)
+        return d
 
     def reset_metrics(self) -> None:
         self.auc_state = self._auc_init()

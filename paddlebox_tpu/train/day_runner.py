@@ -178,7 +178,12 @@ class DayRunner:
             ds.local_shuffle(seed=zlib.crc32(f"{day}:{pass_id}".encode()))
         return ds
 
-    def _feed_keys(self, ds: Dataset, *, async_build: bool) -> None:
+    def _feed_keys(self, ds: Dataset, *, async_build: bool = True) -> None:
+        """Register an online pass's keys. Defaults to the async build:
+        with the split-key early build the engine overlaps everything it
+        legally can with the active pass (and with the dataset work of
+        THIS thread when no pass is active) — the serial build is only
+        for callers that need the build's errors raised here."""
         eng = self.trainer.engine
         eng.feed_pass([ds.pass_keys(slots=g.slots) for g in eng.groups],
                       async_build=async_build)
@@ -211,6 +216,25 @@ class DayRunner:
         """One online pass: load → shuffle → train → delta checkpoint.
         ``dataset``/``feed_keys`` let the pipelined day loop hand in a
         preloaded dataset whose table build is already in flight."""
+        try:
+            return self._train_pass_inner(day, pass_id, files,
+                                          dataset=dataset,
+                                          feed_keys=feed_keys)
+        except BaseException:
+            # EVERY failure path drops the pending build (load error,
+            # train-step error, checkpoint error): an exception between
+            # feed_pass and begin_pass would otherwise orphan a build
+            # holding the one-slot semaphore — a retry (or the elastic
+            # restart's next pass) would deadlock in feed_pass or
+            # silently consume the wrong pass's table/keymap. The
+            # engine's cancellable boundary wait makes this safe even
+            # when the failed pass never ran end_pass.
+            self.trainer.engine.cancel_pending()
+            raise
+
+    def _train_pass_inner(self, day: str, pass_id: int, files: List[str],
+                          *, dataset: Optional[Dataset],
+                          feed_keys: bool) -> Dict[str, float]:
         report.init_telemetry_from_flags()
         with self.timers.scope("load"), \
                 trace.span("day/load", day=day, pass_id=pass_id):
@@ -300,9 +324,12 @@ class DayRunner:
                     ds, feed_keys = preloaded["ds"], False
                 elif self.pipeline_passes:
                     # First pass of the day: load + feed here so training
-                    # can begin while the NEXT pass preloads.
+                    # can begin while the NEXT pass preloads. Async build
+                    # (the default): begin_pass joins it; a build error
+                    # surfaces there, inside the same try as every other
+                    # pass failure.
                     ds = self._load_dataset(day, pass_id, files)
-                    self._feed_keys(ds, async_build=False)
+                    self._feed_keys(ds)
                     feed_keys = False
                 else:
                     ds, feed_keys = None, True

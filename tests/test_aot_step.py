@@ -36,6 +36,11 @@ def test_full_ctr_step_aot_compiles_for_tpu():
     # scan body, through the same Mosaic pipeline.
     assert "MEGASTEP(K=4) TPU AOT COMPILE: OK" in out
     assert "MEGASTEP-EVAL(K=4) TPU AOT COMPILE: OK" in out
+    # Fused end/begin pass-boundary program (FLAGS_pass_boundary_fuse):
+    # one dispatch per boundary must keep compiling for TPU, single-chip
+    # and sharded-all_to_all variants both.
+    assert "FUSED-BOUNDARY(local) TPU AOT COMPILE: OK" in out
+    assert "FUSED-BOUNDARY(sharded S=" in out
 
 
 @pytest.mark.slow

@@ -1,0 +1,320 @@
+"""Overlapped pass boundary (round 8): the device-tier split-key early
+build, the fused end/begin boundary program, and the off-critical-path
+host keymap must be BIT-identical to the serial path on CPU — same
+store state, same tables, same params/opt-state/AUC — across shared-key
+fractions, eval (readonly) builds, aborts, cancellation, and a threaded
+pipelined stress loop.
+
+Role of the reference overlap being mirrored: PreLoadIntoMemory /
+WaitFeedPassDone (box_wrapper.h:1140,1161) and the double-buffered
+BuildPull threads (ps_gpu_wrapper.cc:907), extended to the HBM-resident
+store tier where the build is an on-device gather.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.embedding import PassEngine, TableConfig
+from paddlebox_tpu.embedding.device_store import DeviceFeatureStore
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+
+SLOTS = ("u", "i")
+
+
+@pytest.fixture(autouse=True)
+def _restore_boundary_flags():
+    old = {k: flagmod.flag(k) for k in
+           ("pass_split_build", "pass_boundary_fuse",
+            "keymap_lookup_threads", "trainer_map_ahead")}
+    try:
+        yield
+    finally:
+        flagmod.set_flags(old)
+
+
+def _engine(dim=4):
+    mesh = build_mesh(HybridTopology(dp=8))
+    cfg = TableConfig(dim=dim, learning_rate=0.1)
+    store = DeviceFeatureStore(cfg, mesh=mesh)
+    return PassEngine(cfg, store, mesh=mesh, table_axis="dp"), store
+
+
+def _keys_with_share(frac, n=64):
+    """Pass-B key set sharing ``frac`` of pass A's keys (A = 1..64)."""
+    n_sh = int(n * frac)
+    return np.unique(np.concatenate([
+        np.arange(n + 1 - n_sh, n + 1, dtype=np.uint64),
+        np.arange(100, 100 + n - n_sh, dtype=np.uint64)]))
+
+
+def _one_boundary(split, fuse, frac, *, readonly=False, settle=0.25):
+    """Pass A trains (emb += 1), pass B feeds async mid-pass, boundary,
+    begin B. Returns (B's rows in key order, store values for B's keys,
+    boundary device-program count, store growth during B's build)."""
+    flagmod.set_flags({"pass_split_build": split,
+                       "pass_boundary_fuse": fuse})
+    eng, store = _engine()
+    keys_a = np.arange(1, 65, dtype=np.uint64)
+    eng.feed_pass(keys_a)
+    table = eng.begin_pass()
+    table = table.with_emb(table.emb + 1.0)
+    eng.update_table(table)
+    keys_b = _keys_with_share(frac)
+    nf0 = store.num_features
+    c0 = monitor.get("device_store/boundary_progs")
+    eng.feed_pass(keys_b, async_build=True, readonly=readonly)
+    time.sleep(settle)  # let the early half run DURING the active pass
+    eng.end_pass()
+    tb = eng.begin_pass()
+    c1 = monitor.get("device_store/boundary_progs")
+    rows = eng.lookup_rows(keys_b)
+    out = np.asarray(tb.vals)[rows]
+    eng.abort_pass() if readonly else eng.end_pass()
+    vals = store.pull_for_pass(keys_b)
+    return out, vals, c1 - c0, store.num_features - nf0
+
+
+@pytest.mark.parametrize("frac", [0.0, 0.5, 1.0])
+@pytest.mark.parametrize("fuse", ["off", "auto"])
+def test_split_build_bit_identical_to_serial(frac, fuse):
+    """Overlapped build == serial build, bit for bit: shared keys
+    observe pass A's write-back, not-shared keys gathered early carry
+    exactly the values the serial (post-write-back) gather would read,
+    and the post-B store state matches."""
+    base_tbl, base_vals, _, _ = _one_boundary(False, "off", frac)
+    got_tbl, got_vals, _, _ = _one_boundary(True, fuse, frac)
+    np.testing.assert_array_equal(base_tbl, got_tbl)
+    for f in base_vals:
+        np.testing.assert_array_equal(base_vals[f], got_vals[f])
+
+
+def test_fused_boundary_single_dispatch_pin():
+    """The boundary's device-program count: fused = ONE jitted dispatch
+    (scatter + remainder gather in one program); unfused split = two;
+    and a fully-disjoint pass needs only the end_pass scatter."""
+    _, _, n_fused, _ = _one_boundary(True, "auto", 0.5)
+    assert n_fused == 1, n_fused
+    _, _, n_split, _ = _one_boundary(True, "off", 0.5)
+    assert n_split == 2, n_split
+    _, _, n_disjoint, _ = _one_boundary(True, "auto", 0.0)
+    assert n_disjoint == 1, n_disjoint  # scatter only; build fully early
+    _, _, n_serial, _ = _one_boundary(False, "off", 0.5)
+    assert n_serial == 2, n_serial      # scatter + serial full gather
+
+
+def test_readonly_eval_build_never_inserts():
+    """An overlapped eval (readonly) build must not grow the store —
+    missing keys ride the init-record overlay in the EARLY half (a
+    missing key is never shared) and the store stays untouched."""
+    for split, fuse in ((False, "off"), (True, "off"), (True, "auto")):
+        tbl, vals, _, grew = _one_boundary(split, fuse, 0.5,
+                                           readonly=True)
+        assert grew == 0
+    # And parity: readonly overlapped == readonly serial, bit for bit.
+    base_tbl, base_vals, _, _ = _one_boundary(False, "off", 0.5,
+                                              readonly=True)
+    got_tbl, got_vals, _, _ = _one_boundary(True, "auto", 0.5,
+                                            readonly=True)
+    np.testing.assert_array_equal(base_tbl, got_tbl)
+    for f in base_vals:
+        np.testing.assert_array_equal(base_vals[f], got_vals[f])
+
+
+def test_abort_mid_overlap_reads_pre_pass_state():
+    """abort_pass (eval/test mode) while a split build is parked: no
+    write-back happens, so the merged remainder must read the PRE-pass
+    values — identical to a serial build after the abort."""
+    flagmod.set_flags({"pass_split_build": True,
+                       "pass_boundary_fuse": "auto"})
+    eng, store = _engine()
+    keys_a = np.arange(1, 65, dtype=np.uint64)
+    eng.feed_pass(keys_a)
+    table = eng.begin_pass()
+    baseline = store.pull_for_pass(keys_a)  # pre-mutation store state
+    table = table.with_emb(table.emb + 7.0)  # would dirty if written back
+    eng.update_table(table)
+    keys_b = _keys_with_share(0.5)
+    eng.feed_pass(keys_b, async_build=True)
+    time.sleep(0.25)
+    eng.abort_pass()                         # NOT end_pass
+    tb = eng.begin_pass()
+    rows = eng.lookup_rows(keys_a[32:])      # the shared half
+    got = np.asarray(tb.vals)[rows][:, :4]
+    np.testing.assert_array_equal(got, baseline["emb"][32:])
+    eng.abort_pass()
+
+
+def test_cancel_pending_while_parked_does_not_deadlock():
+    """cancel_pending against a builder parked at the boundary wait
+    (its pass failed mid-training and will never run end_pass) must
+    return promptly and leave the engine reusable — pre-r08 this join
+    hung forever."""
+    flagmod.set_flags({"pass_split_build": True,
+                       "pass_boundary_fuse": "auto"})
+    eng, store = _engine()
+    eng.feed_pass(np.arange(1, 65, dtype=np.uint64))
+    eng.begin_pass()
+    # All-shared next pass => the builder parks awaiting the boundary.
+    eng.feed_pass(np.arange(1, 65, dtype=np.uint64), async_build=True)
+    time.sleep(0.2)
+    t0 = time.perf_counter()
+    eng.cancel_pending()
+    assert time.perf_counter() - t0 < 5.0
+    # Engine remains fully usable: finish the pass and run another.
+    eng.end_pass()
+    eng.feed_pass(np.arange(200, 264, dtype=np.uint64))
+    eng.begin_pass()
+    eng.end_pass()
+    assert store.num_features == 64 + 64
+
+
+def test_threaded_stress_50_passes_matches_serial():
+    """Pipelined day-loop shape, 50 passes: pass k+1 feeds from a loader
+    thread while pass k 'trains' (table mutation), with jittered timing
+    so the boundary lands at different points of the build. Final store
+    must be bit-identical to the fully-serial run."""
+    def run(split, fuse):
+        flagmod.set_flags({"pass_split_build": split,
+                           "pass_boundary_fuse": fuse})
+        eng, store = _engine()
+        rng = np.random.default_rng(42)
+        keysets = [np.unique(rng.choice(
+            np.arange(1, 257, dtype=np.uint64), 64))
+            for _ in range(50)]
+        eng.feed_pass(keysets[0])
+        table = eng.begin_pass()
+        for i in range(50):
+            feeder = None
+            if i + 1 < len(keysets):
+                feeder = threading.Thread(
+                    target=eng.feed_pass, args=(keysets[i + 1],),
+                    kwargs={"async_build": True}, daemon=True)
+                feeder.start()
+            table = table.with_emb(table.emb + 1.0)
+            eng.update_table(table)
+            if i % 7 == 0:
+                time.sleep(0.01)  # jitter where the boundary lands
+            if feeder is not None:
+                feeder.join()
+            eng.end_pass()
+            if i + 1 < len(keysets):
+                table = eng.begin_pass()
+        keys = np.sort(store.dirty_keys())
+        return keys, store.pull_for_pass(keys)
+
+    keys_s, vals_s = run(False, "off")
+    keys_o, vals_o = run(True, "auto")
+    np.testing.assert_array_equal(keys_s, keys_o)
+    for f in vals_s:
+        np.testing.assert_array_equal(vals_s[f], vals_o[f])
+
+
+def test_trainer_pipelined_day_bit_identical_device_store(tmp_path):
+    """End-to-end acceptance pin: a pipelined day over the device store
+    (split build + fused boundary + map-ahead keymap) produces
+    BIT-identical params, opt state, per-pass loss/AUC, and store
+    values vs the serial path — and the pass reports carry the boundary
+    breakdown."""
+    import jax
+
+    from paddlebox_tpu.data import DataFeedConfig, SlotConf
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+    from paddlebox_tpu.train.day_runner import DayRunner
+
+    data = str(tmp_path / "data")
+    rng = np.random.default_rng(7)
+    for h in (0, 1, 2):
+        d = os.path.join(data, "20260801", f"{h:02d}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "part-0"), "w") as f:
+            for _ in range(96):
+                feats = {s: rng.integers(1, 150, rng.integers(1, 3))
+                         for s in SLOTS}
+                label = int(rng.random() < 0.3)
+                toks = " ".join(f"{s}:{v}" for s, vs in feats.items()
+                                for v in vs)
+                f.write(f"{label} {toks}\n")
+
+    def run(out, pipeline, split, fuse, map_ahead):
+        flagmod.set_flags({"pass_split_build": split,
+                           "pass_boundary_fuse": fuse,
+                           "trainer_map_ahead": map_ahead})
+        mesh = build_mesh(HybridTopology(dp=8))
+        feed = DataFeedConfig(
+            slots=tuple(SlotConf(s, avg_len=1.5) for s in SLOTS),
+            batch_size=32)
+        trainer = CTRTrainer(
+            DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
+            TableConfig(name="emb", dim=8, learning_rate=0.1),
+            mesh=mesh,
+            config=TrainerConfig(dense_learning_rate=3e-3,
+                                 auc_num_buckets=1 << 10),
+            store_factory=lambda cfg: DeviceFeatureStore(cfg, mesh=mesh))
+        trainer.init(seed=0)
+        runner = DayRunner(trainer, feed, out, data_root=data,
+                           split_interval=60, split_per_pass=1,
+                           hours=[0, 1, 2], num_reader_threads=2,
+                           pipeline_passes=pipeline)
+        stats = runner.train_day("20260801")
+        return trainer, stats
+
+    tr_s, st_s = run(str(tmp_path / "o_s"), False, False, "off", False)
+    tr_o, st_o = run(str(tmp_path / "o_o"), True, True, "auto", True)
+
+    assert len(st_s) == len(st_o) == 3
+    for a, b in zip(st_s, st_o):
+        assert a["steps"] == b["steps"]
+        assert a["loss"] == b["loss"], (a["loss"], b["loss"])
+        assert a["auc"] == b["auc"]
+        for k in ("end_ms", "build_ms", "feed_wait_ms", "overlap_frac"):
+            assert k in b["boundary"]
+    of = st_o[1]["boundary"]["overlap_frac"]
+    assert of is None or 0.0 <= of <= 1.0
+
+    for a, b in zip(jax.tree.leaves(tr_s.params),
+                    jax.tree.leaves(tr_o.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(tr_s.opt_state),
+                    jax.tree.leaves(tr_o.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    store_s, store_o = tr_s.engine.store, tr_o.engine.store
+    assert store_s.num_features == store_o.num_features
+    keys = np.sort(store_s.dirty_keys())
+    va, vb = store_s.pull_for_pass(keys), store_o.pull_for_pass(keys)
+    for f in va:
+        np.testing.assert_array_equal(va[f], vb[f])
+
+
+def test_keymap_sharded_fallback_bit_identical():
+    """The numpy-fallback lookup sharded across the worker pool must be
+    bit-identical to the single-threaded lookup — including the
+    position-dependent round-robin trash rows for missing/zero keys
+    (the offset-aware map_keys_to_rows contract)."""
+    from paddlebox_tpu.native.keymap_py import KeyMap
+
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 1 << 40, 5000).astype(np.uint64))
+    km = KeyMap(keys, rows_per_shard=1024, num_shards=8)
+    km.close()
+    km._handle = None  # force the numpy fallback path
+    m = (1 << 16) + 777  # above the auto-shard threshold, odd tail
+    batch = rng.choice(keys, m).astype(np.uint64)
+    batch[rng.choice(m, m // 10, replace=False)] = 0          # pads
+    batch[rng.choice(m, m // 10, replace=False)] = (1 << 41)  # missing
+    flagmod.set_flags({"keymap_lookup_threads": 1})
+    single = km.lookup(batch).copy()
+    flagmod.set_flags({"keymap_lookup_threads": 5})
+    out = np.empty((m,), np.int32)
+    sharded = km.lookup(batch, out=out)
+    assert sharded is out
+    np.testing.assert_array_equal(single, sharded)
+    # auto mode engages sharding at this size and stays identical too
+    flagmod.set_flags({"keymap_lookup_threads": 0})
+    np.testing.assert_array_equal(single, km.lookup(batch))

@@ -149,6 +149,48 @@ def main() -> None:
     mega_eval.lower(*mega_eval_args).compile()
     print(f"MEGASTEP-EVAL(K={K}) TPU AOT COMPILE: OK")
 
+    # Fused pass-boundary program (FLAGS_pass_boundary_fuse): the
+    # end_pass scatter + next-pass remainder gather in ONE dispatch —
+    # both the single-chip program and the sharded all_to_all variant
+    # must survive XLA:TPU (the boundary is pure-XLA scatter/gather, so
+    # any regression here is an XLA-lowering one, caught tunnel-free).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddlebox_tpu.embedding.device_store import (
+        _fused_boundary_fn_local, _fused_boundary_fn_sharded)
+
+    w_rec = 2 * emb_dim + 8          # bench-ish fused record width
+    rps = 32768                      # 20K-key pass pow2 bucket
+    m_cap = 16384                    # shared-remainder pow2 bucket
+    store_rows = 1 << 20
+    mesh1 = Mesh(np.array([topo.devices[0]]), (tr.axis,))
+    rep = NamedSharding(mesh1, P())
+
+    def sd(shape, dt=jnp.float32):
+        return jax.ShapeDtypeStruct(shape, dt, sharding=rep)
+
+    fb = _fused_boundary_fn_local(w_rec, rps, rps)
+    fb.lower(sd((store_rows + 1, w_rec)), sd((rps + 1, w_rec)),
+             sd((rps,), jnp.int32), sd((rps + 1, w_rec)),
+             sd((m_cap,), jnp.int32), sd((m_cap,), jnp.int32)).compile()
+    print("FUSED-BOUNDARY(local) TPU AOT COMPILE: OK")
+
+    s = min(4, len(topo.devices))
+    mesh_s = Mesh(np.array(topo.devices[:s]), (tr.axis,))
+    cap = 2048
+    scap = 1 << 18
+    fbs = _fused_boundary_fn_sharded(mesh_s, tr.axis, s, cap, cap,
+                                     w_rec, rps, rps, scap)
+    f32, i32t = jnp.float32, jnp.int32
+    fbs.lower(
+        jax.ShapeDtypeStruct((s * (scap + 1), w_rec), f32),
+        jax.ShapeDtypeStruct((s * (rps + 1), w_rec), f32),
+        jax.ShapeDtypeStruct((s, s * cap), i32t),
+        jax.ShapeDtypeStruct((s, s * cap), i32t),
+        jax.ShapeDtypeStruct((s * (rps + 1), w_rec), f32),
+        jax.ShapeDtypeStruct((s, s * cap), i32t),
+        jax.ShapeDtypeStruct((s, s * cap), i32t)).compile()
+    print(f"FUSED-BOUNDARY(sharded S={s}) TPU AOT COMPILE: OK")
+
 
 if __name__ == "__main__":
     main()

@@ -236,6 +236,54 @@ def main():
     t = timeit(auc_acc, hist, probs, labels)
     print(f"AUC hist scatter [{BATCH}]   {t*1e3:8.2f} ms")
 
+    _tick("pass-boundary")
+    # Fused end/begin boundary program (FLAGS_pass_boundary_fuse) at
+    # bench pass shapes: 4M-row resident store, 20K-key next pass, half
+    # the pass shared with the ending one. Three rows: the end_pass
+    # scatter alone, the remainder merge-gather alone (the two-dispatch
+    # boundary), and the fused single-dispatch program. On the tunnel
+    # the fused win is dominated by the saved dispatch RTT (the
+    # empty-step row above), not the device time.
+    W = 2 * D + 8
+    PASS = 20_000
+    rps = 1 << (PASS - 1).bit_length()          # pow2 rows_per_shard
+    scratch = N_ROWS                            # store scratch row
+    store_vals = jnp.asarray(
+        rng.normal(size=(N_ROWS + 1, W)), jnp.float32)
+    prev_block = jnp.asarray(rng.normal(size=(rps + 1, W)), jnp.float32)
+    next_block = jnp.zeros((rps + 1, W), jnp.float32)
+    prev_idx_h = np.full((rps,), scratch, np.int32)
+    prev_idx_h[:PASS] = rng.choice(N_ROWS, PASS, replace=False)
+    prev_idx = jnp.asarray(prev_idx_h)
+    m = PASS // 2                               # shared remainder
+    m_cap = 1 << (m - 1).bit_length()
+    idx_h = np.full((m_cap,), scratch, np.int32)
+    idx_h[:m] = rng.choice(N_ROWS, m, replace=False)
+    place_h = np.full((m_cap,), rps, np.int32)
+    place_h[:m] = rng.choice(PASS, m, replace=False)
+    nidx, nplace = jnp.asarray(idx_h), jnp.asarray(place_h)
+
+    # Non-donating probe twins of device_store's boundary programs (the
+    # real ones donate the store/block, which a repeat-timing loop
+    # cannot feed; op structure is identical).
+    scat = jax.jit(lambda v, b, i: v.at[i].set(b[:rps]))
+    merge = jax.jit(lambda b, v, i, p: b.at[p].set(v[i]).at[rps].set(0.0))
+
+    @jax.jit
+    def fused(v, pb, pi, nb, ni, pl):
+        v = v.at[pi].set(pb[:rps])
+        out = nb.at[pl].set(v[ni])
+        return v, out.at[rps].set(0.0)
+
+    t = timeit(scat, store_vals, prev_block, prev_idx)
+    print(f"boundary scatter [{PASS}x{W}]    {t*1e3:8.2f} ms")
+    t = timeit(merge, next_block, store_vals, nidx, nplace)
+    print(f"boundary merge [{m}x{W}]     {t*1e3:8.2f} ms")
+    t = timeit(fused, store_vals, prev_block, prev_idx, next_block,
+               nidx, nplace)
+    print(f"boundary fused (1 dispatch)  {t*1e3:8.2f} ms "
+          f"(vs scatter+merge = 2 dispatches)")
+
     _tick("bandwidth")
     # D2H bandwidth at end_pass sizes (np.asarray = the write-back path)
     for arr in (emb, jnp.asarray(rng.normal(size=(N_ROWS,)), jnp.float32)):
