@@ -36,26 +36,78 @@ _SMALL = os.environ.get("PBX_BENCH_SCALE") == "small"
 # Stall watchdog. The axon TPU tunnel can wedge mid-run (observed
 # 2026-07-31: a device call blocked on the tunnel socket for 30+ min with
 # zero progress) — and a bench that hangs forever records NOTHING for the
-# round. A daemon thread watches a heartbeat that every phase/sync
-# advances; if nothing moves for the limit it prints a parseable JSON
-# line naming the stalled phase and hard-exits. Two-tier limit: a DEAD
-# tunnel shows up in the very first device round-trip, so until one
-# _sync succeeds the limit is short (PBX_BENCH_WATCHDOG_EARLY_S, 240 —
-# a dead-tunnel run fails structured in <5 min); after the backend has
+# round. The heartbeat machinery lives in core/watchdog.py (the library
+# version the day loop also arms); bench keeps only its own stall
+# POLICY: a parseable failure JSON + hard exit, and the two-tier limit —
+# a DEAD tunnel shows up in the very first device round-trip, so until
+# one _sync succeeds the limit is short (PBX_BENCH_WATCHDOG_EARLY_S, 240
+# — a dead-tunnel run fails structured in <5 min); after the backend has
 # proven alive it relaxes (PBX_BENCH_WATCHDOG_S, 900) so a long mid-run
-# compile is not a false positive. The thread also emits a stderr
-# heartbeat every 30 s naming the current phase, so an externally killed
-# capture window still shows where the run was. Started before the jax
-# import: backend init itself can hang.
+# compile is not a false positive. The monitor also emits a stderr
+# heartbeat every 30 s naming the current phase. Armed before the jax
+# import: backend init itself can hang. (core.watchdog imports no jax.)
 # ---------------------------------------------------------------------------
 
-_WD = {"t": time.monotonic(), "t0": time.monotonic(),
-       "phase": "import-jax", "device_alive": False, "trace": None}
+# Importing the library watchdog pulls in the package __init__ (which
+# imports jax) — cover THAT window with a bare-threading import guard so
+# a hung jax import still fails structured, as the pre-library watchdog
+# did.
+_IMPORT_GUARD = {"done": False}
+
+
+def _import_guard() -> None:
+    t0 = time.monotonic()
+    limit = float(os.environ.get("PBX_BENCH_WATCHDOG_EARLY_S", "240"))
+    while not _IMPORT_GUARD["done"]:
+        if time.monotonic() - t0 > limit:
+            name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
+            print(json.dumps({
+                "metric": f"{name}_FAILED", "value": 0.0, "unit": "none",
+                "vs_baseline": None,
+                "error": f"watchdog: package/jax import hung for "
+                         f"{limit:.0f}s"}), flush=True)
+            os._exit(3)
+        time.sleep(5)
+
+
+if os.environ.get("PBX_BENCH_WATCHDOG", "1") != "0":
+    import threading
+    threading.Thread(target=_import_guard, daemon=True).start()
+
+from paddlebox_tpu.core.watchdog import Watchdog  # noqa: E402
+
+_IMPORT_GUARD["done"] = True
+_WD = {"device_alive": False, "trace": None, "wd": None}
+
+
+def _on_bench_stall(phase: str, idle: float) -> None:
+    name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
+    # Stall forensics (the r05 lesson: "no progress in phase
+    # 'device-probe'" with nothing else is undiagnosable): every
+    # thread's Python stack + the trace ring tail ride in the failure
+    # JSON, so the post-mortem names the frame blocked on the tunnel,
+    # not just the phase.
+    try:
+        from paddlebox_tpu.core.trace import stall_forensics
+        tail = stall_forensics()
+    except Exception as e:  # noqa: BLE001 - keep the record
+        tail = {"error": f"forensics unavailable: {e!r}"}
+    print(json.dumps({
+        "metric": f"{name}_FAILED",
+        "value": 0.0,
+        "unit": "none",
+        "vs_baseline": None,
+        "error": (f"watchdog: no progress in phase {phase!r} for "
+                  f"{idle:.0f}s — device backend stall (axon tunnel?)"),
+        "tail": tail,
+    }, default=str), flush=True)
+    os._exit(3)
 
 
 def _tick(phase: str) -> None:
-    _WD["t"] = time.monotonic()
-    _WD["phase"] = phase
+    wd = _WD["wd"]
+    if wd is not None:
+        wd.beat(phase)
     tr = _WD["trace"]
     if tr is not None and tr.enabled:
         # Phase transitions land in the span-tracer ring, so a stall
@@ -63,48 +115,12 @@ def _tick(phase: str) -> None:
         tr.instant("bench/" + phase)
 
 
-def _watchdog_loop() -> None:
-    early = float(os.environ.get("PBX_BENCH_WATCHDOG_EARLY_S", "240"))
-    late = float(os.environ.get("PBX_BENCH_WATCHDOG_S", "900"))
-    last_hb = time.monotonic()
-    while True:
-        time.sleep(5)
-        now = time.monotonic()
-        if now - last_hb >= 30:
-            last_hb = now
-            print(f"[bench hb] phase={_WD['phase']} "
-                  f"idle={now - _WD['t']:.0f}s "
-                  f"elapsed={now - _WD['t0']:.0f}s",
-                  file=sys.stderr, flush=True)
-        limit = late if _WD["device_alive"] else early
-        if now - _WD["t"] > limit:
-            name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
-            # Stall forensics (the r05 lesson: "no progress in phase
-            # 'device-probe'" with nothing else is undiagnosable):
-            # every thread's Python stack + the trace ring tail ride
-            # in the failure JSON, so the post-mortem names the frame
-            # blocked on the tunnel, not just the phase.
-            try:
-                from paddlebox_tpu.core.trace import stall_forensics
-                tail = stall_forensics()
-            except Exception as e:  # noqa: BLE001 - keep the record
-                tail = {"error": f"forensics unavailable: {e!r}"}
-            print(json.dumps({
-                "metric": f"{name}_FAILED",
-                "value": 0.0,
-                "unit": "none",
-                "vs_baseline": None,
-                "error": (f"watchdog: no progress in phase "
-                          f"{_WD['phase']!r} for {limit:.0f}s — "
-                          f"device backend stall (axon tunnel?)"),
-                "tail": tail,
-            }, default=str), flush=True)
-            os._exit(3)
-
-
 if os.environ.get("PBX_BENCH_WATCHDOG", "1") != "0":
-    import threading
-    threading.Thread(target=_watchdog_loop, daemon=True).start()
+    _WD["wd"] = Watchdog(
+        float(os.environ.get("PBX_BENCH_WATCHDOG_EARLY_S", "240")),
+        name="bench", on_stall=_on_bench_stall, poll_s=5.0,
+        heartbeat_s=30.0)
+    _WD["wd"].arm(phase="import-jax")
 
 # Persistent compilation cache: a bench retry (the recorder retries once,
 # and the driver may run multiple configs) must not re-pay multi-minute
@@ -143,7 +159,12 @@ def _sync(x) -> float:
     finishes, so timing loops MUST fetch a concrete value."""
     v = float(np.asarray(x).ravel()[0])
     _tick("sync")
-    _WD["device_alive"] = True  # backend proven: relax the watchdog tier
+    if not _WD["device_alive"]:
+        # Backend proven alive: relax the watchdog to the late tier.
+        _WD["device_alive"] = True
+        if _WD["wd"] is not None:
+            _WD["wd"].set_timeout(
+                float(os.environ.get("PBX_BENCH_WATCHDOG_S", "900")))
     return v
 
 

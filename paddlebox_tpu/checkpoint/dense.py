@@ -4,15 +4,29 @@ Flat-key npz format: pytree paths joined with ``/``; arrays fetched to
 host. Restores into the template's structure, re-placing onto the
 template leaves' shardings (so a restored model resumes with identical
 layouts — including ZeRO-sharded optimizer state).
+
+Crash consistency: the write is fsync'd before the atomic rename (a
+power cut after ``os.replace`` must not leave a hole where the data
+should be), and the payload carries a CRC32 over every array's bytes so
+``load_pytree`` can tell a torn/corrupt file from a good one —
+:class:`CheckpointCorruptError` lets recovery skip to an older record
+instead of dying inside the restart it exists to serve.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Dict
+import zipfile
+import zlib
+from typing import Any, Dict, Tuple
 
 import jax
 import numpy as np
+
+
+class CheckpointCorruptError(Exception):
+    """The checkpoint file is truncated or its payload fails the CRC —
+    recovery should warn and fall back to an older record."""
 
 
 def _flatten(tree: Any) -> Dict[str, Any]:
@@ -24,20 +38,65 @@ def _flatten(tree: Any) -> Dict[str, Any]:
     return flat
 
 
+def _payload_crc32(flat: Dict[str, np.ndarray]) -> int:
+    """CRC32 over every array's raw bytes in sorted key order — the
+    same walk at save and load, so any flipped/zeroed payload byte (not
+    just zip-structure truncation) fails verification."""
+    crc = 0
+    for key in sorted(flat):
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(flat[key]).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
 def save_pytree(tree: Any, path: str, *, step: int | None = None) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     flat = _flatten(tree)
     if step is not None:
         flat["__step__"] = np.asarray(step)
+    flat["__crc32__"] = np.asarray(_payload_crc32(
+        {k: v for k, v in flat.items() if k != "__crc32__"}),
+        dtype=np.uint64)
     tmp = path + ".tmp"
-    np.savez(tmp, **flat)
-    # np.savez appends .npz to the name it opens.
-    os.replace(tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp, path)
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+        # Durability before visibility: flush + fsync the payload, THEN
+        # rename. os.replace alone only orders the directory entry — a
+        # crash could publish a name pointing at unflushed bytes.
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
-def load_pytree(template: Any, path: str) -> Any:
-    """Restore into template's structure + shardings. Returns (tree, step)."""
-    data = np.load(path)
+def _verify_crc(data) -> None:
+    if "__crc32__" not in data.files:
+        return  # pre-CRC checkpoint: structure checks still apply
+    want = int(data["__crc32__"])
+    try:
+        flat = {k: data[k] for k in data.files if k != "__crc32__"}
+    except (zipfile.BadZipFile, OSError, EOFError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint payload unreadable: {e}") from e
+    got = _payload_crc32(flat)
+    if got != want:
+        raise CheckpointCorruptError(
+            f"checkpoint CRC mismatch: payload {got:#010x} != "
+            f"recorded {want:#010x} (torn or corrupted write)")
+
+
+def load_pytree(template: Any, path: str) -> Tuple[Any, Any]:
+    """Restore into template's structure + shardings. Returns (tree, step).
+
+    Raises :class:`CheckpointCorruptError` for a truncated or
+    bit-flipped file (including ``zipfile.BadZipFile`` from a torn npz),
+    ``KeyError`` for a structure mismatch — both are skip-to-older-record
+    cases for recovery, distinct from a genuine IO error."""
+    try:
+        data = np.load(path)
+    except (zipfile.BadZipFile, ValueError, EOFError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is not a readable npz: {e}") from e
+    _verify_crc(data)
     flat_t = _flatten(template)
     missing = [k for k in flat_t if k not in data.files]
     if missing:

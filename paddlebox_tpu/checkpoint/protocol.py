@@ -22,7 +22,7 @@ import os
 import time
 from typing import List, Optional, Tuple
 
-from paddlebox_tpu.core import log
+from paddlebox_tpu.core import faults, log
 
 
 def get_online_pass_interval(hours: List[int], split_interval: int,
@@ -130,6 +130,14 @@ class CheckpointProtocol:
             for r in recs:
                 f.write(r.line() + "\n")
             f.write(rec.line() + "\n")
+            # The donefile is the recovery INDEX: it must be durable
+            # before it becomes visible, or a crash could recover
+            # through a record pointing at data the page cache lost.
+            f.flush()
+            os.fsync(f.fileno())
+        # The classic crash window: model files written, index not yet
+        # swapped — recovery must resume from the PREVIOUS record.
+        faults.faultpoint("checkpoint/publish")
         os.replace(tmp, donefile)  # atomic publication
         log.vlog(0, "%s: published %s/%s -> %s",
                  os.path.basename(donefile), day, pid, rec.path)

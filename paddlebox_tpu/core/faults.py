@@ -1,0 +1,272 @@
+"""Deterministic fault injection: named faultpoints driven by a spec flag.
+
+The recovery story of the reference system — donefile resume, elastic
+restart, pass-exactly-once — is only credible if the failure paths are
+exercised deliberately. This module lets a test (or an operator drill)
+break a *named site* in the pipeline on a *chosen traversal*: raise a
+typed exception, inject latency, or kill the process outright — all from
+one spec string, with no code changes at the site.
+
+Spec grammar (``FLAGS_fault_spec``; ``;``-separated clauses)::
+
+    <site>[:hit=N][:times=M]:<action>
+
+    actions:   raise=<ExcName>     raise that exception type at the site
+               delay_ms=<float>    sleep that long, then continue
+               kill[=SIG]          os.kill(self, SIG) — crash drills
+                                   (default SIGKILL)
+
+    hit=N      trigger on the Nth traversal of the site (1-based,
+               default 1); earlier traversals pass through untouched
+    times=M    how many consecutive traversals trigger once armed
+               (default 1; 0 = every traversal from N on)
+
+Examples::
+
+    FLAGS_fault_spec='pass_engine/build:hit=2:raise=IOError'
+    FLAGS_fault_spec='transport/get:delay_ms=500;day_runner/publish:kill'
+
+Design constraints (sites sit on pass-loop paths):
+
+- **Zero cost when disabled.** ``faultpoint(site)`` checks ONE cached
+  bool and returns — no flag-registry read, no lock, no allocation
+  (the ``core/trace.py`` discipline). Arming is explicit
+  (``configure()`` or ``init_from_flags()``), never inferred per call.
+- **Host-side only.** A faultpoint may never appear inside a jitted
+  program; sites wrap host orchestration (builds, dispatch boundaries,
+  checkpoint IO, sockets).
+- **Observable.** Every triggered injection bumps
+  ``fault/<site>_injected`` in the metric registry and drops a trace
+  instant, so a drill's forensics name what was broken and when.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+from typing import Dict, List, Optional
+
+from paddlebox_tpu.core import flags, log, monitor, trace
+
+
+class InjectedFault(RuntimeError):
+    """Default injected exception (used when raise= names no builtin).
+    Carries ``site`` and is classified transient by default."""
+
+    transient = True
+
+    def __init__(self, msg: str, site: str = ""):
+        super().__init__(msg)
+        self.site = site
+
+
+# Exception types a spec may name. Anything else becomes InjectedFault
+# with the requested name in the message (never a silent typo-noop).
+_EXC_TYPES = {
+    t.__name__: t
+    for t in (OSError, RuntimeError, ValueError, KeyError,
+              ConnectionError, ConnectionResetError, BrokenPipeError,
+              TimeoutError, FloatingPointError, MemoryError, EOFError,
+              InterruptedError, InjectedFault)
+}
+# IOError is an alias of OSError whose __name__ says 'OSError' — keep
+# the spelling drills actually use.
+_EXC_TYPES["IOError"] = OSError
+
+# Exception types (and supertypes) the self-healing pass loop treats as
+# TRANSIENT — worth a rollback + retry. Everything else is fatal: a
+# ValueError/KeyError/FloatingPointError means wrong data or wrong code,
+# and retrying would just fail again (or worse, hide a real bug).
+_TRANSIENT_TYPES = (TimeoutError, ConnectionError, InterruptedError,
+                    BrokenPipeError, OSError, EOFError, InjectedFault)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Classify an exception for the pass-retry loop. An explicit
+    ``exc.transient`` attribute wins (StallError sets True; a fault spec
+    raising ValueError stays fatal by design); otherwise IO-flavored
+    types are transient and everything else — including BaseExceptions
+    like KeyboardInterrupt — is fatal."""
+    t = getattr(exc, "transient", None)
+    if t is not None:
+        return bool(t)
+    if not isinstance(exc, Exception):
+        return False  # KeyboardInterrupt / SystemExit: never retry
+    return isinstance(exc, _TRANSIENT_TYPES)
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    site: str
+    hit: int = 1            # 1-based traversal that first triggers
+    times: int = 1          # consecutive triggers once armed (0 = forever)
+    raise_name: Optional[str] = None
+    delay_ms: float = 0.0
+    kill_sig: Optional[int] = None
+
+    def should_trigger(self, n_hit: int) -> bool:
+        if n_hit < self.hit:
+            return False
+        if self.times == 0:
+            return True
+        return n_hit < self.hit + self.times
+
+
+class FaultError(ValueError):
+    """Malformed FLAGS_fault_spec — raised at configure time, never at a
+    site (a drill with a typo'd spec must fail loudly up front)."""
+
+
+def parse_fault_spec(spec: str) -> List[FaultSpec]:
+    """Parse the spec string. Empty/whitespace → []."""
+    out: List[FaultSpec] = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        fs = FaultSpec(site=parts[0].strip())
+        if not fs.site:
+            raise FaultError(f"fault clause without a site: {clause!r}")
+        has_action = False
+        for p in parts[1:]:
+            p = p.strip()
+            key, _, val = p.partition("=")
+            if key == "hit":
+                fs.hit = int(val)
+            elif key == "times":
+                fs.times = int(val)
+            elif key == "raise":
+                fs.raise_name = val or "InjectedFault"
+                has_action = True
+            elif key == "delay_ms":
+                fs.delay_ms = float(val)
+                has_action = True
+            elif key == "kill":
+                fs.kill_sig = int(val) if val else int(signal.SIGKILL)
+                has_action = True
+            else:
+                raise FaultError(
+                    f"unknown fault directive {p!r} in {clause!r}")
+        if not has_action:
+            raise FaultError(
+                f"fault clause {clause!r} has no action "
+                "(raise= / delay_ms= / kill)")
+        if fs.hit < 1:
+            raise FaultError(f"hit must be >= 1 in {clause!r}")
+        out.append(fs)
+    return out
+
+
+class FaultRegistry:
+    """Process-global faultpoint registry (one per process, like the
+    tracer and the metric registry)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._armed = False          # the ONE hot-path check
+        self._specs: Dict[str, FaultSpec] = {}
+        self._hits: Dict[str, int] = {}
+        self._flags_checked = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def configure(self, spec: str) -> int:
+        """Arm from a spec string (replaces any previous config; empty
+        disarms). Returns the number of active fault clauses."""
+        specs = parse_fault_spec(spec)
+        with self._lock:
+            self._specs = {fs.site: fs for fs in specs}
+            self._hits = {}
+            self._armed = bool(self._specs)
+        if self._armed:
+            log.warning("fault injection ARMED: %s",
+                        "; ".join(sorted(self._specs)))
+        return len(specs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs = {}
+            self._hits = {}
+            self._armed = False
+            self._flags_checked = False
+
+    def init_from_flags(self) -> bool:
+        """Idempotent flag-driven arm (called at pass/bench/service entry
+        points beside telemetry init): a non-empty ``FLAGS_fault_spec``
+        configures the registry ONCE. Returns armed."""
+        if not self._flags_checked:
+            self._flags_checked = True
+            spec = flags.flag("fault_spec")
+            if spec:
+                self.configure(spec)
+        return self._armed
+
+    # -- introspection (tests / drills) ------------------------------------
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def sites(self) -> List[str]:
+        with self._lock:
+            return sorted(self._specs)
+
+    # -- the faultpoint ----------------------------------------------------
+
+    def faultpoint(self, site: str) -> None:
+        """Declare a named fault site. Disabled path: one cached-bool
+        check, nothing else."""
+        if not self._armed:
+            return
+        with self._lock:
+            fs = self._specs.get(site)
+            if fs is None:
+                return
+            n = self._hits.get(site, 0) + 1
+            self._hits[site] = n
+            if not fs.should_trigger(n):
+                return
+        self._trigger(site, fs, n)
+
+    def _trigger(self, site: str, fs: FaultSpec, n_hit: int) -> None:
+        monitor.add(f"fault/{site}_injected", 1)
+        trace.instant("fault/injected", site=site, hit=n_hit)
+        if fs.delay_ms > 0:
+            log.warning("faultpoint %s (hit %d): injecting %.0f ms delay",
+                        site, n_hit, fs.delay_ms)
+            time.sleep(fs.delay_ms / 1e3)
+        if fs.kill_sig is not None:
+            # Crash drill: no cleanup, no atexit — the whole point is to
+            # die the way a SIGKILL'd/OOM'd production worker dies.
+            log.warning("faultpoint %s (hit %d): killing pid %d with "
+                        "signal %d", site, n_hit, os.getpid(), fs.kill_sig)
+            os.kill(os.getpid(), fs.kill_sig)
+            time.sleep(30)  # SIGKILL needs no help; give softer sigs time
+        if fs.raise_name is not None:
+            exc_type = _EXC_TYPES.get(fs.raise_name)
+            msg = (f"injected fault at {site!r} "
+                   f"(hit {n_hit}, spec {fs.raise_name})")
+            log.warning("faultpoint %s (hit %d): raising %s",
+                        site, n_hit, fs.raise_name)
+            if exc_type is None or exc_type is InjectedFault:
+                raise InjectedFault(msg, site=site)
+            raise exc_type(msg)
+
+
+GLOBAL = FaultRegistry()
+
+faultpoint = GLOBAL.faultpoint
+configure = GLOBAL.configure
+clear = GLOBAL.clear
+init_from_flags = GLOBAL.init_from_flags
+armed = lambda: GLOBAL.armed  # noqa: E731
+hits = GLOBAL.hits
+sites = GLOBAL.sites

@@ -341,3 +341,43 @@ define_flag("metrics_path", "",
 define_flag("metrics_flush_interval_s", 30.0,
             "period of the metrics JSONL background flush thread "
             "(<= 0 disables the thread; pass reports still append)")
+define_flag("fault_spec", "",
+            "deterministic fault-injection spec: ';'-separated "
+            "'<site>[:hit=N][:times=M]:<raise=Exc|delay_ms=X|kill[=SIG]>'"
+            " clauses (empty = injection off, the default — faultpoints "
+            "are one cached-bool no-ops). See core/faults.py and "
+            "ROBUSTNESS.md")
+define_flag("pass_max_retries", 2,
+            "max pass-level retries after a TRANSIENT train_pass failure "
+            "(IO/connection/timeout/stall): each retry cancels pending "
+            "builds, rolls the sparse store + dense state back to the "
+            "last published record, and replays the pass — bit-identical "
+            "to an unfailed run. Fatal errors (bad data, NaN loss, code "
+            "bugs) never retry. 0 disables the self-healing loop")
+define_flag("pass_retry_backoff_s", 0.5,
+            "base of the capped exponential backoff between pass retries "
+            "(sleep = base * 2^(attempt-1), capped by "
+            "pass_retry_backoff_max_s)")
+define_flag("pass_retry_backoff_max_s", 30.0,
+            "cap on the pass-retry backoff sleep")
+define_flag("stall_timeout_s", 0.0,
+            "abort the current pass when the training heartbeat "
+            "(per-block dispatch progress) stalls for this many seconds: "
+            "stall forensics (all-thread stacks + trace ring tail) land "
+            "in the log and StallError is raised in the training thread "
+            "so the pass retries through the normal rollback machinery. "
+            "<= 0 disables (default)")
+define_flag("rpc_max_retries", 3,
+            "max reconnect-and-retry attempts for IDEMPOTENT "
+            "FramedRPCConn methods after a connection failure "
+            "(pull/stats-class reads — the caller declares which methods "
+            "are idempotent); non-idempotent methods never retry (the "
+            "request may have executed)")
+define_flag("rpc_retry_backoff_s", 0.05,
+            "base of the capped exponential backoff between RPC retries "
+            "(sleep = base * 2^(attempt-1), capped at 2s)")
+define_flag("rpc_retry_deadline_s", 30.0,
+            "overall wall-clock deadline across an idempotent call's "
+            "retries: when exceeded the last connection error raises "
+            "even if attempts remain (a PS blip should cost ms, not "
+            "minutes of blind retry)")

@@ -279,7 +279,22 @@ class Carrier:
         [t.start() for t in feeders]
         try:
             if not self._done.wait(timeout):
-                raise TimeoutError("fleet executor did not drain")
+                # Name the missing participants: which sink scopes never
+                # arrived and which stage threads are still live — a
+                # wedged stage debugs from this line alone.
+                with self._results_lock:
+                    got = sorted(self._results)
+                missing = ([s for s in range(self._expected or 0)
+                            if s not in set(got)]
+                           if self._expected is not None else [])
+                alive = [tid for tid, it in self.interceptors.items()
+                         if it._thread.is_alive()]
+                raise TimeoutError(
+                    f"fleet executor did not drain within {timeout}s: "
+                    f"{len(got)}/{self._expected} sink scopes arrived "
+                    f"(missing scopes {missing[:8]}"
+                    f"{'...' if len(missing) > 8 else ''}); "
+                    f"interceptors still running: {alive}")
         finally:
             self._consumed = True
         [t.join() for t in feeders]

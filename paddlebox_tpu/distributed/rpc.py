@@ -26,9 +26,10 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import Optional
+import time
+from typing import FrozenSet, Iterable, Optional
 
-from paddlebox_tpu.core import log
+from paddlebox_tpu.core import faults, flags, log, monitor
 from paddlebox_tpu.distributed import wire
 from paddlebox_tpu.distributed.transport import _recv_exact
 
@@ -110,29 +111,74 @@ class FramedRPCServer:
 
 
 class FramedRPCConn:
-    """One blocking client connection with in-band error raising."""
+    """One blocking client connection with in-band error raising,
+    transparent reconnect, and retry-with-backoff for idempotent methods.
+
+    A dropped/half-read/desynced stream closes the socket; the NEXT call
+    reconnects (a PS restart no longer strands every client forever).
+    Methods named in ``idempotent`` (pure reads: pull/stats/predict)
+    additionally retry the call itself — reconnect, capped exponential
+    backoff, bounded by ``FLAGS_rpc_max_retries`` AND the wall-clock
+    ``FLAGS_rpc_retry_deadline_s`` — so a server blip costs latency, not
+    the pass. Non-idempotent methods (pushes, applies) never auto-retry:
+    the request may have executed before the connection died, and
+    re-running it would double-apply."""
 
     def __init__(self, endpoint: str, *, timeout: float = 60.0,
-                 service_name: str = "rpc"):
-        host, port = endpoint.rsplit(":", 1)
-        self._sock: Optional[socket.socket] = socket.create_connection(
-            (host, int(port)), timeout=timeout)
+                 service_name: str = "rpc",
+                 idempotent: Iterable[str] = ()):
+        self.endpoint = endpoint
+        self._timeout = timeout
+        self._idempotent: FrozenSet[str] = frozenset(idempotent)
         self._lock = threading.Lock()
         self._service = service_name
+        self._sock: Optional[socket.socket] = self._connect()
+
+    def _connect(self) -> socket.socket:
+        host, port = self.endpoint.rsplit(":", 1)
+        return socket.create_connection((host, int(port)),
+                                        timeout=self._timeout)
+
+    def _call_once(self, method: str, kw) -> dict:
+        faults.faultpoint("rpc/call")
+        if self._sock is None:  # reconnect after a previous failure
+            self._sock = self._connect()
+            monitor.add("rpc/reconnects", 1)
+        s = self._sock
+        try:
+            s.sendall(wire.pack_frame({"method": method, **kw}))
+            ln = wire.read_frame_header(
+                _recv_exact(s, wire.HEADER.size))
+            return wire.loads(_recv_exact(s, ln))
+        except (OSError, ConnectionError, wire.WireError):
+            # A timed-out / half-read / desynced stream cannot be
+            # reused — drop it so the next attempt reconnects cleanly.
+            self.close()
+            raise
 
     def call(self, method: str, **kw):
+        retries = (max(0, int(flags.flag("rpc_max_retries")))
+                   if method in self._idempotent else 0)
+        deadline = time.monotonic() + float(
+            flags.flag("rpc_retry_deadline_s"))
         with self._lock:
-            s = self._sock
-            try:
-                s.sendall(wire.pack_frame({"method": method, **kw}))
-                ln = wire.read_frame_header(
-                    _recv_exact(s, wire.HEADER.size))
-                resp = wire.loads(_recv_exact(s, ln))
-            except (OSError, ConnectionError, wire.WireError):
-                # A timed-out / half-read / desynced stream cannot be
-                # reused — drop it so the caller can reconnect cleanly.
-                self.close()
-                raise
+            attempt = 0
+            while True:
+                try:
+                    resp = self._call_once(method, kw)
+                    break
+                except (OSError, ConnectionError, wire.WireError) as e:
+                    if attempt >= retries or time.monotonic() >= deadline:
+                        raise
+                    attempt += 1
+                    monitor.add("rpc/retries", 1)
+                    log.warning(
+                        "%s.%s: connection error %r — reconnect+retry "
+                        "%d/%d", self._service, method, e, attempt,
+                        retries)
+                    time.sleep(min(
+                        float(flags.flag("rpc_retry_backoff_s"))
+                        * (2.0 ** (attempt - 1)), 2.0))
         if not resp["ok"]:
             raise RuntimeError(
                 f"{self._service}.{method}: {resp['error']}")

@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from paddlebox_tpu.core import log
+from paddlebox_tpu.core import faults, log
 
 
 class FileStore:
@@ -45,20 +45,51 @@ class FileStore:
         return g
 
     def set(self, key: str, value: bytes) -> None:
+        faults.faultpoint("transport/set")
         tmp = os.path.join(self.root, f".{key}.{self.rank}.tmp")
         with open(tmp, "wb") as f:
             f.write(value)
         os.replace(tmp, os.path.join(self.root, key))
 
     def get(self, key: str, timeout: float = 60.0) -> bytes:
+        faults.faultpoint("transport/get")
         path = os.path.join(self.root, key)
         deadline = time.time() + timeout
+        # Exponential poll backoff 10ms -> ~250ms: a long rendezvous wait
+        # (slow rank, cold start) must not spin the shared filesystem
+        # with 100 stat()s/s per rank per key.
+        poll = 0.01
         while not os.path.exists(path):
             if time.time() > deadline:
-                raise TimeoutError(f"FileStore.get({key!r}) timed out")
-            time.sleep(0.01)
+                raise TimeoutError(
+                    f"FileStore.get({key!r}) timed out after {timeout}s "
+                    f"(rank {self.rank}/{self.world}, root {self.root})")
+            time.sleep(poll)
+            poll = min(poll * 2.0, 0.25)
         with open(path, "rb") as f:
             return f.read()
+
+    def _gather_from_all(self, prefix: str, what: str, name: str,
+                         timeout: float) -> List[bytes]:
+        """Collect one marker per rank, converting a per-key timeout into
+        an error naming the MISSING RANKS and the waited key — 'rank 3
+        never arrived' debugs a wedged barrier; 'get(...) timed out'
+        does not."""
+        deadline = time.time() + timeout
+        out: List[Optional[bytes]] = [None] * self.world
+        for r in range(self.world):
+            left = deadline - time.time()
+            try:
+                out[r] = self.get(f"{prefix}.{r}", max(left, 0.0))
+            except TimeoutError:
+                missing = [i for i in range(self.world)
+                           if out[i] is None and not os.path.exists(
+                               os.path.join(self.root, f"{prefix}.{i}"))]
+                raise TimeoutError(
+                    f"FileStore.{what}({name!r}) timed out after "
+                    f"{timeout}s on rank {self.rank}: ranks {missing} "
+                    f"never arrived (waited key {prefix}.{r})") from None
+        return out  # type: ignore[return-value]
 
     def _cleanup_old_gen(self, prefix: str, g: int) -> None:
         """Unlink our own generation g-2 marker: by the time any rank runs
@@ -78,16 +109,16 @@ class FileStore:
         g = self._gen(f"barrier.{name}")
         self._cleanup_old_gen(f"barrier.{name}", g)
         self.set(f"barrier.{name}.{g}.{self.rank}", b"1")
-        for r in range(self.world):
-            self.get(f"barrier.{name}.{g}.{r}", timeout)
+        self._gather_from_all(f"barrier.{name}.{g}", "barrier", name,
+                              timeout)
 
     def all_gather(self, name: str, value: bytes,
                    timeout: float = 60.0) -> List[bytes]:
         g = self._gen(f"ag.{name}")
         self._cleanup_old_gen(f"ag.{name}", g)
         self.set(f"ag.{name}.{g}.{self.rank}", value)
-        return [self.get(f"ag.{name}.{g}.{r}", timeout)
-                for r in range(self.world)]
+        return self._gather_from_all(f"ag.{name}.{g}", "all_gather", name,
+                                     timeout)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -155,6 +186,7 @@ class TcpTransport:
             return
 
     def _send(self, dst: int, rnd: int, payload: bytes) -> None:
+        faults.faultpoint("transport/send")
         host, port = self.endpoints[dst].rsplit(":", 1)
         deadline = time.time() + 30
         while True:
@@ -175,6 +207,7 @@ class TcpTransport:
         peer (self's slot short-circuits locally)."""
         if len(buffers) != self.world:
             raise ValueError(f"{len(buffers)} buffers != world {self.world}")
+        faults.faultpoint("transport/recv")
         rnd = self._round
         self._round += 1
         out: List[Optional[bytes]] = [None] * self.world

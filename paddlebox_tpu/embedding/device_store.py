@@ -47,7 +47,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.core import faults, log, monitor
 from paddlebox_tpu.embedding.table import (PassTable, TableConfig,
                                            extract_pass_values_host,
                                            fuse_values_host, lay_fused_host,
@@ -446,6 +446,7 @@ class DeviceFeatureStore:
         are NOT inserted — their pass rows carry the deterministic init
         record via an overlay, and the store is left untouched; the
         returned rows have -1 at missing keys."""
+        faults.faultpoint("device_store/pull")
         with self._lock:
             monitor.add("device_store/boundary_progs", 1)
             return self._pull_pass_table_locked(pass_keys_sorted,
@@ -517,6 +518,7 @@ class DeviceFeatureStore:
         link per boundary instead of two, and the gather reads the
         post-scatter store so shared keys observe the write-back
         bit-exactly as the serial sequencing does."""
+        faults.faultpoint("device_store/fused")
         with self._lock:
             k = np.ascontiguousarray(prev_keys_sorted, np.uint64)
             n_prev = k.shape[0]
@@ -660,6 +662,7 @@ class DeviceFeatureStore:
                         rows: np.ndarray, table: PassTable) -> None:
         """Write a finished pass table back into the resident store (role
         of EndPass, ps_gpu_wrapper.cc:983 — one on-device scatter)."""
+        faults.faultpoint("device_store/push")
         with self._lock:
             k = np.ascontiguousarray(pass_keys_sorted, np.uint64)
             n = k.shape[0]
@@ -911,6 +914,11 @@ class DeviceFeatureStore:
                 "w_state": np.empty((0, self.kw), np.float32),
                 "show": np.empty((0,), np.float32),
                 "click": np.empty((0,), np.float32)}
+
+    def reset(self) -> None:
+        """Drop everything (pass-retry rollback — see FeatureStore.reset):
+        fresh key index, zeroed HBM block, clean delta set."""
+        self.set_all(np.empty((0,), np.uint64), self._empty_vals())
 
     def _save_arrays(self, path: str, keys, vals, kind: str) -> None:
         os.makedirs(path, exist_ok=True)

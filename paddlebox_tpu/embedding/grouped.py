@@ -82,6 +82,15 @@ class GroupedStore:
                     return sum(m.save_xbox(self._subdir(path, g))
                                for m, g in zip(members, groups))
                 return save_xbox
+        if name == "reset":
+            # Pass-retry rollback: forwarded only when EVERY member can
+            # reset, so hasattr(store, "reset") stays truthful.
+            members = [g.engine.store for g in groups]
+            if all(hasattr(m, "reset") for m in members):
+                def reset() -> None:
+                    for m in members:
+                        m.reset()
+                return reset
         raise AttributeError(name)
 
     def save_base(self, path: str) -> None:
@@ -183,6 +192,11 @@ class GroupedEngine:
         """Drop the active pass without write-back (eval/test mode)."""
         for g in self.groups:
             g.engine.abort_pass()
+
+    def abort_if_active(self) -> None:
+        """Drop any active pass, no-op otherwise (retry rollback)."""
+        for g in self.groups:
+            g.engine.abort_if_active()
 
     def cancel_pending(self) -> None:
         for g in self.groups:

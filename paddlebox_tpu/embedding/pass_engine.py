@@ -20,7 +20,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddlebox_tpu.core import flags, log, monitor, timers
+from paddlebox_tpu.core import faults, flags, log, monitor, timers
 from paddlebox_tpu.embedding.store import FeatureStore
 from paddlebox_tpu.embedding.table import (PassTable, TableConfig,
                                            build_pass_table_host,
@@ -94,6 +94,7 @@ class PassEngine:
     def _build(self, pass_keys: np.ndarray, pending: _PendingPass,
                readonly: bool = False) -> None:
         try:
+            faults.faultpoint("pass_engine/build")
             with self.timers.scope("feed_pass"):
                 # Key dedup can overlap the active pass... (native
                 # multi-threaded dedup, role of PreBuildTask,
@@ -219,6 +220,7 @@ class PassEngine:
         pending visible — feed_pass publishes ``_pending`` before the
         builder starts); the ``_no_active_pass`` check is both the
         no-active fast path and a poll-rate safety net."""
+        faults.faultpoint("pass_engine/boundary")
         with self.timers.scope("feed_wait"):
             while True:
                 if pending.cancel.is_set():
@@ -341,6 +343,13 @@ class PassEngine:
         return map_keys_to_rows(self._current_keys, batch_keys,
                                 self._table.rows_per_shard, self.num_shards)
 
+    def abort_if_active(self) -> None:
+        """Error-path twin of :meth:`abort_pass`: drop the active pass if
+        there is one, no-op otherwise — the pass-retry rollback cannot
+        know whether the failure hit before or after begin_pass."""
+        if self._table is not None:
+            self.abort_pass()
+
     def abort_pass(self) -> None:
         """Drop the active pass WITHOUT writing back (role of the test
         mode, SetTestMode: eval passes must not dirty or grow the store)."""
@@ -388,6 +397,7 @@ class PassEngine:
         store inside the program)."""
         if self._table is None or self._current_keys is None:
             raise RuntimeError("end_pass without begin_pass")
+        faults.faultpoint("pass_engine/write_back")
         with self.timers.scope("end_pass"):
             if self._current_rows is not None and hasattr(
                     self.store, "push_pass_table"):

@@ -370,6 +370,19 @@ class FeatureStore:
             self._dirty_parts = []
             self._shrunk_since_base = False
 
+    def reset(self) -> None:
+        """Drop everything (pass-retry rollback: a failed attempt's key
+        insertions/write-backs are wiped before the recovery-chain
+        reload replays the published state)."""
+        d = self.config.dim
+        self.set_all(np.empty((0,), np.uint64), {
+            "emb": np.empty((0, d), np.float32),
+            "emb_state": np.empty((0, self._ke), np.float32),
+            "w": np.empty((0,), np.float32),
+            "w_state": np.empty((0, self._kw), np.float32),
+            "show": np.empty((0,), np.float32),
+            "click": np.empty((0,), np.float32)})
+
     def load(self, path: str, kind: str = "base") -> None:
         """Load a base snapshot, or apply a delta on top."""
         data = np.load(os.path.join(path, f"{self.config.name}.{kind}.npz"))

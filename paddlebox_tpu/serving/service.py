@@ -98,8 +98,12 @@ class PredictClient:
     """Blocking client for one serving endpoint."""
 
     def __init__(self, endpoint: str, timeout: float = 60.0):
+        # predict/stats are pure reads: a serving blip reconnects and
+        # retries them under the rpc retry flags; apply_delta/stop are
+        # NOT idempotent and surface connection errors to the caller.
         self._conn = rpc.FramedRPCConn(endpoint, timeout=timeout,
-                                       service_name="serving")
+                                       service_name="serving",
+                                       idempotent=("predict", "stats"))
 
     def predict(self, lines: List[str]) -> np.ndarray:
         # The wire serializes str natively (utf-8 frames) — no

@@ -28,7 +28,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddlebox_tpu.core import flags, log, monitor, report, timers, trace
+from paddlebox_tpu.core import (faults, flags, log, monitor, report, timers,
+                                trace, watchdog)
 from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
 from paddlebox_tpu.embedding import TableConfig, make_sparse_optimizer
@@ -697,6 +698,7 @@ class CTRTrainer:
         if self.params is None:
             raise RuntimeError("call init() first")
         report.init_telemetry_from_flags()
+        faults.init_from_flags()
         pass_t0 = time.perf_counter()
         stage_base = self.timers.snapshot_ms()
         boundary_base = self.engine.boundary_ms()
@@ -744,6 +746,7 @@ class CTRTrainer:
                                                     labels, valid, dense)
                         loss = jnp.sum(losses)
                 n_blocks += 1
+                watchdog.beat()
                 monitor.observe("trainer/dispatch_ms",
                                 (time.perf_counter() - t_disp0) * 1e3)
                 loss_sum = loss if loss_sum is None else loss_sum + loss
@@ -879,10 +882,12 @@ class CTRTrainer:
             # Stage split (PrintSyncTimer vocabulary): "pull" is the host
             # half of PullSparse (feasign -> device-row keymap, the
             # CopyKeys role); "pack" is batch assembly + dtype prep.
+            faults.faultpoint("trainer/map_ahead")
             with self.timers.scope("pull"), trace.span("prefetch/keymap"):
                 return self._map_batch_rows_host(batch)
 
         def _pack_host(batch, rows_h):
+            faults.faultpoint("trainer/pack")
             with self.timers.scope("pack"):
                 dense_h = _concat_dense_host(batch)
                 if dense_bf16:
@@ -919,6 +924,7 @@ class CTRTrainer:
                 # slice/channel pop — the reference's ReadInstance
                 # timer); separate from pack/pull so a starved pass
                 # is distinguishable from a slow keymap.
+                faults.faultpoint("trainer/prefetch")
                 with self.timers.scope("read"):
                     return next(it, _EOF)
 
@@ -938,6 +944,7 @@ class CTRTrainer:
                     rows_h = (fut.result() if fut is not None
                               else _map_rows_timed(batch))
                     if k == 1:
+                        faults.faultpoint("trainer/pack")
                         with self.timers.scope("host_map"), \
                                 trace.span("prefetch/host_map"):
                             with self.timers.scope("pack"):
@@ -1101,6 +1108,7 @@ class CTRTrainer:
         # reads the totals), and seg-cache counters. NOTHING below adds
         # ops or syncs to the jitted step.
         report.init_telemetry_from_flags()
+        faults.init_from_flags()
         pass_t0 = time.perf_counter()
         stage_base = self.timers.snapshot_ms()
         boundary_base = self.engine.boundary_ms()
@@ -1303,6 +1311,9 @@ class CTRTrainer:
                      blk_overflows, blk_finites) = out
                     blk_overflow = jnp.sum(blk_overflows)
             self._dispatch_blocks += 1
+            # Stall-watchdog heartbeat: per-block dispatch progress is
+            # the liveness signal (one cached-bool no-op when disarmed).
+            watchdog.beat()
             disp_s = time.perf_counter() - t_disp0
             # Step-latency distribution (host-observed block enqueue
             # wall): the pass report's histogram feed.

@@ -19,13 +19,15 @@ from __future__ import annotations
 
 import glob
 import os
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from paddlebox_tpu.checkpoint.protocol import (CheckpointProtocol,
                                                get_online_pass_interval)
-from paddlebox_tpu.core import log, monitor, report, timers, trace
+from paddlebox_tpu.core import (faults, flags, log, monitor, report, timers,
+                                trace, watchdog)
 from paddlebox_tpu.data.dataset import Dataset
 
 
@@ -64,6 +66,10 @@ class DayRunner:
         self.pipeline_passes = pipeline_passes
         self.is_rank0 = is_rank0
         self.timers = timers.TimerGroup()
+        # Pipelined next-pass preload in flight (train_day): the pass
+        # retry path must be able to join + invalidate it, so the handle
+        # lives on self, not in train_day's locals.
+        self._inflight_preload = None
 
     # -- data addressing ---------------------------------------------------
 
@@ -86,7 +92,10 @@ class DayRunner:
                     os.path.join(model_dir, "dense.npz"))
 
     def _load_dense(self, model_dir: str) -> bool:
-        from paddlebox_tpu.checkpoint.dense import load_pytree
+        import zipfile
+
+        from paddlebox_tpu.checkpoint.dense import (CheckpointCorruptError,
+                                                    load_pytree)
         path = os.path.join(model_dir, "dense.npz")
         if not os.path.exists(path):
             return False
@@ -94,6 +103,15 @@ class DayRunner:
                     "opt_state": self.trainer.opt_state}
         try:
             state, _step = load_pytree(template, path)
+        except (CheckpointCorruptError, zipfile.BadZipFile, EOFError,
+                ValueError, OSError) as e:
+            # Torn/corrupt dense.npz (crash mid-write before the fsync
+            # discipline existed, disk corruption): one more warned
+            # skip-to-older-record case — the restart this checkpoint
+            # exists to serve must not die on it.
+            log.warning("day_runner: dense checkpoint %s is corrupt "
+                        "(%s) — skipping it", path, e)
+            return False
         except KeyError as e:
             # Structure mismatch — e.g. the optimizer config changed
             # (grad_clip_norm re-nests opt_state under optax.chain) since
@@ -166,6 +184,7 @@ class DayRunner:
 
     def _load_dataset(self, day: str, pass_id: int,
                       files: List[str]) -> Dataset:
+        faults.faultpoint("day_runner/load")
         ds = Dataset(self.feed_config,
                      num_reader_threads=self.num_reader_threads)
         ds.set_filelist(files)
@@ -200,6 +219,7 @@ class DayRunner:
 
         def body():
             try:
+                faults.faultpoint("day_runner/preload")
                 out["ds"] = self._load_dataset(day, pass_id, files)
                 self._feed_keys(out["ds"], async_build=True)
             except BaseException as e:
@@ -208,6 +228,7 @@ class DayRunner:
         t = threading.Thread(target=body, daemon=True)
         t.start()
         out["thread"] = t
+        self._inflight_preload = out
         return out
 
     def train_pass(self, day: str, pass_id: int, files: List[str], *,
@@ -215,27 +236,134 @@ class DayRunner:
                    feed_keys: bool = True) -> Dict[str, float]:
         """One online pass: load → shuffle → train → delta checkpoint.
         ``dataset``/``feed_keys`` let the pipelined day loop hand in a
-        preloaded dataset whose table build is already in flight."""
-        try:
-            return self._train_pass_inner(day, pass_id, files,
-                                          dataset=dataset,
-                                          feed_keys=feed_keys)
-        except BaseException:
-            # EVERY failure path drops the pending build (load error,
-            # train-step error, checkpoint error): an exception between
-            # feed_pass and begin_pass would otherwise orphan a build
-            # holding the one-slot semaphore — a retry (or the elastic
-            # restart's next pass) would deadlock in feed_pass or
-            # silently consume the wrong pass's table/keymap. The
-            # engine's cancellable boundary wait makes this safe even
-            # when the failed pass never ran end_pass.
-            self.trainer.engine.cancel_pending()
-            raise
+        preloaded dataset whose table build is already in flight.
+
+        Self-healing (``FLAGS_pass_max_retries``): a TRANSIENT failure
+        (IO/connection/timeout, an injected drill fault, a watchdog
+        stall) costs one pass retry, not the day — each retry drops the
+        pending build, rolls the sparse store + dense state back to the
+        last published record, reloads the pass's data with its
+        deterministic shuffle, and replays; the retried pass is
+        bit-identical to an unfailed run. Fatal errors (bad data, NaN
+        loss, code bugs) raise immediately."""
+        max_retries = max(0, int(flags.flag("pass_max_retries")))
+        # Dense pre-pass snapshot (HOST copies — the train step donates
+        # the device buffers, so by failure time the originals are
+        # deleted): the rollback source when NO published record carries
+        # dense state yet (a first-day first-pass failure — self.params
+        # is only committed at train_pass success, so this equals the
+        # last published dense whenever one exists).
+        dense_snap = None
+        if max_retries:
+            import jax
+            dense_snap = jax.tree.map(
+                lambda x: np.array(x),
+                (self.trainer.params, self.trainer.opt_state))
+        attempt = 0
+        while True:
+            wd_armed = watchdog.arm_from_flags(
+                phase=f"day {day} pass {pass_id}")
+            try:
+                return self._train_pass_inner(day, pass_id, files,
+                                              dataset=dataset,
+                                              feed_keys=feed_keys)
+            except BaseException as e:
+                # EVERY failure path drops the pending build (load error,
+                # train-step error, checkpoint error): an exception
+                # between feed_pass and begin_pass would otherwise orphan
+                # a build holding the one-slot semaphore — a retry (or
+                # the elastic restart's next pass) would deadlock in
+                # feed_pass or silently consume the wrong pass's
+                # table/keymap. The engine's cancellable boundary wait
+                # makes this safe even when the failed pass never ran
+                # end_pass.
+                self.trainer.engine.cancel_pending()
+                if attempt >= max_retries or not faults.is_transient(e):
+                    raise
+                attempt += 1
+                monitor.add("pass/retries", 1)
+                log.warning(
+                    "day %s pass %d failed with transient %s: %r — "
+                    "rolling back and retrying (%d/%d)", day, pass_id,
+                    type(e).__name__, e, attempt, max_retries)
+                trace.instant("pass/retry", day=day, pass_id=pass_id,
+                              attempt=attempt, error=repr(e))
+                self._rollback_for_retry(dense_snap)
+                backoff = min(
+                    float(flags.flag("pass_retry_backoff_s"))
+                    * (2.0 ** (attempt - 1)),
+                    float(flags.flag("pass_retry_backoff_max_s")))
+                if backoff > 0:
+                    time.sleep(backoff)
+                # Replay from scratch: the handed-in dataset/build may be
+                # partially consumed or mid-flight — a fresh load with
+                # the deterministic day:pass shuffle seed reproduces the
+                # exact batch order of an unfailed run.
+                dataset, feed_keys = None, True
+            finally:
+                if wd_armed:
+                    watchdog.disarm()
+
+    def _rollback_for_retry(self, dense_snap) -> None:
+        """Restore the model to the last published state so the retry
+        replays the pass against exactly the inputs an unfailed run
+        would have seen.
+
+        - Active pass dropped WITHOUT write-back (it may be mid-train).
+        - Sparse store reset and rebuilt from ``recovery_chain()`` (the
+          failed attempt may have inserted the pass's unseen keys, or —
+          when the failure hit AFTER end_pass, in save/publish — already
+          written the pass's updates back; replaying on top would
+          double-apply them).
+        - Dense state from the newest published record carrying it,
+          falling back to the pre-pass in-memory snapshot (identical
+          whenever a published record exists; the only source before the
+          first publish).
+        """
+        eng = self.trainer.engine
+        # An in-flight NEXT-pass preload (pipelined day loop) may still
+        # be loading data or building its table: join it so its
+        # feed_pass has happened, then cancel that build too — its
+        # boundary state is stale after the rollback. The slot it would
+        # wait on is already free (the caller's cancel_pending ran).
+        pre = getattr(self, "_inflight_preload", None)
+        if pre is not None and pre.get("thread") is not None:
+            pre["thread"].join()
+            pre["cancelled"] = True
+        eng.cancel_pending()
+        eng.abort_if_active()
+        store = eng.store
+        base, deltas = self.ckpt.recovery_chain()
+        if hasattr(store, "reset"):
+            store.reset()
+        elif base is None:
+            log.warning("day_runner: store %s has no reset(); rollback "
+                        "without a base may leave the failed attempt's "
+                        "writes in place", type(store).__name__)
+        if base is not None:
+            store.load(base.path, "base")
+        for d in deltas:
+            store.load(d.path, "delta")
+        for rec in [*reversed(deltas)] + ([base] if base else []):
+            if self._load_dense(rec.path):
+                log.vlog(0, "day_runner: rollback dense from %s", rec.path)
+                break
+        else:
+            import jax
+            params, opt = dense_snap
+            if self.trainer.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                rep = NamedSharding(self.trainer.mesh, P())
+                params = jax.device_put(params, rep)
+                opt = jax.device_put(opt, rep)
+            self.trainer.params, self.trainer.opt_state = params, opt
+        monitor.add("pass/rollbacks", 1)
 
     def _train_pass_inner(self, day: str, pass_id: int, files: List[str],
                           *, dataset: Optional[Dataset],
                           feed_keys: bool) -> Dict[str, float]:
         report.init_telemetry_from_flags()
+        faults.init_from_flags()
         with self.timers.scope("load"), \
                 trace.span("day/load", day=day, pass_id=pass_id):
             ds = dataset if dataset is not None else self._load_dataset(
@@ -250,6 +378,7 @@ class DayRunner:
             with self.timers.scope("save_delta"), \
                     trace.span("day/save_delta", day=day,
                                pass_id=pass_id):
+                faults.faultpoint("day_runner/save")
                 mdir = self.ckpt.model_dir(day, pass_id)
                 self.trainer.engine.store.save_delta(mdir)
                 # Dense state rides with every sparse checkpoint (role
@@ -258,6 +387,7 @@ class DayRunner:
                 # dense towers from init would resume an inconsistent
                 # model. data_norm stats live in params and ride too.
                 self._save_dense(mdir)
+                faults.faultpoint("day_runner/publish")
                 self.ckpt.publish(day, pass_id)
             if self.save_xbox and hasattr(self.trainer.engine.store,
                                           "save_xbox"):
@@ -290,6 +420,10 @@ class DayRunner:
         must not retrain it and republish its passes (observed: a
         post-completion join regenerated deltas 1..6 over a finished
         day before this guard)."""
+        # Arm fault injection before the FIRST dataset load/preload —
+        # waiting for train_pass would leave the early load sites
+        # un-drillable (and racy from the preload thread).
+        faults.init_from_flags()
         if start_pass is None:
             p = getattr(self, "_recover_point", None)
             if p is not None and p["day"] == str(day):
@@ -319,9 +453,16 @@ class DayRunner:
             for i, (pass_id, files) in enumerate(jobs):
                 if preloaded is not None:
                     preloaded["thread"].join()
+                    self._inflight_preload = None
                     if preloaded["error"] is not None:
                         raise preloaded["error"]
                     ds, feed_keys = preloaded["ds"], False
+                    if preloaded.get("cancelled"):
+                        # The previous pass's retry rollback cancelled
+                        # this preload's table build — re-feed from the
+                        # (still loaded) dataset so begin_pass has a
+                        # fresh build against the rolled-back store.
+                        self._feed_keys(ds)
                 elif self.pipeline_passes:
                     # First pass of the day: load + feed here so training
                     # can begin while the NEXT pass preloads. Async build
@@ -345,6 +486,7 @@ class DayRunner:
             # would consume the orphaned (wrong-pass) table/keymap.
             if preloaded is not None:
                 preloaded["thread"].join()
+            self._inflight_preload = None
             self.trainer.engine.cancel_pending()
             raise
         if not all_stats and not resumed_past:
@@ -362,9 +504,11 @@ class DayRunner:
             with self.timers.scope("day_end"), \
                     trace.span("day/day_end", day=day):
                 evicted = store.shrink(min_show=self.min_show_shrink)
+                faults.faultpoint("day_runner/day_end_save")
                 bdir = self.ckpt.model_dir(day, pass_id=-1)
                 store.save_base(bdir)
                 self._save_dense(bdir)
+                faults.faultpoint("day_runner/publish")
                 self.ckpt.publish(day, pass_id=-1)
         elif getattr(store, "shared", False):
             # Shared backing tier (e.g. PSBackedStore): rank 0 already

@@ -19,7 +19,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.core import faults, log, monitor
 from paddlebox_tpu.data.channel import Channel, ClosedChannelError
 
 
@@ -43,10 +43,14 @@ class DumpWriter:
                         lines = self._ch.get()
                     except ClosedChannelError:
                         return
+                    faults.faultpoint("dump/write")
                     f.write(lines)
                     monitor.add("dump/lines", lines.count("\n"))
         except BaseException as e:
             self._error = e
+            monitor.add("fault/dump_errors", 1)
+            log.warning("dump writer for %s died: %r — the next "
+                        "write_batch/close raises it", self.path, e)
             # Close so a blocked producer wakes up (put raises on closed)
             # instead of hanging on a full channel; write_batch re-raises
             # the root cause.
@@ -57,7 +61,13 @@ class DumpWriter:
                     ins_ids: Optional[Sequence[str]] = None,
                     extra: Optional[Dict[str, np.ndarray]] = None) -> None:
         """Queue one batch of prediction lines:
-        ``<ins_id>\\t<pred>\\t<label>[\\t<extra>...]``."""
+        ``<ins_id>\\t<pred>\\t<label>[\\t<extra>...]``.
+
+        A writer-thread failure (disk full, IO error) surfaces HERE on
+        the next call — with the ORIGINAL exception — not silently at
+        close() after an entire pass of dropped lines."""
+        if self._error is not None:
+            raise self._error
         preds = np.asarray(preds).reshape(-1)
         labels = np.asarray(labels).reshape(-1)
         n = preds.shape[0]

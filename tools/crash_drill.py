@@ -1,0 +1,274 @@
+"""Crash drill harness: kill a real training process at a chosen
+faultpoint, restart it with ``resume=True``, and diff the final state
+against an uninterrupted run.
+
+The donefile protocol's whole value proposition — "a SIGKILL costs at
+most the in-flight pass" — is only proven by actually dying. This tool
+runs a short 2-pass deepfm day in a subprocess with
+``FLAGS_fault_spec='<site>:hit=N:kill'`` so the process SIGKILLs itself
+the instant it reaches the chosen site (deterministic — no sleep/poll
+races), restarts the same job with recovery enabled, and byte-compares
+the final model (dense params digest, sparse store digest, per-pass
+losses) against a never-killed reference run.
+
+Usage::
+
+    python tools/crash_drill.py                     # fast 2-site drill
+    python tools/crash_drill.py --full              # full site matrix
+    python tools/crash_drill.py --site checkpoint/publish --hit 2
+    python tools/crash_drill.py --worker DATA OUT RESULT [--resume]
+
+Fast mode's two sites are the tier-1 CI drill
+(``tests/test_self_heal.py``); the full matrix is in the slow tier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DAY = "20260728"
+SLOTS = ("user", "item")
+HOURS = (0, 1)
+ROWS_PER_SPLIT = 64
+
+# (site, hit) pairs. Fast = the two crash windows that matter most:
+# model files written but index not yet swapped (checkpoint/publish)
+# and death before any files exist (day_runner/save). The full matrix
+# adds every other save/publish-adjacent window.
+FAST_SITES = [("day_runner/save", 1), ("checkpoint/publish", 2)]
+FULL_SITES = FAST_SITES + [
+    ("checkpoint/publish", 1),
+    ("day_runner/publish", 1),
+    ("day_runner/day_end_save", 1),
+    ("day_runner/load", 2),
+]
+
+
+def write_day(data_root: str, day: str = DAY, hours=HOURS,
+              rows_per_split: int = ROWS_PER_SPLIT) -> None:
+    """Deterministic tiny day of CTR text data (the test_day_runner
+    generator, shared so drill and tests agree on inputs)."""
+    import numpy as np
+    rng = np.random.default_rng(int(day))
+    for h in hours:
+        d = os.path.join(data_root, day, f"{h:02d}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "part-00000"), "w") as f:
+            for _ in range(rows_per_split):
+                feats = {s: rng.integers(1, 120, rng.integers(1, 3))
+                         for s in SLOTS}
+                click = float(np.mean([(int(v) % 5 == 0)
+                                       for vs in feats.values()
+                                       for v in vs]))
+                label = int(rng.random() < 0.1 + 0.8 * click)
+                toks = " ".join(f"{s}:{v}" for s, vs in feats.items()
+                                for v in vs)
+                f.write(f"{label} {toks}\n")
+
+
+# ---------------------------------------------------------------------------
+# worker (runs in the subprocess that gets killed / resumed)
+# ---------------------------------------------------------------------------
+
+def _digest(arrays) -> str:
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def worker_main(data: str, out: str, result: str, *,
+                resume: bool) -> None:
+    import numpy as np
+
+    from paddlebox_tpu.data import DataFeedConfig, SlotConf
+    from paddlebox_tpu.embedding import TableConfig
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+    from paddlebox_tpu.train.day_runner import DayRunner
+
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.5) for s in SLOTS),
+        batch_size=32)
+    trainer = CTRTrainer(
+        DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
+        TableConfig(name="emb", dim=8, learning_rate=0.1), mesh=mesh,
+        config=TrainerConfig(dense_learning_rate=3e-3,
+                             auc_num_buckets=1 << 10))
+    trainer.init(seed=0)
+    runner = DayRunner(trainer, feed, out, data_root=data,
+                       split_interval=60, split_per_pass=1,
+                       hours=list(HOURS), num_reader_threads=2)
+    stats = runner.run_days([DAY], resume=resume)
+
+    import jax
+    store = trainer.engine.store
+    keys = np.sort(store.key_stats()[0])
+    vals = store.pull_for_pass(keys)
+    payload = {
+        "losses": [round(float(s["loss"]), 10)
+                   for s in stats.get(DAY, [])],
+        "trained_passes": len(stats.get(DAY, [])),
+        "num_features": int(store.num_features),
+        "dense_digest": _digest(
+            [np.ascontiguousarray(x)
+             for x in jax.tree.leaves(jax.device_get(trainer.params))]
+            + [np.ascontiguousarray(x)
+               for x in jax.tree.leaves(
+                   jax.device_get(trainer.opt_state))]),
+        "store_digest": _digest(
+            [keys] + [np.ascontiguousarray(vals[f])
+                      for f in sorted(vals)]),
+        "records": [[r.day, r.pass_id] for r in runner.ckpt.records()],
+    }
+    tmp = result + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, result)
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+def run_worker(data: str, out: str, result: str, *, resume: bool,
+               fault_spec: str = "", timeout: float = 300.0,
+               log_path: str = "") -> int:
+    """Spawn one worker process; returns its exit code (negative =
+    killed by that signal, the expected outcome of a kill drill)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["FLAGS_fault_spec"] = fault_spec
+    args = [sys.executable, os.path.abspath(__file__), "--worker",
+            data, out, result]
+    if resume:
+        args.append("--resume")
+    logf = open(log_path, "ab") if log_path else subprocess.DEVNULL
+    try:
+        proc = subprocess.run(args, env=env, cwd=REPO, timeout=timeout,
+                              stdout=logf, stderr=subprocess.STDOUT)
+    finally:
+        if log_path:
+            logf.close()
+    return proc.returncode
+
+
+def run_reference(workdir: str, *, timeout: float = 300.0) -> dict:
+    """Uninterrupted run on a fresh output dir — the parity baseline."""
+    data = os.path.join(workdir, "data")
+    if not os.path.isdir(os.path.join(data, DAY)):
+        write_day(data)
+    out = os.path.join(workdir, "ref_out")
+    result = os.path.join(workdir, "ref.json")
+    rc = run_worker(data, out, result, resume=True, timeout=timeout,
+                    log_path=os.path.join(workdir, "ref.log"))
+    if rc != 0:
+        raise RuntimeError(f"reference run failed rc={rc} "
+                           f"(see {workdir}/ref.log)")
+    with open(result) as f:
+        return json.load(f)
+
+
+def run_drill(workdir: str, site: str, *, hit: int = 1,
+              reference: dict | None = None,
+              timeout: float = 300.0) -> dict:
+    """Kill at ``site`` (hit N), restart with resume, diff vs reference.
+    Returns {"ok", "killed_rc", "site", "hit", "drilled", "reference",
+    "mismatch"}."""
+    data = os.path.join(workdir, "data")
+    if not os.path.isdir(os.path.join(data, DAY)):
+        write_day(data)
+    tag = site.replace("/", "_") + f"_h{hit}"
+    out = os.path.join(workdir, f"out_{tag}")
+    result = os.path.join(workdir, f"result_{tag}.json")
+    log = os.path.join(workdir, f"{tag}.log")
+
+    rc = run_worker(data, out, result, resume=True,
+                    fault_spec=f"{site}:hit={hit}:kill",
+                    timeout=timeout, log_path=log)
+    if rc == 0:
+        # The site was never reached — a drill that doesn't kill proves
+        # nothing and usually means the site moved.
+        return {"ok": False, "site": site, "hit": hit, "killed_rc": rc,
+                "mismatch": ["faultpoint never reached (rc=0)"]}
+
+    rc2 = run_worker(data, out, result, resume=True, fault_spec="",
+                     timeout=timeout, log_path=log)
+    if rc2 != 0:
+        return {"ok": False, "site": site, "hit": hit, "killed_rc": rc,
+                "mismatch": [f"resume run failed rc={rc2} (see {log})"]}
+    with open(result) as f:
+        drilled = json.load(f)
+    ref = reference if reference is not None else run_reference(
+        workdir, timeout=timeout)
+
+    mismatch = []
+    for k in ("num_features", "dense_digest", "store_digest", "records"):
+        if drilled[k] != ref[k]:
+            mismatch.append(
+                f"{k}: drilled {drilled[k]!r} != reference {ref[k]!r}")
+    # The resumed process only retrains from the crash point on, so its
+    # loss list is a SUFFIX of the reference's.
+    n = len(drilled["losses"])
+    if n and drilled["losses"] != ref["losses"][-n:]:
+        mismatch.append(f"losses: {drilled['losses']} != "
+                        f"tail of {ref['losses']}")
+    return {"ok": not mismatch, "site": site, "hit": hit,
+            "killed_rc": rc, "drilled": drilled, "reference": ref,
+            "mismatch": mismatch}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker", nargs=3,
+                    metavar=("DATA", "OUT", "RESULT"))
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--site", help="drill one site")
+    ap.add_argument("--hit", type=int, default=1)
+    ap.add_argument("--full", action="store_true",
+                    help="run the full site matrix (slow)")
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        worker_main(*args.worker, resume=args.resume)
+        return 0
+
+    import tempfile
+    workdir = args.workdir or tempfile.mkdtemp(prefix="crash_drill_")
+    sites = ([(args.site, args.hit)] if args.site
+             else (FULL_SITES if args.full else FAST_SITES))
+    t0 = time.time()
+    ref = run_reference(workdir)
+    results = []
+    for site, hit in sites:
+        r = run_drill(workdir, site, hit=hit, reference=ref)
+        results.append(r)
+        print(json.dumps({k: r[k] for k in
+                          ("ok", "site", "hit", "killed_rc", "mismatch")
+                          if k in r}), flush=True)
+    ok = all(r["ok"] for r in results)
+    print(json.dumps({"metric": "crash_drill",
+                      "ok": ok,
+                      "sites": len(results),
+                      "wall_s": round(time.time() - t0, 1),
+                      "workdir": workdir}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
