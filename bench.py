@@ -571,6 +571,14 @@ def bench_deepfm() -> dict:
         "build_ms": (stats.get("boundary") or {}).get("build_ms"),
         "feed_wait_ms": (stats.get("boundary") or {}).get("feed_wait_ms"),
         "overlap_frac": (stats.get("boundary") or {}).get("overlap_frac"),
+        # Critical-path attribution (round 11): the pass's bottleneck
+        # verdict (bounding stage + device idle fraction + per-stage
+        # busy/blocked shares + queue depths) and the dispatch-latency
+        # quantiles — what tools/perf_gate.py gates across rounds, so
+        # "store_build is the wall" is a machine-checked field, not a
+        # post-hoc bench analysis.
+        "bottleneck": stats.get("bottleneck"),
+        "dispatch_ms_quantiles": stats.get("dispatch_ms_quantiles"),
         "pass_split_build": bool(flags.flag("pass_split_build")),
         "pass_boundary_fuse": flags.flag("pass_boundary_fuse"),
         "load_s": round(t_load, 3),
@@ -1100,12 +1108,28 @@ def bench_serving() -> dict:
     dt = time.perf_counter() - t0
     qps = SERVING_QUERY_BATCHES * SERVING_BATCH / dt
 
+    # Per-request latency digest (the SLO view, recorded beside the
+    # pipelined-throughput headline — NOT inside its timed loop, which
+    # must stay async to remain comparable with prior rounds): each
+    # predict here is synced so a sample is a real request latency.
+    _tick("serving:latency")
+    from paddlebox_tpu.core.quantiles import LogQuantileDigest
+    lat = LogQuantileDigest()
+    for b in batches:
+        tq = time.perf_counter()
+        float(pred.predict(b)[0])
+        lat.observe((time.perf_counter() - tq) * 1e3)
+    lat_q = {k: (round(v, 3) if v is not None else None)
+             for k, v in lat.quantiles().items()}
+
     return {
         "metric": "serving_predict_samples_per_sec",
         "value": round(qps, 1),
         "unit": "samples/s",
         "vs_baseline": _vs("serving", qps),
         "table_load_s": round(load_s, 3),
+        "predict_ms_quantiles": lat_q,
+        "serving_slo_p99_ms": float(flags.flag("serving_slo_p99_ms")),
         "serving_keys": SERVING_KEYS,
         "batch_size": SERVING_BATCH,
         "n_devices": len(jax.devices()),

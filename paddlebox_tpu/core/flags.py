@@ -376,6 +376,13 @@ define_flag("rpc_max_retries", 3,
 define_flag("rpc_retry_backoff_s", 0.05,
             "base of the capped exponential backoff between RPC retries "
             "(sleep = base * 2^(attempt-1), capped at 2s)")
+define_flag("serving_slo_p99_ms", 0.0,
+            "serving predict-latency SLO target in ms: every predict RPC "
+            "whose server-side latency exceeds it bumps the "
+            "slo/violations counter, and handle_stats reports the "
+            "p50/p90/p99/p999 latency quantiles against it so the "
+            "operator reads margin, not just breaches. <= 0 disables "
+            "(default) — quantiles are still recorded")
 define_flag("rpc_retry_deadline_s", 30.0,
             "overall wall-clock deadline across an idempotent call's "
             "retries: when exceeded the last connection error raises "

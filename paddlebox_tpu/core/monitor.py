@@ -17,11 +17,14 @@ starts.
 
 from __future__ import annotations
 
+import atexit
 import bisect
 import json
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
+
+from paddlebox_tpu.core.quantiles import LogQuantileDigest, merge_digests
 
 Number = Union[int, float]
 
@@ -75,9 +78,11 @@ class Monitor:
         self._stats: Dict[str, Number] = {}        # counters (add/set)
         self._gauges: Dict[str, float] = {}        # float set-last-wins
         self._hists: Dict[str, Histogram] = {}
+        self._digests: Dict[str, LogQuantileDigest] = {}
         self._flush_thread: Optional[threading.Thread] = None
         self._flush_stop = threading.Event()
         self._flush_path: Optional[str] = None
+        self._atexit_registered = False
 
     # -- counters (original StatRegistry API, unchanged) -------------------
 
@@ -130,6 +135,28 @@ class Monitor:
                 h = self._hists[name] = Histogram(buckets)
             h.observe(value)
 
+    # -- streaming quantile digests ------------------------------------------
+
+    def observe_quantile(self, name: str, value: float,
+                         rel_error: float = 0.01) -> None:
+        """Feed the named log-bucketed quantile sketch (created on first
+        observe). Unlike the fixed-bucket histogram, the digest needs no
+        pre-chosen bounds and merges across ranks — the p50/p90/p99/p999
+        source for the pass report and the serving SLO layer."""
+        with self._lock:
+            d = self._digests.get(name)
+            if d is None:
+                d = self._digests[name] = LogQuantileDigest(rel_error)
+            d.observe(value)
+
+    def quantile_digest(self, name: str
+                        ) -> Optional[LogQuantileDigest]:
+        """A COPY of the named digest (safe to keep as a window base for
+        :meth:`LogQuantileDigest.delta`); None when never observed."""
+        with self._lock:
+            d = self._digests.get(name)
+            return d.copy() if d is not None else None
+
     # -- snapshots -----------------------------------------------------------
 
     def snapshot(self) -> Dict[str, Number]:
@@ -151,6 +178,8 @@ class Monitor:
                 "gauges": dict(self._gauges),
                 "histograms": {n: h.to_dict()
                                for n, h in self._hists.items()},
+                "quantiles": {n: d.to_dict()
+                              for n, d in self._digests.items()},
             }
 
     def reset(self) -> None:
@@ -158,6 +187,7 @@ class Monitor:
             self._stats.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._digests.clear()
 
     # -- JSONL exporter -------------------------------------------------------
 
@@ -179,11 +209,31 @@ class Monitor:
             f.write(line + "\n")
         return path
 
+    def _atexit_flush(self) -> None:
+        """Final flush at interpreter exit: short-lived runs (tools,
+        crash drills) must not lose their last window just because no
+        pass report or flush tick landed before exit. Idempotent with
+        the periodic thread — it appends one more labeled snapshot, and
+        a de-configured exporter (stop_flush_thread ran) makes it a
+        no-op."""
+        try:
+            self.flush_jsonl(self._flush_path,
+                             labels={"event": "final_flush"})
+        except OSError:
+            pass
+
+    def _register_atexit(self) -> None:
+        if not self._atexit_registered:
+            self._atexit_registered = True
+            atexit.register(self._atexit_flush)
+
     def start_flush_thread(self, path: str,
                            interval_s: float = 30.0) -> bool:
         """Periodic background JSONL flusher (daemon). Idempotent; a
         non-positive interval means 'no thread' (pass-report flushes
-        still append)."""
+        still append). Arming the exporter also registers the one
+        atexit final flush."""
+        self._register_atexit()
         with self._lock:
             self._flush_path = path
             if interval_s <= 0 or (self._flush_thread is not None
@@ -225,6 +275,92 @@ class Monitor:
         return True
 
 
+# -- cluster-level aggregation ------------------------------------------------
+
+def merge_snapshots(snaps: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-rank ``snapshot_all()`` dicts into ONE cluster-level
+    snapshot (prep for multi-host: each rank keeps its own registry; a
+    collector folds them so the operator reads one report, not N).
+
+    Merge semantics per section:
+    - ``counters``: summed (they are totals — bytes, passes, retries).
+    - ``gauges``: arithmetic mean across the ranks that reported the
+      name, plus ``<name>__max`` for skew-sensitive reads (a mean hides
+      the one stalled rank; the max names it).
+    - ``histograms``: bucket-wise count addition (identical bucket
+      bounds required — mixed bounds raise, same as define_histogram).
+    - ``quantiles``: digest merge (the whole point of the log-bucketed
+      sketch — associative bucket addition, no accuracy loss).
+    """
+    if not snaps:
+        return {"ts": time.time(), "ranks": 0, "labels": {},
+                "counters": {}, "gauges": {}, "histograms": {},
+                "quantiles": {}}
+    counters: Dict[str, Number] = {}
+    for s in snaps:
+        for k, v in (s.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+    gauge_vals: Dict[str, List[float]] = {}
+    for s in snaps:
+        for k, v in (s.get("gauges") or {}).items():
+            gauge_vals.setdefault(k, []).append(float(v))
+    gauges: Dict[str, float] = {}
+    for k, vs in gauge_vals.items():
+        gauges[k] = sum(vs) / len(vs)
+        if len(vs) > 1:
+            gauges[k + "__max"] = max(vs)
+    hists: Dict[str, Dict[str, Any]] = {}
+    for s in snaps:
+        for k, h in (s.get("histograms") or {}).items():
+            cur = hists.get(k)
+            if cur is None:
+                hists[k] = {**h, "counts": list(h["counts"])}
+                continue
+            if list(cur["buckets"]) != list(h["buckets"]):
+                raise ValueError(
+                    f"histogram {k!r} has mismatched buckets across "
+                    f"ranks — cannot merge")
+            cur["counts"] = [a + b for a, b in zip(cur["counts"],
+                                                   h["counts"])]
+            cur["count"] += h["count"]
+            cur["sum"] = round(cur["sum"] + h["sum"], 6)
+            mins = [m for m in (cur["min"], h["min"]) if m is not None]
+            maxs = [m for m in (cur["max"], h["max"]) if m is not None]
+            cur["min"] = min(mins) if mins else None
+            cur["max"] = max(maxs) if maxs else None
+    digs: Dict[str, List[LogQuantileDigest]] = {}
+    for s in snaps:
+        for k, d in (s.get("quantiles") or {}).items():
+            digs.setdefault(k, []).append(LogQuantileDigest.from_dict(d))
+    quantiles = {k: merge_digests(ds).to_dict()
+                 for k, ds in digs.items()}
+    return {"ts": max(float(s.get("ts", 0.0)) for s in snaps),
+            "ranks": len(snaps),
+            "labels": dict(snaps[0].get("labels") or {}),
+            "counters": counters, "gauges": gauges,
+            "histograms": hists, "quantiles": quantiles}
+
+
+def collect_cluster_snapshot(store, *, labels: Optional[Dict[str, Any]]
+                             = None, key: str = "metrics_snapshot",
+                             timeout: float = 60.0,
+                             snapshot: Optional[Dict[str, Any]] = None,
+                             registry: Optional["Monitor"] = None
+                             ) -> Dict[str, Any]:
+    """All-gather every rank's registry snapshot through a FileStore
+    (``distributed.transport.FileStore`` — or anything with its
+    ``all_gather(name, bytes, timeout)`` contract) and return the ONE
+    merged cluster-level snapshot on every rank. Symmetric: all ranks
+    must call it (it is a rendezvous). Rank 0 typically writes the
+    result to the metrics JSONL with a ``{"event": "cluster_report"}``
+    label."""
+    reg = registry if registry is not None else GLOBAL
+    mine = snapshot if snapshot is not None else reg.snapshot_all(labels)
+    blobs = store.all_gather(key, json.dumps(mine, default=str).encode(),
+                             timeout=timeout)
+    return merge_snapshots([json.loads(b) for b in blobs])
+
+
 GLOBAL = Monitor()
 
 add = GLOBAL.add
@@ -236,6 +372,8 @@ reset = GLOBAL.reset
 set_gauge = GLOBAL.set_gauge
 get_gauge = GLOBAL.get_gauge
 observe = GLOBAL.observe
+observe_quantile = GLOBAL.observe_quantile
+quantile_digest = GLOBAL.quantile_digest
 define_histogram = GLOBAL.define_histogram
 flush_jsonl = GLOBAL.flush_jsonl
 start_flush_thread = GLOBAL.start_flush_thread

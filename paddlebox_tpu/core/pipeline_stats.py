@@ -1,0 +1,212 @@
+"""Pipeline occupancy + critical-path attribution.
+
+The pass report's stage timers (core/report.py) say how long each stage
+RAN; they cannot say who was blocked on whom — the question BENCH_r02's
+15.6%-of-device-only number actually poses. This module records, per
+pipeline stage, the three wall-time states that answer it:
+
+- **busy** — the stage was doing its own work;
+- **blocked_up** — waiting on its upstream (starved for input);
+- **blocked_down** — waiting on its downstream (output queue full);
+
+plus sampled queue depths (log-bucketed digests — core/quantiles.py),
+and computes a per-window ``bottleneck`` verdict: the bounding stage,
+the device idle fraction, and the host critical-path share. The stages
+wired today (all HOST-side — nothing here touches the jitted step):
+
+| stage      | where                                                   |
+|---|---|
+| ``reader`` | prefetch producer waiting on the dataset iterator        |
+| ``packer`` | batch assembly / K-stacking / H2D (+ put-wait = blocked_down) |
+| ``keymap`` | the map-ahead host keymap worker (CopyKeys role)         |
+| ``device`` | consumer: dispatch enqueue + blocking fetches = busy; queue get-wait = blocked_up (the device-starved signal) |
+| ``boundary`` | pass build (busy) vs time parked on the active pass (blocked_up) — fed from ``PassEngine.boundary_ms`` deltas |
+| ``day_load`` | day-loop dataset load (usually hidden under the previous pass) |
+
+Process-global like the metric registry; per-pass attribution windows
+come from :meth:`PipelineStats.snapshot` + :meth:`window` deltas, so
+multiple sequential passes (and trainers) share one recorder.
+
+Verdict semantics (classic pipeline analysis — the stage running
+closest to 100% utilization bounds throughput):
+
+- ``stage``: the stage with the highest busy share of the window.
+- ``device_idle_frac``: the consumer's blocked_up share — the fraction
+  of the pass the device had no new block to chew on (host-visible
+  starvation; an async dispatch queue means true device idle can only
+  be lower).
+- ``host_critical_share``: ``1 - device busy share`` — the fraction of
+  the pass wall NOT attributable to device dispatch/drain, i.e. what a
+  host-side fix could reclaim.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+from paddlebox_tpu.core.quantiles import LogQuantileDigest
+
+# Relative error of the queue-depth digests (depths are small ints; 2%
+# keeps the bucket count tiny).
+_QUEUE_REL_ERROR = 0.02
+
+KINDS = ("busy", "blocked_up", "blocked_down")
+
+
+class _Stage:
+    __slots__ = ("busy_s", "blocked_up_s", "blocked_down_s", "count")
+
+    def __init__(self) -> None:
+        self.busy_s = 0.0
+        self.blocked_up_s = 0.0
+        self.blocked_down_s = 0.0
+        self.count = 0
+
+
+class PipelineStats:
+    """Thread-safe per-stage occupancy recorder with queue-depth
+    digests. All methods are cheap (two perf_counter calls + one lock
+    per scope) — they run per BATCH, never per device op."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stages: Dict[str, _Stage] = {}
+        self._queues: Dict[str, LogQuantileDigest] = {}
+
+    # -- recording ---------------------------------------------------------
+
+    def add(self, stage: str, kind: str, seconds: float) -> None:
+        """Credit an externally-measured interval (the TimerGroup
+        ``add_elapsed`` idiom — used by tests and by callers that
+        already timed the interval)."""
+        if kind not in KINDS:
+            raise ValueError(f"unknown occupancy kind {kind!r}")
+        with self._lock:
+            st = self._stages.get(stage)
+            if st is None:
+                st = self._stages[stage] = _Stage()
+            setattr(st, kind + "_s", getattr(st, kind + "_s") + seconds)
+            st.count += 1
+
+    @contextmanager
+    def _scope(self, stage: str, kind: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(stage, kind, time.perf_counter() - t0)
+
+    def busy(self, stage: str):
+        return self._scope(stage, "busy")
+
+    def blocked_up(self, stage: str):
+        return self._scope(stage, "blocked_up")
+
+    def blocked_down(self, stage: str):
+        return self._scope(stage, "blocked_down")
+
+    def sample_queue(self, name: str, depth: int) -> None:
+        with self._lock:
+            d = self._queues.get(name)
+            if d is None:
+                d = self._queues[name] = LogQuantileDigest(
+                    _QUEUE_REL_ERROR)
+            d.observe(float(depth))
+
+    # -- windows -----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Cumulative state — the base for a per-pass :meth:`window`."""
+        with self._lock:
+            return {
+                "stages": {n: {"busy_s": s.busy_s,
+                               "blocked_up_s": s.blocked_up_s,
+                               "blocked_down_s": s.blocked_down_s,
+                               "count": s.count}
+                           for n, s in self._stages.items()},
+                "queues": {n: d.copy() for n, d in self._queues.items()},
+            }
+
+    def window(self, base: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
+        """Delta since ``base`` (a prior snapshot): per-stage ms in each
+        state + per-queue window digests. Stages with zero activity in
+        the window are dropped."""
+        now = self.snapshot()
+        base = base or {"stages": {}, "queues": {}}
+        stages: Dict[str, Dict[str, float]] = {}
+        for n, s in now["stages"].items():
+            b = base["stages"].get(n, {})
+            d = {"busy_ms": (s["busy_s"] - b.get("busy_s", 0.0)) * 1e3,
+                 "blocked_up_ms": (s["blocked_up_s"]
+                                   - b.get("blocked_up_s", 0.0)) * 1e3,
+                 "blocked_down_ms": (s["blocked_down_s"]
+                                     - b.get("blocked_down_s", 0.0))
+                 * 1e3,
+                 "count": s["count"] - b.get("count", 0)}
+            if d["count"] > 0 or any(d[k] > 1e-6 for k in
+                                     ("busy_ms", "blocked_up_ms",
+                                      "blocked_down_ms")):
+                stages[n] = {k: (round(v, 3) if k != "count" else v)
+                             for k, v in d.items()}
+        queues = {}
+        for n, d in now["queues"].items():
+            w = d.delta(base["queues"].get(n))
+            if w.count:
+                queues[n] = w
+        return {"stages": stages, "queues": queues}
+
+
+def bottleneck_verdict(window: Dict[str, Any], wall_ms: float,
+                       device_stage: str = "device") -> Dict[str, Any]:
+    """Compute the bounding-stage verdict from a :meth:`window` delta.
+
+    Pure and deterministic — tests feed synthetic windows. Returns a
+    JSON-safe dict: ``stage`` (bounding stage — highest busy share),
+    ``device_idle_frac``, ``host_critical_share``, per-stage
+    busy/blocked shares, and queue-depth percentiles."""
+    stages = window.get("stages") or {}
+    out: Dict[str, Any] = {"stage": None, "device_idle_frac": None,
+                           "host_critical_share": None, "stages": {},
+                           "queue_depth": {}}
+    if wall_ms <= 0 or not stages:
+        return out
+    shares: Dict[str, Dict[str, float]] = {}
+    for n, s in stages.items():
+        shares[n] = {
+            "busy_ms": round(s.get("busy_ms", 0.0), 3),
+            "busy_frac": round(s.get("busy_ms", 0.0) / wall_ms, 4),
+            "blocked_up_frac": round(
+                s.get("blocked_up_ms", 0.0) / wall_ms, 4),
+            "blocked_down_frac": round(
+                s.get("blocked_down_ms", 0.0) / wall_ms, 4),
+        }
+    out["stages"] = shares
+    out["stage"] = max(shares, key=lambda n: shares[n]["busy_frac"])
+    dev = shares.get(device_stage)
+    if dev is not None:
+        out["device_idle_frac"] = dev["blocked_up_frac"]
+        out["host_critical_share"] = round(
+            min(1.0, max(0.0, 1.0 - dev["busy_frac"])), 4)
+    for n, d in (window.get("queues") or {}).items():
+        qs = d.quantiles((0.5, 0.9, 0.99))
+        rnd = lambda v: round(v, 2) if v is not None else None  # noqa: E731
+        out["queue_depth"][n] = {
+            "p50": rnd(qs["p50"]), "p90": rnd(qs["p90"]),
+            "p99": rnd(qs["p99"]), "max": rnd(d.max),
+            "samples": d.count}
+    return out
+
+
+GLOBAL = PipelineStats()
+
+add = GLOBAL.add
+busy = GLOBAL.busy
+blocked_up = GLOBAL.blocked_up
+blocked_down = GLOBAL.blocked_down
+sample_queue = GLOBAL.sample_queue
+snapshot = GLOBAL.snapshot
+window = GLOBAL.window

@@ -1,0 +1,231 @@
+"""Streaming quantile sketch: a mergeable log-bucketed digest.
+
+Role of the quantile layer the fixed-bucket ``monitor.Histogram`` cannot
+play: the histogram's bounds must be chosen up front (1ms..30s latency
+buckets), so a value range it was not designed for — queue depths,
+key counts, sub-millisecond RPC latencies — degrades to "everything in
+one bucket". This digest is DDSketch-shaped (log-spaced buckets with a
+configurable RELATIVE error): bucket ``i`` covers
+``(gamma^(i-1), gamma^i]`` with ``gamma = (1+a)/(1-a)``, so any quantile
+estimate is within ``a`` (default 1%) of the true value, for ANY value
+range, with O(log(max/min)/a) memory and O(1) inserts.
+
+Mergeability is the point: two digests with the same ``rel_error`` merge
+by adding bucket counts (associative + commutative), so per-rank
+sketches combine into one cluster-level digest
+(``monitor.merge_snapshots``), and a cumulative digest supports per-pass
+windows by COUNT SUBTRACTION (:meth:`delta`) — the trainer keeps one
+digest per metric and reports each pass's p50/p90/p99/p999 from the
+window delta, no per-pass re-allocation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, Optional, Sequence
+
+# The quantile points every report surfaces (SLO vocabulary).
+DEFAULT_QS = (0.5, 0.9, 0.99, 0.999)
+
+
+def _q_name(q: float) -> str:
+    """0.5 -> 'p50', 0.999 -> 'p999' (the SLO field-name convention)."""
+    pct = q * 100.0
+    if abs(pct - round(pct)) < 1e-9:
+        return f"p{int(round(pct))}"
+    return "p" + f"{pct:g}".replace(".", "")
+
+
+class LogQuantileDigest:
+    """Log-bucketed quantile sketch with a bounded relative error.
+
+    Handles the full real line: positive values land in log buckets,
+    negative values in a mirrored set, zeros in their own counter — so
+    "unbounded-range" metrics (deltas, temperature-style gauges) sketch
+    correctly, not just latencies.
+    """
+
+    __slots__ = ("rel_error", "_gamma", "_log_gamma", "counts",
+                 "neg_counts", "zero_count", "count", "sum", "min", "max")
+
+    def __init__(self, rel_error: float = 0.01):
+        if not 0.0 < rel_error < 1.0:
+            raise ValueError(f"rel_error must be in (0, 1): {rel_error}")
+        self.rel_error = float(rel_error)
+        self._gamma = (1.0 + rel_error) / (1.0 - rel_error)
+        self._log_gamma = math.log(self._gamma)
+        self.counts: Dict[int, int] = {}
+        self.neg_counts: Dict[int, int] = {}
+        self.zero_count = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # -- inserts -----------------------------------------------------------
+
+    def _bucket(self, mag: float) -> int:
+        return int(math.ceil(math.log(mag) / self._log_gamma))
+
+    def _bucket_value(self, i: int) -> float:
+        # Midpoint estimate 2*gamma^i/(gamma+1): the worst-case relative
+        # error over the bucket's range equals rel_error exactly.
+        return 2.0 * self._gamma ** i / (self._gamma + 1.0)
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        self.min = v if v < self.min else self.min
+        self.max = v if v > self.max else self.max
+        if v > 0.0:
+            i = self._bucket(v)
+            self.counts[i] = self.counts.get(i, 0) + 1
+        elif v < 0.0:
+            i = self._bucket(-v)
+            self.neg_counts[i] = self.neg_counts.get(i, 0) + 1
+        else:
+            self.zero_count += 1
+
+    # -- queries -----------------------------------------------------------
+
+    def _ascending(self):
+        """Yield (estimate, count) in ascending value order: negatives
+        from most- to least-negative, zeros, positives ascending."""
+        for i in sorted(self.neg_counts, reverse=True):
+            yield -self._bucket_value(i), self.neg_counts[i]
+        if self.zero_count:
+            yield 0.0, self.zero_count
+        for i in sorted(self.counts):
+            yield self._bucket_value(i), self.counts[i]
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile estimate; None on an empty digest.
+        Guaranteed within ``rel_error`` (relative) of the true value."""
+        if self.count == 0:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1]: {q}")
+        rank = q * (self.count - 1)
+        cum = 0
+        est = None
+        for est, c in self._ascending():
+            cum += c
+            if cum > rank:
+                return est
+        return est  # numerical edge: q == 1.0
+
+    def quantiles(self, qs: Sequence[float] = DEFAULT_QS
+                  ) -> Dict[str, Optional[float]]:
+        return {_q_name(q): self.quantile(q) for q in qs}
+
+    # -- merge / window ----------------------------------------------------
+
+    def _check_compatible(self, other: "LogQuantileDigest") -> None:
+        if abs(other.rel_error - self.rel_error) > 1e-12:
+            raise ValueError(
+                f"cannot combine digests with rel_error "
+                f"{self.rel_error} vs {other.rel_error}")
+
+    def merge(self, other: "LogQuantileDigest") -> "LogQuantileDigest":
+        """In-place merge (bucket-count addition — associative and
+        commutative, the cluster-aggregation property). Returns self."""
+        self._check_compatible(other)
+        for i, c in other.counts.items():
+            self.counts[i] = self.counts.get(i, 0) + c
+        for i, c in other.neg_counts.items():
+            self.neg_counts[i] = self.neg_counts.get(i, 0) + c
+        self.zero_count += other.zero_count
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        return self
+
+    def copy(self) -> "LogQuantileDigest":
+        d = LogQuantileDigest(self.rel_error)
+        d.counts = dict(self.counts)
+        d.neg_counts = dict(self.neg_counts)
+        d.zero_count = self.zero_count
+        d.count = self.count
+        d.sum = self.sum
+        d.min = self.min
+        d.max = self.max
+        return d
+
+    def delta(self, base: Optional["LogQuantileDigest"]
+              ) -> "LogQuantileDigest":
+        """Window digest: the observations recorded since ``base`` (a
+        prior :meth:`copy` of this same digest). Count subtraction —
+        exact because inserts only ever add. The window's true min/max
+        are not recoverable from bucket counts; the delta reports its
+        quantile(0)/quantile(1) estimates instead (within rel_error)."""
+        if base is None:
+            return self.copy()
+        self._check_compatible(base)
+        d = LogQuantileDigest(self.rel_error)
+        for i, c in self.counts.items():
+            n = c - base.counts.get(i, 0)
+            if n > 0:
+                d.counts[i] = n
+        for i, c in self.neg_counts.items():
+            n = c - base.neg_counts.get(i, 0)
+            if n > 0:
+                d.neg_counts[i] = n
+        d.zero_count = max(0, self.zero_count - base.zero_count)
+        d.count = max(0, self.count - base.count)
+        d.sum = self.sum - base.sum
+        if d.count:
+            d.min = d.quantile(0.0)
+            d.max = d.quantile(1.0)
+        return d
+
+    # -- serialization -----------------------------------------------------
+
+    def to_dict(self, qs: Sequence[float] = DEFAULT_QS) -> Dict:
+        """JSON-safe snapshot: the merge state (bucket counts) PLUS the
+        derived quantile estimates, so a consumer that only wants p99
+        never needs to rebuild the digest."""
+        out = {
+            "rel_error": self.rel_error,
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+            "zero_count": self.zero_count,
+            "buckets": {str(i): c for i, c in self.counts.items()},
+            "neg_buckets": {str(i): c for i, c in self.neg_counts.items()},
+        }
+        out.update(self.quantiles(qs))
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "LogQuantileDigest":
+        out = cls(float(d.get("rel_error", 0.01)))
+        out.counts = {int(i): int(c)
+                      for i, c in (d.get("buckets") or {}).items()}
+        out.neg_counts = {int(i): int(c)
+                          for i, c in (d.get("neg_buckets") or {}).items()}
+        out.zero_count = int(d.get("zero_count", 0))
+        out.count = int(d.get("count", 0))
+        out.sum = float(d.get("sum", 0.0))
+        out.min = d.get("min")
+        out.max = d.get("max")
+        if out.min is None:
+            out.min = math.inf
+        if out.max is None:
+            out.max = -math.inf
+        return out
+
+
+def merge_digests(digests: Iterable[LogQuantileDigest]
+                  ) -> Optional[LogQuantileDigest]:
+    """Fold any number of compatible digests into a fresh one (None for
+    an empty iterable) — the per-rank collector's reduce step."""
+    out: Optional[LogQuantileDigest] = None
+    for d in digests:
+        if out is None:
+            out = d.copy()
+        else:
+            out.merge(d)
+    return out

@@ -105,6 +105,26 @@ def emit_pass_report(kind: str, *, steps: int, samples: int,
             v = b.get(k)
             if isinstance(v, (int, float)):
                 reg.set_gauge(f"pass/{kind}_boundary_{k}", float(v))
+    # Critical-path verdict (round 11): headline fractions + per-stage
+    # occupancy land as gauges under pipeline/ so trace_report.py can
+    # render the occupancy table from the metrics JSONL alone.
+    bn = summary.get("bottleneck")
+    if isinstance(bn, dict):
+        for k in ("device_idle_frac", "host_critical_share"):
+            v = bn.get(k)
+            if isinstance(v, (int, float)):
+                reg.set_gauge(f"pass/{kind}_{k}", float(v))
+        for stage, sh in (bn.get("stages") or {}).items():
+            for k in ("busy_ms", "busy_frac", "blocked_up_frac",
+                      "blocked_down_frac"):
+                v = sh.get(k)
+                if isinstance(v, (int, float)):
+                    reg.set_gauge(f"pipeline/{stage}_{k}", float(v))
+    dq = summary.get("dispatch_ms_quantiles")
+    if isinstance(dq, dict):
+        for k, v in dq.items():
+            if k != "count" and isinstance(v, (int, float)):
+                reg.set_gauge(f"pass/{kind}_dispatch_ms_{k}", float(v))
 
     line = json.dumps(summary, default=str)
     log.info("pass_report %s", line)

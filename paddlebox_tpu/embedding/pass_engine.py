@@ -20,7 +20,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddlebox_tpu.core import faults, flags, log, monitor, timers
+from paddlebox_tpu.core import (faults, flags, log, monitor,
+                                pipeline_stats, timers)
 from paddlebox_tpu.embedding.store import FeatureStore
 from paddlebox_tpu.embedding.table import (PassTable, TableConfig,
                                            build_pass_table_host,
@@ -221,7 +222,13 @@ class PassEngine:
         builder starts); the ``_no_active_pass`` check is both the
         no-active fast path and a poll-rate safety net."""
         faults.faultpoint("pass_engine/boundary")
-        with self.timers.scope("feed_wait"):
+        # Occupancy: the builder parked here is the boundary stage
+        # blocked on its upstream (the active pass owning the store).
+        # The per-pass verdict uses the engine's own boundary_ms deltas
+        # as the authoritative numbers; this feed keeps the raw
+        # occupancy view (trace_report) consistent with them.
+        with self.timers.scope("feed_wait"), \
+                pipeline_stats.GLOBAL.blocked_up("boundary"):
             while True:
                 if pending.cancel.is_set():
                     raise PassBuildCancelled(

@@ -17,13 +17,14 @@ consistent model versions for free.
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import List
 
 import numpy as np
 
-import time
-
-from paddlebox_tpu.core import monitor, report, trace
+from paddlebox_tpu.core import flags, monitor, report, trace
+from paddlebox_tpu.core.quantiles import LogQuantileDigest
 from paddlebox_tpu.data.parser import parse_lines
 from paddlebox_tpu.data.slots import SlotBatch
 from paddlebox_tpu.distributed import rpc
@@ -40,6 +41,15 @@ class PredictServer(rpc.FramedRPCServer):
         # Arm the telemetry sinks (trace/metrics paths) once per replica;
         # per-request cost is one cached-bool check when disabled.
         report.init_telemetry_from_flags()
+        # SLO layer: server-side predict latency quantile digest (the
+        # log-bucketed sketch — sub-ms CPU predicts and multi-second
+        # tunnel stalls both land within 1% relative error) + uptime
+        # anchor for the throughput gauge. The digest is per-replica
+        # state; the registry copy under serving/predict_ms merges
+        # across replicas via monitor.merge_snapshots.
+        self._started = time.time()
+        self._latency = LogQuantileDigest()
+        self._lat_lock = threading.Lock()  # handlers run per-connection
         rpc.FramedRPCServer.__init__(self, endpoint)
 
     # -- handlers ---------------------------------------------------------
@@ -64,10 +74,22 @@ class PredictServer(rpc.FramedRPCServer):
             batch = SlotBatch.pack(parse_lines(lines, feed), feed)
             probs = self.predictor.predict(batch)
             out = np.asarray(probs[:n], np.float32)
+        ms = (time.perf_counter() - t0) * 1e3
         monitor.add("serving/predict_rpcs", 1)
         monitor.add("serving/predict_lines", n)
-        monitor.observe("serving/predict_ms",
-                        (time.perf_counter() - t0) * 1e3)
+        monitor.observe("serving/predict_ms", ms)
+        monitor.observe_quantile("serving/predict_ms", ms)
+        with self._lat_lock:
+            self._latency.observe(ms)
+        # SLO check (FLAGS_serving_slo_p99_ms): each breaching RPC is a
+        # counted violation — the p99 the operator reads from
+        # handle_stats then says how much margin remains.
+        slo = float(flags.flag("serving_slo_p99_ms"))
+        if slo > 0 and ms > slo:
+            monitor.add("slo/violations", 1)
+        monitor.set_gauge(
+            "serving/throughput_rps",
+            self._latency.count / max(time.time() - self._started, 1e-9))
         return out
 
     def handle_apply_delta(self, req) -> int:
@@ -82,12 +104,26 @@ class PredictServer(rpc.FramedRPCServer):
 
     def handle_stats(self, req) -> dict:
         snap = monitor.snapshot()
+        uptime = time.time() - self._started
+        with self._lat_lock:
+            lat = {k: (round(v, 3) if v is not None else None)
+                   for k, v in self._latency.quantiles().items()}
+            n_lat = self._latency.count
         return {"keys": int(self.predictor._table.shape[0] - 1),
                 "dim": int(self.predictor._dim),
                 "predict_rpcs": int(snap.get("serving/predict_rpcs", 0)),
                 "predict_lines": int(snap.get("serving/predict_lines",
                                               0)),
-                "delta_rpcs": int(snap.get("serving/delta_rpcs", 0))}
+                "delta_rpcs": int(snap.get("serving/delta_rpcs", 0)),
+                "uptime_s": round(uptime, 3),
+                # Server-side latency quantiles + the SLO they are read
+                # against (client predict keeps its OWN digest, so
+                # server time vs wire time separate cleanly).
+                "latency_ms": lat,
+                "latency_count": n_lat,
+                "throughput_rps": round(n_lat / max(uptime, 1e-9), 3),
+                "slo_p99_ms": float(flags.flag("serving_slo_p99_ms")),
+                "slo_violations": int(snap.get("slo/violations", 0))}
 
     def handle_stop(self, req) -> bool:
         self.stop()
@@ -104,11 +140,27 @@ class PredictClient:
         self._conn = rpc.FramedRPCConn(endpoint, timeout=timeout,
                                        service_name="serving",
                                        idempotent=("predict", "stats"))
+        # End-to-end predict latency (RPC round-trip included): diffing
+        # these quantiles against the server's handle_stats latency_ms
+        # separates server time from wire time per percentile.
+        self._latency = LogQuantileDigest()
 
     def predict(self, lines: List[str]) -> np.ndarray:
         # The wire serializes str natively (utf-8 frames) — no
         # per-line encode/decode round-trip.
-        return self._conn.call("predict", lines=list(lines))
+        t0 = time.perf_counter()
+        out = self._conn.call("predict", lines=list(lines))
+        self._latency.observe((time.perf_counter() - t0) * 1e3)
+        return out
+
+    def latency_quantiles(self) -> dict:
+        """Client-observed end-to-end predict latency (ms): p50/p90/
+        p99/p999 + count — the wire-inclusive twin of the server's
+        ``stats()['latency_ms']``."""
+        out = {k: (round(v, 3) if v is not None else None)
+               for k, v in self._latency.quantiles().items()}
+        out["count"] = self._latency.count
+        return out
 
     def apply_delta(self, path: str, table: str = "embedding") -> int:
         return self._conn.call("apply_delta", path=path, table=table)

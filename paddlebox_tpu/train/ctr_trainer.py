@@ -28,8 +28,9 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddlebox_tpu.core import (faults, flags, log, monitor, report, timers,
-                                trace, watchdog)
+from paddlebox_tpu.core import (faults, flags, log, monitor,
+                                pipeline_stats, report, timers, trace,
+                                watchdog)
 from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
 from paddlebox_tpu.embedding import TableConfig, make_sparse_optimizer
@@ -702,6 +703,8 @@ class CTRTrainer:
         pass_t0 = time.perf_counter()
         stage_base = self.timers.snapshot_ms()
         boundary_base = self.engine.boundary_ms()
+        pipe_base = pipeline_stats.GLOBAL.snapshot()
+        disp_q_base = monitor.GLOBAL.quantile_digest("trainer/dispatch_ms")
         self._seg_cache_hits = 0
         self._seg_cache_misses = 0
         n_blocks = 0
@@ -729,6 +732,7 @@ class CTRTrainer:
             for args in self._prefetch_batches(dataset, k=k_disp):
                 t_disp0 = time.perf_counter()
                 with self.timers.scope("dispatch"), \
+                        pipeline_stats.GLOBAL.busy("device"), \
                         trace.span("pass/dispatch", kind="eval",
                                    block=n_blocks, k=k_disp):
                     if k_disp == 1:
@@ -747,13 +751,16 @@ class CTRTrainer:
                         loss = jnp.sum(losses)
                 n_blocks += 1
                 watchdog.beat()
-                monitor.observe("trainer/dispatch_ms",
-                                (time.perf_counter() - t_disp0) * 1e3)
+                disp_ms = (time.perf_counter() - t_disp0) * 1e3
+                monitor.observe("trainer/dispatch_ms", disp_ms)
+                monitor.observe_quantile("trainer/dispatch_ms", disp_ms)
                 loss_sum = loss if loss_sum is None else loss_sum + loss
                 nsteps += n_active
         finally:
             eng.abort_pass()
-        with self.timers.scope("sync"), trace.span("pass/final_fetch"):
+        with self.timers.scope("sync"), \
+                pipeline_stats.GLOBAL.busy("device"), \
+                trace.span("pass/final_fetch"):
             stats = self._auc_stats(auc)
             stats["loss"] = (float(loss_sum) / nsteps if nsteps
                              else float("nan"))
@@ -762,10 +769,15 @@ class CTRTrainer:
         stats["steps_per_dispatch"] = k_disp
         stats["seg_cache_hit_rate"] = self._seg_cache_rate()
         stats["boundary"] = self._boundary_delta(boundary_base)
+        wall_s = time.perf_counter() - pass_t0
+        stats["bottleneck"] = self._bottleneck_verdict(
+            pipe_base, stats["boundary"], wall_s)
+        stats["dispatch_ms_quantiles"] = self._dispatch_quantiles(
+            disp_q_base)
         stats["pass_report"] = report.emit_pass_report(
             "eval", steps=nsteps,
             samples=nsteps * self.feed_config.batch_size,
-            wall_s=time.perf_counter() - pass_t0,
+            wall_s=wall_s,
             stage_ms=report.stage_delta(self.timers, stage_base),
             stats=stats,
             extra={"steps_per_dispatch": k_disp,
@@ -857,13 +869,20 @@ class CTRTrainer:
             return dev
 
         def _put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+            # blocked_down on the packer stage: time spent here with the
+            # queue FULL means the device side is the slower half (a
+            # healthy sign); near-zero put-wait with a starved consumer
+            # means the host pipeline is the wall.
+            with pipeline_stats.GLOBAL.blocked_down("packer"):
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        pipeline_stats.GLOBAL.sample_queue(
+                            "producer_queue", q.qsize())
+                        return True
+                    except queue.Full:
+                        continue
+                return False
 
         n_groups = len(self.engine.groups)
         # Map-ahead worker (FLAGS_trainer_map_ahead): the host keymap
@@ -883,12 +902,14 @@ class CTRTrainer:
             # half of PullSparse (feasign -> device-row keymap, the
             # CopyKeys role); "pack" is batch assembly + dtype prep.
             faults.faultpoint("trainer/map_ahead")
-            with self.timers.scope("pull"), trace.span("prefetch/keymap"):
+            with self.timers.scope("pull"), trace.span("prefetch/keymap"), \
+                    pipeline_stats.GLOBAL.busy("keymap"):
                 return self._map_batch_rows_host(batch)
 
         def _pack_host(batch, rows_h):
             faults.faultpoint("trainer/pack")
-            with self.timers.scope("pack"):
+            with self.timers.scope("pack"), \
+                    pipeline_stats.GLOBAL.busy("packer"):
                 dense_h = _concat_dense_host(batch)
                 if dense_bf16:
                     import ml_dtypes
@@ -898,7 +919,8 @@ class CTRTrainer:
                         batch.labels, batch.valid, dense_h)
 
         def _stack_block(blk):
-            with self.timers.scope("pack"):
+            with self.timers.scope("pack"), \
+                    pipeline_stats.GLOBAL.busy("packer"):
                 n_active = len(blk)
                 # static-shape tail pad
                 blk = blk + [blk[-1]] * (k - n_active)
@@ -925,7 +947,8 @@ class CTRTrainer:
                 # timer); separate from pack/pull so a starved pass
                 # is distinguishable from a slow keymap.
                 faults.faultpoint("trainer/prefetch")
-                with self.timers.scope("read"):
+                with self.timers.scope("read"), \
+                        pipeline_stats.GLOBAL.busy("reader"):
                     return next(it, _EOF)
 
             try:
@@ -947,7 +970,8 @@ class CTRTrainer:
                         faults.faultpoint("trainer/pack")
                         with self.timers.scope("host_map"), \
                                 trace.span("prefetch/host_map"):
-                            with self.timers.scope("pack"):
+                            with self.timers.scope("pack"), \
+                                    pipeline_stats.GLOBAL.busy("packer"):
                                 dense_h = _concat_dense_host(batch)
                                 if dense_bf16:
                                     import ml_dtypes
@@ -988,7 +1012,13 @@ class CTRTrainer:
         t.start()
         try:
             while True:
-                item = q.get()
+                # blocked_up on the device stage: the consumer (and so
+                # the device's supply of new blocks) starved waiting on
+                # the host pipeline — the device_idle_frac numerator.
+                with pipeline_stats.GLOBAL.blocked_up("device"):
+                    item = q.get()
+                pipeline_stats.GLOBAL.sample_queue("producer_queue",
+                                                   q.qsize())
                 if item is _DONE:
                     break
                 if isinstance(item, BaseException):
@@ -1112,6 +1142,8 @@ class CTRTrainer:
         pass_t0 = time.perf_counter()
         stage_base = self.timers.snapshot_ms()
         boundary_base = self.engine.boundary_ms()
+        pipe_base = pipeline_stats.GLOBAL.snapshot()
+        disp_q_base = monitor.GLOBAL.quantile_digest("trainer/dispatch_ms")
         self._seg_cache_hits = 0
         self._seg_cache_misses = 0
         eng = self.engine
@@ -1181,7 +1213,9 @@ class CTRTrainer:
             base, fin, na = pending_finite
             pending_finite = None
             self._host_syncs += 1
-            with self.timers.scope("sync"), trace.span("pass/sync_finite"):
+            with self.timers.scope("sync"), \
+                    pipeline_stats.GLOBAL.busy("device"), \
+                    trace.span("pass/sync_finite"):
                 fv = np.asarray(fin)[:na]
             if not fv.all():
                 bad = base + int(np.argmin(fv)) + 1
@@ -1280,6 +1314,7 @@ class CTRTrainer:
             # the synced step wall (credited to fwd_bwd below).
             with self.timers.scope("device_step"), \
                     self.timers.scope("dispatch"), \
+                    pipeline_stats.GLOBAL.busy("device"), \
                     trace.span("pass/dispatch",
                                block=self._dispatch_blocks, k=k_disp):
                 if k_disp == 1:
@@ -1316,8 +1351,10 @@ class CTRTrainer:
             watchdog.beat()
             disp_s = time.perf_counter() - t_disp0
             # Step-latency distribution (host-observed block enqueue
-            # wall): the pass report's histogram feed.
+            # wall): the pass report's histogram feed, plus the
+            # log-bucketed digest behind the per-pass p50/p90/p99/p999.
             monitor.observe("trainer/dispatch_ms", disp_s * 1e3)
+            monitor.observe_quantile("trainer/dispatch_ms", disp_s * 1e3)
             if profiling and k_disp == 1:
                 # Profiling syncs per step, so the block wall IS the
                 # fused device step (pull+fwd-bwd+push) — the closest
@@ -1365,7 +1402,9 @@ class CTRTrainer:
             eng.end_pass()
         # "sync" = blocking device fetches: the pass-end stat reductions
         # (plus any deferred finite-vector fetches counted above).
-        with self.timers.scope("sync"), trace.span("pass/final_fetch"):
+        with self.timers.scope("sync"), \
+                pipeline_stats.GLOBAL.busy("device"), \
+                trace.span("pass/final_fetch"):
             stats = self._auc_stats(self.auc_state)
             stats["loss"] = (float(loss_sum) / nsteps if nsteps
                              else float("nan"))
@@ -1403,12 +1442,20 @@ class CTRTrainer:
                         stats["lookup_overflow"])
         stats["seg_cache_hit_rate"] = self._seg_cache_rate()
         stats["boundary"] = self._boundary_delta(boundary_base)
+        wall_s = time.perf_counter() - pass_t0
+        # Critical-path attribution: the occupancy window over this pass
+        # plus the boundary halves -> ONE bottleneck verdict, and the
+        # dispatch-latency digest window -> p50/p90/p99/p999.
+        stats["bottleneck"] = self._bottleneck_verdict(
+            pipe_base, stats["boundary"], wall_s)
+        stats["dispatch_ms_quantiles"] = self._dispatch_quantiles(
+            disp_q_base)
         # The PrintSyncTimer moment: ONE structured per-pass summary
         # line + registry/JSONL publish (core.report).
         stats["pass_report"] = report.emit_pass_report(
             "train", steps=nsteps,
             samples=nsteps * self.feed_config.batch_size,
-            wall_s=time.perf_counter() - pass_t0,
+            wall_s=wall_s,
             stage_ms=report.stage_delta(self.timers, stage_base),
             stats=stats,
             extra={"steps_per_dispatch": k_disp,
@@ -1438,6 +1485,40 @@ class CTRTrainer:
                                    4)
                              if build > 1e-6 else None)
         return d
+
+    def _bottleneck_verdict(self, pipe_base, boundary,
+                            wall_s: float) -> Dict[str, Any]:
+        """The pass's critical-path verdict: the occupancy window since
+        ``pipe_base`` (reader/packer/keymap/device states + queue
+        depths) with the engine's boundary halves injected as a
+        ``boundary`` stage (build minus its blocked wait = busy; the
+        wait itself = blocked_up; end_pass write-back counts as busy —
+        it holds the store against the next build)."""
+        win = pipeline_stats.GLOBAL.window(pipe_base)
+        b = boundary or {}
+        build = float(b.get("build_ms") or 0.0)
+        wait = float(b.get("feed_wait_ms") or 0.0)
+        end = float(b.get("end_ms") or 0.0)
+        if build > 1e-6 or wait > 1e-6 or end > 1e-6:
+            win["stages"]["boundary"] = {
+                "busy_ms": round(max(build - wait, 0.0) + end, 3),
+                "blocked_up_ms": round(wait, 3),
+                "blocked_down_ms": 0.0, "count": 1}
+        return pipeline_stats.bottleneck_verdict(win, wall_s * 1e3)
+
+    def _dispatch_quantiles(self, base) -> Optional[Dict[str, float]]:
+        """This pass's dispatch-latency p50/p90/p99/p999 from the
+        cumulative registry digest, windowed by count subtraction."""
+        d = monitor.GLOBAL.quantile_digest("trainer/dispatch_ms")
+        if d is None:
+            return None
+        w = d.delta(base)
+        if not w.count:
+            return None
+        out = {k: (round(v, 3) if v is not None else None)
+               for k, v in w.quantiles().items()}
+        out["count"] = w.count
+        return out
 
     def reset_metrics(self) -> None:
         self.auc_state = self._auc_init()

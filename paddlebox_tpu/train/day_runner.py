@@ -26,8 +26,9 @@ import numpy as np
 
 from paddlebox_tpu.checkpoint.protocol import (CheckpointProtocol,
                                                get_online_pass_interval)
-from paddlebox_tpu.core import (faults, flags, log, monitor, report, timers,
-                                trace, watchdog)
+from paddlebox_tpu.core import (faults, flags, log, monitor,
+                                pipeline_stats, report, timers, trace,
+                                watchdog)
 from paddlebox_tpu.data.dataset import Dataset
 
 
@@ -188,7 +189,12 @@ class DayRunner:
         ds = Dataset(self.feed_config,
                      num_reader_threads=self.num_reader_threads)
         ds.set_filelist(files)
-        ds.load_into_memory()
+        # Occupancy: a pipelined day loop runs this in the preload
+        # thread, so day_load overlapping a training window shows up in
+        # that pass's verdict exactly like the reference's
+        # PreLoadIntoMemory overlap would.
+        with pipeline_stats.GLOBAL.busy("day_load"):
+            ds.load_into_memory()
         if self.shuffle:
             # Deterministic digest — hash(str) is randomized per
             # process, which would make recovery replays and per-rank

@@ -129,6 +129,16 @@ def test_train_pass_report_with_megastep_and_artifacts(shard_13,
     assert rep["lookup_exchange_bytes"] == stats["lookup_exchange_bytes"]
     assert rep["lookup_exchange_bytes"] > 0
     assert "seg_cache_hit_rate" in rep
+    # -- critical-path attribution (round 11) -------------------------
+    bn = rep["bottleneck"]
+    assert bn["stage"] is not None
+    assert 0.0 <= bn["device_idle_frac"] <= 1.0
+    assert 0.0 <= bn["host_critical_share"] <= 1.0
+    for stage in ("reader", "packer", "keymap", "device"):
+        assert stage in bn["stages"]
+    dq = rep["dispatch_ms_quantiles"]
+    assert dq["count"] == stats["dispatch_blocks"]
+    assert dq["p50"] <= dq["p99"]
 
     # -- trace artifact: Perfetto/chrome-loadable ---------------------
     out = trace.export()
@@ -158,6 +168,14 @@ def test_train_pass_report_with_megastep_and_artifacts(shard_13,
     assert last["gauges"]["pass/train_samples_per_s"] > 0
     assert last["counters"]["lookup/exchange_bytes_per_step"] == \
         stats["lookup_exchange_bytes"]
+    # Quantile digests ride the snapshot (mergeable across ranks), and
+    # the occupancy gauges feed trace_report's pipeline table.
+    q = last["quantiles"]["trainer/dispatch_ms"]
+    assert q["count"] == stats["dispatch_blocks"]
+    assert q["p50"] is not None
+    assert last["gauges"]["pass/train_device_idle_frac"] == \
+        rep["bottleneck"]["device_idle_frac"]
+    assert "pipeline/device_busy_frac" in last["gauges"]
 
 
 def test_eval_pass_report(shard_13, telemetry_paths):

@@ -1,0 +1,155 @@
+"""The cross-run perf regression gate (tools/perf_gate.py) as a tier-1
+smoke: the gate must exit 0 on a baseline-identical report, nonzero on
+a synthetically-regressed one (throughput, stage share, tail quantile,
+device idle fraction), honor tolerances/directions, and its built-in
+--smoke self-check must pass — so a perf regression fails THIS suite,
+not a future bench recording.
+
+No jax import: the gate is pure stdlib and runs in milliseconds.
+"""
+
+import copy
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "perf_gate", os.path.join(REPO, "tools", "perf_gate.py"))
+perf_gate = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(perf_gate)
+
+
+# A realistic bench-record shape (BENCH_r02-style + the round-11
+# bottleneck/quantile fields).
+BASE = {
+    "metric": "deepfm_ctr_e2e_samples_per_sec_per_chip",
+    "value": 8587.0,
+    "unit": "samples/s/chip",
+    "vs_baseline": 1.0,
+    "device_only_per_chip": 55000.0,
+    "e2e_over_device_only": 0.156,
+    "store_build_keys_per_s": 406000.0,
+    "stage_ms": {"read": 1200.0, "pack": 400.0, "pull": 300.0,
+                 "dispatch": 9000.0, "sync": 50.0},
+    "boundary": {"end_ms": 900.0, "build_ms": 4000.0,
+                 "feed_wait_ms": 1000.0, "overlap_frac": 0.75},
+    "bottleneck": {"stage": "reader", "device_idle_frac": 0.4,
+                   "host_critical_share": 0.6},
+    "dispatch_ms_quantiles": {"p50": 120.0, "p90": 150.0, "p99": 300.0,
+                              "p999": 800.0, "count": 64},
+    "lookup_exchange_bytes": 19200,
+    "auc": 0.78,
+    "seg_cache_hit_rate": 0.9,
+    "n_devices": 1,
+    "steps_per_dispatch": 4,
+    "sparse_gather_kernel": "auto",
+}
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def test_gate_passes_on_baseline_identical_report(tmp_path, capsys):
+    rep = _write(tmp_path, "rep.json", BASE)
+    base = _write(tmp_path, "base.json", BASE)
+    assert perf_gate.main([rep, "--baseline", base]) == 0
+    out = capsys.readouterr().out
+    assert "0 regression(s)" in out
+
+
+def test_gate_fails_on_synthetic_regressions(tmp_path, capsys):
+    bad = copy.deepcopy(BASE)
+    bad["value"] *= 0.6                                  # throughput drop
+    bad["stage_ms"]["read"] *= 5.0                       # stage blow-up
+    bad["dispatch_ms_quantiles"]["p99"] = 3000.0         # tail explosion
+    bad["bottleneck"]["device_idle_frac"] = 0.9          # starved device
+    rep = _write(tmp_path, "rep.json", bad)
+    base = _write(tmp_path, "base.json", BASE)
+    assert perf_gate.main([rep, "--baseline", base]) == 1
+    out = capsys.readouterr().out
+    for name in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
+                 "bottleneck.device_idle_frac"):
+        assert name in out, out
+
+
+def test_gate_ignores_improvements_and_unknown_fields(tmp_path):
+    good = copy.deepcopy(BASE)
+    good["value"] *= 3.0
+    good["stage_ms"]["read"] = 1.0
+    good["e2e_over_device_only"] = 0.9
+    good["n_devices"] = 8                 # count: not gated
+    good["sparse_gather_kernel"] = "pallas"  # string: not gated
+    good["brand_new_metric_per_s"] = 1.0  # absent from baseline: skipped
+    rep = _write(tmp_path, "rep.json", good)
+    base = _write(tmp_path, "base.json", BASE)
+    assert perf_gate.main([rep, "--baseline", base]) == 0
+
+
+def test_tolerances_default_and_per_metric(tmp_path):
+    wob = copy.deepcopy(BASE)
+    wob["value"] *= 0.9          # -10% < default 15% tolerance
+    rep = _write(tmp_path, "rep.json", wob)
+    base = _write(tmp_path, "base.json", BASE)
+    assert perf_gate.main([rep, "--baseline", base]) == 0
+    # Tighten the default: now it trips...
+    assert perf_gate.main([rep, "--baseline", base,
+                           "--tolerance", "0.05"]) == 1
+    # ...unless a per-metric override loosens exactly that metric.
+    assert perf_gate.main([rep, "--baseline", base,
+                           "--tolerance", "0.05",
+                           "--tol", "value=0.2"]) == 0
+
+
+def test_abs_floor_suppresses_micro_ms_noise(tmp_path):
+    wob = copy.deepcopy(BASE)
+    wob["stage_ms"]["sync"] = 50.8   # +1.6% and +0.8ms: noise
+    rep = _write(tmp_path, "rep.json", wob)
+    base = _write(tmp_path, "base.json", BASE)
+    assert perf_gate.main([rep, "--baseline", base,
+                           "--tolerance", "0.0"]) == 0
+    # But a genuine ms regression past both gates fails.
+    wob["stage_ms"]["sync"] = 80.0
+    rep = _write(tmp_path, "rep2.json", wob)
+    assert perf_gate.main([rep, "--baseline", base]) == 1
+
+
+def test_write_baseline_roundtrip(tmp_path):
+    rep = _write(tmp_path, "rep.json", BASE)
+    out = str(tmp_path / "new_base.json")
+    assert perf_gate.main([rep, "--write-baseline", out]) == 0
+    assert perf_gate.main([rep, "--baseline", out]) == 0
+
+
+def test_builtin_smoke_self_check():
+    assert perf_gate.smoke() == 0
+    assert perf_gate.main(["--smoke"]) == 0
+
+
+def test_gates_a_real_pass_report_shape(tmp_path):
+    """End-to-end with the trainer's actual pass_report schema: gate a
+    report against itself (0) and against a degraded twin (1). Uses a
+    canned summary (the full-trainer path is covered by
+    test_pipeline_stats) so this stays jax-free and milliseconds."""
+    summary = {
+        "kind": "train", "steps": 13, "samples": 416, "wall_s": 1.9,
+        "samples_per_s": 221.8,
+        "stage_ms": {"read": 14.6, "pack": 6.5, "pull": 0.7,
+                     "fwd_bwd": 0.0, "push": 152.6, "dispatch": 1278.5,
+                     "sync": 0.6},
+        "bottleneck": {"stage": "device", "device_idle_frac": 0.05,
+                       "host_critical_share": 0.2},
+        "dispatch_ms_quantiles": {"p50": 95.0, "p99": 140.0,
+                                  "count": 4},
+    }
+    base = _write(tmp_path, "base.json", summary)
+    rep = _write(tmp_path, "rep.json", summary)
+    assert perf_gate.main([rep, "--baseline", base]) == 0
+    worse = copy.deepcopy(summary)
+    worse["samples_per_s"] = 100.0
+    worse["bottleneck"]["host_critical_share"] = 0.8
+    rep2 = _write(tmp_path, "rep2.json", worse)
+    assert perf_gate.main([rep2, "--baseline", base]) == 1

@@ -1,0 +1,283 @@
+"""The streaming quantile sketch contract (core/quantiles.py).
+
+The digest is the SLO layer's foundation: its relative-error bound must
+hold on adversarial value distributions (six-decade lognormals, heavy
+tails, constants, negatives/zeros), its merge must be associative (the
+per-rank cluster aggregation is a fold), and the registry integration
+(monitor.observe_quantile / snapshot_all / merge_snapshots / the
+FileStore collector / the atexit final flush) must round-trip through
+JSON without accuracy loss.
+"""
+
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.core.quantiles import (DEFAULT_QS, LogQuantileDigest,
+                                          merge_digests)
+
+QS = (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 0.999)
+
+
+def _check_rel_error(values, rel_error=0.01, qs=QS):
+    d = LogQuantileDigest(rel_error)
+    for v in values:
+        d.observe(v)
+    arr = np.asarray(values, dtype=np.float64)
+    for q in qs:
+        # The sketch's guarantee is per-VALUE: its estimate is within
+        # rel_error of SOME value at that rank. Compare against the
+        # nearest-rank exact quantile it targets.
+        exact = float(np.quantile(arr, q, method="lower"))
+        est = d.quantile(q)
+        if exact == 0.0:
+            assert abs(est) <= rel_error, (q, est)
+        else:
+            assert abs(est - exact) <= rel_error * abs(exact) + 1e-12, \
+                (q, exact, est)
+
+
+def test_rel_error_lognormal_six_decades():
+    rng = np.random.default_rng(0)
+    _check_rel_error(rng.lognormal(mean=0.0, sigma=3.0, size=50_000))
+
+
+def test_rel_error_heavy_tail_pareto():
+    rng = np.random.default_rng(1)
+    _check_rel_error((rng.pareto(1.1, size=50_000) + 1.0) * 0.001)
+
+
+def test_rel_error_mixture_with_negatives_and_zeros():
+    rng = np.random.default_rng(2)
+    vals = np.concatenate([
+        -rng.lognormal(2.0, 2.0, 10_000),      # negative tail
+        np.zeros(5_000),                        # exact zeros
+        rng.lognormal(2.0, 2.0, 10_000),        # positive tail
+    ])
+    rng.shuffle(vals)
+    _check_rel_error(vals)
+
+
+def test_rel_error_constant_and_near_constant():
+    _check_rel_error(np.full(1000, 42.5))
+    rng = np.random.default_rng(3)
+    _check_rel_error(42.5 + rng.normal(0, 1e-9, 1000))
+
+
+def test_rel_error_configurable():
+    rng = np.random.default_rng(4)
+    _check_rel_error(rng.lognormal(1.0, 2.0, 20_000), rel_error=0.05)
+
+
+def test_empty_and_single_value_edges():
+    d = LogQuantileDigest()
+    assert d.quantile(0.5) is None
+    assert all(v is None for v in d.quantiles().values())
+    assert d.to_dict()["count"] == 0
+    assert d.to_dict()["min"] is None
+    d.observe(7.0)
+    for q in (0.0, 0.5, 1.0):
+        assert abs(d.quantile(q) - 7.0) <= 0.01 * 7.0
+    assert d.min == d.max == 7.0
+    with pytest.raises(ValueError):
+        d.quantile(1.5)
+    with pytest.raises(ValueError):
+        LogQuantileDigest(0.0)
+
+
+def test_merge_associativity_and_exactness():
+    rng = np.random.default_rng(5)
+    chunks = [rng.lognormal(0, 2.0, 5_000) * s
+              for s in (1.0, 100.0, 1e-3)]
+    digs = []
+    for c in chunks:
+        d = LogQuantileDigest()
+        for v in c:
+            d.observe(v)
+        digs.append(d)
+    a, b, c = (d.copy() for d in digs)
+    left = a.merge(b).merge(c)                       # (a+b)+c
+    a2, b2, c2 = (d.copy() for d in digs)
+    right = a2.merge(b2.merge(c2))                   # a+(b+c)
+    assert left.counts == right.counts
+    assert left.count == right.count
+    # Merged digest == digest of the concatenated stream, bucket-exact.
+    whole = LogQuantileDigest()
+    for v in np.concatenate(chunks):
+        whole.observe(v)
+    assert left.counts == whole.counts
+    assert left.zero_count == whole.zero_count
+    for q in QS:
+        assert left.quantile(q) == whole.quantile(q)
+    # merge_digests fold helper
+    folded = merge_digests(digs)
+    assert folded.counts == whole.counts
+    assert merge_digests([]) is None
+    # Mixed rel_error digests must refuse to merge.
+    with pytest.raises(ValueError):
+        LogQuantileDigest(0.01).merge(LogQuantileDigest(0.02))
+
+
+def test_delta_window():
+    d = LogQuantileDigest()
+    for v in (1.0, 2.0, 3.0):
+        d.observe(v)
+    base = d.copy()
+    for v in (100.0, 200.0, 300.0):
+        d.observe(v)
+    w = d.delta(base)
+    assert w.count == 3
+    # The window sees ONLY the post-base observations.
+    assert w.quantile(0.0) > 50.0
+    assert abs(w.quantile(0.5) - 200.0) <= 0.01 * 200.0 + 1e-9
+    # delta(None) == copy of the whole digest.
+    assert d.delta(None).count == 6
+
+
+def test_serialization_roundtrip():
+    rng = np.random.default_rng(6)
+    d = LogQuantileDigest()
+    for v in rng.lognormal(0, 2, 2000):
+        d.observe(v)
+    d.observe(0.0)
+    d.observe(-5.0)
+    blob = json.dumps(d.to_dict())
+    back = LogQuantileDigest.from_dict(json.loads(blob))
+    assert back.count == d.count
+    assert back.counts == d.counts
+    assert back.neg_counts == d.neg_counts
+    assert back.zero_count == d.zero_count
+    for q in QS:
+        assert back.quantile(q) == d.quantile(q)
+    # to_dict carries the derived SLO fields directly.
+    td = d.to_dict()
+    for name in ("p50", "p90", "p99", "p999"):
+        assert name in td
+
+
+def test_monitor_quantile_registration():
+    reg = monitor.Monitor()
+    for v in (1.0, 10.0, 100.0):
+        reg.observe_quantile("trainer/dispatch_ms", v)
+    snap = reg.snapshot_all()
+    q = snap["quantiles"]["trainer/dispatch_ms"]
+    assert q["count"] == 3
+    assert abs(q["p50"] - 10.0) <= 0.1 + 1e-9
+    # quantile_digest returns a COPY (window-base safety).
+    cp = reg.quantile_digest("trainer/dispatch_ms")
+    reg.observe_quantile("trainer/dispatch_ms", 1000.0)
+    assert cp.count == 3
+    assert reg.quantile_digest("missing") is None
+    reg.reset()
+    assert reg.snapshot_all()["quantiles"] == {}
+
+
+def test_merge_snapshots_cluster_semantics():
+    regs = [monitor.Monitor() for _ in range(3)]
+    for i, r in enumerate(regs):
+        r.add("pass/train_samples", 100 * (i + 1))
+        r.set_gauge("pass/train_samples_per_s", 1000.0 * (i + 1))
+        r.observe("trainer/dispatch_ms", 10.0 * (i + 1))
+        r.observe_quantile("trainer/dispatch_ms", 10.0 * (i + 1))
+    merged = monitor.merge_snapshots([r.snapshot_all({"rank": i})
+                                      for i, r in enumerate(regs)])
+    assert merged["ranks"] == 3
+    assert merged["counters"]["pass/train_samples"] == 600
+    assert merged["gauges"]["pass/train_samples_per_s"] == 2000.0
+    # The skew view: the mean hides the slow rank, __max names it.
+    assert merged["gauges"]["pass/train_samples_per_s__max"] == 3000.0
+    h = merged["histograms"]["trainer/dispatch_ms"]
+    assert h["count"] == 3 and sum(h["counts"]) == 3
+    assert h["min"] == 10.0 and h["max"] == 30.0
+    q = merged["quantiles"]["trainer/dispatch_ms"]
+    assert q["count"] == 3
+    assert abs(q["p50"] - 20.0) <= 0.25
+    # Mismatched histogram buckets across ranks must refuse to merge.
+    a, b = monitor.Monitor(), monitor.Monitor()
+    a.observe("h", 1.0, buckets=(1.0, 2.0))
+    b.observe("h", 1.0, buckets=(1.0, 3.0))
+    with pytest.raises(ValueError):
+        monitor.merge_snapshots([a.snapshot_all(), b.snapshot_all()])
+    assert monitor.merge_snapshots([])["ranks"] == 0
+
+
+def test_filestore_cluster_collector(tmp_path):
+    """Two ranks rendezvous through a FileStore; both get the SAME
+    merged cluster snapshot (prep for multihost_scale)."""
+    from paddlebox_tpu.distributed.transport import FileStore
+
+    world = 2
+    regs = []
+    for i in range(world):
+        r = monitor.Monitor()
+        r.add("pass/train_steps", 10 + i)
+        r.observe_quantile("trainer/dispatch_ms", float(10 ** (i + 1)))
+        regs.append(r)
+    results = [None] * world
+    errors = []
+
+    def rank_body(i):
+        try:
+            fs = FileStore(str(tmp_path / "fs"), rank=i, world=world)
+            results[i] = monitor.collect_cluster_snapshot(
+                fs, registry=regs[i], labels={"rank": i}, timeout=30.0)
+        except BaseException as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=rank_body, args=(i,))
+          for i in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60.0)
+    assert not errors, errors
+    for res in results:
+        assert res is not None
+        assert res["ranks"] == world
+        assert res["counters"]["pass/train_steps"] == 21
+        assert res["quantiles"]["trainer/dispatch_ms"]["count"] == 2
+    assert results[0]["counters"] == results[1]["counters"]
+
+
+def test_atexit_final_flush_idempotent(tmp_path):
+    """Arming the exporter registers a final flush that appends one last
+    labeled snapshot at exit — and is safe to run alongside (or after)
+    the periodic thread."""
+    path = str(tmp_path / "m.jsonl")
+    reg = monitor.Monitor()
+    reg.add("tool/things", 3)
+    # interval <= 0: no thread, but the path is armed and the atexit
+    # hook registered — the short-lived-tool case the flush exists for.
+    reg.start_flush_thread(path, interval_s=0.0)
+    assert reg._atexit_registered
+    reg._atexit_flush()
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert lines and lines[-1]["labels"] == {"event": "final_flush"}
+    assert lines[-1]["counters"]["tool/things"] == 3
+    # Idempotent: calling again appends another valid line, never raises.
+    reg._atexit_flush()
+    assert len(open(path).read().splitlines()) == 2
+    # Fully de-configured exporter (stop_flush_thread) -> exit flush is
+    # a no-op instead of resurrecting the file.
+    reg.stop_flush_thread()
+    before = open(path).read()
+    reg._atexit_flush()
+    assert open(path).read() == before
+
+
+def test_bucket_midpoint_bound_math():
+    """The bucket-estimate error bound is exactly rel_error at the
+    bucket edges (the DDSketch midpoint property) — pin the math so a
+    refactor of _bucket_value can't silently widen the guarantee."""
+    a = 0.01
+    d = LogQuantileDigest(a)
+    gamma = (1 + a) / (1 - a)
+    for v in (1e-6, 0.1, 1.0, 7.3, 1e4, 1e9):
+        i = math.ceil(math.log(v) / math.log(gamma))
+        est = 2.0 * gamma ** i / (gamma + 1.0)
+        assert abs(est - v) <= a * v * (1 + 1e-9)
+        d.observe(v)

@@ -254,3 +254,70 @@ def test_export_serving_preserves_data_norm(tmp_path):
     batch = SlotBatch.pack(parse_lines(lines, feed), feed)
     np.testing.assert_allclose(pred.predict(batch), ref.predict(batch),
                                rtol=1e-6)
+
+
+def test_serving_slo_quantiles_and_client_latency(tmp_path):
+    """The serving SLO layer: handle_stats returns server-side latency
+    quantiles + uptime + throughput, a sub-ms SLO target counts every
+    predict as a violation, and the client's end-to-end digest records
+    wire-inclusive latencies >= nothing (separable from server time)."""
+    import jax
+
+    from paddlebox_tpu.core import flags as flagmod
+    from paddlebox_tpu.core import monitor
+
+    rng = np.random.default_rng(21)
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=8)
+    model = DeepFM(slot_names=SLOTS, emb_dim=4, hidden=())
+    keys = np.arange(1, 50, dtype=np.uint64)
+    pred = CTRPredictor(model, feed, keys,
+                        rng.normal(size=(49, 4)).astype(np.float32),
+                        rng.normal(size=(49,)).astype(np.float32),
+                        model.init(jax.random.PRNGKey(0)),
+                        compute_dtype="float32")
+    monitor.reset()
+    prev = flagmod.flag("serving_slo_p99_ms")
+    flagmod.set_flags({"serving_slo_p99_ms": 1e-6})  # everything breaches
+    server = PredictServer("127.0.0.1:0", pred)
+    cli = PredictClient(server.endpoint)
+    try:
+        lines = ["0 " + " ".join(f"{s}:{rng.integers(1, 40)}"
+                                 for s in SLOTS)
+                 for _ in range(feed.batch_size)]
+        n_rpcs = 5
+        for _ in range(n_rpcs):
+            cli.predict(lines)
+        st = cli.stats()
+        assert st["latency_count"] == n_rpcs
+        lat = st["latency_ms"]
+        assert lat["p50"] is not None and lat["p50"] > 0.0
+        assert lat["p50"] <= lat["p99"] <= lat["p999"]
+        assert st["uptime_s"] > 0.0
+        assert st["throughput_rps"] > 0.0
+        assert st["slo_p99_ms"] == 1e-6
+        assert st["slo_violations"] == n_rpcs
+        # Client-side end-to-end digest: wire-inclusive, so every
+        # percentile is >= the corresponding server-side one.
+        cq = cli.latency_quantiles()
+        assert cq["count"] == n_rpcs
+        assert cq["p50"] >= lat["p50"]
+        # Registry carries the mergeable digest + throughput gauge.
+        snap = monitor.snapshot_all()
+        assert snap["quantiles"]["serving/predict_ms"]["count"] == n_rpcs
+        assert snap["gauges"]["serving/throughput_rps"] > 0.0
+        assert snap["counters"]["slo/violations"] == n_rpcs
+
+        # SLO off (default): violations stop counting, quantiles remain.
+        flagmod.set_flags({"serving_slo_p99_ms": 0.0})
+        cli.predict(lines)
+        st2 = cli.stats()
+        assert st2["slo_violations"] == n_rpcs
+        assert st2["latency_count"] == n_rpcs + 1
+    finally:
+        flagmod.set_flags({"serving_slo_p99_ms": prev})
+        cli.stop_server()
+        cli.close()
+        server.stop()
+        monitor.reset()

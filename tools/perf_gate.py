@@ -1,0 +1,278 @@
+"""Cross-run perf regression gate.
+
+Machine-checks a bench record (``bench.py`` JSON) or a ``pass_report``
+summary against a previously recorded baseline, with a configurable
+noise tolerance per metric, and exits nonzero on regression — so a
+throughput or stage-share regression fails a command (CI, the tier-1
+suite via tests/test_perf_gate.py), not a future human reading
+BASELINE.md.
+
+Both files are arbitrary (possibly nested) JSON; numeric leaves are
+flattened to dotted paths (``stage_ms.read``,
+``bottleneck.device_idle_frac``) and every path present in BOTH files
+whose direction is known is gated:
+
+- **higher-better** (regression = drop below ``base * (1 - tol)``):
+  throughput (``*_per_s``/``per_sec``/``value``), ``auc``, cache
+  ``hit_rate``, ``overlap_frac``, ``e2e_over_device_only``,
+  ``throughput_rps``, ``mfu``.
+- **lower-better** (regression = rise above ``base * (1 + tol)`` AND by
+  more than ``--abs-floor`` — sub-floor wobble on a 0.3 ms stage is
+  noise, not signal): ``*_ms``, ``*_s`` walls, ``*_bytes``,
+  ``*idle_frac``, ``host_critical_share``, ``blocked_*_frac``,
+  ``violations``, ``host_syncs``, ``*overflow``.
+- everything else (counts, ids, flags) is ignored.
+
+Usage:
+
+    python tools/perf_gate.py report.json --baseline BASE.json
+    python tools/perf_gate.py report.json --baseline BASE.json \
+        --tolerance 0.2 --tol stage_ms.read=0.5 --abs-floor 2.0
+    python tools/perf_gate.py report.json --write-baseline BASE.json
+    python tools/perf_gate.py --smoke      # self-check, no files
+
+Exit codes: 0 = no regression, 1 = regression(s), 2 = usage/self-check
+failure. No jax import — the gate runs anywhere in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_TOLERANCE = 0.15
+DEFAULT_ABS_FLOOR = 1.0  # lower-better metrics: ignore sub-floor rises
+
+# Suffix tables, checked in order (higher-better first: "samples_per_s"
+# must match "_per_s" before the lower-better "_s" wall suffix does).
+HIGHER_SUFFIXES = ("_per_s", "per_sec", "samples_per_s", "auc",
+                   "hit_rate", "overlap_frac", "e2e_over_device_only",
+                   "throughput_rps", "mfu", "achieved_gflops_per_chip")
+LOWER_SUFFIXES = ("_ms", "_s", "_bytes", "idle_frac",
+                  "host_critical_share", "blocked_up_frac",
+                  "blocked_down_frac", "violations", "host_syncs",
+                  "overflow")
+# Exact-name entries (dotted-path last segment).
+HIGHER_NAMES = ("value",)  # bench headline — every config is throughput
+
+
+def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of nested dicts as dotted paths. Bools, strings,
+    lists, and nulls are not gateable and are dropped."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            p = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten(v, p))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix] = float(obj)
+    return out
+
+
+# Never gated even though a suffix matches (wall-clock identity, not a
+# performance property of the run).
+SKIP_NAMES = ("uptime_s", "ts")
+
+
+def direction(path: str) -> int:
+    """+1 higher-better, -1 lower-better, 0 not gated. Segments are
+    checked leaf-to-root: the unit often lives in the PARENT key
+    (``stage_ms.read``, ``dispatch_ms_quantiles.p99``), so a leaf with
+    no recognizable unit inherits its container's."""
+    segments = path.split(".")
+    if segments[-1] in SKIP_NAMES:
+        return 0
+    for seg in reversed(segments):
+        if seg in HIGHER_NAMES:
+            return 1
+        for s in HIGHER_SUFFIXES:
+            # endswith, or unit-in-the-middle ("dispatch_ms_quantiles").
+            if seg.endswith(s) or (s + "_") in seg:
+                return 1
+        for s in LOWER_SUFFIXES:
+            if seg.endswith(s) or (s + "_") in seg:
+                return -1
+    return 0
+
+
+def _abs_floor_for(path: str, abs_floor: float) -> float:
+    """The absolute floor exists to ignore sub-ms wobble on tiny stage
+    timers — it only makes sense for ms/s/bytes-unit metrics. Fractions
+    and counters get a nominal 0.01 floor (so +1 violation or a 2-point
+    share move past tolerance always counts)."""
+    for seg in reversed(path.split(".")):
+        for s in ("_ms", "_s", "_bytes"):
+            if seg.endswith(s) or (s + "_") in seg:
+                return abs_floor
+    return 0.01
+
+
+def compare(report: Dict[str, Any], baseline: Dict[str, Any], *,
+            tolerance: float = DEFAULT_TOLERANCE,
+            per_metric_tol: Optional[Dict[str, float]] = None,
+            abs_floor: float = DEFAULT_ABS_FLOOR
+            ) -> Tuple[List[dict], List[dict]]:
+    """Returns (checks, regressions): every gated comparison, and the
+    subset that regressed. Pure — tests and --smoke drive it directly."""
+    rep = flatten(report)
+    base = flatten(baseline)
+    per_metric_tol = per_metric_tol or {}
+    checks: List[dict] = []
+    regressions: List[dict] = []
+    for path in sorted(set(rep) & set(base)):
+        d = direction(path)
+        if d == 0:
+            continue
+        bv, rv = base[path], rep[path]
+        tol = per_metric_tol.get(path, tolerance)
+        if d > 0:
+            bad = rv < bv * (1.0 - tol)
+            ratio = rv / bv if bv else None
+        else:
+            bad = (rv > bv * (1.0 + tol)
+                   and (rv - bv) > _abs_floor_for(path, abs_floor))
+            ratio = rv / bv if bv else None
+        check = {"metric": path, "baseline": bv, "value": rv,
+                 "direction": "higher" if d > 0 else "lower",
+                 "tolerance": tol, "ratio": ratio,
+                 "regressed": bool(bad)}
+        checks.append(check)
+        if bad:
+            regressions.append(check)
+    return checks, regressions
+
+
+def _print_table(checks: List[dict], verbose: bool) -> None:
+    hdr = (f"{'metric':<44} {'dir':>6} {'baseline':>12} {'value':>12} "
+           f"{'ratio':>8} {'tol':>6}  verdict")
+    print(hdr)
+    print("-" * len(hdr))
+    for c in checks:
+        if not verbose and not c["regressed"]:
+            continue
+        ratio = f"{c['ratio']:.3f}" if c["ratio"] is not None else "-"
+        verdict = "REGRESSED" if c["regressed"] else "ok"
+        print(f"{c['metric']:<44} {c['direction']:>6} "
+              f"{c['baseline']:>12.4g} {c['value']:>12.4g} {ratio:>8} "
+              f"{c['tolerance']:>6.2f}  {verdict}")
+
+
+def smoke() -> int:
+    """Self-check of the gate logic (the gate gates itself): a clean
+    report must pass, and planted throughput / stage-share / quantile
+    regressions must each trip it. Milliseconds, no files, no jax —
+    safe as a tier-1 not-slow test."""
+    base = {"metric": "deepfm_ctr_e2e_samples_per_sec_per_chip",
+            "value": 8587.0,
+            "e2e_over_device_only": 0.156,
+            "stage_ms": {"read": 120.0, "pack": 60.0, "dispatch": 900.0},
+            "bottleneck": {"device_idle_frac": 0.10,
+                           "host_critical_share": 0.30},
+            "dispatch_ms_quantiles": {"p50": 12.0, "p99": 30.0},
+            "steps_per_dispatch": 4,        # not gated (count)
+            "sparse_gather_kernel": "auto"}  # not gated (string)
+    ok = True
+
+    def expect(name, got, want):
+        nonlocal ok
+        if got != want:
+            ok = False
+            print(f"smoke FAIL: {name}: got {got}, want {want}")
+
+    # Identical report: zero regressions.
+    _, regs = compare(base, base)
+    expect("identical report regressions", len(regs), 0)
+    # Within-tolerance wobble: still clean.
+    wobble = json.loads(json.dumps(base))
+    wobble["value"] *= 0.95
+    wobble["stage_ms"]["read"] *= 1.05
+    _, regs = compare(wobble, base)
+    expect("within-tolerance wobble", len(regs), 0)
+    # Planted regressions: throughput halved, a stage share blown up,
+    # a tail quantile exploded — each must be named.
+    bad = json.loads(json.dumps(base))
+    bad["value"] *= 0.5
+    bad["stage_ms"]["read"] *= 10.0
+    bad["dispatch_ms_quantiles"]["p99"] = 400.0
+    bad["bottleneck"]["device_idle_frac"] = 0.85
+    _, regs = compare(bad, base)
+    names = {r["metric"] for r in regs}
+    for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
+                 "bottleneck.device_idle_frac"):
+        expect(f"planted regression {want!r} detected", want in names,
+               True)
+    # An IMPROVEMENT must never trip the gate.
+    good = json.loads(json.dumps(base))
+    good["value"] *= 2.0
+    good["stage_ms"]["read"] *= 0.1
+    _, regs = compare(good, base)
+    expect("improvement regressions", len(regs), 0)
+    # The abs floor keeps micro-ms noise out.
+    tiny = json.loads(json.dumps(base))
+    tiny["stage_ms"]["pack"] = 60.9  # +1.5% over tol? no: +0.9ms < floor
+    _, regs = compare(tiny, base, tolerance=0.0)
+    expect("abs-floor suppresses sub-ms noise", len(regs), 0)
+    print("perf_gate --smoke: " + ("PASS" if ok else "FAIL"))
+    return 0 if ok else 2
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("report", nargs="?",
+                    help="bench/pass_report JSON to gate")
+    ap.add_argument("--baseline", help="baseline JSON to compare against")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help=f"default relative noise tolerance "
+                         f"(default {DEFAULT_TOLERANCE})")
+    ap.add_argument("--tol", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric tolerance override (dotted path), "
+                         "repeatable: --tol stage_ms.read=0.5")
+    ap.add_argument("--abs-floor", type=float, default=DEFAULT_ABS_FLOOR,
+                    help="lower-better metrics must also rise by more "
+                         "than this absolute amount to regress "
+                         f"(default {DEFAULT_ABS_FLOOR})")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="snapshot the report as a new baseline file "
+                         "and exit 0 (no gating)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the built-in self-check and exit")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print every gated metric, not just regressions")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return smoke()
+    if not args.report:
+        ap.error("pass a report JSON (or --smoke)")
+    with open(args.report) as f:
+        report = json.load(f)
+    if args.write_baseline:
+        with open(args.write_baseline, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"baseline written: {args.write_baseline}")
+        return 0
+    if not args.baseline:
+        ap.error("pass --baseline (or --write-baseline / --smoke)")
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    per_tol = {}
+    for t in args.tol:
+        if "=" not in t:
+            ap.error(f"--tol wants METRIC=FRAC, got {t!r}")
+        k, v = t.split("=", 1)
+        per_tol[k] = float(v)
+    checks, regressions = compare(report, baseline,
+                                  tolerance=args.tolerance,
+                                  per_metric_tol=per_tol,
+                                  abs_floor=args.abs_floor)
+    _print_table(checks, args.verbose or bool(regressions))
+    print(f"\n{len(checks)} metrics gated, "
+          f"{len(regressions)} regression(s)")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
