@@ -123,6 +123,10 @@ class CheckpointProtocol:
             log.warning("donefile %s: %s/%s already published",
                         os.path.basename(donefile), day, pid)
             return False
+        # The record key is publication METADATA (a human-readable id in
+        # the donefile), never replayed training state: recovery orders
+        # records by file position, not key.
+        # graftlint: allow-replay(donefile key is metadata, not replayed state)
         rec = DoneRecord(day=day, key=key or int(time.time()),
                          path=model_path, pass_id=pid)
         tmp = donefile + ".tmp"

@@ -137,6 +137,42 @@ class FlagRegistry:
             f = self._require(name)
             return f.help
 
+    def defaults(self) -> Dict[str, Any]:
+        """name -> declared default (not the live value)."""
+        with self._lock:
+            return {n: f.default for n, f in self._flags.items()}
+
+    def validate_all(self) -> List[str]:
+        """Every default must round-trip through its own env parser —
+        ``_parse(type, str(default)) == default`` — so a bad default
+        fails statically (graftlint's flag-hygiene pass calls this at
+        review time) instead of at the first env override. Returns a
+        list of error strings; empty = all defaults sound."""
+        errors: List[str] = []
+        with self._lock:
+            for f in self._flags.values():
+                if not isinstance(f.default, f.type) or (
+                        f.type is not bool
+                        and isinstance(f.default, bool)):
+                    errors.append(
+                        f"flag {f.name!r}: default {f.default!r} is "
+                        f"{type(f.default).__name__}, declared "
+                        f"{f.type.__name__}")
+                    continue
+                try:
+                    rt = _parse(f.type, str(f.default), f.name)
+                except FlagError as e:
+                    errors.append(
+                        f"flag {f.name!r}: default {f.default!r} does "
+                        f"not parse under its env parser: {e}")
+                    continue
+                if rt != f.default:
+                    errors.append(
+                        f"flag {f.name!r}: default {f.default!r} "
+                        f"round-trips to {rt!r} — an env override of "
+                        "the documented default would change behavior")
+        return errors
+
 
 def builtins_type(v: Any) -> type:
     if isinstance(v, bool):
@@ -169,6 +205,13 @@ def set_flags(values: Dict[str, Any]) -> None:
     """Set many flags; mirrors paddle's ``set_flags`` signature."""
     for k, v in values.items():
         GLOBAL.set(k, v)
+
+
+def validate_all() -> List[str]:
+    """Round-trip every registered default through its env parser (see
+    :meth:`FlagRegistry.validate_all`). Called by graftlint's
+    flag-hygiene pass and tests/test_core.py."""
+    return GLOBAL.validate_all()
 
 
 def pallas_kernels_enabled() -> bool:

@@ -205,6 +205,7 @@ class Tracer:
                          "args": {"name": th.name}})
         return {"traceEvents": meta + events,
                 "displayTimeUnit": "ms",
+                # graftlint: allow-lock(approximate stat; torn read is fine)
                 "otherData": {"dropped_events": self._dropped}}
 
     def export(self, path: Optional[str] = None) -> str:

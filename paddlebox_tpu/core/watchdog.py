@@ -156,9 +156,17 @@ class Watchdog:
                       f"idle={now - self._t:.0f}s "
                       f"elapsed={now - self._t0:.0f}s",
                       file=sys.stderr, flush=True)
-            if now - self._t > self._timeout and not self._fired:
-                self._fired = True
-                self._fire(now - self._t)
+            # Check-and-set under the lock: arm() resets _fired from the
+            # training thread, and an unguarded race here could double-
+            # fire (two async raises) into a freshly re-armed window.
+            with self._lock:
+                idle = now - self._t
+                fire = (self._armed and idle > self._timeout
+                        and not self._fired)
+                if fire:
+                    self._fired = True
+            if fire:
+                self._fire(idle)
 
     def _fire(self, idle: float) -> None:
         monitor.add("watchdog/stalls", 1)

@@ -134,11 +134,15 @@ class Dataset:
         missing = [f for f in files if not os.path.exists(f)]
         if missing:
             raise FileNotFoundError(f"dataset files missing: {missing[:3]}")
-        self._filelist = list(files)
+        # The pipelined day loop calls this from its preload thread while
+        # the training thread may inspect filelist — swap under the lock.
+        with self._lock:
+            self._filelist = list(files)
 
     @property
     def filelist(self) -> List[str]:
-        return list(self._filelist)
+        with self._lock:
+            return list(self._filelist)
 
     # -- load --------------------------------------------------------------
 

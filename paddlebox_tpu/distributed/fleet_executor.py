@@ -188,7 +188,8 @@ class Carrier:
         self._aborted.set()   # release anything blocked in deliver()
         self._aborted = threading.Event()
         self._done.clear()
-        self._error = None
+        with self._results_lock:
+            self._error = None
         self._results.clear()
         self._consumed = False
         self._spawn_interceptors()
@@ -227,7 +228,11 @@ class Carrier:
                 self._done.set()
 
     def abort(self, err: BaseException) -> None:
-        self._error = err
+        # Interceptor threads race each other (and run()'s reader) here;
+        # first error wins, publication ordered by the lock + done event.
+        with self._results_lock:
+            if self._error is None:
+                self._error = err
         self._aborted.set()
         self._done.set()
 
@@ -242,7 +247,8 @@ class Carrier:
             self.reset()
         self._results.clear()
         self._done.clear()
-        self._error = None
+        with self._results_lock:
+            self._error = None
         self._expected = self._count_sink_scopes(num_micro_batches)
         sources = [n for n in self.nodes.values() if n.role == "source"
                    and n.rank == self.rank]
@@ -298,8 +304,10 @@ class Carrier:
         finally:
             self._consumed = True
         [t.join() for t in feeders]
-        if self._error is not None:
-            raise RuntimeError("interceptor failed") from self._error
+        with self._results_lock:
+            err = self._error
+        if err is not None:
+            raise RuntimeError("interceptor failed") from err
         # Drain the STOP cascade before returning: done fires on the
         # expected result count, but STOP may still be propagating — a
         # back-to-back run() would reset() to fresh interceptors and the

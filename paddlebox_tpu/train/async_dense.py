@@ -100,17 +100,18 @@ class AsyncDenseTable:
             except BaseException as e:
                 # A dead updater must not be silent: record and surface on
                 # the next worker-side call instead of freezing params.
-                self._error = e
+                with self._params_lock:
+                    self._error = e
                 log.error("async dense update failed: %s", e)
                 self._ring.task_done()
                 return
             self._ring.task_done()
 
     def _apply(self, g) -> None:
-        self._t += 1
-        b1t = 1.0 - self.b1 ** self._t
-        b2t = 1.0 - self.b2 ** self._t
         with self._params_lock:
+            self._t += 1
+            b1t = 1.0 - self.b1 ** self._t
+            b2t = 1.0 - self.b2 ** self._t
             for i, gi in enumerate(g):
                 self._m[i] = self.b1 * self._m[i] + (1 - self.b1) * gi
                 self._v[i] = self.b2 * self._v[i] + (1 - self.b2) * gi * gi
@@ -120,28 +121,33 @@ class AsyncDenseTable:
     # -- lifecycle ---------------------------------------------------------
 
     def _check_error(self) -> None:
-        if self._error is not None:
-            raise RuntimeError("async dense updater died") from self._error
+        with self._params_lock:
+            err = self._error
+        if err is not None:
+            raise RuntimeError("async dense updater died") from err
 
     def flush(self, timeout: float = 10.0) -> None:
         """Drain pending grads INCLUDING the in-flight one the updater has
         already dequeued (unfinished_tasks counts until task_done), so a
         post-flush pull/checkpoint sees every pushed gradient applied."""
         import time
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while self._ring.unfinished_tasks:
             self._check_error()
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError("async dense flush timed out")
             time.sleep(0.005)
         self._check_error()
 
     def stop(self) -> None:
-        if self._error is None:
+        with self._params_lock:
+            died = self._error is not None
+        if not died:
             self.flush()
         self._running = False
         self._thread.join(5.0)
 
     @property
     def steps_applied(self) -> int:
-        return self._t
+        with self._params_lock:
+            return self._t

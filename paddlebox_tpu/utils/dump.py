@@ -47,6 +47,10 @@ class DumpWriter:
                     f.write(lines)
                     monitor.add("dump/lines", lines.count("\n"))
         except BaseException as e:
+            # Publication is ordered by the channel close below (put
+            # raises strictly after _error is set; close() reads after
+            # join()), so no lock is needed on either side.
+            # graftlint: allow-lock(event-ordered via channel close + join)
             self._error = e
             monitor.add("fault/dump_errors", 1)
             log.warning("dump writer for %s died: %r — the next "

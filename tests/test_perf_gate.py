@@ -153,3 +153,33 @@ def test_gates_a_real_pass_report_shape(tmp_path):
     worse["bottleneck"]["host_critical_share"] = 0.8
     rep2 = _write(tmp_path, "rep2.json", worse)
     assert perf_gate.main([rep2, "--baseline", base]) == 1
+
+
+def test_gates_a_graftlint_summary(tmp_path):
+    """The static-analysis trend wire: a graftlint --summary JSON gated
+    against a recorded one fails when the finding/baseline/pragma
+    surface grows (counts are lower-better via LOWER_NAMES), and passes
+    when it shrinks."""
+    summary = {
+        "findings_total": 12, "new": 0, "baselined": 0, "allowed": 12,
+        "warnings": 1, "files_scanned": 145,
+        "per_pass": {"hot_sync": {"findings_total": 7, "new": 0,
+                                  "baselined": 0, "allowed": 7}},
+    }
+    base = _write(tmp_path, "gl_base.json", summary)
+    same = _write(tmp_path, "gl_same.json", summary)
+    assert perf_gate.main([same, "--baseline", base]) == 0
+    grown = copy.deepcopy(summary)
+    grown["new"] = 3                      # a non-baselined finding
+    grown["per_pass"]["hot_sync"]["new"] = 3
+    rep = _write(tmp_path, "gl_grown.json", grown)
+    assert perf_gate.main([rep, "--baseline", base]) == 1
+    crept = copy.deepcopy(summary)
+    crept["baselined"] = 9                # silent baseline growth
+    rep2 = _write(tmp_path, "gl_crept.json", crept)
+    assert perf_gate.main([rep2, "--baseline", base]) == 1
+    shrunk = copy.deepcopy(summary)
+    shrunk["findings_total"] = 4
+    shrunk["allowed"] = 4
+    rep3 = _write(tmp_path, "gl_shrunk.json", shrunk)
+    assert perf_gate.main([rep3, "--baseline", base]) == 0

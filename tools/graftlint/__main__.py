@@ -1,0 +1,5 @@
+import sys
+
+from tools.graftlint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,154 @@
+"""Finding model, baseline suppression, and the run summary.
+
+A finding's **fingerprint** deliberately excludes line numbers — it is
+``pass_id:relpath:code:key`` where ``key`` is the stable subject of the
+finding (a flag name, a metric name, a ``Class.attr``, the synced
+expression text), so an unrelated edit shifting lines never invalidates
+a baseline entry, while moving the same defect to another file does.
+
+``baseline.json`` holds ``{fingerprint: reason}`` entries; with
+``--fail-on new`` (the default) only findings NOT in the baseline fail
+the run, which is what makes the suite adoptable on a tree with known,
+reviewed exceptions. ``--write-baseline`` records the current findings
+(preserving existing reasons) — growing it is visible in the summary
+JSON's ``baselined`` count, which the trend gate tracks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+SEV_ERROR = "error"
+SEV_WARN = "warn"
+
+
+@dataclasses.dataclass
+class Finding:
+    pass_id: str          # e.g. "hot_sync"
+    code: str             # e.g. "HS001"
+    severity: str         # SEV_ERROR | SEV_WARN
+    path: str             # absolute; serialized relative to root
+    lineno: int
+    message: str
+    key: str              # stable subject for the fingerprint
+    suppressed_by: Optional[str] = None   # pragma reason, if any
+    baselined_reason: Optional[str] = None
+
+    def fingerprint(self, root: str) -> str:
+        rel = os.path.relpath(self.path, root) if self.path else "-"
+        return f"{self.pass_id}:{rel}:{self.code}:{self.key}"
+
+    def to_dict(self, root: str) -> Dict[str, object]:
+        d = {
+            "pass": self.pass_id,
+            "code": self.code,
+            "severity": self.severity,
+            "file": os.path.relpath(self.path, root) if self.path else "-",
+            "line": self.lineno,
+            "message": self.message,
+            "fingerprint": self.fingerprint(root),
+        }
+        if self.suppressed_by is not None:
+            d["allowed"] = self.suppressed_by
+        if self.baselined_reason is not None:
+            d["baselined"] = self.baselined_reason
+        return d
+
+
+class Baseline:
+    def __init__(self, entries: Optional[Dict[str, str]] = None):
+        self.entries: Dict[str, str] = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls()
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        entries = data.get("entries", {})
+        if isinstance(entries, list):  # tolerate the list-of-dicts shape
+            entries = {e["fingerprint"]: e.get("reason", "")
+                       for e in entries}
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        data = {
+            "_comment": ("graftlint suppression baseline — every entry "
+                         "is a REVIEWED finding with a written reason; "
+                         "see STATIC_ANALYSIS.md for the workflow"),
+            "entries": dict(sorted(self.entries.items())),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    def reason_for(self, fingerprint: str) -> Optional[str]:
+        return self.entries.get(fingerprint)
+
+
+@dataclasses.dataclass
+class RunResult:
+    findings: List[Finding]           # everything the passes produced
+    root: str
+    files_scanned: int = 0
+    pass_ids: List[str] = dataclasses.field(default_factory=list)
+
+    def apply_baseline(self, baseline: Baseline) -> None:
+        for f in self.findings:
+            if f.suppressed_by is None:
+                reason = baseline.reason_for(f.fingerprint(self.root))
+                if reason is not None:
+                    f.baselined_reason = reason
+
+    @property
+    def active(self) -> List[Finding]:
+        """Findings not suppressed by a pragma."""
+        return [f for f in self.findings if f.suppressed_by is None]
+
+    @property
+    def new(self) -> List[Finding]:
+        """Active findings not covered by the baseline."""
+        return [f for f in self.active if f.baselined_reason is None]
+
+    def failures(self, fail_on: str) -> List[Finding]:
+        if fail_on == "none":
+            return []
+        if fail_on == "any":
+            return [f for f in self.active if f.severity == SEV_ERROR]
+        # "new": baselined findings pass; new warnings don't fail either
+        return [f for f in self.new if f.severity == SEV_ERROR]
+
+    def summary(self) -> Dict[str, object]:
+        """The trend-tracking JSON: a future PR silently growing the
+        baseline (or the pragma count) moves these numbers, and
+        tools/perf_gate.py gates them like any lower-better metric."""
+        per_pass: Dict[str, Dict[str, int]] = {}
+        for pid in self.pass_ids:
+            per_pass[pid] = {"findings_total": 0, "new": 0,
+                             "baselined": 0, "allowed": 0}
+        for f in self.findings:
+            row = per_pass.setdefault(
+                f.pass_id, {"findings_total": 0, "new": 0,
+                            "baselined": 0, "allowed": 0})
+            row["findings_total"] += 1
+            if f.suppressed_by is not None:
+                row["allowed"] += 1
+            elif f.baselined_reason is not None:
+                row["baselined"] += 1
+            else:
+                row["new"] += 1
+        tot = {k: sum(r[k] for r in per_pass.values())
+               for k in ("findings_total", "new", "baselined", "allowed")}
+        return {
+            "findings_total": tot["findings_total"],
+            "new": tot["new"],
+            "baselined": tot["baselined"],
+            "allowed": tot["allowed"],
+            "warnings": sum(1 for f in self.findings
+                            if f.severity == SEV_WARN),
+            "files_scanned": self.files_scanned,
+            "per_pass": per_pass,
+        }

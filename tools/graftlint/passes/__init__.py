@@ -1,0 +1,15 @@
+"""Pass registry. Adding a pass = one module with ``PASS_ID`` and
+``run(project, config) -> List[Finding]``, plus a row here (and a
+fixture in tests/test_graftlint.py — see STATIC_ANALYSIS.md)."""
+
+from tools.graftlint.passes import (flag_hygiene, hot_sync,
+                                    lock_discipline, registry_drift,
+                                    replay_purity)
+
+ALL_PASSES = {
+    hot_sync.PASS_ID: hot_sync.run,
+    flag_hygiene.PASS_ID: flag_hygiene.run,
+    registry_drift.PASS_ID: registry_drift.run,
+    lock_discipline.PASS_ID: lock_discipline.run,
+    replay_purity.PASS_ID: replay_purity.run,
+}

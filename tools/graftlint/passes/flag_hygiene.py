@@ -1,0 +1,237 @@
+"""Pass 2 — flag hygiene.
+
+The 45+ ``FLAGS_*`` names are the system's operator surface; nothing
+cross-checked them until now. Against the AST of the flags module and
+every reference in the tree:
+
+- ``FH001`` — a referenced flag name (``flags.flag("x")``,
+  ``get_flags``/``set_flags`` literals, a ``FLAGS_x`` string in code)
+  resolves to no ``define_flag``
+- ``FH002`` — a defined flag is never referenced anywhere in code
+  (orphan: dead operator surface)
+- ``FH003`` — a defined flag appears in none of the configured docs as
+  ``FLAGS_<name>`` (undocumented operator surface)
+- ``FH004`` — a doc mentions ``FLAGS_x`` for a flag that does not exist
+  (doc drift — usually a rename that missed the docs)
+- ``FH005`` — a default does not round-trip through the flag's own env
+  parser / declared type (checked statically from the AST literal, and
+  dynamically via ``flags.validate_all()`` when the flags module is
+  importable standalone)
+
+``# graftlint: allow-flag(reason)`` on the ``define_flag`` line
+suppresses FH002/FH003 for that flag.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.graftlint import project as P
+from tools.graftlint.findings import Finding, SEV_ERROR, SEV_WARN
+
+PASS_ID = "flag_hygiene"
+
+_FLAGS_IN_STR = re.compile(r"FLAGS_([a-z][a-z0-9_]*)")
+
+# APIs whose first positional arg is a flag name.
+_REF_APIS = {"flags.flag": 0, "flag": 0}
+
+
+def _collect_defines(proj: P.Project, flags_path: str
+                     ) -> Dict[str, Tuple[int, ast.Call]]:
+    """name -> (lineno, call node) for every define_flag in the module."""
+    out: Dict[str, Tuple[int, ast.Call]] = {}
+    for mod in proj.modules.values():
+        if os.path.abspath(mod.path) != os.path.abspath(flags_path):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = P.call_chain(node.func)
+            if chain is None or chain[-1] != "define_flag":
+                continue
+            if node.args and (name := P.literal_str(node.args[0])):
+                out[name] = (node.lineno, node)
+    return out
+
+
+def _static_default_check(name: str, call: ast.Call
+                          ) -> Optional[str]:
+    """Literal default vs the (inferred or declared) type."""
+    if len(call.args) < 2:
+        return None
+    dflt = call.args[1]
+    if not isinstance(dflt, ast.Constant):
+        return None  # computed defaults (1 << 20) are fine — typed below
+    v = dflt.value
+    declared = None
+    for kw in call.keywords:
+        if kw.arg == "type" and isinstance(kw.value, ast.Name):
+            declared = kw.value.id
+    if declared is None:
+        if v is None:
+            return f"flag {name!r} default is None (no inferable type)"
+        return None
+    pytype = type(v).__name__
+    ok = {"bool": ("bool",), "int": ("int", "bool"),
+          "float": ("float", "int"), "str": ("str",)}
+    if pytype not in ok.get(declared, (declared,)):
+        return (f"flag {name!r} default {v!r} ({pytype}) does not "
+                f"match declared type {declared}")
+    return None
+
+
+def _dynamic_validate(flags_path: str) -> List[str]:
+    """Import the flags module standalone (it must not import the
+    package / jax) and run ``validate_all()``. Errors come back as
+    strings; an unimportable module or a missing validate_all is
+    reported too — the contract is that the flags module stays
+    standalone-checkable."""
+    import sys
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_graftlint_flags_probe", flags_path)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclasses resolves cls.__module__ through sys.modules during
+        # class creation — register for the exec, then drop.
+        sys.modules["_graftlint_flags_probe"] = mod
+        try:
+            spec.loader.exec_module(mod)
+        finally:
+            sys.modules.pop("_graftlint_flags_probe", None)
+    except Exception as e:
+        return [f"flags module not importable standalone: {e!r}"]
+    validate = getattr(mod, "validate_all", None)
+    if validate is None:
+        return ["flags module has no validate_all() — defaults are "
+                "unchecked until first env override"]
+    try:
+        return list(validate())
+    except Exception as e:
+        return [f"validate_all() raised: {e!r}"]
+
+
+def run(proj: P.Project, cfg) -> List[Finding]:
+    findings: List[Finding] = []
+    flags_path = cfg.abspath(cfg.flags_module)
+    defines = _collect_defines(proj, flags_path)
+    flags_mod = None
+    for mod in proj.modules.values():
+        if os.path.abspath(mod.path) == os.path.abspath(flags_path):
+            flags_mod = mod
+
+    # ---- code-side references -------------------------------------------
+    # name -> [(path, lineno)]
+    refs: Dict[str, List[Tuple[str, int]]] = {}
+
+    def add_ref(name: str, path: str, lineno: int) -> None:
+        refs.setdefault(name, []).append((path, lineno))
+
+    for sr in proj.string_refs(_REF_APIS):
+        if not sr.is_pattern:
+            add_ref(sr.value, sr.path, sr.lineno)
+    for mod in proj.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                chain = P.call_chain(node.func)
+                tail = chain[-1] if chain else None
+                if tail in ("get_flags", "set_flags") and node.args:
+                    a = node.args[0]
+                    items: List[ast.AST] = [a]
+                    if isinstance(a, (ast.List, ast.Tuple, ast.Set)):
+                        items = list(a.elts)
+                    elif isinstance(a, ast.Dict):
+                        items = [k for k in a.keys if k is not None]
+                    for it in items:
+                        s = P.literal_str(it)
+                        if s is not None:
+                            add_ref(s, mod.path, it.lineno)
+            elif (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                for m in _FLAGS_IN_STR.finditer(node.value):
+                    add_ref(m.group(1), mod.path, node.lineno)
+            elif isinstance(node, ast.JoinedStr):
+                for v in node.values:
+                    if (isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        for m in _FLAGS_IN_STR.finditer(v.value):
+                            add_ref(m.group(1), mod.path, node.lineno)
+
+    # FH001: unresolved references. A FLAGS_ string mention inside the
+    # flags module itself (help text narrating another system's flags)
+    # still counts — drift there misleads operators the same way.
+    for name, sites in sorted(refs.items()):
+        if name in defines:
+            continue
+        # tolerate truncated prefix mentions like "FLAGS_flash_block_"
+        if any(d.startswith(name) for d in defines):
+            continue
+        for path, lineno in sites[:3]:
+            mod = _mod_for(proj, path)
+            reason = (P.pragma_for(mod, lineno, PASS_ID)
+                      if mod else None)
+            findings.append(Finding(
+                PASS_ID, "FH001", SEV_ERROR, path, lineno,
+                f"reference to undefined flag {name!r} "
+                "(no define_flag in the flags module)",
+                name, suppressed_by=reason))
+
+    # ---- doc-side --------------------------------------------------------
+    doc_mentions: Dict[str, List[Tuple[str, int]]] = {}
+    for rel in cfg.flag_docs:
+        path = cfg.abspath(rel)
+        text = P.read_doc(path)
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in _FLAGS_IN_STR.finditer(line):
+                doc_mentions.setdefault(m.group(1), []).append((path, i))
+
+    for name, (lineno, _call) in sorted(defines.items()):
+        reason = (P.pragma_for(flags_mod, lineno, PASS_ID)
+                  if flags_mod else None)
+        if name not in refs:
+            findings.append(Finding(
+                PASS_ID, "FH002", SEV_ERROR, flags_path, lineno,
+                f"flag {name!r} is defined but never referenced in code "
+                "(orphaned operator surface)",
+                name, suppressed_by=reason))
+        if name not in doc_mentions:
+            findings.append(Finding(
+                PASS_ID, "FH003", SEV_ERROR, flags_path, lineno,
+                f"flag {name!r} is undocumented: FLAGS_{name} appears in "
+                f"none of {', '.join(cfg.flag_docs)}",
+                name, suppressed_by=reason))
+
+    for name, sites in sorted(doc_mentions.items()):
+        if name in defines:
+            continue
+        if any(d.startswith(name) for d in defines):
+            continue  # FLAGS_flash_block_{q,k}-style family mention
+        path, lineno = sites[0]
+        findings.append(Finding(
+            PASS_ID, "FH004", SEV_ERROR, path, lineno,
+            f"doc mentions FLAGS_{name} but no such flag is defined "
+            "(doc drift)", name))
+
+    # ---- defaults --------------------------------------------------------
+    for name, (lineno, call) in sorted(defines.items()):
+        msg = _static_default_check(name, call)
+        if msg:
+            findings.append(Finding(
+                PASS_ID, "FH005", SEV_ERROR, flags_path, lineno,
+                msg, name))
+    for msg in _dynamic_validate(flags_path):
+        findings.append(Finding(
+            PASS_ID, "FH005", SEV_ERROR, flags_path, 1, msg,
+            f"validate_all:{msg[:40]}"))
+    return findings
+
+
+def _mod_for(proj: P.Project, path: str) -> Optional[P.ModuleInfo]:
+    for mod in proj.modules.values():
+        if mod.path == path:
+            return mod
+    return None

@@ -1,0 +1,275 @@
+"""Pass 1 — hot-path implicit device→host sync detector.
+
+BENCH_r02's host-bound breakdown (e2e at 15.6% of device-only) is why
+the dispatch loop's "zero host syncs per block" discipline exists; this
+pass keeps it true without re-running a TPU bench. Within every
+function reachable from the declared hot-path roots it infers which
+local names hold **device values** (results of ``jnp.*``/``lax.*``
+calls, ``jax.device_put``, compiled-step handles like
+``self._step_fn(...)``, params annotated ``jax.Array``, and anything
+propagated from them through assignment / arithmetic / subscript /
+tuple-unpack), then flags the operations that force a transfer or a
+tracer-boolization:
+
+- ``HS001`` — ``float()/int()/bool()/len()`` on a device value
+- ``HS002`` — ``.item()/.tolist()`` on a device value
+- ``HS003`` — ``np.asarray()/np.array()`` on a device value
+- ``HS004`` — ``jax.device_get(...)`` / ``.block_until_ready()``
+  anywhere in hot code (always an explicit sync)
+- ``HS005`` — ``if``/``while``/``assert``/ternary truth-test on a
+  device value (host sync at runtime; a TracerBoolConversionError
+  inside jit)
+- ``HS006`` — ``for`` iteration over a device value (one sync per
+  element)
+
+Intentional syncs (the deferred finite-vector fetch, the pass-end stat
+reduction) carry ``# graftlint: allow-sync(<reason>)`` pragmas on the
+flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from tools.graftlint import project as P
+from tools.graftlint.findings import Finding, SEV_ERROR, SEV_WARN
+
+PASS_ID = "hot_sync"
+
+_DEVICE_MODULES = {"jnp", "lax"}
+_SYNC_BUILTINS = {"float", "int", "bool", "len"}
+_SYNC_METHODS = {"item", "tolist"}
+_NP_SYNCS = {"asarray", "array"}
+# jnp/lax functions that return HOST values (static shape/type queries)
+_HOST_RESULT_FNS = {"axis_size", "result_type", "dtype", "ndim",
+                    "shape_dtype_struct", "eval_shape"}
+
+
+def _ann_is_device(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    try:
+        txt = ast.unparse(ann)
+    except Exception:
+        return False
+    return ("jax.Array" in txt or "jnp.ndarray" in txt
+            or "jnp.Array" in txt)
+
+
+class _DeviceInference(ast.NodeVisitor):
+    """One function body: track device-valued local names, flag syncs."""
+
+    def __init__(self, fi: P.FunctionInfo, cfg, findings: List[Finding]):
+        self.fi = fi
+        self.cfg = cfg
+        self.findings = findings
+        self.device: Set[str] = set()
+        node = fi.node
+        # nested inside a step builder -> a jit-traced body: every
+        # parameter is a tracer
+        local = fi.qualname.split(":", 1)[1].split(".")
+        traced = any(seg in cfg.traced_parents for seg in local[:-1])
+        args = getattr(node, "args", None)
+        if args is not None:
+            for a in (list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)):
+                if traced or _ann_is_device(a.annotation):
+                    self.device.add(a.arg)
+
+    # -- device-ness of an expression -------------------------------------
+
+    def is_device(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.device
+        if isinstance(node, ast.Call):
+            return self.call_is_device(node)
+        if isinstance(node, ast.Subscript):
+            return self.is_device(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_device(node.left) or self.is_device(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_device(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self.is_device(node.body) or self.is_device(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_device(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_device(node.value)
+        return False
+
+    def call_is_device(self, node: ast.Call) -> bool:
+        chain = P.call_chain(node.func)
+        if chain is None:
+            # call of a call: self._sync_params_fn()(params)
+            if isinstance(node.func, ast.Call):
+                return self.call_is_device(node.func)
+            return False
+        head = chain[0]
+        if chain[-1] in _HOST_RESULT_FNS:
+            return False
+        if head in _DEVICE_MODULES:
+            return True
+        if head == "jax":
+            if len(chain) >= 2 and chain[1] in ("device_get",):
+                return False  # host result (flagged separately)
+            return len(chain) >= 2 and chain[1] in (
+                "device_put", "jit", "vmap", "pmap")
+        if head == "np" or head == "numpy":
+            return False
+        last = chain[-1]
+        for suf in self.cfg.device_fn_suffixes:
+            if last.endswith(suf):
+                return True
+        if isinstance(node.func, ast.Name) and node.func.id in self.device:
+            return True
+        return False
+
+    # -- assignment propagation -------------------------------------------
+
+    def _assign_names(self, target: ast.AST, is_dev: bool) -> None:
+        if isinstance(target, ast.Name):
+            if is_dev:
+                self.device.add(target.id)
+            else:
+                self.device.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_names(e, is_dev)
+        elif isinstance(target, ast.Starred):
+            self._assign_names(target.value, is_dev)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        is_dev = self.is_device(node.value)
+        for t in node.targets:
+            self._assign_names(t, is_dev)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if self.is_device(node.value):
+            self._assign_names(node.target, True)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self.generic_visit(node)
+        if node.value is not None:
+            self._assign_names(node.target, self.is_device(node.value))
+
+    # -- nested defs are analyzed as their own reachable functions ---------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is self.fi.node:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+    # -- sync sites --------------------------------------------------------
+
+    def _flag(self, node: ast.AST, code: str, msg: str, key: str,
+              severity: str = SEV_ERROR) -> None:
+        lineno = getattr(node, "lineno", self.fi.lineno)
+        reason = P.pragma_for(self.fi.module, lineno, PASS_ID)
+        self.findings.append(Finding(
+            PASS_ID, code, severity, self.fi.path, lineno,
+            f"{msg} (in hot-path function {self.fi.qualname})",
+            key, suppressed_by=reason))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        chain = P.call_chain(node.func)
+        if chain is None:
+            return
+        key = f"{self.fi.qualname}:{_src(node)}"
+        if (len(chain) == 1 and chain[0] in _SYNC_BUILTINS
+                and len(node.args) >= 1 and self.is_device(node.args[0])):
+            self._flag(node, "HS001",
+                       f"implicit device→host sync: {chain[0]}() on a "
+                       f"device value `{_src(node.args[0])}`", key)
+        elif (len(chain) >= 2 and chain[-1] in _SYNC_METHODS
+                and self.is_device(node.func.value)):
+            self._flag(node, "HS002",
+                       f".{chain[-1]}() syncs the device value "
+                       f"`{_src(node.func.value)}` to the host", key)
+        elif (len(chain) == 2 and chain[0] in ("np", "numpy")
+                and chain[1] in _NP_SYNCS
+                and len(node.args) >= 1 and self.is_device(node.args[0])):
+            self._flag(node, "HS003",
+                       f"np.{chain[1]}() on a device value "
+                       f"`{_src(node.args[0])}` forces a transfer", key)
+        elif chain[-1] == "block_until_ready" or (
+                len(chain) >= 2 and chain[0] == "jax"
+                and chain[1] == "device_get"):
+            self._flag(node, "HS004",
+                       f"explicit device sync `{_src(node)}` on a "
+                       "hot path", key)
+
+    def _flag_truth(self, test: ast.AST, ctx: str) -> None:
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return  # identity checks (x is not None) never sync
+        dev = None
+        if self.is_device(test):
+            dev = test
+        elif isinstance(test, ast.Compare) and (
+                self.is_device(test.left)
+                or any(self.is_device(c) for c in test.comparators)):
+            dev = test
+        elif isinstance(test, ast.BoolOp):
+            for v in test.values:
+                if self.is_device(v):
+                    dev = v
+                    break
+        if dev is not None:
+            self._flag(test, "HS005",
+                       f"truth-test on a device value `{_src(dev)}` in "
+                       f"{ctx} (host sync; TracerBoolConversionError "
+                       "inside jit)",
+                       f"{self.fi.qualname}:{ctx}:{_src(dev)}")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._flag_truth(node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._flag_truth(node.test, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._flag_truth(node.test, "assert")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._flag_truth(node.test, "ternary")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if self.is_device(node.iter):
+            self._flag(node.iter, "HS006",
+                       f"iterating device value `{_src(node.iter)}` "
+                       "syncs per element",
+                       f"{self.fi.qualname}:for:{_src(node.iter)}",
+                       severity=SEV_WARN)
+        self.generic_visit(node)
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:
+        s = "<expr>"
+    return s if len(s) <= 60 else s[:57] + "..."
+
+
+def run(proj: P.Project, cfg) -> List[Finding]:
+    findings: List[Finding] = []
+    reachable = proj.reachable(cfg.hot_roots)
+    for qual in sorted(reachable):
+        fi = proj.functions.get(qual)
+        if fi is None:
+            continue
+        inf = _DeviceInference(fi, cfg, findings)
+        inf.visit(fi.node)
+    return findings

@@ -1,0 +1,351 @@
+"""Pass 4 — lock discipline across the threaded pipeline modules.
+
+The pipeline is a thicket of producer/builder/watchdog/RPC threads
+(``pass_engine``, ``ctr_trainer``, ``transport``, ``ps``, ``watchdog``,
+…). Three checks, all per-module with a project call graph for
+reachability:
+
+- ``LD001`` — a ``self.<attr>`` written from thread-entry-reachable
+  code and accessed from other code where **no common lock** covers
+  both sides. Writes in ``__init__`` (pre-``start()``) don't count;
+  attributes that *are* synchronization objects (locks, events,
+  queues, semaphores) are exempt — they are the mechanism, not the
+  state. One finding per (class, attr), listing witness sites.
+- ``LD002`` — the lock-acquisition-order graph (``with self.A:`` nested
+  inside ``with self.B:``, plus one-level call propagation) has a
+  cycle: a deadlock candidate.
+- ``LD003`` — (warn) ``Event.wait()``/``Condition.wait()`` with no
+  timeout in thread-reachable code: an un-wakeable park that turns a
+  missed ``set()`` into a hang the watchdog must break.
+
+The convention already in the tree is honored: a method named
+``*_locked`` is asserted to run under its class lock and counts as
+locked on both sides. ``# graftlint: allow-lock(reason)`` suppresses a
+finding at the attribute's first unlocked write (LD001) or the wait
+site (LD003).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint import project as P
+from tools.graftlint.findings import Finding, SEV_ERROR, SEV_WARN
+
+PASS_ID = "lock_discipline"
+
+_SYNC_CTORS = (
+    "threading.Lock", "threading.RLock", "threading.Event",
+    "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier",
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Lock", "RLock", "Event", "Condition",
+    "Semaphore", "BoundedSemaphore", "Queue", "SimpleQueue",
+)
+_LOCK_CTORS = ("threading.Lock", "threading.RLock",
+               "threading.Condition", "Lock", "RLock", "Condition")
+_EVENT_CTORS = ("threading.Event", "threading.Condition", "Event",
+                "Condition")
+_THREAD_CTORS = ("threading.Thread", "Thread", "threading.Timer",
+                 "Timer")
+
+
+@dataclasses.dataclass
+class _Access:
+    func: P.FunctionInfo
+    lineno: int
+    kind: str            # "read" | "write"
+    locks: Tuple[str, ...]  # lock names held (self attrs / globals)
+
+
+def _thread_entries(proj: P.Project) -> Set[str]:
+    """Qualnames of functions used as Thread targets (or run() methods
+    of Thread subclasses)."""
+    entries: Set[str] = set()
+    for mod in proj.modules.values():
+        for qual, fi in mod.functions.items():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = P.call_chain(node.func)
+                if chain is None or ".".join(chain) not in _THREAD_CTORS:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    tchain = P.call_chain(kw.value)
+                    if tchain is None:
+                        continue
+                    for target in proj.resolve_call(tchain, fi):
+                        entries.add(target.qualname)
+        for cname, ci in mod.classes.items():
+            if any(b in ("Thread",) for b in ci.bases):
+                run_m = ci.methods.get("run")
+                if run_m is not None:
+                    entries.add(run_m.qualname)
+    return entries
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Walk one function recording attribute accesses + held locks +
+    lock-order edges + untimed waits."""
+
+    def __init__(self, fi: P.FunctionInfo, lock_attrs: Set[str],
+                 event_attrs: Set[str]):
+        self.fi = fi
+        self.lock_attrs = lock_attrs      # names known to be locks
+        self.event_attrs = event_attrs    # names known to be events/conds
+        self.held: List[str] = []
+        # if the convention says the whole method runs under the class
+        # lock, record a synthetic hold
+        if fi.name.endswith("_locked"):
+            self.held.append("<class-lock>")
+        self.accesses: List[Tuple[str, _Access]] = []  # (attr, access)
+        self.acquired: List[str] = []        # all locks this fn acquires
+        self.order_edges: List[Tuple[str, str, int]] = []
+        self.waits: List[Tuple[int, str]] = []
+        self.calls_with_locks: List[Tuple[Tuple[str, ...], Tuple[str, ...],
+                                          int]] = []
+
+    def _lock_name(self, node: ast.AST) -> Optional[str]:
+        chain = P.call_chain(node)
+        if chain is None:
+            return None
+        name = ".".join(chain)
+        tail = chain[-1]
+        if tail in self.lock_attrs or name in self.lock_attrs:
+            return tail
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        names = []
+        for item in node.items:
+            ln = self._lock_name(item.context_expr)
+            if ln is not None:
+                names.append(ln)
+        for ln in names:
+            if self.held and self.held[-1] != ln:
+                self.order_edges.append((self.held[-1], ln, node.lineno))
+            self.held.append(ln)
+            self.acquired.append(ln)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in names:
+            self.held.pop()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            kind = ("write" if isinstance(node.ctx,
+                                          (ast.Store, ast.Del))
+                    else "read")
+            self.accesses.append((node.attr, _Access(
+                self.fi, node.lineno, kind, tuple(self.held))))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = P.call_chain(node.func)
+        if chain is not None:
+            if (chain[-1] == "wait" and len(chain) >= 2
+                    and not node.args
+                    and not any(kw.arg == "timeout"
+                                for kw in node.keywords)):
+                owner = chain[-2]
+                if owner in self.event_attrs:
+                    self.waits.append((node.lineno, ".".join(chain)))
+            self.calls_with_locks.append(
+                (chain, tuple(self.held), node.lineno))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is self.fi.node:
+            self.generic_visit(node)
+        # nested defs analyzed separately
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(proj: P.Project, cfg) -> List[Finding]:
+    findings: List[Finding] = []
+    entries = _thread_entries(proj)
+    if not entries:
+        return findings
+    thread_reach = set(proj.reachable(
+        [f"{q.split(':', 1)[0]}:{q.split(':', 1)[1]}" for q in entries]))
+
+    # global sets of lock-ish / event-ish attr names, per class walk
+    all_lock_attrs: Set[str] = set()
+    all_event_attrs: Set[str] = set()
+    for infos in proj.classes.values():
+        for ci in infos:
+            for attr, ctor in ci.attr_ctors.items():
+                if ctor in _LOCK_CTORS:
+                    all_lock_attrs.add(attr)
+                if ctor in _EVENT_CTORS:
+                    all_event_attrs.add(attr)
+    # module-level locks: NAME = threading.Lock()
+    for mod in proj.modules.values():
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                chain = P.call_chain(node.value.func)
+                if chain and ".".join(chain) in _LOCK_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            all_lock_attrs.add(t.id)
+
+    order_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    fn_acquires: Dict[str, Set[str]] = {}
+    fn_calls: Dict[str, List[Tuple[Tuple[str, ...], Tuple[str, ...],
+                                   int]]] = {}
+
+    # ---- per-class shared-attribute analysis -----------------------------
+    for infos in proj.classes.values():
+        for ci in infos:
+            methods = {q: fi for q, fi in ci.module.functions.items()
+                       if fi.cls == ci.name}
+            if not methods:
+                continue
+            t_meths = {q for q in methods if q in thread_reach}
+            # walk every method once
+            per_attr: Dict[str, List[_Access]] = {}
+            for q, fi in methods.items():
+                w = _LockWalker(fi, all_lock_attrs, all_event_attrs)
+                w.visit(fi.node)
+                for a, b, ln in w.order_edges:
+                    order_edges.setdefault((a, b), (fi.path, ln))
+                fn_acquires[q] = set(w.acquired)
+                fn_calls[q] = w.calls_with_locks
+                for lineno, expr in w.waits:
+                    if q in thread_reach:
+                        reason = P.pragma_for(fi.module, lineno, PASS_ID)
+                        findings.append(Finding(
+                            PASS_ID, "LD003", SEV_WARN, fi.path, lineno,
+                            f"`{expr}()` without a timeout in "
+                            "thread-reachable code — an un-wakeable "
+                            "park (a missed set() hangs the thread)",
+                            f"{fi.qualname}:{expr}",
+                            suppressed_by=reason))
+                for attr, acc in w.accesses:
+                    per_attr.setdefault(attr, []).append(acc)
+            if not t_meths:
+                continue
+            for attr, accs in sorted(per_attr.items()):
+                if (attr in ci.attr_ctors
+                        and ci.attr_ctors[attr] in _SYNC_CTORS):
+                    continue
+                if attr.startswith("__"):
+                    continue
+                t_writes = [a for a in accs
+                            if a.kind == "write"
+                            and a.func.qualname in t_meths
+                            and a.func.name != "__init__"]
+                other = [a for a in accs
+                         if a.func.qualname not in t_meths
+                         and a.func.name != "__init__"]
+                if not t_writes or not other:
+                    continue
+                unlocked_w = [a for a in t_writes if not a.locks]
+                # common lock: every thread write AND every other-side
+                # access hold at least one shared lock name
+                def _common(side_a: List[_Access],
+                            side_b: List[_Access]) -> bool:
+                    sets_a = [set(x.locks) for x in side_a]
+                    sets_b = [set(x.locks) for x in side_b]
+                    if not sets_a or not sets_b:
+                        return False
+                    inter = set.intersection(*(sets_a + sets_b))
+                    return bool(inter)
+                if _common(t_writes, other):
+                    continue
+                if not unlocked_w:
+                    # thread side always locked; other side not — still a
+                    # torn read risk but much weaker: report on the first
+                    # unlocked other-side access
+                    first = min((a for a in other if not a.locks),
+                                key=lambda a: a.lineno, default=None)
+                    if first is None:
+                        continue
+                    w0 = t_writes[0]
+                    reason = P.pragma_for(first.func.module,
+                                          first.lineno, PASS_ID)
+                    findings.append(Finding(
+                        PASS_ID, "LD001", SEV_WARN, first.func.path,
+                        first.lineno,
+                        f"self.{attr} is written under a lock from "
+                        f"thread code ({w0.func.name}:{w0.lineno}) but "
+                        f"read without one in {first.func.name}",
+                        f"{ci.name}.{attr}", suppressed_by=reason))
+                    continue
+                w0 = unlocked_w[0]
+                o0 = other[0]
+                reason = P.pragma_for(w0.func.module, w0.lineno, PASS_ID)
+                findings.append(Finding(
+                    PASS_ID, "LD001", SEV_ERROR, w0.func.path, w0.lineno,
+                    f"self.{attr} written from thread-reachable "
+                    f"{w0.func.name} (line {w0.lineno}) without a lock "
+                    f"and accessed in {o0.func.name} (line {o0.lineno}) "
+                    "— no common lock covers both sides",
+                    f"{ci.name}.{attr}", suppressed_by=reason))
+
+    # ---- plain functions: order edges + waits outside classes ------------
+    for mod in proj.modules.values():
+        for q, fi in mod.functions.items():
+            if fi.cls is not None or q in fn_acquires:
+                continue
+            w = _LockWalker(fi, all_lock_attrs, all_event_attrs)
+            w.visit(fi.node)
+            for a, b, ln in w.order_edges:
+                order_edges.setdefault((a, b), (fi.path, ln))
+            fn_acquires[q] = set(w.acquired)
+            fn_calls[q] = w.calls_with_locks
+            for lineno, expr in w.waits:
+                if q in thread_reach:
+                    reason = P.pragma_for(fi.module, lineno, PASS_ID)
+                    findings.append(Finding(
+                        PASS_ID, "LD003", SEV_WARN, fi.path, lineno,
+                        f"`{expr}()` without a timeout in "
+                        "thread-reachable code — an un-wakeable park",
+                        f"{fi.qualname}:{expr}",
+                        suppressed_by=reason))
+
+    # ---- one-level call propagation into the order graph -----------------
+    for q, calls in fn_calls.items():
+        fi = proj.functions.get(q)
+        if fi is None:
+            continue
+        for chain, held, lineno in calls:
+            if not held:
+                continue
+            for callee in proj.resolve_call(chain, fi):
+                for lk in fn_acquires.get(callee.qualname, ()):
+                    if lk != held[-1]:
+                        order_edges.setdefault(
+                            (held[-1], lk), (fi.path, lineno))
+
+    # ---- cycle detection -------------------------------------------------
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in order_edges:
+        graph.setdefault(a, set()).add(b)
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: List[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in stack:
+                cyc = tuple(sorted(stack[stack.index(nxt):] + [nxt]))
+                if cyc in seen_cycles:
+                    continue
+                seen_cycles.add(cyc)
+                path, ln = order_edges[(node, nxt)]
+                findings.append(Finding(
+                    PASS_ID, "LD002", SEV_ERROR, path, ln,
+                    "lock-acquisition-order cycle (deadlock candidate): "
+                    + " -> ".join(stack[stack.index(nxt):] + [nxt]),
+                    "cycle:" + ">".join(cyc)))
+            elif len(stack) < 16:
+                dfs(nxt, stack + [nxt])
+
+    for start in sorted(graph):
+        dfs(start, [start])
+    return findings

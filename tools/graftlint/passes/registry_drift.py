@@ -1,0 +1,278 @@
+"""Pass 3 — registry drift: faultpoint sites and metric names vs docs.
+
+ROBUSTNESS.md's site table and OBSERVABILITY.md's metric-name listings
+are the operator's index into the fault/telemetry registries; nothing
+kept them honest. Cross-checks, both directions:
+
+- ``RD001`` — a ``faults.faultpoint("site")`` literal in code is
+  missing from the ROBUSTNESS.md site table
+- ``RD002`` — the site table lists a site no code declares (stale doc)
+- ``RD003`` — a metric name registered in code
+  (``monitor.add/set_stat/set_gauge/observe/observe_quantile``) is not
+  documented in any metric doc (literal or pattern match)
+- ``RD004`` — *near-miss* (warn): an undocumented code metric is within
+  edit distance 2 of a documented one — almost always a typo
+- ``RD005`` — (warn) a concrete (wildcard-free) doc metric name matches
+  nothing in code — stale doc entry
+- ``RD006`` — the self-heal contract: the faults module must keep
+  ``InjectedFault`` transient (``transient = True`` and membership in
+  ``_TRANSIENT_TYPES``) — otherwise every injected drill turns fatal
+  and the retry machinery is silently untested
+
+F-string metric names (``f"fault/{site}_injected"``) become ``*``
+patterns and match documented ``fault/<site>_injected`` forms; doc
+tokens expand ``{a,b}`` alternation, ``<x>`` and ``...`` wildcards.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint import project as P
+from tools.graftlint.findings import Finding, SEV_ERROR, SEV_WARN
+
+PASS_ID = "registry_drift"
+
+_METRIC_APIS = {"monitor.add": 0, "monitor.set_stat": 0,
+                "monitor.set_gauge": 0, "monitor.observe": 0,
+                "monitor.observe_quantile": 0,
+                "add": 0, "set_stat": 0, "set_gauge": 0,
+                "observe": 0, "observe_quantile": 0}
+# Trace span/instant/counter names share the doc namespace (the
+# OBSERVABILITY.md "built-in span names" list): collect them so a doc
+# span entry isn't misread as a stale metric — and so a new slash-named
+# span needs a doc row like any metric.
+_TRACE_APIS = {"trace.span": 0, "trace.instant": 0, "trace.counter": 0}
+_FAULT_APIS = {"faults.faultpoint": 0, "faultpoint": 0}
+
+
+def _edit_distance(a: str, b: str, cap: int = 3) -> int:
+    if abs(len(a) - len(b)) > cap:
+        return cap + 1
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        if min(cur) > cap:
+            return cap + 1
+        prev = cur
+    return prev[-1]
+
+
+def _is_metric_shaped(tok: str) -> bool:
+    """Expanded doc tokens that are plausibly metric/span names:
+    slash-separated identifiers — not code refs (``monitor.add/get``),
+    paths (``fleet/box_wrapper.h:395``), URLs, or math
+    (``O(log(max/min)/a)``)."""
+    tok = tok.strip()
+    if "/" not in tok or tok.startswith("/") or tok.endswith("/"):
+        return False
+    if any(c in tok for c in " ():=,\"'") or "//" in tok:
+        return False
+    return all(
+        seg and "." not in seg
+        and re.fullmatch(r"[A-Za-z0-9_*-]+", seg)
+        for seg in tok.split("/"))
+
+
+def _doc_metric_patterns(cfg) -> Dict[str, List[str]]:
+    """pattern -> [sources]; every metric-shaped backticked token
+    (brace alternation / ``<x>`` / ``...`` expanded BEFORE shape
+    filtering, so ``pass/{train,eval}_*`` survives)."""
+    out: Dict[str, List[str]] = {}
+    for rel in cfg.metric_docs:
+        text = P.read_doc(cfg.abspath(rel))
+        for tok in P.backtick_tokens(text):
+            for pat in P.expand_doc_pattern(tok):
+                if _is_metric_shaped(pat):
+                    out.setdefault(pat, []).append(rel)
+    return out
+
+
+def globs_intersect(a: str, b: str) -> bool:
+    """True when two '*'-glob patterns share at least one concrete
+    string (``pass/*_steps`` vs ``pass/train_*`` -> ``pass/train_steps``).
+    Plain strings degrade to equality/fnmatch."""
+    memo: Dict[Tuple[int, int], bool] = {}
+
+    def go(i: int, j: int) -> bool:
+        key = (i, j)
+        if key in memo:
+            return memo[key]
+        memo[key] = False  # cycle guard for ('*','*')
+        if i == len(a) and j == len(b):
+            res = True
+        elif i < len(a) and a[i] == "*":
+            res = go(i + 1, j) or (j < len(b) and go(i, j + 1))
+        elif j < len(b) and b[j] == "*":
+            res = go(i, j + 1) or (i < len(a) and go(i + 1, j))
+        elif i < len(a) and j < len(b) and a[i] == b[j]:
+            res = go(i + 1, j + 1)
+        else:
+            res = False
+        memo[key] = res
+        return res
+
+    return go(0, 0)
+
+
+def _doc_sites(cfg) -> Set[str]:
+    text = P.read_doc(cfg.abspath(cfg.robustness_doc))
+    section = P.doc_section(text, cfg.faultpoint_section)
+    sites: Set[str] = set()
+    for tok in P.backtick_tokens(section):
+        # a table cell may hold "`a/b` / `a/c`" — backtick_tokens already
+        # split those; keep slash-shaped tokens only
+        if "/" in tok and " " not in tok.strip():
+            sites.add(tok.strip())
+    return sites
+
+
+def _looks_like_path(tok: str) -> bool:
+    return tok.endswith((".py", ".md", ".cc", ".h"))
+
+
+def run(proj: P.Project, cfg) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # ---- faultpoint sites ------------------------------------------------
+    code_sites: Dict[str, Tuple[str, int]] = {}
+    for sr in proj.string_refs(_FAULT_APIS):
+        if sr.is_pattern:
+            continue
+        # skip call sites inside the faults module itself (the registry's
+        # own plumbing passes `site` through, not a literal)
+        code_sites.setdefault(sr.value, (sr.path, sr.lineno))
+    doc_sites = _doc_sites(cfg)
+    doc_path = cfg.abspath(cfg.robustness_doc)
+
+    for site, (path, lineno) in sorted(code_sites.items()):
+        if site not in doc_sites:
+            mod = _mod_for(proj, path)
+            reason = (P.pragma_for(mod, lineno, PASS_ID)
+                      if mod else None)
+            findings.append(Finding(
+                PASS_ID, "RD001", SEV_ERROR, path, lineno,
+                f"faultpoint site {site!r} is missing from the "
+                f"{cfg.robustness_doc} site table", site,
+                suppressed_by=reason))
+    for site in sorted(doc_sites - set(code_sites)):
+        if _looks_like_path(site):
+            continue
+        findings.append(Finding(
+            PASS_ID, "RD002", SEV_ERROR, doc_path, 1,
+            f"{cfg.robustness_doc} site table lists {site!r} but no "
+            "faultpoint declares it", site))
+
+    # ---- metric names ----------------------------------------------------
+    code_metrics: Dict[str, Tuple[str, int, bool]] = {}
+    for sr in (proj.string_refs(_METRIC_APIS)
+               + proj.string_refs(_TRACE_APIS)):
+        if "/" not in sr.value:
+            continue  # monitor.add("counter") bare names are internal
+        code_metrics.setdefault(sr.value, (sr.path, sr.lineno,
+                                           sr.is_pattern))
+    doc_pats = _doc_metric_patterns(cfg)
+    doc_literals = [p for p in doc_pats if "*" not in p]
+
+    def documented(name: str, is_pattern: bool) -> bool:
+        return any(globs_intersect(name, pat) for pat in doc_pats)
+
+    for name, (path, lineno, is_pat) in sorted(code_metrics.items()):
+        if documented(name, is_pat):
+            continue
+        mod = _mod_for(proj, path)
+        reason = P.pragma_for(mod, lineno, PASS_ID) if mod else None
+        near = None
+        if not is_pat:
+            best = min(doc_literals, default=None,
+                       key=lambda d: _edit_distance(name, d))
+            if best is not None and _edit_distance(name, best) <= 2:
+                near = best
+        if near is not None:
+            findings.append(Finding(
+                PASS_ID, "RD004", SEV_WARN, path, lineno,
+                f"metric {name!r} is undocumented but is within edit "
+                f"distance 2 of documented {near!r} — typo?", name,
+                suppressed_by=reason))
+        else:
+            findings.append(Finding(
+                PASS_ID, "RD003", SEV_ERROR, path, lineno,
+                f"metric {name!r} is documented in none of "
+                f"{', '.join(cfg.metric_docs)}", name,
+                suppressed_by=reason))
+
+    code_names = list(code_metrics)
+    code_literals = [n for n, (_, _, is_pat) in code_metrics.items()
+                     if not is_pat]
+    for pat in sorted(doc_literals):
+        if pat in doc_sites or pat in code_sites:
+            continue  # faultpoint sites share the doc namespace
+        hit = any(globs_intersect(pat, cn) for cn in code_names)
+        if not hit and any(_edit_distance(pat, cn) <= 2
+                           for cn in code_literals):
+            continue  # the RD004 near-miss already covers this typo
+        if not hit:
+            findings.append(Finding(
+                PASS_ID, "RD005", SEV_WARN,
+                cfg.abspath(cfg.metric_docs[0]), 1,
+                f"doc metric {pat!r} matches no registered metric in "
+                "code (stale doc entry?)", pat))
+
+    # ---- transient contract ---------------------------------------------
+    findings.extend(_check_transient_contract(proj, cfg))
+    return findings
+
+
+def _check_transient_contract(proj: P.Project, cfg) -> List[Finding]:
+    """InjectedFault must stay transient, or drills stop proving the
+    self-heal loop. Located by finding the module that defines
+    ``is_transient`` + ``InjectedFault``; absent module -> no check
+    (fixture projects)."""
+    for mod in proj.modules.values():
+        cls = mod.classes.get("InjectedFault")
+        has_fn = any(q.endswith(":is_transient") for q in mod.functions)
+        if cls is None or not has_fn:
+            continue
+        out: List[Finding] = []
+        transient_attr = False
+        for node in cls.node.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "transient"
+                            for t in node.targets)
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is True):
+                transient_attr = True
+        in_types = False
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "_TRANSIENT_TYPES"
+                            for t in node.targets)):
+                for el in ast.walk(node.value):
+                    if (isinstance(el, ast.Name)
+                            and el.id == "InjectedFault"):
+                        in_types = True
+        if not (transient_attr or in_types):
+            out.append(Finding(
+                PASS_ID, "RD006", SEV_ERROR, mod.path, cls.node.lineno,
+                "InjectedFault is no longer classified transient "
+                "(neither `transient = True` nor membership in "
+                "_TRANSIENT_TYPES) — injected drills would stop "
+                "exercising the pass-retry loop", "InjectedFault"))
+        return out
+    return []
+
+
+def _mod_for(proj: P.Project, path: str) -> Optional[P.ModuleInfo]:
+    for mod in proj.modules.values():
+        if mod.path == path:
+            return mod
+    return None

@@ -1,0 +1,156 @@
+"""Pass 5 — replay purity.
+
+PR 5's guarantee is that a retried pass replays **bit-identical** to an
+unfailed run. That holds only while nothing on the replay path consults
+a nondeterministic source. Within every function reachable from the
+self-heal replay roots (``day_runner.train_pass``'s retry loop, the
+pass engine, the device store):
+
+- ``RP001`` — wall-clock state source: ``time.time``/``time_ns``,
+  ``datetime.now``/``utcnow``/``today``. (``time.perf_counter``/
+  ``monotonic``/``sleep`` are allowed — they feed telemetry and
+  backoff, not state; a perf_counter value flowing into model state
+  would be a bug this pass cannot see, which STATIC_ANALYSIS.md calls
+  out.)
+- ``RP002`` — randomness: the global ``random`` module, legacy
+  ``np.random.*`` global-state calls, seedless
+  ``np.random.default_rng()``, ``os.urandom``, ``uuid.uuid1/4``,
+  ``secrets.*``.
+- ``RP003`` — (warn) nondeterministic iteration: ``for`` over a value
+  built as a ``set`` in the same function, or ``list(set(...))`` /
+  ``tuple(set(...))`` (set order is hash-seed-dependent across
+  processes — a replay in a restarted worker walks a different order).
+
+Intentional sites (timestamps embedded as *metadata*, never state)
+carry ``# graftlint: allow-replay(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.graftlint import project as P
+from tools.graftlint.findings import Finding, SEV_ERROR, SEV_WARN
+
+PASS_ID = "replay_purity"
+
+_WALL_CLOCK = {
+    ("time", "time"), ("time", "time_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+}
+_RANDOM_HEADS = {"random", "secrets"}
+_NP_RANDOM = {"rand", "randn", "randint", "shuffle", "permutation",
+              "choice", "random", "uniform", "normal", "sample", "seed",
+              "random_sample", "bytes"}
+_RANDOM_ALLOWED = {"Random", "SystemRandom"}  # explicit-seed instances
+
+
+class _PurityVisitor(ast.NodeVisitor):
+    def __init__(self, fi: P.FunctionInfo, findings: List[Finding]):
+        self.fi = fi
+        self.findings = findings
+        self.set_vars: Set[str] = set()
+
+    def _flag(self, node: ast.AST, code: str, msg: str,
+              severity: str = SEV_ERROR) -> None:
+        lineno = getattr(node, "lineno", self.fi.lineno)
+        reason = P.pragma_for(self.fi.module, lineno, PASS_ID)
+        try:
+            expr = ast.unparse(node)[:60]
+        except Exception:
+            expr = "<expr>"
+        self.findings.append(Finding(
+            PASS_ID, code, severity, self.fi.path, lineno,
+            f"{msg} (replay-reachable function {self.fi.qualname})",
+            f"{self.fi.qualname}:{expr}", suppressed_by=reason))
+
+    def _is_set_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            chain = P.call_chain(node.func)
+            if chain == ("set",):
+                return True
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+            return (self._is_set_expr(node.left)
+                    or self._is_set_expr(node.right))
+        if isinstance(node, ast.Name):
+            return node.id in self.set_vars
+        return False
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        is_set = self._is_set_expr(node.value)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                (self.set_vars.add if is_set
+                 else self.set_vars.discard)(t.id)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        chain = P.call_chain(node.func)
+        if chain is None:
+            return
+        tail2 = tuple(chain[-2:]) if len(chain) >= 2 else None
+        if tail2 in _WALL_CLOCK:
+            self._flag(node, "RP001",
+                       f"wall-clock call `{'.'.join(chain)}()` on the "
+                       "replay path (nondeterministic across retries)")
+            return
+        head = chain[0]
+        if (head in _RANDOM_HEADS and len(chain) >= 2
+                and chain[1] not in _RANDOM_ALLOWED):
+            self._flag(node, "RP002",
+                       f"global randomness `{'.'.join(chain)}()` on the "
+                       "replay path")
+            return
+        if (len(chain) >= 3 and head in ("np", "numpy")
+                and chain[1] == "random" and chain[2] in _NP_RANDOM):
+            self._flag(node, "RP002",
+                       f"legacy global-RNG `{'.'.join(chain)}()` on the "
+                       "replay path (use a seeded Generator)")
+            return
+        if (len(chain) >= 3 and head in ("np", "numpy")
+                and chain[1] == "random" and chain[2] == "default_rng"
+                and not node.args):
+            self._flag(node, "RP002",
+                       "seedless np.random.default_rng() on the replay "
+                       "path")
+            return
+        if tail2 == ("os", "urandom") or (
+                head == "uuid" and len(chain) >= 2
+                and chain[1] in ("uuid1", "uuid4")):
+            self._flag(node, "RP002",
+                       f"entropy source `{'.'.join(chain)}()` on the "
+                       "replay path")
+            return
+        if (chain in (("list",), ("tuple",)) and node.args
+                and self._is_set_expr(node.args[0])):
+            self._flag(node, "RP003",
+                       f"`{chain[0]}(set(...))` materializes "
+                       "hash-order-dependent sequence", SEV_WARN)
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._is_set_expr(node.iter):
+            self._flag(node.iter, "RP003",
+                       "iteration over a set (hash-order-dependent) on "
+                       "the replay path — use sorted()", SEV_WARN)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is self.fi.node:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(proj: P.Project, cfg) -> List[Finding]:
+    findings: List[Finding] = []
+    reachable = proj.reachable(cfg.replay_roots)
+    for qual in sorted(reachable):
+        fi = proj.functions.get(qual)
+        if fi is not None:
+            _PurityVisitor(fi, findings).visit(fi.node)
+    return findings

@@ -1,0 +1,587 @@
+"""The shared project walker behind every graftlint pass.
+
+One parse of the tree (stdlib ``ast`` only — no jax, no runtime import)
+produces the three structures the passes share:
+
+- a **module index**: every ``.py`` file under the configured roots,
+  parsed, with its import table and top-level symbols;
+- an **intra-project call graph**: best-effort resolution of every call
+  site to project functions/methods (local names, ``from``-imports,
+  ``module.func``, ``self.method`` through the enclosing class and its
+  project bases, plus a unique-name fallback for ``obj.method`` when
+  exactly one project function carries that name);
+- a **string-literal registry**: every literal (and f-string pattern)
+  passed to the flag / faultpoint / metric APIs, with file:line, so the
+  drift passes cross-check code against the markdown tables without
+  executing anything.
+
+Resolution is deliberately *recall-biased*: hot-path reachability wants
+to over-approximate (a missed edge hides a sync; a spurious edge at
+worst asks for a pragma). Passes that need precision (lock discipline)
+re-walk function bodies themselves with the graph as scaffolding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# --------------------------------------------------------------------------
+# Data model
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function or method (nested defs included)."""
+    qualname: str                 # "pkg.mod:Class.method" / "pkg.mod:f.inner"
+    module: "ModuleInfo"
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef | Lambda
+    cls: Optional[str]            # enclosing class name, if a method
+    parent: Optional[str]         # enclosing function qualname, if nested
+    name: str = ""
+
+    def __post_init__(self):
+        self.name = getattr(self.node, "name",
+                            self.qualname.rsplit(".", 1)[-1])
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+    @property
+    def path(self) -> str:
+        return self.module.path
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    bases: List[str]
+    methods: Dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    # attribute name -> the rhs call chain it was assigned from in any
+    # method body (e.g. "_lock" -> "threading.Lock"); first writer wins.
+    attr_ctors: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class StringRef:
+    """A string literal (or f-string pattern) at a registry call site."""
+    value: str                    # literal text; f-string parts become "*"
+    api: str                      # e.g. "monitor.add", "faultpoint", "flag"
+    path: str
+    lineno: int
+    is_pattern: bool = False      # True when built from an f-string
+
+
+class ModuleInfo:
+    def __init__(self, name: str, path: str, tree: ast.Module,
+                 source: str):
+        self.name = name                      # dotted, e.g. "pkg.train.x"
+        self.path = path
+        self.tree = tree
+        self.source = source
+        self.lines = source.splitlines()
+        # alias -> dotted module ("np" -> "numpy"); from-import:
+        # name -> (module, original_name)
+        self.import_modules: Dict[str, str] = {}
+        self.import_names: Dict[str, Tuple[str, str]] = {}
+        self.functions: Dict[str, FunctionInfo] = {}   # qual -> info
+        self.classes: Dict[str, ClassInfo] = {}
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+# --------------------------------------------------------------------------
+# AST helpers
+# --------------------------------------------------------------------------
+
+
+def call_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """``a.b.c`` -> ("a","b","c"); bare name -> ("a",); else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_pattern(node: ast.AST) -> Optional[str]:
+    """JoinedStr -> glob pattern with '*' for each formatted value."""
+    if not isinstance(node, ast.JoinedStr):
+        return None
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        else:
+            parts.append("*")
+    return "".join(parts)
+
+
+def string_or_pattern(node: ast.AST) -> Optional[Tuple[str, bool]]:
+    s = literal_str(node)
+    if s is not None:
+        return s, False
+    p = fstring_pattern(node)
+    if p is not None:
+        return p, True
+    return None
+
+
+# --------------------------------------------------------------------------
+# Pragmas
+# --------------------------------------------------------------------------
+
+# ``# graftlint: allow-sync(reason)`` — also allow-flag / allow-registry /
+# allow-lock / allow-replay, and the catch-all allow(reason). A pragma on
+# the finding's line or the line directly above suppresses it.
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*allow(?:-(?P<kind>[a-z_]+))?\s*\(\s*(?P<reason>[^)]*)\)")
+
+PRAGMA_KINDS = {
+    "sync": "hot_sync",
+    "flag": "flag_hygiene",
+    "registry": "registry_drift",
+    "lock": "lock_discipline",
+    "replay": "replay_purity",
+}
+
+
+def pragma_for(module: ModuleInfo, lineno: int,
+               pass_id: str) -> Optional[str]:
+    """Return the pragma reason suppressing ``pass_id`` at ``lineno``
+    (same line or the line above), or None."""
+    for ln in (lineno, lineno - 1):
+        m = _PRAGMA_RE.search(module.line(ln))
+        if not m:
+            continue
+        kind = m.group("kind")
+        if kind is None or PRAGMA_KINDS.get(kind) == pass_id:
+            return m.group("reason").strip() or "allowed by pragma"
+    return None
+
+
+# --------------------------------------------------------------------------
+# The project
+# --------------------------------------------------------------------------
+
+
+class Project:
+    """Parsed view of the tree. Build once, share across passes."""
+
+    def __init__(self, root: str, roots: Sequence[str],
+                 exclude: Sequence[str] = ()):
+        self.root = os.path.abspath(root)
+        self.modules: Dict[str, ModuleInfo] = {}      # dotted name -> info
+        self.functions: Dict[str, FunctionInfo] = {}  # qualname -> info
+        self.classes: Dict[str, List[ClassInfo]] = {}  # bare name -> infos
+        self.parse_errors: List[Tuple[str, str]] = []
+        # simple-name -> [qualnames] for the unique-name fallback
+        self._by_name: Dict[str, List[str]] = {}
+        self._call_cache: Dict[str, Tuple[str, ...]] = {}
+        for path in self._iter_paths(roots, exclude):
+            self._load(path)
+        self._index()
+
+    # -- loading -----------------------------------------------------------
+
+    def _iter_paths(self, roots: Sequence[str],
+                    exclude: Sequence[str]) -> Iterable[str]:
+        exc = [os.path.normpath(e) for e in exclude]
+        for r in roots:
+            full = os.path.join(self.root, r)
+            if os.path.isfile(full):
+                yield full
+                continue
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                rel_dir = os.path.relpath(dirpath, self.root)
+                if any(rel_dir == e or rel_dir.startswith(e + os.sep)
+                       for e in exc):
+                    dirnames[:] = []
+                    continue
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+    def _module_name(self, path: str) -> str:
+        rel = os.path.relpath(path, self.root)
+        rel = rel[:-3] if rel.endswith(".py") else rel
+        parts = rel.split(os.sep)
+        if parts[-1] == "__init__":
+            parts = parts[:-1] or parts
+        return ".".join(parts)
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError) as e:
+            self.parse_errors.append((path, str(e)))
+            return
+        mod = ModuleInfo(self._module_name(path), path, tree, src)
+        self.modules[mod.name] = mod
+        self._collect(mod)
+
+    # -- symbol collection -------------------------------------------------
+
+    def _collect(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.import_modules[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    mod.import_names[a.asname or a.name] = (
+                        node.module, a.name)
+
+        def walk_body(body, cls: Optional[ClassInfo], prefix: str,
+                      parent: Optional[str]):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{mod.name}:{prefix}{node.name}"
+                    fi = FunctionInfo(qual, mod, node,
+                                      cls.name if cls else None, parent)
+                    mod.functions[qual] = fi
+                    if cls is not None and parent is None:
+                        cls.methods[node.name] = fi
+                    walk_body(node.body, cls, prefix + node.name + ".",
+                              qual)
+                elif isinstance(node, ast.ClassDef):
+                    ci = ClassInfo(node.name, mod, node,
+                                   [b.id for b in node.bases
+                                    if isinstance(b, ast.Name)]
+                                   + [b.attr for b in node.bases
+                                      if isinstance(b, ast.Attribute)])
+                    mod.classes[node.name] = ci
+                    walk_body(node.body, ci, prefix + node.name + ".",
+                              parent)
+                    self._collect_attr_ctors(ci)
+                elif isinstance(node, (ast.If, ast.Try, ast.With,
+                                       ast.For, ast.While)):
+                    # conservative: walk nested statement bodies for defs
+                    for field in ("body", "orelse", "finalbody"):
+                        walk_body(getattr(node, field, []) or [],
+                                  cls, prefix, parent)
+                    for h in getattr(node, "handlers", []) or []:
+                        walk_body(h.body, cls, prefix, parent)
+
+        walk_body(mod.tree.body, None, "", None)
+
+    def _collect_attr_ctors(self, ci: ClassInfo) -> None:
+        """``self.x = threading.Lock()`` anywhere in the class body."""
+        for node in ast.walk(ci.node):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, ast.Call):
+                continue
+            chain = call_chain(node.value.func)
+            if chain is None:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and t.attr not in ci.attr_ctors):
+                    ci.attr_ctors[t.attr] = ".".join(chain)
+
+    def _index(self) -> None:
+        for mod in self.modules.values():
+            for qual, fi in mod.functions.items():
+                self.functions[qual] = fi
+                self._by_name.setdefault(fi.name, []).append(qual)
+            for name, ci in mod.classes.items():
+                self.classes.setdefault(name, []).append(ci)
+
+    # -- call resolution ---------------------------------------------------
+
+    def class_method(self, cls_name: str, meth: str,
+                     seen: Optional[Set[str]] = None
+                     ) -> Optional[FunctionInfo]:
+        seen = seen or set()
+        if cls_name in seen:
+            return None
+        seen.add(cls_name)
+        for ci in self.classes.get(cls_name, []):
+            if meth in ci.methods:
+                return ci.methods[meth]
+            for b in ci.bases:
+                got = self.class_method(b, meth, seen)
+                if got is not None:
+                    return got
+        return None
+
+    def resolve_call(self, chain: Tuple[str, ...],
+                     caller: FunctionInfo) -> List[FunctionInfo]:
+        """Best-effort: call chain at a site inside ``caller`` -> project
+        functions it may invoke."""
+        mod = caller.module
+        out: List[FunctionInfo] = []
+        if len(chain) == 1:
+            name = chain[0]
+            # nested / sibling function in the same scope chain
+            for pref in self._scope_prefixes(caller):
+                fi = mod.functions.get(f"{mod.name}:{pref}{name}")
+                if fi is not None:
+                    return [fi]
+            if name in mod.import_names:
+                src_mod, src_name = mod.import_names[name]
+                fi = self.functions.get(f"{src_mod}:{src_name}")
+                if fi is not None:
+                    return [fi]
+                # from X import Class — calling it runs __init__
+                got = self.class_method_in(src_mod, src_name, "__init__")
+                if got is not None:
+                    return [got]
+            return out
+        head, rest = chain[0], chain[1:]
+        if head == "self" and caller.cls is not None and len(rest) == 1:
+            got = self.class_method(caller.cls, rest[0])
+            if got is not None:
+                return [got]
+        if head in mod.import_modules and len(rest) == 1:
+            target = mod.import_modules[head]
+            fi = self.functions.get(f"{target}:{rest[0]}")
+            if fi is not None:
+                return [fi]
+            if target not in self.modules:
+                return out  # external library — never unique-name it
+        if head in mod.import_names and len(rest) == 1:
+            src_mod, src_name = mod.import_names[head]
+            fi = self.functions.get(f"{src_mod}:{src_name}.{rest[0]}")
+            if fi is not None:
+                return [fi]
+            got = self.class_method_in(src_mod, src_name, rest[0])
+            if got is not None:
+                return [got]
+        # unique-name fallback on the final attribute: obj.method(...)
+        quals = self._by_name.get(chain[-1], [])
+        if len(quals) == 1:
+            return [self.functions[quals[0]]]
+        return out
+
+    def class_method_in(self, mod_name: str, cls_name: str,
+                        meth: str) -> Optional[FunctionInfo]:
+        mod = self.modules.get(mod_name)
+        if mod is None:
+            return None
+        ci = mod.classes.get(cls_name)
+        if ci is None:
+            return None
+        if meth in ci.methods:
+            return ci.methods[meth]
+        for b in ci.bases:
+            got = self.class_method(b, meth)
+            if got is not None:
+                return got
+        return None
+
+    def _scope_prefixes(self, fi: FunctionInfo) -> List[str]:
+        """Qual prefixes to try for a bare-name call inside ``fi``:
+        its own nested scope, enclosing scopes, then module level."""
+        local = fi.qualname.split(":", 1)[1]
+        parts = local.split(".")
+        prefixes = []
+        for i in range(len(parts), 0, -1):
+            prefixes.append(".".join(parts[:i]) + ".")
+        prefixes.append("")
+        # a method's bare-name calls also see module scope (captured by
+        # the trailing ""), not the class namespace — python semantics.
+        return prefixes
+
+    def callees(self, fi: FunctionInfo) -> List[FunctionInfo]:
+        cached = self._call_cache.get(fi.qualname)
+        if cached is not None:
+            return [self.functions[q] for q in cached
+                    if q in self.functions]
+        out: List[FunctionInfo] = []
+        seen: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                chain = call_chain(node.func)
+                if chain is None:
+                    continue
+                for target in self.resolve_call(chain, fi):
+                    if target.qualname not in seen:
+                        seen.add(target.qualname)
+                        out.append(target)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                if node is fi.node:
+                    continue
+                # a nested def is conservatively reachable from its parent
+                qual = self._nested_qual(fi, node)
+                if qual and qual not in seen:
+                    seen.add(qual)
+                    out.append(self.functions[qual])
+        self._call_cache[fi.qualname] = tuple(seen)
+        return out
+
+    def _nested_qual(self, parent: FunctionInfo,
+                     node: ast.AST) -> Optional[str]:
+        for qual, fi in parent.module.functions.items():
+            if fi.node is node:
+                return qual
+        return None
+
+    def reachable(self, root_specs: Sequence[str]) -> Dict[str, int]:
+        """Transitive closure from root specs.
+
+        A spec is ``module:qual`` (exact), ``module:Class.*`` (all
+        methods), or ``module:*`` (every function in the module).
+        Returns {qualname: depth}; depth 0 = root.
+        """
+        frontier: List[FunctionInfo] = []
+        for spec in root_specs:
+            frontier.extend(self._match_spec(spec))
+        depth: Dict[str, int] = {f.qualname: 0 for f in frontier}
+        work = list(frontier)
+        while work:
+            fi = work.pop()
+            d = depth[fi.qualname]
+            for callee in self.callees(fi):
+                if callee.qualname not in depth:
+                    depth[callee.qualname] = d + 1
+                    work.append(callee)
+        return depth
+
+    def _match_spec(self, spec: str) -> List[FunctionInfo]:
+        mod_name, _, qual = spec.partition(":")
+        mod = self.modules.get(mod_name)
+        if mod is None:
+            return []
+        if qual == "*":
+            return [fi for fi in mod.functions.values()
+                    if fi.parent is None]
+        if qual.endswith(".*"):
+            prefix = qual[:-1]           # keep the trailing dot
+            return [fi for q, fi in mod.functions.items()
+                    if q.split(":", 1)[1].startswith(prefix)
+                    and "." not in q.split(":", 1)[1][len(prefix):]]
+        fi = mod.functions.get(f"{mod_name}:{qual}")
+        return [fi] if fi is not None else []
+
+    # -- string-literal registry ------------------------------------------
+
+    def string_refs(self, apis: Dict[str, int]) -> List[StringRef]:
+        """Collect literal/f-string args at registry call sites.
+
+        ``apis`` maps an API tail (the call chain's last 1–2 elements
+        joined with '.') to the positional arg index holding the name,
+        e.g. {"monitor.add": 0, "faultpoint": 0, "flags.flag": 0}.
+        """
+        out: List[StringRef] = []
+        for mod in self.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = call_chain(node.func)
+                if chain is None:
+                    continue
+                for tail_len in (2, 1):
+                    if len(chain) < tail_len:
+                        continue
+                    tail = ".".join(chain[-tail_len:])
+                    if tail not in apis:
+                        continue
+                    idx = apis[tail]
+                    if idx >= len(node.args):
+                        continue
+                    got = string_or_pattern(node.args[idx])
+                    if got is not None:
+                        val, is_pat = got
+                        out.append(StringRef(val, tail, mod.path,
+                                             node.lineno, is_pat))
+                    break
+        return out
+
+
+# --------------------------------------------------------------------------
+# Markdown helpers (doc-side of the drift passes)
+# --------------------------------------------------------------------------
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+_FENCE_RE = re.compile(r"^```.*?^```[ \t]*$", re.M | re.S)
+
+
+def read_doc(path: str) -> str:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def backtick_tokens(text: str) -> List[str]:
+    """Inline-code tokens. Fenced blocks are dropped first (their
+    backtick runs would flip pairing parity), and a token wrapped
+    across a line break (markdown reflow) is rejoined without the
+    break/indent."""
+    text = _FENCE_RE.sub("", text)
+    out = []
+    for tok in _BACKTICK_RE.findall(text):
+        if "\n" in tok:
+            tok = re.sub(r"\s*\n\s*", "", tok)
+        out.append(tok)
+    return out
+
+
+def doc_section(text: str, heading: str) -> str:
+    """The body of the markdown section whose heading contains
+    ``heading`` (case-insensitive), up to the next same-or-higher-level
+    heading. Empty string when absent."""
+    lines = text.splitlines()
+    out: List[str] = []
+    level = None
+    for ln in lines:
+        m = re.match(r"(#+)\s+(.*)", ln)
+        if m:
+            if level is not None and len(m.group(1)) <= level:
+                break
+            if level is None and heading.lower() in m.group(2).lower():
+                level = len(m.group(1))
+                continue
+        if level is not None:
+            out.append(ln)
+    return "\n".join(out)
+
+
+def expand_doc_pattern(tok: str) -> List[str]:
+    """A backticked doc token -> glob patterns.
+
+    ``pass/{train,eval}_*`` -> ["pass/train_*", "pass/eval_*"];
+    ``fault/<site>_injected`` -> ["fault/*_injected"]; ``...`` -> "*".
+    """
+    tok = tok.strip()
+    tok = re.sub(r"<[^>]*>", "*", tok)
+    tok = tok.replace("...", "*")
+    m = re.search(r"\{([^{}]*)\}", tok)
+    if m:
+        alts = [a.strip() for a in m.group(1).split(",")]
+        out = []
+        for a in alts:
+            out.extend(expand_doc_pattern(
+                tok[:m.start()] + a + tok[m.end():]))
+        return out
+    return [tok]

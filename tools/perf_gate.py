@@ -56,6 +56,11 @@ LOWER_SUFFIXES = ("_ms", "_s", "_bytes", "idle_frac",
                   "overflow")
 # Exact-name entries (dotted-path last segment).
 HIGHER_NAMES = ("value",)  # bench headline — every config is throughput
+# graftlint summary JSON (python -m tools.graftlint --summary): finding
+# counts are lower-better — gating a new summary against a recorded one
+# fails the run when the baseline/pragma surface silently grows.
+LOWER_NAMES = ("findings_total", "new", "baselined", "allowed",
+               "warnings")
 
 
 def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
@@ -87,6 +92,8 @@ def direction(path: str) -> int:
     for seg in reversed(segments):
         if seg in HIGHER_NAMES:
             return 1
+        if seg in LOWER_NAMES:
+            return -1
         for s in HIGHER_SUFFIXES:
             # endswith, or unit-in-the-middle ("dispatch_ms_quantiles").
             if seg.endswith(s) or (s + "_") in seg:
