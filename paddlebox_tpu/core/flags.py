@@ -356,6 +356,28 @@ define_flag("trainer_map_ahead", True,
             "critical path entirely (it was already off the DEVICE "
             "path via the producer thread). False = map inline in the "
             "producer (r07 behavior)")
+define_flag("ingest_workers", 0,
+            "worker PROCESSES for dataset load: file blocks parse into "
+            "ColumnarChunk CSR arrays in child processes (native C++ "
+            "parser, or the vectorized numpy bulk parse when no native "
+            "lib) and hand off through zero-copy shared-memory frames — "
+            "the GIL-bound thread-reader path cannot use more than one "
+            "core for the python parse. 0 (default) = the in-process "
+            "thread reader; ignored when an instance-scoped parser_fn "
+            "is set (closures don't cross process boundaries)")
+define_flag("ingest_file_retries", 1,
+            "times a file whose ingest worker DIED mid-parse (SIGKILL/"
+            "OOM) is requeued onto a fresh worker before the load fails; "
+            "chunks commit only at file completion, so a retry never "
+            "duplicates rows. Worker-raised errors (bad data, failing "
+            "pipe_command) are never retried — they would fail again")
+define_flag("ingest_key_runs", True,
+            "dedup each loaded chunk's keys into per-slot sorted runs "
+            "DURING ingest and serve pass_keys() as a linear k-way "
+            "merge of those runs (the sorted-run store build feed) "
+            "instead of one end-of-load sort over every id. False = the "
+            "r02 behavior (np.unique at feed time); results are "
+            "bit-identical either way")
 define_flag("wuauc_spill_records", 4_000_000,
             "per-user-AUC raw records held in RAM before spilling to "
             "uid-hash bucket files on disk (bounds eval-pass host memory; "

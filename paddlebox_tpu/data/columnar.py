@@ -46,6 +46,14 @@ class ColumnarChunk:
     def num_rows(self) -> int:
         return int(self.labels.shape[0])
 
+    @property
+    def nbytes(self) -> int:
+        """Total array payload bytes (shm frame sizing / ingest metrics)."""
+        return int(self.labels.nbytes
+                   + sum(v.nbytes for v in self.sparse_ids.values())
+                   + sum(v.nbytes for v in self.sparse_offsets.values())
+                   + sum(v.nbytes for v in self.dense.values()))
+
     def all_keys(self) -> np.ndarray:
         parts = [v for v in self.sparse_ids.values() if v.size]
         if not parts:

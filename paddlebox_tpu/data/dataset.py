@@ -18,15 +18,16 @@ during load (role of ``MergeInsKeys`` → ``PSAgent::AddKey``).
 
 from __future__ import annotations
 
+import builtins
 import os
 import queue
 import subprocess
 import threading
-from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.core import faults, flags, log, monitor
 from paddlebox_tpu.data.channel import Channel, ClosedChannelError
 from paddlebox_tpu.data.columnar import ColumnarChunk, instances_to_chunk
 from paddlebox_tpu.data.parser import parse_lines
@@ -85,6 +86,14 @@ def _parse_block(block: bytes, config: DataFeedConfig,
         chunk = parse_chunk_native(block, config)
         if chunk is not None:
             return chunk
+        # No native library: the vectorized numpy bulk parse (C-level
+        # S→numeric casts over the whole block) before the per-line
+        # loop; it returns None on any input it cannot prove it handles
+        # bit-identically, so semantics never change.
+        from paddlebox_tpu.data.parser import parse_block_numpy
+        chunk = parse_block_numpy(block, config)
+        if chunk is not None:
+            return chunk
     # Split on '\n' only — matching the block framing and the native
     # parser; str.splitlines would also break on NEL/FF/LS etc. and make
     # the two parser paths disagree on exotic bytes.
@@ -123,6 +132,17 @@ class Dataset:
         self._preload_threads: List[threading.Thread] = []
         self._reader_errors: List[BaseException] = []
         self._lock = threading.Lock()
+        # Sorted-run pass-key collection (round 13): per-slot sorted
+        # unique key runs, one per loaded chunk, deduped DURING ingest so
+        # pass_keys() is a linear k-way merge instead of one giant
+        # end-of-load sort. Valid only while every loaded chunk passed
+        # through _drain and no key-set-changing op ran.
+        self._key_runs: Dict[str, List[np.ndarray]] = {}
+        self._key_zero: Dict[str, bool] = {}
+        self._key_runs_valid = True
+        # Live ingest worker processes (multi-process path) — exposed so
+        # tests/drills can kill one mid-load.
+        self._ingest_procs: List = []
         # Hook invoked with each loaded chunk's keys at load time — wired
         # to the embedding engine's pass-key collector (role of
         # PSAgent::AddKey threading in MergeInsKeys, data_set.cc:2289).
@@ -165,6 +185,8 @@ class Dataset:
                 self._reader_errors.append(e)
 
     def _start_load(self) -> Channel:
+        if int(flags.flag("ingest_workers")) > 0 and self.parser_fn is None:
+            return self._start_load_mp(int(flags.flag("ingest_workers")))
         file_q: "queue.Queue[str]" = queue.Queue()
         for f in self._filelist:
             file_q.put(f)
@@ -183,6 +205,216 @@ class Dataset:
             out.close()
 
         threading.Thread(target=closer, daemon=True).start()
+        return out
+
+    def _start_load_mp(self, num_workers: int) -> Channel:
+        """Multi-process columnar ingest (FLAGS_ingest_workers; role of
+        the reference's multithreaded LoadIntoMemory, data_set.cc:2283,
+        which parallelizes for real because it is C++ — here the python
+        parse escapes the GIL by running in worker PROCESSES that hand
+        chunks back through zero-copy shared-memory frames).
+
+        Same Channel contract as the thread path, so load/preload/dump
+        and ``_drain`` (key_sink included) are unchanged. A worker death
+        mid-file is detected by the pump, its staged frames are
+        discarded (commit happens only on ``file_done``, so no partial
+        rows), the file is requeued up to ``FLAGS_ingest_file_retries``
+        times on a fresh worker, and an exhausted retry budget surfaces
+        through ``_reader_errors`` like any reader failure."""
+        import multiprocessing as mp
+
+        from paddlebox_tpu.data import shm_channel
+        from paddlebox_tpu.data.ingest_worker import worker_main
+
+        # spawn, not fork: the parent holds jax state and live threads
+        # (preload/trainer); forking either is undefined behavior.
+        ctx = mp.get_context("spawn")
+        with self._lock:
+            files = list(self._filelist)
+        out: Channel = Channel(self._channel_capacity)
+        parent_pid = os.getpid()
+        load_id = shm_channel.next_load_id()
+        task_q = ctx.Queue()
+        for f in files:
+            task_q.put(f)
+        msg_q = ctx.Queue()
+        n_workers = min(num_workers, max(1, len(files)))
+        max_file_retries = int(flags.flag("ingest_file_retries"))
+        # Runaway-respawn backstop (a replacement that itself keeps
+        # dying must converge to an error, not a spawn loop).
+        respawn_budget = [n_workers + len(files) * max(1, max_file_retries)]
+
+        def pump():
+            procs: Dict[int, object] = {}
+            current: Dict[int, Optional[str]] = {}
+            staged: Dict[int, list] = {}
+            committed: Dict[int, set] = {}
+            finished: set = set()
+            settled: set = set()   # paths that reached done/error
+            file_retries: Dict[str, int] = {}
+            next_wid = [0]
+
+            def new_worker():
+                faults.faultpoint("ingest/worker_spawn")
+                if respawn_budget[0] <= 0:
+                    raise RuntimeError(
+                        "ingest worker respawn budget exhausted")
+                respawn_budget[0] -= 1
+                wid = next_wid[0]
+                next_wid[0] += 1
+                p = ctx.Process(target=worker_main,
+                                args=(wid, parent_pid, load_id, task_q,
+                                      msg_q, self.config),
+                                daemon=True)
+                p.start()
+                procs[wid] = p
+                current[wid] = None
+                staged[wid] = []
+                committed[wid] = set()
+                self._ingest_procs.append(p)
+                monitor.add("ingest/workers_spawned", 1)
+
+            def discard_staged(wid):
+                for _name, _chunk, release in staged[wid]:
+                    release()
+                staged[wid] = []
+
+            def record_error(exc: BaseException):
+                with self._lock:
+                    self._reader_errors.append(exc)
+
+            def handle(msg):
+                kind, wid = msg[0], msg[1]
+                if kind == "file_start":
+                    current[wid] = msg[2]
+                elif kind in ("file_done", "file_error"):
+                    settled.add(msg[2])
+                if kind == "file_done":
+                    current[wid] = None
+                    frames, staged[wid] = staged[wid], []
+                    n = 0
+                    for name, chunk, _release in frames:
+                        committed[wid].add(name)
+                        n += chunk.num_rows
+                        out.put(chunk)
+                    monitor.add("dataset/ins_loaded", n)
+                    monitor.add("ingest/chunks", len(frames))
+                    monitor.add("ingest/rows", n)
+                    log.vlog(1, "ingest: loaded %d instances from %s",
+                             n, msg[2])
+                elif kind == "chunk":
+                    _k, _w, _path, name, _n, _nb = msg
+                    faults.faultpoint("ingest/shm_attach")
+                    chunk, release = shm_channel.read_chunk(name)
+                    staged[wid].append((name, chunk, release))
+                elif kind == "file_error":
+                    _k, _w, path, ename, emsg = msg
+                    current[wid] = None
+                    discard_staged(wid)
+                    t = getattr(builtins, ename, None)
+                    if isinstance(t, type) and issubclass(t, BaseException):
+                        record_error(t(emsg))
+                    else:
+                        record_error(RuntimeError(f"{ename}: {emsg}"))
+                elif kind == "exit":
+                    finished.add(wid)
+
+            def check_dead():
+                dead = [wid for wid, p in procs.items()
+                        if wid not in finished and not p.is_alive()]
+                if not dead:
+                    return
+                # Final drain first: messages the worker flushed before
+                # dying (possibly its file_done/exit) must win over the
+                # death verdict, or a COMPLETED file would be requeued
+                # and its rows duplicated.
+                while True:
+                    try:
+                        handle(msg_q.get_nowait())
+                    except queue.Empty:
+                        break
+                for wid in dead:
+                    if wid in finished:
+                        continue  # the drain found its exit after all
+                    faults.faultpoint("ingest/worker_exit")
+                    p = procs[wid]
+                    finished.add(wid)
+                    discard_staged(wid)
+                    shm_channel.sweep_orphans(parent_pid, load_id,
+                                              worker_id=wid,
+                                              exclude=committed[wid])
+                    path = current.get(wid)
+                    current[wid] = None
+                    monitor.add("ingest/worker_deaths", 1)
+                    if path is not None:
+                        n = file_retries.get(path, 0)
+                        if n < max_file_retries:
+                            file_retries[path] = n + 1
+                            monitor.add("ingest/worker_restarts", 1)
+                            log.warning(
+                                "ingest worker %d died (exitcode %s) "
+                                "parsing %s — retry %d/%d on a fresh "
+                                "worker", wid, p.exitcode, path, n + 1,
+                                max_file_retries)
+                            task_q.put(path)
+                            new_worker()
+                        else:
+                            settled.add(path)
+                            record_error(RuntimeError(
+                                f"ingest worker died (exitcode "
+                                f"{p.exitcode}) parsing {path!r}; "
+                                f"{max_file_retries} retries exhausted"))
+                    elif (not any(procs[w].is_alive() for w in procs)
+                            and not task_q.empty()):
+                        # Died idle with files still queued and no
+                        # sibling left to drain them.
+                        new_worker()
+
+            try:
+                for _ in range(n_workers):
+                    new_worker()
+                while len(finished) < len(procs):
+                    try:
+                        msg = msg_q.get(timeout=0.25)
+                    except queue.Empty:
+                        check_dead()
+                        continue
+                    handle(msg)
+                missing = [f for f in files if f not in settled]
+                with self._lock:
+                    have_errors = bool(self._reader_errors)
+                if missing and not have_errors:
+                    # Closes the kill window between a worker's task_q
+                    # pop and its file_start announcement: a file that
+                    # never settled must fail the load, not silently
+                    # shrink the pass.
+                    record_error(RuntimeError(
+                        f"ingest ended with {len(missing)} unparsed "
+                        f"file(s): {missing[:3]}"))
+            except ClosedChannelError:
+                pass  # consumer bailed early (dump error path)
+            except BaseException as e:
+                record_error(e)
+            finally:
+                # SIGKILL, not SIGTERM: workers are stateless daemons
+                # (any staged shm is discarded below) and a teardown
+                # must never wait on a wedged parse.
+                for p in procs.values():
+                    if p.is_alive():
+                        p.kill()
+                for wid, p in procs.items():
+                    p.join(timeout=5)
+                    discard_staged(wid)
+                    shm_channel.sweep_orphans(parent_pid, load_id,
+                                              worker_id=wid,
+                                              exclude=committed[wid])
+                with self._lock:
+                    self._ingest_procs = [
+                        p for p in self._ingest_procs if p.is_alive()]
+                out.close()
+
+        threading.Thread(target=pump, daemon=True,
+                         name="pbx-ingest-pump").start()
         return out
 
     def _raise_reader_errors(self) -> None:
@@ -212,13 +444,43 @@ class Dataset:
         self._preload_threads = []
         self._raise_reader_errors()
 
+    def _collect_key_runs(self, chunk: ColumnarChunk) -> None:
+        """Dedup the chunk's per-slot keys into sorted runs DURING the
+        load (overlapping ingest) so pass_keys() becomes a linear k-way
+        merge instead of one end-of-load np.unique over every id (the
+        r02 feed-time sort). Bit-parity: merge(runs) == np.unique(concat)
+        — dedup_keys drops the 0 sentinel, so a seen-zero flag restores
+        it for the slots where the old path would have reported it."""
+        from paddlebox_tpu.native.keymap_py import dedup_keys
+        runs: List[Tuple[str, np.ndarray, bool]] = []
+        for s, ids in chunk.sparse_ids.items():
+            if ids.size:
+                runs.append((s, dedup_keys(ids), bool((ids == 0).any())))
+        with self._lock:
+            if not self._key_runs_valid:
+                return
+            for s, run, zero in runs:
+                if run.size:
+                    self._key_runs.setdefault(s, []).append(run)
+                if zero:
+                    self._key_zero[s] = True
+
+    def _invalidate_key_runs(self) -> None:
+        with self._lock:
+            self._key_runs_valid = False
+            self._key_runs = {}
+            self._key_zero = {}
+
     def _drain(self, ch: Channel) -> None:
         sink = self.key_sink
+        collect = bool(flags.flag("ingest_key_runs"))
         local: List[ColumnarChunk] = []
         try:
             while True:
                 chunk = ch.get()
                 local.append(chunk)
+                if collect:
+                    self._collect_key_runs(chunk)
                 if sink is not None:
                     keys = chunk.all_keys()
                     if keys.size:
@@ -228,6 +490,12 @@ class Dataset:
         with self._lock:
             self._chunks.extend(local)
             self._merged = None
+            if local and not collect:
+                # Runs no longer cover every loaded chunk — pass_keys
+                # falls back to the exact merged-sort path.
+                self._key_runs_valid = False
+                self._key_runs = {}
+                self._key_zero = {}
 
     def _merge(self) -> ColumnarChunk:
         with self._lock:
@@ -289,6 +557,9 @@ class Dataset:
                 monitor.add("dataset/shuffle_partition_dropped", dropped)
         else:
             received = exchange(buckets)
+        # The key SET changed (rows left/arrived) — ingest-time runs no
+        # longer describe what is loaded.
+        self._invalidate_key_runs()
         with self._lock:
             self._chunks = [received]
             self._merged = received
@@ -350,6 +621,7 @@ class Dataset:
 
     def restore_chunks(self, snap) -> None:
         chunks, merged = snap
+        self._invalidate_key_runs()  # snapshot may predate current load
         with self._lock:
             self._chunks = list(chunks)
             self._merged = merged
@@ -403,6 +675,7 @@ class Dataset:
             # misconfigured path must not silently yield an empty pass.
             raise FileNotFoundError(f"no chunk-*.npz under {spill_dir!r}")
         chunks = [ColumnarChunk.load(p) for p in files]
+        self._invalidate_key_runs()  # spilled chunks carry no runs
         with self._lock:
             self._chunks = chunks
             self._merged = None
@@ -489,10 +762,37 @@ class Dataset:
 
     def pass_keys(self, slots: Optional[Sequence[str]] = None) -> np.ndarray:
         """Unique feasigns currently loaded (role of the per-pass key set
-        registered via FeedPass, box_wrapper.h:1239).
+        registered via FeedPass, box_wrapper.h:1239): sorted unique, in
+        the shape feed_pass's dedup bypass recognizes.
 
         ``slots`` restricts to the given sparse slots — used by dim-grouped
-        embedding engines that feed each width group its own key set."""
+        embedding engines that feed each width group its own key set.
+
+        Fast path (round 13): when the per-chunk sorted runs collected
+        during ingest still cover everything loaded, this is a linear
+        k-way merge of those runs — no end-of-load sort. Any operation
+        that changed the key set (global shuffle, chunk restore, disk
+        reload) falls back to the exact merged-sort path."""
+        with self._lock:
+            runs_ok = self._key_runs_valid
+            if runs_ok:
+                names = (list(self._key_runs) if slots is None
+                         else [s for s in slots if s in self._key_runs])
+                runs = [r for s in names for r in self._key_runs[s]]
+                seen_zero = any(self._key_zero.get(s, False)
+                                for s in (self._key_zero if slots is None
+                                          else slots))
+        if runs_ok:
+            from paddlebox_tpu.native.store_py import SortedRunMerger
+            merger = SortedRunMerger()
+            for r in runs:
+                merger.add_run(r)
+            keys = merger.merge()
+            if seen_zero:
+                keys = np.concatenate(
+                    [np.zeros((1,), np.uint64), keys])
+            monitor.add("ingest/pass_keys_from_runs", 1)
+            return keys
         merged = self._merge()
         if slots is None:
             keys = merged.all_keys()
@@ -510,3 +810,8 @@ class Dataset:
         with self._lock:
             self._chunks.clear()
             self._merged = None
+            self._key_runs = {}
+            self._key_zero = {}
+            self._key_runs_valid = True
+        # Chunk finalizers unlink their shm segments as the refs die;
+        # nothing else to do here (gc-immediate under CPython).

@@ -1,0 +1,243 @@
+"""Shared-memory chunk handoff between ingest worker processes and the
+parent (role of the reference's zero-copy channel between the reader
+thread pool and the dataset merge, ``data_set.cc:2283`` — here across a
+PROCESS boundary so the GIL-bound parse runs on real cores).
+
+Frame layout inside one ``multiprocessing.shared_memory`` segment::
+
+    [0:4)    magic  b'PBXC'
+    [4:8)    u32 version (1)
+    [8:16)   u64 header length H
+    [16:16+H) json header: [{"key", "dtype", "shape", "offset"}, ...]
+    ...      arrays at 64-byte-aligned offsets
+
+``write_chunk`` serializes a :class:`ColumnarChunk` into a fresh segment
+(one memcpy on the worker side); ``read_chunk`` reconstructs the chunk
+as zero-copy numpy VIEWS over the mapped buffer — the parent never
+copies the arrays again.
+
+Unlink protocol: exactly one process owns each segment's name at a
+time. The worker creates the segment, immediately *untracks* it from
+its resource tracker (else the tracker unlinks it when the worker
+exits — possibly before the parent attached) and sends the name over
+the message queue. The parent attaches, untracks its own side, and
+pins segment lifetime to the chunk object: a ``weakref.finalize`` on
+the chunk unlinks the name as soon as the chunk is garbage-collected
+(``Dataset.clear()``, merge, error paths), so ``/dev/shm`` can never
+accumulate segments while the process lives. ``sweep_orphans`` is the
+belt-and-braces pass for worker-crash windows where a segment was
+created but its name never reached the parent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import weakref
+from multiprocessing import shared_memory
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import log, monitor
+
+_MAGIC = b"PBXC"
+_VERSION = 1
+_ALIGN = 64
+
+#: Segment-name prefix: ``pbx-ing-<parent pid>-<load>-...`` — scoping
+#: names to the parent process AND the load lets sweep_orphans clean up
+#: a dead worker's leftovers without touching segments a previous load's
+#: still-referenced chunks own.
+NAME_PREFIX = "pbx-ing"
+
+_load_counter = [0]
+_load_lock = None  # created lazily; module import must stay cheap
+
+
+def next_load_id() -> int:
+    """Monotone per-process load sequence number — segment names embed
+    it so two loads in one parent can never collide."""
+    global _load_lock
+    if _load_lock is None:
+        import threading
+        _load_lock = threading.Lock()
+    with _load_lock:
+        _load_counter[0] += 1
+        return _load_counter[0]
+
+
+def seg_name(parent_pid: int, load_id: int, worker_id: int,
+             serial: int) -> str:
+    return f"{NAME_PREFIX}-{parent_pid}-{load_id}-{worker_id}-{serial}"
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    """Remove a CREATED segment from this process's resource_tracker:
+    lifetime is managed by the explicit unlink protocol above, and the
+    tracker would otherwise unlink a live segment when the creating
+    worker exits (before the parent consumed the tail frames). Attach
+    paths never call this — CPython only registers on create."""
+    try:  # CPython < 3.13 has no track=False; reach into the tracker
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # pragma: no cover - tracker internals shifted
+        pass
+
+
+def _align(off: int) -> int:
+    return (off + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _chunk_arrays(chunk) -> List[Tuple[str, np.ndarray]]:
+    out = [("labels", chunk.labels)]
+    for s, v in chunk.sparse_ids.items():
+        out.append((f"sid:{s}", v))
+        out.append((f"soff:{s}", chunk.sparse_offsets[s]))
+    for s, v in chunk.dense.items():
+        out.append((f"dense:{s}", v))
+    return out
+
+
+def write_chunk(chunk, name: str) -> int:
+    """Serialize a ColumnarChunk into a fresh named segment. Returns the
+    segment's byte size. The caller (worker) sends ``name`` to the
+    parent; the segment is already untracked here."""
+    arrays = [(k, np.ascontiguousarray(v)) for k, v in _chunk_arrays(chunk)]
+    # Header size depends on the offsets' digit counts — size it with a
+    # worst-case 16-digit placeholder, then pad the real (never longer)
+    # json with trailing spaces to the sized length.
+    header = [{"key": k, "dtype": a.dtype.str, "shape": list(a.shape),
+               "offset": 9_999_999_999_999_999} for k, a in arrays]
+    hcap = len(json.dumps(header).encode())
+    base = _align(16 + hcap)
+    off = base
+    for h, (_, a) in zip(header, arrays):
+        h["offset"] = off
+        off = _align(off + a.nbytes)
+    hb = json.dumps(header).encode().ljust(hcap)
+    # (off >= chunk.nbytes + header: per-array alignment padding only)
+    shm = shared_memory.SharedMemory(create=True, name=name, size=max(off, 1))
+    try:
+        _untrack(shm)
+        buf = shm.buf
+        buf[0:4] = _MAGIC
+        buf[4:8] = np.uint32(_VERSION).tobytes()
+        buf[8:16] = np.uint64(len(hb)).tobytes()
+        buf[16:16 + len(hb)] = hb
+        for h, (_, a) in zip(header, arrays):
+            if a.nbytes:
+                dst = np.ndarray(a.shape, a.dtype, buffer=buf,
+                                 offset=h["offset"])
+                dst[...] = a
+        monitor.add("ingest/shm_bytes", int(off))
+        return int(off)
+    finally:
+        shm.close()
+
+
+def read_chunk(name: str):
+    """Attach a segment and rebuild the chunk as zero-copy views. The
+    returned chunk OWNS the segment: a finalizer unlinks the name when
+    the chunk is collected. Returns (chunk, release_fn) — release_fn
+    force-unlinks early (error paths discarding a staged frame)."""
+    from paddlebox_tpu.data.columnar import ColumnarChunk
+    shm = shared_memory.SharedMemory(name=name)  # attach: not tracked
+    buf = shm.buf
+    if bytes(buf[0:4]) != _MAGIC:
+        shm.close()
+        shm.unlink()
+        raise ValueError(f"shm segment {name!r}: bad magic")
+    hlen = int(np.frombuffer(buf, np.uint64, count=1, offset=8)[0])
+    header = json.loads(bytes(buf[16:16 + hlen]).decode())
+    labels = None
+    ids, offs, dense = {}, {}, {}
+    for h in header:
+        a = np.ndarray(tuple(h["shape"]), np.dtype(h["dtype"]),
+                       buffer=buf, offset=h["offset"])
+        k = h["key"]
+        if k == "labels":
+            labels = a
+        elif k.startswith("sid:"):
+            ids[k[4:]] = a
+        elif k.startswith("soff:"):
+            offs[k[5:]] = a
+        elif k.startswith("dense:"):
+            dense[k[6:]] = a
+    chunk = ColumnarChunk(labels=labels, sparse_ids=ids,
+                          sparse_offsets=offs, dense=dense)
+    release = _make_release(shm)
+    weakref.finalize(chunk, release)
+    return chunk, release
+
+
+def _make_release(shm: shared_memory.SharedMemory):
+    done = [False]
+
+    def release() -> None:
+        if done[0]:
+            return
+        done[0] = True
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception as e:  # pragma: no cover - platform quirks
+            log.warning("shm unlink failed: %r", e)
+        try:
+            # Views may still be alive (a caller kept an array ref after
+            # dropping the chunk): the name is gone either way, and the
+            # mapping is freed when the last view dies.
+            shm.close()
+        except BufferError:
+            pass
+
+    return release
+
+
+def unlink_by_name(name: str) -> bool:
+    """Best-effort unlink of a segment the parent never attached (a
+    staged frame discarded on worker death)."""
+    try:
+        shm = shared_memory.SharedMemory(name=name)  # attach: not tracked
+    except FileNotFoundError:
+        return False
+    shm.close()
+    try:
+        shm.unlink()
+    except FileNotFoundError:
+        return False
+    return True
+
+
+def sweep_orphans(parent_pid: Optional[int] = None,
+                  load_id: Optional[int] = None,
+                  worker_id: Optional[int] = None,
+                  exclude=()) -> int:
+    """Unlink leftover ``pbx-ing-<pid>-<load>[-<wid>]-*`` segments —
+    covers the window where a killed worker created a segment whose name
+    never reached the parent. ``exclude`` names segments that DID reach
+    the parent and are owned by live chunks (their finalizers unlink
+    them). Linux-only (/dev/shm listing); a no-op elsewhere. Returns the
+    number of segments removed."""
+    d = "/dev/shm"
+    if not os.path.isdir(d):
+        return 0
+    pid = parent_pid if parent_pid is not None else os.getpid()
+    prefix = f"{NAME_PREFIX}-{pid}-"
+    if load_id is not None:
+        prefix += f"{load_id}-"
+        if worker_id is not None:
+            prefix += f"{worker_id}-"
+    skip = set(exclude)
+    n = 0
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return 0
+    for e in entries:
+        if e.startswith(prefix) and e not in skip and unlink_by_name(e):
+            n += 1
+    if n:
+        monitor.add("ingest/shm_orphans_swept", n)
+    return n

@@ -363,6 +363,18 @@ class DeviceFeatureStore:
     def _ensure_rows_locked(self, keys: np.ndarray) -> np.ndarray:
         k = np.ascontiguousarray(keys, np.uint64)
         base = self._index.size
+        if base == 0 and k.size and native_store.is_sorted_unique_nonzero(k):
+            # Fresh-build bypass (sorted-run store build, round 13):
+            # pass-key arrays arrive sorted unique (dedup_keys /
+            # run-merge output), so the first build skips the serial
+            # find-or-insert walk — bulk placement parallelizes and the
+            # rows (0..n-1 in input order) are bit-identical to upsert
+            # on an empty index.
+            rows = self._index.bulk_build(k)
+            self._append_rows_locked(k, 0, int(k.size))
+            monitor.add("device_store/new_keys", int(k.size))
+            monitor.add("device_store/bulk_builds", 1)
+            return rows
         rows, n_new = self._index.upsert(k)
         if n_new:
             new_keys = k[rows >= base]

@@ -99,9 +99,17 @@ class PassEngine:
             with self.timers.scope("feed_pass"):
                 # Key dedup can overlap the active pass... (native
                 # multi-threaded dedup, role of PreBuildTask,
-                # ps_gpu_wrapper.cc:114; numpy fallback inside)
+                # ps_gpu_wrapper.cc:114; numpy fallback inside). Keys
+                # arriving already sorted-unique-nonzero — the sorted-run
+                # collector's merge (Dataset.pass_keys, round 13) — skip
+                # the redundant re-sort: one O(n) vectorized check
+                # replaces an O(n log n) dedup on the build path.
                 from paddlebox_tpu.native.keymap_py import KeyMap, dedup_keys
-                keys = dedup_keys(np.asarray(pass_keys, np.uint64))
+                from paddlebox_tpu.native.store_py import \
+                    is_sorted_unique_nonzero
+                keys = np.asarray(pass_keys, np.uint64)
+                if not is_sorted_unique_nonzero(keys):
+                    keys = dedup_keys(keys)
                 if hasattr(self.store, "pull_pass_table"):
                     # Device-resident store tier: the build is an on-device
                     # gather — values never cross the host boundary. Only

@@ -126,6 +126,9 @@ def load_library() -> Optional[ctypes.CDLL]:
                                          ctypes.c_int64, i64p]
         lib.pbx_index_keys_fill.restype = None
         lib.pbx_index_keys_fill.argtypes = [ctypes.c_void_p, u64p]
+        lib.pbx_index_bulk_build.restype = ctypes.c_int64
+        lib.pbx_index_bulk_build.argtypes = [ctypes.c_void_p, u64p,
+                                             ctypes.c_int64]
         lib.pbx_index_free.restype = None
         lib.pbx_index_free.argtypes = [ctypes.c_void_p]
         # store.cc — sorted-store primitives

@@ -270,6 +270,54 @@ void pbx_index_keys_fill(void* h, uint64_t* out) {
 
 void pbx_index_free(void* h) { delete static_cast<GrowMap*>(h); }
 
+// Fresh-build bypass (sorted-run store build, round 13): populate an
+// EMPTY index from n sorted unique nonzero keys with rows 0..n-1 —
+// bit-identical to upserting the same array into an empty index, but
+// the uniqueness precondition removes the serial find-or-insert
+// dependency chain, so placement parallelizes across cores (each
+// thread claims slots with a CAS on the key word; rows publish at the
+// join). Returns n, or -1 when the index is non-empty / the input is
+// not sorted-unique-nonzero (caller falls back to upsert).
+int64_t pbx_index_bulk_build(void* h, const uint64_t* keys, int64_t n) {
+  GrowMap* m = static_cast<GrowMap*>(h);
+  if (m->used != 0) return -1;
+  if (n > 0 && keys[0] == 0) return -1;
+  for (int64_t i = 1; i < n; ++i)
+    if (keys[i] <= keys[i - 1]) return -1;
+  uint64_t want = static_cast<uint64_t>(n);
+  if (want * 2 > m->mask + 1) {
+    size_t cap = m->mask + 1;
+    while (want * 2 > cap) cap <<= 1;
+    m->rehash(cap);
+  }
+  m->by_row.assign(keys, keys + n);
+  Entry* slots = m->slots;
+  const uint64_t mask = m->mask;
+  parallel_chunks(n, num_threads_for(n), [&](int, int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      if (i + kPrefetchWindow < hi)
+        __builtin_prefetch(&slots[mix64(keys[i + kPrefetchWindow]) & mask],
+                           1, 1);
+      uint64_t k = keys[i];
+      uint64_t j = mix64(k) & mask;
+      while (true) {
+        uint64_t expected = 0;
+        if (__atomic_compare_exchange_n(&slots[j].key, &expected, k, false,
+                                        __ATOMIC_ACQ_REL,
+                                        __ATOMIC_RELAXED)) {
+          slots[j].row = static_cast<int64_t>(i);
+          break;
+        }
+        // expected now holds the occupant; unique input means it is
+        // never k — probe on.
+        j = (j + 1) & mask;
+      }
+    }
+  });
+  m->used = n;
+  return n;
+}
+
 // ---------------------------------------------------------------------------
 // Sorted-store primitives (host-RAM tier hot loops).
 // ---------------------------------------------------------------------------

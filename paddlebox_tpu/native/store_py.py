@@ -32,19 +32,94 @@ def _p(a: np.ndarray, t):
     return a.ctypes.data_as(t)
 
 
+def is_sorted_unique_nonzero(keys: np.ndarray) -> bool:
+    """True when ``keys`` is strictly ascending with no 0 (the shape
+    dedup_keys produces) — the precondition for the bulk-build bypasses.
+    One vectorized O(n) pass, cheap next to any build it guards."""
+    k = keys
+    if k.size == 0:
+        return True
+    return bool(k[0] != 0) and (k.size == 1 or bool(np.all(k[1:] > k[:-1])))
+
+
+def merge_unique(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Union of two SORTED UNIQUE key arrays, O(n + m) — ss_locate drops
+    b's duplicates, merge_sorted interleaves the disjoint remainder (both
+    threaded native with exact numpy fallbacks)."""
+    a = np.ascontiguousarray(a, np.uint64)
+    b = np.ascontiguousarray(b, np.uint64)
+    if a.size == 0:
+        return b.copy() if b.base is not None else b
+    if b.size == 0:
+        return a
+    found, _ = ss_locate(a, b)
+    b_new = b[~found] if found.any() else b
+    if b_new.size == 0:
+        return a
+    merged, _ = merge_sorted(a, b_new)
+    return merged
+
+
+class SortedRunMerger:
+    """Accumulates sorted unique key runs (one per ingest chunk) and
+    k-way merges them on demand — the sorted-run store build (round 13):
+    each chunk's dedup overlaps ingest, and the final merge is linear
+    instead of one giant end-of-pass sort. ``merge()`` is a balanced
+    pairwise tree (O(N log k) with k runs), bit-identical to
+    ``np.unique(concat(runs))``."""
+
+    def __init__(self):
+        self._runs: list = []
+
+    def add_run(self, sorted_unique: np.ndarray) -> None:
+        if sorted_unique.size:
+            self._runs.append(np.ascontiguousarray(sorted_unique,
+                                                   np.uint64))
+
+    @property
+    def num_runs(self) -> int:
+        return len(self._runs)
+
+    def merge(self) -> np.ndarray:
+        runs = self._runs
+        if not runs:
+            return np.empty((0,), np.uint64)
+        while len(runs) > 1:
+            nxt = [merge_unique(runs[i], runs[i + 1])
+                   for i in range(0, len(runs) - 1, 2)]
+            if len(runs) % 2:
+                nxt.append(runs[-1])
+            runs = nxt
+        self._runs = runs
+        return runs[0]
+
+    def clear(self) -> None:
+        self._runs = []
+
+
 class KeyIndex:
     """Incremental key → row index. Not internally synchronized — callers
-    serialize mutating calls (the pass lifecycle already does)."""
+    serialize mutating calls (the pass lifecycle already does).
+
+    The no-native fallback is VECTORIZED (round 13): a maintained sorted
+    key view + row permutation served by threaded searchsorted
+    (ss_locate), with new keys batch-appended through merge_sorted — the
+    prior per-key python dict walk was ~100x off the native path and set
+    BENCH_r02's 406K keys/s store-build wall on no-native hosts."""
 
     def __init__(self):
         self._lib = load_library()
         self._closed = False
         if self._lib is not None:
             self._h = self._lib.pbx_index_new()
-            self._fallback = None
         else:
             self._h = None
-            self._fallback = {}
+            # Fallback state: sorted unique keys + their rows, plus the
+            # append-order key log (rows are first-appearance ranks).
+            self._fb_sorted = np.empty((0,), np.uint64)
+            self._fb_rows = np.empty((0,), np.int64)
+            self._fb_by_row = np.empty((0,), np.uint64)
+            self._fb_size = 0
 
     def _check_open(self) -> None:
         if self._closed:
@@ -55,26 +130,40 @@ class KeyIndex:
         self._check_open()
         if self._h is not None:
             return int(self._lib.pbx_index_size(self._h))
-        return len(self._fallback)
+        return self._fb_size
 
     def reserve(self, n: int) -> None:
-        """Pre-size for ~n more keys (skips incremental rehash churn)."""
+        """Pre-size for ~n more keys (skips incremental rehash churn; in
+        the fallback, pre-grows the append log so batched upserts never
+        reallocate it mid-build)."""
         if self._h is not None:
             self._lib.pbx_index_reserve(self._h, int(n))
+        else:
+            self._fb_grow_log(self._fb_size + int(n))
+
+    def _fb_grow_log(self, want: int) -> None:
+        if self._fb_by_row.shape[0] < want:
+            grown = np.empty((max(want, 2 * self._fb_by_row.shape[0]),),
+                             np.uint64)
+            grown[:self._fb_size] = self._fb_by_row[:self._fb_size]
+            # graftlint: allow-lock(caller-serialized by class contract)
+            self._fb_by_row = grown
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """rows [n] int64; -1 for absent (and for the 0 null feasign)."""
         self._check_open()
         k = np.ascontiguousarray(keys, np.uint64)
-        out = np.empty((k.size,), np.int64)
         if self._h is not None:
+            out = np.empty((k.size,), np.int64)
             if k.size:
                 self._lib.pbx_index_lookup(self._h, _p(k, _u64p), k.size,
                                            _p(out, _i64p))
             return out
-        fb = self._fallback
-        for i, kk in enumerate(k.tolist()):
-            out[i] = fb.get(kk, -1) if kk else -1
+        out = np.full((k.size,), -1, np.int64)
+        if k.size and self._fb_size:
+            found, pos = ss_locate(self._fb_sorted, k)
+            if found.any():
+                out[found] = self._fb_rows[pos[found]]
         return out
 
     def upsert(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
@@ -87,19 +176,67 @@ class KeyIndex:
             n_new = int(self._lib.pbx_index_upsert(self._h, _p(k, _u64p),
                                                    k.size, _p(out, _i64p)))
             return out, n_new
-        fb = self._fallback
-        n_new = 0
-        for i, kk in enumerate(k.tolist()):
-            if not kk:
-                out[i] = -1
-                continue
-            r = fb.get(kk)
-            if r is None:
-                r = len(fb)
-                fb[kk] = r
-                n_new += 1
-            out[i] = r
-        return out, n_new
+        if k.size == 0:
+            return out, 0
+        found, pos = ss_locate(self._fb_sorted, k)
+        out[found] = self._fb_rows[pos[found]] if found.any() else 0
+        zero = k == 0
+        out[zero] = -1
+        new_m = ~(found | zero)
+        if not new_m.any():
+            return out, 0
+        nk = k[new_m]
+        uniq, first, inv = np.unique(nk, return_index=True,
+                                     return_inverse=True)
+        # Rows follow FIRST-APPEARANCE order within the batch (the
+        # native contract), not sorted order.
+        order = np.argsort(first, kind="stable")
+        rank = np.empty((order.size,), np.int64)
+        rank[order] = np.arange(order.size)
+        rows_of_uniq = self._fb_size + rank      # aligned to sorted uniq
+        out[new_m] = rows_of_uniq[inv]
+        n_old = self._fb_sorted.shape[0]
+        merged, src = merge_sorted(self._fb_sorted, uniq)
+        rows_merged = np.empty((merged.shape[0],), np.int64)
+        is_new = src >= n_old
+        rows_merged[~is_new] = self._fb_rows[src[~is_new]]
+        rows_merged[is_new] = rows_of_uniq[src[is_new] - n_old]
+        # graftlint: allow-lock(caller-serialized by class contract)
+        self._fb_sorted, self._fb_rows = merged, rows_merged
+        self._fb_grow_log(self._fb_size + order.size)
+        self._fb_by_row[self._fb_size:self._fb_size + order.size] = \
+            uniq[order]
+        # graftlint: allow-lock(class contract: callers serialize)
+        self._fb_size += int(order.size)
+        return out, int(order.size)
+
+    def bulk_build(self, sorted_keys: np.ndarray) -> np.ndarray:
+        """Fresh-build bypass: populate an EMPTY index from sorted unique
+        nonzero keys with rows 0..n-1 — bit-identical to ``upsert`` of
+        the same array, but placement parallelizes (native: CAS-claimed
+        slots across cores; fallback: the sorted view IS the input, no
+        merge at all). Returns the rows (arange). Raises on a non-empty
+        index or unsorted input — the caller chose the wrong API."""
+        self._check_open()
+        if self.size != 0:
+            raise ValueError("bulk_build on a non-empty KeyIndex")
+        k = np.ascontiguousarray(sorted_keys, np.uint64)
+        if not is_sorted_unique_nonzero(k):
+            raise ValueError(
+                "bulk_build wants sorted unique nonzero keys "
+                "(dedup_keys output) — use upsert for raw batches")
+        if self._h is not None:
+            got = int(self._lib.pbx_index_bulk_build(self._h, _p(k, _u64p),
+                                                     k.size))
+            if got != k.size:  # pragma: no cover - guarded above
+                raise ValueError("native bulk_build rejected the input")
+        else:
+            n = k.shape[0]
+            self._fb_sorted = k.copy()
+            self._fb_rows = np.arange(n, dtype=np.int64)
+            self._fb_by_row = k.copy()
+            self._fb_size = n
+        return np.arange(k.shape[0], dtype=np.int64)
 
     def keys_by_row(self) -> np.ndarray:
         """All keys, index = row (append order)."""
@@ -110,8 +247,7 @@ class KeyIndex:
             if n:
                 self._lib.pbx_index_keys_fill(self._h, _p(out, _u64p))
             return out
-        for kk, r in self._fallback.items():
-            out[r] = kk
+        out[:] = self._fb_by_row[:n]
         return out
 
     def close(self) -> None:
@@ -121,7 +257,7 @@ class KeyIndex:
         if self._h is not None:
             self._lib.pbx_index_free(self._h)
             self._h = None
-        self._fallback = None
+        self._fb_sorted = self._fb_rows = self._fb_by_row = None
 
     def __del__(self):  # pragma: no cover - GC timing
         try:
@@ -131,24 +267,65 @@ class KeyIndex:
 
 
 def bench_index_build(n_keys: int, *, chunk: int = 10_000_000,
-                      seed: int = 7, tick=None) -> float:
+                      seed: int = 7, tick=None,
+                      mode: str = "upsert") -> float:
     """ONE definition of the 'host pass-build' metric (SURVEY hard part
-    #1 — PreBuildTask role, ps_gpu_wrapper.cc:114): fresh upsert of
+    #1 — PreBuildTask role, ps_gpu_wrapper.cc:114): fresh build of
     n_keys uniform-random keys into a pre-sized KeyIndex, chunked like a
     production bulk build. Returns keys/s. Shared by bench.py
-    (host_index_build_keys_per_s) and tools/bench_native_store.py so the
-    two recorded numbers can never drift in methodology. ``tick`` is an
-    optional per-chunk progress callback (the bench watchdog)."""
+    (host_index_build_keys_per_s), tools/bench_native_store.py and the
+    round-13 sorted-run acceptance so recorded numbers can never drift
+    in methodology. ``tick`` is an optional per-chunk progress callback
+    (the bench watchdog).
+
+    Modes (same keys in, same index out — rows differ only in the order
+    contract each mode documents):
+
+    - ``upsert``: the incremental find-or-insert walk (r02 methodology).
+    - ``bulk``: the sorted-run build — per-chunk dedup_keys → sorted
+      runs → k-way merge_unique → KeyIndex.bulk_build.
+    - ``dict``: the pre-round-13 per-key python dict loop, kept as the
+      measurable fallback baseline the 10x acceptance compares against.
+    """
     import time as _time
     rng = np.random.default_rng(seed)
     keys = rng.integers(1, 1 << 62, n_keys, dtype=np.uint64)
-    idx = KeyIndex()
-    idx.reserve(n_keys)
     t0 = _time.perf_counter()
-    for lo in range(0, n_keys, chunk):
-        idx.upsert(keys[lo:lo + chunk])
-        if tick is not None:
-            tick(lo)
+    if mode == "bulk":
+        from paddlebox_tpu.native.keymap_py import dedup_keys
+        merger = SortedRunMerger()
+        for lo in range(0, n_keys, chunk):
+            merger.add_run(dedup_keys(keys[lo:lo + chunk]))
+            if tick is not None:
+                tick(lo)
+        idx = KeyIndex()
+        idx.bulk_build(merger.merge())
+    elif mode == "dict":
+        fb: dict = {}
+        out = np.empty((min(chunk, n_keys),), np.int64)
+        for lo in range(0, n_keys, chunk):
+            for i, kk in enumerate(keys[lo:lo + chunk].tolist()):
+                if not kk:
+                    out[i] = -1
+                    continue
+                r = fb.get(kk)
+                if r is None:
+                    r = len(fb)
+                    fb[kk] = r
+                out[i] = r
+            if tick is not None:
+                tick(lo)
+        dt = _time.perf_counter() - t0
+        return n_keys / dt
+    else:
+        if mode != "upsert":
+            raise ValueError(f"unknown bench_index_build mode {mode!r}")
+        idx = KeyIndex()
+        idx.reserve(n_keys)
+        for lo in range(0, n_keys, chunk):
+            idx.upsert(keys[lo:lo + chunk])
+            if tick is not None:
+                tick(lo)
     dt = _time.perf_counter() - t0
     idx.close()
     return n_keys / dt

@@ -183,3 +183,24 @@ def test_gates_a_graftlint_summary(tmp_path):
     shrunk["allowed"] = 4
     rep3 = _write(tmp_path, "gl_shrunk.json", shrunk)
     assert perf_gate.main([rep3, "--baseline", base]) == 0
+
+
+def test_ingest_keys_direction_and_gating(tmp_path):
+    """Round-13 ingest/store-build keys: the throughput rates gate as
+    higher-better, provenance fields (worker count, native bool) never
+    gate, and a planted ingest regression fails a real report pair."""
+    assert perf_gate.direction("ingest_rows_per_s") == 1
+    assert perf_gate.direction("store_build_keys_per_s") == 1
+    assert perf_gate.direction("host_index_build_keys_per_s") == 1
+    assert perf_gate.direction("host_index_bulk_build_keys_per_s") == 1
+    assert perf_gate.direction("ingest_workers") == 0
+    base = {"value": 9000.0, "ingest_rows_per_s": 250000.0,
+            "store_build_keys_per_s": 8.5e6, "ingest_workers": 8,
+            "store_build_native": True}
+    b = _write(tmp_path, "ing_base.json", base)
+    ok = dict(base, ingest_workers=2, store_build_native=False)
+    assert perf_gate.main([_write(tmp_path, "ing_ok.json", ok),
+                           "--baseline", b]) == 0
+    bad = dict(base, ingest_rows_per_s=60000.0)
+    assert perf_gate.main([_write(tmp_path, "ing_bad.json", bad),
+                           "--baseline", b]) == 1

@@ -283,3 +283,46 @@ def test_kill9_resumes_via_recover_full(drill_env, site, hit):
     r = crash_drill.run_drill(workdir, site, hit=hit, reference=ref)
     assert r["killed_rc"] == -9, r
     assert r["ok"], r["mismatch"]
+
+
+def test_killed_ingest_worker_retried_without_hanging_preload(tmp_path):
+    """Round-13 ingest process boundary: SIGKILL an ingest worker
+    MID-FILE — the pump must requeue the file on a fresh worker
+    (FLAGS_ingest_file_retries) and wait_preload_done() must return the
+    complete, non-duplicated dataset instead of hanging on the dead
+    child; with the retry budget at 0 the death propagates as an error
+    (tests/test_ingest.py covers that half)."""
+    from paddlebox_tpu.data import DataFeedConfig, Dataset, SlotConf
+
+    lines = [f"1 user:{i} item:{i + 1000}" for i in range(1, 61)]
+    part = tmp_path / "part-0"
+    part.write_text("\n".join(lines) + "\n")
+    started = tmp_path / "started"
+    feed = DataFeedConfig(
+        slots=(SlotConf("user"), SlotConf("item")), batch_size=8,
+        pipe_command=f"touch {started}; sleep 3; cat")
+    old = flagmod.get_flags(["ingest_workers", "ingest_file_retries"])
+    flagmod.set_flags({"ingest_workers": 1, "ingest_file_retries": 1})
+    try:
+        ds = Dataset(feed)
+        ds.set_filelist([str(part)])
+        ds.preload_into_memory()
+        t0 = time.time()
+        while not started.exists() and time.time() - t0 < 60:
+            time.sleep(0.05)
+        assert started.exists(), "worker never reached the file"
+        time.sleep(0.2)
+        assert ds._ingest_procs
+        started.unlink()  # the RETRY recreates it through the same pipe
+        victim = ds._ingest_procs[0]
+        os.kill(victim.pid, 9)
+        t0 = time.time()
+        while not started.exists() and time.time() - t0 < 60:
+            time.sleep(0.05)
+        assert started.exists(), "no replacement worker took the file"
+        ds.wait_preload_done()  # returns (pipe delay), never hangs
+        assert ds.num_instances == 60  # complete, no duplicated rows
+        assert monitor.get("ingest/worker_restarts") >= 1
+        ds.clear()
+    finally:
+        flagmod.set_flags(old)

@@ -51,10 +51,17 @@ def main() -> None:
     keys = rng.integers(1, 1 << 62, n, dtype=np.uint64)
 
     out = {"keys": n}
-    # The headline metric comes from the ONE shared definition
+    # The headline metrics come from the ONE shared definition
     # (store_py.bench_index_build — same as bench.py's
-    # host_index_build_keys_per_s).
+    # host_index_build_keys_per_s / host_index_bulk_build_keys_per_s).
     out["index_build_keys_per_s"] = round(bench_index_build(n))
+    # Round 13: sorted-run build (per-chunk dedup → run merge →
+    # bulk_build) and the pre-r13 per-key dict walk it is measured
+    # against (the ≥10× acceptance baseline).
+    out["index_bulk_build_keys_per_s"] = round(
+        bench_index_build(n, mode="bulk"))
+    out["index_dict_build_keys_per_s"] = round(
+        bench_index_build(min(n, 8_000_000), mode="dict"))
 
     # The remaining metrics reuse a populated index at the same scale.
     idx = KeyIndex()

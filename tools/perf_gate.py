@@ -47,6 +47,11 @@ DEFAULT_ABS_FLOOR = 1.0  # lower-better metrics: ignore sub-floor rises
 
 # Suffix tables, checked in order (higher-better first: "samples_per_s"
 # must match "_per_s" before the lower-better "_s" wall suffix does).
+# The round-13 ingest keys (ingest_rows_per_s, store_build_keys_per_s,
+# host_index_[bulk_]build_keys_per_s) gate through "_per_s" — an ingest
+# or store-build regression fails the gate like any throughput drop;
+# provenance fields (ingest_workers count, store_build_native bool) are
+# not rates and stay ungated.
 HIGHER_SUFFIXES = ("_per_s", "per_sec", "samples_per_s", "auc",
                    "hit_rate", "overlap_frac", "e2e_over_device_only",
                    "throughput_rps", "mfu", "achieved_gflops_per_chip")
@@ -178,7 +183,12 @@ def smoke() -> int:
             "bottleneck": {"device_idle_frac": 0.10,
                            "host_critical_share": 0.30},
             "dispatch_ms_quantiles": {"p50": 12.0, "p99": 30.0},
+            "ingest_rows_per_s": 250000.0,
+            "store_build_keys_per_s": 406447.0,
+            "host_index_bulk_build_keys_per_s": 5.6e6,
             "steps_per_dispatch": 4,        # not gated (count)
+            "ingest_workers": 8,            # not gated (count)
+            "store_build_native": True,     # not gated (bool)
             "sparse_gather_kernel": "auto"}  # not gated (string)
     ok = True
 
@@ -204,12 +214,19 @@ def smoke() -> int:
     bad["stage_ms"]["read"] *= 10.0
     bad["dispatch_ms_quantiles"]["p99"] = 400.0
     bad["bottleneck"]["device_idle_frac"] = 0.85
+    bad["ingest_rows_per_s"] *= 0.3
+    bad["store_build_keys_per_s"] *= 0.3
+    bad["ingest_workers"] = 1          # provenance: must NOT gate
+    bad["store_build_native"] = False  # provenance: must NOT gate
     _, regs = compare(bad, base)
     names = {r["metric"] for r in regs}
     for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
-                 "bottleneck.device_idle_frac"):
+                 "bottleneck.device_idle_frac", "ingest_rows_per_s",
+                 "store_build_keys_per_s"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
+    for never in ("ingest_workers", "store_build_native"):
+        expect(f"provenance {never!r} not gated", never in names, False)
     # An IMPROVEMENT must never trip the gate.
     good = json.loads(json.dumps(base))
     good["value"] *= 2.0

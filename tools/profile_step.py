@@ -88,12 +88,83 @@ def timeit(fn, *args, n=10, warmup=2):
     return (time.perf_counter() - t0) / n
 
 
+def _ingest_probes():
+    """Host-ingest stage probes (round 13): each row isolates ONE stage
+    of the disk→chunk→store path — parse only (all three parser tiers),
+    shm handoff only (frame write + zero-copy attach), store build only
+    (incremental vs sorted-run vs the dict fallback baseline) — so a
+    PROFILE.md cost model can attribute the ingest wall per stage."""
+    from paddlebox_tpu.data.parser import parse_block_numpy, parse_lines
+    from paddlebox_tpu.data.columnar import instances_to_chunk
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.data import shm_channel
+    from paddlebox_tpu.native.parser_py import parse_chunk_native
+    from paddlebox_tpu.native.store_py import bench_index_build
+
+    _tick("ingest-parse")
+    n_lines, n_slots, dense_dim = 100_000, 26, 13
+    slots = tuple(SlotConf(f"s{i}", avg_len=1.0) for i in range(n_slots))
+    slots += (SlotConf("d", is_dense=True, dim=dense_dim),)
+    cfg = DataFeedConfig(slots=slots, batch_size=1024,
+                         slot_capacity_slack=1.0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 1 << 40, (n_lines, n_slots))
+    parts = [(np.char.add(np.char.add(
+        (ids[:, 0] % 2).astype("U1"), " s0:"), ids[:, 0].astype("U20")))]
+    line = parts[0]
+    for j in range(1, n_slots):
+        line = np.char.add(line, f" s{j}:")
+        line = np.char.add(line, ids[:, j].astype("U20"))
+    line = np.char.add(line, " d:" + ",".join(["0.5"] * dense_dim))
+    block = ("\n".join(line.tolist()) + "\n").encode()
+
+    t0 = time.perf_counter()
+    chunk = parse_chunk_native(block, cfg)
+    dt = time.perf_counter() - t0
+    if chunk is not None:
+        print(f"ingest parse native [{n_lines}]   {dt*1e3:8.1f} ms "
+              f"({n_lines/dt:,.0f} rows/s)")
+    else:
+        print("ingest parse native          unavailable (no native lib)")
+    t0 = time.perf_counter()
+    chunk_np = parse_block_numpy(block, cfg)
+    dt = time.perf_counter() - t0
+    print(f"ingest parse numpy-bulk      {dt*1e3:8.1f} ms "
+          f"({n_lines/dt:,.0f} rows/s)")
+    t0 = time.perf_counter()
+    instances_to_chunk(parse_lines(block.decode().split("\n"), cfg), cfg)
+    dt = time.perf_counter() - t0
+    print(f"ingest parse per-line        {dt*1e3:8.1f} ms "
+          f"({n_lines/dt:,.0f} rows/s)")
+
+    _tick("ingest-shm")
+    chunk = chunk if chunk is not None else chunk_np
+    nbytes = chunk.nbytes
+    name = shm_channel.seg_name(os.getpid(), shm_channel.next_load_id(),
+                                0, 0)
+    t0 = time.perf_counter()
+    shm_channel.write_chunk(chunk, name)
+    got, release = shm_channel.read_chunk(name)
+    dt = time.perf_counter() - t0
+    assert got.num_rows == chunk.num_rows
+    release()
+    print(f"ingest shm roundtrip {nbytes/1e6:6.1f} MB {dt*1e3:8.1f} ms "
+          f"({nbytes/dt/1e9:.2f} GB/s write+attach)")
+
+    _tick("ingest-build")
+    for mode in ("upsert", "bulk", "dict"):
+        r = bench_index_build(4_000_000, chunk=1_000_000, mode=mode)
+        print(f"store build {mode:7s} [4M]     "
+              f"{4e6/r*1e3:8.1f} ms ({r:,.0f} keys/s)")
+
+
 def main():
     # Ring-only tracing (file export when FLAGS_trace_path is set) +
     # the stall watchdog — same forensics discipline as bench.py.
     _report.init_telemetry_from_flags()
     _trace.GLOBAL.enable()
     _start_watchdog()
+    _ingest_probes()
     _tick("setup")
     N_ROWS = 4 * 1024 * 1024        # pass table rows (pow2 bucket)
     D = 16
