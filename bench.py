@@ -1078,10 +1078,122 @@ def bench_graph() -> dict:
 SERVING_KEYS = 2_000_000
 SERVING_BATCH = 2048
 SERVING_QUERY_BATCHES = 50
+SERVE_REQ_ROWS = 64          # rows per client request in --clients mode
+SERVE_CLIENT_SECONDS = 3.0   # timed window per client count
 if _SMALL:
     SERVING_KEYS = 100_000
     SERVING_BATCH = 512
     SERVING_QUERY_BATCHES = 10
+    SERVE_CLIENT_SECONDS = 1.0
+
+# Parsed from --clients by main(): comma-separated client counts for the
+# concurrent wire-mode serving bench ("" = skip the wire section).
+SERVE_CLIENTS = ""
+
+
+def _serve_client_lines(rng, n_requests: int):
+    """Vectorized svm-line assembly for the wire clients (per-line
+    python f-strings would dominate the client threads' CPU budget and
+    measure the bench, not the server)."""
+    out = []
+    for _ in range(n_requests):
+        ids = rng.integers(1, SERVING_KEYS + 1,
+                           (SERVE_REQ_ROWS, NUM_SLOTS))
+        ids[:, 0] = rng.integers(1, 1001, SERVE_REQ_ROWS)
+        line = np.full((SERVE_REQ_ROWS,), "0", dtype="U16")
+        for j in range(NUM_SLOTS):
+            line = np.char.add(line, f" s{j}:")
+            line = np.char.add(line, ids[:, j].astype("U20"))
+        out.append(line.tolist())
+    return out
+
+
+def _bench_serve_clients(pred, clients: list) -> dict:
+    """Concurrent-client wire mode: N PredictClients hammer one
+    PredictServer (micro-batcher on) for a fixed window; records
+    throughput_rps / rows_per_s / p50/p99 predict latency /
+    batch_fill_frac per client count. One fresh server per count so the
+    latency digest and fill gauge belong to that run alone."""
+    import threading
+
+    from paddlebox_tpu.core import flags as flagmod, monitor
+    from paddlebox_tpu.data.parser import parse_lines
+    from paddlebox_tpu.serving.batcher import pack_bucketed, pow2_bucket
+    from paddlebox_tpu.serving.service import PredictClient, PredictServer
+
+    # Compile the pow2 row-bucket ladder BEFORE any timed window: a
+    # coalesced batch of k requests lands in the pow2_bucket(k * rows)
+    # trace, and an in-window XLA compile would be measured as a
+    # multi-second p99.
+    _tick("serving:bucket-warmup")
+    wrng = np.random.default_rng(7)
+    max_rows = min(max(clients) * SERVE_REQ_ROWS,
+                   int(flagmod.flag("serving_batch_max_rows")))
+    warm_lines = _serve_client_lines(wrng, 1)[0]
+    b = pow2_bucket(SERVE_REQ_ROWS)
+    while True:
+        ins = parse_lines(warm_lines * (b // SERVE_REQ_ROWS), pred.feed)
+        pred.predict(pack_bucketed(ins, pred.feed))
+        if b >= pow2_bucket(max_rows):
+            break
+        b *= 2
+
+    out = {}
+    for n_cli in clients:
+        _tick(f"serving:clients{n_cli}")
+        monitor.reset()
+        server = PredictServer("127.0.0.1:0", pred)
+        rng = np.random.default_rng(1234 + n_cli)
+        lines = [_serve_client_lines(rng, 8) for _ in range(n_cli)]
+        done = [0] * n_cli
+        stop = threading.Event()
+        start = threading.Barrier(n_cli + 1)
+
+        def run(i):
+            cli = PredictClient(server.endpoint)
+            ok = True
+            try:
+                cli.predict(lines[i][0])  # warm (compile outside window)
+            except Exception as e:
+                ok = False
+                print(f"serve client {i} warmup failed: {e!r}",
+                      file=sys.stderr)
+            start.wait()  # always reached: a dead client must not
+            try:          # wedge the barrier and stall the recording
+                j = 0
+                while ok and not stop.is_set():
+                    cli.predict(lines[i][j % len(lines[i])])
+                    done[i] += 1
+                    j += 1
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                   for i in range(n_cli)]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        time.sleep(SERVE_CLIENT_SECONDS)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        dt = time.perf_counter() - t0
+        stats_cli = PredictClient(server.endpoint)
+        st = stats_cli.stats()
+        stats_cli.close()
+        server.stop()
+        n_req = sum(done)
+        out[f"c{n_cli}"] = {
+            "throughput_rps": round(n_req / dt, 1),
+            "rows_per_s": round(n_req * SERVE_REQ_ROWS / dt, 1),
+            "predict_p50_ms": st["latency_ms"]["p50"],
+            "predict_p99_ms": st["latency_ms"]["p99"],
+            "batch_fill_frac": round(st["batch_fill_frac"], 4),
+            "batches": st["batches"],
+            "requests": n_req,
+        }
+    return out
 
 
 def bench_serving() -> dict:
@@ -1154,7 +1266,7 @@ def bench_serving() -> dict:
     lat_q = {k: (round(v, 3) if v is not None else None)
              for k, v in lat.quantiles().items()}
 
-    return {
+    out = {
         "metric": "serving_predict_samples_per_sec",
         "value": round(qps, 1),
         "unit": "samples/s",
@@ -1164,8 +1276,14 @@ def bench_serving() -> dict:
         "serving_slo_p99_ms": float(flags.flag("serving_slo_p99_ms")),
         "serving_keys": SERVING_KEYS,
         "batch_size": SERVING_BATCH,
+        "serving_batch_window_ms": float(
+            flags.flag("serving_batch_window_ms")),
         "n_devices": len(jax.devices()),
     }
+    if SERVE_CLIENTS:
+        clients = [int(c) for c in SERVE_CLIENTS.split(",") if c.strip()]
+        out["clients"] = _bench_serve_clients(pred, clients)
+    return out
 
 
 CONFIGS = {
@@ -1176,6 +1294,7 @@ CONFIGS = {
     "wide_deep": bench_wide_deep,
     "graph": bench_graph,
     "serving": bench_serving,
+    "serve": bench_serving,  # alias: `bench.py serve --clients 1,8,32`
 }
 
 
@@ -1267,7 +1386,13 @@ def _preflight_gather_kernel(n: int, dim: int, pass_keys: int) -> None:
 
 
 def main() -> None:
-    name = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
+    global SERVE_CLIENTS
+    argv = list(sys.argv[1:])
+    if "--clients" in argv:
+        i = argv.index("--clients")
+        SERVE_CLIENTS = argv[i + 1] if i + 1 < len(argv) else "1,8,32"
+        del argv[i:i + 2]
+    name = argv[0] if argv else "deepfm"
     # Liveness probe: one tiny device round-trip. A dead tunnel hangs
     # HERE, inside the short early-watchdog tier, producing a structured
     # failure in <5 min; once it answers, the watchdog relaxes so a long

@@ -87,6 +87,38 @@ def main() -> None:
                   f"p(after)={after[:3].round(4).tolist()}")
             assert not np.allclose(before, after), \
                 "patch must change served answers on patched traffic"
+
+            # Pass 3 flows to serving with NO RPC at all: the day
+            # loop's donefile protocol publishes the delta and the
+            # replica's publisher thread hot-swaps it (the
+            # zero-downtime path a real fleet runs on).
+            from paddlebox_tpu.checkpoint.protocol import \
+                CheckpointProtocol
+            from paddlebox_tpu.serving import DonefilePublisher
+            root = os.path.join(tmpdir, "ckpt")
+            proto = CheckpointProtocol(root)
+            pub = DonefilePublisher(pred, root, table="emb",
+                                    poll_s=0.05)
+            pub.start()
+            try:
+                train_pass(tr, feed, tmpdir, rng, 500, 900, "pass3")
+                mdir = proto.model_dir("day0", 1)
+                tr.engine.store.save_delta(mdir)
+                proto.publish("day0", 1)
+                import time as _time
+                deadline = _time.time() + 10
+                while pub.applied < 1 and _time.time() < deadline:
+                    _time.sleep(0.02)
+                assert pub.applied == 1, "publisher must hot-swap"
+                swapped = cli.predict(queries)
+                print(f"donefile hot-swap applied "
+                      f"(stats hotswap_applied="
+                      f"{cli.stats()['hotswap_applied']})  "
+                      f"p(swapped)={swapped[:3].round(4).tolist()}")
+                assert not np.allclose(after, swapped), \
+                    "hot-swap must change served answers"
+            finally:
+                pub.stop()
         finally:
             cli.stop_server()
             cli.close()

@@ -448,6 +448,46 @@ define_flag("serving_slo_p99_ms", 0.0,
             "p50/p90/p99/p999 latency quantiles against it so the "
             "operator reads margin, not just breaches. <= 0 disables "
             "(default) — quantiles are still recorded")
+define_flag("serving_batch_window_ms", 2.0,
+            "server-side ragged micro-batching window: concurrent "
+            "predict RPCs enqueue parsed rows and a dispatcher thread "
+            "drains everything waiting every this-many ms (or earlier "
+            "at serving_batch_max_rows) into ONE packed device forward "
+            "— the request-coalescing that turns N per-RPC dispatches "
+            "into one ragged dispatch. 0 = dispatch as soon as the "
+            "queue is non-empty (still coalesces whatever arrived "
+            "together); < 0 = batching off, every RPC packs and "
+            "dispatches inline (the pre-r14 path)")
+define_flag("serving_batch_max_rows", 4096,
+            "dispatch a serving micro-batch early once this many rows "
+            "are waiting (bounds the packed batch's device shape and "
+            "the head-of-line wait under burst load); also the "
+            "per-request row ceiling when it exceeds the feed batch "
+            "size")
+define_flag("serving_hbm_rows", 0,
+            "serving-table hot-tier capacity in rows: a model with more "
+            "xbox rows than this serves through the hierarchical cache "
+            "(hot rows in HBM, warm in a host-RAM CLOCK cache, cold on "
+            "the ssd tier) with misses batch-promoted toward HBM by "
+            "access frequency off the predict critical path. 0 "
+            "(default) = whole table device-resident, no tiering")
+define_flag("serving_host_cache_rows", 0,
+            "warm host-RAM tier capacity (rows) of the tiered serving "
+            "table; rows evicted from it spill to the ssd/disk tier. "
+            "0 = unbounded host RAM (disk tier never used)")
+define_flag("serving_cache_dir", "",
+            "directory backing the tiered serving table's cold tier "
+            "(DiskShards buckets); empty = a per-predictor temp dir")
+define_flag("serving_publisher_poll_s", 1.0,
+            "donefile poll interval of the serving publisher thread "
+            "(serving/publisher.py): how often a replica checks the "
+            "training day loop's donefile for freshly published "
+            "per-pass deltas to hot-swap via apply_update")
+define_flag("serving_rps_window_s", 30.0,
+            "sliding window for the serving throughput_rps gauge/stat "
+            "(computed from LogQuantileDigest.delta() counts over "
+            "rotating window snapshots — an idle replica decays to 0 "
+            "instead of reporting lifetime-average rate)")
 define_flag("rpc_retry_deadline_s", 30.0,
             "overall wall-clock deadline across an idempotent call's "
             "retries: when exceeded the last connection error raises "

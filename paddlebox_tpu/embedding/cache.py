@@ -1,4 +1,4 @@
-"""Replicated small-table caches + string-keyed input table.
+"""Replicated small-table caches, host row cache, string-keyed input table.
 
 Roles (SURVEY.md §2.2 "GpuReplicaCache / InputTable",
 ``fleet/box_wrapper.h:63-197``):
@@ -6,6 +6,12 @@ Roles (SURVEY.md §2.2 "GpuReplicaCache / InputTable",
   HBM (reference: per-GPU copy filled by ``PullCacheValue``; consumed by
   the ``pull_cache_value`` op). TPU: one jnp array with replicated
   sharding — lookups are local gathers, no collective.
+- ``HostRowCache``: the WARM tier of the hierarchical serving table — a
+  bounded host-RAM row array with CLOCK eviction and batched
+  ``get_rows``/``put_rows`` (role of the BoxPS mem-tier working set
+  between the per-GPU HBM copies and the SSD table; "Dissecting
+  Embedding Bag Performance in DLRM Inference" is the why: the gather
+  path dominates inference, so misses must hit RAM, not disk).
 - ``InputTable``: CPU-side string→index dictionary whose indices flow
   through the graph into a device aux table (reference ``lookup_input``
   op + ``InputTableDataset``): map raw string features (e.g. URLs) to
@@ -15,7 +21,7 @@ Roles (SURVEY.md §2.2 "GpuReplicaCache / InputTable",
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +49,152 @@ class ReplicaCache:
         out = self.values[safe]
         in_range = (ids >= 0) & (ids < self.num_rows)
         return jnp.where(in_range[..., None], out, 0.0)
+
+
+class HostRowCache:
+    """Bounded host-RAM row cache with CLOCK eviction, batched API.
+
+    Fixed-width float32 rows keyed by uint64 feasign. ``capacity == 0``
+    means unbounded (the backing arrays grow by doubling and nothing is
+    ever evicted); a bounded cache evicts CLOCK-cold rows through the
+    ``on_evict(keys, vals)`` callback (the spill hook the serving tier
+    points at its disk shards) — one batched call per ``put_rows``, so a
+    burst of inserts pays one disk write, not one per row.
+
+    NOT internally locked: the owner (the tiered serving table, under
+    the predictor lock) serializes every call — the same caller-
+    serialized contract as the KeyIndex numpy fallback.
+    """
+
+    def __init__(self, width: int, capacity: int = 0,
+                 on_evict: Optional[Callable[[np.ndarray, np.ndarray],
+                                             None]] = None):
+        if capacity < 0:
+            raise ValueError(f"capacity must be >= 0: {capacity}")
+        self.width = int(width)
+        self.capacity = int(capacity)
+        self.on_evict = on_evict
+        size = min(capacity, 1024) if capacity else 1024
+        size = max(size, 8)
+        self._vals = np.zeros((size, self.width), np.float32)
+        self._keys = np.zeros((size,), np.uint64)
+        self._ref = np.zeros((size,), bool)     # CLOCK reference bits
+        self._slot: Dict[int, int] = {}         # key -> slot
+        self._free: List[int] = list(range(size - 1, -1, -1))
+        self._hand = 0
+
+    def __len__(self) -> int:
+        return len(self._slot)
+
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.uint64)
+        return np.fromiter((int(k) in self._slot for k in keys), bool,
+                           count=keys.shape[0])
+
+    def get_rows(self, keys: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """(vals [n, width], hit [n]): values aligned to ``keys`` (zeros
+        where absent). Hits get their CLOCK reference bit set."""
+        keys = np.asarray(keys, np.uint64)
+        n = keys.shape[0]
+        slots = np.fromiter((self._slot.get(int(k), -1) for k in keys),
+                            np.int64, count=n)
+        hit = slots >= 0
+        vals = np.zeros((n, self.width), np.float32)
+        if hit.any():
+            s = slots[hit]
+            vals[hit] = self._vals[s]
+            self._ref[s] = True
+        return vals, hit
+
+    def _grow(self) -> None:
+        old = self._vals.shape[0]
+        new = old * 2
+        if self.capacity:
+            new = min(new, self.capacity)  # never overshoot the budget
+        # graftlint: allow-lock(caller-serialized: every HostRowCache call runs under the owning predictor's lock)
+        self._vals = np.concatenate(
+            [self._vals, np.zeros((new - old, self.width), np.float32)])
+        self._keys = np.concatenate(
+            [self._keys, np.zeros((new - old,), np.uint64)])
+        # graftlint: allow-lock(caller-serialized: every HostRowCache call runs under the owning predictor's lock)
+        self._ref = np.concatenate(
+            [self._ref, np.zeros((new - old,), bool)])
+        self._free.extend(range(new - 1, old - 1, -1))
+
+    def _evict_slots(self, n: int) -> List[int]:
+        """CLOCK sweep: free ``n`` cold slots (second-chance — a set ref
+        bit buys one lap). Evicted rows batch out through on_evict."""
+        size = self._vals.shape[0]
+        out: List[int] = []
+        # <= 2 laps always suffice: the first lap clears every ref bit
+        # it passes, so the second finds only cold slots.
+        for _ in range(2 * size):
+            if len(out) >= n:
+                break
+            s = self._hand
+            self._hand = (self._hand + 1) % size
+            k = int(self._keys[s])
+            if k not in self._slot or self._slot[k] != s:
+                continue  # free or stale slot
+            if self._ref[s]:
+                self._ref[s] = False
+                continue
+            out.append(s)
+            del self._slot[k]
+        if out and self.on_evict is not None:
+            s = np.asarray(out, np.int64)
+            self.on_evict(self._keys[s].copy(), self._vals[s].copy())
+        return out
+
+    def put_rows(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        """Insert/overwrite rows (last duplicate wins). Bounded caches
+        evict cold rows (one batched on_evict) to make room."""
+        keys = np.asarray(keys, np.uint64)
+        vals = np.asarray(vals, np.float32)
+        if keys.shape[0] != vals.shape[0] or (
+                vals.ndim != 2 or vals.shape[1] != self.width):
+            raise ValueError(
+                f"put_rows shape mismatch: {keys.shape} keys vs "
+                f"{vals.shape} vals (width {self.width})")
+        for i in range(keys.shape[0]):
+            k = int(keys[i])
+            s = self._slot.get(k)
+            if s is None:
+                if not self._free:
+                    if self.capacity == 0 or (
+                            self._vals.shape[0] < self.capacity):
+                        self._grow()
+                    else:
+                        self._free.extend(self._evict_slots(
+                            max(1, keys.shape[0] - i)))
+                        if not self._free:  # capacity smaller than batch
+                            continue
+                s = self._free.pop()
+                self._slot[k] = s
+                self._keys[s] = k
+            self._vals[s] = vals[i]
+            self._ref[s] = True
+
+    def pop_rows(self, keys: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """Remove and return (found [n], vals [n, width]) — the tier-
+        promotion read (exclusive tiers: a row leaving RAM-ward must
+        leave this tier)."""
+        keys = np.asarray(keys, np.uint64)
+        n = keys.shape[0]
+        found = np.zeros((n,), bool)
+        vals = np.zeros((n, self.width), np.float32)
+        for i in range(n):
+            k = int(keys[i])
+            s = self._slot.pop(k, None)
+            if s is None:
+                continue
+            found[i] = True
+            vals[i] = self._vals[s]
+            self._ref[s] = False
+            self._free.append(s)
+        return found, vals
 
 
 class InputTable:

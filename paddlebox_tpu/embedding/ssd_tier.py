@@ -103,6 +103,40 @@ class DiskShards:
                 self._save_bucket(int(b), bk[order],
                                   {f: v[order] for f, v in bv.items()})
 
+    def read(self, keys: np.ndarray
+             ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Bulk peek: (found [n], per-field values aligned to ``keys``,
+        zeros where absent). Never moves rows — the serving cold tier's
+        read path (:meth:`take` is the tier-moving variant; a predict
+        miss must not mutate disk state on the request path)."""
+        keys = np.asarray(keys, np.uint64)
+        n = keys.shape[0]
+        found = np.zeros((n,), bool)
+        out: Dict[str, np.ndarray] = {}
+        if n == 0:
+            return found, out
+        buckets = self._bucket_of(keys)
+        for b in np.unique(buckets):
+            ok, ov = self._load_bucket(int(b))
+            if ok.size == 0:
+                continue
+            sel = np.flatnonzero(buckets == b)
+            # ok is sorted (write/take keep buckets sorted): one
+            # searchsorted instead of an O(|bucket|*|keys|) isin.
+            pos = np.searchsorted(ok, keys[sel])
+            pos_c = np.minimum(pos, ok.size - 1)
+            hit = ok[pos_c] == keys[sel]
+            if not hit.any():
+                continue
+            if not out:
+                out = {f: np.zeros((n,) + v.shape[1:], v.dtype)
+                       for f, v in ov.items()}
+            idx = sel[hit]
+            found[idx] = True
+            for f, v in ov.items():
+                out[f][idx] = v[pos_c[hit]]
+        return found, out
+
     def take(self, keys: np.ndarray
              ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
         """Remove and return the present subset of ``keys``."""

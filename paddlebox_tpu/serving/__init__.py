@@ -1,9 +1,13 @@
+from paddlebox_tpu.serving.batcher import MicroBatcher, pack_bucketed
 from paddlebox_tpu.serving.predictor import (CTRPredictor,
+                                             ServingTierStore,
                                              load_delta_update,
                                              load_serving_predictor,
                                              load_xbox_model)
+from paddlebox_tpu.serving.publisher import DonefilePublisher
 from paddlebox_tpu.serving.service import PredictClient, PredictServer
 
-__all__ = ["CTRPredictor", "PredictClient", "PredictServer",
+__all__ = ["CTRPredictor", "DonefilePublisher", "MicroBatcher",
+           "PredictClient", "PredictServer", "ServingTierStore",
            "load_delta_update", "load_serving_predictor",
-           "load_xbox_model"]
+           "load_xbox_model", "pack_bucketed"]

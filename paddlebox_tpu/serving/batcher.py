@@ -1,0 +1,205 @@
+"""Server-side ragged micro-batching for the predict service.
+
+Role of the request-coalescing front end every production serving tier
+grows (and the TPU shape discipline the Ragged Paged Attention paper
+applies to variable-length requests): concurrent predict RPCs do NOT
+each pay a device dispatch. Handler threads enqueue their parsed rows
+and block on a slot; a single dispatcher thread drains everything
+waiting every ``FLAGS_serving_batch_window_ms`` (or as soon as
+``FLAGS_serving_batch_max_rows`` rows are queued), segment-packs all
+waiting requests into ONE static-shape batch — the same capacity-
+bucketed packing the trainer uses, with power-of-two row/capacity
+buckets so the jitted-forward trace count stays O(log max_rows) instead
+of one trace per distinct request shape — runs one device forward, and
+demuxes per-request probability slices back to the blocked handlers.
+
+Padding is explicit masked rows (``SlotBatch.pack`` pads with
+``valid=False`` rows whose segments point at the discard row), never
+synthesized fake svm lines: no parse work for padding, and a padding
+row can never be confused with a real label-0 instance.
+
+Per-request results are bit-identical to a one-request-at-a-time
+dispatch: every model op downstream (segment pools, row-wise MLP) is
+row-local, so a row's probability depends only on its own ids —
+``tests/test_serving_batch.py`` pins exact equality across mixed
+request sizes and capacity buckets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.core import faults, flags, log, monitor, trace
+from paddlebox_tpu.data.slots import DataFeedConfig, Instance, SlotBatch
+
+
+def pow2_bucket(n: int, floor: int = 8) -> int:
+    """Smallest power of two >= max(n, floor) — the shape-bucketing
+    shared by batch rows and per-slot capacities (a pow2 ladder gives
+    <= log2(max_rows) distinct jit traces; exact shapes gave one per
+    distinct request mix)."""
+    n = max(int(n), floor)
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_capacities(feed: DataFeedConfig, bs: int) -> Dict[str, int]:
+    """Per-slot value capacities for a ``bs``-row bucket: the trainer's
+    ``sparse_capacity`` sizing, rounded up to a power of two. Derived
+    from ``bs`` ALONE (not the batch's actual id counts) so the trace
+    key is just the row bucket; a heavy-tailed request overflowing a
+    capacity degrades to counted drops exactly like training packs do
+    (``slot_overflow/<slot>``)."""
+    return {s.name: pow2_bucket(feed.sparse_capacity(s, bs))
+            for s in feed.sparse_slots}
+
+
+def pack_bucketed(instances: Sequence[Instance], feed: DataFeedConfig
+                  ) -> SlotBatch:
+    """Pack instances at pow2-bucketed shapes (rows AND capacities) with
+    masked padding rows — the shape-stable pack both the micro-batcher
+    and the inline (batching-off) predict path share."""
+    bs = pow2_bucket(len(instances))
+    return SlotBatch.pack(instances, feed, batch_size=bs,
+                          capacities=bucket_capacities(feed, bs))
+
+
+class _Pending:
+    """One enqueued request: parsed instances + the slot its handler
+    thread blocks on."""
+
+    __slots__ = ("instances", "t_enqueue", "done", "probs", "error")
+
+    def __init__(self, instances: List[Instance]):
+        self.instances = instances
+        self.t_enqueue = time.perf_counter()
+        self.done = threading.Event()
+        self.probs: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class MicroBatcher:
+    """The dispatcher: a bounded queue of pending requests + one thread
+    draining them into single ragged device forwards."""
+
+    def __init__(self, predictor, *, name: str = "serving-batcher"):
+        self._pred = predictor
+        self._feed = predictor.feed
+        self._q: deque = deque()
+        self._q_rows = 0
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    # -- request side ------------------------------------------------------
+
+    def predict(self, instances: Sequence[Instance],
+                timeout: float = 120.0) -> np.ndarray:
+        """Blocking predict: enqueue, wake the dispatcher, wait for the
+        demuxed per-request slice. Raises whatever the batch's forward
+        raised (an error in one batch fails every request in it — the
+        callers retry individually)."""
+        window_ms = float(flags.flag("serving_batch_window_ms"))
+        if window_ms < 0 or not self._thread.is_alive():
+            # Batching off: pack + dispatch inline (still bucketed
+            # shapes + masked padding — only the coalescing is gone).
+            batch = pack_bucketed(list(instances), self._feed)
+            return np.asarray(
+                self._pred.predict(batch)[:len(instances)], np.float32)
+        req = _Pending(list(instances))
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._q.append(req)
+            self._q_rows += len(req.instances)
+            self._cv.notify_all()
+        if not req.done.wait(timeout):
+            raise TimeoutError(
+                f"micro-batch dispatch did not complete in {timeout}s")
+        if req.error is not None:
+            raise req.error
+        return req.probs
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _drain_locked(self, max_rows: int) -> List[_Pending]:
+        """Pop whole requests until max_rows (a request never splits —
+        its rows must land in one batch for per-batch model-version
+        consistency). Always takes at least one."""
+        out: List[_Pending] = []
+        rows = 0
+        while self._q:
+            nxt = len(self._q[0].instances)
+            if out and rows + nxt > max_rows:
+                break
+            req = self._q.popleft()
+            self._q_rows -= len(req.instances)
+            out.append(req)
+            rows += nxt
+        return out
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait(timeout=0.5)
+                if self._closed and not self._q:
+                    return
+                window_s = max(
+                    float(flags.flag("serving_batch_window_ms")), 0.0
+                ) / 1e3
+                max_rows = max(int(flags.flag("serving_batch_max_rows")),
+                               1)
+                deadline = self._q[0].t_enqueue + window_s
+                while (self._q_rows < max_rows and not self._closed):
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._cv.wait(timeout=left)
+                batch_reqs = self._drain_locked(max_rows)
+            self._dispatch(batch_reqs)
+
+    def _dispatch(self, reqs: List[_Pending]) -> None:
+        t0 = time.perf_counter()
+        try:
+            faults.faultpoint("serving/batch_dispatch")
+            all_ins: List[Instance] = []
+            offsets = [0]
+            for r in reqs:
+                all_ins.extend(r.instances)
+                offsets.append(len(all_ins))
+            with trace.span("serving/batch_dispatch",
+                            requests=len(reqs), rows=len(all_ins)):
+                batch = pack_bucketed(all_ins, self._feed)
+                probs = np.asarray(self._pred.predict(batch), np.float32)
+            bs = batch.batch_size
+            monitor.add("serving/batches", 1)
+            monitor.add("serving/batch_requests", len(reqs))
+            monitor.set_gauge("serving/batch_fill_frac",
+                              len(all_ins) / max(bs, 1))
+            wait_anchor = t0
+            for i, r in enumerate(reqs):
+                r.probs = probs[offsets[i]:offsets[i + 1]]
+                monitor.observe_quantile(
+                    "serving/batch_wait_ms",
+                    (wait_anchor - r.t_enqueue) * 1e3)
+        except BaseException as e:  # fail the whole batch, keep serving
+            log.warning("serving batcher: dispatch of %d request(s) "
+                        "failed: %r", len(reqs), e)
+            for r in reqs:
+                r.error = e
+        finally:
+            for r in reqs:
+                r.done.set()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)

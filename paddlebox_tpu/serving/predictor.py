@@ -13,12 +13,29 @@ TPU-first: the serving lookup is the same pass-table machinery as
 training — host key→row map (C++ hash, native/store.cc), one device
 gather, jitted model forward in bf16 — so a model served here is
 bit-compatible with what training evaluated.
+
+Two capacity regimes:
+
+- **Flat** (default): the whole fused table lives in HBM, one gather
+  per batch — the small-model fast path.
+- **Tiered** (``FLAGS_serving_hbm_rows`` < table rows): the BoxPS
+  memory hierarchy reproduced for inference — hot rows in a fixed-size
+  HBM array (admitted by observed access frequency), warm rows in a
+  host-RAM CLOCK cache (``embedding/cache.py``), cold rows in disk
+  shards (``embedding/ssd_tier.py``). A predict resolves HBM misses
+  from the lower tiers into a per-batch staging array fed to the SAME
+  jitted forward; misses are batch-promoted HBM-ward off the predict
+  critical path ("Dissecting Embedding Bag Performance in DLRM
+  Inference": the gather path dominates, so the hot set must live in
+  device memory and the warm set in RAM).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import tempfile
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -26,7 +43,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddlebox_tpu.core import monitor
+from paddlebox_tpu.core import faults, flags, log, monitor
+from paddlebox_tpu.embedding.cache import HostRowCache
+from paddlebox_tpu.embedding.ssd_tier import DiskShards
 from paddlebox_tpu.native import store_py as native_store
 from paddlebox_tpu.ops.data_norm import normalize_dense_and_strip
 
@@ -115,6 +134,284 @@ def load_serving_predictor(model, feed_config, path: str,
         dense_template=template, **kw)
 
 
+def _pow2(n: int, floor: int = 8) -> int:
+    n = max(int(n), floor)
+    return 1 << (n - 1).bit_length()
+
+
+def _splice_scatter(table: jax.Array, grow: jax.Array,
+                    ex_rows: jax.Array, ex_vals: jax.Array) -> jax.Array:
+    """ONE fused device program for the delta hot-swap: splice appended
+    rows in before the trash row AND overwrite existing rows' values in
+    the same dispatch. Under jit, XLA fuses the scatter into the
+    concatenated output buffer, so a delta pays one new-table
+    allocation — the separate concat-then-scatter it replaces
+    materialized the full table twice (and paused predicts for the
+    extra multi-×-table-size allocation spike)."""
+    width = table.shape[1]
+    out = jnp.concatenate(
+        [table[:-1], grow, jnp.zeros((1, width), table.dtype)])
+    return out.at[ex_rows].set(ex_vals)
+
+
+_splice_scatter_jit = jax.jit(_splice_scatter)
+
+
+class ServingTierStore:
+    """The hierarchical serving table behind a tiered CTRPredictor.
+
+    Tiers are EXCLUSIVE (a key lives in exactly one — the
+    TieredFeatureStore invariant): hot keys map to rows of one
+    fixed-capacity device array ``table`` ([hbm_cap + 1, width]; the
+    last row is the zero trash row unknown/null feasigns read), warm
+    keys live in a :class:`HostRowCache`, cold keys in
+    :class:`DiskShards` (point-read via :meth:`DiskShards.read`;
+    tier moves use the removing ``take``).
+
+    NOT internally locked: every method runs under the owning
+    predictor's lock — including :meth:`promote_locked`, which the
+    promote worker calls with that lock held, keeping the per-request
+    path free of promotion work.
+    """
+
+    FIELD = "v"
+    # Promote once this many miss ACCESSES accumulate (not unique keys:
+    # frequency is the admission signal, so hot misses trip it sooner).
+    PROMOTE_EVERY = 2048
+
+    def __init__(self, keys_sorted: np.ndarray, vals: np.ndarray,
+                 hbm_cap: int, *, cache_rows: Optional[int] = None,
+                 cache_dir: Optional[str] = None):
+        self.width = int(vals.shape[1])
+        self.hbm_cap = int(hbm_cap)
+        n = int(keys_sorted.shape[0])
+        self.total_keys = n
+        n_hot = min(self.hbm_cap, n)
+        dev = np.zeros((self.hbm_cap + 1, self.width), np.float32)
+        dev[:n_hot] = vals[:n_hot]
+        self.table = jnp.asarray(dev)
+        # Initial admission is arbitrary (first n_hot by key order) —
+        # the frequency-driven promote cycle re-ranks it from live
+        # traffic.
+        self._hot_keys = keys_sorted[:n_hot].copy()      # sorted asc
+        self._hot_rows = np.arange(n_hot, dtype=np.int32)
+        self._free_rows = list(range(n_hot, self.hbm_cap))
+        self._hits = np.zeros((self.hbm_cap,), np.int64)
+        self._miss_counts: Dict[int, int] = {}
+        self._miss_accesses = 0
+        if cache_rows is None:
+            cache_rows = int(flags.flag("serving_host_cache_rows"))
+        cdir = cache_dir or str(flags.flag("serving_cache_dir"))
+        self._own_dir = None
+        if not cdir:
+            cdir = tempfile.mkdtemp(prefix="serving_cold_")
+            self._own_dir = cdir
+        self.disk = DiskShards(cdir, num_buckets=16)
+        self.warm = HostRowCache(self.width, capacity=max(cache_rows, 0),
+                                 on_evict=self._spill)
+        if n > n_hot:
+            self.warm.put_rows(keys_sorted[n_hot:], vals[n_hot:])
+
+    def _spill(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        self.disk.write(keys, {self.FIELD: vals})
+        monitor.add("serving/cache_spilled", int(keys.shape[0]))
+
+    def close(self) -> None:
+        if self._own_dir:
+            shutil.rmtree(self._own_dir, ignore_errors=True)
+            self._own_dir = None
+
+    # -- lookup ------------------------------------------------------------
+
+    def lookup(self, ids: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """ids [n] uint64 → (rows [n] int32, staging values
+        [stage, width], stage). Rows < hbm_cap+1 index ``table`` (the
+        trash row for null/unknown); rows >= hbm_cap+1 index the
+        staging array, filled from the warm/cold tiers for this batch.
+        ``stage`` is pow2-bucketed so the jitted forward's trace count
+        stays bounded; 0 = no misses (pure-HBM batch)."""
+        ids = np.asarray(ids, np.uint64)
+        # graftlint: allow-lock(caller-serialized: lookup runs under the predictor lock, same lock promote_locked mutates under)
+        n_hot = self._hot_keys.shape[0]
+        rows = np.full(ids.shape, self.hbm_cap, np.int32)
+        if n_hot:
+            pos = np.searchsorted(self._hot_keys, ids)
+            pos_c = np.minimum(pos, n_hot - 1)
+            hot_hit = (self._hot_keys[pos_c] == ids) & (ids != 0)
+            # graftlint: allow-lock(caller-serialized: lookup runs under the predictor lock, same lock promote_locked mutates under)
+            hit_rows = self._hot_rows[pos_c[hot_hit]]
+            rows[hot_hit] = hit_rows
+            np.add.at(self._hits, hit_rows, 1)
+        else:
+            hot_hit = np.zeros(ids.shape, bool)
+        monitor.add("serving/cache_hbm_hits", int(hot_hit.sum()))
+        miss_sel = ~hot_hit & (ids != 0)
+        if not miss_sel.any():
+            return rows, np.zeros((1, self.width), np.float32), 0
+        uniq, inv, cnt = np.unique(ids[miss_sel], return_inverse=True,
+                                   return_counts=True)
+        vals = np.zeros((uniq.shape[0], self.width), np.float32)
+        wvals, whit = self.warm.get_rows(uniq)
+        vals[whit] = wvals[whit]
+        monitor.add("serving/cache_host_hits", int(cnt[whit].sum()))
+        cold = ~whit
+        if cold.any():
+            cfound, cvals = self.disk.read(uniq[cold])
+            idx = np.flatnonzero(cold)
+            if cvals:
+                vals[idx[cfound]] = cvals[self.FIELD][cfound]
+            monitor.add("serving/cache_ssd_hits",
+                        int(cnt[idx[cfound]].sum()))
+            monitor.add("serving/cache_unknown",
+                        int(cnt[idx[~cfound]].sum()))
+        # Admission accounting: access FREQUENCY per missed key (the
+        # cheap host-side counter the promote cycle ranks by).
+        for k, c in zip(uniq, cnt):
+            ki = int(k)
+            # graftlint: allow-lock(caller-serialized: lookup runs under the predictor lock, same lock promote_locked mutates under)
+            self._miss_counts[ki] = self._miss_counts.get(ki, 0) + int(c)
+        # graftlint: allow-lock(caller-serialized: lookup runs under the predictor lock, same lock promote_locked mutates under)
+        self._miss_accesses += int(cnt.sum())
+        stage = _pow2(uniq.shape[0])
+        miss_arr = np.zeros((stage, self.width), np.float32)
+        miss_arr[:uniq.shape[0]] = vals
+        rows[miss_sel] = (self.hbm_cap + 1 + inv).astype(np.int32)
+        return rows, miss_arr, stage
+
+    def promote_due(self) -> bool:
+        return self._miss_accesses >= self.PROMOTE_EVERY
+
+    # -- tier movement -----------------------------------------------------
+
+    def _take_from_lower(self, keys: np.ndarray
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+        """Remove ``keys`` from warm-then-cold, returning (found [n],
+        vals [n, width]) — the promotion read (exclusive tiers: rows
+        moving HBM-ward leave their old tier)."""
+        found, vals = self.warm.pop_rows(keys)
+        need = ~found
+        if need.any():
+            dk, dv = self.disk.take(keys[need])
+            if dk.size:
+                where = {int(k): i for i, k in enumerate(dk)}
+                for i in np.flatnonzero(need):
+                    j = where.get(int(keys[i]))
+                    if j is not None:
+                        vals[i] = dv[self.FIELD][j]
+                        found[i] = True
+        return found, vals
+
+    def promote_locked(self) -> int:
+        """One batched promotion cycle (runs under the predictor lock,
+        OFF the request path): admit the most-frequent missed keys into
+        HBM — free rows first, then displacing hot rows whose observed
+        hit count is lower — with ONE device scatter for the whole
+        batch; displaced rows move to the warm tier. Returns rows
+        promoted."""
+        faults.faultpoint("serving/cache_promote")
+        self._miss_accesses = 0
+        if not self._miss_counts:
+            return 0
+        cand = sorted(self._miss_counts.items(), key=lambda kv: -kv[1])
+        self._miss_counts = {}
+        k_max = max(64, self.hbm_cap // 16)   # bound one cycle's swap
+        cand = cand[:k_max]
+        ck = np.asarray([k for k, _ in cand], np.uint64)
+        cc = np.asarray([c for _, c in cand], np.int64)
+        found, cvals = self._take_from_lower(ck)
+        ck, cc, cvals = ck[found], cc[found], cvals[found]
+        if ck.size == 0:
+            return 0
+        target_rows: list = []
+        admit: list = []
+        n_free = min(len(self._free_rows), ck.size)
+        for i in range(n_free):
+            target_rows.append(self._free_rows.pop())
+            admit.append(i)
+        evict_entries: list = []
+        if ck.size > n_free and self._hot_keys.size:
+            order = np.argsort(self._hits[self._hot_rows],
+                               kind="stable")
+            for j, cand_i in enumerate(range(n_free, ck.size)):
+                if j >= order.size:
+                    break
+                entry = int(order[j])
+                row = int(self._hot_rows[entry])
+                # Admission by frequency: only displace a hot row a
+                # missed key out-ran since the last cycle.
+                if int(cc[cand_i]) <= int(self._hits[row]):
+                    break
+                evict_entries.append(entry)
+                target_rows.append(row)
+                admit.append(cand_i)
+        if not admit:
+            # Nothing out-ranked the resident set: the fetched
+            # candidates go back to the warm tier.
+            self.warm.put_rows(ck, cvals)
+            return 0
+        admit_a = np.asarray(admit, np.int64)
+        rows_a = np.asarray(target_rows, np.int32)
+        keep_unadmitted = np.setdiff1d(np.arange(ck.size), admit_a)
+        if keep_unadmitted.size:
+            self.warm.put_rows(ck[keep_unadmitted],
+                               cvals[keep_unadmitted])
+        if evict_entries:
+            ev = np.asarray(evict_entries, np.int64)
+            ev_rows = self._hot_rows[ev]
+            ev_vals = np.asarray(self.table[jnp.asarray(ev_rows)])
+            self.warm.put_rows(self._hot_keys[ev], ev_vals)
+            keep = np.ones(self._hot_keys.shape[0], bool)
+            keep[ev] = False
+            self._hot_keys = self._hot_keys[keep]
+            self._hot_rows = self._hot_rows[keep]
+        # ONE scatter admits the whole batch.
+        self.table = self.table.at[jnp.asarray(rows_a)].set(
+            jnp.asarray(cvals[admit_a]))
+        new_keys = np.concatenate([self._hot_keys, ck[admit_a]])
+        new_rows = np.concatenate([self._hot_rows,
+                                   rows_a.astype(np.int32)])
+        order = np.argsort(new_keys, kind="stable")
+        self._hot_keys = new_keys[order]
+        self._hot_rows = new_rows[order]
+        # Fresh admits start with the frequency that earned the slot —
+        # a zeroed counter would make them the next cycle's victims.
+        self._hits[rows_a] = cc[admit_a]
+        monitor.add("serving/cache_promoted", int(admit_a.size))
+        return int(admit_a.size)
+
+    # -- updates -----------------------------------------------------------
+
+    def update(self, keys: np.ndarray, vals: np.ndarray) -> int:
+        """Apply a delta to whichever tier holds each key (hot rows in
+        one device scatter; the rest lands warm, with stale disk copies
+        removed for exclusivity). New keys insert into the warm tier —
+        admission to HBM stays frequency-driven. Returns new keys."""
+        n_hot = self._hot_keys.shape[0]
+        if n_hot:
+            pos = np.searchsorted(self._hot_keys, keys)
+            pos_c = np.minimum(pos, n_hot - 1)
+            hot_hit = self._hot_keys[pos_c] == keys
+            if hot_hit.any():
+                rows = self._hot_rows[pos_c[hot_hit]]
+                # graftlint: allow-lock(caller-serialized: update runs under the predictor lock, same lock promote_locked mutates under)
+                self.table = self.table.at[jnp.asarray(rows)].set(
+                    jnp.asarray(vals[hot_hit], jnp.float32))
+        else:
+            hot_hit = np.zeros(keys.shape, bool)
+        rest = ~hot_hit
+        n_new = 0
+        if rest.any():
+            rk, rv = keys[rest], vals[rest]
+            in_warm = self.warm.contains(rk)
+            if (~in_warm).any():
+                dk, _ = self.disk.take(rk[~in_warm])
+                n_new = int((~in_warm).sum()) - int(dk.shape[0])
+            self.warm.put_rows(rk, rv)
+        self.total_keys += n_new
+        return n_new
+
+
 class CTRPredictor:
     """Batch CTR inference over an xbox-exported sparse model + dense
     params (role of the inference engine serving a BoxPS-trained model).
@@ -129,40 +426,76 @@ class CTRPredictor:
     def __init__(self, model, feed_config, keys: np.ndarray,
                  emb: np.ndarray, w: np.ndarray, dense_params,
                  *, compute_dtype: str = "bfloat16",
-                 data_norm_slot_dim: int = -1):
+                 data_norm_slot_dim: int = -1,
+                 hbm_rows: Optional[int] = None,
+                 host_cache_rows: Optional[int] = None,
+                 cache_dir: Optional[str] = None):
         self.model = model
         self.feed = feed_config
         # Must match the trainer's TrainerConfig.data_norm_slot_dim for
         # data_norm-trained models — the show-skip zeroing is part of
         # the forward.
         self._dn_slot_dim = int(data_norm_slot_dim)
-        order = np.argsort(keys, kind="stable")
-        self._index = native_store.KeyIndex()
-        rows, n_new = self._index.upsert(
-            np.ascontiguousarray(keys[order], np.uint64))
-        if n_new != keys.shape[0]:
-            raise ValueError("duplicate keys in xbox export")
         d = emb.shape[1]
-        # Fused serving record [emb | w], one zero row appended for
-        # unknown keys (row == n).
-        fused = np.zeros((keys.shape[0] + 1, d + 1), np.float32)
-        fused[:-1, :d] = emb[order]
-        fused[:-1, d] = w[order]
-        self._table = jnp.asarray(fused)
-        self._dense_params = dense_params
         self._dim = d
+        order = np.argsort(keys, kind="stable")
+        keys_sorted = np.ascontiguousarray(keys[order], np.uint64)
+        if keys_sorted.size and (np.diff(keys_sorted) == 0).any():
+            raise ValueError("duplicate keys in xbox export")
+        if hbm_rows is None:
+            hbm_rows = int(flags.flag("serving_hbm_rows"))
+        if 0 < hbm_rows < keys_sorted.shape[0]:
+            fused_vals = np.concatenate(
+                [np.asarray(emb, np.float32)[order],
+                 np.asarray(w, np.float32)[order][:, None]], axis=1)
+            self._tiers: Optional[ServingTierStore] = ServingTierStore(
+                keys_sorted, fused_vals, hbm_rows,
+                cache_rows=host_cache_rows, cache_dir=cache_dir)
+            self._table = self._tiers.table
+            self._index = None
+            log.vlog(0, "serving: tiered table — %d keys, %d HBM rows",
+                     keys_sorted.shape[0], hbm_rows)
+        else:
+            self._tiers = None
+            self._index = native_store.KeyIndex()
+            _rows, n_new = self._index.upsert(keys_sorted)
+            if n_new != keys.shape[0]:
+                raise ValueError("duplicate keys in xbox export")
+            # Fused serving record [emb | w], one zero row appended for
+            # unknown keys (row == n).
+            fused = np.zeros((keys.shape[0] + 1, d + 1), np.float32)
+            fused[:-1, :d] = emb[order]
+            fused[:-1, d] = w[order]
+            self._table = jnp.asarray(fused)
+        self._dense_params = dense_params
         self._cdt = dict(float32=jnp.float32,
                          bfloat16=jnp.bfloat16)[compute_dtype]
         self._slot_names = [s.name for s in feed_config.sparse_slots]
-        # Jitted forwards keyed by (caps, batch_size): the traced slicing
-        # closes over them, so a batch with different shapes needs its
-        # own trace — reusing the first would mis-slice silently.
+        # Jitted forwards keyed by (caps, batch_size, staging rows): the
+        # traced slicing closes over them. Callers that pack through
+        # serving/batcher.py only ever present pow2-bucketed shapes, so
+        # the cache stays O(log max_rows); a caller packing exact shapes
+        # pays one trace per distinct shape (the pre-r14 behavior).
         self._fwd_cache: Dict[tuple, object] = {}
-        # Serializes apply_update against predict's index lookup + state
-        # snapshot: KeyIndex is not internally synchronized (a concurrent
-        # upsert can rehash under a reader), and (table, index, dense)
-        # must be swapped as one consistent version.
+        # One fixed dummy staging array for stage-0 (flat / all-hot)
+        # forwards: constant shape, so it never forces a retrace.
+        self._zero_miss = jnp.zeros((1, d + 1), jnp.float32)
+        # Serializes apply_update / tier promotion against predict's
+        # index lookup + state snapshot: KeyIndex is not internally
+        # synchronized (a concurrent upsert can rehash under a reader),
+        # and (table, index/tiers, dense) must swap as one version.
         self._lock = threading.Lock()
+        self._promote_stop = threading.Event()
+        self._promote_wake = threading.Event()
+        self._promote_thread: Optional[threading.Thread] = None
+        if self._tiers is not None:
+            # Promotion runs on its own thread so a predict only ever
+            # pays the counter bump — the batched tier moves happen
+            # between requests, under the same lock.
+            self._promote_thread = threading.Thread(
+                target=self._promote_loop, daemon=True,
+                name="serving-promote")
+            self._promote_thread.start()
 
     @classmethod
     def from_dirs(cls, model, feed_config, xbox_path: str,
@@ -181,7 +514,49 @@ class CTRPredictor:
             dense_params, _step = load_pytree(dense_template, dense_path)
         return cls(model, feed_config, keys, emb, w, dense_params, **kw)
 
-    def _build_fwd(self, caps: Dict[str, int], bs: int):
+    # -- tier promotion ----------------------------------------------------
+
+    def _promote_loop(self) -> None:
+        while not self._promote_stop.is_set():
+            self._promote_wake.wait(timeout=0.5)
+            self._promote_wake.clear()
+            if self._promote_stop.is_set():
+                return
+            if self._tiers is not None and self._tiers.promote_due():
+                self.promote_now()
+
+    def promote_now(self) -> int:
+        """Run one promotion cycle immediately (the promote worker's
+        body; tests drive it directly for determinism)."""
+        if self._tiers is None:
+            return 0
+        with self._lock:
+            n = self._tiers.promote_locked()
+            self._table = self._tiers.table
+        return n
+
+    def close(self) -> None:
+        """Stop the promote worker and drop the cold-tier temp dir
+        (no-op for flat predictors)."""
+        self._promote_stop.set()
+        self._promote_wake.set()
+        if self._promote_thread is not None:
+            self._promote_thread.join(timeout=5.0)
+            self._promote_thread = None
+        if self._tiers is not None:
+            self._tiers.close()
+
+    @property
+    def num_keys(self) -> int:
+        """Keys served (all tiers) — the stats-RPC surface."""
+        if self._tiers is not None:
+            return int(self._tiers.total_keys)
+        # graftlint: allow-lock(benign snapshot: jax arrays are immutable — a stale ref still answers with a consistent shape)
+        return int(self._table.shape[0] - 1)
+
+    # -- forward -----------------------------------------------------------
+
+    def _build_fwd(self, caps: Dict[str, int], bs: int, stage: int):
         model = self.model
         d = self._dim
         cdt = self._cdt
@@ -194,14 +569,24 @@ class CTRPredictor:
 
         dn_slot_dim = self._dn_slot_dim
 
-        def fwd(table, params, rows, segments, dense_feats):
+        def fwd(table, miss, params, rows, segments, dense_feats):
             # data_norm-trained models (TrainerConfig.data_norm):
             # normalize exactly as the trainer's forward does — the
             # SAME shared helper, f32 stats, before the compute cast —
             # or served probabilities diverge from training.
             params, dense_feats = normalize_dense_and_strip(
                 params, dense_feats, slot_dim=dn_slot_dim)
-            picked = table[rows]                      # [sum caps, D+1]
+            if stage:
+                # Tiered batch: rows past the device table index the
+                # per-batch staging array (warm/cold values) — one
+                # gather from each source, row-wise select.
+                n_dev = table.shape[0]
+                dev_rows = jnp.minimum(rows, n_dev - 1)
+                st_rows = jnp.clip(rows - n_dev, 0, stage - 1)
+                picked = jnp.where((rows < n_dev)[:, None],
+                                   table[dev_rows], miss[st_rows])
+            else:
+                picked = table[rows]              # [sum caps, D+1]
             off = 0
             emb: Dict[str, jax.Array] = {}
             w: Dict[str, jax.Array] = {}
@@ -217,6 +602,8 @@ class CTRPredictor:
 
         return jax.jit(fwd)
 
+    # -- updates -----------------------------------------------------------
+
     def apply_update(self, keys: np.ndarray, emb: np.ndarray,
                      w: np.ndarray, *, dense_params=None) -> int:
         """Apply a per-pass update to the LIVE serving table — the
@@ -228,7 +615,10 @@ class CTRPredictor:
         in the same call. Returns the number of new keys.
 
         Thread-safe against concurrent predict(): the (index, table,
-        dense) triple swaps as one version under the predictor lock."""
+        dense) triple swaps as one version under the predictor lock.
+        The flat-table path lands as ONE fused jitted splice+scatter
+        dispatch (:func:`_splice_scatter`); the tiered path routes each
+        key to the tier that owns it."""
         k = np.ascontiguousarray(keys, np.uint64)
         # The null feasign (0) never serves — KeyIndex maps it to row -1
         # and a -1 scatter would wrap onto the trash row, corrupting the
@@ -256,30 +646,35 @@ class CTRPredictor:
             [np.asarray(emb, np.float32)[keep],
              np.asarray(w, np.float32)[keep][:, None]], axis=1)
         with self._lock:
+            if self._tiers is not None:
+                n_new = self._tiers.update(k, vals)
+                self._table = self._tiers.table
+                if dense_params is not None:
+                    self._dense_params = dense_params
+                monitor.add("serving/updated_keys", int(k.shape[0]))
+                monitor.add("serving/new_keys", int(n_new))
+                return int(n_new)
             n_old = self._table.shape[0] - 1
-            # Read-only lookup FIRST: the fallible device allocations
-            # (concat/scatter) must complete before the index mutates,
-            # or an exception would leave index and table permanently
-            # out of sync (every later update then mis-splices).
+            # Read-only lookup FIRST: the fallible device dispatch must
+            # complete before the index mutates, or an exception would
+            # leave index and table permanently out of sync (every
+            # later update then mis-splices).
             looked = self._index.lookup(k)
             new_mask = looked < 0
             n_new = int(new_mask.sum())
-            table = self._table
-            if n_new:
-                # upsert (below) assigns fresh rows [n_old, n_old+n_new)
-                # in input order; splice them in — pre-filled with their
-                # values — BEFORE the trash row.
-                grow = vals[new_mask]
-                table = jnp.concatenate(
-                    [table[:-1], jnp.asarray(grow),
-                     jnp.zeros((1, self._dim + 1), jnp.float32)])
-            ex_rows, ex_vals = looked[~new_mask], vals[~new_mask]
-            if ex_rows.size:
-                # Scatter only the EXISTING keys' rows (fresh rows were
-                # written via the splice — re-scattering them would pay
-                # a second full-table materialization for nothing).
-                table = table.at[jnp.asarray(ex_rows, jnp.int32)].set(
-                    jnp.asarray(ex_vals))
+            grow = vals[new_mask]
+            ex_rows = looked[~new_mask]
+            ex_vals = vals[~new_mask]
+            # One dispatch, one allocation: splice the appended rows in
+            # (pre-filled with their values) and scatter the existing
+            # keys' rows in the SAME fused program. No donation: a
+            # concurrent predict may still hold the old table (it
+            # snapshots under this lock, computes outside it) — the old
+            # version stays alive until its last reader drops it.
+            table = _splice_scatter_jit(
+                self._table, jnp.asarray(grow, jnp.float32),
+                jnp.asarray(ex_rows, jnp.int32),
+                jnp.asarray(ex_vals, jnp.float32))
             if n_new:
                 rows, got_new = self._index.upsert(k)
                 if got_new != n_new or not np.array_equal(
@@ -295,6 +690,8 @@ class CTRPredictor:
         monitor.add("serving/new_keys", int(n_new))
         return int(n_new)
 
+    # -- predict -----------------------------------------------------------
+
     def predict(self, batch) -> np.ndarray:
         """SlotBatch -> CTR probabilities [batch_size] (invalid/padding
         rows yield whatever the model does on zeros — mask with
@@ -302,24 +699,35 @@ class CTRPredictor:
         from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
         caps = {n: batch.ids[n].shape[0] for n in self._slot_names}
         bs = batch.batch_size
-        key = (tuple(sorted(caps.items())), bs)
-        fwd = self._fwd_cache.get(key)
-        if fwd is None:
-            fwd = self._fwd_cache[key] = self._build_fwd(caps, bs)
         all_ids = np.concatenate(
             [batch.ids[n] for n in self._slot_names])
         with self._lock:
             # One consistent model version per batch: lookup + table +
             # dense snapshot under the update lock (jax arrays are
             # immutable, so the compute below needs no lock).
-            rows = self._index.lookup(all_ids)
-            table, dense_params = self._table, self._dense_params
-        n_tab = table.shape[0] - 1
-        rows = np.where(rows < 0, n_tab, rows).astype(np.int32)
+            if self._tiers is not None:
+                rows, miss_arr, stage = self._tiers.lookup(all_ids)
+                table, dense_params = self._table, self._dense_params
+                miss = jnp.asarray(miss_arr) if stage else self._zero_miss
+                promote_due = self._tiers.promote_due()
+            else:
+                looked = self._index.lookup(all_ids)
+                table, dense_params = self._table, self._dense_params
+                n_tab = table.shape[0] - 1
+                rows = np.where(looked < 0, n_tab,
+                                looked).astype(np.int32)
+                miss, stage = self._zero_miss, 0
+                promote_due = False
+        if promote_due:
+            self._promote_wake.set()
+        key = (tuple(sorted(caps.items())), bs, stage)
+        fwd = self._fwd_cache.get(key)
+        if fwd is None:
+            fwd = self._fwd_cache[key] = self._build_fwd(caps, bs, stage)
         segs = {n: jnp.asarray(batch.segments[n])
                 for n in self._slot_names}
-        monitor.add("serving/requests", bs)
-        probs = fwd(table, dense_params,
+        monitor.add("serving/requests", int(batch.num_valid))
+        probs = fwd(table, miss, dense_params,
                     jnp.asarray(rows), segs,
                     jnp.asarray(_concat_dense_host(batch)))
         return np.asarray(probs)

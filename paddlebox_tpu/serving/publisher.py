@@ -1,0 +1,121 @@
+"""Zero-downtime model publication: donefile tail → live hot-swap.
+
+Role of the consumer half of the reference's online-update pipeline
+(``write_model_donefile`` / ``write_xbox_donefile`` produce, the
+serving fleet consumes): the training day loop publishes every pass's
+delta export through the atomic donefile index
+(``checkpoint/protocol.py``); this watcher tails that index from a
+serving replica and applies each newly published per-pass delta to the
+live :class:`~paddlebox_tpu.serving.predictor.CTRPredictor` through
+``apply_update`` — a training pass flows to serving with no restart,
+no RPC, and no torn reads (apply_update swaps the model version under
+the predictor lock, so every in-flight micro-batch sees exactly one
+version).
+
+Records present when the watcher starts are treated as the provenance
+of the base model the operator already loaded and are skipped; only
+records published AFTER startup hot-swap. Day-level base records
+(pass_id == 0) are noted but not applied — a base reload is an operator
+action (new replica / restart), not a delta patch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set, Tuple
+
+from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol
+from paddlebox_tpu.core import faults, flags, log, monitor
+from paddlebox_tpu.serving.predictor import load_delta_update
+
+
+class DonefilePublisher:
+    """Tail a checkpoint root's donefile; hot-swap new deltas in."""
+
+    def __init__(self, predictor, root: str, *,
+                 table: str = "embedding",
+                 poll_s: Optional[float] = None,
+                 catch_up: bool = False):
+        self.predictor = predictor
+        self.table = table
+        self._proto = CheckpointProtocol(root)
+        self._poll_s = poll_s
+        self._seen: Set[Tuple[str, int]] = set()
+        if not catch_up:
+            # The operator stood the replica up from these records'
+            # model — re-applying them would be a no-op at best and a
+            # rollback at worst (an older delta over a newer base).
+            self._seen = {(r.day, r.pass_id) for r in
+                          self._proto.records()}
+        self.applied = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serving-publisher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.poll_once()
+            poll = self._poll_s
+            if poll is None:
+                poll = float(flags.flag("serving_publisher_poll_s"))
+            self._stop.wait(timeout=max(poll, 0.05))
+
+    # -- the tail ----------------------------------------------------------
+
+    def poll_once(self) -> int:
+        """Scan the donefile once; apply every unseen delta record in
+        publication order. Returns deltas applied this scan. Tests and
+        drills call this directly for determinism."""
+        try:
+            recs = self._proto.records()
+        except (OSError, ValueError) as e:
+            log.warning("serving publisher: donefile read failed: %s", e)
+            return 0
+        n = 0
+        for rec in recs:
+            if self._stop.is_set():
+                break
+            tag = (rec.day, rec.pass_id)
+            if tag in self._seen:
+                continue
+            # Mark first: a record whose export is unreadable is
+            # skipped forward, not retried forever — the next pass's
+            # delta carries newer values for every key that matters.
+            self._seen.add(tag)
+            if rec.pass_id == 0:
+                log.vlog(0, "serving publisher: base record %s/0 noted "
+                         "(base reloads are operator actions)", rec.day)
+                continue
+            try:
+                faults.faultpoint("serving/publisher_apply")
+                keys, emb, w = load_delta_update(rec.path, self.table)
+                n_new = self.predictor.apply_update(keys, emb, w)
+                self.applied += 1
+                n += 1
+                monitor.add("serving/hotswap_applied", 1)
+                log.vlog(0, "serving publisher: hot-swapped %s/%d "
+                         "(%d keys, %d new) from %s", rec.day,
+                         rec.pass_id, int(keys.shape[0]), int(n_new),
+                         rec.path)
+            except Exception as e:
+                self.errors += 1
+                monitor.add("serving/hotswap_errors", 1)
+                log.warning("serving publisher: delta %s/%d at %s "
+                            "failed: %r — skipped", rec.day,
+                            rec.pass_id, rec.path, e)
+        return n

@@ -10,54 +10,97 @@ as the PS and graph services (service loop/framing from
 ``distributed/rpc.py`` — no pickle, version-checked; trusted cluster
 network).
 
-The predictor's internal lock already serializes apply_update against
-predict's snapshot, so concurrent request threads get per-batch
-consistent model versions for free.
+Concurrent predict RPCs do not serialize on the device: handler threads
+parse their lines and hand the rows to the shared
+:class:`~paddlebox_tpu.serving.batcher.MicroBatcher`, which coalesces
+everything waiting into ONE ragged device forward per batching window
+and demuxes per-request probability slices back. Padding is masked
+rows inside the packed batch — never synthesized svm lines — and the
+predictor's internal lock gives every micro-batch one consistent model
+version against live ``apply_update`` / publisher hot-swaps.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
 from paddlebox_tpu.core import flags, monitor, report, trace
 from paddlebox_tpu.core.quantiles import LogQuantileDigest
 from paddlebox_tpu.data.parser import parse_lines
-from paddlebox_tpu.data.slots import SlotBatch
 from paddlebox_tpu.distributed import rpc
+from paddlebox_tpu.serving.batcher import MicroBatcher
 from paddlebox_tpu.serving.predictor import CTRPredictor, load_delta_update
 
 
 class PredictServer(rpc.FramedRPCServer):
-    """One predictor endpoint (role of a serving replica)."""
+    """One predictor endpoint (role of a serving replica).
+
+    ``watch_root`` (optional) points at a training day loop's checkpoint
+    root: a :class:`~paddlebox_tpu.serving.publisher.DonefilePublisher`
+    thread then tails its donefile and hot-swaps each newly published
+    per-pass delta into the live predictor — the zero-downtime
+    train→serve flow, no RPC required.
+    """
 
     service_name = "serving"
 
-    def __init__(self, endpoint: str, predictor: CTRPredictor):
+    def __init__(self, endpoint: str, predictor: CTRPredictor, *,
+                 watch_root: Optional[str] = None,
+                 watch_table: str = "embedding"):
         self.predictor = predictor
         # Arm the telemetry sinks (trace/metrics paths) once per replica;
         # per-request cost is one cached-bool check when disabled.
         report.init_telemetry_from_flags()
         # SLO layer: server-side predict latency quantile digest (the
         # log-bucketed sketch — sub-ms CPU predicts and multi-second
-        # tunnel stalls both land within 1% relative error) + uptime
-        # anchor for the throughput gauge. The digest is per-replica
-        # state; the registry copy under serving/predict_ms merges
-        # across replicas via monitor.merge_snapshots.
+        # tunnel stalls both land within 1% relative error) + the
+        # rotating window snapshots behind the throughput gauge. The
+        # digest is per-replica state; the registry copy under
+        # serving/predict_ms merges across replicas via
+        # monitor.merge_snapshots.
         self._started = time.time()
         self._latency = LogQuantileDigest()
         self._lat_lock = threading.Lock()  # handlers run per-connection
+        # Sliding-window throughput state: (anchor time, digest copy at
+        # anchor). Rotated every FLAGS_serving_rps_window_s; the rate is
+        # delta-counts over the previous anchor, so an idle replica
+        # decays to 0 within two windows instead of reporting a stale
+        # lifetime average.
+        self._win_prev = (self._started, self._latency.copy())
+        self._win_cur = (self._started, self._latency.copy())
+        self._batcher = MicroBatcher(predictor)
+        self._publisher = None
+        if watch_root is not None:
+            from paddlebox_tpu.serving.publisher import DonefilePublisher
+            self._publisher = DonefilePublisher(
+                predictor, watch_root, table=watch_table)
+            self._publisher.start()
         rpc.FramedRPCServer.__init__(self, endpoint)
+
+    # -- throughput window -------------------------------------------------
+
+    def _window_rps(self, now: float) -> float:
+        """Requests/s over the sliding window: LogQuantileDigest.delta()
+        count against the previous window anchor (callers hold
+        _lat_lock)."""
+        win = max(float(flags.flag("serving_rps_window_s")), 1e-3)
+        if now - self._win_cur[0] >= win:
+            self._win_prev = self._win_cur
+            self._win_cur = (now, self._latency.copy())
+        t0, base = self._win_prev
+        return self._latency.delta(base).count / max(now - t0, 1e-9)
 
     # -- handlers ---------------------------------------------------------
 
     def handle_predict(self, req) -> np.ndarray:
-        """Raw svm-format lines -> CTR probabilities [n_lines]. Lines
+        """Raw svm-format lines -> CTR probabilities [n_lines]. Requests
         beyond the predictor's feed batch_size are rejected (the caller
-        splits; one fixed shape keeps the jitted forward cache small)."""
+        splits; the micro-batcher coalesces many small requests, it
+        does not split one huge one)."""
         t0 = time.perf_counter()
         lines: List[str] = list(req["lines"])
         feed = self.predictor.feed
@@ -66,30 +109,29 @@ class PredictServer(rpc.FramedRPCServer):
                 f"{len(lines)} lines exceed the serving batch size "
                 f"{feed.batch_size} — split the request")
         n = len(lines)
-        if n < feed.batch_size:
-            # Pad to the fixed shape; padding rows carry no features and
-            # are stripped from the reply.
-            lines = lines + ["0"] * (feed.batch_size - n)
         with trace.span("serving/predict", lines=n):
-            batch = SlotBatch.pack(parse_lines(lines, feed), feed)
-            probs = self.predictor.predict(batch)
-            out = np.asarray(probs[:n], np.float32)
+            # Real rows only: padding to the packed shape is masked
+            # rows inside the batcher's bucketed pack — the old path
+            # synthesized '0' svm lines and paid parse work to create
+            # rows indistinguishable from real label-0 instances.
+            instances = parse_lines(lines, feed)
+            out = self._batcher.predict(instances)
         ms = (time.perf_counter() - t0) * 1e3
         monitor.add("serving/predict_rpcs", 1)
         monitor.add("serving/predict_lines", n)
         monitor.observe("serving/predict_ms", ms)
         monitor.observe_quantile("serving/predict_ms", ms)
+        now = time.time()
         with self._lat_lock:
             self._latency.observe(ms)
+            rps = self._window_rps(now)
         # SLO check (FLAGS_serving_slo_p99_ms): each breaching RPC is a
         # counted violation — the p99 the operator reads from
         # handle_stats then says how much margin remains.
         slo = float(flags.flag("serving_slo_p99_ms"))
         if slo > 0 and ms > slo:
             monitor.add("slo/violations", 1)
-        monitor.set_gauge(
-            "serving/throughput_rps",
-            self._latency.count / max(time.time() - self._started, 1e-9))
+        monitor.set_gauge("serving/throughput_rps", rps)
         return out
 
     def handle_apply_delta(self, req) -> int:
@@ -104,12 +146,15 @@ class PredictServer(rpc.FramedRPCServer):
 
     def handle_stats(self, req) -> dict:
         snap = monitor.snapshot()
-        uptime = time.time() - self._started
+        gauges = monitor.snapshot_all().get("gauges", {})
+        now = time.time()
+        uptime = now - self._started
         with self._lat_lock:
             lat = {k: (round(v, 3) if v is not None else None)
                    for k, v in self._latency.quantiles().items()}
             n_lat = self._latency.count
-        return {"keys": int(self.predictor._table.shape[0] - 1),
+            rps = self._window_rps(now)
+        return {"keys": int(self.predictor.num_keys),
                 "dim": int(self.predictor._dim),
                 "predict_rpcs": int(snap.get("serving/predict_rpcs", 0)),
                 "predict_lines": int(snap.get("serving/predict_lines",
@@ -121,13 +166,27 @@ class PredictServer(rpc.FramedRPCServer):
                 # server time vs wire time separate cleanly).
                 "latency_ms": lat,
                 "latency_count": n_lat,
-                "throughput_rps": round(n_lat / max(uptime, 1e-9), 3),
+                # Sliding-window rate (NOT lifetime count / lifetime
+                # uptime — that decays forever on an idle replica).
+                "throughput_rps": round(rps, 3),
+                "batches": int(snap.get("serving/batches", 0)),
+                "batch_fill_frac": float(
+                    gauges.get("serving/batch_fill_frac", 0.0)),
+                "hotswap_applied": int(
+                    snap.get("serving/hotswap_applied", 0)),
                 "slo_p99_ms": float(flags.flag("serving_slo_p99_ms")),
                 "slo_violations": int(snap.get("slo/violations", 0))}
 
     def handle_stop(self, req) -> bool:
         self.stop()
         return True
+
+    def stop(self) -> None:
+        if self._publisher is not None:
+            self._publisher.stop()
+            self._publisher = None
+        self._batcher.close()
+        rpc.FramedRPCServer.stop(self)
 
 
 class PredictClient:

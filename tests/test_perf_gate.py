@@ -204,3 +204,48 @@ def test_ingest_keys_direction_and_gating(tmp_path):
     bad = dict(base, ingest_rows_per_s=60000.0)
     assert perf_gate.main([_write(tmp_path, "ing_bad.json", bad),
                            "--baseline", b]) == 1
+
+
+def test_serve_client_keys_direction_and_gating(tmp_path):
+    """Round-14 serving keys: the concurrent-client wire-mode record
+    (`bench.py serve --clients N`) gates throughput_rps / rows_per_s /
+    batch_fill_frac as higher-better and the latency quantiles as
+    lower-better; planted regressions on each fail a real report pair
+    and provenance (client/request counts) never gates."""
+    assert perf_gate.direction("clients.c32.throughput_rps") == 1
+    assert perf_gate.direction("clients.c32.rows_per_s") == 1
+    assert perf_gate.direction("clients.c32.batch_fill_frac") == 1
+    assert perf_gate.direction("clients.c1.predict_p50_ms") == -1
+    assert perf_gate.direction("clients.c32.predict_p99_ms") == -1
+    assert perf_gate.direction("clients.c32.requests") == 0
+    assert perf_gate.direction("clients.c32.batches") == 0
+    base = {"value": 90000.0,
+            "clients": {
+                "c1": {"throughput_rps": 300.0, "rows_per_s": 19200.0,
+                       "predict_p50_ms": 3.0, "predict_p99_ms": 6.0,
+                       "batch_fill_frac": 0.12, "requests": 900,
+                       "batches": 900},
+                "c32": {"throughput_rps": 4500.0,
+                        "rows_per_s": 288000.0,
+                        "predict_p50_ms": 5.0, "predict_p99_ms": 11.0,
+                        "batch_fill_frac": 0.85, "requests": 13500,
+                        "batches": 600}}}
+    b = _write(tmp_path, "srv_base.json", base)
+    same = _write(tmp_path, "srv_same.json", base)
+    assert perf_gate.main([same, "--baseline", b]) == 0
+    # Provenance wobble (fewer requests completed in the window because
+    # the box was busy) must not gate on its own.
+    ok = copy.deepcopy(base)
+    ok["clients"]["c32"]["requests"] = 9000
+    ok["clients"]["c32"]["batches"] = 400
+    assert perf_gate.main([_write(tmp_path, "srv_ok.json", ok),
+                           "--baseline", b]) == 0
+    for key, val in (("throughput_rps", 1500.0),
+                     ("rows_per_s", 96000.0),
+                     ("predict_p99_ms", 40.0),
+                     ("batch_fill_frac", 0.3)):
+        bad = copy.deepcopy(base)
+        bad["clients"]["c32"][key] = val
+        assert perf_gate.main(
+            [_write(tmp_path, f"srv_bad_{key}.json", bad),
+             "--baseline", b]) == 1, key

@@ -1,0 +1,495 @@
+"""The production serving tier (r14): ragged micro-batched predict must
+be bit-identical per request to one-at-a-time dispatch, every batch must
+see exactly one model version under concurrent hot-swaps, the
+hierarchical HBM/host/ssd cache must serve values identical to an
+uncached predictor, and the donefile publisher must land a delta under
+live load with zero failed RPCs."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol
+from paddlebox_tpu.core import faults, flags as flagmod, monitor
+from paddlebox_tpu.data.parser import parse_lines
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch, SlotConf
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.serving import (CTRPredictor, DonefilePublisher,
+                                   MicroBatcher, PredictClient,
+                                   PredictServer, pack_bucketed)
+from paddlebox_tpu.serving.batcher import bucket_capacities, pow2_bucket
+
+SLOTS = ("u", "i")
+N_KEYS = 500
+
+
+def _feed(bs=64):
+    return DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=2.0) for s in SLOTS),
+        batch_size=bs)
+
+
+def _predictor(rng, feed, scale=0.01, **kw):
+    model = DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,))
+    keys = np.arange(1, N_KEYS + 1, dtype=np.uint64)
+    emb = rng.normal(size=(N_KEYS, 8)).astype(np.float32) * scale
+    w = rng.normal(size=(N_KEYS,)).astype(np.float32) * scale
+    dense = model.init(jax.random.PRNGKey(0))
+    pred = CTRPredictor(model, feed, keys, emb, w, dense,
+                        compute_dtype="float32", **kw)
+    return pred, (keys, emb, w, dense, model)
+
+
+def _lines(rng, n, lo=1, hi=N_KEYS + 100):
+    # hi past N_KEYS: some unknown feasigns ride along (zero rows).
+    return ["0 " + " ".join(f"{s}:{rng.integers(lo, hi)}" for s in SLOTS)
+            for _ in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    monitor.reset()
+    faults.clear()
+    try:
+        yield
+    finally:
+        faults.clear()
+        flagmod.set_flags({"fault_spec": ""})
+        monitor.reset()
+
+
+# ---------------------------------------------------------------------------
+# micro-batch parity
+# ---------------------------------------------------------------------------
+
+def test_microbatch_parity_mixed_sizes_and_buckets():
+    """Coalescing requests of mixed sizes into one packed forward gives
+    BIT-identical per-request probabilities to dispatching each request
+    alone — across row buckets (1..31 rows span three pow2 buckets) and
+    the capacity buckets they imply."""
+    rng = np.random.default_rng(3)
+    feed = _feed()
+    pred, _ = _predictor(rng, feed)
+    try:
+        sizes = (1, 2, 3, 7, 8, 9, 15, 16, 31)
+        reqs = [parse_lines(_lines(rng, m), feed) for m in sizes]
+        serial = [np.asarray(pred.predict(pack_bucketed(r, feed))[:len(r)])
+                  for r in reqs]
+        flat = [i for r in reqs for i in r]
+        coalesced = np.asarray(pred.predict(pack_bucketed(flat, feed)))
+        off = 0
+        for r, want in zip(reqs, serial):
+            got = coalesced[off:off + len(r)]
+            off += len(r)
+            np.testing.assert_array_equal(got, want)
+    finally:
+        pred.close()
+
+
+def test_pack_bucketed_masks_padding_no_fake_lines():
+    """Padding is masked rows, not synthesized '0' svm lines: the
+    packed batch has exactly n valid rows, pads carry the discard
+    segment, and shapes are pow2 buckets."""
+    rng = np.random.default_rng(5)
+    feed = _feed()
+    ins = parse_lines(_lines(rng, 5), feed)
+    batch = pack_bucketed(ins, feed)
+    assert batch.batch_size == 8                 # pow2 row bucket
+    assert batch.num_valid == 5                  # no fake label-0 rows
+    assert not batch.valid[5:].any()
+    for s in SLOTS:
+        cap = batch.ids[s].shape[0]
+        assert cap == pow2_bucket(feed.sparse_capacity(
+            [c for c in feed.sparse_slots if c.name == s][0], 8))
+        # pad cells point at the discard row (batch_size), never a real
+        # row
+        used = int(batch.lengths[s].sum())
+        assert (batch.segments[s][used:] == batch.batch_size).all()
+    caps = bucket_capacities(feed, 8)
+    assert all(caps[s] == batch.ids[s].shape[0] for s in SLOTS)
+
+
+def test_fwd_trace_cache_stays_bounded():
+    """The pow2 ladder bounds the jitted-forward cache: many distinct
+    request sizes collapse onto <= log2(max rows) traces (the exact-
+    shape cache grew one entry per distinct request mix)."""
+    rng = np.random.default_rng(7)
+    feed = _feed()
+    pred, _ = _predictor(rng, feed)
+    try:
+        for m in range(1, 40):
+            pred.predict(pack_bucketed(parse_lines(
+                _lines(rng, m), feed), feed))
+        # rows buckets hit: 8, 16, 32, 64 -> at most 4 traces
+        assert len(pred._fwd_cache) <= 4
+    finally:
+        pred.close()
+
+
+def test_batcher_coalesces_concurrent_requests():
+    """Concurrent submitters coalesce: N threads blocked on the window
+    land in fewer dispatches than requests, with per-request results
+    identical to solo dispatch."""
+    rng = np.random.default_rng(9)
+    feed = _feed()
+    pred, _ = _predictor(rng, feed)
+    prev = flagmod.flag("serving_batch_window_ms")
+    flagmod.set_flags({"serving_batch_window_ms": 50.0})
+    batcher = MicroBatcher(pred)
+    try:
+        reqs = [parse_lines(_lines(rng, m), feed)
+                for m in (3, 5, 7, 9, 11, 2, 4, 6)]
+        want = [np.asarray(pred.predict(
+            pack_bucketed(r, feed))[:len(r)]) for r in reqs]
+        got = [None] * len(reqs)
+
+        def run(i):
+            got[i] = batcher.predict(reqs[i])
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        snap = monitor.snapshot()
+        assert snap["serving/batch_requests"] == len(reqs)
+        assert snap["serving/batches"] < len(reqs)  # real coalescing
+        assert monitor.snapshot_all()["gauges"][
+            "serving/batch_fill_frac"] > 0.0
+    finally:
+        flagmod.set_flags({"serving_batch_window_ms": prev})
+        batcher.close()
+        pred.close()
+
+
+def test_batch_dispatch_fault_fails_batch_not_batcher():
+    """A fault inside one dispatch surfaces to that batch's callers and
+    the batcher keeps serving the next request (error containment for
+    the shared dispatcher thread)."""
+    rng = np.random.default_rng(11)
+    feed = _feed()
+    pred, _ = _predictor(rng, feed)
+    batcher = MicroBatcher(pred)
+    try:
+        ins = parse_lines(_lines(rng, 4), feed)
+        faults.configure("serving/batch_dispatch:times=1:raise=RuntimeError")
+        with pytest.raises(RuntimeError):
+            batcher.predict(ins)
+        out = batcher.predict(ins)  # the batcher thread survived
+        assert out.shape == (4,)
+    finally:
+        batcher.close()
+        pred.close()
+
+
+# ---------------------------------------------------------------------------
+# model-version consistency under hot-swap
+# ---------------------------------------------------------------------------
+
+def test_every_batch_sees_exactly_one_model_version():
+    """Threaded predict vs apply_update: all embedding rows carry one
+    constant per model version and every request row holds one id per
+    slot, so a request mixing versions would return mixed
+    probabilities. Every returned request must be pure v1 or pure v2."""
+    rng = np.random.default_rng(13)
+    feed = _feed()
+    model = DeepFM(slot_names=SLOTS, emb_dim=4, hidden=())
+    keys = np.arange(1, 101, dtype=np.uint64)
+
+    def version_arrays(c):
+        return (np.full((100, 4), c, np.float32),
+                np.full((100,), c, np.float32))
+
+    e1, w1 = version_arrays(0.01)
+    e2, w2 = version_arrays(0.03)
+    dense = model.init(jax.random.PRNGKey(1))
+    pred = CTRPredictor(model, feed, keys, e1, w1, dense,
+                        compute_dtype="float32")
+    batcher = MicroBatcher(pred)
+    lines = ["0 " + " ".join(f"{s}:{rng.integers(1, 100)}"
+                             for s in SLOTS) for _ in range(8)]
+    ins = parse_lines(lines, feed)
+    p1 = np.asarray(pred.predict(pack_bucketed(ins, feed))[:8])
+    pred.apply_update(keys, e2, w2)
+    p2 = np.asarray(pred.predict(pack_bucketed(ins, feed))[:8])
+    # constant-per-version by construction
+    assert np.unique(p1).size == 1 and np.unique(p2).size == 1
+    assert p1[0] != p2[0]
+    pred.apply_update(keys, e1, w1)
+
+    stop = threading.Event()
+    torn = []
+    errors = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                out = np.asarray(batcher.predict(ins))
+                if not (np.array_equal(out, p1)
+                        or np.array_equal(out, p2)):
+                    torn.append(out.copy())
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for flip in range(6):
+            if flip % 2 == 0:
+                pred.apply_update(keys, e2, w2)
+            else:
+                pred.apply_update(keys, e1, w1)
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        batcher.close()
+        pred.close()
+    assert not errors
+    assert not torn  # no request ever saw two versions
+
+
+# ---------------------------------------------------------------------------
+# hierarchical cache tiers
+# ---------------------------------------------------------------------------
+
+def test_cache_tiers_serve_identical_values(tmp_path):
+    """A table larger than FLAGS_serving_hbm_rows serves THROUGH the
+    host and ssd tiers with probabilities bit-identical to a predictor
+    holding everything in HBM — and the batch actually exercised every
+    tier (hit counters)."""
+    rng = np.random.default_rng(17)
+    feed = _feed()
+    flat, (keys, emb, w, dense, model) = _predictor(rng, feed)
+    tiered = CTRPredictor(model, feed, keys, emb, w, dense,
+                          compute_dtype="float32", hbm_rows=64,
+                          host_cache_rows=128,
+                          cache_dir=str(tmp_path / "cold"))
+    try:
+        batch = pack_bucketed(parse_lines(_lines(rng, 48), feed), feed)
+        want = np.asarray(flat.predict(batch))
+        got = np.asarray(tiered.predict(batch))
+        np.testing.assert_array_equal(got, want)
+        snap = monitor.snapshot()
+        assert snap["serving/cache_hbm_hits"] > 0
+        assert snap["serving/cache_host_hits"] > 0
+        assert snap["serving/cache_ssd_hits"] > 0  # 500-64-128 on disk
+        # Promotion moves the observed hot set HBM-ward and changes no
+        # served value.
+        for _ in range(3):
+            tiered.predict(batch)
+        assert tiered.promote_now() > 0
+        assert monitor.snapshot()["serving/cache_promoted"] > 0
+        np.testing.assert_array_equal(
+            np.asarray(tiered.predict(batch)), want)
+    finally:
+        tiered.close()
+        flat.close()
+
+
+def test_tiered_apply_update_routes_every_tier(tmp_path):
+    """A delta spanning hot, warm, cold, and NEW keys lands correctly in
+    the tiered table: post-update predictions equal a flat predictor
+    given the same delta, and the new-key count matches."""
+    rng = np.random.default_rng(19)
+    feed = _feed()
+    flat, (keys, emb, w, dense, model) = _predictor(rng, feed)
+    tiered = CTRPredictor(model, feed, keys, emb, w, dense,
+                          compute_dtype="float32", hbm_rows=64,
+                          host_cache_rows=128,
+                          cache_dir=str(tmp_path / "cold"))
+    try:
+        # touch some rows so the hot tier is exercised before updating
+        warm_batch = pack_bucketed(parse_lines(_lines(rng, 32), feed),
+                                   feed)
+        tiered.predict(warm_batch)
+        ku = np.concatenate([
+            np.arange(1, 33, dtype=np.uint64),        # hot tier
+            np.arange(100, 150, dtype=np.uint64),     # warm/cold mix
+            np.arange(400, 480, dtype=np.uint64),     # cold tier
+            np.arange(600, 620, dtype=np.uint64),     # new keys
+        ])
+        eu = rng.normal(size=(ku.shape[0], 8)).astype(np.float32) * 0.02
+        wu = rng.normal(size=(ku.shape[0],)).astype(np.float32) * 0.02
+        n_flat = flat.apply_update(ku, eu, wu)
+        n_tier = tiered.apply_update(ku, eu, wu)
+        assert n_flat == n_tier == 20
+        assert tiered.num_keys == flat.num_keys == N_KEYS + 20
+        q = pack_bucketed(parse_lines(_lines(rng, 48, 1, 650), feed),
+                          feed)
+        np.testing.assert_array_equal(np.asarray(tiered.predict(q)),
+                                      np.asarray(flat.predict(q)))
+    finally:
+        tiered.close()
+        flat.close()
+
+
+# ---------------------------------------------------------------------------
+# hot-swap drill: publisher under live wire load
+# ---------------------------------------------------------------------------
+
+def _write_delta(proto, day, pass_id, table, keys, emb, w):
+    mdir = proto.model_dir(day, pass_id)
+    with open(os.path.join(mdir, f"{table}.delta.npz"), "wb") as f:
+        np.savez(f, keys=keys, emb=emb, w=w)
+    assert proto.publish(day, pass_id)
+
+
+def test_hotswap_drill_publisher_under_live_load(tmp_path):
+    """The zero-downtime drill: a donefile publisher applies per-pass
+    deltas while 8 client threads predict over the wire — zero failed
+    RPCs, no torn reads (every reply is a pure model version), and the
+    final state matches the last delta."""
+    rng = np.random.default_rng(23)
+    feed = _feed()
+    model = DeepFM(slot_names=SLOTS, emb_dim=4, hidden=())
+    keys = np.arange(1, 101, dtype=np.uint64)
+    consts = [0.01, 0.02, 0.03, 0.04]
+
+    def version_arrays(c):
+        return (np.full((100, 4), c, np.float32),
+                np.full((100,), c, np.float32))
+
+    dense = model.init(jax.random.PRNGKey(2))
+    e0, w0 = version_arrays(consts[0])
+    pred = CTRPredictor(model, feed, keys, e0, w0, dense,
+                        compute_dtype="float32")
+    lines = ["0 " + " ".join(f"{s}:{rng.integers(1, 100)}"
+                             for s in SLOTS) for _ in range(8)]
+    ins = parse_lines(lines, feed)
+    version_probs = []
+    for c in consts:
+        e, w = version_arrays(c)
+        pred.apply_update(keys, e, w)
+        p = np.asarray(pred.predict(pack_bucketed(ins, feed))[:8])
+        assert np.unique(p).size == 1
+        version_probs.append(p)
+    e, w = version_arrays(consts[0])
+    pred.apply_update(keys, e, w)  # back to v0
+
+    root = str(tmp_path / "ckpt")
+    proto = CheckpointProtocol(root)
+    server = PredictServer("127.0.0.1:0", pred, watch_root=root,
+                           watch_table="emb")
+    stop = threading.Event()
+    failures = []
+    torn = []
+
+    def client():
+        cli = PredictClient(server.endpoint)
+        try:
+            while not stop.is_set():
+                out = np.asarray(cli.predict(lines))
+                if out.shape != (8,):
+                    failures.append(("shape", out.shape))
+                if not any(np.array_equal(out, vp)
+                           for vp in version_probs):
+                    torn.append(out.copy())
+        except Exception as e:
+            failures.append(("rpc", repr(e)))
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        # publish three deltas while the fleet predicts
+        for i, c in enumerate(consts[1:], start=1):
+            e, w = version_arrays(c)
+            _write_delta(proto, "20260804", i, "emb", keys, e, w)
+            time.sleep(0.05)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if server._publisher.applied >= 3:
+                break
+            time.sleep(0.05)
+        assert server._publisher.applied == 3
+        time.sleep(0.1)  # a few more predicts on the final version
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        server.stop()
+        pred.close()
+    assert not failures  # zero failed/dropped RPCs
+    assert not torn      # every reply was a pure version
+    assert monitor.snapshot().get("serving/hotswap_applied", 0) == 3
+    # final state serves the LAST delta
+    final = np.asarray(pred.predict(pack_bucketed(ins, feed))[:8])
+    np.testing.assert_array_equal(final, version_probs[-1])
+
+
+def test_publisher_skips_bad_delta_and_continues(tmp_path):
+    """A torn/unreadable published delta is counted, skipped forward,
+    and does not stop later deltas from applying (no retry spin)."""
+    rng = np.random.default_rng(29)
+    feed = _feed()
+    pred, (keys, emb, w, dense, model) = _predictor(rng, feed)
+    root = str(tmp_path / "ckpt")
+    proto = CheckpointProtocol(root)
+    pub = DonefilePublisher(pred, root, table="emb")
+    try:
+        # pass 1: published record whose delta file is missing
+        proto.model_dir("d", 1)
+        assert proto.publish("d", 1)
+        # pass 2: a well-formed delta
+        ku = np.arange(600, 650, dtype=np.uint64)
+        _write_delta(proto, "d", 2, "emb", ku,
+                     rng.normal(size=(50, 8)).astype(np.float32),
+                     rng.normal(size=(50,)).astype(np.float32))
+        assert pub.poll_once() == 1
+        assert pub.errors == 1 and pub.applied == 1
+        assert pred.num_keys == N_KEYS + 50
+        assert pub.poll_once() == 0  # both records consumed, no respin
+    finally:
+        pub.stop()
+        pred.close()
+
+
+# ---------------------------------------------------------------------------
+# sliding-window throughput
+# ---------------------------------------------------------------------------
+
+def test_throughput_rps_sliding_window_decays_to_zero():
+    """The stats-RPC throughput gauge is a sliding window
+    (LogQuantileDigest.delta counts), not lifetime count / lifetime
+    uptime: an idle replica reads 0 within two windows instead of a
+    forever-decaying stale rate."""
+    rng = np.random.default_rng(31)
+    feed = _feed(bs=8)
+    pred, _ = _predictor(rng, feed)
+    prev = flagmod.flag("serving_rps_window_s")
+    flagmod.set_flags({"serving_rps_window_s": 0.2})
+    server = PredictServer("127.0.0.1:0", pred)
+    cli = PredictClient(server.endpoint)
+    try:
+        lines = _lines(rng, 8)
+        for _ in range(5):
+            cli.predict(lines)
+        st = cli.stats()
+        assert st["throughput_rps"] > 0.0
+        assert st["latency_count"] == 5
+        time.sleep(0.25)
+        cli.stats()          # rotates the window once
+        time.sleep(0.25)
+        st3 = cli.stats()    # second rotation: idle window
+        assert st3["throughput_rps"] == 0.0
+        # the lifetime-average bug would still report > 0 here
+        assert st3["latency_count"] == 5
+    finally:
+        flagmod.set_flags({"serving_rps_window_s": prev})
+        cli.stop_server()
+        cli.close()
+        server.stop()
+        pred.close()

@@ -15,7 +15,7 @@ whose direction is known is gated:
 - **higher-better** (regression = drop below ``base * (1 - tol)``):
   throughput (``*_per_s``/``per_sec``/``value``), ``auc``, cache
   ``hit_rate``, ``overlap_frac``, ``e2e_over_device_only``,
-  ``throughput_rps``, ``mfu``.
+  ``*_rps``, ``*fill_frac``, ``mfu``.
 - **lower-better** (regression = rise above ``base * (1 + tol)`` AND by
   more than ``--abs-floor`` — sub-floor wobble on a 0.3 ms stage is
   noise, not signal): ``*_ms``, ``*_s`` walls, ``*_bytes``,
@@ -54,7 +54,10 @@ DEFAULT_ABS_FLOOR = 1.0  # lower-better metrics: ignore sub-floor rises
 # not rates and stay ungated.
 HIGHER_SUFFIXES = ("_per_s", "per_sec", "samples_per_s", "auc",
                    "hit_rate", "overlap_frac", "e2e_over_device_only",
-                   "throughput_rps", "mfu", "achieved_gflops_per_chip")
+                   "_rps", "mfu", "achieved_gflops_per_chip",
+                   # serving micro-batcher: fuller packed batches =
+                   # better coalescing (bench serve --clients keys).
+                   "fill_frac")
 LOWER_SUFFIXES = ("_ms", "_s", "_bytes", "idle_frac",
                   "host_critical_share", "blocked_up_frac",
                   "blocked_down_frac", "violations", "host_syncs",
@@ -186,6 +189,10 @@ def smoke() -> int:
             "ingest_rows_per_s": 250000.0,
             "store_build_keys_per_s": 406447.0,
             "host_index_bulk_build_keys_per_s": 5.6e6,
+            # bench serve --clients keys (r14 serving tier).
+            "clients": {"c32": {"throughput_rps": 4000.0,
+                                "predict_p99_ms": 12.0,
+                                "batch_fill_frac": 0.8}},
             "steps_per_dispatch": 4,        # not gated (count)
             "ingest_workers": 8,            # not gated (count)
             "store_build_native": True,     # not gated (bool)
@@ -216,13 +223,16 @@ def smoke() -> int:
     bad["bottleneck"]["device_idle_frac"] = 0.85
     bad["ingest_rows_per_s"] *= 0.3
     bad["store_build_keys_per_s"] *= 0.3
+    bad["clients"]["c32"]["throughput_rps"] *= 0.4
+    bad["clients"]["c32"]["batch_fill_frac"] = 0.2
     bad["ingest_workers"] = 1          # provenance: must NOT gate
     bad["store_build_native"] = False  # provenance: must NOT gate
     _, regs = compare(bad, base)
     names = {r["metric"] for r in regs}
     for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
                  "bottleneck.device_idle_frac", "ingest_rows_per_s",
-                 "store_build_keys_per_s"):
+                 "store_build_keys_per_s", "clients.c32.throughput_rps",
+                 "clients.c32.batch_fill_frac"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
     for never in ("ingest_workers", "store_build_native"):
