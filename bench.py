@@ -1286,6 +1286,110 @@ def bench_serving() -> dict:
     return out
 
 
+MULTIHOST_HOSTS = 2          # `bench.py multihost --hosts N` overrides
+MULTIHOST_KEYS = 20_000 if _SMALL else 2_000_000
+MULTIHOST_DIM = 16
+MULTIHOST_ROUNDS = 3
+
+
+def bench_multihost() -> dict:
+    """Loopback-process mode of the multi-host embedding exchange tier
+    (MULTIHOST.md): N shard servers on 127.0.0.1 — the sockets, wire
+    codec, fan-out threading, and reshard machinery are all real; only
+    the DCN propagation delay is absent. Records the cross-host
+    exchange rate per wire dtype plus a grow-by-one reshard
+    (minimal-transfer audit included), gated by tools/perf_gate.py."""
+    from paddlebox_tpu.core import monitor
+    from paddlebox_tpu.embedding.table import TableConfig
+    from paddlebox_tpu.multihost import (MultiHostStore, ShardRangeTable,
+                                         execute_reshard,
+                                         rows_moved_minimal,
+                                         start_local_shards, stop_shards)
+
+    hosts = MULTIHOST_HOSTS
+    cfg = TableConfig(name="emb", dim=MULTIHOST_DIM, learning_rate=0.1)
+    rng = np.random.default_rng(0)
+    keys = np.unique(rng.integers(
+        1, 1 << 50, size=int(MULTIHOST_KEYS * 1.01) + 64,
+        dtype=np.uint64))[:MULTIHOST_KEYS]
+
+    _tick("multihost:cluster")
+    servers, eps = start_local_shards(hosts, cfg)
+    store = MultiHostStore(cfg, eps)
+    # Populate: one untimed pull+push round inserts every key.
+    rows = store.pull_for_pass(keys)
+    store.push_from_pass(keys, rows)
+
+    def timed_round():
+        t0 = time.perf_counter()
+        r = store.pull_for_pass(keys)
+        pull_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        store.push_from_pass(keys, r)
+        return pull_s, time.perf_counter() - t1
+
+    out_wire = {}
+    prev = flags.flag("multihost_wire_dtype")
+    try:
+        for wire in ("f32", "int8"):
+            _tick(f"multihost:wire-{wire}")
+            flags.set_flags({"multihost_wire_dtype": wire})
+            timed_round()  # warm the plan cache + connections
+            b0 = (monitor.GLOBAL.get("multihost/pull_bytes")
+                  + monitor.GLOBAL.get("multihost/push_bytes"))
+            t0 = time.perf_counter()
+            pull_s = push_s = 0.0
+            for _ in range(MULTIHOST_ROUNDS):
+                p, q = timed_round()
+                pull_s += p
+                push_s += q
+            dt = time.perf_counter() - t0
+            moved = (monitor.GLOBAL.get("multihost/pull_bytes")
+                     + monitor.GLOBAL.get("multihost/push_bytes") - b0)
+            out_wire[wire] = {
+                "cross_host_exchange_bytes_per_s": round(moved / dt, 1),
+                "exchange_keys_per_s": round(
+                    MULTIHOST_ROUNDS * keys.size * 2 / dt, 1),
+                "pull_ms": round(pull_s / MULTIHOST_ROUNDS * 1e3, 2),
+                "push_ms": round(push_s / MULTIHOST_ROUNDS * 1e3, 2),
+                "wire_bytes_per_round": int(moved // MULTIHOST_ROUNDS),
+            }
+    finally:
+        flags.set_flags({"multihost_wire_dtype": prev})
+
+    # Grow-by-one reshard at the measured table size, audited against
+    # the minimal-transfer bound.
+    _tick("multihost:reshard")
+    grown, geps = start_local_shards(hosts + 1, cfg)
+    joiner, jep = grown[hosts], geps[hosts]
+    stop_shards(grown[:hosts])
+    rec = execute_reshard(eps, eps + [jep])
+    minimal = rows_moved_minimal(ShardRangeTable.for_world(hosts),
+                                 ShardRangeTable.for_world(hosts + 1),
+                                 keys)
+    assert rec["moved_rows"] == minimal, (rec["moved_rows"], minimal)
+    stop_shards(servers)
+    joiner.stop()
+
+    f32 = out_wire["f32"]
+    return {
+        "metric": f"multihost_{hosts}host_exchange_keys_per_sec",
+        "value": f32["exchange_keys_per_s"],
+        "unit": "keys/s",
+        "hosts": hosts,
+        "pass_keys": int(keys.size),
+        "dim": MULTIHOST_DIM,
+        "wire": out_wire,
+        "reshard_ms": round(rec["reshard_ms"], 2),
+        "reshard_moved_rows": int(rec["moved_rows"]),
+        "reshard_rows_per_s": round(
+            rec["moved_rows"] / max(rec["reshard_ms"], 1e-6) * 1e3, 1),
+        "reshard_minimal_frac": round(
+            rec["moved_rows"] / max(minimal, 1), 4),
+        "embedding_quant_block": int(flags.flag("embedding_quant_block")),
+    }
+
+
 CONFIGS = {
     "deepfm": bench_deepfm,
     "resnet50": bench_resnet50,
@@ -1295,6 +1399,7 @@ CONFIGS = {
     "graph": bench_graph,
     "serving": bench_serving,
     "serve": bench_serving,  # alias: `bench.py serve --clients 1,8,32`
+    "multihost": bench_multihost,  # `bench.py multihost --hosts N`
 }
 
 
@@ -1386,11 +1491,15 @@ def _preflight_gather_kernel(n: int, dim: int, pass_keys: int) -> None:
 
 
 def main() -> None:
-    global SERVE_CLIENTS
+    global SERVE_CLIENTS, MULTIHOST_HOSTS
     argv = list(sys.argv[1:])
     if "--clients" in argv:
         i = argv.index("--clients")
         SERVE_CLIENTS = argv[i + 1] if i + 1 < len(argv) else "1,8,32"
+        del argv[i:i + 2]
+    if "--hosts" in argv:
+        i = argv.index("--hosts")
+        MULTIHOST_HOSTS = int(argv[i + 1]) if i + 1 < len(argv) else 2
         del argv[i:i + 2]
     name = argv[0] if argv else "deepfm"
     # Liveness probe: one tiny device round-trip. A dead tunnel hangs

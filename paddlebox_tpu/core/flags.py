@@ -290,11 +290,15 @@ define_flag("trainer_steps_per_dispatch", 1,
             "force 1 with a logged note")
 define_flag("embedding_exchange_dtype", "f32",
             "wire dtype of the sparse pull-reply and push-gradient "
-            "all_to_all payloads: 'f32' (exact, default) or 'bf16' "
+            "all_to_all payloads: 'f32' (exact, default), 'bf16' "
             "(halves the ICI exchange bytes on top of dedup — "
             "EQuARX-style reduced-precision exchange; accumulation and "
-            "the table stay f32). Row/request exchanges stay int32 "
-            "either way")
+            "the table stay f32), or 'int8' (quarters them: symmetric "
+            "per-block quantization with f32 scales riding a second "
+            "small all_to_all — block width embedding_quant_block; "
+            "grads still merge sender-side in f32 and widen back "
+            "before the owner-side accumulate). Row/request exchanges "
+            "stay int32 either way")
 define_flag("pass_table_pow2_rows", 1,
             "round each pass table's rows-per-shard up to a power of two "
             "so consecutive passes with different key counts reuse the "
@@ -488,6 +492,29 @@ define_flag("serving_rps_window_s", 30.0,
             "(computed from LogQuantileDigest.delta() counts over "
             "rotating window snapshots — an idle replica decays to 0 "
             "instead of reporting lifetime-average rate)")
+define_flag("embedding_quant_block", 128,
+            "values per scale block of the int8 exchange wires: both "
+            "the single-host all_to_all payloads "
+            "(embedding_exchange_dtype=int8) and the cross-host shard "
+            "pull/push (multihost_wire_dtype=int8) carry one f32 "
+            "absmax/127 scale per `block` consecutive payload values "
+            "(EQuARX-style per-block quantization; a payload row "
+            "narrower than the block degrades to one per-row scale)")
+define_flag("multihost_wire_dtype", "f32",
+            "emb payload dtype of the cross-host shard pull/push DCN "
+            "wire (multihost/shard_service.py): 'f32' (exact, default "
+            "— the 2-host drill pins bit-parity with single-host), "
+            "'f16', or 'int8' (per-block scales via "
+            "embedding_quant_block; receivers widen to f32 before "
+            "anything accumulates or persists). Optimizer state, "
+            "w/show/click, and reshard row moves always travel f32")
+define_flag("filestore_chunk_bytes", 1 << 24,
+            "FileStore set() payloads above this many bytes split into "
+            "numbered chunk files behind an atomic manifest (get() "
+            "reassembles transparently) — a multi-MB rank-table or "
+            "gathered cluster snapshot can never exceed one framed "
+            "message or one atomic-rename window. <= 0 disables "
+            "chunking")
 define_flag("rpc_retry_deadline_s", 30.0,
             "overall wall-clock deadline across an idempotent call's "
             "retries: when exceeded the last connection error raises "

@@ -30,6 +30,10 @@ from paddlebox_tpu.core import faults, log
 class FileStore:
     """Shared-directory KV + barrier (role of gloo HdfsStore)."""
 
+    #: Chunk-manifest marker (a value starting with these bytes is
+    #: force-chunked so a literal payload can never be misread as one).
+    _CHUNK_MAGIC = b"__PBX_CHUNKS1__:"
+
     def __init__(self, root: str, rank: int, world: int):
         self.root = root
         self.rank = rank
@@ -44,12 +48,33 @@ class FileStore:
         self._gens[name] = g + 1
         return g
 
-    def set(self, key: str, value: bytes) -> None:
-        faults.faultpoint("transport/set")
+    def _write_atomic(self, key: str, value: bytes) -> None:
         tmp = os.path.join(self.root, f".{key}.{self.rank}.tmp")
         with open(tmp, "wb") as f:
             f.write(value)
         os.replace(tmp, os.path.join(self.root, key))
+
+    def set(self, key: str, value: bytes) -> None:
+        """Publish ``value`` under ``key``. Values above
+        ``FLAGS_filestore_chunk_bytes`` split into numbered chunk files
+        (each its own atomic rename) behind a manifest written LAST —
+        a reader that sees the manifest is guaranteed every chunk is
+        already visible, so a multi-MB rank-table or gathered cluster
+        snapshot can never exceed one frame/rename window or present a
+        torn read."""
+        faults.faultpoint("transport/set")
+        from paddlebox_tpu.core import flags as _flags
+        cap = int(_flags.flag("filestore_chunk_bytes"))
+        if (cap <= 0 or len(value) <= cap) \
+                and not value.startswith(self._CHUNK_MAGIC):
+            self._write_atomic(key, value)
+            return
+        cap = max(cap, 1)
+        n = max(1, -(-len(value) // cap))
+        for i in range(n):
+            self._write_atomic(f"{key}.c{i}", value[i * cap:(i + 1) * cap])
+        self._write_atomic(key, self._CHUNK_MAGIC
+                           + f"{n}:{len(value)}".encode())
 
     def get(self, key: str, timeout: float = 60.0) -> bytes:
         faults.faultpoint("transport/get")
@@ -67,7 +92,29 @@ class FileStore:
             time.sleep(poll)
             poll = min(poll * 2.0, 0.25)
         with open(path, "rb") as f:
-            return f.read()
+            data = f.read()
+        if not data.startswith(self._CHUNK_MAGIC):
+            return data
+        # Chunked value: manifest was published AFTER its chunks, so
+        # every chunk file already exists — missing/short means
+        # corruption, not a race; fail loudly.
+        try:
+            n_s, total_s = data[len(self._CHUNK_MAGIC):].split(b":")
+            n, total = int(n_s), int(total_s)
+        except ValueError:
+            raise OSError(f"FileStore.get({key!r}): malformed chunk "
+                          f"manifest {data[:64]!r}") from None
+        parts = []
+        for i in range(n):
+            cpath = os.path.join(self.root, f"{key}.c{i}")
+            with open(cpath, "rb") as f:
+                parts.append(f.read())
+        out = b"".join(parts)
+        if len(out) != total:
+            raise OSError(
+                f"FileStore.get({key!r}): chunked value reassembled to "
+                f"{len(out)} bytes, manifest says {total}")
+        return out
 
     def _gather_from_all(self, prefix: str, what: str, name: str,
                          timeout: float) -> List[bytes]:

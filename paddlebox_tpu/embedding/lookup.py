@@ -144,22 +144,45 @@ def _bucket_by_shard(dev_rows: jax.Array, num_shards: int, block: int,
     return send_rows, shard_of, pos
 
 
-def _wire_dtype():
-    """Wire dtype for the pull-reply / push-grad all_to_all payloads
-    (``embedding_exchange_dtype``): None for f32 (exact, the cast code
-    must be a no-op so the default path stays bit-identical), or
-    jnp.bfloat16 — payloads are cast on the sender, exchanged at half
-    the bytes, and cast back to f32 on the receiver BEFORE any
-    accumulation (EQuARX-style reduced-precision exchange: quantize the
-    wire, accumulate in full precision). Row/request exchanges are
-    int32 and never cast."""
+def _wire_mode() -> str:
+    """Wire mode for the pull-reply / push-grad all_to_all payloads
+    (``embedding_exchange_dtype``): 'f32' (exact — the default path
+    must stay bit-identical, so it exchanges the payload untouched),
+    'bf16' (cast sender-side, half the bytes, widened back BEFORE any
+    accumulation), or 'int8' (symmetric per-block quantization with f32
+    scales riding a second small all_to_all — quarter the payload
+    bytes; EQuARX-style: quantize the wire, accumulate in full
+    precision). Row/request exchanges are int32 and never cast."""
     mode = flags.flag("embedding_exchange_dtype")
-    if mode == "f32":
-        return None
-    if mode == "bf16":
-        return jnp.bfloat16
+    if mode in ("f32", "bf16", "int8"):
+        return mode
     raise ValueError(
-        f"unknown embedding_exchange_dtype {mode!r} (want 'f32'/'bf16')")
+        f"unknown embedding_exchange_dtype {mode!r} "
+        "(want 'f32'/'bf16'/'int8')")
+
+
+def _exchange_payload(x: jax.Array, axis: str) -> jax.Array:
+    """One f32 payload all_to_all under the configured wire mode.
+    f32 mode is the UNTOUCHED pre-flag exchange (bit-exact); reduced
+    modes encode sender-side and widen back to f32 receiver-side, so
+    whatever the caller accumulates stays full precision."""
+    mode = _wire_mode()
+    if mode == "f32":
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    if mode == "bf16":
+        return lax.all_to_all(
+            x.astype(jnp.bfloat16), axis, split_axis=0, concat_axis=0,
+            tiled=True).astype(jnp.float32)
+    from paddlebox_tpu.multihost.quant import (dequantize_blocked,
+                                               quantize_blocked)
+    block = int(flags.flag("embedding_quant_block"))
+    q, scales = quantize_blocked(x, block)
+    recv_q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                            tiled=True)
+    recv_s = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0,
+                            tiled=True)
+    return dequantize_blocked(recv_q, recv_s, x.shape[-1], block)
 
 
 def _kernel_mode(flag_name: str) -> Optional[str]:
@@ -262,8 +285,18 @@ def exchange_bytes(table: PassTable, n: int,
     # the two row exchanges (pull requests shared with push dests via
     # compute_bucketing, so ONE exchange — but exchange_bytes predates
     # the sharing and deliberately reports the pull+push round as two
-    # independent halves, each carrying its rows) stay int32.
-    esize = 2 if _wire_dtype() is not None else 4
+    # independent halves, each carrying its rows) stay int32. int8
+    # payloads count padded values PLUS the per-block f32 scales.
+    mode = _wire_mode()
+    if mode == "int8":
+        from paddlebox_tpu.multihost.quant import quantized_wire_bytes
+        block = int(flags.flag("embedding_quant_block"))
+        pull = s * cap * 4 + quantized_wire_bytes(
+            s * cap, table.pull_width, block)
+        push = s * cap * 4 + quantized_wire_bytes(
+            s * cap, table.dim + 4, block)
+        return pull + push
+    esize = 2 if mode == "bf16" else 4
     pull = s * cap * 4 + s * cap * table.pull_width * esize
     push = s * cap * 4 + s * cap * (table.dim + 4) * esize
     return pull + push
@@ -280,7 +313,8 @@ def record_exchange_stats(tables, group_n, caps) -> int:
                     for t, n, c in zip(tables, group_n, caps)))
     monitor.set_stat("lookup/exchange_bytes_per_step", total)
     monitor.set_gauge("lookup/wire_bits",
-                      16.0 if _wire_dtype() is not None else 32.0)
+                      {"f32": 32.0, "bf16": 16.0,
+                       "int8": 8.0}[_wire_mode()])
     trace.counter("lookup/exchange_bytes", per_step=total)
     return total
 
@@ -390,17 +424,11 @@ def pull_local(table: PassTable, dev_rows: jax.Array, *, axis: str,
     # collective.
     served = _gather_rows(table.vals, recv_rows, pw, block,
                           layout=layout).reshape(num_shards * cap, pw)
-    # Reduced-precision wire (embedding_exchange_dtype=bf16): cast the
-    # reply payload sender-side, exchange half the bytes, widen back to
-    # f32 receiver-side. f32 mode takes the untouched path (bit-exact).
-    wire = _wire_dtype()
-    if wire is not None:
-        served = served.astype(wire)
-    reply = lax.all_to_all(
-        served, axis, split_axis=0, concat_axis=0, tiled=True)
-    if wire is not None:
-        reply = reply.astype(jnp.float32)
-    reply = reply.reshape(num_shards, cap, pw)
+    # Reduced-precision wire (embedding_exchange_dtype): the reply
+    # payload is encoded sender-side (bf16 cast / int8 per-block
+    # quantize) and widened back to f32 receiver-side; f32 mode takes
+    # the untouched path (bit-exact).
+    reply = _exchange_payload(served, axis).reshape(num_shards, cap, pw)
     # Route replies back: (slot_shard, slot_pos) are in original element
     # order (sort-free bucketing), so one gather finishes the pull.
     in_cap = slot_pos < cap
@@ -565,19 +593,13 @@ def push_local(table: PassTable, dev_rows: jax.Array, grad_emb: jax.Array,
         recv_rows = lax.all_to_all(send_rows, axis, split_axis=0,
                                    concat_axis=0, tiled=True
                                    ).reshape(num_shards * cap)
-    # bf16 wire (embedding_exchange_dtype): grads merged sender-side in
-    # f32 (the bucket scatter-add above), cast for the exchange only,
-    # widened back before the owner-side accumulate — accumulation
-    # never happens in reduced precision.
-    wire = _wire_dtype()
+    # Reduced-precision wire (embedding_exchange_dtype): grads merged
+    # sender-side in f32 (the bucket scatter-add above), encoded for
+    # the exchange only (bf16 cast / int8 per-block quantize), widened
+    # back before the owner-side accumulate — accumulation never
+    # happens in reduced precision.
     send_flat = send_payload.reshape(num_shards * cap, aw)
-    if wire is not None:
-        send_flat = send_flat.astype(wire)
-    recv_payload = lax.all_to_all(
-        send_flat, axis, split_axis=0, concat_axis=0, tiled=True)
-    if wire is not None:
-        recv_payload = recv_payload.astype(jnp.float32)
-    recv_payload = recv_payload.reshape(num_shards * cap, aw)
+    recv_payload = _exchange_payload(send_flat, axis)
 
     # Owner-side accumulate (role of dynamic_merge_grad): filler cells
     # point at the trash row with all-zero payload, so they are no-ops.

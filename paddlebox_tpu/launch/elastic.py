@@ -30,10 +30,18 @@ from paddlebox_tpu.core import log
 
 @dataclasses.dataclass
 class RankTable:
-    """One membership generation: host id → contiguous rank."""
+    """One membership generation: host id → contiguous rank.
+
+    ``meta`` carries each member's self-advertised metadata (host id →
+    dict), published with the table by the leader from the heartbeat
+    payloads — the multi-host shard tier rides it to announce each
+    host's ``shard_endpoint`` so peers can (re)build the
+    :class:`~paddlebox_tpu.multihost.keyrange.ShardRangeTable` client
+    set after a membership change without a second rendezvous."""
 
     generation: int
     hosts: List[str]                  # sorted; index = rank
+    meta: Dict[str, Dict] = dataclasses.field(default_factory=dict)
 
     def rank_of(self, host_id: str) -> Optional[int]:
         try:
@@ -53,9 +61,14 @@ class ElasticManager:
                  min_hosts: int = 1, max_hosts: int = 0,
                  heartbeat_interval: float = 0.5, timeout: float = 2.0,
                  settle: float = 0.5,
-                 on_change: Optional[Callable[[RankTable], None]] = None):
+                 on_change: Optional[Callable[[RankTable], None]] = None,
+                 meta: Optional[Dict] = None):
         self.root = root
         self.host_id = host_id
+        # This host's advertised metadata (e.g. its shard-server
+        # endpoint); rides every heartbeat and lands in the published
+        # rank table's per-host ``meta``. Mutable via set_meta().
+        self.meta: Dict = dict(meta or {})
         self.min_hosts = min_hosts
         self.max_hosts = max_hosts      # 0 = unbounded
         self.heartbeat_interval = heartbeat_interval
@@ -74,10 +87,33 @@ class ElasticManager:
     def _hb_path(self, host: str) -> str:
         return os.path.join(self._hb_dir, host)
 
+    def set_meta(self, **meta) -> None:
+        """Update this host's advertised metadata (picked up by the
+        next heartbeat and the next published table generation)."""
+        self.meta.update(meta)
+
     def _beat(self) -> None:
         path = self._hb_path(self.host_id)
-        with open(path, "w") as f:
-            f.write(str(time.time()))
+        # Atomic replace: the leader READS peer heartbeats for their
+        # meta payload, and a torn json would drop a host's endpoint
+        # from the published table.
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "meta": self.meta}, f)
+        os.replace(tmp, path)
+
+    def _peer_meta(self, hosts: List[str]) -> Dict[str, Dict]:
+        out: Dict[str, Dict] = {}
+        for h in hosts:
+            try:
+                with open(self._hb_path(h)) as f:
+                    d = json.load(f)
+                m = d.get("meta", {})
+                if isinstance(m, dict) and m:
+                    out[h] = m
+            except (OSError, ValueError):
+                continue  # legacy plain-timestamp beat or mid-replace
+        return out
 
     def alive_hosts(self) -> List[str]:
         """Hosts with a fresh heartbeat (capped at max_hosts by sorted
@@ -104,7 +140,8 @@ class ElasticManager:
         try:
             with open(self._table_path()) as f:
                 d = json.load(f)
-            return RankTable(generation=d["generation"], hosts=d["hosts"])
+            return RankTable(generation=d["generation"], hosts=d["hosts"],
+                             meta=d.get("meta", {}))
         except (OSError, ValueError, KeyError):
             return None
 
@@ -114,6 +151,7 @@ class ElasticManager:
         tmp = self._table_path() + f".{self.host_id}.tmp"
         with open(tmp, "w") as f:
             json.dump({"generation": gen, "hosts": hosts,
+                       "meta": self._peer_meta(hosts),
                        "ts": time.time()}, f)
         os.replace(tmp, self._table_path())
         log.vlog(0, "elastic: leader %s published gen %d hosts=%s",

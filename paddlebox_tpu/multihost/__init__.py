@@ -1,0 +1,34 @@
+"""Multi-host embedding exchange tier (MULTIHOST.md).
+
+Three connected pieces take the sparse parameter service across hosts:
+
+- :mod:`~paddlebox_tpu.multihost.shard_service` — the host-sharded
+  parameter service: one :class:`ShardServer` per host owning a
+  contiguous hash range of the key space, framed-RPC pull/push with the
+  PR 5 reconnect/retry machinery.
+- :mod:`~paddlebox_tpu.multihost.quant` — the int8 per-block wire codec
+  shared by the cross-host DCN exchange
+  (``FLAGS_multihost_wire_dtype``) and the single-host ICI all_to_all
+  (``FLAGS_embedding_exchange_dtype=int8``).
+- :mod:`~paddlebox_tpu.multihost.reshard` — elastic live resharding:
+  minimal-transfer row moves at a checkpointed pass boundary when the
+  elastic rank table changes.
+
+:class:`~paddlebox_tpu.multihost.store.MultiHostStore` plugs the tier
+into the existing trainer as its backing store
+(``CTRTrainer(..., store=...)``): ICI all_to_all within the host stays
+in the jitted step; DCN crossings batch to one exchange per peer per
+pass boundary.
+"""
+
+from paddlebox_tpu.multihost.keyrange import (MoveSegment,  # noqa: F401
+                                              ShardRangeTable, mix_keys,
+                                              plan_moves,
+                                              rows_moved_minimal)
+from paddlebox_tpu.multihost.reshard import (ElasticReshardController,  # noqa: F401,E501
+                                             execute_reshard)
+from paddlebox_tpu.multihost.shard_service import (ShardClient,  # noqa: F401
+                                                   ShardServer,
+                                                   start_local_shards,
+                                                   stop_shards)
+from paddlebox_tpu.multihost.store import MultiHostStore  # noqa: F401

@@ -1,0 +1,137 @@
+"""Stable hash-range key partition across hosts.
+
+Role of the reference's cross-node key placement (``key % num_devices``,
+``heter_comm.h:332``) re-shaped for ELASTIC membership: a modulo table
+moves ~``(W-1)/W`` of all keys when the world grows by one host, which
+turns every scale event into a full-table shuffle. Here keys map through
+a fixed 64-bit mix (the same splitmix-style finalizer as
+``embedding/sharded_store.py`` / the SSD tier, so sequential feasign
+ranges spread) into a CONTIGUOUS hash range per host:
+
+    owner(key) = searchsorted(bounds, mix(key))     bounds = equal split
+                                                    of [0, 2^64)
+
+Growing W -> W' re-draws the bounds; the set of keys whose owner changes
+is exactly the symmetric difference of the two interval partitions — the
+MINIMAL row movement any deterministic placement can achieve for that
+membership change ("Memory-efficient array redistribution", PAPERS.md:
+redistribution cost is the measure of the overlap complement, and
+interval partitions minimize it for 1-D range placements).
+:func:`plan_moves` emits that overlap complement as explicit
+``(src, dst, lo, hi)`` segments, so the reshard executor transfers each
+moved row exactly once and can be audited against
+:func:`rows_moved_minimal`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+_SPAN = 1 << 64
+
+
+def mix_keys(keys: np.ndarray) -> np.ndarray:
+    """The 64-bit placement hash (splitmix-style finalizer — identical
+    math to ``sharded_store._bucket_of``'s first two stages). uint64 in,
+    uint64 out, vectorized."""
+    h = np.ascontiguousarray(keys, np.uint64)
+    h = h ^ (h >> np.uint64(33))
+    with np.errstate(over="ignore"):
+        h = h * np.uint64(0xFF51AFD7ED558CCD)
+    h = h ^ (h >> np.uint64(33))
+    return h
+
+
+def range_bounds(world: int) -> List[int]:
+    """``world + 1`` python-int bounds of the equal interval partition of
+    [0, 2^64): host i owns [bounds[i], bounds[i+1])."""
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    return [(_SPAN * i) // world for i in range(world + 1)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardRangeTable:
+    """One membership generation's key placement: ``bounds`` as python
+    ints (the top bound 2^64 does not fit uint64)."""
+
+    bounds: tuple
+
+    @staticmethod
+    def for_world(world: int) -> "ShardRangeTable":
+        return ShardRangeTable(bounds=tuple(range_bounds(world)))
+
+    @property
+    def world(self) -> int:
+        return len(self.bounds) - 1
+
+    def owner_of(self, keys: np.ndarray) -> np.ndarray:
+        """int64 owner index per key (vectorized searchsorted over the
+        interior bounds — bounds[0]=0 and bounds[-1]=2^64 never split)."""
+        h = mix_keys(keys)
+        interior = np.asarray(self.bounds[1:-1], np.uint64)
+        return np.searchsorted(interior, h, side="right").astype(np.int64)
+
+    def range_of(self, host: int) -> tuple:
+        return (self.bounds[host], self.bounds[host + 1])
+
+    def mask_in_range(self, keys: np.ndarray, lo: int, hi: int
+                      ) -> np.ndarray:
+        """Boolean mask of keys whose placement hash falls in [lo, hi).
+        ``hi`` may be 2^64 (exclusive top — every hash qualifies)."""
+        h = mix_keys(keys)
+        m = h >= np.uint64(lo)
+        if hi < _SPAN:
+            m &= h < np.uint64(hi)
+        return m
+
+    def to_dict(self) -> dict:
+        # Bounds as decimal strings: 2^64 overflows i64 and the typed
+        # wire/json carry no u64 scalar.
+        return {"bounds": [str(b) for b in self.bounds]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ShardRangeTable":
+        return ShardRangeTable(bounds=tuple(int(b) for b in d["bounds"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class MoveSegment:
+    """One contiguous hash range that changes owner: rows with
+    mix(key) in [lo, hi) move src -> dst."""
+
+    src: int
+    dst: int
+    lo: int
+    hi: int
+
+
+def plan_moves(old: ShardRangeTable, new: ShardRangeTable
+               ) -> List[MoveSegment]:
+    """Minimal-transfer reshard plan between two range tables: the
+    interval intersections of (old partition x new partition) whose
+    owners differ. Every key whose owner changed is covered by exactly
+    one segment; keys whose owner is unchanged appear in no segment —
+    so executing the plan moves each changed row once and nothing else
+    (the redistribution lower bound for this placement family)."""
+    cuts = sorted(set(old.bounds) | set(new.bounds))
+    segs: List[MoveSegment] = []
+    oi = ni = 0
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        while old.bounds[oi + 1] <= lo:
+            oi += 1
+        while new.bounds[ni + 1] <= lo:
+            ni += 1
+        if oi != ni:
+            segs.append(MoveSegment(src=oi, dst=ni, lo=lo, hi=hi))
+    return segs
+
+
+def rows_moved_minimal(old: ShardRangeTable, new: ShardRangeTable,
+                       keys: np.ndarray) -> int:
+    """Count of keys whose owner differs between the two tables — the
+    audit bound a measured reshard's per-row move total must equal."""
+    return int(np.sum(old.owner_of(keys) != new.owner_of(keys)))

@@ -1,0 +1,105 @@
+"""int8 per-block symmetric quantization for exchange wires.
+
+EQuARX (PAPERS.md) shows reduced-precision collectives done with
+per-block scales and full-precision accumulation lose negligible
+quality; this module is that codec for BOTH wires in the repo:
+
+- the single-host ICI all_to_all payloads
+  (``FLAGS_embedding_exchange_dtype=int8`` — ``embedding/lookup.py``,
+  jnp twins, traced inside the step), and
+- the cross-host DCN shard pull/push
+  (``FLAGS_multihost_wire_dtype=int8`` — ``multihost/shard_service.py``,
+  numpy twins on the host wire).
+
+Codec: a payload row ``[W]`` splits into ``ceil(W / block)`` blocks of
+``block`` consecutive values; each block carries one f32 scale
+``absmax / 127`` (zero block -> scale 1 so dequantization is exact
+zeros); values quantize to round-half-even int8 in [-127, 127]. The wire
+carries the int8 values UNPADDED ([n, W] — a narrow payload must not
+pay a full block of padding bytes) plus the [n, nb] f32 scales; the
+decoder re-pads with zeros (exact) to undo the block reshape.
+Accumulation NEVER happens in int8 — both consumers widen to f32
+before any add.
+
+The numpy and jnp twins are bit-identical on the quantized payload
+(same absmax, same round-half-even — pinned by tests/test_multihost.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def num_blocks(width: int, block: int) -> int:
+    if block < 1:
+        raise ValueError(f"quant block must be >= 1, got {block}")
+    return -(-width // block)
+
+
+def quantized_wire_bytes(rows: int, width: int, block: int) -> int:
+    """Wire bytes of one quantized [rows, width] payload: int8 values
+    (unpadded — the codec strips the block padding before the wire)
+    + f32 per-block scales (the exchange_bytes observable)."""
+    nb = num_blocks(width, block)
+    return rows * width * 1 + rows * nb * 4
+
+
+def quantize_blocked_np(x: np.ndarray, block: int
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """f32 [n, w] -> (int8 [n, w], f32 scales [n, nb])."""
+    x = np.asarray(x, np.float32)
+    n, w = x.shape
+    nb = num_blocks(w, block)
+    pad = nb * block - w
+    if pad:
+        x = np.pad(x, ((0, 0), (0, pad)))
+    xb = x.reshape(n, nb, block)
+    amax = np.abs(xb).max(axis=-1)
+    scale = np.where(amax > 0, amax / np.float32(127.0),
+                     np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.rint(xb / scale[:, :, None]), -127, 127
+                ).astype(np.int8)
+    return q.reshape(n, nb * block)[:, :w], scale
+
+
+def dequantize_blocked_np(q: np.ndarray, scales: np.ndarray, width: int,
+                          block: int) -> np.ndarray:
+    """(int8 [n, width], f32 [n, nb]) -> f32 [n, width]."""
+    n = q.shape[0]
+    nb = num_blocks(width, block)
+    pad = nb * block - width
+    if pad:
+        q = np.pad(q, ((0, 0), (0, pad)))
+    xb = q.reshape(n, nb, block).astype(np.float32) * scales[:, :, None]
+    return xb.reshape(n, nb * block)[:, :width]
+
+
+def quantize_blocked(x, block: int):
+    """jnp twin of :func:`quantize_blocked_np` (traced in the jitted
+    step — static shapes only)."""
+    import jax.numpy as jnp
+    n, w = x.shape
+    nb = num_blocks(w, block)
+    pad = nb * block - w
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+    xb = x.reshape(n, nb, block)
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xb / scale[:, :, None]), -127, 127
+                 ).astype(jnp.int8)
+    return q.reshape(n, nb * block)[:, :w], scale
+
+
+def dequantize_blocked(q, scales, width: int, block: int):
+    """jnp twin of :func:`dequantize_blocked_np`."""
+    import jax.numpy as jnp
+    n = q.shape[0]
+    nb = num_blocks(width, block)
+    pad = nb * block - width
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad)))
+    xb = q.reshape(n, nb, block).astype(jnp.float32) * scales[:, :, None]
+    return xb.reshape(n, nb * block)[:, :width]

@@ -1,0 +1,191 @@
+"""Elastic live resharding of the host-sharded embedding tier.
+
+When the elastic rank table changes (a host joins or leaves —
+``launch/elastic.py``), the key ranges re-draw and the rows whose owner
+changed must move. The plan is the MINIMAL-transfer interval overlap
+from :func:`~paddlebox_tpu.multihost.keyrange.plan_moves`
+("Memory-efficient array redistribution", PAPERS.md): each moved row
+crosses the DCN exactly once, rows whose owner is unchanged never move.
+
+Reshard state machine (every resize is a CHECKPOINTED BOUNDARY EVENT —
+the controller runs from the day loop's pass-boundary hook, immediately
+after that pass's delta published):
+
+    COPY    for each plan segment: ``pull_range`` on the src (read-only
+            copy), ``apply_rows`` on the dst (full-row overwrite —
+            idempotent, so replays cannot double-apply).
+    ADOPT   every server ``set_range`` to the new table; the trainer's
+            MultiHostStore switches topology.
+    COMMIT  for each segment: ``drop_range`` on the src (now outside
+            its range).
+
+A failure (or kill -9) at ANY point rolls back through the PR 5
+machinery: shard stores ``reset()`` + the checkpoint protocol's
+``recovery_chain()`` reload — and because ``handle_load`` filters rows
+by each server's CURRENT range, the reload lands bit-identical in
+either the old or the new layout, whichever the cluster is in when it
+recovers. Rows are whole-row snapshots keyed by feasign, so recovery
+can never double-apply a move (MULTIHOST.md walks the crash windows).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.core import faults, log, monitor, trace
+from paddlebox_tpu.multihost.keyrange import ShardRangeTable, plan_moves
+from paddlebox_tpu.multihost.shard_service import ShardClient
+from paddlebox_tpu.multihost.store import MultiHostStore
+
+
+def execute_reshard(old_endpoints: Sequence[str],
+                    new_endpoints: Sequence[str],
+                    *, old_ranges: Optional[ShardRangeTable] = None,
+                    new_ranges: Optional[ShardRangeTable] = None
+                    ) -> Dict[str, object]:
+    """Run the COPY → ADOPT → COMMIT machine between two endpoint lists
+    (hosts present in both keep their index-aligned position; a grown
+    tail joins empty, a shrunk tail drains before leaving). Returns the
+    audit record: per-segment and total moved-row counts, which tests
+    pin against :func:`keyrange.rows_moved_minimal`."""
+    old_ranges = old_ranges or ShardRangeTable.for_world(
+        len(old_endpoints))
+    new_ranges = new_ranges or ShardRangeTable.for_world(
+        len(new_endpoints))
+    plan = plan_moves(old_ranges, new_ranges)
+    # One connection per distinct endpoint across both generations.
+    conns: Dict[str, ShardClient] = {}
+    for e in list(old_endpoints) + list(new_endpoints):
+        if e not in conns:
+            conns[e] = ShardClient(e)
+    t0 = time.perf_counter()
+    moved = 0
+    seg_counts: List[int] = []
+    try:
+        with trace.span("multihost/reshard",
+                        old_world=old_ranges.world,
+                        new_world=new_ranges.world, segments=len(plan)):
+            # COPY: read-only on sources; overwrite-install on dests.
+            for seg in plan:
+                faults.faultpoint("multihost/reshard_move")
+                rows = conns[old_endpoints[seg.src]].call(
+                    "pull_range", lo=str(seg.lo), hi=str(seg.hi))
+                n = int(np.asarray(rows["keys"]).shape[0])
+                if n:
+                    conns[new_endpoints[seg.dst]].call(
+                        "apply_rows", keys=rows["keys"],
+                        values=rows["values"])
+                moved += n
+                seg_counts.append(n)
+            # ADOPT: every server of the NEW generation takes the new
+            # table (joining hosts already carry it; survivors re-index).
+            for i, e in enumerate(new_endpoints):
+                conns[e].call("set_range", table=new_ranges.to_dict(),
+                              index=i)
+            # COMMIT: sources drop rows now outside their range. A
+            # leaving host (not in new_endpoints) drains here too so a
+            # later rejoin cannot resurrect stale rows.
+            for seg in plan:
+                conns[old_endpoints[seg.src]].call(
+                    "drop_range", lo=str(seg.lo), hi=str(seg.hi))
+    finally:
+        for c in conns.values():
+            c.close()
+    reshard_ms = (time.perf_counter() - t0) * 1e3
+    monitor.add("multihost/reshards", 1)
+    monitor.add("multihost/reshard_moved_rows", moved)
+    return {"moved_rows": moved, "segments": len(plan),
+            "segment_rows": seg_counts, "reshard_ms": reshard_ms,
+            "old_world": old_ranges.world, "new_world": new_ranges.world}
+
+
+class ElasticReshardController:
+    """Bridges the elastic rank table to the shard tier at pass
+    boundaries.
+
+    ``endpoints_of(table)`` maps a
+    :class:`~paddlebox_tpu.launch.elastic.RankTable` to the shard-server
+    endpoint list in rank order (hosts advertise their endpoint through
+    the rank table's per-host ``meta`` — ``launch/elastic.py``).
+    ``maybe_apply`` is called from the day loop's pass-boundary hook:
+    the pass's delta is already PUBLISHED, so the reshard is a boundary
+    event under ``recovery_chain()`` — on any failure the controller
+    rolls the shard tier back to that published state and reports the
+    resize as not-applied (the next boundary retries); training itself
+    never replays a published pass."""
+
+    def __init__(self, store: MultiHostStore, ckpt, *,
+                 table_fn=None):
+        self.store = store
+        self.ckpt = ckpt          # CheckpointProtocol (recovery source)
+        self._table_fn = table_fn  # () -> Optional[RankTable]
+        self._generation: Optional[int] = None
+
+    @staticmethod
+    def endpoints_of(table) -> Optional[List[str]]:
+        """Rank-ordered shard endpoints from a RankTable's host meta;
+        None while any member has not advertised one yet (a joiner's
+        server may still be binding — hold the old topology)."""
+        eps = []
+        for host in table.hosts:
+            ep = (table.meta or {}).get(host, {}).get("shard_endpoint")
+            if not ep:
+                return None
+            eps.append(ep)
+        return eps
+
+    def maybe_apply(self, day: str, pass_id: int) -> Optional[Dict]:
+        """Adopt a new rank-table generation if one is pending. Returns
+        the reshard audit record when a resize ran, None otherwise."""
+        table = self._table_fn() if self._table_fn else None
+        if table is None:
+            return None
+        if self._generation is None:
+            # First observation anchors the generation — the initial
+            # topology was built from it, nothing to move.
+            self._generation = table.generation
+            return None
+        if table.generation == self._generation:
+            return None
+        new_eps = self.endpoints_of(table)
+        if new_eps is None:
+            return None
+        faults.faultpoint("multihost/ranktable_apply")
+        old_eps = list(self.store.endpoints)
+        old_ranges = self.store.ranges
+        new_ranges = ShardRangeTable.for_world(len(new_eps))
+        log.vlog(0, "multihost: rank table gen %s -> %s (world %d -> "
+                 "%d) at day %s pass %s boundary", self._generation,
+                 table.generation, old_ranges.world, new_ranges.world,
+                 day, pass_id)
+        try:
+            rec = execute_reshard(old_eps, new_eps,
+                                  old_ranges=old_ranges,
+                                  new_ranges=new_ranges)
+            self.store.set_topology(new_eps, new_ranges)
+        except Exception as e:
+            # Boundary-event rollback: the pass that just finished is
+            # published, so reloading the recovery chain restores the
+            # shard tier bit-identical; the resize retries at the next
+            # boundary instead of poisoning training.
+            monitor.add("multihost/reshard_errors", 1)
+            log.warning("multihost: reshard to gen %s failed (%r) — "
+                        "rolling back via recovery_chain",
+                        table.generation, e)
+            trace.instant("multihost/reshard_rollback",
+                          generation=table.generation, error=repr(e))
+            self._rollback()
+            return None
+        self._generation = table.generation
+        return rec
+
+    def _rollback(self) -> None:
+        base, deltas = self.ckpt.recovery_chain()
+        self.store.reset()
+        if base is not None:
+            self.store.load(base.path, "base")
+        for d in deltas:
+            self.store.load(d.path, "delta")

@@ -1,0 +1,316 @@
+"""Host-sharded parameter service: one shard server per host.
+
+Role of the reference's multi-node sparse tier (the brpc PS cluster the
+GPU pass build pulls from, ``ps_gpu_wrapper.cc:362``) re-keyed by the
+elastic :class:`~paddlebox_tpu.multihost.keyrange.ShardRangeTable`: each
+host runs ONE :class:`ShardServer` owning the keys whose placement hash
+lands in its contiguous range, so no host ever holds the full 50M+ key
+table. The server speaks the repo's framed typed-wire protocol
+(``distributed/wire.py`` — no pickle) through the shared
+:class:`~paddlebox_tpu.distributed.rpc.FramedRPCServer` loop, and
+clients ride :class:`~paddlebox_tpu.distributed.rpc.FramedRPCConn`'s
+reconnect + idempotent-retry machinery (PR 5), so a shard blip on a pure
+read costs latency, not the pass.
+
+Wire format (``FLAGS_multihost_wire_dtype``): the ``emb`` field — the
+dominant payload — crosses the DCN as f32 (exact, default), f16, or
+int8 with per-block f32 scales (``multihost/quant.py``,
+``FLAGS_embedding_quant_block``); every other field (w, optimizer
+state, show/click) stays f32, and the receiver widens BEFORE anything
+accumulates or persists. Reshard row moves (``pull_range`` /
+``apply_rows``) always travel f32: they relocate training state, which
+must arrive bit-identical.
+
+Checkpoint layout: ``<path>/hostshard-<k>/<table>.<kind>.npz`` per
+server. ``load`` is WORLD-AGNOSTIC: every server scans all hostshard
+dirs (and a flat single-host dump — migration), keeping only rows in
+its own current range — so a checkpoint written at world W recovers
+cleanly into world W', which is what makes a crashed reshard rollback
+safe (MULTIHOST.md, "reshard state machine").
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import flags, log, monitor
+from paddlebox_tpu.distributed import rpc
+from paddlebox_tpu.embedding.store import _FIELDS, FeatureStore
+from paddlebox_tpu.embedding.table import TableConfig
+from paddlebox_tpu.multihost import quant
+from paddlebox_tpu.multihost.keyrange import ShardRangeTable
+
+_SPAN = 1 << 64
+
+
+def wire_mode() -> str:
+    mode = flags.flag("multihost_wire_dtype")
+    if mode not in ("f32", "f16", "int8"):
+        raise ValueError(
+            f"unknown multihost_wire_dtype {mode!r} "
+            "(want 'f32'/'f16'/'int8')")
+    return mode
+
+
+def encode_emb(emb: np.ndarray, mode: str) -> Dict[str, np.ndarray]:
+    """Encode the emb payload for the DCN wire. f32 passes the array
+    through UNTOUCHED (the exact path must stay bit-identical)."""
+    if mode == "f32":
+        return {"emb": emb}
+    if mode == "f16":
+        return {"emb_f16": np.asarray(emb, np.float32).astype(np.float16)}
+    q, scales = quant.quantize_blocked_np(
+        emb, int(flags.flag("embedding_quant_block")))
+    return {"emb_q": q, "emb_scale": scales,
+            "emb_width": np.asarray([emb.shape[1]], np.int64)}
+
+
+def decode_emb(payload: Dict[str, np.ndarray]) -> np.ndarray:
+    """Widen a wire emb payload back to f32 (the only dtype anything
+    downstream accumulates or persists in)."""
+    if "emb" in payload:
+        return payload["emb"]
+    if "emb_f16" in payload:
+        return payload["emb_f16"].astype(np.float32)
+    width = int(payload["emb_width"][0])
+    return quant.dequantize_blocked_np(
+        payload["emb_q"], payload["emb_scale"], width,
+        int(flags.flag("embedding_quant_block")))
+
+
+def payload_nbytes(payload: Dict[str, np.ndarray]) -> int:
+    return int(sum(np.asarray(v).nbytes for v in payload.values()))
+
+
+class ShardServer(rpc.FramedRPCServer):
+    """One host's shard of the multi-host embedding tier."""
+
+    def __init__(self, endpoint: str, index: int,
+                 ranges: ShardRangeTable,
+                 config: TableConfig, *, seed: int = 0,
+                 store: Optional[FeatureStore] = None):
+        self.index = index
+        self.ranges = ranges
+        self.config = config
+        self.store = store if store is not None else FeatureStore(
+            config, seed=seed)
+        # One writer lock over range-mutating sequences (reshard moves /
+        # set_range / load): the FeatureStore lock covers single calls,
+        # but a pull_range -> drop_range commit must not interleave with
+        # a concurrent load's set_all.
+        self._mut_lock = threading.Lock()
+        self.service_name = f"shard[{index}]"
+        rpc.FramedRPCServer.__init__(self, endpoint, backlog=64)
+
+    def _after_reply(self) -> bool:
+        if not self._running:
+            self.stop()
+            return True
+        return False
+
+    def _check_owned(self, keys: np.ndarray) -> None:
+        if keys.size:
+            owner = self.ranges.owner_of(keys)
+            if not np.all(owner == self.index):
+                bad = int(owner[owner != self.index][0])
+                raise ValueError(
+                    f"keys not owned by shard {self.index} "
+                    f"(first stray owner {bad}) — client range table is "
+                    f"stale; re-apply the rank table")
+
+    # -- pull / push (the DCN halves of the lookup exchange) ---------------
+
+    def handle_pull(self, req) -> Dict[str, np.ndarray]:
+        """Full value rows for sorted unique keys in this shard's range
+        (pull_for_pass semantics: unseen keys return deterministic
+        per-key init rows and are NOT inserted — a pure read, declared
+        idempotent by the client). ``wire`` selects the emb encoding."""
+        keys = np.asarray(req["keys"], np.uint64)
+        self._check_owned(keys)
+        rows = self.store.pull_for_pass(keys)
+        out: Dict[str, np.ndarray] = {
+            f: v for f, v in rows.items() if f != "emb"}
+        out.update(encode_emb(rows["emb"], req.get("wire", "f32")))
+        monitor.add("multihost/served_pull_keys", int(keys.size))
+        return out
+
+    def handle_push(self, req) -> int:
+        """EndPass write-back of full rows (emb decoded from the wire
+        encoding to f32 BEFORE the store write)."""
+        keys = np.asarray(req["keys"], np.uint64)
+        self._check_owned(keys)
+        values = dict(req["values"])
+        values["emb"] = decode_emb(values)
+        for k in ("emb_f16", "emb_q", "emb_scale", "emb_width"):
+            values.pop(k, None)
+        self.store.push_from_pass(keys, values)
+        monitor.add("multihost/served_push_keys", int(keys.size))
+        return int(keys.size)
+
+    # -- reshard protocol --------------------------------------------------
+
+    def handle_pull_range(self, req) -> Dict[str, np.ndarray]:
+        """Copy (NOT pop) of every resident row whose placement hash is
+        in [lo, hi) — the read-only COPY phase of a reshard move, so a
+        crash mid-move loses nothing."""
+        lo, hi = int(req["lo"]), int(req["hi"])
+        keys, _ = self.store.key_stats()
+        mask = self.ranges.mask_in_range(keys, lo, hi)
+        sel = keys[mask]
+        vals = (self.store.pull_for_pass(sel) if sel.size else
+                self.store.pull_for_pass(np.empty((0,), np.uint64)))
+        return {"keys": sel, "values": vals}
+
+    def handle_apply_rows(self, req) -> int:
+        """Install moved rows (full-row OVERWRITE — naturally idempotent,
+        so a replayed move after a crash cannot double-apply)."""
+        keys = np.asarray(req["keys"], np.uint64)
+        with self._mut_lock:
+            self.store.push_from_pass(keys, req["values"])
+        return int(keys.size)
+
+    def handle_drop_range(self, req) -> int:
+        """COMMIT phase: discard rows in [lo, hi) after every dest has
+        acknowledged its copy. Idempotent (an empty range drops 0)."""
+        lo, hi = int(req["lo"]), int(req["hi"])
+        with self._mut_lock:
+            keys, _ = self.store.key_stats()
+            mask = self.ranges.mask_in_range(keys, lo, hi)
+            sel = keys[mask]
+            if sel.size:
+                self.store.pop_rows(sel)
+        return int(sel.size)
+
+    def handle_set_range(self, req) -> bool:
+        """Adopt a new range table (+ this server's index in it) — the
+        last step before the drop phase of a reshard."""
+        with self._mut_lock:
+            self.ranges = ShardRangeTable.from_dict(req["table"])
+            self.index = int(req["index"])
+            self.service_name = f"shard[{self.index}]"
+        return True
+
+    # -- checkpoint / lifecycle --------------------------------------------
+
+    def _shard_dir(self, path: str) -> str:
+        d = os.path.join(path, f"hostshard-{self.index:04d}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def handle_save(self, req) -> bool:
+        mode = req.get("mode", "base")
+        with self._mut_lock:
+            if mode == "base":
+                self.store.save_base(self._shard_dir(req["path"]))
+            elif mode == "delta":
+                self.store.save_delta(self._shard_dir(req["path"]))
+            else:
+                self.store.save_xbox(self._shard_dir(req["path"]))
+        return True
+
+    def _checkpoint_parts(self, path: str, kind: str
+                          ) -> List[Tuple[np.ndarray, Dict]]:
+        """Every (keys, values) part of a checkpoint FILTERED to this
+        server's current range — hostshard dirs from any world size,
+        plus a flat single-host dump (migration path)."""
+        name = self.config.name
+        files = sorted(glob.glob(os.path.join(
+            path, "hostshard-*", f"{name}.{kind}.npz")))
+        flat = os.path.join(path, f"{name}.{kind}.npz")
+        if os.path.exists(flat):
+            files.append(flat)
+        if not files:
+            raise FileNotFoundError(
+                f"no {kind} dump for table {name!r} under {path}")
+        parts = []
+        lo, hi = self.ranges.range_of(self.index)
+        for f in files:
+            data = np.load(f)
+            keys = data["keys"].astype(np.uint64)
+            mask = self.ranges.mask_in_range(keys, lo, hi)
+            if not mask.any():
+                continue
+            parts.append((keys[mask],
+                          {fld: data[fld][mask] for fld in _FIELDS}))
+        return parts
+
+    def handle_load(self, req) -> int:
+        """World-agnostic load: keep only rows in this server's range.
+        ``base`` REPLACES contents (set_all semantics, like
+        FeatureStore.load); ``delta`` applies on top."""
+        path, kind = req["path"], req.get("kind", "base")
+        with self._mut_lock:
+            parts = self._checkpoint_parts(path, kind)
+            if kind == "base":
+                if parts:
+                    keys = np.concatenate([k for k, _ in parts])
+                    vals = {f: np.concatenate([v[f] for _, v in parts])
+                            for f in _FIELDS}
+                    order = np.argsort(keys, kind="stable")
+                    self.store.set_all(keys[order],
+                                       {f: v[order]
+                                        for f, v in vals.items()})
+                else:
+                    self.store.reset()
+            else:
+                for keys, vals in parts:
+                    self.store.push_from_pass(keys, vals)
+        return int(self.store.num_features)
+
+    def handle_reset(self, req) -> bool:
+        with self._mut_lock:
+            self.store.reset()
+        return True
+
+    def handle_shrink(self, req) -> int:
+        with self._mut_lock:
+            return self.store.shrink(min_show=req.get("min_show", 0.0))
+
+    def handle_stats(self, req) -> Dict[str, int]:
+        return {"num_features": int(self.store.num_features),
+                "index": int(self.index),
+                "world": int(self.ranges.world)}
+
+    def handle_stop(self, req) -> bool:
+        self._running = False
+        return True
+
+
+class ShardClient:
+    """One host's client handle to a peer shard server (a thin
+    FramedRPCConn wrapper declaring the idempotent reads)."""
+
+    def __init__(self, endpoint: str, *, timeout: float = 60.0):
+        self.endpoint = endpoint
+        self._conn = rpc.FramedRPCConn(
+            endpoint, timeout=timeout, service_name="shard",
+            idempotent=("pull", "pull_range", "stats"))
+
+    def call(self, method: str, **kw):
+        return self._conn.call(method, **kw)
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def start_local_shards(world: int, config: TableConfig, *, seed: int = 0
+                       ) -> Tuple[List[ShardServer], List[str]]:
+    """Loopback cluster on 127.0.0.1 ephemeral ports (tests / the
+    ``bench.py multihost`` loopback mode)."""
+    ranges = ShardRangeTable.for_world(world)
+    servers = [ShardServer("127.0.0.1:0", i, ranges, config, seed=seed)
+               for i in range(world)]
+    return servers, [s.endpoint for s in servers]
+
+
+def stop_shards(servers: List[ShardServer]) -> None:
+    for s in servers:
+        try:
+            s.stop()
+        except Exception as e:  # best-effort teardown
+            log.vlog(1, "shard stop failed: %s", e)

@@ -1,0 +1,277 @@
+"""Trainer-side multi-host store: the DCN half of the lookup exchange.
+
+:class:`MultiHostStore` presents the FeatureStore surface to
+:class:`~paddlebox_tpu.embedding.pass_engine.PassEngine`, so the
+existing trainer stack gains the cross-host tier WITHOUT touching the
+hot loop: within a host the jitted step keeps its ICI ``all_to_all``
+exchange over the device mesh (``embedding/lookup.py``); between hosts
+this store batches the whole pass's working set into ONE pull per peer
+at ``begin_pass`` and one push per peer at ``end_pass`` — the DCN-aware
+layout (DCN latency is paid per PASS, not per step, exactly like the
+reference's BuildPull-from-PS staging, ``ps_gpu_wrapper.cc:362``).
+
+The per-host payloads ride the ONE shared sort: pass keys arrive as the
+single sorted-unique array every tier already shares (the sorted-stream
+layout of PR 1/8); a stable argsort by owner makes each host's slice
+CONTIGUOUS in that order, and the same plan object is reused by the
+matching push (``_plan_for`` caches it), so the boundary pays one owner
+argsort per pass, not one per direction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import faults, monitor, trace
+from paddlebox_tpu.embedding.table import TableConfig
+from paddlebox_tpu.multihost import shard_service
+from paddlebox_tpu.multihost.keyrange import ShardRangeTable
+from paddlebox_tpu.multihost.shard_service import (ShardClient, decode_emb,
+                                                   encode_emb,
+                                                   payload_nbytes)
+
+
+class _OwnerPlan:
+    """One pass's owner split of the shared sorted key array: per-host
+    contiguous slices of ``order`` (stable argsort by owner, so keys
+    stay sorted WITHIN each slice)."""
+
+    def __init__(self, keys: np.ndarray, table: ShardRangeTable):
+        self.keys = keys
+        owner = table.owner_of(keys)
+        self.order = np.argsort(owner, kind="stable")
+        sorted_owner = owner[self.order]
+        starts = np.searchsorted(sorted_owner,
+                                 np.arange(table.world + 1))
+        self.slices: List[np.ndarray] = [
+            self.order[starts[i]:starts[i + 1]]
+            for i in range(table.world)]
+
+    def matches(self, keys: np.ndarray, world: int) -> bool:
+        return (len(self.slices) == world
+                and self.keys.shape == keys.shape
+                and np.array_equal(self.keys, keys))
+
+
+class MultiHostStore:
+    """FeatureStore-shaped client over the host-sharded shard servers."""
+
+    #: One backing cluster shared by every rank: day-end shrink and
+    #: checkpoint writes must run once (rank 0), like PSBackedStore.
+    shared = True
+
+    def __init__(self, config: TableConfig, endpoints: Sequence[str], *,
+                 ranges: Optional[ShardRangeTable] = None):
+        self.config = config
+        from paddlebox_tpu.embedding.optimizers import make_sparse_optimizer
+        self.opt = make_sparse_optimizer(config)
+        self.ranges = ranges or ShardRangeTable.for_world(len(endpoints))
+        if self.ranges.world != len(endpoints):
+            raise ValueError(
+                f"{len(endpoints)} endpoints != range table world "
+                f"{self.ranges.world}")
+        self.endpoints = list(endpoints)
+        self._clients = [ShardClient(e) for e in self.endpoints]
+        self._plan: Optional[_OwnerPlan] = None
+        self._plan_lock = threading.Lock()
+        monitor.set_gauge("multihost/world_size", float(self.ranges.world))
+
+    # -- topology ----------------------------------------------------------
+
+    @property
+    def world(self) -> int:
+        return self.ranges.world
+
+    def set_topology(self, endpoints: Sequence[str],
+                     ranges: ShardRangeTable) -> None:
+        """Adopt a resharded cluster (new membership generation). Old
+        connections close; the owner-plan cache is invalid by
+        construction (world changed)."""
+        if ranges.world != len(endpoints):
+            raise ValueError(
+                f"{len(endpoints)} endpoints != world {ranges.world}")
+        old = self._clients
+        self.endpoints = list(endpoints)
+        self.ranges = ranges
+        self._clients = [ShardClient(e) for e in self.endpoints]
+        with self._plan_lock:
+            self._plan = None
+        for c in old:
+            c.close()
+        monitor.set_gauge("multihost/world_size", float(ranges.world))
+
+    def _plan_for(self, keys: np.ndarray) -> _OwnerPlan:
+        """The ONE owner argsort per pass: the pull computes it, the
+        matching push (same shared sorted key array) reuses it."""
+        with self._plan_lock:
+            plan = self._plan
+            if plan is not None and plan.matches(keys, self.ranges.world):
+                return plan
+            plan = _OwnerPlan(keys, self.ranges)
+            self._plan = plan
+            return plan
+
+    def _fanout(self, work: List[Tuple[int, dict]], method: str) -> Dict:
+        """Issue one RPC per non-empty peer slice concurrently (the DCN
+        fan-out); raise the first error — a lost shard must fail the
+        pass loudly, never return garbage rows."""
+        results: Dict[int, object] = {}
+        errs: List[BaseException] = []
+
+        def run(host: int, kw: dict) -> None:
+            try:
+                results[host] = self._clients[host].call(method, **kw)
+            except BaseException as e:
+                errs.append(e)
+
+        if len(work) == 1:
+            run(*work[0])
+        else:
+            ts = [threading.Thread(target=run, args=(h, kw), daemon=True)
+                  for h, kw in work]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+        if errs:
+            raise errs[0]
+        return results
+
+    # -- pass build surface ------------------------------------------------
+
+    def pull_for_pass(self, pass_keys_sorted: np.ndarray
+                      ) -> Dict[str, np.ndarray]:
+        faults.faultpoint("multihost/shard_pull")
+        keys = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+        wire = shard_service.wire_mode()
+        plan = self._plan_for(keys)
+        n = keys.shape[0]
+        work = [(h, {"keys": keys[idx], "wire": wire})
+                for h, idx in enumerate(plan.slices) if idx.size]
+        if not work:
+            # Empty pass: preserve the FeatureStore contract of fully
+            # shaped (0, ...) field arrays.
+            return self._empty_rows()
+        with trace.span("multihost/shard_pull", keys=n,
+                        world=self.ranges.world):
+            results = self._fanout(work, "pull")
+        out: Optional[Dict[str, np.ndarray]] = None
+        rx_bytes = 0
+        for h, idx in enumerate(plan.slices):
+            if not idx.size:
+                continue
+            res = results[h]
+            rx_bytes += payload_nbytes(res)
+            res = dict(res)
+            res["emb"] = decode_emb(res)
+            for k in ("emb_f16", "emb_q", "emb_scale", "emb_width"):
+                res.pop(k, None)
+            if out is None:
+                out = {f: np.empty((n,) + v.shape[1:], v.dtype)
+                       for f, v in res.items()}
+            for f, v in res.items():
+                out[f][idx] = v
+        monitor.add("multihost/pull_keys", n)
+        monitor.add("multihost/pull_bytes", rx_bytes)
+        monitor.set_gauge(
+            "multihost/wire_bits",
+            {"f32": 32.0, "f16": 16.0, "int8": 8.0}[wire])
+        return out
+
+    def _empty_rows(self) -> Dict[str, np.ndarray]:
+        d = self.config.dim
+        ke = self.opt.emb_state_width(d)
+        kw = self.opt.w_state_width()
+        return {"emb": np.empty((0, d), np.float32),
+                "emb_state": np.empty((0, ke), np.float32),
+                "w": np.empty((0,), np.float32),
+                "w_state": np.empty((0, kw), np.float32),
+                "show": np.empty((0,), np.float32),
+                "click": np.empty((0,), np.float32)}
+
+    def push_from_pass(self, pass_keys_sorted: np.ndarray,
+                       values: Dict[str, np.ndarray]) -> None:
+        faults.faultpoint("multihost/shard_push")
+        keys = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+        wire = shard_service.wire_mode()
+        plan = self._plan_for(keys)
+        work = []
+        tx_bytes = 0
+        for h, idx in enumerate(plan.slices):
+            if not idx.size:
+                continue
+            vals = {f: v[idx] for f, v in values.items()}
+            payload = {f: v for f, v in vals.items() if f != "emb"}
+            payload.update(encode_emb(vals["emb"], wire))
+            tx_bytes += payload_nbytes(payload)
+            work.append((h, {"keys": keys[idx], "values": payload}))
+        with trace.span("multihost/shard_push", keys=int(keys.shape[0]),
+                        world=self.ranges.world):
+            if work:
+                self._fanout(work, "push")
+        monitor.add("multihost/push_keys", int(keys.shape[0]))
+        monitor.add("multihost/push_bytes", tx_bytes)
+
+    # -- size / maintenance ------------------------------------------------
+
+    @property
+    def num_features(self) -> int:
+        return int(sum(s["num_features"]
+                       for s in self._fanout(
+                           [(h, {}) for h in range(self.world)],
+                           "stats").values()))
+
+    def shrink(self, *, min_show: float = 0.0) -> int:
+        return int(sum(self._fanout(
+            [(h, {"min_show": min_show}) for h in range(self.world)],
+            "shrink").values()))
+
+    def reset(self) -> None:
+        """Pass-retry rollback surface: wipe every shard (the recovery
+        chain reload that follows re-filters rows by range)."""
+        self._fanout([(h, {}) for h in range(self.world)], "reset")
+        with self._plan_lock:
+            self._plan = None
+
+    # -- checkpoint surface ------------------------------------------------
+
+    def save_base(self, path: str) -> None:
+        self._fanout([(h, {"path": path, "mode": "base"})
+                      for h in range(self.world)], "save")
+        self._write_meta(path, "base")
+
+    def save_delta(self, path: str) -> None:
+        self._fanout([(h, {"path": path, "mode": "delta"})
+                      for h in range(self.world)], "save")
+        self._write_meta(path, "delta")
+
+    def save_xbox(self, path: str) -> int:
+        self._fanout([(h, {"path": path, "mode": "xbox"})
+                      for h in range(self.world)], "save")
+        self._write_meta(path, "xbox")
+        return self.num_features
+
+    def _write_meta(self, path: str, kind: str) -> None:
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(
+                path, f"{self.config.name}.multihost.json"), "w") as f:
+            json.dump({"world": self.world, "kind": kind,
+                       "table": self.config.name,
+                       "ranges": self.ranges.to_dict()}, f)
+
+    def load(self, path: str, kind: str = "base") -> None:
+        self._fanout([(h, {"path": path, "kind": kind})
+                      for h in range(self.world)], "load")
+
+    def stop_servers(self) -> None:
+        try:
+            self._fanout([(h, {}) for h in range(self.world)], "stop")
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()

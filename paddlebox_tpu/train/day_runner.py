@@ -47,7 +47,9 @@ class DayRunner:
                  min_show_shrink: float = 0.0,
                  save_xbox: bool = False,
                  pipeline_passes: bool = True,
-                 is_rank0: bool = True):
+                 is_rank0: bool = True,
+                 pass_boundary_hook: Optional[Callable[[str, int],
+                                                       None]] = None):
         self.trainer = trainer
         self.feed_config = feed_config
         self.data_root = data_root
@@ -66,6 +68,13 @@ class DayRunner:
         # ps_gpu_wrapper.cc:907).
         self.pipeline_passes = pipeline_passes
         self.is_rank0 = is_rank0
+        # Called after each pass's delta is PUBLISHED — the checkpointed
+        # boundary where cluster-topology events (the multihost elastic
+        # reshard, multihost/reshard.py) are safe: the hook's state
+        # transition is covered by recovery_chain(), and the hook owns
+        # its own rollback (a leaked transient here would re-enter the
+        # pass retry loop and replay an already-published pass).
+        self.pass_boundary_hook = pass_boundary_hook
         self.timers = timers.TimerGroup()
         # Pipelined next-pass preload in flight (train_day): the pass
         # retry path must be able to join + invalidate it, so the handle
@@ -403,6 +412,10 @@ class DayRunner:
                     self.trainer.engine.store.save_xbox(
                         self.ckpt.model_dir(day, pass_id))
                     self.ckpt.publish_xbox(day, pass_id)
+        if self.pass_boundary_hook is not None:
+            with trace.span("day/pass_boundary_hook", day=day,
+                            pass_id=pass_id):
+                self.pass_boundary_hook(day, pass_id)
         ds.clear()
         monitor.add("day_runner/passes", 1)
         # One report path: the day-loop timers land in the registry

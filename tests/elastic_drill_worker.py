@@ -44,11 +44,26 @@ def main() -> None:
     feed = DataFeedConfig(
         slots=tuple(SlotConf(s, avg_len=1.5) for s in SLOTS),
         batch_size=32)
+    table_cfg = TableConfig(name="emb", dim=8, learning_rate=0.1)
+    # PBX_MULTIHOST_WORLD=N: back the trainer with the multi-host shard
+    # tier (N loopback ShardServers + MultiHostStore) instead of the
+    # flat FeatureStore. Every elastic generation rebuilds the loopback
+    # cluster and recovers it from the SAME donefile chain — the
+    # world-agnostic hostshard reload is exactly what a real restarted
+    # host does after a membership change (MULTIHOST.md).
+    store = None
+    mh_world = int(os.environ.get("PBX_MULTIHOST_WORLD", "0"))
+    if mh_world:
+        from paddlebox_tpu.multihost import (MultiHostStore,
+                                             start_local_shards)
+        _servers, eps = start_local_shards(mh_world, table_cfg)
+        store = MultiHostStore(table_cfg, eps)
     trainer = CTRTrainer(
         DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
-        TableConfig(name="emb", dim=8, learning_rate=0.1), mesh=mesh,
+        table_cfg, mesh=mesh,
         config=TrainerConfig(dense_learning_rate=3e-3,
-                             auc_num_buckets=1 << 10))
+                             auc_num_buckets=1 << 10),
+        store=store)
     trainer.init(seed=0)
     runner = DayRunner(trainer, feed, out_dir, data_root=data_dir,
                        split_interval=60, split_per_pass=1,

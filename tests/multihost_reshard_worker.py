@@ -1,0 +1,138 @@
+"""Subprocess payload for the kill -9 mid-reshard drill
+(tests/test_multihost.py).
+
+Every invocation is one crash window of the reshard state machine
+(MULTIHOST.md): the cluster's ONLY durable state is the checkpoint
+chain, so a SIGKILL at any point must recover through
+``recovery_chain()`` with no lost and no double-applied rows — the
+layout-independent content digest this worker emits is the proof.
+
+Usage: multihost_reshard_worker.py <ckpt_root> <mode> [world]
+  seed          world-2 cluster, deterministic rows, save_base+publish,
+                digest -> <ckpt_root>/digest_seed.json
+  reshard W     load the chain into a world-2 cluster, reshard 2 -> W
+                (FLAGS_fault_spec may kill us mid-move), then digest ->
+                digest_reshard.json and save_base+publish the resharded
+                state as the next record
+  recover W     fresh world-W cluster, reset + recovery_chain reload,
+                digest -> digest_recover.json
+"""
+
+import json
+import os
+import sys
+import zlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+DAY = "20260801"
+N_KEYS = 4000
+DIM = 8
+
+
+def _digest(servers) -> dict:
+    """Layout-independent content digest: the union of every server's
+    rows, sorted by key — identical digests mean identical logical
+    table contents regardless of world size/placement (a duplicated or
+    lost row changes `rows` or a crc)."""
+    all_keys, all_emb, all_w = [], [], []
+    for s in servers:
+        keys, _ = s.store.key_stats()
+        if keys.size:
+            vals = s.store.pull_for_pass(np.sort(keys))
+            all_keys.append(np.sort(keys))
+            all_emb.append(vals["emb"])
+            all_w.append(vals["w"])
+    keys = (np.concatenate(all_keys) if all_keys
+            else np.empty((0,), np.uint64))
+    order = np.argsort(keys, kind="stable")
+    keys = keys[order]
+    emb = (np.concatenate(all_emb)[order] if all_keys
+           else np.empty((0, DIM), np.float32))
+    w = (np.concatenate(all_w)[order] if all_keys
+         else np.empty((0,), np.float32))
+    assert np.unique(keys).size == keys.size, "duplicated rows!"
+    return {"rows": int(keys.size),
+            "keys_crc": zlib.crc32(keys.tobytes()),
+            "emb_crc": zlib.crc32(emb.tobytes()),
+            "w_crc": zlib.crc32(w.tobytes())}
+
+
+def main() -> None:
+    root, mode = sys.argv[1], sys.argv[2]
+    world = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+
+    from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol
+    from paddlebox_tpu.core import faults
+    from paddlebox_tpu.embedding.table import TableConfig
+    from paddlebox_tpu.multihost import (MultiHostStore, execute_reshard,
+                                         start_local_shards, stop_shards)
+    from paddlebox_tpu.multihost.keyrange import ShardRangeTable
+
+    faults.init_from_flags()
+    cfg = TableConfig(name="emb", dim=DIM, learning_rate=0.1)
+    ckpt = CheckpointProtocol(root)
+
+    if mode == "seed":
+        servers, eps = start_local_shards(2, cfg)
+        store = MultiHostStore(cfg, eps)
+        rng = np.random.default_rng(7)
+        keys = np.unique(rng.integers(1, 1 << 50, size=N_KEYS + 64,
+                                      dtype=np.uint64))[:N_KEYS]
+        rows = store.pull_for_pass(keys)
+        rows["show"] += 1.0
+        store.push_from_pass(keys, rows)
+        mdir = ckpt.model_dir(DAY, 1)
+        store.save_delta(mdir)
+        ckpt.publish(DAY, 1)
+        out = _digest(servers)
+        stop_shards(servers)
+    elif mode == "reshard":
+        servers, eps = start_local_shards(2, cfg)
+        store = MultiHostStore(cfg, eps)
+        base, deltas = ckpt.recovery_chain()
+        if base is not None:
+            store.load(base.path, "base")
+        for d in deltas:
+            store.load(d.path, "delta")
+        joiners, jeps = [], []
+        for i in range(2, world):
+            s, e = start_local_shards(world, cfg)
+            joiners.append(s[i])
+            jeps.append(e[i])
+            stop_shards([srv for j, srv in enumerate(s) if j != i])
+        # The fault spec may SIGKILL us inside this call — that is the
+        # drill's crash window.
+        execute_reshard(eps, eps + jeps,
+                        old_ranges=ShardRangeTable.for_world(2),
+                        new_ranges=ShardRangeTable.for_world(world))
+        store.set_topology(eps + jeps, ShardRangeTable.for_world(world))
+        mdir = ckpt.model_dir(DAY, 2)
+        store.save_delta(mdir)
+        ckpt.publish(DAY, 2)
+        out = _digest(servers + joiners)
+        stop_shards(servers + joiners)
+    elif mode == "recover":
+        servers, eps = start_local_shards(world, cfg)
+        store = MultiHostStore(cfg, eps)
+        store.reset()
+        base, deltas = ckpt.recovery_chain()
+        if base is not None:
+            store.load(base.path, "base")
+        for d in deltas:
+            store.load(d.path, "delta")
+        out = _digest(servers)
+        stop_shards(servers)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+    path = os.path.join(root, f"digest_{mode}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(out, f)
+    os.replace(path + ".tmp", path)
+
+
+if __name__ == "__main__":
+    main()

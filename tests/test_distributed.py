@@ -53,6 +53,64 @@ def test_filestore_kv_barrier_allgather(tmp_path):
     assert stores[0].get("k2") == b"v2"
 
 
+def test_filestore_chunked_large_value_roundtrip(tmp_path):
+    """A set() payload above FLAGS_filestore_chunk_bytes must split
+    into chunk files behind a manifest and reassemble bit-identical on
+    get() — a multi-MB rank-table/gathered snapshot can't blow one
+    framed message or rename window. Sub-cap values stay single-file."""
+    from paddlebox_tpu.core import flags as flagmod
+    store = FileStore(str(tmp_path), 0, 1)
+    prev = flagmod.flag("filestore_chunk_bytes")
+    flagmod.set_flags({"filestore_chunk_bytes": 1024})
+    try:
+        blob = bytes(bytearray(range(256))) * 37  # 9472 B > cap, odd tail
+        store.set("big", blob)
+        assert store.get("big") == blob
+        # Manifest + ceil(9472/1024)=10 chunk files on disk.
+        import glob
+        assert len(glob.glob(str(tmp_path / "big.c*"))) == 10
+        # Small values do NOT chunk.
+        store.set("small", b"x" * 64)
+        assert not glob.glob(str(tmp_path / "small.c*"))
+        assert store.get("small") == b"x" * 64
+        # Overwrite with a new size re-publishes consistently.
+        blob2 = b"y" * 2000
+        store.set("big", blob2)
+        assert store.get("big") == blob2
+        # A literal value that happens to start with the manifest magic
+        # must round-trip (escaped through the chunked path).
+        tricky = FileStore._CHUNK_MAGIC + b"not-a-manifest"
+        store.set("tricky", tricky)
+        assert store.get("tricky") == tricky
+    finally:
+        flagmod.set_flags({"filestore_chunk_bytes": prev})
+
+
+def test_filestore_chunked_all_gather(tmp_path):
+    """all_gather rides the same set/get, so >cap payloads gather
+    transparently."""
+    from paddlebox_tpu.core import flags as flagmod
+    stores = [FileStore(str(tmp_path), r, 2) for r in range(2)]
+    prev = flagmod.flag("filestore_chunk_bytes")
+    flagmod.set_flags({"filestore_chunk_bytes": 512})
+    try:
+        blobs = [bytes([r]) * 1500 for r in range(2)]
+        results = [None] * 2
+
+        def worker(r):
+            results[r] = stores[r].all_gather("gbig", blobs[r],
+                                              timeout=10)
+
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(2)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for r in range(2):
+            assert results[r] == blobs
+    finally:
+        flagmod.set_flags({"filestore_chunk_bytes": prev})
+
+
 def test_tcp_transport_exchange():
     ports = _free_ports(3)
     eps = [f"127.0.0.1:{p}" for p in ports]

@@ -55,8 +55,9 @@ def _write_day(root, day, hours, rows_per_split=96):
 
 
 def _spawn_host(host_id, elastic_dir, port, data, out, result, log_path, *,
-                min_hosts=1, max_hosts=2):
+                min_hosts=1, max_hosts=2, extra_env=None):
     env = dict(os.environ)
+    env.update(extra_env or {})
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)    # worker pins its own 1-device flag
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -188,19 +189,29 @@ def test_join_host_mid_day_scales_out_and_finishes(tmp_path):
 
 
 @pytest.mark.slow
-def test_kill_worker_mid_day_recovers_and_finishes(tmp_path):
+@pytest.mark.parametrize("multihost", [False, True],
+                         ids=["flat", "multihost"])
+def test_kill_worker_mid_day_recovers_and_finishes(tmp_path, multihost):
+    """``multihost``: the same kill drill with the trainer backed by
+    the 2-shard multi-host tier (PBX_MULTIHOST_WORLD — every elastic
+    generation rebuilds its loopback cluster and recovers it from the
+    shared donefile chain); loss parity against the flat single-host
+    reference run pins the tier end to end under real SIGKILL."""
     data = str(tmp_path / "data")
     out = str(tmp_path / "out")
     elastic = str(tmp_path / "elastic")
     result = str(tmp_path / "result.json")
     _write_day(data, DAY, range(6))
     os.makedirs(out, exist_ok=True)
+    extra_env = {"PBX_MULTIHOST_WORLD": "2"} if multihost else None
 
     port = _free_port()
     host_a = _spawn_host("hostA", elastic, port, data, out, result,
-                         str(tmp_path / "hostA.log"))
+                         str(tmp_path / "hostA.log"),
+                         extra_env=extra_env)
     host_b = _spawn_host("hostB", elastic, port, data, out, result,
-                         str(tmp_path / "hostB.log"))
+                         str(tmp_path / "hostB.log"),
+                         extra_env=extra_env)
     killed = False
     try:
         # Wait until training is underway (first delta published), then

@@ -1,13 +1,16 @@
 """FLAGS_embedding_exchange_dtype: reduced-precision all_to_all wire.
 
-The pull-reply and push-grad payloads may cross the ICI as bf16
-(EQuARX-style quantized exchange — PAPERS.md) while every accumulation
-stays f32: grads merge sender-side in f32 (the bucket scatter-add),
-ride the wire in bf16, and widen back before the owner-side
-accumulate. Pins: (1) 'f32' is BIT-identical to the pre-flag behavior
-(the cast path must be a no-op, not a f32->f32 convert), (2) 'bf16'
-matches within bf16 tolerance, (3) the exchange-bytes observable
-reflects the halved payload, (4) unknown values fail loudly.
+The pull-reply and push-grad payloads may cross the ICI as bf16 or as
+int8 with per-block f32 scales (EQuARX-style quantized exchange —
+PAPERS.md; codec in multihost/quant.py) while every accumulation stays
+f32: grads merge sender-side in f32 (the bucket scatter-add), ride the
+wire reduced, and widen back before the owner-side accumulate. Pins:
+(1) 'f32' is BIT-identical to the pre-flag behavior (the cast path
+must be a no-op, not a f32->f32 convert), (2) 'bf16' matches within
+bf16 tolerance and 'int8' within the per-block quantization bound,
+(3) the exchange-bytes observable reflects the halved/quartered
+payload (int8 counts its scale sidecar), (4) unknown values fail
+loudly.
 """
 
 import numpy as np
@@ -108,6 +111,56 @@ def test_exchange_bytes_tracks_wire_dtype():
     # the ratio sits strictly between 0.5 and 1, near 0.5 at this width.
     ratio = b_bf16 / b_f32
     assert 0.5 < ratio < 0.62, ratio
+
+
+def test_int8_wire_parity_within_tolerance():
+    """Per-block int8: error per value is bounded by the block's
+    absmax / 254 on the wire; the pulled values and one pushed update
+    stay within that envelope while the table/accumulation never leave
+    f32."""
+    emb_f, w_f, pushed_f = _pull_push("f32")
+    emb_i, w_i, pushed_i = _pull_push("int8")
+    np.testing.assert_allclose(emb_i, emb_f, rtol=4e-2, atol=4e-2)
+    np.testing.assert_allclose(w_i, w_f, rtol=4e-2, atol=4e-2)
+    for f in pushed_f:
+        np.testing.assert_allclose(
+            pushed_i[f], pushed_f[f], rtol=5e-2, atol=1.5e-1,
+            err_msg=f"field {f}")
+    # ...and the quantization actually happened.
+    assert not np.array_equal(emb_i, emb_f)
+
+
+def test_exchange_bytes_int8_below_bf16():
+    """The byte accounting must reflect the quartered payload plus the
+    f32 scale sidecar: int8 < bf16 < f32, and int8's payload half sits
+    near a quarter of f32's (scales add < 1 f32 per `block` values)."""
+    table, _, rows, _, _ = _setup()
+    n = int(rows.shape[0])
+    prev = flagmod.flag("embedding_exchange_dtype")
+    try:
+        sizes = {}
+        for mode in ("f32", "bf16", "int8"):
+            flagmod.set_flags({"embedding_exchange_dtype": mode})
+            sizes[mode] = exchange_bytes(table, n)
+    finally:
+        flagmod.set_flags({"embedding_exchange_dtype": prev})
+    assert sizes["int8"] < sizes["bf16"] < sizes["f32"]
+    # Row exchanges stay int32, so the total ratio sits strictly above
+    # the pure-payload 1/4 but below bf16's.
+    assert 0.25 < sizes["int8"] / sizes["f32"] < 0.5
+
+
+def test_int8_wire_bits_recorded():
+    from paddlebox_tpu.core import monitor
+    from paddlebox_tpu.embedding.lookup import record_exchange_stats
+    table, _, rows, _, _ = _setup()
+    prev = flagmod.flag("embedding_exchange_dtype")
+    try:
+        flagmod.set_flags({"embedding_exchange_dtype": "int8"})
+        record_exchange_stats([table], [int(rows.shape[0])], [None])
+    finally:
+        flagmod.set_flags({"embedding_exchange_dtype": prev})
+    assert monitor.GLOBAL.get_gauge("lookup/wire_bits") == 8.0
 
 
 def test_unknown_exchange_dtype_raises():

@@ -1,0 +1,240 @@
+"""End-to-end CTR training on the multi-host shard tier.
+
+The 2-host loopback drill from the acceptance bar (MULTIHOST.md):
+
+- a full DayRunner day with the trainer backed by a 2-host
+  MultiHostStore is BIT-identical to the single-host FeatureStore run
+  on the f32 wire — per-pass losses, final dense params, and final
+  store contents;
+- a mid-day elastic reshard (2 → 3 after pass 1's boundary, 3 → 2
+  after pass 2's) driven through the pass-boundary hook leaves the
+  final state bit-identical to an unresized run at the same data
+  order, with per-row move counts matching the minimal-transfer plan.
+"""
+
+import os
+
+import jax
+import numpy as np
+
+from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol
+from paddlebox_tpu.data import DataFeedConfig, SlotConf
+from paddlebox_tpu.embedding import TableConfig
+from paddlebox_tpu.embedding.store import _FIELDS
+from paddlebox_tpu.launch.elastic import RankTable
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.multihost import (MultiHostStore, ShardRangeTable,
+                                     rows_moved_minimal,
+                                     start_local_shards, stop_shards)
+from paddlebox_tpu.multihost.reshard import ElasticReshardController
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+from paddlebox_tpu.train.day_runner import DayRunner
+
+SLOTS = ("user", "item")
+DAY = "20260801"
+
+
+def _write_day(root, rows_per_split=96):
+    rng = np.random.default_rng(int(DAY))
+    for h in range(3):
+        d = os.path.join(root, DAY, f"{h:02d}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "part-00000"), "w") as f:
+            for _ in range(rows_per_split):
+                feats = {s: rng.integers(1, 120, rng.integers(1, 3))
+                         for s in SLOTS}
+                click = np.mean([(int(v) % 5 == 0)
+                                 for vs in feats.values() for v in vs])
+                label = int(rng.random() < 0.1 + 0.8 * click)
+                toks = " ".join(f"{s}:{v}" for s, vs in feats.items()
+                                for v in vs)
+                f.write(f"{label} {toks}\n")
+
+
+def _make_runner(data, out, store=None, hook=None):
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.5) for s in SLOTS),
+        batch_size=32)
+    trainer = CTRTrainer(
+        DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
+        TableConfig(name="emb", dim=8, learning_rate=0.1), mesh=mesh,
+        config=TrainerConfig(dense_learning_rate=3e-3,
+                             auc_num_buckets=1 << 10),
+        store=store)
+    trainer.init(seed=0)
+    # pipeline_passes=False: the reshard hook mutates shard placement at
+    # the boundary, so the next pass's build must not be pulling
+    # concurrently (MULTIHOST.md "boundary quiescence").
+    return DayRunner(trainer, feed, out, data_root=data,
+                     split_interval=60, split_per_pass=1,
+                     hours=[0, 1, 2], num_reader_threads=1,
+                     pipeline_passes=False, pass_boundary_hook=hook)
+
+
+def _store_rows(store, keys):
+    return store.pull_for_pass(np.sort(np.asarray(keys, np.uint64)))
+
+
+def _assert_same_run(stats_a, stats_b, runner_a, runner_b, keys):
+    assert len(stats_a) == len(stats_b) == 3
+    for sa, sb in zip(stats_a, stats_b):
+        np.testing.assert_array_equal(sa["loss"], sb["loss"])
+        np.testing.assert_array_equal(sa["auc"], sb["auc"])
+    for la, lb in zip(
+            jax.tree_util.tree_leaves(runner_a.trainer.params),
+            jax.tree_util.tree_leaves(runner_b.trainer.params)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    rows_a = _store_rows(runner_a.trainer.engine.store, keys)
+    rows_b = _store_rows(runner_b.trainer.engine.store, keys)
+    for f in _FIELDS:
+        np.testing.assert_array_equal(rows_a[f], rows_b[f], err_msg=f)
+
+
+def test_two_host_day_bit_identical_to_single_host(tmp_path):
+    data = str(tmp_path / "data")
+    _write_day(data)
+
+    flat_runner = _make_runner(data, str(tmp_path / "out_flat"))
+    flat_stats = flat_runner.train_day(DAY)
+
+    servers, eps = start_local_shards(2, TableConfig(
+        name="emb", dim=8, learning_rate=0.1))
+    try:
+        mh_store = MultiHostStore(TableConfig(
+            name="emb", dim=8, learning_rate=0.1), eps)
+        mh_runner = _make_runner(data, str(tmp_path / "out_mh"),
+                                 store=mh_store)
+        mh_stats = mh_runner.train_day(DAY)
+        keys, _ = flat_runner.trainer.engine.store.key_stats()
+        assert keys.size > 0
+        assert mh_store.num_features == keys.size
+        _assert_same_run(flat_stats, mh_stats, flat_runner, mh_runner,
+                         keys)
+    finally:
+        stop_shards(servers)
+
+
+def test_two_host_day_int8_wire_auc_parity(tmp_path):
+    """The quantized DCN wire (documented tolerance, MULTIHOST.md):
+    a 2-host day at multihost_wire_dtype=int8 must track the exact-run
+    losses closely and land the same AUC within quantization noise —
+    the EQuARX negligible-quality-loss claim at training level."""
+    from paddlebox_tpu.core import flags as flagmod
+
+    data = str(tmp_path / "data")
+    _write_day(data, rows_per_split=192)
+
+    flat_runner = _make_runner(data, str(tmp_path / "out_flat"))
+    flat_stats = flat_runner.train_day(DAY)
+
+    servers, eps = start_local_shards(2, TableConfig(
+        name="emb", dim=8, learning_rate=0.1))
+    prev = flagmod.flag("multihost_wire_dtype")
+    flagmod.set_flags({"multihost_wire_dtype": "int8"})
+    try:
+        store = MultiHostStore(TableConfig(
+            name="emb", dim=8, learning_rate=0.1), eps)
+        runner = _make_runner(data, str(tmp_path / "out_i8"),
+                              store=store)
+        stats = runner.train_day(DAY)
+    finally:
+        flagmod.set_flags({"multihost_wire_dtype": prev})
+        stop_shards(servers)
+    assert len(stats) == len(flat_stats) == 3
+    for sa, sb in zip(stats, flat_stats):
+        np.testing.assert_allclose(sa["loss"], sb["loss"],
+                                   rtol=2e-2, atol=2e-2)
+        assert abs(sa["auc"] - sb["auc"]) < 2e-2
+    # ...and the wire really quantized (states diverge somewhere).
+    keys, _ = flat_runner.trainer.engine.store.key_stats()
+    ra = _store_rows(runner.trainer.engine.store, keys)
+    rb = _store_rows(flat_runner.trainer.engine.store, keys)
+    assert not np.array_equal(ra["emb"], rb["emb"])
+    np.testing.assert_allclose(ra["emb"], rb["emb"], rtol=5e-2,
+                               atol=5e-2)
+
+
+def test_mid_day_reshard_bit_identical_to_unresized(tmp_path):
+    data = str(tmp_path / "data")
+    _write_day(data)
+    cfg = TableConfig(name="emb", dim=8, learning_rate=0.1)
+
+    # Baseline: 2-host day, never resharded.
+    base_servers, base_eps = start_local_shards(2, cfg)
+    try:
+        base_store = MultiHostStore(cfg, base_eps)
+        base_runner = _make_runner(data, str(tmp_path / "out_base"),
+                                   store=base_store)
+        base_stats = base_runner.train_day(DAY)
+    finally:
+        stop_shards(base_servers)
+
+    # Resharding run: join after pass 1's boundary, leave after pass 2's.
+    servers, eps = start_local_shards(2, cfg)
+    j3, je3 = start_local_shards(3, cfg)
+    joiner, jep = j3[2], je3[2]
+    stop_shards([j3[0], j3[1]])
+    try:
+        store = MultiHostStore(cfg, eps)
+        out = str(tmp_path / "out_rs")
+        meta2 = {"a": {"shard_endpoint": eps[0]},
+                 "b": {"shard_endpoint": eps[1]}}
+        meta3 = dict(meta2, c={"shard_endpoint": jep})
+        tables = {"t": RankTable(generation=0, hosts=["a", "b"],
+                                 meta=meta2)}
+        ctl = ElasticReshardController(store, CheckpointProtocol(out),
+                                       table_fn=lambda: tables["t"])
+        moved = []
+
+        def resident_keys():
+            ks = [s.store.key_stats()[0] for s in servers + [joiner]]
+            ks = [k for k in ks if k.size]
+            return (np.concatenate(ks) if ks
+                    else np.empty((0,), np.uint64))
+
+        def hook(day, pass_id):
+            rk = resident_keys()
+            rec = ctl.maybe_apply(day, pass_id)
+            if rec is not None:
+                # Per-row move count == the minimal-transfer bound for
+                # the keys resident at THIS boundary.
+                expect = rows_moved_minimal(
+                    ShardRangeTable.for_world(rec["old_world"]),
+                    ShardRangeTable.for_world(rec["new_world"]), rk)
+                assert rec["moved_rows"] == expect
+                moved.append(rec)
+            # Script the NEXT boundary's membership: grow after pass 1,
+            # shrink back after pass 2.
+            if pass_id == 1:
+                tables["t"] = RankTable(generation=1,
+                                        hosts=["a", "b", "c"],
+                                        meta=meta3)
+            elif pass_id == 2:
+                tables["t"] = RankTable(generation=2, hosts=["a", "b"],
+                                        meta=meta2)
+
+        runner = _make_runner(data, out, store=store, hook=hook)
+        stats = runner.train_day(DAY)
+
+        # Both resizes ran (audited per-row inside the hook).
+        assert [m["new_world"] for m in moved] == [3, 2]
+        for m in moved:
+            assert m["moved_rows"] == sum(m["segment_rows"]) > 0
+        # After the final 3->2, the joiner is fully drained and every
+        # surviving server holds only its world-2 range.
+        t2 = ShardRangeTable.for_world(2)
+        jk, _ = joiner.store.key_stats()
+        assert jk.size == 0
+        all_keys = []
+        for i, s in enumerate(servers):
+            sk, _ = s.store.key_stats()
+            assert (t2.owner_of(sk) == i).all()
+            all_keys.append(sk)
+        keys = np.sort(np.concatenate(all_keys))
+
+        _assert_same_run(base_stats, stats, base_runner, runner, keys)
+    finally:
+        stop_shards(servers)
+        joiner.stop()

@@ -206,6 +206,50 @@ def test_ingest_keys_direction_and_gating(tmp_path):
                            "--baseline", b]) == 1
 
 
+def test_multihost_keys_direction_and_gating(tmp_path):
+    """bench.py multihost keys: exchange rates gate higher-better (the
+    `_per_s` suffix must win over the lower-better `_bytes`/`_s`
+    suffixes inside the same segment), reshard_ms gates lower-better,
+    and the moved-row count is provenance (never gated)."""
+    base = {"metric": "multihost_2host_exchange_keys_per_sec",
+            "value": 2.9e6,
+            "hosts": 2,
+            "wire": {"f32": {"cross_host_exchange_bytes_per_s": 2.4e8,
+                             "exchange_keys_per_s": 2.9e6,
+                             "pull_ms": 7.0, "push_ms": 6.6},
+                     "int8": {"cross_host_exchange_bytes_per_s": 3.1e7,
+                              "exchange_keys_per_s": 8.0e5}},
+            "reshard_ms": 13.0,
+            "reshard_rows_per_s": 7.6e5,
+            "reshard_moved_rows": 10036,
+            "reshard_minimal_frac": 1.0}
+    assert perf_gate.direction(
+        "wire.f32.cross_host_exchange_bytes_per_s") == 1
+    assert perf_gate.direction("wire.int8.exchange_keys_per_s") == 1
+    assert perf_gate.direction("reshard_ms") == -1
+    assert perf_gate.direction("reshard_rows_per_s") == 1
+    assert perf_gate.direction("reshard_moved_rows") == 0
+    assert perf_gate.direction("wire.f32.pull_ms") == -1
+
+    bad = copy.deepcopy(base)
+    bad["wire"]["f32"]["cross_host_exchange_bytes_per_s"] *= 0.4
+    bad["reshard_ms"] = 120.0
+    bad["reshard_moved_rows"] = 1  # provenance swing: must not gate
+    rep = _write(tmp_path, "mh_rep.json", bad)
+    b = _write(tmp_path, "mh_base.json", base)
+    assert perf_gate.main([rep, "--baseline", b]) == 1
+    _, regs = perf_gate.compare(bad, base)
+    names = {r["metric"] for r in regs}
+    assert "wire.f32.cross_host_exchange_bytes_per_s" in names
+    assert "reshard_ms" in names
+    assert "reshard_moved_rows" not in names
+    # An int8-wire throughput IMPROVEMENT never trips.
+    good = copy.deepcopy(base)
+    good["wire"]["int8"]["exchange_keys_per_s"] *= 3.0
+    _, regs = perf_gate.compare(good, base)
+    assert not regs
+
+
 def test_serve_client_keys_direction_and_gating(tmp_path):
     """Round-14 serving keys: the concurrent-client wire-mode record
     (`bench.py serve --clients N`) gates throughput_rps / rows_per_s /

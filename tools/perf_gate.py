@@ -193,6 +193,17 @@ def smoke() -> int:
             "clients": {"c32": {"throughput_rps": 4000.0,
                                 "predict_p99_ms": 12.0,
                                 "batch_fill_frac": 0.8}},
+            # bench multihost --hosts keys (r15 multi-host tier):
+            # *_bytes_per_s / *_keys_per_s gate higher-better through
+            # "_per_s" (checked BEFORE the lower-better "_bytes"/"_s"
+            # suffixes), reshard_ms lower-better; reshard_moved_rows
+            # is workload provenance and must NOT gate.
+            "wire": {"f32": {"cross_host_exchange_bytes_per_s": 2.4e8,
+                             "exchange_keys_per_s": 2.9e6,
+                             "pull_ms": 7.0, "push_ms": 6.6}},
+            "reshard_ms": 13.0,
+            "reshard_rows_per_s": 7.6e5,
+            "reshard_moved_rows": 10036,
             "steps_per_dispatch": 4,        # not gated (count)
             "ingest_workers": 8,            # not gated (count)
             "store_build_native": True,     # not gated (bool)
@@ -227,15 +238,21 @@ def smoke() -> int:
     bad["clients"]["c32"]["batch_fill_frac"] = 0.2
     bad["ingest_workers"] = 1          # provenance: must NOT gate
     bad["store_build_native"] = False  # provenance: must NOT gate
+    bad["wire"]["f32"]["cross_host_exchange_bytes_per_s"] *= 0.3
+    bad["reshard_ms"] = 200.0
+    bad["reshard_moved_rows"] = 99999  # provenance: must NOT gate
     _, regs = compare(bad, base)
     names = {r["metric"] for r in regs}
     for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
                  "bottleneck.device_idle_frac", "ingest_rows_per_s",
                  "store_build_keys_per_s", "clients.c32.throughput_rps",
-                 "clients.c32.batch_fill_frac"):
+                 "clients.c32.batch_fill_frac",
+                 "wire.f32.cross_host_exchange_bytes_per_s",
+                 "reshard_ms"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
-    for never in ("ingest_workers", "store_build_native"):
+    for never in ("ingest_workers", "store_build_native",
+                  "reshard_moved_rows"):
         expect(f"provenance {never!r} not gated", never in names, False)
     # An IMPROVEMENT must never trip the gate.
     good = json.loads(json.dumps(base))
