@@ -1089,6 +1089,13 @@ if _SMALL:
 # Parsed from --clients by main(): comma-separated client counts for the
 # concurrent wire-mode serving bench ("" = skip the wire section).
 SERVE_CLIENTS = ""
+# `bench.py serve --replicas 1,2` fleet axis ("" = skip): fresh fleet
+# (R PredictServers + FleetRouter) per count over ONE shared predictor
+# (the CPU-honest stand-in for R hosts: per-replica batchers/sockets/
+# stats are real, the device table is shared so the axis measures the
+# routing+coalescing overhead, not R copies of HBM).
+SERVE_REPLICAS = ""
+SERVE_FLEET_CLIENTS_PER_REPLICA = 4
 
 
 def _serve_client_lines(rng, n_requests: int):
@@ -1196,6 +1203,88 @@ def _bench_serve_clients(pred, clients: list) -> dict:
     return out
 
 
+def _bench_serve_fleet(pred, replicas: list) -> dict:
+    """Fleet axis: R replica servers behind one FleetRouter, hammered
+    by 4 clients per replica for a fixed window. Fresh fleet per count
+    (per-replica instance registries + a fresh router latency digest
+    belong to that run alone); records aggregate throughput_rps,
+    per-replica batch fill, router route_ms p50/p99, and the
+    degraded-path share — the keys tools/perf_gate.py gates."""
+    import threading
+
+    from paddlebox_tpu.serving.router import FleetRouter
+    from paddlebox_tpu.serving.service import PredictClient, PredictServer
+
+    out = {}
+    for n_rep in replicas:
+        _tick(f"serving:replicas{n_rep}")
+        n_cli = max(int(SERVE_FLEET_CLIENTS_PER_REPLICA) * n_rep, 1)
+        servers = [PredictServer("127.0.0.1:0", pred,
+                                 replica_id=f"bench-r{i}")
+                   for i in range(n_rep)]
+        router = FleetRouter("127.0.0.1:0",
+                             replicas=[s.endpoint for s in servers],
+                             start_health=False)
+        rng = np.random.default_rng(4321 + n_rep)
+        lines = [_serve_client_lines(rng, 8) for _ in range(n_cli)]
+        done = [0] * n_cli
+        stop = threading.Event()
+        start = threading.Barrier(n_cli + 1)
+
+        def run(i):
+            cli = PredictClient(router.endpoint)
+            ok = True
+            try:
+                cli.predict(lines[i][0])  # warm outside the window
+            except Exception as e:
+                ok = False
+                print(f"fleet client {i} warmup failed: {e!r}",
+                      file=sys.stderr)
+            start.wait()
+            try:
+                j = 0
+                while ok and not stop.is_set():
+                    cli.predict(lines[i][j % len(lines[i])])
+                    done[i] += 1
+                    j += 1
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                   for i in range(n_cli)]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        time.sleep(SERVE_CLIENT_SECONDS)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        dt = time.perf_counter() - t0
+        stats_cli = PredictClient(router.endpoint)
+        st = stats_cli.stats()
+        stats_cli.close()
+        router.stop()
+        for s in servers:
+            s.stop()
+        n_req = sum(done)
+        fills = [b["stats"]["batch_fill_frac"]
+                 for b in st["replicas"].values()]
+        out[f"r{n_rep}"] = {
+            "throughput_rps": round(n_req / dt, 1),
+            "rows_per_s": round(n_req * SERVE_REQ_ROWS / dt, 1),
+            "route_ms_quantiles": {"p50": st["route_ms"]["p50"],
+                                   "p99": st["route_ms"]["p99"]},
+            "batch_fill_frac": round(
+                sum(fills) / max(len(fills), 1), 4),
+            "degraded_frac": round(
+                st["degraded_rpcs"] / max(st["predict_rpcs"], 1), 4),
+            "clients": n_cli,
+            "requests": n_req,
+        }
+    return out
+
+
 def bench_serving() -> dict:
     import jax
 
@@ -1283,6 +1372,30 @@ def bench_serving() -> dict:
     if SERVE_CLIENTS:
         clients = [int(c) for c in SERVE_CLIENTS.split(",") if c.strip()]
         out["clients"] = _bench_serve_clients(pred, clients)
+    if SERVE_REPLICAS:
+        # The --clients warmup above (when present) already compiled
+        # the pow2 ladder; compile it here if fleet mode runs alone.
+        replicas = [int(r) for r in SERVE_REPLICAS.split(",")
+                    if r.strip()]
+        if not SERVE_CLIENTS:
+            from paddlebox_tpu.core import flags as flagmod
+            from paddlebox_tpu.data.parser import parse_lines as _pl
+            from paddlebox_tpu.serving.batcher import (pack_bucketed,
+                                                       pow2_bucket)
+            wrng = np.random.default_rng(7)
+            max_rows = min(
+                max(replicas) * SERVE_FLEET_CLIENTS_PER_REPLICA
+                * SERVE_REQ_ROWS,
+                int(flagmod.flag("serving_batch_max_rows")))
+            warm_lines = _serve_client_lines(wrng, 1)[0]
+            b = pow2_bucket(SERVE_REQ_ROWS)
+            while True:
+                ins = _pl(warm_lines * (b // SERVE_REQ_ROWS), pred.feed)
+                pred.predict(pack_bucketed(ins, pred.feed))
+                if b >= pow2_bucket(max_rows):
+                    break
+                b *= 2
+        out["replicas"] = _bench_serve_fleet(pred, replicas)
     return out
 
 
@@ -1491,11 +1604,15 @@ def _preflight_gather_kernel(n: int, dim: int, pass_keys: int) -> None:
 
 
 def main() -> None:
-    global SERVE_CLIENTS, MULTIHOST_HOSTS
+    global SERVE_CLIENTS, SERVE_REPLICAS, MULTIHOST_HOSTS
     argv = list(sys.argv[1:])
     if "--clients" in argv:
         i = argv.index("--clients")
         SERVE_CLIENTS = argv[i + 1] if i + 1 < len(argv) else "1,8,32"
+        del argv[i:i + 2]
+    if "--replicas" in argv:
+        i = argv.index("--replicas")
+        SERVE_REPLICAS = argv[i + 1] if i + 1 < len(argv) else "1,2"
         del argv[i:i + 2]
     if "--hosts" in argv:
         i = argv.index("--hosts")

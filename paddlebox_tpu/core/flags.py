@@ -492,6 +492,40 @@ define_flag("serving_rps_window_s", 30.0,
             "(computed from LogQuantileDigest.delta() counts over "
             "rotating window snapshots — an idle replica decays to 0 "
             "instead of reporting lifetime-average rate)")
+define_flag("fleet_vnodes", 64,
+            "virtual nodes per replica on the fleet router's consistent-"
+            "hash ring (serving/router.py): more vnodes = smoother key "
+            "spread and smaller remap on join/leave, at O(vnodes * "
+            "replicas) ring memory")
+define_flag("fleet_health_interval_s", 0.5,
+            "fleet router health-check cadence: the health thread polls "
+            "every replica's stats RPC this often, drives the SLO "
+            "admission window, and adopts elastic membership changes "
+            "(join/leave) between polls")
+define_flag("fleet_health_fails", 2,
+            "consecutive health-check failures before the fleet router "
+            "ejects a replica from the ring (a routed predict that hits "
+            "a dead connection re-routes immediately and counts one "
+            "strike — ejection never waits for a full predict to fail "
+            "this many times)")
+define_flag("fleet_spillover_inflight", 8,
+            "per-replica in-flight predict ceiling for hash-affinity "
+            "routing: past it the router spills the request to the "
+            "least-loaded healthy replica (cache affinity yields to "
+            "load under key skew); a replica whose SLO admission "
+            "tripped sheds its overflow to the degraded path instead")
+define_flag("fleet_slo_window_s", 5.0,
+            "SLO admission window of the fleet router: per-replica "
+            "slo/violations deltas are read per health poll and summed "
+            "over this window; tripping fleet_slo_trip within it moves "
+            "the replica to DEGRADED admission, and one clean window "
+            "restores it")
+define_flag("fleet_slo_trip", 3,
+            "slo/violations within one fleet_slo_window_s that trips a "
+            "replica into DEGRADED admission (its overflow beyond "
+            "fleet_spillover_inflight is served by the degraded "
+            "HBM-hot-rows-only path, flagged degraded=true, instead of "
+            "queueing)")
 define_flag("embedding_quant_block", 128,
             "values per scale block of the int8 exchange wires: both "
             "the single-host all_to_all payloads "

@@ -27,7 +27,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from typing import FrozenSet, Iterable, Optional
+from typing import Callable, FrozenSet, Iterable, Optional
 
 from paddlebox_tpu.core import faults, flags, log, monitor
 from paddlebox_tpu.distributed import wire
@@ -126,12 +126,21 @@ class FramedRPCConn:
 
     def __init__(self, endpoint: str, *, timeout: float = 60.0,
                  service_name: str = "rpc",
-                 idempotent: Iterable[str] = ()):
+                 idempotent: Iterable[str] = (),
+                 resolve: Optional[Callable[[str], str]] = None):
         self.endpoint = endpoint
         self._timeout = timeout
         self._idempotent: FrozenSet[str] = frozenset(idempotent)
         self._lock = threading.Lock()
         self._service = service_name
+        # Optional endpoint re-resolver, consulted BEFORE a reconnect:
+        # (current endpoint) -> endpoint to connect to. Lets a client
+        # whose server moved/died follow a control plane's topology
+        # (e.g. the serving fleet router's epoch) instead of retrying a
+        # fixed dead address until the deadline burns out. Exceptions
+        # from the resolver are the resolver's bug — it should return
+        # the current endpoint when it cannot do better.
+        self._resolve = resolve
         self._sock: Optional[socket.socket] = self._connect()
 
     def _connect(self) -> socket.socket:
@@ -142,6 +151,13 @@ class FramedRPCConn:
     def _call_once(self, method: str, kw) -> dict:
         faults.faultpoint("rpc/call")
         if self._sock is None:  # reconnect after a previous failure
+            if self._resolve is not None:
+                ep = self._resolve(self.endpoint)
+                if ep and ep != self.endpoint:
+                    monitor.add("rpc/reresolves", 1)
+                    log.vlog(0, "%s: endpoint re-resolved %s -> %s",
+                             self._service, self.endpoint, ep)
+                    self.endpoint = ep
             self._sock = self._connect()
             monitor.add("rpc/reconnects", 1)
         s = self._sock

@@ -54,6 +54,23 @@ class RankTable:
         return len(self.hosts)
 
 
+def read_rank_table(root: str) -> Optional[RankTable]:
+    """Read-only view of the published rank table under ``root`` (None
+    when missing/torn — the writer replaces atomically, so a parse
+    failure is a race, not corruption). Non-member observers use this
+    for discovery: the serving fleet router reads the per-host ``meta``
+    for ``serving_endpoint`` advertisements WITHOUT joining membership
+    itself, the same way shard clients rebuild their endpoint set from
+    ``shard_endpoint`` meta."""
+    try:
+        with open(os.path.join(root, "ranktable.json")) as f:
+            d = json.load(f)
+        return RankTable(generation=d["generation"], hosts=d["hosts"],
+                         meta=d.get("meta", {}))
+    except (OSError, ValueError, KeyError):
+        return None
+
+
 class ElasticManager:
     """Directory-lease membership + leader-published rank table."""
 
@@ -137,13 +154,7 @@ class ElasticManager:
         return os.path.join(self.root, "ranktable.json")
 
     def _read_table(self) -> Optional[RankTable]:
-        try:
-            with open(self._table_path()) as f:
-                d = json.load(f)
-            return RankTable(generation=d["generation"], hosts=d["hosts"],
-                             meta=d.get("meta", {}))
-        except (OSError, ValueError, KeyError):
-            return None
+        return read_rank_table(self.root)
 
     def _publish_table(self, hosts: List[str]) -> None:
         prev = self._read_table()

@@ -139,6 +139,32 @@ class ShardServer(rpc.FramedRPCServer):
         monitor.add("multihost/served_pull_keys", int(keys.size))
         return out
 
+    def handle_pull_serving(self, req) -> Dict[str, np.ndarray]:
+        """Serving-tier miss resolution: (found mask, w, wire-encoded
+        emb) for sorted unique keys in this shard's range. A PURE read
+        like ``pull`` — unseen keys are NOT inserted — but it also
+        reports which keys exist (serving must answer zeros for a
+        feasign training never saw, not the trainer's init row) and
+        ships ONLY the serving fields (emb + w), never optimizer state:
+        a replica's miss path reads a fraction of the bytes a trainer
+        pull moves."""
+        keys = np.asarray(req["keys"], np.uint64)
+        self._check_owned(keys)
+        found = self.store.contains(keys)
+        rows = self.store.pull_for_pass(keys)
+        emb = np.ascontiguousarray(rows["emb"], np.float32)
+        w = np.ascontiguousarray(rows["w"], np.float32)
+        if not found.all():
+            # Masked rows ship zeros (cheap to compress, and the client
+            # must not see init values for keys it will serve as
+            # unknown anyway).
+            emb[~found] = 0.0
+            w[~found] = 0.0
+        out: Dict[str, np.ndarray] = {"found": found, "w": w}
+        out.update(encode_emb(emb, req.get("wire", "f32")))
+        monitor.add("multihost/served_serving_keys", int(keys.size))
+        return out
+
     def handle_push(self, req) -> int:
         """EndPass write-back of full rows (emb decoded from the wire
         encoding to f32 BEFORE the store write)."""
@@ -289,7 +315,7 @@ class ShardClient:
         self.endpoint = endpoint
         self._conn = rpc.FramedRPCConn(
             endpoint, timeout=timeout, service_name="shard",
-            idempotent=("pull", "pull_range", "stats"))
+            idempotent=("pull", "pull_serving", "pull_range", "stats"))
 
     def call(self, method: str, **kw):
         return self._conn.call(method, **kw)

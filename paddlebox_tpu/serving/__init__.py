@@ -1,13 +1,20 @@
 from paddlebox_tpu.serving.batcher import MicroBatcher, pack_bucketed
+from paddlebox_tpu.serving.fleet import (Replica, ServingFleet,
+                                         ShardBackedStore, start_replica)
 from paddlebox_tpu.serving.predictor import (CTRPredictor,
+                                             GroupedCTRPredictor,
                                              ServingTierStore,
                                              load_delta_update,
+                                             load_grouped_export,
                                              load_serving_predictor,
                                              load_xbox_model)
 from paddlebox_tpu.serving.publisher import DonefilePublisher
+from paddlebox_tpu.serving.router import FleetRouter
 from paddlebox_tpu.serving.service import PredictClient, PredictServer
 
-__all__ = ["CTRPredictor", "DonefilePublisher", "MicroBatcher",
-           "PredictClient", "PredictServer", "ServingTierStore",
-           "load_delta_update", "load_serving_predictor",
-           "load_xbox_model", "pack_bucketed"]
+__all__ = ["CTRPredictor", "DonefilePublisher", "FleetRouter",
+           "GroupedCTRPredictor", "MicroBatcher", "PredictClient",
+           "PredictServer", "Replica", "ServingFleet",
+           "ServingTierStore", "ShardBackedStore", "load_delta_update",
+           "load_grouped_export", "load_serving_predictor",
+           "load_xbox_model", "pack_bucketed", "start_replica"]

@@ -86,9 +86,14 @@ class MicroBatcher:
     """The dispatcher: a bounded queue of pending requests + one thread
     draining them into single ragged device forwards."""
 
-    def __init__(self, predictor, *, name: str = "serving-batcher"):
+    def __init__(self, predictor, *, name: str = "serving-batcher",
+                 metrics=None):
         self._pred = predictor
         self._feed = predictor.feed
+        # Optional per-replica Monitor: fleet runs several replicas in
+        # one process, and per-replica batch/fill stats must not
+        # last-write-wins each other through the global registry.
+        self._metrics = metrics
         self._q: deque = deque()
         self._q_rows = 0
         self._cv = threading.Condition()
@@ -183,6 +188,10 @@ class MicroBatcher:
             monitor.add("serving/batch_requests", len(reqs))
             monitor.set_gauge("serving/batch_fill_frac",
                               len(all_ins) / max(bs, 1))
+            if self._metrics is not None:
+                self._metrics.add("serving/batches", 1)
+                self._metrics.set_gauge("serving/batch_fill_frac",
+                                        len(all_ins) / max(bs, 1))
             wait_anchor = t0
             for i, r in enumerate(reqs):
                 r.probs = probs[offsets[i]:offsets[i + 1]]

@@ -1,0 +1,620 @@
+"""Serving fleet state: replica registry, hash ring, SLO admission,
+and the shared shard-tier miss resolver.
+
+Role of the fleet half of the reference's online deployment (one AIBox
+inference tier = N workers over ONE sparse parameter service): this
+module owns everything about a fleet that is NOT a socket — the replica
+registry with its health/admission state machine, the consistent-hash
+ring that gives a user key a stable home replica, discovery through the
+elastic :class:`~paddlebox_tpu.launch.elastic.RankTable` heartbeat
+``meta`` (replicas advertise ``serving_endpoint`` exactly the way the
+multihost tier advertises ``shard_endpoint``), and the
+:class:`ShardBackedStore` pure-read resolver that lets every replica's
+warm/cold misses land on the SHARED ShardServer tier instead of a
+private disk shard — so the fleet serves one model out of one backing
+store and its aggregate hot set, not one replica's HBM, bounds the
+servable model ("Dissecting Embedding Bag Performance in DLRM
+Inference": the gather working set is what must live close, and N
+private copies of the cold tier buy nothing).
+
+The RPC front-end that drives this state lives in
+``serving/router.py``; tests drive :class:`ServingFleet` directly
+(``health_check_once`` / ``discover_once``) for determinism.
+
+Replica lifecycle (SERVING_FLEET.md has the full state machine)::
+
+    JOINING --stats ok--> HEALTHY --N check fails--> EJECTED
+     (warms first)          |  ^
+                            v  | clean window
+                  DEGRADED admission (slo/violations tripped)
+
+``EJECTED`` is terminal for a replica id; a restarted process registers
+under a fresh id (or the same id re-added by discovery after its
+endpoint answers again).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import faults, flags, log, monitor
+from paddlebox_tpu.distributed import rpc
+
+_SERVING = ("healthy", "degraded")   # states the ring routes to
+
+
+def stable_hash64(s: str) -> int:
+    """Process-stable 64-bit hash for ring placement (builtin ``hash``
+    is salted per process — two routers would disagree on the ring)."""
+    return int.from_bytes(
+        hashlib.blake2b(s.encode(), digest_size=8).digest(), "little")
+
+
+def route_key_hash(lines: Sequence[str]) -> int:
+    """The request's routing key: the FIRST feature token of the first
+    line (by convention the user slot leads the svm line, so one user's
+    requests share a home replica and its hot rows). Requests with no
+    parseable token hash the raw line — still deterministic."""
+    if not lines:
+        return 0
+    line = lines[0]
+    for tok in line.split():
+        if ":" in tok:
+            return stable_hash64(tok)
+    return stable_hash64(line)
+
+
+class HashRing:
+    """Consistent-hash ring over replica ids (vnode-replicated)."""
+
+    def __init__(self, ids: Sequence[str], vnodes: int):
+        points: List[Tuple[int, str]] = []
+        for rid in ids:
+            for v in range(max(int(vnodes), 1)):
+                points.append((stable_hash64(f"{rid}#{v}"), rid))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._ids = [r for _, r in points]
+
+    def lookup(self, key_hash: int) -> Optional[str]:
+        if not self._ids:
+            return None
+        i = bisect.bisect_right(self._hashes, int(key_hash))
+        return self._ids[i % len(self._ids)]
+
+
+class _ConnPool:
+    """Per-replica FramedRPCConn pool: router handler threads forward
+    concurrently, and one conn serializes its calls under a lock — a
+    pool keeps fan-in from queueing behind a single socket. Predict is
+    deliberately NOT declared idempotent on these conns: a dead replica
+    must surface immediately so the ROUTER re-routes, instead of the
+    conn burning its retry deadline reconnecting to a corpse."""
+
+    def __init__(self, endpoint: str, timeout: float):
+        self.endpoint = endpoint
+        self._timeout = timeout
+        self._free: List[rpc.FramedRPCConn] = []
+        self._lock = threading.Lock()
+
+    def acquire(self) -> rpc.FramedRPCConn:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        return rpc.FramedRPCConn(self.endpoint, timeout=self._timeout,
+                                 service_name="fleet-replica")
+
+    def release(self, conn: rpc.FramedRPCConn) -> None:
+        with self._lock:
+            self._free.append(conn)
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._free = self._free, []
+        for c in conns:
+            c.close()
+
+
+class Replica:
+    """One replica's registry entry. Mutable fields are guarded by the
+    owning fleet's lock."""
+
+    def __init__(self, rid: str, endpoint: str, *, source: str = "static",
+                 timeout: float = 30.0):
+        self.id = rid
+        self.endpoint = endpoint
+        self.source = source              # "static" | "elastic"
+        self.state = "joining"            # joining|healthy|ejected
+        self.admission = "ok"             # ok|degraded
+        self.inflight = 0
+        self.fails = 0
+        self.routed = 0
+        self.degraded_served = 0
+        # SLO admission window state: cumulative slo_violations as last
+        # read from the replica's stats, and the delta accumulated over
+        # the current window.
+        self.seen_violations = -1         # -1 = never read
+        self.window_violations = 0
+        self.window_start = time.monotonic()
+        self.pool = _ConnPool(endpoint, timeout)
+
+    def brief(self) -> Dict[str, object]:
+        return {"id": self.id, "endpoint": self.endpoint,
+                "state": self.state, "admission": self.admission,
+                "inflight": int(self.inflight), "routed": int(self.routed),
+                "degraded_served": int(self.degraded_served),
+                "fails": int(self.fails), "source": self.source}
+
+
+class ServingFleet:
+    """Replica registry + ring + health/admission + elastic discovery.
+
+    ``epoch`` is the topology generation: any membership or
+    serving-state change bumps it, and clients that cached a replica
+    endpoint re-resolve through it (``PredictClient`` resolver)."""
+
+    def __init__(self, *, elastic_root: Optional[str] = None,
+                 replica_timeout: float = 30.0,
+                 stats_call: Optional[Callable] = None):
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Replica] = {}
+        self._ring = HashRing((), 1)
+        self.epoch = 0
+        self.elastic_root = elastic_root
+        self._replica_timeout = replica_timeout
+        # Seam for tests: (replica) -> stats dict. Default RPCs.
+        self._stats_call = stats_call or self._stats_rpc
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- membership --------------------------------------------------------
+
+    def _bump_epoch_locked(self) -> None:
+        self.epoch += 1
+        self._ring = HashRing(
+            [r.id for r in self._replicas.values()
+             if r.state in _SERVING or r.state == "healthy"],
+            int(flags.flag("fleet_vnodes")))
+        monitor.set_gauge("fleet/epoch", float(self.epoch))
+        monitor.set_gauge("fleet/replicas", float(sum(
+            1 for r in self._replicas.values() if r.state == "healthy")))
+
+    def add_replica(self, rid: str, endpoint: str, *,
+                    source: str = "static", ready: bool = False) -> Replica:
+        """Register a replica. ``ready=True`` admits it to the ring
+        immediately (tests/bench with known-warm replicas); otherwise it
+        stays JOINING until a health check confirms it answers stats —
+        the join gate that keeps a cold replica from taking traffic
+        before its warm-up (donefile base + shard-tier pulls) is done."""
+        with self._lock:
+            if rid in self._replicas:
+                return self._replicas[rid]
+            r = Replica(rid, endpoint, source=source,
+                        timeout=self._replica_timeout)
+            self._replicas[rid] = r
+            if ready:
+                r.state = "healthy"
+                monitor.add("fleet/joined", 1)
+            self._bump_epoch_locked()
+        log.vlog(0, "fleet: replica %s at %s registered (%s)", rid,
+                 endpoint, "ready" if ready else "joining")
+        return r
+
+    def remove_replica(self, rid: str) -> None:
+        """Clean leave: drop from the ring and close its conns."""
+        with self._lock:
+            r = self._replicas.pop(rid, None)
+            if r is None:
+                return
+            monitor.add("fleet/left", 1)
+            self._bump_epoch_locked()
+        r.pool.close()
+        log.vlog(0, "fleet: replica %s left", rid)
+
+    def replicas(self) -> List[Dict[str, object]]:
+        with self._lock:
+            return [r.brief() for r in self._replicas.values()]
+
+    def healthy(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self._replicas.values()
+                    if r.state == "healthy"]
+
+    def get(self, rid: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def size(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.state == "healthy")
+
+    # -- routing -----------------------------------------------------------
+
+    def pick(self, key_hash: int, exclude: Tuple[str, ...] = ()
+             ) -> Tuple[Optional[Replica], str, bool]:
+        """Route one request: (replica, mode, degraded). Mode is
+        ``affinity`` (hash home) or ``spillover`` (home overloaded or
+        excluded, least-loaded healthy instead); (None, "none", False)
+        when no healthy replica remains. ``exclude`` names replicas
+        this request already failed on (the router's in-RPC re-route
+        must not hand the request back to the replica that just died
+        before the strike threshold ejects it). ``degraded`` means the
+        home replica's SLO admission tripped AND every candidate is at
+        the in-flight ceiling: the request is shed to the cheap path
+        instead of queueing behind a replica already missing its SLO."""
+        spill = max(int(flags.flag("fleet_spillover_inflight")), 1)
+        with self._lock:
+            home_id = self._ring.lookup(key_hash)
+            home = self._replicas.get(home_id) if home_id else None
+            if home is None or home.state != "healthy" \
+                    or home.id in exclude:
+                cands = [r for r in self._replicas.values()
+                         if r.state == "healthy"
+                         and r.id not in exclude]
+                if not cands:
+                    return None, "none", False
+                home = min(cands, key=lambda r: r.inflight)
+            if home.inflight < spill:
+                home.inflight += 1
+                home.routed += 1
+                return home, "affinity", False
+            # Home is saturated: spill to the least-loaded healthy
+            # replica (cache affinity yields to load under key skew).
+            cands = [r for r in self._replicas.values()
+                     if r.state == "healthy" and r.id not in exclude]
+            alt = min(cands, key=lambda r: r.inflight)
+            if alt.inflight < spill:
+                alt.inflight += 1
+                alt.routed += 1
+                monitor.add("fleet/spillover", 1)
+                return alt, "spillover", False
+            # Everyone is at the ceiling. If the home replica's SLO
+            # admission tripped, shed its overflow to the degraded path
+            # on the least-loaded candidate; otherwise queue on home
+            # (backpressure, the SLO is still being met).
+            target = alt if alt.inflight <= home.inflight else home
+            target.inflight += 1
+            target.routed += 1
+            if home.admission == "degraded":
+                target.degraded_served += 1
+                monitor.add("fleet/degraded", 1)
+                return target, "spillover", True
+            return target, "affinity", False
+
+    def release(self, replica: Replica) -> None:
+        with self._lock:
+            replica.inflight = max(0, replica.inflight - 1)
+
+    def strike(self, replica: Replica) -> None:
+        """A routed call hit a dead connection: one health strike,
+        ejecting at the same threshold as the health thread (the router
+        already re-routed the request — ejection is about not routing
+        the NEXT one there)."""
+        with self._lock:
+            replica.fails += 1
+            should_eject = (replica.state != "ejected" and
+                            replica.fails >= max(
+                                int(flags.flag("fleet_health_fails")), 1))
+        if should_eject:
+            self._eject(replica, reason="predict connection error")
+
+    def _eject(self, replica: Replica, *, reason: str) -> None:
+        faults.faultpoint("fleet/health_eject")
+        with self._lock:
+            if replica.state == "ejected":
+                return
+            replica.state = "ejected"
+            monitor.add("fleet/ejected", 1)
+            self._bump_epoch_locked()
+        replica.pool.close()
+        log.warning("fleet: ejected replica %s (%s)", replica.id, reason)
+
+    # -- health + admission ------------------------------------------------
+
+    def _stats_rpc(self, replica: Replica) -> Dict:
+        conn = replica.pool.acquire()
+        try:
+            out = conn.call("stats")
+        except BaseException:
+            conn.close()
+            raise
+        replica.pool.release(conn)
+        return out
+
+    def health_check_once(self) -> None:
+        """One health + admission sweep over every non-ejected replica:
+        a stats answer clears strikes, admits JOINING replicas
+        (``fleet/replica_join``), and feeds the SLO admission window;
+        repeated failures eject (``fleet/health_eject``)."""
+        with self._lock:
+            todo = [r for r in self._replicas.values()
+                    if r.state != "ejected"]
+        thresh = max(int(flags.flag("fleet_health_fails")), 1)
+        for r in todo:
+            try:
+                st = self._stats_call(r)
+            except (OSError, ConnectionError, RuntimeError,
+                    faults.InjectedFault) as e:
+                with self._lock:
+                    r.fails += 1
+                    should_eject = r.fails >= thresh
+                if should_eject:
+                    self._eject(r, reason=f"health check failed: {e!r}")
+                continue
+            with self._lock:
+                r.fails = 0
+                if r.state == "joining":
+                    faults.faultpoint("fleet/replica_join")
+                    r.state = "healthy"
+                    monitor.add("fleet/joined", 1)
+                    self._bump_epoch_locked()
+                    log.vlog(0, "fleet: replica %s joined serving", r.id)
+                self._admission_update_locked(
+                    r, int(st.get("slo_violations", 0)))
+
+    def _admission_update_locked(self, r: Replica, violations: int) -> None:
+        """Feed one stats reading into the replica's SLO window. The
+        counter is cumulative on the replica; the window sums deltas,
+        trips DEGRADED at ``fleet_slo_trip``, and one clean (zero-delta)
+        full window restores OK."""
+        if r.seen_violations < 0:
+            r.seen_violations = violations
+            return
+        delta = max(0, violations - r.seen_violations)
+        r.seen_violations = violations
+        r.window_violations += delta
+        now = time.monotonic()
+        window = max(float(flags.flag("fleet_slo_window_s")), 1e-3)
+        trip = max(int(flags.flag("fleet_slo_trip")), 1)
+        if r.window_violations >= trip:
+            if r.admission != "degraded":
+                r.admission = "degraded"
+                monitor.add("fleet/admission_trips", 1)
+                log.warning(
+                    "fleet: replica %s SLO admission tripped (%d "
+                    "violations in window)", r.id, r.window_violations)
+            # Re-arm: a replica still violating keeps re-tripping.
+            r.window_violations = 0
+            r.window_start = now
+        elif now - r.window_start >= window:
+            if r.window_violations == 0 and r.admission != "ok":
+                r.admission = "ok"
+                log.vlog(0, "fleet: replica %s admission restored", r.id)
+            r.window_violations = 0
+            r.window_start = now
+
+    # -- elastic discovery -------------------------------------------------
+
+    def discover_once(self) -> bool:
+        """Adopt the elastic rank table's ``serving_endpoint`` meta:
+        hosts advertising one and not yet known register (JOINING —
+        the next health sweep admits them once they answer); known
+        elastic-sourced replicas whose host left the table are removed
+        (clean leave — a kill -9 is caught faster by the health
+        thread). Returns whether membership changed."""
+        if self.elastic_root is None:
+            return False
+        from paddlebox_tpu.launch.elastic import read_rank_table
+        table = read_rank_table(self.elastic_root)
+        if table is None:
+            return False
+        eps: Dict[str, str] = {}
+        for host in table.hosts:
+            m = table.meta.get(host) or {}
+            ep = m.get("serving_endpoint")
+            if ep:
+                eps[host] = str(ep)
+        changed = False
+        with self._lock:
+            known = dict(self._replicas)
+        for host, ep in eps.items():
+            r = known.get(host)
+            if r is None:
+                self.add_replica(host, ep, source="elastic")
+                changed = True
+            elif r.state == "ejected" and r.endpoint != ep:
+                # Same host id came back on a fresh endpoint (restart):
+                # re-register it as a joining replica.
+                self.remove_replica(host)
+                self.add_replica(host, ep, source="elastic")
+                changed = True
+        for rid, r in known.items():
+            if r.source == "elastic" and rid not in eps:
+                self.remove_replica(rid)
+                changed = True
+        return changed
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fleet-health")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                self.discover_once()
+                self.health_check_once()
+            except Exception as e:  # keep the fleet alive
+                log.warning("fleet health loop: %s", e)
+            time.sleep(max(
+                float(flags.flag("fleet_health_interval_s")), 0.05))
+
+    def stop(self) -> None:
+        self._running = False
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        with self._lock:
+            reps = list(self._replicas.values())
+        for r in reps:
+            r.pool.close()
+
+
+class ShardBackedStore:
+    """Pure-read miss resolver over the shared ShardServer tier.
+
+    The ``backing`` a :class:`~paddlebox_tpu.serving.predictor.
+    ServingTierStore` plugs its cold path into instead of private
+    :class:`~paddlebox_tpu.embedding.ssd_tier.DiskShards`: batched
+    ``pull_serving`` RPCs over the framed wire (int8/f16 wire dtype
+    honored via ``FLAGS_multihost_wire_dtype``), fused ``[emb | w]``
+    rows back, and a found mask so a feasign training never saw keeps
+    serving zeros. Replicas NEVER write through this object — training
+    owns the tier; a replica's deltas land only on its local hot/warm
+    copies (the donefile publisher), which shadow the backing rows.
+    """
+
+    def __init__(self, endpoints: Sequence[str], dim: int, *,
+                 ranges=None, timeout: float = 60.0):
+        from paddlebox_tpu.multihost.keyrange import ShardRangeTable
+        from paddlebox_tpu.multihost.shard_service import ShardClient
+        self.dim = int(dim)
+        self.ranges = (ranges if ranges is not None
+                       else ShardRangeTable.for_world(len(endpoints)))
+        if self.ranges.world != len(endpoints):
+            raise ValueError(
+                f"{len(endpoints)} endpoints != range table world "
+                f"{self.ranges.world}")
+        self.endpoints = list(endpoints)
+        self._clients = [ShardClient(e, timeout=timeout)
+                         for e in self.endpoints]
+
+    def read(self, keys: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """(found [n], fused vals [n, dim+1]) for sorted unique keys —
+        the DiskShards.read-shaped surface the serving tier store's
+        miss path consumes. One RPC per owning shard, concurrent."""
+        from paddlebox_tpu.multihost import shard_service
+        faults.faultpoint("fleet/shard_miss")
+        keys = np.ascontiguousarray(keys, np.uint64)
+        n = keys.shape[0]
+        found = np.zeros((n,), bool)
+        vals = np.zeros((n, self.dim + 1), np.float32)
+        if n == 0:
+            return found, vals
+        wire = shard_service.wire_mode()
+        owner = self.ranges.owner_of(keys)
+        order = np.argsort(owner, kind="stable")
+        starts = np.searchsorted(owner[order],
+                                 np.arange(self.ranges.world + 1))
+        work = []
+        for h in range(self.ranges.world):
+            idx = order[starts[h]:starts[h + 1]]
+            if idx.size:
+                work.append((h, idx))
+        results: Dict[int, dict] = {}
+        errs: List[BaseException] = []
+
+        def run(h: int, idx: np.ndarray) -> None:
+            try:
+                results[h] = self._clients[h].call(
+                    "pull_serving", keys=keys[idx], wire=wire)
+            except BaseException as e:
+                errs.append(e)
+
+        if len(work) == 1:
+            run(*work[0])
+        else:
+            ts = [threading.Thread(target=run, args=(h, idx), daemon=True)
+                  for h, idx in work]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+        if errs:
+            # A lost shard fails the miss resolution loudly — serving a
+            # zero row for a key the tier OWNS would silently mis-rank.
+            raise errs[0]
+        rx = 0
+        for h, idx in work:
+            res = results[h]
+            rx += shard_service.payload_nbytes(res)
+            emb = shard_service.decode_emb(res)
+            f = np.asarray(res["found"], bool)
+            found[idx] = f
+            vals[idx, :self.dim] = emb
+            vals[idx, self.dim] = np.asarray(res["w"], np.float32)
+        monitor.add("serving/shard_miss_keys", int(n))
+        monitor.add("serving/shard_miss_bytes", int(rx))
+        monitor.add("serving/shard_miss_unknown", int(n - found.sum()))
+        return found, vals
+
+    def num_features(self) -> int:
+        """Total keys resident in the backing tier (stats fan-out)."""
+        total = 0
+        for c in self._clients:
+            total += int(c.call("stats")["num_features"])
+        return total
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()
+
+
+def start_replica(model, feed_config, *, endpoint: str = "127.0.0.1:0",
+                  base_export: Optional[str] = None,
+                  dense_params=None,
+                  shard_endpoints: Optional[Sequence[str]] = None,
+                  hbm_rows: Optional[int] = None,
+                  watch_root: Optional[str] = None,
+                  table: str = "embedding",
+                  elastic_root: Optional[str] = None,
+                  host_id: Optional[str] = None,
+                  warm_lines: Optional[Sequence[str]] = None,
+                  **predictor_kw):
+    """Stand one serving replica up and (optionally) register it with
+    the fleet: build the predictor from the donefile-base xbox export,
+    plug its warm/cold misses into the shared shard tier, run a warm-up
+    predict BEFORE advertising the endpoint (a joining replica must
+    never take traffic cold), then heartbeat ``serving_endpoint`` into
+    the elastic root the router watches. Returns (server, manager) —
+    manager is None without an elastic root."""
+    from paddlebox_tpu.serving.predictor import CTRPredictor, load_xbox_model
+    from paddlebox_tpu.serving.service import PredictServer
+
+    backing = None
+    if shard_endpoints:
+        if base_export is not None:
+            keys, emb, w = load_xbox_model(base_export, table)
+            dim = emb.shape[1]
+        else:
+            # No base export: the replica starts empty and warms every
+            # row it serves from the shard tier on first miss.
+            dim = int(predictor_kw.pop("dim"))
+            keys = np.empty((0,), np.uint64)
+            emb = np.empty((0, dim), np.float32)
+            w = np.empty((0,), np.float32)
+        backing = ShardBackedStore(shard_endpoints, dim)
+        pred = CTRPredictor(model, feed_config, keys, emb, w, dense_params,
+                            hbm_rows=hbm_rows, shard_backing=backing,
+                            **predictor_kw)
+    else:
+        keys, emb, w = load_xbox_model(base_export, table)
+        pred = CTRPredictor(model, feed_config, keys, emb, w, dense_params,
+                            hbm_rows=hbm_rows, **predictor_kw)
+    if warm_lines:
+        from paddlebox_tpu.data.parser import parse_lines
+        from paddlebox_tpu.serving.batcher import pack_bucketed
+        ins = parse_lines(list(warm_lines), feed_config)
+        pred.predict(pack_bucketed(ins, feed_config))
+    server = PredictServer(endpoint, pred, watch_root=watch_root,
+                           watch_table=table)
+    manager = None
+    if elastic_root is not None:
+        from paddlebox_tpu.launch.elastic import ElasticManager
+        manager = ElasticManager(
+            elastic_root, host_id or f"replica-{server.endpoint}",
+            meta={"serving_endpoint": server.endpoint})
+        manager.start()
+    return server, manager

@@ -37,7 +37,7 @@ import os
 import shutil
 import tempfile
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -106,6 +106,40 @@ def load_delta_update(path: str, table: str = "embedding"
     return _load_export(path, table, "delta")
 
 
+def grouped_export_dims(path: str) -> List[int]:
+    """Width groups of a dim-grouped export root (``dim8/``, ``dim32/``
+    subdirs — the GroupedStore checkpoint layout); [] for flat."""
+    if not os.path.isdir(path):
+        return []
+    dims = []
+    for d in sorted(os.listdir(path)):
+        if d.startswith("dim") and d[3:].isdigit() and \
+                os.path.isdir(os.path.join(path, d)):
+            dims.append(int(d[3:]))
+    return sorted(dims)
+
+
+def load_grouped_export(path: str, table: str = "embedding",
+                        kind: str = "xbox"
+                        ) -> Dict[int, Tuple[np.ndarray, np.ndarray,
+                                             np.ndarray]]:
+    """Per-width-group (keys, emb, w) from a dim-grouped export root:
+    ``<path>/dim<D>/<table>_dim<D>.<kind>.npz`` per group (the
+    GroupedEngine table naming). A group whose subdir lacks this kind
+    (e.g. a delta that touched only one width) is skipped."""
+    out: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+    for d in grouped_export_dims(path):
+        sub = os.path.join(path, f"dim{d}")
+        try:
+            out[d] = _load_export(sub, f"{table}_dim{d}", kind)
+        except FileNotFoundError:
+            continue
+    if not out:
+        raise FileNotFoundError(
+            f"no dim-grouped {kind} export for {table!r} under {path}")
+    return out
+
+
 def load_serving_predictor(model, feed_config, path: str,
                            **kw) -> "CTRPredictor":
     """Stand a predictor up from a ``CTRTrainer.export_serving`` dir:
@@ -157,6 +191,66 @@ def _splice_scatter(table: jax.Array, grow: jax.Array,
 _splice_scatter_jit = jax.jit(_splice_scatter)
 
 
+def _dedup_update(keys: np.ndarray, emb: np.ndarray, w: np.ndarray,
+                  dim: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Shared update preprocessing: drop the null feasign (0 — KeyIndex
+    maps it to row -1, and a -1 scatter would wrap onto the trash row),
+    keep the LAST occurrence of duplicate keys (updates apply in
+    order; dup-index scatter is order-nondeterministic), and fuse
+    [emb | w]. Returns (keys, fused vals) — possibly empty."""
+    k = np.ascontiguousarray(keys, np.uint64)
+    nz = k != 0
+    if not nz.all():
+        k = k[nz]
+        emb, w = np.asarray(emb)[nz], np.asarray(w)[nz]
+    if k.shape[0] and emb.shape[1] != dim:
+        raise ValueError(
+            f"update width {emb.shape[1]} != serving table width {dim}")
+    if k.shape[0] == 0:
+        return k, np.zeros((0, dim + 1), np.float32)
+    _, last = np.unique(k[::-1], return_index=True)
+    keep = np.sort(k.shape[0] - 1 - last)
+    k = k[keep]
+    vals = np.concatenate(
+        [np.asarray(emb, np.float32)[keep],
+         np.asarray(w, np.float32)[keep][:, None]], axis=1)
+    return k, vals
+
+
+def _apply_flat_update(index, table: jax.Array, k: np.ndarray,
+                       vals: np.ndarray) -> Tuple[jax.Array, int]:
+    """Land a deduped update on one flat serving table + its KeyIndex
+    (callers hold the owning predictor's lock): ONE fused splice+scatter
+    dispatch, then the index upsert — read-only lookup FIRST so a
+    failed device dispatch cannot leave index and table out of sync.
+    Returns (new table, n_new)."""
+    n_old = table.shape[0] - 1
+    looked = index.lookup(k)
+    new_mask = looked < 0
+    n_new = int(new_mask.sum())
+    grow = vals[new_mask]
+    ex_rows = looked[~new_mask]
+    ex_vals = vals[~new_mask]
+    # One dispatch, one allocation: splice the appended rows in
+    # (pre-filled with their values) and scatter the existing keys'
+    # rows in the SAME fused program. No donation: a concurrent predict
+    # may still hold the old table (it snapshots under the lock,
+    # computes outside it) — the old version stays alive until its last
+    # reader drops it.
+    out = _splice_scatter_jit(
+        table, jnp.asarray(grow, jnp.float32),
+        jnp.asarray(ex_rows, jnp.int32),
+        jnp.asarray(ex_vals, jnp.float32))
+    if n_new:
+        rows, got_new = index.upsert(k)
+        if got_new != n_new or not np.array_equal(
+                rows[new_mask], n_old + np.arange(n_new)):
+            raise RuntimeError(
+                "serving index assignment diverged from the spliced "
+                "table layout")
+    return out, n_new
+
+
 class ServingTierStore:
     """The hierarchical serving table behind a tiered CTRPredictor.
 
@@ -181,7 +275,7 @@ class ServingTierStore:
 
     def __init__(self, keys_sorted: np.ndarray, vals: np.ndarray,
                  hbm_cap: int, *, cache_rows: Optional[int] = None,
-                 cache_dir: Optional[str] = None):
+                 cache_dir: Optional[str] = None, backing=None):
         self.width = int(vals.shape[1])
         self.hbm_cap = int(hbm_cap)
         n = int(keys_sorted.shape[0])
@@ -201,36 +295,67 @@ class ServingTierStore:
         self._miss_accesses = 0
         if cache_rows is None:
             cache_rows = int(flags.flag("serving_host_cache_rows"))
-        cdir = cache_dir or str(flags.flag("serving_cache_dir"))
+        # ``backing`` (a fleet ShardBackedStore, or anything with its
+        # read()/num_features()/close() surface) replaces the private
+        # disk tier with the SHARED shard tier: cold misses resolve by
+        # pure-read RPC, warm evictions just drop (the backing row is
+        # authoritative and re-readable), and local tiers are COPIES
+        # that shadow the shared rows rather than exclusive owners.
+        self.backing = backing
         self._own_dir = None
-        if not cdir:
-            cdir = tempfile.mkdtemp(prefix="serving_cold_")
-            self._own_dir = cdir
-        self.disk = DiskShards(cdir, num_buckets=16)
+        if backing is not None:
+            self.disk = None
+        else:
+            cdir = cache_dir or str(flags.flag("serving_cache_dir"))
+            if not cdir:
+                cdir = tempfile.mkdtemp(prefix="serving_cold_")
+                self._own_dir = cdir
+            self.disk = DiskShards(cdir, num_buckets=16)
         self.warm = HostRowCache(self.width, capacity=max(cache_rows, 0),
                                  on_evict=self._spill)
         if n > n_hot:
             self.warm.put_rows(keys_sorted[n_hot:], vals[n_hot:])
 
     def _spill(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        if self.disk is None:
+            # Shard-backed: the shared tier still holds every row a
+            # replica ever read — an evicted warm copy is just dropped.
+            monitor.add("serving/cache_dropped", int(keys.shape[0]))
+            return
         self.disk.write(keys, {self.FIELD: vals})
         monitor.add("serving/cache_spilled", int(keys.shape[0]))
 
+    def local_keys_locked(self) -> int:
+        """Rows materialized in this replica's local tiers (hot + warm;
+        the shard-backed mode's num_keys surface — the shared tier's
+        own count is the backing's num_features()). Caller holds the
+        owning predictor's lock, like every other method here."""
+        return int(self._hot_keys.shape[0]) + len(self.warm)
+
     def close(self) -> None:
+        if self.backing is not None:
+            self.backing.close()
+            self.backing = None
         if self._own_dir:
             shutil.rmtree(self._own_dir, ignore_errors=True)
             self._own_dir = None
 
     # -- lookup ------------------------------------------------------------
 
-    def lookup(self, ids: np.ndarray
+    def lookup(self, ids: np.ndarray, *, resolve: bool = True
                ) -> Tuple[np.ndarray, np.ndarray, int]:
         """ids [n] uint64 → (rows [n] int32, staging values
         [stage, width], stage). Rows < hbm_cap+1 index ``table`` (the
         trash row for null/unknown); rows >= hbm_cap+1 index the
         staging array, filled from the warm/cold tiers for this batch.
         ``stage`` is pow2-bucketed so the jitted forward's trace count
-        stays bounded; 0 = no misses (pure-HBM batch)."""
+        stays bounded; 0 = no misses (pure-HBM batch).
+
+        ``resolve=False`` is the DEGRADED admission path: HBM hot rows
+        only — misses read the zero trash row (the default-init row the
+        predictor serves for unknown keys) with no warm/cold/backing
+        work and no promotion accounting, so a shed request costs one
+        searchsorted and one device gather."""
         ids = np.asarray(ids, np.uint64)
         # graftlint: allow-lock(caller-serialized: lookup runs under the predictor lock, same lock promote_locked mutates under)
         n_hot = self._hot_keys.shape[0]
@@ -247,6 +372,9 @@ class ServingTierStore:
             hot_hit = np.zeros(ids.shape, bool)
         monitor.add("serving/cache_hbm_hits", int(hot_hit.sum()))
         miss_sel = ~hot_hit & (ids != 0)
+        if not resolve:
+            monitor.add("serving/degraded_rows", int(miss_sel.sum()))
+            return rows, np.zeros((1, self.width), np.float32), 0
         if not miss_sel.any():
             return rows, np.zeros((1, self.width), np.float32), 0
         uniq, inv, cnt = np.unique(ids[miss_sel], return_inverse=True,
@@ -256,7 +384,15 @@ class ServingTierStore:
         vals[whit] = wvals[whit]
         monitor.add("serving/cache_host_hits", int(cnt[whit].sum()))
         cold = ~whit
-        if cold.any():
+        if cold.any() and self.backing is not None:
+            bfound, bvals = self.backing.read(uniq[cold])
+            idx = np.flatnonzero(cold)
+            vals[idx[bfound]] = bvals[bfound]
+            monitor.add("serving/cache_backing_hits",
+                        int(cnt[idx[bfound]].sum()))
+            monitor.add("serving/cache_unknown",
+                        int(cnt[idx[~bfound]].sum()))
+        elif cold.any():
             cfound, cvals = self.disk.read(uniq[cold])
             idx = np.flatnonzero(cold)
             if cvals:
@@ -288,10 +424,18 @@ class ServingTierStore:
                          ) -> Tuple[np.ndarray, np.ndarray]:
         """Remove ``keys`` from warm-then-cold, returning (found [n],
         vals [n, width]) — the promotion read (exclusive tiers: rows
-        moving HBM-ward leave their old tier)."""
+        moving HBM-ward leave their old tier). Shard-backed mode reads
+        COPIES from the shared tier instead of taking (replicas never
+        mutate it; the local hot row shadows the backing row)."""
         found, vals = self.warm.pop_rows(keys)
         need = ~found
-        if need.any():
+        if need.any() and self.backing is not None:
+            order = np.argsort(keys[need], kind="stable")
+            idx = np.flatnonzero(need)[order]
+            bfound, bvals = self.backing.read(keys[idx])
+            vals[idx[bfound]] = bvals[bfound]
+            found[idx[bfound]] = True
+        elif need.any():
             dk, dv = self.disk.take(keys[need])
             if dk.size:
                 where = {int(k): i for i, k in enumerate(dk)}
@@ -401,7 +545,20 @@ class ServingTierStore:
             hot_hit = np.zeros(keys.shape, bool)
         rest = ~hot_hit
         n_new = 0
-        if rest.any():
+        if rest.any() and self.backing is not None:
+            # Shared tier: a delta only needs to land on the rows THIS
+            # replica has materialized (hot scatter above, warm
+            # overwrite here). Everything else is bypassed — the
+            # training side already pushed those rows into the shard
+            # tier, and the next miss reads the fresh value. This is
+            # what lets the publisher land a delta once per replica's
+            # hot/warm set instead of once per full model copy.
+            rk, rv = keys[rest], vals[rest]
+            in_warm = self.warm.contains(rk)
+            if in_warm.any():
+                self.warm.put_rows(rk[in_warm], rv[in_warm])
+            monitor.add("serving/delta_bypassed", int((~in_warm).sum()))
+        elif rest.any():
             rk, rv = keys[rest], vals[rest]
             in_warm = self.warm.contains(rk)
             if (~in_warm).any():
@@ -429,7 +586,8 @@ class CTRPredictor:
                  data_norm_slot_dim: int = -1,
                  hbm_rows: Optional[int] = None,
                  host_cache_rows: Optional[int] = None,
-                 cache_dir: Optional[str] = None):
+                 cache_dir: Optional[str] = None,
+                 shard_backing=None):
         self.model = model
         self.feed = feed_config
         # Must match the trainer's TrainerConfig.data_norm_slot_dim for
@@ -444,17 +602,24 @@ class CTRPredictor:
             raise ValueError("duplicate keys in xbox export")
         if hbm_rows is None:
             hbm_rows = int(flags.flag("serving_hbm_rows"))
-        if 0 < hbm_rows < keys_sorted.shape[0]:
+        if shard_backing is not None and hbm_rows <= 0:
+            raise ValueError(
+                "shard-backed serving is tiered by construction: pass "
+                "hbm_rows > 0 (or set FLAGS_serving_hbm_rows)")
+        if shard_backing is not None or 0 < hbm_rows < keys_sorted.shape[0]:
             fused_vals = np.concatenate(
                 [np.asarray(emb, np.float32)[order],
                  np.asarray(w, np.float32)[order][:, None]], axis=1)
             self._tiers: Optional[ServingTierStore] = ServingTierStore(
                 keys_sorted, fused_vals, hbm_rows,
-                cache_rows=host_cache_rows, cache_dir=cache_dir)
+                cache_rows=host_cache_rows, cache_dir=cache_dir,
+                backing=shard_backing)
             self._table = self._tiers.table
             self._index = None
-            log.vlog(0, "serving: tiered table — %d keys, %d HBM rows",
-                     keys_sorted.shape[0], hbm_rows)
+            log.vlog(0, "serving: tiered table — %d keys, %d HBM rows%s",
+                     keys_sorted.shape[0], hbm_rows,
+                     " (shard-backed)" if shard_backing is not None
+                     else "")
         else:
             self._tiers = None
             self._index = native_store.KeyIndex()
@@ -504,14 +669,21 @@ class CTRPredictor:
                   dense_template=None, **kw) -> "CTRPredictor":
         """Load from a training run's artifacts: the xbox sparse export +
         a dense checkpoint (``checkpoint.dense.save_pytree`` format, with
-        ``dense_template`` = a freshly-init'd param pytree)."""
-        keys, emb, w = load_xbox_model(xbox_path, table)
+        ``dense_template`` = a freshly-init'd param pytree). A
+        dim-grouped export root (``dim8/``, ``dim32/`` — the dynamic-mf
+        GroupedStore layout) builds a :class:`GroupedCTRPredictor`, so
+        one replica serves mixed-width slots."""
         if dense_params is None:
             if dense_path is None or dense_template is None:
                 raise ValueError(
                     "need dense_params, or dense_path + dense_template")
             from paddlebox_tpu.checkpoint.dense import load_pytree
             dense_params, _step = load_pytree(dense_template, dense_path)
+        if grouped_export_dims(xbox_path):
+            groups = load_grouped_export(xbox_path, table, "xbox")
+            return GroupedCTRPredictor(model, feed_config, groups,
+                                       dense_params, table=table, **kw)
+        keys, emb, w = load_xbox_model(xbox_path, table)
         return cls(model, feed_config, keys, emb, w, dense_params, **kw)
 
     # -- tier promotion ----------------------------------------------------
@@ -548,8 +720,13 @@ class CTRPredictor:
 
     @property
     def num_keys(self) -> int:
-        """Keys served (all tiers) — the stats-RPC surface."""
+        """Keys served (all tiers) — the stats-RPC surface. Shard-backed
+        replicas report their LOCALLY materialized rows (hot + warm);
+        the shared tier's own count is the backing's num_features()."""
         if self._tiers is not None:
+            if self._tiers.backing is not None:
+                with self._lock:
+                    return int(self._tiers.local_keys_locked())
             return int(self._tiers.total_keys)
         # graftlint: allow-lock(benign snapshot: jax arrays are immutable — a stale ref still answers with a consistent shape)
         return int(self._table.shape[0] - 1)
@@ -619,83 +796,45 @@ class CTRPredictor:
         The flat-table path lands as ONE fused jitted splice+scatter
         dispatch (:func:`_splice_scatter`); the tiered path routes each
         key to the tier that owns it."""
-        k = np.ascontiguousarray(keys, np.uint64)
-        # The null feasign (0) never serves — KeyIndex maps it to row -1
-        # and a -1 scatter would wrap onto the trash row, corrupting the
-        # zeros every unknown key reads.
-        nz = k != 0
-        if not nz.all():
-            k = k[nz]
-            emb, w = np.asarray(emb)[nz], np.asarray(w)[nz]
+        k, vals = _dedup_update(keys, emb, w, self._dim)
         if k.shape[0] == 0:
             if dense_params is not None:
                 with self._lock:
                     self._dense_params = dense_params
             return 0
-        if emb.shape[1] != self._dim:
-            raise ValueError(
-                f"update width {emb.shape[1]} != serving table width "
-                f"{self._dim}")
-        # Keep the LAST occurrence of duplicate keys (a stream of
-        # updates applies in order; scatter with dup indices would be
-        # order-nondeterministic).
-        _, last = np.unique(k[::-1], return_index=True)
-        keep = np.sort(k.shape[0] - 1 - last)
-        k = k[keep]
-        vals = np.concatenate(
-            [np.asarray(emb, np.float32)[keep],
-             np.asarray(w, np.float32)[keep][:, None]], axis=1)
         with self._lock:
             if self._tiers is not None:
                 n_new = self._tiers.update(k, vals)
                 self._table = self._tiers.table
-                if dense_params is not None:
-                    self._dense_params = dense_params
-                monitor.add("serving/updated_keys", int(k.shape[0]))
-                monitor.add("serving/new_keys", int(n_new))
-                return int(n_new)
-            n_old = self._table.shape[0] - 1
-            # Read-only lookup FIRST: the fallible device dispatch must
-            # complete before the index mutates, or an exception would
-            # leave index and table permanently out of sync (every
-            # later update then mis-splices).
-            looked = self._index.lookup(k)
-            new_mask = looked < 0
-            n_new = int(new_mask.sum())
-            grow = vals[new_mask]
-            ex_rows = looked[~new_mask]
-            ex_vals = vals[~new_mask]
-            # One dispatch, one allocation: splice the appended rows in
-            # (pre-filled with their values) and scatter the existing
-            # keys' rows in the SAME fused program. No donation: a
-            # concurrent predict may still hold the old table (it
-            # snapshots under this lock, computes outside it) — the old
-            # version stays alive until its last reader drops it.
-            table = _splice_scatter_jit(
-                self._table, jnp.asarray(grow, jnp.float32),
-                jnp.asarray(ex_rows, jnp.int32),
-                jnp.asarray(ex_vals, jnp.float32))
-            if n_new:
-                rows, got_new = self._index.upsert(k)
-                if got_new != n_new or not np.array_equal(
-                        rows[new_mask],
-                        n_old + np.arange(n_new)):
-                    raise RuntimeError(
-                        "serving index assignment diverged from the "
-                        "spliced table layout")
-            self._table = table
+            else:
+                self._table, n_new = _apply_flat_update(
+                    self._index, self._table, k, vals)
             if dense_params is not None:
                 self._dense_params = dense_params
         monitor.add("serving/updated_keys", int(k.shape[0]))
         monitor.add("serving/new_keys", int(n_new))
         return int(n_new)
 
+    def apply_update_export(self, path: str, table: str = "embedding",
+                            kind: str = "delta") -> int:
+        """Apply an on-disk update export of either layout (the surface
+        the delta RPC and the donefile publisher share): flat/sharded
+        roots go through :meth:`apply_update`; dim-grouped roots are
+        rejected here and handled by :class:`GroupedCTRPredictor`'s
+        override — so a fleet of mixed-dim replicas and flat replicas
+        tails the same donefile."""
+        keys, emb, w = _load_export(path, table, kind)
+        return self.apply_update(keys, emb, w)
+
     # -- predict -----------------------------------------------------------
 
-    def predict(self, batch) -> np.ndarray:
+    def predict(self, batch, *, degraded: bool = False) -> np.ndarray:
         """SlotBatch -> CTR probabilities [batch_size] (invalid/padding
         rows yield whatever the model does on zeros — mask with
-        batch.valid if needed)."""
+        batch.valid if needed). ``degraded=True`` is the fleet router's
+        SLO-shed path: a tiered table serves HBM hot rows only (misses
+        read the default zero row, no warm/cold/backing resolution) —
+        cheaper and approximate, flagged degraded in the RPC reply."""
         from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
         caps = {n: batch.ids[n].shape[0] for n in self._slot_names}
         bs = batch.batch_size
@@ -706,7 +845,8 @@ class CTRPredictor:
             # dense snapshot under the update lock (jax arrays are
             # immutable, so the compute below needs no lock).
             if self._tiers is not None:
-                rows, miss_arr, stage = self._tiers.lookup(all_ids)
+                rows, miss_arr, stage = self._tiers.lookup(
+                    all_ids, resolve=not degraded)
                 table, dense_params = self._table, self._dense_params
                 miss = jnp.asarray(miss_arr) if stage else self._zero_miss
                 promote_due = self._tiers.promote_due()
@@ -731,3 +871,214 @@ class CTRPredictor:
                     jnp.asarray(rows), segs,
                     jnp.asarray(_concat_dense_host(batch)))
         return np.asarray(probs)
+
+
+class _ServingGroup:
+    """One width group of a grouped serving table: its fused flat table
+    ([n+1, dim+1], zero trash row last) + key index + member slots."""
+
+    __slots__ = ("dim", "slots", "index", "table")
+
+    def __init__(self, dim: int, slots: Tuple[str, ...],
+                 keys: np.ndarray, emb: np.ndarray, w: np.ndarray):
+        self.dim = int(dim)
+        self.slots = slots
+        order = np.argsort(keys, kind="stable")
+        keys_sorted = np.ascontiguousarray(keys[order], np.uint64)
+        self.index = native_store.KeyIndex()
+        _rows, n_new = self.index.upsert(keys_sorted)
+        if n_new != keys.shape[0]:
+            raise ValueError(
+                f"duplicate keys in dim{dim} xbox export")
+        fused = np.zeros((keys.shape[0] + 1, self.dim + 1), np.float32)
+        fused[:-1, :self.dim] = np.asarray(emb, np.float32)[order]
+        fused[:-1, self.dim] = np.asarray(w, np.float32)[order]
+        self.table = jnp.asarray(fused)
+
+
+class GroupedCTRPredictor(CTRPredictor):
+    """Serving over a dim-grouped (dynamic-mf) export: one flat table
+    PER WIDTH GROUP, slots routed to their group's table — the serving
+    twin of :class:`~paddlebox_tpu.embedding.grouped.GroupedEngine`
+    (mixed 8/32/64-wide slots in one model, every array static-shape).
+    A feasign appearing in slots of two widths serves an independent
+    row per group, the same contract training has.
+
+    The same ``predict``/``apply_update_export``/stats surface as the
+    flat predictor, so the micro-batcher, the predict service, the
+    donefile publisher, and the fleet router all work unchanged —
+    one fleet serves mixed-dim and single-dim replicas side by side.
+    Tiering is not supported for grouped tables (flat per-group HBM
+    tables only)."""
+
+    def __init__(self, model, feed_config,
+                 groups: Dict[int, Tuple[np.ndarray, np.ndarray,
+                                         np.ndarray]],
+                 dense_params, *, table: str = "embedding",
+                 slot_dims: Optional[Dict[str, int]] = None,
+                 compute_dtype: str = "bfloat16",
+                 data_norm_slot_dim: int = -1,
+                 hbm_rows: Optional[int] = None, **_ignored):
+        if hbm_rows:
+            raise ValueError(
+                "grouped serving tables are flat-per-group; tiering "
+                "(hbm_rows) is not supported")
+        self.model = model
+        self.feed = feed_config
+        self.table_name = table
+        self._dn_slot_dim = int(data_norm_slot_dim)
+        self._slot_names = [s.name for s in feed_config.sparse_slots]
+        if slot_dims is None:
+            md = getattr(model, "emb_dim", None)
+            if hasattr(md, "items"):
+                slot_dims = {s: int(d) for s, d in md.items()}
+            elif isinstance(md, int) and len(groups) == 1:
+                slot_dims = {s: md for s in self._slot_names}
+            else:
+                raise ValueError(
+                    "cannot derive per-slot widths: pass slot_dims= or "
+                    "use a model whose emb_dim is a per-slot mapping")
+        self._slot_dims = {s: int(slot_dims[s]) for s in self._slot_names}
+        want = sorted(set(self._slot_dims.values()))
+        have = sorted(groups)
+        if want != have:
+            raise ValueError(
+                f"export width groups {have} != model slot widths {want}")
+        self._groups: Dict[int, _ServingGroup] = {}
+        for d in have:
+            slots = tuple(s for s in self._slot_names
+                          if self._slot_dims[s] == d)
+            k, e, w = groups[d]
+            if e.shape[1] != d:
+                raise ValueError(
+                    f"dim{d} export has width {e.shape[1]}")
+            self._groups[d] = _ServingGroup(d, slots, k, e, w)
+        self._dim = max(have)     # stats surface: the widest group
+        self._dense_params = dense_params
+        self._cdt = dict(float32=jnp.float32,
+                         bfloat16=jnp.bfloat16)[compute_dtype]
+        self._fwd_cache: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        # No tiers/promote worker for grouped tables; the base close()
+        # and predict() branches read these.
+        self._tiers = None
+        self._index = None
+        self._promote_stop = threading.Event()
+        self._promote_wake = threading.Event()
+        self._promote_thread = None
+        log.vlog(0, "serving: grouped table — dims %s, %d keys", have,
+                 self.num_keys)
+
+    @property
+    def num_keys(self) -> int:
+        return int(sum(g.table.shape[0] - 1
+                       for g in self._groups.values()))
+
+    @property
+    def dims(self) -> List[int]:
+        return sorted(self._groups)
+
+    # -- forward -----------------------------------------------------------
+
+    def _build_fwd_grouped(self, caps: Dict[str, int], bs: int):
+        model = self.model
+        cdt = self._cdt
+        names = self._slot_names
+        dims = self._slot_dims
+        dim_order = self.dims
+        dn_slot_dim = self._dn_slot_dim
+
+        def cast(t):
+            return jax.tree.map(
+                lambda x: x.astype(cdt)
+                if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
+                t)
+
+        def fwd(tables, params, rows, segments, dense_feats):
+            params, dense_feats = normalize_dense_and_strip(
+                params, dense_feats, slot_dim=dn_slot_dim)
+            emb: Dict[str, jax.Array] = {}
+            w: Dict[str, jax.Array] = {}
+            for nme in names:
+                d = dims[nme]
+                picked = tables[dim_order.index(d)][rows[nme]]
+                emb[nme] = cast(picked[:, :d])
+                w[nme] = cast(picked[:, d])
+            logits = model.apply(cast(params), emb, w, segments,
+                                 batch_size=bs,
+                                 dense_feats=cast(dense_feats))
+            return jax.nn.sigmoid(logits.astype(jnp.float32))
+
+        return jax.jit(fwd)
+
+    # -- predict -----------------------------------------------------------
+
+    def predict(self, batch, *, degraded: bool = False) -> np.ndarray:
+        """SlotBatch -> probabilities [batch_size]: per-slot row lookup
+        in the slot's width group, one jitted forward over all group
+        tables. ``degraded`` is accepted for router compatibility (flat
+        group tables have no tiers to shed, so it is a no-op)."""
+        from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
+        caps = {n: batch.ids[n].shape[0] for n in self._slot_names}
+        bs = batch.batch_size
+        rows: Dict[str, jax.Array] = {}
+        with self._lock:
+            tables = tuple(self._groups[d].table for d in self.dims)
+            dense_params = self._dense_params
+            for nme in self._slot_names:
+                g = self._groups[self._slot_dims[nme]]
+                looked = g.index.lookup(
+                    np.ascontiguousarray(batch.ids[nme], np.uint64))
+                n_tab = g.table.shape[0] - 1
+                rows[nme] = jnp.asarray(
+                    np.where(looked < 0, n_tab, looked).astype(np.int32))
+        key = (tuple(sorted(caps.items())), bs)
+        fwd = self._fwd_cache.get(key)
+        if fwd is None:
+            fwd = self._fwd_cache[key] = self._build_fwd_grouped(caps, bs)
+        segs = {n: jnp.asarray(batch.segments[n])
+                for n in self._slot_names}
+        monitor.add("serving/requests", int(batch.num_valid))
+        probs = fwd(tables, dense_params, rows, segs,
+                    jnp.asarray(_concat_dense_host(batch)))
+        return np.asarray(probs)
+
+    # -- updates -----------------------------------------------------------
+
+    def apply_update(self, keys, emb, w, *, dense_params=None) -> int:
+        """A bare (keys, emb, w) update is routed by WIDTH — emb's
+        column count names the target group unambiguously (each group
+        has a distinct dim, and a feasign's row in another group is a
+        different parameter)."""
+        d = int(np.asarray(emb).shape[1])
+        if d not in self._groups:
+            raise ValueError(
+                f"update width {d} matches no serving group "
+                f"{self.dims}")
+        return self.apply_group_update(d, keys, emb, w,
+                                       dense_params=dense_params)
+
+    def apply_group_update(self, dim: int, keys, emb, w, *,
+                           dense_params=None) -> int:
+        k, vals = _dedup_update(keys, emb, w, int(dim))
+        with self._lock:
+            g = self._groups[int(dim)]
+            if k.shape[0]:
+                g.table, n_new = _apply_flat_update(
+                    g.index, g.table, k, vals)
+            else:
+                n_new = 0
+            if dense_params is not None:
+                self._dense_params = dense_params
+        monitor.add("serving/updated_keys", int(k.shape[0]))
+        monitor.add("serving/new_keys", int(n_new))
+        return int(n_new)
+
+    def apply_update_export(self, path: str, table: str = "embedding",
+                            kind: str = "delta") -> int:
+        """Dim-grouped delta root: apply each width group's export to
+        its table (a group absent from the delta is untouched)."""
+        n_new = 0
+        for d, (k, e, w) in load_grouped_export(path, table, kind).items():
+            n_new += self.apply_group_update(d, k, e, w)
+        return n_new

@@ -26,7 +26,6 @@ from typing import Optional, Set, Tuple
 
 from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol
 from paddlebox_tpu.core import faults, flags, log, monitor
-from paddlebox_tpu.serving.predictor import load_delta_update
 
 
 class DonefilePublisher:
@@ -103,15 +102,21 @@ class DonefilePublisher:
                 continue
             try:
                 faults.faultpoint("serving/publisher_apply")
-                keys, emb, w = load_delta_update(rec.path, self.table)
-                n_new = self.predictor.apply_update(keys, emb, w)
+                # apply_update_export routes by layout: flat/sharded
+                # roots through apply_update, dim-grouped roots through
+                # the grouped predictor's per-group path — and a
+                # shard-backed replica's tier store lands only the rows
+                # it has locally materialized (hot scatter + warm
+                # overwrite), since the shared shard tier already holds
+                # the training push for everything else.
+                n_new = self.predictor.apply_update_export(
+                    rec.path, self.table, "delta")
                 self.applied += 1
                 n += 1
                 monitor.add("serving/hotswap_applied", 1)
                 log.vlog(0, "serving publisher: hot-swapped %s/%d "
-                         "(%d keys, %d new) from %s", rec.day,
-                         rec.pass_id, int(keys.shape[0]), int(n_new),
-                         rec.path)
+                         "(%d new) from %s", rec.day,
+                         rec.pass_id, int(n_new), rec.path)
             except Exception as e:
                 self.errors += 1
                 monitor.add("serving/hotswap_errors", 1)

@@ -1,0 +1,226 @@
+"""FleetRouter: the serving fleet's wire front-end.
+
+Role of the request-routing tier in front of the reference's AIBox
+inference workers: ONE endpoint speaking the existing predict/stats
+typed-frame protocol (``PredictClient`` works against it unchanged),
+fanning requests across N :class:`~paddlebox_tpu.serving.service.
+PredictServer` replicas that all serve the same model out of the
+shared shard tier.
+
+Routing policy (state lives in :class:`~paddlebox_tpu.serving.fleet.
+ServingFleet`; SERVING_FLEET.md documents the full machine):
+
+- **consistent hash** on the request's leading feature token (the user
+  key by svm convention) → a stable home replica, so one user's
+  requests keep hitting the replica whose HBM/warm tiers already hold
+  their rows;
+- **least-loaded spillover** when the home replica's in-flight predicts
+  exceed ``FLAGS_fleet_spillover_inflight`` — affinity yields to load
+  under key skew;
+- **SLO-driven admission**: a replica whose ``slo/violations`` trips
+  within the admission window serves its OVERFLOW through the cheap
+  degraded path (HBM-hot-rows-only forward, ``degraded=true`` in the
+  reply) instead of queueing behind a replica already missing its SLO;
+- **health ejection + transparent re-route**: predict is a pure read,
+  so a routed call that dies on a dead connection re-routes to another
+  healthy replica inside the SAME client RPC — a kill -9'd replica
+  costs latency, never a failed client call.
+
+Replies are ``{"probs", "degraded", "replica", "epoch"}`` dicts;
+``PredictClient.predict`` unwraps them (``last_degraded`` /
+``last_replica``) and plain float arrays from a bare replica pass
+through untouched, so one client speaks to both.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.core import faults, log, monitor, trace
+from paddlebox_tpu.core.quantiles import LogQuantileDigest
+from paddlebox_tpu.distributed import rpc, wire
+from paddlebox_tpu.serving.fleet import (Replica, ServingFleet,
+                                         route_key_hash)
+
+
+class FleetRouter(rpc.FramedRPCServer):
+    """Route the predict/stats wire protocol across a serving fleet."""
+
+    service_name = "fleet-router"
+
+    def __init__(self, endpoint: str = "127.0.0.1:0", *,
+                 fleet: Optional[ServingFleet] = None,
+                 replicas: Optional[Sequence[str]] = None,
+                 elastic_root: Optional[str] = None,
+                 start_health: bool = True):
+        self.fleet = fleet or ServingFleet(elastic_root=elastic_root)
+        if replicas:
+            for i, ep in enumerate(replicas):
+                self.fleet.add_replica(f"replica-{i}", ep, ready=True)
+        self._route_lat = LogQuantileDigest()
+        self._route_lock = threading.Lock()
+        if start_health:
+            self.fleet.start()
+        rpc.FramedRPCServer.__init__(self, endpoint, backlog=128)
+
+    # -- predict routing ---------------------------------------------------
+
+    def _forward(self, replica: Replica, lines: List[str],
+                 degraded: bool):
+        """One predict attempt against one replica (conn from its
+        pool; a broken conn is closed, not returned)."""
+        conn = replica.pool.acquire()
+        try:
+            kw = {"lines": lines}
+            if degraded:
+                kw["degraded"] = True
+            out = conn.call("predict", **kw)
+        except BaseException:
+            conn.close()
+            raise
+        replica.pool.release(conn)
+        return out
+
+    def handle_predict(self, req) -> dict:
+        """Route one predict: hash-affinity pick (spillover/degraded per
+        admission state), forward, and on a DEAD CONNECTION re-route to
+        the next healthy replica inside this same RPC — predict is a
+        pure read, so the retry is safe and the client never sees the
+        kill. In-band replica errors (a ValueError for an oversized
+        request) are NOT retried: they would fail identically
+        anywhere."""
+        t0 = time.perf_counter()
+        faults.faultpoint("fleet/route")
+        lines: List[str] = list(req["lines"])
+        key_hash = route_key_hash(lines)
+        tried: set = set()
+        last_err: Optional[BaseException] = None
+        with trace.span("fleet/route", lines=len(lines)):
+            for _attempt in range(max(self.fleet.size(), 1) + 1):
+                replica, _mode, degraded = self.fleet.pick(
+                    key_hash, exclude=tuple(tried))
+                if replica is None:
+                    break
+                tried.add(replica.id)
+                try:
+                    probs = self._forward(replica, lines, degraded)
+                except (OSError, wire.WireError) as e:
+                    # Dead socket / torn reply stream: strike (ejects at
+                    # the same threshold as the health thread) and
+                    # re-route — predict is a pure read, so replaying it
+                    # on another replica is safe.
+                    last_err = e
+                    self.fleet.release(replica)
+                    self.fleet.strike(replica)
+                    monitor.add("fleet/reroutes", 1)
+                    continue
+                self.fleet.release(replica)
+                monitor.add("fleet/routed", 1)
+                ms = (time.perf_counter() - t0) * 1e3
+                monitor.observe_quantile("fleet/route_ms", ms)
+                with self._route_lock:
+                    self._route_lat.observe(ms)
+                return {"probs": np.asarray(probs, np.float32),
+                        "degraded": bool(degraded),
+                        "replica": replica.id,
+                        "epoch": int(self.fleet.epoch)}
+        monitor.add("fleet/route_failures", 1)
+        raise RuntimeError(
+            f"no serving replica could answer (tried {sorted(tried)}): "
+            f"{last_err!r}")
+
+    def handle_apply_delta(self, req) -> int:
+        """Fan a delta export out to EVERY healthy replica (the RPC
+        update path; the donefile publisher per replica is the usual
+        route). Returns the first replica's new-key count — replicas
+        serve the same model, so the counts agree. Not idempotent: a
+        replica failure surfaces to the caller instead of retrying."""
+        n_new: Optional[int] = None
+        applied = 0
+        for r in self.fleet.healthy():
+            conn = r.pool.acquire()
+            try:
+                got = conn.call("apply_delta", path=req["path"],
+                                table=req.get("table", "embedding"))
+            except BaseException:
+                conn.close()
+                raise
+            r.pool.release(conn)
+            applied += 1
+            if n_new is None:
+                n_new = int(got)
+        if applied == 0:
+            raise RuntimeError("no healthy replica to apply the delta")
+        monitor.add("fleet/delta_fanout", applied)
+        return int(n_new)
+
+    # -- control plane -----------------------------------------------------
+
+    def handle_topology(self, req) -> dict:
+        """The fleet's current membership + epoch — what a
+        direct-to-replica ``PredictClient`` re-resolves through after a
+        reconnect, and what drills assert ejection/join against."""
+        return {"epoch": int(self.fleet.epoch),
+                "replicas": self.fleet.replicas()}
+
+    def handle_stats(self, req) -> dict:
+        """Fleet-wide stats: fan ``metrics_snapshot`` out to every
+        healthy replica and fold the per-replica registries through
+        ``monitor.merge_snapshots`` (counters summed, digests merged) —
+        ``slo/violations`` and the predict-latency quantiles become
+        fleet-wide observables in one read. Per-replica briefs +
+        summaries ride along for skew diagnosis."""
+        snaps: List[dict] = []
+        briefs: Dict[str, dict] = {}
+        rps_total = 0.0
+        for r in self.fleet.healthy():
+            conn = r.pool.acquire()
+            try:
+                snap = conn.call("metrics_snapshot")
+                st = conn.call("stats")
+            except (OSError, ConnectionError, RuntimeError) as e:
+                conn.close()
+                log.warning("fleet stats: replica %s unreachable: %r",
+                            r.id, e)
+                continue
+            r.pool.release(conn)
+            snaps.append(snap)
+            b = r.brief()
+            b["stats"] = st
+            briefs[r.id] = b
+            rps_total += float(st.get("throughput_rps", 0.0))
+        merged = monitor.merge_snapshots(snaps)
+        lat = {}
+        pred = merged.get("quantiles", {}).get("serving/predict_ms")
+        if pred:
+            lat = {k: (round(v, 3) if v is not None else None)
+                   for k, v in LogQuantileDigest.from_dict(
+                       pred).quantiles().items()}
+        with self._route_lock:
+            route_q = {k: (round(v, 3) if v is not None else None)
+                       for k, v in self._route_lat.quantiles().items()}
+        counters = merged.get("counters", {})
+        return {"fleet_size": len(snaps),
+                "epoch": int(self.fleet.epoch),
+                "throughput_rps": round(rps_total, 3),
+                "latency_ms": lat,
+                "route_ms": route_q,
+                "predict_rpcs": int(
+                    counters.get("serving/predict_rpcs", 0)),
+                "degraded_rpcs": int(
+                    counters.get("serving/degraded_rpcs", 0)),
+                "slo_violations": int(counters.get("slo/violations", 0)),
+                "merged": merged,
+                "replicas": briefs}
+
+    def handle_stop(self, req) -> bool:
+        self.stop()
+        return True
+
+    def stop(self) -> None:
+        self.fleet.stop()
+        rpc.FramedRPCServer.stop(self)

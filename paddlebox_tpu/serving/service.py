@@ -33,7 +33,7 @@ from paddlebox_tpu.core.quantiles import LogQuantileDigest
 from paddlebox_tpu.data.parser import parse_lines
 from paddlebox_tpu.distributed import rpc
 from paddlebox_tpu.serving.batcher import MicroBatcher
-from paddlebox_tpu.serving.predictor import CTRPredictor, load_delta_update
+from paddlebox_tpu.serving.predictor import CTRPredictor
 
 
 class PredictServer(rpc.FramedRPCServer):
@@ -50,11 +50,19 @@ class PredictServer(rpc.FramedRPCServer):
 
     def __init__(self, endpoint: str, predictor: CTRPredictor, *,
                  watch_root: Optional[str] = None,
-                 watch_table: str = "embedding"):
+                 watch_table: str = "embedding",
+                 replica_id: Optional[str] = None):
         self.predictor = predictor
+        self.replica_id = replica_id or ""
         # Arm the telemetry sinks (trace/metrics paths) once per replica;
         # per-request cost is one cached-bool check when disabled.
         report.init_telemetry_from_flags()
+        # Per-REPLICA registry beside the process-global one: a fleet
+        # test/bench runs several replicas in one process, and the
+        # router's cluster-stats fan-out must merge per-replica
+        # snapshots, not N copies of the same global registry. Serving
+        # counters bump both; the global keeps its existing meaning.
+        self.metrics = monitor.Monitor()
         # SLO layer: server-side predict latency quantile digest (the
         # log-bucketed sketch — sub-ms CPU predicts and multi-second
         # tunnel stalls both land within 1% relative error) + the
@@ -72,7 +80,7 @@ class PredictServer(rpc.FramedRPCServer):
         # lifetime average.
         self._win_prev = (self._started, self._latency.copy())
         self._win_cur = (self._started, self._latency.copy())
-        self._batcher = MicroBatcher(predictor)
+        self._batcher = MicroBatcher(predictor, metrics=self.metrics)
         self._publisher = None
         if watch_root is not None:
             from paddlebox_tpu.serving.publisher import DonefilePublisher
@@ -100,9 +108,14 @@ class PredictServer(rpc.FramedRPCServer):
         """Raw svm-format lines -> CTR probabilities [n_lines]. Requests
         beyond the predictor's feed batch_size are rejected (the caller
         splits; the micro-batcher coalesces many small requests, it
-        does not split one huge one)."""
+        does not split one huge one). ``degraded=True`` (the fleet
+        router's SLO-shed path) packs and dispatches INLINE with
+        HBM-hot-rows-only resolution — never coalesced with normal
+        requests, whose batch would otherwise inherit the degraded
+        lookup."""
         t0 = time.perf_counter()
         lines: List[str] = list(req["lines"])
+        degraded = bool(req.get("degraded", False))
         feed = self.predictor.feed
         if len(lines) > feed.batch_size:
             raise ValueError(
@@ -115,12 +128,23 @@ class PredictServer(rpc.FramedRPCServer):
             # synthesized '0' svm lines and paid parse work to create
             # rows indistinguishable from real label-0 instances.
             instances = parse_lines(lines, feed)
-            out = self._batcher.predict(instances)
+            if degraded:
+                from paddlebox_tpu.serving.batcher import pack_bucketed
+                batch = pack_bucketed(instances, feed)
+                out = np.asarray(
+                    self.predictor.predict(batch, degraded=True)
+                    [:len(instances)], np.float32)
+                monitor.add("serving/degraded_rpcs", 1)
+                self.metrics.add("serving/degraded_rpcs", 1)
+            else:
+                out = self._batcher.predict(instances)
         ms = (time.perf_counter() - t0) * 1e3
         monitor.add("serving/predict_rpcs", 1)
         monitor.add("serving/predict_lines", n)
         monitor.observe("serving/predict_ms", ms)
         monitor.observe_quantile("serving/predict_ms", ms)
+        self.metrics.add("serving/predict_rpcs", 1)
+        self.metrics.add("serving/predict_lines", n)
         now = time.time()
         with self._lat_lock:
             self._latency.observe(ms)
@@ -131,22 +155,29 @@ class PredictServer(rpc.FramedRPCServer):
         slo = float(flags.flag("serving_slo_p99_ms"))
         if slo > 0 and ms > slo:
             monitor.add("slo/violations", 1)
+            self.metrics.add("slo/violations", 1)
         monitor.set_gauge("serving/throughput_rps", rps)
+        self.metrics.set_gauge("serving/throughput_rps", rps)
         return out
 
     def handle_apply_delta(self, req) -> int:
         """Live model refresh from a delta export directory (the online
-        update path — serving_online_update's surface over the wire)."""
+        update path — serving_online_update's surface over the wire).
+        Routed through ``apply_update_export`` so flat, sharded, and
+        dim-grouped delta roots all land."""
         with trace.span("serving/apply_delta", path=req["path"]):
-            keys, emb, w = load_delta_update(req["path"], req.get(
-                "table", "embedding"))
-            n_new = self.predictor.apply_update(keys, emb, w)
+            n_new = self.predictor.apply_update_export(
+                req["path"], req.get("table", "embedding"), "delta")
         monitor.add("serving/delta_rpcs", 1)
         return int(n_new)
 
     def handle_stats(self, req) -> dict:
         snap = monitor.snapshot()
-        gauges = monitor.snapshot_all().get("gauges", {})
+        # Per-REPLICA counters come from the instance registry: with N
+        # replicas in one process (fleet tests/bench) the global would
+        # conflate them, and the router's SLO admission window must see
+        # THIS replica's violations, not the fleet's.
+        mine = self.metrics.snapshot()
         now = time.time()
         uptime = now - self._started
         with self._lat_lock:
@@ -156,8 +187,11 @@ class PredictServer(rpc.FramedRPCServer):
             rps = self._window_rps(now)
         return {"keys": int(self.predictor.num_keys),
                 "dim": int(self.predictor._dim),
-                "predict_rpcs": int(snap.get("serving/predict_rpcs", 0)),
-                "predict_lines": int(snap.get("serving/predict_lines",
+                "replica_id": self.replica_id,
+                "predict_rpcs": int(mine.get("serving/predict_rpcs", 0)),
+                "predict_lines": int(mine.get("serving/predict_lines",
+                                              0)),
+                "degraded_rpcs": int(mine.get("serving/degraded_rpcs",
                                               0)),
                 "delta_rpcs": int(snap.get("serving/delta_rpcs", 0)),
                 "uptime_s": round(uptime, 3),
@@ -169,13 +203,26 @@ class PredictServer(rpc.FramedRPCServer):
                 # Sliding-window rate (NOT lifetime count / lifetime
                 # uptime — that decays forever on an idle replica).
                 "throughput_rps": round(rps, 3),
-                "batches": int(snap.get("serving/batches", 0)),
+                "batches": int(mine.get("serving/batches", 0)),
                 "batch_fill_frac": float(
-                    gauges.get("serving/batch_fill_frac", 0.0)),
+                    self.metrics.get_gauge("serving/batch_fill_frac")),
                 "hotswap_applied": int(
                     snap.get("serving/hotswap_applied", 0)),
                 "slo_p99_ms": float(flags.flag("serving_slo_p99_ms")),
-                "slo_violations": int(snap.get("slo/violations", 0))}
+                "slo_violations": int(mine.get("slo/violations", 0))}
+
+    def handle_metrics_snapshot(self, req) -> dict:
+        """This replica's labeled ``snapshot_all()`` (instance registry
+        + the per-replica latency digest injected under quantiles) —
+        what the fleet router's ``handle_stats`` fan-out merges with
+        ``monitor.merge_snapshots`` into one cluster view."""
+        out = self.metrics.snapshot_all(
+            labels={"replica": self.replica_id,
+                    "endpoint": self.endpoint})
+        with self._lat_lock:
+            out["quantiles"]["serving/predict_ms"] = \
+                self._latency.to_dict()
+        return out
 
     def handle_stop(self, req) -> bool:
         self.stop()
@@ -190,25 +237,77 @@ class PredictServer(rpc.FramedRPCServer):
 
 
 class PredictClient:
-    """Blocking client for one serving endpoint."""
+    """Blocking client for one serving endpoint — a replica directly,
+    or a :class:`~paddlebox_tpu.serving.router.FleetRouter` (same wire
+    protocol; the router's replies carry a ``degraded`` flag surfaced
+    via :attr:`last_degraded`).
 
-    def __init__(self, endpoint: str, timeout: float = 60.0):
+    ``router`` (optional) names a fleet router endpoint used as a
+    TOPOLOGY resolver for a direct-to-replica client: when an
+    idempotent retry has to reconnect, the client first re-resolves its
+    endpoint through the router's current topology epoch — so a
+    predict retried after a replica eject lands on a live replica
+    instead of burning the whole retry deadline reconnecting to the
+    dead one (the retry loop used to re-resolve against the fixed
+    endpoint it was constructed with)."""
+
+    def __init__(self, endpoint: str, timeout: float = 60.0, *,
+                 router: Optional[str] = None):
         # predict/stats are pure reads: a serving blip reconnects and
         # retries them under the rpc retry flags; apply_delta/stop are
         # NOT idempotent and surface connection errors to the caller.
-        self._conn = rpc.FramedRPCConn(endpoint, timeout=timeout,
-                                       service_name="serving",
-                                       idempotent=("predict", "stats"))
+        self._router_ep = router
+        self._router_conn: Optional[rpc.FramedRPCConn] = None
+        self._topology_epoch = -1
+        self._conn = rpc.FramedRPCConn(
+            endpoint, timeout=timeout, service_name="serving",
+            idempotent=("predict", "stats"),
+            resolve=(self._resolve_endpoint if router else None))
         # End-to-end predict latency (RPC round-trip included): diffing
         # these quantiles against the server's handle_stats latency_ms
         # separates server time from wire time per percentile.
         self._latency = LogQuantileDigest()
+        self.last_degraded = False
+        self.last_replica: Optional[str] = None
+
+    def _resolve_endpoint(self, current: str) -> str:
+        """Reconnect-time hook: ask the router which replicas serve
+        NOW; keep the current endpoint while it is still listed, else
+        move to a live one (hashed by client identity so a fleet of
+        retrying clients spreads instead of stampeding one replica)."""
+        try:
+            if self._router_conn is None:
+                self._router_conn = rpc.FramedRPCConn(
+                    self._router_ep, timeout=10.0,
+                    service_name="fleet-router",
+                    idempotent=("topology",))
+            topo = self._router_conn.call("topology")
+        except (OSError, ConnectionError, RuntimeError):
+            return current  # router unreachable: retry where we were
+        self._topology_epoch = int(topo.get("epoch", -1))
+        live = [r["endpoint"] for r in topo.get("replicas", ())
+                if r.get("state") == "healthy"]
+        if not live:
+            return current
+        if current in live:
+            return current
+        monitor.add("serving/client_reresolves", 1)
+        return live[hash(id(self)) % len(live)]
 
     def predict(self, lines: List[str]) -> np.ndarray:
         # The wire serializes str natively (utf-8 frames) — no
         # per-line encode/decode round-trip.
         t0 = time.perf_counter()
         out = self._conn.call("predict", lines=list(lines))
+        if isinstance(out, dict):
+            # Router reply: probabilities + routing metadata (degraded
+            # = the SLO-shed hot-rows-only path answered).
+            self.last_degraded = bool(out.get("degraded", False))
+            self.last_replica = out.get("replica")
+            out = out["probs"]
+        else:
+            self.last_degraded = False
+            self.last_replica = None
         self._latency.observe((time.perf_counter() - t0) * 1e3)
         return out
 
@@ -235,3 +334,6 @@ class PredictClient:
 
     def close(self) -> None:
         self._conn.close()
+        if self._router_conn is not None:
+            self._router_conn.close()
+            self._router_conn = None

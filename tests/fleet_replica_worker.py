@@ -1,0 +1,69 @@
+"""Worker payload for the REAL-PROCESS serving fleet drill: one
+replica process that warms against the shared shard tier, registers its
+serving endpoint through the elastic heartbeat meta (the same discovery
+path the router watches), and serves until killed — the SIGKILL target
+of ``tests/test_fleet_drill.py``.
+
+Determinism contract with the drill: every replica builds the SAME
+model (fixed init seed) over the SAME shared shard tier, so any two
+replicas answer bit-identical probabilities for the same lines — which
+is what lets the drill assert a joiner against an incumbent.
+
+Usage: fleet_replica_worker.py <elastic_root> <host_id>
+       <shard_endpoints_csv> <ready_file>
+"""
+
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+SLOTS = ("u", "i")
+DIM = 8
+
+
+def main() -> None:
+    elastic_root, host_id, shard_eps, ready_file = sys.argv[1:5]
+
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.serving.fleet import start_replica
+
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=16)
+    model = DeepFM(slot_names=SLOTS, emb_dim=DIM, hidden=())
+    dense = model.init(jax.random.PRNGKey(0))
+
+    server, manager = start_replica(
+        model, feed,
+        dense_params=dense,
+        shard_endpoints=[e for e in shard_eps.split(",") if e],
+        hbm_rows=24, dim=DIM,
+        elastic_root=elastic_root, host_id=host_id,
+        warm_lines=["0 u:1 i:2", "0 u:3 i:4"],
+        compute_dtype="float32")
+
+    tmp = ready_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(server.endpoint)
+    os.replace(tmp, ready_file)
+
+    # Serve until killed (the drill SIGKILLs us) or politely stopped.
+    try:
+        while True:
+            time.sleep(0.2)
+    finally:
+        if manager is not None:
+            manager.stop()
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,736 @@
+"""Serving fleet tier: router over replicas sharing one shard tier.
+
+Pins the contracts SERVING_FLEET.md documents: consistent-hash routing
+is deterministic (same key → same healthy replica), least-loaded
+spillover engages under skew, a dead replica is struck/ejected and its
+traffic re-routes INSIDE the client RPC, SLO admission sheds overflow
+to the degraded (HBM-hot-rows-only, ``degraded=true``) path, replicas
+resolving misses against the shared ShardServer tier serve values
+bit-identical to a flat full-table predictor (f32 wire), the router's
+stats fan-out merges per-replica registries into one cluster view, and
+a dim-grouped export serves through one replica (mixed-width slots).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.data.parser import parse_lines
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch, SlotConf
+from paddlebox_tpu.embedding.table import TableConfig
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.multihost.shard_service import (start_local_shards,
+                                                   stop_shards)
+from paddlebox_tpu.multihost.store import MultiHostStore
+from paddlebox_tpu.serving import (CTRPredictor, FleetRouter,
+                                   PredictClient, PredictServer,
+                                   ServingFleet, ShardBackedStore)
+from paddlebox_tpu.serving.fleet import HashRing, route_key_hash
+
+SLOTS = ("u", "i")
+N_KEYS = 400
+DIM = 8
+
+
+def _feed(bs=16):
+    return DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=bs)
+
+
+def _model():
+    return DeepFM(slot_names=SLOTS, emb_dim=DIM, hidden=())
+
+
+def _model_arrays(seed=3):
+    rng = np.random.default_rng(seed)
+    keys = np.arange(1, N_KEYS + 1, dtype=np.uint64)
+    emb = rng.normal(size=(N_KEYS, DIM)).astype(np.float32) * 0.02
+    w = rng.normal(size=(N_KEYS,)).astype(np.float32) * 0.02
+    return keys, emb, w
+
+
+def _dense(model):
+    import jax
+    return model.init(jax.random.PRNGKey(0))
+
+
+def _lines(rng, n, lo=1, hi=N_KEYS):
+    return [f"0 u:{rng.integers(lo, hi)} i:{rng.integers(lo, hi)}"
+            for _ in range(n)]
+
+
+@pytest.fixture()
+def shard_tier():
+    """A 2-host shared shard tier populated with the deterministic
+    model arrays (the trained-model stand-in every replica resolves
+    misses against)."""
+    cfg = TableConfig(name="emb", dim=DIM, learning_rate=0.1)
+    servers, eps = start_local_shards(2, cfg)
+    store = MultiHostStore(cfg, eps)
+    keys, emb, w = _model_arrays()
+    rows = store.pull_for_pass(keys)
+    rows["emb"] = emb.copy()
+    rows["w"] = w.copy()
+    store.push_from_pass(keys, rows)
+    yield eps
+    store.close()
+    stop_shards(servers)
+
+
+def _flat_predictor():
+    model = _model()
+    keys, emb, w = _model_arrays()
+    return CTRPredictor(model, _feed(), keys, emb, w, _dense(model),
+                        compute_dtype="float32")
+
+
+def _backed_predictor(eps, *, warm=32, hbm=24):
+    """A shard-backed replica predictor warm with only the first
+    ``warm`` keys — everything else resolves from the shared tier."""
+    model = _model()
+    keys, emb, w = _model_arrays()
+    return CTRPredictor(model, _feed(), keys[:warm], emb[:warm], w[:warm],
+                        _dense(model), compute_dtype="float32",
+                        hbm_rows=hbm,
+                        shard_backing=ShardBackedStore(eps, DIM))
+
+
+def test_ring_deterministic_and_minimal_remap():
+    ring3 = HashRing(["a", "b", "c"], 64)
+    ring3b = HashRing(["c", "a", "b"], 64)  # order-independent
+    hashes = [route_key_hash([f"0 u:{k} i:9"]) for k in range(1, 400)]
+    owners3 = [ring3.lookup(h) for h in hashes]
+    assert owners3 == [ring3b.lookup(h) for h in hashes]
+    # Removing one replica remaps ONLY the removed replica's keys —
+    # the consistent-hash property that preserves the survivors' warm
+    # tiers on eject.
+    ring2 = HashRing(["a", "b"], 64)
+    for h, o3 in zip(hashes, owners3):
+        o2 = ring2.lookup(h)
+        if o3 != "c":
+            assert o2 == o3
+
+
+def test_same_key_routes_to_same_replica(shard_tier):
+    preds = [_backed_predictor(shard_tier) for _ in range(3)]
+    servers = [PredictServer("127.0.0.1:0", p, replica_id=f"r{i}")
+               for i, p in enumerate(preds)]
+    router = FleetRouter("127.0.0.1:0",
+                         replicas=[s.endpoint for s in servers],
+                         start_health=False)
+    try:
+        rng = np.random.default_rng(11)
+        # Ten requests per distinct user key, interleaved: every repeat
+        # of a key must land on the same replica.
+        by_key = {}
+        for _ in range(10):
+            for uk in (7, 99, 250, 381):
+                out = router.handle_predict(
+                    {"lines": [f"0 u:{uk} i:{rng.integers(1, 300)}"]})
+                by_key.setdefault(uk, set()).add(out["replica"])
+                assert out["degraded"] is False
+        for uk, reps in by_key.items():
+            assert len(reps) == 1, (uk, reps)
+        # Distinct keys spread over more than one replica (64 vnodes ×
+        # 3 replicas: 4 keys landing on one replica has p ~ (1/3)^3).
+        assert len(set().union(*by_key.values())) >= 2
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+        for p in preds:
+            p.close()
+
+
+def test_spillover_under_skew_and_degraded_admission():
+    fleet = ServingFleet()
+    a = fleet.add_replica("a", "127.0.0.1:1", ready=True)
+    b = fleet.add_replica("b", "127.0.0.1:2", ready=True)
+    prev = flagmod.flag("fleet_spillover_inflight")
+    flagmod.set_flags({"fleet_spillover_inflight": 2})
+    try:
+        h = route_key_hash(["0 u:5 i:5"])
+        home = fleet.pick(h)[0]
+        other = b if home is a else a
+        # Fill the home replica to the ceiling: the next pick for the
+        # SAME key spills to the least-loaded healthy replica.
+        r2, mode2, deg2 = fleet.pick(h)
+        assert r2 is home and mode2 == "affinity"
+        r3, mode3, deg3 = fleet.pick(h)
+        assert r3 is other and mode3 == "spillover" and not deg3
+        snap = monitor.snapshot()
+        assert snap.get("fleet/spillover", 0) >= 1
+        # Saturate BOTH replicas: with the home replica's SLO admission
+        # tripped, its overflow is shed to the degraded path instead of
+        # queueing; with admission ok it queues (backpressure).
+        fleet.pick(h); fleet.pick(h)
+        assert home.inflight >= 2 and other.inflight >= 2
+        r, _m, deg = fleet.pick(h)
+        assert not deg            # admission ok -> queue, not shed
+        fleet.release(r)
+        home.admission = "degraded"
+        r, _m, deg = fleet.pick(h)
+        assert deg is True
+        assert monitor.snapshot().get("fleet/degraded", 0) >= 1
+    finally:
+        flagmod.set_flags({"fleet_spillover_inflight": prev})
+        fleet.stop()
+
+
+def test_slo_admission_window_trips_and_recovers():
+    fleet = ServingFleet(stats_call=lambda r: next(stats_iter))
+    r = fleet.add_replica("a", "127.0.0.1:1", ready=True)
+    prev = {k: flagmod.flag(k) for k in ("fleet_slo_window_s",
+                                         "fleet_slo_trip")}
+    flagmod.set_flags({"fleet_slo_window_s": 0.05, "fleet_slo_trip": 3})
+    try:
+        # Baseline read, then +5 violations in one window: trips.
+        stats_iter = iter([{"slo_violations": 10},
+                           {"slo_violations": 15}])
+        fleet.health_check_once()
+        assert r.admission == "ok"      # first read only sets baseline
+        fleet.health_check_once()
+        assert r.admission == "degraded"
+        # One clean (zero-delta) full window restores.
+        time.sleep(0.06)
+        stats_iter = iter([{"slo_violations": 15}])
+        fleet.health_check_once()
+        assert r.admission == "ok"
+    finally:
+        flagmod.set_flags(prev)
+        fleet.stop()
+
+
+def test_kill_replica_reroutes_in_rpc_and_ejects(shard_tier):
+    """Hard-stop one replica under traffic: the routed predict that
+    hits the dead socket re-routes to a live replica inside the SAME
+    client RPC (zero failed RPCs), the dead replica is struck to
+    ejection, and the epoch bumps so clients re-resolve."""
+    preds = [_backed_predictor(shard_tier) for _ in range(3)]
+    servers = [PredictServer("127.0.0.1:0", p, replica_id=f"r{i}")
+               for i, p in enumerate(preds)]
+    router = FleetRouter("127.0.0.1:0",
+                         replicas=[s.endpoint for s in servers],
+                         start_health=False)
+    cli = PredictClient(router.endpoint)
+    try:
+        rng = np.random.default_rng(5)
+        lines_by_key = {uk: [f"0 u:{uk} i:{rng.integers(1, 300)}"]
+                        for uk in range(1, 40)}
+        owners = {uk: router.handle_predict({"lines": ln})["replica"]
+                  for uk, ln in lines_by_key.items()}
+        # Kill the replica that owns at least one key: stop its
+        # listener AND drop the router's pooled connections to it — the
+        # next forward meets a refused connect, exactly what a pooled
+        # conn to a kill -9'd process meets (the REAL SIGKILL drill is
+        # tests/test_fleet_drill.py).
+        victim_id = owners[1]
+        vic_i = int(victim_id.split("-")[1])
+        servers[vic_i].stop()
+        router.fleet.get(victim_id).pool.close()
+        epoch_before = router.fleet.epoch
+        failures = 0
+        rerouted = []
+        for uk, ln in lines_by_key.items():
+            try:
+                out = cli.predict(ln)
+                assert out.shape == (1,)
+                if owners[uk] == victim_id:
+                    rerouted.append((uk, cli.last_replica))
+            except Exception:
+                failures += 1
+        assert failures == 0
+        assert rerouted, "victim owned no keys — test is vacuous"
+        assert all(rep != victim_id for _uk, rep in rerouted)
+        vic = router.fleet.get(victim_id)
+        assert vic.state == "ejected"
+        assert router.fleet.epoch > epoch_before
+        # Routing to the survivors stays deterministic post-eject.
+        for uk, ln in lines_by_key.items():
+            if owners[uk] != victim_id:
+                cli.predict(ln)
+                assert cli.last_replica == owners[uk]
+    finally:
+        cli.close()
+        router.stop()
+        for s in servers:
+            s.stop()
+        for p in preds:
+            p.close()
+
+
+def test_shard_backed_matches_flat_f32_and_int8(shard_tier):
+    """A replica warm with 8% of the model, resolving misses from the
+    shared shard tier, serves BIT-identical probabilities to a flat
+    full-table predictor at the f32 wire; the int8 wire stays within
+    quantization tolerance and moves fewer bytes per key."""
+    flat = _flat_predictor()
+    backed = _backed_predictor(shard_tier)
+    feed = backed.feed
+    rng = np.random.default_rng(17)
+    lines = _lines(rng, 16)
+    batch = SlotBatch.pack(parse_lines(lines, feed), feed)
+    monitor.reset()
+    ref = np.asarray(flat.predict(batch))
+    got = np.asarray(backed.predict(batch))
+    np.testing.assert_array_equal(got, ref)
+    snap = monitor.snapshot()
+    assert snap.get("serving/shard_miss_keys", 0) > 0
+    f32_bytes = snap.get("serving/shard_miss_bytes", 0)
+    assert f32_bytes > 0
+    # Unknown keys (never trained) still serve the zero row.
+    unk = [f"0 u:{N_KEYS + 50} i:{N_KEYS + 60}"]
+    ub = SlotBatch.pack(parse_lines(unk, feed), feed)
+    np.testing.assert_array_equal(
+        np.asarray(backed.predict(ub))[:1],
+        np.asarray(flat.predict(ub))[:1])
+    # int8 wire: tolerance parity, fewer bytes per resolved key.
+    prev = flagmod.flag("multihost_wire_dtype")
+    flagmod.set_flags({"multihost_wire_dtype": "int8"})
+    try:
+        backed8 = _backed_predictor(shard_tier)
+        monitor.reset()
+        got8 = np.asarray(backed8.predict(batch))
+        np.testing.assert_allclose(got8, ref, atol=5e-3)
+        snap8 = monitor.snapshot()
+        keys8 = snap8.get("serving/shard_miss_keys", 0)
+        assert keys8 > 0
+        assert (snap8["serving/shard_miss_bytes"] / keys8
+                < f32_bytes / snap["serving/shard_miss_keys"])
+        backed8.close()
+    finally:
+        flagmod.set_flags({"multihost_wire_dtype": prev})
+    flat.close()
+    backed.close()
+
+
+def test_shard_backed_promotion_and_delta_routing(shard_tier):
+    """Promotion admits hot missed keys by COPY (the shared tier is
+    never mutated), and a delta lands only on locally materialized rows
+    — the rest is bypassed (the tier already has the training push)."""
+    backed = _backed_predictor(shard_tier, warm=16, hbm=8)
+    feed = backed.feed
+    tiers = backed._tiers
+    rng = np.random.default_rng(23)
+    hot_key = 300   # beyond the warm set: resolves via the tier
+    for _ in range(6):
+        lines = [f"0 u:{hot_key} i:{rng.integers(1, 200)}"]
+        backed.predict(SlotBatch.pack(parse_lines(lines, feed), feed))
+    assert tiers._miss_counts.get(hot_key, 0) >= 6
+    n = backed.promote_now()
+    assert n >= 1
+    assert hot_key in tiers._hot_keys
+    # The shared tier still owns the row (copy, not take).
+    bfound, _ = tiers.backing.read(
+        np.asarray([hot_key], np.uint64))
+    assert bfound[0]
+    # Delta: hot row updated in place, unmaterialized keys bypassed.
+    monitor.reset()
+    keys = np.asarray([hot_key, 399], np.uint64)  # 399 never touched
+    emb = np.full((2, DIM), 0.5, np.float32)
+    w = np.asarray([0.25, 0.25], np.float32)
+    n_new = backed.apply_update(keys, emb, w)
+    assert n_new == 0
+    assert monitor.snapshot().get("serving/delta_bypassed", 0) == 1
+    row = np.asarray(
+        tiers.table[int(tiers._hot_rows[
+            np.searchsorted(tiers._hot_keys, hot_key)])])
+    np.testing.assert_allclose(row[:DIM], 0.5)
+    backed.close()
+
+
+def test_degraded_predict_serves_hot_rows_only(shard_tier):
+    """The degraded path: misses read the default (zero) row with no
+    warm/cold/backing resolution — the reply a router flags
+    degraded=true — and the wire carries the flag end to end."""
+    backed = _backed_predictor(shard_tier, warm=16, hbm=16)
+    feed = backed.feed
+    # A key outside the warm/hot set: normal predict resolves it from
+    # the tier; degraded predict serves the zero row instead, which
+    # must equal what an all-unknown flat predictor answers.
+    lines = ["0 u:350 i:360"]
+    batch = SlotBatch.pack(parse_lines(lines, feed), feed)
+    monitor.reset()
+    normal = np.asarray(backed.predict(batch))
+    deg = np.asarray(backed.predict(batch, degraded=True))
+    assert monitor.snapshot().get("serving/degraded_rows", 0) > 0
+    model = _model()
+    keys, emb, w = _model_arrays()
+    empty = CTRPredictor(model, feed, keys[:1], emb[:1], w[:1],
+                         _dense(model), compute_dtype="float32")
+    want = np.asarray(empty.predict(batch))
+    np.testing.assert_array_equal(deg[:1], want[:1])
+    assert not np.array_equal(normal[:1], deg[:1])
+    # End to end through router + wire: force the degraded decision.
+    server = PredictServer("127.0.0.1:0", backed, replica_id="r0")
+    router = FleetRouter("127.0.0.1:0", replicas=[server.endpoint],
+                         start_health=False)
+    cli = PredictClient(router.endpoint)
+    prev = flagmod.flag("fleet_spillover_inflight")
+    flagmod.set_flags({"fleet_spillover_inflight": 1})
+    try:
+        rep = router.fleet.get("replica-0")
+        rep.admission = "degraded"
+        rep.inflight = 5           # past the ceiling: overflow -> shed
+        out = cli.predict(lines)
+        assert cli.last_degraded is True
+        np.testing.assert_array_equal(out, deg[:1])
+        rep.inflight = 0
+        rep.admission = "ok"
+        out2 = cli.predict(lines)
+        assert cli.last_degraded is False
+        np.testing.assert_array_equal(out2, normal[:1])
+    finally:
+        flagmod.set_flags({"fleet_spillover_inflight": prev})
+        cli.close()
+        router.stop()
+        server.stop()
+        backed.close()
+        empty.close()
+
+
+def test_join_mid_traffic_bit_identical(shard_tier):
+    """A replica joining a live fleet (register -> health admit) serves
+    bit-identical probabilities to the incumbents and starts taking its
+    ring share; incumbents keep their keys (minimal remap)."""
+    preds = [_backed_predictor(shard_tier) for _ in range(2)]
+    servers = [PredictServer("127.0.0.1:0", p, replica_id=f"r{i}")
+               for i, p in enumerate(preds)]
+    router = FleetRouter("127.0.0.1:0",
+                         replicas=[s.endpoint for s in servers],
+                         start_health=False)
+    try:
+        rng = np.random.default_rng(31)
+        test_lines = [_lines(rng, 4) for _ in range(6)]
+        before = {i: router.handle_predict({"lines": ln})
+                  for i, ln in enumerate(test_lines)}
+        # Join: a third replica registers (joining) and is admitted by
+        # the health sweep once its stats answer.
+        p3 = _backed_predictor(shard_tier)
+        s3 = PredictServer("127.0.0.1:0", p3, replica_id="r2")
+        epoch_before = router.fleet.epoch
+        router.fleet.add_replica("replica-2", s3.endpoint)
+        assert router.fleet.get("replica-2").state == "joining"
+        router.fleet.health_check_once()
+        assert router.fleet.get("replica-2").state == "healthy"
+        assert router.fleet.epoch > epoch_before
+        # Bit-identical: the joiner answers exactly what an incumbent
+        # answered for the same lines (direct, no router).
+        c_new = PredictClient(s3.endpoint)
+        c_old = PredictClient(servers[0].endpoint)
+        for ln in test_lines:
+            np.testing.assert_array_equal(c_new.predict(ln),
+                                          c_old.predict(ln))
+        c_new.close()
+        c_old.close()
+        # Keys NOT remapped to the joiner stay on their old replica.
+        after = {i: router.handle_predict({"lines": ln})
+                 for i, ln in enumerate(test_lines)}
+        moved = 0
+        for i in before:
+            np.testing.assert_array_equal(before[i]["probs"],
+                                          after[i]["probs"])
+            if after[i]["replica"] != before[i]["replica"]:
+                moved += 1
+                assert after[i]["replica"] == "replica-2"
+        # The joiner eventually serves (drive enough keys through).
+        hit = any(router.handle_predict(
+            {"lines": [f"0 u:{k} i:1"]})["replica"] == "replica-2"
+            for k in range(1, 200))
+        assert hit
+        s3.stop()
+        p3.close()
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+        for p in preds:
+            p.close()
+
+
+def test_cluster_stats_merge(shard_tier):
+    """Router handle_stats = one merge_snapshots view: per-replica
+    predict counts SUM, latency digests MERGE, and slo violations are
+    fleet-wide — while per-replica briefs expose the skew."""
+    prev = flagmod.flag("serving_slo_p99_ms")
+    preds = [_backed_predictor(shard_tier) for _ in range(2)]
+    servers = [PredictServer("127.0.0.1:0", p, replica_id=f"r{i}")
+               for i, p in enumerate(preds)]
+    router = FleetRouter("127.0.0.1:0",
+                         replicas=[s.endpoint for s in servers],
+                         start_health=False)
+    cli = PredictClient(router.endpoint)
+    try:
+        rng = np.random.default_rng(41)
+        n = 12
+        flagmod.set_flags({"serving_slo_p99_ms": 1e-6})  # all violate
+        for _ in range(n):
+            cli.predict(_lines(rng, 2))
+        st = cli.stats()
+        assert st["fleet_size"] == 2
+        assert st["predict_rpcs"] == n
+        assert st["slo_violations"] == n
+        assert st["latency_ms"]["p50"] and st["latency_ms"]["p50"] > 0
+        assert st["route_ms"]["p50"] and st["route_ms"]["p50"] > 0
+        merged = st["merged"]
+        assert merged["ranks"] == 2
+        assert merged["counters"]["serving/predict_rpcs"] == n
+        assert merged["quantiles"]["serving/predict_ms"]["count"] == n
+        per_rep = sum(b["stats"]["predict_rpcs"]
+                      for b in st["replicas"].values())
+        assert per_rep == n
+    finally:
+        flagmod.set_flags({"serving_slo_p99_ms": prev})
+        cli.close()
+        router.stop()
+        for s in servers:
+            s.stop()
+        for p in preds:
+            p.close()
+
+
+def test_elastic_discovery_and_leave(tmp_path, shard_tier):
+    """Replicas advertise serving_endpoint through the elastic
+    heartbeat meta; the fleet adopts the published table (join), and a
+    host leaving the table is removed (clean leave)."""
+    from paddlebox_tpu.launch.elastic import ElasticManager
+    root = str(tmp_path / "elastic")
+    pred = _backed_predictor(shard_tier)
+    server = PredictServer("127.0.0.1:0", pred, replica_id="hostA")
+    m = ElasticManager(root, "hostA", heartbeat_interval=0.05,
+                       timeout=1.0, settle=0.05,
+                       meta={"serving_endpoint": server.endpoint})
+    m.start()
+    fleet = ServingFleet(elastic_root=root)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if fleet.discover_once():
+                break
+            time.sleep(0.05)
+        r = fleet.get("hostA")
+        assert r is not None and r.state == "joining"
+        assert r.endpoint == server.endpoint
+        fleet.health_check_once()
+        assert fleet.get("hostA").state == "healthy"
+        # Clean leave: lease removed -> host drops from the table ->
+        # discovery removes the replica.
+        m.stop(remove_lease=True)
+        # hostA was also the leader; with no hosts left nobody
+        # publishes a new table, so simulate the next generation the
+        # way a surviving leader would: another member publishes a
+        # table without hostA.
+        m2 = ElasticManager(root, "hostB", heartbeat_interval=0.05,
+                            timeout=0.4, settle=0.05)
+        m2.start()
+        deadline = time.time() + 10
+        left = False
+        while time.time() < deadline:
+            fleet.discover_once()
+            if fleet.get("hostA") is None:
+                left = True
+                break
+            time.sleep(0.05)
+        assert left
+        m2.stop()
+    finally:
+        fleet.stop()
+        server.stop()
+        pred.close()
+
+
+def test_client_reresolves_through_router_topology(shard_tier):
+    """The PR-5 retry fix-up: a direct-to-replica client whose replica
+    was ejected re-resolves through the router's topology on reconnect
+    and lands the retried predict on a live replica — instead of
+    burning the whole retry deadline on the dead endpoint."""
+    preds = [_backed_predictor(shard_tier) for _ in range(2)]
+    servers = [PredictServer("127.0.0.1:0", p, replica_id=f"r{i}")
+               for i, p in enumerate(preds)]
+    router = FleetRouter("127.0.0.1:0",
+                         replicas=[s.endpoint for s in servers],
+                         start_health=False)
+    cli = PredictClient(servers[0].endpoint, router=router.endpoint)
+    try:
+        rng = np.random.default_rng(7)
+        lines = _lines(rng, 3)
+        want = cli.predict(lines)
+        # Kill replica 0 (listener down + this client's established
+        # conn dropped, as a SIGKILL would) and eject it from the fleet
+        # (as the health thread would); the client's NEXT predict must
+        # succeed via re-resolution to replica 1.
+        servers[0].stop()
+        cli._conn.close()
+        vic = router.fleet.get("replica-0")
+        router.fleet.strike(vic)
+        router.fleet.strike(vic)
+        assert vic.state == "ejected"
+        got = cli.predict(lines)   # idempotent retry + re-resolve
+        np.testing.assert_array_equal(got, want)
+        assert cli._conn.endpoint == servers[1].endpoint
+    finally:
+        cli.close()
+        router.stop()
+        for s in servers:
+            s.stop()
+        for p in preds:
+            p.close()
+
+
+def test_grouped_export_serves_mixed_dims(tmp_path):
+    """Satellite: a dim-grouped (dynamic-mf) xbox export serves through
+    ONE predictor — per-slot widths routed to their group tables, bit-
+    equal to a hand-gathered model.apply, and grouped deltas land on
+    the right group."""
+    from paddlebox_tpu.serving.predictor import GroupedCTRPredictor
+    import jax
+
+    gslots = ("narrow_a", "narrow_b", "wide")
+    dims = {"narrow_a": 8, "narrow_b": 8, "wide": 32}
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0,
+                             emb_dim=(32 if s == "wide" else None))
+                    for s in gslots),
+        batch_size=8)
+    model = DeepFM(slot_names=gslots, emb_dim=dims, hidden=())
+    dense = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(9)
+    groups = {}
+    for d in (8, 32):
+        n = 60
+        keys = np.arange(1, n + 1, dtype=np.uint64)
+        emb = rng.normal(size=(n, d)).astype(np.float32) * 0.05
+        w = rng.normal(size=(n,)).astype(np.float32) * 0.05
+        groups[d] = (keys, emb, w)
+    pred = GroupedCTRPredictor(model, feed, groups, dense,
+                               compute_dtype="float32")
+    assert pred.dims == [8, 32]
+    assert pred.num_keys == 120
+    lines = [f"0 narrow_a:{rng.integers(1, 80)} "
+             f"narrow_b:{rng.integers(1, 80)} wide:{rng.integers(1, 80)}"
+             for _ in range(7)]
+    # One crafted row hits key 1 in BOTH width groups, so the grouped
+    # delta below provably changes the served output.
+    lines.append("0 narrow_a:1 narrow_b:2 wide:1")
+    batch = SlotBatch.pack(parse_lines(lines, feed), feed)
+    got = np.asarray(pred.predict(batch))
+    # Hand-gathered reference: per-slot rows from that slot's group
+    # arrays (unknown keys -> zero rows), straight through model.apply.
+    emb_ref, w_ref = {}, {}
+    for s in gslots:
+        d = dims[s]
+        k, e, w = groups[d]
+        ids = batch.ids[s]
+        rows = np.zeros((ids.shape[0], d), np.float32)
+        wv = np.zeros((ids.shape[0],), np.float32)
+        for i, fid in enumerate(ids):
+            j = np.searchsorted(k, fid)
+            if j < k.shape[0] and k[j] == fid and fid != 0:
+                rows[i] = e[j]
+                wv[i] = w[j]
+        emb_ref[s] = rows
+        w_ref[s] = wv
+    import jax.numpy as jnp
+    segs = {s: jnp.asarray(batch.segments[s]) for s in gslots}
+    from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
+    logits = model.apply(dense, {s: jnp.asarray(v)
+                                 for s, v in emb_ref.items()},
+                         {s: jnp.asarray(v) for s, v in w_ref.items()},
+                         segs, batch_size=batch.batch_size,
+                         dense_feats=jnp.asarray(
+                             _concat_dense_host(batch)))
+    want = np.asarray(jax.nn.sigmoid(logits.astype(jnp.float32)))
+    np.testing.assert_array_equal(got, want)
+    # Grouped delta export round-trip: write dimD subdirs the way
+    # GroupedStore does and hot-swap them through apply_update_export.
+    delta_root = str(tmp_path / "delta")
+    for d in (8, 32):
+        sub = os.path.join(delta_root, f"dim{d}")
+        os.makedirs(sub, exist_ok=True)
+        dk = np.asarray([1, 200], np.uint64)      # 200 is new
+        de = np.full((2, d), 0.25, np.float32)
+        dw = np.asarray([0.5, 0.5], np.float32)
+        np.savez(os.path.join(sub, f"embedding_dim{d}.delta.npz"),
+                 keys=dk, emb=de, w=dw)
+    n_new = pred.apply_update_export(delta_root, "embedding", "delta")
+    assert n_new == 2                      # one new key per group
+    assert pred.num_keys == 122
+    got2 = np.asarray(pred.predict(batch))
+    assert not np.array_equal(got, got2)   # key 1 moved in both groups
+    # A single-width update routes by its column count.
+    n3 = pred.apply_update(np.asarray([2], np.uint64),
+                           np.full((1, 32), 0.1, np.float32),
+                           np.asarray([0.1], np.float32))
+    assert n3 == 0
+    # from_dirs auto-detects the grouped layout (what
+    # load_serving_predictor hits on a dynamic-mf export_serving dir).
+    xbox_root = str(tmp_path / "xbox")
+    for d in (8, 32):
+        sub = os.path.join(xbox_root, f"dim{d}")
+        os.makedirs(sub, exist_ok=True)
+        k, e, w = groups[d]
+        np.savez(os.path.join(sub, f"embedding_dim{d}.xbox.npz"),
+                 keys=k, emb=e, w=w)
+    loaded = CTRPredictor.from_dirs(model, feed, xbox_root,
+                                    dense_params=dense,
+                                    compute_dtype="float32")
+    assert isinstance(loaded, GroupedCTRPredictor)
+    np.testing.assert_array_equal(np.asarray(loaded.predict(batch)),
+                                  got)
+    loaded.close()
+    pred.close()
+
+
+def test_start_replica_helper(tmp_path, shard_tier):
+    """start_replica: base export + shard backing + warm-up + elastic
+    registration in one call (what the drill worker and a real replica
+    process run)."""
+    from paddlebox_tpu.serving import start_replica
+    model = _model()
+    keys, emb, w = _model_arrays()
+    base = str(tmp_path / "xbox")
+    os.makedirs(base, exist_ok=True)
+    np.savez(os.path.join(base, "embedding.xbox.npz"),
+             keys=keys[:32], emb=emb[:32], w=w[:32])
+    server, mgr = start_replica(
+        model, _feed(), base_export=base, dense_params=_dense(model),
+        shard_endpoints=shard_tier, hbm_rows=16,
+        elastic_root=str(tmp_path / "el"), host_id="repA",
+        warm_lines=["0 u:1 i:2"], compute_dtype="float32")
+    try:
+        assert mgr is not None
+        flat = _flat_predictor()
+        cli = PredictClient(server.endpoint)
+        rng = np.random.default_rng(2)
+        lines = _lines(rng, 4)
+        got = cli.predict(lines)
+        want = flat.predict(SlotBatch.pack(parse_lines(lines, _feed()),
+                                           _feed()))[:4]
+        np.testing.assert_array_equal(got, np.asarray(want))
+        cli.close()
+        flat.close()
+        # The heartbeat advertises the serving endpoint.
+        deadline = time.time() + 10
+        fleet = ServingFleet(elastic_root=str(tmp_path / "el"))
+        seen = False
+        while time.time() < deadline:
+            fleet.discover_once()
+            r = fleet.get("repA")
+            if r is not None:
+                assert r.endpoint == server.endpoint
+                seen = True
+                break
+            time.sleep(0.05)
+        assert seen
+        fleet.stop()
+    finally:
+        if mgr is not None:
+            mgr.stop()
+        server.stop()
+        server.predictor.close()

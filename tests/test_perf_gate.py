@@ -293,3 +293,53 @@ def test_serve_client_keys_direction_and_gating(tmp_path):
         assert perf_gate.main(
             [_write(tmp_path, f"srv_bad_{key}.json", bad),
              "--baseline", b]) == 1, key
+
+
+def test_fleet_replica_keys_direction_and_gating(tmp_path):
+    """Round-16 fleet keys: the `bench.py serve --replicas R` record
+    gates aggregate throughput_rps / rows_per_s / batch_fill_frac as
+    higher-better, router route_ms quantiles and the degraded-path
+    share as lower-better; client/request counts are provenance and
+    never gate."""
+    assert perf_gate.direction("replicas.r2.throughput_rps") == 1
+    assert perf_gate.direction("replicas.r2.rows_per_s") == 1
+    assert perf_gate.direction("replicas.r2.batch_fill_frac") == 1
+    assert perf_gate.direction("replicas.r2.route_ms_quantiles.p50") == -1
+    assert perf_gate.direction("replicas.r2.route_ms_quantiles.p99") == -1
+    assert perf_gate.direction("replicas.r2.degraded_frac") == -1
+    assert perf_gate.direction("replicas.r2.clients") == 0
+    assert perf_gate.direction("replicas.r2.requests") == 0
+    base = {"value": 90000.0,
+            "replicas": {
+                "r1": {"throughput_rps": 4200.0, "rows_per_s": 268800.0,
+                       "route_ms_quantiles": {"p50": 1.2, "p99": 6.0},
+                       "batch_fill_frac": 0.8, "degraded_frac": 0.0,
+                       "clients": 4, "requests": 12600},
+                "r2": {"throughput_rps": 7800.0, "rows_per_s": 499200.0,
+                       "route_ms_quantiles": {"p50": 1.4, "p99": 7.0},
+                       "batch_fill_frac": 0.75, "degraded_frac": 0.0,
+                       "clients": 8, "requests": 23400}}}
+    b = _write(tmp_path, "fleet_base.json", base)
+    assert perf_gate.main(
+        [_write(tmp_path, "fleet_same.json", base),
+         "--baseline", b]) == 0
+    # Provenance wobble (window completed fewer requests) never gates.
+    ok = copy.deepcopy(base)
+    ok["replicas"]["r2"]["requests"] = 11000
+    ok["replicas"]["r2"]["clients"] = 6
+    assert perf_gate.main([_write(tmp_path, "fleet_ok.json", ok),
+                           "--baseline", b]) == 0
+    for key, val in (("throughput_rps", 2000.0),
+                     ("rows_per_s", 120000.0),
+                     ("batch_fill_frac", 0.2),
+                     ("degraded_frac", 0.4)):
+        bad = copy.deepcopy(base)
+        bad["replicas"]["r2"][key] = val
+        assert perf_gate.main(
+            [_write(tmp_path, f"fleet_bad_{key}.json", bad),
+             "--baseline", b]) == 1, key
+    bad = copy.deepcopy(base)
+    bad["replicas"]["r2"]["route_ms_quantiles"]["p99"] = 60.0
+    assert perf_gate.main(
+        [_write(tmp_path, "fleet_bad_route.json", bad),
+         "--baseline", b]) == 1

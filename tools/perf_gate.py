@@ -61,7 +61,10 @@ HIGHER_SUFFIXES = ("_per_s", "per_sec", "samples_per_s", "auc",
 LOWER_SUFFIXES = ("_ms", "_s", "_bytes", "idle_frac",
                   "host_critical_share", "blocked_up_frac",
                   "blocked_down_frac", "violations", "host_syncs",
-                  "overflow")
+                  "overflow",
+                  # serving fleet: a growing degraded-path share means
+                  # the SLO-shed path is serving more of the traffic.
+                  "degraded_frac")
 # Exact-name entries (dotted-path last segment).
 HIGHER_NAMES = ("value",)  # bench headline — every config is throughput
 # graftlint summary JSON (python -m tools.graftlint --summary): finding
@@ -193,6 +196,18 @@ def smoke() -> int:
             "clients": {"c32": {"throughput_rps": 4000.0,
                                 "predict_p99_ms": 12.0,
                                 "batch_fill_frac": 0.8}},
+            # bench serve --replicas keys (r16 fleet tier): aggregate
+            # rps higher-better, router route_ms quantiles lower-better
+            # (unit in the parent segment), degraded share lower-better;
+            # clients/requests are workload provenance and must NOT
+            # gate.
+            "replicas": {"r2": {"throughput_rps": 7800.0,
+                                "route_ms_quantiles": {"p50": 2.0,
+                                                       "p99": 9.0},
+                                "batch_fill_frac": 0.7,
+                                "degraded_frac": 0.0,
+                                "clients": 8,
+                                "requests": 23400}},
             # bench multihost --hosts keys (r15 multi-host tier):
             # *_bytes_per_s / *_keys_per_s gate higher-better through
             # "_per_s" (checked BEFORE the lower-better "_bytes"/"_s"
@@ -241,6 +256,10 @@ def smoke() -> int:
     bad["wire"]["f32"]["cross_host_exchange_bytes_per_s"] *= 0.3
     bad["reshard_ms"] = 200.0
     bad["reshard_moved_rows"] = 99999  # provenance: must NOT gate
+    bad["replicas"]["r2"]["throughput_rps"] *= 0.4
+    bad["replicas"]["r2"]["route_ms_quantiles"]["p99"] = 90.0
+    bad["replicas"]["r2"]["degraded_frac"] = 0.5
+    bad["replicas"]["r2"]["clients"] = 2      # provenance: must NOT gate
     _, regs = compare(bad, base)
     names = {r["metric"] for r in regs}
     for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
@@ -248,11 +267,14 @@ def smoke() -> int:
                  "store_build_keys_per_s", "clients.c32.throughput_rps",
                  "clients.c32.batch_fill_frac",
                  "wire.f32.cross_host_exchange_bytes_per_s",
-                 "reshard_ms"):
+                 "reshard_ms",
+                 "replicas.r2.throughput_rps",
+                 "replicas.r2.route_ms_quantiles.p99",
+                 "replicas.r2.degraded_frac"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
     for never in ("ingest_workers", "store_build_native",
-                  "reshard_moved_rows"):
+                  "reshard_moved_rows", "replicas.r2.clients"):
         expect(f"provenance {never!r} not gated", never in names, False)
     # An IMPROVEMENT must never trip the gate.
     good = json.loads(json.dumps(base))
