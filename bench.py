@@ -182,6 +182,7 @@ SELF_BASELINE = {
     "wide_deep": None,
     "graph_walk": None,
     "serving": None,
+    "online": None,
 }
 
 # First-recorded numbers (tools/record_baselines.py writes them as soon
@@ -1503,6 +1504,128 @@ def bench_multihost() -> dict:
     }
 
 
+ONLINE_DAYS = 3                  # replayed log days (TTL needs >= 3)
+ONLINE_PASS_FILES = 2            # files per carved incremental pass
+ONLINE_FILES_PER_DAY = 4 if _SMALL else 8
+ONLINE_BATCH = 128 if _SMALL else 512
+ONLINE_ROWS_PER_FILE = ONLINE_BATCH * (2 if _SMALL else 4)
+ONLINE_SLOTS = 4
+ONLINE_KEYS_PER_DAY = 2_000 if _SMALL else 20_000
+
+
+def bench_online() -> dict:
+    """Streaming online-learning mode (ONLINE.md): replay a fixed
+    multi-day event log as a stream through StreamRunner — every carved
+    incremental pass trains and publishes a delta through the donefile
+    path serving tails — and record the freshness/lifecycle numbers the
+    roadmap asked for: event→servable latency quantiles, passes/hour,
+    and the post-shrink store row count that proves TTL/decay bounds
+    the table under infinite traffic (each day's keys churn, so without
+    the lifecycle the store would grow ~linearly in days)."""
+    import jax
+
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.embedding import TableConfig
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.stream import StreamRunner
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+    rng = np.random.default_rng(0)
+    slot_names = tuple(f"s{i}" for i in range(ONLINE_SLOTS))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in slot_names),
+        batch_size=ONLINE_BATCH)
+    model = DeepFM(slot_names=slot_names, emb_dim=8, hidden=(32,))
+    mesh = build_mesh(HybridTopology(dp=len(jax.devices())))
+    trainer = CTRTrainer(model, feed,
+                         TableConfig(name="emb", dim=8,
+                                     learning_rate=0.05),
+                         mesh=mesh,
+                         config=TrainerConfig(auc_num_buckets=1 << 10))
+    trainer.init(seed=0)
+
+    def write_day_files(log_dir, day_idx):
+        """One day of events: keys drawn from a per-day sliding window
+        (half the window carries over, half churns) so TTL has real
+        unseen traffic to expire."""
+        lo = 1 + day_idx * ONLINE_KEYS_PER_DAY // 2
+        keys = np.arange(lo, lo + ONLINE_KEYS_PER_DAY, dtype=np.uint64)
+        files = []
+        for i in range(ONLINE_FILES_PER_DAY):
+            ids = rng.choice(keys, (ONLINE_ROWS_PER_FILE, ONLINE_SLOTS))
+            labels = _planted_labels(rng, ids[:, 0])
+            line = labels.astype("U1")
+            for j in range(ONLINE_SLOTS):
+                line = np.char.add(line, f" s{j}:")
+                line = np.char.add(line, ids[:, j].astype("U20"))
+            # Atomic appearance (write-tmp-then-rename), the tailer's
+            # documented arrival convention.
+            name = f"day{day_idx}-{i:04d}.log"
+            tmp = os.path.join(log_dir, "." + name + ".tmp")
+            with open(tmp, "w") as f:
+                f.write("\n".join(line.tolist()) + "\n")
+            final = os.path.join(log_dir, name)
+            os.replace(tmp, final)
+            files.append(final)
+        return files
+
+    from paddlebox_tpu.core import flags as flagmod
+    prev = {k: flagmod.flag(k) for k in
+            ("stream_pass_events", "table_ttl_days")}
+    out_rows = {}
+    with tempfile.TemporaryDirectory() as tmpdir:
+        log_dir = os.path.join(tmpdir, "events")
+        os.makedirs(log_dir)
+        runner = StreamRunner(
+            trainer, feed, os.path.join(tmpdir, "out"), log_dir=log_dir,
+            day_of=lambda p: os.path.basename(p).split("-")[0],
+            shuffle=False, num_reader_threads=2)
+        try:
+            flagmod.set_flags({
+                "stream_pass_events":
+                    ONLINE_PASS_FILES * ONLINE_ROWS_PER_FILE,
+                "table_ttl_days": 1})
+            _tick("online:stream")
+            t0 = time.perf_counter()
+            passes = 0
+            for d in range(ONLINE_DAYS):
+                write_day_files(log_dir, d)
+                passes += runner.poll_once(flush=True)
+                runner.end_day()
+                out_rows[f"day{d}"] = int(
+                    trainer.engine.store.num_features)
+                _tick(f"online:day{d}")
+            wall = time.perf_counter() - t0
+        finally:
+            flagmod.set_flags(prev)
+        store_rows = int(trainer.engine.store.num_features)
+
+    events = ONLINE_DAYS * ONLINE_FILES_PER_DAY * ONLINE_ROWS_PER_FILE
+    fresh = runner.freshness_quantiles() or {}
+    eps = events / wall
+    return {
+        "metric": "online_stream_events_per_sec",
+        "value": round(eps, 1),
+        "unit": "events/s",
+        "vs_baseline": _vs("online", eps),
+        "event_to_servable_ms": {
+            k: (round(v, 1) if v is not None else None)
+            for k, v in fresh.items() if k in ("p50", "p99")},
+        "passes_per_hour": round(passes / wall * 3600.0, 1),
+        "post_shrink_store_rows": store_rows,
+        "day1_rows": out_rows.get("day0"),
+        "day3_over_day1_rows": (
+            round(out_rows["day%d" % (ONLINE_DAYS - 1)]
+                  / max(out_rows["day0"], 1), 4)
+            if "day0" in out_rows else None),
+        "stream_passes": passes,
+        "events": events,
+        "table_ttl_days": 1,
+        "n_devices": len(jax.devices()),
+    }
+
+
 CONFIGS = {
     "deepfm": bench_deepfm,
     "resnet50": bench_resnet50,
@@ -1513,6 +1636,7 @@ CONFIGS = {
     "serving": bench_serving,
     "serve": bench_serving,  # alias: `bench.py serve --clients 1,8,32`
     "multihost": bench_multihost,  # `bench.py multihost --hosts N`
+    "online": bench_online,        # streaming freshness/lifecycle mode
 }
 
 

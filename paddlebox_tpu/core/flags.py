@@ -549,6 +549,42 @@ define_flag("filestore_chunk_bytes", 1 << 24,
             "gathered cluster snapshot can never exceed one framed "
             "message or one atomic-rename window. <= 0 disables "
             "chunking")
+define_flag("stream_pass_events", 0,
+            "streaming ingest (stream/source.py): close an incremental "
+            "pass once this many events (log lines) have accumulated "
+            "across pending files — the count half of the sub-day pass "
+            "carve. 0 = no count bound (passes close on the time "
+            "window, a day change, or an explicit flush)")
+define_flag("stream_pass_window_s", 60.0,
+            "streaming ingest: close the open incremental pass once its "
+            "OLDEST pending event (file mtime) is this many seconds old "
+            "even if stream_pass_events has not been reached — the "
+            "freshness bound that keeps a trickle of traffic from "
+            "sitting unconsumed. <= 0 disables the time trigger")
+define_flag("stream_poll_s", 1.0,
+            "sleep between streaming source polls in "
+            "StreamRunner.run() when a poll carved nothing (the idle "
+            "cadence of the files-as-stream tailer; tests and bench "
+            "drive poll_once() directly and never sleep)")
+define_flag("table_decay_rate", 0.0,
+            "show/click decay applied by every store variant's "
+            "shrink() at the day boundary (role of the reference's "
+            "show_click_decay_rate in ShrinkTable). 0 (default) = use "
+            "the TableConfig.show_click_decay the model was built with; "
+            "> 0 overrides it fleet-wide without rebuilding configs")
+define_flag("table_ttl_days", 0,
+            "feature TTL (role of delete_after_unseen_days): a row "
+            "whose unseen_days counter — bumped by every shrink(), "
+            "reset to 0 by any training write-back of that key — "
+            "EXCEEDS this many days is evicted at the day-boundary "
+            "shrink, bounding store growth under infinite traffic. "
+            "0 disables TTL eviction (default)")
+define_flag("table_min_show", 0.0,
+            "floor on the min_show eviction threshold applied by "
+            "shrink() (role of the reference's delete_threshold): the "
+            "effective threshold is max(caller's min_show, this flag), "
+            "so the lifecycle can be turned on fleet-wide without "
+            "touching DayRunner call sites. 0 = no floor (default)")
 define_flag("rpc_retry_deadline_s", 30.0,
             "overall wall-clock deadline across an idempotent call's "
             "retries: when exceeded the last connection error raises "

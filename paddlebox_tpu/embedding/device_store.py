@@ -48,6 +48,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddlebox_tpu.core import faults, log, monitor
+from paddlebox_tpu.embedding import lifecycle
 from paddlebox_tpu.embedding.table import (PassTable, TableConfig,
                                            extract_pass_values_host,
                                            fuse_values_host, lay_fused_host,
@@ -316,6 +317,10 @@ class DeviceFeatureStore:
         self._lock = threading.Lock()
         self._dirty_parts: List[np.ndarray] = []
         self._shrunk_since_base = False
+        # Per-row unseen-days age aligned with dense row ids (host side,
+        # like the key index — the HBM record is untouched): bumped by
+        # shrink, zeroed by any write-back of the row's key.
+        self._unseen = np.zeros((0,), np.int32)
 
     # -- plumbing ----------------------------------------------------------
 
@@ -408,6 +413,9 @@ class DeviceFeatureStore:
         w = self.width
         seed32 = self._seed & 0xFFFFFFFF
         scale = float(self.config.init_scale)
+        # New rows start at age 0 (inserted FOR a pass = just seen).
+        self._unseen = np.concatenate(
+            [self._unseen, np.zeros((n_new,), np.int32)])
         lo = (new_keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         if s == 1:
             cap = _pow2(n_new)
@@ -587,6 +595,7 @@ class DeviceFeatureStore:
                     self._vals, prev_table.vals, src_d, dst_d,
                     next_table.vals, req_d, pl_d)
             self._dirty_parts.append(k.copy())
+            self._unseen[prev_rows] = 0
             monitor.add("device_store/pushed_keys", n_prev)
         return dataclasses.replace(next_table, vals=merged)
 
@@ -685,6 +694,7 @@ class DeviceFeatureStore:
                 table.vals, rows, n, table.rows_per_shard,
                 table.num_shards)
             self._dirty_parts.append(k.copy())
+            self._unseen[rows] = 0
             monitor.add("device_store/pushed_keys", n)
 
     def _dev_idx(self, rows: np.ndarray) -> np.ndarray:
@@ -848,6 +858,7 @@ class DeviceFeatureStore:
                 lay_fused_host(fuse_values_host(values), s, rps)))
             self._vals = self._scatter_pass_locked(laid, rows, n, rps, s)
             self._dirty_parts.append(k.copy())
+            self._unseen[rows] = 0
 
     def key_stats(self) -> Tuple[np.ndarray, np.ndarray]:
         with self._lock:
@@ -872,18 +883,40 @@ class DeviceFeatureStore:
 
     # -- maintenance / checkpoint ------------------------------------------
 
+    def unseen_for(self, keys: np.ndarray) -> np.ndarray:
+        """Unseen-days ages aligned to ``keys`` (0 where absent)."""
+        k = np.ascontiguousarray(keys, np.uint64)
+        with self._lock:
+            rows = self._index.lookup(k)
+            out = np.zeros(k.shape, np.int32)
+            found = rows >= 0
+            out[found] = self._unseen[rows[found]]
+        return out
+
     def shrink(self, *, min_show: float = 0.0) -> int:
-        """Decay show/click on device; evict sub-threshold rows by
-        compaction (role of ShrinkTable)."""
+        """Day-boundary lifecycle on the HBM tier: ONE jitted scale over
+        the fused record decays show/click in place, unseen_days bump +
+        TTL/min-show eviction compact the store (role of ShrinkTable).
+        Policy comes from :func:`lifecycle.shrink_params` like every
+        other store variant."""
+        decay, ttl, min_show = lifecycle.shrink_params(self.config,
+                                                       min_show)
         with self._lock:
             self._shrunk_since_base = True
             self._vals = self._place(_decay_fn(
-                self.dim, float(self.config.show_click_decay))(self._vals))
-            if min_show <= 0:
+                self.dim, float(decay))(self._vals))
+            self._unseen += 1
+            if min_show <= 0 and ttl <= 0:
                 return 0
             n = self._index.size
-            show = self._fetch_column_locked(self.dim + 1, n)
-            keep = show >= min_show
+            keep = np.ones((n,), bool)
+            if min_show > 0:
+                show = self._fetch_column_locked(self.dim + 1, n)
+                keep &= show >= min_show
+            if ttl > 0:
+                over = self._unseen[:n] > ttl
+                monitor.add("store/ttl_evicted", int((keep & over).sum()))
+                keep &= ~over
             evicted = int((~keep).sum())
             if evicted:
                 self._compact_locked(np.flatnonzero(keep))
@@ -892,6 +925,9 @@ class DeviceFeatureStore:
     def _compact_locked(self, keep_rows: np.ndarray) -> None:
         """Rebuild with only keep_rows (ascending dense row ids)."""
         keys = self._index.keys_by_row()[keep_rows]
+        # keep_rows is ascending and upsert below reassigns dense ids
+        # 0..n-1 in that same order, so the age array just filters.
+        ages = self._unseen[keep_rows]
         n = keys.shape[0]
         s = self.num_shards
         rps = plan_shards(max(n, 1), s)
@@ -902,6 +938,7 @@ class DeviceFeatureStore:
         self._cap = _pow2(max(1 << 10, -(-max(n, 1) // s)))
         self._vals = self._place(
             jnp.zeros((s * (self._cap + 1), self.width), jnp.float32))
+        self._unseen = ages
         if n:
             rows2, n_new = self._index.upsert(keys)
             assert n_new == n
@@ -1012,6 +1049,7 @@ class DeviceFeatureStore:
                 jnp.zeros((s * (self._cap + 1), self.width), jnp.float32))
             self._dirty_parts = []
             self._shrunk_since_base = False
+            self._unseen = np.zeros((n,), np.int32)
             if n == 0:
                 return
             rows, _ = self._index.upsert(

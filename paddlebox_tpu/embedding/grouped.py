@@ -106,6 +106,11 @@ class GroupedStore:
             g.engine.store.load(self._subdir(path, g), kind)
 
     def shrink(self, *, min_show: float = 0.0) -> int:
+        # Day-boundary lifecycle (FLAGS_table_* decay/TTL/min-show)
+        # resolves inside each member store's shrink — a feasign trains
+        # an independent row per width group, so its age is per-group
+        # too (a key hot in the 8-wide slots can expire in the 64-wide
+        # ones, exactly like two distinct features would).
         return sum(g.engine.store.shrink(min_show=min_show)
                    for g in self._groups)
 

@@ -1,0 +1,104 @@
+"""Feature lifecycle policy: show/click decay, unseen-day TTL, min-show.
+
+Role of the table lifecycle the reference runs at every day boundary
+(BoxPS ``ShrinkTable`` / pslib shrink driven by the CtrCommonAccessor's
+``show_click_decay_rate``, ``delete_after_unseen_days`` and
+``delete_threshold``): without it the feature store grows monotonically
+forever under streaming traffic. Every store variant's ``shrink()``
+resolves its effective policy through :func:`shrink_params`, so the
+three ``FLAGS_table_*`` knobs act fleet-wide across the host, device,
+sharded, grouped, SSD-tiered and multi-host tiers without touching any
+call site.
+
+``unseen_days`` semantics (matching ``delete_after_unseen_days``): each
+row carries an integer age, reset to 0 by any training write-back of
+its key and bumped by 1 at every ``shrink()``; a row whose bumped age
+EXCEEDS ``FLAGS_table_ttl_days`` is evicted. Ages are tracked host-side
+beside the key index (never inside the value record — the checkpoint
+and wire formats are unchanged), so a process restart grants surviving
+rows a fresh TTL lease; ONLINE.md documents the difference from the
+reference's persisted accessor field.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import flags
+
+
+def shrink_params(config, min_show: float) -> Tuple[float, int, float]:
+    """Effective (decay, ttl_days, min_show) for one shrink() call:
+    the flag overrides layered onto the table config and the caller's
+    threshold. Every store variant calls this so the six shrink
+    implementations can never drift apart on policy."""
+    decay = float(flags.flag("table_decay_rate")) or float(
+        config.show_click_decay)
+    ttl = int(flags.flag("table_ttl_days"))
+    eff_min_show = max(float(min_show), float(flags.flag("table_min_show")))
+    return decay, ttl, eff_min_show
+
+
+class RowAges:
+    """Sorted-key → unseen-days side table for rows that live OUTSIDE a
+    FeatureStore's aligned age array (the SSD tier's disk-resident
+    rows): the tier wrapper records each row's age when it spills, bumps
+    the whole table per shrink, and hands ages back on stage-in so a
+    disk round-trip does not reset the TTL clock. Not thread-safe —
+    callers hold their tier lock."""
+
+    def __init__(self):
+        self._keys = np.empty((0,), np.uint64)
+        self._age = np.empty((0,), np.int32)
+
+    def _locate(self, k: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        if self._keys.size == 0:
+            return (np.zeros(k.shape, bool),
+                    np.zeros(k.shape, np.int64))
+        pos = np.minimum(np.searchsorted(self._keys, k),
+                         self._keys.size - 1)
+        return self._keys[pos] == k, pos
+
+    def set(self, keys: np.ndarray, ages: np.ndarray) -> None:
+        """Upsert ages for ``keys`` (any order)."""
+        k = np.asarray(keys, np.uint64)
+        if k.size == 0:
+            return
+        a = np.broadcast_to(np.asarray(ages, np.int32), k.shape)
+        order = np.argsort(k, kind="stable")
+        k, a = k[order], a[order]
+        found, pos = self._locate(k)
+        self._age[pos[found]] = a[found]
+        new = ~found
+        if new.any():
+            self._keys = np.concatenate([self._keys, k[new]])
+            self._age = np.concatenate([self._age, a[new]])
+            order = np.argsort(self._keys, kind="stable")
+            self._keys = self._keys[order]
+            self._age = self._age[order]
+
+    def drop(self, keys: np.ndarray) -> None:
+        k = np.asarray(keys, np.uint64)
+        if k.size == 0 or self._keys.size == 0:
+            return
+        keep = ~np.isin(self._keys, k)
+        self._keys = self._keys[keep]
+        self._age = self._age[keep]
+
+    def bump(self) -> None:
+        self._age += 1
+
+    def ages_for(self, keys: np.ndarray) -> np.ndarray:
+        """Ages aligned to ``keys`` (0 where untracked)."""
+        k = np.asarray(keys, np.uint64)
+        out = np.zeros(k.shape, np.int32)
+        if k.size and self._keys.size:
+            found, pos = self._locate(k)
+            out[found] = self._age[pos[found]]
+        return out
+
+    def clear(self) -> None:
+        self._keys = np.empty((0,), np.uint64)
+        self._age = np.empty((0,), np.int32)

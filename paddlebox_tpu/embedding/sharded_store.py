@@ -153,7 +153,21 @@ class ShardedFeatureStore:
 
     # -- maintenance -------------------------------------------------------
 
+    def unseen_for(self, keys: np.ndarray) -> np.ndarray:
+        """Unseen-days ages aligned to ``keys`` (0 where absent)."""
+        k = np.ascontiguousarray(keys, np.uint64)
+        out = np.zeros(k.shape, np.int32)
+        parts = self._split(k)
+        res = self._map(lambda b, idx, kk: self._buckets[b].unseen_for(kk),
+                        parts)
+        for (b, idx, _), r in zip(parts, res):
+            out[idx] = r
+        return out
+
     def shrink(self, *, min_show: float = 0.0) -> int:
+        # Lifecycle policy (FLAGS_table_* decay/TTL/min-show) resolves
+        # inside each bucket's FeatureStore.shrink — per-bucket ages are
+        # independent, so the bucketed shrink equals the flat one.
         return sum(self._pool.map(
             lambda s: s.shrink(min_show=min_show), self._buckets))
 

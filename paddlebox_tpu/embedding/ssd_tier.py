@@ -26,6 +26,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from paddlebox_tpu.core import log, monitor
+from paddlebox_tpu.embedding import lifecycle
 from paddlebox_tpu.embedding.store import FeatureStore
 from paddlebox_tpu.embedding.table import TableConfig
 
@@ -212,6 +213,12 @@ class TieredFeatureStore:
         # they must be staged back for save_delta or their training
         # updates would silently vanish from the delta stream.
         self._evicted_dirty = np.empty((0,), np.uint64)
+        # Unseen-days ages of DISK-resident rows (the RAM tier tracks
+        # its own): recorded at spill time, bumped per shrink, handed
+        # back on stage-in so a disk round-trip never resets a row's
+        # TTL clock. In-memory beside the bucket files, like every
+        # lifecycle age in this repo.
+        self._disk_ages = lifecycle.RowAges()
 
     # -- tier movement -----------------------------------------------------
 
@@ -224,7 +231,10 @@ class TieredFeatureStore:
         if k.size:
             # mark_dirty=False: staged rows are bit-identical to their
             # disk copies — a read-only pull must not bloat save_delta.
-            self.ram.push_from_pass(k, v, mark_dirty=False)
+            # Ages travel with the rows (a stage-in is not a "seen").
+            self.ram.push_from_pass(k, v, mark_dirty=False,
+                                    unseen=self._disk_ages.ages_for(k))
+            self._disk_ages.drop(k)
             monitor.add("ssd_tier/staged_in", int(k.size))
 
     def evict_to_budget(self) -> int:
@@ -241,7 +251,12 @@ class TieredFeatureStore:
         cold = self.ram.rows_by_coldness()[:excess]
         self._evicted_dirty = np.union1d(
             self._evicted_dirty, np.intersect1d(cold, self.ram.dirty_keys()))
-        k, v = self.ram.pop_rows(cold)
+        ku = np.unique(cold)
+        ages = self.ram.unseen_for(ku)
+        k, v = self.ram.pop_rows(ku)
+        # pop_rows returns the present subset in ku's (sorted) order, so
+        # the age rows line up by searchsorted position.
+        self._disk_ages.set(k, ages[np.searchsorted(ku, k)])
         self.disk.write(k, v)
         monitor.add("ssd_tier/evicted", int(k.size))
         log.vlog(1, "ssd_tier: evicted %d rows to disk", k.size)
@@ -277,6 +292,7 @@ class TieredFeatureStore:
             not_in_ram = keys[~self.ram.contains(keys)]
             if not_in_ram.size:
                 self.disk.take(not_in_ram)  # values discarded: overwritten
+                self._disk_ages.drop(not_in_ram)
             self.ram.push_from_pass(pass_keys_sorted, values)
             self._evict_to_budget_locked()
 
@@ -308,19 +324,30 @@ class TieredFeatureStore:
             return self._shrink_locked(min_show=min_show)
 
     def _shrink_locked(self, *, min_show: float = 0.0) -> int:
-        """Shrink both tiers (disk rows decay too — stage all disk rows
-        through RAM bucket-by-bucket to apply decay/eviction)."""
+        """Shrink both tiers: the RAM FeatureStore applies the full
+        lifecycle itself; disk rows decay/age/evict in a bucket-by-
+        bucket walk under the SAME policy (lifecycle.shrink_params), so
+        a row's fate never depends on which tier it happens to sit in."""
+        decay, ttl, eff_min_show = lifecycle.shrink_params(self.config,
+                                                           min_show)
         evicted = self.ram.shrink(min_show=min_show)
-        cfg = self.config
+        self._disk_ages.bump()
         for b in range(self.disk.num_buckets):
             k, v = self.disk._load_bucket(b)
             if k.size == 0:
                 continue
-            v["show"] = v["show"] * cfg.show_click_decay
-            v["click"] = v["click"] * cfg.show_click_decay
-            if min_show > 0:
-                keep = v["show"] >= min_show
+            v["show"] = v["show"] * np.float32(decay)
+            v["click"] = v["click"] * np.float32(decay)
+            keep = np.ones(k.shape, bool)
+            if eff_min_show > 0:
+                keep &= v["show"] >= eff_min_show
+            if ttl > 0:
+                over = self._disk_ages.ages_for(k) > ttl
+                monitor.add("store/ttl_evicted", int((keep & over).sum()))
+                keep &= ~over
+            if not keep.all():
                 evicted += int((~keep).sum())
+                self._disk_ages.drop(k[~keep])
                 k = k[keep]
                 v = {f: a[keep] for f, a in v.items()}
             self.disk._save_bucket(b, k, v)
@@ -377,7 +404,10 @@ class TieredFeatureStore:
         if self._evicted_dirty.size:
             k, v = self.disk.take(self._evicted_dirty)
             if k.size:
+                # Dirty rows were trained this day: mark_dirty resets
+                # their age to 0, which is also the truth.
                 self.ram.push_from_pass(k, v)
+                self._disk_ages.drop(k)
             self._evicted_dirty = np.empty((0,), np.uint64)
         self.ram.save_delta(path)
         self.evict_to_budget()
@@ -386,9 +416,23 @@ class TieredFeatureStore:
         with self._tier_lock:
             self._load_locked(path, kind)
 
+    def unseen_for(self, keys: np.ndarray) -> np.ndarray:
+        """Unseen-days ages aligned to ``keys``, whichever tier holds
+        the row (0 where absent)."""
+        k = np.asarray(keys, np.uint64)
+        with self._tier_lock:
+            out = self.ram.unseen_for(k)
+            in_ram = self.ram.contains(k)
+            if not in_ram.all():
+                out[~in_ram] = self._disk_ages.ages_for(k[~in_ram])
+        return out
+
     def _load_locked(self, path: str, kind: str) -> None:
         self.ram.load(path, kind)
         if kind == "base":
+            # Base-load semantics match the RAM tier's set_all: every
+            # surviving row restarts its TTL lease at age 0.
+            self._disk_ages.clear()
             ssd_src = os.path.join(path, f"{self.config.name}.ssd")
             if os.path.isdir(ssd_src):
                 self.disk.restore_from(ssd_src)

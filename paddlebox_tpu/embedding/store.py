@@ -25,6 +25,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from paddlebox_tpu.core import flags, log, monitor
+from paddlebox_tpu.embedding import lifecycle
 from paddlebox_tpu.embedding.table import TableConfig
 from paddlebox_tpu.native import store_py as native_store
 
@@ -109,6 +110,11 @@ class FeatureStore:
             "click": np.empty((0,), np.float32),
         }
         self._seed = np.uint64(seed)
+        # Per-row unseen-days age, aligned with _keys (lifecycle TTL:
+        # bumped by shrink, reset by any training write-back; lives
+        # beside the index, never in the value record — checkpoints
+        # are unchanged and a restart grants a fresh TTL lease).
+        self._unseen = np.empty((0,), np.int32)
         self._lock = threading.Lock()
         # Keys touched since the last save_base (delta set). Kept as a
         # list of per-push arrays, compacted lazily — a sorted union per
@@ -161,6 +167,7 @@ class FeatureStore:
             keep = np.ones(self._keys.shape[0], bool)
             keep[take] = False
             self._keys = self._keys[keep]
+            self._unseen = self._unseen[keep]
             for f in _FIELDS:
                 self._vals[f] = self._vals[f][keep]
             # Popped keys leave the delta set — they are no longer present
@@ -226,23 +233,35 @@ class FeatureStore:
 
     def push_from_pass(self, pass_keys_sorted: np.ndarray,
                        values: Dict[str, np.ndarray], *,
-                       mark_dirty: bool = True) -> None:
+                       mark_dirty: bool = True,
+                       unseen: Optional[np.ndarray] = None) -> None:
         """Write a finished pass's values back (role of EndPass write-back,
         ps_gpu_wrapper.cc:983). Vectorized sorted merge of new keys.
 
         ``mark_dirty=False`` is for TIER MOVEMENT (ssd_tier stage-in):
         rows identical to their disk copies must not land in the next
-        save_delta — only training updates are deltas."""
+        save_delta — only training updates are deltas. ``unseen`` (tier
+        movement too) carries the rows' unseen-days ages across the
+        move so a disk round-trip does not reset the TTL clock; without
+        it a training push zeroes the pushed keys' ages (the row was
+        just seen) and a tier move preserves existing ages."""
         k = np.ascontiguousarray(pass_keys_sorted, np.uint64)
         if k.shape[0] == 0:
             return
         self._check_state_widths(values)
+        if unseen is not None:
+            unseen = np.ascontiguousarray(unseen, np.int32)
         with self._lock:
             found, pos_c = self._locate(k)
             # Update existing rows in place.
             for f in _FIELDS:
                 native_store.scatter_rows(self._vals[f], pos_c, values[f],
                                           mask=found)
+            if found.any():
+                if unseen is not None:
+                    self._unseen[pos_c[found]] = unseen[found]
+                elif mark_dirty:
+                    self._unseen[pos_c[found]] = 0
             # Merge new rows LINEARLY (two sorted runs -> O(N + n) scatter;
             # a concat + argsort here would cost O((N+n) log(N+n)) on
             # every pass write-back, the scaling wall the reference's
@@ -265,28 +284,55 @@ class FeatureStore:
                     native_store.scatter_rows(merged, old_pos,
                                               self._vals[f])
                     self._vals[f] = merged
+                merged_un = np.zeros((merged_keys.shape[0],), np.int32)
+                if unseen is not None:
+                    merged_un[dst_new] = unseen[new_mask]
+                merged_un[old_pos] = self._unseen
+                self._unseen = merged_un
             if mark_dirty:
                 self._dirty_parts.append(k.copy())
 
     # -- lifecycle maintenance --------------------------------------------
 
+    def unseen_for(self, keys: np.ndarray) -> np.ndarray:
+        """Unseen-days ages aligned to ``keys`` (0 where absent) — the
+        tier wrapper reads these before spilling rows disk-ward."""
+        k = np.ascontiguousarray(keys, np.uint64)
+        out = np.zeros(k.shape, np.int32)
+        with self._lock:
+            found, pos_c = self._locate(k)
+            if found.any():
+                out[found] = self._unseen[pos_c[found]]
+        return out
+
     def shrink(self, *, min_show: float = 0.0) -> int:
-        """Day-level table shrink: decay show/click, evict cold features
-        (role of BoxPS ShrinkTable / pslib shrink)."""
-        cfg = self.config
+        """Day-level table shrink (role of BoxPS ShrinkTable / pslib
+        shrink): decay show/click, bump every row's unseen_days, and
+        evict rows past the TTL or under the show threshold — policy
+        resolved through :func:`lifecycle.shrink_params` so the
+        ``FLAGS_table_*`` lifecycle knobs apply uniformly across every
+        store variant."""
+        decay, ttl, min_show = lifecycle.shrink_params(self.config,
+                                                       min_show)
         with self._lock:
             self._shrunk_since_base = True
-            self._vals["show"] *= cfg.show_click_decay
-            self._vals["click"] *= cfg.show_click_decay
+            self._vals["show"] *= np.float32(decay)
+            self._vals["click"] *= np.float32(decay)
+            self._unseen += 1
+            keep = np.ones(self._keys.shape[0], bool)
             if min_show > 0:
-                keep = self._vals["show"] >= min_show
-                evicted = int((~keep).sum())
-                if evicted:
-                    self._keys = self._keys[keep]
-                    for f in _FIELDS:
-                        self._vals[f] = self._vals[f][keep]
-                return evicted
-        return 0
+                keep &= self._vals["show"] >= min_show
+            if ttl > 0:
+                over = self._unseen > ttl
+                monitor.add("store/ttl_evicted", int((keep & over).sum()))
+                keep &= ~over
+            evicted = int((~keep).sum())
+            if evicted:
+                self._keys = self._keys[keep]
+                self._unseen = self._unseen[keep]
+                for f in _FIELDS:
+                    self._vals[f] = self._vals[f][keep]
+            return evicted
 
     # -- checkpoint: base + delta -----------------------------------------
 
@@ -367,6 +413,7 @@ class FeatureStore:
         with self._lock:
             self._keys = np.ascontiguousarray(keys_sorted, np.uint64)
             self._vals = {f: np.asarray(vals[f]) for f in _FIELDS}
+            self._unseen = np.zeros(self._keys.shape, np.int32)
             self._dirty_parts = []
             self._shrunk_since_base = False
 

@@ -294,8 +294,22 @@ class ShardServer(rpc.FramedRPCServer):
         return True
 
     def handle_shrink(self, req) -> int:
+        """Day-boundary lifecycle on this shard's rows (the FeatureStore
+        resolves FLAGS_table_* decay/TTL/min-show in THIS process); the
+        post-shrink row count is republished as this server's gauge so
+        the bounded-store story is observable per host too."""
         with self._mut_lock:
-            return self.store.shrink(min_show=req.get("min_show", 0.0))
+            evicted = self.store.shrink(min_show=req.get("min_show", 0.0))
+        monitor.set_gauge("multihost/shard_rows",
+                          float(self.store.num_features))
+        return evicted
+
+    def handle_contains(self, req) -> np.ndarray:
+        """Membership mask for keys in this shard's range (pure read —
+        the FeatureStore.contains surface across the wire)."""
+        keys = np.asarray(req["keys"], np.uint64)
+        self._check_owned(keys)
+        return self.store.contains(keys)
 
     def handle_stats(self, req) -> Dict[str, int]:
         return {"num_features": int(self.store.num_features),
@@ -315,7 +329,8 @@ class ShardClient:
         self.endpoint = endpoint
         self._conn = rpc.FramedRPCConn(
             endpoint, timeout=timeout, service_name="shard",
-            idempotent=("pull", "pull_serving", "pull_range", "stats"))
+            idempotent=("pull", "pull_serving", "pull_range", "stats",
+                        "contains"))
 
     def call(self, method: str, **kw):
         return self._conn.call(method, **kw)

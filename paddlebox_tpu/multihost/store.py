@@ -215,6 +215,21 @@ class MultiHostStore:
 
     # -- size / maintenance ------------------------------------------------
 
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        """Membership mask across the shard cluster (pure read; any key
+        order — each key is asked of its owner only)."""
+        k = np.ascontiguousarray(keys, np.uint64)
+        out = np.zeros(k.shape, bool)
+        if k.size == 0:
+            return out
+        owner = self.ranges.owner_of(k)
+        work = [(h, {"keys": k[owner == h]}) for h in range(self.world)
+                if (owner == h).any()]
+        results = self._fanout(work, "contains")
+        for h, _kw in work:
+            out[owner == h] = np.asarray(results[h], bool)
+        return out
+
     @property
     def num_features(self) -> int:
         return int(sum(s["num_features"]
@@ -223,9 +238,17 @@ class MultiHostStore:
                            "stats").values()))
 
     def shrink(self, *, min_show: float = 0.0) -> int:
-        return int(sum(self._fanout(
+        """Day-boundary lifecycle runs PER SHARD on the owning server
+        (its local FeatureStore resolves the FLAGS_table_* decay/TTL/
+        min-show policy from that process's flags), then the post-shrink
+        row counts are republished so the operator reads the bounded
+        store size from one gauge, not a per-host scrape."""
+        evicted = int(sum(self._fanout(
             [(h, {"min_show": min_show}) for h in range(self.world)],
             "shrink").values()))
+        rows = self.num_features  # one stats fan-out, post-shrink
+        monitor.set_gauge("multihost/rows", float(rows))
+        return evicted
 
     def reset(self) -> None:
         """Pass-retry rollback surface: wipe every shard (the recovery

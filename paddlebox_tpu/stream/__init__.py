@@ -1,0 +1,17 @@
+"""Streaming online-learning tier (ONLINE.md): minute-level
+event→servable freshness on top of the existing day/pass engine.
+
+- :mod:`paddlebox_tpu.stream.source` — bounded files-as-stream tailer
+  with a durable consumed-offset cursor (kill -9 safe).
+- :mod:`paddlebox_tpu.stream.runner` — :class:`StreamRunner`, the
+  sub-day sibling of ``DayRunner.train_pass``: trains each carved
+  incremental pass, publishes its delta through the donefile protocol
+  the serving publishers already tail, and measures event→servable
+  latency as a registry quantile digest.
+"""
+
+from paddlebox_tpu.stream.runner import StreamRunner
+from paddlebox_tpu.stream.source import (PassManifest, StreamCursor,
+                                         StreamSource)
+
+__all__ = ["PassManifest", "StreamCursor", "StreamRunner", "StreamSource"]

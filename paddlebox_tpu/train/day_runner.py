@@ -518,6 +518,18 @@ class DayRunner:
             log.warning("day %s: no trainable passes; skipping day-end "
                         "shrink/base", day)
             return all_stats
+        evicted = self.day_end(day)
+        log.vlog(0, "day %s done: %d passes, %d evicted", day,
+                 len(all_stats), evicted)
+        return all_stats
+
+    def day_end(self, day: str) -> int:
+        """The day-boundary sequence the reference runs: table lifecycle
+        shrink (show/click decay + unseen-days TTL + min-show eviction,
+        FLAGS_table_*) → SaveBase → donefile publish. Shared between
+        ``train_day`` and the streaming runner's day rollover
+        (stream/runner.py) — both close a day the exact same way.
+        Returns rows evicted by the shrink."""
         store = self.trainer.engine.store
         if self.is_rank0:
             with self.timers.scope("day_end"), \
@@ -539,9 +551,7 @@ class DayRunner:
             evicted = store.shrink(min_show=self.min_show_shrink)
         monitor.add("day_runner/days", 1)
         monitor.add("day_runner/evicted_keys", int(evicted))
-        log.vlog(0, "day %s done: %d passes, %d evicted", day,
-                 len(all_stats), evicted)
-        return all_stats
+        return evicted
 
     def run_days(self, days: Sequence[str],
                  resume: bool = True) -> Dict[str, List[Dict[str, float]]]:

@@ -1,0 +1,98 @@
+"""Subprocess worker for the streaming kill -9 crash drill
+(tests/test_stream_drill.py, the PR-5 crash_drill pattern applied to
+the stream tier): consume a fixed event-log directory through
+StreamRunner — resume() from the durable cursor, one flushed poll, day
+close — and write the final state digests atomically. The harness
+SIGKILLs this process at a chosen ``stream/*`` faultpoint, reruns it
+clean, and byte-compares against a never-killed reference: the cursor
+contract means no event is ever lost or trained twice."""
+
+import hashlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SLOTS = ("user", "item")
+BS = 32
+FILES = 4
+PASS_EVENTS = 2 * BS          # two files per carved pass
+
+
+def write_events(log_dir: str) -> None:
+    """Deterministic fixed event log (shared by harness + reference)."""
+    import numpy as np
+    rng = np.random.default_rng(29)
+    os.makedirs(log_dir, exist_ok=True)
+    for i in range(FILES):
+        tmp = os.path.join(log_dir, f".e{i:03d}.log.tmp")
+        with open(tmp, "w") as f:
+            for _ in range(BS):
+                toks = " ".join(f"{s}:{rng.integers(1, 150)}"
+                                for s in SLOTS)
+                f.write(f"{int(rng.random() < 0.3)} {toks}\n")
+        os.replace(tmp, os.path.join(log_dir, f"e{i:03d}.log"))
+
+
+def _digest(arrays) -> str:
+    import numpy as np
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def main(log_dir: str, out_dir: str, result: str) -> None:
+    import numpy as np
+
+    import jax
+
+    from paddlebox_tpu.core import flags
+    from paddlebox_tpu.data import DataFeedConfig, SlotConf
+    from paddlebox_tpu.embedding import TableConfig
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.stream import StreamRunner
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+    flags.set_flags({"stream_pass_events": PASS_EVENTS,
+                     "stream_pass_window_s": 0.0})
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=BS)
+    trainer = CTRTrainer(
+        DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
+        TableConfig(name="emb", dim=8, learning_rate=0.1), mesh=mesh,
+        config=TrainerConfig(dense_learning_rate=3e-3,
+                             auc_num_buckets=1 << 10))
+    trainer.init(seed=0)
+    # ONE reader thread: no-shuffle parity needs deterministic chunk
+    # order across the kill/resume/reference runs (see test_stream.py).
+    runner = StreamRunner(trainer, feed, out_dir, log_dir=log_dir,
+                          shuffle=False, num_reader_threads=1)
+    runner.resume()
+    runner.poll_once(flush=True)
+    runner.end_day()
+
+    store = trainer.engine.store
+    keys = np.sort(store.key_stats()[0])
+    vals = store.pull_for_pass(keys)
+    payload = {
+        "num_features": int(store.num_features),
+        "store_digest": _digest([keys] + [vals[f] for f in sorted(vals)]),
+        "dense_digest": _digest(
+            list(jax.tree.leaves(jax.device_get(trainer.params)))
+            + list(jax.tree.leaves(jax.device_get(trainer.opt_state)))),
+        "records": [[r.day, r.pass_id] for r in runner.ckpt.records()],
+        "manifests": [m.to_dict() for m in runner.cursor.manifests],
+    }
+    tmp = result + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, result)
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:4])

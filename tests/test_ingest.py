@@ -247,13 +247,18 @@ def test_mp_ingest_dump_into_disk(tmp_path):
 
 def test_pass_keys_runs_vs_fallback_parity(tmp_path):
     files = _write_files(tmp_path)
-    ds_runs = Dataset(CFG)
+    # ONE reader thread, in-process: the global_shuffle partition below
+    # drops rows BY POSITION, so this parity needs the two datasets
+    # loaded in the same row order — multi-threaded (or mp-ingest)
+    # chunk arrival order is scheduling-dependent and flaked this test.
+    flags.set_flags({"ingest_workers": 0, "ingest_key_runs": True})
+    ds_runs = Dataset(CFG, num_reader_threads=1)
     ds_runs.set_filelist(files)
     ds_runs.load_into_memory()
     assert ds_runs._key_runs_valid
 
     flags.set_flags({"ingest_key_runs": False})
-    ds_flat = Dataset(CFG)
+    ds_flat = Dataset(CFG, num_reader_threads=1)
     ds_flat.set_filelist(files)
     ds_flat.load_into_memory()
     assert not ds_flat._key_runs_valid

@@ -343,3 +343,52 @@ def test_fleet_replica_keys_direction_and_gating(tmp_path):
     assert perf_gate.main(
         [_write(tmp_path, "fleet_bad_route.json", bad),
          "--baseline", b]) == 1
+
+
+def test_online_freshness_direction_and_gating(tmp_path):
+    """Round-17 streaming keys: the `bench.py online` record gates the
+    event→servable freshness quantiles as lower-better (a staler
+    served model is a regression), passes_per_hour as higher-better,
+    and the post-lifecycle store row count as lower-better (TTL/decay
+    stopped bounding the table); pass/event totals are workload
+    provenance and never gate."""
+    assert perf_gate.direction("event_to_servable_ms.p50") == -1
+    assert perf_gate.direction("event_to_servable_ms.p99") == -1
+    assert perf_gate.direction("passes_per_hour") == 1
+    assert perf_gate.direction("post_shrink_store_rows") == -1
+    assert perf_gate.direction("stream_passes") == 0
+    assert perf_gate.direction("events") == 0
+    assert perf_gate.direction("day3_over_day1_rows") == 0
+    base = {"metric": "online_stream_events_per_sec", "value": 2900.0,
+            "event_to_servable_ms": {"p50": 900.0, "p99": 2500.0},
+            "passes_per_hour": 620.0,
+            "post_shrink_store_rows": 31000,
+            "day3_over_day1_rows": 1.01,
+            "stream_passes": 12, "events": 49152}
+    b = _write(tmp_path, "online_base.json", base)
+    assert perf_gate.main(
+        [_write(tmp_path, "online_same.json", base),
+         "--baseline", b]) == 0
+    # Provenance wobble (a different carve) never gates.
+    ok = copy.deepcopy(base)
+    ok["stream_passes"] = 6
+    ok["events"] = 24000
+    assert perf_gate.main([_write(tmp_path, "online_ok.json", ok),
+                           "--baseline", b]) == 0
+    # Freshness blown: the p99 event→servable latency trips the gate.
+    bad = copy.deepcopy(base)
+    bad["event_to_servable_ms"]["p99"] = 60000.0
+    assert perf_gate.main(
+        [_write(tmp_path, "online_bad_fresh.json", bad),
+         "--baseline", b]) == 1
+    # Lifecycle broken: an unbounded post-shrink store trips it too.
+    bad = copy.deepcopy(base)
+    bad["post_shrink_store_rows"] = 500000
+    assert perf_gate.main(
+        [_write(tmp_path, "online_bad_rows.json", bad),
+         "--baseline", b]) == 1
+    bad = copy.deepcopy(base)
+    bad["passes_per_hour"] = 80.0
+    assert perf_gate.main(
+        [_write(tmp_path, "online_bad_pph.json", bad),
+         "--baseline", b]) == 1

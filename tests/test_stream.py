@@ -1,0 +1,492 @@
+"""Streaming online-learning tier (ONLINE.md): source carving, durable
+cursor resume, streamed-vs-batch bit-parity, the event→servable
+freshness digest, and decay/TTL lifecycle parity across every store
+variant's shrink()."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import flags, monitor
+from paddlebox_tpu.data import DataFeedConfig, SlotConf
+from paddlebox_tpu.embedding import TableConfig
+from paddlebox_tpu.embedding.store import FeatureStore
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.stream import StreamCursor, StreamRunner, StreamSource
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+SLOTS = ("user", "item")
+BS = 32
+
+
+@pytest.fixture
+def flagset():
+    """Set flags for one test; restore previous values afterwards."""
+    prev = {}
+
+    def set_(**kw):
+        for k in kw:
+            prev.setdefault(k, flags.flag(k))
+        flags.set_flags(kw)
+
+    yield set_
+    flags.set_flags(prev)
+
+
+def _write_event_file(log_dir, name, rows, rng, lo=1, hi=200,
+                      mtime=None):
+    """One atomically-appearing log segment of ``rows`` events."""
+    os.makedirs(log_dir, exist_ok=True)
+    tmp = os.path.join(log_dir, "." + name + ".tmp")
+    with open(tmp, "w") as f:
+        for _ in range(rows):
+            toks = " ".join(f"{s}:{rng.integers(lo, hi)}" for s in SLOTS)
+            f.write(f"{int(rng.random() < 0.3)} {toks}\n")
+    path = os.path.join(log_dir, name)
+    os.replace(tmp, path)
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+    return path
+
+
+def _make_trainer():
+    mesh = build_mesh(HybridTopology(dp=8))
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=BS)
+    tr = CTRTrainer(
+        DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
+        TableConfig(name="emb", dim=8, learning_rate=0.1), mesh=mesh,
+        config=TrainerConfig(dense_learning_rate=3e-3,
+                             auc_num_buckets=1 << 10))
+    tr.init(seed=0)
+    return tr, feed
+
+
+def _digests(trainer):
+    import hashlib
+
+    import jax
+    store = trainer.engine.store
+    keys = np.sort(store.key_stats()[0]) if hasattr(store, "key_stats") \
+        else np.sort(store.dirty_keys())
+    vals = store.pull_for_pass(keys)
+    h = hashlib.sha256()
+    h.update(keys.tobytes())
+    for f in sorted(vals):
+        h.update(np.ascontiguousarray(vals[f]).tobytes())
+    hd = hashlib.sha256()
+    for x in jax.tree.leaves(jax.device_get(trainer.params)):
+        hd.update(np.ascontiguousarray(x).tobytes())
+    for x in jax.tree.leaves(jax.device_get(trainer.opt_state)):
+        hd.update(np.ascontiguousarray(x).tobytes())
+    return h.hexdigest(), hd.hexdigest(), int(store.num_features)
+
+
+# ---------------------------------------------------------------------------
+# source + cursor (no trainer)
+# ---------------------------------------------------------------------------
+
+def test_carve_by_event_count(tmp_path, flagset):
+    rng = np.random.default_rng(0)
+    log = str(tmp_path / "log")
+    for i in range(5):
+        _write_event_file(log, f"f{i:03d}.log", 10, rng)
+    flagset(stream_pass_events=20, stream_pass_window_s=0.0)
+    src = StreamSource(log, clock=lambda: 0.0)
+    src.poll()
+    protos = src.carve()
+    # 10+10 closes a pass twice; the 10-event tail stays pending.
+    assert [(len(fs), ev) for _d, fs, ev, _t in protos] == [(2, 20),
+                                                           (2, 20)]
+    assert len(src.pending()) == 1
+    tail = src.carve(flush=True)
+    assert [(len(fs), ev) for _d, fs, ev, _t in tail] == [(1, 10)]
+    assert src.pending() == []
+
+
+def test_carve_by_time_window(tmp_path, flagset):
+    rng = np.random.default_rng(1)
+    log = str(tmp_path / "log")
+    _write_event_file(log, "a.log", 4, rng, mtime=1000.0)
+    _write_event_file(log, "b.log", 4, rng, mtime=1030.0)
+    flagset(stream_pass_events=0, stream_pass_window_s=60.0)
+    clock = {"now": 1040.0}
+    src = StreamSource(log, clock=lambda: clock["now"])
+    src.poll()
+    assert src.carve() == []          # oldest event only 40s old
+    clock["now"] = 1061.0
+    protos = src.carve()
+    assert len(protos) == 1
+    day, files, events, oldest = protos[0]
+    assert events == 8 and oldest == 1000.0 and len(files) == 2
+
+
+def test_carve_closes_at_day_change(tmp_path, flagset):
+    rng = np.random.default_rng(2)
+    log = str(tmp_path / "log")
+    _write_event_file(log, "d0-a.log", 3, rng)
+    _write_event_file(log, "d0-b.log", 3, rng)
+    _write_event_file(log, "d1-a.log", 3, rng)
+    flagset(stream_pass_events=100, stream_pass_window_s=0.0)
+    src = StreamSource(log, clock=lambda: 0.0,
+                       day_of=lambda p: os.path.basename(p).split("-")[0])
+    src.poll()
+    protos = src.carve(flush=True)
+    assert [(d, len(fs)) for d, fs, _e, _t in protos] == [("d0", 2),
+                                                          ("d1", 1)]
+
+
+def test_cursor_durable_and_ordered(tmp_path):
+    path = str(tmp_path / "cursor.json")
+    c = StreamCursor(path)
+    m1 = c.append("d0", ["/x/a", "/x/b"], 64, 123.0)
+    m2 = c.append("d0", ["/x/c"], 32, 456.0)
+    m3 = c.append("d1", ["/x/d"], 16, 789.0)
+    assert (m1.pass_id, m2.pass_id, m3.pass_id) == (1, 2, 1)
+    # A fresh reader sees the identical committed assignment.
+    c2 = StreamCursor(path)
+    assert [m.to_dict() for m in c2.manifests] == \
+        [m.to_dict() for m in c.manifests]
+    assert c2.consumed_files() == {"/x/a", "/x/b", "/x/c", "/x/d"}
+    assert c2.next_pass_id("d0") == 3 and c2.next_pass_id("d2") == 1
+    # The cursor file is valid JSON (operators read it in incidents).
+    with open(path) as f:
+        data = json.load(f)
+    assert data["version"] == 1 and len(data["manifests"]) == 3
+
+
+def test_source_skips_consumed_files(tmp_path, flagset):
+    rng = np.random.default_rng(3)
+    log = str(tmp_path / "log")
+    a = _write_event_file(log, "a.log", 4, rng)
+    flagset(stream_pass_events=1, stream_pass_window_s=0.0)
+    src = StreamSource(log, clock=lambda: 0.0, consumed={a})
+    src.poll()
+    assert src.carve(flush=True) == []
+    _write_event_file(log, "b.log", 4, rng)
+    src.poll()
+    protos = src.carve(flush=True)
+    assert len(protos) == 1 and os.path.basename(protos[0][1][0]) == "b.log"
+
+
+# ---------------------------------------------------------------------------
+# streamed day == batch day (bit parity)
+# ---------------------------------------------------------------------------
+
+def test_streamed_day_bit_identical_to_batch_day(tmp_path, flagset):
+    """A full day consumed as 4 streamed incremental passes yields
+    BIT-identical dense params, optimizer state and store to the same
+    data trained as ONE batch pass at the same data order (lifecycle
+    flags off). File sizes are batch-aligned so the batch sequence is
+    identical; shuffle off on both sides."""
+    rng = np.random.default_rng(7)
+    log = str(tmp_path / "log")
+    files = [_write_event_file(log, f"p{i}.log", BS, rng)
+             for i in range(4)]
+
+    # Batch side: one pass over all four files, then the day boundary.
+    # ONE reader thread: with several files per pass and no shuffle,
+    # multi-threaded chunk arrival order IS the data order — "same data
+    # order" (the parity contract) needs the deterministic reader.
+    tr_b, feed = _make_trainer()
+    batch = StreamRunner(tr_b, feed, str(tmp_path / "out_b"),
+                         log_dir=str(tmp_path / "nolog"),
+                         shuffle=False, num_reader_threads=1)
+    batch.train_pass("stream", 1, files)
+    batch.day_end("stream")
+    dig_b = _digests(tr_b)
+
+    # Stream side: the same files as four carved single-file passes.
+    flagset(stream_pass_events=BS, stream_pass_window_s=0.0)
+    tr_s, feed = _make_trainer()
+    stream = StreamRunner(tr_s, feed, str(tmp_path / "out_s"),
+                          log_dir=log, shuffle=False,
+                          num_reader_threads=1)
+    n = stream.poll_once(flush=True)
+    assert n == 4
+    stream.end_day()
+    dig_s = _digests(tr_s)
+
+    assert dig_s == dig_b  # (store sha, dense sha, num_features)
+    # And the stream side published one delta per pass + the day base.
+    recs = [(r.day, r.pass_id) for r in stream.ckpt.records()]
+    assert recs == [("stream", 1), ("stream", 2), ("stream", 3),
+                    ("stream", 4), ("stream", 0)]
+
+
+# ---------------------------------------------------------------------------
+# resume semantics + freshness
+# ---------------------------------------------------------------------------
+
+def test_resume_trains_unpublished_manifest(tmp_path, flagset):
+    """Crash-after-cursor-commit, simulated in-process: a manifest is
+    durable but its pass never published — resume() must train exactly
+    that file set."""
+    rng = np.random.default_rng(11)
+    log = str(tmp_path / "log")
+    f = _write_event_file(log, "a.log", BS, rng)
+    out = str(tmp_path / "out")
+    os.makedirs(out, exist_ok=True)
+    StreamCursor(os.path.join(out, "stream_cursor.json")).append(
+        "stream", [f], BS, os.path.getmtime(f))
+
+    tr, feed = _make_trainer()
+    runner = StreamRunner(tr, feed, out, log_dir=log, shuffle=False,
+                          num_reader_threads=2)
+    runner.resume()
+    assert [(r.day, r.pass_id) for r in runner.ckpt.records()] == \
+        [("stream", 1)]
+    # The file is consumed: a poll carves nothing new.
+    assert runner.poll_once(flush=True) == 0
+
+
+def test_resume_skips_published_and_continues(tmp_path, flagset):
+    rng = np.random.default_rng(13)
+    log = str(tmp_path / "log")
+    _write_event_file(log, "a.log", BS, rng)
+    flagset(stream_pass_events=BS, stream_pass_window_s=0.0)
+    out = str(tmp_path / "out")
+
+    tr1, feed = _make_trainer()
+    r1 = StreamRunner(tr1, feed, out, log_dir=log, shuffle=False,
+                      num_reader_threads=2)
+    assert r1.poll_once(flush=True) == 1
+    n_feat = tr1.engine.store.num_features
+
+    # "Restart": fresh trainer + runner over the same output root.
+    tr2, feed = _make_trainer()
+    r2 = StreamRunner(tr2, feed, out, log_dir=log, shuffle=False,
+                      num_reader_threads=2)
+    r2.resume()
+    assert tr2.engine.store.num_features == n_feat      # model recovered
+    assert [(r.day, r.pass_id) for r in r2.ckpt.records()] == \
+        [("stream", 1)]                                 # nothing re-published
+    # New traffic keeps flowing with continuous pass numbering.
+    _write_event_file(log, "b.log", BS, rng)
+    assert r2.poll_once(flush=True) == 1
+    assert [(r.day, r.pass_id) for r in r2.ckpt.records()] == \
+        [("stream", 1), ("stream", 2)]
+
+
+def test_freshness_digest_and_day_rollover(tmp_path, flagset):
+    """Per-pass event→servable latency lands in the registry digest
+    (count == passes), computed against the injected clock; a day-label
+    change publishes the previous day's base mid-stream."""
+    rng = np.random.default_rng(17)
+    log = str(tmp_path / "log")
+    t0 = 1_000_000.0
+    _write_event_file(log, "d0-a.log", BS, rng, mtime=t0)
+    _write_event_file(log, "d1-a.log", BS, rng, mtime=t0 + 60)
+    flagset(stream_pass_events=BS, stream_pass_window_s=0.0)
+    base = monitor.GLOBAL.quantile_digest("stream/event_to_servable_ms")
+
+    tr, feed = _make_trainer()
+    clock = {"now": t0 + 100.0}
+    runner = StreamRunner(
+        tr, feed, str(tmp_path / "out"), log_dir=log, shuffle=False,
+        num_reader_threads=2, clock=lambda: clock["now"],
+        day_of=lambda p: os.path.basename(p).split("-")[0])
+    assert runner.poll_once(flush=True) == 2
+    runner.end_day()
+    recs = [(r.day, r.pass_id) for r in runner.ckpt.records()]
+    # d0 delta, d0 base (rolled over BEFORE d1 trained), d1 delta, d1 base.
+    assert recs == [("d0", 1), ("d0", 0), ("d1", 1), ("d1", 0)]
+    d = monitor.GLOBAL.quantile_digest("stream/event_to_servable_ms")
+    assert d is not None
+    win = d.delta(base) if base is not None else d
+    assert win.count == 2
+    # This run's two observations off the INJECTED clock: the d0 pass's
+    # oldest event is 100s old at ack, the d1 pass's 40s (1% sketch
+    # error on each).
+    assert win.quantile(0.0) == pytest.approx(40e3, rel=0.02)
+    assert win.quantile(1.0) == pytest.approx(100e3, rel=0.02)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: decay / TTL / min-show across the store variants
+# ---------------------------------------------------------------------------
+
+CFG = TableConfig(name="t", dim=4, learning_rate=0.1,
+                  show_click_decay=0.9)
+
+
+def _touch(store, keys):
+    """Training write-back stand-in: pull rows, set show=1, push."""
+    k = np.sort(np.asarray(keys, np.uint64))
+    vals = store.pull_for_pass(k)
+    vals["show"] = np.ones_like(vals["show"])
+    store.push_from_pass(k, vals)
+
+
+def _lifecycle_scenario(store):
+    """Shared drill: day1 touches A∪B, day2 touches only B; with
+    ttl=1, day3's shrink evicts exactly A (unseen 2 days)."""
+    a = np.arange(2, 22, 2, dtype=np.uint64)       # evens
+    b = np.arange(101, 111, dtype=np.uint64)
+    _touch(store, np.concatenate([a, b]))
+    store.shrink()                                  # day 1 boundary
+    _touch(store, b)
+    store.shrink()                                  # day 2: A at age 2
+    surv_a = store.contains(a)
+    surv_b = store.contains(b)
+    return surv_a, surv_b, int(store.num_features)
+
+
+@pytest.mark.parametrize("variant", [
+    "flat", "sharded", "device", "tiered", "grouped", "multihost"])
+def test_lifecycle_parity_across_variants(variant, tmp_path, flagset):
+    """Unit parity of the unseen-days TTL across ALL six store
+    variants: day1 touches A∪B, day2 touches only B, the day-2 shrink
+    (ttl=1) evicts exactly A everywhere."""
+    flagset(table_ttl_days=1, table_decay_rate=0.0, table_min_show=0.0)
+    servers = None
+    if variant == "grouped":
+        # The dim-grouped facade: drive each width group's member store
+        # through the same scenario, shrink ONCE through the facade —
+        # a feasign ages independently per width group.
+        from paddlebox_tpu.embedding.grouped import GroupedEngine
+        a = np.arange(2, 22, 2, dtype=np.uint64)
+        b = np.arange(101, 111, dtype=np.uint64)
+        eng = GroupedEngine(CFG, {"a": 4, "b": 8})
+        for g in eng.groups:
+            _touch(g.engine.store, np.concatenate([a, b]))
+        eng.store.shrink()
+        for g in eng.groups:
+            _touch(g.engine.store, b)
+        eng.store.shrink()
+        for g in eng.groups:
+            assert not g.engine.store.contains(a).any()
+            assert g.engine.store.contains(b).all()
+        assert eng.store.num_features == 2 * 10
+        return
+    if variant == "flat":
+        store = FeatureStore(CFG)
+    elif variant == "sharded":
+        from paddlebox_tpu.embedding.sharded_store import \
+            ShardedFeatureStore
+        store = ShardedFeatureStore(CFG, num_buckets=4, num_threads=2)
+    elif variant == "device":
+        from paddlebox_tpu.embedding.device_store import DeviceFeatureStore
+        store = DeviceFeatureStore(CFG)
+    elif variant == "tiered":
+        from paddlebox_tpu.embedding.ssd_tier import TieredFeatureStore
+        # RAM budget below the working set: rows MUST cross the disk
+        # tier, proving ages ride the spill/stage-in path.
+        store = TieredFeatureStore(CFG, str(tmp_path / "ssd"),
+                                   max_ram_features=6)
+    else:
+        from paddlebox_tpu.multihost import (MultiHostStore,
+                                             start_local_shards,
+                                             stop_shards)
+        servers, eps = start_local_shards(2, CFG)
+        store = MultiHostStore(CFG, eps)
+    try:
+        surv_a, surv_b, n = _lifecycle_scenario(store)
+    finally:
+        if servers is not None:
+            store.close()
+            stop_shards(servers)
+    assert not surv_a.any(), f"{variant}: TTL must evict unseen rows"
+    assert surv_b.all(), f"{variant}: touched rows must survive"
+    assert n == 10
+
+
+def test_lifecycle_show_values_match_flat(flagset, tmp_path):
+    """Decay parity: surviving rows' show values after the scenario are
+    bit-identical between the flat store and each composed variant."""
+    from paddlebox_tpu.embedding.device_store import DeviceFeatureStore
+    from paddlebox_tpu.embedding.sharded_store import ShardedFeatureStore
+    from paddlebox_tpu.embedding.ssd_tier import TieredFeatureStore
+    flagset(table_ttl_days=1, table_decay_rate=0.0, table_min_show=0.0)
+    b = np.arange(101, 111, dtype=np.uint64)
+
+    def run(store):
+        _lifecycle_scenario(store)
+        return store.pull_for_pass(b)["show"]
+
+    ref = run(FeatureStore(CFG))
+    np.testing.assert_array_equal(
+        ref, run(ShardedFeatureStore(CFG, num_buckets=4, num_threads=2)))
+    np.testing.assert_array_equal(ref, run(DeviceFeatureStore(CFG)))
+    np.testing.assert_array_equal(
+        ref, run(TieredFeatureStore(CFG, str(tmp_path / "ssd"),
+                                    max_ram_features=6)))
+    # One decay after the touch: show == 0.9 exactly.
+    np.testing.assert_allclose(ref, np.float32(0.9))
+
+
+def test_decay_rate_flag_overrides_config(flagset):
+    flagset(table_decay_rate=0.5)
+    store = FeatureStore(CFG)      # config decay is 0.9
+    k = np.arange(1, 5, dtype=np.uint64)
+    _touch(store, k)
+    store.shrink()
+    np.testing.assert_allclose(store.pull_for_pass(k)["show"],
+                               np.float32(0.5))
+
+
+def test_min_show_flag_floor(flagset):
+    flagset(table_min_show=0.6, table_decay_rate=0.0)
+    store = FeatureStore(CFG)
+    k = np.arange(1, 5, dtype=np.uint64)
+    _touch(store, k)               # show 1.0 -> decays to 0.9
+    assert store.shrink() == 0     # 0.9 >= 0.6 floor
+    assert store.shrink(min_show=0.95) == 4  # caller above the floor wins
+
+
+def test_ttl_age_survives_ssd_spill(flagset, tmp_path):
+    """A row's unseen-days clock must not reset when it round-trips
+    through the disk tier."""
+    from paddlebox_tpu.embedding.ssd_tier import TieredFeatureStore
+    flagset(table_ttl_days=0)
+    store = TieredFeatureStore(CFG, str(tmp_path / "ssd"),
+                               max_ram_features=4)
+    cold = np.arange(1, 5, dtype=np.uint64)
+    _touch(store, cold)
+    store.shrink()                 # cold at age 1
+    hot = np.arange(100, 108, dtype=np.uint64)
+    _touch(store, hot)             # evicts the cold (show-decayed) rows
+    assert store.ram.num_features <= 4
+    ages = store.unseen_for(cold)
+    np.testing.assert_array_equal(ages, 1)   # tracked on disk
+    # Stage back in (read pull) — age still 1, not reset to 0.
+    store.pull_for_pass(cold)
+    np.testing.assert_array_equal(store.unseen_for(cold), 1)
+
+
+def test_ttl_bounds_store_under_churning_traffic(flagset):
+    """The acceptance shape: 3 'days' of churning keys with TTL on —
+    the resident row count stays bounded instead of growing linearly."""
+    flagset(table_ttl_days=1)
+    store = FeatureStore(CFG)
+    per_day = 200
+    day_rows = []
+    for day in range(3):
+        lo = 1 + day * per_day // 2          # half carries, half churns
+        keys = np.arange(lo, lo + per_day, dtype=np.uint64)
+        _touch(store, keys)
+        store.shrink()
+        day_rows.append(store.num_features)
+    assert day_rows[2] <= day_rows[0] * 1.5, day_rows
+    # And without lifecycle the same traffic grows monotonically.
+    flags.set_flags({"table_ttl_days": 0})
+    ref = FeatureStore(CFG)
+    ref_rows = []
+    for day in range(3):
+        lo = 1 + day * per_day // 2
+        _touch(ref, np.arange(lo, lo + per_day, dtype=np.uint64))
+        ref.shrink()
+        ref_rows.append(ref.num_features)
+    assert ref_rows[2] > day_rows[2]
+
+
+def test_shrink_still_guards_save_delta(tmp_path, flagset):
+    store = FeatureStore(CFG)
+    _touch(store, np.arange(1, 9, dtype=np.uint64))
+    store.shrink()
+    with pytest.raises(RuntimeError, match="save_delta after shrink"):
+        store.save_delta(str(tmp_path / "d"))

@@ -58,6 +58,11 @@ class Config:
         "paddlebox_tpu.train.day_runner:DayRunner.train_pass",
         "paddlebox_tpu.embedding.pass_engine:PassEngine.*",
         "paddlebox_tpu.embedding.device_store:*",
+        # The streaming pass loop replays carved manifests bit-identical
+        # after kill -9: its clock is INJECTED (clock=), so wall reads
+        # on the closure would be a contract break, not telemetry.
+        "paddlebox_tpu.stream.runner:StreamRunner.*",
+        "paddlebox_tpu.stream.source:*",
     )
     # suppression
     baseline_path: Optional[str] = None   # default: <pkg>/baseline.json

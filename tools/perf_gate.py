@@ -57,7 +57,10 @@ HIGHER_SUFFIXES = ("_per_s", "per_sec", "samples_per_s", "auc",
                    "_rps", "mfu", "achieved_gflops_per_chip",
                    # serving micro-batcher: fuller packed batches =
                    # better coalescing (bench serve --clients keys).
-                   "fill_frac")
+                   "fill_frac",
+                   # streaming online mode (bench.py online): fewer
+                   # trained passes per hour = staler served models.
+                   "_per_hour")
 LOWER_SUFFIXES = ("_ms", "_s", "_bytes", "idle_frac",
                   "host_critical_share", "blocked_up_frac",
                   "blocked_down_frac", "violations", "host_syncs",
@@ -71,7 +74,12 @@ HIGHER_NAMES = ("value",)  # bench headline — every config is throughput
 # counts are lower-better — gating a new summary against a recorded one
 # fails the run when the baseline/pragma surface silently grows.
 LOWER_NAMES = ("findings_total", "new", "baselined", "allowed",
-               "warnings")
+               "warnings",
+               # bench.py online: a growing post-lifecycle store means
+               # TTL/decay stopped bounding the table (the freshness
+               # quantiles under event_to_servable_ms gate through the
+               # "_ms" suffix like every latency).
+               "post_shrink_store_rows")
 
 
 def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
@@ -219,6 +227,16 @@ def smoke() -> int:
             "reshard_ms": 13.0,
             "reshard_rows_per_s": 7.6e5,
             "reshard_moved_rows": 10036,
+            # bench.py online keys (r17 streaming tier): freshness
+            # quantiles gate lower-better ("_ms" in the parent segment),
+            # passes_per_hour higher-better, the post-lifecycle row
+            # count lower-better; stream_passes/events are workload
+            # provenance and must NOT gate.
+            "event_to_servable_ms": {"p50": 900.0, "p99": 2500.0},
+            "passes_per_hour": 620.0,
+            "post_shrink_store_rows": 31000,
+            "stream_passes": 12,
+            "events": 49152,
             "steps_per_dispatch": 4,        # not gated (count)
             "ingest_workers": 8,            # not gated (count)
             "store_build_native": True,     # not gated (bool)
@@ -260,6 +278,10 @@ def smoke() -> int:
     bad["replicas"]["r2"]["route_ms_quantiles"]["p99"] = 90.0
     bad["replicas"]["r2"]["degraded_frac"] = 0.5
     bad["replicas"]["r2"]["clients"] = 2      # provenance: must NOT gate
+    bad["event_to_servable_ms"]["p99"] = 60000.0  # freshness blown
+    bad["passes_per_hour"] = 80.0
+    bad["post_shrink_store_rows"] = 500000    # lifecycle stopped bounding
+    bad["stream_passes"] = 2                  # provenance: must NOT gate
     _, regs = compare(bad, base)
     names = {r["metric"] for r in regs}
     for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
@@ -270,11 +292,15 @@ def smoke() -> int:
                  "reshard_ms",
                  "replicas.r2.throughput_rps",
                  "replicas.r2.route_ms_quantiles.p99",
-                 "replicas.r2.degraded_frac"):
+                 "replicas.r2.degraded_frac",
+                 "event_to_servable_ms.p99",
+                 "passes_per_hour",
+                 "post_shrink_store_rows"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
     for never in ("ingest_workers", "store_build_native",
-                  "reshard_moved_rows", "replicas.r2.clients"):
+                  "reshard_moved_rows", "replicas.r2.clients",
+                  "stream_passes", "events"):
         expect(f"provenance {never!r} not gated", never in names, False)
     # An IMPROVEMENT must never trip the gate.
     good = json.loads(json.dumps(base))
