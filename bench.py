@@ -1485,6 +1485,14 @@ def bench_multihost() -> dict:
     stop_shards(servers)
     joiner.stop()
 
+    # Replicated-tier failover: a replicas=2 cluster under a pull loop
+    # takes a scripted primary kill — pull p99 across the kill is the
+    # failover blip (reads fail over to the surviving backup), the
+    # promote+re-replicate repair restores R, and the journal catch-up
+    # rate is measured by re-syncing a lagged backup.
+    _tick("multihost:failover")
+    fo = _bench_multihost_failover(cfg, keys)
+
     f32 = out_wire["f32"]
     return {
         "metric": f"multihost_{hosts}host_exchange_keys_per_sec",
@@ -1500,8 +1508,89 @@ def bench_multihost() -> dict:
             rec["moved_rows"] / max(rec["reshard_ms"], 1e-6) * 1e3, 1),
         "reshard_minimal_frac": round(
             rec["moved_rows"] / max(minimal, 1), 4),
+        "failover_blip_ms": fo["failover_blip_ms"],
+        "failover_pull_p50_ms": fo["pull_p50_ms"],
+        "repair_ms": fo["repair_ms"],
+        "journal_catchup_rows_per_s": fo["journal_catchup_rows_per_s"],
+        "failover_failed_pulls": fo["failed_pulls"],  # provenance: 0
         "embedding_quant_block": int(flags.flag("embedding_quant_block")),
     }
+
+
+def _bench_multihost_failover(cfg, keys) -> dict:
+    """Scripted primary kill under a pull loop (MULTIHOST.md
+    "replicated tier"): records the pull p99 across the kill
+    (failover_blip_ms — the read-failover cost of losing a shard
+    host), the promote + re-replicate repair wall time (repair_ms),
+    and the journal catch-up throughput for a briefly-lagged backup
+    (journal_catchup_rows_per_s)."""
+    import numpy as np
+
+    from paddlebox_tpu.core import monitor
+    from paddlebox_tpu.multihost import (MultiHostStore, ReplicaMap,
+                                         start_local_shards, stop_shards)
+    from paddlebox_tpu.multihost.shard_service import ShardServer
+
+    sub = keys[: max(1, keys.size // 8)]   # a serving-sized working set
+    servers, eps = start_local_shards(2, cfg, replicas=2)
+    store = MultiHostStore(cfg, eps, replicas=2)
+    rows = store.pull_for_pass(sub)
+    store.push_from_pass(sub, rows)
+
+    # Journal catch-up rate: sever the backup's conns so one push lags,
+    # then time the forced re-sync (delta replay of the missed rows).
+    servers[1].close_connections()
+    rows["show"] += 1.0
+    t0 = time.perf_counter()
+    store.push_from_pass(sub, rows)        # in-line catch-up fires here
+    store.sync_replicas()
+    catchup_s = time.perf_counter() - t0
+    catchup_rows_per_s = sub.size / max(catchup_s, 1e-9)
+
+    # The scripted kill under a pull loop.
+    lat_ms, failed = [], 0
+    kill_at = 10
+    fresh = None
+    try:
+        for i in range(30):
+            if i == kill_at:
+                servers[1].kill()          # the primary of ~half the keys
+            t1 = time.perf_counter()
+            try:
+                store.pull_for_pass(sub)
+            except Exception:
+                failed += 1
+                continue
+            lat_ms.append((time.perf_counter() - t1) * 1e3)
+        lat = np.sort(np.asarray(lat_ms))
+        blip_ms = float(lat[min(len(lat) - 1,
+                                int(0.99 * len(lat)))])
+        p50_ms = float(lat[len(lat) // 2])
+
+        # Repair: promote the survivor, re-replicate to a fresh host.
+        from paddlebox_tpu.multihost.reshard import \
+            ElasticReshardController
+        ctl = ElasticReshardController(store, None)
+        t2 = time.perf_counter()
+        rec = ctl.repair(reason="bench scripted kill")
+        assert rec is not None
+        fresh = ShardServer("127.0.0.1:0", 0, store.ranges, cfg)
+        new_map = store.replica_map
+        for slot in range(new_map.world):
+            new_map = new_map.add_backup(slot, fresh.endpoint)
+        ctl._adopt_map(new_map)
+        store.sync_replicas()
+        repair_ms = (time.perf_counter() - t2) * 1e3
+        assert store.replica_map.replication == 2
+        monitor.set_gauge("multihost/repair_ms", repair_ms)
+    finally:
+        store.close()
+        stop_shards(servers + ([fresh] if fresh else []))
+    return {"failover_blip_ms": round(blip_ms, 2),
+            "pull_p50_ms": round(p50_ms, 2),
+            "repair_ms": round(repair_ms, 2),
+            "journal_catchup_rows_per_s": round(catchup_rows_per_s, 1),
+            "failed_pulls": failed}
 
 
 ONLINE_DAYS = 3                  # replayed log days (TTL needs >= 3)

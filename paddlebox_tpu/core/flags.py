@@ -585,6 +585,32 @@ define_flag("table_min_show", 0.0,
             "effective threshold is max(caller's min_show, this flag), "
             "so the lifecycle can be turned on fleet-wide without "
             "touching DayRunner call sites. 0 = no floor (default)")
+define_flag("multihost_replicas", 1,
+            "replication factor of the multi-host shard tier: each key "
+            "range keeps 1 primary + (R-1) backup copies on DISTINCT "
+            "hosts (ring placement — slot i's backups are the next "
+            "hosts). Writes apply on the primary and forward "
+            "synchronously to backups (a briefly-disconnected backup "
+            "catches up from the primary's sequence-numbered delta "
+            "journal instead of a full range copy); pure reads fail "
+            "over to any live replica. 1 (default) = no replication — "
+            "bit-identical to the pre-replication tier")
+define_flag("multihost_journal_entries", 256,
+            "per-range cap on the primary's delta-journal length "
+            "(entries, each one push/apply/shrink mutation): a backup "
+            "whose lag exceeds the journal window catches up with a "
+            "full range snapshot instead of deltas — the bound that "
+            "keeps journal memory and catch-up work finite. <= 0 "
+            "disables journaling (every catch-up is a full copy)")
+define_flag("stream_tail_bytes", False,
+            "streaming ingest: tail-consume log files still being "
+            "APPENDED — the source tracks a durable per-file byte "
+            "offset, carves complete-line byte ranges "
+            "('path@@start-end' manifest entries) instead of waiting "
+            "for the whole segment to be atomically renamed, and "
+            "resumes mid-file after kill -9 with no event lost or "
+            "duplicated. False (default) = whole-segment mode "
+            "(files must appear via write-tmp-then-rename)")
 define_flag("rpc_retry_deadline_s", 30.0,
             "overall wall-clock deadline across an idempotent call's "
             "retries: when exceeded the last connection error raises "

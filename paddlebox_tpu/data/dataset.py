@@ -35,10 +35,66 @@ from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
 
 _READ_BLOCK = 4 << 20  # bytes per parse chunk
 
+# File-list entries may name a BYTE RANGE of a file still being appended
+# (the streaming tier's tail-consume cursor, stream/source.py):
+# "<path>@@<start>-<end>" reads [start, end) — always cut at a newline
+# boundary by the producer, so the slice parses like a whole file.
+BYTE_RANGE_SEP = "@@"
+
+
+def split_byte_range(spec: str):
+    """``'p@@100-200'`` -> ``('p', 100, 200)``; plain path ->
+    ``(path, None, None)``. A malformed suffix is treated as a literal
+    path (``@@`` is no legal byte in this repo's day layouts)."""
+    if BYTE_RANGE_SEP not in spec:
+        return spec, None, None
+    path, _, rng = spec.rpartition(BYTE_RANGE_SEP)
+    a, dash, b = rng.partition("-")
+    try:
+        start, end = int(a), int(b)
+    except ValueError:
+        return spec, None, None
+    if not dash or start < 0 or end < start:
+        return spec, None, None
+    return path, start, end
+
+
+class _ByteSlice:
+    """Read-only [start, end) window of an open binary file."""
+
+    def __init__(self, f, start: int, end: int):
+        f.seek(start)
+        self._f = f
+        self._left = end - start
+
+    def read(self, n: int = -1) -> bytes:
+        if self._left <= 0:
+            return b""
+        n = self._left if n is None or n < 0 else min(n, self._left)
+        b = self._f.read(n)
+        self._left -= len(b)
+        return b
+
+    def close(self) -> None:
+        self._f.close()
+
 
 def _open_stream(path: str, pipe_command: str):
     """Open a byte stream, optionally through a shell filter (role of
-    pipe_command in data_feed.proto:47 / shell_popen io/fs.cc:69)."""
+    pipe_command in data_feed.proto:47 / shell_popen io/fs.cc:69).
+    Byte-range specs open the base file windowed to [start, end)."""
+    base, start, end = split_byte_range(path)
+    if start is not None:
+        if pipe_command:
+            # A shell filter consumes the raw stream start-to-finish —
+            # a mid-file window through it would re-decompress the
+            # whole prefix per range (and gzip members don't align to
+            # carve cuts). Loud, not silent-wrong.
+            raise ValueError(
+                f"byte-range spec {path!r} cannot combine with "
+                f"pipe_command {pipe_command!r} — tail-consume plain "
+                "text logs only (ONLINE.md)")
+        return None, _ByteSlice(open(base, "rb"), start, end)
     if pipe_command:
         f = open(path, "rb")
         proc = subprocess.Popen(pipe_command, shell=True, stdin=f,
@@ -151,7 +207,8 @@ class Dataset:
     # -- file list ---------------------------------------------------------
 
     def set_filelist(self, files: Sequence[str]) -> None:
-        missing = [f for f in files if not os.path.exists(f)]
+        missing = [f for f in files
+                   if not os.path.exists(split_byte_range(f)[0])]
         if missing:
             raise FileNotFoundError(f"dataset files missing: {missing[:3]}")
         # The pipelined day loop calls this from its preload thread while

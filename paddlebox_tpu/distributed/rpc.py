@@ -46,6 +46,13 @@ class FramedRPCServer:
                                             backlog=backlog)
         self.endpoint = f"{host}:{self._server.getsockname()[1]}"
         self._running = True
+        # Live accepted sockets: close_connections() lets an in-process
+        # "host death" (tests/drills) sever established conns the way a
+        # SIGKILL would — stop() alone only closes the LISTENER, and a
+        # persistent client conn would otherwise get one more reply
+        # from the "dead" host.
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
     def _accept_loop(self) -> None:
@@ -54,10 +61,34 @@ class FramedRPCServer:
                 conn, _ = self._server.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    def close_connections(self) -> None:
+        """Abruptly sever every established connection (kill-like
+        teardown for drills; graceful stops keep draining replies)."""
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
     def _serve(self, conn: socket.socket) -> None:
+        try:
+            self._serve_inner(conn)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def _serve_inner(self, conn: socket.socket) -> None:
         try:
             with conn:
                 while True:

@@ -969,13 +969,26 @@ class DeviceFeatureStore:
         fresh key index, zeroed HBM block, clean delta set."""
         self.set_all(np.empty((0,), np.uint64), self._empty_vals())
 
-    def _save_arrays(self, path: str, keys, vals, kind: str) -> None:
+    def _save_arrays(self, path: str, keys, vals, kind: str,
+                     unseen=None) -> None:
         os.makedirs(path, exist_ok=True)
         final = os.path.join(path, f"{self.config.name}.{kind}.npz")
         tmp = os.path.join(path, f".{self.config.name}.{kind}.tmp")
         with open(tmp, "wb") as f:
             np.savez_compressed(f, keys=keys, **vals)
         os.replace(tmp, final)
+        if unseen is not None:
+            # Unseen-days TTL sidecar aligned to the npz's key order —
+            # same format as FeatureStore's (ONLINE.md), so the six
+            # store variants' checkpoints stay mutually loadable.
+            ages_final = os.path.join(
+                path, f"{self.config.name}.{kind}.ages.npz")
+            ages_tmp = os.path.join(
+                path, f".{self.config.name}.{kind}.ages.tmp")
+            with open(ages_tmp, "wb") as f:
+                np.savez_compressed(
+                    f, unseen=np.ascontiguousarray(unseen, np.int32))
+            os.replace(ages_tmp, ages_final)
         meta = {"kind": kind, "num_features": int(keys.shape[0]),
                 "dim": self.config.dim, "table": self.config.name}
         with open(os.path.join(path,
@@ -983,14 +996,22 @@ class DeviceFeatureStore:
                   "w") as f:
             json.dump(meta, f)
 
+    def _ages_for_locked(self, keys: np.ndarray) -> np.ndarray:
+        rows = self._index.lookup(keys)
+        out = np.zeros(keys.shape, np.int32)
+        found = rows >= 0
+        out[found] = self._unseen[rows[found]]
+        return out
+
     def save_base(self, path: str) -> None:
         with self._lock:
             keys = np.sort(self._index.keys_by_row())
             vals = (self._snapshot_sorted_locked(keys) if keys.size
                     else self._empty_vals())
+            unseen = self._ages_for_locked(keys)
             self._dirty_parts = []
             self._shrunk_since_base = False
-        self._save_arrays(path, keys, vals, "base")
+        self._save_arrays(path, keys, vals, "base", unseen=unseen)
         log.vlog(0, "device store save_base: %d features -> %s",
                  keys.shape[0], path)
 
@@ -1007,7 +1028,8 @@ class DeviceFeatureStore:
             dirty = dirty[present]
             vals = (self._snapshot_sorted_locked(dirty) if dirty.size
                     else self._empty_vals())
-        self._save_arrays(path, dirty, vals, "delta")
+            unseen = self._ages_for_locked(dirty)
+        self._save_arrays(path, dirty, vals, "delta", unseen=unseen)
         log.vlog(0, "device store save_delta: %d features -> %s",
                  dirty.shape[0], path)
 
@@ -1069,3 +1091,20 @@ class DeviceFeatureStore:
         else:
             self._check_state_widths(vals)
             self.push_from_pass(keys, vals)
+        # Restore the unseen-days TTL sidecar (when present — see
+        # FeatureStore.load): the push/set path above reset the loaded
+        # keys' ages, which is correct only for genuinely-new training
+        # writes, not a restart reload.
+        ages_f = os.path.join(path,
+                              f"{self.config.name}.{kind}.ages.npz")
+        if os.path.exists(ages_f):
+            ages = np.load(ages_f)["unseen"].astype(np.int32)
+            if ages.shape[0] == keys.shape[0]:
+                with self._lock:
+                    rows = self._index.lookup(keys)
+                    found = rows >= 0
+                    self._unseen[rows[found]] = ages[found]
+            else:
+                log.warning("ages sidecar %s has %d rows, checkpoint "
+                            "has %d — ignoring it", ages_f,
+                            ages.shape[0], keys.shape[0])

@@ -358,10 +358,19 @@ class TieredFeatureStore:
             self._save_base_locked(path)
 
     def _save_base_locked(self, path: str) -> None:
-        self.ram.save_base(path)
+        self.ram.save_base(path)   # writes the RAM tier's ages sidecar
         self._evicted_dirty = np.empty((0,), np.uint64)
         self.disk.copy_to(os.path.join(path,
                                        f"{self.config.name}.ssd"))
+        # Disk-resident rows track their TTL ages in the in-memory
+        # RowAges side table — persist it beside the copied buckets so
+        # a restart restores disk rows' leases too (ONLINE.md).
+        ages_final = os.path.join(path, f"{self.config.name}.ssd.ages.npz")
+        ages_tmp = os.path.join(path, f".{self.config.name}.ssd.ages.tmp")
+        with open(ages_tmp, "wb") as f:
+            np.savez_compressed(f, keys=self._disk_ages._keys,
+                                unseen=self._disk_ages._age)
+        os.replace(ages_tmp, ages_final)
 
     def save_xbox(self, path: str) -> int:
         """Serving export across BOTH tiers (RAM ∪ disk — the tiers hold
@@ -428,14 +437,21 @@ class TieredFeatureStore:
         return out
 
     def _load_locked(self, path: str, kind: str) -> None:
-        self.ram.load(path, kind)
+        self.ram.load(path, kind)   # restores the RAM ages sidecar too
         if kind == "base":
-            # Base-load semantics match the RAM tier's set_all: every
-            # surviving row restarts its TTL lease at age 0.
             self._disk_ages.clear()
             ssd_src = os.path.join(path, f"{self.config.name}.ssd")
             if os.path.isdir(ssd_src):
                 self.disk.restore_from(ssd_src)
+            # Disk-tier ages sidecar (when present — a pre-sidecar
+            # checkpoint's disk rows restart their TTL lease, the
+            # documented legacy behavior).
+            ages_f = os.path.join(path,
+                                  f"{self.config.name}.ssd.ages.npz")
+            if os.path.exists(ages_f):
+                data = np.load(ages_f)
+                self._disk_ages.set(data["keys"].astype(np.uint64),
+                                    data["unseen"].astype(np.int32))
         else:
             # Disjoint-tiers invariant: the delta's keys are now
             # authoritative in RAM — purge any disk copies (a delta can

@@ -305,15 +305,20 @@ class FeatureStore:
                 out[found] = self._unseen[pos_c[found]]
         return out
 
-    def shrink(self, *, min_show: float = 0.0) -> int:
+    def shrink(self, *, min_show: float = 0.0,
+               resolved: Optional[Tuple[float, int, float]] = None) -> int:
         """Day-level table shrink (role of BoxPS ShrinkTable / pslib
         shrink): decay show/click, bump every row's unseen_days, and
         evict rows past the TTL or under the show threshold — policy
         resolved through :func:`lifecycle.shrink_params` so the
         ``FLAGS_table_*`` lifecycle knobs apply uniformly across every
-        store variant."""
-        decay, ttl, min_show = lifecycle.shrink_params(self.config,
-                                                       min_show)
+        store variant. ``resolved`` = pre-resolved (decay, ttl,
+        min_show) from a REMOTE policy decision (a replicated shard's
+        primary forwards its resolved numbers so a backup host with
+        different flags applies the identical shrink)."""
+        decay, ttl, min_show = (resolved if resolved is not None
+                                else lifecycle.shrink_params(self.config,
+                                                             min_show))
         with self._lock:
             self._shrunk_since_base = True
             self._vals["show"] *= np.float32(decay)
@@ -337,7 +342,8 @@ class FeatureStore:
     # -- checkpoint: base + delta -----------------------------------------
 
     def _save_arrays(self, path: str, keys: np.ndarray,
-                     vals: Dict[str, np.ndarray], kind: str) -> None:
+                     vals: Dict[str, np.ndarray], kind: str,
+                     unseen: Optional[np.ndarray] = None) -> None:
         os.makedirs(path, exist_ok=True)
         final = os.path.join(path, f"{self.config.name}.{kind}.npz")
         # Atomic write: a crash (or a concurrent writer) mid-savez must
@@ -346,11 +352,39 @@ class FeatureStore:
         with open(tmp, "wb") as f:
             np.savez_compressed(f, keys=keys, **vals)
         os.replace(tmp, final)
+        if unseen is not None:
+            # Sidecar ages file ALIGNED to the main npz's key order
+            # (ONLINE.md "persisted TTL ages"): kept out of the value
+            # record so the checkpoint format and every wire stay
+            # unchanged, and a pre-sidecar loader simply ignores it.
+            ages_final = os.path.join(
+                path, f"{self.config.name}.{kind}.ages.npz")
+            ages_tmp = os.path.join(
+                path, f".{self.config.name}.{kind}.ages.tmp")
+            with open(ages_tmp, "wb") as f:
+                np.savez_compressed(
+                    f, unseen=np.ascontiguousarray(unseen, np.int32))
+            os.replace(ages_tmp, ages_final)
         meta = {"kind": kind, "num_features": int(keys.shape[0]),
                 "dim": self.config.dim, "table": self.config.name}
         with open(os.path.join(path, f"{self.config.name}.{kind}.meta.json"),
                   "w") as f:
             json.dump(meta, f)
+
+    def _load_ages(self, path: str, kind: str, n: int
+                   ) -> Optional[np.ndarray]:
+        """The unseen-days sidecar beside a checkpoint npz (None for
+        pre-sidecar checkpoints or a row-count mismatch — those rows
+        restart their TTL lease, the documented legacy behavior)."""
+        f = os.path.join(path, f"{self.config.name}.{kind}.ages.npz")
+        if not os.path.exists(f):
+            return None
+        ages = np.load(f)["unseen"]
+        if ages.shape[0] != n:
+            log.warning("ages sidecar %s has %d rows, checkpoint has %d "
+                        "— ignoring it", f, ages.shape[0], n)
+            return None
+        return ages.astype(np.int32)
 
     def save_base(self, path: str) -> None:
         """Full snapshot; resets the delta set (role of SaveBase,
@@ -358,9 +392,10 @@ class FeatureStore:
         with self._lock:
             keys = self._keys.copy()
             vals = {f: self._vals[f].copy() for f in _FIELDS}
+            unseen = self._unseen.copy()
             self._dirty_parts = []
             self._shrunk_since_base = False
-        self._save_arrays(path, keys, vals, "base")
+        self._save_arrays(path, keys, vals, "base", unseen=unseen)
         log.vlog(0, "save_base: %d features -> %s", keys.shape[0], path)
 
     def save_delta(self, path: str) -> None:
@@ -376,7 +411,8 @@ class FeatureStore:
             present, pos = self._locate(dirty)
             dirty = dirty[present]
             vals = {f: self._vals[f][pos[present]] for f in _FIELDS}
-        self._save_arrays(path, dirty, vals, "delta")
+            unseen = self._unseen[pos[present]].copy()
+        self._save_arrays(path, dirty, vals, "delta", unseen=unseen)
         log.vlog(0, "save_delta: %d features -> %s", dirty.shape[0], path)
 
     def save_xbox(self, path: str) -> int:
@@ -406,14 +442,19 @@ class FeatureStore:
                     f"written with a different sparse optimizer")
 
     def set_all(self, keys_sorted: np.ndarray,
-                vals: Dict[str, np.ndarray]) -> None:
+                vals: Dict[str, np.ndarray], *,
+                unseen: Optional[np.ndarray] = None) -> None:
         """Replace the entire contents (base-load semantics: delta set
-        cleared, shrink guard reset). Keys must be sorted unique."""
+        cleared, shrink guard reset). Keys must be sorted unique.
+        ``unseen`` restores per-row TTL ages (the checkpoint sidecar /
+        a replica snapshot); None = every row starts a fresh lease."""
         self._check_state_widths(vals)
         with self._lock:
             self._keys = np.ascontiguousarray(keys_sorted, np.uint64)
             self._vals = {f: np.asarray(vals[f]) for f in _FIELDS}
-            self._unseen = np.zeros(self._keys.shape, np.int32)
+            self._unseen = (np.ascontiguousarray(unseen, np.int32).copy()
+                            if unseen is not None
+                            else np.zeros(self._keys.shape, np.int32))
             self._dirty_parts = []
             self._shrunk_since_base = False
 
@@ -431,12 +472,15 @@ class FeatureStore:
             "click": np.empty((0,), np.float32)})
 
     def load(self, path: str, kind: str = "base") -> None:
-        """Load a base snapshot, or apply a delta on top."""
+        """Load a base snapshot, or apply a delta on top. The ages
+        sidecar (when present) restores each row's unseen-days TTL age
+        so a restart no longer grants every row a fresh lease."""
         data = np.load(os.path.join(path, f"{self.config.name}.{kind}.npz"))
         keys = data["keys"].astype(np.uint64)
         vals = {f: data[f] for f in _FIELDS}
+        ages = self._load_ages(path, kind, keys.shape[0])
         if kind == "base":
-            self.set_all(keys, vals)
+            self.set_all(keys, vals, unseen=ages)
         else:
             self._check_state_widths(vals)
-            self.push_from_pass(keys, vals)
+            self.push_from_pass(keys, vals, unseen=ages)

@@ -13,6 +13,11 @@ Three connected pieces take the sparse parameter service across hosts:
 - :mod:`~paddlebox_tpu.multihost.reshard` — elastic live resharding:
   minimal-transfer row moves at a checkpointed pass boundary when the
   elastic rank table changes.
+- :mod:`~paddlebox_tpu.multihost.replication` — the replicated tier
+  (``FLAGS_multihost_replicas``): per-slot primary+backup placement
+  (:class:`ReplicaMap`), the primary's sequence-numbered
+  :class:`DeltaJournal` for briefly-disconnected-backup catch-up, and
+  the loud-transient :class:`StalePrimaryError` write contract.
 
 :class:`~paddlebox_tpu.multihost.store.MultiHostStore` plugs the tier
 into the existing trainer as its backing store
@@ -25,6 +30,9 @@ from paddlebox_tpu.multihost.keyrange import (MoveSegment,  # noqa: F401
                                               ShardRangeTable, mix_keys,
                                               plan_moves,
                                               rows_moved_minimal)
+from paddlebox_tpu.multihost.replication import (DeltaJournal,  # noqa: F401
+                                                 ReplicaMap,
+                                                 StalePrimaryError)
 from paddlebox_tpu.multihost.reshard import (ElasticReshardController,  # noqa: F401,E501
                                              execute_reshard)
 from paddlebox_tpu.multihost.shard_service import (ShardClient,  # noqa: F401

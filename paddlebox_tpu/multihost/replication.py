@@ -1,0 +1,245 @@
+"""Replicated shard tier: replica placement map + delta journal.
+
+The ShardServer tier (shard_service.py) is the backbone of both
+training (MultiHostStore) and serving (ShardBackedStore); without
+replication one SIGKILL'd shard host loses its whole key range until an
+operator reloads a checkpoint. This module holds the two pure data
+structures the replicated tier is built from — the wiring lives in
+shard_service/store/reshard:
+
+- :class:`ReplicaMap`: the membership-generation assignment of each key
+  range SLOT to an ordered endpoint list (primary first, then backups
+  on DISTINCT hosts — ring placement, slot i's j-th backup is the host
+  that is primary of slot ``(i+j) % world``). The range BOUNDS
+  (:class:`~paddlebox_tpu.multihost.keyrange.ShardRangeTable`) never
+  change on host loss: fail-over repair only re-points a slot's
+  endpoints, so the re-replication transfer is bounded by the dead
+  host's R slots — never a full-table reshuffle ("Memory-efficient
+  array redistribution", PAPERS.md: the moved set is the measure of the
+  assignment delta, and endpoint re-pointing keeps that measure at the
+  failed host's share).
+
+- :class:`DeltaJournal`: the primary's per-slot sequence-numbered
+  mutation log. Every applied write (push / apply_rows / shrink) gets
+  ``seq += 1`` and forwards to the backups synchronously; a backup that
+  was briefly unreachable catches up by replaying ``since(its_seq)``
+  instead of a full range COPY — bounded by
+  ``FLAGS_multihost_journal_entries``, past which catch-up degrades to
+  the full snapshot (the bounded-re-replication contract).
+
+``replicas == 1`` constructs trivial single-endpoint maps and never
+touches the journal: the tier is bit-identical to the pre-replication
+code path (pinned by tests/test_replication.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from paddlebox_tpu.multihost.keyrange import ShardRangeTable
+
+
+class StalePrimaryError(RuntimeError):
+    """A write reached a server that is not (or no longer) the primary
+    of the keys' range — the client's replica map is stale (a failover
+    promotion or repair happened, or reads failed over and a push chased
+    them). LOUD by design, and TRANSIENT: the pass-retry loop re-resolves
+    the replica set through the elastic rank table and replays."""
+
+    transient = True
+
+
+def ring_assignment(endpoints: Sequence[str], replicas: int
+                    ) -> List[Tuple[str, ...]]:
+    """Slot i -> (endpoints[i], endpoints[i+1], ... R entries) — the
+    ring placement that puts every slot's copies on DISTINCT hosts.
+    ``replicas`` is clamped to the world size (a 2-host world cannot
+    hold 3 distinct copies)."""
+    world = len(endpoints)
+    r = max(1, min(int(replicas), world))
+    return [tuple(endpoints[(i + j) % world] for j in range(r))
+            for i in range(world)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaMap:
+    """One membership generation's slot → ordered-endpoints assignment.
+
+    ``assignment[slot][0]`` is the primary; the rest are backups in
+    catch-up preference order. Slots are the ranges of ``table`` (the
+    slot COUNT is fixed for the life of the replicated cluster — hosts
+    come and go under it via promotion/repair; elastic world RESIZING
+    remains the replicas=1 reshard path)."""
+
+    table: ShardRangeTable
+    assignment: Tuple[Tuple[str, ...], ...]
+
+    def __post_init__(self):
+        if len(self.assignment) != self.table.world:
+            raise ValueError(
+                f"{len(self.assignment)} slot assignments != "
+                f"{self.table.world} ranges")
+        for slot, eps in enumerate(self.assignment):
+            if not eps:
+                raise ValueError(f"slot {slot} has no endpoints")
+            if len(set(eps)) != len(eps):
+                raise ValueError(
+                    f"slot {slot} lists a duplicate endpoint: {eps} — "
+                    "replicas must live on distinct hosts")
+
+    @staticmethod
+    def ring(endpoints: Sequence[str], replicas: int,
+             table: Optional[ShardRangeTable] = None) -> "ReplicaMap":
+        table = table or ShardRangeTable.for_world(len(endpoints))
+        return ReplicaMap(table=table, assignment=tuple(
+            ring_assignment(endpoints, replicas)))
+
+    @property
+    def world(self) -> int:
+        return self.table.world
+
+    @property
+    def replication(self) -> int:
+        """The CURRENT replication factor = the thinnest slot (a dead
+        host removed by promotion lowers it until repair restores R)."""
+        return min(len(eps) for eps in self.assignment)
+
+    def primary(self, slot: int) -> str:
+        return self.assignment[slot][0]
+
+    def replicas_of(self, slot: int) -> Tuple[str, ...]:
+        return self.assignment[slot]
+
+    def primaries(self) -> List[str]:
+        return [eps[0] for eps in self.assignment]
+
+    def all_endpoints(self) -> List[str]:
+        """Every distinct endpoint, in first-appearance slot order."""
+        out: List[str] = []
+        for eps in self.assignment:
+            for e in eps:
+                if e not in out:
+                    out.append(e)
+        return out
+
+    def slots_of(self, endpoint: str) -> Dict[int, str]:
+        """slot -> role ('primary'|'backup') for one endpoint."""
+        roles: Dict[int, str] = {}
+        for slot, eps in enumerate(self.assignment):
+            if endpoint == eps[0]:
+                roles[slot] = "primary"
+            elif endpoint in eps:
+                roles[slot] = "backup"
+        return roles
+
+    def drop_endpoint(self, endpoint: str) -> "ReplicaMap":
+        """Fail-over PROMOTION: remove a dead endpoint everywhere; a
+        slot it led falls to its first surviving backup. Raises if any
+        slot would be left with no replica (data loss — recovery must
+        go through the checkpoint chain instead)."""
+        out: List[Tuple[str, ...]] = []
+        for slot, eps in enumerate(self.assignment):
+            kept = tuple(e for e in eps if e != endpoint)
+            if not kept:
+                raise ValueError(
+                    f"slot {slot} has no surviving replica after "
+                    f"dropping {endpoint} — unrecoverable without a "
+                    "checkpoint reload")
+            out.append(kept)
+        return ReplicaMap(table=self.table, assignment=tuple(out))
+
+    def add_backup(self, slot: int, endpoint: str) -> "ReplicaMap":
+        """Repair RE-REPLICATION: append a fresh backup to one slot."""
+        if endpoint in self.assignment[slot]:
+            return self
+        out = list(self.assignment)
+        out[slot] = self.assignment[slot] + (endpoint,)
+        return ReplicaMap(table=self.table, assignment=tuple(out))
+
+    def to_dict(self) -> dict:
+        return {"table": self.table.to_dict(),
+                "assignment": [list(eps) for eps in self.assignment]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ReplicaMap":
+        return ReplicaMap(
+            table=ShardRangeTable.from_dict(d["table"]),
+            assignment=tuple(tuple(str(e) for e in eps)
+                             for eps in d["assignment"]))
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    seq: int
+    op: str                  # "push" | "apply" | "shrink"
+    payload: dict            # numpy arrays / scalars, wire-encodable
+
+
+class DeltaJournal:
+    """Per-slot sequence-numbered mutation log on the PRIMARY.
+
+    ``seq`` counts every mutation applied to the slot's store since this
+    primary took over; backups track the last (epoch, seq) they applied.
+    The log keeps the most recent ``cap`` entries: ``since(s)`` returns
+    the entries a backup at seq ``s`` is missing, or ``None`` when the
+    gap reaches past the retained window (→ full-snapshot catch-up).
+
+    ``epoch`` names the HISTORY the seqs count over. It changes whenever
+    the baseline under seq 0 changes — promotion, checkpoint load,
+    reset — because a seq is only meaningful relative to its baseline: a
+    freshly-loaded primary and a fresh-empty backup both sit at "seq 0"
+    with different bytes, and replaying the journal across that
+    mismatch would diverge silently. An epoch mismatch always forces a
+    full snapshot. Thread-safe: the owning server appends under its
+    slot lock but drills/benches read concurrently."""
+
+    def __init__(self, cap: int, *, start_seq: int = 0,
+                 epoch: str = ""):
+        self._cap = int(cap)
+        self._entries: deque = deque()
+        self._seq = int(start_seq)
+        self.epoch = epoch
+        self._lock = threading.Lock()
+
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def append(self, op: str, payload: dict) -> int:
+        """Assign the next seq to one applied mutation. With cap <= 0
+        the journal only counts (every catch-up snapshots)."""
+        with self._lock:
+            self._seq += 1
+            if self._cap > 0:
+                self._entries.append(
+                    JournalEntry(seq=self._seq, op=op, payload=payload))
+                while len(self._entries) > self._cap:
+                    self._entries.popleft()
+            return self._seq
+
+    def since(self, seq: int) -> Optional[List[JournalEntry]]:
+        """Entries with ``entry.seq > seq`` — the delta catch-up — or
+        None when the journal no longer reaches back that far (the
+        backup must take a full snapshot)."""
+        with self._lock:
+            if seq >= self._seq:
+                return []
+            if not self._entries or self._entries[0].seq > seq + 1:
+                return None
+            return [e for e in self._entries if e.seq > seq]
+
+    def reset(self, *, start_seq: int = 0, epoch: str = "") -> None:
+        """New history baseline: entries dropped, seq re-anchored, and
+        the epoch re-stamped so stale (old-epoch) backups snapshot."""
+        with self._lock:
+            self._entries.clear()
+            self._seq = int(start_seq)
+            self.epoch = epoch

@@ -37,6 +37,7 @@ import numpy as np
 
 from paddlebox_tpu.core import faults, log, monitor, trace
 from paddlebox_tpu.multihost.keyrange import ShardRangeTable, plan_moves
+from paddlebox_tpu.multihost.replication import ReplicaMap
 from paddlebox_tpu.multihost.shard_service import ShardClient
 from paddlebox_tpu.multihost.store import MultiHostStore
 
@@ -139,7 +140,11 @@ class ElasticReshardController:
 
     def maybe_apply(self, day: str, pass_id: int) -> Optional[Dict]:
         """Adopt a new rank-table generation if one is pending. Returns
-        the reshard audit record when a resize ran, None otherwise."""
+        the reshard audit record when a resize ran, None otherwise.
+        A REPLICATED store (FLAGS_multihost_replicas > 1) never
+        re-draws bounds here: membership changes route to the
+        promote/re-replicate repair path instead (fixed slot count,
+        endpoints move — MULTIHOST.md "failover repair")."""
         table = self._table_fn() if self._table_fn else None
         if table is None:
             return None
@@ -150,6 +155,11 @@ class ElasticReshardController:
             return None
         if table.generation == self._generation:
             return None
+        if self.store.replica_map is not None:
+            rec = self._maybe_repair(table)
+            if rec is not None:
+                self._generation = table.generation
+            return rec
         new_eps = self.endpoints_of(table)
         if new_eps is None:
             return None
@@ -189,3 +199,151 @@ class ElasticReshardController:
             self.store.load(base.path, "base")
         for d in deltas:
             self.store.load(d.path, "delta")
+
+    # -- replicated-tier failover repair -----------------------------------
+    #
+    # Bounds NEVER re-draw on host loss: the dead endpoint is dropped
+    # from the ReplicaMap (its slots fall to their first surviving
+    # backup — PROMOTION, a role flip on a server that already holds
+    # the rows), and replication is restored by snapshotting each
+    # thinned slot to a fresh host (RE-REPLICATION) — so the repair
+    # transfer is bounded by the dead host's R slots, never a
+    # whole-table reshuffle. COPY = the primary's replica snapshot
+    # (idempotent overwrite), ADOPT = set_replication everywhere +
+    # client set_replica_map, COMMIT = adopt dropping stale roles.
+
+    @staticmethod
+    def _probe(endpoint: str, timeout: float = 2.0) -> bool:
+        """Is a shard server answering at this endpoint? Connection
+        refused/reset/timeout = dead (loopback refuses instantly; a
+        hung host costs `timeout`)."""
+        try:
+            c = ShardClient(endpoint, timeout=timeout)
+        except (OSError, ConnectionError):
+            return False
+        try:
+            c.call("stats")
+            return True
+        except (OSError, ConnectionError, RuntimeError):
+            return False
+        finally:
+            c.close()
+
+    def _adopt_map(self, rmap: ReplicaMap) -> None:
+        """ADOPT on every live server of the new map, then the client.
+        Per-server adoption is idempotent, so a crash between servers
+        re-runs cleanly at the next repair attempt."""
+        for ep in rmap.all_endpoints():
+            c = ShardClient(ep)
+            try:
+                c.call("set_replication", map=rmap.to_dict())
+            finally:
+                c.close()
+        self.store.set_replica_map(rmap)
+
+    def repair(self, *, reason: str = "") -> Optional[Dict]:
+        """PROMOTION half of the failover repair, callable from the
+        pass-retry hook (DayRunner ``pass_retry_hook``): probe the
+        current map's endpoints, drop the dead ones (each dead
+        primary's slot falls to its first live backup), adopt the
+        thinned map on the survivors and the client. Re-replication to
+        a fresh host happens at the next checkpointed boundary
+        (``maybe_apply`` → ``_maybe_repair``), so a mid-pass repair
+        never moves rows — it only re-points endpoints. Returns the
+        audit record, or None when every endpoint answered."""
+        rmap = self.store.replica_map
+        if rmap is None:
+            return None
+        dead = [ep for ep in rmap.all_endpoints()
+                if not self._probe(ep)]
+        if not dead:
+            return None
+        faults.faultpoint("multihost/ranktable_apply")
+        t0 = time.perf_counter()
+        promoted: List[int] = []
+        new_map = rmap
+        for ep in dead:
+            before = new_map.primaries()
+            new_map = new_map.drop_endpoint(ep)
+            promoted += [s for s, (a, b) in enumerate(
+                zip(before, new_map.primaries())) if a != b]
+        with trace.span("multihost/repair", dead=len(dead),
+                        promoted=len(promoted)):
+            self._adopt_map(new_map)
+        repair_ms = (time.perf_counter() - t0) * 1e3
+        monitor.add("multihost/repairs", 1)
+        log.warning("multihost: PROMOTED %d slot(s) off dead host(s) %s "
+                    "in %.0f ms%s — replication %d until re-replication",
+                    len(promoted), dead, repair_ms,
+                    f" ({reason})" if reason else "",
+                    new_map.replication)
+        return {"kind": "promote", "dead": dead, "promoted": promoted,
+                "repair_ms": repair_ms,
+                "replication": new_map.replication}
+
+    def _maybe_repair(self, table) -> Optional[Dict]:
+        """Boundary-hook half: fold a new rank-table generation into
+        the replica map — drop members that left (promotion, if the
+        retry hook didn't already), then restore the replication factor
+        by snapshotting thinned slots to advertised hosts not yet in
+        the map. Failures leave the generation un-adopted (retried next
+        boundary); every step is an idempotent overwrite."""
+        rmap = self.store.replica_map
+        live = self.endpoints_of(table)
+        if live is None:
+            return None            # a joiner has not advertised yet
+        faults.faultpoint("multihost/ranktable_apply")
+        t0 = time.perf_counter()
+        promoted: List[int] = []
+        repaired: List[int] = []
+        try:
+            new_map = rmap
+            for ep in [e for e in rmap.all_endpoints() if e not in live]:
+                before = new_map.primaries()
+                new_map = new_map.drop_endpoint(ep)
+                promoted += [s for s, (a, b) in enumerate(
+                    zip(before, new_map.primaries())) if a != b]
+            # RE-REPLICATION: thinned slots take fresh backups from
+            # hosts not yet replicating them (round-robin over the
+            # advertised endpoints, distinct-host invariant preserved
+            # by add_backup's duplicate check).
+            want = self.store._replicas
+            fresh = [e for e in live if e not in new_map.all_endpoints()]
+            pool = fresh + [e for e in live
+                            if e in new_map.all_endpoints()]
+            for slot in range(new_map.world):
+                i = 0
+                while len(new_map.replicas_of(slot)) < want and pool:
+                    cand = pool[i % len(pool)]
+                    i += 1
+                    if i > 2 * len(pool):
+                        break     # nobody eligible (all already listed)
+                    if cand in new_map.replicas_of(slot):
+                        continue
+                    new_map = new_map.add_backup(slot, cand)
+                    repaired.append(slot)
+            if new_map is rmap:
+                return {"kind": "noop", "repair_ms": 0.0,
+                        "replication": rmap.replication}
+            with trace.span("multihost/repair",
+                            promoted=len(promoted),
+                            repaired=len(repaired)):
+                self._adopt_map(new_map)
+                # COPY: bring every repaired slot's new backups to the
+                # journal head (full snapshot for a fresh host).
+                self.store.sync_replicas()
+        except Exception as e:
+            monitor.add("multihost/repair_errors", 1)
+            log.warning("multihost: replica repair failed (%r) — "
+                        "retrying at the next boundary", e)
+            trace.instant("multihost/repair_rollback", error=repr(e))
+            return None
+        repair_ms = (time.perf_counter() - t0) * 1e3
+        monitor.add("multihost/repairs", 1)
+        log.vlog(0, "multihost: repair promoted=%s re-replicated=%s in "
+                 "%.0f ms — replication factor %d", promoted,
+                 sorted(set(repaired)), repair_ms, new_map.replication)
+        return {"kind": "repair", "promoted": promoted,
+                "repaired": sorted(set(repaired)),
+                "repair_ms": repair_ms,
+                "replication": new_map.replication}

@@ -16,6 +16,19 @@ layout of PR 1/8); a stable argsort by owner makes each host's slice
 CONTIGUOUS in that order, and the same plan object is reused by the
 matching push (``_plan_for`` caches it), so the boundary pays one owner
 argsort per pass, not one per direction.
+
+Replication (``FLAGS_multihost_replicas`` > 1 / ``replica_map=``): each
+slot's client conn carries a ``resolve`` hook wired to the CURRENT
+replica set, so the conn-level idempotent retry lands a failed pull on
+the next live replica instead of burning the retry deadline on a dead
+primary — a shard-host kill -9 under live traffic costs one reconnect
+on reads. A push reaching a non-primary replica surfaces the server's
+LOUD ``STALE_PRIMARY`` as a TRANSIENT
+:class:`~paddlebox_tpu.multihost.replication.StalePrimaryError`: the
+pass-retry loop re-resolves the topology (the repair controller's
+promotion, ``multihost/reshard.py``) and replays — a retry, not a lost
+range. ``replicas == 1`` (default) builds no map and is bit-identical
+to the pre-replication client.
 """
 
 from __future__ import annotations
@@ -29,6 +42,7 @@ from paddlebox_tpu.core import faults, monitor, trace
 from paddlebox_tpu.embedding.table import TableConfig
 from paddlebox_tpu.multihost import shard_service
 from paddlebox_tpu.multihost.keyrange import ShardRangeTable
+from paddlebox_tpu.multihost.replication import ReplicaMap, StalePrimaryError
 from paddlebox_tpu.multihost.shard_service import (ShardClient, decode_emb,
                                                    encode_emb,
                                                    payload_nbytes)
@@ -56,6 +70,15 @@ class _OwnerPlan:
                 and np.array_equal(self.keys, keys))
 
 
+def _raise_translated(e: BaseException) -> None:
+    """Server-side STALE_PRIMARY crosses the wire as a generic in-band
+    RuntimeError — rebuild the typed (transient) error so the pass-retry
+    loop classifies it correctly."""
+    if isinstance(e, RuntimeError) and "STALE_PRIMARY" in str(e):
+        raise StalePrimaryError(str(e)) from e
+    raise e
+
+
 class MultiHostStore:
     """FeatureStore-shaped client over the host-sharded shard servers."""
 
@@ -64,22 +87,63 @@ class MultiHostStore:
     shared = True
 
     def __init__(self, config: TableConfig, endpoints: Sequence[str], *,
-                 ranges: Optional[ShardRangeTable] = None):
+                 ranges: Optional[ShardRangeTable] = None,
+                 replicas: Optional[int] = None,
+                 replica_map: Optional[ReplicaMap] = None):
+        from paddlebox_tpu.core import flags
         self.config = config
         from paddlebox_tpu.embedding.optimizers import make_sparse_optimizer
         self.opt = make_sparse_optimizer(config)
-        self.ranges = ranges or ShardRangeTable.for_world(len(endpoints))
-        if self.ranges.world != len(endpoints):
-            raise ValueError(
-                f"{len(endpoints)} endpoints != range table world "
-                f"{self.ranges.world}")
-        self.endpoints = list(endpoints)
-        self._clients = [ShardClient(e) for e in self.endpoints]
+        self._replicas = int(replicas if replicas is not None
+                             else flags.flag("multihost_replicas"))
+        if replica_map is not None:
+            self.replica_map: Optional[ReplicaMap] = replica_map
+            self._replicas = max(self._replicas,
+                                 replica_map.replication)
+        elif self._replicas > 1:
+            self.replica_map = ReplicaMap.ring(
+                endpoints, self._replicas,
+                ranges or ShardRangeTable.for_world(len(endpoints)))
+        else:
+            self.replica_map = None
+        if self.replica_map is not None:
+            self.ranges = self.replica_map.table
+            self.endpoints = self.replica_map.primaries()
+        else:
+            self.ranges = ranges or ShardRangeTable.for_world(
+                len(endpoints))
+            if self.ranges.world != len(endpoints):
+                raise ValueError(
+                    f"{len(endpoints)} endpoints != range table world "
+                    f"{self.ranges.world}")
+            self.endpoints = list(endpoints)
+        self._clients = self._build_clients()
+        # Endpoint-keyed admin conns (save/load/reset/shrink/stop):
+        # distinct from the per-slot data clients so a backup-only host
+        # is still reachable for cluster-wide maintenance.
+        self._admin_clients: Dict[str, ShardClient] = {}
         self._plan: Optional[_OwnerPlan] = None
         self._plan_lock = threading.Lock()
         monitor.set_gauge("multihost/world_size", float(self.ranges.world))
+        if self.replica_map is not None:
+            monitor.set_gauge("multihost/replication",
+                              float(self.replica_map.replication))
 
     # -- topology ----------------------------------------------------------
+
+    def _build_clients(self) -> List[ShardClient]:
+        return [ShardClient(self.endpoints[slot],
+                            replicas_fn=self._replicas_fn(slot))
+                for slot in range(self.ranges.world)]
+
+    def _replicas_fn(self, slot: int):
+        if self.replica_map is None:
+            return None
+
+        def fn() -> Tuple[str, ...]:
+            m = self.replica_map
+            return m.replicas_of(slot) if m is not None else ()
+        return fn
 
     @property
     def world(self) -> int:
@@ -93,15 +157,42 @@ class MultiHostStore:
         if ranges.world != len(endpoints):
             raise ValueError(
                 f"{len(endpoints)} endpoints != world {ranges.world}")
+        if self.replica_map is not None:
+            self.set_replica_map(
+                ReplicaMap.ring(endpoints, self._replicas, ranges))
+            return
         old = self._clients
         self.endpoints = list(endpoints)
         self.ranges = ranges
-        self._clients = [ShardClient(e) for e in self.endpoints]
+        self._clients = self._build_clients()
         with self._plan_lock:
             self._plan = None
         for c in old:
             c.close()
         monitor.set_gauge("multihost/world_size", float(ranges.world))
+
+    def set_replica_map(self, rmap: ReplicaMap) -> None:
+        """Adopt a repaired/promoted replica-map generation (same slot
+        count; endpoints re-pointed). The owner plan survives when the
+        bounds are unchanged — only the clients re-bind."""
+        old = self._clients
+        same_bounds = rmap.table.bounds == self.ranges.bounds
+        self.replica_map = rmap
+        self.ranges = rmap.table
+        self.endpoints = rmap.primaries()
+        self._clients = self._build_clients()
+        if not same_bounds:
+            with self._plan_lock:
+                self._plan = None
+        for c in old:
+            c.close()
+        live = set(rmap.all_endpoints())
+        for ep in list(self._admin_clients):
+            if ep not in live:
+                self._admin_clients.pop(ep).close()
+        monitor.set_gauge("multihost/world_size", float(rmap.world))
+        monitor.set_gauge("multihost/replication",
+                          float(rmap.replication))
 
     def _plan_for(self, keys: np.ndarray) -> _OwnerPlan:
         """The ONE owner argsort per pass: the pull computes it, the
@@ -117,15 +208,17 @@ class MultiHostStore:
     def _fanout(self, work: List[Tuple[int, dict]], method: str) -> Dict:
         """Issue one RPC per non-empty peer slice concurrently (the DCN
         fan-out); raise the first error — a lost shard must fail the
-        pass loudly, never return garbage rows."""
+        pass loudly, never return garbage rows (a dead-primary write
+        surfaces as a TRANSIENT StalePrimaryError so the pass retry
+        re-resolves and replays)."""
         results: Dict[int, object] = {}
-        errs: List[BaseException] = []
+        errs: List[Tuple[int, BaseException]] = []
 
         def run(host: int, kw: dict) -> None:
             try:
                 results[host] = self._clients[host].call(method, **kw)
             except BaseException as e:
-                errs.append(e)
+                errs.append((host, e))
 
         if len(work) == 1:
             run(*work[0])
@@ -135,7 +228,57 @@ class MultiHostStore:
             [t.start() for t in ts]
             [t.join() for t in ts]
         if errs:
-            raise errs[0]
+            for h, e in errs:
+                if isinstance(e, RuntimeError) and "STALE_PRIMARY" in str(e):
+                    # The slot conn drifted onto a backup (sticky read
+                    # failover) or the map is stale: re-bind it to the
+                    # current primary so the pass retry's replay does
+                    # not re-hit the same stale target.
+                    old = self._clients[h]
+                    self._clients[h] = ShardClient(
+                        self.endpoints[h],
+                        replicas_fn=self._replicas_fn(h))
+                    old.close()
+            _raise_translated(errs[0][1])
+        return results
+
+    def _admin_eps(self) -> List[str]:
+        """Every distinct server process — primaries AND backup-only
+        hosts (a freshly re-replicated host leads no slot yet but must
+        still see reset/load/save/stop)."""
+        if self.replica_map is not None:
+            return self.replica_map.all_endpoints()
+        return list(dict.fromkeys(self.endpoints))
+
+    def _ep_client(self, ep: str) -> ShardClient:
+        c = self._admin_clients.get(ep)
+        if c is None:
+            c = self._admin_clients[ep] = ShardClient(ep)
+        return c
+
+    def _admin_fanout(self, kw: dict, method: str) -> Dict[str, object]:
+        """One RPC per distinct server, concurrently; first error
+        raises (admin ops — save/load/reset/shrink — must cover the
+        whole cluster or fail loudly)."""
+        eps = self._admin_eps()
+        results: Dict[str, object] = {}
+        errs: List[BaseException] = []
+
+        def run(ep: str) -> None:
+            try:
+                results[ep] = self._ep_client(ep).call(method, **kw)
+            except BaseException as e:
+                errs.append(e)
+
+        if len(eps) == 1:
+            run(eps[0])
+        else:
+            ts = [threading.Thread(target=run, args=(ep,), daemon=True)
+                  for ep in eps]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+        if errs:
+            _raise_translated(errs[0])
         return results
 
     # -- pass build surface ------------------------------------------------
@@ -230,48 +373,81 @@ class MultiHostStore:
             out[owner == h] = np.asarray(results[h], bool)
         return out
 
+    def unseen_for(self, keys: np.ndarray) -> np.ndarray:
+        """Unseen-days TTL ages across the shard cluster (pure read;
+        any key order — each key is asked of its owner only)."""
+        k = np.ascontiguousarray(keys, np.uint64)
+        out = np.zeros(k.shape, np.int32)
+        if k.size == 0:
+            return out
+        owner = self.ranges.owner_of(k)
+        work = [(h, {"keys": k[owner == h]}) for h in range(self.world)
+                if (owner == h).any()]
+        results = self._fanout(work, "unseen_for")
+        for h, _kw in work:
+            out[owner == h] = np.asarray(results[h], np.int32)
+        return out
+
+    def key_stats(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, show) across the shard cluster, key-sorted — the
+        FeatureStore surface drills/exports walk (pure read)."""
+        parts = self._admin_fanout({}, "key_stats").values()
+        keys = np.concatenate(
+            [np.asarray(p["keys"], np.uint64) for p in parts])
+        show = np.concatenate(
+            [np.asarray(p["show"], np.float32) for p in parts])
+        order = np.argsort(keys, kind="stable")
+        return keys[order], show[order]
+
     @property
     def num_features(self) -> int:
         return int(sum(s["num_features"]
-                       for s in self._fanout(
-                           [(h, {}) for h in range(self.world)],
-                           "stats").values()))
+                       for s in self._admin_fanout({}, "stats").values()))
 
     def shrink(self, *, min_show: float = 0.0) -> int:
         """Day-boundary lifecycle runs PER SHARD on the owning server
         (its local FeatureStore resolves the FLAGS_table_* decay/TTL/
-        min-show policy from that process's flags), then the post-shrink
-        row counts are republished so the operator reads the bounded
-        store size from one gauge, not a per-host scrape."""
-        evicted = int(sum(self._fanout(
-            [(h, {"min_show": min_show}) for h in range(self.world)],
-            "shrink").values()))
+        min-show policy from that process's flags and forwards the
+        resolved numbers to its backups), then the post-shrink row
+        counts are republished so the operator reads the bounded store
+        size from one gauge, not a per-host scrape."""
+        evicted = int(sum(self._admin_fanout(
+            {"min_show": min_show}, "shrink").values()))
         rows = self.num_features  # one stats fan-out, post-shrink
         monitor.set_gauge("multihost/rows", float(rows))
         return evicted
 
+    def sync_replicas(self) -> Dict[int, Dict[str, int]]:
+        """Force every slot's backups to the journal head (boundary
+        quiesce for drills/benches; no-op sans replication)."""
+        if self.replica_map is None:
+            return {}
+        out: Dict[int, Dict[str, int]] = {}
+        for slot in range(self.world):
+            if len(self.replica_map.replicas_of(slot)) > 1:
+                out[slot] = self._clients[slot].call(
+                    "sync_replicas", slot=slot)
+        return out
+
     def reset(self) -> None:
         """Pass-retry rollback surface: wipe every shard (the recovery
         chain reload that follows re-filters rows by range)."""
-        self._fanout([(h, {}) for h in range(self.world)], "reset")
+        self._admin_fanout({}, "reset")
         with self._plan_lock:
             self._plan = None
 
     # -- checkpoint surface ------------------------------------------------
 
     def save_base(self, path: str) -> None:
-        self._fanout([(h, {"path": path, "mode": "base"})
-                      for h in range(self.world)], "save")
+        self._admin_fanout({"path": path, "mode": "base"}, "save")
         self._write_meta(path, "base")
 
     def save_delta(self, path: str) -> None:
-        self._fanout([(h, {"path": path, "mode": "delta"})
-                      for h in range(self.world)], "save")
+        self._admin_fanout({"path": path, "mode": "delta"}, "save")
         self._write_meta(path, "delta")
 
     def save_xbox(self, path: str) -> int:
-        self._fanout([(h, {"path": path, "mode": "xbox"})
-                      for h in range(self.world)], "save")
+        self._admin_fanout({"path": path, "mode": "xbox"}, "save")
         self._write_meta(path, "xbox")
         return self.num_features
 
@@ -279,22 +455,27 @@ class MultiHostStore:
         import json
         import os
         os.makedirs(path, exist_ok=True)
+        meta = {"world": self.world, "kind": kind,
+                "table": self.config.name,
+                "ranges": self.ranges.to_dict()}
+        if self.replica_map is not None:
+            meta["replica_map"] = self.replica_map.to_dict()
         with open(os.path.join(
                 path, f"{self.config.name}.multihost.json"), "w") as f:
-            json.dump({"world": self.world, "kind": kind,
-                       "table": self.config.name,
-                       "ranges": self.ranges.to_dict()}, f)
+            json.dump(meta, f)
 
     def load(self, path: str, kind: str = "base") -> None:
-        self._fanout([(h, {"path": path, "kind": kind})
-                      for h in range(self.world)], "load")
+        self._admin_fanout({"path": path, "kind": kind}, "load")
 
     def stop_servers(self) -> None:
         try:
-            self._fanout([(h, {}) for h in range(self.world)], "stop")
+            self._admin_fanout({}, "stop")
         except Exception:
             pass
 
     def close(self) -> None:
         for c in self._clients:
             c.close()
+        for c in self._admin_clients.values():
+            c.close()
+        self._admin_clients = {}

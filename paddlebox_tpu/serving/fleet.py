@@ -478,19 +478,52 @@ class ShardBackedStore:
     """
 
     def __init__(self, endpoints: Sequence[str], dim: int, *,
-                 ranges=None, timeout: float = 60.0):
+                 ranges=None, timeout: float = 60.0, replica_map=None):
         from paddlebox_tpu.multihost.keyrange import ShardRangeTable
-        from paddlebox_tpu.multihost.shard_service import ShardClient
         self.dim = int(dim)
-        self.ranges = (ranges if ranges is not None
-                       else ShardRangeTable.for_world(len(endpoints)))
-        if self.ranges.world != len(endpoints):
-            raise ValueError(
-                f"{len(endpoints)} endpoints != range table world "
-                f"{self.ranges.world}")
-        self.endpoints = list(endpoints)
-        self._clients = [ShardClient(e, timeout=timeout)
-                         for e in self.endpoints]
+        self._timeout = float(timeout)
+        if replica_map is not None:
+            self.replica_map = replica_map
+            self.ranges = replica_map.table
+            self.endpoints = replica_map.primaries()
+        else:
+            self.replica_map = None
+            self.ranges = (ranges if ranges is not None
+                           else ShardRangeTable.for_world(len(endpoints)))
+            if self.ranges.world != len(endpoints):
+                raise ValueError(
+                    f"{len(endpoints)} endpoints != range table world "
+                    f"{self.ranges.world}")
+            self.endpoints = list(endpoints)
+        self._clients = self._build_clients()
+
+    def _build_clients(self):
+        # Replicated tier: each slot conn's reconnect-time resolve hook
+        # cycles through the slot's CURRENT replica set, so a replica's
+        # miss-path read survives a shard-host kill -9 at the cost of
+        # one reconnect — pull_serving is a pure read any replica
+        # answers (zero failed predict RPCs in the failover drill).
+        from paddlebox_tpu.multihost.shard_service import ShardClient
+
+        def replicas_fn(slot):
+            if self.replica_map is None:
+                return None
+            return lambda: (self.replica_map.replicas_of(slot)
+                            if self.replica_map is not None else ())
+        return [ShardClient(self.endpoints[s], timeout=self._timeout,
+                            replicas_fn=replicas_fn(s))
+                for s in range(self.ranges.world)]
+
+    def set_replica_map(self, replica_map) -> None:
+        """Adopt a promoted/repaired replica-map generation (same slot
+        count, endpoints re-pointed)."""
+        old = self._clients
+        self.replica_map = replica_map
+        self.ranges = replica_map.table
+        self.endpoints = replica_map.primaries()
+        self._clients = self._build_clients()
+        for c in old:
+            c.close()
 
     def read(self, keys: np.ndarray
              ) -> Tuple[np.ndarray, np.ndarray]:

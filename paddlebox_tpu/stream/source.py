@@ -33,6 +33,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from paddlebox_tpu.core import faults, flags, log, monitor
+from paddlebox_tpu.data.dataset import BYTE_RANGE_SEP, split_byte_range
 
 
 @dataclasses.dataclass(frozen=True)
@@ -127,11 +128,20 @@ class StreamSource:
                  consumed: Optional[set] = None):
         self.log_dir = log_dir
         self.pattern_suffix = pattern_suffix
-        self.day_of = day_of or (lambda path: "stream")
+        self._day_of = day_of or (lambda path: "stream")
         self._clock = clock
-        self._consumed: set = set(consumed or ())
-        # path -> (events, mtime); counted once per file, never re-read.
+        # Whole files fully consumed (plain paths), and — tail mode
+        # (FLAGS_stream_tail_bytes) — per-file consumed byte offsets
+        # reconstructed from the cursor's "path@@start-end" range specs:
+        # the durable mid-file resume point.
+        self._consumed: set = set()
+        self._offsets: Dict[str, int] = {}
+        self.mark_consumed(consumed or ())
+        # spec -> (events, mtime); counted once per registration, never
+        # re-read. In tail mode a spec names a byte range of a file
+        # still being appended; one pending (uncarved) range per file.
         self._meta: Dict[str, Tuple[int, float]] = {}
+        self._tail_pending: Dict[str, str] = {}   # base path -> spec
 
     # -- scanning ----------------------------------------------------------
 
@@ -145,13 +155,30 @@ class StreamSource:
                     n += 1
         return n
 
+    def day_of(self, spec: str) -> str:
+        """Day label of a file-list entry (byte-range specs label by
+        their base file)."""
+        return self._day_of(split_byte_range(spec)[0])
+
     def mark_consumed(self, files: Sequence[str]) -> None:
-        self._consumed.update(files)
+        """Record already-consumed entries (cursor replay): plain paths
+        are whole files; range specs advance the file's byte offset —
+        the durable mid-file cut kill -9 resumes from."""
+        for f in files:
+            base, _start, end = split_byte_range(f)
+            if end is None:
+                self._consumed.add(f)
+            else:
+                self._offsets[base] = max(self._offsets.get(base, 0),
+                                          end)
 
     def poll(self) -> int:
-        """Scan the directory for newly arrived files; returns how many
-        new files were registered. Files must appear atomically
-        (write-then-rename) — ONLINE.md documents the convention."""
+        """Scan the directory for newly arrived files (whole-segment
+        mode: files must appear atomically, write-then-rename) or newly
+        appended bytes (``FLAGS_stream_tail_bytes``: every file is an
+        append stream, consumed up to its last complete newline).
+        Returns how many new files/ranges were registered."""
+        tail = bool(flags.flag("stream_tail_bytes"))
         try:
             names = sorted(os.listdir(self.log_dir))
         except FileNotFoundError:
@@ -162,9 +189,21 @@ class StreamSource:
                     self.pattern_suffix):
                 continue
             path = os.path.join(self.log_dir, name)
-            if path in self._consumed or path in self._meta:
+            if path in self._consumed or not os.path.isfile(path):
                 continue
-            if not os.path.isfile(path):
+            if tail:
+                new += self._poll_tail(path)
+                continue
+            if path in self._meta:
+                continue
+            if path in self._offsets:
+                # A byte-offset cursor consumed part of this file in a
+                # previous (tail-mode) run: whole-segment mode cannot
+                # re-consume it without duplicating events.
+                log.warning("stream source: %s has a mid-file cursor at "
+                            "byte %d but FLAGS_stream_tail_bytes is off "
+                            "— skipping the file (re-enable tail mode "
+                            "to drain it)", path, self._offsets[path])
                 continue
             try:
                 mtime = os.path.getmtime(path)
@@ -179,6 +218,44 @@ class StreamSource:
             monitor.add("stream/files", 1)
         monitor.set_gauge("stream/pending_files", float(len(self._meta)))
         return new
+
+    def _poll_tail(self, path: str) -> int:
+        """Register one file's newly appended COMPLETE lines as a byte
+        range ``path@@offset-cut`` (cut = last newline). One pending
+        range per file; the next bytes register after it carves. A
+        trailing unterminated line is never consumed — the writer owns
+        it until its newline lands."""
+        if path in self._tail_pending:
+            return 0
+        offset = self._offsets.get(path, 0)
+        try:
+            size = os.path.getsize(path)
+            if size <= offset:
+                return 0
+            mtime = os.path.getmtime(path)
+            events = 0
+            cut = offset
+            with open(path, "rb") as f:
+                f.seek(offset)
+                buf = f.read(size - offset)
+            last_nl = buf.rfind(b"\n")
+            if last_nl < 0:
+                return 0
+            cut = offset + last_nl + 1
+            events = sum(1 for ln in buf[:last_nl + 1].split(b"\n")
+                         if ln.strip())
+        except OSError as e:
+            log.warning("stream source: %s vanished mid-poll (%s)",
+                        path, e)
+            return 0
+        if events == 0:
+            return 0
+        spec = f"{path}{BYTE_RANGE_SEP}{offset}-{cut}"
+        self._meta[spec] = (events, mtime)
+        self._tail_pending[path] = spec
+        monitor.add("stream/files", 1)
+        monitor.add("stream/tail_bytes", int(cut - offset))
+        return 1
 
     def pending(self) -> List[str]:
         """Registered-but-uncarved files in carve order (name-sorted)."""
@@ -236,6 +313,15 @@ class StreamSource:
         for _day, files, _ev, _ts in out:
             for f in files:
                 self._meta.pop(f, None)
-                self._consumed.add(f)
+                base, _s, end = split_byte_range(f)
+                if end is not None:
+                    # Tail mode: the file's consumed offset advances to
+                    # the carved cut; the next poll registers whatever
+                    # bytes landed after it.
+                    self._offsets[base] = max(
+                        self._offsets.get(base, 0), end)
+                    self._tail_pending.pop(base, None)
+                else:
+                    self._consumed.add(f)
         monitor.set_gauge("stream/pending_files", float(len(self._meta)))
         return out

@@ -49,7 +49,10 @@ class DayRunner:
                  pipeline_passes: bool = True,
                  is_rank0: bool = True,
                  pass_boundary_hook: Optional[Callable[[str, int],
-                                                       None]] = None):
+                                                       None]] = None,
+                 pass_retry_hook: Optional[Callable[[str, int,
+                                                     BaseException],
+                                                    None]] = None):
         self.trainer = trainer
         self.feed_config = feed_config
         self.data_root = data_root
@@ -75,6 +78,14 @@ class DayRunner:
         # its own rollback (a leaked transient here would re-enter the
         # pass retry loop and replay an already-published pass).
         self.pass_boundary_hook = pass_boundary_hook
+        # Called on a TRANSIENT pass failure BEFORE the rollback reload:
+        # the seam where a replicated multihost tier repairs its
+        # topology (promote a surviving backup off a dead shard host,
+        # multihost/reshard.py ElasticReshardController.repair) so the
+        # reset + recovery-chain reload that follows reaches only live
+        # servers. Hook errors are logged, never raised — a broken
+        # repair hook must not turn a retryable failure fatal.
+        self.pass_retry_hook = pass_retry_hook
         self.timers = timers.TimerGroup()
         # Pipelined next-pass preload in flight (train_day): the pass
         # retry path must be able to join + invalidate it, so the handle
@@ -303,6 +314,12 @@ class DayRunner:
                     type(e).__name__, e, attempt, max_retries)
                 trace.instant("pass/retry", day=day, pass_id=pass_id,
                               attempt=attempt, error=repr(e))
+                if self.pass_retry_hook is not None:
+                    try:
+                        self.pass_retry_hook(day, pass_id, e)
+                    except Exception as he:
+                        log.warning("pass_retry_hook failed (%r) — "
+                                    "continuing with the rollback", he)
                 self._rollback_for_retry(dense_snap)
                 backoff = min(
                     float(flags.flag("pass_retry_backoff_s"))

@@ -1,0 +1,42 @@
+"""Subprocess shard host for the replicated-tier failover drill
+(tests/test_shard_failover_drill.py): one ShardServer on an ephemeral
+loopback port, heartbeating its endpoint into the elastic root
+(``shard_endpoint`` meta — the discovery path the repair controller
+reads), then idling until the harness SIGKILLs it. The process IS the
+failure domain: kill -9 takes the socket, the slot stores, and the
+journal with it, exactly like a dead production host."""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(root: str, host_id: str, index: int, world: int) -> None:
+    from paddlebox_tpu.embedding.table import TableConfig
+    from paddlebox_tpu.launch.elastic import ElasticManager
+    from paddlebox_tpu.multihost.keyrange import ShardRangeTable
+    from paddlebox_tpu.multihost.shard_service import ShardServer
+
+    cfg = TableConfig(name="emb", dim=8, learning_rate=0.1)
+    server = ShardServer("127.0.0.1:0", index,
+                         ShardRangeTable.for_world(world), cfg)
+    mgr = ElasticManager(os.path.join(root, "elastic"), host_id,
+                         heartbeat_interval=0.1, timeout=1.0,
+                         settle=0.2,
+                         meta={"shard_endpoint": server.endpoint})
+    mgr.start()
+    # Atomic endpoint advertisement for the harness (the rank table is
+    # the controller's discovery path; this file is the test's).
+    tmp = os.path.join(root, f".{host_id}.ep.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"endpoint": server.endpoint, "pid": os.getpid()}, f)
+    os.replace(tmp, os.path.join(root, f"{host_id}.ep"))
+    while True:
+        time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))

@@ -43,7 +43,39 @@ def _digest(arrays) -> str:
     return h.hexdigest()
 
 
-def main(log_dir: str, out_dir: str, result: str) -> None:
+# -- tail mode (FLAGS_stream_tail_bytes): ONE growing file ------------------
+
+TAIL_STAGES = 3
+
+
+def _stage_bytes(stage: int) -> bytes:
+    """Deterministic event lines of one append stage."""
+    import numpy as np
+    rng = np.random.default_rng(1000 + stage)
+    out = []
+    for _ in range(BS):
+        toks = " ".join(f"{s}:{rng.integers(1, 150)}" for s in SLOTS)
+        out.append(f"{int(rng.random() < 0.3)} {toks}\n")
+    return "".join(out).encode()
+
+
+def append_stage(log_dir: str, stage: int) -> None:
+    """Append stage ``stage``'s bytes IF not already appended (the
+    resumed process replays the same schedule; file size tells which
+    stages the killed run already landed — appends only ever happen at
+    stage boundaries because the faultpoints sit inside poll_once)."""
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, "live.log")
+    want = sum(len(_stage_bytes(s)) for s in range(stage + 1))
+    have = os.path.getsize(path) if os.path.exists(path) else 0
+    if have >= want:
+        return
+    with open(path, "ab") as f:
+        f.write(_stage_bytes(stage))
+
+
+def main(log_dir: str, out_dir: str, result: str,
+         mode: str = "segments") -> None:
     import numpy as np
 
     import jax
@@ -56,8 +88,15 @@ def main(log_dir: str, out_dir: str, result: str) -> None:
     from paddlebox_tpu.stream import StreamRunner
     from paddlebox_tpu.train import CTRTrainer, TrainerConfig
 
-    flags.set_flags({"stream_pass_events": PASS_EVENTS,
-                     "stream_pass_window_s": 0.0})
+    if mode == "tail":
+        # Byte-offset cursor mode: one growing file, one carved pass
+        # per appended stage, cut mid-file at the last newline.
+        flags.set_flags({"stream_tail_bytes": True,
+                         "stream_pass_events": BS,
+                         "stream_pass_window_s": 0.0})
+    else:
+        flags.set_flags({"stream_pass_events": PASS_EVENTS,
+                         "stream_pass_window_s": 0.0})
     mesh = build_mesh(HybridTopology(dp=8))
     feed = DataFeedConfig(
         slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
@@ -73,7 +112,12 @@ def main(log_dir: str, out_dir: str, result: str) -> None:
     runner = StreamRunner(trainer, feed, out_dir, log_dir=log_dir,
                           shuffle=False, num_reader_threads=1)
     runner.resume()
-    runner.poll_once(flush=True)
+    if mode == "tail":
+        for stage in range(TAIL_STAGES):
+            append_stage(log_dir, stage)
+            runner.poll_once(flush=True)
+    else:
+        runner.poll_once(flush=True)
     runner.end_day()
 
     store = trainer.engine.store
@@ -95,4 +139,5 @@ def main(log_dir: str, out_dir: str, result: str) -> None:
 
 
 if __name__ == "__main__":
-    main(*sys.argv[1:4])
+    main(*sys.argv[1:4],
+         mode=(sys.argv[4] if len(sys.argv) > 4 else "segments"))

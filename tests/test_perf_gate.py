@@ -250,6 +250,41 @@ def test_multihost_keys_direction_and_gating(tmp_path):
     assert not regs
 
 
+def test_replication_failover_keys_direction_and_gating(tmp_path):
+    """Round-18 replicated-tier keys: failover_blip_ms (pull p99
+    across a scripted primary kill) and repair_ms gate lower-better,
+    journal_catchup_rows_per_s higher-better; the failed-pull count is
+    a correctness assertion inside the bench, never a gated rate."""
+    assert perf_gate.direction("failover_blip_ms") == -1
+    assert perf_gate.direction("failover_pull_p50_ms") == -1
+    assert perf_gate.direction("repair_ms") == -1
+    assert perf_gate.direction("journal_catchup_rows_per_s") == 1
+    assert perf_gate.direction("failover_failed_pulls") == 0
+    base = {"value": 2.9e6,
+            "failover_blip_ms": 420.0,
+            "failover_pull_p50_ms": 90.0,
+            "repair_ms": 120.0,
+            "journal_catchup_rows_per_s": 1.7e6,
+            "failover_failed_pulls": 0}
+    b = _write(tmp_path, "fo_base.json", base)
+    assert perf_gate.main([_write(tmp_path, "fo_same.json", base),
+                           "--baseline", b]) == 0
+    for key, val in (("failover_blip_ms", 5000.0),
+                     ("repair_ms", 9000.0),
+                     ("journal_catchup_rows_per_s", 2.0e5)):
+        bad = copy.deepcopy(base)
+        bad[key] = val
+        assert perf_gate.main(
+            [_write(tmp_path, f"fo_bad_{key}.json", bad),
+             "--baseline", b]) == 1, key
+    # A faster repair never trips.
+    good = copy.deepcopy(base)
+    good["repair_ms"] = 20.0
+    good["failover_blip_ms"] = 50.0
+    _, regs = perf_gate.compare(good, base)
+    assert not regs
+
+
 def test_serve_client_keys_direction_and_gating(tmp_path):
     """Round-14 serving keys: the concurrent-client wire-mode record
     (`bench.py serve --clients N`) gates throughput_rps / rows_per_s /

@@ -1,0 +1,500 @@
+"""Replicated shard tier (MULTIHOST.md "replicated tier").
+
+Pins, tier-1 (CPU, loopback sockets — the wire is real, the hosts are
+in-process):
+
+- replica placement: ring map invariants (distinct hosts, promotion
+  drop, repair add), dict round-trip;
+- DeltaJournal: seq assignment, since() windows, cap eviction → None
+  (snapshot required), reset;
+- replica consistency: a replicas=2 cluster's pulls/pushes are
+  BIT-identical to replicas=1 AND to a flat FeatureStore, and every
+  backup's slot store is byte-identical to its primary's after
+  synchronous forwarding;
+- journal catch-up vs full-COPY equivalence: a rebuilt backup caught up
+  by journal replay has the same content digest as one caught up by
+  full snapshot (journal disabled);
+- stale-primary loud failure: a write reaching a backup raises a LOUD
+  StalePrimaryError that the pass-retry loop classifies TRANSIENT;
+- read failover: kill a primary — pulls (trainer) and pull_serving
+  (ShardBackedStore) fail over to the surviving backup with identical
+  bytes and zero failed calls;
+- promote + repair 2→2: kill one host of a replicated pair, promote
+  the survivor, re-replicate to a fresh host — content digests equal
+  the pre-kill state and the replication factor is restored;
+- checkpoint round-trip at R=2: save writes one hostshard dir per
+  PRIMARY slot (no double rows), load restores a fully replicated
+  cluster, and the ages sidecar survives.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import faults
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.embedding.store import _FIELDS, FeatureStore
+from paddlebox_tpu.embedding.table import TableConfig
+from paddlebox_tpu.multihost import (DeltaJournal, MultiHostStore,
+                                     ReplicaMap, ShardClient,
+                                     ShardRangeTable, StalePrimaryError,
+                                     start_local_shards, stop_shards)
+from paddlebox_tpu.multihost.shard_service import ShardServer
+
+CFG = TableConfig(name="emb", dim=8, learning_rate=0.1)
+
+
+def _rand_keys(n, seed=0, hi=1 << 50):
+    rng = np.random.default_rng(seed)
+    keys = np.unique(rng.integers(1, hi, size=n + 64, dtype=np.uint64))
+    assert keys.size >= n
+    return keys[:n]
+
+
+def _store_digest(store: FeatureStore) -> str:
+    keys, _ = store.key_stats()
+    keys = np.sort(keys)
+    vals = store.pull_for_pass(keys)
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(keys).tobytes())
+    for f in _FIELDS:
+        h.update(np.ascontiguousarray(vals[f]).tobytes())
+    return h.hexdigest()
+
+
+@pytest.fixture
+def pair():
+    """2-host replicas=2 loopback cluster + its client store."""
+    servers, eps = start_local_shards(2, CFG, replicas=2)
+    store = MultiHostStore(CFG, eps, replicas=2)
+    yield servers, eps, store
+    store.close()
+    stop_shards(servers)
+
+
+# ---------------------------------------------------------------------------
+# ReplicaMap / DeltaJournal units
+# ---------------------------------------------------------------------------
+
+def test_ring_map_invariants_and_roundtrip():
+    eps = ["h0:1", "h1:1", "h2:1"]
+    m = ReplicaMap.ring(eps, 2)
+    assert m.world == 3 and m.replication == 2
+    assert m.primaries() == eps
+    assert m.replicas_of(0) == ("h0:1", "h1:1")
+    assert m.replicas_of(2) == ("h2:1", "h0:1")
+    assert m.slots_of("h1:1") == {1: "primary", 0: "backup"}
+    # R is clamped to the world: 2 hosts cannot hold 3 distinct copies.
+    assert ReplicaMap.ring(eps[:2], 3).replication == 2
+    assert ReplicaMap.from_dict(m.to_dict()) == m
+    # Promotion: dropping h1 everywhere promotes slot 1 to its backup.
+    d = m.drop_endpoint("h1:1")
+    assert d.primaries() == ["h0:1", "h2:1", "h2:1"]
+    assert d.replication == 1
+    # Repair: a fresh host restores the factor slot by slot.
+    r = d.add_backup(0, "h3:1")
+    assert r.replicas_of(0) == ("h0:1", "h3:1")
+    assert r.add_backup(0, "h3:1") is r        # idempotent
+    with pytest.raises(ValueError, match="no surviving replica"):
+        ReplicaMap.ring(["a:1"], 1).drop_endpoint("a:1")
+
+
+def test_delta_journal_windows_and_cap():
+    j = DeltaJournal(cap=4)
+    seqs = [j.append("push", {"i": i}) for i in range(3)]
+    assert seqs == [1, 2, 3] and j.seq == 3
+    assert j.since(3) == []
+    assert [e.seq for e in j.since(1)] == [2, 3]
+    assert [e.seq for e in j.since(0)] == [1, 2, 3]
+    for i in range(3):
+        j.append("push", {"i": 3 + i})          # seqs 4..6, cap 4
+    assert [e.seq for e in j.since(2)] == [3, 4, 5, 6]
+    assert j.since(1) is None                   # past the window: snapshot
+    j2 = DeltaJournal(cap=0, start_seq=7)       # journaling disabled
+    assert j2.append("push", {}) == 8
+    assert j2.since(7) is None and len(j2) == 0
+    j.reset(start_seq=5)
+    assert j.seq == 5 and j.since(5) == []
+
+
+# ---------------------------------------------------------------------------
+# replica consistency
+# ---------------------------------------------------------------------------
+
+def test_replicated_pulls_bit_identical_to_flat_and_r1(pair):
+    servers, eps, store = pair
+    s1, e1 = start_local_shards(2, CFG)          # replicas=1 reference
+    r1 = MultiHostStore(CFG, e1)
+    flat = FeatureStore(CFG, seed=0)
+    try:
+        keys = _rand_keys(3000, seed=1)
+        a = store.pull_for_pass(keys)
+        b = r1.pull_for_pass(keys)
+        c = flat.pull_for_pass(keys)
+        for f in _FIELDS:
+            np.testing.assert_array_equal(a[f], b[f], err_msg=f)
+            np.testing.assert_array_equal(a[f], c[f], err_msg=f)
+        a["emb"] += 0.5
+        a["show"] += 1.0
+        for tgt in (store, r1, flat):
+            tgt.push_from_pass(keys, a)
+        assert store.num_features == r1.num_features == keys.size
+        sub = keys[::3]
+        g = store.pull_for_pass(sub)
+        g2 = flat.pull_for_pass(sub)
+        for f in _FIELDS:
+            np.testing.assert_array_equal(g[f], g2[f], err_msg=f)
+    finally:
+        r1.close()
+        stop_shards(s1)
+
+
+def test_backups_byte_identical_after_sync_forwarding(pair):
+    servers, eps, store = pair
+    keys = _rand_keys(2000, seed=2)
+    rows = store.pull_for_pass(keys)
+    rows["emb"] += 1.25
+    store.push_from_pass(keys, rows)
+    store.push_from_pass(keys, rows)             # second pass (seq 2)
+    # Each server is primary of its own slot and backup of the other:
+    # the backup's slot store must hold the primary's exact bytes.
+    for slot in (0, 1):
+        prim = servers[slot]._slot_stores[slot]
+        back = servers[1 - slot]._slot_stores[slot]
+        assert _store_digest(prim) == _store_digest(back)
+        np.testing.assert_array_equal(
+            prim.unseen_for(keys), back.unseen_for(keys))
+    st = servers[0].handle_replica_status({})
+    assert st["replication"] == 2
+    assert st["slots"]["0"]["role"] == "primary"
+    assert st["slots"]["1"]["role"] == "backup"
+    assert st["slots"]["0"]["backups"][eps[1]] == st["slots"]["0"]["seq"]
+
+
+def test_replicated_shrink_forwards_resolved_policy(pair):
+    servers, eps, store = pair
+    keys = _rand_keys(500, seed=3)
+    rows = store.pull_for_pass(keys)
+    rows["show"] += 4.0
+    store.push_from_pass(keys, rows)
+    prev = flagmod.get_flags(["table_ttl_days"])
+    try:
+        flagmod.set_flags({"table_ttl_days": 2})
+        store.shrink()
+    finally:
+        flagmod.set_flags(prev)
+    for slot in (0, 1):
+        prim = servers[slot]._slot_stores[slot]
+        back = servers[1 - slot]._slot_stores[slot]
+        assert _store_digest(prim) == _store_digest(back)
+        # Ages bumped identically on both replicas.
+        pk, _ = prim.key_stats()
+        if pk.size:
+            np.testing.assert_array_equal(prim.unseen_for(pk),
+                                          back.unseen_for(pk))
+            assert (prim.unseen_for(pk) == 1).all()
+
+
+# ---------------------------------------------------------------------------
+# journal catch-up vs full-COPY equivalence
+# ---------------------------------------------------------------------------
+
+def _rebind_backup(servers, eps, slot_of_backup: int):
+    """Kill the backup host and stand an EMPTY server up on the same
+    endpoint (the 'briefly disconnected backup returns' scenario)."""
+    old = servers[slot_of_backup]
+    ep = eps[slot_of_backup]
+    old.kill()
+    fresh = ShardServer(ep, slot_of_backup,
+                        ShardRangeTable.for_world(len(eps)), CFG)
+    assert fresh.endpoint == ep
+    return fresh
+
+
+@pytest.mark.parametrize("journal_entries", [256, 0],
+                         ids=["journal", "snapshot"])
+def test_backup_catchup_journal_vs_snapshot(journal_entries):
+    """A returned-empty backup is caught up by journal replay (cap
+    covers the gap... except a fresh store needs the snapshot) and by
+    forced snapshot (cap=0) — both land the primary's exact bytes, and
+    a SECOND push after a small lag exercises the pure journal-delta
+    path when enabled."""
+    prev = flagmod.get_flags(["multihost_journal_entries"])
+    flagmod.set_flags({"multihost_journal_entries": journal_entries})
+    servers, eps = start_local_shards(2, CFG, replicas=2)
+    store = MultiHostStore(CFG, eps, replicas=2)
+    fresh = None
+    try:
+        keys = _rand_keys(1500, seed=4)
+        rows = store.pull_for_pass(keys)
+        rows["w"] += 2.0
+        store.push_from_pass(keys, rows)
+
+        # Backup of slot 0 is host 1: replace it with an empty process.
+        fresh = _rebind_backup(servers, eps, 1)
+        rmap = ReplicaMap.ring(eps, 2)
+        fresh.adopt_replica_map(rmap)
+
+        # Next mutation triggers catch-up (snapshot: the fresh store's
+        # seq 0 is past any journal window), then applies the new seq.
+        rows["w"] += 1.0
+        store.push_from_pass(keys, rows)
+        prim0 = servers[0]._slot_stores[0]
+        assert _store_digest(prim0) == _store_digest(
+            fresh._slot_stores[0])
+
+        # Lag the backup by ONE entry while reachable-again: with a
+        # journal this catches up by delta replay, without one by
+        # another snapshot — equivalence is the digest.
+        before = (fresh._applied_seq[0],
+                  len(servers[0]._journals[0]))
+        rows["w"] += 1.0
+        store.push_from_pass(keys, rows)
+        assert _store_digest(prim0) == _store_digest(
+            fresh._slot_stores[0])
+        assert fresh._applied_seq[0] > before[0]
+        if journal_entries:
+            assert len(servers[0]._journals[0]) > 0
+        else:
+            assert len(servers[0]._journals[0]) == 0
+    finally:
+        flagmod.set_flags(prev)
+        store.close()
+        stop_shards(servers + ([fresh] if fresh else []))
+
+
+def test_brief_disconnect_catches_up_with_journal_deltas(pair):
+    """The canonical journal story: a backup whose CONNECTION bounced
+    (host alive, socket dropped) misses one forward and is caught up by
+    delta replay on the same mutation — never a full snapshot."""
+    from paddlebox_tpu.core import monitor
+    servers, eps, store = pair
+    keys = _rand_keys(900, seed=9)
+    rows = store.pull_for_pass(keys)
+    rows["click"] += 1.0
+    store.push_from_pass(keys, rows)
+    snaps0 = monitor.GLOBAL.get("multihost/replica_snapshots")
+    # Sever host 1's established conns (it keeps listening): the next
+    # forward's direct send bounces, the in-line catch-up reconnects
+    # and replays the journal gap.
+    servers[1].close_connections()
+    rows["click"] += 1.0
+    store.push_from_pass(keys, rows)
+    assert monitor.GLOBAL.get("multihost/replica_snapshots") == snaps0
+    for slot in (0, 1):
+        prim = servers[slot]._slot_stores[slot]
+        back = servers[1 - slot]._slot_stores[slot]
+        assert _store_digest(prim) == _store_digest(back)
+
+
+# ---------------------------------------------------------------------------
+# stale-primary loud failure
+# ---------------------------------------------------------------------------
+
+def test_write_to_backup_is_loud_and_transient(pair):
+    servers, eps, store = pair
+    keys = _rand_keys(400, seed=5)
+    rows = store.pull_for_pass(keys)
+    owner = store.ranges.owner_of(keys)
+    slot0 = keys[owner == 0]
+    vals0 = {f: v[owner == 0] for f, v in rows.items()}
+    # Raw push of slot-0 keys to host 1 (its BACKUP): loud in-band.
+    c = ShardClient(eps[1])
+    try:
+        with pytest.raises(RuntimeError, match="STALE_PRIMARY"):
+            c.call("push", keys=slot0, values=vals0)
+    finally:
+        c.close()
+    # Through the client store with a stale (swapped-primary) map: the
+    # typed transient error the pass-retry loop understands.
+    stale = ReplicaMap(table=store.ranges,
+                       assignment=((eps[1], eps[0]), (eps[1], eps[0])))
+    bad = MultiHostStore(CFG, eps, replica_map=stale)
+    try:
+        with pytest.raises(StalePrimaryError) as ei:
+            bad.push_from_pass(keys, rows)
+        assert faults.is_transient(ei.value)
+    finally:
+        bad.close()
+
+
+# ---------------------------------------------------------------------------
+# read failover + promote/repair
+# ---------------------------------------------------------------------------
+
+def test_read_failover_and_promote_repair_restores_r(pair):
+    from paddlebox_tpu.serving.fleet import ShardBackedStore
+    servers, eps, store = pair
+    keys = _rand_keys(2500, seed=6)
+    rows = store.pull_for_pass(keys)
+    rows["emb"] += 0.75
+    store.push_from_pass(keys, rows)
+    ref = {f: rows[f].copy() for f in _FIELDS}
+
+    backed = ShardBackedStore(eps, CFG.dim,
+                              replica_map=store.replica_map)
+    found, fused = backed.read(keys)
+    assert found.all()
+    np.testing.assert_array_equal(fused[:, :CFG.dim], ref["emb"])
+
+    # Kill host 1 (primary of slot 1, backup of slot 0).
+    servers[1].kill()
+
+    # Pure reads fail over to the survivor's replica store — identical
+    # bytes, zero failed calls.
+    got = store.pull_for_pass(keys)
+    for f in _FIELDS:
+        np.testing.assert_array_equal(got[f], ref[f], err_msg=f)
+    found2, fused2 = backed.read(keys)
+    assert found2.all()
+    np.testing.assert_array_equal(fused2, fused)
+
+    # PROMOTE: drop the dead endpoint; the survivor leads both slots.
+    rmap = store.replica_map.drop_endpoint(eps[1])
+    servers[0].adopt_replica_map(rmap)
+    store.set_replica_map(rmap)
+    backed.set_replica_map(rmap)
+    assert rmap.replication == 1
+    got = store.pull_for_pass(keys)
+    for f in _FIELDS:
+        np.testing.assert_array_equal(got[f], ref[f], err_msg=f)
+    # Writes land on the promoted primary (no stale error).
+    got["click"] += 1.0
+    store.push_from_pass(keys, got)
+    ref = got
+
+    # REPAIR: fresh host re-replicates both slots — factor restored.
+    fresh = ShardServer("127.0.0.1:0", 0, store.ranges, CFG)
+    try:
+        r2 = rmap.add_backup(0, fresh.endpoint).add_backup(
+            1, fresh.endpoint)
+        assert r2.replication == 2
+        for s in (servers[0], fresh):
+            s.adopt_replica_map(r2)
+        store.set_replica_map(r2)
+        synced = store.sync_replicas()
+        assert set(synced) == {0, 1}
+        for slot in (0, 1):
+            assert synced[slot][fresh.endpoint] >= 0
+            assert _store_digest(servers[0]._slot_stores[slot]) == \
+                _store_digest(fresh._slot_stores[slot])
+        # The re-replicated backup now serves reads after the promoted
+        # host dies too — the 2→2 repair kept every byte.
+        servers[0].kill()
+        got = store.pull_for_pass(keys)
+        for f in _FIELDS:
+            np.testing.assert_array_equal(got[f], ref[f], err_msg=f)
+    finally:
+        backed.close()
+        fresh.stop()
+
+
+def test_controller_repair_probe_promotes_and_reraises_factor(tmp_path):
+    """ElasticReshardController.repair() (the pass-retry hook) probes
+    endpoints and promotes off the dead one; _maybe_repair (the
+    boundary hook) folds a fresh advertised host back in."""
+    from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol
+    from paddlebox_tpu.launch.elastic import RankTable
+    from paddlebox_tpu.multihost.reshard import ElasticReshardController
+
+    servers, eps = start_local_shards(2, CFG, replicas=2)
+    store = MultiHostStore(CFG, eps, replicas=2)
+    fresh = None
+    try:
+        keys = _rand_keys(1200, seed=7)
+        rows = store.pull_for_pass(keys)
+        rows["show"] += 1.0
+        store.push_from_pass(keys, rows)
+        ckpt = CheckpointProtocol(str(tmp_path / "out"))
+        tables = {"t": RankTable(generation=0, hosts=["a", "b"],
+                                 meta={"a": {"shard_endpoint": eps[0]},
+                                       "b": {"shard_endpoint": eps[1]}})}
+        ctl = ElasticReshardController(store, ckpt,
+                                       table_fn=lambda: tables["t"])
+        assert ctl.maybe_apply("d", 1) is None       # anchors gen 0
+        assert ctl.repair() is None                  # everyone alive
+
+        servers[1].kill()
+        rec = ctl.repair(reason="drill")
+        assert rec is not None and rec["kind"] == "promote"
+        assert rec["replication"] == 1 and rec["promoted"] == [1]
+        got = store.pull_for_pass(keys)
+        for f in _FIELDS:
+            np.testing.assert_array_equal(got[f], rows[f], err_msg=f)
+
+        # Boundary: the rank table drops the dead host and advertises a
+        # fresh one — re-replication restores the factor.
+        fresh = ShardServer("127.0.0.1:0", 0, store.ranges, CFG)
+        tables["t"] = RankTable(
+            generation=1, hosts=["a", "c"],
+            meta={"a": {"shard_endpoint": eps[0]},
+                  "c": {"shard_endpoint": fresh.endpoint}})
+        rec2 = ctl.maybe_apply("d", 2)
+        assert rec2 is not None and rec2["kind"] == "repair"
+        assert rec2["replication"] == 2
+        assert store.replica_map.replication == 2
+        for slot in (0, 1):
+            assert _store_digest(servers[0]._slot_stores[slot]) == \
+                _store_digest(fresh._slot_stores[slot])
+        # Idempotent: same generation does nothing more.
+        assert ctl.maybe_apply("d", 3) is None
+    finally:
+        store.close()
+        stop_shards(servers + ([fresh] if fresh else []))
+
+
+# ---------------------------------------------------------------------------
+# replicated checkpoints + ages sidecar
+# ---------------------------------------------------------------------------
+
+def test_replicated_checkpoint_no_double_rows_and_ages(tmp_path, pair):
+    servers, eps, store = pair
+    keys = _rand_keys(1800, seed=8)
+    rows = store.pull_for_pass(keys)
+    rows["show"] += 3.0
+    store.push_from_pass(keys, rows)
+    prev = flagmod.get_flags(["table_ttl_days"])
+    try:
+        flagmod.set_flags({"table_ttl_days": 10})
+        store.shrink()                    # every row now at age 1
+    finally:
+        flagmod.set_flags(prev)
+    path = str(tmp_path / "ck")
+    store.save_base(path)
+
+    # Exactly one hostshard dir per slot; their key sets are disjoint
+    # (each server saved only its PRIMARY slot — no replica doubles).
+    import glob
+    import os
+    dirs = sorted(glob.glob(os.path.join(path, "hostshard-*")))
+    assert len(dirs) == 2
+    saved = [np.load(os.path.join(d, "emb.base.npz"))["keys"]
+             for d in dirs]
+    assert sum(k.size for k in saved) == keys.size
+    assert np.intersect1d(saved[0], saved[1]).size == 0
+    # Ages sidecar rides beside each dump.
+    for d in dirs:
+        assert os.path.exists(os.path.join(d, "emb.base.ages.npz"))
+
+    # Reload into a FRESH replicated pair: contents bit-identical,
+    # backups populated straight from the checkpoint, ages restored.
+    s2, e2 = start_local_shards(2, CFG, replicas=2)
+    other = MultiHostStore(CFG, e2, replicas=2)
+    try:
+        other.load(path, "base")
+        assert other.num_features == store.num_features
+        got = other.pull_for_pass(keys)
+        want = store.pull_for_pass(keys)
+        for f in _FIELDS:
+            np.testing.assert_array_equal(got[f], want[f], err_msg=f)
+        for slot in (0, 1):
+            prim = s2[slot]._slot_stores[slot]
+            back = s2[1 - slot]._slot_stores[slot]
+            assert _store_digest(prim) == _store_digest(back)
+            pk, _ = prim.key_stats()
+            assert (prim.unseen_for(pk) == 1).all()   # lease survived
+            assert (back.unseen_for(pk) == 1).all()
+    finally:
+        other.close()
+        stop_shards(s2)

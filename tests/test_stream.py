@@ -490,3 +490,254 @@ def test_shrink_still_guards_save_delta(tmp_path, flagset):
     store.shrink()
     with pytest.raises(RuntimeError, match="save_delta after shrink"):
         store.save_delta(str(tmp_path / "d"))
+
+
+# ---------------------------------------------------------------------------
+# persisted TTL ages (the ages sidecar — ROADMAP item-2 follow-up)
+# ---------------------------------------------------------------------------
+
+def _store_variant(variant, tmp_path):
+    if variant == "flat":
+        return FeatureStore(CFG), None
+    if variant == "sharded":
+        from paddlebox_tpu.embedding.sharded_store import \
+            ShardedFeatureStore
+        return ShardedFeatureStore(CFG, num_buckets=4, num_threads=2), None
+    if variant == "device":
+        from paddlebox_tpu.embedding.device_store import DeviceFeatureStore
+        return DeviceFeatureStore(CFG), None
+    if variant == "tiered":
+        from paddlebox_tpu.embedding.ssd_tier import TieredFeatureStore
+        return TieredFeatureStore(CFG, str(tmp_path / "ssd"),
+                                  max_ram_features=6), None
+    from paddlebox_tpu.multihost import MultiHostStore, start_local_shards
+    servers, eps = start_local_shards(2, CFG)
+    return MultiHostStore(CFG, eps), servers
+
+
+@pytest.mark.parametrize("variant", [
+    "flat", "sharded", "device", "tiered", "multihost"])
+def test_ttl_ages_persist_across_restart(variant, tmp_path, flagset):
+    """The ages sidecar (ONLINE.md "persisted TTL ages"): a save_base →
+    fresh-process load round-trip preserves every row's unseen-days
+    age, so a restart no longer grants aged rows a fresh TTL lease —
+    rows one shrink from eviction still evict one shrink after the
+    restart. (The grouped facade delegates to these per-group stores.)"""
+    from paddlebox_tpu.multihost import stop_shards
+    flagset(table_ttl_days=2, table_decay_rate=0.0, table_min_show=0.0)
+    a = np.arange(2, 22, 2, dtype=np.uint64)        # will be age 2
+    b = np.arange(101, 111, dtype=np.uint64)        # will be age 0
+    store, servers = _store_variant(variant, tmp_path)
+    store2, servers2 = None, None
+    try:
+        _touch(store, a)
+        store.shrink()                               # a at age 1
+        store.shrink()                               # a at age 2
+        _touch(store, b)                             # b at age 0
+        path = str(tmp_path / "ck")
+        store.save_base(path)
+        np.testing.assert_array_equal(store.unseen_for(a), 2)
+
+        # "Restart": a brand-new store loads the same checkpoint.
+        store2, servers2 = _store_variant(variant, tmp_path / "re")
+        store2.load(path, "base")
+        np.testing.assert_array_equal(store2.unseen_for(a), 2)
+        np.testing.assert_array_equal(store2.unseen_for(b), 0)
+        # One more shrink pushes a PAST ttl=2 — evicted, b survives.
+        evicted = store2.shrink()
+        assert evicted == a.size
+        assert not store2.contains(a).any()
+        assert store2.contains(b).all()
+    finally:
+        for s, srv in ((store, servers), (store2, servers2)):
+            if srv is not None:
+                s.close()
+                stop_shards(srv)
+
+
+def test_ttl_ages_persist_through_delta_chain(tmp_path, flagset):
+    """Delta checkpoints carry the sidecar too: base + delta reload
+    restores the delta keys' saved ages instead of zeroing them."""
+    flagset(table_ttl_days=0)
+    store = FeatureStore(CFG)
+    a = np.arange(1, 9, dtype=np.uint64)
+    _touch(store, a)
+    base = str(tmp_path / "base")
+    store.save_base(base)
+    b = np.arange(50, 58, dtype=np.uint64)
+    _touch(store, b)
+    delta = str(tmp_path / "delta")
+    store.save_delta(delta)
+
+    re = FeatureStore(CFG)
+    re.load(base, "base")
+    re.load(delta, "delta")
+    np.testing.assert_array_equal(re.unseen_for(a), 0)
+    np.testing.assert_array_equal(re.unseen_for(b), 0)
+    # Pre-sidecar checkpoints (sidecar removed) still load — rows just
+    # restart their lease, the documented legacy behavior.
+    os.unlink(os.path.join(base, "t.base.ages.npz"))
+    legacy = FeatureStore(CFG)
+    legacy.load(base, "base")
+    assert legacy.num_features == a.size
+
+
+def test_tiered_disk_ages_persist_across_restart(tmp_path, flagset):
+    """Disk-tier rows' ages persist too (the RowAges side table rides
+    its own sidecar beside the copied buckets)."""
+    from paddlebox_tpu.embedding.ssd_tier import TieredFeatureStore
+    flagset(table_ttl_days=0, table_decay_rate=0.0)
+    store = TieredFeatureStore(CFG, str(tmp_path / "ssd"),
+                               max_ram_features=4)
+    cold = np.arange(1, 5, dtype=np.uint64)
+    _touch(store, cold)
+    store.shrink()                    # cold at age 1
+    hot = np.arange(100, 108, dtype=np.uint64)
+    _touch(store, hot)                # spills cold rows to disk
+    np.testing.assert_array_equal(store.unseen_for(cold), 1)
+    path = str(tmp_path / "ck")
+    store.save_base(path)
+
+    re = TieredFeatureStore(CFG, str(tmp_path / "ssd2"),
+                            max_ram_features=4)
+    re.load(path, "base")
+    np.testing.assert_array_equal(re.unseen_for(cold), 1)
+    np.testing.assert_array_equal(re.unseen_for(hot), 0)
+
+
+# ---------------------------------------------------------------------------
+# byte-offset tail cursor (FLAGS_stream_tail_bytes)
+# ---------------------------------------------------------------------------
+
+def _append_lines(path, rows, rng, partial=False):
+    """Append complete event lines (plus optionally one UNTERMINATED
+    partial line) to a growing log file."""
+    with open(path, "a") as f:
+        for _ in range(rows):
+            toks = " ".join(f"{s}:{rng.integers(1, 200)}" for s in SLOTS)
+            f.write(f"{int(rng.random() < 0.3)} {toks}\n")
+        if partial:
+            f.write("1 user:17 item")          # no newline: in flight
+
+
+def test_tail_carves_byte_ranges_of_growing_file(tmp_path, flagset):
+    from paddlebox_tpu.data.dataset import split_byte_range
+    rng = np.random.default_rng(11)
+    log = str(tmp_path / "log")
+    os.makedirs(log)
+    path = os.path.join(log, "live.log")
+    _append_lines(path, 6, rng, partial=True)
+    flagset(stream_tail_bytes=True, stream_pass_events=4,
+            stream_pass_window_s=0.0)
+    src = StreamSource(log, clock=lambda: 0.0)
+    src.poll()
+    protos = src.carve(flush=True)
+    # 6 complete lines consumed; the partial trailing line stays with
+    # the writer.
+    assert len(protos) == 1
+    _d, files, events, _t = protos[0]
+    assert events == 6 and len(files) == 1
+    base, start, end = split_byte_range(files[0])
+    assert base == path and start == 0
+    with open(path, "rb") as f:
+        assert f.read(end)[-1:] == b"\n"
+
+    # The writer finishes the partial line and appends more: the next
+    # poll registers EXACTLY the new complete bytes.
+    with open(path, "a") as f:
+        f.write(":9\n")
+    _append_lines(path, 3, rng)
+    src.poll()
+    protos = src.carve(flush=True)
+    assert len(protos) == 1
+    _d, files2, events2, _t = protos[0]
+    b2, s2, e2 = split_byte_range(files2[0])
+    assert (b2, s2) == (path, end) and events2 == 4
+    sz = os.path.getsize(path)
+    assert e2 == sz
+
+
+def test_tail_mode_trains_ranges_and_matches_whole_file(tmp_path,
+                                                        flagset):
+    """A Dataset fed byte-range specs parses exactly the same rows as
+    the whole file split into segments — the reader seam under the
+    tail cursor."""
+    from paddlebox_tpu.data.dataset import BYTE_RANGE_SEP, Dataset
+    rng = np.random.default_rng(12)
+    log = str(tmp_path / "log")
+    path = _write_event_file(log, "seg.log", 12, rng)
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        buf = f.read()
+    cut = buf.find(b"\n", size // 2) + 1          # a mid-file line cut
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=4)
+
+    def rows_of(files):
+        ds = Dataset(feed, num_reader_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        out = [(c.num_rows) for c in ds._chunks]
+        total = sum(out)
+        ds.clear()
+        return total
+
+    whole = rows_of([path])
+    ranged = rows_of([f"{path}{BYTE_RANGE_SEP}0-{cut}",
+                      f"{path}{BYTE_RANGE_SEP}{cut}-{size}"])
+    assert whole == ranged == 12
+
+
+def test_tail_cursor_resume_mid_file(tmp_path, flagset):
+    """Restart with a mid-file cursor: the re-built source resumes at
+    the recorded byte offset — nothing lost, nothing re-consumed."""
+    rng = np.random.default_rng(13)
+    log = str(tmp_path / "log")
+    os.makedirs(log)
+    path = os.path.join(log, "live.log")
+    _append_lines(path, 5, rng)
+    flagset(stream_tail_bytes=True, stream_pass_events=1,
+            stream_pass_window_s=0.0)
+    cursor = StreamCursor(str(tmp_path / "cursor.json"))
+    src = StreamSource(log, clock=lambda: 0.0,
+                       consumed=cursor.consumed_files())
+    src.poll()
+    protos = src.carve(flush=True)
+    assert len(protos) == 1 and protos[0][2] == 5
+    m = cursor.append(protos[0][0], protos[0][1], protos[0][2],
+                      protos[0][3])
+
+    # "kill -9": a fresh source rebuilt from the durable cursor.
+    _append_lines(path, 4, rng)
+    cursor2 = StreamCursor(str(tmp_path / "cursor.json"))
+    assert [x.to_dict() for x in cursor2.manifests] == [m.to_dict()]
+    src2 = StreamSource(log, clock=lambda: 0.0,
+                        consumed=cursor2.consumed_files())
+    src2.poll()
+    protos2 = src2.carve(flush=True)
+    assert len(protos2) == 1 and protos2[0][2] == 4
+    from paddlebox_tpu.data.dataset import split_byte_range
+    _b, s, e = split_byte_range(protos2[0][1][0])
+    _b0, s0, e0 = split_byte_range(m.files[0])
+    assert s == e0 and e == os.path.getsize(path)
+    # Event totals across both incarnations are exact: 5 + 4 = 9.
+    assert protos[0][2] + protos2[0][2] == 9
+
+
+def test_whole_segment_mode_skips_mid_file_cursor(tmp_path, flagset):
+    """Flipping tail mode OFF with a mid-file cursor on record must
+    NOT re-consume the file from byte 0 (that would duplicate
+    events) — the file is skipped with a warning."""
+    from paddlebox_tpu.data.dataset import BYTE_RANGE_SEP
+    rng = np.random.default_rng(14)
+    log = str(tmp_path / "log")
+    os.makedirs(log)
+    path = os.path.join(log, "live.log")
+    _append_lines(path, 4, rng)
+    flagset(stream_tail_bytes=False, stream_pass_events=1,
+            stream_pass_window_s=0.0)
+    src = StreamSource(log, clock=lambda: 0.0,
+                       consumed={f"{path}{BYTE_RANGE_SEP}0-10"})
+    src.poll()
+    assert src.carve(flush=True) == []

@@ -15,11 +15,18 @@ Usage::
 
     python tools/crash_drill.py                     # fast 2-site drill
     python tools/crash_drill.py --full              # full site matrix
+    python tools/crash_drill.py --matrix multihost  # replicated tier
     python tools/crash_drill.py --site checkpoint/publish --hit 2
     python tools/crash_drill.py --worker DATA OUT RESULT [--resume]
 
 Fast mode's two sites are the tier-1 CI drill
 (``tests/test_self_heal.py``); the full matrix is in the slow tier.
+``--matrix multihost`` drills the REPLICATED shard tier (MULTIHOST.md):
+the worker trains against a replicas=2 loopback cluster, then walks a
+host-loss → promote → re-replicate repair — kills land at the
+replica-forward window (shard-kill), between store-apply and journal
+append (journal-truncate), and inside the promotion role flip
+(repair-interrupt); the resumed run must converge byte-identical.
 """
 
 from __future__ import annotations
@@ -49,6 +56,15 @@ FULL_SITES = FAST_SITES + [
     ("day_runner/publish", 1),
     ("day_runner/day_end_save", 1),
     ("day_runner/load", 2),
+]
+# The replicated multihost tier's crash windows (--matrix multihost):
+# shard-kill (die mid replica forward), journal-truncate (die between
+# the store apply and the journal append — store ahead of journal),
+# repair-interrupt (die inside the promotion role flip).
+MULTIHOST_SITES = [
+    ("multihost/replica_forward", 1),
+    ("multihost/journal_append", 2),
+    ("multihost/replica_promote", 1),
 ]
 
 
@@ -86,7 +102,7 @@ def _digest(arrays) -> str:
 
 
 def worker_main(data: str, out: str, result: str, *,
-                resume: bool) -> None:
+                resume: bool, multihost: bool = False) -> None:
     import numpy as np
 
     from paddlebox_tpu.data import DataFeedConfig, SlotConf
@@ -100,16 +116,48 @@ def worker_main(data: str, out: str, result: str, *,
     feed = DataFeedConfig(
         slots=tuple(SlotConf(s, avg_len=1.5) for s in SLOTS),
         batch_size=32)
+    table = TableConfig(name="emb", dim=8, learning_rate=0.1)
+    servers, mh_store = [], None
+    if multihost:
+        # Replicated loopback cluster: a kill takes the WHOLE process
+        # (client, servers, journals) like a dead host+trainer pair;
+        # resume stands a fresh cluster up and recovers from the chain.
+        from paddlebox_tpu.multihost import (MultiHostStore,
+                                             start_local_shards)
+        servers, eps = start_local_shards(2, table, replicas=2)
+        mh_store = MultiHostStore(table, eps, replicas=2)
     trainer = CTRTrainer(
         DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), feed,
-        TableConfig(name="emb", dim=8, learning_rate=0.1), mesh=mesh,
+        table, mesh=mesh,
         config=TrainerConfig(dense_learning_rate=3e-3,
-                             auc_num_buckets=1 << 10))
+                             auc_num_buckets=1 << 10),
+        store=mh_store)
     trainer.init(seed=0)
     runner = DayRunner(trainer, feed, out, data_root=data,
                        split_interval=60, split_per_pass=1,
-                       hours=list(HOURS), num_reader_threads=2)
+                       hours=list(HOURS), num_reader_threads=2,
+                       pipeline_passes=not multihost)
     stats = runner.run_days([DAY], resume=resume)
+    if multihost:
+        # Host-loss repair walk AFTER the day: kill one host, PROMOTE
+        # the survivor (the replica_promote faultpoint fires inside the
+        # role flip), then re-replicate to a fresh host — the drill
+        # kills at each window and the resumed run must still converge.
+        from paddlebox_tpu.multihost.shard_service import ShardServer
+        servers[1].kill()
+        new_map = mh_store.replica_map.drop_endpoint(
+            mh_store.replica_map.all_endpoints()[1])
+        servers[0].adopt_replica_map(new_map)
+        mh_store.set_replica_map(new_map)
+        fresh = ShardServer("127.0.0.1:0", 0, mh_store.ranges, table)
+        servers.append(fresh)
+        for slot in range(new_map.world):
+            new_map = new_map.add_backup(slot, fresh.endpoint)
+        for s in (servers[0], fresh):
+            s.adopt_replica_map(new_map)
+        mh_store.set_replica_map(new_map)
+        mh_store.sync_replicas()
+        assert mh_store.replica_map.replication == 2
 
     import jax
     store = trainer.engine.store
@@ -143,7 +191,7 @@ def worker_main(data: str, out: str, result: str, *,
 
 def run_worker(data: str, out: str, result: str, *, resume: bool,
                fault_spec: str = "", timeout: float = 300.0,
-               log_path: str = "") -> int:
+               log_path: str = "", multihost: bool = False) -> int:
     """Spawn one worker process; returns its exit code (negative =
     killed by that signal, the expected outcome of a kill drill)."""
     env = dict(os.environ)
@@ -157,6 +205,8 @@ def run_worker(data: str, out: str, result: str, *, resume: bool,
             data, out, result]
     if resume:
         args.append("--resume")
+    if multihost:
+        args.append("--multihost")
     logf = open(log_path, "ab") if log_path else subprocess.DEVNULL
     try:
         proc = subprocess.run(args, env=env, cwd=REPO, timeout=timeout,
@@ -167,7 +217,8 @@ def run_worker(data: str, out: str, result: str, *, resume: bool,
     return proc.returncode
 
 
-def run_reference(workdir: str, *, timeout: float = 300.0) -> dict:
+def run_reference(workdir: str, *, timeout: float = 300.0,
+                  multihost: bool = False) -> dict:
     """Uninterrupted run on a fresh output dir — the parity baseline."""
     data = os.path.join(workdir, "data")
     if not os.path.isdir(os.path.join(data, DAY)):
@@ -175,7 +226,8 @@ def run_reference(workdir: str, *, timeout: float = 300.0) -> dict:
     out = os.path.join(workdir, "ref_out")
     result = os.path.join(workdir, "ref.json")
     rc = run_worker(data, out, result, resume=True, timeout=timeout,
-                    log_path=os.path.join(workdir, "ref.log"))
+                    log_path=os.path.join(workdir, "ref.log"),
+                    multihost=multihost)
     if rc != 0:
         raise RuntimeError(f"reference run failed rc={rc} "
                            f"(see {workdir}/ref.log)")
@@ -185,7 +237,7 @@ def run_reference(workdir: str, *, timeout: float = 300.0) -> dict:
 
 def run_drill(workdir: str, site: str, *, hit: int = 1,
               reference: dict | None = None,
-              timeout: float = 300.0) -> dict:
+              timeout: float = 300.0, multihost: bool = False) -> dict:
     """Kill at ``site`` (hit N), restart with resume, diff vs reference.
     Returns {"ok", "killed_rc", "site", "hit", "drilled", "reference",
     "mismatch"}."""
@@ -199,7 +251,7 @@ def run_drill(workdir: str, site: str, *, hit: int = 1,
 
     rc = run_worker(data, out, result, resume=True,
                     fault_spec=f"{site}:hit={hit}:kill",
-                    timeout=timeout, log_path=log)
+                    timeout=timeout, log_path=log, multihost=multihost)
     if rc == 0:
         # The site was never reached — a drill that doesn't kill proves
         # nothing and usually means the site moved.
@@ -207,14 +259,14 @@ def run_drill(workdir: str, site: str, *, hit: int = 1,
                 "mismatch": ["faultpoint never reached (rc=0)"]}
 
     rc2 = run_worker(data, out, result, resume=True, fault_spec="",
-                     timeout=timeout, log_path=log)
+                     timeout=timeout, log_path=log, multihost=multihost)
     if rc2 != 0:
         return {"ok": False, "site": site, "hit": hit, "killed_rc": rc,
                 "mismatch": [f"resume run failed rc={rc2} (see {log})"]}
     with open(result) as f:
         drilled = json.load(f)
     ref = reference if reference is not None else run_reference(
-        workdir, timeout=timeout)
+        workdir, timeout=timeout, multihost=multihost)
 
     mismatch = []
     for k in ("num_features", "dense_digest", "store_digest", "records"):
@@ -241,22 +293,35 @@ def main(argv=None) -> int:
     ap.add_argument("--hit", type=int, default=1)
     ap.add_argument("--full", action="store_true",
                     help="run the full site matrix (slow)")
+    ap.add_argument("--matrix", default="",
+                    help="named drill tier: 'multihost' = the "
+                         "replicated shard tier's crash windows")
+    ap.add_argument("--multihost", action="store_true",
+                    help="(worker) train against a replicas=2 loopback "
+                         "shard cluster + host-loss repair walk")
     ap.add_argument("--workdir", default="")
     args = ap.parse_args(argv)
 
     if args.worker:
-        worker_main(*args.worker, resume=args.resume)
+        worker_main(*args.worker, resume=args.resume,
+                    multihost=args.multihost)
         return 0
+
+    multihost = args.matrix == "multihost" or args.multihost
+    if args.matrix and args.matrix != "multihost":
+        ap.error(f"unknown --matrix tier {args.matrix!r}")
 
     import tempfile
     workdir = args.workdir or tempfile.mkdtemp(prefix="crash_drill_")
     sites = ([(args.site, args.hit)] if args.site
-             else (FULL_SITES if args.full else FAST_SITES))
+             else (MULTIHOST_SITES if multihost
+                   else (FULL_SITES if args.full else FAST_SITES)))
     t0 = time.time()
-    ref = run_reference(workdir)
+    ref = run_reference(workdir, multihost=multihost)
     results = []
     for site, hit in sites:
-        r = run_drill(workdir, site, hit=hit, reference=ref)
+        r = run_drill(workdir, site, hit=hit, reference=ref,
+                      multihost=multihost)
         results.append(r)
         print(json.dumps({k: r[k] for k in
                           ("ok", "site", "hit", "killed_rc", "mismatch")

@@ -227,6 +227,16 @@ def smoke() -> int:
             "reshard_ms": 13.0,
             "reshard_rows_per_s": 7.6e5,
             "reshard_moved_rows": 10036,
+            # bench multihost replicated-tier keys (r18): the read
+            # failover blip and repair wall gate lower-better ("_ms"),
+            # journal catch-up gates higher-better ("_per_s");
+            # failover_failed_pulls is a correctness count the bench
+            # itself asserts 0 — not a gateable rate.
+            "failover_blip_ms": 420.0,
+            "failover_pull_p50_ms": 90.0,
+            "repair_ms": 120.0,
+            "journal_catchup_rows_per_s": 1.7e6,
+            "failover_failed_pulls": 0,
             # bench.py online keys (r17 streaming tier): freshness
             # quantiles gate lower-better ("_ms" in the parent segment),
             # passes_per_hour higher-better, the post-lifecycle row
@@ -274,6 +284,9 @@ def smoke() -> int:
     bad["wire"]["f32"]["cross_host_exchange_bytes_per_s"] *= 0.3
     bad["reshard_ms"] = 200.0
     bad["reshard_moved_rows"] = 99999  # provenance: must NOT gate
+    bad["failover_blip_ms"] = 5000.0          # failover got slow
+    bad["repair_ms"] = 9000.0                 # repair got slow
+    bad["journal_catchup_rows_per_s"] *= 0.2  # catch-up got slow
     bad["replicas"]["r2"]["throughput_rps"] *= 0.4
     bad["replicas"]["r2"]["route_ms_quantiles"]["p99"] = 90.0
     bad["replicas"]["r2"]["degraded_frac"] = 0.5
@@ -289,7 +302,8 @@ def smoke() -> int:
                  "store_build_keys_per_s", "clients.c32.throughput_rps",
                  "clients.c32.batch_fill_frac",
                  "wire.f32.cross_host_exchange_bytes_per_s",
-                 "reshard_ms",
+                 "reshard_ms", "failover_blip_ms", "repair_ms",
+                 "journal_catchup_rows_per_s",
                  "replicas.r2.throughput_rps",
                  "replicas.r2.route_ms_quantiles.p99",
                  "replicas.r2.degraded_frac",
