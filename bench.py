@@ -1204,6 +1204,69 @@ def _bench_serve_clients(pred, clients: list) -> dict:
     return out
 
 
+def _bench_serve_telemetry_overhead(pred, *, n_requests: int = 200
+                                    ) -> dict:
+    """Tracing + live-scrape overhead on the serving path: the same
+    single-replica router loop timed with telemetry OFF, then with the
+    span ring ON and a concurrent fleet_top-style scrape loop hitting
+    metrics_snapshot on router+replica — `telemetry_overhead_frac` is
+    the rps delta, gated lower-better by tools/perf_gate.py (the
+    observability layer must stay ~free, or it gets turned off exactly
+    when it is needed)."""
+    import threading
+
+    from paddlebox_tpu.core import telemetry_scrape, trace
+    from paddlebox_tpu.serving.router import FleetRouter
+    from paddlebox_tpu.serving.service import PredictClient, PredictServer
+
+    server = PredictServer("127.0.0.1:0", pred, replica_id="bench-tel")
+    router = FleetRouter("127.0.0.1:0", replicas=[server.endpoint],
+                         start_health=False)
+    rng = np.random.default_rng(999)
+    lines = _serve_client_lines(rng, 8)
+    cli = PredictClient(router.endpoint)
+    cli.predict(lines[0])  # warm the forward + conns
+
+    def timed_loop() -> float:
+        t0 = time.perf_counter()
+        for j in range(n_requests):
+            cli.predict(lines[j % len(lines)])
+        return n_requests / (time.perf_counter() - t0)
+
+    trace.disable()
+    rps_off = timed_loop()
+    trace.enable()   # ring-only: no file unless FLAGS_trace_path is set
+    targets = {"router": router.endpoint, "replica": server.endpoint}
+    stop = threading.Event()
+    scrapes = [0]
+
+    def scrape_loop():
+        while not stop.is_set():
+            telemetry_scrape.scrape_cluster(targets, with_stats=False)
+            scrapes[0] += 1
+            stop.wait(0.1)
+
+    t = threading.Thread(target=scrape_loop, daemon=True)
+    t.start()
+    try:
+        rps_on = timed_loop()
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        trace.disable()
+        trace.clear()
+        cli.close()
+        router.stop()
+        server.stop()
+    return {
+        "trace_off_rps": round(rps_off, 1),
+        "trace_on_rps": round(rps_on, 1),
+        "telemetry_overhead_frac": round(
+            max(0.0, 1.0 - rps_on / max(rps_off, 1e-9)), 4),
+        "scrapes": int(scrapes[0]),
+    }
+
+
 def _bench_serve_fleet(pred, replicas: list) -> dict:
     """Fleet axis: R replica servers behind one FleetRouter, hammered
     by 4 clients per replica for a fixed window. Fresh fleet per count
@@ -1283,6 +1346,8 @@ def _bench_serve_fleet(pred, replicas: list) -> dict:
             "clients": n_cli,
             "requests": n_req,
         }
+    _tick("serving:telemetry-overhead")
+    out["telemetry"] = _bench_serve_telemetry_overhead(pred)
     return out
 
 
@@ -1471,6 +1536,38 @@ def bench_multihost() -> dict:
     finally:
         flags.set_flags({"multihost_wire_dtype": prev})
 
+    # Tracing + scrape overhead on the exchange path (f32 wire): the
+    # same pull+push rounds with the span ring ON — every RPC then
+    # carries a trace context and client/server spans — plus one
+    # metrics_snapshot scrape of every shard per round. The keys/s
+    # delta is `telemetry_overhead_frac`, gated lower-better by
+    # tools/perf_gate.py.
+    _tick("multihost:telemetry-overhead")
+    from paddlebox_tpu.core import telemetry_scrape, trace
+    off_t0 = time.perf_counter()
+    for _ in range(MULTIHOST_ROUNDS):
+        timed_round()
+    off_s = time.perf_counter() - off_t0
+    trace.enable()
+    try:
+        targets = {f"shard{i}": ep for i, ep in enumerate(eps)}
+        on_t0 = time.perf_counter()
+        for _ in range(MULTIHOST_ROUNDS):
+            timed_round()
+            telemetry_scrape.scrape_cluster(targets, with_stats=False)
+        on_s = time.perf_counter() - on_t0
+    finally:
+        trace.disable()
+        trace.clear()
+    keys_off = MULTIHOST_ROUNDS * keys.size * 2 / off_s
+    keys_on = MULTIHOST_ROUNDS * keys.size * 2 / on_s
+    telemetry = {
+        "trace_off_keys_per_s": round(keys_off, 1),
+        "trace_on_keys_per_s": round(keys_on, 1),
+        "telemetry_overhead_frac": round(
+            max(0.0, 1.0 - keys_on / max(keys_off, 1e-9)), 4),
+    }
+
     # Grow-by-one reshard at the measured table size, audited against
     # the minimal-transfer bound.
     _tick("multihost:reshard")
@@ -1513,6 +1610,7 @@ def bench_multihost() -> dict:
         "repair_ms": fo["repair_ms"],
         "journal_catchup_rows_per_s": fo["journal_catchup_rows_per_s"],
         "failover_failed_pulls": fo["failed_pulls"],  # provenance: 0
+        "telemetry": telemetry,
         "embedding_quant_block": int(flags.flag("embedding_quant_block")),
     }
 

@@ -1,0 +1,178 @@
+"""One-scrape cluster telemetry: collect every server's registry.
+
+The fleet (PRs 10-13) is N processes — FleetRouter, PredictServer
+replicas, ShardServer hosts — each keeping its OWN metric registry
+(instance Monitors, so in-process drills don't clobber each other).
+This module is the collector half of the "one-scrape cluster" story
+(OBSERVABILITY.md "Distributed tracing"): every framed service answers
+a ``metrics_snapshot`` RPC (the ``FramedRPCServer`` base handler;
+PredictServer / ShardServer / FleetRouter override it with their
+instance registries and scrape-time derived gauges such as the
+replication-lag pair), and :func:`scrape_cluster` folds the per-target
+snapshots through :func:`monitor.merge_snapshots` into ONE cluster
+snapshot plus a flat per-target summary table — what
+``tools/fleet_top.py`` renders live and records to JSONL.
+
+Pure client code: no jax, no server state, safe to run from an
+operator laptop against a live cluster (trusted network, same stance
+as the wire protocol itself).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Optional
+
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.core.quantiles import LogQuantileDigest
+
+
+def _conn(endpoint: str, timeout: float):
+    from paddlebox_tpu.distributed import rpc
+    return rpc.FramedRPCConn(
+        endpoint, timeout=timeout, service_name="scrape",
+        idempotent=("metrics_snapshot", "stats", "topology"))
+
+
+def scrape_endpoint(endpoint: str, *, timeout: float = 10.0,
+                    with_stats: bool = True) -> Dict[str, Any]:
+    """One target's ``metrics_snapshot`` (labeled registry snapshot),
+    with its ``stats`` reply attached under ``"stats"`` when the
+    service answers one (best-effort — the snapshot is the contract,
+    stats is gravy like the per-process rpc reconnect/retry totals)."""
+    c = _conn(endpoint, timeout)
+    try:
+        snap = c.call("metrics_snapshot")
+        if with_stats:
+            try:
+                snap["stats"] = c.call("stats")
+            except (OSError, ConnectionError, RuntimeError):
+                pass
+        return snap
+    finally:
+        c.close()
+
+
+def discover_router_targets(router_endpoint: str, *,
+                            timeout: float = 10.0) -> Dict[str, str]:
+    """label -> endpoint map from a FleetRouter's ``topology`` RPC:
+    the router itself plus every non-ejected replica — so fleet_top
+    follows join/leave without re-listing endpoints by hand."""
+    c = _conn(router_endpoint, timeout)
+    try:
+        topo = c.call("topology")
+    finally:
+        c.close()
+    out = {"router": router_endpoint}
+    for r in topo.get("replicas", ()):
+        if r.get("state") != "ejected" and r.get("endpoint"):
+            out[f"replica:{r['id']}"] = str(r["endpoint"])
+    return out
+
+
+def _q(snap: Dict[str, Any], name: str, q: str = "p99"
+       ) -> Optional[float]:
+    d = (snap.get("quantiles") or {}).get(name)
+    if not d:
+        return None
+    v = LogQuantileDigest.from_dict(d).quantiles().get(q)
+    return round(v, 3) if isinstance(v, (int, float)) else None
+
+
+def summarize_target(label: str, endpoint: str,
+                     snap: Dict[str, Any]) -> Dict[str, Any]:
+    """One flat row per target: the columns an operator watches —
+    per-replica predict p99 + rps + SLO breaches, per-shard served
+    volume + worst/p99 replication journal lag, router hop split, and
+    the process's rpc reconnect/retry totals (off the stats ride-along)."""
+    if "error" in snap and "counters" not in snap:
+        return {"target": label, "endpoint": endpoint,
+                "error": snap["error"]}
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    stats = snap.get("stats") or {}
+    row: Dict[str, Any] = {"target": label, "endpoint": endpoint}
+    p99 = _q(snap, "serving/predict_ms")
+    if p99 is not None:
+        row["predict_p99_ms"] = p99
+    rps = gauges.get("serving/throughput_rps")
+    if rps is None and isinstance(stats.get("throughput_rps"),
+                                  (int, float)):
+        rps = stats["throughput_rps"]
+    if rps is not None:
+        row["throughput_rps"] = round(float(rps), 1)
+    if counters.get("slo/violations") or "slo/violations" in counters:
+        row["slo_violations"] = int(counters.get("slo/violations", 0))
+    for k, name in (("served_pull_keys", "multihost/served_pull_keys"),
+                    ("served_push_keys", "multihost/served_push_keys")):
+        if name in counters:
+            row[k] = int(counters[name])
+    for k, name in (("replica_lag_worst", "multihost/replica_lag_worst"),
+                    ("replica_lag_p99", "multihost/replica_lag_p99"),
+                    ("shard_rows", "multihost/shard_rows")):
+        if name in gauges:
+            row[k] = gauges[name]
+    if "fleet/routed" in counters:
+        row["routed"] = int(counters["fleet/routed"])
+    for k, name in (("hop_route_p99_ms", "fleet/hop_route_ms"),
+                    ("hop_wire_p99_ms", "fleet/hop_wire_ms"),
+                    ("hop_server_p99_ms", "fleet/hop_server_ms")):
+        v = _q(snap, name)
+        if v is not None:
+            row[k] = v
+    for k in ("rpc_reconnects", "rpc_retries", "num_features", "keys"):
+        if isinstance(stats.get(k), (int, float)):
+            row[k] = int(stats[k])
+    return row
+
+
+def scrape_cluster(targets: Dict[str, str], *, timeout: float = 10.0,
+                   with_stats: bool = True) -> Dict[str, Any]:
+    """Scrape every target once and fold the answers: per-target
+    snapshots + summary rows, the ONE merged cluster snapshot
+    (counters summed, gauges mean+__max, digests merged — so the
+    fleet-wide predict p99 and worst replication lag come out of a
+    single read), and an error map for unreachable targets."""
+    per: Dict[str, Dict[str, Any]] = {}
+    errors: Dict[str, str] = {}
+    for label, ep in targets.items():
+        try:
+            per[label] = scrape_endpoint(ep, timeout=timeout,
+                                         with_stats=with_stats)
+        except (OSError, ConnectionError, RuntimeError) as e:
+            errors[label] = repr(e)
+    # merge_snapshots understands the snapshot_all sections only; the
+    # stats ride-along must not leak in.
+    merged = monitor.merge_snapshots(
+        [{k: v for k, v in s.items() if k != "stats"}
+         for s in per.values()])
+    summary = [summarize_target(label, targets[label], snap)
+               for label, snap in per.items()]
+    cluster: Dict[str, Any] = {
+        "scraped": len(per),
+        "unreachable": len(errors),
+        "fleet_predict_p99_ms": _q(merged, "serving/predict_ms"),
+        "fleet_route_p99_ms": _q(merged, "fleet/route_ms"),
+    }
+    g = merged.get("gauges") or {}
+    lag = g.get("multihost/replica_lag_worst__max",
+                g.get("multihost/replica_lag_worst"))
+    if lag is not None:
+        cluster["replica_lag_worst"] = lag
+    return {"ts": time.time(), "targets": dict(targets),
+            "per_target": per, "summary": summary,
+            "errors": errors, "merged": merged, "cluster": cluster}
+
+
+def record_jsonl(path: str, record: Dict[str, Any], *,
+                 full: bool = False) -> None:
+    """Append one scrape to a JSONL file (the fleet_top ``--record``
+    sink). Default keeps the compact sections (summary + cluster +
+    errors); ``full`` also writes the merged snapshot."""
+    keep = ("ts", "targets", "summary", "cluster", "errors")
+    line = {k: record.get(k) for k in keep}
+    if full:
+        line["merged"] = record.get("merged")
+    with open(path, "a") as f:
+        f.write(json.dumps(line, default=str) + "\n")

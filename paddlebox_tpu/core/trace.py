@@ -18,6 +18,20 @@ Design constraints (the CTR hot loop runs through here):
   multi-hour run cannot OOM the host, and ``snapshot()`` hands the tail
   to crash/stall dumps (bench.py's watchdog forensics).
 
+Distributed tracing (OBSERVABILITY.md "Distributed tracing"): a
+compact TRACE CONTEXT — ``{tid, sid, origin}`` = trace id, sending
+span id, origin host:pid — rides the framed RPC header
+(``distributed/rpc.py``), so every server-side span across the fleet
+records the trace id of the request that caused it. Context is
+thread-local (``use_context``); span/trace ids come from a process
+counter salted with the pid (no wall clock, no randomness — the replay
+closure stays pure). Each trace file carries a WALL-CLOCK ANCHOR
+(``otherData.wall_anchor_ns`` = the unix ns at ring ts 0) plus the
+per-connection clock offsets measured by the RPC handshake
+(``note_peer_offset``), which is what lets ``tools/trace_report.py
+--merge`` stitch N per-process rings onto ONE global timeline with
+cross-process flow arrows.
+
 Usage::
 
     from paddlebox_tpu.core import trace
@@ -30,12 +44,13 @@ Usage::
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from paddlebox_tpu.core import flags
 
@@ -61,6 +76,83 @@ class _NullSpan:
 
 
 _NULL_SPAN = _NullSpan()
+NULL_SPAN = _NULL_SPAN   # public alias (callers pre-picking a span)
+
+# -- distributed trace context ------------------------------------------------
+
+# Per-thread active context: {"tid": trace id, "sid": this hop's span id,
+# "origin": "host:pid" of the trace root, optional "parent": the sending
+# span id}. Set by the RPC server loop for the handler's duration, by
+# fan-out helpers that carry a caller's context into worker threads, and
+# by the serving micro-batcher for the batch it coalesced.
+_CTX = threading.local()
+
+# Monotonic span-id source. next() on itertools.count is atomic under
+# the GIL; ids are salted with the pid so two processes never collide.
+_SPAN_IDS = itertools.count(1)
+
+
+def _new_id() -> str:
+    return f"{os.getpid():x}.{next(_SPAN_IDS):x}"
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The calling thread's active trace context (None when no traced
+    request is in scope — including always when tracing is off, since
+    only traced RPCs install one)."""
+    return getattr(_CTX, "ctx", None)
+
+
+class _CtxScope:
+    """Push/pop one context on the calling thread (re-entrant; restores
+    whatever was active on exit, including None)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: Optional[Dict[str, str]]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._prev = getattr(_CTX, "ctx", None)
+        _CTX.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _CTX.ctx = self._prev
+        return False
+
+
+def use_context(ctx: Optional[Dict[str, str]]):
+    """``with trace.use_context(ctx): ...`` — activate a captured
+    context on this thread (fan-out worker threads, the micro-batcher
+    dispatcher). ``None`` is legal and deactivates for the scope."""
+    return _CtxScope(ctx)
+
+
+def wire_context() -> Optional[Dict[str, str]]:
+    """The context an outgoing RPC should carry, or None when tracing
+    is off (the one-cached-bool discipline: a disabled process attaches
+    nothing and pays one attribute check). A fresh root is minted when
+    no context is active — the client edge is where a trace starts."""
+    if not GLOBAL._enabled:
+        return None
+    cur = getattr(_CTX, "ctx", None)
+    sid = _new_id()
+    if cur is None:
+        return {"tid": _new_id(), "sid": sid,
+                "origin": f"{GLOBAL.host}:{GLOBAL._pid}"}
+    return {"tid": cur["tid"], "sid": sid,
+            "origin": cur.get("origin", "")}
+
+
+def server_context(wire_ctx: Dict[str, Any]) -> Dict[str, str]:
+    """The server-side child of a context received off the wire: same
+    trace id, a fresh local span id, ``parent`` = the client's span id
+    (what the merge tool draws the cross-process flow arrow from)."""
+    return {"tid": str(wire_ctx.get("tid", "")),
+            "sid": _new_id(),
+            "parent": str(wire_ctx.get("sid", "")),
+            "origin": str(wire_ctx.get("origin", ""))}
 
 
 class _Span:
@@ -99,7 +191,21 @@ class Tracer:
         self._enabled = False          # the ONE hot-path check
         self._path: Optional[str] = None
         self._epoch_ns = time.perf_counter_ns()
+        # Wall-clock anchor: the unix ns corresponding to ring ts 0.
+        # Captured back-to-back with the perf epoch so cross-process
+        # merge (trace_report --merge) can place this ring on a global
+        # timeline. Constructed once per process, outside any replay
+        # closure.
+        self._wall_anchor_ns = time.time_ns()
         self._pid = os.getpid()
+        try:
+            self.host = os.uname().nodename
+        except (AttributeError, OSError):  # pragma: no cover - non-posix
+            self.host = "localhost"
+        # endpoint -> {"offset_ms", "rtt_ms"} from the RPC clock
+        # handshake (rpc.FramedRPCConn): how far each peer's wall clock
+        # sits from ours, embedded in the export for merge refinement.
+        self._peer_offsets: Dict[str, Dict[str, float]] = {}
         self._atexit_registered = False
         self._dropped = 0
 
@@ -156,6 +262,12 @@ class Tracer:
         }
         if ph == "X":
             ev["dur"] = dur_ns / 1e3
+        ctx = getattr(_CTX, "ctx", None)
+        if ctx is not None:
+            # Every span recorded under a traced request carries its
+            # caller's trace id — the cross-process correlation key.
+            args = dict(args or {})
+            args.setdefault("trace", ctx["tid"])
         if args:
             ev["args"] = {k: _json_safe(v) for k, v in args.items()}
         with self._lock:
@@ -203,10 +315,30 @@ class Tracer:
             meta.append({"name": "thread_name", "ph": "M",
                          "pid": self._pid, "tid": th.ident,
                          "args": {"name": th.name}})
+        with self._lock:
+            peer_offsets = {ep: dict(v)
+                            for ep, v in self._peer_offsets.items()}
         return {"traceEvents": meta + events,
                 "displayTimeUnit": "ms",
-                # graftlint: allow-lock(approximate stat; torn read is fine)
-                "otherData": {"dropped_events": self._dropped}}
+                "otherData": {
+                    # graftlint: allow-lock(approximate stat; torn read ok)
+                    "dropped_events": self._dropped,
+                    # The merge anchors: unix ns of ring ts 0, this
+                    # process's identity, and measured peer clock
+                    # offsets (trace_report --merge).
+                    "wall_anchor_ns": int(self._wall_anchor_ns),
+                    "host": self.host,
+                    "pid": int(self._pid),
+                    "peer_offsets_ms": peer_offsets}}
+
+    def note_peer_offset(self, endpoint: str, offset_ms: float,
+                         rtt_ms: float = 0.0) -> None:
+        """Record one clock-handshake result (rpc.FramedRPCConn calls
+        this per connect while tracing is on)."""
+        with self._lock:
+            self._peer_offsets[endpoint] = {
+                "offset_ms": round(float(offset_ms), 3),
+                "rtt_ms": round(float(rtt_ms), 3)}
 
     def export(self, path: Optional[str] = None) -> str:
         """Write the Perfetto/chrome://tracing-loadable JSON file.
@@ -240,13 +372,26 @@ instant = GLOBAL.instant
 counter = GLOBAL.counter
 snapshot = GLOBAL.snapshot
 export = GLOBAL.export
+note_peer_offset = GLOBAL.note_peer_offset
+
+# Extra stall-forensics sections contributed by other modules (the rpc
+# layer registers its in-flight call table here — trace cannot import
+# rpc without a cycle). Each provider must be cheap and non-raising.
+_FORENSICS_PROVIDERS: Dict[str, Callable[[], Any]] = {}
+
+
+def register_forensics_provider(name: str, fn: Callable[[], Any]) -> None:
+    _FORENSICS_PROVIDERS[name] = fn
 
 
 def stall_forensics(max_events: int = 256) -> Dict[str, Any]:
     """Post-mortem payload for a hung run: every thread's Python stack
-    (faulthandler) + the trace ring tail. bench.py's watchdog embeds
-    this in the failure JSON so an r05-style 'no progress in phase
-    device-probe' stall names the blocked frame, not just the phase."""
+    (faulthandler), the trace ring tail, and every registered provider
+    section (e.g. ``inflight_rpcs`` — the in-flight RPC table, so a
+    hang names the REMOTE it is stuck on, not just local frames).
+    bench.py's watchdog embeds this in the failure JSON so an r05-style
+    'no progress in phase device-probe' stall names the blocked frame,
+    not just the phase."""
     import faulthandler
     import tempfile
     try:
@@ -256,5 +401,11 @@ def stall_forensics(max_events: int = 256) -> Dict[str, Any]:
             stacks = f.read().splitlines()
     except Exception as e:  # noqa: BLE001 - forensics must never raise
         stacks = [f"<faulthandler failed: {e!r}>"]
-    return {"thread_stacks": stacks,
-            "trace_tail": GLOBAL.snapshot()[-max_events:]}
+    out: Dict[str, Any] = {"thread_stacks": stacks,
+                           "trace_tail": GLOBAL.snapshot()[-max_events:]}
+    for name, fn in _FORENSICS_PROVIDERS.items():
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001 - forensics must never raise
+            out[name] = f"<provider failed: {e!r}>"
+    return out

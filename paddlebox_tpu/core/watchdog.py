@@ -176,12 +176,21 @@ class Watchdog:
             self._on_stall(phase, idle)
             return
         # Default action: forensics into the log, then abort the armed
-        # thread through the normal exception path.
+        # thread through the normal exception path. The in-flight RPC
+        # table (rpc.inflight_table via the forensics provider) leads:
+        # a stall blocked on a dead peer should name the REMOTE first,
+        # not bury it under local thread stacks.
         fx = trace.stall_forensics()
+        inflight = fx.get("inflight_rpcs") or []
+        remote = "; ".join(
+            f"{e['service']}.{e['method']} -> {e['endpoint']} "
+            f"(in flight {e['age_s']:.1f}s)"
+            for e in inflight if isinstance(e, dict)) or "none"
         log.warning(
-            "%s: no progress in phase %r for %.0fs — dumping stall "
-            "forensics and aborting the pass:\n%s", self.name, phase,
-            idle, "\n".join(fx.get("thread_stacks", [])))
+            "%s: no progress in phase %r for %.0fs — in-flight RPCs: "
+            "%s — dumping stall forensics and aborting the pass:\n%s",
+            self.name, phase, idle, remote,
+            "\n".join(fx.get("thread_stacks", [])))
         target = self._target
         if target is not None and _async_raise(target, StallError):
             monitor.add("watchdog/aborts", 1)

@@ -20,18 +20,77 @@ Robustness contract of the loop:
   length-prefixed stream cannot be resynchronized);
 - ``_after_reply()`` hooks post-response actions (the PS ``stop`` RPC
   closes its listener only AFTER the acknowledgement is on the wire).
+
+Distributed tracing (OBSERVABILITY.md "Distributed tracing"): when the
+CLIENT process has tracing on, every request dict carries a compact
+``_trace`` context (``{tid, sid, origin}``) that the server loop pops,
+installs thread-locally for the handler's duration, and records as a
+``rpc/<method>`` server span whose ``parent`` is the client's span id —
+so one predict's trace id follows it through router → replica → shard
+hops, and ``tools/trace_report.py --merge`` can draw the cross-process
+flow arrows. Every reply also carries ``_server_ms`` (handler wall),
+letting any client decompose its observed latency into server vs wire
+share without a second RPC. With tracing off the client attaches
+nothing and the per-call cost is one cached-bool check.
+
+Two always-on observability surfaces (RPCs are not the jitted hot
+loop): the module-level IN-FLIGHT CALL TABLE (``inflight_table()`` —
+peer endpoint, method, age; registered as a ``trace.stall_forensics``
+provider so a watchdog stall names the remote it is stuck on), and
+per-method reconnect/retry counters (``rpc/reconnects/<method>``,
+``rpc/retries/<method>`` beside the long-standing totals) so a
+failover drill can assert exactly which method consumed the retry
+budget.
 """
 
 from __future__ import annotations
 
+import itertools
 import socket
 import threading
 import time
-from typing import Callable, FrozenSet, Iterable, Optional
+from typing import Any, Callable, Dict, FrozenSet, Iterable, List, Optional
 
-from paddlebox_tpu.core import faults, flags, log, monitor
+from paddlebox_tpu.core import faults, flags, log, monitor, trace
 from paddlebox_tpu.distributed import wire
 from paddlebox_tpu.distributed.transport import _recv_exact
+
+# -- in-flight RPC table ------------------------------------------------------
+
+_INFLIGHT: Dict[int, Dict[str, Any]] = {}
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT_IDS = itertools.count(1)
+
+
+def _inflight_enter(endpoint: str, method: str, service: str) -> int:
+    token = next(_INFLIGHT_IDS)
+    with _INFLIGHT_LOCK:
+        _INFLIGHT[token] = {"endpoint": endpoint, "method": method,
+                            "service": service, "t0": time.monotonic()}
+    return token
+
+
+def _inflight_exit(token: int) -> None:
+    with _INFLIGHT_LOCK:
+        _INFLIGHT.pop(token, None)
+
+
+def inflight_table() -> List[Dict[str, Any]]:
+    """Every RPC currently blocked on a peer: endpoint, method, service,
+    age. The watchdog's stall forensics include this (oldest first), so
+    a hang past FLAGS_stall_timeout_s names the remote, not just the
+    local thread stacks."""
+    now = time.monotonic()
+    with _INFLIGHT_LOCK:
+        entries = list(_INFLIGHT.values())
+    out = [{"endpoint": e["endpoint"], "method": e["method"],
+            "service": e["service"], "age_s": round(now - e["t0"], 3)}
+           for e in entries]
+    out.sort(key=lambda e: -e["age_s"])
+    return out
+
+
+trace.register_forensics_provider("inflight_rpcs", inflight_table)
 
 
 class FramedRPCServer:
@@ -103,10 +162,18 @@ class FramedRPCServer:
                              "error": "request must be a dict with a "
                                       "str 'method'"}))
                         continue
+                    tctx = req.pop("_trace", None)
+                    t0 = time.perf_counter()
                     try:
-                        out = getattr(self, "handle_" + method)(req)
+                        out = self._dispatch(method, req, tctx)
                         conn.sendall(wire.pack_frame(
-                            {"ok": True, "result": out}))
+                            {"ok": True, "result": out,
+                             # Server share of the caller's observed
+                             # latency: total - _server_ms = wire+queue,
+                             # the per-hop decomposition every client
+                             # gets for free.
+                             "_server_ms": round(
+                                 (time.perf_counter() - t0) * 1e3, 3)}))
                     except Exception as e:  # report in-band, keep serving
                         log.vlog(0, "%s %s failed: %s", self.service_name,
                                  method, e)
@@ -123,6 +190,54 @@ class FramedRPCServer:
             return
         except (ConnectionError, OSError, EOFError):
             return
+
+    def _dispatch(self, method: str, req: dict, tctx: Optional[dict]):
+        """Invoke ``handle_<method>``, under the caller's trace context
+        when the request carried one: the handler thread's spans then
+        record the caller's trace id, and the ``rpc/<method>`` server
+        span's ``parent`` links back to the client span for the merged
+        trace's flow arrows. Requests without a context (tracing off at
+        the client) dispatch exactly as before."""
+        handler = getattr(self, "handle_" + method)
+        if not isinstance(tctx, dict):
+            return handler(req)
+        sctx = trace.server_context(tctx)
+        with trace.use_context(sctx), \
+                trace.span(f"rpc/{method}", span=sctx["sid"],
+                           parent=sctx["parent"],
+                           origin=sctx["origin"]):
+            return handler(req)
+
+    # -- base handlers every framed service answers ------------------------
+
+    def handle_clock_probe(self, req) -> Dict[str, int]:
+        """Wall-clock sample for the client's clock-offset handshake
+        (one probe per connect while tracing is on): the client halves
+        the RTT to estimate this server's wall offset, which the merge
+        tool uses to align per-process trace timelines."""
+        # graftlint: allow-replay(clock handshake metadata, never training state)
+        return {"wall_ns": time.time_ns()}
+
+    def handle_metrics_snapshot(self, req) -> dict:
+        """This process's labeled registry snapshot — the one-scrape
+        cluster-telemetry surface (core/telemetry_scrape.py /
+        tools/fleet_top.py). Servers with per-instance registries
+        (PredictServer, ShardServer, FleetRouter) override this; the
+        base answers from the process-global registry so EVERY framed
+        service is scrapeable."""
+        return monitor.snapshot_all(
+            labels={"service": self.service_name,
+                    "endpoint": self.endpoint})
+
+    def handle_trace_export(self, req) -> dict:
+        """Export this process's span ring to ``req['path']`` (or the
+        configured FLAGS_trace_path) and return the path — how a drill
+        or operator collects per-process trace files for
+        ``trace_report --merge`` without waiting for process exit."""
+        path = req.get("path") or None
+        out = trace.GLOBAL.export(path)
+        return {"path": out,
+                "events": len(trace.snapshot())}
 
     def _after_reply(self) -> bool:
         """Post-response hook; return True to end this connection (the
@@ -172,12 +287,51 @@ class FramedRPCConn:
         # from the resolver are the resolver's bug — it should return
         # the current endpoint when it cannot do better.
         self._resolve = resolve
+        # Per-hop latency decomposition from the newest completed call:
+        # the reply's _server_ms (handler wall on the peer) and the
+        # client-observed remainder (wire + peer queue). Read under the
+        # conn lock by callers that just completed a call (the fleet
+        # router's hop metrics).
+        self.last_server_ms: Optional[float] = None
+        self.last_wire_ms: Optional[float] = None
+        # Clock-offset handshake result (peer wall - our wall, ms);
+        # None until tracing is on during a connect.
+        self.clock_offset_ms: Optional[float] = None
         self._sock: Optional[socket.socket] = self._connect()
 
     def _connect(self) -> socket.socket:
         host, port = self.endpoint.rsplit(":", 1)
-        return socket.create_connection((host, int(port)),
+        sock = socket.create_connection((host, int(port)),
                                         timeout=self._timeout)
+        if trace.enabled():
+            self._clock_handshake(sock)
+        return sock
+
+    def _clock_handshake(self, sock: socket.socket) -> None:
+        """One wall-clock probe per connect (tracing on only): the
+        peer's wall at the RTT midpoint vs ours estimates the clock
+        offset the trace merge aligns per-process timelines with.
+        Best-effort — a peer that cannot answer costs nothing."""
+        try:
+            # graftlint: allow-replay(telemetry clock metadata, gated on tracing)
+            t0_wall = time.time_ns()
+            t0 = time.perf_counter_ns()
+            sock.sendall(wire.pack_frame({"method": "clock_probe"}))
+            ln = wire.read_frame_header(
+                _recv_exact(sock, wire.HEADER.size))
+            resp = wire.loads(_recv_exact(sock, ln))
+            rtt_ns = time.perf_counter_ns() - t0
+            if not (isinstance(resp, dict) and resp.get("ok")):
+                return
+            peer_wall = int(resp["result"]["wall_ns"])
+            offset_ms = (peer_wall - (t0_wall + rtt_ns // 2)) / 1e6
+            self.clock_offset_ms = round(offset_ms, 3)
+            trace.note_peer_offset(self.endpoint, offset_ms,
+                                   rtt_ms=rtt_ns / 1e6)
+            monitor.set_gauge("rpc/clock_offset_ms", round(offset_ms, 3))
+        except (OSError, ConnectionError, wire.WireError, KeyError,
+                TypeError, ValueError):
+            return
 
     def _call_once(self, method: str, kw) -> dict:
         faults.faultpoint("rpc/call")
@@ -191,24 +345,37 @@ class FramedRPCConn:
                     self.endpoint = ep
             self._sock = self._connect()
             monitor.add("rpc/reconnects", 1)
+            monitor.add(f"rpc/reconnects/{method}", 1)
         s = self._sock
+        tctx = kw.get("_trace")
+        sp = (trace.span(f"rpc/client/{method}", trace=tctx["tid"],
+                         span=tctx["sid"], peer=self.endpoint)
+              if tctx is not None else trace.NULL_SPAN)
+        token = _inflight_enter(self.endpoint, method, self._service)
         try:
-            s.sendall(wire.pack_frame({"method": method, **kw}))
-            ln = wire.read_frame_header(
-                _recv_exact(s, wire.HEADER.size))
-            return wire.loads(_recv_exact(s, ln))
+            with sp:
+                s.sendall(wire.pack_frame({"method": method, **kw}))
+                ln = wire.read_frame_header(
+                    _recv_exact(s, wire.HEADER.size))
+                return wire.loads(_recv_exact(s, ln))
         except (OSError, ConnectionError, wire.WireError):
             # A timed-out / half-read / desynced stream cannot be
             # reused — drop it so the next attempt reconnects cleanly.
             self.close()
             raise
+        finally:
+            _inflight_exit(token)
 
     def call(self, method: str, **kw):
         retries = (max(0, int(flags.flag("rpc_max_retries")))
                    if method in self._idempotent else 0)
         deadline = time.monotonic() + float(
             flags.flag("rpc_retry_deadline_s"))
+        tctx = trace.wire_context()
+        if tctx is not None:
+            kw["_trace"] = tctx
         with self._lock:
+            t_call = time.perf_counter()
             attempt = 0
             while True:
                 try:
@@ -219,6 +386,7 @@ class FramedRPCConn:
                         raise
                     attempt += 1
                     monitor.add("rpc/retries", 1)
+                    monitor.add(f"rpc/retries/{method}", 1)
                     log.warning(
                         "%s.%s: connection error %r — reconnect+retry "
                         "%d/%d", self._service, method, e, attempt,
@@ -226,6 +394,16 @@ class FramedRPCConn:
                     time.sleep(min(
                         float(flags.flag("rpc_retry_backoff_s"))
                         * (2.0 ** (attempt - 1)), 2.0))
+            total_ms = (time.perf_counter() - t_call) * 1e3
+            server_ms = resp.get("_server_ms") if isinstance(resp, dict) \
+                else None
+            if isinstance(server_ms, (int, float)):
+                self.last_server_ms = float(server_ms)
+                self.last_wire_ms = round(
+                    max(0.0, total_ms - float(server_ms)), 3)
+            else:
+                self.last_server_ms = None
+                self.last_wire_ms = None
         if not resp["ok"]:
             raise RuntimeError(
                 f"{self._service}.{method}: {resp['error']}")

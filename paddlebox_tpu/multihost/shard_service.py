@@ -60,7 +60,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from paddlebox_tpu.core import faults, flags, log, monitor
+from paddlebox_tpu.core import faults, flags, log, monitor, trace
 from paddlebox_tpu.distributed import rpc, wire
 from paddlebox_tpu.embedding.store import _FIELDS, FeatureStore
 from paddlebox_tpu.embedding.table import TableConfig
@@ -167,8 +167,24 @@ class ShardServer(rpc.FramedRPCServer):
         # RPC they initiated.
         self._slot_locks: Dict[int, threading.RLock] = {}
         self._locks_guard = threading.Lock()
+        # Per-SERVER registry beside the process-global one (the
+        # PredictServer instance-Monitor pattern): in-process multi-
+        # server drills run N ShardServers in one interpreter, and
+        # per-host assertions (served keys, forward errors, journal
+        # lag) need each server's own numbers — the global keeps its
+        # process-wide meaning. handle_metrics_snapshot serves this
+        # registry to the fleet_top / telemetry_scrape collectors.
+        self.metrics = monitor.Monitor()
         self.service_name = f"shard[{index}]"
         rpc.FramedRPCServer.__init__(self, endpoint, backlog=64)
+
+    def _bump(self, name: str, delta: int = 1) -> None:
+        monitor.add(name, delta)
+        self.metrics.add(name, delta)
+
+    def _set_gauge(self, name: str, value: float) -> None:
+        monitor.set_gauge(name, value)
+        self.metrics.set_gauge(name, value)
 
     def _slot_lock(self, slot: int) -> "threading.RLock":
         with self._locks_guard:
@@ -236,6 +252,7 @@ class ShardServer(rpc.FramedRPCServer):
                     f"(first stray owner {bad}) — client range table is "
                     f"stale; re-apply the rank table")
             if write and role != "primary":
+                self._bump("multihost/stale_primary_errors", 1)
                 raise StalePrimaryError(
                     f"STALE_PRIMARY: shard {self.index} is {role} for "
                     f"slot {int(s)} — the client's replica map predates "
@@ -317,7 +334,7 @@ class ShardServer(rpc.FramedRPCServer):
             except (OSError, ConnectionError, RuntimeError,
                     wire.WireError) as e:
                 st["lagged"] = True
-                monitor.add("multihost/replica_forward_errors", 1)
+                self._bump("multihost/replica_forward_errors", 1)
                 log.warning("%s: forward %s seq %d slot %d -> %s failed "
                             "(%r) — backup marked lagged",
                             self.service_name, op, seq, slot, ep, e)
@@ -340,9 +357,9 @@ class ShardServer(rpc.FramedRPCServer):
             peer.call("replica_snapshot", slot=slot, seq=j.seq,
                       epoch=j.epoch, keys=keys, values=vals,
                       unseen=store.unseen_for(keys))
-            monitor.add("multihost/replica_snapshots", 1)
-            monitor.add("multihost/replica_snapshot_rows",
-                        int(keys.size))
+            self._bump("multihost/replica_snapshots", 1)
+            self._bump("multihost/replica_snapshot_rows",
+                       int(keys.size))
             log.vlog(0, "%s: slot %d snapshot -> %s (%d rows, seq %d; "
                      "backup was at %d)", self.service_name, slot, ep,
                      keys.size, j.seq, bseq)
@@ -350,8 +367,8 @@ class ShardServer(rpc.FramedRPCServer):
             for e in entries:
                 peer.call("replica_apply", slot=slot, seq=e.seq,
                           op=e.op, epoch=j.epoch, **e.payload)
-            monitor.add("multihost/replica_catchup_entries",
-                        len(entries))
+            self._bump("multihost/replica_catchup_entries",
+                       len(entries))
             if entries:
                 log.vlog(0, "%s: slot %d journal catch-up -> %s "
                          "(%d entries, seq %d -> %d)", self.service_name,
@@ -390,7 +407,7 @@ class ShardServer(rpc.FramedRPCServer):
                         cap, start_seq=start,
                         epoch=self._slot_epoch.get(slot, ""))
                     if old == "backup":
-                        monitor.add("multihost/replica_promotes", 1)
+                        self._bump("multihost/replica_promotes", 1)
                         log.vlog(0, "%s: PROMOTED to primary of slot %d "
                                  "(seq %d)", self.service_name, slot,
                                  start)
@@ -414,8 +431,8 @@ class ShardServer(rpc.FramedRPCServer):
                 for slot in prim
                 for ep in rmap.replicas_of(slot)[1:]}
             self.service_name = f"shard[{self.index}]"
-            monitor.set_gauge("multihost/replication",
-                              float(rmap.replication))
+            self._set_gauge("multihost/replication",
+                            float(rmap.replication))
             return dict(self._roles)
 
     # -- pull / push (the DCN halves of the lookup exchange) ---------------
@@ -443,7 +460,7 @@ class ShardServer(rpc.FramedRPCServer):
         out: Dict[str, np.ndarray] = {
             f: v for f, v in rows.items() if f != "emb"}
         out.update(encode_emb(rows["emb"], req.get("wire", "f32")))
-        monitor.add("multihost/served_pull_keys", int(keys.size))
+        self._bump("multihost/served_pull_keys", int(keys.size))
         return out
 
     def handle_pull_serving(self, req) -> Dict[str, np.ndarray]:
@@ -484,7 +501,7 @@ class ShardServer(rpc.FramedRPCServer):
                 w[idx] = ww
         out: Dict[str, np.ndarray] = {"found": found, "w": w}
         out.update(encode_emb(emb, req.get("wire", "f32")))
-        monitor.add("multihost/served_serving_keys", int(keys.size))
+        self._bump("multihost/served_serving_keys", int(keys.size))
         return out
 
     def handle_push(self, req) -> int:
@@ -505,7 +522,7 @@ class ShardServer(rpc.FramedRPCServer):
                 slot, "push", {"keys": sub_k, "values": sub_v},
                 lambda s=slot, k=sub_k, v=sub_v:
                     self._slot_stores[s].push_from_pass(k, v))
-        monitor.add("multihost/served_push_keys", int(keys.size))
+        self._bump("multihost/served_push_keys", int(keys.size))
         return int(keys.size)
 
     # -- replica protocol --------------------------------------------------
@@ -513,6 +530,7 @@ class ShardServer(rpc.FramedRPCServer):
     def _require_backup(self, slot: int) -> FeatureStore:
         role = self._roles.get(slot)
         if role != "backup":
+            self._bump("multihost/stale_primary_errors", 1)
             raise StalePrimaryError(
                 f"STALE_PRIMARY: shard {self.index} is "
                 f"{role or 'no replica'} for slot {slot} — the sender's "
@@ -887,7 +905,7 @@ class ShardServer(rpc.FramedRPCServer):
                 else:
                     evicted += store.shrink(
                         min_show=req.get("min_show", 0.0))
-        monitor.set_gauge(
+        self._set_gauge(
             "multihost/shard_rows",
             float(sum(self._slot_stores[s].num_features
                       for s in self._primary_slots())))
@@ -937,14 +955,58 @@ class ShardServer(rpc.FramedRPCServer):
                 else np.empty((0,), np.float32))
         return {"keys": keys, "show": show}
 
+    def replication_lag(self) -> Dict[str, float]:
+        """Per-slot journal lag of this server's primary slots: for
+        every (slot, backup) pair, primary seq minus the backup's last
+        acked seq (an unacked/never-synced backup counts the full
+        journal seq). Returns the worst and the p99 across slots — the
+        fleet-wide freshness-of-replicas gauges a scrape reads. An
+        approximate stat: read without slot locks (a torn read is off
+        by at most the in-flight mutation)."""
+        lags: List[int] = []
+        journals = dict(self._journals)
+        for (slot, _ep), st in list(self._backup_state.items()):
+            j = journals.get(slot)
+            if j is None:
+                continue
+            acked = st.get("seq")
+            lags.append(max(0, j.seq - (acked if acked is not None
+                                        else 0)))
+        if not lags:
+            return {"worst": 0.0, "p99": 0.0, "pairs": 0.0}
+        lags.sort()
+        p99 = lags[min(len(lags) - 1,
+                       max(0, int(round(0.99 * (len(lags) - 1)))))]
+        return {"worst": float(lags[-1]), "p99": float(p99),
+                "pairs": float(len(lags))}
+
+    def handle_metrics_snapshot(self, req) -> dict:
+        """This server's labeled instance-registry snapshot, with the
+        replication-lag gauges computed AT SCRAPE TIME (they are a
+        derived view of journal/ack state, not an event counter) — the
+        per-host share of the one-scrape cluster snapshot
+        (core/telemetry_scrape.py, tools/fleet_top.py)."""
+        lag = self.replication_lag()
+        self._set_gauge("multihost/replica_lag_worst", lag["worst"])
+        self._set_gauge("multihost/replica_lag_p99", lag["p99"])
+        return self.metrics.snapshot_all(
+            labels={"service": self.service_name,
+                    "endpoint": self.endpoint,
+                    "shard": int(self.index)})
+
     def handle_stats(self, req) -> Dict[str, int]:
+        snap = monitor.snapshot()
         return {"num_features": int(sum(
                     self._slot_stores[s].num_features
                     for s in self._primary_slots())),
                 "index": int(self.index),
                 "world": int(self.ranges.world),
                 "replication": int(self._map.replication
-                                   if self._map else 1)}
+                                   if self._map else 1),
+                # Process-level conn health: the failover drills assert
+                # the retry budget actually consumed.
+                "rpc_reconnects": int(snap.get("rpc/reconnects", 0)),
+                "rpc_retries": int(snap.get("rpc/retries", 0))}
 
     def handle_stop(self, req) -> bool:
         self._running = False
@@ -1042,6 +1104,12 @@ class ShardClient:
                 except OSError:
                     pass
                 monitor.add("multihost/replica_failovers", 1)
+                # The failover HOP is part of the request's story: the
+                # instant carries the active trace id (when traced), so
+                # a merged trace shows which replica answered after the
+                # primary died.
+                trace.instant("multihost/replica_failover",
+                              method=method, endpoint=ep)
                 log.warning("shard client: read %s failed over to "
                             "replica %s", method, ep)
                 return out

@@ -213,10 +213,15 @@ class MultiHostStore:
         re-resolves and replays)."""
         results: Dict[int, object] = {}
         errs: List[Tuple[int, BaseException]] = []
+        # Carry the caller's trace context into the fan-out threads
+        # (thread-locals don't cross Thread boundaries), so every
+        # per-peer RPC of one pass boundary shares the pass's trace id.
+        tctx = trace.current_context()
 
         def run(host: int, kw: dict) -> None:
             try:
-                results[host] = self._clients[host].call(method, **kw)
+                with trace.use_context(tctx):
+                    results[host] = self._clients[host].call(method, **kw)
             except BaseException as e:
                 errs.append((host, e))
 
@@ -263,10 +268,12 @@ class MultiHostStore:
         eps = self._admin_eps()
         results: Dict[str, object] = {}
         errs: List[BaseException] = []
+        tctx = trace.current_context()
 
         def run(ep: str) -> None:
             try:
-                results[ep] = self._ep_client(ep).call(method, **kw)
+                with trace.use_context(tctx):
+                    results[ep] = self._ep_client(ep).call(method, **kw)
             except BaseException as e:
                 errs.append(e)
 

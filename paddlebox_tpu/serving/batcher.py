@@ -72,7 +72,8 @@ class _Pending:
     """One enqueued request: parsed instances + the slot its handler
     thread blocks on."""
 
-    __slots__ = ("instances", "t_enqueue", "done", "probs", "error")
+    __slots__ = ("instances", "t_enqueue", "done", "probs", "error",
+                 "ctx")
 
     def __init__(self, instances: List[Instance]):
         self.instances = instances
@@ -80,6 +81,11 @@ class _Pending:
         self.done = threading.Event()
         self.probs: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # The enqueuing handler thread's trace context (None when the
+        # request was not traced): the dispatcher adopts the batch's
+        # first traced context so the device forward and the shard-miss
+        # RPCs carry a request trace id across the thread hop.
+        self.ctx = trace.current_context()
 
 
 class MicroBatcher:
@@ -179,8 +185,16 @@ class MicroBatcher:
             for r in reqs:
                 all_ins.extend(r.instances)
                 offsets.append(len(all_ins))
-            with trace.span("serving/batch_dispatch",
-                            requests=len(reqs), rows=len(all_ins)):
+            # A batch coalesces requests from MANY traces; Dapper-style,
+            # the dispatch rides the first traced request's context
+            # (its id correlates the downstream shard hops) and records
+            # how many traced requests were coalesced under it.
+            ctx = next((r.ctx for r in reqs if r.ctx is not None), None)
+            with trace.use_context(ctx), \
+                    trace.span("serving/batch_dispatch",
+                               requests=len(reqs), rows=len(all_ins),
+                               coalesced_traces=sum(
+                                   1 for r in reqs if r.ctx is not None)):
                 batch = pack_bucketed(all_ins, self._feed)
                 probs = np.asarray(self._pred.predict(batch), np.float32)
             bs = batch.batch_size

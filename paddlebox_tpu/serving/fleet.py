@@ -43,7 +43,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from paddlebox_tpu.core import faults, flags, log, monitor
+from paddlebox_tpu.core import faults, flags, log, monitor, trace
 from paddlebox_tpu.distributed import rpc
 
 _SERVING = ("healthy", "degraded")   # states the ring routes to
@@ -550,11 +550,16 @@ class ShardBackedStore:
                 work.append((h, idx))
         results: Dict[int, dict] = {}
         errs: List[BaseException] = []
+        # The caller's trace context (the coalesced batch's, via the
+        # micro-batcher) rides into the per-shard fan-out threads so
+        # the miss hop carries the predict's trace id.
+        tctx = trace.current_context()
 
         def run(h: int, idx: np.ndarray) -> None:
             try:
-                results[h] = self._clients[h].call(
-                    "pull_serving", keys=keys[idx], wire=wire)
+                with trace.use_context(tctx):
+                    results[h] = self._clients[h].call(
+                        "pull_serving", keys=keys[idx], wire=wire)
             except BaseException as e:
                 errs.append(e)
 
@@ -599,6 +604,7 @@ def start_replica(model, feed_config, *, endpoint: str = "127.0.0.1:0",
                   base_export: Optional[str] = None,
                   dense_params=None,
                   shard_endpoints: Optional[Sequence[str]] = None,
+                  shard_replicas: int = 1,
                   hbm_rows: Optional[int] = None,
                   watch_root: Optional[str] = None,
                   table: str = "embedding",
@@ -628,7 +634,14 @@ def start_replica(model, feed_config, *, endpoint: str = "127.0.0.1:0",
             keys = np.empty((0,), np.uint64)
             emb = np.empty((0, dim), np.float32)
             w = np.empty((0,), np.float32)
-        backing = ShardBackedStore(shard_endpoints, dim)
+        # shard_replicas > 1: the backing tier is replicated (ring map
+        # over the listed endpoints, MULTIHOST.md) — miss-path reads
+        # then fail over across a slot's backups on a shard-host death.
+        from paddlebox_tpu.multihost.replication import ReplicaMap
+        rmap = (ReplicaMap.ring(list(shard_endpoints), shard_replicas)
+                if int(shard_replicas) > 1 else None)
+        backing = ShardBackedStore(shard_endpoints, dim,
+                                   replica_map=rmap)
         pred = CTRPredictor(model, feed_config, keys, emb, w, dense_params,
                             hbm_rows=hbm_rows, shard_backing=backing,
                             **predictor_kw)

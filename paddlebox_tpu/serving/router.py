@@ -63,27 +63,43 @@ class FleetRouter(rpc.FramedRPCServer):
                 self.fleet.add_replica(f"replica-{i}", ep, ready=True)
         self._route_lat = LogQuantileDigest()
         self._route_lock = threading.Lock()
+        # Per-ROUTER registry beside the global (the PredictServer /
+        # ShardServer instance-Monitor pattern): hop decomposition and
+        # routing counters for THIS router, servable to the cluster
+        # scrape without conflating in-process test fleets.
+        self.metrics = monitor.Monitor()
         if start_health:
             self.fleet.start()
         rpc.FramedRPCServer.__init__(self, endpoint, backlog=128)
+
+    def _bump(self, name: str, delta: int = 1) -> None:
+        monitor.add(name, delta)
+        self.metrics.add(name, delta)
+
+    def _observe_q(self, name: str, value: float) -> None:
+        monitor.observe_quantile(name, value)
+        self.metrics.observe_quantile(name, value)
 
     # -- predict routing ---------------------------------------------------
 
     def _forward(self, replica: Replica, lines: List[str],
                  degraded: bool):
         """One predict attempt against one replica (conn from its
-        pool; a broken conn is closed, not returned)."""
+        pool; a broken conn is closed, not returned). Returns
+        (reply, replica server ms from the framed reply — None on a
+        pre-decomposition peer)."""
         conn = replica.pool.acquire()
         try:
             kw = {"lines": lines}
             if degraded:
                 kw["degraded"] = True
             out = conn.call("predict", **kw)
+            server_ms = conn.last_server_ms
         except BaseException:
             conn.close()
             raise
         replica.pool.release(conn)
-        return out
+        return out, server_ms
 
     def handle_predict(self, req) -> dict:
         """Route one predict: hash-affinity pick (spillover/degraded per
@@ -106,8 +122,10 @@ class FleetRouter(rpc.FramedRPCServer):
                 if replica is None:
                     break
                 tried.add(replica.id)
+                t_pick = time.perf_counter()
                 try:
-                    probs = self._forward(replica, lines, degraded)
+                    probs, srv_ms = self._forward(replica, lines,
+                                                  degraded)
                 except (OSError, wire.WireError) as e:
                     # Dead socket / torn reply stream: strike (ejects at
                     # the same threshold as the health thread) and
@@ -116,19 +134,36 @@ class FleetRouter(rpc.FramedRPCServer):
                     last_err = e
                     self.fleet.release(replica)
                     self.fleet.strike(replica)
-                    monitor.add("fleet/reroutes", 1)
+                    self._bump("fleet/reroutes", 1)
                     continue
                 self.fleet.release(replica)
-                monitor.add("fleet/routed", 1)
-                ms = (time.perf_counter() - t0) * 1e3
+                self._bump("fleet/routed", 1)
+                t_done = time.perf_counter()
+                ms = (t_done - t0) * 1e3
                 monitor.observe_quantile("fleet/route_ms", ms)
                 with self._route_lock:
                     self._route_lat.observe(ms)
+                # Per-hop decomposition: router queue/pick share, the
+                # replica's server wall (off its framed reply), and the
+                # router→replica wire remainder. Returned in the reply
+                # so the CLIENT adds its own wire share on top.
+                route_ms = (t_pick - t0) * 1e3
+                fwd_ms = (t_done - t_pick) * 1e3
+                hop = {"route_ms": round(route_ms, 3)}
+                if isinstance(srv_ms, (int, float)):
+                    hop["server_ms"] = round(float(srv_ms), 3)
+                    hop["wire_ms"] = round(
+                        max(0.0, fwd_ms - float(srv_ms)), 3)
+                    self._observe_q("fleet/hop_server_ms",
+                                    hop["server_ms"])
+                    self._observe_q("fleet/hop_wire_ms", hop["wire_ms"])
+                self._observe_q("fleet/hop_route_ms", route_ms)
                 return {"probs": np.asarray(probs, np.float32),
                         "degraded": bool(degraded),
                         "replica": replica.id,
-                        "epoch": int(self.fleet.epoch)}
-        monitor.add("fleet/route_failures", 1)
+                        "epoch": int(self.fleet.epoch),
+                        "hop": hop}
+        self._bump("fleet/route_failures", 1)
         raise RuntimeError(
             f"no serving replica could answer (tried {sorted(tried)}): "
             f"{last_err!r}")
@@ -204,6 +239,7 @@ class FleetRouter(rpc.FramedRPCServer):
             route_q = {k: (round(v, 3) if v is not None else None)
                        for k, v in self._route_lat.quantiles().items()}
         counters = merged.get("counters", {})
+        snap = monitor.snapshot()
         return {"fleet_size": len(snaps),
                 "epoch": int(self.fleet.epoch),
                 "throughput_rps": round(rps_total, 3),
@@ -214,8 +250,26 @@ class FleetRouter(rpc.FramedRPCServer):
                 "degraded_rpcs": int(
                     counters.get("serving/degraded_rpcs", 0)),
                 "slo_violations": int(counters.get("slo/violations", 0)),
+                # Router-process conn health: reconnects/retries its
+                # replica pools burned (the failover-blip assertions).
+                "rpc_reconnects": int(snap.get("rpc/reconnects", 0)),
+                "rpc_retries": int(snap.get("rpc/retries", 0)),
                 "merged": merged,
                 "replicas": briefs}
+
+    def handle_metrics_snapshot(self, req) -> dict:
+        """The ROUTER's own instance registry (hop decomposition,
+        routing counters) with the route-latency digest injected — its
+        share of the one-scrape cluster snapshot. Replica registries
+        are scraped directly from the replicas (or folded via
+        ``handle_stats``), not re-served here."""
+        out = self.metrics.snapshot_all(
+            labels={"service": self.service_name,
+                    "endpoint": self.endpoint})
+        with self._route_lock:
+            out["quantiles"]["fleet/route_ms"] = \
+                self._route_lat.to_dict()
+        return out
 
     def handle_stop(self, req) -> bool:
         self.stop()

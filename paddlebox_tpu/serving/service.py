@@ -209,7 +209,13 @@ class PredictServer(rpc.FramedRPCServer):
                 "hotswap_applied": int(
                     snap.get("serving/hotswap_applied", 0)),
                 "slo_p99_ms": float(flags.flag("serving_slo_p99_ms")),
-                "slo_violations": int(mine.get("slo/violations", 0))}
+                "slo_violations": int(mine.get("slo/violations", 0)),
+                # Process-level conn health (global registry: reconnect/
+                # retry totals of every conn this process owns) — the
+                # failover-blip drills assert the retry budget actually
+                # consumed through the stats surface.
+                "rpc_reconnects": int(snap.get("rpc/reconnects", 0)),
+                "rpc_retries": int(snap.get("rpc/retries", 0))}
 
     def handle_metrics_snapshot(self, req) -> dict:
         """This replica's labeled ``snapshot_all()`` (instance registry
@@ -269,6 +275,13 @@ class PredictClient:
         self._latency = LogQuantileDigest()
         self.last_degraded = False
         self.last_replica: Optional[str] = None
+        # Per-hop decomposition of the newest predict: the reply's
+        # server share (router or replica handler wall) vs the client-
+        # observed remainder (wire + connect), and — through a router —
+        # the router's own hop split (route/wire/replica-server ms).
+        self.last_server_ms: Optional[float] = None
+        self.last_wire_ms: Optional[float] = None
+        self.last_hop: Optional[dict] = None
 
     def _resolve_endpoint(self, current: str) -> str:
         """Reconnect-time hook: ask the router which replicas serve
@@ -301,14 +314,26 @@ class PredictClient:
         out = self._conn.call("predict", lines=list(lines))
         if isinstance(out, dict):
             # Router reply: probabilities + routing metadata (degraded
-            # = the SLO-shed hot-rows-only path answered).
+            # = the SLO-shed hot-rows-only path answered; hop = the
+            # router's route/wire/replica-server decomposition).
             self.last_degraded = bool(out.get("degraded", False))
             self.last_replica = out.get("replica")
+            self.last_hop = out.get("hop")
             out = out["probs"]
         else:
             self.last_degraded = False
             self.last_replica = None
-        self._latency.observe((time.perf_counter() - t0) * 1e3)
+            self.last_hop = None
+        total_ms = (time.perf_counter() - t0) * 1e3
+        self._latency.observe(total_ms)
+        # The reply's _server_ms (every framed reply carries it) lets
+        # the client attribute its observed latency: wire share = total
+        # minus the peer's handler wall.
+        self.last_server_ms = self._conn.last_server_ms
+        self.last_wire_ms = self._conn.last_wire_ms
+        if self.last_wire_ms is not None:
+            monitor.observe_quantile("serving/client_wire_ms",
+                                     self.last_wire_ms)
         return out
 
     def latency_quantiles(self) -> dict:

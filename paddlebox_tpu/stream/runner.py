@@ -149,8 +149,14 @@ class StreamRunner(DayRunner):
         self._current_day = m.day
         if (m.day, m.pass_id) in self._published:
             return 0
-        with trace.span("stream/pass", day=m.day, pass_id=m.pass_id,
-                        files=len(m.files), events=m.events):
+        # One root trace context per carved pass (a no-op when tracing
+        # is off): every training-write RPC of this pass — trainer push
+        # → shard primary → synchronous backup forward — carries ONE
+        # trace id, so a merged fleet trace shows the whole write path
+        # of one incremental pass.
+        with trace.use_context(trace.wire_context()), \
+                trace.span("stream/pass", day=m.day, pass_id=m.pass_id,
+                           files=len(m.files), events=m.events):
             self.train_pass(m.day, m.pass_id, list(m.files))
         # Delta published (train_pass's donefile write) — the window
         # between publication and the freshness ack: a kill here must

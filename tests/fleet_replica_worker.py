@@ -41,10 +41,15 @@ def main() -> None:
     model = DeepFM(slot_names=SLOTS, emb_dim=DIM, hidden=())
     dense = model.init(jax.random.PRNGKey(0))
 
+    # PBX_FLEET_SHARD_REPLICAS > 1: the shared shard tier is replicated
+    # (the distributed-trace drill kills a shard primary under traffic
+    # and expects this replica's miss reads to fail over).
+    shard_replicas = int(os.environ.get("PBX_FLEET_SHARD_REPLICAS", "1"))
     server, manager = start_replica(
         model, feed,
         dense_params=dense,
         shard_endpoints=[e for e in shard_eps.split(",") if e],
+        shard_replicas=shard_replicas,
         hbm_rows=24, dim=DIM,
         elastic_root=elastic_root, host_id=host_id,
         warm_lines=["0 u:1 i:2", "0 u:3 i:4"],

@@ -1,0 +1,681 @@
+"""Fleet-wide distributed tracing (ISSUE 14 acceptance suite).
+
+Covers the four contract pillars:
+
+- **propagation** — a trace context minted at the client edge rides the
+  framed wire through router → replica batcher → shard tier (including
+  a forced read-failover hop) and through the training write path
+  (push → primary → synchronous backup forward): one trace id on every
+  hop's spans.
+- **merge** — per-process trace rings carry wall-clock anchors; the
+  ``trace_report --merge`` stitch produces ONE Perfetto trace with
+  per-process tracks and resolving cross-process flow arrows. The
+  3-process drill (router + 2 replica processes over a replicated
+  2-host shard tier) proves it against real processes, kill included.
+- **one-scrape telemetry** — every framed server answers
+  ``metrics_snapshot``; ShardServer's instance registry keeps per-host
+  counters separable, the replication-lag gauges are computed at scrape
+  time, and ``fleet_top --once --json`` reports per-replica p99 +
+  worst-slot lag in one sweep.
+- **zero cost** — tracing-on (context active) leaves the jitted train
+  step and serving forward op counts unchanged, and the disabled path
+  attaches nothing to the wire.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.core import monitor, telemetry_scrape, trace
+from paddlebox_tpu.distributed import rpc
+from paddlebox_tpu.embedding.table import TableConfig
+from paddlebox_tpu.multihost.shard_service import (start_local_shards,
+                                                   stop_shards)
+from paddlebox_tpu.multihost.store import MultiHostStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "fleet_replica_worker.py")
+DIM = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    trace.disable()
+    trace.clear()
+    yield
+    trace.disable()
+    trace.clear()
+
+
+class _EchoServer(rpc.FramedRPCServer):
+    service_name = "echo"
+
+    def handle_echo(self, req):
+        with trace.span("echo/inner"):
+            return {"x": req.get("x"),
+                    "ctx": trace.current_context()}
+
+    def handle_slow(self, req):
+        time.sleep(float(req.get("sleep_s", 0.5)))
+        return True
+
+
+# -- context + wire units ----------------------------------------------------
+
+
+def test_wire_context_off_is_none_and_attaches_nothing():
+    """Disabled path: no context minted, nothing on the wire, handler
+    sees no thread-local context."""
+    assert trace.wire_context() is None
+    srv = _EchoServer("127.0.0.1:0")
+    conn = rpc.FramedRPCConn(srv.endpoint, service_name="echo")
+    try:
+        out = conn.call("echo", x=1)
+        assert out["ctx"] is None
+        assert trace.snapshot() == []
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_context_scopes_and_ids():
+    trace.enable()
+    root = trace.wire_context()
+    assert set(root) == {"tid", "sid", "origin"}
+    assert ":" in root["origin"]
+    with trace.use_context(root):
+        child = trace.wire_context()
+        assert child["tid"] == root["tid"]          # same trace
+        assert child["sid"] != root["sid"]          # fresh span
+        assert trace.current_context() is root
+    assert trace.current_context() is None
+    sctx = trace.server_context(child)
+    assert sctx["tid"] == root["tid"]
+    assert sctx["parent"] == child["sid"]
+
+
+def test_rpc_propagation_server_ms_and_flow_linkage():
+    """One traced RPC: client span + server span share the trace id,
+    the server span's parent is the client span id (the flow-arrow
+    key), and the reply's _server_ms decomposes the client's observed
+    latency into server vs wire share."""
+    srv = _EchoServer("127.0.0.1:0")
+    trace.enable()
+    conn = rpc.FramedRPCConn(srv.endpoint, service_name="echo")
+    try:
+        out = conn.call("echo", x=2)
+        assert out["ctx"] is not None               # context crossed
+        assert conn.last_server_ms is not None
+        assert conn.last_wire_ms is not None and conn.last_wire_ms >= 0
+        evs = trace.snapshot()
+        by_name = {e["name"]: e for e in evs}
+        cli = by_name["rpc/client/echo"]
+        se = by_name["rpc/echo"]
+        inner = by_name["echo/inner"]
+        tid = cli["args"]["trace"]
+        assert se["args"]["trace"] == tid
+        assert inner["args"]["trace"] == tid        # nested span inherits
+        assert se["args"]["parent"] == cli["args"]["span"]
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_clock_offset_handshake_and_anchor():
+    """Tracing-on connects run the clock handshake: a same-machine peer
+    reports a near-zero offset, recorded per endpoint in the export's
+    otherData beside the wall anchor."""
+    srv = _EchoServer("127.0.0.1:0")
+    trace.enable()
+    conn = rpc.FramedRPCConn(srv.endpoint, service_name="echo")
+    try:
+        assert conn.clock_offset_ms is not None
+        assert abs(conn.clock_offset_ms) < 1000.0   # same machine
+        obj = trace.GLOBAL.trace_object()
+        od = obj["otherData"]
+        assert od["wall_anchor_ns"] > 0
+        assert od["pid"] == os.getpid()
+        assert srv.endpoint in od["peer_offsets_ms"]
+        assert monitor.get_gauge("rpc/clock_offset_ms") == \
+            conn.clock_offset_ms
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_rpc_retry_counters_labeled_by_method():
+    """The ride-along bugfix: reconnects/retries are counted per method
+    beside the totals, and a server restart consumes exactly the
+    budget the counters say."""
+    srv = _EchoServer("127.0.0.1:0")
+    ep = srv.endpoint
+    conn = rpc.FramedRPCConn(ep, service_name="echo",
+                             idempotent=("echo",))
+    base_re = monitor.get("rpc/retries/echo")
+    base_rc = monitor.get("rpc/reconnects/echo")
+    try:
+        conn.call("echo", x=1)
+        # Kill-like teardown, then a fresh server on the same port.
+        srv.stop()
+        srv.close_connections()
+        deadline = time.time() + 30
+        srv2 = None
+        while srv2 is None and time.time() < deadline:
+            try:
+                srv2 = _EchoServer(ep)
+            except OSError:
+                time.sleep(0.1)
+        assert srv2 is not None
+        out = conn.call("echo", x=2)    # retried through the reconnect
+        assert out["x"] == 2
+        assert monitor.get("rpc/retries/echo") > base_re
+        assert monitor.get("rpc/reconnects/echo") > base_rc
+    finally:
+        conn.close()
+        srv2.stop()
+
+
+def test_inflight_rpc_table_reaches_stall_forensics():
+    """The watchdog satellite: a call blocked on a slow peer shows up
+    in stall_forensics' inflight_rpcs with its endpoint, method, and
+    age — and unregisters on completion."""
+    srv = _EchoServer("127.0.0.1:0")
+    conn = rpc.FramedRPCConn(srv.endpoint, service_name="echo")
+    seen = {}
+
+    def run():
+        conn.call("slow", sleep_s=1.0)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        tab = rpc.inflight_table()
+        hit = [e for e in tab if e["method"] == "slow"]
+        if hit:
+            seen = hit[0]
+            break
+        time.sleep(0.02)
+    assert seen, "slow call never appeared in the inflight table"
+    assert seen["endpoint"] == srv.endpoint
+    assert seen["service"] == "echo"
+    fx = trace.stall_forensics()
+    assert any(e.get("method") == "slow"
+               for e in fx["inflight_rpcs"])
+    t.join(timeout=10)
+    assert not [e for e in rpc.inflight_table()
+                if e["method"] == "slow"]
+    conn.close()
+    srv.stop()
+
+
+# -- shard tier: instance metrics + replication lag ---------------------------
+
+
+def _shard_cluster(replicas=2, n_keys=400):
+    cfg = TableConfig(name="emb", dim=DIM, learning_rate=0.1)
+    servers, eps = start_local_shards(2, cfg, replicas=replicas)
+    store = MultiHostStore(cfg, eps, replicas=replicas)
+    keys = np.arange(1, n_keys + 1, dtype=np.uint64)
+    rows = store.pull_for_pass(keys)
+    store.push_from_pass(keys, rows)
+    if replicas > 1:
+        store.sync_replicas()
+    return cfg, servers, eps, store, keys
+
+
+def test_shard_server_instance_metrics_separate_per_host():
+    """Satellite 1: two in-process ShardServers no longer clobber each
+    other's multihost/* counters — each instance registry carries its
+    own served volume, and the scrape merge still totals them."""
+    cfg, servers, eps, store, keys = _shard_cluster(replicas=1)
+    try:
+        snaps = [telemetry_scrape.scrape_endpoint(ep, with_stats=False)
+                 for ep in eps]
+        per_host = [s["counters"].get("multihost/served_push_keys", 0)
+                    for s in snaps]
+        assert all(v > 0 for v in per_host), per_host
+        assert sum(per_host) == keys.size
+        # Per-host labels identify the shard.
+        assert {s["labels"]["shard"] for s in snaps} == {0, 1}
+        merged = monitor.merge_snapshots(snaps)
+        assert merged["counters"]["multihost/served_push_keys"] == \
+            keys.size
+    finally:
+        store.close()
+        stop_shards(servers)
+
+
+def test_replication_lag_gauge_under_held_back_backup():
+    """The journal-lag gauge: kill one host (its backup slots stop
+    acking), push N more mutations, and the surviving primary's scrape
+    reports worst lag >= N while a healthy pair reports 0."""
+    cfg, servers, eps, store, keys = _shard_cluster(replicas=2)
+    try:
+        snap = telemetry_scrape.scrape_endpoint(eps[1], with_stats=False)
+        assert snap["gauges"]["multihost/replica_lag_worst"] == 0.0
+        servers[0].kill()
+        owner = store.ranges.owner_of(keys)
+        held = keys[owner == 1]
+        rows = {f: v for f, v in store.pull_for_pass(held).items()}
+        n_push = 3
+        for _ in range(n_push):
+            store.push_from_pass(held, rows)
+        snap = telemetry_scrape.scrape_endpoint(eps[1], with_stats=False)
+        lag = snap["gauges"]["multihost/replica_lag_worst"]
+        assert lag >= n_push, lag
+        assert snap["gauges"]["multihost/replica_lag_p99"] >= n_push
+        # The lag rides the instance registry, scrapeable in one sweep.
+        rec = telemetry_scrape.scrape_cluster({"shard1": eps[1]})
+        row = rec["summary"][0]
+        assert row["replica_lag_worst"] >= n_push
+    finally:
+        store.close()
+        stop_shards(servers)
+
+
+def test_training_write_path_one_trace_id():
+    """Training writes: trainer push → primary → synchronous backup
+    forward all carry ONE trace id (the fan-out threads and the
+    server-side peer forward both propagate the context)."""
+    cfg, servers, eps, store, keys = _shard_cluster(replicas=2)
+    trace.enable()
+    try:
+        with trace.use_context(trace.wire_context()) as ctx:
+            rows = store.pull_for_pass(keys)
+            store.push_from_pass(keys, rows)
+        evs = trace.snapshot()
+        tid = ctx["tid"]
+        traced = {e["name"] for e in evs
+                  if (e.get("args") or {}).get("trace") == tid}
+        assert "rpc/client/push" in traced, traced
+        assert "rpc/push" in traced
+        # The synchronous backup forward is a hop of the SAME trace.
+        assert "rpc/client/replica_apply" in traced, traced
+        assert "rpc/replica_apply" in traced
+        assert "multihost/shard_push" in traced
+    finally:
+        store.close()
+        stop_shards(servers)
+
+
+# -- fleet_top / scrape -------------------------------------------------------
+
+
+def test_fleet_top_once_json_smoke(capsys):
+    """Tier-1 CLI smoke: fleet_top --once --json against any framed
+    server prints one parseable scrape record with summary + merged
+    sections and exits 0."""
+    from tools import fleet_top
+    srv = _EchoServer("127.0.0.1:0")
+    monitor.add("echo/requests", 1)  # graftlint: allow-registry(test-only name)
+    try:
+        rcode = fleet_top.main(["--targets", f"echo={srv.endpoint}",
+                                "--once", "--json"])
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        rec = json.loads(out)
+        assert rcode == 0
+        assert rec["summary"][0]["target"] == "echo"
+        assert rec["cluster"]["scraped"] == 1
+        assert "counters" in rec["merged"]
+    finally:
+        srv.stop()
+
+
+def test_fleet_top_unreachable_target_exits_nonzero(capsys):
+    from tools import fleet_top
+    rcode = fleet_top.main(["--targets", "gone=127.0.0.1:1",
+                            "--once", "--json", "--timeout", "2"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rcode == 1
+    assert "gone" in rec["errors"]
+
+
+# -- merge validity -----------------------------------------------------------
+
+
+def _fake_ring(events, wall_anchor_ns, pid, host="h"):
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"wall_anchor_ns": wall_anchor_ns,
+                          "host": host, "pid": pid,
+                          "peer_offsets_ms": {}}}
+
+
+def test_merge_traces_aligns_anchors_and_draws_flows(tmp_path):
+    """Merge mechanics on fabricated rings: wall anchors shift each
+    file onto one timeline, colliding pids are remapped to distinct
+    tracks, and client→server span pairs produce resolving flow
+    arrows."""
+    from tools.trace_report import merge_files
+    cli_ev = {"name": "rpc/client/echo", "ph": "X", "pid": 7, "tid": 1,
+              "ts": 100.0, "dur": 900.0,
+              "args": {"trace": "t1", "span": "a.1"}}
+    srv_ev = {"name": "rpc/echo", "ph": "X", "pid": 7, "tid": 9,
+              "ts": 50.0, "dur": 500.0,
+              "args": {"trace": "t1", "span": "b.1", "parent": "a.1"}}
+    t0 = 1_000_000_000_000_000_000
+    p1 = tmp_path / "a.trace.json"
+    p2 = tmp_path / "b.trace.json"
+    p1.write_text(json.dumps(_fake_ring([cli_ev], t0, 7, "hostA")))
+    # Second process: same pid (collision), anchor 1 ms later.
+    p2.write_text(json.dumps(_fake_ring([srv_ev], t0 + 1_000_000, 7,
+                                        "hostB")))
+    out = tmp_path / "merged.json"
+    merged = merge_files([str(p1), str(p2)], str(out))
+    evs = merged["traceEvents"]
+    xs = [e for e in evs if e.get("ph") == "X"]
+    pids = {e["pid"] for e in xs}
+    assert len(pids) == 2                       # collision remapped
+    cli = next(e for e in xs if e["name"] == "rpc/client/echo")
+    srv = next(e for e in xs if e["name"] == "rpc/echo")
+    # Anchor alignment: file B's events shifted +1 ms.
+    assert srv["ts"] == pytest.approx(50.0 + 1000.0)
+    assert cli["ts"] == pytest.approx(100.0)
+    # Flow arrows: one s->f pair, binding client start to server start.
+    flows = [e for e in evs if e.get("ph") in ("s", "f")]
+    assert merged["otherData"]["flow_arrows"] == 1
+    starts = {e["id"]: e for e in flows if e["ph"] == "s"}
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert len(starts) == len(finishes) == 1
+    f = finishes[0]
+    s = starts[f["id"]]
+    assert (s["pid"], s["tid"]) == (cli["pid"], cli["tid"])
+    assert (f["pid"], f["tid"]) == (srv["pid"], srv["tid"])
+    assert s["ts"] <= f["ts"]
+    # Per-process tracks are named.
+    names = {e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert any("hostA" in n for n in names)
+    assert any("hostB" in n for n in names)
+    # The merged file is a valid Chrome trace (loadable JSON object).
+    loaded = json.loads(out.read_text())
+    assert isinstance(loaded["traceEvents"], list)
+
+
+# -- zero-cost pin ------------------------------------------------------------
+
+
+def test_tracing_on_leaves_serving_forward_and_step_unchanged():
+    """The jaxpr pin: with tracing enabled AND a trace context active,
+    the serving forward and the jitted train step trace to identical
+    op counts — the context is host-side metadata, never a device op."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.data.parser import parse_lines
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch, SlotConf
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.serving.batcher import pack_bucketed
+    from paddlebox_tpu.serving.predictor import CTRPredictor
+    from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
+    from paddlebox_tpu.utils import inspect as pbx_inspect
+
+    slots = ("u", "i")
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in slots),
+        batch_size=8)
+    model = DeepFM(slot_names=slots, emb_dim=DIM, hidden=())
+    dense = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    keys = np.arange(1, 33, dtype=np.uint64)
+    emb = rng.normal(size=(32, DIM)).astype(np.float32)
+    w = rng.normal(size=(32,)).astype(np.float32)
+    pred = CTRPredictor(model, feed, keys, emb, w, dense,
+                        compute_dtype="float32")
+    batch = pack_bucketed(
+        parse_lines(["0 u:3 i:4", "1 u:5 i:6"], feed), feed)
+
+    def fwd_op_counts():
+        caps = {n: batch.ids[n].shape[0] for n in pred._slot_names}
+        all_ids = np.concatenate(
+            [batch.ids[n] for n in pred._slot_names])
+        looked = pred._index.lookup(all_ids)
+        rows = np.where(looked < 0, pred._table.shape[0] - 1,
+                        looked).astype(np.int32)
+        fwd = pred._build_fwd(caps, batch.batch_size, 0)
+        segs = {n: jnp.asarray(batch.segments[n])
+                for n in pred._slot_names}
+        return pbx_inspect.jaxpr_summary(
+            lambda *a: fwd(*a), pred._table, pred._zero_miss,
+            pred._dense_params, rows, segs,
+            jnp.asarray(_concat_dense_host(batch)))
+
+    off = fwd_op_counts()
+    trace.enable()
+    with trace.use_context(trace.wire_context()):
+        on = fwd_op_counts()
+    assert on == off, (on, off)
+
+    # Train step: same pin through the trainer build (the serving
+    # forward covers the predict path; this covers the fleet's write
+    # producer).
+    from paddlebox_tpu.embedding import DeviceFeatureStore
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+    def step_op_counts():
+        mesh = build_mesh(HybridTopology(dp=4),
+                          devices=jax.devices()[:4])
+        tr = CTRTrainer(model, feed, TableConfig(dim=DIM),
+                        mesh=mesh,
+                        config=TrainerConfig(auc_num_buckets=1 << 10),
+                        store_factory=lambda c: DeviceFeatureStore(
+                            c, mesh=mesh))
+        tr.init(seed=0)
+        lines = [f"{i % 2} u:{3 + i} i:{4 + i}" for i in range(8)]
+        b = SlotBatch.pack_sharded(parse_lines(lines, feed), feed, 4)
+        tr.engine.feed_pass([
+            np.unique(np.concatenate([b.ids[n] for n in g.slots]))
+            for g in tr.engine.groups])
+        step = tr._build_step()
+        tables = tr.engine.begin_pass()
+        rows = tr._map_batch_rows(b)
+        segs = {n: jnp.asarray(b.segments[n]) for n in b.ids}
+        args = (tables, tr.params, tr.opt_state, tr.auc_state, rows,
+                segs, jnp.asarray(b.labels), jnp.asarray(b.valid),
+                jnp.asarray(_concat_dense_host(b)),
+                jnp.zeros((), jnp.int32))
+        return pbx_inspect.jaxpr_summary(lambda *a: step(*a), *args)
+
+    trace.disable()
+    step_off = step_op_counts()
+    trace.enable()
+    with trace.use_context(trace.wire_context()):
+        step_on = step_op_counts()
+    assert step_on == step_off, (step_on, step_off)
+
+
+# -- the 3-process acceptance drill -------------------------------------------
+
+
+def _spawn_replica(elastic_root, host_id, shard_eps, ready_file,
+                   trace_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["FLAGS_trace_path"] = trace_path
+    env["PBX_FLEET_SHARD_REPLICAS"] = "2"
+    env.pop("PBX_RANK", None)
+    return subprocess.Popen(
+        [sys.executable, WORKER, elastic_root, host_id,
+         ",".join(shard_eps), ready_file],
+        cwd=REPO, env=env, start_new_session=True)
+
+
+def _wait_file(path, timeout=180.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return f.read().strip()
+        time.sleep(0.1)
+    raise TimeoutError(f"worker never wrote {path}")
+
+
+def test_three_process_trace_drill(tmp_path, capsys):
+    """The acceptance drill: router + 2 replica PROCESSES over a
+    replicated 2-host shard tier. One predict's trace id spans client,
+    router, replica, and shard hops — including a forced read-failover
+    after a shard-host kill — across the MERGED per-process trace; and
+    one fleet_top scrape reports per-replica p99 + worst-slot
+    replication lag."""
+    from paddlebox_tpu.serving.router import FleetRouter
+    from paddlebox_tpu.serving.service import PredictClient
+    from tools.trace_report import merge_files
+
+    cfg = TableConfig(name="emb", dim=DIM, learning_rate=0.1)
+    shard_servers, shard_eps = start_local_shards(2, cfg, replicas=2)
+    store = MultiHostStore(cfg, shard_eps, replicas=2)
+    keys = np.arange(1, 801, dtype=np.uint64)
+    rows = store.pull_for_pass(keys)
+    rng = np.random.default_rng(3)
+    rows["emb"] = rng.normal(size=(keys.size, DIM)).astype(np.float32) * .02
+    rows["w"] = rng.normal(size=(keys.size,)).astype(np.float32) * .02
+    store.push_from_pass(keys, rows)
+    store.sync_replicas()
+    owner = store.ranges.owner_of(keys)
+    slot0 = keys[owner == 0]
+    assert slot0.size >= 8
+
+    root = str(tmp_path / "elastic")
+    procs = {}
+    router = None
+    cli = None
+    prev_hb = flagmod.flag("fleet_health_interval_s")
+    flagmod.set_flags({"fleet_health_interval_s": 0.2})
+    traces = {h: str(tmp_path / f"{h}.trace.json")
+              for h in ("repA", "repB")}
+    try:
+        for hid in ("repA", "repB"):
+            procs[hid] = _spawn_replica(root, hid, shard_eps,
+                                        str(tmp_path / f"{hid}.ep"),
+                                        traces[hid])
+        eps = {hid: _wait_file(str(tmp_path / f"{hid}.ep"))
+               for hid in ("repA", "repB")}
+        router = FleetRouter("127.0.0.1:0", elastic_root=root)
+        deadline = time.time() + 120
+        while router.fleet.size() < 2 and time.time() < deadline:
+            time.sleep(0.1)
+        assert router.fleet.size() >= 2, router.fleet.replicas()
+
+        trace.enable()
+        cli = PredictClient(router.endpoint)
+        # Warm hops (also warms each replica's conns).
+        cli.predict([f"0 u:{slot0[0]} i:{slot0[1]}"])
+        # Forced read-failover: kill shard host 0 (primary of slot 0),
+        # then predict FRESH slot-0 keys — every replica's miss must
+        # fail over to the surviving backup.
+        shard_servers[0].kill()
+        probe = [f"0 u:{slot0[-1]} i:{slot0[-2]}"]
+        out = cli.predict(probe)
+        assert out.shape == (1,)
+        assert cli.last_hop is not None and "route_ms" in cli.last_hop
+        tid = None
+        for e in reversed(trace.snapshot()):
+            if e["name"] == "rpc/client/predict":
+                tid = e["args"]["trace"]
+                break
+        assert tid is not None
+
+        # Collect every process's ring: workers via the trace_export
+        # RPC, the parent (client + router + shard tier) directly.
+        files = []
+        for hid, ep in eps.items():
+            c = rpc.FramedRPCConn(ep, service_name="collect")
+            got = c.call("trace_export", path=traces[hid])
+            c.close()
+            assert got["events"] > 0
+            files.append(traces[hid])
+        parent_trace = str(tmp_path / "parent.trace.json")
+        trace.GLOBAL.export(parent_trace)
+        files.append(parent_trace)
+
+        merged = merge_files(files, str(tmp_path / "fleet.trace.json"))
+        evs = merged["traceEvents"]
+        xs = [e for e in evs if e.get("ph") == "X"]
+        hop_evs = [e for e in xs
+                   if (e.get("args") or {}).get("trace") == tid]
+        hop_pids = {e["pid"] for e in hop_evs}
+        hop_names = {e["name"] for e in hop_evs}
+        # ONE trace id across processes: the parent's client+router
+        # spans AND a replica process's server-side spans.
+        assert len(hop_pids) >= 2, (hop_pids, hop_names)
+        assert "rpc/client/predict" in hop_names
+        assert "rpc/predict" in hop_names
+        assert "serving/predict" in hop_names
+        # The shard hop (miss resolution) rides the same id.
+        assert "rpc/client/pull_serving" in hop_names, hop_names
+        # The forced failover hop is recorded under a trace id that the
+        # parent's predicts minted (the batcher may coalesce, so match
+        # any client-minted id).
+        cli_tids = {e["args"]["trace"] for e in xs
+                    if e["name"] == "rpc/client/predict"}
+        fo = [e for e in evs
+              if e.get("name") == "multihost/replica_failover"]
+        assert fo, "no failover hop recorded"
+        assert any((e.get("args") or {}).get("trace") in cli_tids
+                   for e in fo)
+        # Merged-trace validity: per-track timestamps are finite and
+        # flow arrows resolve start-before-finish within clock-skew
+        # tolerance (same machine).
+        assert all(e["ts"] >= 0 for e in xs)
+        flows = [e for e in evs if e.get("ph") in ("s", "f")]
+        assert merged["otherData"]["flow_arrows"] > 0
+        starts = {}
+        for e in flows:
+            if e["ph"] == "s":
+                starts.setdefault(e["id"], e)
+        for e in flows:
+            if e["ph"] == "f":
+                assert e["id"] in starts, e
+                assert starts[e["id"]]["ts"] <= e["ts"] + 50_000, e
+
+        # One-scrape cluster telemetry over the LIVE fleet — through
+        # the fleet_top CLI itself: per-replica p99 + worst-slot
+        # replication lag in ONE scrape. Push a held-back mutation
+        # first so the lag is visible (shard host 0 is dead, so the
+        # slot-1 primary's backup stops acking).
+        from tools import fleet_top
+        held = keys[owner == 1][:64]
+        store.push_from_pass(held, store.pull_for_pass(held))
+        rcode = fleet_top.main(["--router", router.endpoint,
+                                "--shards", shard_eps[1],
+                                "--once", "--json"])
+        assert rcode == 0
+        rec = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        rows_by_target = {r["target"]: r for r in rec["summary"]}
+        rep_rows = [r for t, r in rows_by_target.items()
+                    if t.startswith("replica:")]
+        # Per-replica p99 for every replica that served traffic (hash
+        # affinity may leave one replica idle — an idle digest has no
+        # quantiles, correctly).
+        assert len(rep_rows) == 2, rows_by_target
+        assert any("predict_p99_ms" in r for r in rep_rows), \
+            rows_by_target
+        assert rows_by_target["shard0"]["replica_lag_worst"] >= 1
+        assert rec["cluster"]["fleet_predict_p99_ms"] is not None
+        assert rec["cluster"]["replica_lag_worst"] >= 1
+    finally:
+        flagmod.set_flags({"fleet_health_interval_s": prev_hb})
+        if cli is not None:
+            cli.close()
+        if router is not None:
+            router.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    p.kill()
+                p.wait(timeout=30)
+        store.close()
+        stop_shards(shard_servers)

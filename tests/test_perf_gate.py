@@ -427,3 +427,37 @@ def test_online_freshness_direction_and_gating(tmp_path):
     assert perf_gate.main(
         [_write(tmp_path, "online_bad_pph.json", bad),
          "--baseline", b]) == 1
+
+
+def test_telemetry_overhead_direction_and_gating(tmp_path):
+    """Round-19 distributed-tracing keys: the bench serve/multihost
+    `telemetry` record gates the off-vs-on overhead fraction as
+    lower-better (tracing that gets expensive gets turned off exactly
+    when it is needed) and the absolute off/on rates as higher-better;
+    the scrape count is workload provenance and never gates."""
+    assert perf_gate.direction(
+        "telemetry.telemetry_overhead_frac") == -1
+    assert perf_gate.direction("telemetry.trace_off_rps") == 1
+    assert perf_gate.direction("telemetry.trace_on_rps") == 1
+    assert perf_gate.direction(
+        "telemetry.trace_on_keys_per_s") == 1
+    assert perf_gate.direction("telemetry.scrapes") == 0
+    base = {"metric": "serve_clients_rps", "value": 1900.0,
+            "telemetry": {"telemetry_overhead_frac": 0.02,
+                          "trace_off_rps": 1900.0,
+                          "trace_on_rps": 1860.0,
+                          "scrapes": 40}}
+    b = _write(tmp_path, "tel_base.json", base)
+    assert perf_gate.main(
+        [_write(tmp_path, "tel_same.json", base), "--baseline", b]) == 0
+    # Fewer scrapes (a shorter window) never gates.
+    ok = copy.deepcopy(base)
+    ok["telemetry"]["scrapes"] = 4
+    assert perf_gate.main(
+        [_write(tmp_path, "tel_ok.json", ok), "--baseline", b]) == 0
+    # Tracing got expensive: the overhead fraction trips the gate.
+    bad = copy.deepcopy(base)
+    bad["telemetry"]["telemetry_overhead_frac"] = 0.4
+    bad["telemetry"]["trace_on_rps"] = 1150.0
+    assert perf_gate.main(
+        [_write(tmp_path, "tel_bad.json", bad), "--baseline", b]) == 1

@@ -1,0 +1,142 @@
+"""fleet_top: live one-scrape telemetry view of the whole cluster.
+
+`top` for the serving/shard fleet: every framed service answers a
+``metrics_snapshot`` RPC (PredictServer replicas, ShardServer hosts,
+the FleetRouter — each with its instance registry), and this tool
+scrapes them ALL in one sweep (``core/telemetry_scrape.py``), folds
+them through ``monitor.merge_snapshots``, and renders one table —
+per-replica predict p99 / rps / SLO breaches, per-shard served volume
+and worst/p99 replication journal lag, router hop decomposition
+(route / wire / replica-server ms), and per-process rpc
+reconnect/retry totals.
+
+    # live view, replicas discovered through the router's topology RPC
+    python tools/fleet_top.py --router 127.0.0.1:7100 \
+        --shards 127.0.0.1:7200,127.0.0.1:7201
+
+    # one scrape, machine-readable (the tier-1 smoke)
+    python tools/fleet_top.py --targets rep0=127.0.0.1:7300 --once --json
+
+    # record a JSONL timeline while watching
+    python tools/fleet_top.py --router ... --record /tmp/fleet.jsonl
+
+No jax import — runs anywhere the cluster network is reachable.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_targets(args) -> dict:
+    from paddlebox_tpu.core import telemetry_scrape as ts
+    targets = {}
+    if args.router:
+        try:
+            targets.update(ts.discover_router_targets(
+                args.router, timeout=args.timeout))
+        except (OSError, ConnectionError, RuntimeError) as e:
+            targets["router"] = args.router
+            print(f"fleet_top: router discovery failed: {e!r}",
+                  file=sys.stderr)
+    for i, ep in enumerate(e for e in (args.shards or "").split(",") if e):
+        targets[f"shard{i}"] = ep
+    for t in args.targets or ():
+        if "=" not in t:
+            raise SystemExit(f"--targets wants LABEL=ENDPOINT, got {t!r}")
+        label, ep = t.split("=", 1)
+        targets[label] = ep
+    if not targets:
+        raise SystemExit(
+            "no targets: pass --router and/or --shards and/or --targets")
+    return targets
+
+
+_COLS = (("target", 16, "{}"), ("throughput_rps", 9, "{:.1f}"),
+         ("predict_p99_ms", 9, "{:.2f}"), ("slo_violations", 5, "{}"),
+         ("replica_lag_worst", 6, "{:.0f}"),
+         ("replica_lag_p99", 7, "{:.0f}"), ("shard_rows", 10, "{:.0f}"),
+         ("routed", 8, "{}"), ("hop_wire_p99_ms", 9, "{:.2f}"),
+         ("rpc_reconnects", 6, "{}"), ("rpc_retries", 6, "{}"))
+
+_HEADS = {"target": "target", "throughput_rps": "rps",
+          "predict_p99_ms": "p99_ms", "slo_violations": "slo",
+          "replica_lag_worst": "lag_w", "replica_lag_p99": "lag_p99",
+          "shard_rows": "rows", "routed": "routed",
+          "hop_wire_p99_ms": "wire_p99", "rpc_reconnects": "reconn",
+          "rpc_retries": "retry"}
+
+
+def render(rec: dict, *, clear: bool) -> None:
+    if clear:
+        sys.stdout.write("\x1b[H\x1b[2J")
+    c = rec["cluster"]
+    head = (f"fleet_top  {time.strftime('%H:%M:%S', time.localtime(rec['ts']))}"
+            f"  targets={c['scraped']}/{c['scraped'] + c['unreachable']}")
+    for k, label in (("fleet_predict_p99_ms", "fleet p99"),
+                     ("fleet_route_p99_ms", "route p99"),
+                     ("replica_lag_worst", "worst lag")):
+        v = c.get(k)
+        if v is not None:
+            head += f"  {label}={v:g}"
+    print(head)
+    hdr = " ".join(f"{_HEADS[name]:>{w}}" for name, w, _ in _COLS)
+    print(hdr)
+    print("-" * len(hdr))
+    for row in rec["summary"]:
+        cells = []
+        for name, w, fmt in _COLS:
+            v = row.get(name)
+            cells.append(f"{fmt.format(v) if v is not None else '-':>{w}}")
+        print(" ".join(cells))
+    for label, err in rec.get("errors", {}).items():
+        print(f"{label:>16} UNREACHABLE {err}")
+    sys.stdout.flush()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--router", help="FleetRouter endpoint: scraped AND "
+                    "used to discover replica targets (topology RPC)")
+    ap.add_argument("--shards", help="comma-separated ShardServer "
+                    "endpoints")
+    ap.add_argument("--targets", action="append", metavar="LABEL=EP",
+                    help="explicit extra target, repeatable")
+    ap.add_argument("--once", action="store_true",
+                    help="one scrape, then exit")
+    ap.add_argument("--json", action="store_true",
+                    help="print the scrape as JSON (summary + cluster + "
+                         "merged) instead of the table")
+    ap.add_argument("--record", metavar="PATH",
+                    help="append each scrape's summary to this JSONL")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between scrapes (default 2)")
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help="per-target RPC timeout (default 10)")
+    args = ap.parse_args(argv)
+
+    from paddlebox_tpu.core import telemetry_scrape as ts
+    first = True
+    while True:
+        targets = build_targets(args)
+        rec = ts.scrape_cluster(targets, timeout=args.timeout)
+        if args.record:
+            ts.record_jsonl(args.record, rec)
+        if args.json:
+            out = {k: rec[k] for k in ("ts", "targets", "summary",
+                                       "cluster", "errors", "merged")}
+            print(json.dumps(out, default=str))
+        else:
+            render(rec, clear=not first and not args.once)
+        if args.once:
+            return 0 if not rec["errors"] else 1
+        first = False
+        try:
+            time.sleep(max(args.interval, 0.2))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

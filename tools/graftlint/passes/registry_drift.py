@@ -41,7 +41,12 @@ _METRIC_APIS = {"monitor.add": 0, "monitor.set_stat": 0,
                 "monitor.set_gauge": 0, "monitor.observe": 0,
                 "monitor.observe_quantile": 0,
                 "add": 0, "set_stat": 0, "set_gauge": 0,
-                "observe": 0, "observe_quantile": 0}
+                "observe": 0, "observe_quantile": 0,
+                # Instance-mirror helpers (ShardServer / FleetRouter
+                # bump their per-server registry AND the global through
+                # one call) — a metric name reaching only these is
+                # still a registered name.
+                "_bump": 0, "_set_gauge": 0, "_observe_q": 0}
 # Trace span/instant/counter names share the doc namespace (the
 # OBSERVABILITY.md "built-in span names" list): collect them so a doc
 # span entry isn't misread as a stale metric — and so a new slash-named

@@ -67,7 +67,11 @@ LOWER_SUFFIXES = ("_ms", "_s", "_bytes", "idle_frac",
                   "overflow",
                   # serving fleet: a growing degraded-path share means
                   # the SLO-shed path is serving more of the traffic.
-                  "degraded_frac")
+                  "degraded_frac",
+                  # distributed tracing (r19): the rps/keys-per-s cost
+                  # of running with the span ring + cluster scrape ON —
+                  # telemetry that gets expensive gets turned off.
+                  "overhead_frac")
 # Exact-name entries (dotted-path last segment).
 HIGHER_NAMES = ("value",)  # bench headline — every config is throughput
 # graftlint summary JSON (python -m tools.graftlint --summary): finding
@@ -247,6 +251,14 @@ def smoke() -> int:
             "post_shrink_store_rows": 31000,
             "stream_passes": 12,
             "events": 49152,
+            # distributed-tracing overhead keys (r19): the off-vs-on
+            # delta gates lower-better ("overhead_frac"), the absolute
+            # rates higher-better ("_rps"/"_per_s"); scrape count is
+            # workload provenance and must NOT gate.
+            "telemetry": {"telemetry_overhead_frac": 0.02,
+                          "trace_off_rps": 1900.0,
+                          "trace_on_rps": 1860.0,
+                          "scrapes": 40},
             "steps_per_dispatch": 4,        # not gated (count)
             "ingest_workers": 8,            # not gated (count)
             "store_build_native": True,     # not gated (bool)
@@ -295,6 +307,8 @@ def smoke() -> int:
     bad["passes_per_hour"] = 80.0
     bad["post_shrink_store_rows"] = 500000    # lifecycle stopped bounding
     bad["stream_passes"] = 2                  # provenance: must NOT gate
+    bad["telemetry"]["telemetry_overhead_frac"] = 0.4  # tracing got costly
+    bad["telemetry"]["scrapes"] = 3           # provenance: must NOT gate
     _, regs = compare(bad, base)
     names = {r["metric"] for r in regs}
     for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
@@ -309,12 +323,13 @@ def smoke() -> int:
                  "replicas.r2.degraded_frac",
                  "event_to_servable_ms.p99",
                  "passes_per_hour",
-                 "post_shrink_store_rows"):
+                 "post_shrink_store_rows",
+                 "telemetry.telemetry_overhead_frac"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
     for never in ("ingest_workers", "store_build_native",
                   "reshard_moved_rows", "replicas.r2.clients",
-                  "stream_passes", "events"):
+                  "stream_passes", "events", "telemetry.scrapes"):
         expect(f"provenance {never!r} not gated", never in names, False)
     # An IMPROVEMENT must never trip the gate.
     good = json.loads(json.dumps(base))

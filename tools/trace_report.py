@@ -10,10 +10,21 @@ percentiles from the newest metrics snapshot.
     python tools/trace_report.py /tmp/run.trace.json
     python tools/trace_report.py --metrics /tmp/run.metrics.jsonl
     python tools/trace_report.py trace.json --metrics m.jsonl --top 15
+
+Cross-process merge (``--merge``): stitch N per-process trace files
+(each exported by ``core/trace.py`` with its wall-clock anchor and
+peer clock offsets in ``otherData``) into ONE Perfetto-loadable trace —
+per-process tracks on a single wall-aligned timeline, plus flow arrows
+binding each RPC client span to its server span (the ``span``/``parent``
+ids the distributed trace context stamps on ``rpc/*`` spans):
+
+    python tools/trace_report.py --merge /tmp/fleet.trace.json \
+        router.trace.json replica0.trace.json shard0.trace.json
 """
 
 import argparse
 import json
+import os
 import sys
 from collections import defaultdict
 
@@ -178,19 +189,127 @@ def report_metrics(path: str) -> None:
             print(f"{name:<44} {v:>14}")
 
 
+def merge_traces(objs, names=None) -> dict:
+    """Stitch per-process trace objects into ONE Chrome/Perfetto trace.
+
+    - Every file's events shift onto a single wall-clock timeline via
+      its ``otherData.wall_anchor_ns`` (unix ns at that ring's ts 0);
+      the earliest anchor becomes global ts 0. Files without an anchor
+      (legacy exports) keep their local timeline at offset 0.
+    - Each file keeps its own process track (pids colliding across
+      files — in-process drills exporting multiple rings — are
+      remapped), named ``host:pid (filename)``.
+    - Flow arrows: an event whose ``args.parent`` matches another
+      event's ``args.span`` gets a Chrome flow ``s``→``f`` pair (the
+      RPC client→server hop the distributed trace context stamps), so
+      Perfetto draws the request's path across process tracks.
+    """
+    names = names or [f"trace{i}" for i in range(len(objs))]
+    anchors = []
+    for obj in objs:
+        od = obj.get("otherData") or {}
+        anchors.append(int(od.get("wall_anchor_ns") or 0))
+    known = [a for a in anchors if a]
+    t0 = min(known) if known else 0
+    merged = []
+    used_pids = set()
+    span_index = {}   # span id -> (pid, tid, ts)
+    file_meta = []
+    for i, obj in enumerate(objs):
+        od = obj.get("otherData") or {}
+        shift_us = (anchors[i] - t0) / 1e3 if anchors[i] else 0.0
+        events = obj.get("traceEvents", obj
+                         if isinstance(obj, list) else [])
+        orig_pids = {e.get("pid", 0) for e in events}
+        pid_map = {}
+        for p in sorted(orig_pids):
+            np_ = p
+            while np_ in used_pids:
+                np_ = (np_ or 1) + 100000
+            pid_map[p] = np_
+            used_pids.add(np_)
+        label = (f"{od.get('host', '?')}:{od.get('pid', '?')} "
+                 f"({os.path.basename(str(names[i]))})")
+        for p in sorted(set(pid_map.values())):
+            merged.append({"name": "process_name", "ph": "M", "pid": p,
+                           "args": {"name": label}})
+        file_meta.append({"file": str(names[i]), "label": label,
+                          "wall_anchor_ns": anchors[i],
+                          "shift_us": round(shift_us, 3),
+                          "peer_offsets_ms": od.get("peer_offsets_ms",
+                                                    {})})
+        for e in events:
+            e = dict(e)
+            e["pid"] = pid_map.get(e.get("pid", 0), e.get("pid", 0))
+            if "ts" in e:
+                e["ts"] = e["ts"] + shift_us
+            merged.append(e)
+            a = e.get("args") or {}
+            if e.get("ph") == "X" and a.get("span"):
+                span_index[str(a["span"])] = (e["pid"], e.get("tid", 0),
+                                              e["ts"])
+    flows = []
+    for e in merged:
+        a = e.get("args") or {}
+        parent = a.get("parent")
+        if e.get("ph") != "X" or not parent:
+            continue
+        src = span_index.get(str(parent))
+        if src is None:
+            continue
+        fid = f"{a.get('trace', '')}:{parent}"
+        flows.append({"name": "rpc", "cat": "rpc", "ph": "s",
+                      "id": fid, "pid": src[0], "tid": src[1],
+                      "ts": src[2]})
+        flows.append({"name": "rpc", "cat": "rpc", "ph": "f", "bp": "e",
+                      "id": fid, "pid": e["pid"],
+                      "tid": e.get("tid", 0), "ts": e["ts"]})
+    return {"traceEvents": merged + flows,
+            "displayTimeUnit": "ms",
+            "otherData": {"merged_from": file_meta,
+                          "flow_arrows": len(flows) // 2}}
+
+
+def merge_files(paths, out_path: str) -> dict:
+    objs = []
+    for p in paths:
+        with open(p) as f:
+            objs.append(json.load(f))
+    merged = merge_traces(objs, names=list(paths))
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out_path)
+    meta = merged["otherData"]
+    print(f"merged {len(paths)} trace file(s) -> {out_path} "
+          f"({len(merged['traceEvents'])} events, "
+          f"{meta['flow_arrows']} flow arrows)")
+    return merged
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", nargs="?", help="Chrome trace JSON "
-                    "(FLAGS_trace_path output)")
+    ap.add_argument("trace", nargs="*", help="Chrome trace JSON "
+                    "(FLAGS_trace_path output); several with --merge")
     ap.add_argument("--metrics", help="metrics JSONL "
                     "(FLAGS_metrics_path output)")
     ap.add_argument("--top", type=int, default=20,
                     help="max span rows (default 20)")
+    ap.add_argument("--merge", metavar="OUT",
+                    help="stitch the given trace files into ONE "
+                         "Perfetto trace at OUT (wall-aligned process "
+                         "tracks + cross-process flow arrows)")
     args = ap.parse_args(argv)
+    if args.merge:
+        if not args.trace:
+            ap.error("--merge needs at least one input trace file")
+        merge_files(args.trace, args.merge)
+        report_trace(args.merge, args.top)
+        return 0
     if not args.trace and not args.metrics:
         ap.error("pass a trace file and/or --metrics")
-    if args.trace:
-        report_trace(args.trace, args.top)
+    for t in args.trace:
+        report_trace(t, args.top)
     if args.metrics:
         report_metrics(args.metrics)
     return 0
