@@ -553,6 +553,27 @@ def bench_deepfm() -> dict:
         stats = trainer.train_pass(dataset)
         t_pass = time.perf_counter() - t0
 
+        # Opt-in slot-importance block (--slot-auc[=s0,s1,...]): the
+        # AUC-runner slot-replacement eval on the freshly trained
+        # model over the timed pass's (still-loaded) data — per-slot
+        # AUC degradation becomes a recorded artifact + quality/
+        # slot_auc gauges instead of a print. Untimed by construction:
+        # every perf number above is already captured.
+        slot_auc_block = None
+        if SLOT_AUC is not None:
+            from paddlebox_tpu.train.auc_runner import \
+                slot_replacement_eval
+            names = SLOT_AUC or [f"s{i}"
+                                 for i in range(min(4, NUM_SLOTS))]
+            _tick("deepfm:slot_auc")
+            sa = slot_replacement_eval(trainer, dataset, slots=names)
+            slot_auc_block = {
+                "base_auc": round(float(sa["base_auc"]), 5),
+                "ranking": sa["ranking"],
+                "slots": {n: {"auc": round(v["auc"], 5),
+                              "drop": round(v["auc_drop"], 5)}
+                          for n, v in sa["slots"].items()}}
+
     n_samples = N_BATCHES * BATCH
     e2e = n_samples / (t_load + t_pass)
     tm = trainer.timers
@@ -630,6 +651,8 @@ def bench_deepfm() -> dict:
         "lookup_overflow": _overflow_guard(stats),
         "lookup_exchange_bytes": int(stats["lookup_exchange_bytes"]),
         "scale_sparse_grad_by_batch": stats["scale_sparse_grad_by_batch"],
+        **({"slot_auc": slot_auc_block}
+           if slot_auc_block is not None else {}),
         "n_devices": ndev,
     }
 
@@ -1089,6 +1112,12 @@ if _SMALL:
 
 # Parsed from --clients by main(): comma-separated client counts for the
 # concurrent wire-mode serving bench ("" = skip the wire section).
+# `bench.py deepfm --slot-auc[=s0,s1,...]` opt-in: run the AUC-runner
+# slot-replacement eval on the trained model after the timed pass and
+# record per-slot AUC degradation (None = off; [] = default first-4
+# slots; a list = exactly those slots). Untimed — it runs after every
+# perf number is captured.
+SLOT_AUC = None
 SERVE_CLIENTS = ""
 # `bench.py serve --replicas 1,2` fleet axis ("" = skip): fresh fleet
 # (R PredictServers + FleetRouter) per count over ONE shared predictor
@@ -1759,7 +1788,7 @@ def bench_online() -> dict:
 
     from paddlebox_tpu.core import flags as flagmod
     prev = {k: flagmod.flag(k) for k in
-            ("stream_pass_events", "table_ttl_days")}
+            ("stream_pass_events", "table_ttl_days", "quality_collect")}
     out_rows = {}
     with tempfile.TemporaryDirectory() as tmpdir:
         log_dir = os.path.join(tmpdir, "events")
@@ -1772,7 +1801,11 @@ def bench_online() -> dict:
             flagmod.set_flags({
                 "stream_pass_events":
                     ONLINE_PASS_FILES * ONLINE_ROWS_PER_FILE,
-                "table_ttl_days": 1})
+                "table_ttl_days": 1,
+                # Model-quality plane ON for the streamed run: per-pass
+                # COPC/calibration + slot health + drift alarms ride
+                # the same replay (the "quality" record block below).
+                "quality_collect": True})
             _tick("online:stream")
             t0 = time.perf_counter()
             passes = 0
@@ -1791,6 +1824,31 @@ def bench_online() -> dict:
     events = ONLINE_DAYS * ONLINE_FILES_PER_DAY * ONLINE_ROWS_PER_FILE
     fresh = runner.freshness_quantiles() or {}
     eps = events / wall
+    # Model-quality record (core/quality.py, collected per carved
+    # pass): headline COPC + the per-pass calibration-error p99 from
+    # the registry digest, total drift alarms, the worst slot's
+    # example coverage, and the data-shape provenance (skew/churn —
+    # recorded, never gated).
+    from paddlebox_tpu.core import monitor as _mon
+    snap = _mon.snapshot()
+    cal_d = _mon.GLOBAL.quantile_digest("quality/calibration_error")
+    slot_covs = [v for k, v in snap.items()
+                 if k.startswith("quality/slot_coverage/")]
+    quality_block = {
+        "copc": round(float(snap.get("quality/copc", float("nan"))), 4),
+        "calibration_error": (
+            {"p99": round(cal_d.quantile(0.99), 5)}
+            if cal_d is not None and cal_d.count else None),
+        "quality_alarms": int(sum(
+            v for k, v in snap.items()
+            if k.startswith("quality/alarms/"))),
+        "slot_coverage": (round(min(slot_covs), 4) if slot_covs
+                          else None),
+        "skew_top_share": round(float(
+            snap.get("quality/skew_top_share", 0.0)), 4),
+        "key_churn": round(float(
+            snap.get("quality/key_churn", 0.0)), 4),
+    }
     return {
         "metric": "online_stream_events_per_sec",
         "value": round(eps, 1),
@@ -1809,6 +1867,7 @@ def bench_online() -> dict:
         "stream_passes": passes,
         "events": events,
         "table_ttl_days": 1,
+        "quality": quality_block,
         "n_devices": len(jax.devices()),
     }
 
@@ -1915,8 +1974,17 @@ def _preflight_gather_kernel(n: int, dim: int, pass_keys: int) -> None:
 
 
 def main() -> None:
-    global SERVE_CLIENTS, SERVE_REPLICAS, MULTIHOST_HOSTS
+    global SERVE_CLIENTS, SERVE_REPLICAS, MULTIHOST_HOSTS, SLOT_AUC
     argv = list(sys.argv[1:])
+    if "--slot-auc" in argv:
+        i = argv.index("--slot-auc")
+        SLOT_AUC = []
+        del argv[i]
+    for i, a in enumerate(argv):
+        if a.startswith("--slot-auc="):
+            SLOT_AUC = [s for s in a.split("=", 1)[1].split(",") if s]
+            del argv[i]
+            break
     if "--clients" in argv:
         i = argv.index("--clients")
         SERVE_CLIENTS = argv[i + 1] if i + 1 < len(argv) else "1,8,32"

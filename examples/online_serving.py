@@ -198,6 +198,53 @@ def main() -> None:
                 rcli.close()
                 router.stop()
                 pub2.stop()
+
+            # Phase 5 — the model-quality observatory (OBSERVABILITY.md
+            # "Model quality & data health"): served traffic is sampled
+            # by request id, labels arrive late (the stream tier's
+            # event log catching up) and join against the bounded
+            # pending window, and a calibration-shifted burst must trip
+            # quality/alarms/copc on the replica — visible in ONE
+            # `fleet_top --once --json` scrape beside the systems
+            # columns.
+            import contextlib
+            import io
+            import json as _json
+
+            prev_q = {k: flagmod.flag(k) for k in
+                      ("quality_sample_rate", "quality_min_events",
+                       "quality_copc_band")}
+            try:
+                flagmod.set_flags({"quality_sample_rate": 1.0,
+                                   "quality_min_events": 64,
+                                   "quality_copc_band": 0.3})
+                shifted = ["0 " + " ".join(
+                    f"{s}:{rng.integers(1, 400)}" for s in SLOTS)
+                    for _ in range(16)]
+                for r in range(8):
+                    rid = f"req-{r}"
+                    cli.predict(shifted, rid=rid)
+                    # The late label feed reports every served request
+                    # clicked — a hard calibration shift vs the model's
+                    # predicted CTR.
+                    cli.send_labels(rid, [1.0] * len(shifted))
+                st = cli.stats()
+                assert st["quality_alarms"] >= 1, \
+                    "calibration-shifted burst must trip a copc alarm"
+                from tools import fleet_top
+                buf = io.StringIO()
+                with contextlib.redirect_stdout(buf):
+                    rc = fleet_top.main(["--targets",
+                                         f"rep={server.endpoint}",
+                                         "--once", "--json"])
+                assert rc == 0, "fleet_top scrape must reach the replica"
+                row = _json.loads(buf.getvalue())["summary"][0]
+                assert row.get("quality_alarms", 0) >= 1, row
+                print(f"calibration-shift alarm visible in one fleet_top "
+                      f"scrape (copc={row.get('copc')}, "
+                      f"alarms={row['quality_alarms']})")
+            finally:
+                flagmod.set_flags(prev_q)
         finally:
             cli.stop_server()
             cli.close()

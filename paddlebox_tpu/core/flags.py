@@ -611,6 +611,63 @@ define_flag("stream_tail_bytes", False,
             "resumes mid-file after kill -9 with no event lost or "
             "duplicated. False (default) = whole-segment mode "
             "(files must appear via write-tmp-then-rename)")
+define_flag("quality_collect", False,
+            "model-quality & data-health observatory (core/quality.py): "
+            "per-slot input health collected on the ingest chunk path, "
+            "per-pass COPC/calibration tracking rebinned from the AUC "
+            "histogram, drift alarms (quality/alarms/<kind>) and ONE "
+            "quality_report line beside each pass_report. Host-side "
+            "only — the jitted step is unchanged (test_quality pins "
+            "it). False (default) = collection off; the pass report's "
+            "headline copc/bucket_error fields are free and always on")
+define_flag("quality_sample_rate", 0.0,
+            "serving-side sampled calibration: fraction of rid-carrying "
+            "predict RPCs whose predictions are logged for a late "
+            "label join (deterministic crc32-of-rid selection, no "
+            "RNG). 0 (default) disables serving quality sampling")
+define_flag("quality_join_window_s", 300.0,
+            "bounded pending window of the serving prediction+label "
+            "join: a sampled request whose labels have not arrived "
+            "within this many seconds expires COUNTED "
+            "(quality/label_join_expired), never crashes the join")
+define_flag("quality_join_pending", 65536,
+            "max sampled requests held pending a label join; beyond it "
+            "the oldest entries expire counted (bounds serving host "
+            "memory under a label-feed outage)")
+define_flag("quality_min_events", 256,
+            "joined label rows per serving calibration window: every "
+            "this-many joined rows the window's COPC/calibration error "
+            "is evaluated against the drift baseline")
+define_flag("quality_baseline_passes", 8,
+            "previous-N-pass window behind each quality drift baseline "
+            "(the EWMA updates over it; alarms compare the new pass "
+            "against the baseline built from prior passes only)")
+define_flag("quality_warmup_passes", 3,
+            "observed passes of a metric before its drift alarms may "
+            "fire — early training legitimately moves calibration, and "
+            "a baseline of one pass is noise")
+define_flag("quality_copc_tol", 0.25,
+            "relative COPC (actual ctr / predicted ctr) deviation from "
+            "the EWMA baseline that raises quality/alarms/copc — the "
+            "within-one-pass calibration-drift trip wire")
+define_flag("quality_copc_band", 0.0,
+            "absolute |COPC - 1| band that raises quality/alarms/copc "
+            "immediately, no baseline needed (a calibrated CTR model "
+            "targets COPC 1.0). 0 (default) = band check off — early "
+            "training sits far from 1 by construction")
+define_flag("quality_calibration_tol", 0.5,
+            "relative RISE of the bucket calibration error over its "
+            "EWMA baseline (and past a 0.01 absolute floor) that "
+            "raises quality/alarms/calibration")
+define_flag("quality_coverage_drop", 0.5,
+            "relative DROP of a slot's example coverage vs its EWMA "
+            "baseline (and past a 0.01 absolute floor) that raises "
+            "quality/alarms/slot_dark — the slot-went-dark trip wire")
+define_flag("quality_churn_max", 0.0,
+            "pass-over-pass key churn (fraction of a slot's keys unseen "
+            "last pass) above which quality/alarms/churn raises; "
+            "suppressed for the first pass after a day rollover (the "
+            "per-day key window slides by design). 0 (default) = off")
 define_flag("rpc_retry_deadline_s", 30.0,
             "overall wall-clock deadline across an idempotent call's "
             "retries: when exceeded the last connection error raises "

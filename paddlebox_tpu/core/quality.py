@@ -1,0 +1,679 @@
+"""Model-quality & data-health observatory.
+
+The systems plane (PRs 6/14) answers "is the fleet healthy"; this
+module answers "is the MODEL healthy" on the same one-scrape telemetry
+plane. CTR fleets die silently from data and calibration drift, not
+crashes — the reference ships slot-level calibration machinery (PCOC in
+``fused_seqpool_cvm_with_pcoc``, the bucket calibration error in its AUC
+calculator) precisely because day-end AUC is too late. Three layers,
+all host-side (nothing here ever enters a jitted program — the quality
+jaxpr pins in tests/test_quality.py hold with everything on):
+
+- :class:`SlotHealthCollector` — per-slot input health fed from the
+  ingest chunk path (``data/`` columnar chunks): example coverage,
+  ids/example quantiles, zero-key rate, label out-of-range rate,
+  pass-over-pass key churn and access-skew top-share (the hot-set
+  statistics "Dissecting Embedding Bag Performance in DLRM Inference"
+  analyzes offline, live as gauges).
+- calibration — streaming COPC (actual ctr / predicted ctr; 1.0 =
+  calibrated, the inverse of the reference's PCOC) plus the registry's
+  ``bucket_error_sweep`` calibration error, localized into log-spaced
+  prediction buckets so an excursion NAMES the offending buckets.
+  Accumulated per pass from the trainer's device AUC table (a host
+  rebin of the existing ``[2, nb]`` histogram — zero device ops), and
+  on served traffic via :class:`ServingQuality`'s sampled
+  prediction+label join (labels arrive late through the stream tier's
+  event log; join by sampled request id under a bounded pending
+  window — expiry is counted, never crashed).
+- drift alarms — :class:`DriftDetector` keeps a previous-N-pass window
+  + EWMA baseline per metric; ``FLAGS_quality_*`` thresholds raise
+  ``quality/alarms/<kind>`` counters and ONE structured
+  ``quality_report {json}`` line beside ``pass_report``
+  (:func:`core.report.emit_quality_report`), so a COPC excursion or a
+  slot going dark is caught within one pass, not at day-end AUC.
+
+Default-off (``FLAGS_quality_collect``), consistent with the rest of
+the telemetry plane; the pass_report's headline ``copc`` /
+``bucket_error`` fields are free and always on. Replay purity: nothing
+on the training path reads the wall clock or randomness — the serving
+joiner's clock is injectable and lives outside the replay closure.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import zlib
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.core import flags, log, monitor, report
+
+# Log-spaced prediction-bucket edges for COPC localization: CTR
+# predictions live on a log scale (1e-4 tail traffic and 0.5 head
+# traffic are both real), so linear buckets would put every alarm in
+# one bin. 24 buckets over [1e-6, 1].
+N_PRED_BUCKETS = 24
+PRED_EDGES = np.concatenate(
+    [[0.0], np.logspace(-6.0, 0.0, N_PRED_BUCKETS)])
+
+
+def enabled() -> bool:
+    """Master switch (``FLAGS_quality_collect``). Read at per-pass /
+    per-dataset granularity — never per row."""
+    return bool(flags.flag("quality_collect"))
+
+
+# -- calibration --------------------------------------------------------------
+
+
+def log_bucket_table(table: np.ndarray) -> List[Dict[str, float]]:
+    """Rebin a linear ``[2, nb]`` neg/pos prediction histogram (the
+    device AUC table / host calculator table) into the log-spaced
+    prediction buckets. Per bucket: shows, clicks, the midpoint-
+    approximated predicted ctr, and COPC = actual/predicted. The
+    midpoint approximation is exact to one linear bucket's width
+    (1/nb), far below any alarm threshold."""
+    table = np.asarray(table, np.float64)
+    neg, pos = table[0], table[1]
+    nb = neg.shape[0]
+    centers = (np.arange(nb, dtype=np.float64) + 0.5) / nb
+    li = np.clip(np.searchsorted(PRED_EDGES[1:], centers, side="left"),
+                 0, N_PRED_BUCKETS - 1)
+    shows = np.bincount(li, weights=neg + pos, minlength=N_PRED_BUCKETS)
+    clicks = np.bincount(li, weights=pos, minlength=N_PRED_BUCKETS)
+    pred_sum = np.bincount(li, weights=(neg + pos) * centers,
+                           minlength=N_PRED_BUCKETS)
+    out: List[Dict[str, float]] = []
+    for b in range(N_PRED_BUCKETS):
+        if shows[b] <= 0:
+            continue
+        predicted = pred_sum[b] / shows[b]
+        actual = clicks[b] / shows[b]
+        out.append({
+            "lo": round(float(PRED_EDGES[b]), 8),
+            "hi": round(float(PRED_EDGES[b + 1]), 8),
+            "count": float(shows[b]),
+            "predicted_ctr": round(float(predicted), 6),
+            "actual_ctr": round(float(actual), 6),
+            "copc": (round(float(actual / predicted), 4)
+                     if predicted > 0 else None),
+        })
+    return out
+
+
+def offending_buckets(buckets: Sequence[Dict[str, float]], *,
+                      tol: float, top: int = 8) -> List[Dict[str, float]]:
+    """The buckets a COPC excursion should NAME: count-qualified
+    (>= max(16, 0.2%) of the window) log buckets whose per-bucket COPC
+    deviates from 1.0 by more than ``tol``, worst first."""
+    total = sum(b["count"] for b in buckets)
+    min_count = max(16.0, 0.002 * total)
+    bad = [b for b in buckets
+           if b["count"] >= min_count and b["copc"] is not None
+           and abs(b["copc"] - 1.0) > tol]
+    bad.sort(key=lambda b: -abs(b["copc"] - 1.0))
+    return bad[:top]
+
+
+def calibration_error_from_table(table: np.ndarray) -> float:
+    """The registry's adaptive-span bucket calibration error, reused
+    verbatim (metrics/registry.py ``bucket_error_sweep``)."""
+    from paddlebox_tpu.metrics.registry import bucket_error_sweep
+    return float(bucket_error_sweep(np.asarray(table, np.float64)))
+
+
+# -- drift baselines ----------------------------------------------------------
+
+
+class DriftDetector:
+    """Windowed per-metric baseline: previous-N-pass value window plus
+    an EWMA. A check compares the NEW value against the baseline built
+    from prior passes only (the current value joins the window after
+    the verdict), so an abrupt excursion alarms on the pass it lands
+    in while gradual convergence never does. No alarms before
+    ``warmup`` observations of a metric — early training legitimately
+    moves calibration."""
+
+    EWMA_ALPHA = 0.3
+
+    def __init__(self):
+        self._hist: Dict[str, deque] = {}
+        self._ewma: Dict[str, float] = {}
+
+    def baseline(self, name: str) -> Optional[float]:
+        return self._ewma.get(name)
+
+    def check(self, name: str, value: Optional[float], *, rel_tol: float,
+              abs_floor: float = 0.0, direction: str = "both"
+              ) -> Optional[Dict[str, Any]]:
+        """Update the metric's window with ``value`` and return an alarm
+        dict when it deviates from the pre-existing baseline by more
+        than ``rel_tol`` (relative) AND ``abs_floor`` (absolute).
+        ``direction``: 'both', 'up' (only a rise alarms — error-style
+        metrics), or 'down' (only a drop — coverage-style)."""
+        if value is None or not math.isfinite(value):
+            return None
+        window = max(2, int(flags.flag("quality_baseline_passes")))
+        warmup = max(1, int(flags.flag("quality_warmup_passes")))
+        hist = self._hist.get(name)
+        if hist is None or hist.maxlen != window:
+            hist = self._hist[name] = deque(hist or (), maxlen=window)
+        base = self._ewma.get(name)
+        alarm = None
+        if base is not None and len(hist) >= warmup:
+            dev = value - base
+            dir_ok = (direction == "both"
+                      or (direction == "up" and dev > 0)
+                      or (direction == "down" and dev < 0))
+            if (dir_ok and abs(dev) > rel_tol * max(abs(base), 1e-9)
+                    and abs(dev) > abs_floor):
+                alarm = {"metric": name, "value": round(value, 6),
+                         "baseline": round(base, 6),
+                         "window": len(hist)}
+        hist.append(value)
+        self._ewma[name] = (value if base is None
+                            else self.EWMA_ALPHA * value
+                            + (1.0 - self.EWMA_ALPHA) * base)
+        return alarm
+
+    def reset(self) -> None:
+        self._hist.clear()
+        self._ewma.clear()
+
+
+# -- per-slot input health ----------------------------------------------------
+
+
+class SlotHealthCollector:
+    """Per-slot data-health accumulated from ingest-path columnar
+    chunks (one collector per Dataset load window; the hook lives in
+    ``Dataset._drain``). All numpy-vectorized per chunk — the heavy
+    half (per-chunk key dedup) mirrors what ``ingest_key_runs`` already
+    pays. Thread-safe: the preload thread feeds it."""
+
+    MAX_LEN_BIN = 64          # ids/example histogram cap (clipped)
+    TOP_SHARE_FRAC = 0.01     # "top share" = head 1% of keys
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots: Dict[str, Dict[str, Any]] = {}
+        self._rows = 0
+        self._label_values = 0
+        self._label_oob = 0
+
+    def observe_chunk(self, chunk) -> None:
+        n = int(chunk.num_rows)
+        if n == 0:
+            return
+        lab = chunk.labels
+        oob = int(np.count_nonzero(~np.isfinite(lab) | (lab < 0.0)
+                                   | (lab > 1.0)))
+        per_slot = []
+        for s, ids in chunk.sparse_ids.items():
+            lens = np.diff(chunk.sparse_offsets[s])
+            hist = np.bincount(np.minimum(lens, self.MAX_LEN_BIN),
+                               minlength=self.MAX_LEN_BIN + 1)
+            uk, cnt = (np.unique(ids, return_counts=True) if ids.size
+                       else (np.empty(0, np.uint64),
+                             np.empty(0, np.int64)))
+            per_slot.append((s, int(np.count_nonzero(lens > 0)),
+                             int(ids.size),
+                             int(np.count_nonzero(ids == 0)),
+                             hist, uk, cnt))
+        with self._lock:
+            self._rows += n
+            self._label_values += int(lab.size)
+            self._label_oob += oob
+            for s, with_slot, nids, zeros, hist, uk, cnt in per_slot:
+                st = self._slots.get(s)
+                if st is None:
+                    st = self._slots[s] = {
+                        "with_slot": 0, "ids": 0, "zeros": 0,
+                        "len_hist": np.zeros(self.MAX_LEN_BIN + 1,
+                                             np.int64),
+                        "runs": []}
+                st["with_slot"] += with_slot
+                st["ids"] += nids
+                st["zeros"] += zeros
+                st["len_hist"] += hist
+                if uk.size:
+                    st["runs"].append((uk, cnt))
+
+    @staticmethod
+    def _hist_quantile(hist: np.ndarray, total: int, q: float) -> float:
+        if total <= 0:
+            return 0.0
+        cum = np.cumsum(hist)
+        return float(np.searchsorted(cum, q * total, side="left"))
+
+    def finalize(self) -> Optional[Dict[str, Any]]:
+        """One health snapshot of everything observed so far:
+        per-slot coverage / ids-per-example quantiles / zero rate /
+        access-skew top-share plus the merged unique key+count arrays
+        (the churn comparand the tracker keeps pass-over-pass)."""
+        with self._lock:
+            rows = self._rows
+            if rows == 0:
+                return None
+            slots = {s: dict(st) for s, st in self._slots.items()}
+            label_values = self._label_values
+            label_oob = self._label_oob
+        out_slots: Dict[str, Dict[str, Any]] = {}
+        keys_by_slot: Dict[str, np.ndarray] = {}
+        for s, st in slots.items():
+            if st["runs"]:
+                all_k = np.concatenate([r[0] for r in st["runs"]])
+                all_c = np.concatenate([r[1] for r in st["runs"]])
+                uk, inv = np.unique(all_k, return_inverse=True)
+                counts = np.bincount(inv, weights=all_c.astype(np.float64))
+            else:
+                uk = np.empty(0, np.uint64)
+                counts = np.empty(0, np.float64)
+            total = float(counts.sum())
+            if uk.size:
+                head = max(1, int(math.ceil(self.TOP_SHARE_FRAC
+                                            * uk.size)))
+                top = float(np.sort(counts)[::-1][:head].sum())
+                top_share = top / total if total > 0 else 0.0
+            else:
+                top_share = 0.0
+            out_slots[s] = {
+                "coverage": round(st["with_slot"] / rows, 6),
+                "ids_per_example_p50": self._hist_quantile(
+                    st["len_hist"], rows, 0.5),
+                "ids_per_example_p99": self._hist_quantile(
+                    st["len_hist"], rows, 0.99),
+                "zero_frac": round(st["zeros"] / max(st["ids"], 1), 6),
+                "unique_keys": int(uk.size),
+                "top_share": round(top_share, 4),
+            }
+            keys_by_slot[s] = uk
+        return {"examples": rows,
+                "label_oob_frac": round(label_oob / max(label_values, 1),
+                                        6),
+                "slots": out_slots,
+                "_keys": keys_by_slot}
+
+
+# -- the training-side tracker ------------------------------------------------
+
+
+class QualityTracker:
+    """Per-process model-quality state: per-pass calibration + slot
+    health + drift alarms, emitted as ONE ``quality_report`` line and
+    a set of ``quality/*`` registry gauges/counters beside each pass
+    report. Driven by ``CTRTrainer.train_pass/eval_pass``; the stream
+    and day runners stamp the pass context (day/pass_id) first."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._drift = DriftDetector()
+        self._prev_keys: Dict[str, np.ndarray] = {}
+        self._pass_idx = 0
+        self._ctx: Optional[Dict[str, Any]] = None
+        self._day_rollover = False
+        self.last_report: Optional[Dict[str, Any]] = None
+
+    def set_pass_context(self, day: str, pass_id: int, *,
+                         events: Optional[int] = None,
+                         files: Optional[int] = None,
+                         override: bool = True) -> None:
+        """Stamp the NEXT observe_pass with its day/pass identity (the
+        stream runner adds manifest detail; the day runner only fills
+        in when nothing richer is pending)."""
+        if not enabled():
+            return
+        with self._lock:
+            if self._ctx is not None and not override:
+                return
+            ctx: Dict[str, Any] = {"day": str(day),
+                                   "pass_id": int(pass_id)}
+            if events is not None:
+                ctx["events"] = int(events)
+            if files is not None:
+                ctx["files"] = int(files)
+            self._ctx = ctx
+
+    def note_day_rollover(self) -> None:
+        """A day boundary just closed: key churn on the NEXT pass is
+        expected (the per-day key window slides), so the churn alarm is
+        suppressed for that one pass."""
+        with self._lock:
+            self._day_rollover = True
+
+    def reset(self) -> None:
+        with self._lock:
+            self._drift.reset()
+            self._prev_keys = {}
+            self._pass_idx = 0
+            self._ctx = None
+            self._day_rollover = False
+            self.last_report = None
+
+    # -- the per-pass observation -----------------------------------------
+
+    def observe_pass(self, kind: str, *, stats: Dict[str, Any],
+                     auc_table: Optional[np.ndarray] = None,
+                     health: Optional[Dict[str, Any]] = None
+                     ) -> Optional[Dict[str, Any]]:
+        """Fold one finished pass into the quality plane. ``stats`` is
+        the trainer's pass stats (carries copc / bucket_error /
+        predicted_ctr / actual_ctr from the shared AUC sweep);
+        ``auc_table`` the host copy of the ``[2, nb]`` histogram for
+        bucket localization; ``health`` a SlotHealthCollector
+        finalize(). Returns the quality summary (also in
+        ``last_report``), or None when collection is off."""
+        if not enabled():
+            return None
+        reg = monitor.GLOBAL
+        copc_tol = float(flags.flag("quality_copc_tol"))
+        copc_band = float(flags.flag("quality_copc_band"))
+        with self._lock:
+            self._pass_idx += 1
+            summary: Dict[str, Any] = {"kind": kind,
+                                       "pass": self._pass_idx}
+            ctx, self._ctx = self._ctx, None
+            if ctx:
+                summary.update(ctx)
+            rollover, self._day_rollover = self._day_rollover, False
+            alarms: List[Dict[str, Any]] = []
+
+            # -- calibration ----------------------------------------------
+            copc = stats.get("copc")
+            cal_err = stats.get("bucket_error")
+            for k in ("copc", "predicted_ctr", "actual_ctr"):
+                v = stats.get(k)
+                if isinstance(v, (int, float)) and math.isfinite(v):
+                    summary[k] = round(float(v), 6)
+            if isinstance(cal_err, (int, float)):
+                summary["calibration_error"] = round(float(cal_err), 6)
+            if isinstance(copc, (int, float)) and math.isfinite(copc):
+                reg.set_gauge("quality/copc", float(copc))
+                reg.observe_quantile("quality/copc", float(copc))
+                a = self._drift.check(f"{kind}:copc", float(copc),
+                                      rel_tol=copc_tol)
+                if a is None and copc_band > 0 \
+                        and abs(float(copc) - 1.0) > copc_band:
+                    a = {"metric": "copc", "value": round(float(copc), 6),
+                         "baseline": 1.0, "band": copc_band}
+                if a is not None:
+                    a["kind"] = "copc"
+                    alarms.append(a)
+            if isinstance(cal_err, (int, float)) and math.isfinite(cal_err):
+                reg.set_gauge("quality/calibration_error", float(cal_err))
+                reg.observe_quantile("quality/calibration_error",
+                                     float(cal_err))
+                a = self._drift.check(
+                    f"{kind}:calibration_error", float(cal_err),
+                    rel_tol=float(flags.flag("quality_calibration_tol")),
+                    abs_floor=0.01, direction="up")
+                if a is not None:
+                    a["kind"] = "calibration"
+                    alarms.append(a)
+            if auc_table is not None:
+                buckets = log_bucket_table(auc_table)
+                bad = offending_buckets(buckets,
+                                        tol=max(copc_tol, 0.2))
+                summary["prediction_buckets"] = len(buckets)
+                if bad:
+                    summary["offending_buckets"] = bad
+
+            # -- per-slot input health ------------------------------------
+            if health:
+                churn_max = float(flags.flag("quality_churn_max"))
+                cov_drop = float(flags.flag("quality_coverage_drop"))
+                slot_out: Dict[str, Dict[str, Any]] = {}
+                churns: List[float] = []
+                top_shares: List[float] = []
+                new_keys = health.get("_keys") or {}
+                for s, h in health["slots"].items():
+                    h = dict(h)
+                    prev = self._prev_keys.get(s)
+                    cur = new_keys.get(s)
+                    churn = None
+                    if prev is not None and cur is not None and cur.size:
+                        shared = np.intersect1d(
+                            prev, cur, assume_unique=True).size
+                        churn = round(1.0 - shared / cur.size, 4)
+                        h["key_churn"] = churn
+                        churns.append(churn)
+                    top_shares.append(h.get("top_share", 0.0))
+                    slot_out[s] = h
+                    reg.set_gauge(f"quality/slot_coverage/{s}",
+                                  h["coverage"])
+                    reg.set_gauge(f"quality/slot_zero_frac/{s}",
+                                  h["zero_frac"])
+                    reg.set_gauge(f"quality/slot_top_share/{s}",
+                                  h["top_share"])
+                    reg.set_gauge(f"quality/slot_ids_p50/{s}",
+                                  h["ids_per_example_p50"])
+                    reg.set_gauge(f"quality/slot_ids_p99/{s}",
+                                  h["ids_per_example_p99"])
+                    if churn is not None:
+                        reg.set_gauge(f"quality/slot_churn/{s}", churn)
+                    a = self._drift.check(
+                        f"coverage/{s}", h["coverage"],
+                        rel_tol=cov_drop, abs_floor=0.01,
+                        direction="down")
+                    if a is not None:
+                        a["kind"] = "slot_dark"
+                        a["slot"] = s
+                        alarms.append(a)
+                    if (churn is not None and churn_max > 0
+                            and churn > churn_max and not rollover):
+                        alarms.append({"kind": "churn", "slot": s,
+                                       "metric": f"churn/{s}",
+                                       "value": churn,
+                                       "threshold": churn_max})
+                self._prev_keys.update(new_keys)
+                summary["slots"] = slot_out
+                summary["examples"] = health.get("examples")
+                lo = health.get("label_oob_frac")
+                if lo:
+                    summary["label_oob_frac"] = lo
+                if churns:
+                    reg.set_gauge("quality/key_churn",
+                                  sum(churns) / len(churns))
+                if top_shares:
+                    reg.set_gauge("quality/skew_top_share",
+                                  max(top_shares))
+
+            # -- emit -----------------------------------------------------
+            for a in alarms:
+                reg.add(f"quality/alarms/{a['kind']}", 1)
+                log.warning("quality alarm [%s] %s: value=%s baseline=%s",
+                            a["kind"], a.get("metric", a.get("slot")),
+                            a.get("value"), a.get("baseline"))
+            if alarms:
+                summary["alarms"] = alarms
+            report.emit_quality_report(kind, summary)
+            self.last_report = summary
+            return summary
+
+
+GLOBAL = QualityTracker()
+
+
+# -- served-traffic calibration ----------------------------------------------
+
+
+class ServingQuality:
+    """Sampled prediction + late-label join on a serving replica.
+
+    ``sample(rid, preds)`` logs a request's predictions under its
+    request id when ``FLAGS_quality_sample_rate`` selects it (crc32
+    hash of the rid — deterministic, no RNG); labels arrive late
+    (through the stream tier's event log, or any label feed) via
+    ``join(rid, labels)``. The pending map is bounded: entries older
+    than ``FLAGS_quality_join_window_s`` (or past
+    ``FLAGS_quality_join_pending``) expire COUNTED
+    (``quality/label_join_expired``), never crash, and a join for an
+    expired/unsampled rid is a counted miss. Joined pairs accumulate
+    in a linear prediction histogram (the registry bucket-error math
+    applies unchanged); every ``FLAGS_quality_min_events`` joined rows
+    the window's COPC/calibration is evaluated against the drift
+    baseline and alarms land in every attached registry (the replica's
+    instance Monitor rides the ``metrics_snapshot`` scrape)."""
+
+    def __init__(self, registries: Sequence[Any] = (), *,
+                 clock: Callable[[], float] = time.time,
+                 num_buckets: int = 1 << 12):
+        self._lock = threading.Lock()
+        self._regs = list(registries)
+        self._clock = clock
+        self._pending: "OrderedDict[str, Tuple[float, np.ndarray]]" = \
+            OrderedDict()
+        self._table = np.zeros((2, num_buckets), np.float64)
+        self._pred_sum = 0.0
+        self._label_sum = 0.0
+        self._count = 0.0
+        self._win_base = (self._table.copy(), 0.0, 0.0, 0.0)
+        self._drift = DriftDetector()
+        self.alarms = 0
+
+    def _bump(self, name: str, delta: int = 1) -> None:
+        monitor.add(name, delta)
+        for r in self._regs:
+            r.add(name, delta)
+
+    def _set(self, name: str, value: float) -> None:
+        monitor.set_gauge(name, value)
+        for r in self._regs:
+            r.set_gauge(name, value)
+
+    @staticmethod
+    def _selected(rid: str, rate: float) -> bool:
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        return (zlib.crc32(rid.encode()) % 1000000) < rate * 1000000
+
+    def sample(self, rid: str, preds: np.ndarray) -> bool:
+        """Record one request's predictions for a later label join.
+        Returns whether the rid was sampled."""
+        rate = float(flags.flag("quality_sample_rate"))
+        if not self._selected(rid, rate):
+            return False
+        now = self._clock()
+        preds = np.asarray(preds, np.float64).ravel().copy()
+        cap = max(1, int(flags.flag("quality_join_pending")))
+        with self._lock:
+            self._expire_locked(now)
+            while len(self._pending) >= cap:
+                self._pending.popitem(last=False)
+                self._bump("quality/label_join_expired", 1)
+            self._pending[rid] = (now, preds)
+        self._bump("quality/sampled_rows", int(preds.size))
+        return True
+
+    def _expire_locked(self, now: float) -> None:
+        window = float(flags.flag("quality_join_window_s"))
+        expired = 0
+        while self._pending:
+            rid, (ts, _p) = next(iter(self._pending.items()))
+            if now - ts <= window:
+                break
+            self._pending.popitem(last=False)
+            expired += 1
+        if expired:
+            self._bump("quality/label_join_expired", expired)
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def join(self, rid: str, labels: np.ndarray) -> bool:
+        """Late labels for a sampled request. Returns whether the join
+        landed (False = never sampled, or expired out of the window)."""
+        labels = np.asarray(labels, np.float64).ravel()
+        with self._lock:
+            self._expire_locked(self._clock())
+            ent = self._pending.pop(rid, None)
+            if ent is None:
+                evaluate = False
+            else:
+                _ts, preds = ent
+                m = min(preds.size, labels.size)
+                preds, lab = preds[:m], labels[:m]
+                nb = self._table.shape[1]
+                bucket = np.clip((preds * nb).astype(np.int64), 0, nb - 1)
+                pos = (lab > 0.5).astype(np.int64)
+                np.add.at(self._table, (pos, bucket), 1.0)
+                self._pred_sum += float(preds.sum())
+                self._label_sum += float(lab.sum())
+                self._count += float(m)
+                evaluate = (self._count - self._win_base[3]
+                            >= max(1, int(flags.flag("quality_min_events"))))
+        if ent is None:
+            self._bump("quality/label_join_miss", 1)
+            return False
+        self._bump("quality/label_joined", int(m))
+        if evaluate:
+            self.evaluate()
+        return True
+
+    def evaluate(self, force: bool = False) -> List[Dict[str, Any]]:
+        """Close the current joined-label window: COPC + calibration
+        error over it, drift-check, alarm, and publish gauges. Called
+        automatically every ``FLAGS_quality_min_events`` joined rows;
+        ``force`` evaluates whatever the window holds."""
+        with self._lock:
+            base_table, base_pred, base_label, base_count = self._win_base
+            win_count = self._count - base_count
+            if win_count <= 0 and not force:
+                return []
+            win_table = self._table - base_table
+            win_pred = self._pred_sum - base_pred
+            win_label = self._label_sum - base_label
+            self._win_base = (self._table.copy(), self._pred_sum,
+                              self._label_sum, self._count)
+            copc = win_label / win_pred if win_pred > 0 else None
+            cal_err = calibration_error_from_table(win_table)
+            alarms: List[Dict[str, Any]] = []
+            band = float(flags.flag("quality_copc_band"))
+            if copc is not None and math.isfinite(copc):
+                self._set("quality/copc", float(copc))
+                a = self._drift.check(
+                    "serving_copc", float(copc),
+                    rel_tol=float(flags.flag("quality_copc_tol")))
+                if a is None and band > 0 and abs(copc - 1.0) > band:
+                    a = {"metric": "serving_copc",
+                         "value": round(float(copc), 6),
+                         "baseline": 1.0, "band": band}
+                if a is not None:
+                    a["kind"] = "copc"
+                    alarms.append(a)
+            self._set("quality/calibration_error", float(cal_err))
+            a = self._drift.check(
+                "serving_calibration_error", float(cal_err),
+                rel_tol=float(flags.flag("quality_calibration_tol")),
+                abs_floor=0.01, direction="up")
+            if a is not None:
+                a["kind"] = "calibration"
+                alarms.append(a)
+            summary: Dict[str, Any] = {
+                "kind": "serving", "events": int(win_count),
+                "copc": (round(float(copc), 6)
+                         if copc is not None else None),
+                "calibration_error": round(float(cal_err), 6),
+            }
+            bad = offending_buckets(
+                log_bucket_table(win_table),
+                tol=max(float(flags.flag("quality_copc_tol")), 0.2))
+            if bad:
+                summary["offending_buckets"] = bad
+        for a in alarms:
+            self._bump(f"quality/alarms/{a['kind']}", 1)
+            log.warning("serving quality alarm [%s]: value=%s "
+                        "baseline=%s", a["kind"], a.get("value"),
+                        a.get("baseline"))
+        if alarms:
+            summary["alarms"] = alarms
+            self.alarms += len(alarms)
+        report.emit_quality_report("serving", summary)
+        return alarms

@@ -87,7 +87,12 @@ def emit_pass_report(kind: str, *, steps: int, samples: int,
     for s in STAGES:
         reg.set_gauge(f"pass/{kind}_{s}_ms", summary["stage_ms"][s])
     if stats:
-        for k in ("loss", "auc"):
+        # Model-health headline beside the systems stages: the shared
+        # AUC sweep computes bucket_error / copc / ctr ratios every
+        # pass — they land as gauges (and ride the summary via the
+        # stats merge above) instead of being dropped on the floor.
+        for k in ("loss", "auc", "bucket_error", "copc",
+                  "actual_ctr", "predicted_ctr"):
             v = stats.get(k)
             if isinstance(v, (int, float)):
                 reg.set_gauge(f"pass/{kind}_{k}", float(v))
@@ -131,6 +136,25 @@ def emit_pass_report(kind: str, *, steps: int, samples: int,
     trace.instant(f"pass_report/{kind}", steps=steps,
                   samples_per_s=summary["samples_per_s"])
     reg.flush_jsonl(labels={"event": "pass_report", "kind": kind})
+    return summary
+
+
+def emit_quality_report(kind: str, summary: Dict[str, Any]
+                        ) -> Dict[str, Any]:
+    """Publish one model-quality summary (core/quality.py) the same
+    three ways the pass report goes out: ONE structured
+    ``quality_report {json}`` log line beside ``pass_report``, a trace
+    instant, and a labeled metrics-JSONL snapshot — so a COPC
+    excursion or a dark slot is greppable, timeline-visible, and
+    scrape-able through the same plane."""
+    reg = monitor.GLOBAL
+    reg.add("quality/reports", 1)
+    line = json.dumps(summary, default=str)
+    log.info("quality_report %s", line)
+    trace.instant(f"quality_report/{kind}",
+                  alarms=len(summary.get("alarms") or ()),
+                  copc=summary.get("copc"))
+    reg.flush_jsonl(labels={"event": "quality_report", "kind": kind})
     return summary
 
 

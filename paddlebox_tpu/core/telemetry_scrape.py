@@ -124,6 +124,18 @@ def summarize_target(label: str, endpoint: str,
     for k in ("rpc_reconnects", "rpc_retries", "num_features", "keys"):
         if isinstance(stats.get(k), (int, float)):
             row[k] = int(stats[k])
+    # Model-quality pane (core/quality.py): COPC / calibration error
+    # gauges plus the target's total quality alarms — "is the model
+    # healthy" answered in the same row as "is the target healthy".
+    for k, name in (("copc", "quality/copc"),
+                    ("calibration_error", "quality/calibration_error")):
+        v = gauges.get(name)
+        if isinstance(v, (int, float)):
+            row[k] = round(float(v), 4)
+    qa = sum(int(v) for k, v in counters.items()
+             if k.startswith("quality/alarms/"))
+    if qa or any(k.startswith("quality/") for k in counters):
+        row["quality_alarms"] = qa
     return row
 
 
@@ -160,6 +172,17 @@ def scrape_cluster(targets: Dict[str, str], *, timeout: float = 10.0,
                 g.get("multihost/replica_lag_worst"))
     if lag is not None:
         cluster["replica_lag_worst"] = lag
+    # Fleet-wide model health: quality alarms sum across every scraped
+    # registry (counters section of the merged snapshot) plus the mean
+    # COPC gauge — one scrape answers "is the model healthy" next to
+    # the latency/lag systems columns above.
+    qa = sum(int(v) for k, v in (merged.get("counters") or {}).items()
+             if k.startswith("quality/alarms/"))
+    if qa:
+        cluster["quality_alarms"] = qa
+    copc = g.get("quality/copc")
+    if copc is not None:
+        cluster["copc"] = round(float(copc), 4)
     return {"ts": time.time(), "targets": dict(targets),
             "per_target": per, "summary": summary,
             "errors": errors, "merged": merged, "cluster": cluster}

@@ -203,6 +203,10 @@ class Dataset:
         # to the embedding engine's pass-key collector (role of
         # PSAgent::AddKey threading in MergeInsKeys, data_set.cc:2289).
         self.key_sink: Optional[Callable[[np.ndarray], None]] = None
+        # Per-load data-health collector (FLAGS_quality_collect, core/
+        # quality.py): fed each chunk in _drain; the trainer reads the
+        # finalized per-slot health at pass time (quality_health()).
+        self._quality = None
 
     # -- file list ---------------------------------------------------------
 
@@ -531,6 +535,13 @@ class Dataset:
     def _drain(self, ch: Channel) -> None:
         sink = self.key_sink
         collect = bool(flags.flag("ingest_key_runs"))
+        qc = None
+        if flags.flag("quality_collect"):
+            from paddlebox_tpu.core import quality
+            with self._lock:
+                if self._quality is None:
+                    self._quality = quality.SlotHealthCollector()
+                qc = self._quality
         local: List[ColumnarChunk] = []
         try:
             while True:
@@ -538,6 +549,8 @@ class Dataset:
                 local.append(chunk)
                 if collect:
                     self._collect_key_runs(chunk)
+                if qc is not None:
+                    qc.observe_chunk(chunk)
                 if sink is not None:
                     keys = chunk.all_keys()
                     if keys.size:
@@ -863,6 +876,18 @@ class Dataset:
             return keys
         return np.unique(keys)
 
+    def quality_health(self):
+        """Finalized per-slot data-health of everything this dataset
+        loaded (core/quality.py SlotHealthCollector.finalize()); None
+        when FLAGS_quality_collect was off during the load. The
+        trainer attaches this to the pass's quality report — load-time
+        collection keeps the per-chunk work off the pass critical path
+        and attributes a pipelined preload's chunks to the dataset
+        (and so the pass) that actually consumes them."""
+        with self._lock:
+            qc = self._quality
+        return qc.finalize() if qc is not None else None
+
     def clear(self) -> None:
         with self._lock:
             self._chunks.clear()
@@ -870,5 +895,6 @@ class Dataset:
             self._key_runs = {}
             self._key_zero = {}
             self._key_runs_valid = True
+            self._quality = None
         # Chunk finalizers unlink their shm segments as the refs die;
         # nothing else to do here (gc-immediate under CPython).

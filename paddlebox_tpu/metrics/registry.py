@@ -116,6 +116,11 @@ def compute_from_table(table: np.ndarray, abserr: float, sqrerr: float,
         "rmse": (sqrerr / c) ** 0.5,
         "actual_ctr": label_sum / c,
         "predicted_ctr": pred_sum / c,
+        # COPC (Click Over Predicted Click) = actual/predicted ctr —
+        # 1.0 = calibrated; the inverse of the reference's PCOC. The
+        # headline calibration ratio every pass report carries.
+        "copc": (label_sum / pred_sum if pred_sum > 0
+                 else float("nan")),
         "count": count,
     }
 

@@ -28,7 +28,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from paddlebox_tpu.core import flags, monitor, report, trace
+from paddlebox_tpu.core import flags, monitor, quality, report, trace
 from paddlebox_tpu.core.quantiles import LogQuantileDigest
 from paddlebox_tpu.data.parser import parse_lines
 from paddlebox_tpu.distributed import rpc
@@ -81,6 +81,14 @@ class PredictServer(rpc.FramedRPCServer):
         self._win_prev = (self._started, self._latency.copy())
         self._win_cur = (self._started, self._latency.copy())
         self._batcher = MicroBatcher(predictor, metrics=self.metrics)
+        # Served-traffic calibration (core/quality.py): sampled
+        # prediction logging keyed by request id + late label join —
+        # labels trail through the stream tier's event log. Alarms and
+        # gauges land in the instance registry too, so the fleet's
+        # metrics_snapshot scrape shows THIS replica's model health.
+        # Eagerly built (small fixed arrays): no handler-thread race;
+        # sampling itself is off until FLAGS_quality_sample_rate > 0.
+        self.quality = quality.ServingQuality(registries=(self.metrics,))
         self._publisher = None
         if watch_root is not None:
             from paddlebox_tpu.serving.publisher import DonefilePublisher
@@ -138,6 +146,14 @@ class PredictServer(rpc.FramedRPCServer):
                 self.metrics.add("serving/degraded_rpcs", 1)
             else:
                 out = self._batcher.predict(instances)
+        # Sampled calibration logging: a request carrying a rid may be
+        # selected (crc32 hash, FLAGS_quality_sample_rate) — its
+        # predictions wait in the bounded pending window for the late
+        # label join (handle_labels).
+        rid = req.get("rid")
+        if rid is not None and float(
+                flags.flag("quality_sample_rate")) > 0.0:
+            self.quality.sample(str(rid), out)
         ms = (time.perf_counter() - t0) * 1e3
         monitor.add("serving/predict_rpcs", 1)
         monitor.add("serving/predict_lines", n)
@@ -170,6 +186,18 @@ class PredictServer(rpc.FramedRPCServer):
                 req["path"], req.get("table", "embedding"), "delta")
         monitor.add("serving/delta_rpcs", 1)
         return int(n_new)
+
+    def handle_labels(self, req) -> dict:
+        """Late labels for a sampled predict (``rid`` + ``labels`` in
+        request order): joins against the pending prediction log and
+        feeds the served-traffic COPC/calibration window. An expired
+        or never-sampled rid is a counted miss, never an error — the
+        label feed (the stream tier's event log) trails serving by
+        minutes and may replay."""
+        joined = self.quality.join(
+            str(req["rid"]), np.asarray(req["labels"], np.float64))
+        return {"joined": bool(joined),
+                "pending": int(self.quality.pending())}
 
     def handle_stats(self, req) -> dict:
         snap = monitor.snapshot()
@@ -215,7 +243,12 @@ class PredictServer(rpc.FramedRPCServer):
                 # failover-blip drills assert the retry budget actually
                 # consumed through the stats surface.
                 "rpc_reconnects": int(snap.get("rpc/reconnects", 0)),
-                "rpc_retries": int(snap.get("rpc/retries", 0))}
+                "rpc_retries": int(snap.get("rpc/retries", 0)),
+                # Model health of THIS replica (served-traffic sampled
+                # calibration): total quality alarms raised here.
+                "quality_alarms": int(sum(
+                    v for k, v in mine.items()
+                    if k.startswith("quality/alarms/")))}
 
     def handle_metrics_snapshot(self, req) -> dict:
         """This replica's labeled ``snapshot_all()`` (instance registry
@@ -307,11 +340,18 @@ class PredictClient:
         monitor.add("serving/client_reresolves", 1)
         return live[hash(id(self)) % len(live)]
 
-    def predict(self, lines: List[str]) -> np.ndarray:
+    def predict(self, lines: List[str], *,
+                rid: Optional[str] = None) -> np.ndarray:
         # The wire serializes str natively (utf-8 frames) — no
-        # per-line encode/decode round-trip.
+        # per-line encode/decode round-trip. ``rid`` tags the request
+        # for sampled calibration logging on the replica (late labels
+        # follow via send_labels) — direct-replica clients only; the
+        # router rebuilds its forwarded request without it.
         t0 = time.perf_counter()
-        out = self._conn.call("predict", lines=list(lines))
+        kwargs = {"lines": list(lines)}
+        if rid is not None:
+            kwargs["rid"] = str(rid)
+        out = self._conn.call("predict", **kwargs)
         if isinstance(out, dict):
             # Router reply: probabilities + routing metadata (degraded
             # = the SLO-shed hot-rows-only path answered; hop = the
@@ -344,6 +384,13 @@ class PredictClient:
                for k, v in self._latency.quantiles().items()}
         out["count"] = self._latency.count
         return out
+
+    def send_labels(self, rid: str, labels) -> dict:
+        """Deliver a sampled request's late labels (the stream tier's
+        event log catching up with served traffic) for the replica's
+        prediction+label calibration join."""
+        return self._conn.call("labels", rid=str(rid),
+                               labels=[float(v) for v in labels])
 
     def apply_delta(self, path: str, table: str = "embedding") -> int:
         return self._conn.call("apply_delta", path=path, table=table)

@@ -35,7 +35,7 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
-from paddlebox_tpu.core import faults, flags, log, monitor, trace
+from paddlebox_tpu.core import faults, flags, log, monitor, quality, trace
 from paddlebox_tpu.stream.source import (PassManifest, StreamCursor,
                                          StreamSource)
 from paddlebox_tpu.train.day_runner import DayRunner
@@ -154,6 +154,13 @@ class StreamRunner(DayRunner):
         # → shard primary → synchronous backup forward — carries ONE
         # trace id, so a merged fleet trace shows the whole write path
         # of one incremental pass.
+        # The carved manifest is the richest pass identity the quality
+        # plane can get (event/file counts ride the quality_report) —
+        # stamped BEFORE train_pass so the per-pass drift detection
+        # over carved passes names the exact sub-day pass that drifted.
+        quality.GLOBAL.set_pass_context(m.day, m.pass_id,
+                                        events=int(m.events),
+                                        files=len(m.files))
         with trace.use_context(trace.wire_context()), \
                 trace.span("stream/pass", day=m.day, pass_id=m.pass_id,
                            files=len(m.files), events=m.events):

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
-from paddlebox_tpu.core import log
+from paddlebox_tpu.core import log, monitor
 
 
 def slot_replacement_eval(trainer, dataset, *,
@@ -28,6 +28,13 @@ def slot_replacement_eval(trainer, dataset, *,
     Returns ``{"base_auc", "base_loss", "slots": {name: {"auc",
     "auc_drop", "loss"}}, "ranking": [names, most important first]}``.
     The dataset is restored to its original content afterwards.
+
+    Results also land in the metric registry — ``quality/base_auc``
+    plus per-slot ``quality/slot_auc/<slot>`` /
+    ``quality/slot_auc_drop/<slot>`` gauges — so per-slot AUC
+    degradation is recordable through the telemetry plane (JSONL
+    export, ``metrics_snapshot`` scrape, ``bench.py deepfm
+    --slot-auc``) instead of print-only.
     """
     base = trainer.eval_pass(dataset)
     names = list(slots) if slots is not None else [
@@ -51,6 +58,11 @@ def slot_replacement_eval(trainer, dataset, *,
         dataset.restore_chunks(snap)
     ranking: List[str] = sorted(
         per_slot, key=lambda n: per_slot[n]["auc_drop"], reverse=True)
+    monitor.set_gauge("quality/base_auc", float(base["auc"]))
+    for name, st in per_slot.items():
+        monitor.set_gauge(f"quality/slot_auc/{name}", st["auc"])
+        monitor.set_gauge(f"quality/slot_auc_drop/{name}",
+                          st["auc_drop"])
     return {"base_auc": float(base["auc"]),
             "base_loss": float(base["loss"]),
             "slots": per_slot,

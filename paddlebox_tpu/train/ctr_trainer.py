@@ -29,8 +29,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddlebox_tpu.core import (faults, flags, log, monitor,
-                                pipeline_stats, report, timers, trace,
-                                watchdog)
+                                pipeline_stats, quality, report, timers,
+                                trace, watchdog)
 from paddlebox_tpu.data.dataset import Dataset
 from paddlebox_tpu.data.slots import DataFeedConfig, SlotBatch
 from paddlebox_tpu.embedding import TableConfig, make_sparse_optimizer
@@ -783,6 +783,7 @@ class CTRTrainer:
             stats=stats,
             extra={"steps_per_dispatch": k_disp,
                    "seg_cache_hit_rate": stats["seg_cache_hit_rate"]})
+        self._observe_quality("eval", stats, dataset, auc_state=auc)
         return stats
 
     def _sync_params_fn(self):
@@ -1473,9 +1474,40 @@ class CTRTrainer:
             extra={"steps_per_dispatch": k_disp,
                    "seg_cache_hit_rate": stats["seg_cache_hit_rate"],
                    "lookup_duplication": stats["lookup_duplication"]})
+        self._observe_quality("train", stats, dataset)
         log.vlog(0, "pass done: steps=%d loss=%.5f auc=%.5f (%s)",
                  nsteps, stats["loss"], stats["auc"], self.timers.report())
         return stats
+
+    def _observe_quality(self, kind: str, stats: Dict[str, float],
+                         dataset, auc_state=None) -> None:
+        """Fold the finished pass into the model-quality plane
+        (FLAGS_quality_collect, core/quality.py): the host copy of the
+        device AUC histogram localizes a COPC excursion into prediction
+        buckets, the dataset's load-time slot-health snapshot carries
+        coverage/churn/skew, and the tracker raises the drift alarms +
+        the quality_report line beside the pass_report. Host-side only
+        — one extra pass-end table fetch, zero device ops."""
+        if not quality.enabled():
+            return
+        auc = auc_state if auc_state is not None else self.auc_state
+        q_table = None
+        if self.num_tasks == 1 and auc is not None:
+            with self.timers.scope("sync"), \
+                    pipeline_stats.GLOBAL.busy("device"):
+                # graftlint: allow-sync(pass-end quality table fetch inside the sync scope)
+                q_table = np.asarray(auc.table, np.float64)
+        # Slot health rides TRAIN passes only: eval re-walks the same
+        # dataset (slot_replacement_eval runs many evals per load), and
+        # feeding the churn/coverage baselines duplicate snapshots of
+        # one load would dilute the drift signal with zeros.
+        health_fn = (getattr(dataset, "quality_health", None)
+                     if kind == "train" else None)
+        summary = quality.GLOBAL.observe_pass(
+            kind, stats=stats, auc_table=q_table,
+            health=health_fn() if health_fn is not None else None)
+        if summary is not None:
+            stats["quality_report"] = summary
 
     def _seg_cache_rate(self) -> Optional[float]:
         total = self._seg_cache_hits + self._seg_cache_misses

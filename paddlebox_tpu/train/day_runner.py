@@ -27,8 +27,8 @@ import numpy as np
 from paddlebox_tpu.checkpoint.protocol import (CheckpointProtocol,
                                                get_online_pass_interval)
 from paddlebox_tpu.core import (faults, flags, log, monitor,
-                                pipeline_stats, report, timers, trace,
-                                watchdog)
+                                pipeline_stats, quality, report, timers,
+                                trace, watchdog)
 from paddlebox_tpu.data.dataset import Dataset
 
 
@@ -396,6 +396,10 @@ class DayRunner:
                           feed_keys: bool) -> Dict[str, float]:
         report.init_telemetry_from_flags()
         faults.init_from_flags()
+        # Stamp the quality tracker with this pass's identity (non-
+        # override: a stream manifest's richer context wins) so the
+        # quality_report line names day/pass beside the pass_report.
+        quality.GLOBAL.set_pass_context(day, pass_id, override=False)
         with self.timers.scope("load"), \
                 trace.span("day/load", day=day, pass_id=pass_id):
             ds = dataset if dataset is not None else self._load_dataset(
@@ -568,6 +572,9 @@ class DayRunner:
             evicted = store.shrink(min_show=self.min_show_shrink)
         monitor.add("day_runner/days", 1)
         monitor.add("day_runner/evicted_keys", int(evicted))
+        # The per-day key window slides at the boundary by design —
+        # the NEXT pass's churn alarm is suppressed, not a drift.
+        quality.GLOBAL.note_day_rollover()
         return evicted
 
     def run_days(self, days: Sequence[str],

@@ -461,3 +461,52 @@ def test_telemetry_overhead_direction_and_gating(tmp_path):
     bad["telemetry"]["trace_on_rps"] = 1150.0
     assert perf_gate.main(
         [_write(tmp_path, "tel_bad.json", bad), "--baseline", b]) == 1
+
+
+def test_quality_keys_direction_and_gating(tmp_path):
+    """Round-20 model-quality keys: the `bench.py online` quality block
+    gates calibration_error (and its quantile leaves) lower-better,
+    alarm counts lower-better, slot coverage higher-better; COPC
+    (target 1.0, not monotonic-better in either direction) and the
+    skew/churn data-shape numbers are provenance and never gate."""
+    assert perf_gate.direction("quality.calibration_error") == -1
+    assert perf_gate.direction("quality.calibration_error.p99") == -1
+    assert perf_gate.direction("quality.quality_alarms") == -1
+    assert perf_gate.direction("quality.slot_coverage") == 1
+    assert perf_gate.direction("quality.copc") == 0
+    assert perf_gate.direction("quality.skew_top_share") == 0
+    assert perf_gate.direction("quality.key_churn") == 0
+    base = {"metric": "online_stream_events_per_sec", "value": 2900.0,
+            "quality": {"copc": 1.0,
+                        "calibration_error": {"p99": 0.05},
+                        "quality_alarms": 0,
+                        "slot_coverage": 0.99,
+                        "skew_top_share": 0.35,
+                        "key_churn": 0.5}}
+    b = _write(tmp_path, "q_base.json", base)
+    assert perf_gate.main(
+        [_write(tmp_path, "q_same.json", base), "--baseline", b]) == 0
+    # Data-shape wobble (different traffic mix) never gates — and a
+    # COPC move is a quality ALARM's job, not the perf gate's.
+    ok = copy.deepcopy(base)
+    ok["quality"]["copc"] = 0.6
+    ok["quality"]["skew_top_share"] = 0.9
+    ok["quality"]["key_churn"] = 0.9
+    assert perf_gate.main(
+        [_write(tmp_path, "q_ok.json", ok), "--baseline", b]) == 0
+    # Calibration blown: the error p99 trips the gate.
+    bad = copy.deepcopy(base)
+    bad["quality"]["calibration_error"]["p99"] = 0.5
+    assert perf_gate.main(
+        [_write(tmp_path, "q_bad_cal.json", bad), "--baseline", b]) == 1
+    # Drift alarms fired on an identical workload: trips it too.
+    bad = copy.deepcopy(base)
+    bad["quality"]["quality_alarms"] = 7
+    assert perf_gate.main(
+        [_write(tmp_path, "q_bad_alarm.json", bad),
+         "--baseline", b]) == 1
+    # A slot going dark (coverage collapse) trips it.
+    bad = copy.deepcopy(base)
+    bad["quality"]["slot_coverage"] = 0.2
+    assert perf_gate.main(
+        [_write(tmp_path, "q_bad_cov.json", bad), "--baseline", b]) == 1

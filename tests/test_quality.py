@@ -1,0 +1,523 @@
+"""Model-quality & data-health observatory (core/quality.py).
+
+The planted-drift contract: a synthetic calibration shift and a slot
+going dark each trip exactly the right ``quality/alarms/*`` within one
+pass, a healthy multi-day stream run trips none, the label-join window
+expiry is counted not crashed, one ``telemetry_scrape`` sweep shows a
+trainer-side alarm fleet-wide, and the jaxpr pins prove the train step
+and serving forward are unchanged with quality collection on."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import flags, monitor, quality
+from paddlebox_tpu.data import DataFeedConfig, SlotConf
+from paddlebox_tpu.data.columnar import instances_to_chunk
+from paddlebox_tpu.data.parser import parse_lines
+from paddlebox_tpu.embedding import TableConfig
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+from paddlebox_tpu.stream import StreamRunner
+from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+
+SLOTS = ("user", "item")
+BS = 32
+ROWS = 96          # rows per event file = one carved pass
+
+
+@pytest.fixture
+def qflags():
+    """Arm quality collection with test-friendly thresholds; restore +
+    reset the global tracker/registry afterwards."""
+    prev = {}
+
+    def set_(**kw):
+        for k in kw:
+            prev.setdefault(k, flags.flag(k))
+        flags.set_flags(kw)
+
+    set_(quality_collect=True, quality_warmup_passes=2,
+         quality_baseline_passes=6, quality_copc_tol=0.5,
+         quality_coverage_drop=0.5)
+    quality.GLOBAL.reset()
+    monitor.reset()
+    try:
+        yield set_
+    finally:
+        flags.set_flags(prev)
+        quality.GLOBAL.reset()
+        monitor.reset()
+
+
+def _feed():
+    return DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=BS)
+
+
+def _trainer():
+    mesh = build_mesh(HybridTopology(dp=8))
+    tr = CTRTrainer(
+        DeepFM(slot_names=SLOTS, emb_dim=8, hidden=(16,)), _feed(),
+        TableConfig(name="emb", dim=8, learning_rate=0.05), mesh=mesh,
+        config=TrainerConfig(dense_learning_rate=1e-3,
+                             auc_num_buckets=1 << 10))
+    tr.init(seed=0)
+    return tr
+
+
+def _write_event_file(log_dir, name, rng, label_fn, *, rows=ROWS,
+                      lo=1, hi=200, slots=SLOTS):
+    """One atomically-appearing log segment; label_fn(rng) -> 0/1."""
+    os.makedirs(log_dir, exist_ok=True)
+    tmp = os.path.join(log_dir, "." + name + ".tmp")
+    with open(tmp, "w") as f:
+        for _ in range(rows):
+            toks = " ".join(f"{s}:{rng.integers(lo, hi)}" for s in slots)
+            f.write(f"{label_fn(rng)} {toks}\n")
+    path = os.path.join(log_dir, name)
+    os.replace(tmp, path)
+    return path
+
+
+def _stream_runner(tr, tmp_path):
+    return StreamRunner(tr, _feed(), str(tmp_path / "out"),
+                        log_dir=str(tmp_path / "events"),
+                        day_of=lambda p: os.path.basename(p).split("-")[0],
+                        shuffle=False, num_reader_threads=1)
+
+
+# -- units --------------------------------------------------------------------
+
+
+def test_log_bucket_rebin_and_offenders():
+    nb = 1000
+    table = np.zeros((2, nb))
+    b = int(0.3 * nb)                 # 10K shows predicted at ~0.3
+    table[0, b] = 7000.0
+    table[1, b] = 3000.0              # actual ctr 0.3 -> calibrated
+    buckets = quality.log_bucket_table(table)
+    assert len(buckets) == 1
+    assert abs(buckets[0]["copc"] - 1.0) < 0.05
+    assert quality.offending_buckets(buckets, tol=0.2) == []
+    # Flip the labels: actual 0.7 at predicted 0.3 -> the bucket must
+    # be NAMED as offending, with its prediction range attached.
+    table[0, b], table[1, b] = 3000.0, 7000.0
+    bad = quality.offending_buckets(quality.log_bucket_table(table),
+                                    tol=0.2)
+    assert len(bad) == 1
+    assert bad[0]["copc"] > 2.0
+    assert bad[0]["lo"] < 0.3 <= bad[0]["hi"]
+    # The calibration error reuses the registry sweep verbatim.
+    from paddlebox_tpu.metrics.registry import bucket_error_sweep
+    assert quality.calibration_error_from_table(table) == \
+        pytest.approx(bucket_error_sweep(table))
+
+
+def test_drift_detector_warmup_then_abrupt_alarm(qflags):
+    d = quality.DriftDetector()
+    # Warmup + gradual convergence: never alarms.
+    for v in (0.6, 0.65, 0.7, 0.74, 0.78):
+        assert d.check("m", v, rel_tol=0.25) is None
+    # Abrupt excursion vs the EWMA baseline: alarms with context.
+    a = d.check("m", 2.5, rel_tol=0.25)
+    assert a is not None and a["value"] == 2.5
+    assert 0.6 <= a["baseline"] <= 0.85
+    # Direction filter: a coverage-style metric only alarms DOWN.
+    for v in (0.9, 0.9, 0.9):
+        d.check("cov", v, rel_tol=0.3, direction="down")
+    assert d.check("cov", 2.0, rel_tol=0.3, direction="down") is None
+    assert d.check("cov", 0.1, rel_tol=0.3, direction="down") is not None
+
+
+def test_slot_health_collector_units():
+    feed = _feed()
+    lines = ([f"0 user:{i % 5 + 1} item:{i + 1}" for i in range(80)]
+             + [f"0 user:{i % 5 + 1}" for i in range(20)])  # item gap
+    chunk = instances_to_chunk(parse_lines(lines, feed), feed)
+    # Zero keys never survive the svm parser — plant them chunk-side
+    # (the collector watches the columnar path, wherever it came from).
+    chunk.sparse_ids["user"][:20] = 0
+    c = quality.SlotHealthCollector()
+    c.observe_chunk(chunk)
+    h = c.finalize()
+    assert h["examples"] == 100
+    u, it = h["slots"]["user"], h["slots"]["item"]
+    assert u["coverage"] == 1.0
+    assert it["coverage"] == pytest.approx(0.8)
+    assert u["zero_frac"] == pytest.approx(0.2)
+    assert u["unique_keys"] == 6       # 5 hot + the planted zero key
+    # user draws from 6 keys -> its head-1% (1 key) owns a fat share;
+    # item ids are all unique -> top share is ~1/n.
+    assert u["top_share"] > it["top_share"]
+    assert it["ids_per_example_p50"] in (0.0, 1.0)
+    assert h["label_oob_frac"] == 0.0
+    assert set(h["_keys"]) == {"user", "item"}
+
+
+# -- the always-on pass_report satellite -------------------------------------
+
+
+def test_pass_report_carries_copc_and_bucket_error(tmp_path):
+    """Satellite pin: calibration lands in EVERY pass report + registry
+    — computed-then-dropped no more — with quality_collect left OFF."""
+    from paddlebox_tpu.data import Dataset
+
+    assert not flags.flag("quality_collect")
+    monitor.reset()
+    rng = np.random.default_rng(0)
+    path = _write_event_file(str(tmp_path), "p0.log", rng,
+                             lambda r: int(r.random() < 0.3))
+    ds = Dataset(_feed(), num_reader_threads=1)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    tr = _trainer()
+    stats = tr.train_pass(ds)
+    rep = stats["pass_report"]
+    for k in ("copc", "bucket_error", "actual_ctr", "predicted_ctr"):
+        assert k in rep and np.isfinite(rep[k]), k
+    assert rep["copc"] == pytest.approx(
+        rep["actual_ctr"] / rep["predicted_ctr"], rel=1e-6)
+    snap = monitor.snapshot()
+    assert snap["pass/train_copc"] == pytest.approx(rep["copc"])
+    assert snap["pass/train_bucket_error"] == rep["bucket_error"]
+    # Off by default: no quality_report was emitted.
+    assert "quality_report" not in stats
+    assert monitor.get("quality/reports") == 0
+    ev = tr.eval_pass(ds)
+    assert monitor.get_gauge("pass/eval_copc") == pytest.approx(
+        ev["copc"])
+    monitor.reset()
+
+
+# -- planted drift over a stream ---------------------------------------------
+
+
+def test_planted_copc_shift_trips_alarm_within_one_pass(tmp_path,
+                                                        qflags):
+    """The acceptance drill: a streamed day with a planted mid-day
+    calibration shift raises quality/alarms/copc within ONE carved
+    pass, the quality_report names the offending prediction buckets,
+    and one telemetry_scrape sweep shows the alarm fleet-wide."""
+    qflags(stream_pass_events=ROWS, stream_pass_window_s=0.0)
+    tr = _trainer()
+    runner = _stream_runner(tr, tmp_path)
+    log_dir = str(tmp_path / "events")
+    rng = np.random.default_rng(1)
+    healthy = lambda r: int(r.random() < 0.3)  # noqa: E731
+    for i in range(5):
+        _write_event_file(log_dir, f"day0-{i:03d}.log", rng, healthy)
+        assert runner.poll_once(flush=True) == 1
+    assert monitor.get("quality/alarms/copc") == 0
+    base_reports = monitor.get("quality/reports")
+    # Mid-day shift: every event converts — actual ctr ~1.0 against
+    # predictions trained at 0.3.
+    _write_event_file(log_dir, "day0-900.log", rng, lambda r: 1)
+    assert runner.poll_once(flush=True) == 1
+    assert monitor.get("quality/alarms/copc") >= 1
+    assert monitor.get("quality/reports") == base_reports + 1
+    rep = quality.GLOBAL.last_report
+    assert rep["day"] == "day0" and rep["pass_id"] == 6
+    assert rep["events"] == ROWS
+    assert any(a["kind"] == "copc" for a in rep["alarms"])
+    assert rep["offending_buckets"], "the shifted buckets must be named"
+    assert all(b["copc"] > 1.0 for b in rep["offending_buckets"])
+
+    # Fleet-wide: ANY framed server in this process answers the base
+    # metrics_snapshot from the global registry — one scrape sweep
+    # shows the trainer's alarm beside everything else.
+    from paddlebox_tpu.core import telemetry_scrape as ts
+    from paddlebox_tpu.distributed import rpc
+    srv = rpc.FramedRPCServer("127.0.0.1:0")
+    try:
+        sweep = ts.scrape_cluster({"trainer": srv.endpoint},
+                                  with_stats=False)
+    finally:
+        srv.stop()
+    assert not sweep["errors"]
+    merged = sweep["merged"]
+    assert merged["counters"]["quality/alarms/copc"] >= 1
+    assert sweep["summary"][0]["quality_alarms"] >= 1
+    assert sweep["cluster"]["quality_alarms"] >= 1
+    assert "copc" in sweep["cluster"]
+
+
+def test_healthy_stream_trips_no_alarms(tmp_path, qflags):
+    """Stationary multi-day traffic through day rollovers: gradual
+    convergence and the sliding per-day key window must trip NOTHING
+    (churn alarm armed; rollover suppression covers the day edge)."""
+    qflags(stream_pass_events=ROWS, stream_pass_window_s=0.0,
+           quality_churn_max=0.9)
+    tr = _trainer()
+    runner = _stream_runner(tr, tmp_path)
+    log_dir = str(tmp_path / "events")
+    rng = np.random.default_rng(2)
+    healthy = lambda r: int(r.random() < 0.3)  # noqa: E731
+    for day in range(3):
+        for i in range(2):
+            _write_event_file(log_dir, f"day{day}-{i:03d}.log", rng,
+                              healthy, lo=1 + day * 50,
+                              hi=200 + day * 50)
+            assert runner.poll_once(flush=True) == 1
+        runner.end_day()
+    snap = monitor.snapshot()
+    alarms = {k: v for k, v in snap.items()
+              if k.startswith("quality/alarms/")}
+    assert not alarms, alarms
+    # The quality plane still observed every pass.
+    assert monitor.get("quality/reports") == 6
+    assert monitor.get_gauge("quality/copc") > 0.0
+    assert "quality/slot_coverage/user" in snap
+
+
+def test_slot_going_dark_trips_slot_dark(tmp_path, qflags):
+    qflags(stream_pass_events=ROWS, stream_pass_window_s=0.0)
+    tr = _trainer()
+    runner = _stream_runner(tr, tmp_path)
+    log_dir = str(tmp_path / "events")
+    rng = np.random.default_rng(3)
+    healthy = lambda r: int(r.random() < 0.3)  # noqa: E731
+    for i in range(4):
+        _write_event_file(log_dir, f"day0-{i:03d}.log", rng, healthy)
+        assert runner.poll_once(flush=True) == 1
+    assert monitor.get("quality/alarms/slot_dark") == 0
+    # The item slot vanishes from the feed (an upstream join broke).
+    _write_event_file(log_dir, "day0-900.log", rng, healthy,
+                      slots=("user",))
+    assert runner.poll_once(flush=True) == 1
+    assert monitor.get("quality/alarms/slot_dark") >= 1
+    rep = quality.GLOBAL.last_report
+    dark = [a for a in rep["alarms"] if a["kind"] == "slot_dark"]
+    assert dark and dark[0]["slot"] == "item"
+    assert rep["slots"]["item"]["coverage"] == 0.0
+    assert monitor.get_gauge("quality/slot_coverage/item") == 0.0
+
+
+# -- serving label join -------------------------------------------------------
+
+
+def test_label_join_window_expiry_counted_not_crashed(qflags):
+    qflags(quality_sample_rate=1.0, quality_join_window_s=10.0,
+           quality_join_pending=4, quality_min_events=10_000)
+    now = [1000.0]
+    reg = monitor.Monitor()
+    q = quality.ServingQuality(registries=(reg,),
+                               clock=lambda: now[0])
+    preds = np.full(8, 0.25)
+    assert q.sample("r1", preds)
+    assert q.sample("r2", preds)
+    now[0] += 60.0                     # both age out of the window
+    assert not q.join("r1", np.ones(8))
+    assert reg.get("quality/label_join_expired") >= 2
+    assert reg.get("quality/label_join_miss") == 1
+    # A fresh sample joins fine.
+    assert q.sample("r3", preds)
+    assert q.join("r3", np.ones(8))
+    assert reg.get("quality/label_joined") == 8
+    # Capacity bound: oldest entries expire counted, never unbounded.
+    for i in range(10):
+        q.sample(f"cap-{i}", preds)
+    assert q.pending() <= 4
+    # An unknown rid is a counted miss, not an error.
+    assert not q.join("never-sampled", np.ones(8))
+
+
+def test_serving_copc_band_alarm_reaches_instance_registry(qflags):
+    qflags(quality_sample_rate=1.0, quality_min_events=32,
+           quality_copc_band=0.3)
+    reg = monitor.Monitor()
+    q = quality.ServingQuality(registries=(reg,), clock=lambda: 0.0)
+    preds = np.full(16, 0.25)
+    for i in range(4):                 # 64 joined rows -> 2 windows
+        rid = f"r{i}"
+        assert q.sample(rid, preds)
+        assert q.join(rid, np.ones(16))   # every impression clicked
+    assert reg.get("quality/alarms/copc") >= 1
+    assert monitor.get("quality/alarms/copc") >= 1
+    assert reg.get_gauge("quality/copc") == pytest.approx(4.0, rel=0.1)
+
+
+def test_predict_rid_sampling_and_labels_rpc(tmp_path, qflags):
+    """End-to-end over the wire: rid-tagged predicts sample on the
+    replica, send_labels joins, the alarm lands in the instance
+    registry, and handle_stats/fleet summarize it."""
+    import jax
+
+    from paddlebox_tpu.serving import (CTRPredictor, PredictClient,
+                                       PredictServer)
+    qflags(quality_sample_rate=1.0, quality_min_events=32,
+           quality_copc_band=0.3)
+    feed = _feed()
+    model = DeepFM(slot_names=SLOTS, emb_dim=8, hidden=())
+    dense = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    keys = np.arange(1, 65, dtype=np.uint64)
+    emb = rng.normal(size=(64, 8)).astype(np.float32) * 0.01
+    w = rng.normal(size=(64,)).astype(np.float32) * 0.01
+    pred = CTRPredictor(model, feed, keys, emb, w, dense,
+                        compute_dtype="float32")
+    srv = PredictServer("127.0.0.1:0", pred)
+    cli = PredictClient(srv.endpoint)
+    try:
+        lines = [f"0 user:{i % 60 + 1} item:{(i * 7) % 60 + 1}"
+                 for i in range(16)]
+        for i in range(4):
+            cli.predict(lines, rid=f"q{i}")
+            out = cli.send_labels(f"q{i}", [1.0] * 16)
+            assert out["joined"]
+        st = cli.stats()
+        assert st["quality_alarms"] >= 1
+        snap = srv.handle_metrics_snapshot({})
+        assert snap["counters"]["quality/alarms/copc"] >= 1
+        from paddlebox_tpu.core.telemetry_scrape import summarize_target
+        row = summarize_target("rep", srv.endpoint, snap)
+        assert row["quality_alarms"] >= 1
+        # A rid the window never saw: counted miss over the wire too.
+        assert not cli.send_labels("ghost", [1.0])["joined"]
+    finally:
+        cli.stop_server()
+        cli.close()
+        srv.stop()
+
+
+# -- slot-AUC satellite -------------------------------------------------------
+
+
+def test_slot_auc_gauges(tmp_path):
+    from paddlebox_tpu.data import Dataset
+    from paddlebox_tpu.train.auc_runner import slot_replacement_eval
+
+    monitor.reset()
+    rng = np.random.default_rng(5)
+    # user carries the label signal; item is noise — the drop ranking
+    # must reflect it AND land in the registry.
+    path = os.path.join(str(tmp_path), "p0")
+    with open(path, "w") as f:
+        for _ in range(BS * 8):
+            u = int(rng.integers(1, 40))
+            it = int(rng.integers(1, 40))
+            label = int(rng.random() < (0.8 if u % 2 else 0.1))
+            f.write(f"{label} user:{u} item:{it}\n")
+    ds = Dataset(_feed(), num_reader_threads=1)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    tr = _trainer()
+    for _ in range(2):
+        tr.train_pass(ds)
+    out = slot_replacement_eval(tr, ds, seed=0)
+    assert out["ranking"][0] == "user"
+    snap = monitor.snapshot()
+    assert snap["quality/base_auc"] == pytest.approx(out["base_auc"])
+    for s in SLOTS:
+        assert snap[f"quality/slot_auc/{s}"] == pytest.approx(
+            out["slots"][s]["auc"])
+        assert snap[f"quality/slot_auc_drop/{s}"] == pytest.approx(
+            out["slots"][s]["auc_drop"])
+    monitor.reset()
+
+
+# -- zero-device-cost pins ----------------------------------------------------
+
+
+def test_quality_on_leaves_step_and_serving_forward_unchanged(qflags):
+    """The jaxpr pin: quality collection is host-side only — the train
+    step and the serving forward trace to identical op counts with
+    FLAGS_quality_collect (and serving sampling) on."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.data.slots import SlotBatch
+    from paddlebox_tpu.embedding import DeviceFeatureStore
+    from paddlebox_tpu.serving.batcher import pack_bucketed
+    from paddlebox_tpu.serving.predictor import CTRPredictor
+    from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
+    from paddlebox_tpu.utils import inspect as pbx_inspect
+
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=8)
+    model = DeepFM(slot_names=SLOTS, emb_dim=8, hidden=())
+
+    def step_op_counts():
+        mesh = build_mesh(HybridTopology(dp=4),
+                          devices=jax.devices()[:4])
+        tr = CTRTrainer(model, feed, TableConfig(dim=8), mesh=mesh,
+                        config=TrainerConfig(auc_num_buckets=1 << 10),
+                        store_factory=lambda c: DeviceFeatureStore(
+                            c, mesh=mesh))
+        tr.init(seed=0)
+        lines = [f"{i % 2} user:{3 + i} item:{4 + i}" for i in range(8)]
+        b = SlotBatch.pack_sharded(parse_lines(lines, feed), feed, 4)
+        tr.engine.feed_pass([
+            np.unique(np.concatenate([b.ids[n] for n in g.slots]))
+            for g in tr.engine.groups])
+        step = tr._build_step()
+        tables = tr.engine.begin_pass()
+        rows = tr._map_batch_rows(b)
+        segs = {n: jnp.asarray(b.segments[n]) for n in b.ids}
+        args = (tables, tr.params, tr.opt_state, tr.auc_state, rows,
+                segs, jnp.asarray(b.labels), jnp.asarray(b.valid),
+                jnp.asarray(_concat_dense_host(b)),
+                jnp.zeros((), jnp.int32))
+        return pbx_inspect.jaxpr_summary(lambda *a: step(*a), *args)
+
+    def fwd_op_counts():
+        rng = np.random.default_rng(0)
+        keys = np.arange(1, 33, dtype=np.uint64)
+        emb = rng.normal(size=(32, 8)).astype(np.float32)
+        w = rng.normal(size=(32,)).astype(np.float32)
+        pred = CTRPredictor(model, feed, keys, emb, w,
+                            model.init(jax.random.PRNGKey(0)),
+                            compute_dtype="float32")
+        batch = pack_bucketed(
+            parse_lines(["0 user:3 item:4", "1 user:5 item:6"], feed),
+            feed)
+        caps = {n: batch.ids[n].shape[0] for n in pred._slot_names}
+        all_ids = np.concatenate(
+            [batch.ids[n] for n in pred._slot_names])
+        looked = pred._index.lookup(all_ids)
+        rows = np.where(looked < 0, pred._table.shape[0] - 1,
+                        looked).astype(np.int32)
+        fwd = pred._build_fwd(caps, batch.batch_size, 0)
+        segs = {n: jnp.asarray(batch.segments[n])
+                for n in pred._slot_names}
+        return pbx_inspect.jaxpr_summary(
+            lambda *a: fwd(*a), pred._table, pred._zero_miss,
+            pred._dense_params, rows, segs,
+            jnp.asarray(_concat_dense_host(batch)))
+
+    flags.set_flags({"quality_collect": False, "quality_sample_rate": 0.0})
+    step_off, fwd_off = step_op_counts(), fwd_op_counts()
+    flags.set_flags({"quality_collect": True, "quality_sample_rate": 1.0})
+    step_on, fwd_on = step_op_counts(), fwd_op_counts()
+    assert step_on == step_off, (step_on, step_off)
+    assert fwd_on == fwd_off, (fwd_on, fwd_off)
+
+
+def test_quality_report_jsonl_and_artifacts(tmp_path, qflags):
+    """With the metrics sink armed, each quality_report appends one
+    labeled snapshot — the scrape/JSONL surface of the quality plane."""
+    from paddlebox_tpu.data import Dataset
+
+    mpath = str(tmp_path / "m.jsonl")
+    qflags(metrics_path=mpath, metrics_flush_interval_s=0.0)
+    rng = np.random.default_rng(0)
+    path = _write_event_file(str(tmp_path), "p0.log", rng,
+                             lambda r: int(r.random() < 0.3))
+    ds = Dataset(_feed(), num_reader_threads=1)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    tr = _trainer()
+    stats = tr.train_pass(ds)
+    assert stats["quality_report"]["copc"] > 0
+    assert "slots" in stats["quality_report"]
+    lines = [json.loads(x) for x in open(mpath).read().splitlines()]
+    q = [ln for ln in lines
+         if ln["labels"].get("event") == "quality_report"]
+    assert q, "quality_report must append a labeled JSONL snapshot"
+    assert q[-1]["gauges"]["quality/copc"] > 0
+    assert q[-1]["counters"]["quality/reports"] == 1
+    monitor.stop_flush_thread()

@@ -58,14 +58,20 @@ _COLS = (("target", 16, "{}"), ("throughput_rps", 9, "{:.1f}"),
          ("replica_lag_worst", 6, "{:.0f}"),
          ("replica_lag_p99", 7, "{:.0f}"), ("shard_rows", 10, "{:.0f}"),
          ("routed", 8, "{}"), ("hop_wire_p99_ms", 9, "{:.2f}"),
-         ("rpc_reconnects", 6, "{}"), ("rpc_retries", 6, "{}"))
+         ("rpc_reconnects", 6, "{}"), ("rpc_retries", 6, "{}"),
+         # Model-quality pane (core/quality.py): served/trained COPC,
+         # calibration error, and the target's quality alarms — model
+         # health in the same scrape as fleet health.
+         ("copc", 6, "{:.3f}"), ("calibration_error", 8, "{:.4f}"),
+         ("quality_alarms", 7, "{}"))
 
 _HEADS = {"target": "target", "throughput_rps": "rps",
           "predict_p99_ms": "p99_ms", "slo_violations": "slo",
           "replica_lag_worst": "lag_w", "replica_lag_p99": "lag_p99",
           "shard_rows": "rows", "routed": "routed",
           "hop_wire_p99_ms": "wire_p99", "rpc_reconnects": "reconn",
-          "rpc_retries": "retry"}
+          "rpc_retries": "retry", "copc": "copc",
+          "calibration_error": "cal_err", "quality_alarms": "q_alarm"}
 
 
 def render(rec: dict, *, clear: bool) -> None:
@@ -76,7 +82,9 @@ def render(rec: dict, *, clear: bool) -> None:
             f"  targets={c['scraped']}/{c['scraped'] + c['unreachable']}")
     for k, label in (("fleet_predict_p99_ms", "fleet p99"),
                      ("fleet_route_p99_ms", "route p99"),
-                     ("replica_lag_worst", "worst lag")):
+                     ("replica_lag_worst", "worst lag"),
+                     ("copc", "copc"),
+                     ("quality_alarms", "q_alarms")):
         v = c.get(k)
         if v is not None:
             head += f"  {label}={v:g}"

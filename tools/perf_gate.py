@@ -60,7 +60,10 @@ HIGHER_SUFFIXES = ("_per_s", "per_sec", "samples_per_s", "auc",
                    "fill_frac",
                    # streaming online mode (bench.py online): fewer
                    # trained passes per hour = staler served models.
-                   "_per_hour")
+                   "_per_hour",
+                   # model-quality plane (r20): a slot's example
+                   # coverage dropping = the slot is going dark.
+                   "_coverage")
 LOWER_SUFFIXES = ("_ms", "_s", "_bytes", "idle_frac",
                   "host_critical_share", "blocked_up_frac",
                   "blocked_down_frac", "violations", "host_syncs",
@@ -71,7 +74,10 @@ LOWER_SUFFIXES = ("_ms", "_s", "_bytes", "idle_frac",
                   # distributed tracing (r19): the rps/keys-per-s cost
                   # of running with the span ring + cluster scrape ON —
                   # telemetry that gets expensive gets turned off.
-                  "overhead_frac")
+                  "overhead_frac",
+                  # model-quality plane (r20): more drift alarms on an
+                  # identical workload = the model got less healthy.
+                  "_alarms")
 # Exact-name entries (dotted-path last segment).
 HIGHER_NAMES = ("value",)  # bench headline — every config is throughput
 # graftlint summary JSON (python -m tools.graftlint --summary): finding
@@ -83,7 +89,13 @@ LOWER_NAMES = ("findings_total", "new", "baselined", "allowed",
                # TTL/decay stopped bounding the table (the freshness
                # quantiles under event_to_servable_ms gate through the
                # "_ms" suffix like every latency).
-               "post_shrink_store_rows")
+               "post_shrink_store_rows",
+               # model-quality plane (r20): calibration error is the
+               # |actual/adjusted - 1| bucket sweep — lower is better.
+               # COPC itself is NOT gated (1.0 is the target; neither
+               # direction is monotonic-better), and skew/churn are
+               # data provenance, never a regression.
+               "calibration_error")
 
 
 def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
@@ -259,6 +271,19 @@ def smoke() -> int:
                           "trace_off_rps": 1900.0,
                           "trace_on_rps": 1860.0,
                           "scrapes": 40},
+            # model-quality keys (r20, bench.py online "quality" block):
+            # calibration_error gates lower-better (exact-name match —
+            # the p99 leaf inherits the parent's direction), alarm
+            # counts lower-better ("_alarms"), slot coverage higher-
+            # better ("_coverage"); copc targets 1.0 (not monotonic)
+            # and skew/churn describe the DATA — all three are
+            # provenance and must NOT gate.
+            "quality": {"copc": 1.0,
+                        "calibration_error": {"p99": 0.05},
+                        "quality_alarms": 0,
+                        "slot_coverage": 0.99,
+                        "skew_top_share": 0.35,
+                        "key_churn": 0.5},
             "steps_per_dispatch": 4,        # not gated (count)
             "ingest_workers": 8,            # not gated (count)
             "store_build_native": True,     # not gated (bool)
@@ -309,6 +334,12 @@ def smoke() -> int:
     bad["stream_passes"] = 2                  # provenance: must NOT gate
     bad["telemetry"]["telemetry_overhead_frac"] = 0.4  # tracing got costly
     bad["telemetry"]["scrapes"] = 3           # provenance: must NOT gate
+    bad["quality"]["calibration_error"]["p99"] = 0.5  # calibration blown
+    bad["quality"]["quality_alarms"] = 7              # drift alarms fired
+    bad["quality"]["slot_coverage"] = 0.2             # a slot went dark
+    bad["quality"]["copc"] = 0.6              # provenance: must NOT gate
+    bad["quality"]["skew_top_share"] = 0.9    # provenance: must NOT gate
+    bad["quality"]["key_churn"] = 0.9         # provenance: must NOT gate
     _, regs = compare(bad, base)
     names = {r["metric"] for r in regs}
     for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
@@ -324,12 +355,16 @@ def smoke() -> int:
                  "event_to_servable_ms.p99",
                  "passes_per_hour",
                  "post_shrink_store_rows",
-                 "telemetry.telemetry_overhead_frac"):
+                 "telemetry.telemetry_overhead_frac",
+                 "quality.calibration_error.p99",
+                 "quality.quality_alarms", "quality.slot_coverage"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
     for never in ("ingest_workers", "store_build_native",
                   "reshard_moved_rows", "replicas.r2.clients",
-                  "stream_passes", "events", "telemetry.scrapes"):
+                  "stream_passes", "events", "telemetry.scrapes",
+                  "quality.copc", "quality.skew_top_share",
+                  "quality.key_churn"):
         expect(f"provenance {never!r} not gated", never in names, False)
     # An IMPROVEMENT must never trip the gate.
     good = json.loads(json.dumps(base))

@@ -128,6 +128,49 @@ def _report_occupancy(gauges: dict) -> None:
     print("  ".join(parts))
 
 
+def _report_quality(gauges: dict, counters: dict) -> None:
+    """Model quality & data health section (core/quality.py): the
+    COPC/calibration headline, every quality alarm counter, and the
+    per-slot health gauges (coverage / zero rate / churn / skew) — so
+    a PROFILE round reads model health beside the stage tables."""
+    qg = {k: v for k, v in gauges.items() if k.startswith("quality/")}
+    qa = {k: v for k, v in counters.items()
+          if k.startswith("quality/")}
+    if not qg and not qa:
+        return
+    print("\nmodel quality & data health")
+    print("-" * 27)
+    head = []
+    for name, label in (("quality/copc", "copc"),
+                        ("quality/calibration_error", "cal_err"),
+                        ("quality/key_churn", "churn"),
+                        ("quality/skew_top_share", "top_share")):
+        v = qg.get(name)
+        if v is not None:
+            head.append(f"{label}={v:.4f}")
+    if head:
+        print("  ".join(head))
+    alarms = {k: v for k, v in qa.items()
+              if k.startswith("quality/alarms/")}
+    if alarms:
+        print("alarms: " + "  ".join(
+            f"{k[len('quality/alarms/'):]}={v}"
+            for k, v in sorted(alarms.items())))
+    slots = sorted({k.rsplit("/", 1)[1] for k in qg
+                    if k.startswith("quality/slot_coverage/")})
+    if slots:
+        hdr = (f"{'slot':<14} {'coverage':>9} {'zero':>7} "
+               f"{'churn':>7} {'top1%':>7} {'auc_drop':>9}")
+        print(hdr)
+        for s in slots:
+            def g(prefix):
+                v = qg.get(f"quality/{prefix}/{s}")
+                return f"{v:.4f}" if isinstance(v, (int, float)) else "-"
+            print(f"{s:<14} {g('slot_coverage'):>9} "
+                  f"{g('slot_zero_frac'):>7} {g('slot_churn'):>7} "
+                  f"{g('slot_top_share'):>7} {g('slot_auc_drop'):>9}")
+
+
 def _report_quantiles(quantiles: dict) -> None:
     """Streaming-digest percentiles (core/quantiles.py): exact-count,
     rel-error-bounded p50/p90/p99/p999 — the dispatch-latency and
@@ -175,6 +218,7 @@ def report_metrics(path: str) -> None:
                   f"{(h['max'] if h['max'] is not None else 0):>9.3f}")
     _report_quantiles(last.get("quantiles", {}))
     _report_occupancy(last.get("gauges", {}))
+    _report_quality(last.get("gauges", {}), last.get("counters", {}))
     gauges = last.get("gauges", {})
     if gauges:
         print(f"\n{'gauge':<44} {'value':>14}")
