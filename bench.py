@@ -1722,6 +1722,103 @@ def _bench_multihost_failover(cfg, keys) -> dict:
 
 ONLINE_DAYS = 3                  # replayed log days (TTL needs >= 3)
 ONLINE_PASS_FILES = 2            # files per carved incremental pass
+# ---------------------------------------------------------------------------
+# RPC plane microbench (`bench.py rpc`): the event-loop/mux wire (RPC.md)
+# ---------------------------------------------------------------------------
+
+RPC_DEPTHS = (1, 4, 16)
+RPC_PAYLOAD_F32 = ({"64b": 16, "64kb": 16384} if _SMALL
+                   else {"64b": 16, "64kb": 16384, "1mb": 262144})
+RPC_WINDOWS = 60 if _SMALL else 400
+
+
+def bench_rpc() -> dict:
+    """Echo RTT ladder over one loopback FramedRPCServer: payload size
+    × outstanding depth × wire plane ({legacy: v1 frames, one call per
+    RTT (depth > 1 = the old thread-per-call fan-out); mux: v2
+    request-id multiplexing, ``call_async`` pipelining on ONE socket;
+    sg: mux + zero-copy scatter/gather array frames}). Per cell:
+    calls_per_s, the window-completion p50/p99, and payload bytes/s —
+    all pinned by tools/perf_gate.py. The headline is mux calls_per_s
+    at depth ≥ 2; ``mux_over_legacy_at_o4`` records the pipelining win
+    that motivated the mux wire (provenance, not gated)."""
+    from paddlebox_tpu.core import monitor
+    from paddlebox_tpu.distributed import rpc
+
+    class _EchoServer(rpc.FramedRPCServer):
+        service_name = "rpc-bench"
+
+        def handle_echo(self, req):
+            return {"a": req["a"]}
+
+    modes = {
+        "legacy": {"rpc_mux": False, "rpc_sg_min_bytes": -1},
+        "mux": {"rpc_mux": True, "rpc_sg_min_bytes": -1},
+        "sg": {"rpc_mux": True, "rpc_sg_min_bytes": 4096},
+    }
+    prev = {k: flags.flag(k) for k in ("rpc_mux", "rpc_sg_min_bytes")}
+    out_modes = {}
+    sg0 = monitor.GLOBAL.get("rpc/sg_frames")
+    try:
+        for mode, fl in modes.items():
+            _tick(f"rpc:{mode}")
+            flags.set_flags(fl)
+            srv = _EchoServer("127.0.0.1:0")
+            conn = rpc.FramedRPCConn(srv.endpoint, timeout=60.0,
+                                     service_name="rpc-bench",
+                                     idempotent=("echo",))
+            cells = {}
+            try:
+                for pname, n in RPC_PAYLOAD_F32.items():
+                    a = np.arange(n, dtype=np.float32)
+                    per_call = a.nbytes * 2  # request + echoed reply
+                    windows = max(20, RPC_WINDOWS // max(1, n // 4096))
+                    conn.call("echo", a=a)  # warm connect + caps
+                    for depth in RPC_DEPTHS:
+                        walls = []
+                        t0 = time.perf_counter()
+                        for _ in range(windows):
+                            w0 = time.perf_counter()
+                            if depth == 1:
+                                conn.call("echo", a=a)
+                            else:
+                                futs = [conn.call_async("echo", a=a)
+                                        for _ in range(depth)]
+                                for f in futs:
+                                    f.result()
+                            walls.append(time.perf_counter() - w0)
+                        dt = time.perf_counter() - t0
+                        calls = windows * depth
+                        cells[f"{pname}_o{depth}"] = {
+                            "calls_per_s": round(calls / dt, 1),
+                            "p50_ms": round(float(
+                                np.percentile(walls, 50)) * 1e3, 3),
+                            "p99_ms": round(float(
+                                np.percentile(walls, 99)) * 1e3, 3),
+                            "bytes_per_s": round(
+                                calls * per_call / dt, 1),
+                        }
+            finally:
+                conn.close()
+                srv.stop()
+                srv.close_connections()
+            out_modes[mode] = cells
+    finally:
+        flags.set_flags(prev)
+    mux_r = out_modes["mux"]["64b_o4"]["calls_per_s"]
+    leg_r = out_modes["legacy"]["64b_o4"]["calls_per_s"]
+    return {
+        "metric": "rpc_echo_mux_calls_per_sec",
+        "value": mux_r,
+        "unit": "calls/s",
+        "windows": RPC_WINDOWS,                       # provenance
+        "mux_over_legacy_at_o4": round(
+            mux_r / max(leg_r, 1e-9), 3),             # provenance
+        "sg_frames": int(monitor.GLOBAL.get("rpc/sg_frames") - sg0),
+        "modes": out_modes,
+    }
+
+
 ONLINE_FILES_PER_DAY = 4 if _SMALL else 8
 ONLINE_BATCH = 128 if _SMALL else 512
 ONLINE_ROWS_PER_FILE = ONLINE_BATCH * (2 if _SMALL else 4)
@@ -1883,6 +1980,7 @@ CONFIGS = {
     "serve": bench_serving,  # alias: `bench.py serve --clients 1,8,32`
     "multihost": bench_multihost,  # `bench.py multihost --hosts N`
     "online": bench_online,        # streaming freshness/lifecycle mode
+    "rpc": bench_rpc,              # event-loop/mux wire echo ladder
 }
 
 

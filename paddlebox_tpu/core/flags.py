@@ -668,6 +668,50 @@ define_flag("quality_churn_max", 0.0,
             "last pass) above which quality/alarms/churn raises; "
             "suppressed for the first pass after a day rollover (the "
             "per-day key window slides by design). 0 (default) = off")
+define_flag("rpc_mux", True,
+            "negotiate the multiplexed v2 wire on connect (one "
+            "wire_caps probe per connect): frames carry an in-flight "
+            "request id so ONE socket serves N outstanding calls "
+            "(call_async/futures) and the per-replica conn pools "
+            "collapse to one mux'd conn. A peer that does not answer "
+            "the probe keeps the blocking v1 protocol — mixed-version "
+            "clusters interoperate per-connection. False = always "
+            "speak v1 (the pre-r21 one-RTT-per-call plane)")
+define_flag("rpc_worker_threads", 4,
+            "bounded worker-pool size of the event-loop FramedRPCServer: "
+            "device-touching/blocking handlers (pull, push, predict) "
+            "dispatch to at most this many worker threads per server "
+            "while cheap handlers (stats, clock_probe, metrics_snapshot, "
+            "contains) run inline on the poller thread")
+define_flag("rpc_sg_min_bytes", 4096,
+            "ndarray payload bytes above which a v2 frame switches to "
+            "the zero-copy scatter/gather encoding: arrays ride as "
+            "64B-aligned trailing segments sent via sendmsg (no "
+            "payload-sized join copy) and are received into the "
+            "frame's preallocated buffer (decoded as views, no "
+            "intermediate copy). < 0 disables SG frames (mux frames "
+            "still carry request ids)")
+define_flag("rpc_shm", False,
+            "co-located-process shortcut for SG array frames: when "
+            "both peers sit on the loopback interface, array segments "
+            "ride a one-shot shared-memory block (name on the wire, "
+            "receiver attaches/unlinks) instead of the socket. "
+            "Off by default — a receiver that dies between frame and "
+            "attach leaks the segment until sweep_orphans")
+define_flag("rpc_shm_min_bytes", 65536,
+            "ndarray payload bytes above which an shm-eligible frame "
+            "(FLAGS_rpc_shm, loopback peer) actually uses the shared-"
+            "memory path; smaller payloads stay on the socket where "
+            "the segment setup cost would dominate")
+define_flag("multihost_coalesce_window_ms", 0.0,
+            "shard-server coalescing window for concurrent pull/"
+            "pull_serving requests: requests for the same slot arriving "
+            "within the window merge into ONE union-key store lookup "
+            "(the serving micro-batcher pattern applied to the shard "
+            "tier; results scatter back per request, bit-identical to "
+            "serial). 0 (default) = opportunistic — no added latency, "
+            "merge only what queued while the previous lookup ran; "
+            "< 0 disables coalescing entirely")
 define_flag("rpc_retry_deadline_s", 30.0,
             "overall wall-clock deadline across an idempotent call's "
             "retries: when exceeded the last connection error raises "

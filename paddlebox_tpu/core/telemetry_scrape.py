@@ -124,6 +124,18 @@ def summarize_target(label: str, endpoint: str,
     for k in ("rpc_reconnects", "rpc_retries", "num_features", "keys"):
         if isinstance(stats.get(k), (int, float)):
             row[k] = int(stats[k])
+    # RPC-plane health (PR 16 event-loop servers): poller-loop lag and
+    # worker-queue depth say "is the one poller keeping up"; coalesced
+    # pulls and mux fallbacks say the optimization planes are engaged.
+    for k, name in (("rpc_poller_lag_ms", "rpc/poller_lag_ms"),
+                    ("rpc_worker_queue", "rpc/worker_queue_depth")):
+        v = gauges.get(name)
+        if isinstance(v, (int, float)):
+            row[k] = round(float(v), 3)
+    for k, name in (("rpc_mux_fallbacks", "rpc/mux_fallbacks"),
+                    ("coalesced_pulls", "multihost/coalesced_pulls")):
+        if counters.get(name):
+            row[k] = int(counters[name])
     # Model-quality pane (core/quality.py): COPC / calibration error
     # gauges plus the target's total quality alarms — "is the model
     # healthy" answered in the same row as "is the target healthy".

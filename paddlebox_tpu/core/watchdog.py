@@ -176,20 +176,31 @@ class Watchdog:
             self._on_stall(phase, idle)
             return
         # Default action: forensics into the log, then abort the armed
-        # thread through the normal exception path. The in-flight RPC
-        # table (rpc.inflight_table via the forensics provider) leads:
-        # a stall blocked on a dead peer should name the REMOTE first,
-        # not bury it under local thread stacks.
+        # thread through the normal exception path. The RPC plane leads
+        # (rpc.poller_table / rpc.inflight_table via the forensics
+        # providers): a stall in the event-loop plane should name the
+        # POLLER THREAD and its deepest worker queue first — a wedged
+        # poller or a backed-up worker pool stalls every conn it owns —
+        # then the in-flight remotes (a stall blocked on a dead peer
+        # should name the REMOTE, not bury it under thread stacks).
         fx = trace.stall_forensics()
+        pollers = fx.get("rpc_pollers") or []
+        plane = "; ".join(
+            f"{p['service']}@{p['endpoint']} thread={p['thread']} "
+            f"queue={p['worker_queue_depth']} "
+            f"lag={p['loop_lag_ms']:.1f}ms conns={p['conns']}"
+            for p in pollers if isinstance(p, dict)) or "none"
         inflight = fx.get("inflight_rpcs") or []
         remote = "; ".join(
             f"{e['service']}.{e['method']} -> {e['endpoint']} "
-            f"(in flight {e['age_s']:.1f}s)"
+            f"(in flight {e['age_s']:.1f}s, "
+            f"{e.get('outstanding', 1)} outstanding)"
             for e in inflight if isinstance(e, dict)) or "none"
         log.warning(
-            "%s: no progress in phase %r for %.0fs — in-flight RPCs: "
-            "%s — dumping stall forensics and aborting the pass:\n%s",
-            self.name, phase, idle, remote,
+            "%s: no progress in phase %r for %.0fs — rpc pollers "
+            "(deepest queue first): %s — in-flight RPCs: %s — dumping "
+            "stall forensics and aborting the pass:\n%s",
+            self.name, phase, idle, plane, remote,
             "\n".join(fx.get("thread_stacks", [])))
         target = self._target
         if target is not None and _async_raise(target, StallError):

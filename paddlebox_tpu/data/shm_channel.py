@@ -72,6 +72,13 @@ def seg_name(parent_pid: int, load_id: int, worker_id: int,
     return f"{NAME_PREFIX}-{parent_pid}-{load_id}-{worker_id}-{serial}"
 
 
+def untrack(shm: shared_memory.SharedMemory) -> None:
+    """Public alias of :func:`_untrack` for other explicit-unlink
+    protocols (the RPC plane's one-shot FLAG_SHM frames hand unlink
+    ownership to the receiving process the same way)."""
+    _untrack(shm)
+
+
 def _untrack(shm: shared_memory.SharedMemory) -> None:
     """Remove a CREATED segment from this process's resource_tracker:
     lifetime is managed by the explicit unlink protocol above, and the

@@ -1,16 +1,39 @@
-"""Shared framed-RPC skeleton for the wire-protocol services.
+"""Shared framed-RPC plane for the wire-protocol services.
 
-The PS (``distributed/ps.py``), graph (``graph/service.py``), and
-serving (``serving/service.py``) services all speak the same
-length-prefixed typed-frame protocol (``distributed/wire.py``) with the
-same loop shape: accept → per-connection thread → dispatch
-``handle_<method>`` → ``{ok, result|error}`` reply. This base collects
-that loop ONCE so protocol hardening (malformed-payload handling, frame
-errors, shutdown semantics) cannot drift between services — the role of
-brpc's common service plumbing under the reference's PS/graph stubs
+The PS (``distributed/ps.py``), graph (``graph/service.py``), shard
+(``multihost/shard_service.py``), and serving (``serving/service.py``)
+services all speak the same length-prefixed typed-frame protocol
+(``distributed/wire.py``). This module collects the transport ONCE so
+protocol hardening (malformed-payload handling, frame errors, shutdown
+semantics) cannot drift between services — the role of brpc's common
+service plumbing under the reference's PS/graph stubs
 (``sendrecv.proto`` services share one server loop there too).
 
-Robustness contract of the loop:
+Server: an EVENT LOOP, not thread-per-connection. ONE poller thread
+(``selectors``) owns accept/read/write for every connection; decoded
+requests go to a bounded worker pool (``FLAGS_rpc_worker_threads``)
+only for device-touching/blocking handlers, while cheap handlers
+(``POLLER_INLINE``: stats, clock_probe, metrics_snapshot, contains,
+wire_caps) run inline on the poller. Payload bytes are received
+straight into one preallocated buffer per frame (``recv_into`` — no
+chunk-join copies) and replies are scatter/gather ``sendmsg`` buffer
+lists, so a large ndarray reply is never materialized into a second
+flat payload. Selector registrations are mutated ONLY on the poller
+thread; workers hand completions back through a command queue and a
+socketpair wakeup.
+
+Client: a ``FramedRPCConn`` negotiates the MULTIPLEXED v2 wire on
+connect (a ``wire_caps`` probe sent as a plain v1 frame — an old server
+answers with an in-band error and the client falls back to the blocking
+v1 discipline, counted by ``rpc/mux_fallbacks``, so mixed-version
+clusters interoperate). On the mux plane every frame carries an
+in-flight request id: N calls can be outstanding per socket
+(``call_async``/futures), a dedicated reader thread matches replies out
+of order, and array-heavy payloads ride zero-copy scatter/gather
+(FLAG_SG) or shared-memory (FLAG_SHM, co-located processes,
+``FLAGS_rpc_shm``) frames.
+
+Robustness contract (unchanged from the blocking plane):
 - a payload that is not a ``{"method": str, ...}`` dict gets an error
   REPLY (not a dropped connection — a malformed request must not strand
   the client until its socket timeout);
@@ -19,41 +42,57 @@ Robustness contract of the loop:
 - wire-protocol violations drop the connection (a corrupt
   length-prefixed stream cannot be resynchronized);
 - ``_after_reply()`` hooks post-response actions (the PS ``stop`` RPC
-  closes its listener only AFTER the acknowledgement is on the wire).
+  closes its listener only AFTER the acknowledgement is on the wire);
+- v1 requests are answered strictly IN ORDER per connection (a v1
+  client matches replies by order, so the event loop serializes that
+  connection's v1 dispatches even when handlers run on the pool).
 
 Distributed tracing (OBSERVABILITY.md "Distributed tracing"): when the
 CLIENT process has tracing on, every request dict carries a compact
 ``_trace`` context (``{tid, sid, origin}``) that the server loop pops,
 installs thread-locally for the handler's duration, and records as a
-``rpc/<method>`` server span whose ``parent`` is the client's span id —
-so one predict's trace id follows it through router → replica → shard
-hops, and ``tools/trace_report.py --merge`` can draw the cross-process
-flow arrows. Every reply also carries ``_server_ms`` (handler wall),
-letting any client decompose its observed latency into server vs wire
-share without a second RPC. With tracing off the client attaches
-nothing and the per-call cost is one cached-bool check.
+``rpc/<method>`` server span whose ``parent`` is the client's span id.
+Every reply also carries ``_server_ms`` (handler wall), letting any
+client decompose its observed latency into server vs wire share without
+a second RPC; on a SHARED mux connection the decomposition
+(``last_server_ms``/``last_wire_ms``) is thread-local, so concurrent
+callers each read their own call's split.
 
-Two always-on observability surfaces (RPCs are not the jitted hot
-loop): the module-level IN-FLIGHT CALL TABLE (``inflight_table()`` —
-peer endpoint, method, age; registered as a ``trace.stall_forensics``
-provider so a watchdog stall names the remote it is stuck on), and
-per-method reconnect/retry counters (``rpc/reconnects/<method>``,
-``rpc/retries/<method>`` beside the long-standing totals) so a
-failover drill can assert exactly which method consumed the retry
-budget.
+Retry/reconnect (unchanged): a dropped/half-read/desynced stream closes
+the socket; the NEXT call re-resolves (``resolve=`` hook) and
+reconnects. Methods named in ``idempotent`` retry with capped
+exponential backoff bounded by ``FLAGS_rpc_max_retries`` AND
+``FLAGS_rpc_retry_deadline_s``; non-idempotent methods never auto-retry
+a call whose request may have executed.
+
+Always-on observability (RPCs are not the jitted hot loop): the
+module-level IN-FLIGHT CALL TABLE (``inflight_table()`` — peer
+endpoint, method, age, per-endpoint outstanding depth) and the POLLER
+TABLE (``poller_table()`` — per-server poller thread name, loop lag,
+worker-queue depth), both registered as ``trace.stall_forensics``
+providers so a watchdog stall names the remote or the wedged poller
+first; per-method reconnect/retry counters
+(``rpc/reconnects/<method>``, ``rpc/retries/<method>``) beside the
+long-standing totals.
 """
 
 from __future__ import annotations
 
 import itertools
+import os
+import selectors
 import socket
 import threading
 import time
+import weakref
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, FrozenSet, Iterable, List, Optional
 
 from paddlebox_tpu.core import faults, flags, log, monitor, trace
 from paddlebox_tpu.distributed import wire
-from paddlebox_tpu.distributed.transport import _recv_exact
+from paddlebox_tpu.distributed.transport import (_recv_exact,
+                                                 _recv_into_exact)
 
 # -- in-flight RPC table ------------------------------------------------------
 
@@ -76,120 +115,558 @@ def _inflight_exit(token: int) -> None:
 
 
 def inflight_table() -> List[Dict[str, Any]]:
-    """Every RPC currently blocked on a peer: endpoint, method, service,
-    age. The watchdog's stall forensics include this (oldest first), so
-    a hang past FLAGS_stall_timeout_s names the remote, not just the
-    local thread stacks."""
+    """Every RPC currently awaiting a peer's reply: endpoint, method,
+    service, age, and ``outstanding`` — how many calls this process has
+    in flight to that same endpoint (the mux depth). The watchdog's
+    stall forensics include this (oldest first), so a hang past
+    FLAGS_stall_timeout_s names the remote and the deepest pipe, not
+    just the local thread stacks."""
     now = time.monotonic()
     with _INFLIGHT_LOCK:
         entries = list(_INFLIGHT.values())
+    depth: Dict[str, int] = {}
+    for e in entries:
+        depth[e["endpoint"]] = depth.get(e["endpoint"], 0) + 1
     out = [{"endpoint": e["endpoint"], "method": e["method"],
-            "service": e["service"], "age_s": round(now - e["t0"], 3)}
+            "service": e["service"], "age_s": round(now - e["t0"], 3),
+            "outstanding": depth[e["endpoint"]]}
            for e in entries]
-    out.sort(key=lambda e: -e["age_s"])
+    out.sort(key=lambda e: (-e["outstanding"], -e["age_s"]))
     return out
 
 
 trace.register_forensics_provider("inflight_rpcs", inflight_table)
 
+# -- poller table -------------------------------------------------------------
+
+_SERVERS: "weakref.WeakSet[FramedRPCServer]" = weakref.WeakSet()
+
+
+def poller_table() -> List[Dict[str, Any]]:
+    """One row per live FramedRPCServer in this process: poller thread
+    name, current loop lag (how long the poller has been processing
+    without re-entering ``select`` — a wedged inline handler shows up
+    here), worker-queue depth, and connection count. Deepest queue
+    first; a stalled server names its poller thread in the watchdog's
+    forensics before any thread stack."""
+    now = time.monotonic()
+    out = []
+    for srv in list(_SERVERS):
+        try:
+            out.append(srv._poller_stats(now))
+        except Exception:  # a half-torn-down server must not break forensics
+            continue
+    out.sort(key=lambda r: (-r["worker_queue_depth"], -r["loop_lag_ms"]))
+    return out
+
+
+trace.register_forensics_provider("rpc_pollers", poller_table)
+
+
+def _host_id() -> str:
+    """Machine identity for the co-located-process shm shortcut: two
+    peers exchange this in ``wire_caps`` and enable FLAG_SHM only on an
+    exact match (boot id beats hostname — containers can share names)."""
+    global _HOST_ID
+    if _HOST_ID is None:
+        tag = ""
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                tag = f.read().strip()
+        except OSError:
+            pass
+        _HOST_ID = f"{socket.gethostname()}|{tag}"
+    return _HOST_ID
+
+
+_HOST_ID: Optional[str] = None
+_SHM_IDS = itertools.count(1)
+
+
+def _pack_shm_frame(obj: Any, rid: int) -> bytes:
+    """Encode one FLAG_SHM frame: arrays land in a fresh one-shot
+    SharedMemory block whose unlink OWNERSHIP transfers to the receiver
+    (this side untracks it, shm_channel discipline)."""
+    from multiprocessing import shared_memory
+    from paddlebox_tpu.data import shm_channel
+    _, arrays = wire.dumps_sg(obj)
+    _, total = wire.sg_plan(arrays)
+    shm = shared_memory.SharedMemory(
+        create=True, size=total,
+        name=f"pbx-rpc-{os.getpid()}-{next(_SHM_IDS)}")
+    try:
+        frame, _ = wire.pack_frame_shm(obj, rid, shm.name, shm.buf)
+    except BaseException:
+        shm.close()
+        try:
+            shm.unlink()
+        except OSError:
+            pass
+        raise
+    shm_channel.untrack(shm)
+    shm.close()
+    return frame
+
+
+def _consume_shm(payload: memoryview) -> Any:
+    """Decode one FLAG_SHM payload, then close AND unlink its one-shot
+    block (the arrays were copied out by ``wire.loads_shm``)."""
+    from multiprocessing import shared_memory
+    holder: Dict[str, Any] = {}
+
+    def attach(name: str):
+        shm = shared_memory.SharedMemory(name=name)
+        holder["shm"] = shm
+        return shm.buf
+
+    try:
+        return wire.loads_shm(payload, attach)
+    finally:
+        shm = holder.get("shm")
+        if shm is not None:
+            shm.close()
+            try:
+                shm.unlink()
+            except OSError:
+                pass
+
+
+def _decode_v2_payload(fl: int, payload: bytearray):
+    """(req_id, value) from a v2 payload buffer, honoring SG/SHM flags.
+    ``rpc/sg_recv`` is the segmented-receive faultpoint — the window a
+    crash drill kills in the middle of a scatter/gather frame."""
+    if fl & wire.FLAG_SHM:
+        faults.faultpoint("rpc/sg_recv")
+        return _consume_shm(memoryview(payload))
+    if fl & wire.FLAG_SG:
+        faults.faultpoint("rpc/sg_recv")
+        # Arrays decode as VIEWS over `payload`; the bytearray stays
+        # alive as long as any of them does.
+        return wire.loads_sg(memoryview(payload))
+    return wire.loads_v2(payload)
+
+
+def _sendmsg_all(sock: socket.socket, bufs: List[Any]) -> None:
+    """Gather-send every buffer (sendmsg may stop short; resume from
+    the trim point). The blocking-socket sibling of the poller's
+    incremental flush."""
+    pending = deque(bufs)
+    while pending:
+        batch = list(itertools.islice(pending, 0, 64))
+        sent = sock.sendmsg(batch)
+        _trim_sent(pending, sent)
+
+
+def _trim_sent(pending: deque, sent: int) -> None:
+    while sent > 0 and pending:
+        head = pending[0]
+        n = len(head) if not isinstance(head, memoryview) else head.nbytes
+        if sent >= n:
+            pending.popleft()
+            sent -= n
+        else:
+            mv = head if isinstance(head, memoryview) else memoryview(head)
+            pending[0] = mv[sent:]
+            sent = 0
+
+
+class _Conn:
+    """Per-connection state owned by the poller thread."""
+
+    __slots__ = ("sock", "peer", "hbuf", "pver", "pflags", "plen", "pbuf",
+                 "pview", "pfill", "out", "wreg", "close_after_flush",
+                 "dead", "v1_busy", "v1_backlog", "peer_sg", "peer_shm")
+
+    def __init__(self, sock: socket.socket, peer: str):
+        self.sock = sock
+        self.peer = peer
+        self.hbuf = bytearray()        # partial frame header
+        self.pver = 0
+        self.pflags = 0
+        self.plen = 0
+        self.pbuf: Optional[bytearray] = None   # preallocated payload
+        self.pview: Optional[memoryview] = None
+        self.pfill = 0
+        self.out: deque = deque()      # reply buffers awaiting flush
+        self.wreg = False              # EVENT_WRITE registered
+        self.close_after_flush = False
+        self.dead = False
+        self.v1_busy = False           # a v1 request is being served
+        self.v1_backlog: deque = deque()
+        self.peer_sg = False           # negotiated via wire_caps
+        self.peer_shm = False
+
 
 class FramedRPCServer:
-    """Socket server dispatching typed frames to ``handle_<method>``."""
+    """Event-loop socket server dispatching typed frames to
+    ``handle_<method>``: one poller thread owns every socket, a bounded
+    worker pool runs the blocking handlers."""
 
     # Subclasses set this for log attribution ("ps[3]", "graph[0]", ...).
     service_name: str = "rpc"
+
+    #: Methods cheap and non-blocking enough to run ON the poller thread
+    #: (no device work, at most a brief lock): a stats scrape or clock
+    #: probe answers even while every worker is wedged on device work.
+    POLLER_INLINE: FrozenSet[str] = frozenset(
+        {"stats", "clock_probe", "metrics_snapshot", "contains",
+         "wire_caps"})
 
     def __init__(self, endpoint: str, *, backlog: int = 32):
         host, port = endpoint.rsplit(":", 1)
         self._server = socket.create_server((host, int(port)),
                                             backlog=backlog)
+        self._server.setblocking(False)
         self.endpoint = f"{host}:{self._server.getsockname()[1]}"
         self._running = True
-        # Live accepted sockets: close_connections() lets an in-process
-        # "host death" (tests/drills) sever established conns the way a
-        # SIGKILL would — stop() alone only closes the LISTENER, and a
-        # persistent client conn would otherwise get one more reply
-        # from the "dead" host.
-        self._conns: set = set()
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._server, selectors.EVENT_READ, None)
+        # Wakeup pipe: workers (and cross-thread stop/close calls) post
+        # a command and write one byte; ONLY the poller thread ever
+        # mutates selector registrations or _Conn state.
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._cmds: deque = deque()
+        self._conns: Dict[socket.socket, _Conn] = {}
         self._conns_lock = threading.Lock()
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        self._workers: Optional[ThreadPoolExecutor] = None
+        self._queue_depth = 0          # requests handed to the pool
+        self._busy_since: Optional[float] = None
+        _SERVERS.add(self)
+        self._poller = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name=f"rpc-poller-{self.endpoint}")
+        self._poller.start()
 
-    def _accept_loop(self) -> None:
-        while self._running:
+    # -- poller loop -------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while True:
             try:
-                conn, _ = self._server.accept()
+                events = self._sel.select()
+                # graftlint: allow-lock(poller-owned stamp: single writer, float slot — forensics reader tolerates a torn instant)
+                self._busy_since = time.monotonic()
+                for key, mask in events:
+                    data = key.data
+                    if data is None:
+                        self._do_accept()
+                    elif data == "wake":
+                        self._drain_wake()
+                    else:
+                        cs: _Conn = data
+                        if mask & selectors.EVENT_WRITE and not cs.dead:
+                            self._flush(cs)
+                        if mask & selectors.EVENT_READ and not cs.dead:
+                            self._do_read(cs)
+                monitor.set_gauge(
+                    "rpc/poller_lag_ms",
+                    round((time.monotonic() - self._busy_since) * 1e3, 3))
+            except Exception as e:  # the poller must survive anything
+                log.warning("%s: poller error: %r", self.service_name, e)
+            finally:
+                self._busy_since = None
+            if (not self._running and self._server is None
+                    and not self._conns):
+                break
+        self._teardown()
+
+    def _teardown(self) -> None:
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
             except OSError:
+                pass
+        if self._workers is not None:
+            self._workers.shutdown(wait=False)
+
+    def _post(self, fn: Callable[[], None]) -> None:
+        self._cmds.append(fn)
+        self._wake()
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+
+    def _drain_wake(self) -> None:
+        faults.faultpoint("rpc/poller_wakeup")
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except BlockingIOError:
+            pass
+        while True:
+            try:
+                fn = self._cmds.popleft()
+            except IndexError:
+                break
+            fn()
+
+    def _do_accept(self) -> None:
+        srv = self._server
+        if srv is None:
+            return
+        while True:
+            try:
+                sock, addr = srv.accept()
+            except BlockingIOError:
                 return
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
-
-    def close_connections(self) -> None:
-        """Abruptly sever every established connection (kill-like
-        teardown for drills; graceful stops keep draining replies)."""
-        with self._conns_lock:
-            conns, self._conns = set(self._conns), set()
-        for c in conns:
+            except OSError:
+                self._close_listener()
+                return
+            sock.setblocking(False)
             try:
-                c.shutdown(socket.SHUT_RDWR)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 pass
-            try:
-                c.close()
-            except OSError:
-                pass
-
-    def _serve(self, conn: socket.socket) -> None:
-        try:
-            self._serve_inner(conn)
-        finally:
+            cs = _Conn(sock, f"{addr[0]}:{addr[1]}")
             with self._conns_lock:
-                self._conns.discard(conn)
+                self._conns[sock] = cs
+            self._sel.register(sock, selectors.EVENT_READ, cs)
 
-    def _serve_inner(self, conn: socket.socket) -> None:
+    def _close_listener(self) -> None:
+        srv = self._server
+        if srv is None:
+            return
+        # graftlint: allow-lock(poller-owned: only the poller clears it; stop() reads a stale fd at worst and shutdown is idempotent)
+        self._server = None
         try:
-            with conn:
-                while True:
-                    ln = wire.read_frame_header(
-                        _recv_exact(conn, wire.HEADER.size))
-                    req = wire.loads(_recv_exact(conn, ln))
-                    method = (req.get("method")
-                              if isinstance(req, dict) else None)
-                    if not isinstance(method, str):
-                        conn.sendall(wire.pack_frame(
-                            {"ok": False,
-                             "error": "request must be a dict with a "
-                                      "str 'method'"}))
-                        continue
-                    tctx = req.pop("_trace", None)
-                    t0 = time.perf_counter()
-                    try:
-                        out = self._dispatch(method, req, tctx)
-                        conn.sendall(wire.pack_frame(
-                            {"ok": True, "result": out,
-                             # Server share of the caller's observed
-                             # latency: total - _server_ms = wire+queue,
-                             # the per-hop decomposition every client
-                             # gets for free.
-                             "_server_ms": round(
-                                 (time.perf_counter() - t0) * 1e3, 3)}))
-                    except Exception as e:  # report in-band, keep serving
-                        log.vlog(0, "%s %s failed: %s", self.service_name,
-                                 method, e)
-                        conn.sendall(wire.pack_frame(
-                            {"ok": False, "error": repr(e)}))
-                    if self._after_reply():
+            self._sel.unregister(srv)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            srv.close()
+        except OSError:
+            pass
+
+    # -- read side ---------------------------------------------------------
+
+    def _do_read(self, cs: _Conn) -> None:
+        try:
+            while not cs.dead:
+                if cs.pbuf is None:
+                    chunk = cs.sock.recv(wire.HEADER.size - len(cs.hbuf))
+                    if not chunk:
+                        raise ConnectionError("peer closed")
+                    cs.hbuf += chunk
+                    if len(cs.hbuf) < wire.HEADER.size:
                         return
+                    ver, fl, ln = wire.read_any_header(bytes(cs.hbuf))
+                    cs.hbuf.clear()
+                    cs.pver, cs.pflags, cs.plen = ver, fl, ln
+                    cs.pbuf = bytearray(ln)
+                    cs.pview = memoryview(cs.pbuf)
+                    cs.pfill = 0
+                    if ln == 0:
+                        self._on_frame(cs)
+                else:
+                    n = cs.sock.recv_into(cs.pview[cs.pfill:])
+                    if n == 0:
+                        raise ConnectionError("peer closed")
+                    cs.pfill += n
+                    if cs.pfill == cs.plen:
+                        self._on_frame(cs)
+        except BlockingIOError:
+            return
         except wire.WireError as e:
-            # Protocol violation (malformed/mismatched frame): drop the
-            # connection — resynchronizing a corrupt byte stream is not
-            # possible with length-prefixed framing.
+            # Protocol violation: drop the connection — resynchronizing
+            # a corrupt length-prefixed stream is not possible.
             log.warning("%s: dropping connection on wire error: %s",
                         self.service_name, e)
-            return
+            self._drop_conn(cs)
         except (ConnectionError, OSError, EOFError):
+            self._drop_conn(cs)
+
+    def _on_frame(self, cs: _Conn) -> None:
+        ver, fl, payload = cs.pver, cs.pflags, cs.pbuf
+        cs.pbuf = cs.pview = None
+        # The decoded-request handoff point (worker pool or inline): the
+        # drills' hook for a server wedged between transport and handler.
+        faults.faultpoint("rpc/mux_dispatch")
+        if ver == wire.WIRE_VERSION:
+            req = wire.loads(bytes(payload))
+            if cs.v1_busy:
+                # v1 clients match replies by ORDER: serialize this
+                # connection's v1 dispatches.
+                cs.v1_backlog.append(req)
+            else:
+                cs.v1_busy = True
+                self._start_request(cs, req, rid=0, v1=True)
+        else:
+            rid, req = _decode_v2_payload(fl, payload)
+            self._start_request(cs, req, rid=rid, v1=False)
+
+    def _start_request(self, cs: _Conn, req: Any, *, rid: int,
+                       v1: bool) -> None:
+        method = req.get("method") if isinstance(req, dict) else None
+        if not isinstance(method, str):
+            self._queue_reply(cs, self._encode_reply(
+                cs, rid, v1, {"ok": False,
+                              "error": "request must be a dict with a "
+                                       "str 'method'"}), v1)
             return
+        if method == "wire_caps":
+            self._queue_reply(cs, self._encode_reply(
+                cs, rid, v1, {"ok": True,
+                              "result": self._wire_caps(cs, req)}), v1)
+            return
+        tctx = req.pop("_trace", None)
+        if method in self.POLLER_INLINE:
+            self._run_handler(cs, rid, v1, method, req, tctx, pooled=False)
+        else:
+            # graftlint: allow-lock(poller-owned counter: +1 here and -1 in _complete both run on the poller thread; forensics read is advisory)
+            self._queue_depth += 1
+            monitor.set_gauge("rpc/worker_queue_depth", self._queue_depth)
+            self._pool().submit(self._run_handler, cs, rid, v1, method,
+                                req, tctx, pooled=True)
+
+    def _wire_caps(self, cs: _Conn, req: dict) -> dict:
+        """The mux negotiation probe (always a v1 frame): record what
+        the PEER can receive, answer what WE can. An old client never
+        sends this; an old server answers it with an in-band
+        AttributeError, which the client treats as 'v1 only'."""
+        sg_ok = int(flags.flag("rpc_sg_min_bytes")) >= 0
+        shm_ok = bool(flags.flag("rpc_shm"))
+        same_host = req.get("host") == _host_id()
+        cs.peer_sg = bool(req.get("sg")) and sg_ok
+        cs.peer_shm = bool(req.get("shm")) and shm_ok and same_host
+        return {"max_version": wire.WIRE_VERSION_MUX, "sg": sg_ok,
+                "shm": shm_ok and same_host, "host": _host_id()}
+
+    def _pool(self) -> ThreadPoolExecutor:
+        p = self._workers
+        if p is None:  # lazily, on the poller thread only
+            n = max(1, int(flags.flag("rpc_worker_threads")))
+            p = self._workers = ThreadPoolExecutor(
+                max_workers=n,
+                thread_name_prefix=f"rpc-worker-{self.endpoint}")
+        return p
+
+    # -- handler execution (worker pool or inline) -------------------------
+
+    def _run_handler(self, cs: _Conn, rid: int, v1: bool, method: str,
+                     req: dict, tctx: Optional[dict], *,
+                     pooled: bool) -> None:
+        t0 = time.perf_counter()
+        try:
+            out = self._dispatch(method, req, tctx)
+            bufs = self._encode_reply(
+                cs, rid, v1,
+                {"ok": True, "result": out,
+                 # Server share of the caller's observed latency:
+                 # total - _server_ms = wire+queue, the per-hop
+                 # decomposition every client gets for free.
+                 "_server_ms": round(
+                     (time.perf_counter() - t0) * 1e3, 3)})
+        except Exception as e:  # report in-band, keep serving
+            log.vlog(0, "%s %s failed: %s", self.service_name, method, e)
+            try:
+                bufs = self._encode_reply(
+                    cs, rid, v1, {"ok": False, "error": repr(e)})
+            except wire.WireError:
+                bufs = None  # cannot even frame the error: drop the conn
+        if pooled:
+            self._post(lambda: self._complete(cs, bufs, v1, pooled=True))
+        else:
+            self._complete(cs, bufs, v1, pooled=False)
+
+    def _complete(self, cs: _Conn, bufs: Optional[List[Any]], v1: bool,
+                  *, pooled: bool) -> None:
+        # Poller thread only.
+        if pooled:
+            self._queue_depth -= 1
+            monitor.set_gauge("rpc/worker_queue_depth", self._queue_depth)
+        if cs.dead:
+            return
+        if bufs is None:
+            self._drop_conn(cs)
+            return
+        self._queue_reply(cs, bufs, v1)
+
+    def _queue_reply(self, cs: _Conn, bufs: List[Any], v1: bool) -> None:
+        cs.out.extend(bufs)
+        self._flush(cs)
+        if cs.dead:
+            return
+        if self._after_reply():
+            cs.close_after_flush = True
+            if not cs.out:
+                self._drop_conn(cs)
+                return
+        if v1:
+            if cs.v1_backlog:
+                self._start_request(cs, cs.v1_backlog.popleft(), rid=0,
+                                    v1=True)
+            else:
+                cs.v1_busy = False
+
+    def _encode_reply(self, cs: _Conn, rid: int, v1: bool,
+                      resp: dict) -> List[Any]:
+        if v1:
+            return [wire.pack_frame(resp)]
+        nbytes = wire.array_nbytes(resp)
+        if (cs.peer_shm
+                and nbytes >= int(flags.flag("rpc_shm_min_bytes"))):
+            try:
+                frame = _pack_shm_frame(resp, rid)
+                monitor.add("rpc/shm_frames", 1)
+                return [frame]
+            except (OSError, wire.WireError):
+                pass  # shm pressure: degrade to the socket forms
+        sg_min = int(flags.flag("rpc_sg_min_bytes"))
+        if cs.peer_sg and sg_min >= 0 and nbytes >= sg_min:
+            monitor.add("rpc/sg_frames", 1)
+            return wire.sg_frame_buffers(resp, rid)
+        return [wire.pack_frame_v2(resp, rid)]
+
+    # -- write side --------------------------------------------------------
+
+    def _flush(self, cs: _Conn) -> None:
+        try:
+            while cs.out:
+                batch = list(itertools.islice(cs.out, 0, 64))
+                sent = cs.sock.sendmsg(batch)
+                _trim_sent(cs.out, sent)
+        except BlockingIOError:
+            pass
+        except (ConnectionError, OSError):
+            self._drop_conn(cs)
+            return
+        if cs.out and not cs.wreg:
+            cs.wreg = True
+            self._sel.modify(cs.sock, selectors.EVENT_READ
+                             | selectors.EVENT_WRITE, cs)
+        elif not cs.out:
+            if cs.wreg:
+                cs.wreg = False
+                self._sel.modify(cs.sock, selectors.EVENT_READ, cs)
+            if cs.close_after_flush:
+                self._drop_conn(cs)
+
+    def _drop_conn(self, cs: _Conn) -> None:
+        if cs.dead:
+            return
+        cs.dead = True
+        cs.out.clear()
+        cs.v1_backlog.clear()
+        with self._conns_lock:
+            self._conns.pop(cs.sock, None)
+        try:
+            self._sel.unregister(cs.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            cs.sock.close()
+        except OSError:
+            pass
+
+    # -- dispatch ----------------------------------------------------------
 
     def _dispatch(self, method: str, req: dict, tctx: Optional[dict]):
         """Invoke ``handle_<method>``, under the caller's trace context
@@ -244,21 +721,129 @@ class FramedRPCServer:
         PS stop RPC uses it to close only after the ack is sent)."""
         return False
 
+    # -- lifecycle ---------------------------------------------------------
+
+    def _poller_stats(self, now: float) -> Dict[str, Any]:
+        busy = self._busy_since
+        with self._conns_lock:
+            nconns = len(self._conns)
+        return {"service": self.service_name, "endpoint": self.endpoint,
+                "thread": self._poller.name,
+                "loop_lag_ms": round((now - busy) * 1e3, 3)
+                if busy is not None else 0.0,
+                "worker_queue_depth": self._queue_depth,
+                "conns": nconns, "running": self._running}
+
     def stop(self) -> None:
+        """Stop accepting. Established connections keep draining until
+        their clients close (graceful-stop semantics the PS stop drill
+        pins); ``close_connections()`` is the abrupt variant."""
         self._running = False
+        srv = self._server
+        if srv is not None:
+            try:
+                # Refuses new connects immediately (synchronously);
+                # the poller closes the listener fd on its next tick.
+                srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        self._post(self._close_listener)
+
+    def close_connections(self) -> None:
+        """Abruptly sever every established connection (kill-like
+        teardown for drills; graceful stops keep draining replies)."""
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for cs in conns:
+            try:
+                # Synchronous: peers see EOF/RST now, like a host death.
+                cs.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+        def _reap() -> None:
+            for cs in conns:
+                self._drop_conn(cs)
+        self._post(_reap)
+
+
+# -- client -------------------------------------------------------------------
+
+
+class _MuxPending:
+    __slots__ = ("event", "resp", "err", "token")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.resp: Optional[dict] = None
+        self.err: Optional[BaseException] = None
+        self.token: Optional[int] = None
+
+
+class _MuxState:
+    """Everything tied to ONE negotiated mux socket generation: pending
+    table, request-id counter, send lock, reader thread. A socket death
+    fails the whole generation at once; the conn then reconnects and
+    negotiates a fresh generation."""
+
+    def __init__(self, sock: socket.socket, *, sg: bool, shm: bool):
+        self.sock = sock
+        self.sg = sg
+        self.shm = shm
+        self.send_lock = threading.Lock()
+        self.pending: Dict[int, _MuxPending] = {}
+        self.plock = threading.Lock()
+        self.ids = itertools.count(1)
+        self.dead = False
+
+    def add(self, rid: int, p: _MuxPending) -> None:
+        with self.plock:
+            if self.dead:
+                raise ConnectionError("mux connection is closed")
+            self.pending[rid] = p
+
+    def forget(self, rid: int) -> None:
+        with self.plock:
+            self.pending.pop(rid, None)
+
+    def resolve(self, rid: int, resp: dict) -> None:
+        with self.plock:
+            p = self.pending.pop(rid, None)
+        if p is None:
+            return  # caller gave up (timeout) — late reply, drop
+        p.resp = resp
+        if p.token is not None:
+            _inflight_exit(p.token)
+        p.event.set()
+
+    def fail_all(self, exc: BaseException) -> None:
+        with self.plock:
+            if self.dead:
+                ps: List[_MuxPending] = []
+            else:
+                self.dead = True
+                ps = list(self.pending.values())
+                self.pending.clear()
+        for p in ps:
+            p.err = exc
+            if p.token is not None:
+                _inflight_exit(p.token)
+            p.event.set()
         try:
-            self._server.shutdown(socket.SHUT_RDWR)
+            self.sock.close()
         except OSError:
             pass
-        try:
-            self._server.close()
-        except OSError:
-            pass
+
+
+_TLS_MISS = object()
 
 
 class FramedRPCConn:
-    """One blocking client connection with in-band error raising,
-    transparent reconnect, and retry-with-backoff for idempotent methods.
+    """One client connection: multiplexed (v2, N outstanding calls per
+    socket, out-of-order replies matched by request id) when the server
+    negotiates it, blocking v1 otherwise — with in-band error raising,
+    transparent reconnect, and retry-with-backoff for idempotent
+    methods.
 
     A dropped/half-read/desynced stream closes the socket; the NEXT call
     reconnects (a PS restart no longer strands every client forever).
@@ -277,7 +862,8 @@ class FramedRPCConn:
         self.endpoint = endpoint
         self._timeout = timeout
         self._idempotent: FrozenSet[str] = frozenset(idempotent)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()        # serializes v1 call pairs
+        self._conn_lock = threading.RLock()  # guards _sock/_mux identity
         self._service = service_name
         # Optional endpoint re-resolver, consulted BEFORE a reconnect:
         # (current endpoint) -> endpoint to connect to. Lets a client
@@ -287,25 +873,93 @@ class FramedRPCConn:
         # from the resolver are the resolver's bug — it should return
         # the current endpoint when it cannot do better.
         self._resolve = resolve
-        # Per-hop latency decomposition from the newest completed call:
-        # the reply's _server_ms (handler wall on the peer) and the
-        # client-observed remainder (wire + peer queue). Read under the
-        # conn lock by callers that just completed a call (the fleet
-        # router's hop metrics).
-        self.last_server_ms: Optional[float] = None
-        self.last_wire_ms: Optional[float] = None
+        # Per-hop latency decomposition from the newest completed call,
+        # THREAD-LOCAL on top of an instance fallback: a mux connection
+        # is shared by concurrent callers (the fleet router's fan-out),
+        # and each caller must read its own call's split.
+        self._tls = threading.local()
+        self._g_server_ms: Optional[float] = None
+        self._g_wire_ms: Optional[float] = None
         # Clock-offset handshake result (peer wall - our wall, ms);
         # None until tracing is on during a connect.
         self.clock_offset_ms: Optional[float] = None
-        self._sock: Optional[socket.socket] = self._connect()
+        self._mux: Optional[_MuxState] = None
+        self._sock: Optional[socket.socket] = None
+        self._sock, self._mux = self._connect()
 
-    def _connect(self) -> socket.socket:
+    # -- latency decomposition (thread-local view) -------------------------
+
+    @property
+    def last_server_ms(self) -> Optional[float]:
+        v = getattr(self._tls, "server_ms", _TLS_MISS)
+        return self._g_server_ms if v is _TLS_MISS else v
+
+    @property
+    def last_wire_ms(self) -> Optional[float]:
+        v = getattr(self._tls, "wire_ms", _TLS_MISS)
+        return self._g_wire_ms if v is _TLS_MISS else v
+
+    def _note_latency(self, resp: Any, total_ms: float) -> None:
+        server_ms = resp.get("_server_ms") if isinstance(resp, dict) \
+            else None
+        if isinstance(server_ms, (int, float)):
+            s = float(server_ms)
+            w = round(max(0.0, total_ms - s), 3)
+        else:
+            s = w = None
+        self._tls.server_ms = s
+        self._tls.wire_ms = w
+        self._g_server_ms = s
+        self._g_wire_ms = w
+
+    # -- connect / negotiate ----------------------------------------------
+
+    def _connect(self):
         host, port = self.endpoint.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)),
                                         timeout=self._timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
         if trace.enabled():
             self._clock_handshake(sock)
-        return sock
+        ms = self._negotiate(sock)
+        return sock, ms
+
+    def _negotiate(self, sock: socket.socket) -> Optional[_MuxState]:
+        """One v1 ``wire_caps`` probe per connect. A peer that answers
+        with an error (an old server has no such handler) pins this
+        socket generation to the blocking v1 plane — counted, so a
+        mixed-version rollout is visible on one scrape."""
+        if not flags.flag("rpc_mux"):
+            return None
+        want_sg = int(flags.flag("rpc_sg_min_bytes")) >= 0
+        want_shm = bool(flags.flag("rpc_shm"))
+        sock.sendall(wire.pack_frame(
+            {"method": "wire_caps", "max_version": wire.WIRE_VERSION_MUX,
+             "sg": want_sg, "shm": want_shm, "host": _host_id()}))
+        ln = wire.read_frame_header(_recv_exact(sock, wire.HEADER.size))
+        resp = wire.loads(_recv_exact(sock, ln))
+        caps = resp.get("result") if isinstance(resp, dict) \
+            and resp.get("ok") else None
+        if not (isinstance(caps, dict)
+                and int(caps.get("max_version", 1))
+                >= wire.WIRE_VERSION_MUX):
+            monitor.add("rpc/mux_fallbacks", 1)
+            log.vlog(1, "%s: peer %s speaks v1 only; mux off for this "
+                     "connection", self._service, self.endpoint)
+            return None
+        ms = _MuxState(
+            sock,
+            sg=want_sg and bool(caps.get("sg")),
+            shm=(want_shm and bool(caps.get("shm"))
+                 and caps.get("host") == _host_id()))
+        t = threading.Thread(target=self._reader_loop, args=(ms,),
+                             daemon=True,
+                             name=f"rpc-mux-reader-{self.endpoint}")
+        t.start()
+        return ms
 
     def _clock_handshake(self, sock: socket.socket) -> None:
         """One wall-clock probe per connect (tracing on only): the
@@ -333,38 +987,174 @@ class FramedRPCConn:
                 TypeError, ValueError):
             return
 
+    def _ensure_connected(self, method: str):
+        """(sock, mux-or-None), reconnecting — resolve= first — when the
+        previous generation died."""
+        with self._conn_lock:
+            if self._sock is None:
+                if self._resolve is not None:
+                    ep = self._resolve(self.endpoint)
+                    if ep and ep != self.endpoint:
+                        monitor.add("rpc/reresolves", 1)
+                        log.vlog(0, "%s: endpoint re-resolved %s -> %s",
+                                 self._service, self.endpoint, ep)
+                        self.endpoint = ep
+                self._sock, self._mux = self._connect()
+                monitor.add("rpc/reconnects", 1)
+                monitor.add(f"rpc/reconnects/{method}", 1)
+            return self._sock, self._mux
+
+    def _forget(self, sock: Optional[socket.socket],
+                ms: Optional[_MuxState]) -> None:
+        """Retire one socket generation (if still current)."""
+        with self._conn_lock:
+            if self._sock is sock or (ms is not None and self._mux is ms):
+                self._sock = None
+                self._mux = None
+        if ms is not None:
+            ms.fail_all(ConnectionError("mux connection closed"))
+        elif sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- mux reader --------------------------------------------------------
+
+    def _reader_loop(self, ms: _MuxState) -> None:
+        sock = ms.sock
+        try:
+            while True:
+                hdr = self._recv_frame_hdr(sock)
+                ver, fl, ln = wire.read_any_header(hdr)
+                if ver != wire.WIRE_VERSION_MUX:
+                    raise wire.WireError(
+                        "v1 frame on a negotiated mux connection")
+                if fl & (wire.FLAG_SG | wire.FLAG_SHM):
+                    faults.faultpoint("rpc/sg_recv")
+                buf = bytearray(ln)
+                _recv_into_exact(sock, memoryview(buf))
+                rid, resp = _decode_v2_payload(fl, buf)
+                ms.resolve(rid, resp)
+        except BaseException as e:
+            # Fail every waiter now, but leave the dead generation in
+            # place: the NEXT call trips on it, counts a retry, and
+            # reconnects — the blocking plane's call-time failure
+            # detection, which the drill suites pin.
+            ms.fail_all(e if isinstance(e, Exception)
+                        else ConnectionError(repr(e)))
+
+    @staticmethod
+    def _recv_frame_hdr(sock: socket.socket) -> bytes:
+        """Header read tolerating IDLE socket timeouts: between frames a
+        quiet connection is healthy (a slow server is the CALLER's
+        timeout to enforce); a timeout mid-header means a wedged peer
+        and propagates."""
+        buf = bytearray()
+        while len(buf) < wire.HEADER.size:
+            try:
+                part = sock.recv(wire.HEADER.size - len(buf))
+            except socket.timeout:
+                if buf:
+                    raise
+                continue
+            if not part:
+                raise ConnectionError("peer closed")
+            buf += part
+        return bytes(buf)
+
+    # -- send/encode -------------------------------------------------------
+
+    def _mux_send(self, ms: _MuxState, obj: dict, rid: int) -> None:
+        if ms.dead:
+            raise ConnectionError("mux connection is closed")
+        nbytes = wire.array_nbytes(obj)
+        if ms.shm and nbytes >= int(flags.flag("rpc_shm_min_bytes")):
+            try:
+                frame = _pack_shm_frame(obj, rid)
+                monitor.add("rpc/shm_frames", 1)
+                with ms.send_lock:
+                    ms.sock.sendall(frame)
+                return
+            except (wire.WireError, FileExistsError, MemoryError):
+                pass  # shm pressure: degrade to the socket forms
+        sg_min = int(flags.flag("rpc_sg_min_bytes"))
+        if ms.sg and sg_min >= 0 and nbytes >= sg_min:
+            bufs = wire.sg_frame_buffers(obj, rid)
+            monitor.add("rpc/sg_frames", 1)
+            with ms.send_lock:
+                _sendmsg_all(ms.sock, bufs)
+            return
+        data = wire.pack_frame_v2(obj, rid)
+        with ms.send_lock:
+            ms.sock.sendall(data)
+
+    # -- the call paths ----------------------------------------------------
+
     def _call_once(self, method: str, kw) -> dict:
         faults.faultpoint("rpc/call")
-        if self._sock is None:  # reconnect after a previous failure
-            if self._resolve is not None:
-                ep = self._resolve(self.endpoint)
-                if ep and ep != self.endpoint:
-                    monitor.add("rpc/reresolves", 1)
-                    log.vlog(0, "%s: endpoint re-resolved %s -> %s",
-                             self._service, self.endpoint, ep)
-                    self.endpoint = ep
-            self._sock = self._connect()
-            monitor.add("rpc/reconnects", 1)
-            monitor.add(f"rpc/reconnects/{method}", 1)
-        s = self._sock
+        sock, ms = self._ensure_connected(method)
+        if ms is not None:
+            return self._mux_call_once(ms, method, kw)
+        with self._lock:
+            s = self._sock
+            if s is None or s is not sock:
+                raise ConnectionError("connection closed concurrently")
+            tctx = kw.get("_trace")
+            sp = (trace.span(f"rpc/client/{method}", trace=tctx["tid"],
+                             span=tctx["sid"], peer=self.endpoint)
+                  if tctx is not None else trace.NULL_SPAN)
+            token = _inflight_enter(self.endpoint, method, self._service)
+            try:
+                with sp:
+                    s.sendall(wire.pack_frame({"method": method, **kw}))
+                    ln = wire.read_frame_header(
+                        _recv_exact(s, wire.HEADER.size))
+                    return wire.loads(_recv_exact(s, ln))
+            except (OSError, ConnectionError, wire.WireError):
+                # A timed-out / half-read / desynced stream cannot be
+                # reused — drop it so the next attempt reconnects.
+                self._forget(sock, None)
+                raise
+            finally:
+                _inflight_exit(token)
+
+    def _mux_call_once(self, ms: _MuxState, method: str, kw) -> dict:
+        rid = next(ms.ids)
+        p = _MuxPending()
+        p.token = _inflight_enter(self.endpoint, method, self._service)
         tctx = kw.get("_trace")
         sp = (trace.span(f"rpc/client/{method}", trace=tctx["tid"],
                          span=tctx["sid"], peer=self.endpoint)
               if tctx is not None else trace.NULL_SPAN)
-        token = _inflight_enter(self.endpoint, method, self._service)
         try:
             with sp:
-                s.sendall(wire.pack_frame({"method": method, **kw}))
-                ln = wire.read_frame_header(
-                    _recv_exact(s, wire.HEADER.size))
-                return wire.loads(_recv_exact(s, ln))
+                ms.add(rid, p)
+                self._mux_send(ms, {"method": method, **kw}, rid)
+                if not p.event.wait(self._timeout):
+                    raise socket.timeout(
+                        f"rpc {method} to {self.endpoint}: no reply in "
+                        f"{self._timeout}s")
+                if p.err is not None:
+                    raise self._translate(p.err)
+                return p.resp
         except (OSError, ConnectionError, wire.WireError):
-            # A timed-out / half-read / desynced stream cannot be
-            # reused — drop it so the next attempt reconnects cleanly.
-            self.close()
+            # Conservative, like the blocking plane: a timeout or stream
+            # error poisons the whole generation (replies can no longer
+            # be trusted to match), so every sibling call fails fast and
+            # the next call reconnects.
+            self._forget(ms.sock, ms)
             raise
         finally:
-            _inflight_exit(token)
+            ms.forget(rid)
+            if not p.event.is_set():
+                _inflight_exit(p.token)
+
+    @staticmethod
+    def _translate(err: BaseException) -> Exception:
+        if isinstance(err, (OSError, wire.WireError)):
+            return err
+        return ConnectionError(repr(err))
 
     def call(self, method: str, **kw):
         retries = (max(0, int(flags.flag("rpc_max_retries")))
@@ -374,45 +1164,173 @@ class FramedRPCConn:
         tctx = trace.wire_context()
         if tctx is not None:
             kw["_trace"] = tctx
-        with self._lock:
-            t_call = time.perf_counter()
-            attempt = 0
-            while True:
-                try:
-                    resp = self._call_once(method, kw)
-                    break
-                except (OSError, ConnectionError, wire.WireError) as e:
-                    if attempt >= retries or time.monotonic() >= deadline:
-                        raise
-                    attempt += 1
-                    monitor.add("rpc/retries", 1)
-                    monitor.add(f"rpc/retries/{method}", 1)
-                    log.warning(
-                        "%s.%s: connection error %r — reconnect+retry "
-                        "%d/%d", self._service, method, e, attempt,
-                        retries)
-                    time.sleep(min(
-                        float(flags.flag("rpc_retry_backoff_s"))
-                        * (2.0 ** (attempt - 1)), 2.0))
-            total_ms = (time.perf_counter() - t_call) * 1e3
-            server_ms = resp.get("_server_ms") if isinstance(resp, dict) \
-                else None
-            if isinstance(server_ms, (int, float)):
-                self.last_server_ms = float(server_ms)
-                self.last_wire_ms = round(
-                    max(0.0, total_ms - float(server_ms)), 3)
-            else:
-                self.last_server_ms = None
-                self.last_wire_ms = None
+        t_call = time.perf_counter()
+        attempt = 0
+        while True:
+            try:
+                resp = self._call_once(method, kw)
+                break
+            except (OSError, ConnectionError, wire.WireError) as e:
+                if attempt >= retries or time.monotonic() >= deadline:
+                    raise
+                attempt += 1
+                monitor.add("rpc/retries", 1)
+                monitor.add(f"rpc/retries/{method}", 1)
+                log.warning(
+                    "%s.%s: connection error %r — reconnect+retry "
+                    "%d/%d", self._service, method, e, attempt,
+                    retries)
+                time.sleep(min(
+                    float(flags.flag("rpc_retry_backoff_s"))
+                    * (2.0 ** (attempt - 1)), 2.0))
+        self._note_latency(resp, (time.perf_counter() - t_call) * 1e3)
         if not resp["ok"]:
             raise RuntimeError(
                 f"{self._service}.{method}: {resp['error']}")
         return resp["result"]
 
-    def close(self) -> None:
-        if self._sock is not None:
+    def call_async(self, method: str, **kw) -> "RPCFuture":
+        """Start a call WITHOUT waiting: returns an :class:`RPCFuture`
+        whose ``.result()`` yields what ``call`` would have returned.
+        On a mux connection this is true pipelining — the request is on
+        the wire now and the caller's thread is free to issue more; the
+        fan-out tiers (router, replication forwarding, boundary
+        exchange) stop paying one RTT per sequential call. On a v1
+        connection it degrades to a helper thread running ``call`` (same
+        contract, same retry semantics)."""
+        tctx = trace.wire_context()
+        if tctx is not None:
+            kw["_trace"] = tctx
+        ms = None
+        try:
+            _, ms = self._ensure_connected(method)
+        except (OSError, ConnectionError, wire.WireError):
+            pass  # the fallback path below owns reconnect+retry
+        if ms is not None:
+            rid = next(ms.ids)
+            p = _MuxPending()
+            p.token = _inflight_enter(self.endpoint, method,
+                                      self._service)
             try:
-                self._sock.close()
+                ms.add(rid, p)
+                self._mux_send(ms, {"method": method, **kw}, rid)
+                return _MuxFuture(self, ms, rid, p, method, kw,
+                                  time.perf_counter())
+            except (OSError, ConnectionError, wire.WireError):
+                # Send failed -> the frame never fully left, so the
+                # request did not execute: safe to fall back to the
+                # sync path even for non-idempotent methods.
+                ms.forget(rid)
+                if not p.event.is_set():
+                    _inflight_exit(p.token)
+                self._forget(ms.sock, ms)
+        return _ThreadFuture(self, method, kw)
+
+    def close(self) -> None:
+        with self._conn_lock:
+            sock, ms = self._sock, self._mux
+            self._sock = None
+            self._mux = None
+        if ms is not None:
+            ms.fail_all(ConnectionError("connection closed"))
+        elif sock is not None:
+            try:
+                sock.close()
             except OSError:
                 pass
-            self._sock = None
+
+
+class RPCFuture:
+    """Handle for one in-flight ``call_async``; ``result()`` blocks."""
+
+    def result(self, timeout: Optional[float] = None):
+        raise NotImplementedError
+
+
+class _MuxFuture(RPCFuture):
+    __slots__ = ("_conn", "_ms", "_rid", "_p", "_method", "_kw", "_t0")
+
+    def __init__(self, conn: FramedRPCConn, ms: _MuxState, rid: int,
+                 p: _MuxPending, method: str, kw: dict, t0: float):
+        self._conn = conn
+        self._ms = ms
+        self._rid = rid
+        self._p = p
+        self._method = method
+        self._kw = kw
+        self._t0 = t0
+
+    def result(self, timeout: Optional[float] = None):
+        c = self._conn
+        p = self._p
+        # The pipelined call still contributes its ``rpc/client/<m>``
+        # span to the merged trace (the sync paths emit it around the
+        # send; here the visible client-side wait is the result() call).
+        tctx = self._kw.get("_trace")
+        sp = (trace.span(f"rpc/client/{self._method}",
+                         trace=tctx["tid"], span=tctx["sid"],
+                         peer=c.endpoint)
+              if isinstance(tctx, dict) else trace.NULL_SPAN)
+        with sp:
+            return self._result(timeout)
+
+    def _result(self, timeout: Optional[float]):
+        c = self._conn
+        p = self._p
+        tmo = c._timeout if timeout is None else timeout
+        if not p.event.wait(tmo):
+            # Same conservative poisoning as the sync mux path.
+            self._ms.forget(self._rid)
+            _inflight_exit(p.token)
+            c._forget(self._ms.sock, self._ms)
+            p.err = p.err or socket.timeout(
+                f"rpc {self._method} to {c.endpoint}: no reply in {tmo}s")
+        if p.err is not None:
+            if self._method in c._idempotent:
+                # The reply was lost but the method is a pure read:
+                # re-issue through the sync path's full retry/resolve
+                # machinery.
+                kw = dict(self._kw)
+                kw.pop("_trace", None)
+                return c.call(self._method, **kw)
+            raise c._translate(p.err)
+        resp = p.resp
+        c._note_latency(resp, (time.perf_counter() - self._t0) * 1e3)
+        if not resp["ok"]:
+            raise RuntimeError(
+                f"{c._service}.{self._method}: {resp['error']}")
+        return resp["result"]
+
+
+class _ThreadFuture(RPCFuture):
+    """v1 fallback: one helper thread runs the blocking ``call`` (the
+    fan-out tiers used to spawn exactly this thread themselves)."""
+
+    def __init__(self, conn: FramedRPCConn, method: str, kw: dict):
+        self._out: Any = None
+        self._exc: Optional[BaseException] = None
+        self._method = method
+        self._conn = conn
+
+        def _run() -> None:
+            try:
+                # graftlint: allow-lock(Thread.join in result() orders these writes before the read)
+                self._out = conn.call(method, **kw)
+            except BaseException as e:
+                # graftlint: allow-lock(Thread.join in result() orders these writes before the read)
+                self._exc = e
+
+        self._t = threading.Thread(
+            target=_run, daemon=True,
+            name=f"rpc-async-{method}-{conn.endpoint}")
+        self._t.start()
+
+    def result(self, timeout: Optional[float] = None):
+        self._t.join(self._conn._timeout if timeout is None else timeout)
+        if self._t.is_alive():
+            raise socket.timeout(
+                f"rpc {self._method} to {self._conn.endpoint}: "
+                f"no reply")
+        if self._exc is not None:
+            raise self._exc
+        return self._out

@@ -178,6 +178,19 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _recv_into_exact(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` from the socket with ``recv_into`` — the payload
+    lands in the caller's preallocated buffer with no intermediate
+    chunk copies (the RPC plane's zero-copy receive discipline)."""
+    got = 0
+    n = len(view)
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
+
+
 class TcpTransport:
     """Length-prefixed TCP mesh for host-buffer exchange.
 

@@ -10,11 +10,12 @@ localhost).
 Frame layout (little-endian):
 
     magic   2s   b"PB"
-    version u8   WIRE_VERSION — mismatch is rejected, not guessed at
-    flags   u8   reserved (0)
+    version u8   1 (legacy blocking) or 2 (multiplexed) — per FRAME, so
+                 one connection can carry both during negotiation
+    flags   u8   v1: reserved (0); v2: FLAG_SG / FLAG_SHM payload form
     length  u64  payload byte length (bounded by MAX_PAYLOAD)
 
-Payload: one value, tag-prefixed; containers recurse.
+v1 payload: one value, tag-prefixed; containers recurse.
 
     0x00 None
     0x01 bool      u8
@@ -26,6 +27,24 @@ Payload: one value, tag-prefixed; containers recurse.
     0x07 dict      u32 count + (str key, value)*
     0x08 list      u32 count + value*
 
+v2 payload (the RPC mux plane, RPC.md): a u64 REQUEST ID leads, so N
+calls can be in flight per socket and replies match out of order.
+
+    plain (flags=0):  u64 req_id + one v1-encoded value
+    FLAG_SG:          u64 req_id, u32 meta_len, meta, u32 nseg,
+                      nseg * (u64 offset, u64 nbytes), pad, segments.
+                      ``meta`` is the typed tree with ndarray leaves
+                      replaced by tag 0x09 (dtype, shape, seg index);
+                      raw array bytes are 64-byte-aligned TRAILING
+                      segments (the shm_channel frame discipline), so
+                      the sender can scatter/gather ``sendmsg`` live
+                      array views with no join copy and the receiver
+                      decodes views straight out of the frame buffer.
+    FLAG_SHM:         like FLAG_SG but the segment table indexes into a
+                      named shared-memory block (u32 name_len + name
+                      follow the meta) instead of trailing bytes — the
+                      co-located-process shortcut (FLAGS_rpc_shm).
+
 SECURITY SCOPE: the protocol authenticates nothing — it is for a trusted
 cluster network (same stance as the reference's brpc PS, which runs on
 the job's private fabric). It is robust against malformed and truncated
@@ -36,14 +55,25 @@ raise :class:`WireError`), not against an active adversary.
 from __future__ import annotations
 
 import struct
-from typing import Any, Dict, List, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 WIRE_VERSION = 1
+WIRE_VERSION_MUX = 2
 MAX_PAYLOAD = 1 << 34          # 16 GiB frame cap
 _MAGIC = b"PB"
 HEADER = struct.Struct("<2sBBQ")
+
+# v2 frame flags.
+FLAG_SG = 0x01                 # scatter/gather segmented array payload
+FLAG_SHM = 0x02                # segments live in a shared-memory block
+
+_ALIGN = 64                    # segment alignment (shm_channel discipline)
+
+
+def _align(off: int) -> int:
+    return (off + _ALIGN - 1) & ~(_ALIGN - 1)
 
 # dtype allowlist (code <-> dtype); anything else is rejected.
 _DTYPES = (np.dtype(np.float32), np.dtype(np.float64),
@@ -62,7 +92,13 @@ class WireError(ValueError):
     """Malformed, truncated, oversized, or version-mismatched frame."""
 
 
-def _enc_value(out: List[bytes], v: Any) -> None:
+def _enc_value(out: List[bytes], v: Any, segs: List[np.ndarray] = None
+               ) -> None:
+    """Encode one value into ``out`` (a list of buffer segments joined
+    or gathered by the caller). With ``segs`` not None (the SG meta
+    form), ndarray leaves emit tag 0x09 — dtype/shape + an index into
+    ``segs`` — and the raw bytes are collected into ``segs`` for the
+    frame's aligned trailing segments instead of inlining."""
     if v is None:
         out.append(b"\x00")
     elif isinstance(v, bool):           # before int (bool is int subclass)
@@ -84,9 +120,21 @@ def _enc_value(out: List[bytes], v: Any) -> None:
             raise WireError(f"dtype {a.dtype} not on the wire allowlist")
         if a.ndim > _MAX_NDIM:
             raise WireError(f"ndim {a.ndim} > {_MAX_NDIM}")
+        if segs is not None:
+            out.append(b"\x09" + struct.pack("<BB", code, a.ndim)
+                       + struct.pack(f"<{a.ndim}Q", *a.shape)
+                       + struct.pack("<I", len(segs)))
+            segs.append(a)
+            return
         out.append(b"\x06" + struct.pack("<BB", code, a.ndim)
                    + struct.pack(f"<{a.ndim}Q", *a.shape))
-        out.append(a.tobytes())
+        # A memoryview SEGMENT, not tobytes(): the final join (or the
+        # sendmsg gather) reads the array buffer directly, so encoding
+        # never pays a payload-sized intermediate copy. Frames are
+        # bit-identical to the tobytes() form (pinned by
+        # tests/test_rpc_mux.py round-trip). Empty arrays cannot be
+        # cast (zeros in shape) and contribute zero bytes anyway.
+        out.append(memoryview(a).cast("B") if a.size else b"")
     elif isinstance(v, dict):
         out.append(b"\x07" + struct.pack("<I", len(v)))
         for k, item in v.items():
@@ -94,11 +142,11 @@ def _enc_value(out: List[bytes], v: Any) -> None:
                 raise WireError(f"dict key must be str, got {type(k)}")
             kb = k.encode("utf-8")
             out.append(struct.pack("<I", len(kb)) + kb)
-            _enc_value(out, item)
+            _enc_value(out, item, segs)
     elif isinstance(v, (list, tuple)):
         out.append(b"\x08" + struct.pack("<I", len(v)))
         for item in v:
-            _enc_value(out, item)
+            _enc_value(out, item, segs)
     else:
         raise WireError(f"type {type(v).__name__} not wire-serializable")
 
@@ -107,6 +155,18 @@ def dumps(obj: Any) -> bytes:
     out: List[bytes] = []
     _enc_value(out, obj)
     return b"".join(out)
+
+
+def array_nbytes(obj: Any) -> int:
+    """Total ndarray payload bytes in a tree — the cheap scan deciding
+    whether a v2 frame is worth the SG/shm form."""
+    if isinstance(obj, np.ndarray):
+        return obj.nbytes
+    if isinstance(obj, dict):
+        return sum(array_nbytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(array_nbytes(v) for v in obj)
+    return 0
 
 
 class _Reader:
@@ -132,7 +192,7 @@ _F64 = struct.Struct("<d")
 _BB = struct.Struct("<BB")
 
 
-def _dec_value(r: _Reader) -> Any:
+def _dec_value(r: _Reader, segs: Optional[List[Any]] = None) -> Any:
     tag = r.take(1)
     if tag == b"\x00":
         return None
@@ -178,13 +238,38 @@ def _dec_value(r: _Reader) -> Any:
                 k = r.take(kl).decode("utf-8")
             except UnicodeDecodeError as e:
                 raise WireError(f"bad utf-8 key: {e}") from None
-            d[k] = _dec_value(r)
+            d[k] = _dec_value(r, segs)
         return d
     if tag == b"\x08":
         (n,) = r.unpack(_U32)
         if n > _MAX_CONTAINER:
             raise WireError("list too large")
-        return [_dec_value(r) for _ in range(n)]
+        return [_dec_value(r, segs) for _ in range(n)]
+    if tag == b"\x09":
+        if segs is None:
+            raise WireError("segment-ref array outside an SG frame")
+        code, ndim = r.unpack(_BB)
+        if code >= len(_DTYPES):
+            raise WireError(f"unknown dtype code {code}")
+        if ndim > _MAX_NDIM:
+            raise WireError(f"ndim {ndim} > {_MAX_NDIM}")
+        shape = struct.unpack(f"<{ndim}Q", r.take(8 * ndim))
+        (idx,) = r.unpack(_U32)
+        if idx >= len(segs):
+            raise WireError(f"segment index {idx} >= {len(segs)}")
+        dt = _DTYPES[code]
+        count = 1
+        for s in shape:
+            count *= s
+        nbytes = count * dt.itemsize
+        seg = segs[idx]
+        if nbytes != len(seg):
+            raise WireError(
+                f"segment {idx}: {len(seg)} bytes != shape {shape} "
+                f"({nbytes} bytes)")
+        # A VIEW over the frame's receive buffer — no copy; the buffer
+        # outlives the arrays (each frame owns its own buffer).
+        return np.frombuffer(seg, dtype=dt).reshape(shape)
     raise WireError(f"unknown type tag {tag!r}")
 
 
@@ -217,3 +302,208 @@ def read_frame_header(hdr: bytes) -> int:
     if length > MAX_PAYLOAD:
         raise WireError(f"frame length {length} exceeds cap")
     return length
+
+
+# ---------------------------------------------------------------------------
+# v2 (multiplexed) frames — request-id'd payloads, optional SG/shm array
+# segments. The v1 surface above is untouched; a connection negotiates
+# up via the ``wire_caps`` probe (distributed/rpc.py) and every frame
+# still self-describes its version, so mixed-version peers interoperate
+# per-frame.
+# ---------------------------------------------------------------------------
+
+_REQID = struct.Struct("<Q")
+_SEG = struct.Struct("<QQ")     # (offset, nbytes) per segment
+
+
+def read_any_header(hdr: bytes) -> Tuple[int, int, int]:
+    """Validate a v1 OR v2 header; returns (version, flags, length)."""
+    try:
+        magic, version, fl, length = HEADER.unpack(hdr)
+    except struct.error as e:
+        raise WireError(f"bad header: {e}") from None
+    if magic != _MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if version not in (WIRE_VERSION, WIRE_VERSION_MUX):
+        raise WireError(f"peer wire version {version} not in "
+                        f"({WIRE_VERSION}, {WIRE_VERSION_MUX}) — "
+                        f"mixed-version cluster; upgrade in lockstep")
+    if version == WIRE_VERSION and fl != 0:
+        raise WireError(f"v1 frame with flags {fl:#x}")
+    if length > MAX_PAYLOAD:
+        raise WireError(f"frame length {length} exceeds cap")
+    return version, fl, length
+
+
+def pack_frame_v2(obj: Any, req_id: int) -> bytes:
+    """One plain (non-SG) v2 frame: header + req id + typed tree."""
+    out: List[bytes] = []
+    _enc_value(out, obj)
+    payload_len = 8 + sum(len(b) for b in out)
+    if payload_len > MAX_PAYLOAD:
+        raise WireError(f"payload {payload_len} exceeds cap")
+    return b"".join([HEADER.pack(_MAGIC, WIRE_VERSION_MUX, 0, payload_len),
+                     _REQID.pack(req_id)] + out)
+
+
+def loads_v2(payload) -> Tuple[int, Any]:
+    """Decode a plain v2 payload -> (req_id, value)."""
+    buf = bytes(payload)
+    if len(buf) < 8:
+        raise WireError("v2 payload shorter than its request id")
+    (req_id,) = _REQID.unpack_from(buf)
+    return req_id, loads(buf[8:])
+
+
+def dumps_sg(obj: Any) -> Tuple[bytes, List[np.ndarray]]:
+    """SG meta encoding: (meta bytes, contiguous arrays referenced by
+    tag-0x09 leaves, in segment order)."""
+    out: List[bytes] = []
+    segs: List[np.ndarray] = []
+    _enc_value(out, obj, segs)
+    return b"".join(out), segs
+
+
+def sg_frame_buffers(obj: Any, req_id: int) -> List[Any]:
+    """Scatter/gather buffer list for ONE SG frame — header + head in a
+    single small bytes object, then alternating pad/array-view buffers.
+    ``socket.sendmsg(bufs)`` gathers straight from the live array
+    buffers: the encode path never materializes the payload. The caller
+    must not mutate the arrays until the send completes."""
+    meta, arrays = dumps_sg(obj)
+    nseg = len(arrays)
+    head_len = 8 + 4 + len(meta) + 4 + _SEG.size * nseg
+    offs: List[int] = []
+    off = _align(head_len)
+    for a in arrays:
+        offs.append(off)
+        off = _align(off + a.nbytes)
+    # Payload ends at the last segment's end (no trailing pad).
+    payload_len = (offs[-1] + arrays[-1].nbytes) if nseg else head_len
+    if payload_len > MAX_PAYLOAD:
+        raise WireError(f"payload {payload_len} exceeds cap")
+    head = [HEADER.pack(_MAGIC, WIRE_VERSION_MUX, FLAG_SG, payload_len),
+            _REQID.pack(req_id), _U32.pack(len(meta)), meta,
+            _U32.pack(nseg)]
+    head += [_SEG.pack(o, a.nbytes) for o, a in zip(offs, arrays)]
+    bufs: List[Any] = [b"".join(head)]
+    cursor = head_len
+    for o, a in zip(offs, arrays):
+        if o > cursor:
+            bufs.append(b"\x00" * (o - cursor))
+        if a.size:  # empty arrays can't cast and carry no bytes
+            bufs.append(memoryview(a).cast("B"))
+        cursor = o + a.nbytes
+    return bufs
+
+
+def _sg_head(r: "_Reader", payload) -> Tuple[int, bytes, List[Tuple[int,
+                                                                    int]]]:
+    (req_id,) = r.unpack(_REQID)
+    (meta_len,) = r.unpack(_U32)
+    meta = r.take(meta_len)
+    (nseg,) = r.unpack(_U32)
+    if nseg > _MAX_CONTAINER:
+        raise WireError("too many segments")
+    table = [r.unpack(_SEG) for _ in range(nseg)]
+    return req_id, meta, table
+
+
+def loads_sg(payload) -> Tuple[int, Any]:
+    """Decode an SG payload -> (req_id, value). ``payload`` should be a
+    memoryview over the frame's receive buffer: decoded arrays are
+    zero-copy views into it (the buffer must outlive them)."""
+    mv = payload if isinstance(payload, memoryview) else memoryview(payload)
+    r = _Reader(mv)
+    req_id, meta, table = _sg_head(r, mv)
+    segs: List[Any] = []
+    for off, nbytes in table:
+        if off < r.pos or off + nbytes > len(mv):
+            raise WireError(f"segment [{off}, {off + nbytes}) outside "
+                            f"payload of {len(mv)} bytes")
+        segs.append(mv[off:off + nbytes])
+    return req_id, loads_meta(bytes(meta), segs)
+
+
+def loads_meta(meta: bytes, segs: List[Any]) -> Any:
+    """Decode an SG meta tree against an externally supplied segment
+    list (the shm path attaches its block and slices it here)."""
+    r = _Reader(meta)
+    v = _dec_value(r, segs)
+    if r.pos != len(meta):
+        raise WireError(f"{len(meta) - r.pos} trailing bytes after value")
+    return v
+
+
+def sg_plan(arrays: List[np.ndarray]) -> Tuple[List[int], int]:
+    """64B-aligned placement of ``arrays`` in one block: (offsets,
+    total). Shared by the shm shortcut's block sizing."""
+    offs: List[int] = []
+    off = 0
+    for a in arrays:
+        offs.append(off)
+        off = _align(off + a.nbytes)
+    return offs, max(off, 1)
+
+
+def pack_frame_shm(obj: Any, req_id: int, name: str,
+                   block: memoryview) -> Tuple[bytes, int]:
+    """One FLAG_SHM frame: meta + segment table on the socket, array
+    bytes copied into ``block`` (the caller's shared-memory mapping,
+    sized by :func:`sg_plan`). Returns (frame bytes, bytes placed)."""
+    meta, arrays = dumps_sg(obj)
+    offs, total = sg_plan(arrays)
+    if total > len(block) and arrays:
+        raise WireError(f"shm block {len(block)} < plan {total}")
+    for o, a in zip(offs, arrays):
+        if a.size:  # empty arrays can't cast and place no bytes
+            block[o:o + a.nbytes] = memoryview(a).cast("B")
+    nb = name.encode("utf-8")
+    head = [_REQID.pack(req_id), _U32.pack(len(meta)), meta,
+            _U32.pack(len(nb)), nb, _U32.pack(len(arrays))]
+    head += [_SEG.pack(o, a.nbytes) for o, a in zip(offs, arrays)]
+    payload = b"".join(head)
+    if len(payload) > MAX_PAYLOAD:
+        raise WireError(f"payload {len(payload)} exceeds cap")
+    frame = HEADER.pack(_MAGIC, WIRE_VERSION_MUX, FLAG_SG | FLAG_SHM,
+                        len(payload)) + payload
+    return frame, total
+
+
+def loads_shm(payload, attach: Callable[[str], Any]) -> Tuple[int, Any]:
+    """Decode a FLAG_SHM payload: ``attach(name)`` returns the block's
+    memoryview; decoded arrays are COPIES (the caller unlinks the
+    one-shot block immediately after)."""
+    mv = payload if isinstance(payload, memoryview) else memoryview(payload)
+    r = _Reader(mv)
+    (req_id,) = r.unpack(_REQID)
+    (meta_len,) = r.unpack(_U32)
+    meta = r.take(meta_len)
+    (name_len,) = r.unpack(_U32)
+    try:
+        name = bytes(r.take(name_len)).decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise WireError(f"bad shm name: {e}") from None
+    (nseg,) = r.unpack(_U32)
+    if nseg > _MAX_CONTAINER:
+        raise WireError("too many segments")
+    table = [r.unpack(_SEG) for _ in range(nseg)]
+    block = attach(name)
+    segs: List[Any] = []
+    for off, nbytes in table:
+        if off + nbytes > len(block):
+            raise WireError(f"shm segment [{off}, {off + nbytes}) outside "
+                            f"block of {len(block)} bytes")
+        segs.append(block[off:off + nbytes])
+    obj = loads_meta(bytes(meta), segs)
+    return req_id, _copy_arrays(obj)
+
+
+def _copy_arrays(obj: Any) -> Any:
+    if isinstance(obj, np.ndarray):
+        return obj.copy()
+    if isinstance(obj, dict):
+        return {k: _copy_arrays(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_copy_arrays(v) for v in obj]
+    return obj

@@ -56,7 +56,8 @@ from __future__ import annotations
 import glob
 import os
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -109,6 +110,109 @@ def decode_emb(payload: Dict[str, np.ndarray]) -> np.ndarray:
 
 def payload_nbytes(payload: Dict[str, np.ndarray]) -> int:
     return int(sum(np.asarray(v).nbytes for v in payload.values()))
+
+
+class _CoalesceEntry:
+    __slots__ = ("keys", "rows", "err", "done")
+
+    def __init__(self, keys: np.ndarray):
+        self.keys = keys
+        self.rows: Optional[Dict[str, np.ndarray]] = None
+        self.err: Optional[Exception] = None
+        self.done = False
+
+
+class _PullCoalescer:
+    """Server-side read coalescing: concurrent ``pull`` /
+    ``pull_serving`` requests hitting one shard fold into ONE store
+    lookup (the ``serving/batcher.py`` window pattern applied to the
+    shard tier). Under trainer fan-in the per-slot FeatureStore lock is
+    the hot resource; N worker threads queueing on it serially pay N
+    lock acquisitions and N gather passes over overlapping keys.
+
+    Protocol: the first request of a round becomes the LEADER — it
+    optionally sleeps ``FLAGS_multihost_coalesce_window_ms`` (0 =
+    opportunistic: no sleep, riders are whatever piled up while the
+    previous round held the store), drains the queue, unions the key
+    sets (all sorted unique per the pull contract, so ``np.union1d``
+    stays exact), runs the raw lookup ONCE, and scatters each rider's
+    slice back via ``np.searchsorted``. Riders block on a timed
+    Condition wait (lock-discipline rule: no untimed waits); a rider
+    that arrives after the leader's drain claims the NEXT round when
+    the busy flag drops. Bit-identity: init rows and ``contains`` are
+    per-key deterministic, so a coalesced slice equals the direct
+    call's bytes. A leader error fails the whole round loudly — the
+    clients' idempotent-retry machinery re-issues.
+
+    Per (server, kind) rounds; ``multihost_coalesce_window_ms < 0``
+    disables coalescing entirely (every request takes the direct
+    path)."""
+
+    _KINDS = ("pull", "pull_serving")
+
+    def __init__(self, server: "ShardServer"):
+        self._srv = server
+        self._cv = threading.Condition()
+        self._queues: Dict[str, List[_CoalesceEntry]] = {
+            k: [] for k in self._KINDS}
+        self._busy: Dict[str, bool] = {k: False for k in self._KINDS}
+
+    def rows(self, kind: str, keys: np.ndarray,
+             fn: Callable[[np.ndarray], Dict[str, np.ndarray]]
+             ) -> Dict[str, np.ndarray]:
+        window = float(flags.flag("multihost_coalesce_window_ms"))
+        if window < 0 or keys.size == 0:
+            return fn(keys)
+        ent = _CoalesceEntry(keys)
+        with self._cv:
+            self._queues[kind].append(ent)
+            while not ent.done and self._busy[kind]:
+                self._cv.wait(timeout=0.05)
+            if not ent.done:
+                # Claim leadership of the next round (our entry is
+                # still queued — the round serves it with the riders).
+                self._busy[kind] = True
+        if not ent.done:
+            try:
+                if window > 0:
+                    time.sleep(window / 1e3)
+                with self._cv:
+                    batch = self._queues[kind]
+                    self._queues[kind] = []
+                self._serve(batch, fn)
+            finally:
+                with self._cv:
+                    self._busy[kind] = False
+                    self._cv.notify_all()
+        if ent.err is not None:
+            raise ent.err
+        assert ent.rows is not None
+        return ent.rows
+
+    def _serve(self, batch: List[_CoalesceEntry],
+               fn: Callable[[np.ndarray], Dict[str, np.ndarray]]
+               ) -> None:
+        try:
+            if len(batch) == 1:
+                batch[0].rows = fn(batch[0].keys)
+            else:
+                union = batch[0].keys
+                for b in batch[1:]:
+                    union = np.union1d(union, b.keys)
+                rows = fn(union)
+                for b in batch:
+                    idx = np.searchsorted(union, b.keys)
+                    b.rows = {f: v[idx] for f, v in rows.items()}
+                self._srv._bump("multihost/coalesced_pulls",
+                                len(batch) - 1)
+            self._srv._bump("multihost/coalesce_rounds", 1)
+        except Exception as e:
+            for b in batch:
+                b.err = e
+        with self._cv:
+            for b in batch:
+                b.done = True
+            self._cv.notify_all()
 
 
 class ShardServer(rpc.FramedRPCServer):
@@ -175,6 +279,7 @@ class ShardServer(rpc.FramedRPCServer):
         # process-wide meaning. handle_metrics_snapshot serves this
         # registry to the fleet_top / telemetry_scrape collectors.
         self.metrics = monitor.Monitor()
+        self._coalescer = _PullCoalescer(self)
         self.service_name = f"shard[{index}]"
         rpc.FramedRPCServer.__init__(self, endpoint, backlog=64)
 
@@ -305,18 +410,40 @@ class ShardServer(rpc.FramedRPCServer):
 
     def _forward_locked(self, slot: int, seq: int, op: str,
                         payload: dict) -> None:
-        for ep in self._replicated(slot):
-            st = self._backup_state.setdefault(
-                (slot, ep), {"seq": None, "lagged": True})
+        # In-sync backups get their replica_apply PIPELINED on the
+        # mux'd peer conns (PR 16): all sends go out back-to-back, then
+        # the acks are collected — R=3 pays one backup RTT, not two.
+        # Out-of-sync backups fall to the sequential catch-up path; a
+        # failed pipelined apply falls there too (the peer conn
+        # reconnects lazily and journal/snapshot replay is idempotent).
+        eps = self._replicated(slot)
+        states = {ep: self._backup_state.setdefault(
+            (slot, ep), {"seq": None, "lagged": True}) for ep in eps}
+        futs: Dict[str, "_ShardFuture"] = {}
+        for ep in eps:
+            if states[ep]["seq"] == seq - 1:
+                try:
+                    futs[ep] = self._peer(ep).call_async(
+                        "replica_apply", slot=slot, seq=seq, op=op,
+                        epoch=self._journals[slot].epoch, **payload)
+                except (OSError, ConnectionError, wire.WireError):
+                    pass    # send failed: the collect loop catches up
+        for ep in eps:
+            st = states[ep]
             try:
                 try:
-                    if st["seq"] != seq - 1:
-                        self._catch_up_locked(slot, ep, st)
-                    if st["seq"] == seq - 1:
-                        self._peer(ep).call(
-                            "replica_apply", slot=slot, seq=seq, op=op,
-                            epoch=self._journals[slot].epoch, **payload)
+                    if ep in futs:
+                        futs[ep].result()
                         st["seq"] = seq
+                    else:
+                        self._catch_up_locked(slot, ep, st)
+                        if st["seq"] == seq - 1:
+                            self._peer(ep).call(
+                                "replica_apply", slot=slot, seq=seq,
+                                op=op,
+                                epoch=self._journals[slot].epoch,
+                                **payload)
+                            st["seq"] = seq
                 except (OSError, ConnectionError, RuntimeError,
                         wire.WireError):
                     # Direct send bounced (stale conn after a backup
@@ -437,13 +564,11 @@ class ShardServer(rpc.FramedRPCServer):
 
     # -- pull / push (the DCN halves of the lookup exchange) ---------------
 
-    def handle_pull(self, req) -> Dict[str, np.ndarray]:
-        """Full value rows for sorted unique keys in a locally
-        replicated slot (pull_for_pass semantics: unseen keys return
-        deterministic per-key init rows and are NOT inserted — a pure
-        read, declared idempotent by the client, served by primary OR
-        backup). ``wire`` selects the emb encoding."""
-        keys = np.asarray(req["keys"], np.uint64)
+    def _pull_rows(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
+        """Raw full-row lookup for sorted unique keys (pull_for_pass
+        semantics; ``emb`` stays f32 — wire encoding is per-request, on
+        top). This is the coalescable unit: one call per coalescing
+        round, holding each touched slot store once."""
         groups = self._slot_groups(keys, write=False)
         rows: Optional[Dict[str, np.ndarray]] = None
         for slot, idx in groups:
@@ -457,22 +582,29 @@ class ShardServer(rpc.FramedRPCServer):
                                         v.dtype) for f, v in part.items()}
                 for f, v in part.items():
                     rows[f][idx] = v
+        return rows
+
+    def handle_pull(self, req) -> Dict[str, np.ndarray]:
+        """Full value rows for sorted unique keys in a locally
+        replicated slot (pull_for_pass semantics: unseen keys return
+        deterministic per-key init rows and are NOT inserted — a pure
+        read, declared idempotent by the client, served by primary OR
+        backup). ``wire`` selects the emb encoding. Concurrent pulls
+        coalesce into one store lookup (``_PullCoalescer``); the wire
+        encode and served-keys counter stay per-request."""
+        keys = np.asarray(req["keys"], np.uint64)
+        rows = self._coalescer.rows("pull", keys, self._pull_rows)
         out: Dict[str, np.ndarray] = {
             f: v for f, v in rows.items() if f != "emb"}
         out.update(encode_emb(rows["emb"], req.get("wire", "f32")))
         self._bump("multihost/served_pull_keys", int(keys.size))
         return out
 
-    def handle_pull_serving(self, req) -> Dict[str, np.ndarray]:
-        """Serving-tier miss resolution: (found mask, w, wire-encoded
-        emb) for sorted unique keys in a locally replicated slot. A PURE
-        read like ``pull`` — unseen keys are NOT inserted — but it also
-        reports which keys exist (serving must answer zeros for a
-        feasign training never saw, not the trainer's init row) and
-        ships ONLY the serving fields (emb + w), never optimizer state:
-        a replica's miss path reads a fraction of the bytes a trainer
-        pull moves."""
-        keys = np.asarray(req["keys"], np.uint64)
+    def _pull_serving_rows(self, keys: np.ndarray
+                           ) -> Dict[str, np.ndarray]:
+        """Raw serving lookup: found mask + w + f32 emb (zeros for
+        missing keys), per-key deterministic — the coalescable unit
+        behind ``handle_pull_serving``."""
         groups = self._slot_groups(keys, write=False)
         n = keys.shape[0]
         found = np.zeros((n,), bool)
@@ -499,8 +631,23 @@ class ShardServer(rpc.FramedRPCServer):
                 found[idx] = f
                 emb[idx] = e
                 w[idx] = ww
-        out: Dict[str, np.ndarray] = {"found": found, "w": w}
-        out.update(encode_emb(emb, req.get("wire", "f32")))
+        return {"found": found, "w": w, "emb": emb}
+
+    def handle_pull_serving(self, req) -> Dict[str, np.ndarray]:
+        """Serving-tier miss resolution: (found mask, w, wire-encoded
+        emb) for sorted unique keys in a locally replicated slot. A PURE
+        read like ``pull`` — unseen keys are NOT inserted — but it also
+        reports which keys exist (serving must answer zeros for a
+        feasign training never saw, not the trainer's init row) and
+        ships ONLY the serving fields (emb + w), never optimizer state:
+        a replica's miss path reads a fraction of the bytes a trainer
+        pull moves. Concurrent calls coalesce like ``pull``."""
+        keys = np.asarray(req["keys"], np.uint64)
+        rows = self._coalescer.rows("pull_serving", keys,
+                                    self._pull_serving_rows)
+        out: Dict[str, np.ndarray] = {"found": rows["found"],
+                                      "w": rows["w"]}
+        out.update(encode_emb(rows["emb"], req.get("wire", "f32")))
         self._bump("multihost/served_serving_keys", int(keys.size))
         return out
 
@@ -1115,8 +1262,42 @@ class ShardClient:
                 return out
             raise
 
+    def call_async(self, method: str, **kw) -> "_ShardFuture":
+        """Pipelined call on the underlying mux conn (PR 16): N
+        ``call_async`` results share one round trip instead of N.
+        ``result()`` applies the same fallback as :meth:`call` — a
+        transport failure on a method :meth:`call` would retry/fail
+        over re-issues it synchronously through :meth:`call`; anything
+        else re-raises (the caller owns catch-up, exactly as with the
+        blocking path)."""
+        return _ShardFuture(self, self._conn.call_async(method, **kw),
+                            method, kw)
+
     def close(self) -> None:
         self._conn.close()
+
+
+class _ShardFuture:
+    """Future returned by :meth:`ShardClient.call_async`: resolves the
+    pipelined reply, falling back to the client's synchronous
+    retry/failover path when the transport died and the method is safe
+    to re-issue (a read, or the idempotent-by-contract ``push``)."""
+
+    _REISSUE = ShardClient.READS | frozenset(("push",))
+
+    def __init__(self, client: ShardClient, fut, method: str, kw: dict):
+        self._client = client
+        self._fut = fut
+        self._method = method
+        self._kw = kw
+
+    def result(self, timeout: Optional[float] = None):
+        try:
+            return self._fut.result(timeout)
+        except (OSError, ConnectionError, wire.WireError):
+            if self._method not in self._REISSUE:
+                raise
+            return self._client.call(self._method, **self._kw)
 
 
 def start_local_shards(world: int, config: TableConfig, *, seed: int = 0,

@@ -207,31 +207,35 @@ class MultiHostStore:
 
     def _fanout(self, work: List[Tuple[int, dict]], method: str) -> Dict:
         """Issue one RPC per non-empty peer slice concurrently (the DCN
-        fan-out); raise the first error — a lost shard must fail the
-        pass loudly, never return garbage rows (a dead-primary write
-        surfaces as a TRANSIENT StalePrimaryError so the pass retry
-        re-resolves and replays)."""
+        fan-out) by PIPELINING on the slots' mux'd conns — all requests
+        go on the wire back-to-back from this thread, then the replies
+        are collected (PR 16: no per-peer helper threads, and the
+        caller's trace context rides each send naturally). Raise the
+        first error — a lost shard must fail the pass loudly, never
+        return garbage rows (a dead-primary write surfaces as a
+        TRANSIENT StalePrimaryError so the pass retry re-resolves and
+        replays)."""
         results: Dict[int, object] = {}
         errs: List[Tuple[int, BaseException]] = []
-        # Carry the caller's trace context into the fan-out threads
-        # (thread-locals don't cross Thread boundaries), so every
-        # per-peer RPC of one pass boundary shares the pass's trace id.
-        tctx = trace.current_context()
-
-        def run(host: int, kw: dict) -> None:
-            try:
-                with trace.use_context(tctx):
-                    results[host] = self._clients[host].call(method, **kw)
-            except BaseException as e:
-                errs.append((host, e))
-
         if len(work) == 1:
-            run(*work[0])
+            h, kw = work[0]
+            try:
+                results[h] = self._clients[h].call(method, **kw)
+            except BaseException as e:
+                errs.append((h, e))
         else:
-            ts = [threading.Thread(target=run, args=(h, kw), daemon=True)
-                  for h, kw in work]
-            [t.start() for t in ts]
-            [t.join() for t in ts]
+            futs = []
+            for h, kw in work:
+                try:
+                    futs.append(
+                        (h, self._clients[h].call_async(method, **kw)))
+                except BaseException as e:
+                    errs.append((h, e))
+            for h, f in futs:
+                try:
+                    results[h] = f.result()
+                except BaseException as e:
+                    errs.append((h, e))
         if errs:
             for h, e in errs:
                 if isinstance(e, RuntimeError) and "STALE_PRIMARY" in str(e):
@@ -262,28 +266,31 @@ class MultiHostStore:
         return c
 
     def _admin_fanout(self, kw: dict, method: str) -> Dict[str, object]:
-        """One RPC per distinct server, concurrently; first error
-        raises (admin ops — save/load/reset/shrink — must cover the
-        whole cluster or fail loudly)."""
+        """One RPC per distinct server, pipelined like :meth:`_fanout`;
+        first error raises (admin ops — save/load/reset/shrink — must
+        cover the whole cluster or fail loudly)."""
         eps = self._admin_eps()
         results: Dict[str, object] = {}
         errs: List[BaseException] = []
-        tctx = trace.current_context()
-
-        def run(ep: str) -> None:
+        if len(eps) == 1:
             try:
-                with trace.use_context(tctx):
-                    results[ep] = self._ep_client(ep).call(method, **kw)
+                results[eps[0]] = self._ep_client(eps[0]).call(
+                    method, **kw)
             except BaseException as e:
                 errs.append(e)
-
-        if len(eps) == 1:
-            run(eps[0])
         else:
-            ts = [threading.Thread(target=run, args=(ep,), daemon=True)
-                  for ep in eps]
-            [t.start() for t in ts]
-            [t.join() for t in ts]
+            futs = []
+            for ep in eps:
+                try:
+                    futs.append(
+                        (ep, self._ep_client(ep).call_async(method, **kw)))
+                except BaseException as e:
+                    errs.append(e)
+            for ep, f in futs:
+                try:
+                    results[ep] = f.result()
+                except BaseException as e:
+                    errs.append(e)
         if errs:
             _raise_translated(errs[0])
         return results

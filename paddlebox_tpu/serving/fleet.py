@@ -90,33 +90,55 @@ class HashRing:
 
 
 class _ConnPool:
-    """Per-replica FramedRPCConn pool: router handler threads forward
-    concurrently, and one conn serializes its calls under a lock — a
-    pool keeps fan-in from queueing behind a single socket. Predict is
-    deliberately NOT declared idempotent on these conns: a dead replica
-    must surface immediately so the ROUTER re-routes, instead of the
-    conn burning its retry deadline reconnecting to a corpse."""
+    """Per-replica conn source for router handler threads. With the mux
+    wire (PR 16, ``FLAGS_rpc_mux``) this collapses to ONE multiplexed
+    conn shared by every thread: in-flight request ids let N
+    outstanding predicts interleave on a single socket, so the per-conn
+    serialization that motivated a pool is gone (per-thread latency
+    attribution — ``last_server_ms`` — is thread-local on the conn).
+    ``release`` on the shared conn is a no-op and an error-path
+    ``conn.close()`` just poisons the current mux generation — the next
+    acquire reuses the object and it reconnects lazily. ``--norpc_mux``
+    restores the legacy pool-of-conns (one conn per concurrent caller).
+    Predict is deliberately NOT declared idempotent on these conns: a
+    dead replica must surface immediately so the ROUTER re-routes,
+    instead of the conn burning its retry deadline reconnecting to a
+    corpse."""
 
     def __init__(self, endpoint: str, timeout: float):
         self.endpoint = endpoint
         self._timeout = timeout
         self._free: List[rpc.FramedRPCConn] = []
+        self._shared: Optional[rpc.FramedRPCConn] = None
         self._lock = threading.Lock()
 
-    def acquire(self) -> rpc.FramedRPCConn:
-        with self._lock:
-            if self._free:
-                return self._free.pop()
+    def _new(self) -> rpc.FramedRPCConn:
         return rpc.FramedRPCConn(self.endpoint, timeout=self._timeout,
                                  service_name="fleet-replica")
 
+    def acquire(self) -> rpc.FramedRPCConn:
+        if flags.flag("rpc_mux"):
+            with self._lock:
+                if self._shared is None:
+                    self._shared = self._new()
+                return self._shared
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        return self._new()
+
     def release(self, conn: rpc.FramedRPCConn) -> None:
         with self._lock:
+            if conn is self._shared:
+                return
             self._free.append(conn)
 
     def close(self) -> None:
         with self._lock:
-            conns, self._free = self._free, []
+            conns, self._free = list(self._free), []
+            if self._shared is not None:
+                conns.append(self._shared)
+                self._shared = None
         for c in conns:
             c.close()
 
@@ -550,26 +572,30 @@ class ShardBackedStore:
                 work.append((h, idx))
         results: Dict[int, dict] = {}
         errs: List[BaseException] = []
-        # The caller's trace context (the coalesced batch's, via the
-        # micro-batcher) rides into the per-shard fan-out threads so
-        # the miss hop carries the predict's trace id.
-        tctx = trace.current_context()
-
-        def run(h: int, idx: np.ndarray) -> None:
+        # Pipelined on the slots' mux'd conns (PR 16): the sends leave
+        # back-to-back from this thread — which also means the caller's
+        # trace context (the coalesced batch's, via the micro-batcher)
+        # rides each request without thread plumbing.
+        if len(work) == 1:
+            h, idx = work[0]
             try:
-                with trace.use_context(tctx):
-                    results[h] = self._clients[h].call(
-                        "pull_serving", keys=keys[idx], wire=wire)
+                results[h] = self._clients[h].call(
+                    "pull_serving", keys=keys[idx], wire=wire)
             except BaseException as e:
                 errs.append(e)
-
-        if len(work) == 1:
-            run(*work[0])
         else:
-            ts = [threading.Thread(target=run, args=(h, idx), daemon=True)
-                  for h, idx in work]
-            [t.start() for t in ts]
-            [t.join() for t in ts]
+            futs = []
+            for h, idx in work:
+                try:
+                    futs.append((h, self._clients[h].call_async(
+                        "pull_serving", keys=keys[idx], wire=wire)))
+                except BaseException as e:
+                    errs.append(e)
+            for h, f in futs:
+                try:
+                    results[h] = f.result()
+                except BaseException as e:
+                    errs.append(e)
         if errs:
             # A lost shard fails the miss resolution loudly — serving a
             # zero row for a key the tier OWNS would silently mis-rank.

@@ -52,6 +52,10 @@ class FleetRouter(rpc.FramedRPCServer):
 
     service_name = "fleet-router"
 
+    # The router's ``stats`` fans RPCs out to every replica — a blocking
+    # network handler, so it must NOT run inline on the poller thread.
+    POLLER_INLINE = rpc.FramedRPCServer.POLLER_INLINE - {"stats"}
+
     def __init__(self, endpoint: str = "127.0.0.1:0", *,
                  fleet: Optional[ServingFleet] = None,
                  replicas: Optional[Sequence[str]] = None,

@@ -1,0 +1,43 @@
+"""Subprocess echo server for the RPC mux kill -9 drill
+(tests/test_rpc_mux.py): one FramedRPCServer with an ``echo`` handler
+(optional server-side sleep so the harness can land a SIGKILL while
+calls are provably in flight) on an ephemeral loopback port. The
+endpoint is advertised through an atomic file rename; the process then
+idles until the harness kills it — the process IS the failure domain,
+exactly like the shard-host drill worker."""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(root: str, name: str) -> None:
+    import numpy as np
+
+    from paddlebox_tpu.distributed import rpc
+
+    class EchoServer(rpc.FramedRPCServer):
+        service_name = "rpc-drill"
+
+        def handle_echo(self, req):
+            sleep_ms = float(req.get("sleep_ms", 0.0))
+            if sleep_ms > 0:
+                time.sleep(sleep_ms / 1e3)
+            return {"a": np.asarray(req["a"], np.float32) * 2.0,
+                    "who": name}
+
+    server = EchoServer("127.0.0.1:0")
+    tmp = os.path.join(root, f".{name}.ep.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"endpoint": server.endpoint, "pid": os.getpid()}, f)
+    os.replace(tmp, os.path.join(root, f"{name}.ep"))
+    while True:
+        time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

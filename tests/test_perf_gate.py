@@ -510,3 +510,54 @@ def test_quality_keys_direction_and_gating(tmp_path):
     bad["quality"]["slot_coverage"] = 0.2
     assert perf_gate.main(
         [_write(tmp_path, "q_bad_cov.json", bad), "--baseline", b]) == 1
+
+
+def test_rpc_keys_direction_and_gating(tmp_path):
+    """bench.py rpc keys (PR 16 event-loop/mux wire): per-cell
+    calls_per_s and bytes_per_s gate higher-better (`_per_s` wins over
+    the lower-better `_bytes` suffix in the same segment), the window
+    p50/p99 gate lower-better, and the mux-over-legacy ratio + frame
+    counts are provenance (never gated)."""
+    base = {"metric": "rpc_echo_mux_calls_per_sec",
+            "value": 30000.0,
+            "windows": 400,
+            "mux_over_legacy_at_o4": 2.6,
+            "sg_frames": 842,
+            "modes": {"legacy": {"64b_o4": {"calls_per_s": 11000.0,
+                                            "p50_ms": 0.35,
+                                            "p99_ms": 1.0,
+                                            "bytes_per_s": 1.4e6}},
+                      "mux": {"64b_o4": {"calls_per_s": 30000.0,
+                                         "p50_ms": 0.13,
+                                         "p99_ms": 0.5,
+                                         "bytes_per_s": 3.8e6}},
+                      "sg": {"1mb_o4": {"calls_per_s": 1800.0,
+                                        "p50_ms": 2.2,
+                                        "p99_ms": 6.0,
+                                        "bytes_per_s": 3.8e9}}}}
+    assert perf_gate.direction("modes.mux.64b_o4.calls_per_s") == 1
+    assert perf_gate.direction("modes.sg.1mb_o4.bytes_per_s") == 1
+    assert perf_gate.direction("modes.mux.64b_o4.p99_ms") == -1
+    assert perf_gate.direction("mux_over_legacy_at_o4") == 0
+    assert perf_gate.direction("sg_frames") == 0
+    assert perf_gate.direction("windows") == 0
+
+    b = _write(tmp_path, "rpc_base.json", base)
+    assert perf_gate.main(
+        [_write(tmp_path, "rpc_same.json", base), "--baseline", b]) == 0
+    # Mux throughput collapse and a blown tail each trip the gate.
+    bad = copy.deepcopy(base)
+    bad["modes"]["mux"]["64b_o4"]["calls_per_s"] *= 0.4
+    bad["modes"]["sg"]["1mb_o4"]["p99_ms"] = 80.0
+    rep = _write(tmp_path, "rpc_bad.json", bad)
+    assert perf_gate.main([rep, "--baseline", b]) == 1
+    _, regs = perf_gate.compare(bad, base)
+    names = {r["metric"] for r in regs}
+    assert "modes.mux.64b_o4.calls_per_s" in names
+    assert "modes.sg.1mb_o4.p99_ms" in names
+    # The speedup ratio drifting is provenance, never a gate trip.
+    ok = copy.deepcopy(base)
+    ok["mux_over_legacy_at_o4"] = 0.5
+    ok["sg_frames"] = 3
+    assert perf_gate.main(
+        [_write(tmp_path, "rpc_ok.json", ok), "--baseline", b]) == 0

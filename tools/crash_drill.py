@@ -60,11 +60,15 @@ FULL_SITES = FAST_SITES + [
 # The replicated multihost tier's crash windows (--matrix multihost):
 # shard-kill (die mid replica forward), journal-truncate (die between
 # the store apply and the journal append — store ahead of journal),
-# repair-interrupt (die inside the promotion role flip).
+# repair-interrupt (die inside the promotion role flip), and mid-frame
+# (die while a scatter/gather array frame is half-received — the
+# receiver's preallocated buffer holds a torn payload that must never
+# reach a store).
 MULTIHOST_SITES = [
     ("multihost/replica_forward", 1),
     ("multihost/journal_append", 2),
     ("multihost/replica_promote", 1),
+    ("rpc/sg_recv", 1),
 ]
 
 

@@ -62,7 +62,12 @@ class _Access:
 
 def _thread_entries(proj: P.Project) -> Set[str]:
     """Qualnames of functions used as Thread targets (or run() methods
-    of Thread subclasses)."""
+    of Thread subclasses). The RPC plane's poller loops
+    (``FramedRPCServer._poll_loop``, the mux ``_reader_loop``) enter
+    here like any other root: everything the ONE poller thread owns —
+    selector registrations, ``_Conn`` state, queue-depth counters — is
+    thread-reachable and analyzed; single-writer poller-owned slots
+    carry ``allow-lock`` pragmas naming the ownership argument."""
     entries: Set[str] = set()
     for mod in proj.modules.values():
         for qual, fi in mod.functions.items():

@@ -284,6 +284,17 @@ def smoke() -> int:
                         "slot_coverage": 0.99,
                         "skew_top_share": 0.35,
                         "key_churn": 0.5},
+            # bench rpc keys (r21 event-loop/mux RPC plane): rates gate
+            # higher-better ("_per_s" is checked BEFORE the lower-better
+            # "_bytes" suffix, so bytes_per_s gates as a rate), window
+            # quantiles lower-better ("_ms"); the mux-over-legacy ratio
+            # and frame counts are provenance and must NOT gate.
+            "modes": {"mux": {"64kb_o4": {"calls_per_s": 30000.0,
+                                          "p50_ms": 0.4,
+                                          "p99_ms": 1.2,
+                                          "bytes_per_s": 3.9e9}}},
+            "mux_over_legacy_at_o4": 2.6,
+            "sg_frames": 842,
             "steps_per_dispatch": 4,        # not gated (count)
             "ingest_workers": 8,            # not gated (count)
             "store_build_native": True,     # not gated (bool)
@@ -340,6 +351,10 @@ def smoke() -> int:
     bad["quality"]["copc"] = 0.6              # provenance: must NOT gate
     bad["quality"]["skew_top_share"] = 0.9    # provenance: must NOT gate
     bad["quality"]["key_churn"] = 0.9         # provenance: must NOT gate
+    bad["modes"]["mux"]["64kb_o4"]["calls_per_s"] *= 0.4  # mux got slow
+    bad["modes"]["mux"]["64kb_o4"]["p99_ms"] = 60.0       # tail blown
+    bad["mux_over_legacy_at_o4"] = 0.5        # provenance: must NOT gate
+    bad["sg_frames"] = 3                      # provenance: must NOT gate
     _, regs = compare(bad, base)
     names = {r["metric"] for r in regs}
     for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
@@ -357,14 +372,17 @@ def smoke() -> int:
                  "post_shrink_store_rows",
                  "telemetry.telemetry_overhead_frac",
                  "quality.calibration_error.p99",
-                 "quality.quality_alarms", "quality.slot_coverage"):
+                 "quality.quality_alarms", "quality.slot_coverage",
+                 "modes.mux.64kb_o4.calls_per_s",
+                 "modes.mux.64kb_o4.p99_ms"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
     for never in ("ingest_workers", "store_build_native",
                   "reshard_moved_rows", "replicas.r2.clients",
                   "stream_passes", "events", "telemetry.scrapes",
                   "quality.copc", "quality.skew_top_share",
-                  "quality.key_churn"):
+                  "quality.key_churn", "mux_over_legacy_at_o4",
+                  "sg_frames"):
         expect(f"provenance {never!r} not gated", never in names, False)
     # An IMPROVEMENT must never trip the gate.
     good = json.loads(json.dumps(base))
