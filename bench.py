@@ -1561,9 +1561,52 @@ def bench_multihost() -> dict:
                 "pull_ms": round(pull_s / MULTIHOST_ROUNDS * 1e3, 2),
                 "push_ms": round(push_s / MULTIHOST_ROUNDS * 1e3, 2),
                 "wire_bytes_per_round": int(moved // MULTIHOST_ROUNDS),
+                # One pass boundary = one pull + one push of the pass's
+                # working set: the DCN byte bill the quantized wire
+                # shrinks. Gated lower-better ("_bytes_").
+                "cross_host_bytes_per_pass": int(
+                    moved // MULTIHOST_ROUNDS),
             }
     finally:
         flags.set_flags({"multihost_wire_dtype": prev})
+    assert (out_wire["int8"]["cross_host_bytes_per_pass"] * 2
+            <= out_wire["f32"]["cross_host_bytes_per_pass"]), out_wire
+
+    # Overlapped boundary exchange (the split-build early pulls + this
+    # round's background exchange worker): each round writes the pass
+    # back with push_from_pass_async — the 50% shared window pushes
+    # synchronously, the bulk drains on the worker while the "trainer"
+    # computes — then the next pass pulls its shared window
+    # barrier-free at the boundary. exchange_overlap_frac = 1 -
+    # wait/busy over the phase; gated higher-better ("overlap_frac").
+    _tick("multihost:overlap")
+    from paddlebox_tpu.embedding.table import shared_key_mask
+    half = np.zeros(keys.size, bool)
+    half[::2] = True
+    rows = store.pull_for_pass(keys, pass_id=1000)
+    xs0 = store.exchange_stats()
+    ov_t0 = time.perf_counter()
+    for r in range(MULTIHOST_ROUNDS):
+        pid = 1000 + r
+        job = store.push_from_pass_async(keys, rows,
+                                         priority_select=half,
+                                         pass_id=pid)
+        while not job.done:          # the pass's training compute
+            np.multiply(rows["emb"], np.float32(1.0))
+        store.pull_for_pass(keys, half, pass_id=pid + 1,
+                            barrier=False, boundary=True)
+        rows = store.pull_for_pass(keys, pass_id=pid + 1)
+    ov_s = time.perf_counter() - ov_t0
+    xs1 = store.exchange_stats()
+    xbusy = xs1["exchange_busy_ms"] - xs0["exchange_busy_ms"]
+    xwait = xs1["exchange_wait_ms"] - xs0["exchange_wait_ms"]
+    overlap = {
+        "exchange_overlap_frac": round(
+            max(0.0, min(1.0, 1.0 - xwait / max(xbusy, 1e-9))), 4),
+        "exchange_busy_ms": round(xbusy, 2),
+        "exchange_wait_ms": round(xwait, 2),
+        "overlap_round_ms": round(ov_s / MULTIHOST_ROUNDS * 1e3, 2),
+    }
 
     # Tracing + scrape overhead on the exchange path (f32 wire): the
     # same pull+push rounds with the span ring ON — every RPC then
@@ -1639,6 +1682,7 @@ def bench_multihost() -> dict:
         "repair_ms": fo["repair_ms"],
         "journal_catchup_rows_per_s": fo["journal_catchup_rows_per_s"],
         "failover_failed_pulls": fo["failed_pulls"],  # provenance: 0
+        "overlap": overlap,
         "telemetry": telemetry,
         "embedding_quant_block": int(flags.flag("embedding_quant_block")),
     }

@@ -602,6 +602,34 @@ define_flag("multihost_journal_entries", 256,
             "full range snapshot instead of deltas — the bound that "
             "keeps journal memory and catch-up work finite. <= 0 "
             "disables journaling (every catch-up is a full copy)")
+define_flag("multihost_overlap_exchange", True,
+            "run the multi-host boundary exchange on a background "
+            "worker (multihost/store.py): end_pass pushes and the "
+            "split-build early pulls overlap the next pass's training "
+            "instead of serializing with the boundary; only the "
+            "shared-key remainder (plus the rows the pending pass "
+            "needs back — the priority slice of the push) waits. "
+            "Pushes are full-row overwrites keyed by the cached owner "
+            "plan, so overlap ordering cannot change results. False = "
+            "every pull/push synchronous in the caller (the "
+            "pre-overlap wire, bit-identical either way)")
+define_flag("dense_allreduce_dtype", "f32",
+            "wire dtype of the dense-grad cross-replica sync "
+            "(parallel/collective.py quantized_psum): 'f32' (exact "
+            "lax.psum, default — bit-parity pinned), 'bf16' (halve "
+            "the wire, stochastic-free cast), or 'int8' (EQuARX-style "
+            "per-block absmax quantize -> scatter -> f32 "
+            "dequant-accumulate -> gather; per-block scales via "
+            "embedding_quant_block). Under a hierarchical ici+dcn "
+            "mesh only the DCN hop narrows; the ICI hop stays f32")
+define_flag("reshard_chunk_rows", 65536,
+            "row window of the bounded-memory reshard/repair COPY walk "
+            "(multihost/reshard.py + replica snapshots): pull_range / "
+            "replica_snapshot move at most this many rows per RPC, "
+            "pipelined two windows in flight (pull chunk k+1 while "
+            "chunk k applies), each chunk an idempotent full-row "
+            "overwrite so kill -9 drills carry over unchanged. <= 0 = "
+            "whole-range single-shot moves (the pre-chunking wire)")
 define_flag("stream_tail_bytes", False,
             "streaming ingest: tail-consume log files still being "
             "APPENDED — the source tracks a durable per-file byte "

@@ -106,7 +106,8 @@ def emit_pass_report(kind: str, *, steps: int, samples: int,
     # AND land as gauges so the JSONL exporter carries the overlap win.
     b = summary.get("boundary")
     if isinstance(b, dict):
-        for k in ("end_ms", "build_ms", "feed_wait_ms", "overlap_frac"):
+        for k in ("end_ms", "build_ms", "feed_wait_ms", "overlap_frac",
+                  "exchange_overlap_frac"):
             v = b.get(k)
             if isinstance(v, (int, float)):
                 reg.set_gauge(f"pass/{kind}_boundary_{k}", float(v))

@@ -187,24 +187,50 @@ class PassEngine:
                 active = self._current_keys  # snapshot; sorted or None
                 vals = None
                 shared = None
+                # Multi-host tier: plan-aware partial pulls slice ONE
+                # cached owner plan (keyed by this pass's id) instead of
+                # re-deriving an argsort per sub-pull, and the key set
+                # publishes EARLY so the active pass's end_pass can
+                # split its push into the priority slice (rows this
+                # pass pulls back at the boundary) + an overlapped bulk
+                # remainder on the exchange worker.
+                mh = hasattr(self.store, "push_from_pass_async")
+                pid = self._pass_id + 1 if mh else None
+                if mh:
+                    pending.keys = keys
                 if (active is not None and active.size and keys.size
                         and not self._no_active_pass.is_set()):
                     shared = shared_key_mask(active, keys)
                     if shared.any() and not shared.all():
-                        part = self.store.pull_for_pass(keys[~shared])
+                        part = (self.store.pull_for_pass(
+                                    keys, ~shared, pass_id=pid) if mh
+                                else self.store.pull_for_pass(
+                                    keys[~shared]))
                         n = keys.shape[0]
                         vals = {f: np.empty((n,) + v.shape[1:], v.dtype)
                                 for f, v in part.items()}
                         for f, v in part.items():
                             vals[f][~shared] = v
                     elif not shared.any():
-                        vals = self.store.pull_for_pass(keys)
+                        vals = (self.store.pull_for_pass(
+                                    keys, pass_id=pid) if mh
+                                else self.store.pull_for_pass(keys))
                         shared = None
                 self._wait_boundary(pending)
                 if vals is None:
-                    vals = self.store.pull_for_pass(keys)
+                    vals = (self.store.pull_for_pass(keys, pass_id=pid)
+                            if mh else self.store.pull_for_pass(keys))
                 elif shared is not None:
-                    part = self.store.pull_for_pass(keys[shared])
+                    # The ONE coalesced boundary pull: only the shared
+                    # remainder waits here. barrier=False is safe — the
+                    # shared rows were pushed synchronously as the
+                    # priority slice of end_pass's write-back, and any
+                    # still-queued bulk push holds only keys NOT in
+                    # this pass.
+                    part = (self.store.pull_for_pass(
+                                keys, shared, pass_id=pid,
+                                barrier=False, boundary=True) if mh
+                            else self.store.pull_for_pass(keys[shared]))
                     for f, v in part.items():
                         vals[f][shared] = v
                 table = build_pass_table_host(
@@ -433,7 +459,22 @@ class PassEngine:
             else:
                 vals = extract_pass_values_host(
                     self._table, self._current_keys.shape[0])
-                self.store.push_from_pass(self._current_keys, vals)
+                if hasattr(self.store, "push_from_pass_async"):
+                    # Priority split: rows the PENDING pass pulls back
+                    # at its boundary push synchronously; the disjoint
+                    # bulk remainder overlaps the next pass's training
+                    # on the exchange worker. No pending keys yet (or
+                    # overlap off) degrades to the serial push inside
+                    # push_from_pass_async.
+                    p = self._pending
+                    nxt = p.keys if p is not None else None
+                    pri = (shared_key_mask(nxt, self._current_keys)
+                           if nxt is not None and nxt.size else None)
+                    self.store.push_from_pass_async(
+                        self._current_keys, vals, priority_select=pri,
+                        pass_id=self._pass_id)
+                else:
+                    self.store.push_from_pass(self._current_keys, vals)
         self._table = None
         self._current_keys = None
         self._current_rows = None
@@ -451,8 +492,16 @@ class PassEngine:
         ``build_ms`` the whole feed_pass build, ``feed_wait_ms`` the
         serial fraction of it — the time the builder sat blocked on the
         active pass. overlap_frac = 1 - feed_wait/build is computed by
-        the per-pass reporter from these deltas."""
+        the per-pass reporter from these deltas.
+
+        A store with a background exchange worker (MultiHostStore)
+        contributes ``exchange_busy_ms``/``exchange_wait_ms`` — the
+        reporter derives boundary.exchange_overlap_frac (1 -
+        wait/busy) from their per-pass deltas."""
         snap = self.timers.snapshot_ms()
-        return {"end_ms": snap.get("end_pass", 0.0),
-                "build_ms": snap.get("feed_pass", 0.0),
-                "feed_wait_ms": snap.get("feed_wait", 0.0)}
+        out = {"end_ms": snap.get("end_pass", 0.0),
+               "build_ms": snap.get("feed_pass", 0.0),
+               "feed_wait_ms": snap.get("feed_wait", 0.0)}
+        if hasattr(self.store, "exchange_stats"):
+            out.update(self.store.exchange_stats())
+        return out

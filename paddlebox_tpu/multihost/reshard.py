@@ -35,11 +35,47 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from paddlebox_tpu.core import faults, log, monitor, trace
+from paddlebox_tpu.core import faults, flags, log, monitor, trace
 from paddlebox_tpu.multihost.keyrange import ShardRangeTable, plan_moves
 from paddlebox_tpu.multihost.replication import ReplicaMap
 from paddlebox_tpu.multihost.shard_service import ShardClient
 from paddlebox_tpu.multihost.store import MultiHostStore
+
+
+def _copy_segment(src: ShardClient, dst: ShardClient, seg,
+                  chunk: int) -> int:
+    """COPY one plan segment src -> dst. With FLAGS_reshard_chunk_rows
+    > 0 the walk is paged into bounded row windows and pipelined TWO
+    windows deep: the pull for window k+1 is issued (``call_async`` on
+    the PR 16 mux conn) before window k installs on the dst, so the DCN
+    pull hides behind the apply and peak memory is two windows instead
+    of the whole segment. Every window is a full-row overwrite
+    (idempotent) and ``pull_range`` is a pure read, so a kill -9
+    mid-walk replays cleanly from the recovery chain."""
+    if chunk <= 0:
+        rows = src.call("pull_range", lo=str(seg.lo), hi=str(seg.hi))
+        n = int(np.asarray(rows["keys"]).shape[0])
+        if n:
+            dst.call("apply_rows", keys=rows["keys"],
+                     values=rows["values"])
+        return n
+    moved = 0
+    fut = src.call_async("pull_range", lo=str(seg.lo),
+                         hi=str(seg.hi), limit=chunk)
+    while fut is not None:
+        rows = fut.result()
+        fut = None
+        if bool(rows.get("more")):
+            fut = src.call_async(
+                "pull_range", lo=str(seg.lo), hi=str(seg.hi),
+                after=str(int(rows["next_after"])), limit=chunk)
+        keys = np.asarray(rows["keys"])
+        if keys.shape[0]:
+            faults.faultpoint("multihost/reshard_chunk")
+            dst.call("apply_rows", keys=keys, values=rows["values"])
+            moved += int(keys.shape[0])
+        monitor.add("multihost/reshard_chunks", 1)
+    return moved
 
 
 def execute_reshard(old_endpoints: Sequence[str],
@@ -69,16 +105,14 @@ def execute_reshard(old_endpoints: Sequence[str],
         with trace.span("multihost/reshard",
                         old_world=old_ranges.world,
                         new_world=new_ranges.world, segments=len(plan)):
-            # COPY: read-only on sources; overwrite-install on dests.
+            # COPY: read-only on sources; overwrite-install on dests,
+            # in bounded pipelined windows (FLAGS_reshard_chunk_rows).
+            chunk = int(flags.flag("reshard_chunk_rows"))
             for seg in plan:
                 faults.faultpoint("multihost/reshard_move")
-                rows = conns[old_endpoints[seg.src]].call(
-                    "pull_range", lo=str(seg.lo), hi=str(seg.hi))
-                n = int(np.asarray(rows["keys"]).shape[0])
-                if n:
-                    conns[new_endpoints[seg.dst]].call(
-                        "apply_rows", keys=rows["keys"],
-                        values=rows["values"])
+                n = _copy_segment(conns[old_endpoints[seg.src]],
+                                  conns[new_endpoints[seg.dst]],
+                                  seg, chunk)
                 moved += n
                 seg_counts.append(n)
             # ADOPT: every server of the NEW generation takes the new

@@ -72,6 +72,11 @@ from paddlebox_tpu.multihost.replication import (DeltaJournal, ReplicaMap,
 
 _SPAN = 1 << 64
 
+# Backup-slot epoch while a CHUNKED replica snapshot is mid-stream.
+# Never equals a real journal epoch, so a crash between chunks makes the
+# next catch-up negotiation fall back to a fresh full snapshot.
+_SNAPSHOT_PARTIAL = "~snapshot-partial~"
+
 
 def wire_mode() -> str:
     mode = flags.flag("multihost_wire_dtype")
@@ -480,10 +485,31 @@ class ShardServer(rpc.FramedRPCServer):
         if entries is None:
             store = self._slot_stores[slot]
             keys, _ = store.key_stats()
-            vals = store.pull_for_pass(keys)
-            peer.call("replica_snapshot", slot=slot, seq=j.seq,
-                      epoch=j.epoch, keys=keys, values=vals,
-                      unseen=store.unseen_for(keys))
+            unseen = store.unseen_for(keys)
+            chunk = int(flags.flag("reshard_chunk_rows"))
+            n = int(keys.size)
+            if chunk <= 0 or n <= chunk:
+                peer.call("replica_snapshot", slot=slot, seq=j.seq,
+                          epoch=j.epoch, keys=keys,
+                          values=store.pull_for_pass(keys),
+                          unseen=unseen)
+            else:
+                # Bounded-memory re-replication: stream the snapshot in
+                # FLAGS_reshard_chunk_rows windows so neither side ever
+                # materializes the whole slot in one RPC. Chunks are
+                # synchronous (strictly ordered); the backup holds the
+                # mid-snapshot sentinel epoch until 'last' commits, so
+                # a kill -9 between chunks forces a clean re-snapshot.
+                for i0 in range(0, n, chunk):
+                    i1 = min(i0 + chunk, n)
+                    sub = keys[i0:i1]
+                    peer.call("replica_snapshot", slot=slot, seq=j.seq,
+                              epoch=j.epoch, keys=sub,
+                              values=store.pull_for_pass(sub),
+                              unseen=unseen[i0:i1],
+                              part=("first" if i0 == 0 else
+                                    "last" if i1 == n else "mid"))
+                    self._bump("multihost/replica_snapshot_chunks", 1)
             self._bump("multihost/replica_snapshots", 1)
             self._bump("multihost/replica_snapshot_rows",
                        int(keys.size))
@@ -720,16 +746,40 @@ class ShardServer(rpc.FramedRPCServer):
 
     def handle_replica_snapshot(self, req) -> int:
         """Full-slot overwrite install (catch-up past the journal
-        window, or initial re-replication COPY). Idempotent."""
+        window, or initial re-replication COPY). Idempotent.
+
+        Chunked form (bounded-memory re-replication): the primary
+        streams the snapshot in FLAGS_reshard_chunk_rows windows —
+        ``part='first'`` REPLACES the slot store and stamps the
+        mid-snapshot sentinel epoch, ``part='mid'`` appends,
+        ``part='last'`` appends then commits the real (seq, epoch).
+        A kill -9 between chunks leaves the sentinel epoch, which can
+        never equal a primary's epoch, so the next catch-up negotiation
+        re-snapshots from scratch instead of trusting a torn store."""
         slot, seq = int(req["slot"]), int(req["seq"])
+        part = str(req.get("part", "all"))
         with self._slot_lock(slot):
             store = self._require_backup(slot)
             keys = np.asarray(req["keys"], np.uint64)
             vals = {f: np.asarray(req["values"][f]) for f in _FIELDS}
-            store.set_all(keys, vals,
-                          unseen=np.asarray(req["unseen"], np.int32))
-            self._applied_seq[slot] = seq
-            self._slot_epoch[slot] = str(req.get("epoch", ""))
+            unseen = np.asarray(req["unseen"], np.int32)
+            if part in ("all", "first"):
+                store.set_all(keys, vals, unseen=unseen)
+            elif part in ("mid", "last"):
+                if self._slot_epoch.get(slot) != _SNAPSHOT_PARTIAL:
+                    raise RuntimeError(
+                        f"SNAPSHOT_GAP: slot {slot} got snapshot chunk "
+                        f"part={part!r} without an open first chunk — "
+                        "restart the snapshot")
+                if keys.size:
+                    store.push_from_pass(keys, vals, unseen=unseen)
+            else:
+                raise ValueError(f"unknown snapshot part {part!r}")
+            if part in ("all", "last"):
+                self._applied_seq[slot] = seq
+                self._slot_epoch[slot] = str(req.get("epoch", ""))
+            else:
+                self._slot_epoch[slot] = _SNAPSHOT_PARTIAL
         return int(keys.size)
 
     def handle_replica_seq(self, req) -> Dict:
@@ -806,28 +856,57 @@ class ShardServer(rpc.FramedRPCServer):
         """Copy (NOT pop) of every resident row whose placement hash is
         in [lo, hi) — the read-only COPY phase of a reshard move, so a
         crash mid-move loses nothing. Scans every locally replicated
-        slot store (one store in the R=1 layout)."""
+        slot store (one store in the R=1 layout).
+
+        Cursor paging (``after``/``limit``): with ``limit > 0`` the
+        reply holds at most ``limit`` rows in global key order starting
+        strictly AFTER the ``after`` key, plus ``more``/``next_after``
+        so the caller can walk the range in bounded windows
+        (FLAGS_reshard_chunk_rows) instead of materializing the whole
+        range in one RPC. Pure read — re-pulling any window is free."""
         lo, hi = int(req["lo"]), int(req["hi"])
-        parts_k: List[np.ndarray] = []
-        parts_v: List[Dict[str, np.ndarray]] = []
+        after = int(req.get("after", 0) or 0)
+        limit = int(req.get("limit", 0) or 0)
+        slot_sel: List[Tuple[int, np.ndarray]] = []
         for slot in sorted(self._slot_stores):
             store = self._slot_stores[slot]
             keys, _ = store.key_stats()
             mask = self.ranges.mask_in_range(keys, lo, hi)
+            if after:
+                mask &= keys > np.uint64(after)
             sel = keys[mask]
             if sel.size:
-                parts_k.append(sel)
-                parts_v.append(store.pull_for_pass(sel))
+                slot_sel.append((slot, sel))
+        more = False
+        next_after = 0
+        total = sum(int(s.size) for _, s in slot_sel)
+        if limit > 0 and total > limit:
+            # The page is the `limit` smallest candidate keys (slot
+            # ranges are disjoint, so keys are unique across stores and
+            # a <=-cut reproduces the global order exactly).
+            cut = np.sort(
+                np.concatenate([s for _, s in slot_sel]))[limit - 1]
+            slot_sel = [(slot, s[s <= cut]) for slot, s in slot_sel]
+            slot_sel = [(slot, s) for slot, s in slot_sel if s.size]
+            more = True
+            next_after = int(cut)
+        parts_k: List[np.ndarray] = []
+        parts_v: List[Dict[str, np.ndarray]] = []
+        for slot, sel in slot_sel:
+            parts_k.append(sel)
+            parts_v.append(self._slot_stores[slot].pull_for_pass(sel))
         if not parts_k:
             empty = self._slot_stores[self.index].pull_for_pass(
                 np.empty((0,), np.uint64))
-            return {"keys": np.empty((0,), np.uint64), "values": empty}
+            return {"keys": np.empty((0,), np.uint64), "values": empty,
+                    "more": False, "next_after": "0"}
         keys = np.concatenate(parts_k)
         vals = {f: np.concatenate([p[f] for p in parts_v])
                 for f in parts_v[0]}
         order = np.argsort(keys, kind="stable")
         return {"keys": keys[order],
-                "values": {f: v[order] for f, v in vals.items()}}
+                "values": {f: v[order] for f, v in vals.items()},
+                "more": more, "next_after": str(next_after)}
 
     def handle_apply_rows(self, req) -> int:
         """Install moved rows (full-row OVERWRITE — naturally idempotent,

@@ -33,8 +33,11 @@ to the pre-replication client.
 
 from __future__ import annotations
 
+import queue
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -68,6 +71,100 @@ class _OwnerPlan:
         return (len(self.slices) == world
                 and self.keys.shape == keys.shape
                 and np.array_equal(self.keys, keys))
+
+
+class _ExchangeJob:
+    """Handle of one background exchange job (a queued boundary push):
+    ``wait()`` blocks until the worker ran it and re-raises its error
+    in the caller — the pass-retry loop, not the worker thread, owns
+    failure classification."""
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._err: Optional[BaseException] = None
+        self.busy_ms = 0.0
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while not self._done.wait(timeout=0.5):
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError("exchange job did not complete")
+        if self._err is not None:
+            raise self._err
+
+
+_DONE_JOB = _ExchangeJob()
+_DONE_JOB._done.set()
+
+
+class _ExchangeWorker:
+    """The ONE background exchange thread: a FIFO of whole push jobs
+    drained in order, so overlapped pushes commute with nothing — a
+    job either ran completely or has not started (no torn peer state;
+    cancel never drops a queued push). ``drain()`` is the ordering
+    barrier pulls take before touching rows a queued push may still
+    own."""
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue[Optional[Tuple[Callable[[], None], _ExchangeJob]]]" = (
+            queue.Queue())
+        self._lock = threading.Lock()
+        self._busy_ms = 0.0
+        self._pending = 0
+        self._idle = threading.Event()
+        self._idle.set()
+        self._thread = threading.Thread(
+            target=self._run, name="multihost-exchange", daemon=True)
+        self._thread.start()
+
+    def submit(self, fn: Callable[[], None]) -> _ExchangeJob:
+        job = _ExchangeJob()
+        with self._lock:
+            self._pending += 1
+            self._idle.clear()
+        self._q.put((fn, job))
+        return job
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, job = item
+            t0 = time.perf_counter()
+            try:
+                fn()
+            except BaseException as e:
+                job._err = e
+            finally:
+                job.busy_ms = (time.perf_counter() - t0) * 1e3
+                with self._lock:
+                    self._busy_ms += job.busy_ms
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.set()
+                job._done.set()
+
+    def busy_ms(self) -> float:
+        with self._lock:
+            return self._busy_ms
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while not self._idle.wait(timeout=0.5):
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError("exchange worker drain timed out")
+
+    def stop(self) -> None:
+        self.drain()
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
 
 
 def _raise_translated(e: BaseException) -> None:
@@ -122,8 +219,20 @@ class MultiHostStore:
         # distinct from the per-slot data clients so a backup-only host
         # is still reachable for cluster-wide maintenance.
         self._admin_clients: Dict[str, ShardClient] = {}
-        self._plan: Optional[_OwnerPlan] = None
+        # Owner-plan cache keyed by pass id (the pull computes a pass's
+        # plan, the matching partial pulls and push reuse it; an
+        # interleaved admin fan-out can no longer evict it — the
+        # single-entry cache of the pre-overlap tier could).
+        self._plans: "OrderedDict[object, _OwnerPlan]" = OrderedDict()
+        self._plan_seq = 0
         self._plan_lock = threading.Lock()
+        # Background exchange worker (FLAGS_multihost_overlap_exchange):
+        # lazily started by the first async push; wait/busy are the
+        # overlap accounting behind boundary.exchange_overlap_frac.
+        self._exchange: Optional[_ExchangeWorker] = None
+        self._exchange_lock = threading.Lock()
+        self._exchange_wait_ms = 0.0
+        self._exchange_jobs: List[_ExchangeJob] = []
         monitor.set_gauge("multihost/world_size", float(self.ranges.world))
         if self.replica_map is not None:
             monitor.set_gauge("multihost/replication",
@@ -166,7 +275,7 @@ class MultiHostStore:
         self.ranges = ranges
         self._clients = self._build_clients()
         with self._plan_lock:
-            self._plan = None
+            self._plans.clear()
         for c in old:
             c.close()
         monitor.set_gauge("multihost/world_size", float(ranges.world))
@@ -183,7 +292,7 @@ class MultiHostStore:
         self._clients = self._build_clients()
         if not same_bounds:
             with self._plan_lock:
-                self._plan = None
+                self._plans.clear()
         for c in old:
             c.close()
         live = set(rmap.all_endpoints())
@@ -194,15 +303,37 @@ class MultiHostStore:
         monitor.set_gauge("multihost/replication",
                           float(rmap.replication))
 
-    def _plan_for(self, keys: np.ndarray) -> _OwnerPlan:
+    _PLAN_CACHE = 4
+
+    def _plan_for(self, keys: np.ndarray,
+                  pass_id: Optional[int] = None) -> _OwnerPlan:
         """The ONE owner argsort per pass: the pull computes it, the
-        matching push (same shared sorted key array) reuses it."""
+        matching partial pulls and push (same shared sorted key array,
+        same ``pass_id``) reuse it. Every re-derivation counts on
+        ``multihost/plan_misses`` — a steady-state pass pays exactly
+        one."""
         with self._plan_lock:
-            plan = self._plan
-            if plan is not None and plan.matches(keys, self.ranges.world):
-                return plan
+            if pass_id is not None:
+                plan = self._plans.get(("pass", pass_id))
+                if (plan is not None
+                        and plan.matches(keys, self.ranges.world)):
+                    self._plans.move_to_end(("pass", pass_id))
+                    return plan
+            for k in reversed(self._plans):
+                plan = self._plans[k]
+                if plan.matches(keys, self.ranges.world):
+                    self._plans.move_to_end(k)
+                    return plan
+            monitor.add("multihost/plan_misses", 1)
             plan = _OwnerPlan(keys, self.ranges)
-            self._plan = plan
+            if pass_id is not None:
+                key: object = ("pass", pass_id)
+            else:
+                self._plan_seq += 1
+                key = ("anon", self._plan_seq)
+            self._plans[key] = plan
+            while len(self._plans) > self._PLAN_CACHE:
+                self._plans.popitem(last=False)
             return plan
 
     def _fanout(self, work: List[Tuple[int, dict]], method: str) -> Dict:
@@ -251,6 +382,56 @@ class MultiHostStore:
             _raise_translated(errs[0][1])
         return results
 
+    # -- background exchange worker ---------------------------------------
+
+    def _exchange_worker(self) -> _ExchangeWorker:
+        with self._exchange_lock:
+            if self._exchange is None:
+                self._exchange = _ExchangeWorker()
+            return self._exchange
+
+    def _submit_exchange(self, fn: Callable[[], None]) -> _ExchangeJob:
+        job = self._exchange_worker().submit(fn)
+        with self._exchange_lock:
+            self._exchange_jobs.append(job)
+        return job
+
+    def _drain_exchange(self, *, swallow: bool = False) -> None:
+        """Barrier on the exchange worker: every queued push completes
+        before the caller proceeds (pulls and admin/maintenance ops may
+        otherwise observe a peer mid-overwrite). The blocked time is
+        the 'not overlapped' half of exchange_overlap_frac."""
+        w = self._exchange
+        if w is None:
+            return
+        t0 = time.perf_counter()
+        w.drain()
+        with self._exchange_lock:
+            self._exchange_wait_ms += (time.perf_counter() - t0) * 1e3
+            jobs, self._exchange_jobs = self._exchange_jobs, []
+        errs = [j._err for j in jobs if j._err is not None]
+        if errs and not swallow:
+            _raise_translated(errs[0])
+
+    def exchange_stats(self) -> Dict[str, float]:
+        """Cumulative overlap accounting of the background exchange:
+        ``exchange_busy_ms`` (worker time spent moving bytes) and
+        ``exchange_wait_ms`` (caller time blocked on the worker). Their
+        complement-ratio is exchange_overlap_frac — 1.0 means every
+        background byte moved while the caller was doing other work."""
+        w = self._exchange
+        with self._exchange_lock:
+            wait = self._exchange_wait_ms
+        return {"exchange_busy_ms": w.busy_ms() if w else 0.0,
+                "exchange_wait_ms": wait}
+
+    def exchange_overlap_frac(self) -> float:
+        s = self.exchange_stats()
+        if s["exchange_busy_ms"] <= 0.0:
+            return 1.0
+        return max(0.0, min(1.0, 1.0 - s["exchange_wait_ms"]
+                            / s["exchange_busy_ms"]))
+
     def _admin_eps(self) -> List[str]:
         """Every distinct server process — primaries AND backup-only
         hosts (a freshly re-replicated host leads no slot yet but must
@@ -268,7 +449,10 @@ class MultiHostStore:
     def _admin_fanout(self, kw: dict, method: str) -> Dict[str, object]:
         """One RPC per distinct server, pipelined like :meth:`_fanout`;
         first error raises (admin ops — save/load/reset/shrink — must
-        cover the whole cluster or fail loudly)."""
+        cover the whole cluster or fail loudly). Always barriers on the
+        exchange worker: a bulk push still in flight during a save or
+        shrink would be a lost (or doubly-lifecycled) write."""
+        self._drain_exchange(swallow=(method in ("reset", "stop")))
         eps = self._admin_eps()
         results: Dict[str, object] = {}
         errs: List[BaseException] = []
@@ -297,25 +481,58 @@ class MultiHostStore:
 
     # -- pass build surface ------------------------------------------------
 
-    def pull_for_pass(self, pass_keys_sorted: np.ndarray
-                      ) -> Dict[str, np.ndarray]:
+    def pull_for_pass(self, pass_keys_sorted: np.ndarray,
+                      select: Optional[np.ndarray] = None, *,
+                      pass_id: Optional[int] = None,
+                      barrier: bool = True,
+                      boundary: bool = False) -> Dict[str, np.ndarray]:
+        """Pull rows for a pass's sorted key array — ONE coalesced RPC
+        per owning peer. ``select`` (bool mask over the FULL key array)
+        pulls only the masked subset while still slicing from the one
+        full-array owner plan, so the split-build partial pulls share
+        the plan (and the push reuses it via ``pass_id``) instead of
+        re-deriving an argsort per sub-pull. Rows return compacted in
+        key order of the selected subset.
+
+        ``barrier`` (default) drains the background exchange first —
+        a queued push may still own rows this pull reads. The boundary
+        shared-remainder pull passes ``barrier=False``: its keys are
+        disjoint from every queued bulk push by construction (bulk =
+        previous-pass keys NOT in the pending pass). ``boundary=True``
+        counts the fan-out on ``multihost/boundary_pulls`` — the pin
+        that each boundary pays one coalesced pull round."""
         faults.faultpoint("multihost/shard_pull")
         keys = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+        if barrier:
+            self._drain_exchange()
         wire = shard_service.wire_mode()
-        plan = self._plan_for(keys)
+        plan = self._plan_for(keys, pass_id)
         n = keys.shape[0]
+        if select is None:
+            slices: List[np.ndarray] = list(plan.slices)
+            pos: Optional[np.ndarray] = None
+            n_out = n
+        else:
+            sel = np.asarray(select, bool)
+            sel_idx = np.flatnonzero(sel)
+            pos = np.empty(n, np.int64)
+            pos[sel_idx] = np.arange(sel_idx.size)
+            slices = [idx[sel[idx]] for idx in plan.slices]
+            n_out = int(sel_idx.size)
         work = [(h, {"keys": keys[idx], "wire": wire})
-                for h, idx in enumerate(plan.slices) if idx.size]
+                for h, idx in enumerate(slices) if idx.size]
         if not work:
             # Empty pass: preserve the FeatureStore contract of fully
             # shaped (0, ...) field arrays.
             return self._empty_rows()
-        with trace.span("multihost/shard_pull", keys=n,
+        if boundary:
+            monitor.add("multihost/boundary_pulls", 1)
+        with trace.span("multihost/shard_pull", keys=n_out,
                         world=self.ranges.world):
             results = self._fanout(work, "pull")
         out: Optional[Dict[str, np.ndarray]] = None
         rx_bytes = 0
-        for h, idx in enumerate(plan.slices):
+        for h, idx in enumerate(slices):
             if not idx.size:
                 continue
             res = results[h]
@@ -325,11 +542,12 @@ class MultiHostStore:
             for k in ("emb_f16", "emb_q", "emb_scale", "emb_width"):
                 res.pop(k, None)
             if out is None:
-                out = {f: np.empty((n,) + v.shape[1:], v.dtype)
+                out = {f: np.empty((n_out,) + v.shape[1:], v.dtype)
                        for f, v in res.items()}
+            dst = idx if pos is None else pos[idx]
             for f, v in res.items():
-                out[f][idx] = v
-        monitor.add("multihost/pull_keys", n)
+                out[f][dst] = v
+        monitor.add("multihost/pull_keys", n_out)
         monitor.add("multihost/pull_bytes", rx_bytes)
         monitor.set_gauge(
             "multihost/wire_bits",
@@ -348,14 +566,32 @@ class MultiHostStore:
                 "click": np.empty((0,), np.float32)}
 
     def push_from_pass(self, pass_keys_sorted: np.ndarray,
-                       values: Dict[str, np.ndarray]) -> None:
+                       values: Dict[str, np.ndarray],
+                       select: Optional[np.ndarray] = None, *,
+                       pass_id: Optional[int] = None,
+                       barrier: bool = True) -> None:
+        """Write back a pass's rows — one coalesced RPC per owning
+        peer, slicing the SAME owner plan the pull built (``pass_id``).
+        ``select`` pushes only the masked rows (``values`` stays the
+        full [n] arrays); ``barrier`` keeps a direct push FIFO-ordered
+        behind queued background pushes (the async path passes False —
+        its slices are disjoint from the queue by construction)."""
         faults.faultpoint("multihost/shard_push")
         keys = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+        if barrier:
+            self._drain_exchange()
         wire = shard_service.wire_mode()
-        plan = self._plan_for(keys)
+        plan = self._plan_for(keys, pass_id)
+        if select is None:
+            slices: List[np.ndarray] = list(plan.slices)
+            n_out = int(keys.shape[0])
+        else:
+            sel = np.asarray(select, bool)
+            slices = [idx[sel[idx]] for idx in plan.slices]
+            n_out = int(np.count_nonzero(sel))
         work = []
         tx_bytes = 0
-        for h, idx in enumerate(plan.slices):
+        for h, idx in enumerate(slices):
             if not idx.size:
                 continue
             vals = {f: v[idx] for f, v in values.items()}
@@ -363,12 +599,55 @@ class MultiHostStore:
             payload.update(encode_emb(vals["emb"], wire))
             tx_bytes += payload_nbytes(payload)
             work.append((h, {"keys": keys[idx], "values": payload}))
-        with trace.span("multihost/shard_push", keys=int(keys.shape[0]),
+        with trace.span("multihost/shard_push", keys=n_out,
                         world=self.ranges.world):
             if work:
                 self._fanout(work, "push")
-        monitor.add("multihost/push_keys", int(keys.shape[0]))
+        monitor.add("multihost/push_keys", n_out)
         monitor.add("multihost/push_bytes", tx_bytes)
+
+    def push_from_pass_async(self, pass_keys_sorted: np.ndarray,
+                             values: Dict[str, np.ndarray], *,
+                             priority_select: Optional[np.ndarray] = None,
+                             pass_id: Optional[int] = None
+                             ) -> _ExchangeJob:
+        """end_pass write-back with the boundary taken off the critical
+        path: the ``priority_select`` rows (the ones the PENDING pass
+        pulls back at its boundary — previous ∩ next keys) push
+        synchronously here, and the disjoint bulk remainder drains on
+        the background exchange worker while the next pass trains.
+        Pushes are full-row overwrites keyed by the cached owner plan,
+        so this reordering cannot change any result — only when each
+        byte moves. With ``FLAGS_multihost_overlap_exchange`` off (or
+        no priority info and no worker benefit) the whole push runs
+        synchronously; the returned job is always waitable."""
+        from paddlebox_tpu.core import flags
+        if (not bool(flags.flag("multihost_overlap_exchange"))
+                or priority_select is None):
+            # Overlap off — or no pending-pass key info, so no proof
+            # which rows the next boundary pull needs: push everything
+            # synchronously (a whole-pass push queued behind the
+            # boundary could be read stale by a barrier-free shared
+            # pull).
+            self.push_from_pass(pass_keys_sorted, values,
+                                pass_id=pass_id)
+            return _DONE_JOB
+        keys = np.ascontiguousarray(pass_keys_sorted, np.uint64)
+        pri = np.asarray(priority_select, bool)
+        if pri.any():
+            # Disjoint from every queued bulk push (those are
+            # earlier-pass keys NOT in the pass these rows belong to),
+            # so no FIFO barrier needed.
+            self.push_from_pass(keys, values, pri, pass_id=pass_id,
+                                barrier=False)
+        bulk = ~pri
+        if not bulk.any():
+            return _DONE_JOB
+
+        def run() -> None:
+            self.push_from_pass(keys, values, bulk, pass_id=pass_id,
+                                barrier=False)
+        return self._submit_exchange(run)
 
     # -- size / maintenance ------------------------------------------------
 
@@ -379,6 +658,7 @@ class MultiHostStore:
         out = np.zeros(k.shape, bool)
         if k.size == 0:
             return out
+        self._drain_exchange()
         owner = self.ranges.owner_of(k)
         work = [(h, {"keys": k[owner == h]}) for h in range(self.world)
                 if (owner == h).any()]
@@ -394,6 +674,7 @@ class MultiHostStore:
         out = np.zeros(k.shape, np.int32)
         if k.size == 0:
             return out
+        self._drain_exchange()
         owner = self.ranges.owner_of(k)
         work = [(h, {"keys": k[owner == h]}) for h in range(self.world)
                 if (owner == h).any()]
@@ -436,6 +717,7 @@ class MultiHostStore:
         quiesce for drills/benches; no-op sans replication)."""
         if self.replica_map is None:
             return {}
+        self._drain_exchange()
         out: Dict[int, Dict[str, int]] = {}
         for slot in range(self.world):
             if len(self.replica_map.replicas_of(slot)) > 1:
@@ -448,7 +730,7 @@ class MultiHostStore:
         chain reload that follows re-filters rows by range)."""
         self._admin_fanout({}, "reset")
         with self._plan_lock:
-            self._plan = None
+            self._plans.clear()
 
     # -- checkpoint surface ------------------------------------------------
 
@@ -488,6 +770,14 @@ class MultiHostStore:
             pass
 
     def close(self) -> None:
+        with self._exchange_lock:
+            w, self._exchange = self._exchange, None
+            self._exchange_jobs = []
+        if w is not None:
+            try:
+                w.stop()
+            except Exception:
+                pass
         for c in self._clients:
             c.close()
         for c in self._admin_clients.values():

@@ -66,8 +66,102 @@ def broadcast(x: jax.Array, axis: Axis, *, root: int = 0) -> jax.Array:
     return lax.psum(x * mask, axis)
 
 
+def _quantized_allreduce_flat(flat: jax.Array, axis: Axis,
+                              wire_dtype: str, block: int) -> jax.Array:
+    """All-reduce-sum one flat f32 vector over ``axis`` with a narrowed
+    wire (EQuARX, PAPERS.md): the scatter hop ships each rank's destined
+    segment quantized (int8 per-block absmax scales, or a bf16 cast),
+    accumulation ALWAYS happens in f32 after dequantization, and the
+    gather hop ships the reduced segment through the same codec.
+    ``wire_dtype='f32'`` is a plain ``lax.psum`` — bit-identical to the
+    pre-quantization program, so the default path never changes HLO.
+
+    The reduce-scatter is realized as a tiled ``all_to_all`` of the
+    quantized rows (a real ``psum_scatter`` would accumulate IN the wire
+    dtype — int8 sums overflow immediately); row i of the [n, seg]
+    reshape is the segment destined for rank i.
+    """
+    if wire_dtype == "f32":
+        return lax.psum(flat, axis)
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    n = 1
+    for nm in names:
+        n *= lax.axis_size(nm)
+    if n == 1:
+        return flat
+    orig = flat.size
+    pad = (-orig) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    seg_w = flat.size // n
+    rows = flat.reshape(n, seg_w)
+    if wire_dtype == "bf16":
+        recv = lax.all_to_all(rows.astype(jnp.bfloat16), axis,
+                              split_axis=0, concat_axis=0, tiled=True)
+        seg = jnp.sum(recv.astype(jnp.float32), axis=0)
+        out = lax.all_gather(seg.astype(jnp.bfloat16), axis, axis=0,
+                             tiled=True).astype(jnp.float32)
+    elif wire_dtype == "int8":
+        from paddlebox_tpu.multihost.quant import (dequantize_blocked,
+                                                   quantize_blocked)
+        q, scales = quantize_blocked(rows, block)
+        q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                           tiled=True)
+        scales = lax.all_to_all(scales, axis, split_axis=0,
+                                concat_axis=0, tiled=True)
+        seg = jnp.sum(dequantize_blocked(q, scales, seg_w, block), axis=0)
+        qg, sg = quantize_blocked(seg[None, :], block)
+        qg = lax.all_gather(qg[0], axis, axis=0, tiled=True)
+        sg = lax.all_gather(sg[0], axis, axis=0, tiled=True)
+        out = dequantize_blocked(qg.reshape(n, seg_w),
+                                 sg.reshape(n, -1), seg_w,
+                                 block).reshape(-1)
+    else:
+        raise ValueError(
+            f"quantized allreduce wire must be f32|bf16|int8, "
+            f"got {wire_dtype!r}")
+    return out[:orig] if pad else out
+
+
+def quantized_psum(tree, axis: Axis, *, wire_dtype: str = "f32",
+                   block: int = 128):
+    """All-reduce-sum a pytree over ``axis`` with a reduced-precision
+    wire (``FLAGS_dense_allreduce_dtype``): blocked int8 absmax
+    quantize -> scatter -> f32 dequant-accumulate -> gather, reusing
+    the ``multihost/quant.py`` jnp codec twins. ``'f32'`` returns
+    ``lax.psum(tree, axis)`` verbatim — the default program is
+    bit-identical to the unquantized sync.
+
+    Like :func:`hierarchical_psum_tree` the tree is fused into ONE flat
+    vector (raveled leaves, padded to a multiple of the axis size) so
+    arbitrary leaf shapes never break the segment split, and per-block
+    scales amortize over the whole fused grad block. Call under
+    shard_map / pjit manual axes with ``axis`` in scope.
+    """
+    if wire_dtype == "f32":
+        return lax.psum(tree, axis)
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    sizes = [int(l.size) for l in leaves]
+    shapes = [l.shape for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    acc_dt = jnp.result_type(*dtypes)
+    flat = jnp.concatenate([l.astype(acc_dt).ravel() for l in leaves])
+    flat = _quantized_allreduce_flat(flat.astype(jnp.float32), axis,
+                                     wire_dtype, block).astype(acc_dt)
+    out = []
+    off = 0
+    for size, shape, dt in zip(sizes, shapes, dtypes):
+        out.append(flat[off:off + size].reshape(shape).astype(dt))
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
 def hierarchical_psum_tree(tree, *, inner_axis: Axis,
-                           outer_axis: Axis):
+                           outer_axis: Axis,
+                           outer_wire_dtype: str = "f32",
+                           quant_block: int = 128):
     """All-reduce-sum a pytree across inner (ICI) × outer (DCN) axes by
     the bandwidth-optimal two-level schedule: reduce-scatter over the
     fast inner axis, all-reduce only the 1/inner_n shard over the slow
@@ -86,6 +180,13 @@ def hierarchical_psum_tree(tree, *, inner_axis: Axis,
     split — same fusion the reference applies to the dense param block.
     Numerically == ``lax.psum(tree, (inner, outer))`` up to summation
     order. Call under shard_map with both axes in scope.
+
+    ``outer_wire_dtype`` narrows ONLY the slow outer (DCN) hop through
+    the :func:`quantized_psum` codec (``'bf16'``/``'int8'``); the fast
+    ICI reduce-scatter/all-gather stays f32 — the DCN link is where
+    bytes cost, and keeping ICI exact bounds the quantization error to
+    one outer round trip. ``'f32'`` (default) leaves the program
+    bit-identical to the pre-quantization wire.
     """
     leaves, treedef = jax.tree.flatten(tree)
     if not leaves:
@@ -104,10 +205,12 @@ def hierarchical_psum_tree(tree, *, inner_axis: Axis,
     if n_in > 1:
         part = lax.psum_scatter(flat, inner_axis, scatter_dimension=0,
                                 tiled=True)
-        part = lax.psum(part, outer_axis)
+        part = _quantized_allreduce_flat(part, outer_axis,
+                                         outer_wire_dtype, quant_block)
         flat = lax.all_gather(part, inner_axis, axis=0, tiled=True)
     else:
-        flat = lax.psum(flat, outer_axis)
+        flat = _quantized_allreduce_flat(flat, outer_axis,
+                                         outer_wire_dtype, quant_block)
     out = []
     off = 0
     for size, shape, dt in zip(sizes, shapes, dtypes):

@@ -71,6 +71,28 @@ def shard_tree(tree: Any, mesh: Mesh, *, axis: str = "sharding",
     return jax.tree.map(jax.device_put, tree, sh)
 
 
+def reduce_gradients(grads: Any, axis: Any = "dp", *,
+                     wire_dtype: Optional[str] = None,
+                     block: Optional[int] = None) -> Any:
+    """Cross-replica gradient reduce of the ZeRO stack — the explicit
+    stage-1/2 reduce for step functions that sync grads by hand instead
+    of leaning on sharding annotations (the trainer's dense sync does).
+
+    Routes through ``parallel/collective.quantized_psum`` behind
+    ``FLAGS_dense_allreduce_dtype``: ``f32`` is a verbatim ``lax.psum``
+    (bit-identical), ``bf16``/``int8`` narrow the wire with f32
+    accumulation (per-block scales via ``FLAGS_embedding_quant_block``).
+    Call under shard_map / pjit manual axes with ``axis`` in scope.
+    """
+    from paddlebox_tpu.core import flags
+    from paddlebox_tpu.parallel.collective import quantized_psum
+    if wire_dtype is None:
+        wire_dtype = str(flags.flag("dense_allreduce_dtype"))
+    if block is None:
+        block = int(flags.flag("embedding_quant_block"))
+    return quantized_psum(grads, axis, wire_dtype=wire_dtype, block=block)
+
+
 def _resolve_host_kind(mesh: Mesh, requested: str) -> str:
     """Map the canonical host memory kind to what the backend actually
     exposes: TPU runtimes advertise ``pinned_host``; CPU backends (the

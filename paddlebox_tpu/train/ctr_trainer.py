@@ -42,7 +42,8 @@ from paddlebox_tpu.metrics import (AucState, auc_accumulate, auc_compute,
                                    auc_state_init)
 from paddlebox_tpu.ops.data_norm import (data_norm_apply, data_norm_init,
                                          normalize_dense_and_strip)
-from paddlebox_tpu.parallel.collective import hierarchical_psum_tree
+from paddlebox_tpu.parallel.collective import (hierarchical_psum_tree,
+                                               quantized_psum)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -428,6 +429,18 @@ class CTRTrainer:
         scale_sparse = self.config.scale_sparse_grad_by_batch
         sparse_scale = float(self.feed_config.batch_size)
         loss_of, auc_of = self._make_loss_auc(raxes)
+        # Dense-grad wire dtype (FLAGS_dense_allreduce_dtype): trace-time
+        # constant — 'f32' keeps the sync a verbatim lax.psum /
+        # hierarchical tree (bit-parity pinned); 'bf16'/'int8' narrow
+        # the allreduce wire with f32 accumulation (quantized_psum).
+        dense_wire = str(flags.flag("dense_allreduce_dtype"))
+        if dense_wire not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"dense_allreduce_dtype must be f32|bf16|int8, "
+                f"got {dense_wire!r}")
+        dense_qblock = int(flags.flag("embedding_quant_block"))
+        monitor.set_gauge("dense/allreduce_wire_bits",
+                          {"f32": 32, "bf16": 16, "int8": 8}[dense_wire])
         dn_on = self.config.data_norm
         if dn_on and mode == "async":
             # The reference routes data_norm stats through the async
@@ -483,10 +496,16 @@ class CTRTrainer:
                 # all-gather back (SyncParam's exact shape,
                 # boxps_worker.cc:584-645).
                 if dcn:
+                    # Only the slow DCN hop narrows under a reduced
+                    # dense wire; the ICI hops stay f32.
                     g_params = hierarchical_psum_tree(
-                        g_params, inner_axis=axis, outer_axis=dcn)
+                        g_params, inner_axis=axis, outer_axis=dcn,
+                        outer_wire_dtype=dense_wire,
+                        quant_block=dense_qblock)
                 else:
-                    g_params = lax.psum(g_params, axis)
+                    g_params = quantized_psum(g_params, axis,
+                                              wire_dtype=dense_wire,
+                                              block=dense_qblock)
                 updates, opt_state = optimizer.update(g_params, opt_state,
                                                       params)
                 params = optax.apply_updates(params, updates)
@@ -504,7 +523,9 @@ class CTRTrainer:
                         lambda x: lax.pmean(x, raxes), p),
                     lambda p: p, params)
             else:  # async: host table applies the update
-                g_params = lax.psum(g_params, raxes)
+                g_params = quantized_psum(g_params, raxes,
+                                          wire_dtype=dense_wire,
+                                          block=dense_qblock)
 
             if dn_on:
                 # Decayed summary update from the SAME stats the forward
@@ -1528,6 +1549,15 @@ class CTRTrainer:
         d["overlap_frac"] = (round(min(1.0, max(0.0, 1.0 - wait / build)),
                                    4)
                              if build > 1e-6 else None)
+        # Background DCN exchange (MultiHostStore worker): the fraction
+        # of exchange bytes that moved while the caller was doing other
+        # work. No exchange work this pass -> no row (the gauge would
+        # lie at 1.0 on single-host tiers).
+        xbusy = d.get("exchange_busy_ms", 0.0)
+        xwait = d.get("exchange_wait_ms", 0.0)
+        if xbusy > 1e-6:
+            d["exchange_overlap_frac"] = round(
+                min(1.0, max(0.0, 1.0 - xwait / xbusy)), 4)
         return d
 
     def _bottleneck_verdict(self, pipe_base, boundary,

@@ -156,6 +156,49 @@ def test_two_host_day_int8_wire_auc_parity(tmp_path):
                                atol=5e-2)
 
 
+def test_two_host_day_int8_dense_sync_auc_parity(tmp_path):
+    """The quantized dense-grad allreduce (FLAGS_dense_allreduce_dtype,
+    MULTIHOST.md): a 2-host day with the dp=8 dense sync on the int8
+    wire must track the exact-run losses closely and land AUC within
+    the documented 2e-2 — the DCN-byte win costs no training quality.
+    The shard wire stays f32 here so ONLY the dense sync quantizes."""
+    from paddlebox_tpu.core import flags as flagmod, monitor
+
+    data = str(tmp_path / "data")
+    _write_day(data, rows_per_split=192)
+
+    flat_runner = _make_runner(data, str(tmp_path / "out_flat"))
+    flat_stats = flat_runner.train_day(DAY)
+
+    servers, eps = start_local_shards(2, TableConfig(
+        name="emb", dim=8, learning_rate=0.1))
+    prev = flagmod.flag("dense_allreduce_dtype")
+    flagmod.set_flags({"dense_allreduce_dtype": "int8"})
+    try:
+        store = MultiHostStore(TableConfig(
+            name="emb", dim=8, learning_rate=0.1), eps)
+        runner = _make_runner(data, str(tmp_path / "out_i8d"),
+                              store=store)
+        stats = runner.train_day(DAY)
+        assert monitor.GLOBAL.get_gauge("dense/allreduce_wire_bits") == 8
+    finally:
+        flagmod.set_flags({"dense_allreduce_dtype": prev})
+        stop_shards(servers)
+    assert len(stats) == len(flat_stats) == 3
+    for sa, sb in zip(stats, flat_stats):
+        np.testing.assert_allclose(sa["loss"], sb["loss"],
+                                   rtol=2e-2, atol=2e-2)
+        assert abs(sa["auc"] - sb["auc"]) < 2e-2
+    # ...and the dense wire really quantized (params diverge, closely).
+    la = jax.tree_util.tree_leaves(runner.trainer.params)
+    lb = jax.tree_util.tree_leaves(flat_runner.trainer.params)
+    assert any(not np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(la, lb))
+    for a, b in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=5e-2)
+
+
 def test_mid_day_reshard_bit_identical_to_unresized(tmp_path):
     data = str(tmp_path / "data")
     _write_day(data)

@@ -250,6 +250,45 @@ def test_multihost_keys_direction_and_gating(tmp_path):
     assert not regs
 
 
+def test_overlap_and_bytes_per_pass_direction_and_gating(tmp_path):
+    """r22 keys: exchange_overlap_frac gates higher-better (a boundary
+    that stops hiding its exchange is a regression),
+    cross_host_bytes_per_pass gates lower-better through the
+    unit-in-the-middle `_bytes_` rule (the quantized wire exists to
+    shrink it), and the busy/wait walls gate as ordinary `_ms`."""
+    base = {"metric": "multihost_2host_exchange_keys_per_sec",
+            "value": 2.9e6,
+            "wire": {"f32": {"cross_host_bytes_per_pass": 3.4e6},
+                     "int8": {"cross_host_bytes_per_pass": 1.6e6}},
+            "overlap": {"exchange_overlap_frac": 0.97,
+                        "exchange_busy_ms": 18.0,
+                        "exchange_wait_ms": 0.1,
+                        "overlap_round_ms": 26.0}}
+    assert perf_gate.direction("overlap.exchange_overlap_frac") == 1
+    assert perf_gate.direction("wire.f32.cross_host_bytes_per_pass") == -1
+    assert perf_gate.direction("wire.int8.cross_host_bytes_per_pass") == -1
+    assert perf_gate.direction("overlap.exchange_busy_ms") == -1
+    assert perf_gate.direction("overlap.exchange_wait_ms") == -1
+
+    bad = copy.deepcopy(base)
+    bad["overlap"]["exchange_overlap_frac"] = 0.3   # un-hidden boundary
+    bad["wire"]["int8"]["cross_host_bytes_per_pass"] = 3.3e6  # wire grew
+    rep = _write(tmp_path, "ov_rep.json", bad)
+    b = _write(tmp_path, "ov_base.json", base)
+    assert perf_gate.main([rep, "--baseline", b]) == 1
+    _, regs = perf_gate.compare(bad, base)
+    names = {r["metric"] for r in regs}
+    assert "overlap.exchange_overlap_frac" in names
+    assert "wire.int8.cross_host_bytes_per_pass" in names
+    # Byte SHRINK and overlap IMPROVEMENT never trip.
+    good = copy.deepcopy(base)
+    good["wire"]["f32"]["cross_host_bytes_per_pass"] *= 0.4
+    good["overlap"]["exchange_overlap_frac"] = 1.0
+    good["overlap"]["exchange_wait_ms"] = 0.0
+    _, regs = perf_gate.compare(good, base)
+    assert not regs
+
+
 def test_replication_failover_keys_direction_and_gating(tmp_path):
     """Round-18 replicated-tier keys: failover_blip_ms (pull p99
     across a scripted primary kill) and repair_ms gate lower-better,

@@ -114,6 +114,17 @@ def main() -> None:
     print("FULL-STEP TPU AOT COMPILE: OK "
           f"(flops={ca.get('flops', 0):.3e})")
 
+    # int8 dense-sync variant (FLAGS_dense_allreduce_dtype=int8): the
+    # quantize -> psum(int32) -> dequantize dense-grad wire is a
+    # different device program than the verbatim-f32 step — it must
+    # survive XLA:TPU on its own.
+    flagmod.set_flags({"dense_allreduce_dtype": "int8"})
+    try:
+        tr._build_step().lower(*sds_like(args)).compile()
+    finally:
+        flagmod.set_flags({"dense_allreduce_dtype": "f32"})
+    print("FULL-STEP(int8 dense sync) TPU AOT COMPILE: OK")
+
     eval_step = tr._build_eval_step()
     eval_args = (tables, tr.params, tr.auc_state, rows, segs_j,
                  jnp.asarray(batch_obj.labels),

@@ -237,9 +237,20 @@ def smoke() -> int:
             # "_per_s" (checked BEFORE the lower-better "_bytes"/"_s"
             # suffixes), reshard_ms lower-better; reshard_moved_rows
             # is workload provenance and must NOT gate.
+            # cross_host_bytes_per_pass (r22 quantized wire) gates
+            # lower-better through the unit-in-the-middle "_bytes_"
+            # rule — the int8 wire exists to shrink this number.
             "wire": {"f32": {"cross_host_exchange_bytes_per_s": 2.4e8,
                              "exchange_keys_per_s": 2.9e6,
-                             "pull_ms": 7.0, "push_ms": 6.6}},
+                             "pull_ms": 7.0, "push_ms": 6.6,
+                             "cross_host_bytes_per_pass": 3.4e6}},
+            # bench multihost overlap keys (r22 overlapped boundary
+            # exchange): the hidden-fraction gates higher-better
+            # ("overlap_frac"), busy/wait walls lower-better ("_ms").
+            "overlap": {"exchange_overlap_frac": 0.95,
+                        "exchange_busy_ms": 18.0,
+                        "exchange_wait_ms": 0.1,
+                        "overlap_round_ms": 26.0},
             "reshard_ms": 13.0,
             "reshard_rows_per_s": 7.6e5,
             "reshard_moved_rows": 10036,
@@ -330,6 +341,8 @@ def smoke() -> int:
     bad["ingest_workers"] = 1          # provenance: must NOT gate
     bad["store_build_native"] = False  # provenance: must NOT gate
     bad["wire"]["f32"]["cross_host_exchange_bytes_per_s"] *= 0.3
+    bad["wire"]["f32"]["cross_host_bytes_per_pass"] *= 3.0  # wire grew
+    bad["overlap"]["exchange_overlap_frac"] = 0.2  # boundary un-hidden
     bad["reshard_ms"] = 200.0
     bad["reshard_moved_rows"] = 99999  # provenance: must NOT gate
     bad["failover_blip_ms"] = 5000.0          # failover got slow
@@ -362,6 +375,8 @@ def smoke() -> int:
                  "store_build_keys_per_s", "clients.c32.throughput_rps",
                  "clients.c32.batch_fill_frac",
                  "wire.f32.cross_host_exchange_bytes_per_s",
+                 "wire.f32.cross_host_bytes_per_pass",
+                 "overlap.exchange_overlap_frac",
                  "reshard_ms", "failover_blip_ms", "repair_ms",
                  "journal_catchup_rows_per_s",
                  "replicas.r2.throughput_rps",

@@ -355,6 +355,27 @@ def main():
     print(f"boundary fused (1 dispatch)  {t*1e3:8.2f} ms "
           f"(vs scatter+merge = 2 dispatches)")
 
+    _tick("quantized-psum")
+    # int8 dense-grad codec probe (FLAGS_dense_allreduce_dtype): the
+    # blocked quantize -> dequantize round-trip at fused dense-grad
+    # size — the per-step device cost quantized_psum adds on TOP of
+    # the DCN byte win (the collective itself needs a multi-device
+    # mesh; bench multihost carries the byte accounting).
+    from paddlebox_tpu.multihost.quant import (dequantize_blocked,
+                                               quantize_blocked)
+    GRAD = 1 << 20                             # ~1M-param dense block
+    QB = 128
+    g8 = jnp.asarray(rng.normal(size=(8, GRAD // 8)), jnp.float32)
+
+    @jax.jit
+    def qdq(x):
+        q, s = quantize_blocked(x, QB)
+        return dequantize_blocked(q, s, x.shape[1], QB)
+
+    t = timeit(qdq, g8)
+    print(f"int8 grad codec round-trip [{GRAD}] {t*1e3:8.2f} ms "
+          f"(block {QB})")
+
     _tick("bandwidth")
     # D2H bandwidth at end_pass sizes (np.asarray = the write-back path)
     for arr in (emb, jnp.asarray(rng.normal(size=(N_ROWS,)), jnp.float32)):
