@@ -1278,12 +1278,35 @@ def _bench_serve_telemetry_overhead(pred, *, n_requests: int = 200
     t = threading.Thread(target=scrape_loop, daemon=True)
     t.start()
     try:
-        rps_on = timed_loop()
+        try:
+            rps_on = timed_loop()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+            trace.disable()
+            trace.clear()
+        # Health plane (fleet health PR): history sampler + alert
+        # engine ON at a deliberately hot 100ms cadence — every
+        # registered registry (global + router + replica instance
+        # rings) is sampled and the burn-rate rule pack evaluated per
+        # tick. history_overhead_frac is the additional rps cost vs
+        # telemetry-off; alerts_firing must be 0 on a healthy bench
+        # (both gated by tools/perf_gate.py).
+        from paddlebox_tpu.core import alerts, timeseries
+        prev = {k: flags.flag(k)
+                for k in ("history_interval_s", "alerts_enable")}
+        flags.set_flags({"history_interval_s": 0.1,
+                         "alerts_enable": True})
+        try:
+            timeseries.init_from_flags()
+            alerts.init_from_flags()
+            rps_health = timed_loop()
+            firing = alerts.firing_count()
+        finally:
+            alerts.shutdown()
+            timeseries.GLOBAL_SAMPLER.stop()
+            flags.set_flags(prev)
     finally:
-        stop.set()
-        t.join(timeout=10)
-        trace.disable()
-        trace.clear()
         cli.close()
         router.stop()
         server.stop()
@@ -1292,6 +1315,10 @@ def _bench_serve_telemetry_overhead(pred, *, n_requests: int = 200
         "trace_on_rps": round(rps_on, 1),
         "telemetry_overhead_frac": round(
             max(0.0, 1.0 - rps_on / max(rps_off, 1e-9)), 4),
+        "history_on_rps": round(rps_health, 1),
+        "history_overhead_frac": round(
+            max(0.0, 1.0 - rps_health / max(rps_off, 1e-9)), 4),
+        "alerts_firing": int(firing),
         "scrapes": int(scrapes[0]),
     }
 
@@ -1633,11 +1660,38 @@ def bench_multihost() -> dict:
         trace.clear()
     keys_off = MULTIHOST_ROUNDS * keys.size * 2 / off_s
     keys_on = MULTIHOST_ROUNDS * keys.size * 2 / on_s
+    # Health plane: history sampler + alert engine ON (100ms cadence
+    # over the global + per-shard instance rings, burn-rate pack
+    # evaluated per tick) for the same rounds — the additional keys/s
+    # cost is history_overhead_frac; alerts_firing must be 0 on a
+    # healthy bench. Both gated by tools/perf_gate.py.
+    from paddlebox_tpu.core import alerts as _alerts
+    from paddlebox_tpu.core import timeseries as _timeseries
+    _prev_hp = {k: flags.flag(k)
+                for k in ("history_interval_s", "alerts_enable")}
+    flags.set_flags({"history_interval_s": 0.1, "alerts_enable": True})
+    try:
+        _timeseries.init_from_flags()
+        _alerts.init_from_flags()
+        hp_t0 = time.perf_counter()
+        for _ in range(MULTIHOST_ROUNDS):
+            timed_round()
+        hp_s = time.perf_counter() - hp_t0
+        hp_firing = _alerts.firing_count()
+    finally:
+        _alerts.shutdown()
+        _timeseries.GLOBAL_SAMPLER.stop()
+        flags.set_flags(_prev_hp)
+    keys_health = MULTIHOST_ROUNDS * keys.size * 2 / hp_s
     telemetry = {
         "trace_off_keys_per_s": round(keys_off, 1),
         "trace_on_keys_per_s": round(keys_on, 1),
         "telemetry_overhead_frac": round(
             max(0.0, 1.0 - keys_on / max(keys_off, 1e-9)), 4),
+        "history_on_keys_per_s": round(keys_health, 1),
+        "history_overhead_frac": round(
+            max(0.0, 1.0 - keys_health / max(keys_off, 1e-9)), 4),
+        "alerts_firing": int(hp_firing),
     }
 
     # Grow-by-one reshard at the measured table size, audited against

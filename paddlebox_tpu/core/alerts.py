@@ -1,0 +1,387 @@
+"""Declarative SLO alerting over the metric history ring.
+
+The PaddleBox production discipline was named ``Monitor`` stats that a
+human watched; this module grows them into *objectives* a controller
+can consume (ROADMAP item 1's autoscaler/canary interface). Each
+:class:`SLORule` names one signal in the history ring
+(core/timeseries.py) and is evaluated with **multi-window burn-rate**
+semantics every sampler tick:
+
+- breach in the FAST window only       → ``pending`` (might be a blip)
+- breach in fast AND slow windows      → ``firing``  (sustained burn)
+- fast and slow clean for
+  ``FLAGS_alerts_clear_windows`` ticks → ``resolved`` (hysteresis —
+  one good sample never flaps a page), decaying to ``ok`` when a new
+  breach cycle starts.
+
+The default rule pack covers the signals the fleet already emits —
+merged predict p99 vs ``FLAGS_serving_slo_p99_ms``, ``slo/violations``
+error-budget burn, replica journal lag, event-to-servable freshness,
+``quality/alarms/*`` deltas and the boundary-exchange overlap floor —
+each gated on its threshold flag so an unset objective is simply not
+evaluated. Outputs are machine-readable three ways: the
+``alerts_active`` RPC (every framed server answers it), ``alert/<name>``
+counters on each firing transition, and one ``alert_report {json}``
+log line beside pass_report.
+
+Containment contract (ROBUSTNESS.md ``alerts/evaluate``): the
+evaluator runs on the sampler thread behind a faultpoint; a crash is
+counted (``alerts/evaluate_errors``), warned, and retried next tick —
+it can never take down a serving or training thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from paddlebox_tpu.core import faults, flags, log, monitor, timeseries, trace
+
+STATES = ("ok", "pending", "firing", "resolved")
+KINDS = ("quantile", "rate", "gauge", "delta")
+SEVERITIES = ("page", "warn")
+DIRECTIONS = ("above", "below")
+
+
+@dataclasses.dataclass
+class SLORule:
+    """One objective over one history signal.
+
+    ``threshold_flag`` (read at every evaluation, so an operator can
+    retune a live fleet) overrides ``threshold`` when set; a resolved
+    threshold of 0 with ``gate_on_threshold`` means the objective is
+    unset and the rule is skipped entirely.
+    """
+
+    name: str
+    metric: str
+    kind: str = "quantile"          # quantile | rate | gauge | delta
+    q: str = "p99"                  # quantile kind: which quantile
+    threshold: float = 0.0
+    threshold_flag: str = ""
+    direction: str = "above"        # breach when value above/below
+    burn: float = 1.0               # rate kind: burn-rate multiplier
+    severity: str = "page"
+    fast_window_s: float = 0.0      # 0 = FLAGS_alerts_fast_window_s
+    slow_window_s: float = 0.0      # 0 = FLAGS_alerts_slow_window_s
+    gate_on_threshold: bool = True  # skip rule while threshold <= 0
+
+    def validate(self) -> List[str]:
+        errs = []
+        if not self.name:
+            errs.append("rule with empty name")
+        if not self.metric:
+            errs.append(f"{self.name}: empty metric")
+        if self.kind not in KINDS:
+            errs.append(f"{self.name}: unknown kind {self.kind!r}")
+        if self.direction not in DIRECTIONS:
+            errs.append(f"{self.name}: unknown direction "
+                        f"{self.direction!r}")
+        if self.severity not in SEVERITIES:
+            errs.append(f"{self.name}: unknown severity "
+                        f"{self.severity!r}")
+        if self.burn <= 0:
+            errs.append(f"{self.name}: burn must be > 0")
+        if (self.fast_window_s and self.slow_window_s
+                and self.fast_window_s >= self.slow_window_s):
+            errs.append(f"{self.name}: fast window must be shorter "
+                        "than slow window")
+        return errs
+
+    # -- evaluation helpers ------------------------------------------------
+
+    def resolved_threshold(self) -> float:
+        if self.threshold_flag:
+            v = flags.flag(self.threshold_flag)
+            if isinstance(v, (int, float)) and float(v) > 0:
+                return float(v)
+            return 0.0 if self.gate_on_threshold else self.threshold
+        return self.threshold
+
+    def value(self, history: timeseries.MetricHistory,
+              window_s: float) -> Optional[float]:
+        if self.kind == "quantile":
+            wq = history.window_quantiles(self.metric, window_s)
+            v = wq.get(self.q)
+            return float(v) if isinstance(v, (int, float)) else None
+        if self.kind == "rate":
+            return history.rate(self.metric, window_s)
+        if self.kind == "delta":
+            prefix = self.metric.endswith("*")
+            name = self.metric[:-1] if prefix else self.metric
+            return history.delta(name, window_s, prefix=prefix)
+        v = history.latest(self.metric)  # gauge
+        return float(v) if isinstance(v, (int, float)) else None
+
+    def breached(self, value: Optional[float],
+                 threshold: float) -> bool:
+        if value is None:
+            return False
+        bar = threshold * self.burn if self.kind == "rate" else threshold
+        if self.direction == "below":
+            return value < bar
+        # "delta" objectives with threshold 0 mean "any event is a
+        # breach" (quality alarm bursts) — strict > keeps 0 clean.
+        return value > bar
+
+
+def default_rule_pack() -> List[SLORule]:
+    """The objectives the fleet already has signals for. Every rule is
+    threshold-flag gated: set the flag, get the objective —
+    FLAGS_serving_slo_p99_ms (predict p99), FLAGS_alerts_violations_per_s
+    (SLO-violation burn), FLAGS_alerts_replica_lag (fleet step lag),
+    FLAGS_alerts_freshness_p99_ms (event→servable p99), and
+    FLAGS_alerts_overlap_floor (boundary exchange overlap floor)."""
+    return [
+        SLORule(name="serving_predict_p99",
+                metric="serving/predict_ms", kind="quantile", q="p99",
+                threshold_flag="serving_slo_p99_ms", severity="page"),
+        SLORule(name="slo_violation_burn",
+                metric="slo/violations", kind="rate",
+                threshold_flag="alerts_violations_per_s",
+                severity="page"),
+        SLORule(name="replica_lag_p99",
+                metric="multihost/replica_lag_p99", kind="gauge",
+                threshold_flag="alerts_replica_lag", severity="page"),
+        SLORule(name="stream_freshness_p99",
+                metric="stream/event_to_servable_ms", kind="quantile",
+                q="p99", threshold_flag="alerts_freshness_p99_ms",
+                severity="warn"),
+        SLORule(name="quality_alarm_burst",
+                metric="quality/alarms/*", kind="delta", threshold=0.0,
+                severity="warn", gate_on_threshold=False),
+        SLORule(name="boundary_overlap_floor",
+                metric="pass/train_boundary_exchange_overlap_frac",
+                kind="gauge", direction="below",
+                threshold_flag="alerts_overlap_floor", severity="warn"),
+    ]
+
+
+def validate_rules(rules: List[SLORule]) -> List[str]:
+    errs: List[str] = []
+    seen: Dict[str, int] = {}
+    for r in rules:
+        errs.extend(r.validate())
+        seen[r.name] = seen.get(r.name, 0) + 1
+    errs.extend(f"duplicate rule name {n!r}" for n, c in seen.items()
+                if c > 1)
+    return errs
+
+
+@dataclasses.dataclass
+class AlertState:
+    rule: SLORule
+    state: str = "ok"
+    since: float = 0.0          # ts of the last state transition
+    clean_evals: int = 0
+    fired: int = 0              # firing transitions over lifetime
+    value_fast: Optional[float] = None
+    value_slow: Optional[float] = None
+    threshold: float = 0.0
+
+    def summary(self) -> Dict[str, Any]:
+        r = self.rule
+        return {"name": r.name, "state": self.state,
+                "severity": r.severity, "metric": r.metric,
+                "kind": r.kind, "direction": r.direction,
+                "value_fast": self.value_fast,
+                "value_slow": self.value_slow,
+                "threshold": self.threshold, "since": self.since,
+                "fired": self.fired}
+
+
+class AlertEngine:
+    """Evaluates a rule pack against ONE history every tick and runs
+    the PENDING→FIRING→RESOLVED machine per rule. Registered as a
+    sampler callback by :func:`init_from_flags`; tests drive
+    ``evaluate(now=...)`` directly on planted histories."""
+
+    def __init__(self, history: Optional[timeseries.MetricHistory] = None,
+                 rules: Optional[List[SLORule]] = None, *,
+                 clock: Callable[[], float] = time.time,
+                 on_page: Optional[Callable[[Dict[str, Any]], Any]] = None):
+        self._history = history
+        self._rules = list(default_rule_pack() if rules is None
+                           else rules)
+        errs = validate_rules(self._rules)
+        if errs:
+            raise ValueError("invalid alert rule pack: "
+                             + "; ".join(errs))
+        self._alerts = {r.name: AlertState(r) for r in self._rules}
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._on_page = on_page
+
+    @property
+    def rules(self) -> List[SLORule]:
+        return list(self._rules)
+
+    def _resolve_history(self) -> Optional[timeseries.MetricHistory]:
+        if self._history is not None:
+            return self._history
+        return timeseries.history_for(create=False)
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate_safe(self, now: Optional[float] = None
+                      ) -> List[Dict[str, Any]]:
+        """The sampler-callback entry: contained per the ROBUSTNESS.md
+        ``alerts/evaluate`` row — count, warn, retry next tick."""
+        try:
+            return self.evaluate(now)
+        except Exception as e:  # noqa: BLE001 - containment contract
+            monitor.add("alerts/evaluate_errors", 1)
+            log.warning("alerts: evaluation failed (retried next "
+                        "tick): %r", e)
+            return []
+
+    def evaluate(self, now: Optional[float] = None
+                 ) -> List[Dict[str, Any]]:
+        """One pass over every rule; returns the transitions
+        ``[{name, from, to, ...summary}]`` that happened."""
+        faults.faultpoint("alerts/evaluate")
+        ts = float(self._clock() if now is None else now)
+        history = self._resolve_history()
+        if history is None or len(history) < 2:
+            return []
+        fast_d = float(flags.flag("alerts_fast_window_s"))
+        slow_d = float(flags.flag("alerts_slow_window_s"))
+        clear_n = max(int(flags.flag("alerts_clear_windows")), 1)
+        transitions: List[Dict[str, Any]] = []
+        with self._lock:
+            monitor.add("alerts/evaluations", 1)
+            for rule in self._rules:
+                st = self._alerts[rule.name]
+                threshold = rule.resolved_threshold()
+                if rule.gate_on_threshold and threshold <= 0:
+                    continue
+                fast = rule.fast_window_s or fast_d
+                slow = rule.slow_window_s or slow_d
+                vf = rule.value(history, fast)
+                vs = rule.value(history, slow)
+                bf = rule.breached(vf, threshold)
+                bs = rule.breached(vs, threshold)
+                st.value_fast = vf
+                st.value_slow = vs
+                st.threshold = threshold
+                new = self._step(st, bf, bs, clear_n)
+                if new != st.state:
+                    old, st.state, st.since = st.state, new, ts
+                    if new == "firing":
+                        st.fired += 1
+                    transitions.append({"from": old, "to": new,
+                                        **st.summary()})
+            firing = sum(1 for a in self._alerts.values()
+                         if a.state == "firing")
+            pending = sum(1 for a in self._alerts.values()
+                          if a.state == "pending")
+        monitor.GLOBAL.set_gauge("alerts/firing", float(firing))
+        monitor.GLOBAL.set_gauge("alerts/pending", float(pending))
+        for t in transitions:
+            self._publish(t)
+        return transitions
+
+    @staticmethod
+    def _step(st: AlertState, bf: bool, bs: bool, clear_n: int) -> str:
+        state = st.state
+        if state in ("ok", "resolved", "pending"):
+            st.clean_evals = 0
+            if bf and bs:
+                return "firing"
+            if bf:
+                return "pending"
+            return "ok" if state == "pending" else state
+        # firing: hysteresis — both windows clean for clear_n
+        # consecutive evaluations before resolving.
+        if not bf and not bs:
+            st.clean_evals += 1
+            if st.clean_evals >= clear_n:
+                return "resolved"
+        else:
+            st.clean_evals = 0
+        return "firing"
+
+    def _publish(self, t: Dict[str, Any]) -> None:
+        line = json.dumps(t, default=str)
+        if t["to"] == "firing":
+            monitor.add(f"alert/{t['name']}", 1)
+            log.warning("alert_report %s", line)
+            trace.instant(f"alert/{t['name']}", state="firing",
+                          severity=t["severity"])
+            if t["severity"] == "page":
+                if self._on_page is not None:
+                    self._on_page(t)
+                else:
+                    from paddlebox_tpu.core import incident
+                    incident.trigger(f"alert:{t['name']}",
+                                     context={"alert": t})
+        else:
+            log.info("alert_report %s", line)
+            trace.instant(f"alert/{t['name']}", state=t["to"])
+
+    # -- queries -----------------------------------------------------------
+
+    def active(self, include_ok: bool = False) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = [a.summary() for a in self._alerts.values()
+                   if include_ok or a.state != "ok"]
+        order = {"firing": 0, "pending": 1, "resolved": 2, "ok": 3}
+        out.sort(key=lambda a: (order[a["state"]], a["name"]))
+        return out
+
+    def state(self, name: str) -> str:
+        with self._lock:
+            return self._alerts[name].state
+
+    def firing_count(self) -> int:
+        with self._lock:
+            return sum(1 for a in self._alerts.values()
+                       if a.state == "firing")
+
+
+# -- process-global engine ----------------------------------------------------
+
+GLOBAL: Optional[AlertEngine] = None
+_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    return GLOBAL is not None
+
+
+def active_alerts(include_ok: bool = False) -> List[Dict[str, Any]]:
+    eng = GLOBAL
+    return eng.active(include_ok) if eng is not None else []
+
+
+def firing_count() -> int:
+    eng = GLOBAL
+    return eng.firing_count() if eng is not None else 0
+
+
+def init_from_flags() -> bool:
+    """Arm the process-global engine over the global history when
+    FLAGS_alerts_enable is set: ensures the sampler runs and registers
+    ``evaluate_safe`` as its tick callback. Idempotent."""
+    global GLOBAL
+    if not flags.flag("alerts_enable"):
+        return GLOBAL is not None
+    with _LOCK:
+        if GLOBAL is None:
+            GLOBAL = AlertEngine()
+        timeseries.GLOBAL_SAMPLER.add_callback(
+            "alerts", GLOBAL.evaluate_safe)
+    timeseries.init_from_flags()
+    return True
+
+
+def shutdown() -> None:
+    """Disarm (tests/bench): drop the global engine and its sampler
+    callback; the sampler itself is left to its owner."""
+    global GLOBAL
+    with _LOCK:
+        timeseries.GLOBAL_SAMPLER.remove_callback("alerts")
+        GLOBAL = None

@@ -745,3 +745,63 @@ define_flag("rpc_retry_deadline_s", 30.0,
             "retries: when exceeded the last connection error raises "
             "even if attempts remain (a PS blip should cost ms, not "
             "minutes of blind retry)")
+define_flag("history_interval_s", 0.0,
+            "metric-history sampler cadence (core/timeseries.py): every "
+            "interval one bounded ring point is taken per registered "
+            "registry (counter deltas, gauge last-values, digest window "
+            "deltas) — the trend source for burn-rate alerts, fleet_top "
+            "sparklines and incident bundles. 0 (default) = sampler off; "
+            "alerts_enable arms a 5s fallback cadence")
+define_flag("history_points", 360,
+            "metric-history ring retention in points per registry "
+            "(core/timeseries.py): oldest points fall off — 360 points "
+            "at a 10s cadence is one hour of trend per process")
+define_flag("alerts_enable", False,
+            "arm the declarative SLO alert engine (core/alerts.py): the "
+            "default rule pack evaluates multi-window burn rates off the "
+            "metric history every sampler tick; active alerts surface "
+            "via the alerts_active RPC, alert/<name> counters and one "
+            "alert_report log line")
+define_flag("alerts_fast_window_s", 60.0,
+            "fast burn-rate window (core/alerts.py): a rule whose fast-"
+            "window value breaches goes PENDING; fast AND slow breach "
+            "goes FIRING — the fast window catches the step change")
+define_flag("alerts_slow_window_s", 300.0,
+            "slow burn-rate window (core/alerts.py): confirms a fast-"
+            "window breach is sustained before FIRING, and must come "
+            "back clean before an alert RESOLVES")
+define_flag("alerts_clear_windows", 2,
+            "hysteresis (core/alerts.py): consecutive clean evaluations "
+            "(fast AND slow windows healthy) before a FIRING alert "
+            "transitions to RESOLVED — one noisy good sample must not "
+            "flap a page")
+define_flag("alerts_violations_per_s", 0.0,
+            "SLO error-budget burn threshold for the slo/violations "
+            "counter (core/alerts.py default rule pack): sustained "
+            "violations-per-second at or above this rate in both burn "
+            "windows pages. 0 (default) disables the rule")
+define_flag("alerts_replica_lag", 0.0,
+            "page threshold for the multihost/replica_lag_p99 gauge "
+            "(journal entries a replica trails the primary); 0 "
+            "(default) disables the rule")
+define_flag("alerts_freshness_p99_ms", 0.0,
+            "warn threshold for the stream/event_to_servable_ms window "
+            "p99 (event-to-servable freshness SLO); 0 (default) "
+            "disables the rule")
+define_flag("alerts_overlap_floor", 0.0,
+            "warn floor for pass/train_boundary_exchange_overlap_frac: "
+            "a sustained drop below the floor means the PR-17 boundary-"
+            "exchange overlap stopped hiding DCN time; 0 (default) "
+            "disables the rule")
+define_flag("incident_dir", "",
+            "directory for incident flight-recorder bundles "
+            "(core/incident.py): a FIRING page alert, watchdog stall, "
+            "replica eject or STALE_PRIMARY burst writes one atomically-"
+            "renamed JSON bundle (history window, trace tail, rpc "
+            "tables, active alerts, last reports). Empty (default) = "
+            "recorder off")
+define_flag("incident_min_interval_s", 60.0,
+            "incident capture rate limit (core/incident.py): at most "
+            "one bundle per interval per process — a flapping alert "
+            "must not turn the flight recorder into a disk-filling "
+            "loop; suppressed captures count incident/rate_limited")

@@ -1,0 +1,244 @@
+"""Incident flight recorder: forensics captured when nobody is watching.
+
+PRs 14-16 built the forensic surfaces — trace ring, stall forensics
+providers (thread stacks + ``rpc.inflight_table()`` /
+``rpc.poller_table()``), metric history — but each only helps if a
+human is at the terminal when things break. This module snapshots all
+of them into ONE JSON bundle the moment something goes wrong:
+
+Triggers (the incident matrix):
+- a FIRING **page**-severity alert (core/alerts.py publishes here),
+- a **watchdog stall** (core/watchdog.py's default fire path),
+- a fleet **replica eject** (serving/fleet.py),
+- a **STALE_PRIMARY burst** (multihost/shard_service.py's redirect
+  errors arriving faster than failover should produce them).
+
+Bundle layout (one dict, rendered by ``tools/incident_report.py``):
+``kind/ts/seq/context`` header, ``alerts`` (active + resolved),
+``history`` (the metric ring window), ``forensics`` (thread stacks,
+trace tail, in-flight RPCs, poller tables — the same providers the
+watchdog prints), ``pass_report``/``quality_report`` (last emitted),
+and a flat ``metrics`` snapshot.
+
+Write discipline: bundle goes to ``<dir>/.incident-*.tmp`` then ONE
+``os.replace`` — a reader (or the crash drill's kill window at
+``incident/capture``) can never mistake a torn bundle for a complete
+one, because complete bundles only ever appear atomically. Captures
+are rate-limited (``FLAGS_incident_min_interval_s``) so a flapping
+alert cannot fill a disk, and CONTAINED: a capture crash is counted
+(``incident/capture_errors``), warned, and never propagates into the
+serving/training thread that tripped it. Default-off: with
+``FLAGS_incident_dir`` empty, ``trigger()`` is one cached-bool check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from paddlebox_tpu.core import faults, flags, log, monitor, trace
+
+# STALE_PRIMARY burst detection: this many redirect errors inside the
+# window means clients are storming a demoted primary (routing is not
+# converging) — an incident, not a blip.
+STALE_BURST = 3
+STALE_WINDOW_S = 10.0
+
+# Keep bundles bounded: trace tail length and history points captured.
+TRACE_TAIL = 256
+HISTORY_POINTS = 120
+
+
+class IncidentRecorder:
+    """One per process (module-level default below); tests build their
+    own with injected clocks and a tmp dir."""
+
+    def __init__(self, directory: Optional[str] = None, *,
+                 min_interval_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
+        self._dir = directory
+        self._min_interval = min_interval_s
+        self._clock = clock
+        self._wall = wall
+        self._lock = threading.Lock()
+        self._last: Optional[float] = None
+        self._seq = 0
+        self._context: Dict[str, Any] = {}
+        self._stale: deque = deque(maxlen=STALE_BURST)
+
+    # -- configuration -----------------------------------------------------
+
+    def _directory(self) -> str:
+        d = self._dir if self._dir is not None \
+            else str(flags.flag("incident_dir") or "")
+        return d
+
+    def _interval(self) -> float:
+        if self._min_interval is not None:
+            return float(self._min_interval)
+        return float(flags.flag("incident_min_interval_s"))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._directory())
+
+    def set_context(self, **kv: Any) -> None:
+        """Stamp ambient context (stream runner: day/pass) carried in
+        every subsequent bundle. ``None`` values clear keys."""
+        with self._lock:
+            for k, v in kv.items():
+                if v is None:
+                    self._context.pop(k, None)
+                else:
+                    self._context[k] = v
+
+    # -- capture -----------------------------------------------------------
+
+    def trigger(self, kind: str, *,
+                context: Optional[Dict[str, Any]] = None,
+                forensics: Optional[Dict[str, Any]] = None,
+                force: bool = False) -> Optional[str]:
+        """Capture one bundle. Returns the bundle path, or None when
+        disabled / rate-limited / failed. NEVER raises — the
+        containment contract (ROBUSTNESS.md ``incident/capture``)."""
+        if not self.enabled:
+            return None
+        now = self._clock()
+        with self._lock:
+            if (not force and self._last is not None
+                    and now - self._last < self._interval()):
+                monitor.add("incident/rate_limited", 1)
+                return None
+            # Claim the slot BEFORE the (slow) capture so concurrent
+            # triggers in the window collapse to one bundle; release
+            # the claim on failure so the next trigger retries.
+            prev_last, self._last = self._last, now
+            self._seq += 1
+            seq = self._seq
+        try:
+            path = self._capture(kind, seq, context, forensics)
+        except Exception as e:  # noqa: BLE001 - containment contract
+            with self._lock:
+                self._last = prev_last
+            monitor.add("incident/capture_errors", 1)
+            log.warning("incident: capture %r failed (contained): %r",
+                        kind, e)
+            return None
+        monitor.add("incident/captured", 1)
+        trace.instant("incident/capture", kind=kind, path=path)
+        log.warning("incident: captured %r -> %s", kind, path)
+        return path
+
+    def _capture(self, kind: str, seq: int,
+                 context: Optional[Dict[str, Any]],
+                 forensics: Optional[Dict[str, Any]]) -> str:
+        from paddlebox_tpu.core import alerts, report, timeseries
+        directory = self._directory()
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            ctx = dict(self._context)
+        ctx.update(context or {})
+        hist = timeseries.history_for(create=False)
+        fx = forensics if forensics is not None \
+            else trace.stall_forensics(max_events=TRACE_TAIL)
+        bundle: Dict[str, Any] = {
+            "schema": "incident/1",
+            "kind": kind,
+            "seq": seq,
+            "ts": self._wall(),
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "context": ctx,
+            "alerts": alerts.active_alerts(),
+            "history": (hist.to_dict(last_n=HISTORY_POINTS)
+                        if hist is not None else None),
+            "forensics": fx,
+            "pass_report": report.LAST_PASS_REPORT,
+            "quality_report": report.LAST_QUALITY_REPORT,
+            "metrics": monitor.snapshot(),
+        }
+        stamp = time.strftime("%Y%m%dT%H%M%S",
+                              time.gmtime(bundle["ts"]))
+        slug = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in kind)
+        final = os.path.join(directory,
+                             f"incident-{stamp}-{seq:04d}-{slug}.json")
+        tmp = os.path.join(directory,
+                           f".incident-{seq:04d}-{slug}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            # THE crash window (tools/crash_drill.py --matrix incident):
+            # bundle bytes durable under the tmp name, rename pending —
+            # a kill here leaves a torn ``.tmp`` that ``list_bundles``
+            # never mistakes for a complete bundle.
+            faults.faultpoint("incident/capture")
+            os.replace(tmp, final)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        return final
+
+    # -- stale-primary burst detector --------------------------------------
+
+    def note_stale_primary(self) -> None:
+        """Called on every STALE_PRIMARY redirect error (shard tier).
+        Cheap deque append; trips ``trigger`` when STALE_BURST arrive
+        inside STALE_WINDOW_S."""
+        if not self.enabled:
+            return
+        now = self._clock()
+        with self._lock:
+            self._stale.append(now)
+            burst = (len(self._stale) == STALE_BURST
+                     and now - self._stale[0] <= STALE_WINDOW_S)
+            if burst:
+                self._stale.clear()
+        if burst:
+            self.trigger("stale_primary_burst",
+                         context={"burst": STALE_BURST,
+                                  "window_s": STALE_WINDOW_S})
+
+
+def list_bundles(directory: str) -> list:
+    """Complete bundles only, oldest first — ``.tmp`` files are torn
+    captures by definition and never listed."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted(os.path.join(directory, n) for n in names
+                  if n.startswith("incident-") and n.endswith(".json"))
+
+
+GLOBAL = IncidentRecorder()
+
+
+def trigger(kind: str, *, context: Optional[Dict[str, Any]] = None,
+            forensics: Optional[Dict[str, Any]] = None,
+            force: bool = False) -> Optional[str]:
+    return GLOBAL.trigger(kind, context=context, forensics=forensics,
+                          force=force)
+
+
+def note_stale_primary() -> None:
+    GLOBAL.note_stale_primary()
+
+
+def set_context(**kv: Any) -> None:
+    GLOBAL.set_context(**kv)
+
+
+def enabled() -> bool:
+    return GLOBAL.enabled

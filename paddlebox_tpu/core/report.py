@@ -30,6 +30,12 @@ from paddlebox_tpu.core import log, monitor, timers, trace
 # (tools/trace_report.py, PROFILE rounds) sees a stable schema.
 STAGES = ("read", "pack", "pull", "fwd_bwd", "push", "dispatch", "sync")
 
+# Last emitted summaries, stashed for the incident flight recorder
+# (core/incident.py): a bundle answers "what was the last pass doing"
+# without scraping the log.
+LAST_PASS_REPORT: Optional[Dict[str, Any]] = None
+LAST_QUALITY_REPORT: Optional[Dict[str, Any]] = None
+
 
 def stage_delta(group: "timers.TimerGroup",
                 base_ms: Dict[str, float]) -> Dict[str, float]:
@@ -137,6 +143,8 @@ def emit_pass_report(kind: str, *, steps: int, samples: int,
     trace.instant(f"pass_report/{kind}", steps=steps,
                   samples_per_s=summary["samples_per_s"])
     reg.flush_jsonl(labels={"event": "pass_report", "kind": kind})
+    global LAST_PASS_REPORT
+    LAST_PASS_REPORT = summary
     return summary
 
 
@@ -156,12 +164,18 @@ def emit_quality_report(kind: str, summary: Dict[str, Any]
                   alarms=len(summary.get("alarms") or ()),
                   copc=summary.get("copc"))
     reg.flush_jsonl(labels={"event": "quality_report", "kind": kind})
+    global LAST_QUALITY_REPORT
+    LAST_QUALITY_REPORT = summary
     return summary
 
 
 def init_telemetry_from_flags() -> None:
-    """One-call arming of both telemetry sinks from flags (trace path +
-    metrics path). Idempotent and near-free when both are unset — the
-    trainer/bench/serving entry points call it unconditionally."""
+    """One-call arming of every telemetry plane from flags (trace path,
+    metrics path, history sampler, alert engine). Idempotent and
+    near-free when all are unset — the trainer/bench/serving entry
+    points call it unconditionally."""
     trace.init_from_flags()
     monitor.init_from_flags()
+    from paddlebox_tpu.core import alerts, timeseries
+    timeseries.init_from_flags()
+    alerts.init_from_flags()

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from paddlebox_tpu.core import monitor
 from paddlebox_tpu.core.quantiles import LogQuantileDigest
@@ -32,11 +32,13 @@ def _conn(endpoint: str, timeout: float):
     from paddlebox_tpu.distributed import rpc
     return rpc.FramedRPCConn(
         endpoint, timeout=timeout, service_name="scrape",
-        idempotent=("metrics_snapshot", "stats", "topology"))
+        idempotent=("metrics_snapshot", "metrics_history",
+                    "alerts_active", "stats", "topology"))
 
 
 def scrape_endpoint(endpoint: str, *, timeout: float = 10.0,
-                    with_stats: bool = True) -> Dict[str, Any]:
+                    with_stats: bool = True,
+                    with_alerts: bool = True) -> Dict[str, Any]:
     """One target's ``metrics_snapshot`` (labeled registry snapshot),
     with its ``stats`` reply attached under ``"stats"`` when the
     service answers one (best-effort — the snapshot is the contract,
@@ -49,7 +51,33 @@ def scrape_endpoint(endpoint: str, *, timeout: float = 10.0,
                 snap["stats"] = c.call("stats")
             except (OSError, ConnectionError, RuntimeError):
                 pass
+        if with_alerts:
+            # Best-effort like stats: the alert surface rides every
+            # sweep (the acceptance contract: ONE scrape shows the
+            # FIRING rule), but an old server without the handler
+            # doesn't fail the scrape.
+            try:
+                snap["alerts"] = c.call("alerts_active")
+            except (OSError, ConnectionError, RuntimeError):
+                pass
         return snap
+    finally:
+        c.close()
+
+
+def scrape_history(endpoint: str, *, timeout: float = 10.0,
+                   window_s: Optional[float] = None,
+                   last_n: Optional[int] = None) -> Dict[str, Any]:
+    """One target's ``metrics_history`` ring (core/timeseries.py wire
+    dict) — the trend surface behind fleet_top sparklines."""
+    c = _conn(endpoint, timeout)
+    try:
+        req: Dict[str, Any] = {}
+        if window_s is not None:
+            req["window_s"] = float(window_s)
+        if last_n is not None:
+            req["last_n"] = int(last_n)
+        return c.call("metrics_history", **req)
     finally:
         c.close()
 
@@ -148,11 +176,25 @@ def summarize_target(label: str, endpoint: str,
              if k.startswith("quality/alarms/"))
     if qa or any(k.startswith("quality/") for k in counters):
         row["quality_alarms"] = qa
+    # SLO alert pane (core/alerts.py ride-along): firing count plus
+    # the worst active rule name — one glance answers "is anything
+    # paging on this target".
+    al = snap.get("alerts")
+    if isinstance(al, dict) and al.get("enabled"):
+        row["alerts_firing"] = int(al.get("firing", 0))
+        active = [a for a in al.get("alerts") or ()
+                  if a.get("state") in ("firing", "pending")]
+        if active:
+            row["alert"] = (f"{active[0]['name']}"
+                            f"[{active[0]['state']}]")
     return row
 
 
 def scrape_cluster(targets: Dict[str, str], *, timeout: float = 10.0,
-                   with_stats: bool = True) -> Dict[str, Any]:
+                   with_stats: bool = True, with_alerts: bool = True,
+                   with_history: bool = False,
+                   history_window_s: Optional[float] = None
+                   ) -> Dict[str, Any]:
     """Scrape every target once and fold the answers: per-target
     snapshots + summary rows, the ONE merged cluster snapshot
     (counters summed, gauges mean+__max, digests merged — so the
@@ -163,13 +205,22 @@ def scrape_cluster(targets: Dict[str, str], *, timeout: float = 10.0,
     for label, ep in targets.items():
         try:
             per[label] = scrape_endpoint(ep, timeout=timeout,
-                                         with_stats=with_stats)
+                                         with_stats=with_stats,
+                                         with_alerts=with_alerts)
+            if with_history:
+                try:
+                    per[label]["history"] = scrape_history(
+                        ep, timeout=timeout,
+                        window_s=history_window_s)
+                except (OSError, ConnectionError, RuntimeError):
+                    pass
         except (OSError, ConnectionError, RuntimeError) as e:
             errors[label] = repr(e)
     # merge_snapshots understands the snapshot_all sections only; the
     # stats ride-along must not leak in.
     merged = monitor.merge_snapshots(
-        [{k: v for k, v in s.items() if k != "stats"}
+        [{k: v for k, v in s.items()
+          if k not in ("stats", "alerts", "history")}
          for s in per.values()])
     summary = [summarize_target(label, targets[label], snap)
                for label, snap in per.items()]
@@ -195,9 +246,35 @@ def scrape_cluster(targets: Dict[str, str], *, timeout: float = 10.0,
     copc = g.get("quality/copc")
     if copc is not None:
         cluster["copc"] = round(float(copc), 4)
-    return {"ts": time.time(), "targets": dict(targets),
-            "per_target": per, "summary": summary,
-            "errors": errors, "merged": merged, "cluster": cluster}
+    # Fleet-wide alert roll-up: every FIRING/PENDING rule across the
+    # scraped targets, deduped per (target, rule) — what fleet_top's
+    # ALERTS pane and the acceptance drill read from ONE sweep.
+    fleet_alerts: List[Dict[str, Any]] = []
+    for label, snap in per.items():
+        al = snap.get("alerts")
+        if not (isinstance(al, dict) and al.get("enabled")):
+            continue
+        for a in al.get("alerts") or ():
+            if a.get("state") in ("firing", "pending", "resolved"):
+                fleet_alerts.append({"target": label, **a})
+    if fleet_alerts:
+        order = {"firing": 0, "pending": 1, "resolved": 2}
+        fleet_alerts.sort(key=lambda a: (order.get(a["state"], 3),
+                                         a.get("name", "")))
+        cluster["alerts_firing"] = sum(
+            1 for a in fleet_alerts if a["state"] == "firing")
+    out: Dict[str, Any] = {
+        "ts": time.time(), "targets": dict(targets),
+        "per_target": per, "summary": summary,
+        "errors": errors, "merged": merged, "cluster": cluster,
+        "alerts": fleet_alerts}
+    if with_history:
+        hists = [s["history"] for s in per.values()
+                 if isinstance(s.get("history"), dict)]
+        if hists:
+            from paddlebox_tpu.core import timeseries
+            out["history"] = timeseries.merge_history(hists)
+    return out
 
 
 def record_jsonl(path: str, record: Dict[str, Any], *,
@@ -205,7 +282,7 @@ def record_jsonl(path: str, record: Dict[str, Any], *,
     """Append one scrape to a JSONL file (the fleet_top ``--record``
     sink). Default keeps the compact sections (summary + cluster +
     errors); ``full`` also writes the merged snapshot."""
-    keep = ("ts", "targets", "summary", "cluster", "errors")
+    keep = ("ts", "targets", "summary", "cluster", "errors", "alerts")
     line = {k: record.get(k) for k in keep}
     if full:
         line["merged"] = record.get("merged")

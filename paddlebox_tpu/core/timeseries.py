@@ -1,0 +1,394 @@
+"""Bounded in-process metric history: the trend half of the registry.
+
+``core/monitor.py`` answers "what is the counter NOW"; this module
+answers "what was it over the last N windows" — the missing input for
+burn-rate alerting (core/alerts.py), fleet_top sparklines and incident
+bundles. A :class:`MetricHistory` is a bounded ring of per-window
+points over ONE registry:
+
+- **counters** land as per-window deltas (``rate()`` divides by span),
+- **gauges** land as last-value,
+- **quantile digests** land as :meth:`LogQuantileDigest.delta` window
+  sketches — exact count-subtraction windows, so ``window_quantiles``
+  gives the p99 *of the window*, not of process lifetime.
+
+One process-wide :class:`HistorySampler` daemon thread ticks every
+``FLAGS_history_interval_s`` and samples every registered history
+(weakly held — instance registries on PredictServer/ShardServer/
+FleetRouter ride the same thread). The clock is injected everywhere:
+tests drive ``sample(now=...)`` with planted timestamps, and graftlint
+replay purity holds because nothing on a replay root reads wall time
+through this module. Default-off: with the interval at 0 the sampler
+thread never starts and the hot-path cost is zero (histories are
+sampled off-thread; nothing is observed inline).
+
+Points are plain JSON dicts — ``to_dict()`` is the ``metrics_history``
+RPC payload, and :func:`merge_history` folds per-host rings into one
+cluster series (counter deltas summed, gauges meaned, digests merged
+per aligned bucket), the same associativity story as
+``monitor.merge_snapshots``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from paddlebox_tpu.core import flags, log, monitor
+from paddlebox_tpu.core.quantiles import DEFAULT_QS, LogQuantileDigest
+
+Number = float
+
+
+class MetricHistory:
+    """Bounded ring of per-window points over one ``monitor.Monitor``.
+
+    ``sample()`` diffs the registry's cumulative state against the
+    previous sample: counters become deltas, digests become
+    ``delta()`` window sketches, gauges pass through as last-value.
+    Query methods never touch the registry or a clock — they read the
+    ring only, so a wire-transported or merged history answers the
+    same API through :meth:`from_dict`.
+    """
+
+    def __init__(self, registry: Optional[monitor.Monitor] = None, *,
+                 points: Optional[int] = None, label: str = "",
+                 clock: Callable[[], float] = time.time):
+        self._registry = monitor.GLOBAL if registry is None else registry
+        cap = int(points if points is not None
+                  else flags.flag("history_points"))
+        self._points: deque = deque(maxlen=max(cap, 2))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._prev_counters: Dict[str, Number] = {}
+        self._prev_digests: Dict[str, LogQuantileDigest] = {}
+        self._sampled = False
+        self.label = label
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Take one history point (sampler thread or a test driving an
+        injected timestamp). The FIRST sample establishes the delta
+        base and records an empty-delta point."""
+        ts = float(self._clock() if now is None else now)
+        snap = self._registry.snapshot_all()
+        counters = snap.get("counters") or {}
+        gauges = snap.get("gauges") or {}
+        qdicts = snap.get("quantiles") or {}
+        with self._lock:
+            deltas: Dict[str, Number] = {}
+            for k, v in counters.items():
+                if isinstance(v, (int, float)):
+                    d = v - self._prev_counters.get(k, 0)
+                    if d:
+                        deltas[k] = d
+            qdelta: Dict[str, Any] = {}
+            for name, d in qdicts.items():
+                cur = LogQuantileDigest.from_dict(d)
+                win = cur.delta(self._prev_digests.get(name))
+                if win.count:
+                    qdelta[name] = win.to_dict()
+                self._prev_digests[name] = cur
+            self._prev_counters = {k: v for k, v in counters.items()
+                                   if isinstance(v, (int, float))}
+            point = {"ts": round(ts, 3), "counters": deltas,
+                     "gauges": {k: v for k, v in gauges.items()
+                                if isinstance(v, (int, float))},
+                     "quantiles": qdelta}
+            self._points.append(point)
+            self._sampled = True
+        return point
+
+    # -- queries (ring-only: work identically on merged/wire histories) ----
+
+    def points(self, window_s: Optional[float] = None
+               ) -> List[Dict[str, Any]]:
+        """Points newest-last; ``window_s`` measures back from the
+        NEWEST point's ts (no wall-clock read — replay-pure)."""
+        with self._lock:
+            pts = list(self._points)
+        if window_s is None or not pts:
+            return pts
+        horizon = pts[-1]["ts"] - float(window_s)
+        return [p for p in pts if p["ts"] > horizon]
+
+    def series(self, name: str, *, window_s: Optional[float] = None
+               ) -> List[Tuple[float, Number]]:
+        """(ts, value) pairs: counter per-window deltas, else gauge
+        last-values. A counter absent from a point contributes 0 (the
+        ring stores only nonzero deltas)."""
+        pts = self.points(window_s)
+        if any(name in p["counters"] for p in pts):
+            return [(p["ts"], p["counters"].get(name, 0)) for p in pts]
+        return [(p["ts"], p["gauges"][name]) for p in pts
+                if name in p["gauges"]]
+
+    def rate(self, name: str, window_s: Optional[float] = None
+             ) -> Optional[float]:
+        """Counter events/second over the window: sum of deltas divided
+        by the covered span. None with fewer than two points (no span
+        to divide by — the first point is the delta base)."""
+        pts = self.points(window_s)
+        if len(pts) < 2:
+            return None
+        span = pts[-1]["ts"] - pts[0]["ts"]
+        if span <= 0:
+            return None
+        # The first point's delta belongs to the window BEFORE pts[0].ts.
+        total = sum(p["counters"].get(name, 0) for p in pts[1:])
+        return total / span
+
+    def delta(self, name: str, window_s: Optional[float] = None,
+              *, prefix: bool = False) -> float:
+        """Sum of counter deltas over the window; ``prefix=True`` sums
+        every counter whose name starts with ``name`` (the
+        ``quality/alarms/*`` family read)."""
+        total = 0.0
+        for p in self.points(window_s)[1:]:
+            c = p["counters"]
+            if prefix:
+                total += sum(v for k, v in c.items()
+                             if k.startswith(name))
+            else:
+                total += c.get(name, 0)
+        return total
+
+    def window_quantiles(self, name: str,
+                         window_s: Optional[float] = None,
+                         qs: Sequence[float] = DEFAULT_QS
+                         ) -> Dict[str, float]:
+        """Quantiles of the *window*: merge the per-point digest deltas
+        covering the window and query the merged sketch. Empty dict
+        when the metric was never observed in the window."""
+        merged: Optional[LogQuantileDigest] = None
+        for p in self.points(window_s):
+            d = p["quantiles"].get(name)
+            if not d:
+                continue
+            win = LogQuantileDigest.from_dict(d)
+            if merged is None:
+                merged = win
+            else:
+                merged.merge(win)
+        if merged is None or not merged.count:
+            return {}
+        out = merged.quantiles(qs)
+        out["count"] = merged.count
+        return out
+
+    def latest(self, name: str) -> Optional[Number]:
+        s = self.series(name)
+        return s[-1][1] if s else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._points)
+
+    # -- wire --------------------------------------------------------------
+
+    def to_dict(self, window_s: Optional[float] = None,
+                last_n: Optional[int] = None) -> Dict[str, Any]:
+        pts = self.points(window_s)
+        if last_n is not None:
+            pts = pts[-int(last_n):]
+        return {"label": self.label,
+                "capacity": self._points.maxlen,
+                "points": pts}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MetricHistory":
+        """Rehydrate a wire/merged history as a query-only ring (its
+        ``sample()`` would diff against a fresh base — don't)."""
+        pts = list(d.get("points") or ())
+        h = cls(monitor.Monitor(),
+                points=max(int(d.get("capacity") or len(pts) or 2),
+                           len(pts), 2),
+                label=str(d.get("label") or ""))
+        h._points.extend(pts)
+        return h
+
+
+def merge_history(dicts: Sequence[Dict[str, Any]], *,
+                  bucket_s: Optional[float] = None) -> Dict[str, Any]:
+    """Fold per-host history dicts into ONE cluster series: points are
+    aligned on ``bucket_s`` buckets (default: the median inter-point
+    gap of the inputs, floored at 1s); within a bucket counter deltas
+    SUM, gauges MEAN, digest windows MERGE — associative like
+    ``monitor.merge_snapshots``, so merge order never changes the
+    answer."""
+    pts = [p for d in dicts for p in (d.get("points") or ())]
+    if not pts:
+        return {"label": "merged", "capacity": 2, "points": []}
+    if bucket_s is None:
+        gaps: List[float] = []
+        for d in dicts:
+            ps = d.get("points") or ()
+            gaps.extend(b["ts"] - a["ts"] for a, b in zip(ps, ps[1:]))
+        gaps = sorted(g for g in gaps if g > 0)
+        bucket_s = gaps[len(gaps) // 2] if gaps else 1.0
+    bucket_s = max(float(bucket_s), 1e-9)
+    buckets: Dict[int, Dict[str, Any]] = {}
+    gauge_n: Dict[int, Dict[str, int]] = {}
+    for p in sorted(pts, key=lambda p: p["ts"]):
+        b = int(p["ts"] // bucket_s)
+        out = buckets.get(b)
+        if out is None:
+            out = buckets[b] = {"ts": round((b + 1) * bucket_s, 3),
+                                "counters": {}, "gauges": {},
+                                "quantiles": {}}
+            gauge_n[b] = {}
+        for k, v in (p.get("counters") or {}).items():
+            out["counters"][k] = out["counters"].get(k, 0) + v
+        for k, v in (p.get("gauges") or {}).items():
+            n = gauge_n[b].get(k, 0)
+            prev = out["gauges"].get(k, 0.0)
+            out["gauges"][k] = (prev * n + v) / (n + 1)
+            gauge_n[b][k] = n + 1
+        for k, d in (p.get("quantiles") or {}).items():
+            cur = out["quantiles"].get(k)
+            if cur is None:
+                out["quantiles"][k] = dict(d)
+            else:
+                m = LogQuantileDigest.from_dict(cur)
+                m.merge(LogQuantileDigest.from_dict(d))
+                out["quantiles"][k] = m.to_dict()
+    merged = [buckets[b] for b in sorted(buckets)]
+    return {"label": "merged", "capacity": max(len(merged), 2),
+            "points": merged}
+
+
+class HistorySampler:
+    """ONE daemon thread sampling every registered history per tick,
+    then running the tick callbacks (the alert engine registers its
+    evaluate here). Histories are weakly held — a server that goes
+    away takes its history with it. Callbacks are CONTAINED: a crash
+    is counted and warned, never propagated into the sampler loop."""
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._clock = clock
+        self._histories: "weakref.WeakSet[MetricHistory]" = \
+            weakref.WeakSet()
+        self._callbacks: List[Tuple[str, Callable[[float], Any]]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, history: MetricHistory) -> MetricHistory:
+        with self._lock:
+            self._histories.add(history)
+        return history
+
+    def add_callback(self, name: str,
+                     fn: Callable[[float], Any]) -> None:
+        with self._lock:
+            self._callbacks = ([(n, f) for n, f in self._callbacks
+                                if n != name] + [(name, fn)])
+
+    def remove_callback(self, name: str) -> None:
+        with self._lock:
+            self._callbacks = [(n, f) for n, f in self._callbacks
+                               if n != name]
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Sample every live history, then run callbacks. Returns the
+        number of histories sampled (tests drive this directly with
+        planted ``now``)."""
+        ts = float(self._clock() if now is None else now)
+        with self._lock:
+            hs = list(self._histories)
+            cbs = list(self._callbacks)
+        n = 0
+        for h in hs:
+            try:
+                h.sample(ts)
+                n += 1
+            except Exception as e:  # noqa: BLE001 - sampler must survive
+                monitor.add("history/sample_errors", 1)
+                log.warning("history: sample failed for %r: %r",
+                            h.label, e)
+        for name, fn in cbs:
+            try:
+                fn(ts)
+            except Exception as e:  # noqa: BLE001 - contained by contract
+                monitor.add("history/callback_errors", 1)
+                log.warning("history: tick callback %s failed "
+                            "(retried next tick): %r", name, e)
+        monitor.GLOBAL.set_gauge("history/registries", float(len(hs)))
+        return n
+
+    def start(self, interval_s: float) -> bool:
+        """Idempotent; non-positive interval = no thread (ticks can
+        still be driven by hand)."""
+        if interval_s <= 0:
+            return False
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return True
+            self._stop.clear()
+
+            def loop() -> None:
+                while not self._stop.wait(interval_s):
+                    self.tick()
+
+            self._thread = threading.Thread(
+                target=loop, name="history-sampler", daemon=True)
+            self._thread.start()
+        return True
+
+    def stop(self) -> None:
+        t = self._thread
+        self._stop.set()
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+
+GLOBAL_SAMPLER = HistorySampler()
+
+# registry object -> its history, weakly keyed so instance registries
+# (and their histories) die with their servers.
+_HISTORIES: "weakref.WeakKeyDictionary[monitor.Monitor, MetricHistory]" \
+    = weakref.WeakKeyDictionary()
+_HIST_LOCK = threading.Lock()
+
+
+def history_for(registry: Optional[monitor.Monitor] = None, *,
+                label: str = "", create: bool = True
+                ) -> Optional[MetricHistory]:
+    """The (one) history ring over ``registry`` (default: the
+    process-global registry), created on first ask and registered with
+    the global sampler. Cheap when the sampler never starts — an idle
+    ring object per server."""
+    reg = monitor.GLOBAL if registry is None else registry
+    with _HIST_LOCK:
+        h = _HISTORIES.get(reg)
+        if h is None and create:
+            h = _HISTORIES[reg] = MetricHistory(reg, label=label)
+            GLOBAL_SAMPLER.register(h)
+        return h
+
+
+def enabled() -> bool:
+    return GLOBAL_SAMPLER.running
+
+
+def init_from_flags() -> bool:
+    """Arm the sampler when FLAGS_history_interval_s > 0 (or when the
+    alert engine is on, with a 5s fallback cadence — alerts without
+    history would never see a window). Idempotent; returns armed."""
+    interval = float(flags.flag("history_interval_s"))
+    if interval <= 0 and flags.flag("alerts_enable"):
+        interval = 5.0
+    if interval <= 0:
+        return GLOBAL_SAMPLER.running
+    history_for(label="global")
+    return GLOBAL_SAMPLER.start(interval)

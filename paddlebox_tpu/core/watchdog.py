@@ -202,6 +202,15 @@ class Watchdog:
             "stall forensics and aborting the pass:\n%s",
             self.name, phase, idle, plane, remote,
             "\n".join(fx.get("thread_stacks", [])))
+        # Flight recorder (core/incident.py): persist the forensics
+        # just gathered — a stall at 3am should leave a bundle, not
+        # only a log line. Contained + rate-limited inside trigger.
+        from paddlebox_tpu.core import incident
+        incident.trigger("watchdog_stall",
+                         context={"watchdog": self.name,
+                                  "phase": phase,
+                                  "idle_s": round(idle, 3)},
+                         forensics=fx)
         target = self._target
         if target is not None and _async_raise(target, StallError):
             monitor.add("watchdog/aborts", 1)

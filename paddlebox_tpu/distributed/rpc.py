@@ -309,8 +309,8 @@ class FramedRPCServer:
     #: (no device work, at most a brief lock): a stats scrape or clock
     #: probe answers even while every worker is wedged on device work.
     POLLER_INLINE: FrozenSet[str] = frozenset(
-        {"stats", "clock_probe", "metrics_snapshot", "contains",
-         "wire_caps"})
+        {"stats", "clock_probe", "metrics_snapshot", "metrics_history",
+         "alerts_active", "contains", "wire_caps"})
 
     def __init__(self, endpoint: str, *, backlog: int = 32):
         host, port = endpoint.rsplit(":", 1)
@@ -705,6 +705,31 @@ class FramedRPCServer:
         return monitor.snapshot_all(
             labels={"service": self.service_name,
                     "endpoint": self.endpoint})
+
+    def handle_metrics_history(self, req) -> dict:
+        """This process's metric-history ring (core/timeseries.py) —
+        the trend surface beside the instantaneous snapshot. Servers
+        with per-instance registries override this with their own
+        ring; the base answers the process-global one. Empty ring
+        (sampler off) is a valid answer — the scrape layer treats it
+        as 'no trend yet'."""
+        from paddlebox_tpu.core import timeseries
+        h = timeseries.history_for(create=False)
+        if h is None:
+            return {"label": "global", "capacity": 0, "points": []}
+        return h.to_dict(window_s=req.get("window_s"),
+                         last_n=req.get("last_n"))
+
+    def handle_alerts_active(self, req) -> dict:
+        """Active SLO alerts (core/alerts.py) — the machine-readable
+        surface ROADMAP item 1's controller consumes. The engine is
+        process-global (instance registries mirror their signals into
+        it), so one base handler serves every framed service."""
+        from paddlebox_tpu.core import alerts
+        return {"enabled": alerts.enabled(),
+                "firing": alerts.firing_count(),
+                "alerts": alerts.active_alerts(
+                    include_ok=bool(req.get("include_ok")))}
 
     def handle_trace_export(self, req) -> dict:
         """Export this process's span ring to ``req['path']`` (or the

@@ -61,7 +61,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from paddlebox_tpu.core import faults, flags, log, monitor, trace
+from paddlebox_tpu.core import (faults, flags, incident, log, monitor,
+                                timeseries, trace)
 from paddlebox_tpu.distributed import rpc, wire
 from paddlebox_tpu.embedding.store import _FIELDS, FeatureStore
 from paddlebox_tpu.embedding.table import TableConfig
@@ -284,6 +285,10 @@ class ShardServer(rpc.FramedRPCServer):
         # process-wide meaning. handle_metrics_snapshot serves this
         # registry to the fleet_top / telemetry_scrape collectors.
         self.metrics = monitor.Monitor()
+        # Per-host trend ring (core/timeseries.py) behind the
+        # metrics_history RPC; idle until the sampler is armed.
+        self.history = timeseries.history_for(self.metrics,
+                                              label=f"shard:{index}")
         self._coalescer = _PullCoalescer(self)
         self.service_name = f"shard[{index}]"
         rpc.FramedRPCServer.__init__(self, endpoint, backlog=64)
@@ -363,6 +368,7 @@ class ShardServer(rpc.FramedRPCServer):
                     f"stale; re-apply the rank table")
             if write and role != "primary":
                 self._bump("multihost/stale_primary_errors", 1)
+                incident.note_stale_primary()
                 raise StalePrimaryError(
                     f"STALE_PRIMARY: shard {self.index} is {role} for "
                     f"slot {int(s)} — the client's replica map predates "
@@ -704,6 +710,7 @@ class ShardServer(rpc.FramedRPCServer):
         role = self._roles.get(slot)
         if role != "backup":
             self._bump("multihost/stale_primary_errors", 1)
+            incident.note_stale_primary()
             raise StalePrimaryError(
                 f"STALE_PRIMARY: shard {self.index} is "
                 f"{role or 'no replica'} for slot {slot} — the sender's "
@@ -1219,6 +1226,13 @@ class ShardServer(rpc.FramedRPCServer):
             labels={"service": self.service_name,
                     "endpoint": self.endpoint,
                     "shard": int(self.index)})
+
+    def handle_metrics_history(self, req) -> dict:
+        """This shard host's trend ring (instance registry: served
+        volume, journal lag gauges as of the last scrape) for the
+        fleet_top sparkline pane."""
+        return self.history.to_dict(window_s=req.get("window_s"),
+                                    last_n=req.get("last_n"))
 
     def handle_stats(self, req) -> Dict[str, int]:
         snap = monitor.snapshot()

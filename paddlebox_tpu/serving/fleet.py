@@ -337,6 +337,13 @@ class ServingFleet:
             self._bump_epoch_locked()
         replica.pool.close()
         log.warning("fleet: ejected replica %s (%s)", replica.id, reason)
+        # Flight recorder: an eject is capacity lost — bundle the
+        # forensics that led here (contained + rate-limited inside).
+        from paddlebox_tpu.core import incident
+        incident.trigger("replica_eject",
+                         context={"replica": replica.id,
+                                  "endpoint": replica.endpoint,
+                                  "reason": reason})
 
     # -- health + admission ------------------------------------------------
 

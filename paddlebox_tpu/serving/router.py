@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from paddlebox_tpu.core import faults, log, monitor, trace
+from paddlebox_tpu.core import faults, log, monitor, timeseries, trace
 from paddlebox_tpu.core.quantiles import LogQuantileDigest
 from paddlebox_tpu.distributed import rpc, wire
 from paddlebox_tpu.serving.fleet import (Replica, ServingFleet,
@@ -72,6 +72,10 @@ class FleetRouter(rpc.FramedRPCServer):
         # routing counters for THIS router, servable to the cluster
         # scrape without conflating in-process test fleets.
         self.metrics = monitor.Monitor()
+        # Router trend ring (core/timeseries.py) for the
+        # metrics_history RPC; idle until the sampler is armed.
+        self.history = timeseries.history_for(self.metrics,
+                                              label="router")
         if start_health:
             self.fleet.start()
         rpc.FramedRPCServer.__init__(self, endpoint, backlog=128)
@@ -274,6 +278,12 @@ class FleetRouter(rpc.FramedRPCServer):
             out["quantiles"]["fleet/route_ms"] = \
                 self._route_lat.to_dict()
         return out
+
+    def handle_metrics_history(self, req) -> dict:
+        """The router's own trend ring (routing counters, hop
+        latencies) for the fleet_top sparkline pane."""
+        return self.history.to_dict(window_s=req.get("window_s"),
+                                    last_n=req.get("last_n"))
 
     def handle_stop(self, req) -> bool:
         self.stop()

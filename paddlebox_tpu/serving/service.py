@@ -28,7 +28,8 @@ from typing import List, Optional
 
 import numpy as np
 
-from paddlebox_tpu.core import flags, monitor, quality, report, trace
+from paddlebox_tpu.core import (flags, monitor, quality, report,
+                                timeseries, trace)
 from paddlebox_tpu.core.quantiles import LogQuantileDigest
 from paddlebox_tpu.data.parser import parse_lines
 from paddlebox_tpu.distributed import rpc
@@ -63,6 +64,12 @@ class PredictServer(rpc.FramedRPCServer):
         # snapshots, not N copies of the same global registry. Serving
         # counters bump both; the global keeps its existing meaning.
         self.metrics = monitor.Monitor()
+        # Trend ring over the instance registry (core/timeseries.py):
+        # registered with the global sampler, answered by the
+        # metrics_history RPC — idle (never sampled) until the sampler
+        # is armed.
+        self.history = timeseries.history_for(
+            self.metrics, label=f"replica:{self.replica_id}")
         # SLO layer: server-side predict latency quantile digest (the
         # log-bucketed sketch — sub-ms CPU predicts and multi-second
         # tunnel stalls both land within 1% relative error) + the
@@ -161,6 +168,9 @@ class PredictServer(rpc.FramedRPCServer):
         monitor.observe_quantile("serving/predict_ms", ms)
         self.metrics.add("serving/predict_rpcs", 1)
         self.metrics.add("serving/predict_lines", n)
+        # Instance-registry digest too: the per-replica history ring
+        # computes window p99s from the registry it samples.
+        self.metrics.observe_quantile("serving/predict_ms", ms)
         now = time.time()
         with self._lat_lock:
             self._latency.observe(ms)
@@ -262,6 +272,12 @@ class PredictServer(rpc.FramedRPCServer):
             out["quantiles"]["serving/predict_ms"] = \
                 self._latency.to_dict()
         return out
+
+    def handle_metrics_history(self, req) -> dict:
+        """This replica's trend ring (instance registry) — the
+        per-replica half of the fleet_top sparkline pane."""
+        return self.history.to_dict(window_s=req.get("window_s"),
+                                    last_n=req.get("last_n"))
 
     def handle_stop(self, req) -> bool:
         self.stop()

@@ -35,7 +35,8 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
-from paddlebox_tpu.core import faults, flags, log, monitor, quality, trace
+from paddlebox_tpu.core import (faults, flags, incident, log, monitor,
+                                quality, trace)
 from paddlebox_tpu.stream.source import (PassManifest, StreamCursor,
                                          StreamSource)
 from paddlebox_tpu.train.day_runner import DayRunner
@@ -161,6 +162,9 @@ class StreamRunner(DayRunner):
         quality.GLOBAL.set_pass_context(m.day, m.pass_id,
                                         events=int(m.events),
                                         files=len(m.files))
+        # Same identity on the incident recorder: a bundle captured
+        # mid-pass names the exact sub-day pass that was training.
+        incident.set_context(day=m.day, pass_id=m.pass_id)
         with trace.use_context(trace.wire_context()), \
                 trace.span("stream/pass", day=m.day, pass_id=m.pass_id,
                            files=len(m.files), events=m.events):

@@ -1,0 +1,301 @@
+"""The multi-window burn-rate alert engine (core/alerts.py).
+
+Pins the objective semantics OBSERVABILITY.md documents: a breach in
+the fast window alone parks a rule at PENDING (blip), fast AND slow
+together fire it (sustained burn), resolution needs BOTH windows clean
+for ``FLAGS_alerts_clear_windows`` consecutive evaluations (hysteresis
+— one good sample never flaps a page), thresholds come from live flags
+(a 0/unset flag gates the rule out entirely), the default rule pack
+validates, a bad pack raises at construction, and ``evaluate_safe``
+contains an evaluator crash (counted + retried next tick — the
+ROBUSTNESS.md ``alerts/evaluate`` row).
+
+All clocks injected, histories planted — no sampler thread, no wall
+time, no jax.
+"""
+
+import pytest
+
+from paddlebox_tpu.core import alerts, flags, monitor
+from paddlebox_tpu.core.alerts import (AlertEngine, SLORule,
+                                       default_rule_pack, validate_rules)
+from paddlebox_tpu.core.timeseries import MetricHistory
+
+STEP = 10.0
+
+
+@pytest.fixture()
+def aflags():
+    """Short windows so planted rings cover them: fast = exactly the
+    newest sample window, slow = the last three plus the current."""
+    keys = ("alerts_fast_window_s", "alerts_slow_window_s",
+            "alerts_clear_windows")
+    prev = {k: flags.flag(k) for k in keys}
+    flags.set_flags({"alerts_fast_window_s": STEP - 1.0,
+                     "alerts_slow_window_s": 3 * STEP + 1.0,
+                     "alerts_clear_windows": 2})
+    yield
+    flags.set_flags(prev)
+
+
+class _Plant:
+    """A registry + history a test feeds one window at a time."""
+
+    def __init__(self):
+        self.reg = monitor.Monitor()
+        self.hist = MetricHistory(self.reg, points=64, label="plant",
+                                  clock=lambda: 0.0)
+        self.t = 1000.0
+        self.hist.sample(now=self.t)  # delta base
+
+    def window(self, *, lat_ms=None, n=20, counters=(), gauges=()):
+        """One sample window: n latency observations + counter bumps."""
+        if lat_ms is not None:
+            for _ in range(n):
+                self.reg.observe_quantile("serving/predict_ms", lat_ms)
+        for name, v in counters:
+            self.reg.add(name, v)
+        for name, v in gauges:
+            self.reg.set_gauge(name, v)
+        self.t += STEP
+        self.hist.sample(now=self.t)
+        return self.t
+
+
+def _engine(plant, rules, **kw):
+    return AlertEngine(plant.hist, rules, clock=lambda: 0.0, **kw)
+
+
+def _p99_rule(threshold=100.0):
+    return SLORule(name="p99", metric="serving/predict_ms",
+                   kind="quantile", q="p99", threshold=threshold,
+                   severity="page")
+
+
+# -- burn-rate math on planted histories --------------------------------------
+
+
+def test_fast_breach_alone_is_pending_not_firing(aflags):
+    """Three healthy windows then ONE slow window: the fast window
+    breaches but the slow window's merged p99 stays under — blip, not
+    burn."""
+    p = _Plant()
+    eng = _engine(p, [_p99_rule(100.0)], on_page=lambda t: None)
+    for _ in range(3):
+        t = p.window(lat_ms=5.0, n=400)
+        assert eng.evaluate(now=t) == []
+        assert eng.state("p99") == "ok"
+    t = p.window(lat_ms=500.0, n=2)  # 2 slow among 1200 fast in slow win
+    trans = eng.evaluate(now=t)
+    assert [(x["from"], x["to"]) for x in trans] == [("ok", "pending")]
+    st = eng.active()[0]
+    assert st["state"] == "pending"
+    assert st["value_fast"] > 100.0 > st["value_slow"]
+
+
+def test_sustained_breach_fires_then_hysteresis_resolves(aflags):
+    """The full PENDING→FIRING→RESOLVED ride: sustained degradation
+    fires once both windows burn; recovery resolves only after
+    clear_windows consecutive clean evaluations."""
+    fired = []
+    p = _Plant()
+    eng = _engine(p, [_p99_rule(100.0)], on_page=fired.append)
+    for _ in range(3):
+        eng.evaluate(now=p.window(lat_ms=5.0, n=400))
+    # Degrade: window 1's few slow samples breach the fast window only
+    # (<1% of the slow window's tail) → pending; by window 2 the slow
+    # window burns too.
+    eng.evaluate(now=p.window(lat_ms=500.0, n=5))
+    assert eng.state("p99") == "pending"
+    eng.evaluate(now=p.window(lat_ms=500.0, n=50))
+    eng.evaluate(now=p.window(lat_ms=500.0, n=50))
+    assert eng.state("p99") == "firing"
+    assert len(fired) == 1 and fired[0]["name"] == "p99"
+    assert eng.firing_count() == 1
+    assert monitor.GLOBAL.get("alert/p99") >= 1
+    assert monitor.GLOBAL.get_gauge("alerts/firing") == 1.0
+    # Recovery: windows turn clean, but the slow window still holds the
+    # bad samples for a while — FIRING holds (no flap), then after the
+    # slow window slides clean it takes clear_windows=2 clean evals.
+    clean = 0
+    states = []
+    for _ in range(8):
+        t = p.window(lat_ms=5.0, n=50)
+        eng.evaluate(now=t)
+        states.append(eng.state("p99"))
+        if eng.state("p99") == "resolved":
+            break
+    assert states[-1] == "resolved"
+    # No intermediate flap: once firing, only firing→resolved happens.
+    assert set(states[:-1]) == {"firing"}
+    assert len(fired) == 1  # resolution never pages
+
+
+def test_clear_windows_hysteresis_counts_consecutive(aflags):
+    """A breach DURING recovery resets the clean-eval counter: clean,
+    breach, clean, clean → still needs the 2 consecutive cleans AFTER
+    the breach."""
+    p = _Plant()
+    eng = _engine(p, [_p99_rule(100.0)], on_page=lambda t: None)
+    eng.evaluate(now=p.window(lat_ms=500.0))
+    eng.evaluate(now=p.window(lat_ms=500.0))
+    assert eng.state("p99") == "firing"
+    # 4 clean windows slide the slow window clean...
+    for _ in range(4):
+        eng.evaluate(now=p.window(lat_ms=5.0, n=200))
+    # ...but a fresh burst mid-recovery resets the counter.
+    eng.evaluate(now=p.window(lat_ms=500.0, n=200))
+    assert eng.state("p99") == "firing"
+    for _ in range(6):
+        t = p.window(lat_ms=5.0, n=500)
+        eng.evaluate(now=t)
+        if eng.state("p99") == "resolved":
+            break
+    assert eng.state("p99") == "resolved"
+    # resolved decays to a NEW cycle on the next breach (pending/firing)
+    eng.evaluate(now=p.window(lat_ms=900.0, n=500))
+    assert eng.state("p99") in ("pending", "firing")
+
+
+def test_rate_rule_burn_multiplier_and_delta_prefix(aflags):
+    """rate-kind rules gate on threshold*burn events/second; delta-kind
+    rules with a trailing * sum the whole counter family and fire on
+    ANY event when the threshold is 0."""
+    p = _Plant()
+    # rate/delta kinds diff CONSECUTIVE points, so their fast window
+    # must span two samples (the first is the delta base).
+    rules = [SLORule(name="burn", metric="slo/violations", kind="rate",
+                     threshold=1.0, burn=2.0, severity="warn",
+                     fast_window_s=STEP + 1.0),
+             SLORule(name="alarms", metric="quality/alarms/*",
+                     kind="delta", threshold=0.0, severity="warn",
+                     gate_on_threshold=False,
+                     fast_window_s=STEP + 1.0)]
+    eng = _engine(p, rules)
+    # 15 violations / 10s = 1.5/s: above threshold 1.0 but BELOW the
+    # burn bar 1.0*2.0 — must not even go pending.
+    for _ in range(4):
+        t = p.window(counters=[("slo/violations", 15)])
+        eng.evaluate(now=t)
+    assert eng.state("burn") == "ok"
+    # 30/10s = 3.0/s clears the burn bar in both windows.
+    for _ in range(4):
+        t = p.window(counters=[("slo/violations", 30)])
+        eng.evaluate(now=t)
+    assert eng.state("burn") == "firing"
+    # One drift alarm anywhere in the family breaches the 0 threshold.
+    assert eng.state("alarms") == "ok"
+    for _ in range(2):
+        t = p.window(counters=[("quality/alarms/auc_drop", 1)])
+        eng.evaluate(now=t)
+    assert eng.state("alarms") == "firing"
+
+
+def test_gauge_rule_direction_below(aflags):
+    p = _Plant()
+    eng = _engine(p, [SLORule(
+        name="overlap",
+        metric="pass/train_boundary_exchange_overlap_frac",
+        kind="gauge", direction="below", threshold=0.5,
+        severity="warn")])
+    for v in (0.9, 0.8):
+        eng.evaluate(now=p.window(gauges=[(
+            "pass/train_boundary_exchange_overlap_frac", v)]))
+    assert eng.state("overlap") == "ok"
+    for _ in range(4):
+        t = p.window(gauges=[(
+            "pass/train_boundary_exchange_overlap_frac", 0.2)])
+        eng.evaluate(now=t)
+    assert eng.state("overlap") == "firing"
+
+
+# -- threshold flags gate rules ----------------------------------------------
+
+
+def test_threshold_flag_gates_and_retunes_live(aflags):
+    """An unset (0) threshold flag means the objective does not exist;
+    setting it mid-run arms the rule at the NEXT evaluation — operator
+    retunes a live fleet without restarts."""
+    prev = flags.flag("serving_slo_p99_ms")
+    p = _Plant()
+    eng = _engine(p, [SLORule(name="slo", metric="serving/predict_ms",
+                              kind="quantile", q="p99",
+                              threshold_flag="serving_slo_p99_ms",
+                              severity="warn")])
+    try:
+        flags.set_flags({"serving_slo_p99_ms": 0.0})
+        for _ in range(4):
+            t = p.window(lat_ms=500.0)
+            assert eng.evaluate(now=t) == []
+        assert eng.state("slo") == "ok"
+        assert eng.active() == []  # gated rules are invisible
+        flags.set_flags({"serving_slo_p99_ms": 100.0})
+        eng.evaluate(now=p.window(lat_ms=500.0))
+        assert eng.state("slo") == "firing"
+        assert eng.active()[0]["threshold"] == 100.0
+    finally:
+        flags.set_flags({"serving_slo_p99_ms": prev})
+
+
+# -- rule-pack validation -----------------------------------------------------
+
+
+def test_default_rule_pack_validates():
+    pack = default_rule_pack()
+    assert validate_rules(pack) == []
+    names = {r.name for r in pack}
+    assert {"serving_predict_p99", "slo_violation_burn",
+            "replica_lag_p99", "stream_freshness_p99",
+            "quality_alarm_burst",
+            "boundary_overlap_floor"} <= names
+    # Engine construction over the default pack must succeed.
+    AlertEngine(MetricHistory(monitor.Monitor(), points=4,
+                              clock=lambda: 0.0))
+
+
+def test_bad_rule_pack_rejected():
+    bad = [SLORule(name="x", metric="m", kind="nope"),
+           SLORule(name="x", metric="m", severity="loud"),
+           SLORule(name="", metric=""),
+           SLORule(name="w", metric="m", burn=0.0),
+           SLORule(name="v", metric="m", fast_window_s=60.0,
+                   slow_window_s=30.0)]
+    errs = validate_rules(bad)
+    assert len(errs) >= 6  # each defect + the duplicate name
+    with pytest.raises(ValueError, match="invalid alert rule pack"):
+        AlertEngine(None, bad)
+
+
+# -- containment --------------------------------------------------------------
+
+
+def test_evaluate_safe_contains_crashes():
+    """The sampler-callback entry never raises: a crashing evaluation
+    is counted and warned (ROBUSTNESS.md alerts/evaluate row)."""
+    class Boom(MetricHistory):
+        def points(self, window_s=None):
+            raise RuntimeError("planted")
+
+    p = _Plant()
+    boom = Boom(p.reg, points=8, clock=lambda: 0.0)
+    boom.sample(now=1.0)
+    boom.__class__ = Boom  # keep the planted failure after sample()
+    eng = AlertEngine(boom, [_p99_rule(1.0)], clock=lambda: 0.0)
+    errs0 = monitor.GLOBAL.get("alerts/evaluate_errors")
+    # len(history) raises through points()? __len__ reads the deque
+    # directly — force the crash inside evaluate via rule evaluation.
+    boom.sample(now=2.0)
+    assert eng.evaluate_safe(now=3.0) == []
+    assert monitor.GLOBAL.get("alerts/evaluate_errors") == errs0 + 1
+
+
+def test_module_proxies_without_global_engine():
+    assert alerts.GLOBAL is None or True  # other tests may have armed it
+    prev = alerts.GLOBAL
+    alerts.GLOBAL = None
+    try:
+        assert alerts.enabled() is False
+        assert alerts.active_alerts() == []
+        assert alerts.firing_count() == 0
+    finally:
+        alerts.GLOBAL = prev

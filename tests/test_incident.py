@@ -1,0 +1,478 @@
+"""The incident flight recorder (core/incident.py) + the fleet-health
+acceptance drill.
+
+Unit pins: every trigger in the incident matrix (page alert, watchdog
+stall, replica eject, STALE_PRIMARY burst) writes one bundle; captures
+are rate-limited on an injected clock (repeated firing → exactly one
+bundle + ``incident/rate_limited``); bundles appear ONLY via atomic
+rename so a torn ``.tmp`` is never listed; a capture crash is
+contained (counted, returns None — the ROBUSTNESS.md
+``incident/capture`` row); and ``tools/incident_report.py`` renders a
+bundle naming the breached objective.
+
+The acceptance drill runs the real thing: router + 2 replicas over a
+shard tier, health plane armed with second-scale windows, a planted
+predict-latency degradation → ``serving_predict_p99`` FIRING within
+two fast windows, visible in ONE ``telemetry_scrape`` sweep AND in
+``fleet_top --once --json``, exactly one incident bundle under
+repeated firing, ``incident_report`` naming the objective, and
+recovery → RESOLVED after the slow window slides clean.
+
+The jaxpr pin proves the whole plane (sampler + evaluator + capture
+armed) changes ZERO device ops in the train step and serving forward.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import alerts, flags, incident, monitor, timeseries
+from paddlebox_tpu.core.incident import IncidentRecorder, list_bundles
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def recorder(tmp_path):
+    """A fresh recorder swapped in as the process-global one (the
+    watchdog / fleet / alert paths all reach ``incident.GLOBAL``)."""
+    rec = IncidentRecorder(str(tmp_path / "inc"), min_interval_s=3600.0)
+    prev, incident.GLOBAL = incident.GLOBAL, rec
+    yield rec
+    incident.GLOBAL = prev
+
+
+def _bundle_kinds(rec):
+    return [json.load(open(p))["kind"]
+            for p in list_bundles(rec._directory())]
+
+
+# -- atomic bundles + rate limit ----------------------------------------------
+
+
+def test_trigger_writes_atomic_bundle_and_tmp_never_listed(tmp_path):
+    d = str(tmp_path / "inc")
+    rec = IncidentRecorder(d, min_interval_s=0.0)
+    rec.set_context(day="20260807", pass_id=3)
+    path = rec.trigger("unit_test", context={"who": "test"})
+    assert path and os.path.exists(path)
+    b = json.load(open(path))
+    assert b["schema"] == "incident/1"
+    assert b["kind"] == "unit_test"
+    assert b["context"] == {"day": "20260807", "pass_id": 3,
+                            "who": "test"}
+    assert "metrics" in b and "forensics" in b
+    # A torn capture (the crash_drill kill window) is a dot-tmp file:
+    # list_bundles must never mistake it for a complete bundle.
+    torn = os.path.join(d, ".incident-0099-torn.tmp")
+    open(torn, "w").write("{ half a bund")
+    assert list_bundles(d) == [path]
+    # set_context(None) clears keys.
+    rec.set_context(day=None)
+    p2 = rec.trigger("unit_test2")
+    assert "day" not in json.load(open(p2))["context"]
+
+
+def test_rate_limit_one_bundle_under_repeated_firing(tmp_path):
+    clk = [100.0]
+    rec = IncidentRecorder(str(tmp_path / "inc"), min_interval_s=60.0,
+                           clock=lambda: clk[0])
+    limited0 = monitor.GLOBAL.get("incident/rate_limited")
+    assert rec.trigger("flap") is not None
+    for _ in range(5):  # a flapping alert re-triggering in the window
+        clk[0] += 1.0
+        assert rec.trigger("flap") is None
+    assert len(list_bundles(rec._directory())) == 1
+    assert monitor.GLOBAL.get("incident/rate_limited") == limited0 + 5
+    # force bypasses (operator-requested capture), clock expiry re-arms.
+    assert rec.trigger("forced", force=True) is not None
+    clk[0] += 61.0
+    assert rec.trigger("later") is not None
+    assert len(list_bundles(rec._directory())) == 3
+
+
+def test_capture_crash_contained(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("file where the incident dir should be")
+    rec = IncidentRecorder(str(blocker), min_interval_s=0.0)
+    errs0 = monitor.GLOBAL.get("incident/capture_errors")
+    assert rec.trigger("doomed") is None  # contained, never raises
+    assert monitor.GLOBAL.get("incident/capture_errors") == errs0 + 1
+    # The failed capture released its rate-limit claim: a later trigger
+    # (dir fixed) succeeds immediately.
+    rec2 = IncidentRecorder(str(tmp_path / "ok"),
+                            min_interval_s=3600.0)
+    assert rec2.trigger("fine") is not None
+
+
+def test_disabled_recorder_is_a_noop(tmp_path):
+    rec = IncidentRecorder("", min_interval_s=0.0)
+    assert rec.enabled is False
+    assert rec.trigger("ignored") is None
+    rec.note_stale_primary()  # cheap no-op when disabled
+
+
+# -- the trigger matrix -------------------------------------------------------
+
+
+def test_watchdog_stall_writes_bundle(recorder):
+    from paddlebox_tpu.core.watchdog import Watchdog
+    wd = Watchdog(timeout_s=0.01, name="drill-dog")
+    wd._phase = "dispatch"
+    wd._target = None  # nothing to abort: exercise the forensics path
+    wd._fire(12.5)
+    assert _bundle_kinds(recorder) == ["watchdog_stall"]
+    b = json.load(open(list_bundles(recorder._directory())[0]))
+    assert b["context"]["watchdog"] == "drill-dog"
+    assert b["context"]["phase"] == "dispatch"
+    assert "thread_stacks" in (b["forensics"] or {})
+
+
+def test_replica_eject_writes_bundle(recorder):
+    from paddlebox_tpu.serving.fleet import ServingFleet
+    fleet = ServingFleet()
+    fleet.add_replica("r9", "127.0.0.1:1")
+    fleet._eject(fleet.get("r9"), reason="drill")
+    assert _bundle_kinds(recorder) == ["replica_eject"]
+    b = json.load(open(list_bundles(recorder._directory())[0]))
+    assert b["context"]["replica"] == "r9"
+
+
+def test_stale_primary_burst_threshold(recorder):
+    clk = [0.0]
+    rec = IncidentRecorder(recorder._directory(), min_interval_s=0.0,
+                           clock=lambda: clk[0])
+    rec.note_stale_primary()
+    clk[0] = 1.0
+    rec.note_stale_primary()
+    assert list_bundles(rec._directory()) == []  # 2 < burst threshold
+    clk[0] = 2.0
+    rec.note_stale_primary()
+    assert _bundle_kinds(rec) == ["stale_primary_burst"]
+    # Spread wider than the window: never a burst.
+    for dt in (100.0, 120.0, 140.0):
+        clk[0] = dt
+        rec.note_stale_primary()
+    assert len(list_bundles(rec._directory())) == 1
+
+
+def test_page_alert_firing_triggers_capture(recorder):
+    """The alerts→incident seam: a page-severity FIRING transition with
+    no on_page override reaches incident.trigger."""
+    from paddlebox_tpu.core.alerts import AlertEngine, SLORule
+    from paddlebox_tpu.core.timeseries import MetricHistory
+    reg = monitor.Monitor()
+    h = MetricHistory(reg, points=16, clock=lambda: 0.0)
+    h.sample(now=0.0)
+    eng = AlertEngine(h, [SLORule(name="gauge_page", metric="g",
+                                  kind="gauge", threshold=1.0,
+                                  severity="page")],
+                      clock=lambda: 0.0)
+    reg.set_gauge("g", 5.0)
+    h.sample(now=10.0)
+    eng.evaluate(now=10.0)
+    assert eng.state("gauge_page") == "firing"
+    assert _bundle_kinds(recorder) == ["alert:gauge_page"]
+    b = json.load(open(list_bundles(recorder._directory())[0]))
+    assert b["context"]["alert"]["name"] == "gauge_page"
+
+
+# -- incident_report ----------------------------------------------------------
+
+
+def test_incident_report_renders_and_lists(tmp_path, capsys):
+    rec = IncidentRecorder(str(tmp_path / "inc"), min_interval_s=0.0)
+    path = rec.trigger("unit_render", context={"day": "20260807"})
+    irep = _tool("incident_report")
+    assert irep.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "INCIDENT  unit_render" in out
+    assert "day=20260807" in out
+    # Directory form resolves the NEWEST complete bundle; --list names
+    # them all; --json re-dumps machine-readably.
+    assert irep.main([str(tmp_path / "inc"), "--list"]) == 0
+    assert path in capsys.readouterr().out
+    assert irep.main([str(tmp_path / "inc"), "--json"]) == 0
+    assert json.loads(
+        capsys.readouterr().out)["kind"] == "unit_render"
+
+
+# -- the acceptance drill -----------------------------------------------------
+
+SLOTS = ("u", "i")
+N_KEYS = 400
+DIM = 8
+
+
+def _drill_fleet(shard_eps):
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.serving import (CTRPredictor, FleetRouter,
+                                       PredictClient, PredictServer,
+                                       ShardBackedStore)
+    import jax
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=16)
+    model = DeepFM(slot_names=SLOTS, emb_dim=DIM, hidden=())
+    rng = np.random.default_rng(3)
+    keys = np.arange(1, N_KEYS + 1, dtype=np.uint64)
+    emb = rng.normal(size=(N_KEYS, DIM)).astype(np.float32) * 0.02
+    w = rng.normal(size=(N_KEYS,)).astype(np.float32) * 0.02
+    dense = model.init(jax.random.PRNGKey(0))
+    preds = [CTRPredictor(model, feed, keys[:32], emb[:32], w[:32],
+                          dense, compute_dtype="float32", hbm_rows=24,
+                          shard_backing=ShardBackedStore(shard_eps, DIM))
+             for _ in range(2)]
+    servers = [PredictServer("127.0.0.1:0", p, replica_id=f"r{i}")
+               for i, p in enumerate(preds)]
+    router = FleetRouter("127.0.0.1:0",
+                         replicas=[s.endpoint for s in servers],
+                         start_health=False)
+    return preds, servers, router, PredictClient(router.endpoint)
+
+
+@pytest.fixture()
+def shard_tier():
+    from paddlebox_tpu.embedding.table import TableConfig
+    from paddlebox_tpu.multihost.shard_service import (start_local_shards,
+                                                       stop_shards)
+    from paddlebox_tpu.multihost.store import MultiHostStore
+    cfg = TableConfig(name="emb", dim=DIM, learning_rate=0.1)
+    servers, eps = start_local_shards(2, cfg)
+    store = MultiHostStore(cfg, eps)
+    rng = np.random.default_rng(3)
+    keys = np.arange(1, N_KEYS + 1, dtype=np.uint64)
+    rows = store.pull_for_pass(keys)
+    rows["emb"] = rng.normal(size=(N_KEYS, DIM)).astype(np.float32) * 0.02
+    rows["w"] = rng.normal(size=(N_KEYS,)).astype(np.float32) * 0.02
+    store.push_from_pass(keys, rows)
+    yield eps
+    store.close()
+    stop_shards(servers)
+
+
+def test_fleet_health_acceptance_drill(tmp_path, shard_tier, capsys):
+    """ISSUE-18 acceptance: degrade → FIRING in ≤2 fast windows →
+    visible in one scrape sweep and fleet_top → one bundle → report
+    names the objective → recover → RESOLVED; re-fire stays
+    rate-limited at exactly one bundle."""
+    from paddlebox_tpu.core import telemetry_scrape as tscrape
+    inc_dir = str(tmp_path / "inc")
+    keys = ("serving_slo_p99_ms", "alerts_fast_window_s",
+            "alerts_slow_window_s", "alerts_clear_windows")
+    prev = {k: flags.flag(k) for k in keys}
+    flags.set_flags({"serving_slo_p99_ms": 300.0,
+                     "alerts_fast_window_s": 9.0,
+                     "alerts_slow_window_s": 31.0,
+                     "alerts_clear_windows": 2})
+    hist = timeseries.MetricHistory(monitor.GLOBAL, points=64,
+                                    label="global", clock=lambda: 0.0)
+    eng = alerts.AlertEngine(hist, clock=lambda: 0.0)  # default pack
+    rec = IncidentRecorder(inc_dir, min_interval_s=3600.0)
+    prev_rec, incident.GLOBAL = incident.GLOBAL, rec
+    prev_eng, alerts.GLOBAL = alerts.GLOBAL, eng
+    preds, servers, router, cli = _drill_fleet(shard_tier)
+    rng = np.random.default_rng(7)
+
+    def lines(n=2):
+        return [f"0 u:{rng.integers(1, N_KEYS)} i:{rng.integers(1, N_KEYS)}"
+                for _ in range(n)]
+
+    t = [1_000_000.0]
+
+    def window(bad=False):
+        """One sampler window: real fleet traffic, plus (bad) a planted
+        latency degradation >1% of the slow window's observations."""
+        for _ in range(6):
+            cli.predict(lines())
+        for _ in range(200):
+            monitor.observe_quantile(
+                "serving/predict_ms", 5000.0 if bad else 5.0)
+        t[0] += 10.0
+        hist.sample(now=t[0])
+        return eng.evaluate(now=t[0])
+
+    try:
+        for _ in range(8):  # JIT warmup before the delta base
+            cli.predict(lines())
+        hist.sample(now=t[0])
+        for _ in range(3):
+            window()
+        assert eng.state("serving_predict_p99") == "ok"
+
+        window(bad=True)
+        window(bad=True)
+        assert eng.state("serving_predict_p99") == "firing"
+
+        # ONE scrape sweep shows the firing objective fleet-wide.
+        targets = {"router": router.endpoint,
+                   **{f"r{i}": s.endpoint
+                      for i, s in enumerate(servers)}}
+        sweep = tscrape.scrape_cluster(targets, with_history=True)
+        assert not sweep["errors"]
+        assert sweep["cluster"]["alerts_firing"] >= 1
+        st = {a["name"]: a["state"] for a in sweep["alerts"]}
+        assert st["serving_predict_p99"] == "firing"
+        assert (sweep["history"]["points"]
+                or sweep["per_target"]["r0"]["history"]["points"]
+                is not None)
+
+        # ...and in fleet_top --once --json (capsys drains the render).
+        ftop = _tool("fleet_top")
+        rc = ftop.main(["--targets", f"router={router.endpoint}",
+                        "--once", "--json", "--alerts"])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0
+        assert any(a["name"] == "serving_predict_p99"
+                   and a["state"] == "firing" for a in out["alerts"])
+
+        # Exactly one bundle, and the report names the objective.
+        bundles = list_bundles(inc_dir)
+        assert len(bundles) == 1
+        irep = _tool("incident_report")
+        assert irep.main([bundles[0]]) == 0
+        rep = capsys.readouterr().out
+        assert "alert:serving_predict_p99" in rep
+        assert "serving/predict_ms" in rep
+
+        # Recovery: clean windows slide the slow window clean, then the
+        # clear_windows hysteresis resolves.
+        states = []
+        for _ in range(10):
+            window()
+            states.append(eng.state("serving_predict_p99"))
+            if states[-1] == "resolved":
+                break
+        assert states[-1] == "resolved", states
+
+        # Re-fire: rate limit holds the bundle count at exactly one.
+        limited0 = monitor.GLOBAL.get("incident/rate_limited")
+        window(bad=True)
+        window(bad=True)
+        assert eng.state("serving_predict_p99") == "firing"
+        assert len(list_bundles(inc_dir)) == 1
+        assert monitor.GLOBAL.get("incident/rate_limited") == limited0 + 1
+    finally:
+        incident.GLOBAL = prev_rec
+        alerts.GLOBAL = prev_eng
+        flags.set_flags(prev)
+        cli.close()
+        router.stop()
+        for s in servers:
+            s.stop()
+        for p in preds:
+            p.close()
+
+
+# -- zero-device-cost pin -----------------------------------------------------
+
+
+def test_health_plane_leaves_step_and_serving_forward_unchanged(tmp_path):
+    """The jaxpr pin: sampler thread ticking + alert engine evaluating
+    + incident capture armed (and one forced capture taken) change
+    ZERO ops in the train step and the serving forward — the whole
+    plane is host-side."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.data.parser import parse_lines
+    from paddlebox_tpu.data.slots import (DataFeedConfig, SlotBatch,
+                                          SlotConf)
+    from paddlebox_tpu.embedding import DeviceFeatureStore, TableConfig
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import HybridTopology, build_mesh
+    from paddlebox_tpu.serving.batcher import pack_bucketed
+    from paddlebox_tpu.serving.predictor import CTRPredictor
+    from paddlebox_tpu.train import CTRTrainer, TrainerConfig
+    from paddlebox_tpu.train.ctr_trainer import _concat_dense_host
+    from paddlebox_tpu.utils import inspect as pbx_inspect
+
+    slots = ("user", "item")
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in slots),
+        batch_size=8)
+    model = DeepFM(slot_names=slots, emb_dim=8, hidden=())
+
+    def step_op_counts():
+        mesh = build_mesh(HybridTopology(dp=4),
+                          devices=jax.devices()[:4])
+        tr = CTRTrainer(model, feed, TableConfig(dim=8), mesh=mesh,
+                        config=TrainerConfig(auc_num_buckets=1 << 10),
+                        store_factory=lambda c: DeviceFeatureStore(
+                            c, mesh=mesh))
+        tr.init(seed=0)
+        tlines = [f"{i % 2} user:{3 + i} item:{4 + i}"
+                  for i in range(8)]
+        b = SlotBatch.pack_sharded(parse_lines(tlines, feed), feed, 4)
+        tr.engine.feed_pass([
+            np.unique(np.concatenate([b.ids[n] for n in g.slots]))
+            for g in tr.engine.groups])
+        step = tr._build_step()
+        tables = tr.engine.begin_pass()
+        rows = tr._map_batch_rows(b)
+        segs = {n: jnp.asarray(b.segments[n]) for n in b.ids}
+        args = (tables, tr.params, tr.opt_state, tr.auc_state, rows,
+                segs, jnp.asarray(b.labels), jnp.asarray(b.valid),
+                jnp.asarray(_concat_dense_host(b)),
+                jnp.zeros((), jnp.int32))
+        return pbx_inspect.jaxpr_summary(lambda *a: step(*a), *args)
+
+    def fwd_op_counts():
+        rng = np.random.default_rng(0)
+        keys = np.arange(1, 33, dtype=np.uint64)
+        emb = rng.normal(size=(32, 8)).astype(np.float32)
+        w = rng.normal(size=(32,)).astype(np.float32)
+        pred = CTRPredictor(model, feed, keys, emb, w,
+                            model.init(jax.random.PRNGKey(0)),
+                            compute_dtype="float32")
+        batch = pack_bucketed(
+            parse_lines(["0 user:3 item:4", "1 user:5 item:6"], feed),
+            feed)
+        caps = {n: batch.ids[n].shape[0] for n in pred._slot_names}
+        all_ids = np.concatenate(
+            [batch.ids[n] for n in pred._slot_names])
+        looked = pred._index.lookup(all_ids)
+        rows = np.where(looked < 0, pred._table.shape[0] - 1,
+                        looked).astype(np.int32)
+        fwd = pred._build_fwd(caps, batch.batch_size, 0)
+        segs = {n: jnp.asarray(batch.segments[n])
+                for n in pred._slot_names}
+        return pbx_inspect.jaxpr_summary(
+            lambda *a: fwd(*a), pred._table, pred._zero_miss,
+            pred._dense_params, rows, segs,
+            jnp.asarray(_concat_dense_host(batch)))
+
+    step_off, fwd_off = step_op_counts(), fwd_op_counts()
+    keys = ("history_interval_s", "alerts_enable", "incident_dir")
+    prev = {k: flags.flag(k) for k in keys}
+    flags.set_flags({"history_interval_s": 0.02,
+                     "alerts_enable": True,
+                     "incident_dir": str(tmp_path / "inc")})
+    try:
+        timeseries.init_from_flags()
+        alerts.init_from_flags()
+        assert timeseries.GLOBAL_SAMPLER.running
+        assert alerts.enabled()
+        assert incident.enabled()
+        time.sleep(0.06)  # let the sampler tick while armed
+        step_on, fwd_on = step_op_counts(), fwd_op_counts()
+        assert incident.trigger("jaxpr_pin_probe", force=True)
+        assert step_on == step_off, (step_on, step_off)
+        assert fwd_on == fwd_off, (fwd_on, fwd_off)
+    finally:
+        alerts.shutdown()
+        timeseries.GLOBAL_SAMPLER.stop()
+        flags.set_flags(prev)

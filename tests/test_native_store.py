@@ -103,17 +103,24 @@ def test_init_uniform_bit_exact_twin():
 @pytest.mark.skipif(not native_available(), reason="native lib unavailable")
 def test_index_build_throughput():
     """VERDICT r02 task 3 floor: native-grade store build. On the 1-core
-    bench host the prefetch-pipelined insert sustains >~4M keys/s; assert
-    a conservative 2M keys/s so slower CI hosts stay green while a
-    regression to the numpy-era 0.4M keys/s still fails."""
-    n = 10_000_000
-    keys = np.random.default_rng(5).permutation(
-        np.arange(1, n + 1)).astype(np.uint64)
-    idx = sp.KeyIndex()
-    idx.reserve(n)
-    t0 = time.perf_counter()
-    _, n_new = idx.upsert(keys)
-    dt = time.perf_counter() - t0
-    assert n_new == n
-    rate = n / dt
-    assert rate >= 2e6, f"index build {rate/1e6:.2f}M keys/s < 2M floor"
+    bench host the prefetch-pipelined insert sustains >~4M keys/s; the
+    floor is a conservative 2M keys/s on the MEDIAN of three runs so a
+    transient CI load spike (which stalls at most one run) stays green
+    while a regression to the numpy-era 0.4M keys/s still fails all
+    three."""
+    n = 4_000_000
+    rates = []
+    for run in range(3):
+        keys = np.random.default_rng(5 + run).permutation(
+            np.arange(1, n + 1)).astype(np.uint64)
+        idx = sp.KeyIndex()
+        idx.reserve(n)
+        t0 = time.perf_counter()
+        _, n_new = idx.upsert(keys)
+        dt = time.perf_counter() - t0
+        assert n_new == n
+        rates.append(n / dt)
+    rate = sorted(rates)[1]
+    assert rate >= 2e6, (f"index build median {rate/1e6:.2f}M keys/s "
+                         f"< 2M floor (runs: "
+                         f"{[round(r/1e6, 2) for r in rates]}M)")

@@ -600,3 +600,32 @@ def test_rpc_keys_direction_and_gating(tmp_path):
     ok["sg_frames"] = 3
     assert perf_gate.main(
         [_write(tmp_path, "rpc_ok.json", ok), "--baseline", b]) == 0
+
+
+def test_health_plane_keys_direction_and_gating(tmp_path):
+    """Round-18 fleet-health keys: the history-sampler overhead
+    fraction gates lower-better like the tracing overhead, and
+    ``alerts_firing`` gates lower-better FROM A ZERO BASELINE (the
+    counter floor makes 0→any rise a trip — a healthy bench must end
+    with nothing firing)."""
+    assert perf_gate.direction("telemetry.history_overhead_frac") == -1
+    assert perf_gate.direction("telemetry.alerts_firing") == -1
+    assert perf_gate.direction("telemetry.history_on_rps") == 1
+    base = {"value": 9000.0,
+            "telemetry": {"telemetry_overhead_frac": 0.02,
+                          "history_on_rps": 1850.0,
+                          "history_overhead_frac": 0.03,
+                          "alerts_firing": 0}}
+    b = _write(tmp_path, "hp_base.json", base)
+    assert perf_gate.main([_write(tmp_path, "hp_ok.json", base),
+                           "--baseline", b]) == 0
+    costly = copy.deepcopy(base)
+    costly["telemetry"]["history_overhead_frac"] = 0.5
+    assert perf_gate.main([_write(tmp_path, "hp_costly.json", costly),
+                           "--baseline", b]) == 1
+    firing = copy.deepcopy(base)
+    firing["telemetry"]["alerts_firing"] = 2
+    rep = _write(tmp_path, "hp_firing.json", firing)
+    assert perf_gate.main([rep, "--baseline", b]) == 1
+    _, regs = perf_gate.compare(firing, base)
+    assert {r["metric"] for r in regs} == {"telemetry.alerts_firing"}

@@ -1,0 +1,211 @@
+"""The bounded metric-history ring (core/timeseries.py).
+
+Pins the fleet-health-plane sensor contracts OBSERVABILITY.md
+documents: the ring is bounded (retention = FLAGS_history_points),
+counters land as per-window deltas that ``rate()`` turns into
+events/second, quantile digests land as exact ``delta()`` window
+sketches so ``window_quantiles`` answers for the WINDOW (not process
+lifetime), ``merge_history`` is associative across hosts like
+``monitor.merge_snapshots``, ``to_dict``/``from_dict`` round-trips
+through JSON (the ``metrics_history`` RPC payload), and every clock is
+injected — a planted-timestamp test never reads wall time, which is
+the same property graftlint's replay-purity pass relies on.
+
+No jax import: the history plane is pure stdlib.
+"""
+
+import json
+
+import pytest
+
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.core.timeseries import (HistorySampler, MetricHistory,
+                                           merge_history)
+
+
+def _planted(reg, t0=1000.0, n=6, step=10.0, per_window=50,
+             lat=lambda i: 5.0):
+    """Drive ``n`` sample windows over ``reg``: ``per_window`` counter
+    events and quantile observations per window, gauge = window index.
+    Returns the history (ring of n+1 points: base + n windows)."""
+    h = MetricHistory(reg, points=64, label="planted",
+                      clock=lambda: 0.0)
+    h.sample(now=t0)  # delta base
+    for i in range(n):
+        reg.add("req/count", per_window)
+        reg.set_gauge("load/gauge", float(i))
+        for _ in range(per_window):
+            reg.observe_quantile("req/ms", lat(i))
+        h.sample(now=t0 + (i + 1) * step)
+    return h
+
+
+# -- ring bound ---------------------------------------------------------------
+
+
+def test_ring_bound_drops_oldest():
+    reg = monitor.Monitor()
+    h = MetricHistory(reg, points=4, label="bound",
+                      clock=lambda: 0.0)
+    for i in range(10):
+        reg.add("c", 1)
+        h.sample(now=100.0 + i)
+    assert len(h) == 4
+    pts = h.points()
+    assert [p["ts"] for p in pts] == [106.0, 107.0, 108.0, 109.0]
+    # Every retained point carries the one-event delta.
+    assert all(p["counters"]["c"] == 1 for p in pts)
+
+
+# -- counters → deltas → rate -------------------------------------------------
+
+
+def test_counter_deltas_and_rate():
+    reg = monitor.Monitor()
+    h = _planted(reg, per_window=50, step=10.0)
+    # Each point stores the per-window delta, not the cumulative value.
+    assert [v for _, v in h.series("req/count")][1:] == [50] * 6
+    # 50 events per 10s window → 5/s, over any window that spans >= 2
+    # points.
+    assert h.rate("req/count") == pytest.approx(5.0)
+    assert h.rate("req/count", window_s=20.0) == pytest.approx(5.0)
+    # delta() sums the window's events; the first in-window point is
+    # the delta base, so a 25s window covers two 10s deltas.
+    assert h.delta("req/count") == pytest.approx(300)
+    assert h.delta("req/count", window_s=25.0) == pytest.approx(100)
+    # Gauges are last-value: latest wins, series carries each sample.
+    assert h.latest("load/gauge") == 5.0
+    assert h.rate("absent") is None or h.rate("absent") == 0.0
+
+
+def test_rate_needs_two_points():
+    reg = monitor.Monitor()
+    h = MetricHistory(reg, points=8, clock=lambda: 0.0)
+    reg.add("c", 7)
+    h.sample(now=50.0)
+    assert h.rate("c") is None  # single point = no span
+
+
+# -- digest windows -----------------------------------------------------------
+
+
+def test_window_quantiles_answer_for_the_window():
+    """Lifetime digest says ~5ms (300 fast + 50 slow); the LAST window
+    contains only the slow observations — window p50 must see 100ms,
+    proving the per-point sketches are delta() windows."""
+    reg = monitor.Monitor()
+    h = _planted(reg, n=7, lat=lambda i: 100.0 if i == 6 else 5.0)
+    last = h.window_quantiles("req/ms", window_s=10.0)
+    assert last["count"] == 50
+    assert last["p50"] == pytest.approx(100.0, rel=0.2)
+    whole = h.window_quantiles("req/ms")
+    assert whole["count"] == 350
+    assert whole["p50"] == pytest.approx(5.0, rel=0.2)
+    assert h.window_quantiles("never/observed") == {}
+
+
+# -- serialization ------------------------------------------------------------
+
+
+def test_to_dict_from_dict_round_trip_through_json():
+    reg = monitor.Monitor()
+    h = _planted(reg)
+    wire = json.loads(json.dumps(h.to_dict()))  # the RPC payload path
+    back = MetricHistory.from_dict(wire)
+    assert len(back) == len(h)
+    assert back.rate("req/count") == h.rate("req/count")
+    assert back.delta("req/count") == h.delta("req/count")
+    assert (back.window_quantiles("req/ms")["p99"]
+            == h.window_quantiles("req/ms")["p99"])
+    # window_s / last_n trims the payload without touching the ring.
+    assert len(h.to_dict(last_n=2)["points"]) == 2
+    assert len(h.to_dict(window_s=10.0)["points"]) < len(h)
+    assert len(h) == 7
+
+
+# -- merge across hosts -------------------------------------------------------
+
+
+def _host(seed, t0, lat):
+    reg = monitor.Monitor()
+    return _planted(reg, t0=t0, n=4, per_window=10 + seed,
+                    lat=lambda i: lat).to_dict()
+
+
+def test_merge_history_sums_counters_means_gauges_merges_digests():
+    a = _host(0, 1000.0, 5.0)
+    b = _host(5, 1000.0, 50.0)
+    m = merge_history([a, b], bucket_s=10.0)
+    back = MetricHistory.from_dict(m)
+    # Aligned buckets: counter deltas SUM (10 + 15 per window).
+    assert back.delta("req/count") == pytest.approx(4 * 25)
+    # Gauges MEAN within a bucket (both hosts report the same i).
+    assert back.latest("load/gauge") == pytest.approx(3.0)
+    # Digest windows MERGE: the cluster p99 sees the slow host.
+    assert back.window_quantiles("req/ms")["p99"] >= 40.0
+
+
+def test_merge_history_is_associative():
+    hosts = [_host(i, 1000.0, 5.0 * (i + 1)) for i in range(3)]
+    left = merge_history(
+        [merge_history(hosts[:2], bucket_s=10.0), hosts[2]],
+        bucket_s=10.0)
+    flat = merge_history(hosts, bucket_s=10.0)
+    la, fa = MetricHistory.from_dict(left), MetricHistory.from_dict(flat)
+    assert la.delta("req/count") == pytest.approx(fa.delta("req/count"))
+    assert (la.window_quantiles("req/ms")["p99"]
+            == pytest.approx(fa.window_quantiles("req/ms")["p99"]))
+    assert merge_history([])["points"] == []
+
+
+# -- injected-clock purity ----------------------------------------------------
+
+
+def test_injected_clock_means_no_wall_reads():
+    """Sampling AND querying with a planted clock must be wall-time
+    independent: two runs with identical planted timestamps produce
+    identical rings even though real time passed between them — the
+    replay-purity property graftlint walks StreamRunner for."""
+    def run():
+        reg = monitor.Monitor()
+        h = _planted(reg, t0=123456.0)
+        return (h.to_dict(), h.rate("req/count", window_s=30.0),
+                h.window_quantiles("req/ms", window_s=30.0))
+    assert run() == run()
+
+    # A sentinel clock that fails on ANY call proves query paths never
+    # consult the clock once planted `now` timestamps drive sample().
+    def boom():  # pragma: no cover - must never run
+        raise AssertionError("history read wall clock")
+
+    reg = monitor.Monitor()
+    h = MetricHistory(reg, points=8, clock=boom)
+    reg.add("c", 3)
+    h.sample(now=10.0)
+    reg.add("c", 3)
+    h.sample(now=20.0)
+    assert h.rate("c") == pytest.approx(0.3)
+    assert h.points(window_s=100.0)
+
+
+# -- the sampler --------------------------------------------------------------
+
+
+def test_sampler_ticks_all_histories_and_contains_callbacks():
+    regs = [monitor.Monitor() for _ in range(2)]
+    s = HistorySampler(clock=lambda: 0.0)
+    hs = [s.register(MetricHistory(r, points=8, clock=lambda: 0.0))
+          for r in regs]
+    seen = []
+    s.add_callback("ok", seen.append)
+    s.add_callback("boom", lambda ts: 1 / 0)  # contained, never raises
+    errs0 = monitor.GLOBAL.get("history/callback_errors")
+    assert s.tick(now=100.0) == 2
+    assert s.tick(now=110.0) == 2
+    assert all(len(h) == 2 for h in hs)
+    assert seen == [100.0, 110.0]
+    assert monitor.GLOBAL.get("history/callback_errors") == errs0 + 2
+    s.remove_callback("boom")
+    s.tick(now=120.0)
+    assert monitor.GLOBAL.get("history/callback_errors") == errs0 + 2
+    assert not s.running  # never started a thread: hand-driven ticks

@@ -70,6 +70,11 @@ MULTIHOST_SITES = [
     ("multihost/replica_promote", 1),
     ("rpc/sg_recv", 1),
 ]
+# The incident flight recorder's crash window (--matrix incident):
+# die between the bundle's tmp write and its os.replace — the torn
+# ``.incident-*.tmp`` must never be listed as a complete bundle, and a
+# retried capture must yield exactly one bundle incident_report renders.
+INCIDENT_SITE = ("incident/capture", 1)
 
 
 def write_day(data_root: str, day: str = DAY, hours=HOURS,
@@ -288,6 +293,73 @@ def run_drill(workdir: str, site: str, *, hit: int = 1,
             "mismatch": mismatch}
 
 
+def incident_worker(directory: str) -> None:
+    """``--worker-incident`` body: arm the flight recorder at DIR and
+    force one capture (the drill injects the kill via
+    FLAGS_fault_spec)."""
+    from paddlebox_tpu.core import faults, flags, incident
+    faults.init_from_flags()
+    flags.set_flags({"incident_dir": directory})
+    path = incident.GLOBAL.trigger("drill", context={"drill": True},
+                                   force=True)
+    print(json.dumps({"bundle": path}), flush=True)
+
+
+def run_incident_drill(workdir: str, *, timeout: float = 120.0) -> dict:
+    """Drill the ``incident/capture`` window: kill lands after the
+    bundle bytes are durable under the tmp name but before the atomic
+    rename. Proves a torn bundle is never mistaken for a complete one,
+    and that the retried capture completes and renders."""
+    import glob as _glob
+
+    def list_bundles(d):
+        # Mirrors core/incident.py list_bundles (the parent process
+        # runs without PYTHONPATH): complete bundles only — the
+        # atomic-rename contract says torn captures are ``.tmp``.
+        return sorted(_glob.glob(os.path.join(d, "incident-*.json")))
+
+    inc_dir = os.path.join(workdir, "incidents")
+    os.makedirs(inc_dir, exist_ok=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["FLAGS_fault_spec"] = "incident/capture:hit=1:kill"
+    args = [sys.executable, os.path.abspath(__file__),
+            "--worker-incident", inc_dir]
+    rc = subprocess.run(args, env=env, cwd=REPO, timeout=timeout,
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.STDOUT).returncode
+    mismatch = []
+    if rc == 0:
+        mismatch.append("faultpoint never reached (rc=0)")
+    if not _glob.glob(os.path.join(inc_dir, ".incident-*.tmp")):
+        mismatch.append("kill left no torn .tmp (window moved?)")
+    if list_bundles(inc_dir):
+        mismatch.append("torn capture listed as a complete bundle")
+    env["FLAGS_fault_spec"] = ""
+    rc2 = subprocess.run(args, env=env, cwd=REPO, timeout=timeout,
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.STDOUT).returncode
+    if rc2 != 0:
+        mismatch.append(f"clean capture run failed rc={rc2}")
+    bundles = list_bundles(inc_dir)
+    if len(bundles) != 1:
+        mismatch.append(
+            f"want exactly 1 complete bundle, got {len(bundles)}")
+    if bundles and not mismatch:
+        render = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "incident_report.py"),
+             bundles[0]],
+            env=env, cwd=REPO, timeout=timeout,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        if render.returncode != 0:
+            mismatch.append(
+                f"incident_report render failed rc={render.returncode}")
+    return {"ok": not mismatch, "site": INCIDENT_SITE[0],
+            "hit": INCIDENT_SITE[1], "killed_rc": rc,
+            "mismatch": mismatch}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--worker", nargs=3,
@@ -299,7 +371,11 @@ def main(argv=None) -> int:
                     help="run the full site matrix (slow)")
     ap.add_argument("--matrix", default="",
                     help="named drill tier: 'multihost' = the "
-                         "replicated shard tier's crash windows")
+                         "replicated shard tier's crash windows; "
+                         "'incident' = the flight recorder's "
+                         "torn-bundle window")
+    ap.add_argument("--worker-incident", metavar="DIR",
+                    help="(worker) force one incident capture into DIR")
     ap.add_argument("--multihost", action="store_true",
                     help="(worker) train against a replicas=2 loopback "
                          "shard cluster + host-loss repair walk")
@@ -310,13 +386,27 @@ def main(argv=None) -> int:
         worker_main(*args.worker, resume=args.resume,
                     multihost=args.multihost)
         return 0
+    if args.worker_incident:
+        incident_worker(args.worker_incident)
+        return 0
 
     multihost = args.matrix == "multihost" or args.multihost
-    if args.matrix and args.matrix != "multihost":
+    if args.matrix and args.matrix not in ("multihost", "incident"):
         ap.error(f"unknown --matrix tier {args.matrix!r}")
 
     import tempfile
     workdir = args.workdir or tempfile.mkdtemp(prefix="crash_drill_")
+    if args.matrix == "incident":
+        t0 = time.time()
+        r = run_incident_drill(workdir)
+        print(json.dumps({k: r[k] for k in
+                          ("ok", "site", "hit", "killed_rc",
+                           "mismatch")}), flush=True)
+        print(json.dumps({"metric": "crash_drill", "ok": r["ok"],
+                          "sites": 1,
+                          "wall_s": round(time.time() - t0, 1),
+                          "workdir": workdir}), flush=True)
+        return 0 if r["ok"] else 1
     sites = ([(args.site, args.hit)] if args.site
              else (MULTIHOST_SITES if multihost
                    else (FULL_SITES if args.full else FAST_SITES)))

@@ -20,6 +20,9 @@ reconnect/retry totals.
     # record a JSONL timeline while watching
     python tools/fleet_top.py --router ... --record /tmp/fleet.jsonl
 
+    # trend sparklines (metrics_history) + fleet-wide ALERTS pane
+    python tools/fleet_top.py --router ... --history --alerts
+
 No jax import — runs anywhere the cluster network is reachable.
 """
 
@@ -74,6 +77,86 @@ _HEADS = {"target": "target", "throughput_rps": "rps",
           "calibration_error": "cal_err", "quality_alarms": "q_alarm"}
 
 
+_SPARK = "\u2581\u2582\u2583\u2584\u2585\u2586\u2587\u2588"
+
+
+def sparkline(values, width: int = 32) -> str:
+    """Unicode sparkline of the LAST ``width`` values, min-max scaled
+    (a flat series renders as a flat low bar)."""
+    vs = [float(v) for v in values if isinstance(v, (int, float))]
+    if not vs:
+        return ""
+    vs = vs[-width:]
+    lo, hi = min(vs), max(vs)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vs)
+    return "".join(_SPARK[min(int((v - lo) / span * 8), 7)]
+                   for v in vs)
+
+
+def _trend_rows(hist: dict) -> list:
+    """(label, last, sparkline) rows for the trend pane off the
+    cluster-merged history: predict rps + window p99, replication lag,
+    and COPC — the four signals an operator trends first."""
+    from paddlebox_tpu.core import timeseries
+    h = timeseries.MetricHistory.from_dict(hist)
+    pts = h.points()
+    rows = []
+    rps = [p["counters"].get("serving/predict_rpcs", 0) for p in pts[1:]]
+    if any(rps):
+        rows.append(("rps", rps[-1] if rps else 0, sparkline(rps)))
+    p99s = []
+    from paddlebox_tpu.core.quantiles import LogQuantileDigest
+    for p in pts:
+        d = (p.get("quantiles") or {}).get("serving/predict_ms")
+        if d:
+            q = LogQuantileDigest.from_dict(d).quantiles().get("p99")
+            p99s.append(q if isinstance(q, (int, float)) else None)
+        else:
+            p99s.append(None)
+    if any(v is not None for v in p99s):
+        last = [v for v in p99s if v is not None][-1]
+        rows.append(("p99_ms", round(last, 2), sparkline(p99s)))
+    for label, name in (("lag", "multihost/replica_lag_p99"),
+                        ("copc", "quality/copc")):
+        vals = [p["gauges"].get(name) for p in pts]
+        vals = [v for v in vals if isinstance(v, (int, float))]
+        if vals:
+            rows.append((label, round(vals[-1], 3), sparkline(vals)))
+    return rows
+
+
+def render_trend(rec: dict) -> None:
+    hist = rec.get("history")
+    if not isinstance(hist, dict) or not hist.get("points"):
+        print("TREND: no history yet (is FLAGS_history_interval_s set?)")
+        return
+    rows = _trend_rows(hist)
+    if rows:
+        print("TREND (cluster-merged metrics_history)")
+        for label, last, spark in rows:
+            print(f"  {label:>7} {last!s:>9} {spark}")
+
+
+def render_alerts(rec: dict) -> None:
+    alerts = rec.get("alerts") or ()
+    shown = [a for a in alerts if a.get("state") in ("firing",
+                                                     "pending")]
+    if not shown:
+        print("ALERTS: none firing")
+        return
+    print("ALERTS (fleet-wide)")
+    for a in shown:
+        vf = a.get("value_fast")
+        vf = f"{vf:g}" if isinstance(vf, (int, float)) else "-"
+        th = a.get("threshold")
+        th = f"{th:g}" if isinstance(th, (int, float)) else "-"
+        print(f"  {a['state'].upper():>8} [{a.get('severity', '?')}] "
+              f"{a.get('target', '?')}: {a.get('name')} "
+              f"({a.get('metric')} fast={vf} vs {th})")
+
+
 def render(rec: dict, *, clear: bool) -> None:
     if clear:
         sys.stdout.write("\x1b[H\x1b[2J")
@@ -100,6 +183,10 @@ def render(rec: dict, *, clear: bool) -> None:
         print(" ".join(cells))
     for label, err in rec.get("errors", {}).items():
         print(f"{label:>16} UNREACHABLE {err}")
+    if rec.get("_show_history"):
+        render_trend(rec)
+    if rec.get("_show_alerts"):
+        render_alerts(rec)
     sys.stdout.flush()
 
 
@@ -118,6 +205,13 @@ def main(argv=None) -> int:
                          "merged) instead of the table")
     ap.add_argument("--record", metavar="PATH",
                     help="append each scrape's summary to this JSONL")
+    ap.add_argument("--history", action="store_true",
+                    help="also scrape metrics_history and render the "
+                         "TREND pane (unicode sparklines for "
+                         "rps/p99/lag/copc off the cluster-merged ring)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="render the fleet-wide ALERTS pane "
+                         "(FIRING/PENDING SLO rules from alerts_active)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="seconds between scrapes (default 2)")
     ap.add_argument("--timeout", type=float, default=10.0,
@@ -128,12 +222,18 @@ def main(argv=None) -> int:
     first = True
     while True:
         targets = build_targets(args)
-        rec = ts.scrape_cluster(targets, timeout=args.timeout)
+        rec = ts.scrape_cluster(targets, timeout=args.timeout,
+                                with_history=args.history)
+        rec["_show_history"] = args.history
+        rec["_show_alerts"] = args.alerts
         if args.record:
             ts.record_jsonl(args.record, rec)
         if args.json:
-            out = {k: rec[k] for k in ("ts", "targets", "summary",
-                                       "cluster", "errors", "merged")}
+            keys = ["ts", "targets", "summary", "cluster", "errors",
+                    "merged", "alerts"]
+            if args.history:
+                keys.append("history")
+            out = {k: rec.get(k) for k in keys}
             print(json.dumps(out, default=str))
         else:
             render(rec, clear=not first and not args.once)

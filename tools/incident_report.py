@@ -1,0 +1,177 @@
+"""incident_report: render an incident flight-recorder bundle.
+
+``core/incident.py`` writes one atomically-renamed JSON bundle per
+incident (FIRING page alert / watchdog stall / replica eject /
+STALE_PRIMARY burst). This tool turns a bundle — or the newest one in
+``FLAGS_incident_dir`` — into a human timeline: what fired, which
+objective was breached and by how much, what the fleet's trend looked
+like going in, which RPCs were in flight, and what the last pass was
+doing.
+
+    python tools/incident_report.py /var/incidents/incident-...json
+    python tools/incident_report.py /var/incidents           # newest
+    python tools/incident_report.py /var/incidents --list
+    python tools/incident_report.py bundle.json --json       # re-dump
+
+Torn captures (``.incident-*.tmp`` — the process died mid-write) are
+never listed or rendered: complete bundles only ever appear via
+``os.replace``, so presence of the final name IS the integrity check.
+
+No jax import — runs anywhere the bundle file is readable.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _fmt_ts(ts) -> str:
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                             time.gmtime(float(ts)))
+    except (TypeError, ValueError):
+        return str(ts)
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def resolve_bundle(path: str) -> str:
+    """A bundle file, or a directory (newest complete bundle wins)."""
+    import os
+    if os.path.isdir(path):
+        from paddlebox_tpu.core.incident import list_bundles
+        bundles = list_bundles(path)
+        if not bundles:
+            raise SystemExit(f"no complete incident bundles in {path}")
+        return bundles[-1]
+    return path
+
+
+def render(bundle: dict) -> None:
+    kind = bundle.get("kind", "?")
+    print(f"INCIDENT  {kind}")
+    print(f"  captured {_fmt_ts(bundle.get('ts'))}  "
+          f"host={bundle.get('host', '?')}  pid={bundle.get('pid', '?')}"
+          f"  seq={bundle.get('seq', '?')}")
+    ctx = bundle.get("context") or {}
+    if ctx:
+        print("  context  " + "  ".join(f"{k}={v}"
+                                        for k, v in sorted(ctx.items())))
+    print()
+
+    # Breached objectives first: the alert section names WHY the
+    # recorder fired (for alert-triggered bundles the triggering rule
+    # rides the context too).
+    alerts = bundle.get("alerts") or []
+    if alerts:
+        print("OBJECTIVES")
+        for a in alerts:
+            vf = a.get("value_fast")
+            vs = a.get("value_slow")
+            th = a.get("threshold")
+
+            def g(v):
+                return f"{v:g}" if isinstance(v, (int, float)) else "-"
+
+            print(f"  {str(a.get('state', '?')).upper():>8} "
+                  f"[{a.get('severity', '?')}] {a.get('name')}: "
+                  f"{a.get('metric')} {a.get('direction', 'above')} "
+                  f"{g(th)} (fast={g(vf)} slow={g(vs)})")
+    else:
+        print("OBJECTIVES: none active at capture")
+    print()
+
+    # Trend going in: last points of the history ring for whatever
+    # moved (nonzero counters / latency windows).
+    hist = bundle.get("history") or {}
+    pts = hist.get("points") or []
+    if pts:
+        span = pts[-1]["ts"] - pts[0]["ts"] if len(pts) > 1 else 0.0
+        print(f"HISTORY  {len(pts)} points over {span:.0f}s "
+              f"(ring {hist.get('label', '?')!r})")
+        last = pts[-1]
+        moved = sorted(last.get("counters") or {},
+                       key=lambda k: -abs(last["counters"][k]))[:8]
+        for name in moved:
+            print(f"  {name:<44} +{last['counters'][name]:g} "
+                  f"in last window")
+        for name, d in sorted((last.get("quantiles") or {}).items()):
+            from paddlebox_tpu.core.quantiles import LogQuantileDigest
+            qs = LogQuantileDigest.from_dict(d).quantiles()
+            p99 = qs.get("p99")
+            if isinstance(p99, (int, float)):
+                print(f"  {name:<44} window p99 {p99:.3f}")
+        print()
+
+    # Last reports: what the trainer/quality plane last said.
+    for key, label in (("pass_report", "LAST PASS"),
+                       ("quality_report", "LAST QUALITY")):
+        rep = bundle.get(key)
+        if isinstance(rep, dict):
+            brief = {k: rep[k] for k in ("kind", "steps", "samples_per_s",
+                                         "loss", "auc", "copc", "alarms")
+                     if k in rep}
+            print(f"{label}  " + json.dumps(brief, default=str))
+    print()
+
+    # The RPC plane at capture: in-flight remotes then pollers — a
+    # stall bundle names the remote it was stuck on.
+    fx = bundle.get("forensics") or {}
+    inflight = fx.get("inflight_rpcs") or []
+    if isinstance(inflight, list) and inflight:
+        print("IN-FLIGHT RPCS")
+        for e in inflight:
+            if isinstance(e, dict):
+                print(f"  {e.get('service')}.{e.get('method')} -> "
+                      f"{e.get('endpoint')} "
+                      f"age={e.get('age_s', 0):.1f}s")
+    pollers = fx.get("rpc_pollers") or []
+    if isinstance(pollers, list) and pollers:
+        print("RPC POLLERS")
+        for p in pollers:
+            if isinstance(p, dict):
+                print(f"  {p.get('service')}@{p.get('endpoint')} "
+                      f"queue={p.get('worker_queue_depth')} "
+                      f"lag={p.get('loop_lag_ms', 0)}ms")
+    tail = fx.get("trace_tail") or []
+    if tail:
+        print(f"TRACE TAIL  last {min(len(tail), 10)} of {len(tail)} "
+              "events")
+        for ev in tail[-10:]:
+            if isinstance(ev, dict):
+                print(f"  {ev.get('name', '?')} "
+                      f"({ev.get('ph', ev.get('kind', '?'))})")
+    sys.stdout.flush()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="bundle JSON file, or a directory "
+                    "(renders the newest complete bundle)")
+    ap.add_argument("--list", action="store_true",
+                    help="list complete bundles in the directory")
+    ap.add_argument("--json", action="store_true",
+                    help="re-dump the bundle as JSON (machine path)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        from paddlebox_tpu.core.incident import list_bundles
+        for b in list_bundles(args.path):
+            print(b)
+        return 0
+    path = resolve_bundle(args.path)
+    bundle = _load(path)
+    if args.json:
+        print(json.dumps(bundle, default=str))
+        return 0
+    print(f"bundle: {path}")
+    render(bundle)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,7 +77,11 @@ LOWER_SUFFIXES = ("_ms", "_s", "_bytes", "idle_frac",
                   "overhead_frac",
                   # model-quality plane (r20): more drift alarms on an
                   # identical workload = the model got less healthy.
-                  "_alarms")
+                  "_alarms",
+                  # fleet health plane: any FIRING SLO alert on a
+                  # healthy bench run is a regression (the bench
+                  # asserts 0; the gate keeps it 0).
+                  "_firing")
 # Exact-name entries (dotted-path last segment).
 HIGHER_NAMES = ("value",)  # bench headline — every config is throughput
 # graftlint summary JSON (python -m tools.graftlint --summary): finding
@@ -278,9 +282,16 @@ def smoke() -> int:
             # delta gates lower-better ("overhead_frac"), the absolute
             # rates higher-better ("_rps"/"_per_s"); scrape count is
             # workload provenance and must NOT gate.
+            # fleet-health-plane keys ride the same telemetry block:
+            # the history-sampler/alert-engine rps cost gates lower-
+            # better ("overhead_frac") and alerts_firing lower-better
+            # ("_firing" — 0 on a healthy bench, any rise gates).
             "telemetry": {"telemetry_overhead_frac": 0.02,
                           "trace_off_rps": 1900.0,
                           "trace_on_rps": 1860.0,
+                          "history_on_rps": 1850.0,
+                          "history_overhead_frac": 0.03,
+                          "alerts_firing": 0,
                           "scrapes": 40},
             # model-quality keys (r20, bench.py online "quality" block):
             # calibration_error gates lower-better (exact-name match —
@@ -357,6 +368,8 @@ def smoke() -> int:
     bad["post_shrink_store_rows"] = 500000    # lifecycle stopped bounding
     bad["stream_passes"] = 2                  # provenance: must NOT gate
     bad["telemetry"]["telemetry_overhead_frac"] = 0.4  # tracing got costly
+    bad["telemetry"]["history_overhead_frac"] = 0.5  # sampler got costly
+    bad["telemetry"]["alerts_firing"] = 2     # bench fleet was unhealthy
     bad["telemetry"]["scrapes"] = 3           # provenance: must NOT gate
     bad["quality"]["calibration_error"]["p99"] = 0.5  # calibration blown
     bad["quality"]["quality_alarms"] = 7              # drift alarms fired
@@ -386,6 +399,8 @@ def smoke() -> int:
                  "passes_per_hour",
                  "post_shrink_store_rows",
                  "telemetry.telemetry_overhead_frac",
+                 "telemetry.history_overhead_frac",
+                 "telemetry.alerts_firing",
                  "quality.calibration_error.p99",
                  "quality.quality_alarms", "quality.slot_coverage",
                  "modes.mux.64kb_o4.calls_per_s",
