@@ -22,6 +22,7 @@ import os
 import sys
 import tempfile
 import time
+from functools import partial
 
 import numpy as np
 
@@ -266,8 +267,8 @@ def _prepopulate_store(trainer, n_keys: int, chunk: int = 10_000_000) -> float:
             eng.store.ensure_rows(keys)
             _tick(f"prepopulate:{lo}")
         # Include device completion in the timing.
-        jax.block_until_ready(eng.store._vals)
-        np.asarray(eng.store._vals[:1, :1])
+        jax.block_until_ready(eng.store._parts)
+        np.asarray(eng.store._parts[0][:1, :1])
         _tick("prepopulate:done")
     else:
         for lo in range(1, n_keys + 1, chunk):
@@ -584,6 +585,12 @@ def bench_deepfm() -> dict:
     mults = sum(a * b for a, b in zip(dims[:-1], dims[1:]))
     flops_per_sample = 3 * 2 * mults
     per_chip = e2e / ndev
+    # HBM residency (ZeRO-sharded dense state + slot-column offload):
+    # measured bytes from the live arrays, not an asserted formula —
+    # *_hbm_bytes keys gate lower-better in perf_gate through the
+    # "_bytes" suffix; the placement strings are provenance (ungated).
+    dense_mem = trainer.dense_memory_stats()
+    store_mem = trainer.engine.groups[0].engine.store.memory_stats()
     return {
         "metric": "deepfm_ctr_e2e_samples_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -653,6 +660,13 @@ def bench_deepfm() -> dict:
         "scale_sparse_grad_by_batch": stats["scale_sparse_grad_by_batch"],
         **({"slot_auc": slot_auc_block}
            if slot_auc_block is not None else {}),
+        "dense/params_hbm_bytes": int(dense_mem["params_hbm_bytes"]),
+        "dense/opt_state_hbm_bytes": int(
+            dense_mem["opt_state_hbm_bytes"]),
+        "table/hot_hbm_bytes": int(store_mem["hot_hbm_bytes"]),
+        "table/slot_hbm_bytes": int(store_mem["slot_hbm_bytes"]),
+        "dense_zero": str(dense_mem["dense_zero"]),
+        "table_slot_placement": str(store_mem["placement"]),
         "n_devices": ndev,
     }
 
@@ -770,22 +784,51 @@ def bench_bert_dp() -> dict:
         cfg = BertConfig()  # BERT-base defaults
     params = init_bert(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(1e-4)
-    opt_state = opt.init(params)
     bs, seq = (2 * ndev, 64) if _SMALL else (8 * ndev, 128)
 
     data_sh = NamedSharding(mesh, P("dp"))
     rep = NamedSharding(mesh, P())
     params = jax.device_put(params, rep)
-    opt_state = jax.device_put(opt_state, rep)
 
     def loss_fn(p, tokens, targets, mask):
         return bert_mlm_loss(p, cfg, tokens, targets, mask)
 
-    @jax.jit
-    def step(p, s, tokens, targets, mask):
-        loss, g = jax.value_and_grad(loss_fn)(p, tokens, targets, mask)
-        updates, s = opt.update(g, s, p)
-        return optax.apply_updates(p, updates), s, loss
+    # FLAGS_dense_zero applies to the dense workloads exactly as to the
+    # CTR trainer: "shard" places adamw moments ZeRO-1 over dp (each
+    # chip stores 1/dp of every large leaf; params output pinned
+    # replicated so the sharded state can't leak into p+u), "offload"
+    # keeps them in host memory between steps via OffloadedOptimizer.
+    from paddlebox_tpu.parallel import zero as zero_lib
+    dense_zero = str(flags.flag("dense_zero"))
+    zero_min = int(flags.flag("dense_zero_min_size"))
+    if dense_zero == "offload":
+        off_tx = zero_lib.OffloadedOptimizer(
+            opt, mesh, axis="dp", min_size=zero_min)
+        opt_state = off_tx.init(params)
+        grad_step = jax.jit(jax.value_and_grad(loss_fn))
+
+        def step(p, s, tokens, targets, mask):
+            loss, g = grad_step(p, tokens, targets, mask)
+            p, s = off_tx.update_apply(g, s, p)
+            return p, s, loss
+    else:
+        opt_state = opt.init(params)
+        if dense_zero == "shard":
+            opt_sh = zero_lib.zero_shardings(
+                opt_state, mesh, axis="dp", min_size=zero_min)
+            opt_state = jax.device_put(opt_state, opt_sh)
+            jit_kw = {"out_shardings": (
+                jax.tree.map(lambda _: rep, params), opt_sh, rep)}
+        else:
+            opt_state = jax.device_put(opt_state, rep)
+            jit_kw = {}
+
+        @partial(jax.jit, **jit_kw)
+        def step(p, s, tokens, targets, mask):
+            loss, g = jax.value_and_grad(loss_fn)(p, tokens, targets,
+                                                  mask)
+            updates, s = opt.update(g, s, p)
+            return optax.apply_updates(p, updates), s, loss
 
     rng = np.random.default_rng(0)
     tokens = jax.device_put(jnp.asarray(
@@ -809,6 +852,10 @@ def bench_bert_dp() -> dict:
     tps = n * bs * seq / dt
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
+    # Measured per-device HBM residency of the dense state (what
+    # FLAGS_dense_zero exists to shrink) — not a formula.
+    params_hbm = zero_lib.tree_hbm_bytes_per_device(params)
+    opt_hbm = zero_lib.tree_hbm_bytes_per_device(opt_state)
     return {
         "metric": "bert_base_dp_tokens_per_sec",
         "value": round(tps, 1),
@@ -818,6 +865,9 @@ def bench_bert_dp() -> dict:
         "batch_size": bs,
         "seq_len": seq,
         "n_params": n_params,
+        "dense/params_hbm_bytes": int(params_hbm),
+        "dense/opt_state_hbm_bytes": int(opt_hbm),
+        "dense_zero": dense_zero,
         # 6ND estimate over ALL chips -> divide by ndev for per-chip MFU.
         "achieved_mfu": _mfu(6.0 * n_params * tps / ndev),
     }
@@ -847,8 +897,42 @@ def bench_gpt() -> dict:
     mesh = build_mesh(HybridTopology(dp=ndev))
     params, specs = init_gpt(jax.random.PRNGKey(0), cfg, pp_stages=1)
     opt = optax.adafactor(1e-3)
-    step = make_gpt_train_step(cfg, mesh, specs, opt, num_microbatches=1)
     opt_state = opt.init(params)
+
+    # Same FLAGS_dense_zero wiring as bert_dp: "shard" ZeRO-1-places the
+    # adafactor state over dp (params pinned replicated through the
+    # step's out_shardings), "offload" keeps it host-resident between
+    # steps; "off" is the replicated baseline.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddlebox_tpu.parallel import zero as zero_lib
+    dense_zero = str(flags.flag("dense_zero"))
+    zero_min = int(flags.flag("dense_zero_min_size"))
+    rep = NamedSharding(mesh, P())
+    if dense_zero == "offload":
+        from paddlebox_tpu.models.gpt import gpt_loss_fn
+        params = jax.device_put(params, rep)
+        off_tx = zero_lib.OffloadedOptimizer(
+            opt, mesh, axis="dp", min_size=zero_min)
+        opt_state = off_tx.init(params)
+        vg = jax.jit(jax.value_and_grad(
+            gpt_loss_fn(cfg, mesh, specs, num_microbatches=1)))
+
+        def step(p, s, tokens, targets):
+            loss, g = vg(p, tokens, targets)
+            p, s = off_tx.update_apply(g, s, p)
+            return p, s, loss
+    elif dense_zero == "shard":
+        params = jax.device_put(params, rep)
+        opt_sh = zero_lib.zero_shardings(
+            opt_state, mesh, axis="dp", min_size=zero_min)
+        opt_state = jax.device_put(opt_state, opt_sh)
+        step = make_gpt_train_step(
+            cfg, mesh, specs, opt, num_microbatches=1,
+            out_shardings=(jax.tree.map(lambda _: rep, params),
+                           opt_sh, rep))
+    else:
+        step = make_gpt_train_step(cfg, mesh, specs, opt,
+                                   num_microbatches=1)
 
     bs, seq = (2 * ndev, 128) if _SMALL else (4 * ndev, 1024)
     rng = np.random.default_rng(0)
@@ -869,6 +953,8 @@ def bench_gpt() -> dict:
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
     flops = 6.0 * n_params * tps  # standard 6ND estimate
+    params_hbm = zero_lib.tree_hbm_bytes_per_device(params)
+    opt_hbm = zero_lib.tree_hbm_bytes_per_device(opt_state)
     return {
         "metric": "gpt_tokens_per_sec",
         "value": round(tps, 1),
@@ -876,6 +962,9 @@ def bench_gpt() -> dict:
         "vs_baseline": _vs("gpt", tps),
         "n_devices": ndev,
         "n_params": n_params,
+        "dense/params_hbm_bytes": int(params_hbm),
+        "dense/opt_state_hbm_bytes": int(opt_hbm),
+        "dense_zero": dense_zero,
         "achieved_tflops": round(flops / 1e12, 2),
         "achieved_mfu": _mfu(flops / ndev),
     }

@@ -622,6 +622,40 @@ define_flag("dense_allreduce_dtype", "f32",
             "dequant-accumulate -> gather; per-block scales via "
             "embedding_quant_block). Under a hierarchical ici+dcn "
             "mesh only the DCN hop narrows; the ICI hop stays f32")
+define_flag("dense_zero", "off",
+            "ZeRO-1/2 placement of the trainer's dense optimizer state "
+            "(parallel/zero.py over the data-parallel axis): 'off' "
+            "(default) replicates it on every device (the pre-ZeRO "
+            "layout); 'shard' places each state leaf with zero_shardings "
+            "and the step updates only the local param shard before an "
+            "all-gather — f32 math is bit-identical to replicated while "
+            "per-device state HBM drops to ~1/dp; 'offload' routes the "
+            "update through OffloadedOptimizer so the state lives in "
+            "host (pinned_host) memory between steps — HBM holds ~zero "
+            "optimizer bytes at the cost of host-link traffic per step "
+            "(requires dense_sync_mode='step'). 'shard' degrades to "
+            "'off' under dense_sync_mode='kstep': k-step state is "
+            "worker-local (intentionally divergent), so there is no "
+            "redundant replica to shard away")
+define_flag("dense_zero_min_size", 2048,
+            "smallest dense leaf (elements) that FLAGS_dense_zero "
+            "shards/offloads; smaller leaves stay replicated in HBM "
+            "(gather latency and per-leaf transfer overhead would "
+            "dominate their few bytes). Lower it to 0 to shard "
+            "everything — what the parity tests do on toy models")
+define_flag("table_slot_placement", "fused",
+            "column layout of DeviceFeatureStore's persistent HBM "
+            "table: 'fused' (default) keeps one [rows, D+3+Ke+Kw] "
+            "array (the pre-split layout); 'split' carves the "
+            "emb_state/w_state optimizer-slot columns into a sibling "
+            "[rows, Ke+Kw] array so the hot array is exactly (D+3)*4 "
+            "bytes/row — serving-tier capacity bounded by value bytes; "
+            "'host' additionally pins the slot array to host memory "
+            "(pinned_host via zero_shardings memory_kind) with "
+            "transient HBM crossings around the pass-boundary "
+            "push/pull. All three serve bit-identical payloads and "
+            "write the same checkpoint/wire format — a checkpoint "
+            "saved under one placement loads under any other")
 define_flag("reshard_chunk_rows", 65536,
             "row window of the bounded-memory reshard/repair COPY walk "
             "(multihost/reshard.py + replica snapshots): pull_range / "

@@ -65,15 +65,29 @@ def _pow2(n: int) -> int:
 # ---------------------------------------------------------------------------
 # Cached jitted device programs. Keyed by static shape params so
 # steady-state passes (stable pow2 sizes) never recompile.
+#
+# Every program operates on a TUPLE of column-part arrays (`widths` is
+# the per-part column split of the fused record W). `fused` placement is
+# the 1-tuple (W,) — byte-identical programs to the pre-split store;
+# `split`/`host` carve the optimizer-slot columns into a sibling part.
+# Gathers serve each part at the same indices and concatenate into the
+# FUSED pass block (concat-then-gather == gather-then-concat, so the
+# PassTable the trainer sees is bit-identical across placements);
+# scatters split the fused block's columns back. The index plumbing and
+# the collective count per boundary are unchanged — one request
+# all_to_all, one fused-width reply.
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=64)
-def _grow_fn(s: int, c_old: int, c_new: int, w: int):
-    def grow(v):
+def _grow_fn(s: int, c_old: int, c_new: int, widths: Tuple[int, ...]):
+    def grow_one(v, w):
         v3 = v.reshape(s, c_old + 1, w)
         out = jnp.zeros((s, c_new + 1, w), v.dtype)
         out = out.at[:, :c_old].set(v3[:, :c_old])
         return out.reshape(s * (c_new + 1), w)
+
+    def grow(vs):
+        return tuple(grow_one(v, w) for v, w in zip(vs, widths))
     return jax.jit(grow)
 
 
@@ -95,34 +109,57 @@ def _u32_uniform_device(keys_lo: jax.Array, dim: int, seed32: int,
             * jnp.float32(scale)).astype(jnp.float32)
 
 
+def _split_cols(block: jax.Array, widths: Tuple[int, ...]):
+    """Column-split a fused [n, W] block into the part widths."""
+    out, off = [], 0
+    for w in widths:
+        out.append(lax.slice_in_dim(block, off, off + w, axis=1))
+        off += w
+    return out
+
+
 @functools.lru_cache(maxsize=64)
-def _append_fn_local(w: int, cap: int, dim: int, seed32: int, scale: float):
+def _append_fn_local(widths: Tuple[int, ...], cap: int, dim: int,
+                     seed32: int, scale: float):
     """Masked dynamic-update-slice append of cnt (<= cap) NEW rows at slot
     `start`: rows are BUILT ON DEVICE from 4-byte key hashes (emb columns
     via the shared deterministic init; the state tail from a constant
     template row) — the host transfers cap*4 bytes, not cap*W*4."""
-    def upd(v, keys_lo, template, start, cnt):
+    def upd(vs, keys_lo, template, start, cnt):
         emb = _u32_uniform_device(keys_lo, dim, seed32, scale)
-        rows = jnp.broadcast_to(template, (cap, w))
-        rows = jnp.concatenate([emb, rows[:, dim:]], axis=1)
-        cur = lax.dynamic_slice(v, (start, 0), (cap, w))
         keep = (jnp.arange(cap) < cnt)[:, None]
-        return lax.dynamic_update_slice(v, jnp.where(keep, rows, cur),
-                                        (start, 0))
+        out, off = [], 0
+        for v, w in zip(vs, widths):
+            rows = jnp.broadcast_to(template[off:off + w], (cap, w))
+            if off == 0:
+                rows = jnp.concatenate([emb, rows[:, dim:]], axis=1)
+            cur = lax.dynamic_slice(v, (start, 0), (cap, w))
+            out.append(lax.dynamic_update_slice(
+                v, jnp.where(keep, rows, cur), (start, 0)))
+            off += w
+        return tuple(out)
     return jax.jit(upd, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=64)
-def _append_fn_sharded(mesh: Mesh, axis: str, w: int, cap: int, dim: int,
-                       seed32: int, scale: float):
-    def body(v, keys_lo, template, start, cnt):
+def _append_fn_sharded(mesh: Mesh, axis: str, widths: Tuple[int, ...],
+                       cap: int, dim: int, seed32: int, scale: float):
+    wsum = sum(widths)
+
+    def body(vs, keys_lo, template, start, cnt):
         emb = _u32_uniform_device(keys_lo.reshape(cap), dim, seed32, scale)
-        rows = jnp.broadcast_to(template.reshape(1, w), (cap, w))
-        rows = jnp.concatenate([emb, rows[:, dim:]], axis=1)
-        cur = lax.dynamic_slice(v, (start[0], 0), (cap, w))
         keep = (jnp.arange(cap) < cnt[0])[:, None]
-        return lax.dynamic_update_slice(v, jnp.where(keep, rows, cur),
-                                        (start[0], 0))
+        tmpl = template.reshape(1, wsum)
+        out, off = [], 0
+        for v, w in zip(vs, widths):
+            rows = jnp.broadcast_to(tmpl[:, off:off + w], (cap, w))
+            if off == 0:
+                rows = jnp.concatenate([emb, rows[:, dim:]], axis=1)
+            cur = lax.dynamic_slice(v, (start[0], 0), (cap, w))
+            out.append(lax.dynamic_update_slice(
+                v, jnp.where(keep, rows, cur), (start[0], 0)))
+            off += w
+        return tuple(out)
     sm = jax.shard_map(body, mesh=mesh,
                        in_specs=(P(axis), P(axis), P(axis), P(axis),
                                  P(axis)),
@@ -131,44 +168,55 @@ def _append_fn_sharded(mesh: Mesh, axis: str, w: int, cap: int, dim: int,
 
 
 @functools.lru_cache(maxsize=64)
-def _gather_fn_local(w: int, rps: int):
-    """v[idx] into a pass block [rps+1, w]. idx == scratch (the store's
-    last row) marks padding/missing lanes — they read zero. init_idx/
-    init_vals overlay host-computed init records onto missing pass rows
-    (read-only pulls; pads point init_idx at the trash row, re-zeroed)."""
-    def gather(v, idx, init_idx, init_vals):
-        scratch = v.shape[0] - 1
-        picked = jnp.where((idx == scratch)[:, None], 0.0, v[idx])
-        block = jnp.concatenate([picked, jnp.zeros((1, w), v.dtype)])
+def _gather_fn_local(widths: Tuple[int, ...], rps: int):
+    """vs[*][idx] into a FUSED pass block [rps+1, W]. idx == scratch (the
+    store's last row) marks padding/missing lanes — they read zero.
+    init_idx/init_vals overlay host-computed init records onto missing
+    pass rows (read-only pulls; pads point init_idx at the trash row,
+    re-zeroed)."""
+    w = sum(widths)
+
+    def gather(vs, idx, init_idx, init_vals):
+        scratch = vs[0].shape[0] - 1
+        miss = (idx == scratch)[:, None]
+        picked = jnp.concatenate(
+            [jnp.where(miss, 0.0, v[idx]) for v in vs], axis=1)
+        block = jnp.concatenate([picked, jnp.zeros((1, w), picked.dtype)])
         block = block.at[init_idx].set(init_vals)
         return block.at[rps].set(0.0)
     return jax.jit(gather)
 
 
 @functools.lru_cache(maxsize=64)
-def _scatter_fn_local(w: int, rps: int):
-    """Write pass block rows back into store: v[idx[i]] = block[i] for
-    i < rps (pads point idx at the scratch slot)."""
-    def scatter(v, block, idx):
-        return v.at[idx].set(block[:rps])
+def _scatter_fn_local(widths: Tuple[int, ...], rps: int):
+    """Write pass block rows back into store parts: vs[p][idx[i]] =
+    block[i, part p's columns] for i < rps (pads point idx at the
+    scratch slot)."""
+    def scatter(vs, block, idx):
+        parts = _split_cols(block[:rps], widths)
+        return tuple(v.at[idx].set(b) for v, b in zip(vs, parts))
     return jax.jit(scatter, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=64)
-def _gather_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int, w: int,
-                       rps: int, store_cap: int):
-    def body(v, rq, pl, init_idx, init_vals):
+def _gather_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int,
+                       widths: Tuple[int, ...], rps: int, store_cap: int):
+    w = sum(widths)
+
+    def body(vs, rq, pl, init_idx, init_vals):
         rq2 = rq.reshape(s, cap)
         # rq2[s2, c]: slots I request from store-shard s2. Exchange so
         # each store shard receives its requests, serve, exchange back.
         recv = lax.all_to_all(rq2, axis, split_axis=0, concat_axis=0,
                               tiled=True).reshape(s, cap)
         # Scratch-slot requests (padding / missing keys) serve zeros.
-        served = jnp.where((recv == store_cap)[..., None], 0.0, v[recv])
+        miss = (recv == store_cap)[..., None]
+        served = jnp.concatenate(
+            [jnp.where(miss, 0.0, v[recv]) for v in vs], axis=-1)
         reply = lax.all_to_all(
             served.reshape(s * cap, w), axis, split_axis=0,
             concat_axis=0, tiled=True).reshape(s * cap, w)
-        block = jnp.zeros((rps + 1, w), v.dtype)
+        block = jnp.zeros((rps + 1, w), served.dtype)
         block = block.at[pl.reshape(s * cap)].set(reply)
         # Read-only pulls: overlay init records for missing keys.
         block = block.at[init_idx.reshape(-1)].set(init_vals)
@@ -182,8 +230,11 @@ def _gather_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int, w: int,
 
 
 @functools.lru_cache(maxsize=64)
-def _scatter_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int, w: int):
-    def body(v, b, sr, ds):
+def _scatter_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int,
+                        widths: Tuple[int, ...]):
+    w = sum(widths)
+
+    def body(vs, b, sr, ds):
         sr2 = sr.reshape(s, cap)
         payload = b[sr2]                              # [s, cap, w]
         sent = lax.all_to_all(
@@ -191,8 +242,9 @@ def _scatter_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int, w: int):
             concat_axis=0, tiled=True)
         recv_dst = lax.all_to_all(ds.reshape(s, cap), axis, split_axis=0,
                                   concat_axis=0, tiled=True)
-        return v.at[recv_dst.reshape(s * cap)].set(
-            sent.reshape(s * cap, w))
+        idx = recv_dst.reshape(s * cap)
+        parts = _split_cols(sent.reshape(s * cap, w), widths)
+        return tuple(v.at[idx].set(p) for v, p in zip(vs, parts))
     sm = jax.shard_map(body, mesh=mesh,
                        in_specs=(P(axis), P(axis), P(axis), P(axis)),
                        out_specs=P(axis), check_vma=False)
@@ -200,26 +252,31 @@ def _scatter_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int, w: int):
 
 
 @functools.lru_cache(maxsize=64)
-def _merge_fn_local(w: int, rps: int):
-    """Late half of the split pass build: overlay store rows v[idx[i]]
+def _merge_fn_local(widths: Tuple[int, ...], rps: int):
+    """Late half of the split pass build: overlay store rows vs[*][idx[i]]
     at block[place[i]] — the shared-key remainder gather AFTER the
     previous pass's write-back, merged into the early-built block. Pads
     point idx at the scratch row and place at the trash row (re-zeroed),
     so the early-gathered rows elsewhere are untouched."""
-    def merge(block, v, idx, place):
-        out = block.at[place].set(v[idx])
+    def merge(block, vs, idx, place):
+        picked = jnp.concatenate([v[idx] for v in vs], axis=1)
+        out = block.at[place].set(picked)
         return out.at[rps].set(0.0)
     return jax.jit(merge, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=64)
-def _merge_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int, w: int,
-                      rps: int, store_cap: int):
-    def body(block, v, rq, pl):
+def _merge_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int,
+                      widths: Tuple[int, ...], rps: int, store_cap: int):
+    w = sum(widths)
+
+    def body(block, vs, rq, pl):
         rq2 = rq.reshape(s, cap)
         recv = lax.all_to_all(rq2, axis, split_axis=0, concat_axis=0,
                               tiled=True).reshape(s, cap)
-        served = jnp.where((recv == store_cap)[..., None], 0.0, v[recv])
+        miss = (recv == store_cap)[..., None]
+        served = jnp.concatenate(
+            [jnp.where(miss, 0.0, v[recv]) for v in vs], axis=-1)
         reply = lax.all_to_all(
             served.reshape(s * cap, w), axis, split_axis=0,
             concat_axis=0, tiled=True).reshape(s * cap, w)
@@ -232,25 +289,33 @@ def _merge_fn_sharded(mesh: Mesh, axis: str, s: int, cap: int, w: int,
 
 
 @functools.lru_cache(maxsize=64)
-def _fused_boundary_fn_local(w: int, rps_prev: int, rps_next: int):
+def _fused_boundary_fn_local(widths: Tuple[int, ...], rps_prev: int,
+                             rps_next: int):
     """ONE device program for the pass boundary (FLAGS_pass_boundary_
     fuse): the previous pass's EndPass scatter followed by the next
     pass's shared-remainder gather — the gather reads the POST-scatter
     store, so shared keys observe the write-back exactly as the serial
-    sequencing guarantees, but the host pays one dispatch, not two."""
-    def fused(v, prev_block, prev_idx, next_block, idx, place):
-        v = v.at[prev_idx].set(prev_block[:rps_prev])
-        nb = next_block.at[place].set(v[idx])
-        return v, nb.at[rps_next].set(0.0)
+    sequencing guarantees, but the host pays one dispatch, not two.
+    Under split placement BOTH parts scatter and serve inside this same
+    dispatch — the slot columns update in lockstep with the values."""
+    def fused(vs, prev_block, prev_idx, next_block, idx, place):
+        parts = _split_cols(prev_block[:rps_prev], widths)
+        vs = tuple(v.at[prev_idx].set(p) for v, p in zip(vs, parts))
+        picked = jnp.concatenate([v[idx] for v in vs], axis=1)
+        nb = next_block.at[place].set(picked)
+        return vs, nb.at[rps_next].set(0.0)
     return jax.jit(fused, donate_argnums=(0, 3))
 
 
 @functools.lru_cache(maxsize=64)
 def _fused_boundary_fn_sharded(mesh: Mesh, axis: str, s: int,
-                               cap_prev: int, cap_next: int, w: int,
+                               cap_prev: int, cap_next: int,
+                               widths: Tuple[int, ...],
                                rps_prev: int, rps_next: int,
                                store_cap: int):
-    def body(v, b_prev, sr, ds, b_next, rq, pl):
+    w = sum(widths)
+
+    def body(vs, b_prev, sr, ds, b_next, rq, pl):
         # EndPass scatter leg (the _scatter_fn_sharded structure).
         payload = b_prev[sr.reshape(s, cap_prev)]
         sent = lax.all_to_all(
@@ -258,19 +323,22 @@ def _fused_boundary_fn_sharded(mesh: Mesh, axis: str, s: int,
             concat_axis=0, tiled=True)
         recv_dst = lax.all_to_all(ds.reshape(s, cap_prev), axis,
                                   split_axis=0, concat_axis=0, tiled=True)
-        v = v.at[recv_dst.reshape(s * cap_prev)].set(
-            sent.reshape(s * cap_prev, w))
+        idx_w = recv_dst.reshape(s * cap_prev)
+        parts = _split_cols(sent.reshape(s * cap_prev, w), widths)
+        vs = tuple(v.at[idx_w].set(p) for v, p in zip(vs, parts))
         # Remainder-gather leg (the _merge_fn_sharded structure) over
         # the post-scatter values.
         recv = lax.all_to_all(rq.reshape(s, cap_next), axis, split_axis=0,
                               concat_axis=0, tiled=True).reshape(s,
                                                                  cap_next)
-        served = jnp.where((recv == store_cap)[..., None], 0.0, v[recv])
+        miss = (recv == store_cap)[..., None]
+        served = jnp.concatenate(
+            [jnp.where(miss, 0.0, v[recv]) for v in vs], axis=-1)
         reply = lax.all_to_all(
             served.reshape(s * cap_next, w), axis, split_axis=0,
             concat_axis=0, tiled=True).reshape(s * cap_next, w)
         nb = b_next.at[pl.reshape(s * cap_next)].set(reply)
-        return v, nb.at[rps_next].set(0.0)
+        return vs, nb.at[rps_next].set(0.0)
     sm = jax.shard_map(body, mesh=mesh,
                        in_specs=(P(axis),) * 7,
                        out_specs=(P(axis), P(axis)), check_vma=False)
@@ -294,6 +362,7 @@ class DeviceFeatureStore:
                  table_axis: str = "dp", seed: int = 0,
                  capacity_hint: int = 0):
         self.config = config
+        from paddlebox_tpu.core import flags
         from paddlebox_tpu.embedding.optimizers import make_sparse_optimizer
         self.opt = make_sparse_optimizer(config)
         self.dim, self.ke, self.kw = table_widths(config)
@@ -304,15 +373,35 @@ class DeviceFeatureStore:
                            if mesh is not None else 1)
         self._sharding = (NamedSharding(mesh, P(table_axis))
                           if mesh is not None else None)
+        # FLAGS_table_slot_placement: where the per-row optimizer-slot
+        # columns live. 'fused' is the historic single [rows, W] record;
+        # 'split' carves emb_state/w_state into a sibling [rows, Ke+Kw]
+        # part (hot array holds exactly (D+3)*4 bytes/row); 'host'
+        # additionally pins that part to host memory — HBM then holds
+        # values, not values×slots, with transient crossings around the
+        # boundary programs. An optimizer without slot columns has
+        # nothing to carve, so it degrades to fused.
+        placement = str(flags.flag("table_slot_placement"))
+        if placement not in ("fused", "split", "host"):
+            raise ValueError("table_slot_placement must be "
+                             f"fused|split|host, got {placement!r}")
+        slot_w = self.ke + self.kw
+        if slot_w == 0:
+            placement = "fused"
+        self.placement = placement
+        self._widths = ((self.width,) if placement == "fused"
+                        else (self.dim + 3, slot_w))
+        self._part_shardings = self._resolve_part_shardings()
         self._index = native_store.KeyIndex()
         if capacity_hint:
             self._index.reserve(capacity_hint)
         s = self.num_shards
         self._cap = _pow2(max(1 << 10, -(-int(capacity_hint) // s)))
-        self._vals = self._place(
-            jnp.zeros((s * (self._cap + 1), self.width), jnp.float32))
+        self._parts = self._place_parts(tuple(
+            jnp.zeros((s * (self._cap + 1), w), jnp.float32)
+            for w in self._widths))
         self._seed = int(seed)
-        # Serializes mutations of (_index, _vals, _cap, _dirty_parts).
+        # Serializes mutations of (_index, _parts, _cap, _dirty_parts).
         # NOT reentrant: public methods lock, _*_locked helpers assume it.
         self._lock = threading.Lock()
         self._dirty_parts: List[np.ndarray] = []
@@ -324,14 +413,88 @@ class DeviceFeatureStore:
 
     # -- plumbing ----------------------------------------------------------
 
+    def _resolve_part_shardings(self) -> Tuple:
+        """Persistent placement per part: device sharding for the hot
+        part; 'host' pins the slot part to the backend's host memory
+        kind (pinned_host on TPU; CPU backends expose unpinned_host,
+        which IS their default memory — the placement is then a no-op
+        byte-wise but exercises the same code path)."""
+        if self.placement != "host":
+            return tuple(self._sharding for _ in self._widths)
+        from jax.sharding import SingleDeviceSharding
+        from paddlebox_tpu.parallel.zero import _resolve_host_kind
+        if self.mesh is not None:
+            kind = _resolve_host_kind(self.mesh, "pinned_host")
+            slot_sh = NamedSharding(self.mesh, P(self.axis),
+                                    memory_kind=kind)
+            return (self._sharding, slot_sh)
+        dev = jax.devices()[0]
+        try:
+            kinds = {m.kind for m in dev.addressable_memories()}
+        except Exception:
+            kinds = set()
+        kind = ("pinned_host" if "pinned_host" in kinds
+                else "unpinned_host" if "unpinned_host" in kinds else None)
+        slot_sh = (SingleDeviceSharding(dev, memory_kind=kind)
+                   if kind is not None else None)
+        return (None, slot_sh)
+
     def _place(self, arr):
         if self._sharding is not None:
             return jax.device_put(arr, self._sharding)
         return arr
 
+    def _place_parts(self, parts) -> Tuple:
+        return tuple(
+            jax.device_put(p, sh) if sh is not None else p
+            for p, sh in zip(parts, self._part_shardings))
+
+    def _compute_parts(self) -> Tuple:
+        """Parts staged for a jitted device program. 'host' placement
+        pays its transient HBM crossing here (slot part host -> device);
+        other placements pass through untouched."""
+        if self.placement != "host":
+            return self._parts
+        dev_sh = (self._sharding if self._sharding is not None
+                  else jax.devices()[0])
+        return (self._parts[0],) + tuple(
+            jax.device_put(p, dev_sh) for p in self._parts[1:])
+
+    def _settle_parts(self, parts) -> Tuple:
+        """Inverse of :meth:`_compute_parts`: stream mutated parts back
+        to their persistent placement (slot part device -> host)."""
+        if self.placement != "host":
+            return tuple(parts)
+        return (parts[0],) + tuple(
+            jax.device_put(p, sh)
+            for p, sh in zip(parts[1:], self._part_shardings[1:]))
+
     @property
     def num_features(self) -> int:
         return self._index.size
+
+    def memory_stats(self) -> Dict[str, object]:
+        """Measured per-device memory bytes of the live store arrays
+        (actual shardings + memory kinds, not flag arithmetic), split
+        hot vs slot columns; also lands the table/*_hbm_bytes gauges the
+        benches record. Under 'fused' the slot share is the column
+        fraction of the one array; under 'host' on TPU the slot part is
+        in host memory and measures 0 HBM bytes."""
+        from paddlebox_tpu.parallel.zero import tree_hbm_bytes_per_device
+        with self._lock:
+            parts = self._parts
+        if self.placement == "fused":
+            total = tree_hbm_bytes_per_device(parts[0])
+            hot = total * (self.dim + 3) // self.width
+            slot = total - hot
+        else:
+            hot = tree_hbm_bytes_per_device(parts[0])
+            slot = tree_hbm_bytes_per_device(parts[1:])
+        stats = {"hot_hbm_bytes": int(hot), "slot_hbm_bytes": int(slot),
+                 "placement": self.placement}
+        monitor.set_gauge("table/hot_hbm_bytes", float(hot))
+        monitor.set_gauge("table/slot_hbm_bytes", float(slot))
+        return stats
 
     def _ensure_capacity_locked(self, total_rows: int) -> None:
         s = self.num_shards
@@ -343,8 +506,9 @@ class DeviceFeatureStore:
             c_new *= 2
         log.vlog(1, "device store grow: %d -> %d slots/shard",
                  self._cap, c_new)
-        self._vals = self._place(
-            _grow_fn(s, self._cap, c_new, self.width)(self._vals))
+        self._parts = self._place_parts(
+            _grow_fn(s, self._cap, c_new, self._widths)(
+                self._compute_parts()))
         self._cap = c_new
 
     def _host_init_fused(self, keys: np.ndarray) -> np.ndarray:
@@ -425,9 +589,10 @@ class DeviceFeatureStore:
             self._ensure_capacity_locked((base + cap) * s)
             keys_pad = np.zeros((cap,), np.uint32)
             keys_pad[:n_new] = lo
-            self._vals = _append_fn_local(w, cap, self.dim, seed32, scale)(
-                self._vals, jnp.asarray(keys_pad),
-                jnp.asarray(self._template_row), base, n_new)
+            self._parts = self._settle_parts(_append_fn_local(
+                self._widths, cap, self.dim, seed32, scale)(
+                self._compute_parts(), jnp.asarray(keys_pad),
+                jnp.asarray(self._template_row), base, n_new))
             return
         rows = np.arange(base, base + n_new)
         shard = rows % s
@@ -448,9 +613,10 @@ class DeviceFeatureStore:
             self._sharding)
         st = jax.device_put(starts, self._sharding)
         cn = jax.device_put(counts.astype(np.int32), self._sharding)
-        self._vals = _append_fn_sharded(self.mesh, self.axis, w, cap,
-                                        self.dim, seed32, scale)(
-            self._vals, kd, tmpl, st, cn)
+        self._parts = self._settle_parts(_append_fn_sharded(
+            self.mesh, self.axis, self._widths, cap,
+            self.dim, seed32, scale)(
+            self._compute_parts(), kd, tmpl, st, cn))
 
     # -- pass build / write-back (the hot per-pass surface) ----------------
 
@@ -544,7 +710,6 @@ class DeviceFeatureStore:
             n_prev = k.shape[0]
             sel_pos = np.flatnonzero(np.asarray(next_select, bool))
             s = self.num_shards
-            w = self.width
             rps_p = prev_table.rows_per_shard
             sp_p = prev_table.num_shards
             rps_n = next_table.rows_per_shard
@@ -562,11 +727,12 @@ class DeviceFeatureStore:
                 if m:
                     idx_n[:m] = self._dev_idx(next_rows[sel_pos])
                     place[:m] = sel_pos
-                self._vals, merged = _fused_boundary_fn_local(
-                    w, rps_p, rps_n)(
-                    self._vals, prev_table.vals,
+                parts, merged = _fused_boundary_fn_local(
+                    self._widths, rps_p, rps_n)(
+                    self._compute_parts(), prev_table.vals,
                     jnp.asarray(idx_p, jnp.int32), next_table.vals,
                     jnp.asarray(idx_n, jnp.int32), jnp.asarray(place))
+                self._parts = self._settle_parts(parts)
             else:
                 if s != sp_p or s != sp_n:
                     raise ValueError(
@@ -589,11 +755,12 @@ class DeviceFeatureStore:
                 pl_d = jax.device_put(
                     jnp.asarray(place.reshape(sp_n, s * cap_n)),
                     self._sharding)
-                self._vals, merged = _fused_boundary_fn_sharded(
-                    self.mesh, self.axis, s, cap_p, cap_n, w, rps_p,
-                    rps_n, self._cap)(
-                    self._vals, prev_table.vals, src_d, dst_d,
+                parts, merged = _fused_boundary_fn_sharded(
+                    self.mesh, self.axis, s, cap_p, cap_n, self._widths,
+                    rps_p, rps_n, self._cap)(
+                    self._compute_parts(), prev_table.vals, src_d, dst_d,
                     next_table.vals, req_d, pl_d)
+                self._parts = self._settle_parts(parts)
             self._dirty_parts.append(k.copy())
             self._unseen[prev_rows] = 0
             monitor.add("device_store/pushed_keys", n_prev)
@@ -632,7 +799,6 @@ class DeviceFeatureStore:
                            sel_pos: np.ndarray, rps: int,
                            sp: int) -> jax.Array:
         s = self.num_shards
-        w = self.width
         m = sel_pos.size
         if s == 1 and sp == 1:
             cap_m = _pow2(max(m, 1))
@@ -642,9 +808,9 @@ class DeviceFeatureStore:
             if m:
                 idx[:m] = self._dev_idx(rows[sel_pos])
                 place[:m] = sel_pos
-            return _merge_fn_local(w, rps)(
-                block_vals, self._vals, jnp.asarray(idx, jnp.int32),
-                jnp.asarray(place))
+            return _merge_fn_local(self._widths, rps)(
+                block_vals, self._compute_parts(),
+                jnp.asarray(idx, jnp.int32), jnp.asarray(place))
         if s != sp:
             raise ValueError("pass shards must equal store shards")
         req, place, cap = self._bucket_selected(rows, sel_pos, rps, sp)
@@ -652,9 +818,9 @@ class DeviceFeatureStore:
             jnp.asarray(req.reshape(sp, s * cap)), self._sharding)
         pl_d = jax.device_put(
             jnp.asarray(place.reshape(sp, s * cap)), self._sharding)
-        return _merge_fn_sharded(self.mesh, self.axis, s, cap, w, rps,
-                                 self._cap)(
-            block_vals, self._vals, req_d, pl_d)
+        return _merge_fn_sharded(self.mesh, self.axis, s, cap,
+                                 self._widths, rps, self._cap)(
+            block_vals, self._compute_parts(), req_d, pl_d)
 
     def _pull_pass_table_locked(self, pass_keys_sorted: np.ndarray,
                                 num_pass_shards: int, *,
@@ -690,7 +856,7 @@ class DeviceFeatureStore:
             if n == 0:
                 return
             monitor.add("device_store/boundary_progs", 1)
-            self._vals = self._scatter_pass_locked(
+            self._parts = self._scatter_pass_locked(
                 table.vals, rows, n, table.rows_per_shard,
                 table.num_shards)
             self._dirty_parts.append(k.copy())
@@ -752,8 +918,8 @@ class DeviceFeatureStore:
             if n_miss:
                 init_idx[:n_miss] = missing
                 init_vals[:n_miss] = init
-            return _gather_fn_local(w, rps)(
-                self._vals, jnp.asarray(idx, jnp.int32),
+            return _gather_fn_local(self._widths, rps)(
+                self._compute_parts(), jnp.asarray(idx, jnp.int32),
                 jnp.asarray(init_idx), jnp.asarray(init_vals))
         if s != sp:
             raise ValueError(
@@ -785,19 +951,22 @@ class DeviceFeatureStore:
         init_idx_d = jax.device_put(jnp.asarray(init_idx), self._sharding)
         init_vals_d = jax.device_put(
             jnp.asarray(init_vals.reshape(sp * cap_m, w)), self._sharding)
-        return _gather_fn_sharded(self.mesh, self.axis, s, cap, w, rps,
-                                  self._cap)(
-            self._vals, req_d, place_d, init_idx_d, init_vals_d)
+        return _gather_fn_sharded(self.mesh, self.axis, s, cap,
+                                  self._widths, rps, self._cap)(
+            self._compute_parts(), req_d, place_d, init_idx_d,
+            init_vals_d)
 
     def _scatter_pass_locked(self, block_vals: jax.Array, rows: np.ndarray,
-                             n: int, rps: int, sp: int) -> jax.Array:
+                             n: int, rps: int, sp: int) -> Tuple:
+        """Returns the new parts tuple (persistent placement)."""
         s = self.num_shards
-        w = self.width
         if s == 1 and sp == 1:
             idx = np.full((rps,), s * (self._cap + 1) - 1, np.int64)
             idx[:n] = self._dev_idx(rows)
-            return _scatter_fn_local(w, rps)(
-                self._vals, block_vals, jnp.asarray(idx, jnp.int32))
+            return self._settle_parts(_scatter_fn_local(
+                self._widths, rps)(
+                self._compute_parts(), block_vals,
+                jnp.asarray(idx, jnp.int32)))
         if s != sp:
             raise ValueError("pass shards must equal store shards")
         slot, local, _, cap = self._bucket_exact(rows, n, rps, sp)
@@ -807,8 +976,9 @@ class DeviceFeatureStore:
             jnp.asarray(src.reshape(sp, s * cap)), self._sharding)
         dst_d = jax.device_put(
             jnp.asarray(dst.reshape(sp, s * cap)), self._sharding)
-        return _scatter_fn_sharded(self.mesh, self.axis, s, cap, w)(
-            self._vals, block_vals, src_d, dst_d)
+        return self._settle_parts(_scatter_fn_sharded(
+            self.mesh, self.axis, s, cap, self._widths)(
+            self._compute_parts(), block_vals, src_d, dst_d))
 
     # -- FeatureStore-compatible host-dict surface -------------------------
 
@@ -856,7 +1026,7 @@ class DeviceFeatureStore:
             rps = plan_shards(n, s)
             laid = self._place(jnp.asarray(
                 lay_fused_host(fuse_values_host(values), s, rps)))
-            self._vals = self._scatter_pass_locked(laid, rows, n, rps, s)
+            self._parts = self._scatter_pass_locked(laid, rows, n, rps, s)
             self._dirty_parts.append(k.copy())
             self._unseen[rows] = 0
 
@@ -877,7 +1047,7 @@ class DeviceFeatureStore:
         s = self.num_shards
         cap1 = self._cap + 1
         host = np.asarray(
-            jax.jit(lambda v: v[:, col])(self._vals)).reshape(s, cap1)
+            jax.jit(lambda v: v[:, col])(self._parts[0])).reshape(s, cap1)
         rows = np.arange(n)
         return host[rows % s, rows // s]
 
@@ -903,8 +1073,9 @@ class DeviceFeatureStore:
                                                        min_show)
         with self._lock:
             self._shrunk_since_base = True
-            self._vals = self._place(_decay_fn(
-                self.dim, float(decay))(self._vals))
+            self._parts = (self._place(_decay_fn(
+                self.dim, float(decay))(self._parts[0])),
+                ) + self._parts[1:]
             self._unseen += 1
             if min_show <= 0 and ttl <= 0:
                 return 0
@@ -936,16 +1107,17 @@ class DeviceFeatureStore:
         self._index = native_store.KeyIndex()
         self._index.reserve(n)
         self._cap = _pow2(max(1 << 10, -(-max(n, 1) // s)))
-        self._vals = self._place(
-            jnp.zeros((s * (self._cap + 1), self.width), jnp.float32))
+        self._parts = self._place_parts(tuple(
+            jnp.zeros((s * (self._cap + 1), w), jnp.float32)
+            for w in self._widths))
         self._unseen = ages
         if n:
             rows2, n_new = self._index.upsert(keys)
             assert n_new == n
             # Rows are fresh appends 0..n-1; values come from the gathered
             # block, not init — scatter them in directly.
-            self._vals = self._scatter_pass_locked(survivors, rows2, n,
-                                                   rps, s)
+            self._parts = self._scatter_pass_locked(survivors, rows2, n,
+                                                    rps, s)
         log.vlog(0, "device store compacted: %d rows kept", n)
 
     def _snapshot_sorted_locked(self, keys_sorted: np.ndarray
@@ -1067,8 +1239,9 @@ class DeviceFeatureStore:
             self._index = native_store.KeyIndex()
             self._index.reserve(n)
             self._cap = _pow2(max(1 << 10, -(-max(n, 1) // s)))
-            self._vals = self._place(
-                jnp.zeros((s * (self._cap + 1), self.width), jnp.float32))
+            self._parts = self._place_parts(tuple(
+                jnp.zeros((s * (self._cap + 1), w), jnp.float32)
+                for w in self._widths))
             self._dirty_parts = []
             self._shrunk_since_base = False
             self._unseen = np.zeros((n,), np.int32)
@@ -1079,7 +1252,7 @@ class DeviceFeatureStore:
             rps = plan_shards(n, s)
             laid = self._place(jnp.asarray(
                 lay_fused_host(fuse_values_host(vals), s, rps)))
-            self._vals = self._scatter_pass_locked(laid, rows, n, rps, s)
+            self._parts = self._scatter_pass_locked(laid, rows, n, rps, s)
 
     def load(self, path: str, kind: str = "base") -> None:
         data = np.load(os.path.join(path,

@@ -352,7 +352,8 @@ def gpt_value_and_grad_1f1b(cfg: GPTConfig, mesh: Mesh, specs: Dict, *,
 
 def make_gpt_train_step(cfg: GPTConfig, mesh: Mesh, specs: Dict,
                         optimizer, *, num_microbatches: int = 1,
-                        schedule: str = "gpipe", num_chunks: int = 1):
+                        schedule: str = "gpipe", num_chunks: int = 1,
+                        out_shardings=None):
     """Jitted (params, opt_state, tokens, targets) -> (params, opt_state,
     loss) with donation. Gradient reduction across dp/pp/sp/mp falls out
     of differentiating through the shard_map (``schedule="gpipe"``) or is
@@ -394,4 +395,10 @@ def make_gpt_train_step(cfg: GPTConfig, mesh: Mesh, specs: Dict,
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    # out_shardings (a (params, opt_state, loss) pytree) lets a caller
+    # pin the outputs — the ZeRO bench path shards opt_state over dp and
+    # must pin params replicated, or the sharded state inputs would leak
+    # their sharding into p+u (accidental ZeRO-3).
+    jit_kw = {} if out_shardings is None else {
+        "out_shardings": out_shardings}
+    return jax.jit(step, donate_argnums=(0, 1), **jit_kw)

@@ -44,6 +44,7 @@ from paddlebox_tpu.ops.data_norm import (data_norm_apply, data_norm_init,
                                          normalize_dense_and_strip)
 from paddlebox_tpu.parallel.collective import (hierarchical_psum_tree,
                                                quantized_psum)
+from paddlebox_tpu.parallel import zero as zero_lib
 
 
 @dataclasses.dataclass(frozen=True)
@@ -217,6 +218,12 @@ class CTRTrainer:
             self._optax = optax.sgd(self.config.dense_learning_rate)
         else:
             raise ValueError(self.config.dense_optimizer)
+        # The ZeRO-sharded step decomposes the chain by hand: the clip
+        # must see the FULL gradient tree (its global norm spans every
+        # leaf), then the elementwise inner optimizer runs on the local
+        # shards — so keep the parts addressable next to the chain.
+        self._optax_base = self._optax
+        self._clip_tx = None
         if self.config.grad_clip_norm > 0:
             if self.config.dense_sync_mode == "async":
                 # The async path applies updates in the host
@@ -225,9 +232,15 @@ class CTRTrainer:
                 raise NotImplementedError(
                     "grad_clip_norm with dense_sync_mode='async' is not "
                     "supported (the host dense table applies updates)")
-            self._optax = optax.chain(
-                optax.clip_by_global_norm(self.config.grad_clip_norm),
-                self._optax)
+            self._clip_tx = optax.clip_by_global_norm(
+                self.config.grad_clip_norm)
+            self._optax = optax.chain(self._clip_tx, self._optax_base)
+        # FLAGS_dense_zero placement, resolved at init() (the mesh and
+        # sync mode decide whether 'shard' is meaningful); the offload
+        # wrapper is built lazily.
+        self._dense_zero = "off"
+        self._offload_tx: Optional[zero_lib.OffloadedOptimizer] = None
+        self._zero_warned = False
 
     # -- init -------------------------------------------------------------
 
@@ -306,13 +319,112 @@ class CTRTrainer:
             # model) but is updated by the decayed summary path, not the
             # optimizer — _build_step overwrites it after the update.
             self.params["data_norm"] = data_norm_init(dense_dim)
-        self.opt_state = self._optax.init(self.params)
+        self._init_dense()
         self.auc_state = self._auc_init()
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
-            self.params = jax.device_put(self.params, rep)
-            self.opt_state = jax.device_put(self.opt_state, rep)
             self.auc_state = jax.device_put(self.auc_state, rep)
+
+    # -- dense placement (FLAGS_dense_zero) -------------------------------
+
+    def _dense_zero_mode(self) -> str:
+        """Resolve FLAGS_dense_zero against the mesh and sync mode.
+
+        'shard' + 'kstep' degrades to 'off' with one warning: ZeRO
+        removes REDUNDANCY, and k-step optimizer state is worker-local
+        (intentionally divergent between syncs) — there is no replicated
+        copy to shard away, and an all-gather would mix per-device
+        trajectories. 'offload' requires the in-step grads of
+        dense_sync_mode='step' ('async' already has its own host
+        updater; 'kstep' state must stay device-local per step)."""
+        z = str(flags.flag("dense_zero"))
+        if z not in ("off", "shard", "offload"):
+            raise ValueError(
+                f"dense_zero must be off|shard|offload, got {z!r}")
+        if z == "off" or self.mesh is None:
+            return "off"
+        if z == "offload" and self.config.dense_sync_mode != "step":
+            raise ValueError(
+                "dense_zero='offload' requires dense_sync_mode='step' "
+                f"(got {self.config.dense_sync_mode!r})")
+        if z == "shard" and self.config.dense_sync_mode == "kstep":
+            if not self._zero_warned:
+                self._zero_warned = True
+                log.warning(
+                    "dense_zero='shard' ignored under "
+                    "dense_sync_mode='kstep': k-step optimizer state is "
+                    "worker-local (no replicated copy to shard) — "
+                    "running with replicated placement")
+            return "off"
+        return z
+
+    def _init_dense(self) -> None:
+        """Init + place the dense params/optimizer state. Params stay
+        replicated (ZeRO-1/2, not ZeRO-3 — the CTR dense half is MBs,
+        the state is the redundancy worth removing); opt_state placement
+        follows FLAGS_dense_zero. Checkpoints stay layout-agnostic: the
+        GLOBAL shapes are identical under every mode (sharding is
+        placement, not format), so save gathers to the host format and
+        :meth:`place_dense` re-shards on load."""
+        self._dense_zero = self._dense_zero_mode()
+        if self.mesh is None:
+            self.opt_state = self._optax.init(self.params)
+            return
+        rep = NamedSharding(self.mesh, P())
+        self.params = jax.device_put(self.params, rep)
+        if self._dense_zero == "offload":
+            self._offload_tx = zero_lib.OffloadedOptimizer(
+                self._optax, self.mesh, axis=self.axis,
+                min_size=int(flags.flag("dense_zero_min_size")))
+            self.opt_state = self._offload_tx.init(self.params)
+        else:
+            self.opt_state = self._optax.init(self.params)
+            self.opt_state = jax.tree.map(
+                jax.device_put, self.opt_state,
+                self._opt_shardings(self.opt_state))
+        self.dense_memory_stats()
+
+    def _opt_shardings(self, state: Any):
+        """Per-leaf NamedShardings of the NON-offload opt_state
+        placement: replicated under 'off', zero_shardings over the table
+        axis under 'shard' (replicated across slices on a multi-slice
+        mesh — the hierarchical psum keeps slice replicas bit-equal, so
+        only intra-slice redundancy is worth removing)."""
+        if self._dense_zero == "shard":
+            return zero_lib.zero_shardings(
+                state, self.mesh, axis=self.axis,
+                min_size=int(flags.flag("dense_zero_min_size")))
+        rep = NamedSharding(self.mesh, P())
+        return jax.tree.map(lambda _: rep, state)
+
+    def place_dense(self, params: Any, opt_state: Any) -> Tuple[Any, Any]:
+        """device_put HOST-format dense state into this trainer's live
+        placement — the checkpoint-load half of layout agnosticism
+        (save is plain device_get: global shapes are mode-invariant)."""
+        if self.mesh is None:
+            return params, opt_state
+        rep = NamedSharding(self.mesh, P())
+        params = jax.device_put(params, rep)
+        if self._dense_zero == "offload":
+            assert self._offload_tx is not None
+            opt_state = jax.tree.map(
+                jax.device_put, opt_state,
+                self._offload_tx._state_shardings(opt_state))
+        else:
+            opt_state = jax.tree.map(jax.device_put, opt_state,
+                                     self._opt_shardings(opt_state))
+        return params, opt_state
+
+    def dense_memory_stats(self) -> Dict[str, Any]:
+        """Measured per-device HBM bytes of the dense half (live array
+        shardings, not flag arithmetic) + placement provenance; also
+        lands the dense/*_hbm_bytes gauges the benches record."""
+        pb = zero_lib.tree_hbm_bytes_per_device(self.params)
+        ob = zero_lib.tree_hbm_bytes_per_device(self.opt_state)
+        monitor.set_gauge("dense/params_hbm_bytes", pb)
+        monitor.set_gauge("dense/opt_state_hbm_bytes", ob)
+        return {"params_hbm_bytes": pb, "opt_state_hbm_bytes": ob,
+                "dense_zero": self._dense_zero}
 
     # -- the fused step ----------------------------------------------------
 
@@ -426,6 +538,31 @@ class CTRTrainer:
         mode = self.config.dense_sync_mode
         if mode not in ("step", "kstep", "async"):
             raise ValueError(f"unknown dense_sync_mode {mode!r}")
+        # FLAGS_dense_zero (resolved at init): 'shard' decomposes the
+        # in-step dense update — clip on the FULL psum'd grad tree (its
+        # global norm spans every leaf), elementwise inner optimizer on
+        # this device's zero_slice shard (bit-identical per element),
+        # tiled all-gather of the updated param shards (the psum+slice/
+        # all-gather pair is exactly the reduce-scatter/all-gather
+        # schedule of the weight-update-sharding paper, compiler-
+        # scheduled). 'offload' makes the dense update EXTERNAL like
+        # async: the step returns psum'd grads and train_pass routes
+        # them through OffloadedOptimizer.
+        zmode = self._dense_zero
+        zmin = int(flags.flag("dense_zero_min_size"))
+        z_shard = zmode == "shard" and mode == "step"
+        external_dense = mode == "async" or zmode == "offload"
+        if z_shard:
+            pz_specs = zero_lib.zero_specs(self.params, self.mesh,
+                                           axis=axis, min_size=zmin)
+            z_nsh = int(self.mesh.shape[axis])
+        if zmode == "shard":
+            opt_spec = zero_lib.zero_specs(self.opt_state, self.mesh,
+                                           axis=axis, min_size=zmin)
+        else:
+            opt_spec = P()
+        clip_tx = self._clip_tx
+        base_tx = self._optax_base
         scale_sparse = self.config.scale_sparse_grad_by_batch
         sparse_scale = float(self.feed_config.batch_size)
         loss_of, auc_of = self._make_loss_auc(raxes)
@@ -487,7 +624,13 @@ class CTRTrainer:
                 tuple(p["w"] for p in pulled))
 
             # Dense sync (see TrainerConfig.dense_sync_mode).
-            if mode == "step":
+            if external_dense:
+                # async / offload: the host applies the update — the
+                # step's job is the exact cross-replica grad sum.
+                g_params = quantized_psum(g_params, raxes,
+                                          wire_dtype=dense_wire,
+                                          block=dense_qblock)
+            elif mode == "step":
                 # Grads already carry the global 1/N via the global
                 # denominator — the sum over replicas completes the
                 # reduction (role of SyncParam / c_allreduce_sum). On a
@@ -506,9 +649,29 @@ class CTRTrainer:
                     g_params = quantized_psum(g_params, axis,
                                               wire_dtype=dense_wire,
                                               block=dense_qblock)
-                updates, opt_state = optimizer.update(g_params, opt_state,
-                                                      params)
-                params = optax.apply_updates(params, updates)
+                if z_shard:
+                    if clip_tx is not None:
+                        clip_state, inner_state = opt_state
+                        g_params, clip_state = clip_tx.update(
+                            g_params, clip_state, params)
+                    else:
+                        inner_state = opt_state
+                    g_sl = zero_lib.zero_slice(g_params, pz_specs, axis,
+                                               z_nsh)
+                    p_sl = zero_lib.zero_slice(params, pz_specs, axis,
+                                               z_nsh)
+                    updates, inner_state = base_tx.update(g_sl,
+                                                          inner_state,
+                                                          p_sl)
+                    p_new = optax.apply_updates(p_sl, updates)
+                    params = zero_lib.zero_all_gather(p_new, pz_specs,
+                                                      axis)
+                    opt_state = ((clip_state, inner_state)
+                                 if clip_tx is not None else inner_state)
+                else:
+                    updates, opt_state = optimizer.update(
+                        g_params, opt_state, params)
+                    params = optax.apply_updates(params, updates)
             elif mode == "kstep":
                 # Local step with the unbiased full-grad estimate
                 # (local grad x world size, since the loss denominator is
@@ -522,11 +685,6 @@ class CTRTrainer:
                     lambda p: jax.tree.map(
                         lambda x: lax.pmean(x, raxes), p),
                     lambda p: p, params)
-            else:  # async: host table applies the update
-                g_params = quantized_psum(g_params, raxes,
-                                          wire_dtype=dense_wire,
-                                          block=dense_qblock)
-
             if dn_on:
                 # Decayed summary update from the SAME stats the forward
                 # normalized with (the optimizer saw zero grads for them
@@ -569,7 +727,7 @@ class CTRTrainer:
                 sum(p["overflow"][0] for p in pulled), raxes)
             out = (tuple(new_tables), params, opt_state, auc, loss_global,
                    overflow_global)
-            if mode == "async":
+            if external_dense:
                 out = out + (g_params,)
             return out
 
@@ -583,13 +741,13 @@ class CTRTrainer:
         # full replica set (slice-major matches pack_sharded order).
         dspec = P((dcn, axis)) if dcn else P(axis)
         if k_steps == 1:
-            out_specs = (P(axis), P(), P(), P(), P(), P())
-            if mode == "async":
+            out_specs = (P(axis), P(), opt_spec, P(), P(), P())
+            if external_dense:
                 out_specs = out_specs + (P(),)
             body_sm = jax.shard_map(
                 body, mesh=self.mesh,
-                in_specs=(P(axis), P(), P(), P(), dspec, dspec, dspec,
-                          dspec, dspec, P()),
+                in_specs=(P(axis), P(), opt_spec, P(), dspec, dspec,
+                          dspec, dspec, dspec, P()),
                 out_specs=out_specs,
                 check_vma=False)
             return jax.jit(body_sm, donate_argnums=(0, 1, 2, 3))
@@ -599,12 +757,13 @@ class CTRTrainer:
         # in the K=1 program — the per-step op budget is unchanged ×K).
         if k_steps < 1:
             raise ValueError(f"k_steps must be >= 1, got {k_steps}")
-        if mode == "async":
-            # The host dense table needs a pull/push around EVERY step;
-            # train_pass forces K=1 for this mode before building.
+        if external_dense:
+            # The host updater (async dense table / offload optimizer)
+            # needs a pull/push around EVERY step; train_pass forces
+            # K=1 for these modes before building.
             raise ValueError("steps_per_dispatch > 1 requires a device-"
-                             "side dense_sync_mode ('step'/'kstep'), "
-                             "not 'async'")
+                             "side dense update ('step'/'kstep'), not "
+                             "'async' or dense_zero='offload'")
         k_sync = max(1, self.config.dense_sync_interval)
 
         def mega(tables, params, opt_state, auc, step0, n_active, rows,
@@ -649,9 +808,9 @@ class CTRTrainer:
         sdspec = P(None, (dcn, axis)) if dcn else P(None, axis)
         mega_sm = jax.shard_map(
             mega, mesh=self.mesh,
-            in_specs=(P(axis), P(), P(), P(), P(), P(), sdspec, sdspec,
-                      sdspec, sdspec, sdspec),
-            out_specs=(P(axis), P(), P(), P(), P(), P(), P()),
+            in_specs=(P(axis), P(), opt_spec, P(), P(), P(), sdspec,
+                      sdspec, sdspec, sdspec, sdspec),
+            out_specs=(P(axis), P(), opt_spec, P(), P(), P(), P()),
             check_vma=False)
         return jax.jit(mega_sm, donate_argnums=(0, 1, 2, 3))
 
@@ -1190,6 +1349,15 @@ class CTRTrainer:
                      "dense_sync_mode='async' pulls/pushes the host dense "
                      "table around every step — running K=1", k_disp)
             k_disp = 1
+        # dense_zero='offload' is the other external-update mode: the
+        # host-resident optimizer needs the grads around every step.
+        offload = self._dense_zero == "offload"
+        if k_disp > 1 and offload:
+            log.vlog(0, "trainer_steps_per_dispatch=%d ignored: "
+                     "dense_zero='offload' routes the dense update "
+                     "through the host-pinned optimizer every step — "
+                     "running K=1", k_disp)
+            k_disp = 1
         if k_disp > 1 and profiling:
             log.vlog(0, "trainer_steps_per_dispatch=%d ignored under "
                      "FLAGS_profile_trainer (per-step timing needs "
@@ -1351,9 +1519,11 @@ class CTRTrainer:
                         1 if (mode == "kstep" and (nsteps + 1) % k == 0)
                         else 0]
                     out = self._step_fn(
-                        tables, params, opt_state, auc, rows, segs,
-                        labels, valid, dense, sync_flag)
-                    tables, params, opt_state, auc, loss, overflow = out[:6]
+                        tables, params, () if offload else opt_state,
+                        auc, rows, segs, labels, valid, dense, sync_flag)
+                    tables, params, opt_out, auc, loss, overflow = out[:6]
+                    if not offload:
+                        opt_state = opt_out
                     blk_losses, blk_overflow = loss, overflow
                     if profiling:
                         # Completion INSIDE the scope so device_step
@@ -1394,6 +1564,14 @@ class CTRTrainer:
                 # PushDense role: hand psum'd grads to the host updater.
                 # graftlint: allow-sync(async dense pulls grads to the host each step by design)
                 self._async_dense.push_dense(jax.device_get(out[6]))
+            elif offload:
+                # The offload round-trip: stage host state -> HBM, run
+                # the jitted update, stream the new state back to its
+                # host pinning, apply updates to the replicated params.
+                # All transfers are async dispatches — nothing here
+                # blocks on the device.
+                params, opt_state = self._offload_tx.update_apply(
+                    out[6], opt_state, params)
             nsteps += n_active
             if profiling and k_disp == 1:
                 # graftlint: allow-sync(FLAGS_profile_trainer per-step log)

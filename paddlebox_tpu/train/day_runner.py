@@ -154,8 +154,12 @@ class DayRunner:
                     "current model's %s — skipping it", path,
                     np.shape(a), np.shape(b))
                 return False
-        self.trainer.params = state["params"]
-        self.trainer.opt_state = state["opt_state"]
+        # load_pytree returns HOST-format leaves; re-place them into the
+        # trainer's live layout (replicated, ZeRO-sharded, or host-
+        # pinned per FLAGS_dense_zero) — checkpoints are layout- and
+        # world-agnostic, exactly like the sparse shard loads.
+        self.trainer.params, self.trainer.opt_state = (
+            self.trainer.place_dense(state["params"], state["opt_state"]))
         return True
 
     def recover(self) -> Optional[Dict[str, object]]:
@@ -381,14 +385,9 @@ class DayRunner:
                 log.vlog(0, "day_runner: rollback dense from %s", rec.path)
                 break
         else:
-            import jax
             params, opt = dense_snap
-            if self.trainer.mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                rep = NamedSharding(self.trainer.mesh, P())
-                params = jax.device_put(params, rep)
-                opt = jax.device_put(opt, rep)
-            self.trainer.params, self.trainer.opt_state = params, opt
+            self.trainer.params, self.trainer.opt_state = (
+                self.trainer.place_dense(params, opt))
         monitor.add("pass/rollbacks", 1)
 
     def _train_pass_inner(self, day: str, pass_id: int, files: List[str],

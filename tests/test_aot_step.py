@@ -41,6 +41,14 @@ def test_full_ctr_step_aot_compiles_for_tpu():
     # and sharded-all_to_all variants both.
     assert "FUSED-BOUNDARY(local) TPU AOT COMPILE: OK" in out
     assert "FUSED-BOUNDARY(sharded S=" in out
+    # Slot-column split store (FLAGS_table_slot_placement=split|host):
+    # the two-part scatter/boundary programs are distinct from the
+    # fused 1-tuple layout and must lower for TPU on their own.
+    assert "SPLIT-SLOT-PUSH(sharded S=" in out
+    # ZeRO-sharded dense update (FLAGS_dense_zero=shard): psum ->
+    # zero_slice -> shard update -> all-gather inside the full dp=4
+    # shard_map'd step, clip-decomposed adam included.
+    assert "ZERO-STEP(dp=4, adam+clip) TPU AOT COMPILE: OK" in out
 
 
 @pytest.mark.slow

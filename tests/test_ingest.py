@@ -387,8 +387,8 @@ def test_device_store_bulk_build_bit_parity(devices8, monkeypatch):
     incr = device_store.DeviceFeatureStore(cfg)
     r_incr = incr.ensure_rows(keys)
     np.testing.assert_array_equal(r_fresh, r_incr)
-    np.testing.assert_array_equal(np.asarray(fresh._vals),
-                                  np.asarray(incr._vals))
+    for a, b in zip(fresh._parts, incr._parts):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # Later batches through the normal upsert path still line up.
     more = np.unique(rng.integers(1, 1 << 40, 500, dtype=np.uint64))
     np.testing.assert_array_equal(fresh.ensure_rows(more),

@@ -629,3 +629,47 @@ def test_health_plane_keys_direction_and_gating(tmp_path):
     assert perf_gate.main([rep, "--baseline", b]) == 1
     _, regs = perf_gate.compare(firing, base)
     assert {r["metric"] for r in regs} == {"telemetry.alerts_firing"}
+
+
+def test_hbm_residency_keys_direction_and_gating(tmp_path):
+    """ZeRO/slot-offload keys: measured HBM residency gates lower-better
+    through the "_bytes" suffix (slash-separated names are one path
+    segment — the suffix rule still sees them), and the placement
+    strings (``dense_zero``, ``table_slot_placement``) are provenance
+    that must never gate. Shrinking resident bytes (turning ZeRO on
+    against an off baseline) is an improvement, never a trip."""
+    assert perf_gate.direction("dense/opt_state_hbm_bytes") == -1
+    assert perf_gate.direction("dense/params_hbm_bytes") == -1
+    assert perf_gate.direction("table/slot_hbm_bytes") == -1
+    assert perf_gate.direction("table/hot_hbm_bytes") == -1
+    base = {"value": 8500.0,
+            "dense/params_hbm_bytes": 1972808,
+            "dense/opt_state_hbm_bytes": 3945620,
+            "table/hot_hbm_bytes": 79691852,
+            "table/slot_hbm_bytes": 8388616,
+            "dense_zero": "shard",
+            "table_slot_placement": "host"}
+    b = _write(tmp_path, "hbm_base.json", base)
+    assert perf_gate.main([_write(tmp_path, "hbm_ok.json", base),
+                           "--baseline", b]) == 0
+    # Optimizer state grew back to replicated size: a memory regression
+    # even with throughput flat.
+    grew = copy.deepcopy(base)
+    grew["dense/opt_state_hbm_bytes"] *= 2
+    grew["dense_zero"] = "off"  # provenance flip rides along, ungated
+    assert perf_gate.main([_write(tmp_path, "hbm_grew.json", grew),
+                           "--baseline", b]) == 1
+    _, regs = perf_gate.compare(grew, base)
+    assert {r["metric"] for r in regs} == {"dense/opt_state_hbm_bytes"}
+    # Slot columns crept back into HBM (placement silently fused).
+    crept = copy.deepcopy(base)
+    crept["table/slot_hbm_bytes"] *= 5
+    _, regs = perf_gate.compare(crept, base)
+    assert {r["metric"] for r in regs} == {"table/slot_hbm_bytes"}
+    # Turning the features ON against an off baseline only shrinks
+    # bytes: an improvement must not trip the gate.
+    shrunk = copy.deepcopy(base)
+    shrunk["dense/opt_state_hbm_bytes"] //= 2
+    shrunk["table/slot_hbm_bytes"] = 0
+    _, regs = perf_gate.compare(shrunk, base)
+    assert regs == []

@@ -172,6 +172,36 @@ def test_backups_byte_identical_after_sync_forwarding(pair):
     assert st["slots"]["0"]["backups"][eps[1]] == st["slots"]["0"]["seq"]
 
 
+def test_slot_columns_ride_replication_like_values(pair):
+    """FLAGS_table_slot_placement is device-side PLACEMENT only: the
+    replication wire and journal carry the full LOGICAL row, so the
+    optimizer slot columns (emb_state/w_state) forward to backups
+    bit-identically with the values — never re-derived, never dropped.
+    (That's what lets a device store under split/host rehydrate exact
+    slot state from any replica after failover.)"""
+    servers, eps, store = pair
+    keys = _rand_keys(800, seed=11)
+    rows = store.pull_for_pass(keys)
+    rng = np.random.default_rng(11)
+    rows["emb_state"] = rng.normal(
+        size=rows["emb_state"].shape).astype(np.float32)
+    rows["w_state"] = rng.normal(
+        size=rows["w_state"].shape).astype(np.float32)
+    store.push_from_pass(keys, rows)
+    for slot in (0, 1):
+        prim = servers[slot]._slot_stores[slot]
+        back = servers[1 - slot]._slot_stores[slot]
+        pk, _ = prim.key_stats()
+        pk = np.sort(pk)
+        if not pk.size:
+            continue
+        pv, bv = prim.pull_for_pass(pk), back.pull_for_pass(pk)
+        for f in ("emb_state", "w_state"):
+            assert np.asarray(pv[f]).any(), f"{f} all-zero: vacuous"
+            np.testing.assert_array_equal(np.asarray(pv[f]),
+                                          np.asarray(bv[f]), err_msg=f)
+
+
 def test_replicated_shrink_forwards_resolved_policy(pair):
     servers, eps, store = pair
     keys = _rand_keys(500, seed=3)

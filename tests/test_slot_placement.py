@@ -1,0 +1,174 @@
+"""FLAGS_table_slot_placement parity: slot-column split/offload of the
+device feature store vs the fused baseline.
+
+Role of the reference's value/slot layout split: a feature row is
+[emb D | show click day | emb_state Ke | w_state Kw], but only the
+first D+3 columns are touched by pull/serving — the optimizer slot
+columns ride along every HBM byte only because the fused layout stores
+values x slots together. 'split' carves the slot columns into a sibling
+array (hot part becomes exactly [rows, D+3]); 'host' additionally pins
+the slot part to host memory with transient HBM crossings around the
+push. Both must be PLACEMENT, not format: identical key sets, bitwise
+identical pulled values, identical lifecycle (decay/TTL/eviction)
+results, and checkpoints that round-trip across placements unchanged.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.embedding import TableConfig
+from paddlebox_tpu.embedding.device_store import DeviceFeatureStore
+from paddlebox_tpu.parallel import HybridTopology, build_mesh
+
+CFG = dict(name="t", dim=8, optimizer="adagrad", show_click_decay=0.98)
+
+PLACEMENTS = ("fused", "split", "host")
+
+
+@pytest.fixture(autouse=True)
+def _restore_placement_flags():
+    old = {k: flagmod.flag(k) for k in
+           ("table_slot_placement", "table_ttl_days")}
+    try:
+        yield
+    finally:
+        flagmod.set_flags(old)
+
+
+def _keys(seed=0, n=600):
+    rng = np.random.default_rng(seed)
+    return np.unique(rng.integers(1, 1 << 40, n, dtype=np.uint64))
+
+
+def _lifecycle_run(placement, sharded):
+    """Two pull/push cycles + decay/TTL shrink + min_show eviction,
+    ending in a full-store snapshot digest."""
+    flagmod.set_flags({"table_slot_placement": placement,
+                       "table_ttl_days": 2})
+    mesh = build_mesh(HybridTopology(dp=8)) if sharded else None
+    st = DeviceFeatureStore(TableConfig(**CFG), mesh=mesh)
+    keys = _keys()
+    vals = st.pull_for_pass(keys)
+    upd = {f: np.asarray(v) for f, v in vals.items()}
+    upd["emb"] = upd["emb"] + 0.5
+    upd["show"] = upd["show"] + 1.0
+    st.push_from_pass(keys, upd)
+    st.shrink(min_show=0.0)          # decay + TTL aging, no eviction
+    k2 = np.unique(np.concatenate(
+        [keys[::2], keys.max() + np.arange(1, 100, dtype=np.uint64)]))
+    v2 = st.pull_for_pass(k2)
+    st.push_from_pass(k2, {f: np.asarray(v) for f, v in v2.items()})
+    st.shrink(min_show=0.5)          # evicts the cold half
+    allk = np.sort(st._index.keys_by_row())
+    snap = st.pull_for_pass(allk)
+    digest = {f: np.asarray(v).tobytes() for f, v in snap.items()}
+    return allk, digest, st.memory_stats(), st
+
+
+def test_six_variants_bitwise_vs_fused_local():
+    """All six store variants (fused/split/host x local/dp-sharded):
+    identical surviving key sets and bitwise-identical value digests
+    through pull -> push -> decay/TTL -> eviction. Split placements
+    must also carve the exact shapes: hot [rows, D+3], slot
+    [rows, Ke+Kw]."""
+    base_k = base_dig = None
+    for sharded in (False, True):
+        for placement in PLACEMENTS:
+            k, dig, mem, st = _lifecycle_run(placement, sharded)
+            assert mem["placement"] == placement
+            if placement != "fused":
+                rows_tot = st.num_shards * (st._cap + 1)
+                assert st._parts[0].shape == (rows_tot, st.dim + 3)
+                assert st._parts[1].shape == (rows_tot, st.ke + st.kw)
+            if base_k is None:
+                base_k, base_dig = k.tobytes(), dig
+                continue
+            tag = f"{placement}/{'sharded' if sharded else 'local'}"
+            assert k.tobytes() == base_k, f"{tag}: key set diverged"
+            for f in dig:
+                assert dig[f] == base_dig[f], f"{tag}: {f} diverged"
+
+
+def test_memory_stats_hot_bytes_per_row_exact():
+    """The acceptance arithmetic: under split/host the HOT array holds
+    exactly (D+3) f32 columns per row — the slot columns contribute
+    zero bytes to it. Fused reports the same TOTAL, attributed
+    proportionally."""
+    for placement in ("split", "host"):
+        flagmod.set_flags({"table_slot_placement": placement})
+        st = DeviceFeatureStore(TableConfig(**CFG))
+        st.pull_for_pass(_keys())
+        rows_tot = st.num_shards * (st._cap + 1)
+        mem = st.memory_stats()
+        width = st.dim + 3 + st.ke + st.kw
+        hot_plus_slot = rows_tot * width * 4
+        assert mem["hot_hbm_bytes"] == rows_tot * (st.dim + 3) * 4
+        assert (mem["hot_hbm_bytes"] + mem["slot_hbm_bytes"]
+                == hot_plus_slot)
+    flagmod.set_flags({"table_slot_placement": "fused"})
+    st = DeviceFeatureStore(TableConfig(**CFG))
+    st.pull_for_pass(_keys())
+    mem = st.memory_stats()
+    width = st.dim + 3 + st.ke + st.kw
+    total = st.num_shards * (st._cap + 1) * width * 4
+    assert mem["hot_hbm_bytes"] + mem["slot_hbm_bytes"] == total
+
+
+def test_checkpoint_roundtrip_across_placements(tmp_path):
+    """save_base under one placement, load under another: checkpoints
+    carry the LOGICAL row (placement is not format) — pulls after
+    fused->split and split->host round-trips are bitwise identical."""
+    flagmod.set_flags({"table_slot_placement": "fused"})
+    keys = _keys()
+    a = DeviceFeatureStore(TableConfig(**CFG))
+    va = a.pull_for_pass(keys)
+    a.push_from_pass(keys,
+                     {f: np.asarray(v) + 0.25 for f, v in va.items()})
+    d1 = str(tmp_path / "ck_fused")
+    a.save_base(d1)
+    ref = a.pull_for_pass(keys)
+
+    flagmod.set_flags({"table_slot_placement": "split"})
+    b = DeviceFeatureStore(TableConfig(**CFG))
+    b.load(d1, "base")
+    got = b.pull_for_pass(keys)
+    for f in ref:
+        np.testing.assert_array_equal(np.asarray(ref[f]),
+                                      np.asarray(got[f]),
+                                      err_msg=f"fused->split {f}")
+
+    d2 = str(tmp_path / "ck_split")
+    b.save_base(d2)
+    flagmod.set_flags({"table_slot_placement": "host"})
+    c = DeviceFeatureStore(TableConfig(**CFG))
+    c.load(d2, "base")
+    got2 = c.pull_for_pass(keys)
+    for f in ref:
+        np.testing.assert_array_equal(np.asarray(ref[f]),
+                                      np.asarray(got2[f]),
+                                      err_msg=f"split->host {f}")
+
+
+def test_pass_table_block_identical_across_placements():
+    """The PassTable stays FUSED under every placement (the trainer's
+    jitted pull/push signature never changes): the [rows, width] block
+    handed to the pass is bitwise identical, fused vs split."""
+    blocks = {}
+    keys = _keys(seed=7, n=200)
+    for placement in ("fused", "split"):
+        flagmod.set_flags({"table_slot_placement": placement})
+        st = DeviceFeatureStore(TableConfig(**CFG))
+        vals = st.pull_for_pass(keys)
+        st.push_from_pass(
+            keys, {f: np.asarray(v) + 1.0 for f, v in vals.items()})
+        table, rows = st.pull_pass_table(keys, st.num_shards)
+        blocks[placement] = (np.asarray(table.vals).tobytes(),
+                             np.asarray(rows).tobytes())
+    assert blocks["fused"] == blocks["split"]
+
+
+def test_invalid_placement_raises():
+    flagmod.set_flags({"table_slot_placement": "hbm3"})
+    with pytest.raises(ValueError, match="table_slot_placement"):
+        DeviceFeatureStore(TableConfig(**CFG))

@@ -117,20 +117,31 @@ def check_device_store_sharded(topo) -> None:
     mesh = Mesh(np.array(topo.devices).reshape(4), ("dp",))
     s, cap_store, w, rps, cap = 4, 1 << 18, 23, 1 << 16, 1 << 14
 
-    v = jax.ShapeDtypeStruct((s * (cap_store + 1), w), jnp.float32)
+    # Resident values are a parts TUPLE since the slot-column split
+    # (1-tuple under the fused layout; (hot, slot) under split/host).
+    v = (jax.ShapeDtypeStruct((s * (cap_store + 1), w), jnp.float32),)
     rq = jax.ShapeDtypeStruct((s, s * cap), jnp.int32)
     ii = jax.ShapeDtypeStruct((s, 1), jnp.int32)
     iv = jax.ShapeDtypeStruct((s, w), jnp.float32)
-    _gather_fn_sharded(mesh, "dp", s, cap, w, rps, cap_store).lower(
+    _gather_fn_sharded(mesh, "dp", s, cap, (w,), rps, cap_store).lower(
         v, rq, rq, ii, iv).compile()
     b = jax.ShapeDtypeStruct(((rps + 1) * s, w), jnp.float32)
-    _scatter_fn_sharded(mesh, "dp", s, cap, w).lower(
+    _scatter_fn_sharded(mesh, "dp", s, cap, (w,)).lower(
         v, b, rq, rq).compile()
     keys = jax.ShapeDtypeStruct((s * (1 << 12),), jnp.uint32)
     tmpl = jax.ShapeDtypeStruct((s, w), jnp.float32)
     st = jax.ShapeDtypeStruct((s,), jnp.int32)
-    _append_fn_sharded(mesh, "dp", w, 1 << 12, 16, 0, 0.01).lower(
+    _append_fn_sharded(mesh, "dp", (w,), 1 << 12, 16, 0, 0.01).lower(
         v, keys, tmpl, st, st).compile()
+    # Split placement variant: same collectives, two-part writes.
+    hot = 16 + 3
+    v2 = (jax.ShapeDtypeStruct((s * (cap_store + 1), hot), jnp.float32),
+          jax.ShapeDtypeStruct((s * (cap_store + 1), w - hot),
+                               jnp.float32))
+    _gather_fn_sharded(mesh, "dp", s, cap, (hot, w - hot), rps,
+                       cap_store).lower(v2, rq, rq, ii, iv).compile()
+    _scatter_fn_sharded(mesh, "dp", s, cap, (hot, w - hot)).lower(
+        v2, b, rq, rq).compile()
     print("AOT device store sharded gather/scatter/append: OK")
 
 

@@ -20,9 +20,11 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Append (last occurrence of a repeated flag wins) so an inherited
-# 8-virtual-device setting from a test env doesn't leak in.
+# 8-virtual-device setting from a test env doesn't leak in. 4 virtual
+# CPU devices: the single-chip step builds on devices[:1]; the ZeRO
+# dp=4 section needs a real 4-way mesh to learn its argument structure.
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=1"
+                           + " --xla_force_host_platform_device_count=4"
                            ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -57,7 +59,7 @@ def main() -> None:
     model = DeepFM(slot_names=tuple(f"s{i}" for i in range(n_slots)),
                    emb_dim=emb_dim, dense_dim=dense_dim,
                    hidden=(400, 400, 400))
-    mesh_cpu = build_mesh(HybridTopology(dp=1))
+    mesh_cpu = build_mesh(HybridTopology(dp=1), devices=jax.devices()[:1])
     tr = CTRTrainer(model, feed,
                     TableConfig(dim=emb_dim, learning_rate=0.05),
                     mesh=mesh_cpu,
@@ -179,8 +181,8 @@ def main() -> None:
     def sd(shape, dt=jnp.float32):
         return jax.ShapeDtypeStruct(shape, dt, sharding=rep)
 
-    fb = _fused_boundary_fn_local(w_rec, rps, rps)
-    fb.lower(sd((store_rows + 1, w_rec)), sd((rps + 1, w_rec)),
+    fb = _fused_boundary_fn_local((w_rec,), rps, rps)
+    fb.lower((sd((store_rows + 1, w_rec)),), sd((rps + 1, w_rec)),
              sd((rps,), jnp.int32), sd((rps + 1, w_rec)),
              sd((m_cap,), jnp.int32), sd((m_cap,), jnp.int32)).compile()
     print("FUSED-BOUNDARY(local) TPU AOT COMPILE: OK")
@@ -190,10 +192,10 @@ def main() -> None:
     cap = 2048
     scap = 1 << 18
     fbs = _fused_boundary_fn_sharded(mesh_s, tr.axis, s, cap, cap,
-                                     w_rec, rps, rps, scap)
+                                     (w_rec,), rps, rps, scap)
     f32, i32t = jnp.float32, jnp.int32
     fbs.lower(
-        jax.ShapeDtypeStruct((s * (scap + 1), w_rec), f32),
+        (jax.ShapeDtypeStruct((s * (scap + 1), w_rec), f32),),
         jax.ShapeDtypeStruct((s * (rps + 1), w_rec), f32),
         jax.ShapeDtypeStruct((s, s * cap), i32t),
         jax.ShapeDtypeStruct((s, s * cap), i32t),
@@ -201,6 +203,87 @@ def main() -> None:
         jax.ShapeDtypeStruct((s, s * cap), i32t),
         jax.ShapeDtypeStruct((s, s * cap), i32t)).compile()
     print(f"FUSED-BOUNDARY(sharded S={s}) TPU AOT COMPILE: OK")
+
+    # Split slot placement (FLAGS_table_slot_placement=split|host): the
+    # resident store is a (hot [rows, D+3], slot [rows, Ke+Kw]) parts
+    # tuple and the push writes BOTH parts inside one dispatch — the
+    # column-split scatter and the two-part fused boundary are distinct
+    # device programs from the 1-tuple fused layout and must survive
+    # XLA:TPU on their own (same collective count: ONE request
+    # all_to_all + ONE fused-width reply).
+    from paddlebox_tpu.embedding.device_store import _scatter_fn_sharded
+    hot_w = emb_dim + 3
+    widths2 = (hot_w, w_rec - hot_w)
+    parts2 = tuple(jax.ShapeDtypeStruct((s * (scap + 1), wp), f32)
+                   for wp in widths2)
+    _scatter_fn_sharded(mesh_s, tr.axis, s, cap, widths2).lower(
+        parts2,
+        jax.ShapeDtypeStruct((s * (rps + 1), w_rec), f32),
+        jax.ShapeDtypeStruct((s, s * cap), i32t),
+        jax.ShapeDtypeStruct((s, s * cap), i32t)).compile()
+    fbs2 = _fused_boundary_fn_sharded(mesh_s, tr.axis, s, cap, cap,
+                                      widths2, rps, rps, scap)
+    fbs2.lower(
+        parts2,
+        jax.ShapeDtypeStruct((s * (rps + 1), w_rec), f32),
+        jax.ShapeDtypeStruct((s, s * cap), i32t),
+        jax.ShapeDtypeStruct((s, s * cap), i32t),
+        jax.ShapeDtypeStruct((s * (rps + 1), w_rec), f32),
+        jax.ShapeDtypeStruct((s, s * cap), i32t),
+        jax.ShapeDtypeStruct((s, s * cap), i32t)).compile()
+    print(f"SPLIT-SLOT-PUSH(sharded S={s}) TPU AOT COMPILE: OK")
+
+    # ZeRO-sharded dense step (FLAGS_dense_zero=shard over dp=4): the
+    # psum -> zero_slice -> shard update -> tiled all-gather schedule
+    # plus the clip-decomposed optimizer, inside the full shard_map'd
+    # CTR step with sharded opt_state in/out specs.
+    check_zero_step(topo)
+
+
+def check_zero_step(topo) -> None:
+    from paddlebox_tpu.data.slots import SlotBatch
+
+    flagmod.set_flags({"dense_zero": "shard", "dense_zero_min_size": 0})
+    try:
+        n_slots, emb_dim, batch = 4, 8, 256
+        slots = tuple(SlotConf(f"s{i}", avg_len=1.0)
+                      for i in range(n_slots))
+        feed = DataFeedConfig(slots=slots, batch_size=batch,
+                              slot_capacity_slack=1.0)
+        model = DeepFM(slot_names=tuple(f"s{i}" for i in range(n_slots)),
+                       emb_dim=emb_dim, hidden=(64,))
+        tr = CTRTrainer(
+            model, feed, TableConfig(dim=emb_dim),
+            mesh=build_mesh(HybridTopology(dp=4)),
+            config=TrainerConfig(auc_num_buckets=1 << 12,
+                                 dense_optimizer="adam",
+                                 grad_clip_norm=1.0))
+        tr.init(seed=0)
+        rng = np.random.default_rng(0)
+        keys = np.sort(rng.choice(np.arange(1, 100_000, dtype=np.uint64),
+                                  20_000, replace=False))
+        tr.engine.feed_pass([keys for _ in tr.engine.groups])
+        tables = tr.engine.begin_pass()
+        ids = {f"s{i}": rng.choice(keys, batch).astype(np.uint64)
+               for i in range(n_slots)}
+        b = SlotBatch(
+            labels=(rng.random((batch, 1)) < 0.2).astype(np.float32),
+            valid=np.ones((batch,), bool), ids=ids,
+            segments={n: np.arange(batch, dtype=np.int32) for n in ids},
+            lengths={n: np.ones((batch,), np.int32) for n in ids},
+            dense={})
+        rows = tr._map_batch_rows(b)
+        segs_j = {n: jnp.asarray(b.segments[n]) for n in ids}
+        args = (tables, tr.params, tr.opt_state, tr.auc_state, rows,
+                segs_j, jnp.asarray(b.labels), jnp.asarray(b.valid),
+                jnp.zeros((batch, 0), jnp.float32),
+                jnp.zeros((), jnp.int32))
+        assert tr._dense_zero == "shard"
+        tr.mesh = Mesh(np.array(topo.devices[:4]).reshape(4), (tr.axis,))
+        tr._build_step().lower(*sds_like(args)).compile()
+        print("ZERO-STEP(dp=4, adam+clip) TPU AOT COMPILE: OK")
+    finally:
+        flagmod.set_flags({"dense_zero": "off"})
 
 
 if __name__ == "__main__":

@@ -317,6 +317,18 @@ def smoke() -> int:
                                           "bytes_per_s": 3.9e9}}},
             "mux_over_legacy_at_o4": 2.6,
             "sg_frames": 842,
+            # HBM residency keys (r23 ZeRO-sharded dense state +
+            # slot-column offload): measured bytes gate lower-better
+            # through the "_bytes" suffix — growing resident state on
+            # an identical workload is a memory regression even when
+            # throughput holds; the placement strings are provenance
+            # (flatten drops strings) and must NOT gate.
+            "dense/params_hbm_bytes": 1972808,
+            "dense/opt_state_hbm_bytes": 3945620,
+            "table/hot_hbm_bytes": 7.97e7,
+            "table/slot_hbm_bytes": 8.39e6,
+            "dense_zero": "off",            # not gated (string)
+            "table_slot_placement": "fused",  # not gated (string)
             "steps_per_dispatch": 4,        # not gated (count)
             "ingest_workers": 8,            # not gated (count)
             "store_build_native": True,     # not gated (bool)
@@ -381,6 +393,10 @@ def smoke() -> int:
     bad["modes"]["mux"]["64kb_o4"]["p99_ms"] = 60.0       # tail blown
     bad["mux_over_legacy_at_o4"] = 0.5        # provenance: must NOT gate
     bad["sg_frames"] = 3                      # provenance: must NOT gate
+    bad["dense/opt_state_hbm_bytes"] *= 3.0   # ZeRO placement lost
+    bad["table/slot_hbm_bytes"] *= 4.0        # slot columns back in HBM
+    bad["dense_zero"] = "shard"               # provenance: must NOT gate
+    bad["table_slot_placement"] = "host"      # provenance: must NOT gate
     _, regs = compare(bad, base)
     names = {r["metric"] for r in regs}
     for want in ("value", "stage_ms.read", "dispatch_ms_quantiles.p99",
@@ -404,7 +420,9 @@ def smoke() -> int:
                  "quality.calibration_error.p99",
                  "quality.quality_alarms", "quality.slot_coverage",
                  "modes.mux.64kb_o4.calls_per_s",
-                 "modes.mux.64kb_o4.p99_ms"):
+                 "modes.mux.64kb_o4.p99_ms",
+                 "dense/opt_state_hbm_bytes",
+                 "table/slot_hbm_bytes"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
     for never in ("ingest_workers", "store_build_native",
@@ -412,7 +430,7 @@ def smoke() -> int:
                   "stream_passes", "events", "telemetry.scrapes",
                   "quality.copc", "quality.skew_top_share",
                   "quality.key_churn", "mux_over_legacy_at_o4",
-                  "sg_frames"):
+                  "sg_frames", "dense_zero", "table_slot_placement"):
         expect(f"provenance {never!r} not gated", never in names, False)
     # An IMPROVEMENT must never trip the gate.
     good = json.loads(json.dumps(base))
