@@ -2156,6 +2156,261 @@ def bench_online() -> dict:
     }
 
 
+FLEET_TRACE = ""   # `bench.py fleet --trace seed[,duration_s[,rps]]`
+
+
+def bench_fleet() -> dict:
+    """Autopilot soak: replay a seeded, diurnal, hot-set-skewed trace
+    (serving/traceload.py — replay-pure, so two runs of one spec are
+    the same trace) against a small in-process fleet with the full
+    control loop armed: history sampler + alert engine (PR 18 plane),
+    FleetAutopilot scaling on the merged stats, and the COPC-gated
+    canary controller watching a live donefile. The chaos script rides
+    the trace: a 10x spike, a replica kill, and a calibration-poisoned
+    BASE publish that must be confined to the canary subset and rolled
+    back on the real sampled-label join. Records the soak/* keys
+    tools/perf_gate.py gates: failed_rpcs and predict_p99_ms lower-
+    better, action counts as provenance."""
+    import dataclasses
+    import shutil
+
+    import jax
+
+    from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol
+    from paddlebox_tpu.core import (alerts, flags as flagmod, monitor,
+                                    telemetry_scrape, timeseries)
+    from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.serving import traceload
+    from paddlebox_tpu.serving.autopilot import FleetAutopilot
+    from paddlebox_tpu.serving.predictor import (CTRPredictor,
+                                                 load_xbox_model)
+    from paddlebox_tpu.serving.router import FleetRouter
+    from paddlebox_tpu.serving.service import PredictClient, PredictServer
+
+    spec = [s for s in FLEET_TRACE.split(",") if s.strip()]
+    seed = int(spec[0]) if len(spec) > 0 else 0
+    duration = float(spec[1]) if len(spec) > 1 else (6.0 if _SMALL
+                                                    else 20.0)
+    rps = float(spec[2]) if len(spec) > 2 else 30.0
+
+    slots = ("u", "i")
+    dim = 8
+    n_keys = 2000
+    feed = DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in slots),
+        batch_size=64)
+    model = DeepFM(slot_names=slots, emb_dim=dim, hidden=())
+    dense = model.init(jax.random.PRNGKey(0))
+    mrng = np.random.default_rng(3)
+    keys = np.arange(1, n_keys + 1, dtype=np.uint64)
+    emb = mrng.normal(size=(n_keys, dim)).astype(np.float32) * 0.02
+    w = mrng.normal(size=(n_keys,)).astype(np.float32) * 0.02
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    root = os.path.join(tmp, "publish")
+    proto = CheckpointProtocol(root)
+
+    def write_base(day, e, ww):
+        d = proto.model_dir(day, 0)
+        os.makedirs(d, exist_ok=True)
+        np.savez(os.path.join(d, "embedding.xbox.npz"),
+                 keys=keys, emb=e, w=ww)
+        return d
+
+    base_dir = write_base("20260801", emb, w)
+    proto.publish("20260801")
+    # The poisoned base: weights shifted so every prediction saturates
+    # toward 1.0 — served COPC (label_sum/pred_sum) collapses to ~0.5
+    # against the alternating labels below, a textbook calibration
+    # break the canary gate must catch.
+    write_base("20260802", emb + 5.0, w + 5.0)
+
+    prev = {k: flagmod.flag(k) for k in (
+        "quality_sample_rate", "quality_min_events",
+        "serving_slo_p99_ms", "autopilot_cooldown_s",
+        "autopilot_min_replicas", "autopilot_max_replicas",
+        "autopilot_poll_s", "autopilot_canary_replicas",
+        "autopilot_canary_min_labels", "autopilot_canary_copc_margin",
+        "autopilot_canary_timeout_s", "history_interval_s",
+        "alerts_enable", "fleet_health_interval_s")}
+    flagmod.set_flags({
+        "quality_sample_rate": 1.0, "quality_min_events": 8,
+        "serving_slo_p99_ms": 2000.0,   # generous CPU bound: the soak
+        # asserts p99 stays UNDER it, scale-out triggers on the kill
+        "autopilot_cooldown_s": 1.0, "autopilot_min_replicas": 2,
+        "autopilot_max_replicas": 4, "autopilot_poll_s": 0.2,
+        "autopilot_canary_replicas": 1,
+        "autopilot_canary_min_labels": 24,
+        "autopilot_canary_copc_margin": 0.2,
+        "autopilot_canary_timeout_s": 30.0,
+        "history_interval_s": 0.2, "alerts_enable": True,
+        "fleet_health_interval_s": 0.2})
+    monitor.reset()
+    _tick("fleet:setup")
+
+    def make_server(rid):
+        k, e, ww = load_xbox_model(base_dir, "embedding")
+        pred = CTRPredictor(model, feed, k, e, ww, dense,
+                            compute_dtype="float32")
+        return PredictServer("127.0.0.1:0", pred, replica_id=rid)
+
+    servers = {f"replica-{i}": make_server(f"replica-{i}")
+               for i in range(2)}
+    router = FleetRouter("127.0.0.1:0",
+                         replicas=[s.endpoint
+                                   for s in servers.values()])
+    timeseries.init_from_flags()
+    alerts.init_from_flags()
+
+    spawn_n = [0]
+
+    def spawn():
+        rid = f"auto-{spawn_n[0]}"
+        spawn_n[0] += 1
+        s = make_server(rid)
+        servers[rid] = s
+        router.fleet.add_replica(rid, s.endpoint, ready=True)
+        return rid
+
+    def retire(rid):
+        s = servers.pop(rid, None)
+        if s is not None:
+            s.stop()
+
+    # registry=router.metrics: action counters land in the router's
+    # instance registry too, so ONE telemetry_scrape sweep over the
+    # fleet shows every action the autopilot took.
+    autopilot = FleetAutopilot(
+        router.fleet, lambda: router.handle_stats({}),
+        donefile_root=root, spawn=spawn, retire=retire,
+        registry=router.metrics,
+        state_path=os.path.join(tmp, "autopilot.json"))
+    autopilot.start()
+
+    # Trace skew calibrated from the live observatory when it has
+    # reported (quality/slot_top_share gauges in a replica snapshot);
+    # falls back to the config default on a cold start.
+    snap = next(iter(servers.values())).metrics.snapshot_all()
+    cfg = traceload.TraceConfig.from_quality(
+        snap.get("gauges") or {}, seed=seed, duration_s=duration,
+        base_rps=rps, n_keys=n_keys, slots=slots, rows_per_request=2,
+        chaos=(
+            traceload.ChaosEvent(at_s=0.30 * duration, kind="spike",
+                                 duration_s=0.15 * duration,
+                                 factor=10.0),
+            traceload.ChaosEvent(at_s=0.40 * duration,
+                                 kind="kill_replica", arg="replica-1"),
+            traceload.ChaosEvent(at_s=0.50 * duration,
+                                 kind="poison_delta", arg="20260802"),
+        ))
+    gen = traceload.TraceGenerator(cfg)
+
+    cli = PredictClient(router.endpoint)
+    failed = [0]
+    lines0 = next(iter(gen.requests())).lines
+    cli.predict(list(lines0))  # compile outside the soak window
+
+    def send(req):
+        try:
+            cli.predict(list(req.lines), rid=req.rid)
+            cli.send_labels(
+                req.rid,
+                [(int(req.rid.rsplit("-", 1)[1]) + r) % 2
+                 for r in range(len(req.lines))])
+        except Exception as e:  # noqa: BLE001 - every failure counts
+            failed[0] += 1
+            print(f"[bench fleet] rpc failed: {e!r}", file=sys.stderr)
+
+    def kill_replica(ev):
+        s = servers.pop(ev.arg, None)
+        if s is not None:
+            # Kill-like teardown: refuse new connects AND sever the
+            # router's pooled conns (a graceful stop would keep
+            # draining them and the fleet would never notice).
+            s.stop()
+            s.close_connections()
+
+    def poison(ev):
+        proto.publish(ev.arg)
+
+    _tick("fleet:replay")
+    t0 = time.perf_counter()
+    replayed = traceload.replay(
+        gen, send, handlers={"kill_replica": kill_replica,
+                             "poison_delta": poison})
+    replay_wall = time.perf_counter() - t0
+    # Drain the canary: the verdict needs joined labels on BOTH sides
+    # after the poisoned base staged — keep the labeled trace flowing
+    # (fresh seed: content no longer asserted) until it resolves.
+    _tick("fleet:canary-drain")
+    t_end = time.perf_counter() + 30.0
+    extra = 1
+    while autopilot.canary.state.data.get("canary") is not None \
+            and time.perf_counter() < t_end:
+        drain = traceload.TraceGenerator(dataclasses.replace(
+            cfg, seed=seed + extra, chaos=()))
+        extra += 1
+        for req in drain.requests():
+            if autopilot.canary.state.data.get("canary") is None \
+                    or time.perf_counter() > t_end:
+                break
+            send(req)
+
+    st = router.handle_stats({})
+    snap_all = monitor.snapshot()
+    # One cluster sweep must show every action the autopilot took.
+    targets = {"router": router.endpoint}
+    targets.update({rid: s.endpoint for rid, s in servers.items()})
+    sweep = telemetry_scrape.scrape_cluster(targets, with_stats=False)
+    sweep_counters = (sweep.get("merged") or {}).get("counters") or {}
+    reports = list(autopilot.canary.reports)
+
+    autopilot.stop()
+    alerts.shutdown()
+    timeseries.GLOBAL_SAMPLER.stop()
+    cli.close()
+    router.stop()
+    for s in servers.values():
+        s.stop()
+    flagmod.set_flags(prev)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    scale_out = int(snap_all.get("autopilot/actions/scale_out", 0))
+    scale_in = int(snap_all.get("autopilot/actions/scale_in", 0))
+    rollbacks = [r for r in reports if r.get("verdict") == "rollback"]
+    return {
+        # Headline follows the bench convention (value = throughput,
+        # higher-better): replayed requests per wall second THROUGH the
+        # chaos. The robustness keys gate under soak/*.
+        "metric": "fleet_soak_requests_per_s",
+        "value": round(replayed["sent"] / max(replay_wall, 1e-9), 1),
+        "unit": "req/s",
+        "soak": {
+            "failed_rpcs": int(failed[0]),
+            "predict_p99_ms": (st.get("latency_ms") or {}).get("p99"),
+            "degraded_frac": round(
+                st.get("degraded_rpcs", 0)
+                / max(st.get("predict_rpcs", 1), 1), 4),
+            "scale_actions": scale_out + scale_in,
+            "canary_blocked": len(rollbacks),
+        },
+        "trace": {"seed": seed, "duration_s": duration,
+                  "base_rps": rps, "hot_share": cfg.hot_share,
+                  "requests": int(replayed["sent"]),
+                  "events_fired": int(replayed["events_fired"])},
+        "actions": {k.rsplit("/", 1)[1]: int(v)
+                    for k, v in snap_all.items()
+                    if k.startswith("autopilot/actions/")},
+        "canary_reports": reports,
+        "scrape_shows_actions": any(
+            k.startswith("autopilot/actions/")
+            for k in sweep_counters),
+        "slo_p99_ms_flag": 2000.0,
+        "n_devices": len(jax.devices()),
+    }
+
+
 CONFIGS = {
     "deepfm": bench_deepfm,
     "resnet50": bench_resnet50,
@@ -2168,6 +2423,7 @@ CONFIGS = {
     "multihost": bench_multihost,  # `bench.py multihost --hosts N`
     "online": bench_online,        # streaming freshness/lifecycle mode
     "rpc": bench_rpc,              # event-loop/mux wire echo ladder
+    "fleet": bench_fleet,  # autopilot soak: `bench.py fleet --trace`
 }
 
 
@@ -2260,6 +2516,7 @@ def _preflight_gather_kernel(n: int, dim: int, pass_keys: int) -> None:
 
 def main() -> None:
     global SERVE_CLIENTS, SERVE_REPLICAS, MULTIHOST_HOSTS, SLOT_AUC
+    global FLEET_TRACE
     argv = list(sys.argv[1:])
     if "--slot-auc" in argv:
         i = argv.index("--slot-auc")
@@ -2282,6 +2539,18 @@ def main() -> None:
         i = argv.index("--hosts")
         MULTIHOST_HOSTS = int(argv[i + 1]) if i + 1 < len(argv) else 2
         del argv[i:i + 2]
+    if "--trace" in argv:
+        # `bench.py fleet --trace [seed[,duration_s[,rps]]]` — the spec
+        # is optional (defaults in bench_fleet); a bare --trace keeps
+        # the seeded defaults.
+        i = argv.index("--trace")
+        if i + 1 < len(argv) and not argv[i + 1].startswith("-") \
+                and argv[i + 1] not in CONFIGS:
+            FLEET_TRACE = argv[i + 1]
+            del argv[i:i + 2]
+        else:
+            FLEET_TRACE = ""
+            del argv[i]
     name = argv[0] if argv else "deepfm"
     # Liveness probe: one tiny device round-trip. A dead tunnel hangs
     # HERE, inside the short early-watchdog tier, producing a structured

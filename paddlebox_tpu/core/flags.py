@@ -839,3 +839,43 @@ define_flag("incident_min_interval_s", 60.0,
             "one bundle per interval per process — a flapping alert "
             "must not turn the flight recorder into a disk-filling "
             "loop; suppressed captures count incident/rate_limited")
+define_flag("autopilot_poll_s", 0.5,
+            "fleet autopilot control-loop cadence "
+            "(serving/autopilot.py): each tick reads the merged fleet "
+            "stats + active alerts and may emit at most one scale "
+            "action and one canary transition")
+define_flag("autopilot_cooldown_s", 5.0,
+            "hysteresis guard between consecutive autopilot scale "
+            "actions (out, in, or shard repair): inside the cooldown "
+            "the loop observes but never acts — a flapping sensor "
+            "produces at most one action per window. Persisted in the "
+            "controller state file, so a restarted controller honors "
+            "the window instead of double-applying")
+define_flag("autopilot_min_replicas", 1,
+            "scale-in floor: the autopilot never drains the fleet "
+            "below this many healthy replicas")
+define_flag("autopilot_max_replicas", 8,
+            "scale-out ceiling: the autopilot never spawns past this "
+            "many healthy replicas, whatever the burn rate says")
+define_flag("autopilot_scale_in_fill", 0.1,
+            "scale-in trigger: merged batch_fill_frac below this with "
+            "zero SLO-violation delta and p99 under half the SLO means "
+            "the fleet is over-provisioned — drain the least-loaded "
+            "replica (subject to the cooldown and the floor)")
+define_flag("autopilot_canary_replicas", 1,
+            "canary subset size: a new donefile BASE lands on this "
+            "many replicas first (clamped so at least one incumbent "
+            "keeps serving the old model for the COPC comparison)")
+define_flag("autopilot_canary_min_labels", 64,
+            "joined label rows each side (canary and incumbent) of "
+            "the quality comparison needs before the controller "
+            "renders a promote/rollback verdict")
+define_flag("autopilot_canary_copc_margin", 0.2,
+            "rollback objective: the canary's |COPC - 1| may exceed "
+            "the incumbent's by at most this margin; past it the base "
+            "is judged calibration-poisoned and rolled back")
+define_flag("autopilot_canary_timeout_s", 60.0,
+            "fail-closed canary deadline: a canary that cannot gather "
+            "enough joined labels for a verdict within this window is "
+            "rolled back (objective 'timeout'), never promoted on "
+            "missing evidence. <= 0 disables the deadline")

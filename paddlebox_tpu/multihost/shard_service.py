@@ -1301,7 +1301,17 @@ class ShardClient:
         self.endpoint = endpoint
         self._timeout = timeout
         self._replicas_fn = replicas_fn
-        self._conn = self._make_conn(endpoint)
+        try:
+            self._conn = self._make_conn(endpoint)
+        except (OSError, ConnectionError):
+            if replicas_fn is None:
+                raise
+            # Replicated slot with a dead primary AT CLIENT BUILD TIME
+            # — a replica joining mid-failover (the autopilot spawns
+            # joiners precisely while hosts are dying). Defer: the
+            # first call builds the conn, and its read failover walks
+            # the replica set if the primary is still down.
+            self._conn = None
 
     def _make_conn(self, endpoint: str) -> rpc.FramedRPCConn:
         return rpc.FramedRPCConn(
@@ -1320,7 +1330,10 @@ class ShardClient:
 
     def call(self, method: str, **kw):
         try:
-            return self._conn.call(method, **kw)
+            conn = self._conn
+            if conn is None:
+                conn = self._conn = self._make_conn(self.endpoint)
+            return conn.call(method, **kw)
         except (OSError, ConnectionError, wire.WireError):
             if self._replicas_fn is None or method not in self.READS:
                 raise
@@ -1340,7 +1353,8 @@ class ShardClient:
                 # re-enter this loop against the full candidate list).
                 old, self._conn = self._conn, conn
                 try:
-                    old.close()
+                    if old is not None:
+                        old.close()
                 except OSError:
                     pass
                 monitor.add("multihost/replica_failovers", 1)
@@ -1363,11 +1377,22 @@ class ShardClient:
         over re-issues it synchronously through :meth:`call`; anything
         else re-raises (the caller owns catch-up, exactly as with the
         blocking path)."""
-        return _ShardFuture(self, self._conn.call_async(method, **kw),
+        conn = self._conn
+        if conn is None:
+            try:
+                conn = self._conn = self._make_conn(self.endpoint)
+            except (OSError, ConnectionError):
+                if method not in _ShardFuture._REISSUE:
+                    raise
+                # Dead primary on a deferred conn: resolve through the
+                # synchronous failover path at result() time.
+                return _ShardFuture(self, None, method, kw)
+        return _ShardFuture(self, conn.call_async(method, **kw),
                             method, kw)
 
     def close(self) -> None:
-        self._conn.close()
+        if self._conn is not None:
+            self._conn.close()
 
 
 class _ShardFuture:
@@ -1385,6 +1410,11 @@ class _ShardFuture:
         self._kw = kw
 
     def result(self, timeout: Optional[float] = None):
+        if self._fut is None:
+            # call_async could not even build a conn to the primary
+            # (deferred-conn client, primary dead): straight to the
+            # synchronous failover path.
+            return self._client.call(self._method, **self._kw)
         try:
             return self._fut.result(timeout)
         except (OSError, ConnectionError, wire.WireError):

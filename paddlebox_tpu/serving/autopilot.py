@@ -1,0 +1,606 @@
+"""Fleet autopilot: the actuator loop that closes the control loop.
+
+PRs 11-18 built every sensor (``alerts_active`` burn-rate states,
+``metrics_history`` rings, the one-scrape cluster snapshot, the PR 15
+quality observatory) and every actuator (``start_replica()``/eject in
+``serving/fleet.py``, shard re-replication repair, donefile publish) —
+this module connects them, the way an SRE would (AUTOPILOT.md has the
+full control-loop diagram and action table):
+
+- :class:`Autoscaler` — a poll loop over the merged fleet stats and the
+  active alert set: scale OUT on a predict-p99/violation burn, scale IN
+  on a cold over-provisioned fleet, repair the shard tier on replica
+  lag. Every action is hysteresis-guarded
+  (``FLAGS_autopilot_cooldown_s``), clamped
+  (``FLAGS_autopilot_{min,max}_replicas``), bounded to one per poll,
+  counted under ``autopilot/actions/<kind>``, and journaled to a state
+  file BEFORE it applies — a controller killed inside an action window
+  resumes past the cooldown instead of double-applying.
+- :class:`CanaryController` — COPC-gated publish: a new donefile BASE
+  (pass_id == 0, which the per-replica publishers deliberately skip)
+  lands on a FLAGS-sized canary subset first; the controller compares
+  canary vs incumbent calibration on sampled live labels through the
+  PR 15 ``ServingQuality`` join (the ``quality/copc`` gauges in each
+  replica's ``metrics_snapshot``), then promotes to full fanout or
+  rolls the canary back to the incumbent base — the poisoned model
+  never reaches full fanout, and the verdict lands as one
+  ``autopilot_report {json}`` line.
+- :class:`FleetAutopilot` — both controllers behind one background
+  thread at ``FLAGS_autopilot_poll_s``; tests and drills call
+  ``poll_once`` directly for determinism.
+
+Faultpoints ``autopilot/{scale_out,scale_in,canary_promote,
+canary_rollback}`` sit between the journal write and the action —
+ROBUSTNESS.md's crash-drill window for "resume without double-apply".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol, DoneRecord
+from paddlebox_tpu.core import alerts, faults, flags, incident, log, monitor
+
+# Alert names (core/alerts.py default rule pack) whose FIRING state is a
+# scale-out signal on its own — the burn says the SLO is being missed.
+_SCALE_OUT_ALERTS = frozenset({"serving_predict_p99",
+                               "slo_violation_burn"})
+# Replica-state gauge encoding (fleet/replica_state/<rid>), shared with
+# serving/fleet.py's gauge publisher.
+STATE_CODES = {"joining": 0.0, "healthy": 1.0, "degraded": 2.0,
+               "ejected": 3.0}
+
+
+class ControllerState:
+    """Crash-safe controller journal: one small JSON file written
+    tmp+fsync+replace (the donefile discipline). The journal is written
+    BEFORE an action applies, so a controller killed inside the action
+    window (the ``autopilot/*`` faultpoints) resumes knowing the intent
+    — the cooldown stamp suppresses a double scale action, and the
+    canary phase is re-driven idempotently instead of half-promoted.
+    ``path=None`` keeps the journal in memory (pure in-process tests)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.data: Dict[str, Any] = {"last_action": {}, "canary": None,
+                                     "seen_bases": [], "incumbent": None}
+        if path is not None and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self.data.update(json.load(f))
+            except (OSError, ValueError) as e:
+                log.warning("autopilot: state %s unreadable (%r) — "
+                            "starting fresh", path, e)
+
+    def save(self) -> None:
+        if self.path is None:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.data, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # -- cooldown stamps ---------------------------------------------------
+
+    def last_action_ts(self, group: str) -> float:
+        return float(self.data["last_action"].get(group, 0.0))
+
+    def stamp(self, group: str, now: float) -> None:
+        self.data["last_action"][group] = float(now)
+        self.save()
+
+
+def _record_action(kind: str, reason: str,
+                   registries: Sequence = ()) -> None:
+    """One action: counter in the global registry (and any attached
+    instance registries) + incident-recorder context, so a later bundle
+    names what the autopilot last did and why."""
+    monitor.add(f"autopilot/actions/{kind}", 1)
+    for reg in registries:
+        reg.add(f"autopilot/actions/{kind}", 1)
+    incident.set_context(autopilot_last_action=f"{kind}: {reason}")
+
+
+class Autoscaler:
+    """Scale replicas out/in and repair the shard tier off the sensor
+    plane. Construction wires the actuators explicitly:
+
+    - ``stats_fn`` — merged fleet stats (a router's ``handle_stats``
+      payload: ``latency_ms``/``slo_violations``/per-replica briefs);
+    - ``spawn`` — start one replica (``start_replica`` in a process, a
+      subprocess worker in the drill); returns its id for the log;
+    - ``retire(rid)`` — stop a drained replica's server/process;
+    - ``shard_repair`` — the PR 13 ``ElasticReshardController.repair``
+      seam (probe + promote + re-replicate).
+
+    ``alerts_fn`` defaults to the process-global alert engine; tests
+    inject a fake feed. The loop never raises out of ``poll_once`` —
+    a sensor read failure is a warning, not a dead autopilot."""
+
+    def __init__(self, fleet, stats_fn: Callable[[], Dict], *,
+                 spawn: Optional[Callable[[], str]] = None,
+                 retire: Optional[Callable[[str], None]] = None,
+                 shard_repair: Optional[Callable[[], Any]] = None,
+                 alerts_fn: Callable[[], List[Dict]] =
+                 alerts.active_alerts,
+                 state: Optional[ControllerState] = None,
+                 registry: Optional[monitor.Monitor] = None,
+                 clock: Callable[[], float] = time.time):
+        self.fleet = fleet
+        self._stats_fn = stats_fn
+        self._spawn = spawn
+        self._retire = retire
+        self._shard_repair = shard_repair
+        self._alerts_fn = alerts_fn
+        self.state = state or ControllerState()
+        self._regs = (registry,) if registry is not None else ()
+        self._clock = clock
+        self._seen_violations = -1
+        self.actions: List[Dict[str, Any]] = []
+
+    # -- sensor digestion --------------------------------------------------
+
+    def _cooldown_ok(self, group: str, now: float) -> bool:
+        cd = max(float(flags.flag("autopilot_cooldown_s")), 0.0)
+        return now - self.state.last_action_ts(group) >= cd
+
+    def read_sensors(self) -> Dict[str, Any]:
+        """One digest of the plane: merged p99, mean batch fill, the
+        violation delta since the previous poll, and the firing alert
+        names. Sensor failures degrade to an empty reading."""
+        try:
+            st = self._stats_fn()
+        except Exception as e:  # noqa: BLE001 - the loop must survive
+            log.warning("autopilot: stats read failed: %r", e)
+            return {}
+        p99 = (st.get("latency_ms") or {}).get("p99") or 0.0
+        fills = [b["stats"].get("batch_fill_frac", 0.0)
+                 for b in (st.get("replicas") or {}).values()
+                 if isinstance(b.get("stats"), dict)]
+        viol = int(st.get("slo_violations", 0))
+        delta = (max(0, viol - self._seen_violations)
+                 if self._seen_violations >= 0 else 0)
+        self._seen_violations = viol
+        firing = {a["name"] for a in self._alerts_fn()
+                  if a.get("state") == "firing"}
+        return {"p99_ms": float(p99),
+                "fill": (sum(fills) / len(fills)) if fills else None,
+                "violation_delta": delta,
+                "firing": firing,
+                "fleet_size": int(self.fleet.size())}
+
+    # -- actions -----------------------------------------------------------
+
+    def _scale_out(self, now: float, reason: str) -> Dict[str, Any]:
+        # Journal the intent FIRST: a kill between the stamp and the
+        # spawn costs one cooldown of capacity, never a double spawn.
+        self.state.stamp("scale", now)
+        faults.faultpoint("autopilot/scale_out")
+        rid = self._spawn() if self._spawn is not None else None
+        _record_action("scale_out", reason, self._regs)
+        log.warning("autopilot: scale OUT (%s) -> %s", reason, rid)
+        return {"kind": "scale_out", "reason": reason, "replica": rid,
+                "t": now}
+
+    def _scale_in(self, now: float, reason: str) -> Optional[Dict]:
+        # Graceful drain: drop the least-loaded healthy replica from
+        # the ring (its in-flight requests finish on their open conns;
+        # new ones route elsewhere), then retire its server.
+        victims = sorted(self.fleet.healthy(),
+                         key=lambda r: (r.inflight, r.routed, r.id))
+        if not victims:
+            return None
+        victim = victims[0]
+        self.state.stamp("scale", now)
+        faults.faultpoint("autopilot/scale_in")
+        self.fleet.remove_replica(victim.id)
+        if self._retire is not None:
+            self._retire(victim.id)
+        _record_action("scale_in", reason, self._regs)
+        log.warning("autopilot: scale IN (%s): drained %s", reason,
+                    victim.id)
+        return {"kind": "scale_in", "reason": reason,
+                "replica": victim.id, "t": now}
+
+    def poll_once(self, now: Optional[float] = None) -> List[Dict]:
+        """One control tick: read sensors, apply AT MOST one scale
+        action (hysteresis + clamps) and at most one shard repair.
+        Returns the actions taken (also appended to ``self.actions``)."""
+        now = self._clock() if now is None else now
+        monitor.add("autopilot/polls", 1)
+        for reg in self._regs:
+            reg.add("autopilot/polls", 1)
+        sense = self.read_sensors()
+        taken: List[Dict[str, Any]] = []
+        if sense:
+            n = sense["fleet_size"]
+            slo = float(flags.flag("serving_slo_p99_ms"))
+            lo = max(int(flags.flag("autopilot_min_replicas")), 1)
+            hi = max(int(flags.flag("autopilot_max_replicas")), lo)
+            breach_alerts = sense["firing"] & _SCALE_OUT_ALERTS
+            # Heal is a breach too: a kill -9 that drops the healthy
+            # count under the floor must re-grow capacity without
+            # waiting for the latency it will soon cost to show up.
+            below_min = 0 < n < lo
+            breach = bool(breach_alerts) or below_min or (
+                slo > 0 and (sense["p99_ms"] > slo
+                             or sense["violation_delta"] > 0))
+            if breach_alerts:
+                reason = f"alerts={sorted(breach_alerts)}"
+            elif below_min:
+                reason = f"healthy={n} < min_replicas={lo}"
+            else:
+                reason = (f"p99={sense['p99_ms']:.1f}ms "
+                          f"viol_delta={sense['violation_delta']}")
+            if breach and n > 0 and n < hi \
+                    and self._cooldown_ok("scale", now) \
+                    and self._spawn is not None:
+                taken.append(self._scale_out(now, reason))
+            elif (not breach and sense["fill"] is not None
+                  and sense["fill"] < float(
+                      flags.flag("autopilot_scale_in_fill"))
+                  and sense["violation_delta"] == 0
+                  and (slo <= 0 or sense["p99_ms"] < 0.5 * slo)
+                  and n > lo and self._cooldown_ok("scale", now)):
+                act = self._scale_in(
+                    now, f"fill={sense['fill']:.3f} idle fleet")
+                if act is not None:
+                    taken.append(act)
+        # Shard-tier rebalance: the replication-lag gauge the replicated
+        # tier publishes (multihost/replica_lag_p99) past the alert
+        # threshold — or its burn alert firing — drives the PR 13
+        # promote/re-replicate repair. Its own cooldown group: a shard
+        # repair must not eat the replica-scale budget.
+        lag_thresh = float(flags.flag("alerts_replica_lag"))
+        lag = monitor.get_gauge("multihost/replica_lag_p99", 0.0)
+        lag_firing = "replica_lag_p99" in (sense.get("firing") or ())
+        if self._shard_repair is not None \
+                and (lag_firing or (lag_thresh > 0 and lag > lag_thresh)) \
+                and self._cooldown_ok("shard", now):
+            self.state.stamp("shard", now)
+            try:
+                audit = self._shard_repair()
+            except Exception as e:  # noqa: BLE001 - keep the loop alive
+                log.warning("autopilot: shard repair failed: %r", e)
+            else:
+                _record_action("shard_repair",
+                               f"lag_p99={lag:.1f}", self._regs)
+                taken.append({"kind": "shard_repair", "t": now,
+                              "lag_p99": lag, "audit": audit})
+        self.actions.extend(taken)
+        return taken
+
+
+class CanaryController:
+    """COPC-gated canary publish over the donefile protocol.
+
+    Watches ``root``'s donefile for NEW day-level base records
+    (pass_id == 0 — the records every per-replica
+    :class:`~paddlebox_tpu.serving.publisher.DonefilePublisher`
+    deliberately skips: base rollout is a controller action, not a tail
+    apply). State machine, journaled per transition::
+
+        watch --new base--> canary --copc ok--> promoting -> watch
+                               |                   (full fanout,
+                               |                    new incumbent)
+                               +--breach/timeout--> rolling_back -> watch
+                                                    (incumbent re-applied
+                                                     on the canary set)
+
+    The verdict compares |COPC - 1| of the canary subset vs the
+    incumbent subset, each read from the replicas' ``quality/copc``
+    gauges once both sides joined ``FLAGS_autopilot_canary_min_labels``
+    live labels since the canary began — the PR 15 sampled-label join
+    is the evidence, not a synthetic probe. Every transition emits one
+    ``autopilot_report {json}`` line naming the verdict and objective.
+    """
+
+    def __init__(self, fleet, root: str, *, table: str = "embedding",
+                 state: Optional[ControllerState] = None,
+                 registry: Optional[monitor.Monitor] = None,
+                 clock: Callable[[], float] = time.time):
+        self.fleet = fleet
+        self.table = table
+        self._proto = CheckpointProtocol(root)
+        self.state = state or ControllerState()
+        self._regs = (registry,) if registry is not None else ()
+        self._clock = clock
+        self.reports: List[Dict[str, Any]] = []
+        if self.state.data.get("incumbent") is None \
+                and not self.state.data.get("seen_bases"):
+            # First boot: the bases already published are the model the
+            # operator stood the fleet up from — the LAST one is the
+            # incumbent, none of them canary.
+            bases = self._bases()
+            self.state.data["seen_bases"] = [self._tag(b) for b in bases]
+            if bases:
+                self.state.data["incumbent"] = bases[-1]._asdict() \
+                    if hasattr(bases[-1], "_asdict") else {
+                        "day": bases[-1].day, "key": bases[-1].key,
+                        "path": bases[-1].path,
+                        "pass_id": bases[-1].pass_id}
+            self.state.save()
+
+    # -- donefile scan -----------------------------------------------------
+
+    @staticmethod
+    def _tag(rec) -> List[str]:
+        return [str(rec.day), str(rec.path)]
+
+    def _bases(self) -> List[DoneRecord]:
+        try:
+            return [r for r in self._proto.records() if r.pass_id == 0]
+        except (OSError, ValueError) as e:
+            log.warning("canary: donefile read failed: %r", e)
+            return []
+
+    def incumbent(self) -> Optional[Dict[str, Any]]:
+        return self.state.data.get("incumbent")
+
+    # -- replica RPC helpers ----------------------------------------------
+
+    def _call(self, replica, method: str, **kw):
+        conn = replica.pool.acquire()
+        try:
+            out = conn.call(method, **kw)
+        except BaseException:
+            conn.close()
+            raise
+        replica.pool.release(conn)
+        return out
+
+    def _apply_base(self, replica, path: str) -> None:
+        self._call(replica, "apply_delta", path=path, table=self.table,
+                   kind="xbox")
+
+    def _quality_read(self, replica) -> Dict[str, float]:
+        snap = self._call(replica, "metrics_snapshot")
+        gauges = snap.get("gauges") or {}
+        counters = snap.get("counters") or {}
+        return {"copc": gauges.get("quality/copc"),
+                "joined": float(counters.get("quality/label_joined", 0)),
+                "alarms": float(sum(
+                    v for k, v in counters.items()
+                    if k.startswith("quality/alarms/")))}
+
+    # -- reporting ---------------------------------------------------------
+
+    def _report(self, verdict: str, objective: str,
+                detail: Dict[str, Any]) -> None:
+        rec = {"verdict": verdict, "objective": objective, **detail}
+        self.reports.append(rec)
+        print("autopilot_report " + json.dumps(rec, default=str),
+              flush=True)
+
+    # -- state machine -----------------------------------------------------
+
+    def _begin_canary(self, rec: DoneRecord, now: float) -> None:
+        healthy = sorted(self.fleet.healthy(), key=lambda r: r.id)
+        k = max(int(flags.flag("autopilot_canary_replicas")), 1)
+        # At least one incumbent must keep serving the old model or
+        # there is nothing to compare against.
+        k = min(k, max(len(healthy) - 1, 0))
+        if k == 0:
+            log.warning("canary: fleet too small for a canary subset "
+                        "(%d healthy) — base %s held", len(healthy),
+                        rec.path)
+            return
+        subset = [r.id for r in healthy[:k]]
+        labels0 = {}
+        for r in healthy:
+            try:
+                labels0[r.id] = self._quality_read(r)["joined"]
+            except Exception:  # noqa: BLE001 - replica may be mid-join
+                labels0[r.id] = 0.0
+        self.state.data["canary"] = {
+            "phase": "canary",
+            "day": rec.day, "key": rec.key, "path": rec.path,
+            "pass_id": rec.pass_id, "canary_ids": subset,
+            "since": now, "labels0": labels0}
+        self.state.data["seen_bases"].append(self._tag(rec))
+        # Journal BEFORE applying: a kill mid-apply resumes in phase
+        # 'canary' and re-applies idempotently (apply_update overwrites
+        # the same rows) instead of leaving an unknown subset.
+        self.state.save()
+        for rid in subset:
+            r = self.fleet.get(rid)
+            if r is not None:
+                self._apply_base(r, rec.path)
+        _record_action("canary_start",
+                       f"base {rec.day} -> {subset}", self._regs)
+        log.warning("canary: base %s/%s staged on %s", rec.day,
+                    rec.path, subset)
+
+    def _verdict(self, can: Dict[str, Any], now: float
+                 ) -> Optional[Dict[str, Any]]:
+        """None = keep gathering; else {'promote': bool, 'objective',
+        sides}."""
+        subset = set(can["canary_ids"])
+        labels0 = can.get("labels0") or {}
+        sides: Dict[str, List[Dict[str, float]]] = {"canary": [],
+                                                    "incumbent": []}
+        for r in self.fleet.healthy():
+            try:
+                q = self._quality_read(r)
+            except Exception:  # noqa: BLE001 - a dying replica abstains
+                continue
+            q["joined_new"] = q["joined"] - float(
+                labels0.get(r.id, 0.0))
+            sides["canary" if r.id in subset else "incumbent"].append(q)
+        need = max(int(flags.flag("autopilot_canary_min_labels")), 0)
+
+        def ready(rows):
+            return rows and all(x["copc"] is not None for x in rows) \
+                and sum(x["joined_new"] for x in rows) >= need
+
+        if not (ready(sides["canary"]) and ready(sides["incumbent"])):
+            timeout = float(flags.flag("autopilot_canary_timeout_s"))
+            if timeout > 0 and now - float(can["since"]) > timeout:
+                return {"promote": False, "objective": "timeout",
+                        "sides": sides}
+            return None
+
+        def dev(rows):
+            return sum(abs(x["copc"] - 1.0) for x in rows) / len(rows)
+
+        margin = float(flags.flag("autopilot_canary_copc_margin"))
+        c_dev, i_dev = dev(sides["canary"]), dev(sides["incumbent"])
+        if c_dev > i_dev + margin:
+            return {"promote": False, "objective": "copc",
+                    "canary_copc_dev": c_dev,
+                    "incumbent_copc_dev": i_dev, "sides": sides}
+        return {"promote": True, "objective": "copc",
+                "canary_copc_dev": c_dev, "incumbent_copc_dev": i_dev,
+                "sides": sides}
+
+    def _promote(self, can: Dict[str, Any], verdict: Dict) -> None:
+        can["phase"] = "promoting"
+        self.state.save()
+        faults.faultpoint("autopilot/canary_promote")
+        subset = set(can["canary_ids"])
+        for r in self.fleet.healthy():
+            if r.id not in subset:
+                self._apply_base(r, can["path"])
+        self.state.data["incumbent"] = {
+            "day": can["day"], "key": can["key"], "path": can["path"],
+            "pass_id": can["pass_id"]}
+        self.state.data["canary"] = None
+        self.state.save()
+        _record_action("canary_promote",
+                       f"base {can['day']} full fanout", self._regs)
+        self._report("promote", verdict.get("objective", "copc"), {
+            "day": can["day"], "path": can["path"],
+            "canary": sorted(subset),
+            "canary_copc_dev": verdict.get("canary_copc_dev"),
+            "incumbent_copc_dev": verdict.get("incumbent_copc_dev")})
+
+    def _rollback(self, can: Dict[str, Any], verdict: Dict) -> None:
+        can["phase"] = "rolling_back"
+        self.state.save()
+        faults.faultpoint("autopilot/canary_rollback")
+        inc = self.incumbent()
+        for rid in can["canary_ids"]:
+            r = self.fleet.get(rid)
+            if r is None:
+                continue
+            if inc is not None:
+                # Republish the incumbent base on the canary replica:
+                # its rollback_to handler re-applies the prior base
+                # atomically and bumps serving/hotswap_rollbacks.
+                self._call(r, "rollback_to", day=inc["day"],
+                           key=inc.get("key", ""), path=inc["path"],
+                           pass_id=int(inc.get("pass_id", 0)),
+                           table=self.table)
+        self.state.data["canary"] = None
+        self.state.save()
+        _record_action("canary_rollback",
+                       f"base {can['day']}: {verdict.get('objective')}",
+                       self._regs)
+        self._report("rollback", verdict.get("objective", "copc"), {
+            "day": can["day"], "path": can["path"],
+            "canary": can["canary_ids"],
+            "canary_copc_dev": verdict.get("canary_copc_dev"),
+            "incumbent_copc_dev": verdict.get("incumbent_copc_dev"),
+            "restored": (inc or {}).get("path")})
+
+    def poll_once(self, now: Optional[float] = None) -> Optional[str]:
+        """One canary tick. Returns the transition taken (``canary``/
+        ``promote``/``rollback``) or None."""
+        now = self._clock() if now is None else now
+        can = self.state.data.get("canary")
+        if can is None:
+            seen = {tuple(t) for t in self.state.data["seen_bases"]}
+            for rec in self._bases():
+                if tuple(self._tag(rec)) not in seen:
+                    self._begin_canary(rec, now)
+                    return "canary"
+            return None
+        # Crash resume: a journaled decision re-drives idempotently.
+        if can["phase"] == "promoting":
+            self._promote(can, {"objective": "resume"})
+            return "promote"
+        if can["phase"] == "rolling_back":
+            self._rollback(can, {"objective": "resume"})
+            return "rollback"
+        verdict = self._verdict(can, now)
+        if verdict is None:
+            return None
+        if verdict["promote"]:
+            self._promote(can, verdict)
+            return "promote"
+        self._rollback(can, verdict)
+        return "rollback"
+
+
+class FleetAutopilot:
+    """Both controllers behind one poll thread. ``state_path`` journals
+    both (one file): the crash-drill contract is that killing this
+    process inside any ``autopilot/*`` faultpoint and restarting it
+    with the same path resumes without double-applied scale actions or
+    a half-promoted canary."""
+
+    def __init__(self, fleet, stats_fn: Callable[[], Dict], *,
+                 donefile_root: Optional[str] = None,
+                 table: str = "embedding",
+                 spawn: Optional[Callable[[], str]] = None,
+                 retire: Optional[Callable[[str], None]] = None,
+                 shard_repair: Optional[Callable[[], Any]] = None,
+                 alerts_fn: Callable[[], List[Dict]] =
+                 alerts.active_alerts,
+                 state_path: Optional[str] = None,
+                 registry: Optional[monitor.Monitor] = None,
+                 clock: Callable[[], float] = time.time):
+        self.state = ControllerState(state_path)
+        self.scaler = Autoscaler(
+            fleet, stats_fn, spawn=spawn, retire=retire,
+            shard_repair=shard_repair, alerts_fn=alerts_fn,
+            state=self.state, registry=registry, clock=clock)
+        self.canary = None
+        if donefile_root is not None:
+            self.canary = CanaryController(
+                fleet, donefile_root, table=table, state=self.state,
+                registry=registry, clock=clock)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self, now: Optional[float] = None) -> List[Dict]:
+        acts = self.scaler.poll_once(now)
+        if self.canary is not None:
+            try:
+                t = self.canary.poll_once(now)
+            except Exception as e:  # noqa: BLE001 - loop must survive
+                log.warning("autopilot: canary tick failed: %r", e)
+            else:
+                if t is not None:
+                    acts.append({"kind": f"canary_{t}"})
+        return acts
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-autopilot")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 - keep polling
+                log.warning("autopilot: poll failed: %r", e)
+            self._stop.wait(max(
+                float(flags.flag("autopilot_poll_s")), 0.05))
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+            self._thread = None

@@ -47,6 +47,10 @@ from paddlebox_tpu.core import faults, flags, log, monitor, trace
 from paddlebox_tpu.distributed import rpc
 
 _SERVING = ("healthy", "degraded")   # states the ring routes to
+# Gauge encoding for fleet/replica_state/<rid> (metrics_snapshot
+# topology view; serving/autopilot.py mirrors this table).
+_STATE_CODES = {"joining": 0.0, "healthy": 1.0, "degraded": 2.0,
+                "ejected": 3.0}
 
 
 def stable_hash64(s: str) -> int:
@@ -192,10 +196,42 @@ class ServingFleet:
         self._replica_timeout = replica_timeout
         # Seam for tests: (replica) -> stats dict. Default RPCs.
         self._stats_call = stats_call or self._stats_rpc
+        # Instance registries mirroring the topology gauges (a router
+        # attaches its own so ONE metrics_snapshot on it carries the
+        # whole membership picture — no stats fan-out needed).
+        self._registries: List[monitor.Monitor] = []
         self._running = False
         self._thread: Optional[threading.Thread] = None
 
+    def attach_registry(self, registry: monitor.Monitor) -> None:
+        """Mirror ``fleet/topology_epoch`` + per-replica state gauges
+        into ``registry`` (the owning router's instance registry, so its
+        ``metrics_snapshot`` exposes membership in one scrape)."""
+        with self._lock:
+            if registry not in self._registries:
+                self._registries.append(registry)
+            self._publish_gauges_locked()
+
     # -- membership --------------------------------------------------------
+
+    def _publish_gauges_locked(self) -> None:
+        """Topology as gauges: ``fleet/topology_epoch`` plus one
+        ``fleet/replica_state/<rid>`` per known replica, encoded
+        0=joining 1=healthy 2=degraded 3=ejected (DEGRADED is a healthy
+        replica whose SLO admission window tripped). The autoscaler and
+        ``fleet_top`` read these from any single ``metrics_snapshot``
+        instead of fanning stats out to every replica."""
+        monitor.set_gauge("fleet/topology_epoch", float(self.epoch))
+        for reg in self._registries:
+            reg.set_gauge("fleet/topology_epoch", float(self.epoch))
+        for r in self._replicas.values():
+            if r.state == "healthy" and r.admission == "degraded":
+                code = _STATE_CODES["degraded"]
+            else:
+                code = _STATE_CODES.get(r.state, 0.0)
+            monitor.set_gauge(f"fleet/replica_state/{r.id}", code)
+            for reg in self._registries:
+                reg.set_gauge(f"fleet/replica_state/{r.id}", code)
 
     def _bump_epoch_locked(self) -> None:
         self.epoch += 1
@@ -206,6 +242,7 @@ class ServingFleet:
         monitor.set_gauge("fleet/epoch", float(self.epoch))
         monitor.set_gauge("fleet/replicas", float(sum(
             1 for r in self._replicas.values() if r.state == "healthy")))
+        self._publish_gauges_locked()
 
     def add_replica(self, rid: str, endpoint: str, *,
                     source: str = "static", ready: bool = False) -> Replica:
@@ -236,6 +273,13 @@ class ServingFleet:
                 return
             monitor.add("fleet/left", 1)
             self._bump_epoch_locked()
+            # The departed replica's state gauge must not freeze at its
+            # last serving code — observers reading one snapshot would
+            # keep counting it as live capacity.
+            code = _STATE_CODES["ejected"]
+            monitor.set_gauge(f"fleet/replica_state/{rid}", code)
+            for reg in self._registries:
+                reg.set_gauge(f"fleet/replica_state/{rid}", code)
         r.pool.close()
         log.vlog(0, "fleet: replica %s left", rid)
 
@@ -406,6 +450,7 @@ class ServingFleet:
             if r.admission != "degraded":
                 r.admission = "degraded"
                 monitor.add("fleet/admission_trips", 1)
+                self._publish_gauges_locked()
                 log.warning(
                     "fleet: replica %s SLO admission tripped (%d "
                     "violations in window)", r.id, r.window_violations)
@@ -415,6 +460,7 @@ class ServingFleet:
         elif now - r.window_start >= window:
             if r.window_violations == 0 and r.admission != "ok":
                 r.admission = "ok"
+                self._publish_gauges_locked()
                 log.vlog(0, "fleet: replica %s admission restored", r.id)
             r.window_violations = 0
             r.window_start = now
@@ -654,6 +700,30 @@ def start_replica(model, feed_config, *, endpoint: str = "127.0.0.1:0",
     manager is None without an elastic root."""
     from paddlebox_tpu.serving.predictor import CTRPredictor, load_xbox_model
     from paddlebox_tpu.serving.service import PredictServer
+
+    # Fail LOUDLY on a taken port before the expensive part. The bind
+    # itself happens only after the predictor build + warm-up below —
+    # minutes on a real model — so without this probe a supervisor
+    # restarting a replica onto a port the old process still holds
+    # burns the whole build first (and a subprocess worker dies after
+    # its parent gave up waiting on the ready file: a hang, not an
+    # error). Port 0 always binds; nothing to probe.
+    host, _, port = endpoint.rpartition(":")
+    if port not in ("", "0"):
+        import socket
+        probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            # Match create_server's SO_REUSEADDR: a TIME_WAIT remnant
+            # must not fail the probe — only a live listener should.
+            probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            probe.bind((host or "127.0.0.1", int(port)))
+        except OSError as e:
+            raise RuntimeError(
+                f"start_replica: endpoint {endpoint} is already bound "
+                f"({e}) — refusing to build a predictor for a port "
+                "this replica can never serve on") from e
+        finally:
+            probe.close()
 
     backing = None
     if shard_endpoints:

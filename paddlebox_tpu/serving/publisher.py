@@ -124,3 +124,28 @@ class DonefilePublisher:
                             "failed: %r — skipped", rec.day,
                             rec.pass_id, rec.path, e)
         return n
+
+    # -- rollback ----------------------------------------------------------
+
+    def rollback_to(self, rec) -> int:
+        """Re-apply a PRIOR published record — the reverse gear the
+        forward-only tail lacks. A base record (pass_id == 0) re-applies
+        its full serving-format export, overwriting every row a bad
+        delta (or a rolled-back canary base) touched; a delta record
+        re-applies that delta. The swap is the same single-version
+        ``apply_update`` hot-swap the forward path uses, so it is atomic
+        under the predictor lock. Marks the record seen (the tail must
+        not immediately re-apply it as new work) and bumps
+        ``serving/hotswap_rollbacks``. Returns rows written.
+
+        ``rec`` is a :class:`~paddlebox_tpu.checkpoint.protocol.
+        DoneRecord` or anything with ``day``/``pass_id``/``path``."""
+        kind = "xbox" if int(rec.pass_id) == 0 else "delta"
+        n_new = self.predictor.apply_update_export(
+            rec.path, self.table, kind)
+        self._seen.add((str(rec.day), int(rec.pass_id)))
+        monitor.add("serving/hotswap_rollbacks", 1)
+        log.warning("serving publisher: ROLLED BACK to %s/%d (%s, "
+                    "%d new rows) from %s", rec.day, int(rec.pass_id),
+                    kind, int(n_new), rec.path)
+        return int(n_new)

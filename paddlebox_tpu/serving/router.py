@@ -76,6 +76,11 @@ class FleetRouter(rpc.FramedRPCServer):
         # metrics_history RPC; idle until the sampler is armed.
         self.history = timeseries.history_for(self.metrics,
                                               label="router")
+        # Mirror the fleet's topology gauges (fleet/topology_epoch +
+        # per-replica state codes) into this router's registry: ONE
+        # metrics_snapshot on the router shows membership without a
+        # stats fan-out (what the autoscaler and fleet_top read).
+        self.fleet.attach_registry(self.metrics)
         if start_health:
             self.fleet.start()
         rpc.FramedRPCServer.__init__(self, endpoint, backlog=128)
@@ -91,7 +96,7 @@ class FleetRouter(rpc.FramedRPCServer):
     # -- predict routing ---------------------------------------------------
 
     def _forward(self, replica: Replica, lines: List[str],
-                 degraded: bool):
+                 degraded: bool, rid: Optional[str] = None):
         """One predict attempt against one replica (conn from its
         pool; a broken conn is closed, not returned). Returns
         (reply, replica server ms from the framed reply — None on a
@@ -101,6 +106,11 @@ class FleetRouter(rpc.FramedRPCServer):
             kw = {"lines": lines}
             if degraded:
                 kw["degraded"] = True
+            if rid is not None:
+                # The rid rides to the replica: quality sampling keys
+                # on it there, and the late-label fanout
+                # (handle_labels) joins on the SAME id.
+                kw["rid"] = rid
             out = conn.call("predict", **kw)
             server_ms = conn.last_server_ms
         except BaseException:
@@ -132,8 +142,9 @@ class FleetRouter(rpc.FramedRPCServer):
                 tried.add(replica.id)
                 t_pick = time.perf_counter()
                 try:
-                    probs, srv_ms = self._forward(replica, lines,
-                                                  degraded)
+                    probs, srv_ms = self._forward(
+                        replica, lines, degraded,
+                        rid=req.get("rid"))
                 except (OSError, wire.WireError) as e:
                     # Dead socket / torn reply stream: strike (ejects at
                     # the same threshold as the health thread) and
@@ -188,7 +199,8 @@ class FleetRouter(rpc.FramedRPCServer):
             conn = r.pool.acquire()
             try:
                 got = conn.call("apply_delta", path=req["path"],
-                                table=req.get("table", "embedding"))
+                                table=req.get("table", "embedding"),
+                                kind=req.get("kind", "delta"))
             except BaseException:
                 conn.close()
                 raise
@@ -200,6 +212,31 @@ class FleetRouter(rpc.FramedRPCServer):
             raise RuntimeError("no healthy replica to apply the delta")
         monitor.add("fleet/delta_fanout", applied)
         return int(n_new)
+
+    def handle_labels(self, req) -> dict:
+        """Fan a sampled request's late labels to every healthy replica.
+        The label feed does not know which replica served a rid (the
+        router's hash pick, plus spillover/re-routes, decided that), so
+        it delivers through the router and exactly the replica holding
+        the rid in its pending window joins — the others count a miss,
+        which the quality layer already treats as normal trailing-feed
+        behavior. Returns whether ANY replica joined."""
+        joined = False
+        fanout = 0
+        for r in self.fleet.healthy():
+            conn = r.pool.acquire()
+            try:
+                got = conn.call("labels", rid=str(req["rid"]),
+                                labels=req["labels"])
+            except (OSError, ConnectionError, RuntimeError):
+                conn.close()
+                continue
+            r.pool.release(conn)
+            fanout += 1
+            if got.get("joined"):
+                joined = True
+        self._bump("fleet/label_fanout", 1)
+        return {"joined": joined, "fanout": fanout}
 
     # -- control plane -----------------------------------------------------
 

@@ -191,11 +191,35 @@ class PredictServer(rpc.FramedRPCServer):
         update path — serving_online_update's surface over the wire).
         Routed through ``apply_update_export`` so flat, sharded, and
         dim-grouped delta roots all land."""
+        kind = str(req.get("kind", "delta"))
         with trace.span("serving/apply_delta", path=req["path"]):
+            # kind='xbox' applies a full serving-format BASE export —
+            # the canary controller's staging/promote path (autopilot);
+            # the default 'delta' stays the per-pass online update.
             n_new = self.predictor.apply_update_export(
-                req["path"], req.get("table", "embedding"), "delta")
+                req["path"], req.get("table", "embedding"), kind)
         monitor.add("serving/delta_rpcs", 1)
         return int(n_new)
+
+    def handle_rollback_to(self, req) -> int:
+        """Re-apply a prior published record (autopilot canary rollback
+        / operator reverse gear): routes through the publisher's
+        ``rollback_to`` when this replica tails a donefile — marking
+        the record seen so the tail will not re-apply it — else applies
+        the export directly. Either way bumps
+        ``serving/hotswap_rollbacks``. Returns rows written."""
+        from paddlebox_tpu.checkpoint.protocol import DoneRecord
+        rec = DoneRecord(str(req["day"]), int(req.get("key", 0)),
+                         req["path"], int(req.get("pass_id", 0)))
+        table = req.get("table", "embedding")
+        with trace.span("serving/rollback_to", path=rec.path):
+            if self._publisher is not None:
+                return int(self._publisher.rollback_to(rec))
+            kind = "xbox" if rec.pass_id == 0 else "delta"
+            n_new = self.predictor.apply_update_export(
+                rec.path, table, kind)
+            monitor.add("serving/hotswap_rollbacks", 1)
+            return int(n_new)
 
     def handle_labels(self, req) -> dict:
         """Late labels for a sampled predict (``rid`` + ``labels`` in
@@ -408,8 +432,18 @@ class PredictClient:
         return self._conn.call("labels", rid=str(rid),
                                labels=[float(v) for v in labels])
 
-    def apply_delta(self, path: str, table: str = "embedding") -> int:
-        return self._conn.call("apply_delta", path=path, table=table)
+    def apply_delta(self, path: str, table: str = "embedding",
+                    kind: str = "delta") -> int:
+        return self._conn.call("apply_delta", path=path, table=table,
+                               kind=kind)
+
+    def rollback_to(self, day: str, path: str, *, key: int = 0,
+                    pass_id: int = 0, table: str = "embedding") -> int:
+        """Re-apply a prior published record on the replica (the
+        autopilot's canary-rollback actuator)."""
+        return self._conn.call("rollback_to", day=str(day), path=path,
+                               key=int(key), pass_id=int(pass_id),
+                               table=table)
 
     def stats(self) -> dict:
         return self._conn.call("stats")

@@ -1,0 +1,228 @@
+"""Replay-pure serving-traffic trace generator: the fleet bench AND
+the chaos drill harness.
+
+Role of the load half of the autopilot loop (AUTOPILOT.md): the
+reference's serving tier is sized against diurnal, heavily skewed CTR
+traffic — a small hot set of users/items takes most of the lookups
+("Dissecting Embedding Bag Performance in DLRM Inference", PAPERS.md) —
+so the generator that exercises the autoscaler must reproduce exactly
+that shape, deterministically. Everything here derives from an INJECTED
+seed and a VIRTUAL clock:
+
+- the request sequence (timestamps, rids, svm lines) is a pure function
+  of :class:`TraceConfig` — two generators with the same config yield
+  byte-identical traces, which is what makes the chaos drill's
+  bit-identical-routing assertion and the bench's cross-run comparisons
+  meaningful;
+- the rate follows a diurnal sine (``base_rps``/``diurnal_amp``/
+  ``diurnal_period_s``) with scriptable 10x spike windows on top;
+- key draws follow a hot-set split calibrated from the live
+  ``quality/slot_top_share`` gauges the PR 15 observatory collects
+  (:func:`skew_from_gauges`) — the head ``hot_frac`` of the key space
+  takes ``hot_share`` of the draws;
+- chaos events (replica kill -9, shard-host kill, spike, calibration-
+  poisoned base publish) are part of the trace, so a drill IS a trace
+  and replays like one.
+
+graftlint's replay_purity pass roots here: wall-clock reads
+(``time.time``/``datetime.now``) and global RNG draws are contract
+breaks, not style. :func:`replay` paces the virtual timeline against a
+real monotonic clock (monotonic/sleep are pacing, not trace inputs —
+the trace CONTENT never depends on them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import (Callable, Dict, Iterator, List, Mapping, NamedTuple,
+                    Optional, Sequence, Tuple)
+
+import numpy as np
+
+# Chaos kinds the drill harness understands. ``spike`` shapes the rate
+# inside the generator; the other three are handed to the replay
+# driver's handlers (the process-touching half lives with the caller).
+CHAOS_KINDS = ("kill_replica", "kill_shard", "spike", "poison_delta")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One scripted fault on the virtual timeline."""
+
+    at_s: float                 # virtual trace time the event fires
+    kind: str                   # one of CHAOS_KINDS
+    arg: str = ""               # replica id / shard endpoint / export path
+    duration_s: float = 0.0     # spike window length (spike only)
+    factor: float = 10.0        # spike rate multiplier (spike only)
+
+    def __post_init__(self):
+        if self.kind not in CHAOS_KINDS:
+            raise ValueError(f"unknown chaos kind {self.kind!r} "
+                             f"(want one of {CHAOS_KINDS})")
+
+
+class TraceRequest(NamedTuple):
+    """One replayed predict request: virtual timestamp, deterministic
+    request id (the rid the quality join samples on), and raw svm
+    lines."""
+
+    t: float
+    rid: str
+    lines: Tuple[str, ...]
+
+
+def skew_from_gauges(gauges: Mapping[str, float]) -> Optional[float]:
+    """Hot-set share calibrated from a live metrics snapshot's gauge
+    map: the mean ``quality/slot_top_share/<slot>`` (the head-1%%
+    occurrence share ``core/quality.py`` measures on real ingest), or
+    the cross-slot ``quality/skew_top_share`` when per-slot gauges are
+    absent. None when the observatory has not reported yet."""
+    shares = [float(v) for k, v in gauges.items()
+              if k.startswith("quality/slot_top_share/")]
+    if shares:
+        return min(max(sum(shares) / len(shares), 0.0), 1.0)
+    v = gauges.get("quality/skew_top_share")
+    if v is not None:
+        return min(max(float(v), 0.0), 1.0)
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Everything a trace is a function of. Frozen: the config IS the
+    trace identity (equal configs replay equal traces)."""
+
+    seed: int = 0
+    duration_s: float = 10.0
+    base_rps: float = 50.0
+    # Diurnal shaping: rate(t) = base * (1 + amp * sin(2 pi t / period)),
+    # floored at 5% of base so the trough never stalls the replay.
+    diurnal_amp: float = 0.5
+    diurnal_period_s: float = 10.0
+    # Key-space skew: the head ``hot_frac`` of n_keys takes ``hot_share``
+    # of the draws (the quality observatory's top-share statistic).
+    n_keys: int = 1000
+    hot_frac: float = 0.01
+    hot_share: float = 0.5
+    slots: Tuple[str, ...] = ("u", "i")
+    rows_per_request: int = 2
+    chaos: Tuple[ChaosEvent, ...] = ()
+
+    @classmethod
+    def from_quality(cls, gauges: Mapping[str, float],
+                     **kw) -> "TraceConfig":
+        """Config whose ``hot_share`` is the LIVE skew statistic
+        (``skew_from_gauges``); explicit kwargs win, absent gauges keep
+        the class default."""
+        share = skew_from_gauges(gauges)
+        if share is not None and "hot_share" not in kw:
+            kw["hot_share"] = share
+        return cls(**kw)
+
+
+class TraceGenerator:
+    """Deterministic request stream + chaos schedule for one config."""
+
+    def __init__(self, cfg: TraceConfig):
+        if cfg.n_keys < 2:
+            raise ValueError("n_keys must be >= 2")
+        self.cfg = cfg
+        self._hot_n = max(1, int(cfg.n_keys * cfg.hot_frac))
+
+    # -- rate shape --------------------------------------------------------
+
+    def rate_at(self, t: float) -> float:
+        """Diurnal sine with scripted spike windows folded in."""
+        cfg = self.cfg
+        rate = cfg.base_rps * (1.0 + cfg.diurnal_amp * math.sin(
+            2.0 * math.pi * t / max(cfg.diurnal_period_s, 1e-9)))
+        rate = max(rate, 0.05 * cfg.base_rps)
+        for ev in cfg.chaos:
+            if ev.kind == "spike" and ev.at_s <= t < ev.at_s + \
+                    max(ev.duration_s, 0.0):
+                rate *= max(ev.factor, 1.0)
+        return rate
+
+    # -- request stream ----------------------------------------------------
+
+    def _draw_keys(self, rng: np.random.Generator, n: int) -> np.ndarray:
+        """Hot-set split: each draw comes from the head ``hot_n`` keys
+        with probability ``hot_share``, else uniform over the whole
+        space. Keys are 1-based (0 is the svm label position)."""
+        cfg = self.cfg
+        hot = rng.random(n) < cfg.hot_share
+        keys = rng.integers(1, cfg.n_keys + 1, n)
+        keys[hot] = rng.integers(1, self._hot_n + 1, hot.sum())
+        return keys
+
+    def requests(self) -> Iterator[TraceRequest]:
+        """The trace: virtual-clock-paced TraceRequests. Pure — a fresh
+        iterator replays the identical sequence."""
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed)
+        t = 0.0
+        seq = 0
+        while True:
+            t += 1.0 / self.rate_at(t)
+            if t >= cfg.duration_s:
+                return
+            keys = self._draw_keys(
+                rng, cfg.rows_per_request * len(cfg.slots))
+            lines = []
+            for r in range(cfg.rows_per_request):
+                toks = ["0"]
+                for j, slot in enumerate(cfg.slots):
+                    toks.append(
+                        f"{slot}:{keys[r * len(cfg.slots) + j]}")
+                lines.append(" ".join(toks))
+            yield TraceRequest(t, f"trace-{cfg.seed}-{seq}",
+                               tuple(lines))
+            seq += 1
+
+    def events(self) -> List[ChaosEvent]:
+        """The non-spike chaos schedule in firing order (spikes shape
+        the rate inside ``requests`` and need no handler)."""
+        return sorted((e for e in self.cfg.chaos if e.kind != "spike"),
+                      key=lambda e: e.at_s)
+
+
+def replay(gen: TraceGenerator,
+           send: Callable[[TraceRequest], None], *,
+           handlers: Optional[Mapping[
+               str, Callable[[ChaosEvent], None]]] = None,
+           speed: float = 1.0,
+           clock: Callable[[], float] = time.monotonic,
+           sleep: Callable[[float], None] = time.sleep) -> Dict[str, int]:
+    """Pace the virtual timeline against a real monotonic clock:
+    ``send(req)`` per request (the caller's RPC; its exceptions are the
+    caller's to count), ``handlers[kind](event)`` once as virtual time
+    passes each chaos event. ``speed`` > 1 compresses wall time (the
+    CPU-small bench runs a 60 s trace in 6 s of wall) without changing
+    the trace content. Returns replay counts."""
+    handlers = dict(handlers or {})
+    events = gen.events()
+    next_ev = 0
+    sent = 0
+    fired = 0
+    t0 = clock()
+    for req in gen.requests():
+        while next_ev < len(events) and events[next_ev].at_s <= req.t:
+            ev = events[next_ev]
+            next_ev += 1
+            fn = handlers.get(ev.kind)
+            if fn is not None:
+                fn(ev)
+                fired += 1
+        lag = req.t / max(speed, 1e-9) - (clock() - t0)
+        if lag > 0:
+            sleep(lag)
+        send(req)
+        sent += 1
+    for ev in events[next_ev:]:
+        fn = handlers.get(ev.kind)
+        if fn is not None:
+            fn(ev)
+            fired += 1
+    return {"sent": sent, "events_fired": fired}

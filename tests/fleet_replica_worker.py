@@ -45,15 +45,21 @@ def main() -> None:
     # (the distributed-trace drill kills a shard primary under traffic
     # and expects this replica's miss reads to fail over).
     shard_replicas = int(os.environ.get("PBX_FLEET_SHARD_REPLICAS", "1"))
+    # PBX_FLEET_BASE_EXPORT: donefile base dir to stand the replica up
+    # from (the autopilot chaos drill's canary/rollback target) — keys
+    # in the export serve warm, everything else still resolves misses
+    # against the shard tier.
+    base_export = os.environ.get("PBX_FLEET_BASE_EXPORT") or None
+    kw = {"base_export": base_export} if base_export else {"dim": DIM}
     server, manager = start_replica(
         model, feed,
         dense_params=dense,
         shard_endpoints=[e for e in shard_eps.split(",") if e],
         shard_replicas=shard_replicas,
-        hbm_rows=24, dim=DIM,
+        hbm_rows=24,
         elastic_root=elastic_root, host_id=host_id,
         warm_lines=["0 u:1 i:2", "0 u:3 i:4"],
-        compute_dtype="float32")
+        compute_dtype="float32", **kw)
 
     tmp = ready_file + ".tmp"
     with open(tmp, "w") as f:

@@ -1,0 +1,672 @@
+"""Fleet autopilot unit/contract suite (ISSUE 20).
+
+Pins the control-loop contracts AUTOPILOT.md documents:
+
+- the trace generator is replay-pure — one config yields a
+  byte-identical request sequence and bit-identical replica routing,
+  the diurnal/spike rate shape and the hot-set skew are exactly as
+  configured, and the replay driver fires chaos handlers on the virtual
+  timeline;
+- the autoscaler is hysteresis-guarded (a flap storm produces at most
+  one scale action per cooldown window), clamps to
+  FLAGS_autopilot_{min,max}_replicas, heals a below-floor fleet,
+  drains the least-loaded replica on scale-in, repairs the shard tier
+  on replication lag under its own cooldown, and a controller killed
+  inside the journaled action window resumes without double-applying;
+- the canary controller stages a new donefile base on a bounded subset,
+  confines it there, promotes on clean COPC, rolls back (restoring the
+  incumbent base, bumping ``serving/hotswap_rollbacks``) on a
+  calibration breach, emits one ``autopilot_report {json}`` verdict
+  line per resolution, and re-drives a journaled half-finished
+  promote/rollback idempotently after a crash;
+- the fleet publishes ``fleet/topology_epoch`` + per-replica state
+  gauges into attached instance registries (one metrics_snapshot shows
+  membership), ``start_replica`` fails loudly on a bound port, and
+  ``DonefilePublisher.rollback_to`` re-applies a prior base atomically.
+"""
+
+import contextlib
+import json
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol
+from paddlebox_tpu.core import faults
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.core import monitor
+from paddlebox_tpu.data.parser import parse_lines
+from paddlebox_tpu.data.slots import DataFeedConfig, SlotConf
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.serving import traceload
+from paddlebox_tpu.serving.autopilot import (Autoscaler, CanaryController,
+                                             ControllerState)
+from paddlebox_tpu.serving.batcher import pack_bucketed
+from paddlebox_tpu.serving.fleet import (HashRing, ServingFleet,
+                                         route_key_hash, start_replica)
+from paddlebox_tpu.serving.predictor import CTRPredictor, load_xbox_model
+from paddlebox_tpu.serving.publisher import DonefilePublisher
+from paddlebox_tpu.serving.service import PredictClient, PredictServer
+
+SLOTS = ("u", "i")
+DIM = 4
+N_KEYS = 64
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@contextlib.contextmanager
+def _flags(**kw):
+    prev = {k: flagmod.flag(k) for k in kw}
+    flagmod.set_flags(kw)
+    try:
+        yield
+    finally:
+        flagmod.set_flags(prev)
+
+
+# -- trace replay: purity, shape, chaos schedule ------------------------------
+
+
+def test_trace_replay_bit_identical_requests_and_routing():
+    """Two generators from ONE config yield byte-identical request
+    sequences AND bit-identical replica routing through the fleet's
+    consistent-hash ring — the determinism the chaos drill and the
+    bench's cross-run comparisons stand on."""
+    cfg = traceload.TraceConfig(seed=7, duration_s=3.0, base_rps=40.0,
+                                n_keys=500, hot_frac=0.02, hot_share=0.7)
+    a = list(traceload.TraceGenerator(cfg).requests())
+    b = list(traceload.TraceGenerator(cfg).requests())
+    assert a == b
+    assert len(a) > 50
+    ring = HashRing(["rep-0", "rep-1", "rep-2"], 64)
+    owners_a = [ring.lookup(route_key_hash(list(r.lines))) for r in a]
+    owners_b = [ring.lookup(route_key_hash(list(r.lines))) for r in b]
+    assert owners_a == owners_b
+    assert len(set(owners_a)) == 3          # skew still spreads
+    # A different seed is a different trace (the rid carries the seed).
+    c = list(traceload.TraceGenerator(
+        traceload.TraceConfig(seed=8, duration_s=3.0, base_rps=40.0,
+                              n_keys=500)).requests())
+    assert [r.lines for r in c[:20]] != [r.lines for r in a[:20]]
+    assert a[0].rid.startswith("trace-7-")
+    assert c[0].rid.startswith("trace-8-")
+
+
+def test_trace_rate_diurnal_and_spike_shape():
+    spike = traceload.ChaosEvent(at_s=4.0, kind="spike", duration_s=1.0,
+                                 factor=10.0)
+    gen = traceload.TraceGenerator(traceload.TraceConfig(
+        seed=0, duration_s=10.0, base_rps=100.0, diurnal_amp=0.9,
+        diurnal_period_s=10.0, chaos=(spike,)))
+    # Peak near t=2.5 (sin max), trough near t=7.5 floored at 5%.
+    assert gen.rate_at(2.5) == pytest.approx(190.0)
+    assert gen.rate_at(7.5) >= 5.0
+    # The spike window multiplies whatever the diurnal curve says.
+    assert gen.rate_at(4.5) == pytest.approx(10.0 * gen.rate_at(3.9),
+                                             rel=0.2)
+    assert gen.rate_at(5.1) < gen.rate_at(4.5) / 5
+
+
+def test_trace_hot_set_skew_and_quality_calibration():
+    cfg = traceload.TraceConfig(seed=3, duration_s=20.0, base_rps=50.0,
+                                n_keys=1000, hot_frac=0.01,
+                                hot_share=0.8)
+    gen = traceload.TraceGenerator(cfg)
+    hot_n = max(1, int(cfg.n_keys * cfg.hot_frac))
+    keys = []
+    for req in gen.requests():
+        for line in req.lines:
+            keys.extend(int(tok.split(":")[1])
+                        for tok in line.split()[1:])
+    keys = np.asarray(keys)
+    share = float((keys <= hot_n).mean())
+    # hot_share of draws from the head, plus the uniform tail's overlap.
+    assert 0.7 < share < 0.9, share
+    # Skew calibrated from live observatory gauges; explicit kw wins.
+    gauges = {"quality/slot_top_share/u": 0.6,
+              "quality/slot_top_share/i": 0.2}
+    assert traceload.skew_from_gauges(gauges) == pytest.approx(0.4)
+    assert traceload.skew_from_gauges(
+        {"quality/skew_top_share": 0.33}) == pytest.approx(0.33)
+    assert traceload.skew_from_gauges({}) is None
+    assert traceload.TraceConfig.from_quality(
+        gauges).hot_share == pytest.approx(0.4)
+    assert traceload.TraceConfig.from_quality(
+        gauges, hot_share=0.9).hot_share == 0.9
+
+
+def test_replay_virtual_clock_pacing_and_chaos_handlers():
+    """The replay driver paces the virtual timeline against an injected
+    clock and fires each non-spike chaos handler exactly once, in
+    virtual-time order, between the requests that straddle it."""
+    kill = traceload.ChaosEvent(at_s=1.0, kind="kill_replica", arg="r1")
+    poison = traceload.ChaosEvent(at_s=2.0, kind="poison_delta",
+                                  arg="20260807")
+    gen = traceload.TraceGenerator(traceload.TraceConfig(
+        seed=1, duration_s=3.0, base_rps=20.0,
+        chaos=(poison, kill)))
+    now = [0.0]
+    fired = []
+    sent = []
+
+    def clock():
+        return now[0]
+
+    def sleep(dt):
+        now[0] += dt
+
+    out = traceload.replay(
+        gen, lambda req: sent.append(req.t),
+        handlers={"kill_replica": lambda ev: fired.append(("kill",
+                                                           ev.arg)),
+                  "poison_delta": lambda ev: fired.append(("poison",
+                                                           ev.arg))},
+        speed=2.0, clock=clock, sleep=sleep)
+    assert out["sent"] == len(sent) == len(list(gen.requests()))
+    assert out["events_fired"] == 2
+    assert fired == [("kill", "r1"), ("poison", "20260807")]
+    # speed=2 compresses the 3 s virtual trace into ~1.5 s of clock.
+    assert now[0] == pytest.approx(sent[-1] / 2.0, abs=0.1)
+
+
+# -- autoscaler: hysteresis, clamps, heal, crash resume -----------------------
+
+
+class _Rep:
+    def __init__(self, rid, inflight=0, routed=0):
+        self.id = rid
+        self.inflight = inflight
+        self.routed = routed
+        self.state = "healthy"
+        self.admission = "ok"
+
+
+class _Fleet:
+    def __init__(self, rids):
+        self._r = {rid: _Rep(rid) for rid in rids}
+
+    def healthy(self):
+        return sorted(self._r.values(), key=lambda r: r.id)
+
+    def size(self):
+        return len(self._r)
+
+    def remove_replica(self, rid):
+        self._r.pop(rid, None)
+
+    def get(self, rid):
+        return self._r.get(rid)
+
+
+def _stats(p99=10.0, viol=0, fills=(0.8, 0.8)):
+    return {"latency_ms": {"p99": p99}, "slo_violations": viol,
+            "replicas": {f"r{i}": {"stats": {"batch_fill_frac": f}}
+                         for i, f in enumerate(fills)}}
+
+
+def test_autoscaler_flap_storm_one_action_per_cooldown():
+    """Hysteresis: a p99 flap storm (breach on every poll) inside one
+    cooldown window produces exactly ONE scale-out; the next window
+    admits exactly one more."""
+    spawns = []
+    fleet = _Fleet(["a", "b"])
+    sc = Autoscaler(fleet, lambda: _stats(p99=500.0),
+                    spawn=lambda: spawns.append("n") or f"n{len(spawns)}",
+                    alerts_fn=lambda: [], state=ControllerState(),
+                    clock=lambda: 0.0)
+    with _flags(serving_slo_p99_ms=100.0, autopilot_cooldown_s=10.0,
+                autopilot_min_replicas=1, autopilot_max_replicas=8):
+        for t in range(10):                      # one cooldown window
+            sc.poll_once(now=100.0 + t)
+        assert len(spawns) == 1
+        sc.poll_once(now=111.0)                  # next window opens
+        assert len(spawns) == 2
+        assert all(a["kind"] == "scale_out" for a in sc.actions)
+
+
+def test_autoscaler_alert_breach_and_max_clamp():
+    """A firing burn alert is a breach on its own — and the max-replica
+    clamp wins over any breach signal."""
+    spawns = []
+    firing = [{"name": "slo_violation_burn", "state": "firing"}]
+    sc = Autoscaler(_Fleet(["a", "b"]), lambda: _stats(p99=1.0),
+                    spawn=lambda: spawns.append("n") or "n",
+                    alerts_fn=lambda: firing, state=ControllerState(),
+                    clock=lambda: 0.0)
+    with _flags(serving_slo_p99_ms=100.0, autopilot_cooldown_s=1.0,
+                autopilot_min_replicas=1, autopilot_max_replicas=2):
+        sc.poll_once(now=0.0)
+        assert spawns == []                      # n == max: clamped
+    with _flags(serving_slo_p99_ms=100.0, autopilot_cooldown_s=1.0,
+                autopilot_min_replicas=1, autopilot_max_replicas=4):
+        sc.poll_once(now=10.0)
+        assert len(spawns) == 1
+        assert "slo_violation_burn" in sc.actions[-1]["reason"]
+
+
+def test_autoscaler_below_min_heals_without_latency_signal():
+    """A kill that drops the healthy count under the floor re-grows
+    capacity even when every latency sensor still reads clean."""
+    spawns = []
+    sc = Autoscaler(_Fleet(["a"]), lambda: _stats(p99=1.0),
+                    spawn=lambda: spawns.append("n") or "heal-0",
+                    alerts_fn=lambda: [], state=ControllerState(),
+                    clock=lambda: 0.0)
+    with _flags(serving_slo_p99_ms=1000.0, autopilot_cooldown_s=1.0,
+                autopilot_min_replicas=2, autopilot_max_replicas=4):
+        acts = sc.poll_once(now=10.0)
+    assert len(spawns) == 1
+    assert "min_replicas" in acts[0]["reason"]
+
+
+def test_autoscaler_scale_in_drains_least_loaded_to_floor():
+    fleet = _Fleet(["a", "b", "c"])
+    fleet.get("a").inflight = 5
+    fleet.get("c").inflight = 1
+    retired = []
+    sc = Autoscaler(fleet, lambda: _stats(p99=5.0, fills=(0.02, 0.03)),
+                    spawn=lambda: "n", retire=retired.append,
+                    alerts_fn=lambda: [], state=ControllerState(),
+                    clock=lambda: 0.0)
+    with _flags(serving_slo_p99_ms=1000.0, autopilot_cooldown_s=10.0,
+                autopilot_min_replicas=1, autopilot_max_replicas=4,
+                autopilot_scale_in_fill=0.1):
+        sc.poll_once(now=100.0)
+        assert retired == ["b"]                  # least (inflight, routed)
+        sc.poll_once(now=101.0)                  # same window: held
+        assert len(retired) == 1
+        sc.poll_once(now=120.0)
+        assert retired == ["b", "c"]
+        sc.poll_once(now=140.0)                  # n == min: floor holds
+        assert len(retired) == 2
+    assert fleet.size() == 1
+
+
+def test_autoscaler_crash_resume_no_double_spawn(tmp_path):
+    """Kill the controller INSIDE the scale-out window (journal stamped,
+    action not yet applied): a restarted controller on the same journal
+    honors the cooldown — one window of lost capacity, never a double
+    spawn."""
+    path = str(tmp_path / "autopilot.json")
+    spawns = []
+    with _flags(serving_slo_p99_ms=100.0, autopilot_cooldown_s=10.0,
+                autopilot_min_replicas=1, autopilot_max_replicas=8):
+        sc = Autoscaler(_Fleet(["a"]), lambda: _stats(p99=500.0),
+                        spawn=lambda: spawns.append("n") or "n",
+                        alerts_fn=lambda: [],
+                        state=ControllerState(path),
+                        clock=lambda: 0.0)
+        faults.configure("autopilot/scale_out:raise=IOError")
+        with pytest.raises(OSError):
+            sc.poll_once(now=100.0)
+        assert spawns == []                      # died before the spawn
+        faults.clear()
+        # Restarted controller, same journal: inside the stamped window
+        # the breach does NOT re-spawn; past it, exactly one spawn.
+        sc2 = Autoscaler(_Fleet(["a"]), lambda: _stats(p99=500.0),
+                         spawn=lambda: spawns.append("n") or "n",
+                         alerts_fn=lambda: [],
+                         state=ControllerState(path),
+                         clock=lambda: 0.0)
+        sc2.poll_once(now=105.0)
+        assert spawns == []
+        sc2.poll_once(now=110.5)
+        assert len(spawns) == 1
+
+
+def test_autoscaler_shard_repair_on_replica_lag():
+    """Replication lag past FLAGS_alerts_replica_lag drives the shard
+    repair actuator under its OWN cooldown group (a shard repair must
+    not eat the replica-scale budget)."""
+    repairs = []
+    sc = Autoscaler(_Fleet(["a", "b"]), lambda: _stats(p99=1.0),
+                    spawn=lambda: "n",
+                    shard_repair=lambda: repairs.append("r") or {"ok": 1},
+                    alerts_fn=lambda: [], state=ControllerState(),
+                    clock=lambda: 0.0)
+    try:
+        monitor.set_gauge("multihost/replica_lag_p99", 50.0)
+        with _flags(serving_slo_p99_ms=1000.0, autopilot_cooldown_s=10.0,
+                    autopilot_min_replicas=1, autopilot_max_replicas=4,
+                    alerts_replica_lag=8.0):
+            sc.poll_once(now=100.0)
+            sc.poll_once(now=101.0)              # same window: held
+            assert len(repairs) == 1
+            sc.poll_once(now=120.0)
+            assert len(repairs) == 2
+        assert all(a["kind"] == "shard_repair" for a in sc.actions)
+    finally:
+        monitor.set_gauge("multihost/replica_lag_p99", 0.0)
+
+
+def test_controller_state_journal_roundtrip(tmp_path):
+    path = str(tmp_path / "state.json")
+    st = ControllerState(path)
+    st.stamp("scale", 42.0)
+    st.data["incumbent"] = {"day": "20260801"}
+    st.save()
+    st2 = ControllerState(path)
+    assert st2.last_action_ts("scale") == 42.0
+    assert st2.data["incumbent"]["day"] == "20260801"
+    # Garbage journal: start fresh, never crash the controller.
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert ControllerState(path).last_action_ts("scale") == 0.0
+
+
+# -- canary publish controller ------------------------------------------------
+
+
+def _feed():
+    return DataFeedConfig(
+        slots=tuple(SlotConf(s, avg_len=1.0) for s in SLOTS),
+        batch_size=16)
+
+
+def _mk_canary_fleet(tmp_path, n=3):
+    """n in-process replicas serving one published donefile base, in a
+    ServingFleet the canary controller drives over real RPCs."""
+    import jax
+    model = DeepFM(slot_names=SLOTS, emb_dim=DIM, hidden=())
+    dense = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(5)
+    keys = np.arange(1, N_KEYS + 1, dtype=np.uint64)
+    emb = rng.normal(size=(N_KEYS, DIM)).astype(np.float32) * 0.05
+    w = rng.normal(size=(N_KEYS,)).astype(np.float32) * 0.05
+    root = str(tmp_path / "publish")
+    proto = CheckpointProtocol(root)
+
+    def write_base(day, e, ww):
+        d = proto.model_dir(day, 0)
+        os.makedirs(d, exist_ok=True)
+        np.savez(os.path.join(d, "embedding.xbox.npz"),
+                 keys=keys, emb=e, w=ww)
+        return d
+
+    base = write_base("20260801", emb, w)
+    proto.publish("20260801")
+    fleet = ServingFleet()
+    servers = {}
+    for i in range(n):
+        k2, e2, w2 = load_xbox_model(base, "embedding")
+        pred = CTRPredictor(model, _feed(), k2, e2, w2, dense,
+                            compute_dtype="float32")
+        s = PredictServer("127.0.0.1:0", pred, replica_id=f"rep-{i}")
+        servers[f"rep-{i}"] = s
+        fleet.add_replica(f"rep-{i}", s.endpoint, ready=True)
+    return fleet, servers, proto, write_base, (keys, emb, w)
+
+
+def _probs(endpoint, lines):
+    cli = PredictClient(endpoint)
+    try:
+        return cli.predict(lines)
+    finally:
+        cli.close()
+
+
+_PROBE = ["0 u:3 i:9", "0 u:17 i:40", "0 u:60 i:2"]
+
+
+def _plant_copc(servers, values):
+    for rid, v in values.items():
+        servers[rid].metrics.set_gauge("quality/copc", v)
+
+
+def test_canary_stage_confine_and_promote(tmp_path):
+    fleet, servers, proto, write_base, (keys, emb, w) = \
+        _mk_canary_fleet(tmp_path)
+    try:
+        with _flags(autopilot_canary_replicas=1,
+                    autopilot_canary_min_labels=0,
+                    autopilot_canary_copc_margin=0.2,
+                    autopilot_canary_timeout_s=60.0):
+            ctrl = CanaryController(
+                fleet, str(tmp_path / "publish"),
+                state=ControllerState(str(tmp_path / "ap.json")),
+                clock=lambda: 100.0)
+            # The base the fleet stood up from is the incumbent, not a
+            # canary.
+            assert ctrl.poll_once() is None
+            assert ctrl.incumbent()["day"] == "20260801"
+            before = _probs(servers["rep-1"].endpoint, _PROBE)
+
+            write_base("20260802", -emb, w)
+            proto.publish("20260802")
+            assert ctrl.poll_once() == "canary"
+            can = ctrl.state.data["canary"]
+            assert can["canary_ids"] == ["rep-0"]     # FLAGS-sized subset
+            # Confined: the canary replica serves the NEW base, the
+            # incumbents still serve the old one.
+            canary_probs = _probs(servers["rep-0"].endpoint, _PROBE)
+            assert not np.allclose(canary_probs, before)
+            np.testing.assert_array_equal(
+                _probs(servers["rep-1"].endpoint, _PROBE), before)
+            # No verdict until both sides report COPC.
+            assert ctrl.poll_once() is None
+            _plant_copc(servers, {"rep-0": 1.01, "rep-1": 0.99,
+                                  "rep-2": 1.0})
+            n_promote = monitor.get("autopilot/actions/canary_promote")
+            assert ctrl.poll_once() == "promote"
+            # Full fanout: every replica now serves the canary's model;
+            # the new base is the incumbent.
+            for s in servers.values():
+                np.testing.assert_array_equal(
+                    _probs(s.endpoint, _PROBE), canary_probs)
+            assert ctrl.incumbent()["day"] == "20260802"
+            assert ctrl.state.data["canary"] is None
+            assert monitor.get("autopilot/actions/canary_promote") == \
+                n_promote + 1
+            assert ctrl.reports[-1]["verdict"] == "promote"
+            assert ctrl.poll_once() is None           # seen, not re-staged
+    finally:
+        for s in servers.values():
+            s.stop()
+
+
+def test_canary_rollback_confines_poisoned_base(tmp_path, capsys):
+    fleet, servers, proto, write_base, (keys, emb, w) = \
+        _mk_canary_fleet(tmp_path)
+    try:
+        with _flags(autopilot_canary_replicas=1,
+                    autopilot_canary_min_labels=0,
+                    autopilot_canary_copc_margin=0.2,
+                    autopilot_canary_timeout_s=60.0):
+            ctrl = CanaryController(
+                fleet, str(tmp_path / "publish"),
+                state=ControllerState(str(tmp_path / "ap.json")),
+                clock=lambda: 100.0)
+            ctrl.poll_once()
+            incumbent_probs = _probs(servers["rep-0"].endpoint, _PROBE)
+            write_base("20260803", emb + 5.0, w + 5.0)   # poisoned
+            proto.publish("20260803")
+            assert ctrl.poll_once() == "canary"
+            assert not np.allclose(
+                _probs(servers["rep-0"].endpoint, _PROBE),
+                incumbent_probs)
+            # Breached calibration on the canary side only.
+            _plant_copc(servers, {"rep-0": 0.5, "rep-1": 1.0,
+                                  "rep-2": 1.0})
+            n_rb = monitor.get("serving/hotswap_rollbacks")
+            assert ctrl.poll_once() == "rollback"
+            # The incumbent base is RESTORED on the canary replica; the
+            # poisoned model never reached the other replicas.
+            np.testing.assert_array_equal(
+                _probs(servers["rep-0"].endpoint, _PROBE),
+                incumbent_probs)
+            np.testing.assert_array_equal(
+                _probs(servers["rep-2"].endpoint, _PROBE),
+                incumbent_probs)
+            assert monitor.get("serving/hotswap_rollbacks") == n_rb + 1
+            assert ctrl.incumbent()["day"] == "20260801"
+            rep = ctrl.reports[-1]
+            assert rep["verdict"] == "rollback"
+            assert rep["objective"] == "copc"
+            # One machine-readable verdict line.
+            lines = [ln for ln in capsys.readouterr().out.splitlines()
+                     if ln.startswith("autopilot_report ")]
+            assert lines, "no autopilot_report line emitted"
+            parsed = json.loads(lines[-1].split(" ", 1)[1])
+            assert parsed["verdict"] == "rollback"
+            assert parsed["objective"] == "copc"
+            # The bad base stays seen: never re-staged.
+            assert ctrl.poll_once() is None
+    finally:
+        for s in servers.values():
+            s.stop()
+
+
+def test_canary_crash_resume_never_half_promoted(tmp_path):
+    """Kill the controller inside the promote (and then the rollback)
+    faultpoint window: the journaled phase re-drives idempotently on
+    restart — the fleet always converges to all-new or all-incumbent,
+    never a half-promoted split."""
+    fleet, servers, proto, write_base, (keys, emb, w) = \
+        _mk_canary_fleet(tmp_path)
+    path = str(tmp_path / "ap.json")
+    try:
+        with _flags(autopilot_canary_replicas=1,
+                    autopilot_canary_min_labels=0,
+                    autopilot_canary_copc_margin=0.2,
+                    autopilot_canary_timeout_s=60.0):
+            ctrl = CanaryController(fleet, str(tmp_path / "publish"),
+                                    state=ControllerState(path),
+                                    clock=lambda: 100.0)
+            ctrl.poll_once()
+            write_base("20260804", -emb, w)
+            proto.publish("20260804")
+            assert ctrl.poll_once() == "canary"
+            canary_probs = _probs(servers["rep-0"].endpoint, _PROBE)
+            _plant_copc(servers, {"rep-0": 1.0, "rep-1": 1.0,
+                                  "rep-2": 1.0})
+            faults.configure("autopilot/canary_promote:raise=IOError")
+            with pytest.raises(OSError):
+                ctrl.poll_once()
+            faults.clear()
+            # Restart on the same journal: the promote re-drives.
+            ctrl2 = CanaryController(fleet, str(tmp_path / "publish"),
+                                     state=ControllerState(path),
+                                     clock=lambda: 200.0)
+            assert ctrl2.poll_once() == "promote"
+            for s in servers.values():
+                np.testing.assert_array_equal(
+                    _probs(s.endpoint, _PROBE), canary_probs)
+            assert ctrl2.incumbent()["day"] == "20260804"
+            assert ctrl2.poll_once() is None
+
+            # Same contract for a rollback killed mid-flight.
+            write_base("20260805", emb + 5.0, w + 5.0)
+            proto.publish("20260805")
+            assert ctrl2.poll_once() == "canary"
+            _plant_copc(servers, {"rep-0": 0.4, "rep-1": 1.0,
+                                  "rep-2": 1.0})
+            faults.configure("autopilot/canary_rollback:raise=IOError")
+            with pytest.raises(OSError):
+                ctrl2.poll_once()
+            faults.clear()
+            ctrl3 = CanaryController(fleet, str(tmp_path / "publish"),
+                                     state=ControllerState(path),
+                                     clock=lambda: 300.0)
+            assert ctrl3.poll_once() == "rollback"
+            for s in servers.values():
+                np.testing.assert_array_equal(
+                    _probs(s.endpoint, _PROBE), canary_probs)
+            assert ctrl3.incumbent()["day"] == "20260804"
+    finally:
+        for s in servers.values():
+            s.stop()
+
+
+# -- fleet topology gauges + loud port conflict -------------------------------
+
+
+def test_fleet_topology_gauges_in_attached_registry():
+    fleet = ServingFleet()
+    reg = monitor.Monitor()
+    fleet.attach_registry(reg)
+    fleet.add_replica("a", "127.0.0.1:1", ready=True)
+    fleet.add_replica("b", "127.0.0.1:2", ready=False)
+    snap = reg.snapshot_all()
+    g = snap["gauges"]
+    assert g["fleet/topology_epoch"] == float(fleet.epoch)
+    assert g["fleet/replica_state/a"] == 1.0      # healthy
+    assert g["fleet/replica_state/b"] == 0.0      # joining
+    epoch0 = fleet.epoch
+    fleet.remove_replica("a")
+    g = reg.snapshot_all()["gauges"]
+    assert g["fleet/topology_epoch"] == float(fleet.epoch) > epoch0
+    assert g["fleet/replica_state/a"] == 3.0      # left the fleet
+    # The process-global registry mirrors the same picture.
+    assert monitor.get_gauge("fleet/replica_state/b") == 0.0
+
+
+def test_start_replica_bound_port_fails_loudly():
+    """A supervisor restarting a replica onto a port the old process
+    still holds must get an immediate error, not a predictor build
+    followed by a hang."""
+    holder = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    holder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    holder.bind(("127.0.0.1", 0))
+    holder.listen(1)
+    port = holder.getsockname()[1]
+    try:
+        with pytest.raises(RuntimeError, match="already bound"):
+            start_replica(None, None,
+                          endpoint=f"127.0.0.1:{port}")
+    finally:
+        holder.close()
+
+
+# -- publisher reverse gear ---------------------------------------------------
+
+
+def test_publisher_rollback_to_restores_base(tmp_path):
+    import jax
+    model = DeepFM(slot_names=SLOTS, emb_dim=DIM, hidden=())
+    dense = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(9)
+    keys = np.arange(1, N_KEYS + 1, dtype=np.uint64)
+    emb = rng.normal(size=(N_KEYS, DIM)).astype(np.float32) * 0.05
+    w = rng.normal(size=(N_KEYS,)).astype(np.float32) * 0.05
+    root = str(tmp_path / "publish")
+    proto = CheckpointProtocol(root)
+    base_dir = proto.model_dir("20260801", 0)
+    os.makedirs(base_dir, exist_ok=True)
+    np.savez(os.path.join(base_dir, "embedding.xbox.npz"),
+             keys=keys, emb=emb, w=w)
+    proto.publish("20260801")
+
+    pred = CTRPredictor(model, _feed(), keys, emb, w, dense,
+                        compute_dtype="float32")
+
+    def probs():
+        return pred.predict(pack_bucketed(
+            parse_lines(_PROBE, _feed()), _feed()))
+
+    base_probs = probs()
+    pub = DonefilePublisher(pred, root)   # base already seen: provenance
+    delta_dir = proto.model_dir("20260801", 1)
+    os.makedirs(delta_dir, exist_ok=True)
+    np.savez(os.path.join(delta_dir, "embedding.delta.npz"),
+             keys=keys, emb=emb + 1.0, w=w + 1.0)
+    proto.publish("20260801", pass_id=1)
+    assert pub.poll_once() == 1
+    assert not np.allclose(probs(), base_probs)
+
+    base_rec = [r for r in proto.records() if r.pass_id == 0][0]
+    n_rb = monitor.get("serving/hotswap_rollbacks")
+    rows = pub.rollback_to(base_rec)
+    assert rows >= 0
+    np.testing.assert_array_equal(probs(), base_probs)
+    assert monitor.get("serving/hotswap_rollbacks") == n_rb + 1
+    # The reverse gear marks the record seen: the forward tail does not
+    # immediately re-apply it as new work.
+    assert pub.poll_once() == 0

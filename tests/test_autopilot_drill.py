@@ -1,0 +1,339 @@
+"""The ISSUE-20 acceptance drill: chaos-under-load soak with the full
+autopilot loop closed over REAL replica processes.
+
+A seeded, hot-set-skewed trace (serving/traceload.py) replays against a
+router over three replica subprocesses (``fleet_replica_worker.py``)
+standing on a REPLICATED 2-host shard tier, with the FleetAutopilot
+driving the actuators. The chaos script rides the trace:
+
+- a 10x rate spike,
+- a replica kill -9 (the autopilot must heal the fleet back over the
+  FLAGS_autopilot_min_replicas floor by spawning a fresh worker
+  process),
+- a shard-host kill (replicated tier: every replica's miss reads fail
+  over, no client sees it),
+- a calibration-poisoned donefile BASE publish (the canary controller
+  stages it on one replica, watches the REAL sampled-label COPC join
+  collapse, and rolls the canary back to the incumbent base — the
+  poisoned model never reaches full fanout).
+
+Acceptance: ZERO failed client RPCs, merged predict p99 under the SLO
+flag, the poisoned model confined + rolled back, and every autopilot
+action visible in ONE telemetry_scrape sweep.
+"""
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from paddlebox_tpu.checkpoint.protocol import CheckpointProtocol
+from paddlebox_tpu.core import flags as flagmod
+from paddlebox_tpu.core import telemetry_scrape
+from paddlebox_tpu.embedding.table import TableConfig
+from paddlebox_tpu.multihost.shard_service import (start_local_shards,
+                                                   stop_shards)
+from paddlebox_tpu.multihost.store import MultiHostStore
+from paddlebox_tpu.serving import traceload
+from paddlebox_tpu.serving.autopilot import FleetAutopilot
+from paddlebox_tpu.serving.router import FleetRouter
+from paddlebox_tpu.serving.service import PredictClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "fleet_replica_worker.py")
+
+DIM = 8
+N_KEYS = 400           # shard tier holds all of these, clean
+N_BASE = 360           # donefile base covers a prefix: the tail keys
+#                        still exercise the shard-tier miss/failover path
+
+_PROBE = ["0 u:5 i:9", "0 u:77 i:123", "0 u:200 i:350"]
+
+
+def _spawn(elastic_root, host_id, shard_eps, ready_file, base_dir):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PBX_FLEET_SHARD_REPLICAS"] = "2"
+    env["PBX_FLEET_BASE_EXPORT"] = base_dir
+    # The drill's labels flow through the router fan-out; every replica
+    # samples every rid so the COPC join is dense enough for a verdict.
+    env["FLAGS_quality_sample_rate"] = "1.0"
+    env["FLAGS_quality_min_events"] = "8"
+    env.pop("PBX_RANK", None)
+    return subprocess.Popen(
+        [sys.executable, WORKER, elastic_root, host_id,
+         ",".join(shard_eps), ready_file],
+        cwd=REPO, env=env, start_new_session=True)
+
+
+def _wait_file(path, timeout=180.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return f.read().strip()
+        time.sleep(0.1)
+    raise TimeoutError(f"worker never wrote {path}")
+
+
+def _wait_healthy(router, want, timeout=180.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if router.fleet.size() >= want:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"fleet never reached {want} healthy: {router.fleet.replicas()}")
+
+
+def test_autopilot_chaos_soak_drill(tmp_path):
+    # Replicated shard tier, populated with the deterministic model.
+    cfg = TableConfig(name="emb", dim=DIM, learning_rate=0.1)
+    shard_servers, shard_eps = start_local_shards(2, cfg, replicas=2)
+    store = MultiHostStore(cfg, shard_eps, replicas=2)
+    rng = np.random.default_rng(3)
+    keys = np.arange(1, N_KEYS + 1, dtype=np.uint64)
+    emb = rng.normal(size=(N_KEYS, DIM)).astype(np.float32) * 0.02
+    w = rng.normal(size=(N_KEYS,)).astype(np.float32) * 0.02
+    rows = store.pull_for_pass(keys)
+    rows["emb"] = emb.copy()
+    rows["w"] = w.copy()
+    store.push_from_pass(keys, rows)
+    store.sync_replicas()
+
+    # Donefile root: the clean incumbent base (published — the model
+    # the workers stand up from) and the poisoned base (written now,
+    # PUBLISHED mid-trace by the chaos event). The poison saturates
+    # every prediction toward 1.0: served COPC collapses to ~0.5
+    # against the alternating labels below.
+    pub_root = str(tmp_path / "publish")
+    proto = CheckpointProtocol(pub_root)
+
+    def write_base(day, e, ww):
+        d = proto.model_dir(day, 0)
+        os.makedirs(d, exist_ok=True)
+        np.savez(os.path.join(d, "embedding.xbox.npz"),
+                 keys=keys[:N_BASE], emb=e[:N_BASE], w=ww[:N_BASE])
+        return d
+
+    base_dir = write_base("20260801", emb, w)
+    proto.publish("20260801")
+    write_base("20260802", emb + 5.0, w + 5.0)
+
+    root = str(tmp_path / "elastic")
+    procs = {}
+    router = None
+    cli = None
+    autopilot = None
+    prev = {k: flagmod.flag(k) for k in (
+        "fleet_health_interval_s", "serving_slo_p99_ms",
+        "autopilot_cooldown_s", "autopilot_min_replicas",
+        "autopilot_max_replicas", "autopilot_poll_s",
+        "autopilot_canary_replicas", "autopilot_canary_min_labels",
+        "autopilot_canary_copc_margin", "autopilot_canary_timeout_s")}
+    flagmod.set_flags({
+        "fleet_health_interval_s": 0.2,
+        "serving_slo_p99_ms": 2000.0,   # generous CPU bound; the drill
+        # asserts p99 stays UNDER it through the spike and the kills
+        "autopilot_cooldown_s": 8.0, "autopilot_min_replicas": 3,
+        "autopilot_max_replicas": 5, "autopilot_poll_s": 0.25,
+        "autopilot_canary_replicas": 1,
+        "autopilot_canary_min_labels": 16,
+        "autopilot_canary_copc_margin": 0.15,
+        "autopilot_canary_timeout_s": 90.0})
+    try:
+        for hid in ("repA", "repB", "repC"):
+            procs[hid] = _spawn(root, hid, shard_eps,
+                                str(tmp_path / f"{hid}.ep"), base_dir)
+        eps = {hid: _wait_file(str(tmp_path / f"{hid}.ep"))
+               for hid in ("repA", "repB", "repC")}
+        router = FleetRouter("127.0.0.1:0", elastic_root=root)
+        _wait_healthy(router, 3)
+
+        # The clean model's answers — identical on every replica (same
+        # base export, same dense seed, same shard tier), and what the
+        # whole fleet must serve again once the poisoned canary is
+        # rolled back.
+        clean_probs = None
+        for ep in eps.values():
+            c = PredictClient(ep)
+            p = c.predict(_PROBE)
+            c.close()
+            if clean_probs is None:
+                clean_probs = p
+            else:
+                np.testing.assert_array_equal(p, clean_probs)
+
+        spawned = {}
+
+        def spawn():
+            # Idempotent actuator: asked again while the last joiner is
+            # still importing jax, hand back the same rid instead of
+            # forking another process.
+            for rid, p in spawned.items():
+                rep = router.fleet.get(rid)
+                if p.poll() is None and (rep is None
+                                         or rep.state != "healthy"):
+                    return rid
+            rid = f"auto-{len(spawned)}"
+            spawned[rid] = procs[rid] = _spawn(
+                root, rid, shard_eps, str(tmp_path / f"{rid}.ep"),
+                base_dir)
+            return rid
+
+        def retire(rid):
+            p = procs.pop(rid, None)
+            spawned.pop(rid, None)
+            if p is not None and p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    p.kill()
+
+        autopilot = FleetAutopilot(
+            router.fleet, lambda: router.handle_stats({}),
+            donefile_root=pub_root, spawn=spawn, retire=retire,
+            registry=router.metrics,
+            state_path=str(tmp_path / "autopilot.json"))
+        autopilot.start()
+
+        dur = 8.0
+        cfg_t = traceload.TraceConfig(
+            seed=0, duration_s=dur, base_rps=25.0, n_keys=N_KEYS,
+            slots=("u", "i"), rows_per_request=2,
+            chaos=(
+                traceload.ChaosEvent(at_s=0.30 * dur, kind="spike",
+                                     duration_s=0.15 * dur, factor=10.0),
+                traceload.ChaosEvent(at_s=0.45 * dur,
+                                     kind="kill_replica", arg="repB"),
+                traceload.ChaosEvent(at_s=0.60 * dur, kind="kill_shard",
+                                     arg="0"),
+                traceload.ChaosEvent(at_s=0.70 * dur,
+                                     kind="poison_delta",
+                                     arg="20260802"),
+            ))
+        gen = traceload.TraceGenerator(cfg_t)
+
+        cli = PredictClient(router.endpoint)
+        failures = []
+
+        def send(req):
+            seq = int(req.rid.rsplit("-", 1)[1])
+            try:
+                out = cli.predict(list(req.lines), rid=req.rid)
+                assert out.shape == (len(req.lines),)
+                cli.send_labels(req.rid,
+                                [(seq + r) % 2
+                                 for r in range(len(req.lines))])
+            except Exception as e:  # noqa: BLE001 - the drill count
+                failures.append((req.rid, repr(e)))
+
+        def kill_replica(ev):
+            p = procs[ev.arg]
+            os.kill(p.pid, signal.SIGKILL)
+            p.wait(timeout=30)
+
+        def kill_shard(ev):
+            shard_servers[int(ev.arg)].kill()
+
+        def poison(ev):
+            proto.publish(ev.arg)
+
+        # Label-join sanity before the chaos starts: a broken sample/
+        # fan-out path would otherwise surface as a canary timeout.
+        warm = traceload.TraceGenerator(
+            dataclasses.replace(cfg_t, seed=99, duration_s=1.0,
+                                chaos=()))
+        for req in warm.requests():
+            send(req)
+        snap = telemetry_scrape.scrape_endpoint(eps["repA"],
+                                                with_stats=False)
+        assert snap["counters"].get("quality/label_joined", 0) > 0, \
+            "label join path is dead — canary verdict would starve"
+
+        replayed = traceload.replay(
+            gen, send, handlers={"kill_replica": kill_replica,
+                                 "kill_shard": kill_shard,
+                                 "poison_delta": poison})
+        assert replayed["events_fired"] == 3
+
+        # Drain: keep labeled traffic flowing until the canary verdict
+        # lands and the fleet heals back over the floor.
+        deadline = time.time() + 150.0
+        extra = 1
+        while time.time() < deadline:
+            canary_open = autopilot.canary.state.data.get(
+                "canary") is not None
+            healed = router.fleet.size() >= 3
+            if not canary_open and healed:
+                break
+            drain = traceload.TraceGenerator(dataclasses.replace(
+                cfg_t, seed=1000 + extra, duration_s=1.5, chaos=()))
+            extra += 1
+            for req in drain.requests():
+                send(req)
+        reports = list(autopilot.canary.reports)
+        st = router.handle_stats({})
+        autopilot.stop()
+
+        # -- acceptance ----------------------------------------------------
+        assert failures == [], failures[:5]
+        # The killed replica left; the autopilot healed the floor.
+        assert router.fleet.size() >= 3, router.fleet.replicas()
+        dead = router.fleet.get("repB")
+        assert dead is None or dead.state == "ejected"
+        assert any(a["kind"] == "scale_out"
+                   for a in autopilot.scaler.actions), \
+            autopilot.scaler.actions
+        # Bounded tail through spike + kills.
+        p99 = (st.get("latency_ms") or {}).get("p99")
+        assert p99 is not None and p99 < 2000.0, st.get("latency_ms")
+        # The poisoned base was staged, breached COPC, and rolled back
+        # — never promoted, and the whole fleet serves the clean model.
+        rollbacks = [r for r in reports if r["verdict"] == "rollback"]
+        assert rollbacks, reports
+        assert rollbacks[-1]["objective"] in ("copc", "timeout")
+        assert not [r for r in reports if r["verdict"] == "promote"]
+        for rep in router.fleet.healthy():
+            c = PredictClient(rep.endpoint)
+            try:
+                np.testing.assert_array_equal(c.predict(_PROBE),
+                                              clean_probs)
+            finally:
+                c.close()
+        # Every action in ONE scrape sweep (the autopilot mirrors its
+        # counters into the router's instance registry).
+        sweep = telemetry_scrape.scrape_cluster(
+            {"router": router.endpoint}, with_stats=False)
+        acts = {k: v
+                for k, v in (sweep["merged"]["counters"] or {}).items()
+                if k.startswith("autopilot/actions/")}
+        assert acts.get("autopilot/actions/scale_out", 0) >= 1, acts
+        assert acts.get("autopilot/actions/canary_start", 0) >= 1, acts
+        assert acts.get("autopilot/actions/canary_rollback", 0) >= 1, \
+            acts
+        router_snap = telemetry_scrape.scrape_endpoint(
+            router.endpoint, with_stats=False)
+        assert router_snap["gauges"].get("fleet/topology_epoch", 0) > 0
+    finally:
+        if autopilot is not None:
+            autopilot.stop()
+        flagmod.set_flags(prev)
+        if cli is not None:
+            cli.close()
+        if router is not None:
+            router.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    p.kill()
+                p.wait(timeout=30)
+        store.close()
+        stop_shards(shard_servers)

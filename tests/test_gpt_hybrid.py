@@ -80,6 +80,8 @@ def test_hybrid_loss_matches_dense(devices8, data, topo):
     np.testing.assert_allclose(float(loss), float(ref), rtol=2e-4)
 
 
+@pytest.mark.slow  # loss parity above is the tier-1 oracle; the
+# 5-step learn loop compiles the full train step and rides tier-2
 def test_hybrid_train_step_learns(devices8, data):
     mesh = build_mesh(HybridTopology(dp=2, pp=2, sp=1, mp=2), devices8)
     params, specs = init_gpt(jax.random.PRNGKey(1), CFG, pp_stages=2)
@@ -95,6 +97,8 @@ def test_hybrid_train_step_learns(devices8, data):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # three extra full-pipeline compiles; the plain
+# 1f1b parity in test_1f1b_wired.py stays tier-1
 def test_interleaved_1f1b_matches_tied_layer_loss(devices8, data):
     """Interleaved GPT wiring: with every layer's params TIED to the same
     values, the composed function is layer-order-invariant, so the

@@ -673,3 +673,44 @@ def test_hbm_residency_keys_direction_and_gating(tmp_path):
     shrunk["table/slot_hbm_bytes"] = 0
     _, regs = perf_gate.compare(shrunk, base)
     assert regs == []
+
+
+def test_autopilot_soak_keys_direction_and_gating(tmp_path):
+    """Round-20 chaos-soak keys: a dropped client RPC
+    (``soak.failed_rpcs``, exact-name lower-better — the drill's
+    baseline is ZERO so any drop trips) and the soaked predict tail
+    (``soak.predict_p99_ms`` via the ``_ms`` suffix) gate the bench;
+    the ACTION counts (``scale_actions``, ``canary_blocked``) are
+    chaos-script provenance — how much healing the script demanded —
+    and must never gate in either direction."""
+    assert perf_gate.direction("soak.failed_rpcs") == -1
+    assert perf_gate.direction("soak.predict_p99_ms") == -1
+    assert perf_gate.direction("soak.degraded_frac") == -1
+    assert perf_gate.direction("soak.scale_actions") == 0
+    assert perf_gate.direction("soak.canary_blocked") == 0
+    base = {"value": 9100.0,
+            "soak": {"failed_rpcs": 0, "predict_p99_ms": 14.0,
+                     "degraded_frac": 0.0, "scale_actions": 2,
+                     "canary_blocked": 1}}
+    b = _write(tmp_path, "soak_base.json", base)
+    assert perf_gate.main([_write(tmp_path, "soak_ok.json", base),
+                           "--baseline", b]) == 0
+    # One dropped RPC under chaos is a robustness regression outright.
+    dropped = copy.deepcopy(base)
+    dropped["soak"]["failed_rpcs"] = 1
+    assert perf_gate.main([_write(tmp_path, "soak_drop.json", dropped),
+                           "--baseline", b]) == 1
+    _, regs = perf_gate.compare(dropped, base)
+    assert {r["metric"] for r in regs} == {"soak.failed_rpcs"}
+    # The soaked tail blowing out gates even with zero failures.
+    slow = copy.deepcopy(base)
+    slow["soak"]["predict_p99_ms"] = 400.0
+    _, regs = perf_gate.compare(slow, base)
+    assert {r["metric"] for r in regs} == {"soak.predict_p99_ms"}
+    # A different chaos script (more kills → more heals, a canary that
+    # promoted instead of blocking) is provenance, never a trip.
+    other = copy.deepcopy(base)
+    other["soak"]["scale_actions"] = 9
+    other["soak"]["canary_blocked"] = 0
+    _, regs = perf_gate.compare(other, base)
+    assert regs == []

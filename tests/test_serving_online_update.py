@@ -187,7 +187,7 @@ def test_concurrent_predict_during_updates(tmp_path):
     t = threading.Thread(target=updater)
     t.start()
     try:
-        for _ in range(30):
+        for _ in range(15):
             probs = pred.predict(batch)
             assert np.isfinite(probs).all()
     finally:

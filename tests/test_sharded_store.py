@@ -85,13 +85,18 @@ def test_incremental_push_much_cheaper_than_rebuild():
     t0 = time.perf_counter()
     shard.push_from_pass(keys, shard.pull_for_pass(keys))
     t_build = time.perf_counter() - t0
-    per_key_build = t_build / n
 
-    small = np.arange(n + 1, n + 10_001, dtype=np.uint64)
-    vals = shard.pull_for_pass(small)
-    t0 = time.perf_counter()
-    shard.push_from_pass(small, vals)
-    t_small = time.perf_counter() - t0
+    # Median of 3 distinct 10k-key deltas: a single GC pause or CI load
+    # spike during one push must not fail the ratio.
+    times = []
+    for r in range(3):
+        lo = n + 1 + r * 10_000
+        small = np.arange(lo, lo + 10_000, dtype=np.uint64)
+        vals = shard.pull_for_pass(small)
+        t0 = time.perf_counter()
+        shard.push_from_pass(small, vals)
+        times.append(time.perf_counter() - t0)
+    t_small = sorted(times)[1]
     # A 10k-key delta must cost far less than rebuilding the 2M-key
     # store (linear per-bucket merges, no store-wide re-sort). Generous
     # 10x margin keeps this stable on loaded CI hosts.

@@ -12,6 +12,8 @@ from paddlebox_tpu.embedding.cache import InputTable, ReplicaCache
 from paddlebox_tpu.utils import DumpWriter, Profiler, profile_pass
 
 
+@pytest.mark.slow  # XPlane start/stop collection dominates; the cheap
+# profile_pass context test below keeps the API in tier-1
 def test_profiler_trace_and_timers(tmp_path):
     prof = Profiler(str(tmp_path / "trace"))
     prof.start()

@@ -63,6 +63,11 @@ class Config:
         # on the closure would be a contract break, not telemetry.
         "paddlebox_tpu.stream.runner:StreamRunner.*",
         "paddlebox_tpu.stream.source:*",
+        # The fleet trace generator replays seeded traces bit-identical
+        # (the autopilot drill's determinism contract): its RNG and
+        # clock are injected — wall time or a global draw would make
+        # two replays of one config disagree.
+        "paddlebox_tpu.serving.traceload:*",
     )
     # suppression
     baseline_path: Optional[str] = None   # default: <pkg>/baseline.json

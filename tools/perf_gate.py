@@ -99,7 +99,14 @@ LOWER_NAMES = ("findings_total", "new", "baselined", "allowed",
                # COPC itself is NOT gated (1.0 is the target; neither
                # direction is monotonic-better), and skew/churn are
                # data provenance, never a regression.
-               "calibration_error")
+               "calibration_error",
+               # autopilot soak (bench.py fleet --trace): any RPC the
+               # chaos replay fails is a dropped prediction — the drill
+               # asserts 0, the gate keeps it 0. predict_p99_ms gates
+               # via the _ms suffix; scale_actions / canary_blocked
+               # are how-the-run-went provenance, never gated (an
+               # autopilot that acts MORE is not a regression).
+               "failed_rpcs")
 
 
 def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
@@ -317,6 +324,18 @@ def smoke() -> int:
                                           "bytes_per_s": 3.9e9}}},
             "mux_over_legacy_at_o4": 2.6,
             "sg_frames": 842,
+            # autopilot soak keys (bench.py fleet --trace): a chaos
+            # replay that fails an RPC dropped a prediction
+            # ("failed_rpcs" exact-name, lower-better) and the merged
+            # predict tail must stay bounded ("_ms"); degraded share
+            # lower-better; the ACTION counts are how-the-controller-
+            # responded provenance and must NOT gate (a run that
+            # scales or blocks a canary more is doing its job).
+            "soak": {"failed_rpcs": 0,
+                     "predict_p99_ms": 12.0,
+                     "degraded_frac": 0.0,
+                     "scale_actions": 1,
+                     "canary_blocked": 1},
             # HBM residency keys (r23 ZeRO-sharded dense state +
             # slot-column offload): measured bytes gate lower-better
             # through the "_bytes" suffix — growing resident state on
@@ -395,6 +414,10 @@ def smoke() -> int:
     bad["sg_frames"] = 3                      # provenance: must NOT gate
     bad["dense/opt_state_hbm_bytes"] *= 3.0   # ZeRO placement lost
     bad["table/slot_hbm_bytes"] *= 4.0        # slot columns back in HBM
+    bad["soak"]["failed_rpcs"] = 3            # chaos replay dropped RPCs
+    bad["soak"]["predict_p99_ms"] = 300.0     # soak tail blown
+    bad["soak"]["scale_actions"] = 9          # provenance: must NOT gate
+    bad["soak"]["canary_blocked"] = 0         # provenance: must NOT gate
     bad["dense_zero"] = "shard"               # provenance: must NOT gate
     bad["table_slot_placement"] = "host"      # provenance: must NOT gate
     _, regs = compare(bad, base)
@@ -422,7 +445,8 @@ def smoke() -> int:
                  "modes.mux.64kb_o4.calls_per_s",
                  "modes.mux.64kb_o4.p99_ms",
                  "dense/opt_state_hbm_bytes",
-                 "table/slot_hbm_bytes"):
+                 "table/slot_hbm_bytes",
+                 "soak.failed_rpcs", "soak.predict_p99_ms"):
         expect(f"planted regression {want!r} detected", want in names,
                True)
     for never in ("ingest_workers", "store_build_native",
@@ -430,7 +454,8 @@ def smoke() -> int:
                   "stream_passes", "events", "telemetry.scrapes",
                   "quality.copc", "quality.skew_top_share",
                   "quality.key_churn", "mux_over_legacy_at_o4",
-                  "sg_frames", "dense_zero", "table_slot_placement"):
+                  "sg_frames", "dense_zero", "table_slot_placement",
+                  "soak.scale_actions", "soak.canary_blocked"):
         expect(f"provenance {never!r} not gated", never in names, False)
     # An IMPROVEMENT must never trip the gate.
     good = json.loads(json.dumps(base))
